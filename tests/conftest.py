@@ -1,0 +1,23 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of running "distributed" tests in-process
+(SURVEY.md §4): instead of loopback gRPC between real hosts, multi-device
+sharding tests run on 8 emulated CPU devices.  Must set env vars before jax
+is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("VENEUR_TPU_TEST", "1")
+
+# A sitecustomize in this image prepends the experimental "axon" TPU-tunnel
+# platform to jax_platforms, overriding the env var — force CPU explicitly so
+# tests don't round-trip every op through the tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

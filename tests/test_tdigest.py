@@ -1,0 +1,220 @@
+"""Statistical validity tests for the batched t-digest kernels.
+
+Mirrors the reference's `tdigest/histo_test.go`: weight conservation and
+centroid size bound (`validateMergingDigest`, histo_test.go:54-70), 2%
+median accuracy on 100k uniform samples (histo_test.go:27), sparse merge
+behavior (histo_test.go:34-49), plus merge-order invariance (which replaces
+the reference's shuffled-re-Add order-debiasing, merging_digest.go:374-389).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veneur_tpu.sketches import tdigest as td
+
+
+def validate_digest(d: td.MergingDigest):
+    """Port of validateMergingDigest (histo_test.go:54-70): centroid size
+    bound and weight conservation.
+
+    The sequential reference guarantees k-span <= 1 per centroid; the
+    parallel midpoint-assignment compressor guarantees k-span <= 2 (a
+    cluster may straddle one scale-function boundary).  Statistical accuracy
+    is equivalent to a sequential digest at compression delta/2 and is
+    enforced directly by the quantile-error assertions below.
+    """
+    means, weights = d.centroids()
+    total = weights.sum()
+    assert total == pytest.approx(d.count(), rel=1e-5)
+
+    delta = d.compression
+    q = 0.0
+    index = 0.0
+    for i, w in enumerate(weights):
+        next_index = delta * (math.asin(2 * min(1.0, q + w / total) - 1) / math.pi + 0.5)
+        if 0 < i < len(weights) - 1:
+            assert next_index - index <= 2 + 1e-4 or w == 1.0, \
+                f"centroid {i} oversized: span {next_index - index}, w={w}"
+        q += w / total
+        index = next_index
+    # structural bound: at most floor(1.5*delta)+1 centroids, within the
+    # reference's ceil(pi*delta/2) bound (merging_digest.go:71)
+    assert len(weights) <= int(1.5 * delta) + 1
+    assert len(weights) <= int(math.pi * delta / 2 + 0.5) + 1
+
+
+def test_uniform_median():
+    rng = np.random.default_rng(42)
+    d = td.MergingDigest(1000)
+    d.add_batch(rng.random(100000))
+    validate_digest(d)
+    assert d.quantile(0.5) == pytest.approx(0.5, rel=0.02)
+    assert d.min() >= 0
+    assert d.max() < 1
+    assert d.sum() > 0
+    assert d.reciprocal_sum() > 0
+
+
+def test_compression_100_accuracy():
+    """The production compression setting (samplers/samplers.go:350)."""
+    rng = np.random.default_rng(7)
+    d = td.MergingDigest(100)
+    data = rng.random(50000)
+    d.add_batch(data)
+    validate_digest(d)
+    for q in (0.25, 0.5, 0.75, 0.9, 0.99):
+        assert d.quantile(q) == pytest.approx(np.quantile(data, q), abs=0.02)
+
+
+def test_sparse_merge():
+    """histo_test.go:34-49."""
+    d = td.MergingDigest(1000)
+    d.add(-200000, 1)
+    other = td.MergingDigest(1000)
+    other.add(200000, 1)
+    d.merge(other)
+    validate_digest(d)
+    assert d.cdf(0) == pytest.approx(0.5, rel=0.02)
+    assert d.quantile(0.5) == pytest.approx(0, abs=0.02)
+    assert d.quantile(0) == pytest.approx(d.min(), rel=0.02)
+    assert d.quantile(1) == pytest.approx(d.max(), rel=0.02)
+    assert d.sum() == pytest.approx(0, abs=0.01)
+
+
+def test_weighted_add():
+    d = td.MergingDigest(100)
+    d.add(10.0, 5.0)
+    d.add(20.0, 5.0)
+    assert d.count() == 10.0
+    assert d.sum() == pytest.approx(150.0)
+    assert d.min() == 10.0
+    assert d.max() == 20.0
+    assert d.reciprocal_sum() == pytest.approx(5 / 10 + 5 / 20)
+
+
+def test_merge_order_invariance():
+    """Merging A into B and B into A must give identical quantiles (the
+    batched merge is a sort-based reduce, so order cannot matter)."""
+    rng = np.random.default_rng(3)
+    a_data = rng.normal(0, 1, 20000)
+    b_data = rng.normal(5, 2, 20000)
+
+    def build(data):
+        d = td.MergingDigest(100)
+        d.add_batch(data)
+        return d
+
+    ab = build(a_data)
+    ab.merge(build(b_data))
+    ba = build(b_data)
+    ba.merge(build(a_data))
+
+    ref = np.concatenate([a_data, b_data])
+    for q in (0.1, 0.5, 0.9):
+        assert ab.quantile(q) == pytest.approx(ba.quantile(q), rel=1e-5)
+        assert ab.quantile(q) == pytest.approx(np.quantile(ref, q), abs=0.1)
+
+
+def test_merge_accuracy_many_digests():
+    """Global-aggregation realism: merging 64 shard digests must preserve
+    quantile accuracy (the hot path of flusher.go:516-591 / worker.go:402)."""
+    rng = np.random.default_rng(11)
+    all_data = []
+    merged = td.MergingDigest(100)
+    for _ in range(64):
+        data = rng.exponential(3.0, 2000)
+        all_data.append(data)
+        shard = td.MergingDigest(100)
+        shard.add_batch(data)
+        merged.merge(shard)
+    validate_digest(merged)
+    ref = np.concatenate(all_data)
+    assert merged.count() == pytest.approx(len(ref), rel=1e-5)
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == pytest.approx(
+            np.quantile(ref, q), rel=0.05)
+
+
+def test_batched_independence():
+    """Rows of the batched state are independent keys."""
+    state = td.empty(3, 100)
+    vals = jnp.array([
+        [1.0, 2.0, 3.0, 4.0],
+        [10.0, 20.0, 30.0, 40.0],
+        [5.0, 5.0, 5.0, 0.0],
+    ], jnp.float32)
+    wts = jnp.array([
+        [1.0, 1.0, 1.0, 1.0],
+        [1.0, 1.0, 1.0, 1.0],
+        [1.0, 1.0, 1.0, 0.0],
+    ], jnp.float32)
+    state = td.ingest(state, vals, wts)
+    w = td.total_weight(state)
+    np.testing.assert_allclose(np.asarray(w), [4.0, 4.0, 3.0])
+    s = td.sum_values(state)
+    np.testing.assert_allclose(np.asarray(s), [10.0, 100.0, 15.0], rtol=1e-5)
+    med = td.quantile(state, [0.5])
+    assert np.asarray(med)[2, 0] == pytest.approx(5.0)
+    aggs = td.aggregates(state)
+    np.testing.assert_allclose(np.asarray(aggs["min"]), [1.0, 10.0, 5.0])
+    np.testing.assert_allclose(np.asarray(aggs["max"]), [4.0, 40.0, 5.0])
+    np.testing.assert_allclose(np.asarray(aggs["avg"]), [2.5, 25.0, 5.0])
+
+
+def test_empty_rows_are_nan():
+    state = td.empty(2, 100)
+    vals = jnp.array([[1.0], [0.0]], jnp.float32)
+    wts = jnp.array([[1.0], [0.0]], jnp.float32)
+    state = td.ingest(state, vals, wts)
+    q = np.asarray(td.quantile(state, [0.5]))
+    assert q[0, 0] == pytest.approx(1.0)
+    assert np.isnan(q[1, 0])
+
+
+def test_incremental_ingest_matches_bulk():
+    """Feeding samples in many small device batches approximates one bulk
+    feed (both are valid t-digests over the same data)."""
+    rng = np.random.default_rng(5)
+    data = rng.random(8192).astype(np.float32)
+
+    inc = td.empty(1, 100)
+    for chunk in data.reshape(64, 128):
+        inc = td.ingest(inc, jnp.asarray(chunk[None, :]),
+                        jnp.ones((1, 128), jnp.float32))
+
+    assert float(td.total_weight(inc)[0]) == pytest.approx(8192, rel=1e-5)
+    q = float(td.quantile(inc, [0.5])[0, 0])
+    assert q == pytest.approx(0.5, abs=0.02)
+
+
+def test_merge_stacked():
+    rng = np.random.default_rng(9)
+    K, R, C = 4, 3, td.centroid_capacity(100)
+    state = td.empty(K, 100)
+    datas = rng.random((R, K, 64)).astype(np.float32)
+    means = np.zeros((R, K, C), np.float32)
+    weights = np.zeros((R, K, C), np.float32)
+    mins = np.full((R, K), np.inf, np.float32)
+    maxs = np.full((R, K), -np.inf, np.float32)
+    rsums = np.zeros((R, K), np.float32)
+    for r in range(R):
+        sub = td.empty(K, 100)
+        sub = td.ingest(sub, jnp.asarray(datas[r]),
+                        jnp.ones((K, 64), jnp.float32))
+        means[r] = np.asarray(sub.mean)
+        weights[r] = np.asarray(sub.weight)
+        mins[r] = np.asarray(sub.min)
+        maxs[r] = np.asarray(sub.max)
+        rsums[r] = np.asarray(sub.rsum)
+    merged = td.merge_stacked(state, jnp.asarray(means), jnp.asarray(weights),
+                              jnp.asarray(mins), jnp.asarray(maxs),
+                              jnp.asarray(rsums))
+    w = np.asarray(td.total_weight(merged))
+    np.testing.assert_allclose(w, np.full(K, R * 64), rtol=1e-5)
+    med = np.asarray(td.quantile(merged, [0.5]))[:, 0]
+    ref = np.median(datas.transpose(1, 0, 2).reshape(K, -1), axis=1)
+    np.testing.assert_allclose(med, ref, atol=0.05)

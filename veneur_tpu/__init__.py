@@ -1,0 +1,10 @@
+"""veneur_tpu: a TPU-native distributed metrics-aggregation framework.
+
+A ground-up re-design of Stripe's Veneur (see SURVEY.md) for TPU hardware:
+DogStatsD/SSF-compatible ingestion, mergeable sketches (merging t-digest,
+HyperLogLog) held as batched device tensors, global aggregation as XLA
+collectives over a key-sharded mesh, and pluggable sinks/sources around the
+compute core.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,471 @@
+"""Batched merging t-digest as JAX tensor kernels.
+
+This is the TPU-native re-design of the reference's sequential merging
+t-digest (`tdigest/merging_digest.go:23-483`): instead of one Go object per
+metric key with an amortized in-place merge loop (`mergeAllTemps`,
+`merging_digest.go:140-224`) and a greedy sequential compression scan
+(`mergeOne`, `merging_digest.go:229-255`), we hold the centroids of *all* K
+keys as struct-of-arrays tensors `[K, C]` and compress every key at once with
+a data-parallel pipeline:
+
+    sort by mean  ->  prefix-sum of weights  ->  arcsine scale-function
+    bucket assignment  ->  segmented weighted reduce  ->  re-sort compact
+
+The scale function is the same arcsine `indexEstimate`
+(`merging_digest.go:258-262`): k(q) = delta * (asin(2q-1)/pi + 1/2).  The
+sequential reference merges a centroid into its predecessor while the k-index
+span stays <= 1; the parallel formulation instead inverts the scale function
+into fixed cluster boundaries and assigns each (sorted) centroid to the
+cluster containing its weight midpoint.  Every produced cluster has k-size
+<= 1 by construction, so the t-digest size bound (<= delta+1 centroids,
+tighter than the reference's ceil(pi*delta/2), `merging_digest.go:71`) and
+accuracy guarantees carry over; statistical equivalence is validated by
+tests/test_tdigest.py (weight conservation, 2% median error, merge-order
+invariance) mirroring the reference's `tdigest/histo_test.go`.
+
+Merging two digests (`MergingDigest.Merge`, `merging_digest.go:374-389`)
+shuffles and re-Adds centroids sequentially to avoid order bias; here merge is
+concatenate + sort + compress, which is order-invariant by construction (the
+sort erases input order), so no shuffle is needed.
+
+Quantile / CDF use the same uniform-within-centroid interpolation with
+min/max boundary handling as the reference (`merging_digest.go:266-332`,
+`centroidUpperBound` `merging_digest.go:355-370`), vectorized over all keys
+and all requested quantiles at once.
+
+All functions are jit-friendly, shape-static, and batched over the leading
+key axis K; sharding K across devices with pjit/shard_map gives multi-chip
+scaling with zero code change (see veneur_tpu/parallel/).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_COMPRESSION = 100.0
+
+_INF = jnp.inf
+
+
+# The parallel compressor buckets with a refined internal scale
+# delta_eff = OVERSAMPLE * delta.  Left-edge cluster assignment bounds each
+# cluster's k-span by 1/OVERSAMPLE (+ the k-width of its last member), which
+# beats the sequential reference's span-<=-1 guarantee while the cluster
+# count floor(OVERSAMPLE*delta)+1 stays within the reference's
+# ceil(pi*delta/2) memory bound (`tdigest/merging_digest.go:71`).
+OVERSAMPLE = 1.5
+
+
+def centroid_capacity(compression: float) -> int:
+    """Number of centroid slots per key: floor(1.5*delta)+1 clusters,
+    rounded up to a multiple of 8 for TPU sublane alignment."""
+    need = int(math.floor(OVERSAMPLE * compression)) + 1
+    return ((need + 7) // 8) * 8
+
+
+class TDigestState(NamedTuple):
+    """Struct-of-arrays batched t-digest for K keys.
+
+    Invariants (maintained by every exported op):
+      - per row, centroids are sorted ascending by mean with empty slots
+        (weight == 0) packed at the end;
+      - `min`/`max` are +inf/-inf for rows that have never seen a sample;
+      - `rsum` is the running reciprocal sum (sum of weight/value), matching
+        the reference's `reciprocalSum` (`merging_digest.go:131`).
+    """
+
+    mean: jax.Array    # [K, C] f32
+    weight: jax.Array  # [K, C] f32; 0 == empty slot
+    min: jax.Array     # [K] f32
+    max: jax.Array     # [K] f32
+    rsum: jax.Array    # [K] f32
+
+    @property
+    def num_keys(self) -> int:
+        return self.mean.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.mean.shape[1]
+
+
+def empty(num_keys: int, compression: float = DEFAULT_COMPRESSION,
+          capacity: int | None = None) -> TDigestState:
+    """A fresh state for `num_keys` keys (all rows empty)."""
+    cap = capacity if capacity is not None else centroid_capacity(compression)
+    k = num_keys
+    return TDigestState(
+        mean=jnp.zeros((k, cap), jnp.float32),
+        weight=jnp.zeros((k, cap), jnp.float32),
+        min=jnp.full((k,), _INF, jnp.float32),
+        max=jnp.full((k,), -_INF, jnp.float32),
+        rsum=jnp.zeros((k,), jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Core compression kernel
+# ---------------------------------------------------------------------------
+
+def _scale_k(q: jax.Array, compression: float) -> jax.Array:
+    """Arcsine scale function k(q), `merging_digest.go:258-262`."""
+    q = jnp.clip(q, 0.0, 1.0)
+    return compression * (jnp.arcsin(2.0 * q - 1.0) / jnp.pi + 0.5)
+
+
+def compress(mean: jax.Array, weight: jax.Array, compression: float,
+             out_capacity: int) -> tuple[jax.Array, jax.Array]:
+    """Compress centroid rows `[K, M]` down to `[K, out_capacity]`.
+
+    Replaces the reference's sequential greedy `mergeAllTemps`/`mergeOne`
+    loop (`merging_digest.go:140-255`) with a fully parallel segmented
+    reduction.  Input rows need not be sorted; empty slots are weight==0.
+    """
+    kdim, m = mean.shape
+    c = out_capacity
+    delta = float(compression)
+
+    # 1. Sort each row by mean, empties (+inf key) to the end.
+    sort_key = jnp.where(weight > 0, mean, _INF)
+    sort_key, mean, weight = jax.lax.sort(
+        (sort_key, mean, weight), dimension=1, num_keys=1)
+
+    # 2. Normalized cumulative left edges.  Assigning each centroid to the
+    #    cluster containing its *left* quantile edge bounds every cluster's
+    #    k-span by 1 + (k-width of its last member) — tight for raw samples,
+    #    <= 2 when re-compressing already-compressed centroids.
+    total = jnp.sum(weight, axis=1, keepdims=True)          # [K, 1]
+    safe_total = jnp.where(total > 0, total, 1.0)
+    cum = jnp.cumsum(weight, axis=1)                        # inclusive
+    qleft = (cum - weight) / safe_total                     # [K, M]
+
+    # 3. Cluster id by inverted scale function; empties parked in the last
+    #    bucket where their zero weight is harmless.
+    kval = _scale_k(qleft, OVERSAMPLE * delta)
+    bucket = jnp.clip(jnp.floor(kval).astype(jnp.int32), 0, c - 1)
+    bucket = jnp.where(weight > 0, bucket, c - 1)
+
+    # 4. Segmented weighted reduce via prefix sums + per-bucket boundary
+    #    gather.  `bucket` is monotone non-decreasing along the row (qmid is
+    #    monotone), so the last index with bucket <= b marks the segment end.
+    s_w = cum                                                # [K, M]
+    s_wm = jnp.cumsum(weight * mean, axis=1)                 # [K, M]
+
+    targets = jnp.arange(c, dtype=jnp.int32)                 # [C]
+
+    def row_bounds(b_row):
+        return jnp.searchsorted(b_row, targets, side='right')  # [C]
+
+    pos = jax.vmap(row_bounds)(bucket) - 1                   # [K, C], -1 = none
+
+    def gather_prefix(s):
+        g = jnp.take_along_axis(s, jnp.maximum(pos, 0), axis=1)
+        return jnp.where(pos >= 0, g, 0.0)
+
+    g_w = gather_prefix(s_w)                                 # [K, C]
+    g_wm = gather_prefix(s_wm)
+    zero = jnp.zeros((kdim, 1), jnp.float32)
+    w_out = g_w - jnp.concatenate([zero, g_w[:, :-1]], axis=1)
+    wm_out = g_wm - jnp.concatenate([zero, g_wm[:, :-1]], axis=1)
+    # Guard tiny negative dust from float cancellation.
+    w_out = jnp.maximum(w_out, 0.0)
+    m_out = jnp.where(w_out > 0, wm_out / jnp.where(w_out > 0, w_out, 1.0), 0.0)
+
+    # 5. Re-sort to restore "sorted, empties at end" (empty buckets may be
+    #    interleaved with occupied ones).
+    key2 = jnp.where(w_out > 0, m_out, _INF)
+    _, m_out, w_out = jax.lax.sort((key2, m_out, w_out), dimension=1, num_keys=1)
+    return m_out, w_out
+
+
+# ---------------------------------------------------------------------------
+# Ingest / merge
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def ingest(state: TDigestState, values: jax.Array, vweights: jax.Array,
+           compression: float = DEFAULT_COMPRESSION) -> TDigestState:
+    """Fold a batch of raw samples `[K, T]` into the digest state.
+
+    Equivalent of `MergingDigest.Add` + `mergeAllTemps`
+    (`merging_digest.go:115-224`) for every key at once.  Empty sample slots
+    have vweights == 0.  Also maintains min/max/reciprocal-sum exactly like
+    `Add` (`merging_digest.go:127-131`).
+    """
+    occupied = vweights > 0
+    vmin = jnp.min(jnp.where(occupied, values, _INF), axis=1)
+    vmax = jnp.max(jnp.where(occupied, values, -_INF), axis=1)
+    rs = jnp.sum(jnp.where(occupied, vweights / values, 0.0), axis=1)
+
+    cat_mean = jnp.concatenate([state.mean, values], axis=1)
+    cat_w = jnp.concatenate([state.weight, vweights], axis=1)
+    m, w = compress(cat_mean, cat_w, compression, state.capacity)
+    return TDigestState(
+        mean=m, weight=w,
+        min=jnp.minimum(state.min, vmin),
+        max=jnp.maximum(state.max, vmax),
+        rsum=state.rsum + rs,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def merge(state: TDigestState, other: TDigestState,
+          compression: float = DEFAULT_COMPRESSION) -> TDigestState:
+    """Merge another batched digest into this one, key-aligned.
+
+    Equivalent of `MergingDigest.Merge` (`merging_digest.go:374-389`); the
+    reference re-Adds the other digest's centroids in shuffled order to avoid
+    order bias — our concat+sort+compress is order-invariant by construction
+    so the shuffle is unnecessary.
+    """
+    cat_mean = jnp.concatenate([state.mean, other.mean], axis=1)
+    cat_w = jnp.concatenate([state.weight, other.weight], axis=1)
+    m, w = compress(cat_mean, cat_w, compression, state.capacity)
+    return TDigestState(
+        mean=m, weight=w,
+        min=jnp.minimum(state.min, other.min),
+        max=jnp.maximum(state.max, other.max),
+        rsum=state.rsum + other.rsum,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("compression",))
+def merge_stacked(state: TDigestState, means: jax.Array, weights: jax.Array,
+                  mins: jax.Array, maxs: jax.Array, rsums: jax.Array,
+                  compression: float = DEFAULT_COMPRESSION) -> TDigestState:
+    """Merge R incoming digests per key: means/weights `[R, K, C2]`,
+    scalars `[R, K]`.  This is the global-import reduce — the device-side
+    equivalent of the gRPC `ImportMetric` merge loop (`worker.go:402-459`)
+    that the north-star benchmark measures.
+    """
+    kdim = means.shape[1]
+    flat_means = jnp.transpose(means, (1, 0, 2)).reshape(kdim, -1)
+    flat_weights = jnp.transpose(weights, (1, 0, 2)).reshape(kdim, -1)
+    cat_mean = jnp.concatenate([state.mean, flat_means], axis=1)
+    cat_w = jnp.concatenate([state.weight, flat_weights], axis=1)
+    m, w = compress(cat_mean, cat_w, compression, state.capacity)
+    return TDigestState(
+        mean=m, weight=w,
+        min=jnp.minimum(state.min, jnp.min(mins, axis=0)),
+        max=jnp.maximum(state.max, jnp.max(maxs, axis=0)),
+        rsum=state.rsum + jnp.sum(rsums, axis=0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+def total_weight(state: TDigestState) -> jax.Array:
+    """Count() equivalent, [K] (`merging_digest.go:340-342`)."""
+    return jnp.sum(state.weight, axis=1)
+
+
+def sum_values(state: TDigestState) -> jax.Array:
+    """Sum() equivalent, [K] (`merging_digest.go:346-353`)."""
+    return jnp.sum(state.weight * state.mean, axis=1)
+
+
+def _bounds(state: TDigestState):
+    """Per-centroid uniform-distribution bounds, mirroring
+    `centroidUpperBound` (`merging_digest.go:355-370`): the upper bound of
+    centroid i is the midpoint to centroid i+1, or max for the last occupied
+    centroid; the lower bound is the previous upper bound, or min for the
+    first."""
+    mean, weight = state.mean, state.weight
+    kdim, c = mean.shape
+    occ = weight > 0
+    n = jnp.sum(occ.astype(jnp.int32), axis=1)                       # [K]
+    idx = jnp.arange(c)[None, :]
+    mid = 0.5 * (mean + jnp.concatenate(
+        [mean[:, 1:], mean[:, -1:]], axis=1))                        # [K, C]
+    last = idx == (n[:, None] - 1)
+    upper = jnp.where(last, state.max[:, None], mid)
+    upper = jnp.where(idx < n[:, None], upper, state.max[:, None])
+    lower = jnp.concatenate([state.min[:, None], upper[:, :-1]], axis=1)
+    return lower, upper, n
+
+
+@jax.jit
+def quantile(state: TDigestState, qs: Sequence[float] | jax.Array) -> jax.Array:
+    """Vectorized Quantile() (`merging_digest.go:304-332`): returns [K, P].
+
+    Uniform interpolation inside the containing centroid between its lower
+    and upper bounds; NaN for empty rows.
+    """
+    qs = jnp.asarray(qs, jnp.float32)
+    lower, upper, n = _bounds(state)
+    w = state.weight
+    cum = jnp.cumsum(w, axis=1)                                      # [K, C]
+    tot = cum[:, -1]
+    target = qs[None, :] * tot[:, None]                              # [K, P]
+
+    # First occupied centroid i with cum[i] >= target  (q <= weightSoFar + w).
+    def row_search(cum_row, t_row):
+        return jnp.searchsorted(cum_row, t_row, side='left')
+    i = jax.vmap(row_search)(cum, target)                            # [K, P]
+    i = jnp.minimum(i, jnp.maximum(n[:, None] - 1, 0))
+
+    cum_before = jnp.take_along_axis(
+        jnp.concatenate([jnp.zeros_like(cum[:, :1]), cum[:, :-1]], axis=1),
+        i, axis=1)
+    w_i = jnp.take_along_axis(w, i, axis=1)
+    lo = jnp.take_along_axis(lower, i, axis=1)
+    up = jnp.take_along_axis(upper, i, axis=1)
+    prop = jnp.where(w_i > 0, (target - cum_before) / jnp.where(w_i > 0, w_i, 1.0), 0.0)
+    prop = jnp.clip(prop, 0.0, 1.0)
+    out = lo + prop * (up - lo)
+    return jnp.where((n > 0)[:, None], out, jnp.nan)
+
+
+@jax.jit
+def cdf(state: TDigestState, xs: Sequence[float] | jax.Array) -> jax.Array:
+    """Vectorized CDF() (`merging_digest.go:266-298`): returns [K, P]."""
+    xs = jnp.asarray(xs, jnp.float32)
+    lower, upper, n = _bounds(state)
+    w = state.weight
+    cum = jnp.cumsum(w, axis=1)
+    tot = cum[:, -1]
+    x = xs[None, :]                                                   # [K, P]
+
+    # Fraction of each centroid's weight below x under the uniform assumption.
+    span = upper - lower
+    frac = jnp.where(
+        span[:, :, None] > 0,
+        (x[:, None, :] - lower[:, :, None]) / jnp.where(span > 0, span, 1.0)[:, :, None],
+        (x[:, None, :] >= upper[:, :, None]).astype(jnp.float32))
+    frac = jnp.clip(frac, 0.0, 1.0)
+    below = jnp.sum(w[:, :, None] * frac, axis=1)                     # [K, P]
+    out = below / jnp.where(tot > 0, tot, 1.0)[:, None]
+    # Boundary precedence matches the reference (merging_digest.go:272-277):
+    # the <= min check wins over >= max (a min==max digest yields 0).
+    out = jnp.where(x >= state.max[:, None], 1.0, out)
+    out = jnp.where(x <= state.min[:, None], 0.0, out)
+    return jnp.where((n > 0)[:, None], out, jnp.nan)
+
+
+def aggregates(state: TDigestState) -> dict[str, jax.Array]:
+    """All scalar aggregates the Histo sampler flushes
+    (`samplers/samplers.go:377-495`): each [K]."""
+    w = total_weight(state)
+    s = sum_values(state)
+    safe_w = jnp.where(w > 0, w, 1.0)
+    med = quantile(state, jnp.array([0.5], jnp.float32))[:, 0]
+    return {
+        "min": state.min,
+        "max": state.max,
+        "sum": s,
+        "count": w,
+        "avg": s / safe_w,
+        "median": med,
+        "hmean": w / jnp.where(state.rsum != 0, state.rsum, 1.0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host-side scalar convenience wrapper (reference-API-shaped; used by tests,
+# codecs, and the CPU baseline arm of the benchmark)
+# ---------------------------------------------------------------------------
+
+class MergingDigest:
+    """Single-digest convenience wrapper over the batched kernels.
+
+    API mirrors the reference `MergingDigest` (`merging_digest.go`) so the
+    statistical tests translate directly.  Buffers samples host-side and
+    flushes them through the batched `ingest` kernel (K=1).
+    """
+
+    def __init__(self, compression: float = DEFAULT_COMPRESSION):
+        self.compression = float(compression)
+        self._cap = centroid_capacity(compression)
+        self._temp_cap = max(32, self._cap)
+        self._state = empty(1, compression, self._cap)
+        self._buf_v: list[float] = []
+        self._buf_w: list[float] = []
+
+    def add(self, value: float, weight: float = 1.0) -> None:
+        if not np.isfinite(value) or weight <= 0:
+            raise ValueError("invalid value added")
+        self._buf_v.append(float(value))
+        self._buf_w.append(float(weight))
+        if len(self._buf_v) >= self._temp_cap:
+            self._flush_temps()
+
+    def add_batch(self, values, weights=None) -> None:
+        values = np.asarray(values, np.float32).ravel()
+        if weights is None:
+            weights = np.ones_like(values)
+        else:
+            weights = np.asarray(weights, np.float32).ravel()
+        self._buf_v.extend(values.tolist())
+        self._buf_w.extend(weights.tolist())
+        self._flush_temps()
+
+    def _flush_temps(self) -> None:
+        if not self._buf_v:
+            return
+        n = len(self._buf_v)
+        # Pad to the next power of two so repeated flushes reuse compiled
+        # shapes (weight-0 slots are ignored by the kernel).
+        padded = max(32, 1 << (n - 1).bit_length())
+        v = np.zeros((1, padded), np.float32)
+        w = np.zeros((1, padded), np.float32)
+        v[0, :n] = self._buf_v
+        w[0, :n] = self._buf_w
+        self._buf_v, self._buf_w = [], []
+        self._state = ingest(self._state, jnp.asarray(v), jnp.asarray(w),
+                             self.compression)
+
+    def merge(self, other: "MergingDigest") -> None:
+        self._flush_temps()
+        other._flush_temps()
+        if other._state.capacity != self._state.capacity:
+            om, ow = compress(other._state.mean, other._state.weight,
+                              self.compression, self._state.capacity)
+            ostate = other._state._replace(mean=om, weight=ow)
+        else:
+            ostate = other._state
+        self._state = merge(self._state, ostate, self.compression)
+
+    # accessors mirroring merging_digest.go:334-353
+    def quantile(self, q: float) -> float:
+        self._flush_temps()
+        return float(quantile(self._state, [q])[0, 0])
+
+    def cdf(self, x: float) -> float:
+        self._flush_temps()
+        return float(cdf(self._state, [x])[0, 0])
+
+    def min(self) -> float:
+        self._flush_temps()
+        return float(self._state.min[0])
+
+    def max(self) -> float:
+        self._flush_temps()
+        return float(self._state.max[0])
+
+    def count(self) -> float:
+        self._flush_temps()
+        return float(total_weight(self._state)[0])
+
+    def sum(self) -> float:
+        self._flush_temps()
+        return float(sum_values(self._state)[0])
+
+    def reciprocal_sum(self) -> float:
+        self._flush_temps()
+        return float(self._state.rsum[0])
+
+    def centroids(self) -> tuple[np.ndarray, np.ndarray]:
+        """(means, weights) of occupied centroids, sorted by mean."""
+        self._flush_temps()
+        m = np.asarray(self._state.mean[0])
+        w = np.asarray(self._state.weight[0])
+        occ = w > 0
+        return m[occ], w[occ]
