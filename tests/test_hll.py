@@ -1,0 +1,105 @@
+"""HLL accuracy and merge tests.
+
+The reference relies on axiomhq/hyperloglog's own test suite; here we
+enforce the estimator error bound directly (~1.04/sqrt(2^14) ≈ 0.8% std
+error at p=14), union commutativity, and codec round-trips — the semantics
+the Set sampler depends on (`samplers/samplers.go:236-311`).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veneur_tpu.sketches import hll
+
+
+def test_estimate_accuracy():
+    sk = hll.HLLSketch()
+    n = 100_000
+    sk.insert_batch([f"member-{i}".encode() for i in range(n)])
+    assert sk.estimate() == pytest.approx(n, rel=0.03)
+
+
+def test_small_cardinality_exactish():
+    sk = hll.HLLSketch()
+    for i in range(100):
+        sk.insert(f"x{i}")
+        sk.insert(f"x{i}")  # duplicates don't count
+    assert sk.estimate() == pytest.approx(100, abs=3)
+
+
+def test_empty():
+    assert hll.HLLSketch().estimate() == 0
+
+
+def test_union_commutative_and_idempotent():
+    a = hll.HLLSketch()
+    b = hll.HLLSketch()
+    a.insert_batch([f"a{i}".encode() for i in range(5000)])
+    b.insert_batch([f"b{i}".encode() for i in range(5000)])
+
+    ab = hll.HLLSketch(); ab.regs = a.regs.copy(); ab.merge(b)
+    ba = hll.HLLSketch(); ba.regs = b.regs.copy(); ba.merge(a)
+    np.testing.assert_array_equal(ab.regs, ba.regs)
+    assert ab.estimate() == pytest.approx(10_000, rel=0.03)
+
+    # self-union is a no-op
+    aa = hll.HLLSketch(); aa.regs = a.regs.copy(); aa.merge(a)
+    np.testing.assert_array_equal(aa.regs, a.regs)
+
+
+def test_union_overlap():
+    a = hll.HLLSketch()
+    b = hll.HLLSketch()
+    a.insert_batch([f"m{i}".encode() for i in range(10_000)])
+    b.insert_batch([f"m{i}".encode() for i in range(5_000, 15_000)])
+    a.merge(b)
+    assert a.estimate() == pytest.approx(15_000, rel=0.03)
+
+
+def test_precision_mismatch_rejected():
+    with pytest.raises(ValueError):
+        hll.HLLSketch(14).merge(hll.HLLSketch(16))
+    with pytest.raises(ValueError):
+        hll.HLLSketch(3)
+
+
+def test_codec_roundtrip_sparse_and_dense():
+    small = hll.HLLSketch()
+    small.insert_batch([f"s{i}".encode() for i in range(50)])
+    data = small.marshal()
+    assert len(data) < 1000  # sparse encoding
+    back = hll.HLLSketch.unmarshal(data)
+    np.testing.assert_array_equal(back.regs, small.regs)
+
+    big = hll.HLLSketch()
+    big.insert_batch([f"d{i}".encode() for i in range(100_000)])
+    back = hll.HLLSketch.unmarshal(big.marshal())
+    np.testing.assert_array_equal(back.regs, big.regs)
+    assert back.estimate() == big.estimate()
+
+
+def test_batched_estimate_rows_independent():
+    s, m = 4, 1 << 14
+    regs = np.zeros((s, m), np.uint8)
+    sizes = [0, 100, 10_000, 50_000]
+    for row, n in enumerate(sizes):
+        idx, rank = hll.hash_batch(
+            [f"r{row}-{i}".encode() for i in range(n)])
+        np.maximum.at(regs[row], idx, rank)
+    est = np.asarray(hll.estimate(jnp.asarray(regs)))
+    assert est[0] == 0
+    for row, n in enumerate(sizes[1:], start=1):
+        assert est[row] == pytest.approx(n, rel=0.03)
+
+
+def test_update_registers_batch():
+    regs = np.zeros((2, 1 << 14), np.uint8)
+    members = [f"k{i}".encode() for i in range(1000)]
+    idx, rank = hll.hash_batch(members)
+    rows = np.zeros(len(members), np.int64)
+    hll.update_registers(regs, rows, idx, rank)
+    est = np.asarray(hll.estimate(jnp.asarray(regs)))
+    assert est[0] == pytest.approx(1000, rel=0.05)
+    assert est[1] == 0

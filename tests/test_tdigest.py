@@ -22,10 +22,11 @@ def validate_digest(d: td.MergingDigest):
     bound and weight conservation.
 
     The sequential reference guarantees k-span <= 1 per centroid; the
-    parallel midpoint-assignment compressor guarantees k-span <= 2 (a
-    cluster may straddle one scale-function boundary).  Statistical accuracy
-    is equivalent to a sequential digest at compression delta/2 and is
-    enforced directly by the quantile-error assertions below.
+    parallel left-edge-assignment compressor guarantees k-span <= 1/1.5 plus
+    the k-width of the cluster's last member (<= 2 when re-compressing
+    already-compressed centroids).  Accuracy is enforced directly by the
+    quantile-error assertions below and by comparison against the
+    sequential arm.
     """
     means, weights = d.centroids()
     total = weights.sum()

@@ -1,0 +1,226 @@
+"""Batched HyperLogLog as JAX tensor kernels + host-side hashing.
+
+TPU-native re-design of the reference's Set sampler
+(`samplers/samplers.go:236-311`), which wraps axiomhq/hyperloglog (precision
+14, LogLog-Beta estimation, metro-hashed inputs).  Here the registers of all
+S set-type keys live as one dense uint8 tensor `[S, 2^p]`:
+
+  - host side: members are hashed (blake2b-64) and scattered into numpy
+    staging registers with `np.maximum.at` — the equivalent of
+    `Sketch.Insert`;
+  - device side: union is an elementwise `maximum` (the merge kernel of the
+    global-import path, `samplers/samplers.go:299-311`) and cardinality
+    estimation is the LogLog-Beta estimator evaluated for all S keys at once
+    (constants from the Ertl LogLog-Beta paper, the same estimator family the
+    reference uses).
+
+The reference keeps a sparse compressed list for small sets; we keep dense
+registers on device (static shapes) and use a sparse wire encoding only for
+serialization (codec below), which preserves the bandwidth win without
+dynamic shapes.  Byte-level compatibility with axiomhq's MarshalBinary is
+not implemented (documented gap; our own fleet uses the codec below).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import NamedTuple
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_PRECISION = 14  # matches hyperloglog.New() in the reference
+
+# LogLog-Beta bias-correction polynomial for p=14 (published constants from
+# Ertl, "New cardinality estimation algorithms for HyperLogLog sketches" /
+# the LogLog-Beta paper; identical family to the reference's estimator).
+_BETA14 = (-0.370393911, 0.070471823, 0.17393686, 0.16339839,
+           -0.09237745, 0.03738027, -0.005384159, 0.00042419)
+# p=16 variant (the reference also ships one).
+_BETA16 = (-0.37331876643753059, -1.41704077448122989, 0.40729184796612533,
+           1.56152033906584164, -0.99242233534286128, 0.26064681399483092,
+           -0.03053811369682807, 0.00155770210179105)
+
+_BETAS = {14: _BETA14, 16: _BETA16}
+
+
+def _alpha(m: float) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1 + 1.079 / m)
+
+
+# ---------------------------------------------------------------------------
+# Host-side hashing + register updates (the ingest hot path)
+# ---------------------------------------------------------------------------
+
+def hash64(data: bytes) -> int:
+    """Stable 64-bit hash of a set member (blake2b-8; the reference uses
+    metro hash — any well-mixed 64-bit hash gives the same estimator
+    guarantees)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def pos_val(h: int, p: int = DEFAULT_PRECISION) -> tuple[int, int]:
+    """(register index, rank) from a 64-bit hash; mirrors the reference's
+    getPosVal (vendor hyperloglog/utils.go): index = top p bits, rank =
+    leading zeros of the remainder (with sentinel) + 1."""
+    idx = h >> (64 - p)
+    w = ((h << p) | (1 << (p - 1))) & 0xFFFFFFFFFFFFFFFF
+    rank = 65 - w.bit_length()
+    return idx, rank
+
+
+def hash_batch(members: list[bytes], p: int = DEFAULT_PRECISION
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized (indices, ranks) for a batch of members."""
+    hs = np.fromiter(
+        (hash64(m) for m in members), dtype=np.uint64, count=len(members))
+    return split_hashes(hs, p)
+
+
+def split_hashes(hs: np.ndarray, p: int = DEFAULT_PRECISION
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """(indices, ranks) from precomputed uint64 hashes (numpy, branch-free)."""
+    hs = hs.astype(np.uint64, copy=False)
+    idx = (hs >> np.uint64(64 - p)).astype(np.int32)
+    w = (hs << np.uint64(p)) | np.uint64(1 << (p - 1))
+    # clz via bit-smear + popcount
+    for s in (1, 2, 4, 8, 16, 32):
+        w = w | (w >> np.uint64(s))
+    rank = (65 - np.bitwise_count(w)).astype(np.uint8)
+    return idx, rank
+
+
+def update_registers(regs: np.ndarray, rows: np.ndarray, idx: np.ndarray,
+                     rank: np.ndarray) -> None:
+    """Scatter-max a batch of (set row, register index, rank) into host
+    staging registers `[S, m]` (the Insert path)."""
+    np.maximum.at(regs, (rows, idx), rank)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+def union(a: jax.Array, b: jax.Array) -> jax.Array:
+    """HLL merge is register-wise max (`samplers/samplers.go:299-311` →
+    vendor Sketch.Merge)."""
+    return jnp.maximum(a, b)
+
+
+@jax.jit
+def estimate(regs: jax.Array) -> jax.Array:
+    """LogLog-Beta cardinality estimate for every row of `[S, m]` uint8
+    registers; returns [S] f32.  est = alpha*m*(m-ez) / (beta(ez) + sum 2^-r)
+    (vendor hyperloglog.go:207-228)."""
+    s, m = regs.shape
+    p = int(m).bit_length() - 1
+    beta_c = _BETAS.get(p)
+    if beta_c is None:
+        raise ValueError(f"no beta constants for precision {p}")
+    r = regs.astype(jnp.float32)
+    ez = jnp.sum((regs == 0).astype(jnp.float32), axis=1)          # [S]
+    ssum = jnp.sum(jnp.exp2(-r), axis=1)                           # [S]
+    zl = jnp.log(ez + 1.0)
+    beta = beta_c[0] * ez
+    acc = jnp.ones_like(zl)
+    for c in beta_c[1:]:
+        acc = acc * zl
+        beta = beta + c * acc
+    mf = float(m)
+    est = _alpha(mf) * mf * (mf - ez) / (beta + ssum) + 0.5
+    return jnp.floor(est)
+
+
+# ---------------------------------------------------------------------------
+# Wire codec (our fleet's format; axiomhq byte-compat is a documented gap)
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"VH"
+_DENSE = 1
+_SPARSE = 2
+
+
+def marshal(regs: np.ndarray) -> bytes:
+    """Serialize one register row.  Sparse when <1/8 occupied."""
+    regs = np.asarray(regs, np.uint8)
+    m = regs.shape[0]
+    p = int(m).bit_length() - 1
+    nz = np.nonzero(regs)[0]
+    if len(nz) * 3 < m:
+        payload = struct.pack("<BBBI", _SPARSE, p, 0, len(nz))
+        return (_MAGIC + payload + nz.astype(np.uint16).tobytes()
+                + regs[nz].tobytes())
+    return _MAGIC + struct.pack("<BBB", _DENSE, p, 0) + regs.tobytes()
+
+
+def unmarshal(data: bytes) -> np.ndarray:
+    if data[:2] != _MAGIC:
+        raise ValueError("bad HLL magic")
+    kind, p, _ = struct.unpack_from("<BBB", data, 2)
+    m = 1 << p
+    regs = np.zeros(m, np.uint8)
+    if kind == _DENSE:
+        regs[:] = np.frombuffer(data, np.uint8, m, 5)
+    elif kind == _SPARSE:
+        (n,) = struct.unpack_from("<I", data, 5)
+        off = 9
+        idx = np.frombuffer(data, np.uint16, n, off)
+        vals = np.frombuffer(data, np.uint8, n, off + 2 * n)
+        regs[idx.astype(np.int64)] = vals
+    else:
+        raise ValueError(f"bad HLL kind {kind}")
+    return regs
+
+
+# ---------------------------------------------------------------------------
+# Scalar convenience wrapper (reference Sketch-shaped; tests + host samplers)
+# ---------------------------------------------------------------------------
+
+class HLLSketch:
+    """Single-set convenience wrapper, mirroring the reference's
+    `hyperloglog.Sketch` usage in the Set sampler."""
+
+    def __init__(self, precision: int = DEFAULT_PRECISION):
+        if not 4 <= precision <= 18:
+            raise ValueError("precision must be in [4, 18]")
+        self.p = precision
+        self.m = 1 << precision
+        self.regs = np.zeros(self.m, np.uint8)
+
+    def insert(self, member: bytes | str) -> None:
+        if isinstance(member, str):
+            member = member.encode()
+        idx, rank = pos_val(hash64(member), self.p)
+        if rank > self.regs[idx]:
+            self.regs[idx] = rank
+
+    def insert_batch(self, members: list[bytes]) -> None:
+        idx, rank = hash_batch(members, self.p)
+        np.maximum.at(self.regs, idx, rank)
+
+    def merge(self, other: "HLLSketch") -> None:
+        if other.p != self.p:
+            raise ValueError("precisions must be equal")
+        np.maximum(self.regs, other.regs, out=self.regs)
+
+    def estimate(self) -> int:
+        return int(np.asarray(estimate(jnp.asarray(self.regs[None, :])))[0])
+
+    def marshal(self) -> bytes:
+        return marshal(self.regs)
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "HLLSketch":
+        regs = unmarshal(data)
+        sk = cls(int(regs.shape[0]).bit_length() - 1)
+        sk.regs = regs
+        return sk

@@ -14,14 +14,17 @@ a data-parallel pipeline:
 The scale function is the same arcsine `indexEstimate`
 (`merging_digest.go:258-262`): k(q) = delta * (asin(2q-1)/pi + 1/2).  The
 sequential reference merges a centroid into its predecessor while the k-index
-span stays <= 1; the parallel formulation instead inverts the scale function
-into fixed cluster boundaries and assigns each (sorted) centroid to the
-cluster containing its weight midpoint.  Every produced cluster has k-size
-<= 1 by construction, so the t-digest size bound (<= delta+1 centroids,
-tighter than the reference's ceil(pi*delta/2), `merging_digest.go:71`) and
-accuracy guarantees carry over; statistical equivalence is validated by
-tests/test_tdigest.py (weight conservation, 2% median error, merge-order
-invariance) mirroring the reference's `tdigest/histo_test.go`.
+span stays <= 1; the parallel formulation instead inverts a 1.5x-refined
+scale function into fixed cluster boundaries and assigns each (sorted)
+centroid to the cluster containing its *left* cumulative-weight edge.  Every
+produced cluster then has k-span <= 1/1.5 plus the k-width of its last
+member, which matches or beats the sequential guarantee for raw-sample
+ingest while the cluster count stays within the reference's
+ceil(pi*delta/2) memory bound (`merging_digest.go:71`).  Statistical
+equivalence is validated by tests/test_tdigest.py (weight conservation,
+size bound, 2% median error, merge-order invariance) mirroring the
+reference's `tdigest/histo_test.go`, and by direct comparison against the
+faithful sequential arm in tdigest_cpu.py.
 
 Merging two digests (`MergingDigest.Merge`, `merging_digest.go:374-389`)
 shuffles and re-Adds centroids sequentially to avoid order bias; here merge is
@@ -152,8 +155,9 @@ def compress(mean: jax.Array, weight: jax.Array, compression: float,
     bucket = jnp.where(weight > 0, bucket, c - 1)
 
     # 4. Segmented weighted reduce via prefix sums + per-bucket boundary
-    #    gather.  `bucket` is monotone non-decreasing along the row (qmid is
-    #    monotone), so the last index with bucket <= b marks the segment end.
+    #    gather.  `bucket` is monotone non-decreasing along the row (qleft
+    #    is monotone), so the last index with bucket <= b marks the segment
+    #    end.
     s_w = cum                                                # [K, M]
     s_wm = jnp.cumsum(weight * mean, axis=1)                 # [K, M]
 
@@ -326,23 +330,34 @@ def quantile(state: TDigestState, qs: Sequence[float] | jax.Array) -> jax.Array:
 
 @jax.jit
 def cdf(state: TDigestState, xs: Sequence[float] | jax.Array) -> jax.Array:
-    """Vectorized CDF() (`merging_digest.go:266-298`): returns [K, P]."""
+    """Vectorized CDF() (`merging_digest.go:266-298`): returns [K, P].
+
+    Locates the single centroid whose [lower, upper) bound-interval contains
+    each query via searchsorted (O(K*P*log C), same pattern as quantile)
+    and interpolates its weight fraction uniformly.
+    """
     xs = jnp.asarray(xs, jnp.float32)
     lower, upper, n = _bounds(state)
     w = state.weight
     cum = jnp.cumsum(w, axis=1)
     tot = cum[:, -1]
-    x = xs[None, :]                                                   # [K, P]
+    x = jnp.broadcast_to(xs[None, :], (state.num_keys, xs.shape[0]))  # [K, P]
 
-    # Fraction of each centroid's weight below x under the uniform assumption.
-    span = upper - lower
-    frac = jnp.where(
-        span[:, :, None] > 0,
-        (x[:, None, :] - lower[:, :, None]) / jnp.where(span > 0, span, 1.0)[:, :, None],
-        (x[:, None, :] >= upper[:, :, None]).astype(jnp.float32))
-    frac = jnp.clip(frac, 0.0, 1.0)
-    below = jnp.sum(w[:, :, None] * frac, axis=1)                     # [K, P]
-    out = below / jnp.where(tot > 0, tot, 1.0)[:, None]
+    # First centroid with upper > x holds the query point.
+    def row_search(upper_row, x_row):
+        return jnp.searchsorted(upper_row, x_row, side='right')
+    i = jax.vmap(row_search)(upper, x)                                # [K, P]
+    i = jnp.minimum(i, jnp.maximum(n[:, None] - 1, 0))
+
+    w_i = jnp.take_along_axis(w, i, axis=1)
+    lo = jnp.take_along_axis(lower, i, axis=1)
+    up = jnp.take_along_axis(upper, i, axis=1)
+    cum_before = jnp.take_along_axis(cum, i, axis=1) - w_i
+    span = up - lo
+    frac = jnp.where(span > 0,
+                     jnp.clip((x - lo) / jnp.where(span > 0, span, 1.0), 0.0, 1.0),
+                     (x >= up).astype(jnp.float32))
+    out = (cum_before + w_i * frac) / jnp.where(tot > 0, tot, 1.0)[:, None]
     # Boundary precedence matches the reference (merging_digest.go:272-277):
     # the <= min check wins over >= max (a min==max digest yields 0).
     out = jnp.where(x >= state.max[:, None], 1.0, out)
@@ -403,6 +418,8 @@ class MergingDigest:
             weights = np.ones_like(values)
         else:
             weights = np.asarray(weights, np.float32).ravel()
+        if not np.isfinite(values).all() or (weights <= 0).any():
+            raise ValueError("invalid value added")
         self._buf_v.extend(values.tolist())
         self._buf_w.extend(weights.tolist())
         self._flush_temps()
@@ -425,13 +442,9 @@ class MergingDigest:
     def merge(self, other: "MergingDigest") -> None:
         self._flush_temps()
         other._flush_temps()
-        if other._state.capacity != self._state.capacity:
-            om, ow = compress(other._state.mean, other._state.weight,
-                              self.compression, self._state.capacity)
-            ostate = other._state._replace(mean=om, weight=ow)
-        else:
-            ostate = other._state
-        self._state = merge(self._state, ostate, self.compression)
+        # merge() concatenates along the centroid axis, so mismatched
+        # capacities (different compressions) are handled directly.
+        self._state = merge(self._state, other._state, self.compression)
 
     # accessors mirroring merging_digest.go:334-353
     def quantile(self, q: float) -> float:
