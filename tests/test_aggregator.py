@@ -1,0 +1,305 @@
+"""Worker/flush-core tests, porting the semantics of the reference's
+`worker_test.go` and `flusher_test.go`: scope dispatch, local vs global
+flush duality, sampler math, import-merge correctness, interval reset."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric
+from veneur_tpu.samplers.parser import Parser
+
+
+def mk(name, mtype, value, rate=1.0, tags=(), scope=MetricScope.MIXED):
+    m = UDPMetric(name=name, type=mtype, value=value, sample_rate=rate,
+                  scope=scope)
+    m.update_tags(list(tags), None)
+    return m
+
+
+def agg(**kw):
+    kw.setdefault("percentiles", [0.5, 0.9])
+    return MetricAggregator(**kw)
+
+
+def by_name(metrics):
+    return {m.name: m for m in metrics}
+
+
+def test_counter_accumulates_and_rate_normalizes():
+    a = agg()
+    a.process_metric(mk("c", "counter", 10))
+    a.process_metric(mk("c", "counter", 1, rate=0.1))
+    res = a.flush(is_local=True)
+    m = by_name(res.metrics)["c"]
+    assert m.value == 20.0  # 10 + 1/0.1
+    assert m.type == sm.COUNTER
+
+
+def test_gauge_last_write_wins():
+    a = agg()
+    a.process_metric(mk("g", "gauge", 1))
+    a.process_metric(mk("g", "gauge", 42))
+    res = a.flush(is_local=True)
+    assert by_name(res.metrics)["g"].value == 42.0
+
+
+def test_interval_reset():
+    a = agg()
+    a.process_metric(mk("c", "counter", 5))
+    a.flush(is_local=True)
+    res = a.flush(is_local=True)
+    assert res.metrics == []  # untouched keys are not re-emitted
+
+
+def test_histogram_local_flush_aggregates_no_percentiles():
+    """Local flush of a mixed histo: aggregates from local scalars,
+    digest forwarded, no percentiles (flusher.go:57-74)."""
+    a = agg()
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        a.process_metric(mk("h", "histogram", v, tags=("t:1",)))
+    res = a.flush(is_local=True)
+    names = by_name(res.metrics)
+    assert names["h.min"].value == 1.0
+    assert names["h.max"].value == 4.0
+    assert names["h.count"].value == 4.0
+    assert names["h.count"].type == sm.COUNTER
+    assert not any(".50percentile" in n for n in names)
+    # digest was forwarded
+    fwd = [f for f in res.forward if f.name == "h"]
+    assert len(fwd) == 1
+    assert fwd[0].kind == "histogram"
+    assert fwd[0].scope == MetricScope.MIXED
+    assert fwd[0].digest_min == 1.0
+    assert fwd[0].digest_max == 4.0
+    assert sum(fwd[0].digest_weights) == pytest.approx(4.0)
+
+
+def test_histogram_global_flush_percentiles():
+    a = agg()
+    for v in np.random.default_rng(0).random(1000):
+        a.process_metric(mk("h", "histogram", float(v)))
+    res = a.flush(is_local=False)
+    names = by_name(res.metrics)
+    assert names["h.50percentile"].value == pytest.approx(0.5, abs=0.05)
+    assert names["h.90percentile"].value == pytest.approx(0.9, abs=0.05)
+    # mixed histo on global: local-sample aggregates present (samples
+    # arrived over UDP here), min/max from local scalars
+    assert names["h.min"].value >= 0
+    assert res.forward == []
+
+
+def test_local_only_histogram_full_percentiles_locally():
+    a = agg()
+    for v in [1.0, 2.0, 3.0]:
+        a.process_metric(mk("h", "histogram", v,
+                            scope=MetricScope.LOCAL_ONLY))
+    res = a.flush(is_local=True)
+    names = by_name(res.metrics)
+    assert "h.50percentile" in names
+    assert res.forward == []  # local-only never forwarded
+
+
+def test_global_only_histogram_not_emitted_locally():
+    a = agg()
+    a.process_metric(mk("h", "histogram", 1.0,
+                        scope=MetricScope.GLOBAL_ONLY))
+    res = a.flush(is_local=True)
+    assert res.metrics == []
+    assert len(res.forward) == 1
+    assert res.forward[0].scope == MetricScope.GLOBAL_ONLY
+
+
+def test_timer_kind_preserved_in_forward():
+    a = agg()
+    a.process_metric(mk("t", "timer", 5.0))
+    res = a.flush(is_local=True)
+    assert res.forward[0].kind == "timer"
+
+
+def test_set_local_vs_global_flush():
+    a = agg()
+    for v in ("a", "b", "c", "a"):
+        a.process_metric(mk("s", "set", v))
+    res = a.flush(is_local=True)
+    assert res.metrics == []  # mixed sets have no local part
+    assert len(res.forward) == 1
+    assert res.forward[0].kind == "set"
+
+    b = agg()
+    for v in ("a", "b", "c", "a"):
+        b.process_metric(mk("s", "set", v))
+    res = b.flush(is_local=False)
+    m = by_name(res.metrics)["s"]
+    assert m.value == 3.0
+    assert m.type == sm.GAUGE
+
+
+def test_local_only_set_flushed_locally():
+    a = agg()
+    for v in ("x", "y"):
+        a.process_metric(mk("s", "set", v, scope=MetricScope.LOCAL_ONLY))
+    res = a.flush(is_local=True)
+    assert by_name(res.metrics)["s"].value == 2.0
+
+
+def test_global_counter_forwarded_not_emitted():
+    a = agg()
+    a.process_metric(mk("c", "counter", 7, scope=MetricScope.GLOBAL_ONLY))
+    res = a.flush(is_local=True)
+    assert res.metrics == []
+    assert res.forward[0].counter_value == 7
+
+
+def test_status_check_flush():
+    a = agg()
+    m = mk("svc", "status", 1.0)
+    m.message = "warn!"
+    m.hostname = "host1"
+    a.process_metric(m)
+    res = a.flush(is_local=True)
+    sc = by_name(res.metrics)["svc"]
+    assert sc.type == sm.STATUS
+    assert sc.value == 1.0
+    assert sc.message == "warn!"
+    assert sc.hostname == "host1"
+
+
+def test_import_counter_gauge():
+    g = agg()
+    g.import_metric(sm.ForwardMetric(
+        name="c", tags=[], kind="counter", scope=MetricScope.GLOBAL_ONLY,
+        counter_value=5))
+    g.import_metric(sm.ForwardMetric(
+        name="c", tags=[], kind="counter", scope=MetricScope.GLOBAL_ONLY,
+        counter_value=3))
+    g.import_metric(sm.ForwardMetric(
+        name="g", tags=[], kind="gauge", scope=MetricScope.MIXED,
+        gauge_value=9.0))
+    res = g.flush(is_local=False)
+    names = by_name(res.metrics)
+    assert names["c"].value == 8.0
+    assert names["g"].value == 9.0
+
+
+def test_import_rejects_local():
+    g = agg()
+    with pytest.raises(ValueError):
+        g.import_metric(sm.ForwardMetric(
+            name="h", tags=[], kind="histogram",
+            scope=MetricScope.LOCAL_ONLY))
+
+
+def test_local_to_global_histogram_roundtrip():
+    """The core distributed flow (server_test.go TestLocalServerMixedMetrics):
+    local instances sample, forward digests; global merges and reports
+    accurate percentiles."""
+    rng = np.random.default_rng(1)
+    all_data = []
+    g = agg()
+    for host in range(4):
+        local = agg()
+        data = rng.gamma(2, 50, 2000)
+        all_data.append(data)
+        for v in data:
+            local.process_metric(mk("api.latency", "timer", float(v),
+                                    tags=("env:prod",)))
+        res = local.flush(is_local=True)
+        assert res.metrics and res.forward
+        for fm in res.forward:
+            g.import_metric(fm)
+    gres = g.flush(is_local=False)
+    names = by_name(gres.metrics)
+    ref = np.concatenate(all_data)
+    assert names["api.latency.50percentile"].value == pytest.approx(
+        np.quantile(ref, 0.5), rel=0.05)
+    assert names["api.latency.90percentile"].value == pytest.approx(
+        np.quantile(ref, 0.9), rel=0.05)
+    assert names["api.latency.50percentile"].tags == ["env:prod"]
+    # global flush of a mixed digest without local samples: no local
+    # aggregates (the sparse-emission guards, samplers.go:359-370)
+    assert "api.latency.min" not in names
+    assert "api.latency.count" not in names
+
+
+def test_local_to_global_set_roundtrip():
+    g = agg()
+    for host in range(3):
+        local = agg()
+        for i in range(1000):
+            local.process_metric(
+                mk("users", "set", f"host{host}-user{i % 500}"))
+        res = local.flush(is_local=True)
+        for fm in res.forward:
+            g.import_metric(fm)
+    gres = g.flush(is_local=False)
+    # 3 hosts x 500 unique each, no overlap
+    assert by_name(gres.metrics)["users"].value == pytest.approx(
+        1500, rel=0.05)
+
+
+def test_import_min_max_exact():
+    """Imported digest min/max must come from wire scalars, not centroid
+    means (which are interior)."""
+    local = agg()
+    for v in [0.001, 5.0, 1000.0]:
+        local.process_metric(mk("h", "histogram", v))
+    fwd = local.flush(is_local=True).forward
+    g = agg(aggregates=sm.HistogramAggregates(
+        sm.Aggregate.MIN | sm.Aggregate.MAX))
+    for fm in fwd:
+        g.import_metric(fm)
+    # mixed scope + no local samples on global -> min/max suppressed; use a
+    # GLOBAL_ONLY import instead to check digest-backed values
+    g2 = agg(aggregates=sm.HistogramAggregates(
+        sm.Aggregate.MIN | sm.Aggregate.MAX))
+    for fm in fwd:
+        fm.scope = MetricScope.GLOBAL_ONLY
+        g2.import_metric(fm)
+    names = by_name(g2.flush(is_local=False).metrics)
+    assert names["h.min"].value == pytest.approx(0.001)
+    assert names["h.max"].value == pytest.approx(1000.0)
+
+
+def test_unique_timeseries_counting():
+    a = agg(count_unique_timeseries=True)
+    for i in range(100):
+        a.process_metric(mk(f"m{i % 10}", "counter", 1))
+    assert a.unique_ts.estimate() == pytest.approx(10, abs=2)
+
+
+def test_parser_to_aggregator_pipeline():
+    """End-to-end: DogStatsD bytes -> parser -> aggregator -> flush."""
+    p = Parser()
+    a = agg()
+    packets = [b"api.hits:1|c|#route:/home", b"api.hits:1|c|#route:/home",
+               b"api.lat:3.5:4.5|ms|#route:/home",
+               b"api.users:alice|s", b"temp:70.5|g"]
+    for pk in packets:
+        p.parse_metric(pk, a.process_metric)
+    res = a.flush(is_local=False)
+    names = by_name(res.metrics)
+    assert names["api.hits"].value == 2.0
+    assert names["api.hits"].tags == ["route:/home"]
+    assert names["api.lat.50percentile"].value == pytest.approx(4.0, abs=0.5)
+    assert names["api.users"].value == 1.0
+    assert names["temp"].value == 70.5
+
+
+def test_arena_growth():
+    a = agg()
+    for i in range(3000):  # exceeds initial capacity 1024
+        a.process_metric(mk(f"m{i}", "counter", 1))
+    res = a.flush(is_local=True)
+    assert len(res.metrics) == 3000
+
+
+def test_idle_gc():
+    from veneur_tpu.core import arena as am
+    a = agg()
+    a.process_metric(mk("once", "counter", 1))
+    a.flush(is_local=True)
+    for _ in range(am.IDLE_GC_INTERVALS + 1):
+        a.flush(is_local=True)
+    assert len(a.counters.kdict) == 0

@@ -1,0 +1,60 @@
+"""Multi-device sharded flush tests on the 8-device virtual CPU mesh
+(SURVEY.md §4's loopback-gRPC distributed tests re-imagined as
+jax.sharding tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veneur_tpu.parallel import flush_step as fs
+from veneur_tpu.parallel import mesh as mesh_mod
+
+
+def test_mesh_shapes():
+    mesh = mesh_mod.make_mesh(8)
+    assert mesh.shape == {"shard": 4, "replica": 2}
+    mesh1 = mesh_mod.make_mesh(1)
+    assert mesh1.shape == {"shard": 1, "replica": 1}
+
+
+def test_sharded_matches_single_device():
+    """The pjit'd mesh flush must produce identical results to the
+    single-device step on the same inputs."""
+    mesh = mesh_mod.make_mesh(8)
+    inputs = fs.example_inputs(n_keys=32, n_lanes=4, n_sets=8, seed=3)
+    percentiles = jnp.asarray([0.25, 0.5, 0.99], jnp.float32)
+
+    single = fs.flush_step(inputs, percentiles)
+    step = fs.make_sharded_flush_step(mesh)
+    sharded = step(inputs, percentiles)
+
+    np.testing.assert_allclose(np.asarray(single.quantiles),
+                               np.asarray(sharded.quantiles),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(single.counts),
+                               np.asarray(sharded.counts), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(single.counter_totals),
+                               np.asarray(sharded.counter_totals))
+    np.testing.assert_allclose(np.asarray(single.set_estimates),
+                               np.asarray(sharded.set_estimates))
+    assert float(single.unique_ts) == float(sharded.unique_ts)
+
+
+def test_flush_step_merges_lanes():
+    """All R lanes' digests must land in the merged state."""
+    inputs = fs.example_inputs(n_keys=8, n_lanes=3, n_sets=4)
+    out = fs.flush_step(inputs, jnp.asarray([0.5], jnp.float32))
+    # state had 32 unit-weight samples per key, each of 3 lanes adds 32
+    np.testing.assert_allclose(np.asarray(out.counts),
+                               np.full(8, 32.0 * 4), rtol=1e-5)
+
+
+def test_dryrun_entrypoints():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.quantiles.shape == (64, 3)
+    g.dryrun_multichip(8)
+    g.dryrun_multichip(4)

@@ -1,0 +1,248 @@
+"""DogStatsD parser tests, porting the reference's `parser_test.go` cases
+(valid metrics per type, tags/digest determinism, sample rates, multi-value
+packets, the invalid-packet table at parser_test.go:856-882, magic scope
+tags, events at :898-951, service checks at :952-1020, message unescaping)."""
+
+import pytest
+
+from veneur_tpu.samplers import parser as pmod
+from veneur_tpu.samplers.metric_key import (MetricScope, UDPMetric,
+                                            metric_digest)
+from veneur_tpu.util.tagging import ExtendTags
+
+P = pmod.Parser()
+
+
+def parse_one(p: pmod.Parser, packet: bytes) -> UDPMetric:
+    out: list[UDPMetric] = []
+    p.parse_metric(packet, out.append)
+    assert len(out) == 1
+    return out[0]
+
+
+def parse_all(p: pmod.Parser, packet: bytes) -> list[UDPMetric]:
+    out: list[UDPMetric] = []
+    p.parse_metric(packet, out.append)
+    return out
+
+
+def test_counter():
+    m = parse_one(P, b"a.b.c:1|c")
+    assert m.name == "a.b.c"
+    assert m.type == "counter"
+    assert m.value == 1.0
+    assert m.sample_rate == 1.0
+    assert m.tags == []
+
+
+def test_gauge():
+    m = parse_one(P, b"a.b.c:1|g")
+    assert m.type == "gauge"
+    assert m.value == 1.0
+
+
+@pytest.mark.parametrize("t,expected", [
+    (b"h", "histogram"), (b"d", "histogram"), (b"ms", "timer")])
+def test_histogram_family(t, expected):
+    m = parse_one(P, b"a.b.c:1.234|" + t)
+    assert m.type == expected
+    assert m.value == pytest.approx(1.234)
+
+
+def test_set():
+    m = parse_one(P, b"a.b.c:foo|s")
+    assert m.type == "set"
+    assert m.value == "foo"
+
+
+def test_tags_sorted_and_digest():
+    m = parse_one(P, b"a.b.c:1|c|#z:1,a:2,m")
+    assert m.tags == ["a:2", "m", "z:1"]
+    assert m.joined_tags == "a:2,m,z:1"
+    assert m.digest == metric_digest("a.b.c", "counter", "a:2,m,z:1")
+    # identical logical packet with reordered tags gives the same digest
+    m2 = parse_one(P, b"a.b.c:1|c|#m,a:2,z:1")
+    assert m2.digest == m.digest
+
+
+def test_sample_rate():
+    m = parse_one(P, b"a.b.c:1|c|@0.1")
+    assert m.sample_rate == pytest.approx(0.1)
+
+
+def test_sample_rate_and_tags():
+    m = parse_one(P, b"a.b.c:1|c|@0.5|#foo:bar")
+    assert m.sample_rate == pytest.approx(0.5)
+    assert m.tags == ["foo:bar"]
+
+
+def test_multi_value_packet():
+    ms = parse_all(P, b"a.b.c:1:2:3|h|#t:v")
+    assert [m.value for m in ms] == [1.0, 2.0, 3.0]
+    assert len({m.digest for m in ms}) == 1
+    assert all(m.type == "histogram" for m in ms)
+
+
+def test_implicit_tags_extend():
+    p = pmod.Parser(ExtendTags(["implicit"]))
+    m = parse_one(p, b"a.b.c:1|c|#foo:bar")
+    assert m.tags == ["foo:bar", "implicit"]
+
+
+def test_implicit_tags_override_by_key():
+    p = pmod.Parser(ExtendTags(["env:prod"]))
+    m = parse_one(p, b"a.b.c:1|c|#env:dev,other:1")
+    assert m.tags == ["env:prod", "other:1"]
+
+
+INVALID_TABLE = {
+    b"foo": "1 pipe",
+    b"foo:1": "1 pipe",
+    b"foo:1||": "metric type not specified",
+    b"foo:|c|": "empty string after/between pipes",
+    b"this_is_a_bad_metric:nan|g|#shell": "Invalid number for metric value",
+    b"this_is_a_bad_metric:NaN|g|#shell": "Invalid number for metric value",
+    b"this_is_a_bad_metric:-inf|g|#shell": "Invalid number for metric value",
+    b"this_is_a_bad_metric:+inf|g|#shell": "Invalid number for metric value",
+    b"foo:1|foo|": "Invalid type",
+    b"foo:1|c||": "empty string after/between pipes",
+    b"foo:1|c|foo": "unknown section",
+    b"foo:1|c|@-0.1": ">0",
+    b"foo:1|c|@1.1": "<=1",
+    b"foo:1|c|@0.5|@0.2": "multiple sample rates",
+    b"foo:1|c|#foo|#bar": "multiple tag sections",
+    b":1|c": "name cannot be empty",
+    b"foo:1_0|c": "Invalid number",
+}
+
+
+@pytest.mark.parametrize("packet,err", sorted(INVALID_TABLE.items()))
+def test_invalid_packets(packet, err):
+    with pytest.raises(pmod.ParseError, match=None) as exc:
+        parse_all(P, packet)
+    assert err in str(exc.value)
+
+
+def test_local_only_escape():
+    m = parse_one(P, b"a.b.c:1|h|#veneurlocalonly,tag2:quacks")
+    assert m.scope == MetricScope.LOCAL_ONLY
+    assert "veneurlocalonly" not in m.tags
+    assert "tag2:quacks" in m.tags
+
+
+def test_global_only_escape():
+    m = parse_one(P, b"a.b.c:1|h|#veneurglobalonly,tag2:quacks")
+    assert m.scope == MetricScope.GLOBAL_ONLY
+    assert "veneurglobalonly" not in m.tags
+    assert "tag2:quacks" in m.tags
+
+
+def test_event_full():
+    evt = P.parse_event(
+        b"_e{3,3}:foo|bar|k:foos|s:test|t:success|p:low|#foo:bar,baz:qux"
+        b"|d:1136239445|h:example.com")
+    assert evt.name == "foo"
+    assert evt.message == "bar"
+    assert evt.timestamp == 1136239445
+    assert evt.tags == {
+        pmod.EVENT_IDENTIFIER_KEY: "",
+        pmod.EVENT_AGGREGATION_KEY_TAG: "foos",
+        pmod.EVENT_SOURCE_TYPE_TAG: "test",
+        pmod.EVENT_ALERT_TYPE_TAG: "success",
+        pmod.EVENT_PRIORITY_TAG: "low",
+        pmod.EVENT_HOSTNAME_TAG: "example.com",
+        "foo": "bar",
+        "baz": "qux",
+    }
+
+
+def test_event_implicit_tags():
+    p = pmod.Parser(ExtendTags(["implicit"]))
+    evt = p.parse_event(b"_e{3,3}:foo|bar")
+    assert evt.tags["implicit"] == ""
+
+
+EVENT_INVALID = {
+    b"_e{4,3}:foo|bar": "title length",
+    b"_e{3,4}:foo|bar": "text length",
+    b"_e{3,3}:foo|bar|d:abc": "date",
+    b"_e{3,3}:foo|bar|p:baz": "priority",
+    b"_e{3,3}:foo|bar|t:baz": "alert",
+    b"_e{3,3}:foo|bar|t:info|t:info": "multiple alert",
+    b"_e{3,3}:foo|bar||": "pipe",
+    b"_e{3,0}:foo||": "text length",
+    b"_e{3,3}:foo": "text",
+    b"_e{3,3}": "colon",
+}
+
+
+@pytest.mark.parametrize("packet,err", sorted(EVENT_INVALID.items()))
+def test_event_invalid(packet, err):
+    with pytest.raises(pmod.ParseError) as exc:
+        P.parse_event(packet)
+    assert err in str(exc.value)
+
+
+def test_event_message_unescape():
+    evt = P.parse_event(b"_e{3,15}:foo|foo\\nbar\\nbaz\\n")
+    assert evt.message == "foo\nbar\nbaz\n"
+
+
+def test_service_check_full():
+    sc = P.parse_service_check(
+        b"_sc|foo.bar|0|#foo:bar,qux:dor|d:1136239445|h:example.com")
+    assert sc.name == "foo.bar"
+    assert sc.type == "status"
+    assert sc.value == pmod.STATUS_OK
+    assert sc.timestamp == 1136239445
+    assert sc.hostname == "example.com"
+    assert sc.tags == ["foo:bar", "qux:dor"]
+    assert sc.joined_tags == "foo:bar,qux:dor"
+    assert sc.digest == metric_digest("foo.bar", "status", "foo:bar,qux:dor")
+
+
+def test_service_check_implicit_tags():
+    p = pmod.Parser(ExtendTags(["implicit"]))
+    sc = p.parse_service_check(
+        b"_sc|foo.bar|0|#foo:bar,qux:dor|d:1136239445|h:example.com")
+    assert sc.tags == ["foo:bar", "implicit", "qux:dor"]
+    assert sc.joined_tags == "foo:bar,implicit,qux:dor"
+
+
+SC_INVALID = {
+    b"foo.bar|0": "_sc",
+    b"_sc|foo.bar": "status",
+    b"_sc|foo.bar|5": "status",
+    b"_sc|foo.bar|0||": "pipe",
+    b"_sc|foo.bar|0|d:abc": "date",
+}
+
+
+@pytest.mark.parametrize("packet,err", sorted(SC_INVALID.items()))
+def test_service_check_invalid(packet, err):
+    with pytest.raises(pmod.ParseError) as exc:
+        P.parse_service_check(packet)
+    assert err in str(exc.value)
+
+
+def test_service_check_message_unescape_and_status():
+    sc = P.parse_service_check(b"_sc|foo|0|m:foo\\nbar\\nbaz\\n")
+    assert sc.message == "foo\nbar\nbaz\n"
+    sc = P.parse_service_check(b"_sc|foo|1|m:foo")
+    assert sc.message == "foo"
+    assert sc.value == pmod.STATUS_WARNING
+
+
+def test_message_must_be_last():
+    with pytest.raises(pmod.ParseError) as exc:
+        P.parse_service_check(b"_sc|foo|0|m:msg|h:host")
+    assert "message must be the last" in str(exc.value)
+
+
+def test_fnv1a_reference_vector():
+    """fnv1a-32 known vectors so worker sharding is stable across
+    implementations."""
+    from veneur_tpu.samplers.metric_key import fnv1a_32
+    assert fnv1a_32(b"") == 0x811C9DC5
+    assert fnv1a_32(b"a") == 0xE40C292C
+    assert fnv1a_32(b"foobar") == 0xBF9CF968

@@ -1,0 +1,399 @@
+"""MetricAggregator: ingest/import/flush over the batched arenas.
+
+This is the TPU-native fusion of the reference's Worker
+(`worker.go:348-459`: ProcessMetric / ImportMetric scope dispatch) and
+flusher (`flusher.go:26-122,286-415`: tally + InterMetric generation with
+the local/global flush duality).  Instead of N worker goroutines each
+walking per-key sampler maps, one aggregator owns the arenas and every
+flush evaluates all keys in a handful of batched XLA calls.
+
+Flush duality (`flusher.go:57-74`):
+  - a *local* instance emits histogram aggregates from local-sample
+    scalars and NO percentiles for mixed-scope keys (those forward their
+    digests to the global tier), but full percentiles for local-only keys;
+  - a *global* instance emits percentiles (and digest-derived aggregates
+    for global-scope keys), plus sets and global counters/gauges.
+
+Concurrency: ingest threads append to host staging under `lock`; flush
+holds the lock only to sync staging, snapshot the (immutable) device state
+and host scalars, and reset — evaluation and InterMetric generation run on
+the snapshot outside the lock, so ingest continues during flush exactly
+like the reference's swap-maps-under-mutex (`worker.go:462-481`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.core import arena as arena_mod
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricKey, MetricScope, UDPMetric
+from veneur_tpu.sketches import hll as hll_mod
+from veneur_tpu.sketches import tdigest as td
+
+
+@dataclass
+class FlushResult:
+    metrics: list[sm.InterMetric] = field(default_factory=list)
+    forward: list[sm.ForwardMetric] = field(default_factory=list)
+    processed: int = 0
+    imported: int = 0
+
+
+class MetricAggregator:
+    def __init__(self,
+                 percentiles: Optional[list[float]] = None,
+                 aggregates: sm.HistogramAggregates = sm.HistogramAggregates(),
+                 compression: float = td.DEFAULT_COMPRESSION,
+                 set_precision: int = hll_mod.DEFAULT_PRECISION,
+                 count_unique_timeseries: bool = False):
+        self.percentiles = percentiles if percentiles is not None else [0.5]
+        self.aggregates = aggregates
+        self.lock = threading.Lock()
+        self.digests = arena_mod.DigestArena(compression=compression)
+        self.sets = arena_mod.SetArena(precision=set_precision)
+        self.counters = arena_mod.CounterArena()
+        self.gauges = arena_mod.GaugeArena()
+        self.status = arena_mod.StatusArena()
+        self.processed = 0
+        self.imported = 0
+        self.count_unique_timeseries = count_unique_timeseries
+        self.unique_ts = hll_mod.HLLSketch() if count_unique_timeseries else None
+
+    # -- ingest (ProcessMetric, worker.go:348-396) -------------------------
+
+    def process_metric(self, m: UDPMetric) -> None:
+        with self.lock:
+            self._process_locked(m)
+
+    def process_batch(self, ms: list[UDPMetric]) -> None:
+        with self.lock:
+            for m in ms:
+                self._process_locked(m)
+
+    def _process_locked(self, m: UDPMetric) -> None:
+        self.processed += 1
+        if self.unique_ts is not None:
+            self._sample_timeseries(m)
+        t = m.type
+        if t == sm.TYPE_COUNTER:
+            scope = (MetricScope.GLOBAL_ONLY
+                     if m.scope == MetricScope.GLOBAL_ONLY
+                     else MetricScope.MIXED)
+            row = self.counters.row_for(m.key, scope, m.tags)
+            self.counters.sample(row, m.value, m.sample_rate)
+        elif t == sm.TYPE_GAUGE:
+            scope = (MetricScope.GLOBAL_ONLY
+                     if m.scope == MetricScope.GLOBAL_ONLY
+                     else MetricScope.MIXED)
+            row = self.gauges.row_for(m.key, scope, m.tags)
+            self.gauges.sample(row, m.value)
+        elif t in (sm.TYPE_HISTOGRAM, sm.TYPE_TIMER):
+            row = self.digests.row_for(m.key, m.scope, m.tags)
+            self.digests.sample(row, m.value, m.sample_rate)
+        elif t == sm.TYPE_SET:
+            scope = (MetricScope.LOCAL_ONLY
+                     if m.scope == MetricScope.LOCAL_ONLY
+                     else MetricScope.MIXED)
+            row = self.sets.row_for(m.key, scope, m.tags)
+            self.sets.sample(row, str(m.value))
+        elif t == sm.TYPE_STATUS:
+            row = self.status.row_for(m.key, MetricScope.LOCAL_ONLY, m.tags)
+            self.status.sample(row, float(m.value), m.message, m.hostname)
+        # unknown types are silently skipped, as in worker.go:393-395
+
+    def _sample_timeseries(self, m: UDPMetric) -> None:
+        """Unique-timeseries HLL counting (worker.go:301-345): sample iff
+        the series is finalized on this instance."""
+        local_types = {
+            sm.TYPE_COUNTER: m.scope != MetricScope.GLOBAL_ONLY,
+            sm.TYPE_GAUGE: m.scope != MetricScope.GLOBAL_ONLY,
+            sm.TYPE_HISTOGRAM: m.scope == MetricScope.LOCAL_ONLY,
+            sm.TYPE_SET: m.scope == MetricScope.LOCAL_ONLY,
+            sm.TYPE_TIMER: m.scope == MetricScope.LOCAL_ONLY,
+            sm.TYPE_STATUS: True,
+        }
+        if local_types.get(m.type, False):
+            self.unique_ts.insert(m.digest.to_bytes(8, "little"))
+
+    # -- import (ImportMetric, worker.go:402-459) --------------------------
+
+    def import_metric(self, fm: sm.ForwardMetric) -> None:
+        scope = MetricScope(fm.scope)
+        if fm.kind in (sm.TYPE_COUNTER, sm.TYPE_GAUGE):
+            scope = MetricScope.GLOBAL_ONLY
+        if scope == MetricScope.LOCAL_ONLY:
+            raise ValueError("gRPC import does not accept local metrics")
+        key = MetricKey(fm.name, fm.kind, ",".join(sorted(fm.tags)))
+        with self.lock:
+            self.imported += 1
+            if fm.kind == sm.TYPE_COUNTER:
+                row = self.counters.row_for(key, MetricScope.GLOBAL_ONLY,
+                                            fm.tags)
+                self.counters.merge(row, fm.counter_value)
+            elif fm.kind == sm.TYPE_GAUGE:
+                row = self.gauges.row_for(key, MetricScope.GLOBAL_ONLY,
+                                          fm.tags)
+                self.gauges.merge(row, fm.gauge_value)
+            elif fm.kind == sm.TYPE_SET:
+                row = self.sets.row_for(key, MetricScope.MIXED, fm.tags)
+                self.sets.merge(row, fm.hll)
+            elif fm.kind in (sm.TYPE_HISTOGRAM, sm.TYPE_TIMER):
+                cls = (MetricScope.GLOBAL_ONLY
+                       if scope == MetricScope.GLOBAL_ONLY
+                       else MetricScope.MIXED)
+                row = self.digests.row_for(key, cls, fm.tags)
+                self.digests.merge_digest(
+                    row, fm.digest_means or [], fm.digest_weights or [],
+                    fm.digest_min, fm.digest_max, fm.digest_rsum)
+            else:
+                raise ValueError(f"unknown metric kind {fm.kind!r}")
+
+    # -- flush -------------------------------------------------------------
+
+    def flush(self, is_local: bool, now: Optional[int] = None) -> FlushResult:
+        now = int(now if now is not None else time.time())
+        res = FlushResult()
+
+        with self.lock:
+            snap = self._snapshot_and_reset()
+            res.processed, res.imported = snap.pop("counts")
+
+        self._emit_counters(res, snap, is_local, now)
+        self._emit_gauges(res, snap, is_local, now)
+        self._emit_status(res, snap, now)
+        self._emit_sets(res, snap, is_local, now)
+        self._emit_digests(res, snap, is_local, now)
+        return res
+
+    def _snapshot_and_reset(self) -> dict:
+        """Under lock: sync staging, snapshot state+metadata of touched
+        rows, reset.  Device tensors are immutable so the snapshot is a
+        reference; host arrays are fancy-index copies."""
+        d, s, c, g, st = (self.digests, self.sets, self.counters,
+                          self.gauges, self.status)
+        d.sync()
+        s.sync()
+        snap = {"counts": (self.processed, self.imported)}
+        self.processed = 0
+        self.imported = 0
+        if self.unique_ts is not None:
+            snap["unique_ts"] = self.unique_ts
+            self.unique_ts = hll_mod.HLLSketch()
+
+        for name, ar in (("counters", c), ("gauges", g), ("status", st)):
+            rows = ar.touched_rows()
+            snap[name] = {
+                "rows": rows,
+                "meta": [ar.meta[r] for r in rows],
+                "values": ar.values[rows].copy(),
+            }
+        snap["status"]["messages"] = {
+            int(r): st.messages.get(int(r), "")
+            for r in snap["status"]["rows"]}
+        snap["status"]["hostnames"] = {
+            int(r): st.hostnames.get(int(r), "")
+            for r in snap["status"]["rows"]}
+
+        srows = s.touched_rows()
+        snap["sets"] = {
+            "rows": srows,
+            "meta": [s.meta[r] for r in srows],
+            "regs": s.regs[srows].copy(),
+        }
+
+        drows = d.touched_rows()
+        snap["digests"] = {
+            "rows": drows,
+            "meta": [d.meta[r] for r in drows],
+            "state": d.eval_state(),     # immutable snapshot
+            "l_weight": d.l_weight[drows].copy(),
+            "l_min": d.l_min[drows].copy(),
+            "l_max": d.l_max[drows].copy(),
+            "l_sum": d.l_sum[drows].copy(),
+            "l_rsum": d.l_rsum[drows].copy(),
+            "d_min": d.d_min[drows].copy(),
+            "d_max": d.d_max[drows].copy(),
+            "d_rsum": d.d_rsum[drows].copy(),
+        }
+
+        for ar, rows in ((c, snap["counters"]["rows"]),
+                         (g, snap["gauges"]["rows"]),
+                         (st, snap["status"]["rows"]),
+                         (s, srows), (d, drows)):
+            ar.reset_rows(rows)
+            ar.end_interval()
+        return snap
+
+    # -- emitters ----------------------------------------------------------
+
+    def _emit_counters(self, res, snap, is_local, now):
+        part = snap["counters"]
+        for row, meta, val in zip(part["rows"], part["meta"],
+                                  part["values"]):
+            if meta.scope == MetricScope.GLOBAL_ONLY:
+                if is_local:
+                    res.forward.append(sm.ForwardMetric(
+                        name=meta.key.name, tags=meta.tags,
+                        kind=sm.TYPE_COUNTER,
+                        scope=MetricScope.GLOBAL_ONLY,
+                        counter_value=int(val)))
+                    continue
+            res.metrics.append(sm.InterMetric(
+                name=meta.key.name, timestamp=now, value=float(val),
+                tags=meta.tags, type=sm.COUNTER))
+
+    def _emit_gauges(self, res, snap, is_local, now):
+        part = snap["gauges"]
+        for row, meta, val in zip(part["rows"], part["meta"],
+                                  part["values"]):
+            if meta.scope == MetricScope.GLOBAL_ONLY:
+                if is_local:
+                    res.forward.append(sm.ForwardMetric(
+                        name=meta.key.name, tags=meta.tags,
+                        kind=sm.TYPE_GAUGE,
+                        scope=MetricScope.GLOBAL_ONLY,
+                        gauge_value=float(val)))
+                    continue
+            res.metrics.append(sm.InterMetric(
+                name=meta.key.name, timestamp=now, value=float(val),
+                tags=meta.tags, type=sm.GAUGE))
+
+    def _emit_status(self, res, snap, now):
+        part = snap["status"]
+        for row, meta, val in zip(part["rows"], part["meta"],
+                                  part["values"]):
+            res.metrics.append(sm.InterMetric(
+                name=meta.key.name, timestamp=now, value=float(val),
+                tags=meta.tags, type=sm.STATUS,
+                message=part["messages"][int(row)],
+                hostname=part["hostnames"][int(row)]))
+
+    def _emit_sets(self, res, snap, is_local, now):
+        part = snap["sets"]
+        if len(part["rows"]) == 0:
+            return
+        ests = np.asarray(hll_mod.estimate(jnp.asarray(part["regs"])))
+        for i, (row, meta) in enumerate(zip(part["rows"], part["meta"])):
+            if meta.scope == MetricScope.MIXED:
+                if is_local:
+                    res.forward.append(sm.ForwardMetric(
+                        name=meta.key.name, tags=meta.tags,
+                        kind=sm.TYPE_SET, scope=MetricScope.MIXED,
+                        hll=hll_mod.marshal(part["regs"][i])))
+                    continue
+            res.metrics.append(sm.InterMetric(
+                name=meta.key.name, timestamp=now, value=float(ests[i]),
+                tags=meta.tags, type=sm.GAUGE))
+
+    def _emit_digests(self, res, snap, is_local, now):
+        part = snap["digests"]
+        rows = part["rows"]
+        if len(rows) == 0:
+            return
+        state: td.TDigestState = part["state"]
+        pl = list(self.percentiles)
+        qs = np.asarray(td.quantile(state, np.asarray([0.5] + pl,
+                                                      np.float32)))
+        counts = np.asarray(td.total_weight(state))
+        sums = np.asarray(td.sum_values(state))
+        mean_np = np.asarray(state.mean)
+        weight_np = np.asarray(state.weight)
+
+        aggs = self.aggregates.value
+        A = sm.Aggregate
+        for i, (row, meta) in enumerate(zip(rows, part["meta"])):
+            cls = meta.scope  # MIXED / GLOBAL_ONLY / LOCAL_ONLY row class
+            kind = meta.key.type
+            if cls == MetricScope.MIXED:
+                if is_local:
+                    # forward the digest; emit aggregates from local scalars
+                    occ = weight_np[row] > 0
+                    res.forward.append(sm.ForwardMetric(
+                        name=meta.key.name, tags=meta.tags, kind=kind,
+                        scope=MetricScope.MIXED,
+                        digest_means=mean_np[row][occ].tolist(),
+                        digest_weights=weight_np[row][occ].tolist(),
+                        digest_min=float(part["d_min"][i]),
+                        digest_max=float(part["d_max"][i]),
+                        digest_sum=float(sums[row]),
+                        digest_rsum=float(part["d_rsum"][i]),
+                        digest_compression=self.digests.compression))
+                    row_pcts = []
+                else:
+                    row_pcts = pl
+                use_global = False
+            elif cls == MetricScope.GLOBAL_ONLY:
+                if is_local:
+                    occ = weight_np[row] > 0
+                    res.forward.append(sm.ForwardMetric(
+                        name=meta.key.name, tags=meta.tags, kind=kind,
+                        scope=MetricScope.GLOBAL_ONLY,
+                        digest_means=mean_np[row][occ].tolist(),
+                        digest_weights=weight_np[row][occ].tolist(),
+                        digest_min=float(part["d_min"][i]),
+                        digest_max=float(part["d_max"][i]),
+                        digest_sum=float(sums[row]),
+                        digest_rsum=float(part["d_rsum"][i]),
+                        digest_compression=self.digests.compression))
+                    continue  # nothing emitted locally for global-only
+                row_pcts = pl
+                use_global = True
+            else:  # LOCAL_ONLY: flushed fully here, never forwarded
+                row_pcts = pl
+                use_global = False
+
+            self._emit_histo_row(
+                res, meta, now, aggs, A, use_global,
+                l_weight=part["l_weight"][i], l_min=part["l_min"][i],
+                l_max=part["l_max"][i], l_sum=part["l_sum"][i],
+                l_rsum=part["l_rsum"][i],
+                d_min=part["d_min"][i], d_max=part["d_max"][i],
+                d_rsum=part["d_rsum"][i],
+                d_count=counts[row], d_sum=sums[row],
+                median=qs[row, 0],
+                pct_values={p: qs[row, 1 + pl.index(p)] for p in row_pcts})
+
+    def _emit_histo_row(self, res, meta, now, aggs, A, use_global, *,
+                        l_weight, l_min, l_max, l_sum, l_rsum,
+                        d_min, d_max, d_rsum, d_count, d_sum,
+                        median, pct_values):
+        """One histogram row's InterMetrics, mirroring Histo.Flush
+        (samplers/samplers.go:359-514): local-scalar aggregates with
+        sparse-emission guards, digest-backed values when global."""
+        name = meta.key.name
+        tags = meta.tags
+        out = res.metrics
+
+        def emit(suffix, value, mtype=sm.GAUGE):
+            out.append(sm.InterMetric(
+                name=meta.flush_name(suffix), timestamp=now,
+                value=float(value), tags=tags, type=mtype))
+
+        if aggs & A.MAX and (np.isfinite(l_max) or use_global):
+            emit(".max", d_max if use_global else l_max)
+        if aggs & A.MIN and (np.isfinite(l_min) or use_global):
+            emit(".min", d_min if use_global else l_min)
+        if aggs & A.SUM and (l_sum != 0 or use_global):
+            emit(".sum", d_sum if use_global else l_sum)
+        if aggs & A.AVERAGE and (use_global or (l_sum != 0 and l_weight != 0)):
+            emit(".avg", (d_sum / d_count) if use_global
+                 else (l_sum / l_weight))
+        if aggs & A.COUNT and (l_weight != 0 or use_global):
+            emit(".count", d_count if use_global else l_weight, sm.COUNTER)
+        if aggs & A.MEDIAN:
+            # emitted unconditionally when configured (samplers.go:466-479)
+            emit(".median", median)
+        if aggs & A.HARMONIC_MEAN and (use_global or
+                                       (l_rsum != 0 and l_weight != 0)):
+            emit(".hmean", (d_count / d_rsum) if use_global
+                 else (l_weight / l_rsum))
+        for p, v in pct_values.items():
+            # reference naming: int(p*100), samplers.go:495-507
+            emit(f".{int(p * 100)}percentile", v)

@@ -1,0 +1,391 @@
+"""Metric arenas: the TPU-native replacement for per-key sampler objects.
+
+The reference holds one Go object per metric key in 13 scope-partitioned
+maps (`worker.go:58-82`) and walks them sequentially at flush.  Here each
+sampler family is an *arena*: a key dictionary mapping
+(MetricKey, scope) -> row index, plus batched state where row i of a set of
+device tensors / numpy arrays is that key's sampler.  Ingest appends to
+host-side COO staging buffers; `sync()` scatters staging into dense wave
+tensors and folds them into device state with one XLA call per wave; flush
+evaluates every key at once (quantiles, estimates) and emits only rows
+touched this interval.
+
+Scope partitioning (`worker.go:106-175` Upsert) becomes per-row metadata
+(kind, scope) instead of separate maps, so one device call covers all
+histogram classes.
+
+Min/max/reciprocal-sum are tracked host-side as ground truth: re-ingesting a
+forwarded digest's centroids reproduces its quantile shape but not its exact
+scalar accessors (a centroid mean never reaches the true min/max), so
+imports merge the wire scalars directly (`worker.go:402-459` semantics) and
+flush pushes them into the device state before evaluation.
+
+Rows persist across intervals (the reference re-allocates maps each flush,
+`worker.go:462-481`); `reset()` zeroes state and the touched mask instead,
+and idle keys are garbage-collected after IDLE_GC_INTERVALS flushes so
+cardinality churn cannot grow the arena unboundedly.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+from veneur_tpu.sketches import hll as hll_mod
+from veneur_tpu.sketches import tdigest as td
+
+# samples per device-ingest wave (column width of the dense scatter)
+WAVE_WIDTH = 128
+# flush intervals a key may stay untouched before its row is recycled
+IDLE_GC_INTERVALS = 10
+
+_INITIAL_CAPACITY = 1024
+
+
+@dataclass
+class RowMeta:
+    key: MetricKey
+    tags: list[str]
+    scope: MetricScope
+    # pre-rendered flush names, filled lazily (e.g. "x.max", "x.50percentile")
+    names: dict[str, str] = field(default_factory=dict)
+
+    def flush_name(self, suffix: str) -> str:
+        n = self.names.get(suffix)
+        if n is None:
+            n = self.key.name + suffix if suffix else self.key.name
+            self.names[suffix] = n
+        return n
+
+
+class _ArenaBase:
+    """Key dictionary + row lifecycle shared by all arenas."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY):
+        self.capacity = capacity
+        self.kdict: dict[tuple[MetricKey, MetricScope], int] = {}
+        self.meta: list[Optional[RowMeta]] = [None] * capacity
+        self.touched = np.zeros(capacity, bool)
+        self.idle = np.zeros(capacity, np.int32)
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self.lock = threading.Lock()
+
+    def _grow(self) -> None:
+        old = self.capacity
+        self.capacity = old * 2
+        self.meta.extend([None] * old)
+        self.touched = np.concatenate([self.touched, np.zeros(old, bool)])
+        self.idle = np.concatenate([self.idle, np.zeros(old, np.int32)])
+        self._free.extend(range(self.capacity - 1, old - 1, -1))
+        self._grow_state(old)
+
+    def _grow_state(self, old_capacity: int) -> None:
+        raise NotImplementedError
+
+    def row_for(self, key: MetricKey, scope: MetricScope,
+                tags: list[str]) -> int:
+        """Upsert: find or allocate the row for (key, scope)."""
+        dk = (key, scope)
+        row = self.kdict.get(dk)
+        if row is None:
+            if not self._free:
+                self._grow()
+            row = self._free.pop()
+            self.kdict[dk] = row
+            self.meta[row] = RowMeta(key=key, tags=tags, scope=scope)
+            self.idle[row] = 0
+        self.touched[row] = True
+        return row
+
+    def touched_rows(self) -> np.ndarray:
+        return np.nonzero(self.touched)[0]
+
+    def end_interval(self) -> None:
+        """Reset touched state and GC idle rows (after flush)."""
+        self.idle[self.touched] = 0
+        self.idle[~self.touched] += 1
+        dead = np.nonzero((self.idle >= IDLE_GC_INTERVALS)
+                          & np.array([m is not None for m in self.meta]))[0]
+        for row in dead:
+            m = self.meta[row]
+            self.meta[row] = None
+            self.idle[row] = 0
+            del self.kdict[(m.key, m.scope)]
+            self._free.append(int(row))
+        self.touched[:] = False
+
+
+class CounterArena(_ArenaBase):
+    """int64 accumulators (samplers/samplers.go:97-150); mixed and
+    global-only counters share the arena, separated by row scope."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY):
+        super().__init__(capacity)
+        self.values = np.zeros(capacity, np.float64)
+
+    def _grow_state(self, old: int) -> None:
+        self.values = np.concatenate([self.values, np.zeros(old, np.float64)])
+
+    def sample(self, row: int, value: float, sample_rate: float) -> None:
+        # Sample divides by rate at ingest (samplers.go:109-111)
+        self.values[row] += int(value / sample_rate)
+
+    def merge(self, row: int, value: int) -> None:
+        self.values[row] += value
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        self.values[rows] = 0
+
+
+class GaugeArena(_ArenaBase):
+    """Last-write-wins gauges (samplers/samplers.go:152-202)."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY):
+        super().__init__(capacity)
+        self.values = np.zeros(capacity, np.float64)
+
+    def _grow_state(self, old: int) -> None:
+        self.values = np.concatenate([self.values, np.zeros(old, np.float64)])
+
+    def sample(self, row: int, value: float) -> None:
+        self.values[row] = value
+
+    def merge(self, row: int, value: float) -> None:
+        self.values[row] = value  # Merge overwrites (samplers.go:200-202)
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        self.values[rows] = 0
+
+
+class StatusArena(_ArenaBase):
+    """Service-check state: last value + message + hostname
+    (samplers/samplers.go:210-231)."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY):
+        super().__init__(capacity)
+        self.values = np.zeros(capacity, np.float64)
+        self.messages: dict[int, str] = {}
+        self.hostnames: dict[int, str] = {}
+
+    def _grow_state(self, old: int) -> None:
+        self.values = np.concatenate([self.values, np.zeros(old, np.float64)])
+
+    def sample(self, row: int, value: float, message: str,
+               hostname: str) -> None:
+        self.values[row] = value
+        self.messages[row] = message
+        self.hostnames[row] = hostname
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        self.values[rows] = 0
+        for r in rows:
+            self.messages.pop(int(r), None)
+            self.hostnames.pop(int(r), None)
+
+
+class SetArena(_ArenaBase):
+    """HLL register arenas [capacity, 2^p] (samplers/samplers.go:236-311).
+
+    Registers stay in host numpy (the insert path is scatter-max, which is
+    host-friendly); the batched estimate runs on device at flush.
+    """
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY,
+                 precision: int = hll_mod.DEFAULT_PRECISION):
+        super().__init__(capacity)
+        self.precision = precision
+        self.m = 1 << precision
+        self.regs = np.zeros((capacity, self.m), np.uint8)
+        # staging: raw hashes per batch (vectorized split at sync)
+        self._stage_rows: list[int] = []
+        self._stage_hashes: list[int] = []
+
+    def _grow_state(self, old: int) -> None:
+        self.regs = np.concatenate(
+            [self.regs, np.zeros((old, self.m), np.uint8)])
+
+    def sample(self, row: int, member: str) -> None:
+        self._stage_rows.append(row)
+        self._stage_hashes.append(hll_mod.hash64(member.encode()))
+
+    def merge(self, row: int, payload: bytes) -> None:
+        other = hll_mod.unmarshal(payload)
+        np.maximum(self.regs[row], other, out=self.regs[row])
+
+    def sync(self) -> None:
+        if not self._stage_rows:
+            return
+        rows = np.asarray(self._stage_rows, np.int64)
+        hs = np.asarray(self._stage_hashes, np.uint64)
+        self._stage_rows, self._stage_hashes = [], []
+        idx, rank = hll_mod.split_hashes(hs, self.precision)
+        hll_mod.update_registers(self.regs, rows, idx, rank)
+
+    def estimates(self) -> np.ndarray:
+        """Batched device estimate for all rows; returns [capacity] f32."""
+        self.sync()
+        return np.asarray(hll_mod.estimate(jnp.asarray(self.regs)))
+
+    def marshal_row(self, row: int) -> bytes:
+        self.sync()
+        return hll_mod.marshal(self.regs[row])
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        self.sync()
+        self.regs[rows] = 0
+
+
+class DigestArena(_ArenaBase):
+    """All histogram/timer digests as one batched TDigestState.
+
+    Device state holds centroids; host numpy tracks the true digest scalars
+    (min/max/rsum — see module docstring) and the *local-samples-only*
+    scalar accumulators that back the mixed-scope flush duality
+    (`samplers/samplers.go:315-342`: LocalWeight/Min/Max/Sum/ReciprocalSum).
+    """
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY,
+                 compression: float = td.DEFAULT_COMPRESSION):
+        super().__init__(capacity)
+        self.compression = compression
+        self.ccap = td.centroid_capacity(compression)
+        self.state = td.empty(capacity, compression, self.ccap)
+        # true digest scalars (local samples + imports)
+        self.d_min = np.full(capacity, np.inf)
+        self.d_max = np.full(capacity, -np.inf)
+        self.d_rsum = np.zeros(capacity)
+        # local-samples-only accumulators
+        self.l_weight = np.zeros(capacity)
+        self.l_min = np.full(capacity, np.inf)
+        self.l_max = np.full(capacity, -np.inf)
+        self.l_sum = np.zeros(capacity)
+        self.l_rsum = np.zeros(capacity)
+        # COO staging
+        self._rows: list[int] = []
+        self._vals: list[float] = []
+        self._wts: list[float] = []
+        self._local: list[bool] = []
+
+    def _grow_state(self, old: int) -> None:
+        new = td.empty(self.capacity, self.compression, self.ccap)
+        self.state = td.TDigestState(
+            mean=new.mean.at[:old].set(self.state.mean),
+            weight=new.weight.at[:old].set(self.state.weight),
+            min=new.min.at[:old].set(self.state.min),
+            max=new.max.at[:old].set(self.state.max),
+            rsum=new.rsum.at[:old].set(self.state.rsum))
+        pad = lambda a, fill: np.concatenate(
+            [a, np.full(old, fill, a.dtype)])
+        self.d_min = pad(self.d_min, np.inf)
+        self.d_max = pad(self.d_max, -np.inf)
+        self.d_rsum = pad(self.d_rsum, 0)
+        self.l_weight = pad(self.l_weight, 0)
+        self.l_min = pad(self.l_min, np.inf)
+        self.l_max = pad(self.l_max, -np.inf)
+        self.l_sum = pad(self.l_sum, 0)
+        self.l_rsum = pad(self.l_rsum, 0)
+
+    def sample(self, row: int, value: float, sample_rate: float) -> None:
+        """A locally-observed sample (Histo.Sample, samplers.go:331-342)."""
+        w = 1.0 / sample_rate
+        self._rows.append(row)
+        self._vals.append(value)
+        self._wts.append(w)
+        self._local.append(True)
+
+    def merge_digest(self, row: int, means, weights, dmin: float,
+                     dmax: float, drsum: float) -> None:
+        """Fold a forwarded digest into a row (Histo.Merge,
+        samplers.go:539-543): centroids re-ingested as weighted points,
+        scalars merged exactly from the wire values."""
+        self._rows.extend([row] * len(means))
+        self._vals.extend(float(m) for m in means)
+        self._wts.extend(float(w) for w in weights)
+        self._local.extend([False] * len(means))
+        self.d_min[row] = min(self.d_min[row], dmin)
+        self.d_max[row] = max(self.d_max[row], dmax)
+        self.d_rsum[row] += drsum
+
+    def sync(self) -> None:
+        """Scatter COO staging into dense waves and ingest on device."""
+        if not self._rows:
+            return
+        rows = np.asarray(self._rows, np.int64)
+        vals = np.asarray(self._vals, np.float64)
+        wts = np.asarray(self._wts, np.float64)
+        local = np.asarray(self._local, bool)
+        self._rows, self._vals, self._wts, self._local = [], [], [], []
+
+        # host scalar updates (vectorized)
+        np.minimum.at(self.d_min, rows, vals)
+        np.maximum.at(self.d_max, rows, vals)
+        with np.errstate(divide="ignore"):
+            np.add.at(self.d_rsum, rows[local],
+                      wts[local] / vals[local])
+        lr, lv, lw = rows[local], vals[local], wts[local]
+        np.add.at(self.l_weight, lr, lw)
+        np.minimum.at(self.l_min, lr, lv)
+        np.maximum.at(self.l_max, lr, lv)
+        np.add.at(self.l_sum, lr, lv * lw)
+        with np.errstate(divide="ignore"):
+            np.add.at(self.l_rsum, lr, lw / lv)
+
+        # dense waves: position of each sample within its row
+        order = np.argsort(rows, kind="stable")
+        r, v, w = rows[order], vals[order], wts[order]
+        first = np.searchsorted(r, np.arange(self.capacity))
+        pos = np.arange(len(r)) - first[r]
+        wave = pos // WAVE_WIDTH
+        col = pos % WAVE_WIDTH
+        for wv in range(int(wave.max()) + 1):
+            m = wave == wv
+            dv = np.zeros((self.capacity, WAVE_WIDTH), np.float32)
+            dw = np.zeros((self.capacity, WAVE_WIDTH), np.float32)
+            dv[r[m], col[m]] = v[m]
+            dw[r[m], col[m]] = w[m]
+            self.state = td.ingest(self.state, jnp.asarray(dv),
+                                   jnp.asarray(dw), self.compression)
+
+    def eval_state(self) -> td.TDigestState:
+        """Device state with the authoritative host scalars pushed in."""
+        self.sync()
+        return self.state._replace(
+            min=jnp.asarray(self.d_min, jnp.float32),
+            max=jnp.asarray(self.d_max, jnp.float32),
+            rsum=jnp.asarray(self.d_rsum, jnp.float32))
+
+    def export_centroids(self, rows: np.ndarray
+                         ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """(means, weights) per requested row, for forwarding."""
+        self.sync()
+        mean = np.asarray(self.state.mean)
+        weight = np.asarray(self.state.weight)
+        out = []
+        for row in rows:
+            occ = weight[row] > 0
+            out.append((mean[row][occ], weight[row][occ]))
+        return out
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        if len(rows) == 0:
+            return
+        idx = jnp.asarray(rows)
+        self.state = td.TDigestState(
+            mean=self.state.mean.at[idx].set(0.0),
+            weight=self.state.weight.at[idx].set(0.0),
+            min=self.state.min.at[idx].set(jnp.inf),
+            max=self.state.max.at[idx].set(-jnp.inf),
+            rsum=self.state.rsum.at[idx].set(0.0))
+        self.d_min[rows] = np.inf
+        self.d_max[rows] = -np.inf
+        self.d_rsum[rows] = 0
+        self.l_weight[rows] = 0
+        self.l_min[rows] = np.inf
+        self.l_max[rows] = -np.inf
+        self.l_sum[rows] = 0
+        self.l_rsum[rows] = 0

@@ -1,0 +1,365 @@
+"""DogStatsD wire-format parser: metrics, events, service checks.
+
+Behavior-compatible re-implementation of the reference's byte parser
+(`samplers/parser.go:349-770`): `name:v1:v2|type|@rate|#tags` datagrams with
+multi-value packets, `d`/`h` -> histogram, `ms` -> timer, magic
+`veneurlocalonly`/`veneurglobalonly` scope tags (stripped from the tag list,
+`parser.go:444-456`), `_e{...}` events (metadata surfaced as magic
+`vdogstatsd_*` tags, `protocol/dogstatsd/protocol.go`), and `_sc` service
+checks.  Every malformed-packet error case in the reference's 1149-line
+`parser_test.go` has a matching error here (tests/test_parser.py).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric
+from veneur_tpu.util import tagging
+
+# Magic tag keys conducting DogStatsD event metadata to sinks
+# (protocol/dogstatsd/protocol.go:1-20).
+EVENT_AGGREGATION_KEY_TAG = "vdogstatsd_ak"
+EVENT_ALERT_TYPE_TAG = "vdogstatsd_at"
+EVENT_HOSTNAME_TAG = "vdogstatsd_hostname"
+EVENT_IDENTIFIER_KEY = "vdogstatsd_ev"
+EVENT_PRIORITY_TAG = "vdogstatsd_pri"
+EVENT_SOURCE_TYPE_TAG = "vdogstatsd_st"
+
+# Status-check values (ssf.SSFSample_* numeric values).
+STATUS_OK = 0
+STATUS_WARNING = 1
+STATUS_CRITICAL = 2
+STATUS_UNKNOWN = 3
+
+_TYPE_BY_LEAD = {
+    ord("c"): "counter",
+    ord("g"): "gauge",
+    ord("d"): "histogram",
+    ord("h"): "histogram",
+    ord("m"): "timer",     # "ms"
+    ord("s"): "set",
+}
+
+
+class ParseError(ValueError):
+    pass
+
+
+def _strict_float(raw: bytes) -> float:
+    """Go-strconv-like float parse: no underscores or surrounding
+    whitespace (Python's float() is laxer than Go's ParseFloat)."""
+    if b"_" in raw or raw != raw.strip():
+        raise ValueError(f"invalid float syntax: {raw!r}")
+    return float(raw)
+
+
+@dataclass
+class SSFSample:
+    """Minimal host-side sample record for events/service-check metadata
+    (the protobuf twin lives in veneur_tpu/ssf)."""
+    metric: str = "counter"
+    name: str = ""
+    value: float = 0.0
+    timestamp: int = 0
+    message: str = ""
+    status: int = STATUS_OK
+    sample_rate: float = 1.0
+    tags: dict[str, str] = field(default_factory=dict)
+    unit: str = ""
+
+
+class Parser:
+    """DogStatsD parser with configured implicit tags
+    (`samplers.NewParser`, parser.go:110-135)."""
+
+    def __init__(self, extend_tags: tagging.ExtendTags | None = None):
+        self.extend_tags = extend_tags or tagging.EMPTY
+
+    # -- metrics ----------------------------------------------------------
+
+    def parse_metric(self, packet: bytes,
+                     cb: Callable[[UDPMetric], None]) -> None:
+        """Parse one datagram line, invoking cb once per value
+        (multi-value packets `name:v1:v2:v3|t`, parser.go:466-504)."""
+        type_start = packet.find(b"|")
+        if type_start < 0:
+            raise ParseError(
+                "Invalid metric packet, need at least 1 pipe for type")
+        value_start = packet.find(b":", 0, type_start)
+        if value_start < 0:
+            raise ParseError("Invalid metric packet, need at least 1 colon")
+        name_chunk = packet[:value_start]
+        value_chunk = packet[value_start + 1:type_start]
+        if not name_chunk:
+            raise ParseError("Invalid metric packet, name cannot be empty")
+
+        tags_start = packet.find(b"|", type_start + 1)
+        if tags_start < 0:
+            tags_start = len(packet)
+        type_chunk = packet[type_start + 1:tags_start]
+        if not type_chunk:
+            raise ParseError(
+                "Invalid metric packet, metric type not specified")
+        mtype = _TYPE_BY_LEAD.get(type_chunk[0])
+        if mtype is None:
+            raise ParseError("Invalid type for metric")
+
+        metric = UDPMetric(name=name_chunk.decode(), type=mtype)
+
+        found_sample_rate = False
+        temp_tags: Optional[list[str]] = None
+        while tags_start < len(packet):
+            tags_next = packet.find(b"|", tags_start + 1)
+            if tags_next < 0:
+                tags_next = len(packet)
+            chunk = packet[tags_start + 1:tags_next]
+            tags_start = tags_next
+            if not chunk:
+                raise ParseError(
+                    "Invalid metric packet, empty string after/between pipes")
+            lead = chunk[0]
+            if lead == ord("@"):
+                if found_sample_rate:
+                    raise ParseError(
+                        "Invalid metric packet, multiple sample rates specified")
+                try:
+                    rate = _strict_float(chunk[1:])
+                except ValueError:
+                    raise ParseError(
+                        f"Invalid float for sample rate: {chunk[1:].decode(errors='replace')}")
+                if not rate > 0 or rate > 1 or math.isnan(rate):
+                    raise ParseError(
+                        f"Sample rate {rate} must be >0 and <=1")
+                metric.sample_rate = rate
+                found_sample_rate = True
+            elif lead == ord("#"):
+                if temp_tags is not None:
+                    raise ParseError(
+                        "Invalid metric packet, multiple tag sections specified")
+                temp_tags = chunk[1:].decode().split(",")
+                for i, tag in enumerate(temp_tags):
+                    # magic scope tags are stripped (parser.go:444-456)
+                    if tag.startswith("veneurlocalonly"):
+                        del temp_tags[i]
+                        metric.scope = MetricScope.LOCAL_ONLY
+                        break
+                    if tag.startswith("veneurglobalonly"):
+                        del temp_tags[i]
+                        metric.scope = MetricScope.GLOBAL_ONLY
+                        break
+            else:
+                raise ParseError(
+                    "Invalid metric packet, contains unknown section "
+                    f"{chunk.decode(errors='replace')!r}")
+
+        metric.update_tags(temp_tags or [], self.extend_tags)
+
+        # One callback per value; values after the first share identity.
+        values = value_chunk.split(b":")
+        for raw in values:
+            m = UDPMetric(
+                name=metric.name, type=metric.type,
+                joined_tags=metric.joined_tags, digest=metric.digest,
+                tags=metric.tags, sample_rate=metric.sample_rate,
+                scope=metric.scope)
+            if mtype == "set":
+                m.value = raw.decode()
+            else:
+                try:
+                    v = _strict_float(raw)
+                except ValueError:
+                    raise ParseError(
+                        f"Invalid number for metric value: {raw.decode(errors='replace')}")
+                if math.isnan(v) or math.isinf(v):
+                    raise ParseError(
+                        f"Invalid number for metric value: {raw.decode(errors='replace')}")
+                m.value = v
+            cb(m)
+
+    # -- events -----------------------------------------------------------
+
+    def parse_event(self, packet: bytes) -> SSFSample:
+        """`_e{tlen,xlen}:title|text|meta...` (parser.go:511-657)."""
+        ret = SSFSample(timestamp=int(time.time()),
+                        tags={EVENT_IDENTIFIER_KEY: ""})
+        chunks = packet.split(b"|")
+        first = chunks[0]
+        colon = first.find(b":")
+        if colon < 0:
+            raise ParseError("Invalid event packet, need at least 1 colon")
+        lengths = first[:colon]
+        if not lengths.startswith(b"_e{") or not lengths.endswith(b"}"):
+            raise ParseError(
+                "Invalid event packet, must have _e{} wrapper around length section")
+        lengths = lengths[3:-1]
+        comma = lengths.find(b",")
+        if comma < 0:
+            raise ParseError(
+                "Invalid event packet, length section requires comma divider")
+        try:
+            title_len = int(lengths[:comma])
+        except ValueError as e:
+            raise ParseError(
+                f"Invalid event packet, title length is not an integer: {e}")
+        if title_len <= 0:
+            raise ParseError(
+                "Invalid event packet, title length must be positive")
+        try:
+            text_len = int(lengths[comma + 1:])
+        except ValueError as e:
+            raise ParseError(
+                f"Invalid event packet, text length is not an integer: {e}")
+        if text_len <= 0:
+            raise ParseError(
+                "Invalid event packet, text length must be positive")
+
+        title = first[colon + 1:]
+        if len(title) != title_len:
+            raise ParseError(
+                "Invalid event packet, actual title length did not match encoded length")
+        ret.name = title.decode()
+
+        if len(chunks) < 2:
+            raise ParseError(
+                "Invalid event packet, must have at least 1 pipe for text")
+        text = chunks[1]
+        if len(text) != text_len:
+            raise ParseError(
+                "Invalid event packet, actual text length did not match encoded length")
+        ret.message = text.decode().replace("\\n", "\n")
+
+        found: set[str] = set()
+
+        def once(section: str):
+            if section in found:
+                raise ParseError(
+                    f"Invalid event packet, multiple {section} sections")
+            found.add(section)
+
+        for chunk in chunks[2:]:
+            if not chunk:
+                raise ParseError(
+                    "Invalid event packet, empty string after/between pipes")
+            if chunk.startswith(b"d:"):
+                once("date")
+                try:
+                    ret.timestamp = int(chunk[2:])
+                except ValueError as e:
+                    raise ParseError(
+                        "Invalid event packet, could not parse date as unix "
+                        f"timestamp: {e}")
+            elif chunk.startswith(b"h:"):
+                once("hostname")
+                ret.tags[EVENT_HOSTNAME_TAG] = chunk[2:].decode()
+            elif chunk.startswith(b"k:"):
+                once("aggregation key")
+                ret.tags[EVENT_AGGREGATION_KEY_TAG] = chunk[2:].decode()
+            elif chunk.startswith(b"p:"):
+                once("priority")
+                pri = chunk[2:].decode()
+                if pri not in ("normal", "low"):
+                    raise ParseError(
+                        "Invalid event packet, priority must be normal or low")
+                ret.tags[EVENT_PRIORITY_TAG] = pri
+            elif chunk.startswith(b"s:"):
+                once("source")
+                ret.tags[EVENT_SOURCE_TYPE_TAG] = chunk[2:].decode()
+            elif chunk.startswith(b"t:"):
+                once("alert")
+                alert = chunk[2:].decode()
+                if alert not in ("error", "warning", "info", "success"):
+                    raise ParseError(
+                        "Invalid event packet, alert level must be error, "
+                        "warning, info or success")
+                ret.tags[EVENT_ALERT_TYPE_TAG] = alert
+            elif chunk[0] == ord("#"):
+                once("tags")
+                tags = chunk[1:].decode().split(",")
+                ret.tags.update(tagging.parse_tag_slice_to_map(tags))
+            else:
+                raise ParseError(
+                    "Invalid event packet, unrecognized metadata section")
+
+        ret.tags = self.extend_tags.extend_map(ret.tags)
+        return ret
+
+    # -- service checks ---------------------------------------------------
+
+    def parse_service_check(self, packet: bytes) -> UDPMetric:
+        """`_sc|name|status|meta...` (parser.go:663-770)."""
+        ret = UDPMetric(type="status", sample_rate=1.0,
+                        timestamp=int(time.time()))
+        chunks = packet.split(b"|")
+        if chunks[0] != b"_sc":
+            raise ParseError("Invalid service check packet, no _sc prefix")
+        if len(chunks) < 2:
+            raise ParseError(
+                "Invalid service check packet, need name section")
+        if not chunks[1]:
+            raise ParseError("Invalid service check packet, empty name")
+        ret.name = chunks[1].decode()
+        if len(chunks) < 3:
+            raise ParseError(
+                "Invalid service check packet, need status section")
+        status_map = {b"0": STATUS_OK, b"1": STATUS_WARNING,
+                      b"2": STATUS_CRITICAL, b"3": STATUS_UNKNOWN}
+        if chunks[2] not in status_map:
+            raise ParseError(
+                "Invalid service check packet, must have status of 0, 1, 2, or 3")
+        ret.value = status_map[chunks[2]]
+
+        found: set[str] = set()
+        found_message = False
+        temp_tags: list[str] = []
+        for chunk in chunks[3:]:
+            if not chunk:
+                raise ParseError(
+                    "Invalid service packet packet, empty string after/between pipes")
+            if found_message:
+                raise ParseError(
+                    "Invalid service check packet, message must be the last "
+                    "metadata section")
+            if chunk.startswith(b"d:"):
+                if "date" in found:
+                    raise ParseError(
+                        "Invalid service check packet, multiple date sections")
+                found.add("date")
+                try:
+                    ret.timestamp = int(chunk[2:])
+                except ValueError as e:
+                    raise ParseError(
+                        "Invalid service check packet, could not parse date "
+                        f"as unix timestamp: {e}")
+            elif chunk.startswith(b"h:"):
+                if "hostname" in found:
+                    raise ParseError(
+                        "Invalid service check packet, multiple hostname sections")
+                found.add("hostname")
+                ret.hostname = chunk[2:].decode()
+            elif chunk.startswith(b"m:"):
+                found_message = True
+                ret.message = chunk[2:].decode().replace("\\n", "\n")
+            elif chunk[0] == ord("#"):
+                if "tags" in found:
+                    raise ParseError(
+                        "Invalid service check packet, multiple tag sections")
+                found.add("tags")
+                temp_tags = chunk[1:].decode().split(",")
+                for i, tag in enumerate(temp_tags):
+                    if tag == "veneurlocalonly":
+                        del temp_tags[i]
+                        ret.scope = MetricScope.LOCAL_ONLY
+                        break
+                    if tag == "veneurglobalonly":
+                        del temp_tags[i]
+                        ret.scope = MetricScope.GLOBAL_ONLY
+                        break
+            else:
+                raise ParseError(
+                    "Invalid service check packet, unrecognized metadata section")
+        ret.update_tags(temp_tags, self.extend_tags)
+        return ret

@@ -1,0 +1,111 @@
+"""Flush-ready metric records and histogram aggregate configuration.
+
+Mirrors `samplers/samplers.go:34-94` (InterMetric, metric type constants)
+and the HistogramAggregates bitmask (`samplers/samplers.go` aggregates +
+config parsing).  The samplers themselves (Counter/Gauge/Set/Histo/Status)
+are not per-key objects here — their state lives in the batched device
+arenas (veneur_tpu/core/arena.py); this module defines the shared value
+types both sides exchange.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Metric type constants (samplers/samplers.go:50-60).
+COUNTER = "counter"
+GAUGE = "gauge"
+STATUS = "status"
+
+# Sampler type names used in MetricKey.Type (worker.go Upsert switch).
+TYPE_COUNTER = "counter"
+TYPE_GAUGE = "gauge"
+TYPE_HISTOGRAM = "histogram"
+TYPE_SET = "set"
+TYPE_TIMER = "timer"
+TYPE_STATUS = "status"
+
+
+class Aggregate(enum.IntFlag):
+    """Histogram aggregate selection bitmask (samplers/samplers.go)."""
+    MAX = 1
+    MIN = 2
+    SUM = 4
+    AVERAGE = 8
+    COUNT = 16
+    MEDIAN = 32
+    HARMONIC_MEAN = 64
+
+
+AGGREGATE_NAMES = {
+    "max": Aggregate.MAX,
+    "min": Aggregate.MIN,
+    "sum": Aggregate.SUM,
+    "avg": Aggregate.AVERAGE,
+    "count": Aggregate.COUNT,
+    "median": Aggregate.MEDIAN,
+    "hmean": Aggregate.HARMONIC_MEAN,
+}
+
+# config.go:106-112 default aggregates
+DEFAULT_AGGREGATES = Aggregate.MIN | Aggregate.MAX | Aggregate.COUNT
+
+
+def parse_aggregates(names: list[str]) -> "HistogramAggregates":
+    value = Aggregate(0)
+    for n in names:
+        agg = AGGREGATE_NAMES.get(n)
+        if agg is not None:
+            value |= agg
+    return HistogramAggregates(value)
+
+
+@dataclass(frozen=True)
+class HistogramAggregates:
+    value: Aggregate = DEFAULT_AGGREGATES
+
+    @property
+    def count(self) -> int:
+        return bin(self.value).count("1")
+
+
+@dataclass
+class InterMetric:
+    """The flush-ready record handed to sinks (samplers/samplers.go:34-47)."""
+    name: str
+    timestamp: int
+    value: float
+    tags: list[str]
+    type: str  # counter | gauge | status
+    message: str = ""
+    hostname: str = ""
+    # sink routing allowlist; None = all sinks (RouteInformation)
+    sinks: Optional[set[str]] = None
+
+
+@dataclass
+class ForwardMetric:
+    """A metric exported for forwarding to the global tier — the neutral
+    in-memory twin of metricpb.Metric (samplers/metricpb/metric.proto).
+
+    kind/scope are strings to keep this independent of generated protobuf;
+    the gRPC layer converts to/from real protos.
+    """
+    name: str
+    tags: list[str]
+    kind: str                    # counter|gauge|histogram|timer|set
+    scope: int                   # MetricScope value
+    counter_value: int = 0
+    gauge_value: float = 0.0
+    # histogram payload (digest centroids + scalars)
+    digest_means: Optional[list[float]] = None
+    digest_weights: Optional[list[float]] = None
+    digest_min: float = 0.0
+    digest_max: float = 0.0
+    digest_sum: float = 0.0
+    digest_rsum: float = 0.0
+    digest_compression: float = 100.0
+    # set payload
+    hll: bytes = b""

@@ -118,25 +118,33 @@ def union(a: jax.Array, b: jax.Array) -> jax.Array:
 
 @jax.jit
 def estimate(regs: jax.Array) -> jax.Array:
-    """LogLog-Beta cardinality estimate for every row of `[S, m]` uint8
-    registers; returns [S] f32.  est = alpha*m*(m-ez) / (beta(ez) + sum 2^-r)
-    (vendor hyperloglog.go:207-228)."""
+    """Batched cardinality estimate for every row of `[S, m]` uint8
+    registers; returns [S] f32.
+
+    Uses LogLog-Beta (est = alpha*m*(m-ez) / (beta(ez) + sum 2^-r), vendor
+    hyperloglog.go:207-228) for precisions with published beta constants
+    (14, 16); classic bias-corrected HyperLogLog with linear counting
+    otherwise (non-default precisions and small test meshes).
+    """
     s, m = regs.shape
     p = int(m).bit_length() - 1
-    beta_c = _BETAS.get(p)
-    if beta_c is None:
-        raise ValueError(f"no beta constants for precision {p}")
     r = regs.astype(jnp.float32)
     ez = jnp.sum((regs == 0).astype(jnp.float32), axis=1)          # [S]
     ssum = jnp.sum(jnp.exp2(-r), axis=1)                           # [S]
-    zl = jnp.log(ez + 1.0)
-    beta = beta_c[0] * ez
-    acc = jnp.ones_like(zl)
-    for c in beta_c[1:]:
-        acc = acc * zl
-        beta = beta + c * acc
     mf = float(m)
-    est = _alpha(mf) * mf * (mf - ez) / (beta + ssum) + 0.5
+    beta_c = _BETAS.get(p)
+    if beta_c is not None:
+        zl = jnp.log(ez + 1.0)
+        beta = beta_c[0] * ez
+        acc = jnp.ones_like(zl)
+        for c in beta_c[1:]:
+            acc = acc * zl
+            beta = beta + c * acc
+        est = _alpha(mf) * mf * (mf - ez) / (beta + ssum) + 0.5
+    else:
+        raw = _alpha(mf) * mf * mf / ssum
+        linear = mf * jnp.log(mf / jnp.maximum(ez, 1.0))
+        est = jnp.where((raw <= 2.5 * mf) & (ez > 0), linear, raw) + 0.5
     return jnp.floor(est)
 
 
@@ -155,9 +163,9 @@ def marshal(regs: np.ndarray) -> bytes:
     m = regs.shape[0]
     p = int(m).bit_length() - 1
     nz = np.nonzero(regs)[0]
-    if len(nz) * 3 < m:
+    if len(nz) * 5 < m:
         payload = struct.pack("<BBBI", _SPARSE, p, 0, len(nz))
-        return (_MAGIC + payload + nz.astype(np.uint16).tobytes()
+        return (_MAGIC + payload + nz.astype(np.uint32).tobytes()
                 + regs[nz].tobytes())
     return _MAGIC + struct.pack("<BBB", _DENSE, p, 0) + regs.tobytes()
 
@@ -173,8 +181,8 @@ def unmarshal(data: bytes) -> np.ndarray:
     elif kind == _SPARSE:
         (n,) = struct.unpack_from("<I", data, 5)
         off = 9
-        idx = np.frombuffer(data, np.uint16, n, off)
-        vals = np.frombuffer(data, np.uint8, n, off + 2 * n)
+        idx = np.frombuffer(data, np.uint32, n, off)
+        vals = np.frombuffer(data, np.uint8, n, off + 4 * n)
         regs[idx.astype(np.int64)] = vals
     else:
         raise ValueError(f"bad HLL kind {kind}")

@@ -405,7 +405,7 @@ class MergingDigest:
         self._buf_w: list[float] = []
 
     def add(self, value: float, weight: float = 1.0) -> None:
-        if not np.isfinite(value) or weight <= 0:
+        if not np.isfinite(value) or not weight > 0:
             raise ValueError("invalid value added")
         self._buf_v.append(float(value))
         self._buf_w.append(float(weight))
@@ -418,7 +418,7 @@ class MergingDigest:
             weights = np.ones_like(values)
         else:
             weights = np.asarray(weights, np.float32).ravel()
-        if not np.isfinite(values).all() or (weights <= 0).any():
+        if not np.isfinite(values).all() or not (weights > 0).all():
             raise ValueError("invalid value added")
         self._buf_v.extend(values.tolist())
         self._buf_w.extend(weights.tolist())

@@ -1,0 +1,51 @@
+"""Implicit-tag extension, mirroring the reference's `tagging/extend_tags.go`.
+
+ExtendTags merges configured implicit tags into each metric's explicit tags:
+implicit tags override explicit ones by key (the text before the first ':'),
+the result is sorted, and empty configured tags are ignored
+(`tagging/extend_tags.go:20-57,90-147`).
+"""
+
+from __future__ import annotations
+
+
+def parse_tag_slice_to_map(tags: list[str]) -> dict[str, str]:
+    """`tagging.ParseTagSliceToMap`: "k:v" -> {k: v}, bare "k" -> {k: ""};
+    later duplicates win."""
+    out: dict[str, str] = {}
+    for tag in tags:
+        if not tag:
+            continue
+        key, _, value = tag.partition(":")
+        out[key] = value
+    return out
+
+
+class ExtendTags:
+    def __init__(self, tags: list[str] | None = None):
+        tags = tags or []
+        self.extra_tags = sorted(t for t in tags if t)
+        self.extra_tags_map = parse_tag_slice_to_map(tags)
+        self._prefixes = {t.split(":", 1)[0] for t in tags if t}
+
+    def _should_drop(self, tag: str) -> bool:
+        key = tag.split(":", 1)[0]
+        return key in self._prefixes
+
+    def extend(self, tags: list[str]) -> list[str]:
+        """Merged + sorted tag list; implicit tags win on key conflicts
+        (`extend_tags.go:90-147`)."""
+        if not self.extra_tags:
+            return sorted(tags)
+        kept = [t for t in tags if not self._should_drop(t)]
+        kept.extend(self.extra_tags)
+        return sorted(kept)
+
+    def extend_map(self, tags: dict[str, str]) -> dict[str, str]:
+        """Map form used by the event path (`extend_tags.go:149-180`)."""
+        out = dict(tags)
+        out.update(self.extra_tags_map)
+        return out
+
+
+EMPTY = ExtendTags([])
