@@ -1,0 +1,272 @@
+"""Server configuration: YAML + template + environment overrides.
+
+Mirrors `config.go:12-134` (field set and defaults) and the generic loader
+`util/config/config.go:16-63`: the file is template-expanded (env vars via
+$NAME / ${NAME}, the Python analog of the Go text/template pass), parsed as
+YAML (with optional strict unknown-field rejection), then overridden by
+VENEUR_* environment variables (envconfig equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+from dataclasses import dataclass, field, fields
+from typing import Any, Optional
+
+import yaml
+
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.util.matcher import Matcher, matcher_from_config
+
+
+def parse_duration(v: Any) -> float:
+    """Go-style duration ("10s", "50ms", "1m30s") -> seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    units = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+             "s": 1.0, "m": 60.0, "h": 3600.0}
+    total = 0.0
+    for num, unit in re.findall(r"([0-9.]+)(ns|us|µs|ms|s|m|h)", s):
+        total += float(num) * units[unit]
+    if total == 0 and s and re.fullmatch(r"[0-9.]+", s):
+        total = float(s)
+    return total
+
+
+@dataclass
+class SinkRoutingConfig:
+    """metric_sink_routing entry (config.go:78-87)."""
+    name: str = ""
+    match: list[Matcher] = field(default_factory=list)
+    matched: list[str] = field(default_factory=list)
+    not_matched: list[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SinkRoutingConfig":
+        sinks = d.get("sinks", {})
+        return cls(
+            name=d.get("name", ""),
+            match=[matcher_from_config(m) for m in d.get("match", [])],
+            matched=sinks.get("matched", []),
+            not_matched=sinks.get("not_matched", []))
+
+
+@dataclass
+class SourceSpec:
+    kind: str
+    name: str = ""
+    config: dict = field(default_factory=dict)
+    tags: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Config:
+    """Server configuration (config.go:12-112)."""
+    # listeners
+    statsd_listen_addresses: list[str] = field(default_factory=list)
+    ssf_listen_addresses: list[str] = field(default_factory=list)
+    grpc_listen_addresses: list[str] = field(default_factory=list)
+    http_address: str = ""
+    grpc_address: str = ""          # gRPC import (global tier)
+    forward_address: str = ""       # set => this is a LOCAL instance
+    stats_address: str = ""         # self-metrics statsd target
+
+    # aggregation
+    interval: float = 10.0
+    percentiles: list[float] = field(default_factory=list)
+    aggregates: list[str] = field(default_factory=lambda: ["min", "max", "count"])
+    tdigest_compression: float = 100.0
+    set_precision: int = 14
+    count_unique_timeseries: bool = False
+
+    # ingest
+    num_workers: int = 1
+    num_readers: int = 1
+    num_span_workers: int = 1
+    metric_max_length: int = 4096
+    trace_max_length_bytes: int = 16 * 1024 * 1024
+    read_buffer_size_bytes: int = 2 * 1024 * 1024
+    span_channel_capacity: int = 100
+
+    # identity/tags
+    hostname: str = ""
+    omit_empty_hostname: bool = False
+    extend_tags: list[str] = field(default_factory=list)
+    tags_exclude: list[str] = field(default_factory=list)
+
+    # behavior
+    flush_on_shutdown: bool = False
+    flush_watchdog_missed_flushes: int = 0
+    synchronize_with_interval: bool = False
+    debug: bool = False
+    enable_profiling: bool = False
+    http_quit: bool = False
+    http_config_endpoint: bool = False
+    mutex_profile_fraction: int = 0
+    block_profile_rate: int = 0
+    sentry_dsn: str = ""
+
+    # span/indicator
+    indicator_span_timer_name: str = ""
+    objective_span_timer_name: str = ""
+
+    # TLS (statsd TCP listener)
+    tls_key: str = ""
+    tls_certificate: str = ""
+    tls_authority_certificate: str = ""
+
+    # features
+    enable_metric_sink_routing: bool = False
+    diagnostics_metrics_enabled: bool = False
+
+    # plugins
+    metric_sinks: list[sink_mod.SinkSpec] = field(default_factory=list)
+    span_sinks: list[sink_mod.SinkSpec] = field(default_factory=list)
+    sources: list[SourceSpec] = field(default_factory=list)
+    metric_sink_routing: list[SinkRoutingConfig] = field(default_factory=list)
+
+    # scope coercion of self-emitted metrics (veneur_metrics_scopes)
+    veneur_metrics_scopes: dict[str, str] = field(default_factory=dict)
+    veneur_metrics_additional_tags: list[str] = field(default_factory=list)
+
+    def apply_defaults(self) -> None:
+        """config.go:114-134."""
+        if not self.aggregates:
+            self.aggregates = ["min", "max", "count"]
+        if not self.hostname and not self.omit_empty_hostname:
+            self.hostname = socket.gethostname()
+        if self.interval <= 0:
+            self.interval = 10.0
+        if self.metric_max_length <= 0:
+            self.metric_max_length = 4096
+        if self.read_buffer_size_bytes <= 0:
+            self.read_buffer_size_bytes = 2 * 1024 * 1024
+        if self.span_channel_capacity <= 0:
+            self.span_channel_capacity = 100
+
+    @property
+    def is_local(self) -> bool:
+        """Server.IsLocal (server.go:1440-1442): local iff forwarding."""
+        return self.forward_address != ""
+
+
+_LIST_FIELDS_OF_FLOAT = {"percentiles"}
+
+
+def _coerce(key: str, value: Any) -> Any:
+    if key == "interval":
+        return parse_duration(value)
+    if key in _LIST_FIELDS_OF_FLOAT:
+        return [float(x) for x in value]
+    return value
+
+
+def load_config_dict(data: dict, strict: bool = False,
+                     apply_defaults: bool = True) -> Config:
+    cfg = Config()
+    known = {f.name for f in fields(Config)}
+    for key, value in (data or {}).items():
+        if key == "features":
+            for fk, fv in (value or {}).items():
+                if fk == "enable_metric_sink_routing":
+                    cfg.enable_metric_sink_routing = bool(fv)
+                elif fk == "diagnostics_metrics_enabled":
+                    cfg.diagnostics_metrics_enabled = bool(fv)
+                elif strict:
+                    raise ValueError(f"unknown config field features.{fk}")
+            continue
+        if key == "http":
+            cfg.http_config_endpoint = bool((value or {}).get("config"))
+            continue
+        if key == "metric_sinks":
+            cfg.metric_sinks = [sink_mod.SinkSpec.from_dict(d) for d in value]
+            continue
+        if key == "span_sinks":
+            cfg.span_sinks = [sink_mod.SinkSpec.from_dict(d) for d in value]
+            continue
+        if key == "sources":
+            cfg.sources = [SourceSpec(**d) for d in value]
+            continue
+        if key == "metric_sink_routing":
+            cfg.metric_sink_routing = [
+                SinkRoutingConfig.from_dict(d) for d in value]
+            continue
+        if key not in known:
+            if strict:
+                raise ValueError(f"unknown config field {key!r}")
+            continue
+        setattr(cfg, key, _coerce(key, value))
+    if apply_defaults:
+        cfg.apply_defaults()
+    return cfg
+
+
+_ENV_PREFIX = "VENEUR_"
+
+
+def _env_overrides(cfg: Config, environ: dict[str, str]) -> None:
+    """envconfig-style overrides: VENEUR_<FIELDNAME> (util/config:57-60)."""
+    for f in fields(Config):
+        env_key = _ENV_PREFIX + f.name.replace("_", "").upper()
+        alt_key = _ENV_PREFIX + f.name.upper()
+        raw = environ.get(env_key, environ.get(alt_key))
+        if raw is None:
+            continue
+        cur = getattr(cfg, f.name)
+        if isinstance(cur, bool):
+            setattr(cfg, f.name, raw.lower() in ("1", "true", "yes"))
+        elif isinstance(cur, int):
+            setattr(cfg, f.name, int(raw))
+        elif isinstance(cur, float):
+            setattr(cfg, f.name, parse_duration(raw)
+                    if f.name == "interval" else float(raw))
+        elif isinstance(cur, list):
+            items = [x for x in raw.split(",") if x]
+            if f.name in _LIST_FIELDS_OF_FLOAT:
+                setattr(cfg, f.name, [float(x) for x in items])
+            else:
+                setattr(cfg, f.name, items)
+        elif isinstance(cur, str):
+            setattr(cfg, f.name, raw)
+
+
+def read_config(path: str, strict: bool = False,
+                environ: Optional[dict[str, str]] = None) -> Config:
+    """File -> template expansion -> YAML -> env override
+    (util/config/config.go:16-63)."""
+    environ = environ if environ is not None else dict(os.environ)
+    with open(path) as f:
+        raw = f.read()
+    # template pass: $NAME / ${NAME} env expansion
+    raw = _expand(raw, environ)
+    data = yaml.safe_load(raw) or {}
+    # env overrides must land before defaults are computed so flags like
+    # VENEUR_OMITEMPTYHOSTNAME can affect default derivation
+    cfg = load_config_dict(data, strict=strict, apply_defaults=False)
+    _env_overrides(cfg, environ)
+    cfg.apply_defaults()
+    return cfg
+
+
+def _expand(text: str, environ: dict[str, str]) -> str:
+    def repl(m):
+        name = m.group(1) or m.group(2)
+        return environ.get(name, m.group(0))
+    return re.sub(r"\$(?:\{(\w+)\}|(\w+))", repl, text)
+
+
+def redacted_dict(cfg: Config) -> dict:
+    """Config dump with secrets redacted (util/string_secret.go:13-36)."""
+    out = {}
+    for f in fields(Config):
+        v = getattr(cfg, f.name)
+        if f.name in ("sentry_dsn", "tls_key") and v:
+            v = "REDACTED"
+        if isinstance(v, list) and v and not isinstance(
+                v[0], (str, int, float)):
+            v = [str(x) for x in v]
+        out[f.name] = v
+    return out

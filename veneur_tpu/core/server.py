@@ -1,0 +1,396 @@
+"""The veneur_tpu server: listeners, flush ticker, sink fan-out, watchdog.
+
+Composition root mirroring the reference `Server`
+(`server.go:106-174,462-868`): DogStatsD listeners (UDP with SO_REUSEPORT
+multi-reader parallelism as in `networking.go:54-107`/`socket_linux.go`,
+TCP with optional TLS client-cert auth, UNIX datagram/stream), the interval
+flush ticker with per-flush deadline, parallel metric-sink fan-out with
+central filtering (`flusher.go:115-247`), event/service-check handling
+(`server.go:942-993`), the flush watchdog (`server.go:877-912`), and
+pluggable sources/sinks/forwarder.
+
+The aggregation core is the batched MetricAggregator (one arena set instead
+of N worker goroutines; the key-shard parallelism lives on the device mesh,
+see veneur_tpu/parallel/).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import socket
+import ssl
+import threading
+import time
+from typing import Callable, Optional
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.samplers import parser as parser_mod
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.util import matcher as matcher_mod
+from veneur_tpu.util import tagging
+
+logger = logging.getLogger("veneur_tpu.server")
+
+
+def parse_listen_addr(addr: str) -> tuple[str, str]:
+    """'udp://host:port' -> (scheme, rest); bare 'host:port' -> udp."""
+    if "://" in addr:
+        scheme, rest = addr.split("://", 1)
+        return scheme, rest
+    return "udp", addr
+
+
+def _split_hostport(rest: str) -> tuple[str, int]:
+    host, _, port = rest.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class Server:
+    def __init__(self, cfg: config_mod.Config,
+                 extra_metric_sinks: Optional[list] = None,
+                 extra_span_sinks: Optional[list] = None,
+                 forwarder: Optional[Callable[[list[sm.ForwardMetric]], None]] = None):
+        self.config = cfg
+        self.extend_tags = tagging.ExtendTags(cfg.extend_tags)
+        self.parser = parser_mod.Parser(self.extend_tags)
+        self.aggregator = MetricAggregator(
+            percentiles=list(cfg.percentiles),
+            aggregates=sm.parse_aggregates(cfg.aggregates),
+            compression=cfg.tdigest_compression,
+            set_precision=cfg.set_precision,
+            count_unique_timeseries=cfg.count_unique_timeseries)
+        self.forwarder = forwarder
+
+        # sinks: configured kinds + directly injected instances
+        self.metric_sinks: list[tuple[sink_mod.SinkSpec, object]] = []
+        for spec in cfg.metric_sinks:
+            self.metric_sinks.append(
+                (spec, sink_mod.create_metric_sink(spec, cfg)))
+        for s in (extra_metric_sinks or []):
+            self.metric_sinks.append(
+                (sink_mod.SinkSpec(kind=s.kind(), name=s.name()), s))
+        self.span_sinks: list[object] = []
+        for spec in cfg.span_sinks:
+            self.span_sinks.append(sink_mod.create_span_sink(spec, cfg))
+        self.span_sinks.extend(extra_span_sinks or [])
+
+        # event/service-check accumulation (EventWorker, worker.go:491-536)
+        self._events: list[parser_mod.SSFSample] = []
+        self._events_lock = threading.Lock()
+
+        # span ingestion queue feeds span sinks (SpanWorker comes with the
+        # SSF pipeline; scaffolding here so sinks receive spans)
+        self.span_queue: list = []
+        self._span_lock = threading.Lock()
+
+        self._listeners: list[socket.socket] = []
+        self._threads: list[threading.Thread] = []
+        self._shutdown = threading.Event()
+        self._flush_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, len(self.metric_sinks) + 2),
+            thread_name_prefix="flush")
+        self.last_flush_unix = time.time()
+        self.flush_count = 0
+        # resolved addresses (after binding port 0)
+        self.statsd_addrs: list[tuple[str, object]] = []
+        self.shutdown_hook: Callable[[], None] = lambda: os._exit(2)
+
+    @property
+    def is_local(self) -> bool:
+        return self.config.is_local
+
+    # -- ingestion handlers (server.go:942-1011) ---------------------------
+
+    def handle_metric_packet(self, packet: bytes) -> None:
+        """Dispatch one line: event / service check / metric."""
+        if not packet:
+            return
+        try:
+            if packet.startswith(b"_e{"):
+                sample = self.parser.parse_event(packet)
+                with self._events_lock:
+                    self._events.append(sample)
+            elif packet.startswith(b"_sc"):
+                m = self.parser.parse_service_check(packet)
+                self.aggregator.process_metric(m)
+            else:
+                self.parser.parse_metric(
+                    packet, self.aggregator.process_metric)
+        except parser_mod.ParseError as e:
+            logger.debug("could not parse packet %r: %s", packet[:64], e)
+
+    def process_packet_buffer(self, buf: bytes) -> None:
+        """Newline-split a datagram (processMetricPacket,
+        server.go:1109-1133)."""
+        if len(buf) > self.config.metric_max_length:
+            logger.debug("packet too long (%d bytes)", len(buf))
+            return
+        for line in buf.split(b"\n"):
+            if line:
+                self.handle_metric_packet(line)
+
+    # -- listeners (networking.go) ----------------------------------------
+
+    def start(self) -> None:
+        for sspec, sink in self.metric_sinks:
+            sink.start(None)
+        for sink in self.span_sinks:
+            sink.start(None)
+        for addr in self.config.statsd_listen_addresses:
+            self._start_statsd(addr)
+        if self.config.flush_watchdog_missed_flushes > 0:
+            t = threading.Thread(target=self._watchdog, daemon=True,
+                                 name="flush-watchdog")
+            t.start()
+            self._threads.append(t)
+
+    def _start_statsd(self, addr: str) -> None:
+        scheme, rest = parse_listen_addr(addr)
+        if scheme == "udp":
+            host, port = _split_hostport(rest)
+            first_sock = None
+            for i in range(max(1, self.config.num_readers)):
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                # SO_REUSEPORT kernel load balancing (socket_linux.go:26-28)
+                if hasattr(socket, "SO_REUSEPORT"):
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                self.config.read_buffer_size_bytes)
+                if first_sock is None:
+                    sock.bind((host, port))
+                    first_sock = sock
+                    port = sock.getsockname()[1]  # resolve port 0
+                else:
+                    sock.bind((host, port))
+                self._listeners.append(sock)
+                t = threading.Thread(target=self._read_udp, args=(sock,),
+                                     daemon=True, name=f"statsd-udp-{i}")
+                t.start()
+                self._threads.append(t)
+            self.statsd_addrs.append(("udp", first_sock.getsockname()))
+        elif scheme in ("tcp", "tcp+tls"):
+            host, port = _split_hostport(rest)
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((host, port))
+            sock.listen(128)
+            self._listeners.append(sock)
+            ctx = self._tls_context() if (
+                scheme == "tcp+tls" or self.config.tls_key) else None
+            t = threading.Thread(target=self._accept_tcp, args=(sock, ctx),
+                                 daemon=True, name="statsd-tcp")
+            t.start()
+            self._threads.append(t)
+            self.statsd_addrs.append(("tcp", sock.getsockname()))
+        elif scheme == "unixgram":
+            path = rest
+            if os.path.exists(path):
+                os.unlink(path)
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+            sock.bind(path)
+            self._listeners.append(sock)
+            t = threading.Thread(target=self._read_udp, args=(sock,),
+                                 daemon=True, name="statsd-unixgram")
+            t.start()
+            self._threads.append(t)
+            self.statsd_addrs.append(("unixgram", path))
+        elif scheme == "unix":
+            path = rest
+            if os.path.exists(path):
+                os.unlink(path)
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(path)
+            sock.listen(128)
+            self._listeners.append(sock)
+            t = threading.Thread(target=self._accept_tcp, args=(sock, None),
+                                 daemon=True, name="statsd-unix")
+            t.start()
+            self._threads.append(t)
+            self.statsd_addrs.append(("unix", path))
+        else:
+            raise ValueError(f"unknown statsd listener scheme {scheme!r}")
+
+    def _tls_context(self) -> ssl.SSLContext:
+        """TLS with required client certs when an authority is configured
+        (server.go:1257-1281)."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.config.tls_certificate,
+                            self.config.tls_key)
+        if self.config.tls_authority_certificate:
+            ctx.load_verify_locations(self.config.tls_authority_certificate)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def _read_udp(self, sock: socket.socket) -> None:
+        # +1 so an oversized datagram still trips the too-long guard
+        # instead of being silently truncated into a parseable prefix
+        # (the reference allocates metricMaxLength+1, server.go:734).
+        bufsize = self.config.metric_max_length + 1
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(bufsize)
+            except OSError:
+                return
+            if data:
+                self.process_packet_buffer(data)
+
+    def _accept_tcp(self, sock: socket.socket,
+                    ctx: Optional[ssl.SSLContext]) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._read_stream,
+                                 args=(conn, ctx), daemon=True)
+            t.start()
+
+    # idle timeout for stream connections (the reference arms a read
+    # deadline per connection, server.go:1283-1295)
+    STREAM_IDLE_TIMEOUT_S = 600.0
+
+    def _read_stream(self, conn: socket.socket,
+                     ctx: Optional[ssl.SSLContext]) -> None:
+        max_line = max(65536, self.config.metric_max_length)
+        try:
+            conn.settimeout(self.STREAM_IDLE_TIMEOUT_S)
+            if ctx is not None:
+                conn = ctx.wrap_socket(conn, server_side=True)
+            buf = b""
+            while not self._shutdown.is_set():
+                data = conn.recv(65536)
+                if not data:
+                    break
+                buf += data
+                *lines, buf = buf.split(b"\n")
+                for line in lines:
+                    if line:
+                        self.handle_metric_packet(line)
+                if len(buf) > max_line:
+                    # a line that never ends: drop the connection rather
+                    # than buffer unboundedly (bufio.Scanner's token cap)
+                    logger.debug("stream line exceeded %d bytes; closing",
+                                 max_line)
+                    return
+            if buf:
+                self.handle_metric_packet(buf)
+        except (ssl.SSLError, OSError, TimeoutError) as e:
+            logger.debug("stream connection error: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- spans -------------------------------------------------------------
+
+    def ingest_span(self, span) -> None:
+        """Fan a span out to all span sinks (SpanWorker, worker.go:579-654);
+        full SSF listener wiring lives in the ssf package."""
+        for sink in self.span_sinks:
+            try:
+                sink.ingest(span)
+            except Exception as e:
+                logger.warning("span sink %s ingest error: %s",
+                               sink.name(), e)
+
+    # -- flush (flusher.go:26-122) ----------------------------------------
+
+    def flush(self) -> None:
+        self.last_flush_unix = time.time()
+        res = self.aggregator.flush(is_local=self.is_local)
+        self.flush_count += 1
+
+        with self._events_lock:
+            events, self._events = self._events, []
+
+        # sink routing (flusher.go:97-113)
+        if self.config.enable_metric_sink_routing:
+            for m in res.metrics:
+                m.sinks = set()
+                for rc in self.config.metric_sink_routing:
+                    hit = matcher_mod.match(rc.match, m.name, m.tags)
+                    m.sinks.update(rc.matched if hit else rc.not_matched)
+
+        futures = []
+        if self.forwarder is not None and self.is_local and res.forward:
+            futures.append(self._flush_pool.submit(
+                self._forward_safely, res.forward))
+        for spec, sink in self.metric_sinks:
+            futures.append(self._flush_pool.submit(
+                self._flush_sink, spec, sink, res.metrics, events))
+        for sink in self.span_sinks:
+            futures.append(self._flush_pool.submit(sink.flush))
+        concurrent.futures.wait(
+            futures, timeout=self.config.interval)
+
+    def _forward_safely(self, forward: list[sm.ForwardMetric]) -> None:
+        try:
+            self.forwarder(forward)
+        except Exception as e:
+            logger.error("forward failed: %s", e)
+
+    def _flush_sink(self, spec, sink, metrics, events) -> None:
+        try:
+            filtered, counts = sink_mod.filter_metrics_for_sink(
+                spec, self.config.enable_metric_sink_routing, metrics)
+            sink.flush_other_samples(events)
+            result = sink.flush(filtered)
+            logger.debug("flush complete sink=%s flushed=%s counts=%s",
+                         sink.name(), result.flushed, counts)
+        except Exception as e:
+            logger.error("sink %s flush failed: %s", sink.name(), e)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve(self) -> None:
+        """Blocking ticker loop (server.go:830-867)."""
+        interval = self.config.interval
+        if self.config.synchronize_with_interval:
+            now = time.time()
+            time.sleep(interval - (now % interval))
+        next_tick = time.time() + interval
+        while not self._shutdown.is_set():
+            timeout = max(0.0, next_tick - time.time())
+            if self._shutdown.wait(timeout):
+                break
+            next_tick += interval
+            try:
+                self.flush()
+            except Exception as e:
+                logger.exception("flush failed: %s", e)
+
+    def _watchdog(self) -> None:
+        """FlushWatchdog (server.go:877-912): die if flushes stop so a
+        supervisor can restart us."""
+        interval = self.config.interval
+        missed = self.config.flush_watchdog_missed_flushes
+        while not self._shutdown.is_set():
+            if self._shutdown.wait(interval / 2):
+                return
+            overdue = time.time() - self.last_flush_unix
+            if overdue > missed * interval:
+                logger.critical(
+                    "flush watchdog: no flush for %.1fs (> %d intervals); "
+                    "terminating", overdue, missed)
+                self.shutdown_hook()
+                return
+
+    def shutdown(self) -> None:
+        """server.go:1417-1435."""
+        if self.config.flush_on_shutdown:
+            try:
+                self.flush()
+            except Exception:
+                logger.exception("final flush failed")
+        self._shutdown.set()
+        for sock in self._listeners:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._flush_pool.shutdown(wait=False)

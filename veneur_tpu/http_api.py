@@ -1,0 +1,105 @@
+"""Operator HTTP API.
+
+Mirrors `http.go:15-67`: /healthcheck, /version, /builddate, optional
+/config/json + /config/yaml (secret-redacted, util/config/config.go:65-96),
+optional /quitquitquit, and Python-flavored debug endpoints in place of Go's
+pprof suite (/debug/vars runtime stats; /debug/threads stack dump).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import sys
+import threading
+import traceback
+from typing import Optional
+
+import yaml
+
+from veneur_tpu import __version__
+from veneur_tpu import config as config_mod
+
+BUILD_DATE = "dev"
+VERSION = __version__
+
+
+def make_handler(server) -> type:
+    cfg = server.config
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _reply(self, code: int, body: bytes,
+                   ctype: str = "text/plain") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if self.path == "/quitquitquit" and cfg.http_quit:
+                self._reply(200, b"terminating\n")
+                threading.Thread(target=server.shutdown, daemon=True).start()
+                return
+            self._reply(404, b"not found\n")
+
+        def do_GET(self):
+            if self.path == "/healthcheck":
+                self._reply(200, b"ok\n")
+            elif self.path == "/version":
+                self._reply(200, VERSION.encode())
+            elif self.path == "/builddate":
+                self._reply(200, BUILD_DATE.encode())
+            elif self.path == "/config/json" and cfg.http_config_endpoint:
+                body = json.dumps(config_mod.redacted_dict(cfg),
+                                  default=str, indent=2).encode()
+                self._reply(200, body, "application/json")
+            elif self.path == "/config/yaml" and cfg.http_config_endpoint:
+                body = yaml.safe_dump(
+                    json.loads(json.dumps(config_mod.redacted_dict(cfg),
+                                          default=str))).encode()
+                self._reply(200, body, "application/x-yaml")
+            elif self.path == "/debug/vars":
+                stats = {
+                    "flush_count": server.flush_count,
+                    "last_flush_unix": server.last_flush_unix,
+                    "is_local": server.is_local,
+                    "metric_sinks": [s.name() for _, s in
+                                     server.metric_sinks],
+                    "threads": threading.active_count(),
+                }
+                self._reply(200, json.dumps(stats, indent=2).encode(),
+                            "application/json")
+            elif self.path == "/debug/threads":
+                frames = sys._current_frames()
+                out = []
+                for tid, frame in frames.items():
+                    out.append(f"--- thread {tid} ---")
+                    out.extend(traceback.format_stack(frame))
+                self._reply(200, "\n".join(out).encode())
+            else:
+                self._reply(404, b"not found\n")
+
+    return Handler
+
+
+class HttpApi:
+    def __init__(self, server, address: str):
+        host, _, port = address.rpartition(":")
+        self.httpd = http.server.ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), make_handler(server))
+        self.httpd.daemon_threads = True
+        self.address = self.httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="http-api")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

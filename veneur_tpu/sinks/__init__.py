@@ -1,0 +1,218 @@
+"""Sink interfaces and plugin registry.
+
+Mirrors `sinks/sinks.go:42-106` (MetricSink / SpanSink contracts) and the
+registry maps passed into server construction
+(`server.go:62-90`, `cmd/veneur/main.go:102-179`): a sink kind registers a
+factory; instances are configured from the YAML `metric_sinks` /
+`span_sinks` lists with per-sink name/tag filtering applied centrally by
+the server (`flusher.go:124-247`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Protocol, runtime_checkable
+
+from veneur_tpu.samplers.samplers import InterMetric
+from veneur_tpu.util.matcher import TagMatcher
+
+
+@dataclass
+class MetricFlushResult:
+    """sinks.MetricFlushResult: accounting reported by each flush."""
+    flushed: int = 0
+    skipped: int = 0
+    dropped: int = 0
+
+
+@runtime_checkable
+class MetricSink(Protocol):
+    def name(self) -> str: ...
+    def kind(self) -> str: ...
+    def start(self, trace_client) -> None: ...
+    def flush(self, metrics: list[InterMetric]) -> MetricFlushResult: ...
+    def flush_other_samples(self, samples: list) -> None: ...
+
+
+@runtime_checkable
+class SpanSink(Protocol):
+    def name(self) -> str: ...
+    def kind(self) -> str: ...
+    def start(self, trace_client) -> None: ...
+    def ingest(self, span) -> None: ...
+    def flush(self) -> None: ...
+
+
+class BaseMetricSink:
+    """Convenience base with no-op hooks."""
+
+    KIND = "base"
+
+    def __init__(self, name: str = "", config: Optional[dict] = None):
+        self._name = name or self.KIND
+        self.config = config or {}
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return self.KIND
+
+    def start(self, trace_client=None) -> None:
+        pass
+
+    def flush(self, metrics: list[InterMetric]) -> MetricFlushResult:
+        return MetricFlushResult()
+
+    def flush_other_samples(self, samples: list) -> None:
+        pass
+
+
+class BaseSpanSink:
+    KIND = "base"
+
+    def __init__(self, name: str = "", config: Optional[dict] = None):
+        self._name = name or self.KIND
+        self.config = config or {}
+
+    def name(self) -> str:
+        return self._name
+
+    def kind(self) -> str:
+        return self.KIND
+
+    def start(self, trace_client=None) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+
+@dataclass
+class SinkSpec:
+    """One entry of metric_sinks/span_sinks (config.go:95-104)."""
+    kind: str
+    name: str = ""
+    config: dict = field(default_factory=dict)
+    max_name_length: int = 0
+    max_tag_length: int = 0
+    max_tags: int = 0
+    strip_tags: list[TagMatcher] = field(default_factory=list)
+    add_tags: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SinkSpec":
+        strip = [TagMatcher(**t) if isinstance(t, dict) else t
+                 for t in d.get("strip_tags", [])]
+        return cls(
+            kind=d["kind"], name=d.get("name", d["kind"]),
+            config=d.get("config") or {},
+            max_name_length=d.get("max_name_length", 0),
+            max_tag_length=d.get("max_tag_length", 0),
+            max_tags=d.get("max_tags", 0),
+            strip_tags=strip,
+            add_tags=d.get("add_tags") or {})
+
+
+# plugin registries: kind -> factory(spec, server_config) -> sink instance
+# (the reference's Create funcs receive the server Config too,
+# server.go:62-90)
+METRIC_SINK_TYPES: dict[str, Callable[..., Any]] = {}
+SPAN_SINK_TYPES: dict[str, Callable[..., Any]] = {}
+
+
+def register_metric_sink(kind: str):
+    def deco(factory):
+        METRIC_SINK_TYPES[kind] = factory
+        return factory
+    return deco
+
+
+def register_span_sink(kind: str):
+    def deco(factory):
+        SPAN_SINK_TYPES[kind] = factory
+        return factory
+    return deco
+
+
+def create_metric_sink(spec: SinkSpec, server_config=None):
+    factory = METRIC_SINK_TYPES.get(spec.kind)
+    if factory is None:
+        raise ValueError(f"unknown metric sink kind {spec.kind!r}")
+    return factory(spec, server_config)
+
+
+def create_span_sink(spec: SinkSpec, server_config=None):
+    factory = SPAN_SINK_TYPES.get(spec.kind)
+    if factory is None:
+        raise ValueError(f"unknown span sink kind {spec.kind!r}")
+    return factory(spec, server_config)
+
+
+def filter_metrics_for_sink(spec: SinkSpec, routing_enabled: bool,
+                            metrics: list[InterMetric]
+                            ) -> tuple[list[InterMetric], dict[str, int]]:
+    """Central per-sink filtering (flusher.go:138-213): routing allowlist,
+    max name length, strip/length-check/add tags, max tag count.  Returns
+    (filtered metrics, drop counters)."""
+    counts = {"skipped": 0, "max_name_length": 0, "max_tags": 0,
+              "max_tag_length": 0, "flushed": 0}
+    if not routing_enabled and not (
+            spec.max_name_length or spec.max_tag_length or spec.max_tags
+            or spec.strip_tags or spec.add_tags):
+        counts["flushed"] = len(metrics)
+        return metrics, counts
+
+    out: list[InterMetric] = []
+    for m in metrics:
+        if routing_enabled and (m.sinks is not None
+                                and spec.name not in m.sinks):
+            counts["skipped"] += 1
+            continue
+        if spec.max_name_length and len(m.name) > spec.max_name_length:
+            counts["max_name_length"] += 1
+            continue
+        tags = m.tags
+        if spec.strip_tags or spec.max_tag_length:
+            tags = []
+            dropped = False
+            for tag in m.tags:
+                if any(tm.match(tag) for tm in spec.strip_tags):
+                    continue
+                if spec.max_tag_length and len(tag) > spec.max_tag_length:
+                    counts["max_tag_length"] += 1
+                    dropped = True
+                    break
+                tags.append(tag)
+            if dropped:
+                continue
+        if spec.add_tags:
+            tags = list(tags)
+            dropped = False
+            for k, v in spec.add_tags.items():
+                tag = f"{k}:{v}"
+                if spec.max_tag_length and len(tag) > spec.max_tag_length:
+                    counts["max_tag_length"] += 1
+                    dropped = True
+                    break
+                if not any(ft.startswith(k) for ft in tags):
+                    tags.append(tag)
+            if dropped:
+                continue
+        if spec.max_tags and len(tags) > spec.max_tags:
+            counts["max_tags"] += 1
+            continue
+        if tags is not m.tags:
+            m = dataclasses.replace(m, tags=tags)
+        counts["flushed"] += 1
+        out.append(m)
+    return out, counts
+
+
+# Register built-in sinks (import at bottom: simple.py decorates with the
+# registries defined above).
+from veneur_tpu.sinks import simple as _simple  # noqa: E402,F401
