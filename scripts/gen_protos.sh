@@ -1,0 +1,14 @@
+#!/bin/sh
+# Regenerate python protobuf modules from the wire-compatible schemas.
+# protoc emits imports rooted at the -I dir; rewrite them to the package
+# path so installed packages with generic names (tdigest, ssf, ...) can't
+# shadow the generated modules.
+set -e
+cd "$(dirname "$0")/../veneur_tpu/protocol/protos"
+protoc -I. --python_out=../gen \
+    tdigest/tdigest.proto metricpb/metric.proto forwardrpc/forward.proto \
+    ssf/sample.proto ssf/grpc.proto dogstatsd/grpc.proto
+cd ../gen
+for f in */*_pb2.py; do
+  sed -i -E 's/^from (tdigest|metricpb|forwardrpc|ssf|dogstatsd) import/from veneur_tpu.protocol.gen.\1 import/' "$f"
+done

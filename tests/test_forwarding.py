@@ -1,0 +1,188 @@
+"""Local -> global forwarding over real loopback gRPC, porting the
+reference's distributed fixture tests (`server_test.go:312-414`
+TestLocalServerMixedMetrics, `flusher_test.go:100-299` TestServerFlushGRPC
+family) without a real cluster."""
+
+import queue
+import socket
+import time
+
+import grpc
+import numpy as np
+import pytest
+from google.protobuf import empty_pb2
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward import convert
+from veneur_tpu.forward.client import SEND_METRICS, ForwardClient
+from veneur_tpu.protocol import forward_pb2, metric_pb2, tdigest_pb2
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope
+from veneur_tpu.sinks import simple as simple_sinks
+
+
+def boot_global(**kw):
+    cfg = config_mod.Config(
+        grpc_address="127.0.0.1:0", interval=0.05,
+        percentiles=[0.5, 0.9], aggregates=["min", "max", "count"],
+        hostname="global", **kw)
+    sink = simple_sinks.ChannelMetricSink()
+    srv = Server(cfg, extra_metric_sinks=[sink])
+    srv.start()
+    return srv, sink
+
+
+def boot_local(forward_addr: str, **kw):
+    cfg = config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        forward_address=forward_addr, interval=0.05,
+        percentiles=[0.5, 0.9], aggregates=["min", "max", "count"],
+        hostname="local", **kw)
+    sink = simple_sinks.ChannelMetricSink()
+    srv = Server(cfg, extra_metric_sinks=[sink])
+    srv.start()
+    return srv, sink
+
+
+def flush_and_collect(srv, sink, pred, tries=40):
+    for _ in range(tries):
+        srv.flush()
+        got = []
+        while not sink.queue.empty():
+            got.extend(sink.queue.get())
+        if pred(got):
+            return got
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for flushed metrics")
+
+
+def test_local_server_mixed_metrics():
+    """Feed histogram samples to a local instance over UDP; assert the
+    digest received by the global (via real gRPC) reproduces
+    min/max/count/quantiles (server_test.go:312-414)."""
+    glob, gsink = boot_global()
+    local, lsink = boot_local(f"127.0.0.1:{glob.grpc_import.port}")
+    try:
+        rng = np.random.default_rng(4)
+        data = rng.normal(100, 20, 5000)
+        _, addr = local.statsd_addrs[0]
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        for chunk in data.reshape(100, 50):
+            lines = "\n".join(f"lat:{v:.4f}|h|#svc:x" for v in chunk)
+            s.sendto(lines.encode(), addr)
+        s.close()
+        deadline = time.time() + 5
+        while (local.aggregator.processed < 5000
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert local.aggregator.processed == 5000
+
+        local.flush()  # forwards the digest over gRPC
+        got = flush_and_collect(
+            glob, gsink, lambda g: any("percentile" in m.name for m in g))
+        by = {m.name: m for m in got}
+        assert by["lat.50percentile"].value == pytest.approx(
+            np.quantile(data, 0.5), rel=0.02)
+        assert by["lat.90percentile"].value == pytest.approx(
+            np.quantile(data, 0.9), rel=0.02)
+        assert by["lat.50percentile"].tags == ["svc:x"]
+
+        # local side emitted aggregates, no percentiles
+        lgot = []
+        while not lsink.queue.empty():
+            lgot.extend(lsink.queue.get())
+        lby = {m.name: m for m in lgot}
+        assert lby["lat.count"].value == 5000
+        assert lby["lat.min"].value == pytest.approx(data.min(), rel=1e-3)
+        assert lby["lat.max"].value == pytest.approx(data.max(), rel=1e-3)
+        assert not any("percentile" in n for n in lby)
+    finally:
+        local.shutdown()
+        glob.shutdown()
+
+
+def test_global_counters_gauges_sets_over_grpc():
+    glob, gsink = boot_global()
+    locals_ = []
+    try:
+        for i in range(3):
+            local, _ = boot_local(f"127.0.0.1:{glob.grpc_import.port}")
+            locals_.append(local)
+            _, addr = local.statsd_addrs[0]
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.sendto(b"reqs:10|c|#veneurglobalonly", addr)
+            s.sendto(f"users:u{i}|s".encode(), addr)
+            s.sendto(b"users:ushared|s", addr)
+            s.close()
+        deadline = time.time() + 5
+        while any(l.aggregator.processed < 3 for l in locals_) \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        for l in locals_:
+            l.flush()
+        got = flush_and_collect(
+            glob, gsink,
+            lambda g: any(m.name == "reqs" for m in g)
+            and any(m.name == "users" for m in g))
+        by = {m.name: m for m in got}
+        assert by["reqs"].value == 30.0  # 3 x 10, merged by addition
+        assert by["users"].value == 4.0  # u0,u1,u2,ushared
+    finally:
+        for l in locals_:
+            l.shutdown()
+        glob.shutdown()
+
+
+def test_v1_send_metrics_unimplemented():
+    glob, _ = boot_global()
+    try:
+        client = ForwardClient(f"127.0.0.1:{glob.grpc_import.port}")
+        with pytest.raises(grpc.RpcError) as exc:
+            client.send_v1([sm.ForwardMetric(
+                name="x", tags=[], kind="counter",
+                scope=MetricScope.GLOBAL_ONLY, counter_value=1)])
+        assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        client.close()
+    finally:
+        glob.shutdown()
+
+
+def test_import_bad_metric_does_not_kill_stream():
+    """A nil-valued metric mid-stream is logged and skipped; the rest of
+    the stream is still imported (worker.go:451-456 error handling)."""
+    glob, gsink = boot_global()
+    try:
+        client = ForwardClient(f"127.0.0.1:{glob.grpc_import.port}")
+        good = convert.to_pb(sm.ForwardMetric(
+            name="ok", tags=[], kind="counter",
+            scope=MetricScope.GLOBAL_ONLY, counter_value=5))
+        bad = metric_pb2.Metric(name="nil", type=metric_pb2.Counter)
+        client._v2(iter([bad, good]), timeout=5)
+        got = flush_and_collect(
+            glob, gsink, lambda g: any(m.name == "ok" for m in g))
+        assert {m.name for m in got} == {"ok"}
+        client.close()
+    finally:
+        glob.shutdown()
+
+
+def test_wire_compat_fixture():
+    """Serialized metricpb.Metric bytes use the reference's field layout:
+    craft a digest metric, round-trip via raw bytes, and check the known
+    field numbers survive re-parse with a minimal hand-rolled decoder."""
+    fm = sm.ForwardMetric(
+        name="h", tags=["a:b"], kind="histogram",
+        scope=MetricScope.MIXED,
+        digest_means=[1.0, 2.0], digest_weights=[3.0, 4.0],
+        digest_min=1.0, digest_max=2.0, digest_rsum=1.5,
+        digest_compression=100.0)
+    data = convert.to_pb(fm).SerializeToString()
+    m = metric_pb2.Metric.FromString(data)
+    back = convert.from_pb(m)
+    assert back.digest_means == [1.0, 2.0]
+    assert back.digest_weights == [3.0, 4.0]
+    assert back.digest_rsum == 1.5
+    assert back.kind == "histogram"
+    # field 1 is the name, wire type 2 (length-delimited): tag byte 0x0A
+    assert data[0] == 0x0A

@@ -97,6 +97,7 @@ class Server:
         self.flush_count = 0
         # resolved addresses (after binding port 0)
         self.statsd_addrs: list[tuple[str, object]] = []
+        self.grpc_import = None
         self.shutdown_hook: Callable[[], None] = lambda: os._exit(2)
 
     @property
@@ -142,6 +143,23 @@ class Server:
             sink.start(None)
         for addr in self.config.statsd_listen_addresses:
             self._start_statsd(addr)
+        if self.config.grpc_address:
+            # global tier: gRPC import source (server.go:673-682)
+            from veneur_tpu.sources.proxy import GrpcImportServer
+            self.grpc_import = GrpcImportServer(
+                self.config.grpc_address,
+                self.aggregator.import_metric,
+                ingest_span=self.ingest_span,
+                handle_packet=self.process_packet_buffer)
+            self.grpc_import.start()
+        if self.config.forward_address and self.forwarder is None:
+            # local tier: persistent forward connection (server.go:810-828)
+            from veneur_tpu.forward.client import ForwardClient
+            # forward deadline = one flush interval (flusher.go:516-591),
+            # so hung forwards can't pile up across cycles
+            self.forwarder = ForwardClient(
+                self.config.forward_address,
+                timeout_s=self.config.interval)
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")
@@ -392,5 +410,12 @@ class Server:
             try:
                 sock.close()
             except OSError:
+                pass
+        if self.grpc_import is not None:
+            self.grpc_import.stop()
+        if self.forwarder is not None and hasattr(self.forwarder, "close"):
+            try:
+                self.forwarder.close()
+            except Exception:
                 pass
         self._flush_pool.shutdown(wait=False)

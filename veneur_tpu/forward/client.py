@@ -1,0 +1,69 @@
+"""gRPC forwarding client: local instance -> global tier.
+
+Mirrors `Server.forward`/`forwardGrpc` (flusher.go:516-591): a persistent
+channel dialed once at start (optionally mTLS, server.go:810-828), and per
+flush one `SendMetricsV2` client stream carrying each metric
+(forwardrpc/forward.proto:12).  The service methods are invoked through
+explicit method paths + serializers, which is wire-identical to generated
+stubs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.forward import convert
+from veneur_tpu.protocol import forward_pb2, metric_pb2
+from veneur_tpu.samplers import samplers as sm
+
+logger = logging.getLogger("veneur_tpu.forward")
+
+SEND_METRICS = "/forwardrpc.Forward/SendMetrics"
+SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
+
+
+class ForwardClient:
+    def __init__(self, address: str,
+                 credentials: Optional[grpc.ChannelCredentials] = None,
+                 timeout_s: float = 10.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        if credentials is not None:
+            self.channel = grpc.secure_channel(address, credentials)
+        else:
+            self.channel = grpc.insecure_channel(address)
+        self._v2 = self.channel.stream_unary(
+            SEND_METRICS_V2,
+            request_serializer=metric_pb2.Metric.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+        self._v1 = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+    def __call__(self, metrics: list[sm.ForwardMetric]) -> None:
+        self.send(metrics)
+
+    def send(self, metrics: list[sm.ForwardMetric]) -> None:
+        """One stream per flush, one Send per metric
+        (flusher.go:578-591)."""
+        if not metrics:
+            return
+        pbs = [convert.to_pb(fm) for fm in metrics]
+        self._v2(iter(pbs), timeout=self.timeout_s)
+        logger.debug("forwarded %d metrics to %s", len(pbs), self.address)
+
+    def send_v1(self, metrics: list[sm.ForwardMetric]) -> None:
+        """Batch API; the reference global leaves this unimplemented
+        server-side (sources/proxy/server.go:138-142) but the client
+        exists for proxy compatibility."""
+        req = forward_pb2.MetricList(
+            metrics=[convert.to_pb(fm) for fm in metrics])
+        self._v1(req, timeout=self.timeout_s)
+
+    def close(self) -> None:
+        self.channel.close()

@@ -1,0 +1,78 @@
+"""ForwardMetric <-> metricpb.Metric conversion.
+
+The neutral in-memory ForwardMetric (veneur_tpu/samplers/samplers.py) maps
+onto the reference's wire schema (samplers/metricpb/metric.proto): digests
+as MergingDigestData centroid lists (`Histo.Metric()`,
+samplers/samplers.go:524-535), sets as encoded HLL bytes
+(`Set.Metric()`, samplers.go:279-295), counters/gauges as raw values.
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.protocol import metric_pb2, tdigest_pb2
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope
+
+_KIND_TO_PB = {
+    sm.TYPE_COUNTER: metric_pb2.Counter,
+    sm.TYPE_GAUGE: metric_pb2.Gauge,
+    sm.TYPE_HISTOGRAM: metric_pb2.Histogram,
+    sm.TYPE_SET: metric_pb2.Set,
+    sm.TYPE_TIMER: metric_pb2.Timer,
+}
+_PB_TO_KIND = {v: k for k, v in _KIND_TO_PB.items()}
+
+_SCOPE_TO_PB = {
+    MetricScope.MIXED: metric_pb2.Mixed,
+    MetricScope.LOCAL_ONLY: metric_pb2.Local,
+    MetricScope.GLOBAL_ONLY: metric_pb2.Global,
+}
+_PB_TO_SCOPE = {v: k for k, v in _SCOPE_TO_PB.items()}
+
+
+def to_pb(fm: sm.ForwardMetric) -> metric_pb2.Metric:
+    m = metric_pb2.Metric(
+        name=fm.name, tags=list(fm.tags),
+        type=_KIND_TO_PB[fm.kind],
+        scope=_SCOPE_TO_PB[MetricScope(fm.scope)])
+    if fm.kind == sm.TYPE_COUNTER:
+        m.counter.value = int(fm.counter_value)
+    elif fm.kind == sm.TYPE_GAUGE:
+        m.gauge.value = float(fm.gauge_value)
+    elif fm.kind == sm.TYPE_SET:
+        m.set.hyper_log_log = fm.hll
+    else:  # histogram / timer
+        td = tdigest_pb2.MergingDigestData(
+            compression=fm.digest_compression,
+            min=fm.digest_min, max=fm.digest_max,
+            reciprocalSum=fm.digest_rsum)
+        for mean, weight in zip(fm.digest_means or [],
+                                fm.digest_weights or []):
+            td.main_centroids.add(mean=float(mean), weight=float(weight))
+        m.histogram.t_digest.CopyFrom(td)
+    return m
+
+
+def from_pb(m: metric_pb2.Metric) -> sm.ForwardMetric:
+    kind = _PB_TO_KIND[m.type]
+    fm = sm.ForwardMetric(
+        name=m.name, tags=list(m.tags), kind=kind,
+        scope=int(_PB_TO_SCOPE[m.scope]))
+    which = m.WhichOneof("value")
+    if which == "counter":
+        fm.counter_value = m.counter.value
+    elif which == "gauge":
+        fm.gauge_value = m.gauge.value
+    elif which == "set":
+        fm.hll = m.set.hyper_log_log
+    elif which == "histogram":
+        td = m.histogram.t_digest
+        fm.digest_means = [c.mean for c in td.main_centroids]
+        fm.digest_weights = [c.weight for c in td.main_centroids]
+        fm.digest_compression = td.compression or 100.0
+        fm.digest_min = td.min
+        fm.digest_max = td.max
+        fm.digest_rsum = td.reciprocalSum
+    elif which is None:
+        raise ValueError("can't import a metric with a nil value")
+    return fm

@@ -1,0 +1,18 @@
+"""Wire protocol package: generated protobuf modules + framing.
+
+The .proto files under protos/ are wire-compatible twins of the
+reference's schemas (tdigest/tdigest.proto, samplers/metricpb/metric.proto,
+forwardrpc/forward.proto, ssf/sample.proto, ssf/grpc.proto,
+protocol/dogstatsd/grpc.proto).  Generated python lives in gen/ with
+package-rooted imports (regenerate with scripts/gen_protos.sh).
+"""
+
+from veneur_tpu.protocol.gen.tdigest import tdigest_pb2
+from veneur_tpu.protocol.gen.metricpb import metric_pb2
+from veneur_tpu.protocol.gen.forwardrpc import forward_pb2
+from veneur_tpu.protocol.gen.ssf import sample_pb2 as ssf_pb2
+from veneur_tpu.protocol.gen.ssf import grpc_pb2 as ssf_grpc_pb2
+from veneur_tpu.protocol.gen.dogstatsd import grpc_pb2 as dogstatsd_grpc_pb2
+
+__all__ = ["tdigest_pb2", "metric_pb2", "forward_pb2", "ssf_pb2",
+           "ssf_grpc_pb2", "dogstatsd_grpc_pb2"]

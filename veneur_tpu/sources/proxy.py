@@ -1,0 +1,139 @@
+"""gRPC import source: the global tier's receive path.
+
+Mirrors `sources/proxy/server.go`: a Forward service whose
+`SendMetricsV2` recv-loop feeds each metric into the aggregation core
+(`server.go:144-162` -> `ingest.IngestMetricProto` -> worker
+`ImportMetric`), registered when `grpc_address` is configured
+(`server.go:673-682`).  `SendMetrics` (V1) returns UNIMPLEMENTED exactly
+like the reference (`sources/proxy/server.go:138-142`).
+
+Also exposes the gRPC ingest listeners for SSF spans and raw dogstatsd
+packet bytes (`networking.go:326-391`).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+from typing import Callable, Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.forward import convert
+from veneur_tpu.protocol import (dogstatsd_grpc_pb2, forward_pb2, metric_pb2,
+                                 ssf_grpc_pb2, ssf_pb2)
+
+logger = logging.getLogger("veneur_tpu.sources.proxy")
+
+
+class GrpcImportServer:
+    """Hosts forwardrpc.Forward (+ optional SSF/dogstatsd ingest) on one
+    grpc.Server."""
+
+    def __init__(self, address: str,
+                 import_metric: Callable[[object], None],
+                 ingest_span: Optional[Callable[[object], None]] = None,
+                 handle_packet: Optional[Callable[[bytes], None]] = None,
+                 max_workers: int = 8,
+                 server_credentials: Optional[grpc.ServerCredentials] = None):
+        self.import_metric = import_metric
+        self.ingest_span = ingest_span
+        self.handle_packet = handle_packet
+        self.imported_count = 0
+        self._count_lock = threading.Lock()
+        self.server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="grpc-import"))
+        self.server.add_generic_rpc_handlers([self._make_handlers()])
+        if server_credentials is not None:
+            self.port = self.server.add_secure_port(address,
+                                                    server_credentials)
+        else:
+            self.port = self.server.add_insecure_port(address)
+        if self.port == 0:
+            # grpc returns 0 instead of raising; fail startup like the
+            # reference's net.Listen error path (server.go:673-682)
+            raise OSError(f"could not bind gRPC import server to {address}")
+
+    # -- service wiring ----------------------------------------------------
+
+    def _make_handlers(self):
+        def send_metrics(request, context):
+            # V1 unimplemented, matching sources/proxy/server.go:138-142
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "SendMetrics is not implemented")
+
+        def send_metrics_v2(request_iterator, context):
+            count = 0
+            for pb in request_iterator:
+                try:
+                    self.import_metric(convert.from_pb(pb))
+                    count += 1
+                except Exception as e:
+                    logger.error("failed to import metric %s: %s",
+                                 pb.name, e)
+            with self._count_lock:
+                self.imported_count += count
+            return empty_pb2.Empty()
+
+        forward_handlers = {
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                send_metrics,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString),
+            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                send_metrics_v2,
+                request_deserializer=metric_pb2.Metric.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString),
+        }
+        handlers = [grpc.method_handlers_generic_handler(
+            "forwardrpc.Forward", forward_handlers)]
+
+        if self.ingest_span is not None:
+            def send_span(request, context):
+                self.ingest_span(request)
+                return ssf_grpc_pb2.Empty()
+            handlers.append(grpc.method_handlers_generic_handler(
+                "ssf.SSFGRPC", {
+                    "SendSpan": grpc.unary_unary_rpc_method_handler(
+                        send_span,
+                        request_deserializer=ssf_pb2.SSFSpan.FromString,
+                        response_serializer=(
+                            ssf_grpc_pb2.Empty.SerializeToString)),
+                }))
+        if self.handle_packet is not None:
+            def send_packet(request, context):
+                self.handle_packet(request.packetBytes)
+                return dogstatsd_grpc_pb2.Empty()
+            handlers.append(grpc.method_handlers_generic_handler(
+                "dogstatsd.DogstatsdGRPC", {
+                    "SendPacket": grpc.unary_unary_rpc_method_handler(
+                        send_packet,
+                        request_deserializer=(
+                            dogstatsd_grpc_pb2.DogstatsdPacket.FromString),
+                        response_serializer=(
+                            dogstatsd_grpc_pb2.Empty.SerializeToString)),
+                }))
+
+        class _Multi(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                for h in handlers:
+                    r = h.service(handler_call_details)
+                    if r is not None:
+                        return r
+                return None
+
+        return _Multi()
+
+    # -- sources.Source lifecycle (sources/sources.go:1-19) ---------------
+
+    def name(self) -> str:
+        return "proxy"
+
+    def start(self) -> None:
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop(grace=1.0)
