@@ -1,0 +1,173 @@
+"""Proxy tier tests, porting `proxy/handlers/handlers_test.go:65-374`,
+`proxy/proxy_test.go`, and `proxy/connect/connect_test.go:67-170`: hash
+routing stability, fan-in through real gRPC to multiple globals,
+destination removal on close, healthcheck states, discovery reconciliation."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward import convert
+from veneur_tpu.forward.client import ForwardClient
+from veneur_tpu.proxy.consistent import ConsistentHash
+from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope
+from veneur_tpu.sinks import simple as simple_sinks
+
+
+def test_consistent_hash_stability():
+    ring = ConsistentHash(["a:1", "b:1", "c:1"])
+    keys = [f"metric-{i}" for i in range(1000)]
+    before = {k: ring.get(k) for k in keys}
+    # removing one member only remaps that member's keys
+    ring.remove("c:1")
+    moved = sum(1 for k in keys
+                if before[k] != ring.get(k) and before[k] != "c:1")
+    assert moved == 0
+    # re-adding restores the original assignment
+    ring.add("c:1")
+    after = {k: ring.get(k) for k in keys}
+    assert before == after
+    # distribution is roughly even
+    from collections import Counter
+    counts = Counter(before.values())
+    assert all(c > 150 for c in counts.values()), counts
+
+
+def test_empty_ring_raises():
+    with pytest.raises(LookupError):
+        ConsistentHash().get("x")
+
+
+def boot_global():
+    cfg = config_mod.Config(
+        grpc_address="127.0.0.1:0", interval=0.05,
+        percentiles=[0.5], aggregates=["count"], hostname="g")
+    sink = simple_sinks.ChannelMetricSink()
+    srv = Server(cfg, extra_metric_sinks=[sink])
+    srv.start()
+    return srv, sink
+
+
+def fm_counter(name, value):
+    return sm.ForwardMetric(name=name, tags=[], kind="counter",
+                            scope=MetricScope.GLOBAL_ONLY,
+                            counter_value=value)
+
+
+def test_proxy_fan_in_two_globals():
+    """1024-host-style fan-in: many metrics through the proxy land
+    partitioned across two globals, every key on exactly one."""
+    g1, s1 = boot_global()
+    g2, s2 = boot_global()
+    proxy = Proxy(ProxyConfig(static_destinations=[
+        f"127.0.0.1:{g1.grpc_import.port}",
+        f"127.0.0.1:{g2.grpc_import.port}"]))
+    proxy.start()
+    try:
+        client = ForwardClient(f"127.0.0.1:{proxy.grpc_port}")
+        metrics = [convert.to_pb(fm_counter(f"m{i}", 1)) for i in range(200)]
+        client._v2(iter(metrics), timeout=10)
+        deadline = time.time() + 10
+        while time.time() < deadline and proxy.stats["routed"] < 200:
+            time.sleep(0.05)
+        assert proxy.stats["routed"] == 200
+        # drain destination queues
+        time.sleep(0.3)
+        g1.flush()
+        g2.flush()
+        got1, got2 = [], []
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got1) + len(got2) < 200:
+            g1.flush()
+            g2.flush()
+            while not s1.queue.empty():
+                got1.extend(s1.queue.get())
+            while not s2.queue.empty():
+                got2.extend(s2.queue.get())
+            time.sleep(0.05)
+        names1 = {m.name for m in got1}
+        names2 = {m.name for m in got2}
+        assert len(names1 | names2) == 200
+        assert not (names1 & names2)  # each key on exactly one global
+        assert names1 and names2      # both globals participated
+        client.close()
+    finally:
+        proxy.stop()
+        g1.shutdown()
+        g2.shutdown()
+
+
+def test_proxy_healthcheck_states():
+    proxy = Proxy(ProxyConfig(static_destinations=[]))
+    proxy.start()
+    try:
+        url = f"http://127.0.0.1:{proxy.http_port}/healthcheck"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 503
+
+        g, _ = boot_global()
+        try:
+            proxy.discoverer.destinations = [
+                f"127.0.0.1:{g.grpc_import.port}"]
+            proxy.handle_discovery()
+            assert urllib.request.urlopen(url).status == 200
+        finally:
+            g.shutdown()
+    finally:
+        proxy.stop()
+
+
+def test_discovery_reconciliation_and_close_removal():
+    g1, _ = boot_global()
+    g2, _ = boot_global()
+    a1 = f"127.0.0.1:{g1.grpc_import.port}"
+    a2 = f"127.0.0.1:{g2.grpc_import.port}"
+    proxy = Proxy(ProxyConfig(static_destinations=[a1]))
+    proxy.start()
+    try:
+        assert proxy.destinations.size() == 1
+        # membership change: a2 joins, a1 leaves
+        proxy.discoverer.destinations = [a2]
+        proxy.handle_discovery()
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+                proxy.destinations.size() != 1
+                or a2 not in proxy.destinations.stats()):
+            time.sleep(0.05)
+        assert set(proxy.destinations.stats()) == {a2}
+
+        # killing the destination server removes it on stream close
+        g2.shutdown()
+        deadline = time.time() + 10
+        m = convert.to_pb(fm_counter("x", 1))
+        while time.time() < deadline and proxy.destinations.size() > 0:
+            proxy.handle_metric(m)  # trigger send -> notice closure
+            time.sleep(0.1)
+        assert proxy.destinations.size() == 0
+    finally:
+        proxy.stop()
+        g1.shutdown()
+
+
+def test_ignore_tags_affect_routing_key():
+    from veneur_tpu.protocol import metric_pb2
+    from veneur_tpu.util.matcher import TagMatcher
+    cfg = ProxyConfig(ignore_tags=[TagMatcher(kind="prefix", value="host")])
+    proxy = Proxy(cfg)
+    try:
+        m1 = metric_pb2.Metric(name="a", tags=["host:h1", "env:p"],
+                               type=metric_pb2.Counter)
+        m2 = metric_pb2.Metric(name="a", tags=["host:h2", "env:p"],
+                               type=metric_pb2.Counter)
+        assert proxy.routing_key(m1) == proxy.routing_key(m2) == "acounterenv:p"
+    finally:
+        proxy.stop()

@@ -1,0 +1,106 @@
+"""Per-destination connection: long-lived forward stream + send queue.
+
+Mirrors `proxy/connect/connect.go`: each destination owns a gRPC channel, a
+long-lived `SendMetricsV2` client stream, a bounded send buffer drained by a
+sender thread (`sendMetrics`, connect.go:141-227), and close detection that
+notifies the destinations manager so in-flight metrics are counted as
+dropped (`listenForClose`, connect.go:231-245).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.forward.client import SEND_METRICS_V2
+from veneur_tpu.protocol import metric_pb2
+
+logger = logging.getLogger("veneur_tpu.proxy.connect")
+
+_CLOSE = object()  # sentinel terminating the stream iterator
+
+
+class Destination:
+    def __init__(self, address: str, send_buffer_size: int = 1024,
+                 on_closed: Optional[Callable[["Destination"], None]] = None,
+                 dial_timeout_s: float = 5.0):
+        self.address = address
+        self.queue: queue.Queue = queue.Queue(maxsize=send_buffer_size)
+        self.closed = threading.Event()
+        self.on_closed = on_closed
+        self.sent = 0
+        self.dropped = 0
+        self.channel = grpc.insecure_channel(address)
+        grpc.channel_ready_future(self.channel).result(
+            timeout=dial_timeout_s)
+        self._v2 = self.channel.stream_unary(
+            SEND_METRICS_V2,
+            request_serializer=metric_pb2.Metric.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+        self._sender = threading.Thread(
+            target=self._send_loop, daemon=True,
+            name=f"dest-{address}")
+        self._sender.start()
+
+    def _request_iter(self):
+        while True:
+            item = self.queue.get()
+            if item is _CLOSE:
+                return
+            self.sent += 1
+            yield item
+
+    def _send_loop(self) -> None:
+        """One long-lived stream; when it breaks, mark closed and drain
+        the buffer as dropped (connect.go:196-227)."""
+        try:
+            self._v2(self._request_iter())
+        except grpc.RpcError as e:
+            logger.warning("destination %s stream closed: %s",
+                           self.address, e)
+        finally:
+            self.closed.set()
+            while True:
+                try:
+                    item = self.queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _CLOSE:
+                    self.dropped += 1
+            if self.on_closed is not None:
+                self.on_closed(self)
+
+    def send(self, metric: metric_pb2.Metric,
+             block_poll_s: float = 0.05) -> str:
+        """Nonblocking enqueue, then blocking with closed-destination
+        escape (handlers.go:134-163).  Returns 'ok'|'enqueue'|'dropped'."""
+        if self.closed.is_set():
+            self.dropped += 1
+            return "dropped"
+        try:
+            self.queue.put_nowait(metric)
+            return "ok"
+        except queue.Full:
+            pass
+        while not self.closed.is_set():
+            try:
+                self.queue.put(metric, timeout=block_poll_s)
+                return "enqueue"
+            except queue.Full:
+                continue
+        self.dropped += 1
+        return "dropped"
+
+    def close(self, drain_timeout_s: float = 5.0) -> None:
+        """Graceful: stop accepting, let the sender drain, close channel."""
+        try:
+            self.queue.put(_CLOSE, timeout=drain_timeout_s)
+        except queue.Full:
+            self.closed.set()
+        self._sender.join(timeout=drain_timeout_s)
+        self.channel.close()

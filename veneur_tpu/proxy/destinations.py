@@ -1,0 +1,109 @@
+"""Destination set + consistent-hash routing.
+
+Mirrors `proxy/destinations/destinations.go`: Add connects new addresses in
+parallel (`Add`, destinations.go:47-81), Get routes a key through the hash
+ring (`:129-142`), closed connections self-remove (`ConnectionClosed`,
+`:100-126`), Clear tears everything down, and Wait blocks until all
+destinations have drained.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+
+from veneur_tpu.proxy.connect import Destination
+from veneur_tpu.proxy.consistent import ConsistentHash
+
+logger = logging.getLogger("veneur_tpu.proxy.destinations")
+
+
+class Destinations:
+    def __init__(self, send_buffer_size: int = 1024):
+        self.send_buffer_size = send_buffer_size
+        self._lock = threading.Lock()
+        self._ring = ConsistentHash()
+        self._dests: dict[str, Destination] = {}
+
+    def add(self, addresses: list[str]) -> None:
+        """Connect any new addresses in parallel; keep existing ones."""
+        with self._lock:
+            new = [a for a in addresses if a not in self._dests]
+        if not new:
+            return
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(4, len(new))) as pool:
+            futures = {pool.submit(self._connect, a): a for a in new}
+            for fut in concurrent.futures.as_completed(futures):
+                addr = futures[fut]
+                try:
+                    dest = fut.result()
+                except Exception as e:
+                    logger.warning("could not connect to %s: %s", addr, e)
+                    continue
+                duplicate = None
+                with self._lock:
+                    if addr in self._dests:
+                        # a concurrent add() won the race; close the
+                        # duplicate connection (destinations.go:90-94)
+                        duplicate = dest
+                    else:
+                        self._dests[addr] = dest
+                        self._ring.add(addr)
+                if duplicate is not None:
+                    threading.Thread(target=duplicate.close,
+                                     daemon=True).start()
+
+    def _connect(self, address: str) -> Destination:
+        return Destination(address, self.send_buffer_size,
+                           on_closed=self._connection_closed)
+
+    def _connection_closed(self, dest: Destination) -> None:
+        self.remove(dest.address, expected=dest)
+
+    def remove(self, address: str, expected=None) -> None:
+        """Remove a destination; with `expected`, only if the registered
+        object is that same instance (so a stale connection's close
+        callback cannot tear down a re-added healthy destination)."""
+        with self._lock:
+            dest = self._dests.get(address)
+            if dest is None or (expected is not None and dest is not expected):
+                return
+            del self._dests[address]
+            self._ring.remove(address)
+        if not dest.closed.is_set():
+            threading.Thread(target=dest.close, daemon=True).start()
+
+    def set_members(self, addresses: list[str]) -> None:
+        """Reconcile with a discovery result: add new, drop vanished
+        (proxy.go:345-387 HandleDiscovery)."""
+        want = set(addresses)
+        with self._lock:
+            have = set(self._dests)
+        for addr in have - want:
+            self.remove(addr)
+        self.add(sorted(want - have))
+
+    def get(self, key: str) -> Destination:
+        with self._lock:
+            addr = self._ring.get(key)
+            return self._dests[addr]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._dests)
+
+    def clear(self) -> None:
+        with self._lock:
+            dests = list(self._dests.values())
+            self._dests.clear()
+            self._ring = ConsistentHash()
+        for d in dests:
+            d.close()
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {a: {"sent": d.sent, "dropped": d.dropped,
+                        "queued": d.queue.qsize()}
+                    for a, d in self._dests.items()}

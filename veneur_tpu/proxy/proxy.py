@@ -1,0 +1,185 @@
+"""The veneur-proxy equivalent: consistent-hash fan-in tier.
+
+Mirrors `proxy/proxy.go` + `proxy/handlers/handlers.go`: hosts the Forward
+gRPC service, routes each incoming metric by
+key = name + lower(type) + joined(filtered tags) to a consistent-hash
+destination (`handleMetric`, handlers.go:99-164), polls discovery every
+`discovery_interval` to rebuild the ring (`pollDiscovery`,
+proxy.go:345-387), and serves an HTTP healthcheck that fails at zero
+destinations (`handlers.go:30-38`).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import http.server
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.discovery import Discoverer, StaticDiscoverer
+from veneur_tpu.protocol import forward_pb2, metric_pb2
+from veneur_tpu.proxy.destinations import Destinations
+from veneur_tpu.util.matcher import TagMatcher
+
+logger = logging.getLogger("veneur_tpu.proxy")
+
+_TYPE_NAMES = {
+    metric_pb2.Counter: "counter",
+    metric_pb2.Gauge: "gauge",
+    metric_pb2.Histogram: "histogram",
+    metric_pb2.Set: "set",
+    metric_pb2.Timer: "timer",
+}
+
+
+@dataclass
+class ProxyConfig:
+    """proxy/config.go essentials."""
+    grpc_address: str = "127.0.0.1:0"
+    http_address: str = "127.0.0.1:0"
+    forward_service: str = "veneur-global"
+    discovery_interval: float = 10.0
+    send_buffer_size: int = 1024
+    ignore_tags: list[TagMatcher] = field(default_factory=list)
+    static_destinations: list[str] = field(default_factory=list)
+
+
+class Proxy:
+    def __init__(self, cfg: ProxyConfig,
+                 discoverer: Optional[Discoverer] = None):
+        self.cfg = cfg
+        self.discoverer = discoverer or StaticDiscoverer(
+            cfg.static_destinations)
+        self.destinations = Destinations(cfg.send_buffer_size)
+        self.stats = {"received": 0, "routed": 0, "dropped": 0,
+                      "no_destination": 0}
+        self._stats_lock = threading.Lock()
+        self._shutdown = threading.Event()
+
+        self.grpc_server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="proxy-grpc"))
+        self.grpc_server.add_generic_rpc_handlers([self._handlers()])
+        self.grpc_port = self.grpc_server.add_insecure_port(
+            cfg.grpc_address)
+        if self.grpc_port == 0:
+            raise OSError(f"could not bind proxy to {cfg.grpc_address}")
+
+        host, _, port = cfg.http_address.rpartition(":")
+        self.httpd = http.server.ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), self._http_handler())
+        self.httpd.daemon_threads = True
+        self.http_port = self.httpd.server_address[1]
+        self._started = False
+
+    # -- gRPC Forward service ---------------------------------------------
+
+    def _handlers(self):
+        def send_metrics(request, context):
+            for m in request.metrics:
+                self.handle_metric(m)
+            return empty_pb2.Empty()
+
+        def send_metrics_v2(request_iterator, context):
+            for m in request_iterator:
+                self.handle_metric(m)
+            return empty_pb2.Empty()
+
+        return grpc.method_handlers_generic_handler(
+            "forwardrpc.Forward", {
+                "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                    send_metrics,
+                    request_deserializer=forward_pb2.MetricList.FromString,
+                    response_serializer=empty_pb2.Empty.SerializeToString),
+                "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                    send_metrics_v2,
+                    request_deserializer=metric_pb2.Metric.FromString,
+                    response_serializer=empty_pb2.Empty.SerializeToString),
+            })
+
+    def routing_key(self, m: metric_pb2.Metric) -> str:
+        """name + lower(type) + joined(filtered tags)
+        (handlers.go:111-112)."""
+        tags = [t for t in m.tags
+                if not any(tm.match(t) for tm in self.cfg.ignore_tags)]
+        return f"{m.name}{_TYPE_NAMES.get(m.type, '')}{','.join(tags)}"
+
+    def handle_metric(self, m: metric_pb2.Metric) -> None:
+        with self._stats_lock:
+            self.stats["received"] += 1
+        try:
+            dest = self.destinations.get(self.routing_key(m))
+        except LookupError:
+            with self._stats_lock:
+                self.stats["no_destination"] += 1
+            return
+        outcome = dest.send(m)
+        with self._stats_lock:
+            if outcome == "dropped":
+                self.stats["dropped"] += 1
+            else:
+                self.stats["routed"] += 1
+
+    # -- HTTP healthcheck (handlers.go:30-38) ------------------------------
+
+    def _http_handler(self):
+        proxy = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthcheck":
+                    if proxy.destinations.size() > 0:
+                        body, code = b"ok\n", 200
+                    else:
+                        body, code = b"no destinations\n", 503
+                else:
+                    body, code = b"not found\n", 404
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        return Handler
+
+    # -- discovery loop (proxy.go:345-387) ---------------------------------
+
+    def handle_discovery(self) -> None:
+        try:
+            dests = self.discoverer.get_destinations_for_service(
+                self.cfg.forward_service)
+        except Exception as e:
+            logger.warning("discovery failed: %s", e)
+            return
+        self.destinations.set_members(dests)
+
+    def _poll_discovery(self) -> None:
+        while not self._shutdown.wait(self.cfg.discovery_interval):
+            self.handle_discovery()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.handle_discovery()
+        self.grpc_server.start()
+        self._started = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True, name="proxy-http").start()
+        threading.Thread(target=self._poll_discovery,
+                         daemon=True, name="proxy-discovery").start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self.grpc_server.stop(grace=1.0)
+        if self._started:
+            # shutdown() blocks forever unless serve_forever is running
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        self.destinations.clear()
