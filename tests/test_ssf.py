@@ -1,0 +1,259 @@
+"""SSF pipeline tests: framing round-trips and error poisoning
+(protocol/wire_test.go), SSF->metric conversion (parser_test.go SSF cases),
+span e2e over real sockets with metric extraction
+(server_test.go:1240 SSF e2e), trace client loopback."""
+
+import io
+import socket
+import struct
+import time
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import ssf as ssf_mod
+from veneur_tpu import trace as trace_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.samplers import ssf_convert
+from veneur_tpu.samplers.metric_key import MetricScope
+from veneur_tpu.samplers.parser import Parser
+from veneur_tpu.sinks import simple as simple_sinks
+
+P = Parser()
+
+
+def make_span(**kw):
+    span = ssf_mod.SSFSpan(
+        version=0, trace_id=1, id=2, parent_id=0,
+        start_timestamp=1_000_000_000, end_timestamp=2_000_000_000,
+        service="svc", name="op")
+    for k, v in kw.items():
+        setattr(span, k, v)
+    return span
+
+
+def test_frame_roundtrip():
+    span = make_span()
+    span.metrics.append(ssf_mod.count("hits", 3, {"a": "b"}))
+    buf = io.BytesIO()
+    ssf_mod.write_ssf(buf, span)
+    buf.seek(0)
+    back = ssf_mod.read_ssf(buf)
+    assert back.name == "op"
+    assert back.metrics[0].name == "hits"
+    assert back.metrics[0].sample_rate == 1.0  # normalized from 0
+    assert ssf_mod.read_ssf(buf) is None  # clean EOF
+
+
+def test_frame_version_error():
+    buf = io.BytesIO(b"\x01\x00\x00\x00\x05hello")
+    with pytest.raises(ssf_mod.FrameVersionError):
+        ssf_mod.read_ssf(buf)
+
+
+def test_frame_length_error():
+    buf = io.BytesIO(struct.pack(">BI", 0, ssf_mod.MAX_SSF_PACKET_LENGTH + 1))
+    with pytest.raises(ssf_mod.FrameLengthError):
+        ssf_mod.read_ssf(buf)
+
+
+def test_frame_truncation_error():
+    span = make_span()
+    data = ssf_mod.frame_bytes(span)
+    buf = io.BytesIO(data[:-3])
+    with pytest.raises(ssf_mod.FramingIOError):
+        ssf_mod.read_ssf(buf)
+
+
+def test_name_tag_normalization():
+    span = ssf_mod.SSFSpan(trace_id=1, id=2)
+    span.tags["name"] = "from-tag"
+    back = ssf_mod.parse_ssf(span.SerializeToString())
+    assert back.name == "from-tag"
+    assert "name" not in back.tags
+
+
+def test_valid_trace():
+    assert ssf_mod.valid_trace(make_span())
+    assert not ssf_mod.valid_trace(make_span(id=0))
+    assert not ssf_mod.valid_trace(make_span(name=""))
+    with pytest.raises(ssf_mod.InvalidTrace):
+        ssf_mod.validate_trace(make_span(end_timestamp=0))
+
+
+def test_parse_metric_ssf_types():
+    s = ssf_mod.count("c", 2, {"x": "y"})
+    m = ssf_convert.parse_metric_ssf(P, s)
+    assert (m.type, m.value, m.tags) == ("counter", 2.0, ["x:y"])
+
+    s = ssf_mod.gauge("g", 1.5)
+    assert ssf_convert.parse_metric_ssf(P, s).type == "gauge"
+
+    s = ssf_mod.set_sample("s", "member")
+    m = ssf_convert.parse_metric_ssf(P, s)
+    assert (m.type, m.value) == ("set", "member")
+
+    s = ssf_mod.status("st", ssf_mod.SSFSample.WARNING)
+    s.status = ssf_mod.SSFSample.WARNING
+    m = ssf_convert.parse_metric_ssf(P, s)
+    assert (m.type, m.value) == ("status", 1)
+
+
+def test_parse_metric_ssf_scope_tags():
+    s = ssf_mod.count("c", 1, {"veneurglobalonly": "true", "k": "v"})
+    m = ssf_convert.parse_metric_ssf(P, s)
+    assert m.scope == MetricScope.GLOBAL_ONLY
+    assert m.tags == ["k:v"]
+
+
+def test_convert_metrics_invalid_mixed():
+    span = make_span()
+    span.metrics.append(ssf_mod.count("good", 1))
+    span.metrics.append(ssf_mod.count("", 1))  # invalid: no name
+    with pytest.raises(ssf_convert.InvalidMetricsError) as exc:
+        ssf_convert.convert_metrics(P, span)
+    assert len(exc.value.samples) == 1
+    assert [m.name for m in exc.value.metrics] == ["good"]
+
+
+def test_indicator_conversion():
+    span = make_span(indicator=True, error=True)
+    span.tags["ssf_objective"] = "checkout"
+    ms = ssf_convert.convert_indicator_metrics(
+        P, span, "veneur.indicator", "veneur.objective")
+    assert len(ms) == 2
+    ind, obj = ms
+    assert ind.name == "veneur.indicator"
+    # SSF has no timer type; Timing() samples parse as histograms
+    # (ssf/samples.go Timing -> parser.go:302)
+    assert ind.type == "histogram"
+    assert ind.value == pytest.approx(1e9)  # 1s in ns
+    assert "error:true" in ind.tags
+    assert obj.scope == MetricScope.GLOBAL_ONLY
+    assert "objective:checkout" in obj.tags
+
+    # non-indicator span is a no-op
+    assert ssf_convert.convert_indicator_metrics(
+        P, make_span(), "a", "b") == []
+
+
+def test_span_uniqueness_metrics():
+    ms = ssf_convert.convert_span_uniqueness_metrics(P, make_span(), 1.0)
+    assert len(ms) == 1
+    assert ms[0].type == "set"
+    assert ms[0].value == "op"
+    assert "service:svc" in ms[0].tags
+
+
+def _boot_ssf_server(tmp_path, listen):
+    cfg = config_mod.Config(
+        ssf_listen_addresses=[listen], interval=0.05,
+        percentiles=[0.5], aggregates=["count"], hostname="t",
+        indicator_span_timer_name="veneur.indicator")
+    msink = simple_sinks.ChannelMetricSink()
+    ssink = simple_sinks.BlackholeSpanSink()
+    srv = Server(cfg, extra_metric_sinks=[msink], extra_span_sinks=[ssink])
+    srv.start()
+    return srv, msink
+
+
+def test_ssf_udp_end_to_end():
+    srv, msink = _boot_ssf_server(None, "udp://127.0.0.1:0")
+    try:
+        _, addr = srv.ssf_addrs[0]
+        span = make_span(indicator=True)
+        span.metrics.append(ssf_mod.count("span.hits", 7, {"q": "r"}))
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(span.SerializeToString(), addr)
+        s.close()
+        deadline = time.time() + 5
+        while srv.metric_extraction.spans_processed < 1 \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        srv.flush()
+        got = []
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            while not msink.queue.empty():
+                got.extend(msink.queue.get())
+            if not got:
+                srv.flush()
+                time.sleep(0.05)
+        by = {m.name: m for m in got}
+        assert by["span.hits"].value == 7.0
+        # indicator timer extracted too
+        assert "veneur.indicator.count" in by
+    finally:
+        srv.shutdown()
+
+
+def test_ssf_unix_stream_end_to_end(tmp_path):
+    path = str(tmp_path / "ssf.sock")
+    srv, msink = _boot_ssf_server(tmp_path, f"unix://{path}")
+    try:
+        span = make_span()
+        span.metrics.append(ssf_mod.gauge("temp", 70.0))
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(path)
+        c.sendall(ssf_mod.frame_bytes(span))
+        c.close()
+        deadline = time.time() + 5
+        while srv.metric_extraction.spans_processed < 1 \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        srv.flush()
+        got = []
+        deadline = time.time() + 5
+        while time.time() < deadline and not got:
+            while not msink.queue.empty():
+                got.extend(msink.queue.get())
+            if not got:
+                srv.flush()
+                time.sleep(0.05)
+        assert {m.name for m in got} == {"temp"}
+    finally:
+        srv.shutdown()
+
+
+def test_trace_client_loopback():
+    received = []
+    client = trace_mod.new_channel_client(received.append)
+    with client.span("op", service="me", indicator=True) as span:
+        span.add(ssf_mod.count("inner", 1))
+        with span.child("sub"):
+            pass
+    client.flush()
+    time.sleep(0.2)
+    assert len(received) == 2  # child finished first, then parent
+    names = {s.name for s in received}
+    assert names == {"op", "sub"}
+    parent = [s for s in received if s.name == "op"][0]
+    child = [s for s in received if s.name == "sub"][0]
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.id
+    assert parent.metrics[0].name == "inner"
+    assert ssf_mod.valid_trace(parent)
+    client.close()
+
+
+def test_server_self_telemetry_loopback():
+    """The server's own trace client feeds its span pipeline."""
+    cfg = config_mod.Config(interval=0.05, percentiles=[0.5],
+                            aggregates=["count"], hostname="t")
+    msink = simple_sinks.ChannelMetricSink()
+    srv = Server(cfg, extra_metric_sinks=[msink])
+    srv.start()
+    try:
+        from veneur_tpu import trace as tm
+        tm.report_one(srv.trace_client,
+                      ssf_mod.count("veneur.internal", 5))
+        deadline = time.time() + 5
+        got = []
+        while time.time() < deadline and not got:
+            srv.flush()
+            while not msink.queue.empty():
+                got.extend(msink.queue.get())
+            time.sleep(0.05)
+        assert any(m.name == "veneur.internal" for m in got)
+    finally:
+        srv.shutdown()

@@ -78,14 +78,31 @@ class Server:
             self.span_sinks.append(sink_mod.create_span_sink(spec, cfg))
         self.span_sinks.extend(extra_span_sinks or [])
 
+        # metric extraction from spans is always installed
+        # (ssfmetrics, server.go:645-657)
+        from veneur_tpu.sinks.ssfmetrics import MetricExtractionSink
+        self.metric_extraction = MetricExtractionSink(
+            self.parser, self.aggregator.process_metric,
+            indicator_timer_name=cfg.indicator_span_timer_name,
+            objective_timer_name=cfg.objective_span_timer_name)
+        self.span_sinks.append(self.metric_extraction)
+
         # event/service-check accumulation (EventWorker, worker.go:491-536)
         self._events: list[parser_mod.SSFSample] = []
         self._events_lock = threading.Lock()
 
-        # span ingestion queue feeds span sinks (SpanWorker comes with the
-        # SSF pipeline; scaffolding here so sinks receive spans)
-        self.span_queue: list = []
-        self._span_lock = threading.Lock()
+        # span pipeline: bounded queue drained by span workers
+        # (SpanChan + SpanWorker, worker.go:539-654)
+        import queue as queue_mod
+        self.span_queue: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=cfg.span_channel_capacity)
+        self.spans_dropped = 0
+        self.ssf_received = 0
+
+        # self-telemetry loops back into our own span pipeline
+        # (trace.NewChannelClient, server.go:518-521)
+        from veneur_tpu import trace as trace_mod
+        self.trace_client = trace_mod.new_channel_client(self.handle_span)
 
         self._listeners: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
@@ -97,6 +114,7 @@ class Server:
         self.flush_count = 0
         # resolved addresses (after binding port 0)
         self.statsd_addrs: list[tuple[str, object]] = []
+        self.ssf_addrs: list[tuple[str, object]] = []
         self.grpc_import = None
         self.shutdown_hook: Callable[[], None] = lambda: os._exit(2)
 
@@ -143,13 +161,20 @@ class Server:
             sink.start(None)
         for addr in self.config.statsd_listen_addresses:
             self._start_statsd(addr)
+        for addr in self.config.ssf_listen_addresses:
+            self._start_ssf(addr)
+        for i in range(max(1, self.config.num_span_workers)):
+            t = threading.Thread(target=self._span_worker, daemon=True,
+                                 name=f"span-worker-{i}")
+            t.start()
+            self._threads.append(t)
         if self.config.grpc_address:
             # global tier: gRPC import source (server.go:673-682)
             from veneur_tpu.sources.proxy import GrpcImportServer
             self.grpc_import = GrpcImportServer(
                 self.config.grpc_address,
                 self.aggregator.import_metric,
-                ingest_span=self.ingest_span,
+                ingest_span=self.handle_span,
                 handle_packet=self.process_packet_buffer)
             self.grpc_import.start()
         if self.config.forward_address and self.forwarder is None:
@@ -304,17 +329,132 @@ class Server:
             except OSError:
                 pass
 
-    # -- spans -------------------------------------------------------------
+    # -- spans (SSF pipeline) ----------------------------------------------
+
+    def handle_trace_packet(self, packet: bytes) -> None:
+        """One raw SSFSpan protobuf datagram (HandleTracePacket,
+        server.go:1015-1044)."""
+        from veneur_tpu import ssf as ssf_mod
+        if not packet:
+            return
+        try:
+            span = ssf_mod.parse_ssf(packet)
+        except Exception as e:
+            logger.debug("could not parse SSF packet: %s", e)
+            return
+        self.handle_span(span)
+
+    def handle_span(self, span) -> None:
+        """Enqueue for the span workers (handleSSF, server.go:1046-1093);
+        drops when the channel is at capacity."""
+        self.ssf_received += 1
+        try:
+            self.span_queue.put_nowait(span)
+        except Exception:
+            self.spans_dropped += 1
+
+    def _span_worker(self) -> None:
+        """Drain the span queue into every span sink
+        (SpanWorker.Work, worker.go:579-654)."""
+        import queue as queue_mod
+        while not self._shutdown.is_set():
+            try:
+                span = self.span_queue.get(timeout=0.1)
+            except queue_mod.Empty:
+                continue
+            self.ingest_span(span)
 
     def ingest_span(self, span) -> None:
-        """Fan a span out to all span sinks (SpanWorker, worker.go:579-654);
-        full SSF listener wiring lives in the ssf package."""
         for sink in self.span_sinks:
             try:
                 sink.ingest(span)
             except Exception as e:
                 logger.warning("span sink %s ingest error: %s",
                                sink.name(), e)
+
+    def _start_ssf(self, addr: str) -> None:
+        """SSF listeners (StartSSF, networking.go:223-319): UDP datagrams
+        carry a raw SSFSpan protobuf; unix/tcp streams carry framed
+        spans, where any framing error poisons the stream."""
+        scheme, rest = parse_listen_addr(addr)
+        if scheme == "udp":
+            host, port = _split_hostport(rest)
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            if hasattr(socket, "SO_REUSEPORT"):
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                            self.config.read_buffer_size_bytes)
+            sock.bind((host, port))
+            self._listeners.append(sock)
+            t = threading.Thread(target=self._read_ssf_udp, args=(sock,),
+                                 daemon=True, name="ssf-udp")
+            t.start()
+            self._threads.append(t)
+            self.ssf_addrs.append(("udp", sock.getsockname()))
+        elif scheme in ("unix", "tcp"):
+            if scheme == "unix":
+                if os.path.exists(rest):
+                    os.unlink(rest)
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.bind(rest)
+                bound = rest
+            else:
+                host, port = _split_hostport(rest)
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                sock.bind((host, port))
+                bound = sock.getsockname()
+            sock.listen(128)
+            self._listeners.append(sock)
+            t = threading.Thread(target=self._accept_ssf, args=(sock,),
+                                 daemon=True, name=f"ssf-{scheme}")
+            t.start()
+            self._threads.append(t)
+            self.ssf_addrs.append((scheme, bound))
+        else:
+            raise ValueError(f"unknown SSF listener scheme {scheme!r}")
+
+    def _read_ssf_udp(self, sock: socket.socket) -> None:
+        # a UDP datagram can't exceed 64KiB; don't allocate the full
+        # (16MiB default) trace_max_length_bytes per recv
+        bufsize = min(self.config.trace_max_length_bytes, 65536)
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(bufsize)
+            except OSError:
+                return
+            if data:
+                self.handle_trace_packet(data)
+
+    def _accept_ssf(self, sock: socket.socket) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._read_ssf_stream,
+                                 args=(conn,), daemon=True)
+            t.start()
+
+    def _read_ssf_stream(self, conn: socket.socket) -> None:
+        from veneur_tpu import ssf as ssf_mod
+        try:
+            f = conn.makefile("rb")
+            while not self._shutdown.is_set():
+                span = ssf_mod.read_ssf(f)
+                if span is None:
+                    return
+                self.handle_span(span)
+        except ssf_mod.FramingError as e:
+            # the stream is poisoned; close it (protocol/wire.go:26-28)
+            logger.debug("SSF framing error, closing stream: %s", e)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- flush (flusher.go:26-122) ----------------------------------------
 
@@ -406,6 +546,10 @@ class Server:
             except Exception:
                 logger.exception("final flush failed")
         self._shutdown.set()
+        try:
+            self.trace_client.close()
+        except Exception:
+            pass
         for sock in self._listeners:
             try:
                 sock.close()

@@ -1,0 +1,140 @@
+"""SSF -> metric conversion.
+
+Mirrors `samplers/parser.go:154-345`: ParseMetricSSF (one SSFSample ->
+UDPMetric with scope tags handled), ConvertMetrics (batch with typed
+invalid-sample error), ConvertIndicatorMetrics (an indicator span -> the
+indicator timer and the globally-aggregated objective/SLI timer), and
+ConvertSpanUniquenessMetrics (sampled Set of span names per service).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from veneur_tpu import ssf as ssf_mod
+from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric
+from veneur_tpu.samplers.parser import ParseError, Parser
+
+SSFSample = ssf_mod.SSFSample
+
+_TYPE_BY_METRIC = {
+    SSFSample.COUNTER: "counter",
+    SSFSample.GAUGE: "gauge",
+    SSFSample.HISTOGRAM: "histogram",
+    SSFSample.SET: "set",
+    SSFSample.STATUS: "status",
+}
+
+
+class InvalidMetricsError(ValueError):
+    """Some samples failed conversion (parser.go:319-333); the valid ones
+    were still returned."""
+
+    def __init__(self, samples: list):
+        super().__init__(f"parse errors on {len(samples)} metrics")
+        self.samples = samples
+
+
+def valid_metric(m: UDPMetric) -> bool:
+    return bool(m.name) and m.value is not None
+
+
+def parse_metric_ssf(parser: Parser, sample: SSFSample) -> UDPMetric:
+    """parser.go:290-345."""
+    mtype = _TYPE_BY_METRIC.get(sample.metric)
+    if mtype is None:
+        raise ParseError("Invalid type for metric")
+    ret = UDPMetric(name=sample.name, type=mtype, sample_rate=1.0)
+
+    if sample.metric == SSFSample.SET:
+        ret.value = sample.message
+    elif sample.metric == SSFSample.STATUS:
+        ret.value = int(sample.status)
+    else:
+        ret.value = float(sample.value)
+
+    if sample.scope == SSFSample.LOCAL:
+        ret.scope = MetricScope.LOCAL_ONLY
+    elif sample.scope == SSFSample.GLOBAL:
+        ret.scope = MetricScope.GLOBAL_ONLY
+
+    # normalize the proto default (0) to 1.0 here too — spans arriving via
+    # gRPC or in-process loopback never pass through parse_ssf
+    ret.sample_rate = sample.sample_rate if sample.sample_rate > 0 else 1.0
+
+    temp_tags = []
+    for key, value in sample.tags.items():
+        if key == "veneurlocalonly":
+            ret.scope = MetricScope.LOCAL_ONLY
+            continue
+        if key == "veneurglobalonly":
+            ret.scope = MetricScope.GLOBAL_ONLY
+            continue
+        temp_tags.append(f"{key}:{value}")
+    ret.update_tags(temp_tags, parser.extend_tags)
+    return ret
+
+
+def convert_metrics(parser: Parser, span) -> list[UDPMetric]:
+    """parser.go:154-171: convert every sample; raise InvalidMetricsError
+    carrying the invalid ones (valid metrics are on the exception too)."""
+    metrics: list[UDPMetric] = []
+    invalid = []
+    for sample in span.metrics:
+        try:
+            m = parse_metric_ssf(parser, sample)
+        except ParseError:
+            invalid.append(sample)
+            continue
+        if not valid_metric(m):
+            invalid.append(sample)
+            continue
+        metrics.append(m)
+    if invalid:
+        err = InvalidMetricsError(invalid)
+        err.metrics = metrics
+        raise err
+    return metrics
+
+
+def convert_indicator_metrics(parser: Parser, span,
+                              indicator_timer_name: str,
+                              objective_timer_name: str
+                              ) -> list[UDPMetric]:
+    """parser.go:180-232."""
+    if not span.indicator or not ssf_mod.valid_trace(span):
+        return []
+    duration_ns = span.end_timestamp - span.start_timestamp
+    out: list[UDPMetric] = []
+
+    if indicator_timer_name:
+        tags = {"service": span.service,
+                "error": "true" if span.error else "false"}
+        timer = ssf_mod.timing(indicator_timer_name, duration_ns * 1e-9,
+                               1e-9, tags)
+        out.append(parse_metric_ssf(parser, timer))
+
+    if objective_timer_name:
+        tags = {"service": span.service,
+                "objective": span.tags.get("ssf_objective") or span.name,
+                "error": "true" if span.error else "false",
+                "veneurglobalonly": "true"}
+        timer = ssf_mod.timing(objective_timer_name, duration_ns * 1e-9,
+                               1e-9, tags)
+        out.append(parse_metric_ssf(parser, timer))
+    return out
+
+
+def convert_span_uniqueness_metrics(parser: Parser, span,
+                                    rate: float) -> list[UDPMetric]:
+    """parser.go:238-259: sampled Set counting unique span names."""
+    if not span.service:
+        return []
+    samples = ssf_mod.randomly_sample(
+        rate,
+        ssf_mod.set_sample("ssf.names_unique", span.name, {
+            "indicator": str(span.indicator).lower(),
+            "service": span.service,
+            "root_span": str(span.id == span.trace_id).lower(),
+        }))
+    return [parse_metric_ssf(parser, s) for s in samples]

@@ -1,0 +1,62 @@
+"""Metric-extraction span sink.
+
+Mirrors `sinks/ssfmetrics/metrics.go`: installed unconditionally
+(server.go:645-657), it pulls the SSFSamples out of every ingested span,
+converts them through the parser, and feeds them to the metric
+aggregation core; indicator spans additionally produce the
+indicator/objective SLI timers.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.samplers import ssf_convert
+
+logger = logging.getLogger("veneur_tpu.sinks.ssfmetrics")
+
+
+class MetricExtractionSink(sink_mod.BaseSpanSink):
+    KIND = "ssfmetrics"
+
+    # reference samples uniqueness sets at 1% (sinks/ssfmetrics/metrics.go)
+    UNIQUENESS_SAMPLE_RATE = 0.01
+
+    def __init__(self, parser, process_metric,
+                 indicator_timer_name: str = "",
+                 objective_timer_name: str = "",
+                 uniqueness_rate: float = UNIQUENESS_SAMPLE_RATE):
+        super().__init__("ssfmetrics")
+        self.parser = parser
+        self.process_metric = process_metric
+        self.indicator_timer_name = indicator_timer_name
+        self.objective_timer_name = objective_timer_name
+        self.uniqueness_rate = uniqueness_rate
+        self.spans_processed = 0
+
+    def ingest(self, span) -> None:
+        metrics = []
+        try:
+            metrics.extend(ssf_convert.convert_metrics(self.parser, span))
+        except ssf_convert.InvalidMetricsError as e:
+            metrics.extend(e.metrics)
+            logger.debug("span contained %d invalid samples",
+                         len(e.samples))
+        if span.indicator:
+            try:
+                metrics.extend(ssf_convert.convert_indicator_metrics(
+                    self.parser, span, self.indicator_timer_name,
+                    self.objective_timer_name))
+            except Exception as e:
+                logger.warning("indicator conversion failed: %s", e)
+        if self.uniqueness_rate > 0:
+            try:
+                metrics.extend(ssf_convert.convert_span_uniqueness_metrics(
+                    self.parser, span, self.uniqueness_rate))
+            except Exception as e:
+                logger.debug("uniqueness conversion failed: %s", e)
+        for m in metrics:
+            self.process_metric(m)
+        self.spans_processed += 1

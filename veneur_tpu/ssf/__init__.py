@@ -1,0 +1,193 @@
+"""SSF: the Sensor Sample Format — spans + framing + sample constructors.
+
+Wire framing mirrors `protocol/wire.go:5-49`: a frame is one version byte
+(only version 0 exists: a protobuf ssf.SSFSpan follows), a 32-bit
+big-endian length capped at 16MiB, then the protobuf bytes.  Framing
+errors poison the stream (`protocol/errors.go`): there are no re-sync
+hints, so callers must close on any framing error.
+
+Span normalization and validity mirror `protocol/wire.go:137-173,80-98`;
+sample constructors mirror `ssf/samples.go:134-209`.
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+import time
+from typing import BinaryIO, Optional
+
+from veneur_tpu.protocol import ssf_pb2
+
+SSFSample = ssf_pb2.SSFSample
+SSFSpan = ssf_pb2.SSFSpan
+
+MAX_SSF_PACKET_LENGTH = 16 * 1024 * 1024
+SSF_FRAME_LENGTH = 5
+_VERSION0 = 0
+
+
+# -- framing errors (protocol/errors.go) ------------------------------------
+
+class FramingError(Exception):
+    """The stream is poisoned and must be closed."""
+
+
+class FramingIOError(FramingError):
+    pass
+
+
+class FrameVersionError(FramingError):
+    def __init__(self, version: int):
+        super().__init__(f"unknown SSF frame version {version}")
+        self.version = version
+
+
+class FrameLengthError(FramingError):
+    def __init__(self, length: int):
+        super().__init__(
+            f"frame of length {length} exceeds maximum "
+            f"{MAX_SSF_PACKET_LENGTH}")
+        self.length = length
+
+
+def is_framing_error(err: Exception) -> bool:
+    return isinstance(err, FramingError)
+
+
+class InvalidTrace(ValueError):
+    pass
+
+
+# -- span validity (wire.go:80-98) ------------------------------------------
+
+def valid_trace(span: SSFSpan) -> bool:
+    return (span.id != 0 and span.trace_id != 0
+            and span.start_timestamp != 0 and span.end_timestamp != 0
+            and span.name != "")
+
+
+def validate_trace(span: SSFSpan) -> None:
+    if not valid_trace(span):
+        raise InvalidTrace(f"not a valid trace span: {span}")
+
+
+# -- parse + normalize (wire.go:137-173) ------------------------------------
+
+def parse_ssf(packet: bytes) -> SSFSpan:
+    span = SSFSpan.FromString(packet)
+    # name fallback from a "name" tag (backwards compatibility)
+    if not span.name and "name" in span.tags:
+        span.name = span.tags["name"]
+        del span.tags["name"]
+    for sample in span.metrics:
+        if sample.sample_rate == 0:
+            sample.sample_rate = 1.0
+    return span
+
+
+# -- stream framing (wire.go:102-212) ---------------------------------------
+
+def read_ssf(stream: BinaryIO) -> Optional[SSFSpan]:
+    """Read one framed span; returns None on clean EOF at a message
+    boundary; raises FramingError on any mid-message failure."""
+    first = stream.read(1)
+    if first == b"":
+        return None  # clean hang-up between messages
+    version = first[0]
+    if version != _VERSION0:
+        raise FrameVersionError(version)
+    raw_len = _read_exact(stream, 4)
+    (length,) = struct.unpack(">I", raw_len)
+    if length > MAX_SSF_PACKET_LENGTH:
+        raise FrameLengthError(length)
+    body = _read_exact(stream, length)
+    return parse_ssf(body)
+
+
+def _read_exact(stream: BinaryIO, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            raise FramingIOError(f"EOF mid-frame after {len(buf)}/{n} bytes")
+        buf += chunk
+    return buf
+
+
+def write_ssf(stream: BinaryIO, span: SSFSpan) -> int:
+    data = span.SerializeToString()
+    if len(data) > MAX_SSF_PACKET_LENGTH:
+        raise FrameLengthError(len(data))
+    try:
+        stream.write(struct.pack(">BI", _VERSION0, len(data)))
+        n = stream.write(data)
+    except OSError as e:
+        raise FramingIOError(str(e))
+    return n
+
+
+def frame_bytes(span: SSFSpan) -> bytes:
+    data = span.SerializeToString()
+    return struct.pack(">BI", _VERSION0, len(data)) + data
+
+
+# -- sample constructors (ssf/samples.go:134-209) ---------------------------
+
+def _mk(metric, name: str, value: float = 0.0,
+        tags: Optional[dict[str, str]] = None, unit: str = "",
+        timestamp: Optional[int] = None,
+        sample_rate: float = 1.0, message: str = "") -> SSFSample:
+    return SSFSample(
+        metric=metric, name=name, value=value,
+        tags=tags or {}, unit=unit,
+        timestamp=timestamp if timestamp is not None else 0,
+        sample_rate=sample_rate, message=message)
+
+
+def count(name: str, value: float,
+          tags: Optional[dict[str, str]] = None, **kw) -> SSFSample:
+    return _mk(SSFSample.COUNTER, name, value, tags, **kw)
+
+
+def gauge(name: str, value: float,
+          tags: Optional[dict[str, str]] = None, **kw) -> SSFSample:
+    return _mk(SSFSample.GAUGE, name, value, tags, **kw)
+
+
+def histogram(name: str, value: float,
+              tags: Optional[dict[str, str]] = None, **kw) -> SSFSample:
+    return _mk(SSFSample.HISTOGRAM, name, value, tags, **kw)
+
+
+def set_sample(name: str, member: str,
+               tags: Optional[dict[str, str]] = None, **kw) -> SSFSample:
+    return _mk(SSFSample.SET, name, 0.0, tags, message=member, **kw)
+
+
+def timing(name: str, duration_s: float, resolution_s: float = 1e-9,
+           tags: Optional[dict[str, str]] = None, **kw) -> SSFSample:
+    """Duration expressed in `resolution_s` units with a unit string
+    (ssf/samples.go Timing)."""
+    units = {1e-9: "ns", 1e-6: "us", 1e-3: "ms", 1.0: "s"}
+    return _mk(SSFSample.HISTOGRAM, name, duration_s / resolution_s, tags,
+               unit=units.get(resolution_s, ""), **kw)
+
+
+def status(name: str, state: int,
+           tags: Optional[dict[str, str]] = None,
+           message: str = "", **kw) -> SSFSample:
+    s = _mk(SSFSample.STATUS, name, 0.0, tags, message=message, **kw)
+    s.status = state
+    return s
+
+
+def randomly_sample(rate: float, *samples: SSFSample) -> list[SSFSample]:
+    """Client-side sampling (ssf/samples.go RandomlySample): keep each
+    sample with probability `rate`, recording the rate."""
+    out = []
+    for s in samples:
+        if rate >= 1.0 or random.random() < rate:
+            s.sample_rate = rate
+            out.append(s)
+    return out

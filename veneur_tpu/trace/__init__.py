@@ -1,0 +1,191 @@
+"""Trace/SSF client library: span lifecycle + async submission backends.
+
+Mirrors `trace/` (trace.go, client.go, backend.go, metrics/client.go):
+spans are created with start_span / start_span_from_context-style helpers,
+finished spans are submitted asynchronously through a Client whose backend
+is a UDP datagram socket, a framed UNIX/TCP stream (`trace/backend.go:
+46-226`), or an in-process channel loopback (`NewChannelClient`,
+client.go:315 — how the server traces itself into its own span pipeline).
+metrics.report wraps bare samples in a metrics-only span
+(`trace/metrics/client.go:21-50`).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+from veneur_tpu import ssf as ssf_mod
+
+logger = logging.getLogger("veneur_tpu.trace")
+
+
+def _new_id() -> int:
+    return random.getrandbits(63) | 1  # nonzero
+
+
+class Span:
+    """An in-flight span (trace.Trace, trace/trace.go:53-)."""
+
+    def __init__(self, name: str, service: str = "",
+                 parent: Optional["Span"] = None,
+                 client: Optional["Client"] = None,
+                 indicator: bool = False,
+                 tags: Optional[dict[str, str]] = None):
+        self.name = name
+        self.service = service or (parent.service if parent else "")
+        self.trace_id = parent.trace_id if parent else _new_id()
+        self.span_id = _new_id()
+        self.parent_id = parent.span_id if parent else 0
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.error = False
+        self.indicator = indicator
+        self.tags: dict[str, str] = dict(tags or {})
+        self.samples: list = []
+        self.client = client
+
+    def add(self, *samples) -> None:
+        self.samples.extend(samples)
+
+    def child(self, name: str, **kw) -> "Span":
+        return Span(name, parent=self, client=self.client, **kw)
+
+    def to_proto(self) -> ssf_mod.SSFSpan:
+        span = ssf_mod.SSFSpan(
+            version=0, trace_id=self.trace_id, id=self.span_id,
+            parent_id=self.parent_id, start_timestamp=self.start_ns,
+            end_timestamp=self.end_ns or time.time_ns(),
+            error=self.error, service=self.service,
+            indicator=self.indicator, name=self.name)
+        for k, v in self.tags.items():
+            span.tags[k] = v
+        span.metrics.extend(self.samples)
+        return span
+
+    def finish(self, error: bool = False) -> None:
+        """ClientFinish equivalent: stamp the end time and submit."""
+        self.end_ns = time.time_ns()
+        self.error = self.error or error
+
+        if self.client is not None:
+            self.client.record(self.to_proto())
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.finish(error=exc_type is not None)
+
+
+class Client:
+    """Async span submission (trace.Client, trace/client.go:57-128):
+    a worker thread drains a bounded buffer into the backend; overflow
+    drops (UDP heritage)."""
+
+    def __init__(self, backend: Callable[[ssf_mod.SSFSpan], None],
+                 capacity: int = 1024):
+        self._backend = backend
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self.dropped = 0
+        self.sent = 0
+        self._closed = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="trace-client")
+        self._worker.start()
+
+    def record(self, span: ssf_mod.SSFSpan) -> None:
+        try:
+            self._q.put_nowait(span)
+        except queue.Full:
+            self.dropped += 1
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            try:
+                span = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._backend(span)
+                self.sent += 1
+            except Exception as e:
+                self.dropped += 1
+                logger.debug("span submission failed: %s", e)
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        deadline = time.time() + timeout_s
+        while not self._q.empty() and time.time() < deadline:
+            time.sleep(0.01)
+
+    def close(self) -> None:
+        self.flush()
+        self._closed.set()
+        self._worker.join(timeout=1.0)
+
+    def span(self, name: str, **kw) -> Span:
+        return Span(name, client=self, **kw)
+
+
+# -- backends (trace/backend.go:46-226) -------------------------------------
+
+def udp_backend(address: tuple[str, int]):
+    """One datagram per span (packet backend)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def send(span: ssf_mod.SSFSpan) -> None:
+        sock.sendto(span.SerializeToString(), address)
+
+    return send
+
+
+def unix_stream_backend(path: str):
+    """Framed spans on a UNIX stream with reconnect-on-error."""
+    state = {"sock": None}
+
+    def connect():
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(path)
+        state["sock"] = s
+
+    def send(span: ssf_mod.SSFSpan) -> None:
+        if state["sock"] is None:
+            connect()
+        try:
+            state["sock"].sendall(ssf_mod.frame_bytes(span))
+        except OSError:
+            state["sock"] = None
+            raise
+
+    return send
+
+
+def channel_backend(handler: Callable[[ssf_mod.SSFSpan], None]):
+    """In-process loopback (NewChannelClient): spans go straight back
+    into the server's own span pipeline (server.go:518-521)."""
+    return handler
+
+
+def new_channel_client(handler: Callable[[ssf_mod.SSFSpan], None],
+                       capacity: int = 1024) -> Client:
+    return Client(channel_backend(handler), capacity)
+
+
+# -- metrics-only reporting (trace/metrics/client.go:21-50) -----------------
+
+def report(client: Optional[Client], *samples) -> None:
+    """Wrap samples in a metrics-only span and submit."""
+    if client is None or not samples:
+        return
+    span = ssf_mod.SSFSpan()
+    span.metrics.extend(samples)
+    client.record(span)
+
+
+def report_one(client: Optional[Client], sample) -> None:
+    report(client, sample)
