@@ -21,3 +21,11 @@ os.environ.setdefault("VENEUR_TPU_TEST", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache: without it every pytest process cold-compiles
+# the flush kernels (~seconds each), which makes timing-sensitive
+# forwarding/server tests flaky under contention.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), os.pardir,
+                               ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

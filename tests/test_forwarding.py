@@ -45,7 +45,7 @@ def boot_local(forward_addr: str, **kw):
     return srv, sink
 
 
-def flush_and_collect(srv, sink, pred, tries=40):
+def flush_and_collect(srv, sink, pred, tries=150):
     for _ in range(tries):
         srv.flush()
         got = []

@@ -93,10 +93,26 @@ def test_parse_metric_ssf_types():
     m = ssf_convert.parse_metric_ssf(P, s)
     assert (m.type, m.value) == ("set", "member")
 
-    s = ssf_mod.status("st", ssf_mod.SSFSample.WARNING)
+    s = ssf_mod.status("st", ssf_mod.SSFSample.WARNING,
+                       message="disk 95% full")
     s.status = ssf_mod.SSFSample.WARNING
     m = ssf_convert.parse_metric_ssf(P, s)
     assert (m.type, m.value) == ("status", 1)
+    # the service-check message must survive SSF conversion, matching the
+    # DogStatsD _sc path (parser.go:290-345)
+    assert m.message == "disk 95% full"
+
+
+def test_span_finish_idempotent():
+    """Explicit finish() inside a with-block must not double-submit."""
+    spans = []
+    client = trace_mod.new_channel_client(spans.append)
+    with client.span("op") as s:
+        s.add(ssf_mod.count("x", 1))
+        s.finish(error=True)
+    client.close()
+    assert len(spans) == 1
+    assert spans[0].error
 
 
 def test_parse_metric_ssf_scope_tags():

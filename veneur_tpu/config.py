@@ -72,6 +72,7 @@ class Config:
     http_address: str = ""
     grpc_address: str = ""          # gRPC import (global tier)
     forward_address: str = ""       # set => this is a LOCAL instance
+    forward_timeout: float = 0.0    # 0 => max(interval, 10s)
     stats_address: str = ""         # self-metrics statsd target
 
     # aggregation
@@ -140,6 +141,8 @@ class Config:
             self.hostname = socket.gethostname()
         if self.interval <= 0:
             self.interval = 10.0
+        if self.forward_timeout < 0:
+            self.forward_timeout = 0.0
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
         if self.read_buffer_size_bytes <= 0:

@@ -108,10 +108,20 @@ class Server:
         self._threads: list[threading.Thread] = []
         self._shutdown = threading.Event()
         self._flush_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(4, len(self.metric_sinks) + 2),
+            max_workers=max(4, len(self.metric_sinks) + 2)
+            + self.FORWARD_MAX_IN_FLIGHT,
             thread_name_prefix="flush")
         self.last_flush_unix = time.time()
         self.flush_count = 0
+        # Bounded-concurrency forwarding: the reference gives each flush its
+        # own goroutine with a one-interval ctx deadline (flusher.go:81-86),
+        # so in-flight forwards are implicitly bounded by deadline/interval.
+        # With the deadline floored at 10s (see start()), we bound explicitly
+        # instead: up to FORWARD_MAX_IN_FLIGHT concurrent streams, and drop
+        # the batch when all slots are stalled (UDP-heritage loss model).
+        self._forward_slots = threading.BoundedSemaphore(
+            self.FORWARD_MAX_IN_FLIGHT)
+        self.forward_dropped = 0
         # resolved addresses (after binding port 0)
         self.statsd_addrs: list[tuple[str, object]] = []
         self.ssf_addrs: list[tuple[str, object]] = []
@@ -180,11 +190,16 @@ class Server:
         if self.config.forward_address and self.forwarder is None:
             # local tier: persistent forward connection (server.go:810-828)
             from veneur_tpu.forward.client import ForwardClient
-            # forward deadline = one flush interval (flusher.go:516-591),
-            # so hung forwards can't pile up across cycles
+            # The reference bounds each forward by one flush interval
+            # (flusher.go:516-591).  Here at most one forward is in flight
+            # (later flushes drop theirs while one is hung — see flush()),
+            # so the deadline can be floored at the reference's default
+            # interval without unbounded pileup; sub-second test intervals
+            # would otherwise starve a cold-start peer mid-stream.
             self.forwarder = ForwardClient(
                 self.config.forward_address,
-                timeout_s=self.config.interval)
+                timeout_s=self.config.forward_timeout
+                or max(self.config.interval, 10.0))
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")
@@ -295,6 +310,7 @@ class Server:
     # idle timeout for stream connections (the reference arms a read
     # deadline per connection, server.go:1283-1295)
     STREAM_IDLE_TIMEOUT_S = 600.0
+    FORWARD_MAX_IN_FLIGHT = 4
 
     def _read_stream(self, conn: socket.socket,
                      ctx: Optional[ssl.SSLContext]) -> None:
@@ -439,6 +455,7 @@ class Server:
     def _read_ssf_stream(self, conn: socket.socket) -> None:
         from veneur_tpu import ssf as ssf_mod
         try:
+            conn.settimeout(self.STREAM_IDLE_TIMEOUT_S)
             f = conn.makefile("rb")
             while not self._shutdown.is_set():
                 span = ssf_mod.read_ssf(f)
@@ -476,8 +493,19 @@ class Server:
 
         futures = []
         if self.forwarder is not None and self.is_local and res.forward:
-            futures.append(self._flush_pool.submit(
-                self._forward_safely, res.forward))
+            if self._forward_slots.acquire(blocking=False):
+                try:
+                    futures.append(self._flush_pool.submit(
+                        self._forward_safely, res.forward))
+                except RuntimeError:  # pool shut down mid-flush
+                    self._forward_slots.release()
+            else:
+                # all forward slots stalled: drop this interval's batch
+                # rather than queue unboundedly
+                self.forward_dropped += len(res.forward)
+                logger.warning("%d forwards in flight; dropped %d "
+                               "forward metrics",
+                               self.FORWARD_MAX_IN_FLIGHT, len(res.forward))
         for spec, sink in self.metric_sinks:
             futures.append(self._flush_pool.submit(
                 self._flush_sink, spec, sink, res.metrics, events))
@@ -491,6 +519,8 @@ class Server:
             self.forwarder(forward)
         except Exception as e:
             logger.error("forward failed: %s", e)
+        finally:
+            self._forward_slots.release()
 
     def _flush_sink(self, spec, sink, metrics, events) -> None:
         try:

@@ -50,6 +50,9 @@ def parse_metric_ssf(parser: Parser, sample: SSFSample) -> UDPMetric:
         ret.value = sample.message
     elif sample.metric == SSFSample.STATUS:
         ret.value = int(sample.status)
+        ret.message = sample.message
+        if sample.timestamp:
+            ret.timestamp = sample.timestamp
     else:
         ret.value = float(sample.value)
 

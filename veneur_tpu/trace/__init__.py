@@ -49,6 +49,7 @@ class Span:
         self.tags: dict[str, str] = dict(tags or {})
         self.samples: list = []
         self.client = client
+        self._finished = False
 
     def add(self, *samples) -> None:
         self.samples.extend(samples)
@@ -69,7 +70,12 @@ class Span:
         return span
 
     def finish(self, error: bool = False) -> None:
-        """ClientFinish equivalent: stamp the end time and submit."""
+        """ClientFinish equivalent: stamp the end time and submit.
+        Idempotent: a with-block exit after an explicit finish() must not
+        double-submit the span (and double-count its extracted metrics)."""
+        if self._finished:
+            return
+        self._finished = True
         self.end_ns = time.time_ns()
         self.error = self.error or error
 
