@@ -1,0 +1,125 @@
+"""North-star benchmark: p99 flush latency merging 100k t-digests/interval.
+
+Mirrors the reference's global-aggregation hot path (`worker.go:402-459` +
+`flusher.go:26-122`: ImportMetric merges 100k forwarded digests, then Flush
+evaluates percentiles) as one device-resident program: staged centroid
+tensors -> all-lane digest merge -> batched compress -> quantile eval.
+
+Two arms:
+  * device arm  — the jitted flush_step on the default JAX backend (the
+    real TPU chip under the driver; CPU-XLA elsewhere), timed per flush.
+  * baseline arm — the faithful sequential merging-digest
+    (veneur_tpu/sketches/tdigest_cpu.py, the Go algorithm re-implemented
+    1:1), timed on a sample of merges and extrapolated to the full 100k,
+    then divided by 32 to model a *perfectly parallel* 32-core CPU global
+    node (generous to the baseline: real veneur shards merges over worker
+    goroutines but pays channel/lock/GC overhead we ignore).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": speedup}
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_DIGESTS = 100_000          # digests merged per flush interval (north star)
+N_LANES = 8                  # staged ingest lanes
+N_KEYS = N_DIGESTS // N_LANES  # distinct metric keys; lanes*keys = 100k
+N_SETS = 256
+PERCENTILES = (0.5, 0.9, 0.99)
+WARMUP = 3
+ITERS = 30
+BASELINE_SAMPLE = 400        # sequential merges to time for extrapolation
+BASELINE_CORES = 32
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_device() -> tuple[float, float]:
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.parallel import flush_step as fs
+
+    dev = jax.devices()[0]
+    log(f"device arm: backend={dev.platform} device={dev}")
+
+    inputs = fs.example_inputs(n_keys=N_KEYS, n_lanes=N_LANES, n_sets=N_SETS)
+    inputs = jax.device_put(inputs, dev)
+    percentiles = jnp.asarray(PERCENTILES, jnp.float32)
+
+    t0 = time.perf_counter()
+    out = fs.flush_step(inputs, percentiles)
+    jax.block_until_ready(out)
+    log(f"first compile+run: {time.perf_counter() - t0:.1f}s")
+
+    for _ in range(WARMUP):
+        jax.block_until_ready(fs.flush_step(inputs, percentiles))
+
+    lat = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = fs.flush_step(inputs, percentiles)
+        jax.block_until_ready(out)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat = np.asarray(lat)
+    p50, p99 = float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
+    log(f"device arm: p50={p50:.2f}ms p99={p99:.2f}ms over {ITERS} flushes "
+        f"({N_DIGESTS} digests + quantile eval each)")
+    return p50, p99
+
+
+def bench_baseline() -> float:
+    """Sequential merging-digest arm, extrapolated to 100k merges / 32 cores."""
+    from veneur_tpu.sketches.tdigest_cpu import SequentialDigest
+
+    rng = np.random.default_rng(1)
+    # pre-build the incoming digests outside the timed region (the reference
+    # deserializes protobufs here, which we charitably exclude)
+    incoming = []
+    for _ in range(BASELINE_SAMPLE):
+        d = SequentialDigest(compression=100.0)
+        for v in rng.gamma(2.0, 10.0, 32):
+            d.add(float(v), 1.0)
+        incoming.append(d)
+
+    target = SequentialDigest(compression=100.0)
+    t0 = time.perf_counter()
+    for d in incoming:
+        target.merge(d)
+    # charge quantile eval like the device arm does
+    for q in PERCENTILES:
+        target.quantile(q)
+    elapsed = time.perf_counter() - t0
+
+    per_merge = elapsed / BASELINE_SAMPLE
+    full = per_merge * N_DIGESTS / BASELINE_CORES * 1e3
+    log(f"baseline arm: {per_merge * 1e6:.1f}us/merge sequential -> "
+        f"{full:.1f}ms for {N_DIGESTS} merges on {BASELINE_CORES} "
+        f"ideal cores")
+    return full
+
+
+def main() -> None:
+    baseline_ms = bench_baseline()
+    _, p99_ms = bench_device()
+    speedup = baseline_ms / p99_ms if p99_ms > 0 else 0.0
+    log(f"speedup vs ideal 32-core sequential baseline: {speedup:.1f}x")
+    print(json.dumps({
+        "metric": "flush_p99_latency_100k_digest_merge",
+        "value": round(p99_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

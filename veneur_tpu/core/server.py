@@ -191,11 +191,12 @@ class Server:
             # local tier: persistent forward connection (server.go:810-828)
             from veneur_tpu.forward.client import ForwardClient
             # The reference bounds each forward by one flush interval
-            # (flusher.go:516-591).  Here at most one forward is in flight
-            # (later flushes drop theirs while one is hung — see flush()),
-            # so the deadline can be floored at the reference's default
-            # interval without unbounded pileup; sub-second test intervals
-            # would otherwise starve a cold-start peer mid-stream.
+            # (flusher.go:516-591).  Here at most FORWARD_MAX_IN_FLIGHT
+            # forwards run concurrently (later flushes drop theirs once the
+            # semaphore is exhausted — see flush()), so the deadline can be
+            # floored at the reference's default interval without unbounded
+            # pileup; sub-second test intervals would otherwise starve a
+            # cold-start peer mid-stream.
             self.forwarder = ForwardClient(
                 self.config.forward_address,
                 timeout_s=self.config.forward_timeout
@@ -455,7 +456,12 @@ class Server:
     def _read_ssf_stream(self, conn: socket.socket) -> None:
         from veneur_tpu import ssf as ssf_mod
         try:
-            conn.settimeout(self.STREAM_IDLE_TIMEOUT_S)
+            # No idle timeout here: trace clients hold one long-lived SSF
+            # stream and may go quiet for arbitrary stretches; closing an
+            # idle stream server-side makes the client's next span die on
+            # EPIPE (the statsd stream path keeps the timeout for reference
+            # parity with server.go:1283-1295, but SSF streams are
+            # reconnect-on-error, not reconnect-before-send).
             f = conn.makefile("rb")
             while not self._shutdown.is_set():
                 span = ssf_mod.read_ssf(f)
