@@ -1,0 +1,511 @@
+"""Sink tests: wire formats against local capture servers.
+
+Mirrors the reference's per-sink `_test.go` pattern (httptest.Server
+fakes: `sinks/datadog/datadog_test.go`, `sinks/cortex/cortex_test.go`,
+`sinks/splunk/splunk_test.go`, ...).
+"""
+
+import gzip
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.samplers.samplers import InterMetric
+from veneur_tpu.protocol import ssf_pb2
+from veneur_tpu.util import snappy
+
+
+# ---------------------------------------------------------------- fixtures
+
+class _CaptureHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self.server.captured.append({
+            "path": self.path,
+            "headers": dict(self.headers),
+            "body": body,
+        })
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def http_capture():
+    srv = HTTPServer(("127.0.0.1", 0), _CaptureHandler)
+    srv.captured = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def im(name="a.b.c", value=1.0, mtype="gauge", tags=(), ts=1700000000,
+       hostname="testhost"):
+    return InterMetric(name=name, timestamp=ts, value=value,
+                       tags=list(tags), type=mtype, hostname=hostname)
+
+
+def mkspan(trace_id=7, sid=8, parent=0, name="op", service="svc",
+           error=False, tags=None, start=1_700_000_000_000_000_000,
+           dur=5_000_000):
+    return ssf_pb2.SSFSpan(
+        version=0, trace_id=trace_id, id=sid, parent_id=parent,
+        start_timestamp=start, end_timestamp=start + dur, error=error,
+        service=service, name=name, tags=tags or {"k": "v"})
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_covers_reference_inventory():
+    # SURVEY.md §2.5 sink table
+    for kind in ["datadog", "signalfx", "splunk", "cortex", "kafka",
+                 "newrelic", "xray", "falconer", "lightstep", "prometheus",
+                 "cloudwatch", "s3", "localfile", "debug", "blackhole",
+                 "channel", "mock"]:
+        assert (kind in sink_mod.METRIC_SINK_TYPES
+                or kind in sink_mod.SPAN_SINK_TYPES), kind
+
+
+# ---------------------------------------------------------------- datadog
+
+def test_datadog_series_rate_conversion_and_host_tag(http_capture):
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    port = http_capture.server_address[1]
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "k", "api_hostname": f"http://127.0.0.1:{port}"}))
+    sink.interval_s = 10.0
+    res = sink.flush([
+        im("req.count", 50.0, "counter"),
+        im("mem.used", 3.5, "gauge", tags=["host:other", "device:sda"]),
+    ])
+    assert res.flushed == 2 and res.dropped == 0
+    cap = http_capture.captured[0]
+    assert cap["path"].startswith("/api/v1/series")
+    payload = json.loads(gzip.decompress(cap["body"]))
+    by_name = {s["metric"]: s for s in payload["series"]}
+    rate = by_name["req.count"]
+    assert rate["type"] == "rate"
+    assert rate["points"][0][1] == pytest.approx(5.0)  # 50 / 10s
+    assert rate["interval"] == 10
+    gauge = by_name["mem.used"]
+    assert gauge["host"] == "other" and gauge["device"] == "sda"
+    assert gauge["tags"] == []
+
+
+def test_datadog_span_sink_groups_traces(http_capture):
+    from veneur_tpu.sinks.datadog import DatadogSpanSink
+    port = http_capture.server_address[1]
+    sink = DatadogSpanSink(sink_mod.SinkSpec(kind="datadog", config={
+        "trace_api_address": f"http://127.0.0.1:{port}"}))
+    sink.ingest(mkspan(trace_id=1, sid=10))
+    sink.ingest(mkspan(trace_id=1, sid=11, parent=10))
+    sink.ingest(mkspan(trace_id=2, sid=20, error=True))
+    sink.flush()
+    payload = json.loads(gzip.decompress(http_capture.captured[0]["body"]))
+    assert len(payload) == 2  # two traces
+    lens = sorted(len(t) for t in payload)
+    assert lens == [1, 2]
+    errors = [s["error"] for t in payload for s in t]
+    assert sum(errors) == 1
+    # duration must be end-start in ns
+    assert all(s["duration"] == 5_000_000 for t in payload for s in t)
+
+
+def test_datadog_events_and_checks(http_capture):
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    from veneur_tpu.samplers import parser as pm
+    port = http_capture.server_address[1]
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "k", "api_hostname": f"http://127.0.0.1:{port}"}))
+    ev = ssf_pb2.SSFSample(
+        name="deploy", message="went fine", timestamp=1700000000,
+        tags={pm.EVENT_IDENTIFIER_KEY: "", pm.EVENT_PRIORITY_TAG: "low",
+              "env": "prod"})
+    check = ssf_pb2.SSFSample(
+        name="db.up", message="ok", status=ssf_pb2.SSFSample.OK,
+        timestamp=1700000000, tags={"env": "prod"})
+    sink.flush_other_samples([ev, check])
+    paths = sorted(c["path"] for c in http_capture.captured)
+    assert paths[0].startswith("/api/v1/check_run")
+    assert paths[1].startswith("/intake")
+    for c in http_capture.captured:
+        body = json.loads(gzip.decompress(c["body"]))
+        if c["path"].startswith("/intake"):
+            e = body["events"]["api"][0]
+            assert e["title"] == "deploy" and e["priority"] == "low"
+            assert "env:prod" in e["tags"]
+        else:
+            assert body[0]["check"] == "db.up" and body[0]["status"] == 0
+
+
+# ---------------------------------------------------------------- signalfx
+
+def test_signalfx_datapoints_and_vary_key_by(http_capture):
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+    port = http_capture.server_address[1]
+    sink = SignalFxMetricSink(sink_mod.SinkSpec(kind="signalfx", config={
+        "api_key": "default-key",
+        "endpoint_base": f"http://127.0.0.1:{port}",
+        "vary_key_by": "customer",
+        "per_tag_api_keys": {"acme": "acme-key"}}))
+    res = sink.flush([
+        im("api.hits", 5, "counter", tags=["customer:acme"]),
+        im("api.lat", 2.5, "gauge", tags=["region:us"]),
+    ])
+    assert res.flushed == 2
+    by_token = {c["headers"]["X-SF-Token"]: json.loads(c["body"])
+                for c in http_capture.captured}
+    assert set(by_token) == {"default-key", "acme-key"}
+    acme = by_token["acme-key"]["counter"][0]
+    assert acme["metric"] == "api.hits"
+    assert acme["dimensions"]["customer"] == "acme"
+    assert by_token["default-key"]["gauge"][0]["dimensions"]["region"] == "us"
+    # ms timestamps
+    assert acme["timestamp"] == 1700000000 * 1000
+
+
+# ---------------------------------------------------------------- cortex
+
+def _parse_write_request(data: bytes):
+    """Minimal prompb decoder for assertions."""
+    def uvarint(b, p):
+        r, s = 0, 0
+        while True:
+            r |= (b[p] & 0x7F) << s
+            p += 1
+            if not b[p - 1] & 0x80:
+                return r, p
+            s += 7
+
+    def fields(b):
+        p = 0
+        out = []
+        while p < len(b):
+            key, p = uvarint(b, p)
+            fnum, wt = key >> 3, key & 7
+            if wt == 2:
+                ln, p = uvarint(b, p)
+                out.append((fnum, b[p:p + ln]))
+                p += ln
+            elif wt == 0:
+                v, p = uvarint(b, p)
+                out.append((fnum, v))
+            elif wt == 1:
+                out.append((fnum, b[p:p + 8]))
+                p += 8
+        return out
+
+    import struct
+    series = []
+    for fnum, ts_bytes in fields(data):
+        assert fnum == 1
+        labels, samples = {}, []
+        for f2, v2 in fields(ts_bytes):
+            if f2 == 1:
+                lf = dict(fields(v2))
+                labels[lf[1].decode()] = lf[2].decode()
+            else:
+                sf = dict(fields(v2))
+                samples.append((struct.unpack("<d", sf[1])[0], sf[2]))
+        series.append((labels, samples))
+    return series
+
+
+def test_cortex_remote_write(http_capture):
+    from veneur_tpu.sinks.cortex import CortexMetricSink
+    port = http_capture.server_address[1]
+    sink = CortexMetricSink(sink_mod.SinkSpec(kind="cortex", config={
+        "url": f"http://127.0.0.1:{port}/api/prom/push",
+        "headers": {"X-Scope-OrgID": "t1"}}))
+    res = sink.flush([im("http.requests.count", 42.0, "counter",
+                         tags=["code:200", "bad-label!:x"])])
+    assert res.flushed == 1
+    cap = http_capture.captured[0]
+    assert cap["headers"]["Content-Encoding"] == "snappy"
+    hdrs = {k.lower(): v for k, v in cap["headers"].items()}
+    assert hdrs["x-scope-orgid"] == "t1"
+    series = _parse_write_request(snappy.decompress(cap["body"]))
+    labels, samples = series[0]
+    assert labels["__name__"] == "http_requests_count"
+    assert labels["code"] == "200"
+    assert labels["bad_label_"] == "x"
+    assert samples[0][0] == pytest.approx(42.0)
+    assert samples[0][1] == 1700000000 * 1000
+
+
+def test_cortex_labels_sorted_before_name():
+    # "Foo" must sort before "__name__" (prometheus label-order rule)
+    from veneur_tpu.sinks.cortex import encode_write_request
+    data = encode_write_request([im("m", 1.0, tags=["Foo:bar"],
+                                    hostname="")], {})
+    series = _parse_write_request(data)
+    labels = series[0][0]
+    assert list(labels) == sorted(labels)
+    assert labels["Foo"] == "bar" and labels["__name__"] == "m"
+
+
+def test_add_tags_not_suppressed_by_prefix_sibling():
+    spec = sink_mod.SinkSpec(kind="mock", add_tags={"region": "us"})
+    out, _ = sink_mod.filter_metrics_for_sink(
+        spec, False, [im(tags=["region_id:5"])])
+    assert "region:us" in out[0].tags
+    # but an existing region: tag does suppress it
+    out2, _ = sink_mod.filter_metrics_for_sink(
+        spec, False, [im(tags=["region:eu"])])
+    assert out2[0].tags.count("region:eu") == 1
+    assert "region:us" not in out2[0].tags
+
+
+def test_snappy_roundtrip_and_copy_decode():
+    data = b"abcdefgh" * 500 + b"tail"
+    assert snappy.decompress(snappy.compress(data)) == data
+    assert snappy.decompress(snappy.compress(b"")) == b""
+    # hand-built stream with a copy element: literal "abcd" + copy(off=4,len=4)
+    stream = bytes([8]) + bytes([(4 - 1) << 2]) + b"abcd" \
+        + bytes([((4 - 4) << 2) | (0 << 5) | 1, 4])
+    assert snappy.decompress(stream) == b"abcdabcd"
+
+
+# ---------------------------------------------------------------- splunk
+
+def test_splunk_hec_sampling_and_format(http_capture):
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+    port = http_capture.server_address[1]
+    sink = SplunkSpanSink(sink_mod.SinkSpec(kind="splunk", config={
+        "hec_address": f"http://127.0.0.1:{port}",
+        "hec_token": "tok", "span_sample_rate": 10}))
+    kept_err = mkspan(trace_id=3, error=True)     # 3 % 10 != 0, but error
+    kept_mod = mkspan(trace_id=20)                # 20 % 10 == 0
+    dropped = mkspan(trace_id=7)                  # sampled out
+    for s in (kept_err, kept_mod, dropped):
+        sink.ingest(s)
+    assert sink.sampled_out == 1
+    sink.flush()
+    cap = http_capture.captured[0]
+    assert cap["headers"]["Authorization"] == "Splunk tok"
+    events = [json.loads(line) for line in cap["body"].decode().split("\n")]
+    assert len(events) == 2
+    ev = events[0]["event"]
+    assert ev["error"] is True and ev["duration_ns"] == 5_000_000
+    assert events[0]["sourcetype"] == "svc"
+
+
+# ---------------------------------------------------------------- kafka
+
+def test_kafka_encoding_and_producer_injection():
+    from veneur_tpu.sinks.kafka import KafkaMetricSink, KafkaSpanSink
+    produced = []
+    sink = KafkaMetricSink(
+        sink_mod.SinkSpec(kind="kafka", config={"metric_topic": "t"}),
+        producer=lambda t, k, v: produced.append((t, k, v)))
+    res = sink.flush([im("a", 1, "counter"), im("b", 2.5, "gauge")])
+    assert res.flushed == 2
+    assert produced[0][0] == "t"
+    rec = json.loads(produced[0][2])
+    assert rec["Name"] == "a" and rec["Type"] == "counter"
+
+    spans_out = []
+    ssink = KafkaSpanSink(
+        sink_mod.SinkSpec(kind="kafka", config={}),
+        producer=lambda t, k, v: spans_out.append((t, k, v)))
+    ssink.ingest(mkspan(trace_id=5))
+    assert len(spans_out) == 1
+    decoded = ssf_pb2.SSFSpan.FromString(spans_out[0][2])
+    assert decoded.trace_id == 5
+
+    # no producer -> drop, not crash
+    nosink = KafkaMetricSink(sink_mod.SinkSpec(kind="kafka", config={}))
+    nosink.start()
+    assert nosink.flush([im()]).dropped == 1
+
+
+# ---------------------------------------------------------------- newrelic
+
+def test_newrelic_metric_and_span_payloads(http_capture):
+    from veneur_tpu.sinks.newrelic import (NewRelicMetricSink,
+                                           NewRelicSpanSink)
+    port = http_capture.server_address[1]
+    msink = NewRelicMetricSink(sink_mod.SinkSpec(kind="newrelic", config={
+        "account_insert_key": "ik",
+        "metric_url": f"http://127.0.0.1:{port}/metric/v1"}))
+    msink.interval_s = 10.0
+    assert msink.flush([im("c", 30, "counter")]).flushed == 1
+    cap = http_capture.captured[0]
+    assert cap["headers"]["Api-Key"] == "ik"
+    batch = json.loads(cap["body"])[0]
+    assert batch["metrics"][0]["type"] == "count"
+    assert batch["metrics"][0]["interval.ms"] == 10_000
+
+    ssink = NewRelicSpanSink(sink_mod.SinkSpec(kind="newrelic", config={
+        "account_insert_key": "ik",
+        "trace_url": f"http://127.0.0.1:{port}/trace/v1"}))
+    ssink.ingest(mkspan(sid=0xABC, parent=0x9))
+    ssink.flush()
+    spans = json.loads(http_capture.captured[1]["body"])[0]["spans"]
+    assert spans[0]["id"] == "abc"
+    assert spans[0]["attributes"]["parent.id"] == "9"
+    assert spans[0]["attributes"]["duration.ms"] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------- xray
+
+def test_xray_segments_over_udp():
+    from veneur_tpu.sinks.xray import XRaySpanSink
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+    sink = XRaySpanSink(sink_mod.SinkSpec(kind="xray", config={
+        "address": f"127.0.0.1:{port}",
+        "annotation_tags": ["env"]}))
+    sink.start()
+    sink.ingest(mkspan(tags={"env": "prod", "extra": "stuff"},
+                       parent=55))
+    data, _ = recv.recvfrom(65536)
+    recv.close()
+    header, seg_json = data.split(b"\n", 1)
+    assert json.loads(header) == {"format": "json", "version": 1}
+    seg = json.loads(seg_json)
+    assert seg["trace_id"].startswith("1-")
+    assert len(seg["trace_id"].split("-")[2]) == 24
+    assert seg["annotations"] == {"env": "prod"}
+    assert seg["metadata"] == {"extra": "stuff"}
+    assert seg["type"] == "subsegment" and seg["parent_id"].endswith("37")
+
+
+# ---------------------------------------------------------------- falconer
+
+def test_falconer_grpc_send():
+    import grpc
+    from concurrent import futures
+    from google.protobuf import empty_pb2
+    from veneur_tpu.sinks.falconer import FalconerSpanSink, SEND_SPAN
+
+    received = []
+
+    def handler(request, context):
+        received.append(request)
+        return empty_pb2.Empty()
+
+    method = SEND_SPAN.strip("/").split("/")
+    rpc = grpc.unary_unary_rpc_method_handler(
+        handler, request_deserializer=ssf_pb2.SSFSpan.FromString,
+        response_serializer=empty_pb2.Empty.SerializeToString)
+    generic = grpc.method_handlers_generic_handler(
+        method[0], {method[1]: rpc})
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    server.add_generic_rpc_handlers((generic,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        sink = FalconerSpanSink(sink_mod.SinkSpec(
+            kind="falconer", config={"target": f"127.0.0.1:{port}"}))
+        sink.start()
+        sink.ingest(mkspan(trace_id=99))
+        assert sink.sent == 1 and sink.errors == 0
+        assert received[0].trace_id == 99
+    finally:
+        server.stop(0)
+
+
+# ---------------------------------------------------------------- lightstep
+
+def test_lightstep_report(http_capture):
+    from veneur_tpu.sinks.lightstep import LightStepSpanSink
+    port = http_capture.server_address[1]
+    sink = LightStepSpanSink(sink_mod.SinkSpec(kind="lightstep", config={
+        "access_token": "at",
+        "collector_host": f"http://127.0.0.1:{port}",
+        "num_clients": 2}))
+    sink.ingest(mkspan(trace_id=2, sid=1))   # client 0
+    sink.ingest(mkspan(trace_id=3, sid=2))   # client 1
+    sink.flush()
+    assert len(http_capture.captured) == 2
+    body = json.loads(http_capture.captured[0]["body"])
+    assert body["auth"]["access_token"] == "at"
+    rec = body["span_records"][0]
+    assert rec["youngest_micros"] - rec["oldest_micros"] == 5_000
+
+
+# ---------------------------------------------------------------- aws
+
+def test_cloudwatch_datum_and_batching():
+    from veneur_tpu.sinks.cloudwatch import CloudWatchMetricSink
+    calls = []
+    sink = CloudWatchMetricSink(
+        sink_mod.SinkSpec(kind="cloudwatch", config={
+            "cloudwatch_namespace": "ns",
+            "cloudwatch_standard_unit_tag_name": "unit"}),
+        put_metric_data=lambda ns, data: calls.append((ns, data)))
+    sink.interval_s = 10.0
+    res = sink.flush([
+        im("lat", 5.0, "gauge", tags=["unit:Milliseconds", "az:a"]),
+        im("hits", 100.0, "counter"),
+    ])
+    assert res.flushed == 2
+    ns, data = calls[0]
+    assert ns == "ns"
+    assert data[0]["Unit"] == "Milliseconds"
+    assert data[0]["Dimensions"] == [{"Name": "az", "Value": "a"}]
+    assert data[1]["Value"] == pytest.approx(10.0)  # 100/10s
+    assert data[1]["Unit"] == "Count/Second"
+
+
+def test_s3_tsv_object():
+    from veneur_tpu.sinks.s3 import S3MetricSink
+    puts = []
+    sink = S3MetricSink(
+        sink_mod.SinkSpec(kind="s3", config={
+            "aws_s3_bucket": "b", "compress": True}),
+        put_object=lambda b, k, body: puts.append((b, k, body)))
+    sink.hostname = "h1"
+    sink.interval_s = 10.0
+    assert sink.flush([im("x", 20.0, "counter")]).flushed == 1
+    bucket, key, body = puts[0]
+    assert bucket == "b" and key.startswith("veneur/h1/")
+    assert key.endswith(".tsv.gz")
+    row = gzip.decompress(body).decode().strip().split("\t")
+    assert row[0] == "x" and float(row[5]) == pytest.approx(2.0)  # rate
+
+
+# ---------------------------------------------------------------- misc
+
+def test_prometheus_repeater_udp():
+    from veneur_tpu.sinks.prometheus import PrometheusMetricSink
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+    sink = PrometheusMetricSink(sink_mod.SinkSpec(
+        kind="prometheus",
+        config={"repeater_address": f"udp://127.0.0.1:{port}"}))
+    assert sink.flush([im("a.b", 1.5, "gauge", tags=["x:y"]),
+                       im("c", 2, "counter")]).flushed == 2
+    data, _ = recv.recvfrom(65536)
+    recv.close()
+    lines = data.decode().strip().split("\n")
+    assert lines[0] == "a.b:1.5|g|#x:y"
+    assert lines[1] == "c:2|c"
+
+
+def test_mock_sinks_record():
+    from veneur_tpu.sinks.mock import MockMetricSink, MockSpanSink
+    ms = MockMetricSink()
+    ms.start()
+    ms.flush([im()])
+    assert ms.started and len(ms.metrics) == 1
+    ss = MockSpanSink()
+    ss.ingest(mkspan())
+    ss.flush()
+    assert len(ss.spans) == 1 and ss.flush_count == 1

@@ -133,6 +133,16 @@ class Config:
     veneur_metrics_scopes: dict[str, str] = field(default_factory=dict)
     veneur_metrics_additional_tags: list[str] = field(default_factory=list)
 
+    def __post_init__(self) -> None:
+        # accept plain dicts for sink specs so Config can be constructed
+        # directly with the same shapes the YAML loader accepts
+        self.metric_sinks = [
+            s if isinstance(s, sink_mod.SinkSpec)
+            else sink_mod.SinkSpec.from_dict(s) for s in self.metric_sinks]
+        self.span_sinks = [
+            s if isinstance(s, sink_mod.SinkSpec)
+            else sink_mod.SinkSpec.from_dict(s) for s in self.span_sinks]
+
     def apply_defaults(self) -> None:
         """config.go:114-134."""
         if not self.aggregates:

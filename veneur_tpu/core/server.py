@@ -122,6 +122,10 @@ class Server:
         self._forward_slots = threading.BoundedSemaphore(
             self.FORWARD_MAX_IN_FLIGHT)
         self.forward_dropped = 0
+        # accepted stream connections, closed on shutdown so reader
+        # threads blocked in recv are unblocked
+        self._stream_conns: set = set()
+        self._stream_conns_lock = threading.Lock()
         # resolved addresses (after binding port 0)
         self.statsd_addrs: list[tuple[str, object]] = []
         self.ssf_addrs: list[tuple[str, object]] = []
@@ -313,9 +317,19 @@ class Server:
     STREAM_IDLE_TIMEOUT_S = 600.0
     FORWARD_MAX_IN_FLIGHT = 4
 
+    def _track_conn(self, conn) -> None:
+        with self._stream_conns_lock:
+            self._stream_conns.add(conn)
+
+    def _untrack_conn(self, conn) -> None:
+        with self._stream_conns_lock:
+            self._stream_conns.discard(conn)
+
     def _read_stream(self, conn: socket.socket,
                      ctx: Optional[ssl.SSLContext]) -> None:
         max_line = max(65536, self.config.metric_max_length)
+        raw_conn = conn
+        self._track_conn(raw_conn)
         try:
             conn.settimeout(self.STREAM_IDLE_TIMEOUT_S)
             if ctx is not None:
@@ -341,6 +355,7 @@ class Server:
         except (ssl.SSLError, OSError, TimeoutError) as e:
             logger.debug("stream connection error: %s", e)
         finally:
+            self._untrack_conn(raw_conn)
             try:
                 conn.close()
             except OSError:
@@ -455,6 +470,7 @@ class Server:
 
     def _read_ssf_stream(self, conn: socket.socket) -> None:
         from veneur_tpu import ssf as ssf_mod
+        self._track_conn(conn)
         try:
             # No idle timeout here: trace clients hold one long-lived SSF
             # stream and may go quiet for arbitrary stretches; closing an
@@ -474,6 +490,7 @@ class Server:
         except OSError:
             pass
         finally:
+            self._untrack_conn(conn)
             try:
                 conn.close()
             except OSError:
@@ -589,6 +606,19 @@ class Server:
         for sock in self._listeners:
             try:
                 sock.close()
+            except OSError:
+                pass
+        # unblock reader threads parked in recv on accepted streams
+        with self._stream_conns_lock:
+            conns = list(self._stream_conns)
+            self._stream_conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
             except OSError:
                 pass
         if self.grpc_import is not None:

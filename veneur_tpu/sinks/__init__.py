@@ -199,7 +199,8 @@ def filter_metrics_for_sink(spec: SinkSpec, routing_enabled: bool,
                     counts["max_tag_length"] += 1
                     dropped = True
                     break
-                if not any(ft.startswith(k) for ft in tags):
+                if not any(ft == k or ft.startswith(k + ":")
+                           for ft in tags):
                     tags.append(tag)
             if dropped:
                 continue
@@ -213,6 +214,19 @@ def filter_metrics_for_sink(spec: SinkSpec, routing_enabled: bool,
     return out, counts
 
 
-# Register built-in sinks (import at bottom: simple.py decorates with the
-# registries defined above).
+# Register built-in sinks (imports at bottom: each module decorates with
+# the registries defined above).
 from veneur_tpu.sinks import simple as _simple  # noqa: E402,F401
+from veneur_tpu.sinks import cloudwatch as _cloudwatch  # noqa: E402,F401
+from veneur_tpu.sinks import cortex as _cortex  # noqa: E402,F401
+from veneur_tpu.sinks import datadog as _datadog  # noqa: E402,F401
+from veneur_tpu.sinks import falconer as _falconer  # noqa: E402,F401
+from veneur_tpu.sinks import kafka as _kafka  # noqa: E402,F401
+from veneur_tpu.sinks import lightstep as _lightstep  # noqa: E402,F401
+from veneur_tpu.sinks import mock as _mock  # noqa: E402,F401
+from veneur_tpu.sinks import newrelic as _newrelic  # noqa: E402,F401
+from veneur_tpu.sinks import prometheus as _prometheus  # noqa: E402,F401
+from veneur_tpu.sinks import s3 as _s3  # noqa: E402,F401
+from veneur_tpu.sinks import signalfx as _signalfx  # noqa: E402,F401
+from veneur_tpu.sinks import splunk as _splunk  # noqa: E402,F401
+from veneur_tpu.sinks import xray as _xray  # noqa: E402,F401

@@ -1,0 +1,107 @@
+"""AWS CloudWatch sink: PutMetricData submission.
+
+Capability twin of `sinks/cloudwatch/cloudwatch.go`
+(`cloudwatch.go:37,131`): metrics become `MetricDatum` entries (tags as
+dimensions, counters normalized to rate per the standard-unit mapping) in
+a configured namespace, batched at the API limit.
+
+AWS SDK auth is not available in this image, so the uploader is an
+injection point: any callable `put_metric_data(namespace, metric_data)`
+works (boto3's `client("cloudwatch").put_metric_data` has exactly this
+shape via kwargs; tests inject a recorder).  The datum construction — the
+testable contract — is independent of transport.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from veneur_tpu import sinks as sink_mod
+
+logger = logging.getLogger("veneur_tpu.sinks.cloudwatch")
+
+MAX_DATA_PER_CALL = 1000  # PutMetricData API limit
+MAX_DIMENSIONS = 30
+
+
+def metric_datum(m, interval_s: float, standard_unit_tag: str = "") -> dict:
+    dims = []
+    unit = "None"
+    for t in m.tags:
+        k, v = (t.split(":", 1) + [""])[:2]
+        if standard_unit_tag and k == standard_unit_tag:
+            unit = v or "None"
+            continue
+        if len(dims) < MAX_DIMENSIONS:
+            dims.append({"Name": k, "Value": v or "none"})
+    value = m.value
+    if m.type == "counter" and interval_s > 0:
+        value = m.value / interval_s
+        if unit == "None":
+            unit = "Count/Second"
+    return {
+        "MetricName": m.name,
+        "Dimensions": dims,
+        "Timestamp": int(m.timestamp),
+        "Value": value,
+        "Unit": unit,
+    }
+
+
+class CloudWatchMetricSink(sink_mod.BaseMetricSink):
+    KIND = "cloudwatch"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None,
+                 put_metric_data: Optional[Callable] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.namespace = cfg.get("cloudwatch_namespace", "veneur")
+        self.standard_unit_tag = cfg.get(
+            "cloudwatch_standard_unit_tag_name", "")
+        self.interval_s = float(
+            getattr(server_config, "interval", 10.0) or 10.0)
+        self.put_metric_data = put_metric_data
+        self._warned = False
+
+    def start(self, trace_client=None) -> None:
+        if self.put_metric_data is None:
+            try:
+                import boto3  # gated: not in this image by default
+                region = self.config.get("aws_region") or None
+                client = boto3.client("cloudwatch", region_name=region)
+
+                def put(namespace, metric_data):
+                    client.put_metric_data(Namespace=namespace,
+                                           MetricData=metric_data)
+                self.put_metric_data = put
+            except ImportError:
+                if not self._warned:
+                    logger.warning(
+                        "cloudwatch sink %s: boto3 unavailable and no "
+                        "uploader injected; metrics will be dropped",
+                        self._name)
+                    self._warned = True
+
+    def flush(self, metrics):
+        if not metrics:
+            return sink_mod.MetricFlushResult()
+        if self.put_metric_data is None:
+            return sink_mod.MetricFlushResult(dropped=len(metrics))
+        data = [metric_datum(m, self.interval_s, self.standard_unit_tag)
+                for m in metrics]
+        flushed = dropped = 0
+        for i in range(0, len(data), MAX_DATA_PER_CALL):
+            chunk = data[i:i + MAX_DATA_PER_CALL]
+            try:
+                self.put_metric_data(self.namespace, chunk)
+                flushed += len(chunk)
+            except Exception as e:
+                logger.warning("cloudwatch PutMetricData failed: %s", e)
+                dropped += len(chunk)
+        return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
+
+
+sink_mod.register_metric_sink("cloudwatch")(CloudWatchMetricSink)

@@ -1,0 +1,231 @@
+"""Datadog sink: metric series, events, service checks, and APM traces.
+
+Capability twin of `sinks/datadog/datadog.go`:
+  * metrics  -> JSON POST `{"series": [...]}` to `/api/v1/series`
+    (`datadog.go:158` flush path), counters emitted as `rate` divided by
+    the flush interval, `host:`/`device:` tags hoisted into fields,
+    batched by `flush_max_per_body` (`datadog.go:48`).
+  * events   -> `/intake` payload keyed by aggregation key
+    (`FlushOtherSamples`, `datadog.go:451`), service checks ->
+    `/api/v1/check_run`.
+  * spans    -> trace-agent JSON (`/v0.3/traces`): spans grouped into
+    traces, ns timestamps, `error` flag, tags as `meta`.
+
+Transport is `requests` with gzip bodies, mirroring the reference's
+`util.PostHelper` vendored HTTP path.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+import requests
+
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.samplers import parser as parser_mod
+from veneur_tpu.samplers.samplers import InterMetric
+
+logger = logging.getLogger("veneur_tpu.sinks.datadog")
+
+DEFAULT_FLUSH_MAX_PER_BODY = 25_000
+DEFAULT_SPAN_BUFFER = 16_384
+
+
+def _post_json(session: requests.Session, url: str, payload,
+               timeout: float = 10.0, headers: Optional[dict] = None) -> bool:
+    body = gzip.compress(json.dumps(payload).encode())
+    hdrs = {"Content-Type": "application/json",
+            "Content-Encoding": "gzip"}
+    if headers:
+        hdrs.update(headers)
+    try:
+        resp = session.post(url, data=body, headers=hdrs, timeout=timeout)
+        if resp.status_code >= 400:
+            logger.warning("datadog POST %s -> %d: %.200s", url,
+                           resp.status_code, resp.text)
+            return False
+        return True
+    except requests.RequestException as e:
+        logger.warning("datadog POST %s failed: %s", url, e)
+        return False
+
+
+def series_payload(metrics: list[InterMetric], hostname: str,
+                   interval_s: float, tags: list[str]) -> dict:
+    """Build the `/api/v1/series` body (datadog.go flush conversion)."""
+    series = []
+    for m in metrics:
+        host = hostname or m.hostname
+        device = ""
+        mtags = []
+        for t in list(m.tags) + list(tags):
+            if t.startswith("host:"):
+                host = t[len("host:"):]
+            elif t.startswith("device:"):
+                device = t[len("device:"):]
+            else:
+                mtags.append(t)
+        value = m.value
+        mtype = "gauge"
+        entry = {
+            "metric": m.name,
+            "points": [[m.timestamp, value]],
+            "tags": mtags,
+            "host": host,
+        }
+        if m.type == "counter" and interval_s > 0:
+            mtype = "rate"
+            entry["points"] = [[m.timestamp, value / interval_s]]
+            entry["interval"] = int(interval_s) or 1
+        entry["type"] = mtype
+        if device:
+            entry["device"] = device
+        series.append(entry)
+    return {"series": series}
+
+
+class DatadogMetricSink(sink_mod.BaseMetricSink):
+    KIND = "datadog"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, session: Optional[requests.Session] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.api_key = cfg.get("api_key", "")
+        self.api_url = cfg.get("api_hostname",
+                               "https://app.datadoghq.com").rstrip("/")
+        self.flush_max_per_body = int(
+            cfg.get("flush_max_per_body", DEFAULT_FLUSH_MAX_PER_BODY))
+        self.hostname = getattr(server_config, "hostname", "") or ""
+        self.interval_s = float(
+            getattr(server_config, "interval", 10.0) or 10.0)
+        self.extra_tags = list(cfg.get("tags", []))
+        self.session = session or requests.Session()
+
+    def flush(self, metrics):
+        if not metrics:
+            return sink_mod.MetricFlushResult()
+        flushed = dropped = 0
+        # key rides the DD-API-KEY header, never the (logged) URL
+        url = f"{self.api_url}/api/v1/series"
+        auth = {"DD-API-KEY": self.api_key}
+        for i in range(0, len(metrics), self.flush_max_per_body):
+            chunk = metrics[i:i + self.flush_max_per_body]
+            payload = series_payload(chunk, self.hostname, self.interval_s,
+                                     self.extra_tags)
+            if _post_json(self.session, url, payload, headers=auth):
+                flushed += len(chunk)
+            else:
+                dropped += len(chunk)
+        return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
+
+    def flush_other_samples(self, samples):
+        """Events + service checks (datadog.go:451 FlushOtherSamples)."""
+        events, checks = [], []
+        for s in samples:
+            tags = dict(s.tags) if s.tags else {}
+            if parser_mod.EVENT_IDENTIFIER_KEY in tags:
+                tags.pop(parser_mod.EVENT_IDENTIFIER_KEY, None)
+                ev = {
+                    "title": s.name,
+                    "text": s.message,
+                    "date_happened": s.timestamp or int(time.time()),
+                }
+                for tag_key, field in (
+                        (parser_mod.EVENT_AGGREGATION_KEY_TAG,
+                         "aggregation_key"),
+                        (parser_mod.EVENT_PRIORITY_TAG, "priority"),
+                        (parser_mod.EVENT_SOURCE_TYPE_TAG, "source_type_name"),
+                        (parser_mod.EVENT_ALERT_TYPE_TAG, "alert_type"),
+                        (parser_mod.EVENT_HOSTNAME_TAG, "host")):
+                    if tag_key in tags:
+                        ev[field] = tags.pop(tag_key)
+                ev["tags"] = [f"{k}:{v}" for k, v in sorted(tags.items())] \
+                    + self.extra_tags
+                events.append(ev)
+            else:
+                checks.append({
+                    "check": s.name,
+                    "status": int(s.status),
+                    "timestamp": s.timestamp or int(time.time()),
+                    "message": s.message,
+                    "host_name": tags.pop("host", self.hostname),
+                    "tags": [f"{k}:{v}" for k, v in sorted(tags.items())]
+                    + self.extra_tags,
+                })
+        auth = {"DD-API-KEY": self.api_key}
+        if events:
+            _post_json(self.session, f"{self.api_url}/intake",
+                       {"events": {"api": events}}, headers=auth)
+        if checks:
+            _post_json(self.session, f"{self.api_url}/api/v1/check_run",
+                       checks, headers=auth)
+
+
+def span_to_dd(span, tags: dict[str, str]) -> dict:
+    """SSFSpan -> trace-agent span dict (datadog.go span conversion)."""
+    meta = dict(tags)
+    meta.update(span.tags)
+    return {
+        "trace_id": span.trace_id,
+        "span_id": span.id,
+        "parent_id": span.parent_id,
+        "start": span.start_timestamp,
+        "duration": span.end_timestamp - span.start_timestamp,
+        "name": span.name,
+        "resource": span.tags.get("resource", span.name),
+        "service": span.service,
+        "type": "web",
+        "error": 1 if span.error else 0,
+        "meta": meta,
+    }
+
+
+class DatadogSpanSink(sink_mod.BaseSpanSink):
+    KIND = "datadog"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, session: Optional[requests.Session] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.trace_addr = cfg.get(
+            "trace_api_address", "http://127.0.0.1:8126").rstrip("/")
+        self.buffer_size = int(cfg.get("span_buffer_size",
+                                       DEFAULT_SPAN_BUFFER))
+        self.extra_tags = {
+            t.split(":", 1)[0]: t.split(":", 1)[1] if ":" in t else ""
+            for t in cfg.get("tags", [])}
+        self.session = session or requests.Session()
+        self._lock = threading.Lock()
+        self._buffer: list = []
+        self.dropped = 0
+
+    def ingest(self, span) -> None:
+        with self._lock:
+            if len(self._buffer) >= self.buffer_size:
+                self.dropped += 1
+                return
+            self._buffer.append(span)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self._buffer = self._buffer, []
+        if not spans:
+            return
+        traces: dict[int, list] = {}
+        for s in spans:
+            traces.setdefault(s.trace_id, []).append(
+                span_to_dd(s, self.extra_tags))
+        _post_json(self.session, f"{self.trace_addr}/v0.3/traces",
+                   list(traces.values()))
+
+
+sink_mod.register_metric_sink("datadog")(DatadogMetricSink)
+sink_mod.register_span_sink("datadog")(DatadogSpanSink)

@@ -1,0 +1,60 @@
+"""Falconer span sink: gRPC submission to a falconer span store.
+
+Capability twin of `sinks/falconer/falconer.go` (`falconer.go:31`): each
+span is sent over a persistent gRPC channel via the falconer
+`SendSpan(SSFSpan)` unary method.  Like the forward client, the method is
+invoked through its explicit path + serializer (wire-identical to
+generated stubs).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.protocol import ssf_pb2
+
+logger = logging.getLogger("veneur_tpu.sinks.falconer")
+
+SEND_SPAN = "/falconer.Falconer/SendSpan"
+
+
+class FalconerSpanSink(sink_mod.BaseSpanSink):
+    KIND = "falconer"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, channel=None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        self.target = self.config.get("target", "")
+        self._channel = channel
+        self._send = None
+        self.sent = 0
+        self.errors = 0
+
+    def start(self, trace_client=None) -> None:
+        import grpc
+        from google.protobuf import empty_pb2
+        if self._channel is None:
+            if not self.target:
+                logger.warning("falconer sink has no target configured")
+                return
+            self._channel = grpc.insecure_channel(self.target)
+        self._send = self._channel.unary_unary(
+            SEND_SPAN,
+            request_serializer=ssf_pb2.SSFSpan.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+    def ingest(self, span) -> None:
+        if self._send is None:
+            return
+        try:
+            self._send(span, timeout=5.0)
+            self.sent += 1
+        except Exception as e:
+            self.errors += 1
+            logger.debug("falconer send failed: %s", e)
+
+
+sink_mod.register_span_sink("falconer")(FalconerSpanSink)

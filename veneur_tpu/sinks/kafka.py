@@ -1,0 +1,140 @@
+"""Kafka sink: metric and span production with pluggable transport.
+
+Capability twin of `sinks/kafka/kafka.go` (`kafka.go:48,74`): metrics and
+spans are encoded (protobuf or JSON, per config) and produced to
+configurable topics, keyed for partition affinity.  The reference uses the
+sarama async producer; this image ships no Kafka client, so the producer
+is an injection point: any callable `produce(topic, key, value)` works
+(tests inject a recorder; production deployments plug confluent-kafka or
+kafka-python).  Without an injected producer the sink encodes and counts
+but drops, logging once — the encoding layer (the testable contract) is
+identical either way.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Optional
+
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.protocol import metric_pb2
+
+logger = logging.getLogger("veneur_tpu.sinks.kafka")
+
+Producer = Callable[[str, bytes, bytes], None]  # (topic, key, value)
+
+
+def metric_to_json(m, interval_s: float) -> bytes:
+    return json.dumps({
+        "Name": m.name,
+        "Timestamp": m.timestamp,
+        "Value": m.value,
+        "Tags": list(m.tags),
+        "Type": m.type,
+        "Message": m.message,
+        "HostName": m.hostname,
+    }).encode()
+
+
+def metric_to_proto(m) -> bytes:
+    pb = metric_pb2.Metric(name=m.name, tags=list(m.tags))
+    if m.type == "counter":
+        pb.type = metric_pb2.Type.Counter
+        pb.counter.value = int(m.value)
+    else:
+        pb.type = metric_pb2.Type.Gauge
+        pb.gauge.value = float(m.value)
+    return pb.SerializeToString()
+
+
+class KafkaMetricSink(sink_mod.BaseMetricSink):
+    KIND = "kafka"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, producer: Optional[Producer] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.topic = cfg.get("metric_topic", "veneur-metrics")
+        self.serializer = cfg.get("metric_serializer", "json")  # json|proto
+        self.interval_s = float(
+            getattr(server_config, "interval", 10.0) or 10.0)
+        self.producer = producer
+        self._warned = False
+
+    def start(self, trace_client=None) -> None:
+        if self.producer is None and not self._warned:
+            logger.warning(
+                "kafka sink %s has no producer injected; metrics will be "
+                "encoded then dropped", self._name)
+            self._warned = True
+
+    def flush(self, metrics):
+        if not metrics:
+            return sink_mod.MetricFlushResult()
+        flushed = dropped = 0
+        for m in metrics:
+            key = f"{m.name}{m.type}".encode()
+            value = (metric_to_proto(m) if self.serializer == "protobuf"
+                     else metric_to_json(m, self.interval_s))
+            if self.producer is None:
+                dropped += 1
+                continue
+            try:
+                self.producer(self.topic, key, value)
+                flushed += 1
+            except Exception as e:
+                logger.warning("kafka produce failed: %s", e)
+                dropped += 1
+        return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
+
+
+class KafkaSpanSink(sink_mod.BaseSpanSink):
+    KIND = "kafka"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, producer: Optional[Producer] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.topic = cfg.get("span_topic", "veneur-spans")
+        self.serializer = cfg.get("span_serializer", "protobuf")
+        # span_sample_rate_percent: 0-100 (kafka.go sampling knob)
+        self.sample_pct = float(cfg.get("span_sample_rate_percent", 100))
+        self.sample_tag = cfg.get("span_sample_tag", "")
+        self.producer = producer
+        self.sampled_out = 0
+        self.dropped = 0
+
+    def ingest(self, span) -> None:
+        if self.sample_pct < 100:
+            basis = (span.tags.get(self.sample_tag, "").encode()
+                     if self.sample_tag else
+                     span.trace_id.to_bytes(8, "big", signed=True))
+            import zlib
+            if (zlib.crc32(basis) % 100) >= self.sample_pct:
+                self.sampled_out += 1
+                return
+        if self.producer is None:
+            self.dropped += 1
+            return
+        value = (span.SerializeToString() if self.serializer == "protobuf"
+                 else json.dumps({
+                     "trace_id": span.trace_id, "id": span.id,
+                     "parent_id": span.parent_id, "name": span.name,
+                     "service": span.service, "error": bool(span.error),
+                     "start_timestamp": span.start_timestamp,
+                     "end_timestamp": span.end_timestamp,
+                     "tags": dict(span.tags)}).encode())
+        try:
+            self.producer(self.topic,
+                          span.trace_id.to_bytes(8, "big", signed=True),
+                          value)
+        except Exception as e:
+            logger.warning("kafka span produce failed: %s", e)
+            self.dropped += 1
+
+
+sink_mod.register_metric_sink("kafka")(KafkaMetricSink)
+sink_mod.register_span_sink("kafka")(KafkaSpanSink)

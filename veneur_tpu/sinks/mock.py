@@ -1,0 +1,65 @@
+"""Recording sink doubles for tests (capability twin of `sinks/mock/`).
+
+Unlike the gomock-generated doubles in the reference, these are plain
+recorders: they capture every call so tests assert on exact payloads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from veneur_tpu import sinks as sink_mod
+
+
+class MockMetricSink(sink_mod.BaseMetricSink):
+    KIND = "mock"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, fail: bool = False):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        self.started = False
+        self.fail = fail
+        self.flushes: list[list] = []
+        self.other_samples: list = []
+
+    def start(self, trace_client=None) -> None:
+        self.started = True
+
+    def flush(self, metrics):
+        if self.fail:
+            return sink_mod.MetricFlushResult(dropped=len(metrics))
+        self.flushes.append(list(metrics))
+        return sink_mod.MetricFlushResult(flushed=len(metrics))
+
+    def flush_other_samples(self, samples):
+        self.other_samples.extend(samples)
+
+    @property
+    def metrics(self) -> list:
+        return [m for fl in self.flushes for m in fl]
+
+
+class MockSpanSink(sink_mod.BaseSpanSink):
+    KIND = "mock"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        self.started = False
+        self.spans: list = []
+        self.flush_count = 0
+
+    def start(self, trace_client=None) -> None:
+        self.started = True
+
+    def ingest(self, span) -> None:
+        self.spans.append(span)
+
+    def flush(self) -> None:
+        self.flush_count += 1
+
+
+sink_mod.register_metric_sink("mock")(MockMetricSink)
+sink_mod.register_span_sink("mock")(MockSpanSink)

@@ -1,0 +1,163 @@
+"""New Relic sink: metrics and spans via the public telemetry HTTP APIs.
+
+Capability twin of `sinks/newrelic/newrelic.go` (which wraps the NR
+telemetry SDK): metrics POST to the Metric API
+(`https://metric-api.newrelic.com/metric/v1`) as
+`[{"common": {...}, "metrics": [...]}]`; spans POST to the Trace API
+(`https://trace-api.newrelic.com/trace/v1`).  Counters are emitted as NR
+`count` with `interval.ms`, everything else as `gauge` — the same mapping
+the SDK performs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Optional
+
+import requests
+
+from veneur_tpu import sinks as sink_mod
+
+logger = logging.getLogger("veneur_tpu.sinks.newrelic")
+
+
+def _tags_to_attrs(tags) -> dict:
+    attrs = {}
+    for t in tags:
+        if ":" in t:
+            k, v = t.split(":", 1)
+        else:
+            k, v = t, "true"
+        attrs[k] = v
+    return attrs
+
+
+def metrics_payload(metrics, interval_s: float, common_attrs: dict) -> list:
+    out = []
+    for m in metrics:
+        attrs = _tags_to_attrs(m.tags)
+        if m.hostname:
+            attrs.setdefault("host", m.hostname)
+        entry = {
+            "name": m.name,
+            "value": m.value,
+            "timestamp": int(m.timestamp) * 1000,
+            "attributes": attrs,
+        }
+        if m.type == "counter":
+            entry["type"] = "count"
+            entry["interval.ms"] = int(interval_s * 1000)
+        else:
+            entry["type"] = "gauge"
+        out.append(entry)
+    return [{"common": {"attributes": common_attrs}, "metrics": out}]
+
+
+class NewRelicMetricSink(sink_mod.BaseMetricSink):
+    KIND = "newrelic"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, session: Optional[requests.Session] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.insert_key = cfg.get("account_insert_key", "")
+        self.metric_url = cfg.get(
+            "metric_url", "https://metric-api.newrelic.com/metric/v1")
+        self.common_attrs = _tags_to_attrs(cfg.get("tags", []))
+        if cfg.get("service_check_event_type"):
+            self.common_attrs["eventType"] = cfg["service_check_event_type"]
+        self.interval_s = float(
+            getattr(server_config, "interval", 10.0) or 10.0)
+        self.session = session or requests.Session()
+
+    def flush(self, metrics):
+        if not metrics:
+            return sink_mod.MetricFlushResult()
+        payload = metrics_payload(metrics, self.interval_s,
+                                  self.common_attrs)
+        try:
+            resp = self.session.post(
+                self.metric_url, data=json.dumps(payload),
+                headers={"Content-Type": "application/json",
+                         "Api-Key": self.insert_key},
+                timeout=10.0)
+            if resp.status_code >= 400:
+                logger.warning("newrelic metric POST -> %d: %.200s",
+                               resp.status_code, resp.text)
+                return sink_mod.MetricFlushResult(dropped=len(metrics))
+        except requests.RequestException as e:
+            logger.warning("newrelic metric POST failed: %s", e)
+            return sink_mod.MetricFlushResult(dropped=len(metrics))
+        return sink_mod.MetricFlushResult(flushed=len(metrics))
+
+
+def span_payload(spans, common_attrs: dict) -> list:
+    out = []
+    for s in spans:
+        attrs = dict(s.tags)
+        attrs["duration.ms"] = (s.end_timestamp - s.start_timestamp) / 1e6
+        attrs["name"] = s.name
+        attrs["service.name"] = s.service
+        attrs["error"] = bool(s.error)
+        if s.parent_id:
+            attrs["parent.id"] = format(s.parent_id & (2**64 - 1), "x")
+        out.append({
+            "id": format(s.id & (2**64 - 1), "x"),
+            "trace.id": format(s.trace_id & (2**64 - 1), "x"),
+            "timestamp": s.start_timestamp // 1_000_000,  # ms
+            "attributes": attrs,
+        })
+    return [{"common": {"attributes": common_attrs}, "spans": out}]
+
+
+class NewRelicSpanSink(sink_mod.BaseSpanSink):
+    KIND = "newrelic"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, session: Optional[requests.Session] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        self.insert_key = cfg.get("account_insert_key", "")
+        self.trace_url = cfg.get(
+            "trace_url", "https://trace-api.newrelic.com/trace/v1")
+        self.common_attrs = _tags_to_attrs(cfg.get("tags", []))
+        self.buffer_size = int(cfg.get("buffer_size", 16_384))
+        self.session = session or requests.Session()
+        self._lock = threading.Lock()
+        self._buffer: list = []
+        self.dropped = 0
+
+    def ingest(self, span) -> None:
+        with self._lock:
+            if len(self._buffer) >= self.buffer_size:
+                self.dropped += 1
+                return
+            self._buffer.append(span)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans, self._buffer = self._buffer, []
+        if not spans:
+            return
+        try:
+            resp = self.session.post(
+                self.trace_url,
+                data=json.dumps(span_payload(spans, self.common_attrs)),
+                headers={"Content-Type": "application/json",
+                         "Api-Key": self.insert_key,
+                         "Data-Format": "newrelic",
+                         "Data-Format-Version": "1"},
+                timeout=10.0)
+            if resp.status_code >= 400:
+                logger.warning("newrelic trace POST -> %d: %.200s",
+                               resp.status_code, resp.text)
+        except requests.RequestException as e:
+            logger.warning("newrelic trace POST failed: %s", e)
+
+
+sink_mod.register_metric_sink("newrelic")(NewRelicMetricSink)
+sink_mod.register_span_sink("newrelic")(NewRelicSpanSink)

@@ -1,0 +1,98 @@
+"""AWS X-Ray span sink: UDP daemon-protocol segment emission.
+
+Capability twin of `sinks/xray/xray.go` (`xray.go:77,279`): each sampled
+span becomes one X-Ray segment JSON document sent as a UDP datagram to the
+local X-Ray daemon, prefixed with the daemon header
+`{"format": "json", "version": 1}\n`.  Trace IDs use the X-Ray format
+`1-<8 hex epoch seconds>-<24 hex>` derived deterministically from the SSF
+trace id so all spans of a trace land in one X-Ray trace; sampling is
+percentage-based on the trace id (sampled traces keep all their spans).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import zlib
+from typing import Optional
+
+from veneur_tpu import sinks as sink_mod
+
+logger = logging.getLogger("veneur_tpu.sinks.xray")
+
+HEADER = b'{"format": "json", "version": 1}\n'
+# keys whose tags become annotations only when listed (xray.go annotation
+# allow-list behavior); everything else lands in metadata.
+
+
+def xray_trace_id(span) -> str:
+    epoch = span.start_timestamp // 1_000_000_000
+    rand96 = span.trace_id & ((1 << 96) - 1)
+    return f"1-{epoch & 0xFFFFFFFF:08x}-{rand96:024x}"
+
+
+def segment(span, annotation_tags: set[str]) -> dict:
+    annotations = {}
+    metadata = {}
+    for k, v in span.tags.items():
+        # allow-list only: X-Ray indexes (and caps at 50) annotation keys,
+        # so unlisted tags go to metadata
+        if k in annotation_tags:
+            annotations[k] = v
+        else:
+            metadata[k] = v
+    seg = {
+        "id": format(span.id & (2**64 - 1), "016x"),
+        "trace_id": xray_trace_id(span),
+        "name": (span.service or span.name)[:200],
+        "start_time": span.start_timestamp / 1e9,
+        "end_time": span.end_timestamp / 1e9,
+        "error": bool(span.error),
+        "annotations": annotations,
+        "metadata": metadata,
+    }
+    if span.parent_id:
+        seg["parent_id"] = format(span.parent_id & (2**64 - 1), "016x")
+        seg["type"] = "subsegment"
+    return seg
+
+
+class XRaySpanSink(sink_mod.BaseSpanSink):
+    KIND = "xray"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        cfg = self.config
+        addr = cfg.get("address", "127.0.0.1:2000")
+        host, _, port = addr.rpartition(":")
+        self.daemon = (host or "127.0.0.1", int(port or 2000))
+        self.sample_pct = float(cfg.get("sample_percentage", 100))
+        self.annotation_tags = set(cfg.get("annotation_tags", []))
+        self._sock: Optional[socket.socket] = None
+        self.sampled_out = 0
+        self.sent = 0
+
+    def start(self, trace_client=None) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def ingest(self, span) -> None:
+        if self.sample_pct < 100:
+            basis = span.trace_id.to_bytes(8, "big", signed=True)
+            if (zlib.crc32(basis) % 100) >= self.sample_pct:
+                self.sampled_out += 1
+                return
+        if self._sock is None:
+            self.start()
+        doc = HEADER + json.dumps(
+            segment(span, self.annotation_tags)).encode()
+        try:
+            self._sock.sendto(doc, self.daemon)
+            self.sent += 1
+        except OSError as e:
+            logger.warning("xray daemon send failed: %s", e)
+
+
+sink_mod.register_span_sink("xray")(XRaySpanSink)
