@@ -1,0 +1,184 @@
+"""CLI entry-point tests (cmd/veneur, veneur-emit, veneur-prometheus)."""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from veneur_tpu.cli import veneur as cli_veneur
+from veneur_tpu.cli import veneur_emit as cli_emit
+from veneur_tpu.cli import veneur_prometheus as cli_prom
+
+
+def _udp_receiver():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(3.0)
+    return sock, sock.getsockname()[1]
+
+
+def test_veneur_validate_config(tmp_path, capsys):
+    cfgfile = tmp_path / "v.yaml"
+    cfgfile.write_text(
+        "interval: 5s\npercentiles: [0.5, 0.99]\n"
+        "statsd_listen_addresses: ['udp://127.0.0.1:0']\n")
+    rc = cli_veneur.main(["-f", str(cfgfile), "-validate-config"])
+    assert rc == 0
+    assert "config valid" in capsys.readouterr().out
+
+
+def test_veneur_bad_config_rejected(tmp_path):
+    cfgfile = tmp_path / "bad.yaml"
+    cfgfile.write_text("interval: [not, a, duration]\n")
+    assert cli_veneur.main(["-f", str(cfgfile), "-validate-config"]) == 1
+
+
+def test_veneur_requires_config_flag():
+    assert cli_veneur.main([]) == 1
+
+
+def test_veneur_version(capsys):
+    assert cli_veneur.main(["-version"]) == 0
+    assert "veneur-tpu" in capsys.readouterr().out
+
+
+def test_emit_statsd_metrics_and_tags():
+    sock, port = _udp_receiver()
+    rc = cli_emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-name", "x.y", "-count", "3", "-tag", "a:b"])
+    assert rc == 0
+    data, _ = sock.recvfrom(65536)
+    sock.close()
+    assert data == b"x.y:3|c|#a:b"
+
+
+def test_emit_event_and_service_check():
+    sock, port = _udp_receiver()
+    rc = cli_emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-event_title", "deploy", "-event_text", "done",
+                        "-sc_name", "db.up", "-sc_status", "1"])
+    assert rc == 0
+    data, _ = sock.recvfrom(65536)
+    sock.close()
+    lines = data.split(b"\n")
+    assert lines[0].startswith(b"_e{6,4}:deploy|done")
+    assert lines[1].startswith(b"_sc|db.up|1")
+
+
+def test_emit_command_mode_times_subprocess():
+    sock, port = _udp_receiver()
+    rc = cli_emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-command", "true"])
+    assert rc == 0
+    data, _ = sock.recvfrom(65536)
+    sock.close()
+    assert data.startswith(b"veneur-emit.command.duration_ms:")
+    assert b"|ms" in data
+
+
+def test_emit_command_nonzero_exit_propagates():
+    sock, port = _udp_receiver()
+    rc = cli_emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-command", "false"])
+    sock.close()
+    assert rc == 1
+
+
+def test_emit_ssf_span():
+    from veneur_tpu import ssf as ssf_mod
+    sock, port = _udp_receiver()
+    rc = cli_emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-name", "op", "-gauge", "1.5", "-ssf"])
+    assert rc == 0
+    data, _ = sock.recvfrom(65536)
+    sock.close()
+    span = ssf_mod.SSFSpan.FromString(data)
+    assert span.name == "op" and span.service == "veneur-emit"
+    assert span.metrics[0].name == "op"
+    assert abs(span.metrics[0].value - 1.5) < 1e-6
+
+
+def test_veneur_prometheus_once():
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"# TYPE up gauge\nup 1\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    sock, port = _udp_receiver()
+    try:
+        rc = cli_prom.main([
+            "-m", f"http://127.0.0.1:{httpd.server_address[1]}/metrics",
+            "-s", f"127.0.0.1:{port}", "-p", "prom.", "-once"])
+        assert rc == 0
+        data, _ = sock.recvfrom(65536)
+        assert data == b"prom.up:1.0|g"
+    finally:
+        sock.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_server_wires_statsd_and_diagnostics():
+    from veneur_tpu.config import Config
+    from veneur_tpu.core.server import Server
+    sock, port = _udp_receiver()
+    cfg = Config(interval=60.0, stats_address=f"127.0.0.1:{port}",
+                 diagnostics_metrics_enabled=True,
+                 veneur_metrics_additional_tags=["self:1"])
+    srv = Server(cfg)
+    srv.start()
+    try:
+        assert srv.statsd is not None and srv.diagnostics is not None
+        srv.diagnostics.report_once()
+        data, _ = sock.recvfrom(65536)
+        assert data.startswith(b"veneur.")
+        assert b"|#self:1" in data
+    finally:
+        srv.shutdown()
+        sock.close()
+
+
+def test_scopedstatsd_scope_tags():
+    from veneur_tpu import scopedstatsd
+    sock, port = _udp_receiver()
+    client = scopedstatsd.ScopedClient(
+        f"127.0.0.1:{port}",
+        scopes=scopedstatsd.MetricScopes(counter="global", gauge="local"),
+        tags=["base:1"])
+    client.count("c", 2, tags=["k:v"])
+    data, _ = sock.recvfrom(65536)
+    assert data == b"c:2|c|#base:1,k:v,veneurglobalonly"
+    client.gauge("g", 1.5)
+    data, _ = sock.recvfrom(65536)
+    assert data == b"g:1.5|g|#base:1,veneurlocalonly"
+    client.close()
+    sock.close()
+    # nil-safety
+    noop = scopedstatsd.ensure(None)
+    noop.count("x", 1)
+
+
+def test_diagnostics_collect_and_report():
+    from veneur_tpu import diagnostics
+
+    class Rec:
+        def __init__(self):
+            self.gauges = {}
+
+        def gauge(self, name, value, tags=None, rate=1.0):
+            self.gauges[name] = value
+
+    rec = Rec()
+    diag = diagnostics.Diagnostics(statsd=rec, interval_s=60.0)
+    stats = diag.report_once()
+    assert stats["uptime_ms"] >= 0
+    assert stats["threads"] >= 1
+    assert "mem.rss_bytes" in stats
+    assert rec.gauges["veneur.threads"] == stats["threads"]

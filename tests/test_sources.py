@@ -1,0 +1,190 @@
+"""Source tests: openmetrics conversion semantics + server wiring.
+
+Mirrors `sources/openmetrics/openmetrics_test.go` (scrape conversion,
+cumulative->delta, allow/deny) and the registry wiring of
+`server.go:660-670`.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from veneur_tpu import sources as sources_mod
+from veneur_tpu.config import Config, SourceSpec
+from veneur_tpu.sources.openmetrics import OpenMetricsSource, \
+    parse_exposition
+
+
+class Recorder:
+    def __init__(self):
+        self.metrics = []
+
+    def ingest_metric(self, m):
+        self.metrics.append(m)
+
+
+EXPO_1 = """\
+# HELP http_requests_total Total requests.
+# TYPE http_requests_total counter
+http_requests_total{code="200",method="get"} 100
+http_requests_total{code="500",method="get"} 5
+# TYPE mem_usage gauge
+mem_usage 12345.5
+# TYPE rpc_latency histogram
+rpc_latency_bucket{le="0.5"} 10
+rpc_latency_bucket{le="+Inf"} 20
+rpc_latency_sum 9.5
+rpc_latency_count 20
+# TYPE api_quantiles summary
+api_quantiles{quantile="0.99"} 0.42
+api_quantiles_count 7
+untyped_thing 3
+"""
+
+EXPO_2 = """\
+# TYPE http_requests_total counter
+http_requests_total{code="200",method="get"} 130
+http_requests_total{code="500",method="get"} 5
+# TYPE mem_usage gauge
+mem_usage 999.0
+"""
+
+
+def mksource(**cfg):
+    return OpenMetricsSource(SourceSpec(kind="openmetrics", name="om",
+                                        config=cfg))
+
+
+def test_parse_exposition_labels_and_types():
+    rows = list(parse_exposition(EXPO_1))
+    by_name = {}
+    for name, labels, value, mtype in rows:
+        by_name.setdefault(name, []).append((labels, value, mtype))
+    assert by_name["http_requests_total"][0] == (
+        [("code", "200"), ("method", "get")], 100.0, "counter")
+    assert by_name["mem_usage"][0] == ([], 12345.5, "gauge")
+    assert by_name["rpc_latency_bucket"][0][2] == "histogram"
+    assert by_name["rpc_latency_sum"][0][2] == "histogram"
+    assert by_name["api_quantiles"][0][2] == "summary"
+    assert by_name["untyped_thing"][0][2] == "untyped"
+
+
+def test_openmetrics_cumulative_to_delta():
+    src = mksource(scrape_target="http://unused")
+    rec = Recorder()
+    # first scrape: counters cached, no counter emission; gauges emitted
+    src.ingest_exposition(EXPO_1, rec)
+    names = [(m.name, m.type) for m in rec.metrics]
+    assert ("http_requests_total", "counter") not in names
+    assert ("mem_usage", "gauge") in names
+    # quantile line -> gauge immediately
+    assert ("api_quantiles", "gauge") in names
+
+    rec2 = Recorder()
+    src.ingest_exposition(EXPO_2, rec2)
+    deltas = {m.name: m for m in rec2.metrics if m.type == "counter"}
+    assert deltas["http_requests_total"].value == 30  # 130-100
+    # unchanged series (500s) emits nothing
+    assert all("code:500" not in m.tags for m in rec2.metrics)
+    gauge = [m for m in rec2.metrics if m.name == "mem_usage"][0]
+    assert gauge.value == 999.0
+
+
+def test_openmetrics_fractional_sum_deltas_survive():
+    src = mksource(scrape_target="http://unused")
+    rec = Recorder()
+    expo1 = "# TYPE lat histogram\nlat_sum 1.2\nlat_count 3\n"
+    expo2 = "# TYPE lat histogram\nlat_sum 2.0\nlat_count 5\n"
+    src.ingest_exposition(expo1, rec)
+    src.ingest_exposition(expo2, rec)
+    sums = [m for m in rec.metrics if m.name == "lat_sum"]
+    assert len(sums) == 1
+    assert sums[0].value == pytest.approx(0.8)
+
+
+def test_openmetrics_duration_strings():
+    src = mksource(scrape_target="http://unused", scrape_interval="30s",
+                   scrape_timeout="500ms")
+    assert src.interval_s == 30.0
+    assert src.timeout_s == 0.5
+
+
+def test_openmetrics_counter_reset_emits_new_total():
+    src = mksource(scrape_target="http://unused")
+    rec = Recorder()
+    src.ingest_exposition("# TYPE c counter\nc 100\n", rec)
+    src.ingest_exposition("# TYPE c counter\nc 40\n", rec)  # reset
+    counters = [m for m in rec.metrics if m.name == "c"]
+    assert len(counters) == 1 and counters[0].value == 40
+
+
+def test_openmetrics_allow_deny():
+    src = mksource(scrape_target="http://unused", allowlist="^keep",
+                   denylist="bad")
+    rec = Recorder()
+    src.ingest_exposition(
+        "# TYPE keep_this gauge\nkeep_this 1\n"
+        "# TYPE keep_bad gauge\nkeep_bad 2\n"
+        "# TYPE drop_this gauge\ndrop_this 3\n", rec)
+    assert [m.name for m in rec.metrics] == ["keep_this"]
+
+
+def test_openmetrics_scrape_over_http_and_tags():
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"# TYPE g gauge\ng{x=\"1\"} 7\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        src = mksource(
+            scrape_target=f"http://127.0.0.1:{httpd.server_address[1]}/metrics",
+            tags=["src:test"])
+        rec = Recorder()
+        n = src.scrape_once(rec)
+        assert n == 1
+        m = rec.metrics[0]
+        assert m.name == "g" and m.value == 7.0
+        assert sorted(m.tags) == ["src:test", "x:1"]
+        assert m.digest != 0  # sharding digest computed
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_server_wires_sources(monkeypatch):
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sources.mock import MockSource
+
+    cfg = Config(interval=10.0,
+                 sources=[SourceSpec(kind="mock", name="m1")])
+    srv = Server(cfg)
+    assert len(srv.sources) == 1
+    src = srv.sources[0]
+    assert isinstance(src, MockSource)
+    srv.start()
+    try:
+        assert src.started and src.ingest is not None
+        # the shim feeds the aggregator
+        from veneur_tpu.samplers.metric_key import UDPMetric
+        m = UDPMetric(name="via.source", type="counter", value=3)
+        m.update_tags([], None)
+        before = srv.aggregator.processed
+        src.ingest.ingest_metric(m)
+        assert srv.aggregator.processed == before + 1
+    finally:
+        srv.shutdown()
+    assert src.stopped
+
+
+def test_unknown_source_kind_raises():
+    with pytest.raises(ValueError):
+        sources_mod.create_source(SourceSpec(kind="nope"))

@@ -1,0 +1,94 @@
+"""Main server entry point (capability twin of `cmd/veneur/main.go:44-215`).
+
+`python -m veneur_tpu.cli.veneur -f config.yaml` loads the YAML config
+(template expansion + env overrides, `util/config/config.go:16-63`),
+boots the server + HTTP API, and serves until signalled.
+`-validate-config[-strict]` parse-checks and exits; `-print-secrets`
+disables redaction on the config dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.util.build import VERSION, BUILD_DATE
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="veneur-tpu")
+    p.add_argument("-f", dest="config", metavar="FILE",
+                   help="The config file to read for settings.")
+    p.add_argument("-validate-config", action="store_true",
+                   dest="validate_config",
+                   help="Validate the config file and exit.")
+    p.add_argument("-validate-config-strict", action="store_true",
+                   dest="validate_strict",
+                   help="Validate the config file, rejecting unknown "
+                        "fields, and exit.")
+    p.add_argument("-print-secrets", action="store_true",
+                   dest="print_secrets",
+                   help="Disable redaction when dumping config.")
+    p.add_argument("-version", action="store_true", dest="version")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(f"veneur-tpu {VERSION} (built {BUILD_DATE})")
+        return 0
+    if not args.config:
+        print("You must specify a config file", file=sys.stderr)
+        return 1
+
+    strict = args.validate_strict
+    try:
+        cfg = config_mod.read_config(args.config, strict=strict)
+    except Exception as e:
+        print(f"error reading config file: {e}", file=sys.stderr)
+        return 1
+    if args.validate_config or args.validate_strict:
+        import yaml as yaml_mod
+        dump = (config_mod.redacted_dict(cfg) if not args.print_secrets
+                else config_mod.redacted_dict(cfg, redact=False))
+        print(yaml_mod.safe_dump(dump, default_flow_style=False), end="")
+        print("config valid")
+        return 0
+
+    logging.basicConfig(
+        level=getattr(logging, cfg.debug and "DEBUG" or "INFO", logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.http_api import HttpApi
+
+    server = Server(cfg)
+    server.start()
+    api = None
+    if cfg.http_address:
+        api = HttpApi(server, cfg.http_address)
+        api.start()
+
+    def on_signal(signum, frame):
+        # only unblock serve(); the full teardown (which may flush and
+        # take locks the interrupted frame already holds) runs below
+        server.stop_serving()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    try:
+        server.serve()  # blocking flush-ticker loop
+    finally:
+        if api is not None:
+            api.stop()
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

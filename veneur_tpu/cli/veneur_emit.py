@@ -1,0 +1,146 @@
+"""Metric/span emitter CLI (capability twin of `cmd/veneur-emit`).
+
+Modes, mirroring `cmd/veneur-emit/main.go:169,383,546,594`:
+  * statsd datagrams:  -hostport udp://host:port -count/-gauge/-timing
+    plus -tag k:v pairs
+  * SSF:               -ssf sends the metric as an SSF span-sample frame
+  * -command:          run a subprocess, time it, emit a span (SSF) or
+    timing metric (statsd)
+  * events / service checks: -event_* / -sc_* flags build the DogStatsD
+    `_e{}`/`_sc` wire forms
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import socket
+import subprocess
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="veneur-tpu-emit")
+    p.add_argument("-hostport", default="udp://127.0.0.1:8125",
+                   help="udp://host:port destination")
+    p.add_argument("-name", help="metric name")
+    p.add_argument("-count", type=int, help="counter increment")
+    p.add_argument("-gauge", type=float, help="gauge value")
+    p.add_argument("-timing", type=float, help="timing value (ms)")
+    p.add_argument("-set", dest="set_value", help="set member")
+    p.add_argument("-tag", action="append", default=[],
+                   help="tag, repeatable (k:v)")
+    p.add_argument("-ssf", action="store_true",
+                   help="send over SSF instead of statsd")
+    p.add_argument("-command", help="run command, emit its timing")
+    # events
+    p.add_argument("-event_title")
+    p.add_argument("-event_text")
+    p.add_argument("-event_alert_type")
+    # service checks
+    p.add_argument("-sc_name")
+    p.add_argument("-sc_status", type=int)
+    p.add_argument("-sc_msg", default="")
+    return p
+
+
+def _dest(hostport: str) -> tuple[str, int]:
+    addr = hostport.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def statsd_lines(args) -> list[bytes]:
+    tags = ("|#" + ",".join(args.tag)) if args.tag else ""
+    lines = []
+    if args.count is not None:
+        lines.append(f"{args.name}:{args.count}|c{tags}".encode())
+    if args.gauge is not None:
+        lines.append(f"{args.name}:{args.gauge}|g{tags}".encode())
+    if args.timing is not None:
+        lines.append(f"{args.name}:{args.timing}|ms{tags}".encode())
+    if args.set_value is not None:
+        lines.append(f"{args.name}:{args.set_value}|s{tags}".encode())
+    if args.event_title:
+        title, text = args.event_title, args.event_text or ""
+        ev = f"_e{{{len(title)},{len(text)}}}:{title}|{text}"
+        if args.event_alert_type:
+            ev += f"|t:{args.event_alert_type}"
+        if args.tag:
+            ev += "|#" + ",".join(args.tag)
+        lines.append(ev.encode())
+    if args.sc_name:
+        sc = f"_sc|{args.sc_name}|{args.sc_status or 0}"
+        if args.tag:
+            sc += "|#" + ",".join(args.tag)
+        if args.sc_msg:
+            sc += f"|m:{args.sc_msg}"
+        lines.append(sc.encode())
+    return lines
+
+
+def emit_ssf(args, dest: tuple[str, int],
+             duration_ns: int = 0, error: bool = False) -> None:
+    from veneur_tpu import ssf as ssf_mod
+    from veneur_tpu.trace import Span
+    span = Span(args.name or (args.command and "veneur-emit.command")
+                or "veneur-emit", service="veneur-emit")
+    if args.count is not None:
+        span.add(ssf_mod.count(args.name, args.count,
+                               _tag_dict(args.tag)))
+    if args.gauge is not None:
+        span.add(ssf_mod.gauge(args.name, args.gauge, _tag_dict(args.tag)))
+    if args.timing is not None:
+        span.add(ssf_mod.timing(args.name, args.timing / 1e3,
+                                tags=_tag_dict(args.tag)))
+    pb = span.to_proto()
+    if duration_ns:
+        pb.end_timestamp = pb.start_timestamp + duration_ns
+    pb.error = error
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.sendto(pb.SerializeToString(), dest)
+    sock.close()
+
+
+def _tag_dict(tags: list[str]) -> dict:
+    out = {}
+    for t in tags:
+        k, _, v = t.partition(":")
+        out[k] = v
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    dest = _dest(args.hostport)
+    rc = 0
+    if args.command:
+        t0 = time.perf_counter()
+        proc = subprocess.run(shlex.split(args.command))
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        rc = proc.returncode
+        if args.name is None:
+            args.name = "veneur-emit.command.duration_ms"
+        args.timing = elapsed_ms
+        if args.ssf:
+            emit_ssf(args, dest,
+                     duration_ns=int(elapsed_ms * 1e6),
+                     error=rc != 0)
+            return rc
+    if args.ssf:
+        emit_ssf(args, dest)
+        return rc
+    lines = statsd_lines(args)
+    if not lines:
+        print("nothing to emit (need -count/-gauge/-timing/-set/"
+              "-event_title/-sc_name)", file=sys.stderr)
+        return 1
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.sendto(b"\n".join(lines), dest)
+    sock.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""Legacy Prometheus poller CLI (capability twin of `cmd/veneur-prometheus`).
+
+Scrapes a Prometheus /metrics endpoint on an interval and re-emits the
+samples as DogStatsD datagrams (`cmd/veneur-prometheus/main.go:32-108`) —
+the predecessor of the in-server openmetrics source, kept for CLI parity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socket
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="veneur-tpu-prometheus")
+    p.add_argument("-m", dest="metrics_url", required=True,
+                   help="Prometheus /metrics URL to scrape")
+    p.add_argument("-s", dest="statsd", default="127.0.0.1:8125",
+                   help="statsd host:port to emit to")
+    p.add_argument("-i", dest="interval", type=float, default=10.0)
+    p.add_argument("-p", dest="prefix", default="")
+    p.add_argument("-a", dest="added_tags", action="append", default=[])
+    p.add_argument("-once", action="store_true",
+                   help="scrape once and exit (for tests)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    from veneur_tpu.config import SourceSpec
+    from veneur_tpu.sources.openmetrics import OpenMetricsSource
+
+    source = OpenMetricsSource(SourceSpec(
+        kind="openmetrics", name="veneur-prometheus",
+        config={"scrape_target": args.metrics_url,
+                "scrape_interval": args.interval,
+                "tags": args.added_tags}))
+
+    host, _, port = args.statsd.rpartition(":")
+    dest = (host or "127.0.0.1", int(port))
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    class StatsdIngest:
+        """Ingest shim that re-emits as DogStatsD lines."""
+
+        def ingest_metric(self, m):
+            name = args.prefix + m.name
+            mtype = "c" if m.type == "counter" else "g"
+            line = f"{name}:{m.value}|{mtype}"
+            if m.tags:
+                line += "|#" + ",".join(m.tags)
+            sock.sendto(line.encode(), dest)
+
+    ingest = StatsdIngest()
+    if args.once:
+        source.scrape_once(ingest)
+        return 0
+    try:
+        while True:
+            t0 = time.time()
+            try:
+                source.scrape_once(ingest)
+            except Exception:
+                logging.exception("scrape failed")
+            time.sleep(max(0.0, args.interval - (time.time() - t0)))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Proxy entry point (capability twin of `cmd/veneur-proxy/main.go:29-136`).
+
+Boots the consistent-hash fan-in tier with either static destinations or
+a discoverer polled every `discovery_interval`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+
+import yaml
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="veneur-tpu-proxy")
+    p.add_argument("-f", dest="config", metavar="FILE", required=False)
+    p.add_argument("-validate-config", action="store_true",
+                   dest="validate_config")
+    args = p.parse_args(argv)
+
+    data = {}
+    if args.config:
+        with open(args.config) as f:
+            data = yaml.safe_load(f) or {}
+    if args.validate_config:
+        print("config valid")
+        return 0
+
+    logging.basicConfig(level=logging.INFO)
+
+    from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
+    from veneur_tpu.util.matcher import TagMatcher
+
+    cfg = ProxyConfig(
+        grpc_address=data.get("grpc_address", "0.0.0.0:8128"),
+        http_address=data.get("http_address", "0.0.0.0:8127"),
+        forward_service=data.get("forward_service", "veneur-global"),
+        discovery_interval=float(data.get("discovery_interval", 10.0)),
+        send_buffer_size=int(data.get("send_buffer_size", 1024)),
+        ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
+        static_destinations=list(data.get("static_destinations", [])),
+    )
+    discoverer = None
+    disc_kind = data.get("discoverer", "")
+    if disc_kind == "kubernetes":
+        from veneur_tpu.discovery import KubernetesDiscoverer
+        discoverer = KubernetesDiscoverer()
+    elif disc_kind == "consul":
+        from veneur_tpu.discovery import ConsulDiscoverer
+        discoverer = ConsulDiscoverer(data.get("consul_url",
+                                               "http://127.0.0.1:8500"))
+
+    proxy = Proxy(cfg, discoverer=discoverer)
+    proxy.start()
+    logging.info("proxy serving grpc=:%d http=:%d", proxy.grpc_port,
+                 proxy.http_port)
+
+    stop = {"done": False}
+
+    def on_signal(signum, frame):
+        stop["done"] = True
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    try:
+        while not stop["done"]:
+            time.sleep(0.2)
+    finally:
+        proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

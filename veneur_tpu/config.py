@@ -22,17 +22,26 @@ from veneur_tpu.util.matcher import Matcher, matcher_from_config
 
 
 def parse_duration(v: Any) -> float:
-    """Go-style duration ("10s", "50ms", "1m30s") -> seconds."""
+    """Go-style duration ("10s", "50ms", "1m30s") -> seconds.
+
+    Raises ValueError on anything that isn't a number or a duration
+    string (time.ParseDuration errors on malformed input too).
+    """
+    if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+        raise ValueError(f"invalid duration: {v!r}")
     if isinstance(v, (int, float)):
         return float(v)
-    s = str(v).strip()
+    s = v.strip()
+    if re.fullmatch(r"[0-9.]+", s):
+        return float(s)
     units = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
              "s": 1.0, "m": 60.0, "h": 3600.0}
+    matched = re.fullmatch(r"(?:[0-9.]+(?:ns|us|µs|ms|s|m|h))+", s)
+    if not matched:
+        raise ValueError(f"invalid duration: {v!r}")
     total = 0.0
     for num, unit in re.findall(r"([0-9.]+)(ns|us|µs|ms|s|m|h)", s):
         total += float(num) * units[unit]
-    if total == 0 and s and re.fullmatch(r"[0-9.]+", s):
-        total = float(s)
     return total
 
 
@@ -271,12 +280,13 @@ def _expand(text: str, environ: dict[str, str]) -> str:
     return re.sub(r"\$(?:\{(\w+)\}|(\w+))", repl, text)
 
 
-def redacted_dict(cfg: Config) -> dict:
-    """Config dump with secrets redacted (util/string_secret.go:13-36)."""
+def redacted_dict(cfg: Config, redact: bool = True) -> dict:
+    """Config dump with secrets redacted (util/string_secret.go:13-36);
+    redact=False is the -print-secrets escape hatch."""
     out = {}
     for f in fields(Config):
         v = getattr(cfg, f.name)
-        if f.name in ("sentry_dsn", "tls_key") and v:
+        if redact and f.name in ("sentry_dsn", "tls_key") and v:
             v = "REDACTED"
         if isinstance(v, list) and v and not isinstance(
                 v[0], (str, int, float)):

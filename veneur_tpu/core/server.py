@@ -49,6 +49,20 @@ def _split_hostport(rest: str) -> tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
+class _IngestShim:
+    """sources.Ingest implementation handed to every source
+    (the `ingest` shim, server.go:328-355)."""
+
+    def __init__(self, server: "Server"):
+        self._server = server
+
+    def ingest_metric(self, m) -> None:
+        self._server.aggregator.process_metric(m)
+
+    def ingest_metric_proto(self, fm) -> None:
+        self._server.aggregator.import_metric(fm)
+
+
 class Server:
     def __init__(self, cfg: config_mod.Config,
                  extra_metric_sinks: Optional[list] = None,
@@ -103,6 +117,16 @@ class Server:
         # (trace.NewChannelClient, server.go:518-521)
         from veneur_tpu import trace as trace_mod
         self.trace_client = trace_mod.new_channel_client(self.handle_span)
+
+        # pluggable pull/push sources (sources/sources.go, wired like
+        # createSources server.go:660-670); each gets the ingest shim at
+        # start (server.go:328-355 — here the aggregator shards internally)
+        from veneur_tpu import sources as sources_mod
+        self.sources: list = [sources_mod.create_source(spec, cfg)
+                              for spec in cfg.sources]
+        self.ingest_shim = _IngestShim(self)
+        self.statsd = None        # self-metrics client (stats_address)
+        self.diagnostics = None   # runtime stats loop
 
         self._listeners: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
@@ -210,6 +234,33 @@ class Server:
                                  name="flush-watchdog")
             t.start()
             self._threads.append(t)
+        # self-metrics statsd client + runtime diagnostics loop
+        # (cmd/veneur/main.go:85-94, diagnostics/diagnostics_metrics.go)
+        if self.config.stats_address and self.statsd is None:
+            from veneur_tpu import scopedstatsd
+            sc = self.config.veneur_metrics_scopes or {}
+            self.statsd = scopedstatsd.ScopedClient(
+                self.config.stats_address,
+                scopes=scopedstatsd.MetricScopes(
+                    counter=sc.get("counter", ""),
+                    gauge=sc.get("gauge", ""),
+                    histogram=sc.get("histogram", ""),
+                    set_=sc.get("set", ""),
+                    timing=sc.get("timing", "")),
+                tags=list(self.config.veneur_metrics_additional_tags))
+        if self.config.diagnostics_metrics_enabled:
+            from veneur_tpu.diagnostics import Diagnostics
+            self.diagnostics = Diagnostics(
+                self.statsd, interval_s=self.config.interval,
+                tags=list(self.config.veneur_metrics_additional_tags))
+            self.diagnostics.start()
+        for source in self.sources:
+            source.start(self.ingest_shim)
+
+    def stop_serving(self) -> None:
+        """Unblock serve() without tearing down (signal-handler safe:
+        takes no locks, so it may run while a flush is mid-flight)."""
+        self._shutdown.set()
 
     def _start_statsd(self, addr: str) -> None:
         scheme, rest = parse_listen_addr(addr)
@@ -599,6 +650,15 @@ class Server:
             except Exception:
                 logger.exception("final flush failed")
         self._shutdown.set()
+        for source in self.sources:
+            try:
+                source.stop()
+            except Exception:
+                logger.exception("source stop failed")
+        if self.diagnostics is not None:
+            self.diagnostics.stop()
+        if self.statsd is not None:
+            self.statsd.close()
         try:
             self.trace_client.close()
         except Exception:

@@ -1,0 +1,80 @@
+"""Runtime diagnostics loop.
+
+Capability twin of `diagnostics/diagnostics_metrics.go:11,38`: every flush
+interval, report uptime plus runtime memory/GC statistics as self-metrics.
+The Go memstats become the CPython equivalents: RSS, GC generation
+counts/collections, thread count, and open-fd count.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+from typing import Optional
+
+from veneur_tpu import scopedstatsd
+
+
+def collect(start_time: float) -> dict[str, float]:
+    """One snapshot of runtime stats (name -> value)."""
+    stats: dict[str, float] = {
+        "uptime_ms": (time.time() - start_time) * 1000.0,
+        "threads": float(threading.active_count()),
+    }
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        stats["mem.rss_bytes"] = float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        stats["fds"] = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    for i, gen in enumerate(gc.get_stats()):
+        stats[f"gc.gen{i}.collections"] = float(gen.get("collections", 0))
+        stats[f"gc.gen{i}.collected"] = float(gen.get("collected", 0))
+    counts = gc.get_count()
+    for i, c in enumerate(counts):
+        stats[f"gc.gen{i}.pending"] = float(c)
+    return stats
+
+
+class Diagnostics:
+    """Background reporter thread (CollectDiagnosticsMetrics loop)."""
+
+    def __init__(self, statsd=None, interval_s: float = 10.0,
+                 tags: Optional[list[str]] = None,
+                 prefix: str = "veneur."):
+        self.statsd = scopedstatsd.ensure(statsd)
+        self.interval_s = interval_s
+        self.tags = list(tags or [])
+        self.prefix = prefix
+        self.start_time = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def report_once(self) -> dict[str, float]:
+        stats = collect(self.start_time)
+        for name, value in stats.items():
+            self.statsd.gauge(self.prefix + name, value, tags=self.tags)
+        return stats
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="diagnostics")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.report_once()
+            except Exception:
+                pass

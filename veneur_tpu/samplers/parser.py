@@ -29,6 +29,11 @@ EVENT_IDENTIFIER_KEY = "vdogstatsd_ev"
 EVENT_PRIORITY_TAG = "vdogstatsd_pri"
 EVENT_SOURCE_TYPE_TAG = "vdogstatsd_st"
 
+# Magic scope tags (samplers/parser.go:444-456); set the metric's scope
+# and are stripped from the tag list.
+LOCAL_ONLY_TAG = "veneurlocalonly"
+GLOBAL_ONLY_TAG = "veneurglobalonly"
+
 # Status-check values (ssf.SSFSample_* numeric values).
 STATUS_OK = 0
 STATUS_WARNING = 1
@@ -143,11 +148,11 @@ class Parser:
                 temp_tags = chunk[1:].decode().split(",")
                 for i, tag in enumerate(temp_tags):
                     # magic scope tags are stripped (parser.go:444-456)
-                    if tag.startswith("veneurlocalonly"):
+                    if tag.startswith(LOCAL_ONLY_TAG):
                         del temp_tags[i]
                         metric.scope = MetricScope.LOCAL_ONLY
                         break
-                    if tag.startswith("veneurglobalonly"):
+                    if tag.startswith(GLOBAL_ONLY_TAG):
                         del temp_tags[i]
                         metric.scope = MetricScope.GLOBAL_ONLY
                         break
@@ -350,11 +355,11 @@ class Parser:
                 found.add("tags")
                 temp_tags = chunk[1:].decode().split(",")
                 for i, tag in enumerate(temp_tags):
-                    if tag == "veneurlocalonly":
+                    if tag == LOCAL_ONLY_TAG:
                         del temp_tags[i]
                         ret.scope = MetricScope.LOCAL_ONLY
                         break
-                    if tag == "veneurglobalonly":
+                    if tag == GLOBAL_ONLY_TAG:
                         del temp_tags[i]
                         ret.scope = MetricScope.GLOBAL_ONLY
                         break

@@ -1,0 +1,117 @@
+"""Scoped statsd self-metrics client.
+
+Capability twin of `scopedstatsd/client.go:13-58`: a DogStatsD client
+wrapper that appends the magic scope tags (`veneurlocalonly` /
+`veneurglobalonly`) per metric-type scope so the server's own telemetry
+aggregates at the right tier, plus a nil-safe `ensure` (a no-op client
+when none is configured).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from veneur_tpu.samplers import parser as parser_mod
+
+GLOBAL_ONLY = "global"
+LOCAL_ONLY = "local"
+DEFAULT_SCOPE = ""
+
+
+class MetricScopes:
+    """Per-metric-type scope overrides (veneur_metrics_scopes config)."""
+
+    def __init__(self, counter: str = DEFAULT_SCOPE,
+                 gauge: str = DEFAULT_SCOPE, histogram: str = DEFAULT_SCOPE,
+                 set_: str = DEFAULT_SCOPE, timing: str = DEFAULT_SCOPE):
+        self.counter = counter
+        self.gauge = gauge
+        self.histogram = histogram
+        self.set = set_
+        self.timing = timing
+
+
+def scope_tag(scope: str) -> Optional[str]:
+    if scope == GLOBAL_ONLY:
+        return parser_mod.GLOBAL_ONLY_TAG
+    if scope == LOCAL_ONLY:
+        return parser_mod.LOCAL_ONLY_TAG
+    return None
+
+
+class ScopedClient:
+    """UDP DogStatsD emitter with scope tags and implicit tags."""
+
+    def __init__(self, address: str = "127.0.0.1:8125",
+                 scopes: Optional[MetricScopes] = None,
+                 tags: Optional[list[str]] = None):
+        host, _, port = address.rpartition(":")
+        self._dest = (host or "127.0.0.1", int(port or 8125))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.scopes = scopes or MetricScopes()
+        self.tags = list(tags or [])
+
+    def _emit(self, name: str, value, mtype: str, tags: Optional[list[str]],
+              scope: str, rate: float = 1.0) -> None:
+        all_tags = self.tags + list(tags or [])
+        st = scope_tag(scope)
+        if st:
+            all_tags.append(st)
+        line = f"{name}:{value}|{mtype}"
+        if rate != 1.0:
+            line += f"|@{rate}"
+        if all_tags:
+            line += "|#" + ",".join(all_tags)
+        try:
+            self._sock.sendto(line.encode(), self._dest)
+        except OSError:
+            pass
+
+    def count(self, name: str, value: int,
+              tags: Optional[list[str]] = None, rate: float = 1.0) -> None:
+        self._emit(name, value, "c", tags, self.scopes.counter, rate)
+
+    def incr(self, name: str, tags: Optional[list[str]] = None,
+             rate: float = 1.0) -> None:
+        self.count(name, 1, tags, rate)
+
+    def gauge(self, name: str, value: float,
+              tags: Optional[list[str]] = None, rate: float = 1.0) -> None:
+        self._emit(name, value, "g", tags, self.scopes.gauge, rate)
+
+    def histogram(self, name: str, value: float,
+                  tags: Optional[list[str]] = None,
+                  rate: float = 1.0) -> None:
+        self._emit(name, value, "h", tags, self.scopes.histogram, rate)
+
+    def timing(self, name: str, ms: float,
+               tags: Optional[list[str]] = None, rate: float = 1.0) -> None:
+        self._emit(name, ms, "ms", tags, self.scopes.timing, rate)
+
+    def set(self, name: str, member: str,
+            tags: Optional[list[str]] = None, rate: float = 1.0) -> None:
+        self._emit(name, member, "s", tags, self.scopes.set, rate)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class NoopClient:
+    """The nil-safe fallback (scopedstatsd.Ensure, client.go:24-30)."""
+
+    def count(self, *a, **kw): ...
+    def incr(self, *a, **kw): ...
+    def gauge(self, *a, **kw): ...
+    def histogram(self, *a, **kw): ...
+    def timing(self, *a, **kw): ...
+    def set(self, *a, **kw): ...
+    def close(self): ...
+
+
+def ensure(client) -> object:
+    """Return a usable client: the given one, or a no-op."""
+    return client if client is not None else NoopClient()

@@ -1,0 +1,48 @@
+"""Source interfaces and plugin registry.
+
+Mirrors `sources/sources.go:1-19`: a Source is a pluggable pull/push input
+with `Start(Ingest)` / `Stop()`; `Ingest` accepts parsed UDPMetrics (and,
+for the gRPC import path, forwarded protobuf metrics).  The registry map
+parallels `SourceTypes` (`server.go:62-90`), filled from the YAML
+`sources` list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol, runtime_checkable
+
+from veneur_tpu.samplers.metric_key import UDPMetric
+
+
+@runtime_checkable
+class Ingest(Protocol):
+    def ingest_metric(self, m: UDPMetric) -> None: ...
+
+
+@runtime_checkable
+class Source(Protocol):
+    def name(self) -> str: ...
+    def start(self, ingest: Ingest) -> None: ...
+    def stop(self) -> None: ...
+
+
+SOURCE_TYPES: dict[str, Callable[..., Any]] = {}
+
+
+def register_source(kind: str):
+    def deco(factory):
+        SOURCE_TYPES[kind] = factory
+        return factory
+    return deco
+
+
+def create_source(spec, server_config=None):
+    factory = SOURCE_TYPES.get(spec.kind)
+    if factory is None:
+        raise ValueError(f"unknown source kind {spec.kind!r}")
+    return factory(spec, server_config)
+
+
+# registration imports at the bottom (modules decorate with the registry)
+from veneur_tpu.sources import openmetrics as _openmetrics  # noqa: E402,F401
+from veneur_tpu.sources import mock as _mock  # noqa: E402,F401

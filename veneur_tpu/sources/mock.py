@@ -1,0 +1,28 @@
+"""Recording source double (capability twin of `sources/mock/`)."""
+
+from __future__ import annotations
+
+from veneur_tpu import sources as sources_mod
+
+
+class MockSource:
+    KIND = "mock"
+
+    def __init__(self, spec=None, server_config=None):
+        self._name = getattr(spec, "name", "") or self.KIND
+        self.started = False
+        self.stopped = False
+        self.ingest = None
+
+    def name(self) -> str:
+        return self._name
+
+    def start(self, ingest) -> None:
+        self.started = True
+        self.ingest = ingest
+
+    def stop(self) -> None:
+        self.stopped = True
+
+
+sources_mod.register_source("mock")(MockSource)

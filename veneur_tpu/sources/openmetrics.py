@@ -1,0 +1,171 @@
+"""OpenMetrics source: poll a Prometheus /metrics endpoint.
+
+Capability twin of `sources/openmetrics/openmetrics.go`
+(`openmetrics.go:35,117,157,205-399`): on each `scrape_interval` tick,
+fetch the endpoint, parse the text exposition format, and convert:
+
+  * counter    -> veneur counter of the *delta* since the previous scrape
+    (cumulative->delta cache keyed by name+labels; first sight or a
+    counter reset emits nothing/the new value respectively)
+  * gauge      -> gauge
+  * histogram  -> one counter delta per `le` bucket + `_sum`/`_count`
+    counter deltas
+  * summary    -> one gauge per quantile + `_sum`/`_count` counter deltas
+  * untyped    -> gauge
+
+A regex allow/deny pair filters metric names, like the reference's
+`allowlist`/`denylist` options.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+import threading
+from typing import Optional
+
+import requests
+
+from veneur_tpu import sources as sources_mod
+from veneur_tpu.samplers.metric_key import UDPMetric
+
+logger = logging.getLogger("veneur_tpu.sources.openmetrics")
+
+_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+"
+    r"(?P<value>[^ ]+)(?:\s+(?P<ts>\d+))?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_labels(text: str) -> list[tuple[str, str]]:
+    out = []
+    for m in _LABEL_RE.finditer(text or ""):
+        value = m.group(2).replace(r"\"", '"').replace(r"\n", "\n") \
+            .replace("\\\\", "\\")
+        out.append((m.group(1), value))
+    return out
+
+
+def parse_exposition(text: str):
+    """Yield (name, labels, value, type) from Prometheus text format."""
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        name = m.group("name")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+                break
+        yield name, parse_labels(m.group("labels")), value, \
+            types.get(base, types.get(name, "untyped"))
+
+
+class OpenMetricsSource:
+    KIND = "openmetrics"
+
+    def __init__(self, spec=None, server_config=None,
+                 session: Optional[requests.Session] = None):
+        cfg = dict(getattr(spec, "config", None) or {})
+        self._name = getattr(spec, "name", "") or self.KIND
+        from veneur_tpu.config import parse_duration
+        self.url = cfg.get("scrape_target", "")
+        self.interval_s = parse_duration(cfg.get("scrape_interval", 10.0))
+        self.timeout_s = parse_duration(
+            cfg.get("scrape_timeout", self.interval_s))
+        self.allow = re.compile(cfg["allowlist"]) if cfg.get("allowlist") \
+            else None
+        self.deny = re.compile(cfg["denylist"]) if cfg.get("denylist") \
+            else None
+        self.extra_tags = list(cfg.get("tags", []))
+        self.session = session or requests.Session()
+        self._prev: dict[tuple, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def name(self) -> str:
+        return self._name
+
+    def start(self, ingest) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(ingest,), daemon=True,
+            name=f"openmetrics-{self._name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self, ingest) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once(ingest)
+            except Exception:
+                logger.exception("openmetrics scrape failed")
+
+    def scrape_once(self, ingest) -> int:
+        resp = self.session.get(self.url, timeout=self.timeout_s)
+        resp.raise_for_status()
+        return self.ingest_exposition(resp.text, ingest)
+
+    def ingest_exposition(self, text: str, ingest) -> int:
+        n = 0
+        for name, labels, value, mtype in parse_exposition(text):
+            if self.allow and not self.allow.search(name):
+                continue
+            if self.deny and self.deny.search(name):
+                continue
+            if math.isnan(value):
+                continue
+            tags = [f"{k}:{v}" for k, v in labels] + self.extra_tags
+            is_cumulative = (
+                mtype == "counter"
+                or (mtype == "histogram" and not name.endswith("_sum"))
+                or (mtype in ("histogram", "summary")
+                    and name.endswith(("_sum", "_count"))))
+            if mtype == "summary" and not name.endswith(("_sum", "_count")):
+                is_cumulative = False  # quantile gauges
+            if is_cumulative:
+                key = (name, tuple(sorted(tags)))
+                prev = self._prev.get(key)
+                self._prev[key] = value
+                if prev is None:
+                    continue  # first scrape: no delta yet
+                delta = value - prev
+                if delta < 0:
+                    delta = value  # counter reset: emit the new total
+                if delta == 0:
+                    continue
+                # keep fractional deltas (histogram/summary _sum series
+                # grow by fractions; int() would zero them forever)
+                m = UDPMetric(name=name, type="counter", value=delta,
+                              sample_rate=1.0)
+            else:
+                m = UDPMetric(name=name, type="gauge", value=float(value),
+                              sample_rate=1.0)
+            m.update_tags(tags, None)
+            ingest.ingest_metric(m)
+            n += 1
+        return n
+
+
+sources_mod.register_source("openmetrics")(OpenMetricsSource)

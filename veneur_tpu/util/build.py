@@ -1,0 +1,13 @@
+"""Build metadata (capability twin of `util/build/build.go:9-17`).
+
+The reference injects VERSION/BUILD_DATE via -ldflags at link time; here
+they are module constants, overridable via environment for packaged
+builds.
+"""
+
+from __future__ import annotations
+
+import os
+
+VERSION = os.environ.get("VENEUR_TPU_VERSION", "0.1.0-dev")
+BUILD_DATE = os.environ.get("VENEUR_TPU_BUILD_DATE", "unknown")
