@@ -91,6 +91,11 @@ class Config:
     tdigest_compression: float = 100.0
     set_precision: int = 14
     count_unique_timeseries: bool = False
+    # device mesh for the sharded serving flush (veneur_tpu/parallel/):
+    # 0 devices = single-device lanes; replicas 0 = auto (2 when even)
+    mesh_devices: int = 0
+    mesh_replicas: int = 0
+    ingest_lanes: int = 0           # 0 = auto (2 per replica)
 
     # ingest
     num_workers: int = 1

@@ -52,11 +52,14 @@ class MetricAggregator:
                  aggregates: sm.HistogramAggregates = sm.HistogramAggregates(),
                  compression: float = td.DEFAULT_COMPRESSION,
                  set_precision: int = hll_mod.DEFAULT_PRECISION,
-                 count_unique_timeseries: bool = False):
+                 count_unique_timeseries: bool = False,
+                 mesh=None, ingest_lanes: Optional[int] = None):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
-        self.digests = arena_mod.DigestArena(compression=compression)
+        self.mesh = mesh
+        self.digests = arena_mod.DigestArena(
+            compression=compression, mesh=mesh, n_lanes=ingest_lanes)
         self.sets = arena_mod.SetArena(precision=set_precision)
         self.counters = arena_mod.CounterArena()
         self.gauges = arena_mod.GaugeArena()
@@ -212,7 +215,9 @@ class MetricAggregator:
         snap["digests"] = {
             "rows": drows,
             "meta": [d.meta[r] for r in drows],
-            "state": d.eval_state(),     # immutable snapshot
+            # immutable device refs + scalar uploads for the SPMD flush
+            "lanes": d.snapshot_lanes(),
+            "flush_fn": d.flush_fn,
             "l_weight": d.l_weight[drows].copy(),
             "l_min": d.l_min[drows].copy(),
             "l_max": d.l_max[drows].copy(),
@@ -297,14 +302,18 @@ class MetricAggregator:
         rows = part["rows"]
         if len(rows) == 0:
             return
-        state: td.TDigestState = part["state"]
+        # One SPMD program call evaluates every key: lane reduce (replica-
+        # axis all_gather when meshed) -> batched compress -> quantiles.
+        # This IS the serving path of the north-star flush (flusher.go:26-122
+        # + worker.go:402-459 as one device program).
         pl = list(self.percentiles)
-        qs = np.asarray(td.quantile(state, np.asarray([0.5] + pl,
-                                                      np.float32)))
-        counts = np.asarray(td.total_weight(state))
-        sums = np.asarray(td.sum_values(state))
-        mean_np = np.asarray(state.mean)
-        weight_np = np.asarray(state.weight)
+        out = part["flush_fn"](
+            *part["lanes"], jnp.asarray([0.5] + pl, jnp.float32))
+        qs = np.asarray(out.quantiles)
+        counts = np.asarray(out.counts)
+        sums = np.asarray(out.sums)
+        mean_np = np.asarray(out.mean)
+        weight_np = np.asarray(out.weight)
 
         aggs = self.aggregates.value
         A = sm.Aggregate

@@ -35,6 +35,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu.parallel import serving
 from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
 from veneur_tpu.sketches import hll as hll_mod
 from veneur_tpu.sketches import tdigest as td
@@ -241,20 +242,54 @@ class SetArena(_ArenaBase):
 
 
 class DigestArena(_ArenaBase):
-    """All histogram/timer digests as one batched TDigestState.
+    """All histogram/timer digests as lane-striped batched centroid tensors.
 
-    Device state holds centroids; host numpy tracks the true digest scalars
-    (min/max/rsum — see module docstring) and the *local-samples-only*
-    scalar accumulators that back the mixed-scope flush duality
-    (`samplers/samplers.go:315-342`: LocalWeight/Min/Max/Sum/ReciprocalSum).
+    Device state is `[R, capacity, C]` mean/weight tensors — R independent
+    ingest *lanes* per key.  Sample waves stripe across lanes, which (a)
+    cuts a hot key's sequential compress-chain depth by R and (b) is the
+    replica axis of the sharded serving flush
+    (veneur_tpu/parallel/serving.py): with a device mesh, keys shard over
+    the 'shard' axis, lanes over 'replica', and the flush reduces lanes
+    with an ICI all_gather + batched compress — the production form of the
+    gRPC ImportMetric merge loop (`worker.go:402-459`).
+
+    Host numpy tracks the true digest scalars (min/max/rsum — see module
+    docstring) and the *local-samples-only* scalar accumulators that back
+    the mixed-scope flush duality (`samplers/samplers.go:315-342`:
+    LocalWeight/Min/Max/Sum/ReciprocalSum).
     """
 
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
-                 compression: float = td.DEFAULT_COMPRESSION):
+                 compression: float = td.DEFAULT_COMPRESSION,
+                 mesh=None, n_lanes: Optional[int] = None):
         super().__init__(capacity)
         self.compression = compression
         self.ccap = td.centroid_capacity(compression)
-        self.state = td.empty(capacity, compression, self.ccap)
+        self.mesh = mesh
+        if mesh is not None:
+            from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+            n_shards = mesh.shape[SHARD_AXIS]
+            n_replicas = mesh.shape[REPLICA_AXIS]
+            if capacity % n_shards:
+                raise ValueError(
+                    f"arena capacity {capacity} not divisible by "
+                    f"{n_shards} key shards")
+        else:
+            n_replicas = 1
+        # n_lanes None or <1 means auto (Config documents 0 as auto)
+        r = n_lanes if n_lanes and n_lanes > 0 else max(2, 2 * n_replicas)
+        # lanes must tile the replica axis evenly
+        r = ((r + n_replicas - 1) // n_replicas) * n_replicas
+        self.n_lanes = r
+        self._lane_shd = serving.lane_sharding(mesh)
+        self._row_shd = serving.row_sharding(mesh)
+        self._wave_shd = serving.row_sharding(mesh, ndim=2)
+        self.lanes_mean = serving.put(
+            np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
+        self.lanes_weight = serving.put(
+            np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
+        self.flush_fn = serving.make_flush(mesh, compression)
+        self._wave_seq = 0
         # true digest scalars (local samples + imports)
         self.d_min = np.full(capacity, np.inf)
         self.d_max = np.full(capacity, -np.inf)
@@ -272,13 +307,12 @@ class DigestArena(_ArenaBase):
         self._local: list[bool] = []
 
     def _grow_state(self, old: int) -> None:
-        new = td.empty(self.capacity, self.compression, self.ccap)
-        self.state = td.TDigestState(
-            mean=new.mean.at[:old].set(self.state.mean),
-            weight=new.weight.at[:old].set(self.state.weight),
-            min=new.min.at[:old].set(self.state.min),
-            max=new.max.at[:old].set(self.state.max),
-            rsum=new.rsum.at[:old].set(self.state.rsum))
+        nm = np.zeros((self.n_lanes, self.capacity, self.ccap), np.float32)
+        nw = np.zeros_like(nm)
+        nm[:, :old] = np.asarray(self.lanes_mean)
+        nw[:, :old] = np.asarray(self.lanes_weight)
+        self.lanes_mean = serving.put(nm, self._lane_shd)
+        self.lanes_weight = serving.put(nw, self._lane_shd)
         pad = lambda a, fill: np.concatenate(
             [a, np.full(old, fill, a.dtype)])
         self.d_min = pad(self.d_min, np.inf)
@@ -335,52 +369,52 @@ class DigestArena(_ArenaBase):
         with np.errstate(divide="ignore"):
             np.add.at(self.l_rsum, lr, lw / lv)
 
-        # dense waves: position of each sample within its row
+        # dense waves: position of each sample within its row.  Wave w goes
+        # to lane (seq + w) % R, so a hot key's waves run on independent
+        # lane chains instead of one sequential compress chain.
         order = np.argsort(rows, kind="stable")
         r, v, w = rows[order], vals[order], wts[order]
         first = np.searchsorted(r, np.arange(self.capacity))
         pos = np.arange(len(r)) - first[r]
         wave = pos // WAVE_WIDTH
         col = pos % WAVE_WIDTH
-        for wv in range(int(wave.max()) + 1):
+        n_waves = int(wave.max()) + 1
+        for wv in range(n_waves):
             m = wave == wv
             dv = np.zeros((self.capacity, WAVE_WIDTH), np.float32)
             dw = np.zeros((self.capacity, WAVE_WIDTH), np.float32)
             dv[r[m], col[m]] = v[m]
             dw[r[m], col[m]] = w[m]
-            self.state = td.ingest(self.state, jnp.asarray(dv),
-                                   jnp.asarray(dw), self.compression)
+            lane = (self._wave_seq + wv) % self.n_lanes
+            self.lanes_mean, self.lanes_weight = serving.lane_ingest(
+                self.lanes_mean, self.lanes_weight,
+                serving.put(dv, self._wave_shd),
+                serving.put(dw, self._wave_shd),
+                lane, self.compression)
+        self._wave_seq = (self._wave_seq + n_waves) % self.n_lanes
 
-    def eval_state(self) -> td.TDigestState:
-        """Device state with the authoritative host scalars pushed in."""
+    def snapshot_lanes(self) -> tuple:
+        """Immutable refs to the current lane tensors plus f32 copies of the
+        authoritative min/max scalars — everything the flush program needs
+        (rsum stays host-side; hmean is emitted from host scalars).  Call
+        under the aggregator lock, then `reset_rows`; emission evaluates the
+        snapshot outside the lock via `flush_fn`."""
         self.sync()
-        return self.state._replace(
-            min=jnp.asarray(self.d_min, jnp.float32),
-            max=jnp.asarray(self.d_max, jnp.float32),
-            rsum=jnp.asarray(self.d_rsum, jnp.float32))
-
-    def export_centroids(self, rows: np.ndarray
-                         ) -> list[tuple[np.ndarray, np.ndarray]]:
-        """(means, weights) per requested row, for forwarding."""
-        self.sync()
-        mean = np.asarray(self.state.mean)
-        weight = np.asarray(self.state.weight)
-        out = []
-        for row in rows:
-            occ = weight[row] > 0
-            out.append((mean[row][occ], weight[row][occ]))
-        return out
+        return (self.lanes_mean, self.lanes_weight,
+                serving.put(self.d_min.astype(np.float32), self._row_shd),
+                serving.put(self.d_max.astype(np.float32), self._row_shd))
 
     def reset_rows(self, rows: np.ndarray) -> None:
         if len(rows) == 0:
             return
-        idx = jnp.asarray(rows)
-        self.state = td.TDigestState(
-            mean=self.state.mean.at[idx].set(0.0),
-            weight=self.state.weight.at[idx].set(0.0),
-            min=self.state.min.at[idx].set(jnp.inf),
-            max=self.state.max.at[idx].set(-jnp.inf),
-            rsum=self.state.rsum.at[idx].set(0.0))
+        # pad to the next power of two (repeat of row 0) for jit-cache reuse
+        n = len(rows)
+        padded = 1 << (n - 1).bit_length() if n > 1 else 1
+        idx = np.empty(padded, np.int64)
+        idx[:n] = rows
+        idx[n:] = rows[0]
+        self.lanes_mean, self.lanes_weight = serving.reset_rows(
+            self.lanes_mean, self.lanes_weight, jnp.asarray(idx))
         self.d_min[rows] = np.inf
         self.d_max[rows] = -np.inf
         self.d_rsum[rows] = 0

@@ -71,12 +71,21 @@ class Server:
         self.config = cfg
         self.extend_tags = tagging.ExtendTags(cfg.extend_tags)
         self.parser = parser_mod.Parser(self.extend_tags)
+        # device mesh: the sharded serving flush runs over (shard, replica)
+        # when mesh_devices is set (the multi-chip production path)
+        self.mesh = None
+        if cfg.mesh_devices > 0:
+            from veneur_tpu.parallel import mesh as mesh_mod
+            self.mesh = mesh_mod.make_mesh(
+                cfg.mesh_devices, cfg.mesh_replicas or None)
         self.aggregator = MetricAggregator(
             percentiles=list(cfg.percentiles),
             aggregates=sm.parse_aggregates(cfg.aggregates),
             compression=cfg.tdigest_compression,
             set_precision=cfg.set_precision,
-            count_unique_timeseries=cfg.count_unique_timeseries)
+            count_unique_timeseries=cfg.count_unique_timeseries,
+            mesh=self.mesh,
+            ingest_lanes=cfg.ingest_lanes or None)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from veneur_tpu.parallel import serving
 from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
 from veneur_tpu.sketches import hll as hll_mod
 from veneur_tpu.sketches import tdigest as td
@@ -72,13 +73,9 @@ def _local_flush(inputs: FlushInputs, percentiles: jax.Array,
     """Per-shard flush body; `axis` names the replica mesh axis for
     collectives (None = no mesh, plain single-device math)."""
     if axis is not None:
-        # Reduce staged partials across the replica axis: every replica
-        # gathers all lanes' centroids, then compresses once.  R is small
-        # (ingest lanes), so the gathered width R_total*C stays modest.
-        in_means = jax.lax.all_gather(
-            inputs.in_means, axis, axis=0, tiled=True)
-        in_weights = jax.lax.all_gather(
-            inputs.in_weights, axis, axis=0, tiled=True)
+        # Reduce staged scalar partials across the replica axis; the
+        # centroid-lane gather happens inside serving.reduce_eval (the
+        # shared digest-flush core used by the serving path too).
         in_min = jax.lax.pmin(jnp.min(inputs.in_min, axis=0), axis)
         in_max = jax.lax.pmax(jnp.max(inputs.in_max, axis=0), axis)
         in_rsum = jax.lax.psum(jnp.sum(inputs.in_rsum, axis=0), axis)
@@ -86,8 +83,6 @@ def _local_flush(inputs: FlushInputs, percentiles: jax.Array,
         counter_totals = jax.lax.psum(jnp.sum(inputs.counters, axis=0), axis)
         uts = jax.lax.pmax(jnp.max(inputs.uts_regs, axis=0), axis)
     else:
-        in_means = inputs.in_means
-        in_weights = inputs.in_weights
         in_min = jnp.min(inputs.in_min, axis=0)
         in_max = jnp.max(inputs.in_max, axis=0)
         in_rsum = jnp.sum(inputs.in_rsum, axis=0)
@@ -95,16 +90,15 @@ def _local_flush(inputs: FlushInputs, percentiles: jax.Array,
         counter_totals = jnp.sum(inputs.counters, axis=0)
         uts = jnp.max(inputs.uts_regs, axis=0)
 
-    state = td.TDigestState(
-        mean=inputs.state_mean, weight=inputs.state_weight,
-        min=inputs.state_min, max=inputs.state_max, rsum=inputs.state_rsum)
-    merged = td.merge_stacked(
-        state, in_means, in_weights,
-        in_min[None, :], in_max[None, :], in_rsum[None, :], compression)
+    new_min = jnp.minimum(inputs.state_min, in_min)
+    new_max = jnp.maximum(inputs.state_max, in_max)
+    new_rsum = inputs.state_rsum + in_rsum
+    merged = serving.reduce_eval(
+        inputs.in_means, inputs.in_weights,
+        new_min, new_max, new_rsum,
+        percentiles, compression, axis,
+        state_mean=inputs.state_mean, state_weight=inputs.state_weight)
 
-    qs = td.quantile(merged, percentiles)
-    counts = td.total_weight(merged)
-    sums = td.sum_values(merged)
     set_est = hll_mod.estimate(hll_regs)
 
     if axis is not None:
@@ -114,8 +108,8 @@ def _local_flush(inputs: FlushInputs, percentiles: jax.Array,
 
     return FlushOutputs(
         new_mean=merged.mean, new_weight=merged.weight,
-        new_min=merged.min, new_max=merged.max, new_rsum=merged.rsum,
-        quantiles=qs, counts=counts, sums=sums,
+        new_min=new_min, new_max=new_max, new_rsum=new_rsum,
+        quantiles=merged.quantiles, counts=merged.counts, sums=merged.sums,
         counter_totals=counter_totals, set_estimates=set_est,
         unique_ts=uts_est)
 
@@ -138,8 +132,6 @@ def make_sharded_flush_step(mesh: Mesh,
       uts_regs [R, m]:     P(replica)
       outputs:             P(shard) / replicated scalars
     """
-    from jax.experimental.shard_map import shard_map
-
     spec_k = P(SHARD_AXIS)
     spec_kc = P(SHARD_AXIS, None)
     spec_rkc = P(REPLICA_AXIS, SHARD_AXIS, None)
@@ -164,8 +156,8 @@ def make_sharded_flush_step(mesh: Mesh,
     def body(inputs: FlushInputs, percentiles: jax.Array) -> FlushOutputs:
         return _local_flush(inputs, percentiles, compression, REPLICA_AXIS)
 
-    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_rep=False)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
     return jax.jit(fn)
 
 

@@ -1,0 +1,186 @@
+"""The serving-path SPMD programs: lane ingest + the per-flush digest reduce.
+
+This wires the sharded flush (veneur_tpu/parallel/flush_step.py) into the
+*production* aggregation tier: `DigestArena` keeps its centroid state as
+lane-striped device tensors `[R, K, C]` (R ingest lanes x K keys x C
+centroid slots), sharded over a (shard, replica) `Mesh` when one is
+configured —
+
+  - the **shard** axis partitions the key space K (the device analog of the
+    reference's fnv1a-hash worker sharding, `server.go:997-1011` /
+    `worker.go:34-50`, and of the proxy's consistent-hash ring);
+  - the **replica** axis partitions the R ingest lanes, so each replica
+    group accumulates a subset of lanes' partial digests and the flush
+    reduces them with an `all_gather` over ICI followed by one batched
+    compress — the collective form of the gRPC ImportMetric merge loop
+    (`worker.go:402-459`).
+
+Three programs:
+
+  * `lane_ingest`   — fold one dense sample wave `[K, W]` into lane r of the
+                      striped state (the device half of `DigestArena.sync`).
+                      Striping waves across lanes both feeds the replica
+                      axis and cuts the sequential kernel-launch depth for a
+                      hot key by R (each lane's chain is independent).
+  * `make_flush`    — build the per-flush evaluation: gather lanes over the
+                      replica axis, merge into one digest per key, evaluate
+                      all percentiles/aggregates at once.  With `mesh=None`
+                      this is the same math under plain `jit` on the default
+                      device, so single-chip and multi-chip serving share
+                      one code path.
+  * `reset_rows`    — zero the touched rows across every lane after flush
+                      (the map-swap of `worker.go:462-481`; rows persist,
+                      state is interval-scoped).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+from veneur_tpu.sketches import tdigest as td
+
+
+class ServingFlushOutputs(NamedTuple):
+    mean: jax.Array       # [K, C] merged centroids (forwarding export)
+    weight: jax.Array     # [K, C]
+    quantiles: jax.Array  # [K, P]
+    counts: jax.Array     # [K] total weight
+    sums: jax.Array       # [K] weighted sum
+
+
+# ---------------------------------------------------------------------------
+# Shardings
+# ---------------------------------------------------------------------------
+
+def lane_sharding(mesh: Optional[Mesh]):
+    """[R, K, C] lane-striped state: lanes over 'replica', keys over
+    'shard'."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(REPLICA_AXIS, SHARD_AXIS, None))
+
+
+def row_sharding(mesh: Optional[Mesh], ndim: int = 1):
+    """[K, ...] per-key arrays: keys over 'shard'."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(SHARD_AXIS, *([None] * (ndim - 1))))
+
+
+def put(x, sharding):
+    x = jnp.asarray(x)
+    return x if sharding is None else jax.device_put(x, sharding)
+
+
+# ---------------------------------------------------------------------------
+# Lane ingest
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("lane", "compression"),
+                   donate_argnums=(0, 1))
+def lane_ingest(lanes_mean: jax.Array, lanes_weight: jax.Array,
+                values: jax.Array, vweights: jax.Array,
+                lane: int, compression: float
+                ) -> tuple[jax.Array, jax.Array]:
+    """Fold a dense sample wave `[K, W]` into lane `lane` of `[R, K, C]`.
+
+    Device half of `MergingDigest.Add`/`mergeAllTemps`
+    (`merging_digest.go:115-224`) batched over all keys; min/max/rsum are
+    tracked host-side by the arena (they are authoritative there — see
+    DigestArena docstring) so only centroids live here.
+    """
+    cap = lanes_mean.shape[2]
+    cat_m = jnp.concatenate([lanes_mean[lane], values], axis=1)
+    cat_w = jnp.concatenate([lanes_weight[lane], vweights], axis=1)
+    nm, nw = td.compress(cat_m, cat_w, compression, cap)
+    return lanes_mean.at[lane].set(nm), lanes_weight.at[lane].set(nw)
+
+
+@jax.jit
+def reset_rows(lanes_mean: jax.Array, lanes_weight: jax.Array,
+               rows: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Zero the given key rows in every lane.  NOT donating: the flush
+    snapshot may still reference the pre-reset buffers while emission runs
+    outside the aggregator lock."""
+    return (lanes_mean.at[:, rows].set(0.0),
+            lanes_weight.at[:, rows].set(0.0))
+
+
+# ---------------------------------------------------------------------------
+# Flush evaluation
+# ---------------------------------------------------------------------------
+
+def reduce_eval(lanes_mean, lanes_weight, d_min, d_max, d_rsum,
+                percentiles, compression, replica_axis,
+                state_mean=None, state_weight=None) -> ServingFlushOutputs:
+    """THE digest-flush core, shared by the serving path and the benchmark
+    flush_step: all_gather lanes over the replica axis -> one batched
+    compress (optionally folding a persistent [K, C] state in) -> evaluate
+    quantiles/counts/sums for every key at once.
+
+    `replica_axis` names the mesh axis to gather over (None = single
+    device).  The merged min/max/rsum come from the caller's authoritative
+    scalars (re-ingested centroid means never reach the true extremes —
+    `worker.go:402-459` semantics); pass zeros for rsum if the caller
+    tracks it host-side (no device computation consumes it).
+    """
+    if replica_axis is not None:
+        lanes_mean = jax.lax.all_gather(
+            lanes_mean, replica_axis, axis=0, tiled=True)
+        lanes_weight = jax.lax.all_gather(
+            lanes_weight, replica_axis, axis=0, tiled=True)
+    k = lanes_mean.shape[1]
+    cap = lanes_mean.shape[2]
+    flat_m = jnp.transpose(lanes_mean, (1, 0, 2)).reshape(k, -1)
+    flat_w = jnp.transpose(lanes_weight, (1, 0, 2)).reshape(k, -1)
+    if state_mean is not None:
+        flat_m = jnp.concatenate([state_mean, flat_m], axis=1)
+        flat_w = jnp.concatenate([state_weight, flat_w], axis=1)
+    mm, mw = td.compress(flat_m, flat_w, compression, cap)
+    merged = td.TDigestState(mean=mm, weight=mw,
+                             min=d_min, max=d_max, rsum=d_rsum)
+    return ServingFlushOutputs(
+        mean=mm, weight=mw,
+        quantiles=td.quantile(merged, percentiles),
+        counts=td.total_weight(merged),
+        sums=td.sum_values(merged))
+
+
+def make_flush(mesh: Optional[Mesh],
+               compression: float = td.DEFAULT_COMPRESSION):
+    """Build the per-flush program.
+
+    Returns fn(lanes_mean [R,K,C], lanes_weight, d_min [K], d_max,
+    percentiles [P]) -> ServingFlushOutputs.  With a mesh, the function is a
+    shard_map'd SPMD program (keys sharded, lanes gathered over the replica
+    axis); without, the identical math under plain jit.  rsum stays
+    host-side (hmean is emitted from host scalars; no device computation
+    needs it).
+    """
+    def body_for(axis):
+        def body(lanes_mean, lanes_weight, d_min, d_max, percentiles):
+            return reduce_eval(lanes_mean, lanes_weight, d_min, d_max,
+                               jnp.zeros_like(d_min), percentiles,
+                               compression, axis)
+        return body
+
+    if mesh is None:
+        return jax.jit(body_for(None))
+
+    spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
+    spec_k = P(SHARD_AXIS)
+    spec_kc = P(SHARD_AXIS, None)
+    fn = jax.shard_map(
+        body_for(REPLICA_AXIS), mesh=mesh,
+        in_specs=(spec_lanes, spec_lanes, spec_k, spec_k, P(None)),
+        out_specs=ServingFlushOutputs(
+            mean=spec_kc, weight=spec_kc, quantiles=spec_kc,
+            counts=spec_k, sums=spec_k),
+        check_vma=False)
+    return jax.jit(fn)
