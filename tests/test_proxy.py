@@ -93,8 +93,10 @@ def test_proxy_fan_in_two_globals():
             while not s2.queue.empty():
                 got2.extend(s2.queue.get())
             time.sleep(0.05)
-        names1 = {m.name for m in got1}
-        names2 = {m.name for m in got2}
+        # filter out the servers' own flush-span telemetry (the flush is
+        # itself traced and extracted back into metrics)
+        names1 = {m.name for m in got1 if m.name.startswith("m")}
+        names2 = {m.name for m in got2 if m.name.startswith("m")}
         assert len(names1 | names2) == 200
         assert not (names1 & names2)  # each key on exactly one global
         assert names1 and names2      # both globals participated

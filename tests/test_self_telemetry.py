@@ -1,0 +1,170 @@
+"""Self-telemetry: the flush emits the standard statsd self-metrics and is
+itself traced as a span through the server's own pipeline.
+
+Mirrors the reference's flush accounting (`flusher.go:27,42-44,150-229,
+455-475`, `worker.go:477`) and the traced flush
+(`flusher.go:26-34`, forward sub-timings `flusher.go:530-574`).
+"""
+
+import queue
+import socket
+import time
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks import simple as simple_sinks
+
+
+class FakeStatsd:
+    """Capture scopedstatsd calls as (method, name, value, tags)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def _rec(self, method, name, value, tags):
+        self.calls.append((method, name, value, tuple(tags or [])))
+
+    def count(self, name, value, tags=None, rate=1.0):
+        self._rec("count", name, value, tags)
+
+    def incr(self, name, tags=None, rate=1.0):
+        self._rec("count", name, 1, tags)
+
+    def gauge(self, name, value, tags=None, rate=1.0):
+        self._rec("gauge", name, value, tags)
+
+    def histogram(self, name, value, tags=None, rate=1.0):
+        self._rec("histogram", name, value, tags)
+
+    def timing(self, name, ms, tags=None, rate=1.0):
+        self._rec("timing", name, ms, tags)
+
+    def set(self, name, member, tags=None, rate=1.0):
+        self._rec("set", name, member, tags)
+
+    def close(self):
+        pass
+
+    def by_name(self, name):
+        return [c for c in self.calls if c[1] == name]
+
+
+@pytest.fixture
+def telemetry_server():
+    cfg = config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        interval=0.05, percentiles=[0.5],
+        aggregates=["min", "max", "count"],
+        hostname="telem", count_unique_timeseries=True)
+    msink = simple_sinks.ChannelMetricSink()
+    ssink = simple_sinks.ChannelSpanSink()
+    srv = Server(cfg, extra_metric_sinks=[msink],
+                 extra_span_sinks=[ssink])
+    srv.statsd = FakeStatsd()
+    srv.start()
+    yield srv, msink, ssink
+    srv.shutdown()
+
+
+def _send_udp(srv, payload: bytes):
+    _, addr = srv.statsd_addrs[0]
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(payload, addr)
+    s.close()
+
+
+def _wait_processed(srv, n, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if srv.aggregator.processed >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError("packets not processed in time")
+
+
+def test_flush_emits_self_metrics(telemetry_server):
+    srv, msink, _ = telemetry_server
+    _send_udp(srv, b"a:1|c\nb:2.5|g\nlat:3|h")
+    _wait_processed(srv, 3)
+    srv.flush()
+
+    stats = srv.statsd
+    # worker.metrics_processed_total (worker.go:477)
+    processed = stats.by_name("worker.metrics_processed_total")
+    assert processed and processed[0][2] == 3
+    # listen.received_per_protocol_total tagged with the protocol
+    # (flusher.go:280,455-475) — one UDP datagram was received
+    per_proto = stats.by_name("listen.received_per_protocol_total")
+    assert any(v == 1 and "protocol:udp" in tags
+               for (_, _, v, tags) in per_proto)
+    # flush.unique_timeseries_total (flusher.go:42-44): 3 distinct series
+    uts = stats.by_name("flush.unique_timeseries_total")
+    assert uts and uts[0][2] == 3
+    # per-sink flushed_metrics accounting (flusher.go:215-229)
+    flushed = [c for c in stats.by_name("flushed_metrics")
+               if "status:flushed" in c[3]]
+    assert flushed and any(v > 0 for (_, _, v, _) in flushed)
+    # per-sink flush duration timer (sinks.MetricKeyMetricFlushDuration)
+    assert stats.by_name("sink.metric_flush_total_duration_ms")
+    # second flush resets the per-interval tallies
+    srv.flush()
+    per_proto2 = stats.by_name("listen.received_per_protocol_total")
+    assert len(per_proto2) == len(per_proto)  # no new UDP packets counted
+    # counting keeps working after the drain swap (the reader must not
+    # hold a reference to the drained Counter)
+    _send_udp(srv, b"c:1|c")
+    _wait_processed(srv, 1)  # processed counter was reset by the flushes
+    srv.flush()
+    per_proto3 = stats.by_name("listen.received_per_protocol_total")
+    assert len(per_proto3) == len(per_proto2) + 1
+    assert per_proto3[-1][2] == 1 and "protocol:udp" in per_proto3[-1][3]
+
+
+def test_flush_is_traced_as_span(telemetry_server):
+    srv, _, ssink = telemetry_server
+    _send_udp(srv, b"x:1|c")
+    _wait_processed(srv, 1)
+    srv.flush()
+    # the flush span loops back through the trace client into the span
+    # pipeline and lands in every span sink (flusher.go:26-34)
+    deadline = time.time() + 5.0
+    names = []
+    while time.time() < deadline:
+        try:
+            span = ssink.queue.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        names.append(span.name)
+        if span.name == "flush":
+            assert span.service == "veneur_tpu"
+            sample_names = [s.name for s in span.metrics]
+            assert "flush.total_duration_ns" in sample_names
+            return
+    raise AssertionError(f"no flush span observed; saw {names}")
+
+
+def test_forward_subspan_records_timing(telemetry_server):
+    srv, _, ssink = telemetry_server
+    # make the server local with an injected forwarder
+    forwarded = []
+    srv.forwarder = forwarded.extend
+    srv.config.forward_address = "fake:1"
+    _send_udp(srv, b"hist:3|h")  # mixed-scope histogram -> forwarded
+    _wait_processed(srv, 1)
+    srv.flush()
+    assert len(forwarded) >= 0  # forward happens async
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        try:
+            span = ssink.queue.get(timeout=0.2)
+        except queue.Empty:
+            continue
+        if span.name == "flush.forward":
+            sample_names = [s.name for s in span.metrics]
+            assert "forward.duration_ns" in sample_names
+            assert "forward.metrics_total" in sample_names
+            assert forwarded  # the batch reached the injected forwarder
+            return
+    raise AssertionError("no flush.forward span observed")

@@ -1,0 +1,97 @@
+"""Span-pipeline isolation: a hung span sink must not stall the others.
+
+The reference gives each sink a goroutine with a 9s ingest timeout per
+span (`worker.go:603-652`); here each sink owns a bounded queue + drain
+thread, so a hung sink fills only its own queue (dropping with
+accounting) while other sinks keep receiving.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import ssf as ssf_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks import simple as simple_sinks
+
+
+class HungSpanSink(simple_sinks.ChannelSpanSink):
+    """Blocks forever on the first ingest."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.block = threading.Event()
+        self.entered = threading.Event()
+
+    def ingest(self, span):
+        self.entered.set()
+        self.block.wait()  # released only at test teardown
+
+
+@pytest.fixture
+def span_server():
+    cfg = config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        interval=0.05, percentiles=[0.5], hostname="spans",
+        span_channel_capacity=8)
+    good = simple_sinks.ChannelSpanSink()
+    hung = HungSpanSink()
+    srv = Server(cfg, extra_metric_sinks=[simple_sinks.ChannelMetricSink()],
+                 extra_span_sinks=[good, hung])
+    srv.start()
+    yield srv, good, hung
+    hung.block.set()
+    srv.shutdown()
+
+
+def mk_span(i: int):
+    return ssf_mod.SSFSpan(version=0, trace_id=1, id=i + 1,
+                           start_timestamp=1, end_timestamp=2,
+                           service="t", name=f"op{i}")
+
+
+def test_hung_sink_does_not_stall_others(span_server):
+    srv, good, hung = span_server
+    n = 64  # well past the hung sink's queue capacity of 8
+    for i in range(n):
+        srv.handle_span(mk_span(i))
+        # pace the producer so the healthy sink's drain thread keeps up;
+        # the hung sink still can't (its thread is parked in ingest)
+        time.sleep(0.002)
+    assert hung.entered.wait(5.0)
+
+    # every span still reaches the healthy sink
+    got = []
+    deadline = time.time() + 10.0
+    while len(got) < n and time.time() < deadline:
+        try:
+            got.append(good.queue.get(timeout=0.2))
+        except queue.Empty:
+            continue
+    assert len(got) == n
+
+    # the hung sink dropped the overflow beyond its queue (+ the one
+    # span stuck inside ingest) and the drop is accounted
+    deadline = time.time() + 5.0
+    while time.time() < deadline and srv.spans_dropped == 0:
+        time.sleep(0.01)
+    assert srv.spans_dropped >= n - srv.config.span_channel_capacity - 1
+
+    # accounting is drained into interval stats for self-metrics
+    hung_worker = next(w for w in srv.span_workers if w.sink is hung)
+    _, dropped, _, _ = hung_worker.interval_stats()
+    assert dropped == hung_worker.dropped
+
+
+def test_span_ingest_duration_tracked(span_server):
+    srv, good, _ = span_server
+    srv.handle_span(mk_span(0))
+    good_worker = next(w for w in srv.span_workers if w.sink is good)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and good_worker.ingested == 0:
+        time.sleep(0.01)
+    assert good_worker.ingested >= 1
+    assert good_worker.ingest_duration_ns > 0

@@ -44,6 +44,9 @@ class FlushResult:
     forward: list[sm.ForwardMetric] = field(default_factory=list)
     processed: int = 0
     imported: int = 0
+    # HLL estimate of distinct timeseries this interval, or None when
+    # count_unique_timeseries is off (flusher.go:42-44)
+    unique_ts: Optional[int] = None
 
 
 class MetricAggregator:
@@ -53,7 +56,8 @@ class MetricAggregator:
                  compression: float = td.DEFAULT_COMPRESSION,
                  set_precision: int = hll_mod.DEFAULT_PRECISION,
                  count_unique_timeseries: bool = False,
-                 mesh=None, ingest_lanes: Optional[int] = None):
+                 mesh=None, ingest_lanes: Optional[int] = None,
+                 is_local: bool = True):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -68,6 +72,7 @@ class MetricAggregator:
         self.imported = 0
         self.count_unique_timeseries = count_unique_timeseries
         self.unique_ts = hll_mod.HLLSketch() if count_unique_timeseries else None
+        self.is_local = is_local
 
     # -- ingest (ProcessMetric, worker.go:348-396) -------------------------
 
@@ -113,7 +118,11 @@ class MetricAggregator:
 
     def _sample_timeseries(self, m: UDPMetric) -> None:
         """Unique-timeseries HLL counting (worker.go:301-345): sample iff
-        the series is finalized on this instance."""
+        the series is finalized on this instance — always on a global
+        instance (worker.go:310-314), else only non-forwarded types."""
+        if not self.is_local:
+            self.unique_ts.insert(m.digest.to_bytes(8, "little"))
+            return
         local_types = {
             sm.TYPE_COUNTER: m.scope != MetricScope.GLOBAL_ONLY,
             sm.TYPE_GAUGE: m.scope != MetricScope.GLOBAL_ONLY,
@@ -167,6 +176,8 @@ class MetricAggregator:
         with self.lock:
             snap = self._snapshot_and_reset()
             res.processed, res.imported = snap.pop("counts")
+        if "unique_ts" in snap:
+            res.unique_ts = snap["unique_ts"].estimate()
 
         self._emit_counters(res, snap, is_local, now)
         self._emit_gauges(res, snap, is_local, now)

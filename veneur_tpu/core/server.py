@@ -16,6 +16,7 @@ see veneur_tpu/parallel/).
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import logging
 import os
@@ -47,6 +48,68 @@ def parse_listen_addr(addr: str) -> tuple[str, str]:
 def _split_hostport(rest: str) -> tuple[str, int]:
     host, _, port = rest.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+class _SpanSinkWorker:
+    """One span sink's bounded queue + drain thread(s).
+
+    The isolation analog of the reference SpanWorker's per-sink goroutine
+    with a 9s ingest timeout (`worker.go:603-652`): each sink drains its
+    own queue, so a hung or slow sink blocks only itself — its queue fills
+    and further spans are dropped with accounting, while every other sink
+    keeps receiving.  Per-sink cumulative ingest time backs the
+    `sink.span_ingest_total_duration_ns` metric (worker.go:647-652)."""
+
+    def __init__(self, sink, capacity: int, n_threads: int,
+                 shutdown: threading.Event):
+        import queue as queue_mod
+        self.sink = sink
+        self.queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=capacity)
+        self.dropped = 0
+        self.ingested = 0
+        self.errors = 0
+        self.ingest_duration_ns = 0
+        self._reported = (0, 0, 0, 0)
+        self._shutdown = shutdown
+        self.threads = []
+        for i in range(max(1, n_threads)):
+            t = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"span-sink-{sink.name()}-{i}")
+            t.start()
+            self.threads.append(t)
+
+    def submit(self, span) -> None:
+        try:
+            self.queue.put_nowait(span)
+        except Exception:
+            self.dropped += 1
+
+    def interval_stats(self) -> tuple[int, int, int, int]:
+        """(ingested, dropped, errors, duration_ns) since last call."""
+        cur = (self.ingested, self.dropped, self.errors,
+               self.ingest_duration_ns)
+        delta = tuple(c - p for c, p in zip(cur, self._reported))
+        self._reported = cur
+        return delta
+
+    def _run(self) -> None:
+        import queue as queue_mod
+        while not self._shutdown.is_set():
+            try:
+                span = self.queue.get(timeout=0.1)
+            except queue_mod.Empty:
+                continue
+            t0 = time.perf_counter_ns()
+            try:
+                self.sink.ingest(span)
+                self.ingested += 1
+            except Exception as e:
+                self.errors += 1
+                logger.warning("span sink %s ingest error: %s",
+                               self.sink.name(), e)
+            finally:
+                self.ingest_duration_ns += time.perf_counter_ns() - t0
 
 
 class _IngestShim:
@@ -85,7 +148,8 @@ class Server:
             set_precision=cfg.set_precision,
             count_unique_timeseries=cfg.count_unique_timeseries,
             mesh=self.mesh,
-            ingest_lanes=cfg.ingest_lanes or None)
+            ingest_lanes=cfg.ingest_lanes or None,
+            is_local=cfg.is_local)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -114,12 +178,10 @@ class Server:
         self._events: list[parser_mod.SSFSample] = []
         self._events_lock = threading.Lock()
 
-        # span pipeline: bounded queue drained by span workers
-        # (SpanChan + SpanWorker, worker.go:539-654)
-        import queue as queue_mod
-        self.span_queue: "queue_mod.Queue" = queue_mod.Queue(
-            maxsize=cfg.span_channel_capacity)
-        self.spans_dropped = 0
+        # span pipeline: per-sink bounded queues, each drained by its own
+        # worker thread(s) (SpanChan + SpanWorker with per-sink isolation,
+        # worker.go:539-654)
+        self.span_workers: list[_SpanSinkWorker] = []
         self.ssf_received = 0
 
         # self-telemetry loops back into our own span pipeline
@@ -146,6 +208,10 @@ class Server:
             thread_name_prefix="flush")
         self.last_flush_unix = time.time()
         self.flush_count = 0
+        # per-protocol received-packet tallies, drained each flush into
+        # listen.received_per_protocol_total (flusher.go:280,455-475).
+        # Plain int increments; GIL-atomic enough for telemetry.
+        self.proto_received: collections.Counter = collections.Counter()
         # Bounded-concurrency forwarding: the reference gives each flush its
         # own goroutine with a one-interval ctx deadline (flusher.go:81-86),
         # so in-flight forwards are implicitly bounded by deadline/interval.
@@ -210,17 +276,21 @@ class Server:
             self._start_statsd(addr)
         for addr in self.config.ssf_listen_addresses:
             self._start_ssf(addr)
-        for i in range(max(1, self.config.num_span_workers)):
-            t = threading.Thread(target=self._span_worker, daemon=True,
-                                 name=f"span-worker-{i}")
-            t.start()
-            self._threads.append(t)
+        for sink in self.span_sinks:
+            self.span_workers.append(_SpanSinkWorker(
+                sink, self.config.span_channel_capacity,
+                self.config.num_span_workers, self._shutdown))
         if self.config.grpc_address:
             # global tier: gRPC import source (server.go:673-682)
             from veneur_tpu.sources.proxy import GrpcImportServer
+
+            def _import_counted(fm):
+                self.proto_received["grpc"] += 1
+                self.aggregator.import_metric(fm)
+
             self.grpc_import = GrpcImportServer(
                 self.config.grpc_address,
-                self.aggregator.import_metric,
+                _import_counted,
                 ingest_span=self.handle_span,
                 handle_packet=self.process_packet_buffer)
             self.grpc_import.start()
@@ -304,7 +374,8 @@ class Server:
             self._listeners.append(sock)
             ctx = self._tls_context() if (
                 scheme == "tcp+tls" or self.config.tls_key) else None
-            t = threading.Thread(target=self._accept_tcp, args=(sock, ctx),
+            t = threading.Thread(target=self._accept_tcp,
+                                 args=(sock, ctx, "tcp"),
                                  daemon=True, name="statsd-tcp")
             t.start()
             self._threads.append(t)
@@ -316,7 +387,8 @@ class Server:
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
             sock.bind(path)
             self._listeners.append(sock)
-            t = threading.Thread(target=self._read_udp, args=(sock,),
+            t = threading.Thread(target=self._read_udp,
+                                 args=(sock, "unixgram"),
                                  daemon=True, name="statsd-unixgram")
             t.start()
             self._threads.append(t)
@@ -329,7 +401,8 @@ class Server:
             sock.bind(path)
             sock.listen(128)
             self._listeners.append(sock)
-            t = threading.Thread(target=self._accept_tcp, args=(sock, None),
+            t = threading.Thread(target=self._accept_tcp,
+                                 args=(sock, None, "unix"),
                                  daemon=True, name="statsd-unix")
             t.start()
             self._threads.append(t)
@@ -348,7 +421,7 @@ class Server:
             ctx.verify_mode = ssl.CERT_REQUIRED
         return ctx
 
-    def _read_udp(self, sock: socket.socket) -> None:
+    def _read_udp(self, sock: socket.socket, proto: str = "udp") -> None:
         # +1 so an oversized datagram still trips the too-long guard
         # instead of being silently truncated into a parseable prefix
         # (the reference allocates metricMaxLength+1, server.go:734).
@@ -359,17 +432,22 @@ class Server:
             except OSError:
                 return
             if data:
+                # always through the attribute: flush() swaps in a fresh
+                # Counter each interval, so a cached reference would be
+                # orphaned after the first drain
+                self.proto_received[proto] += 1
                 self.process_packet_buffer(data)
 
     def _accept_tcp(self, sock: socket.socket,
-                    ctx: Optional[ssl.SSLContext]) -> None:
+                    ctx: Optional[ssl.SSLContext],
+                    proto: str = "tcp") -> None:
         while not self._shutdown.is_set():
             try:
                 conn, _ = sock.accept()
             except OSError:
                 return
             t = threading.Thread(target=self._read_stream,
-                                 args=(conn, ctx), daemon=True)
+                                 args=(conn, ctx, proto), daemon=True)
             t.start()
 
     # idle timeout for stream connections (the reference arms a read
@@ -386,7 +464,8 @@ class Server:
             self._stream_conns.discard(conn)
 
     def _read_stream(self, conn: socket.socket,
-                     ctx: Optional[ssl.SSLContext]) -> None:
+                     ctx: Optional[ssl.SSLContext],
+                     proto: str = "tcp") -> None:
         max_line = max(65536, self.config.metric_max_length)
         raw_conn = conn
         self._track_conn(raw_conn)
@@ -403,6 +482,7 @@ class Server:
                 *lines, buf = buf.split(b"\n")
                 for line in lines:
                     if line:
+                        self.proto_received[proto] += 1
                         self.handle_metric_packet(line)
                 if len(buf) > max_line:
                     # a line that never ends: drop the connection rather
@@ -437,24 +517,21 @@ class Server:
         self.handle_span(span)
 
     def handle_span(self, span) -> None:
-        """Enqueue for the span workers (handleSSF, server.go:1046-1093);
-        drops when the channel is at capacity."""
+        """Fan one span out to every span sink's queue (handleSSF,
+        server.go:1046-1093 + SpanWorker fan-out, worker.go:603-652);
+        a full sink queue drops for that sink only."""
         self.ssf_received += 1
-        try:
-            self.span_queue.put_nowait(span)
-        except Exception:
-            self.spans_dropped += 1
-
-    def _span_worker(self) -> None:
-        """Drain the span queue into every span sink
-        (SpanWorker.Work, worker.go:579-654)."""
-        import queue as queue_mod
-        while not self._shutdown.is_set():
-            try:
-                span = self.span_queue.get(timeout=0.1)
-            except queue_mod.Empty:
-                continue
+        if self.span_workers:
+            for w in self.span_workers:
+                w.submit(span)
+        else:
+            # not started yet (or no sinks): synchronous fallback so tests
+            # and pre-start self-telemetry are not silently lost
             self.ingest_span(span)
+
+    @property
+    def spans_dropped(self) -> int:
+        return sum(w.dropped for w in self.span_workers)
 
     def ingest_span(self, span) -> None:
         for sink in self.span_sinks:
@@ -516,6 +593,7 @@ class Server:
             except OSError:
                 return
             if data:
+                self.proto_received["ssf-udp"] += 1
                 self.handle_trace_packet(data)
 
     def _accept_ssf(self, sock: socket.socket) -> None:
@@ -543,6 +621,7 @@ class Server:
                 span = ssf_mod.read_ssf(f)
                 if span is None:
                     return
+                self.proto_received["ssf-stream"] += 1
                 self.handle_span(span)
         except ssf_mod.FramingError as e:
             # the stream is poisoned; close it (protocol/wire.go:26-28)
@@ -559,9 +638,48 @@ class Server:
     # -- flush (flusher.go:26-122) ----------------------------------------
 
     def flush(self) -> None:
+        """One flush interval, traced as a span through the server's own
+        pipeline (flusher.go:26-122: Flush is itself a span, and the flush
+        path reports the standard self-metrics)."""
+        from veneur_tpu import scopedstatsd
+        from veneur_tpu import ssf as ssf_mod
+
         self.last_flush_unix = time.time()
+        statsd = scopedstatsd.ensure(self.statsd)
+        span = self.trace_client.span(
+            "flush", service="veneur_tpu",
+            tags={"veneurglobalonly": str(not self.is_local).lower()})
+        flush_start = time.perf_counter()
+
         res = self.aggregator.flush(is_local=self.is_local)
         self.flush_count += 1
+
+        # worker.metrics_processed_total (worker.go:477)
+        statsd.count("worker.metrics_processed_total",
+                     res.processed + res.imported)
+        # flush.unique_timeseries_total (flusher.go:42-44)
+        if res.unique_ts is not None:
+            statsd.count("flush.unique_timeseries_total", res.unique_ts,
+                         tags=["global_veneur:"
+                               + str(not self.is_local).lower()])
+        # listen.received_per_protocol_total (flusher.go:280,455-475)
+        drained, self.proto_received = (self.proto_received,
+                                        collections.Counter())
+        for proto, n in drained.items():
+            statsd.count("listen.received_per_protocol_total", n,
+                         tags=[f"protocol:{proto}"])
+        statsd.count("spans.received_total", self.ssf_received)
+        self.ssf_received = 0
+        # per-span-sink ingest accounting (worker.go:603-678)
+        for w in self.span_workers:
+            ingested, dropped, errors, dur_ns = w.interval_stats()
+            stags = [f"sink:{w.sink.name()}"]
+            statsd.count("worker.span.ingested_total", ingested, tags=stags)
+            statsd.count(sink_mod.SPANS_DROPPED_TOTAL, dropped, tags=stags)
+            if errors:
+                statsd.count("worker.span.ingest_errors_total", errors,
+                             tags=stags)
+            statsd.timing(sink_mod.SPAN_INGEST_DURATION, dur_ns, tags=stags)
 
         with self._events_lock:
             events, self._events = self._events, []
@@ -579,42 +697,124 @@ class Server:
             if self._forward_slots.acquire(blocking=False):
                 try:
                     futures.append(self._flush_pool.submit(
-                        self._forward_safely, res.forward))
+                        self._forward_safely, res.forward, span))
                 except RuntimeError:  # pool shut down mid-flush
                     self._forward_slots.release()
             else:
                 # all forward slots stalled: drop this interval's batch
                 # rather than queue unboundedly
                 self.forward_dropped += len(res.forward)
+                statsd.count("forward.error_total", len(res.forward),
+                             tags=["cause:slots_exhausted"])
                 logger.warning("%d forwards in flight; dropped %d "
                                "forward metrics",
                                self.FORWARD_MAX_IN_FLIGHT, len(res.forward))
         for spec, sink in self.metric_sinks:
             futures.append(self._flush_pool.submit(
-                self._flush_sink, spec, sink, res.metrics, events))
+                self._flush_sink, spec, sink, res.metrics, events, statsd))
         for sink in self.span_sinks:
-            futures.append(self._flush_pool.submit(sink.flush))
-        concurrent.futures.wait(
+            futures.append(self._flush_pool.submit(
+                self._flush_span_sink, sink, statsd))
+        done, not_done = concurrent.futures.wait(
             futures, timeout=self.config.interval)
+        # deadline classification (flusher.go:553-566 / weak-3): a sink
+        # still running after one full interval is a straggler; it keeps
+        # running (we cannot safely interrupt it) but is counted.
+        if not_done:
+            statsd.count("flush.stragglers_total", len(not_done))
+            logger.warning("flush deadline: %d sink flushes still running "
+                           "after %.1fs", len(not_done), self.config.interval)
+        span.add(ssf_mod.timing(
+            "flush.total_duration_ns",
+            time.perf_counter() - flush_start))
+        span.finish()
 
-    def _forward_safely(self, forward: list[sm.ForwardMetric]) -> None:
+    def _forward_safely(self, forward: list[sm.ForwardMetric],
+                        parent=None) -> None:
+        """Forward with sub-timings on a child span
+        (flusher.go:516-576: export/grpc parts + error cause)."""
+        from veneur_tpu import scopedstatsd
+        from veneur_tpu import ssf as ssf_mod
+        statsd = scopedstatsd.ensure(self.statsd)
+        fspan = (parent.child("flush.forward") if parent is not None
+                 else self.trace_client.span("flush.forward"))
+        fspan.add(
+            ssf_mod.gauge("forward.metrics_total", float(len(forward))),
+            ssf_mod.count("forward.post_metrics_total", float(len(forward))))
+        grpc_start = time.perf_counter()
         try:
             self.forwarder(forward)
+            fspan.add(ssf_mod.count("forward.error_total", 0))
+        except TimeoutError:
+            fspan.add(ssf_mod.count("forward.error_total", 1,
+                                    tags={"cause": "deadline_exceeded"}))
+            statsd.count("forward.error_total", 1,
+                         tags=["cause:deadline_exceeded"])
+            logger.error("forward deadline exceeded")
         except Exception as e:
-            logger.error("forward failed: %s", e)
+            cause = "send"
+            msg = str(e)
+            # transient connection rebalancing isn't an error worth paging
+            # on (flusher.go:556-563)
+            if "UNAVAILABLE" in msg or "transport is closing" in msg:
+                cause = "transient_unavailable"
+            else:
+                logger.error("forward failed: %s", e)
+            fspan.add(ssf_mod.count("forward.error_total", 1,
+                                    tags={"cause": cause}))
+            statsd.count("forward.error_total", 1, tags=[f"cause:{cause}"])
         finally:
+            fspan.add(ssf_mod.timing(
+                "forward.duration_ns", time.perf_counter() - grpc_start,
+                tags={"part": "grpc"}))
+            fspan.finish()
             self._forward_slots.release()
 
-    def _flush_sink(self, spec, sink, metrics, events) -> None:
+    def _flush_sink(self, spec, sink, metrics, events, statsd=None) -> None:
+        """One metric sink's flush, with the standard accounting
+        (flusher.go:138-247: flushed_metrics by status + per-sink flush
+        duration timer)."""
+        from veneur_tpu import scopedstatsd
+        statsd = scopedstatsd.ensure(statsd or self.statsd)
+        sink_tags = [f"sink_name:{sink.name()}", f"sink_kind:{spec.kind}"]
+        start = time.perf_counter()
         try:
             filtered, counts = sink_mod.filter_metrics_for_sink(
                 spec, self.config.enable_metric_sink_routing, metrics)
+            for status in ("skipped", "max_name_length", "max_tags",
+                           "max_tag_length", "flushed"):
+                statsd.count("flushed_metrics", counts.get(status, 0),
+                             tags=sink_tags + [f"status:{status}"])
             sink.flush_other_samples(events)
             result = sink.flush(filtered)
+            statsd.count(sink_mod.METRICS_FLUSHED_TOTAL, result.flushed,
+                         tags=sink_tags)
+            statsd.count(sink_mod.METRICS_DROPPED_TOTAL, result.dropped,
+                         tags=sink_tags)
             logger.debug("flush complete sink=%s flushed=%s counts=%s",
                          sink.name(), result.flushed, counts)
         except Exception as e:
+            statsd.count("flush.sink_errors_total", 1, tags=sink_tags)
             logger.error("sink %s flush failed: %s", sink.name(), e)
+        finally:
+            statsd.timing("sink.metric_flush_total_duration_ms",
+                          (time.perf_counter() - start) * 1e3,
+                          tags=sink_tags)
+
+    def _flush_span_sink(self, sink, statsd=None) -> None:
+        """One span sink's flush with per-sink timing
+        (SpanWorker.Flush, worker.go:657-678)."""
+        from veneur_tpu import scopedstatsd
+        statsd = scopedstatsd.ensure(statsd or self.statsd)
+        start = time.perf_counter()
+        try:
+            sink.flush()
+        except Exception as e:
+            logger.error("span sink %s flush failed: %s", sink.name(), e)
+        finally:
+            statsd.timing("worker.span.flush_duration_ns",
+                          (time.perf_counter() - start) * 1e9,
+                          tags=[f"sink:{sink.name()}"])
 
     # -- lifecycle ---------------------------------------------------------
 

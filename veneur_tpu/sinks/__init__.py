@@ -26,6 +26,18 @@ class MetricFlushResult:
     dropped: int = 0
 
 
+# Standardized sink self-metric names (sinks/sinks.go:18-80)
+METRICS_FLUSHED_TOTAL = "sink.metrics_flushed_total"
+METRICS_SKIPPED_TOTAL = "sink.metrics_skipped_total"
+METRICS_DROPPED_TOTAL = "sink.metrics_dropped_total"
+METRIC_FLUSH_DURATION = "sink.metric_flush_total_duration_ms"
+SPANS_FLUSHED_TOTAL = "sink.spans_flushed_total"
+SPANS_DROPPED_TOTAL = "sink.spans_dropped_total"
+SPAN_FLUSH_DURATION = "sink.span_flush_total_duration_ns"
+SPAN_INGEST_DURATION = "sink.span_ingest_total_duration_ns"
+EVENT_REPORTED_COUNT = "sink.events_reported_total"
+
+
 @runtime_checkable
 class MetricSink(Protocol):
     def name(self) -> str: ...

@@ -76,6 +76,23 @@ class DebugSpanSink(sink_mod.BaseSpanSink):
         logger.info("debug sink span: %s", span)
 
 
+@sink_mod.register_span_sink("channel")
+class ChannelSpanSink(sink_mod.BaseSpanSink):
+    """Captures every ingested span to a queue — the span-side test
+    fixture (trace/testbackend channel-backed ClientBackend analog)."""
+
+    KIND = "channel"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None, out: Optional[queue.Queue] = None):
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        self.queue: queue.Queue = out if out is not None else queue.Queue()
+
+    def ingest(self, span):
+        self.queue.put(span)
+
+
 @sink_mod.register_metric_sink("channel")
 class ChannelMetricSink(sink_mod.BaseMetricSink):
     """Delivers each flush's InterMetric list to a queue — the in-process
