@@ -1,0 +1,67 @@
+"""Crash plumbing: a dying thread is detected and reported
+(sentry.go:22-64 ConsumePanic semantics, minus the actual Sentry SDK)."""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from veneur_tpu import crash
+
+
+@pytest.fixture
+def hooks():
+    crash.panics_detected = 0
+    crash.last_panic = None
+    yield
+    crash.uninstall()
+
+
+def test_dying_thread_is_detected(hooks, caplog):
+    seen = []
+    crash.install(terminate=False, on_panic=seen.append)
+
+    def boom():
+        raise RuntimeError("listener died")
+
+    with caplog.at_level(logging.CRITICAL, logger="veneur_tpu.crash"):
+        t = threading.Thread(target=boom, name="statsd-udp-0")
+        t.start()
+        t.join(5.0)
+
+    deadline = time.time() + 2.0
+    while time.time() < deadline and crash.panics_detected == 0:
+        time.sleep(0.01)
+    assert crash.panics_detected == 1
+    assert crash.last_panic["thread"] == "statsd-udp-0"
+    assert crash.last_panic["type"] == "RuntimeError"
+    assert "listener died" in crash.last_panic["traceback"]
+    assert seen and seen[0]["thread"] == "statsd-udp-0"
+    assert any("panic in thread statsd-udp-0" in r.message
+               for r in caplog.records)
+
+
+def test_install_is_idempotent_and_uninstall_restores(hooks):
+    prev = threading.excepthook
+    crash.install(terminate=False)
+    hook1 = threading.excepthook
+    crash.install(terminate=False)
+    assert threading.excepthook is hook1
+    crash.uninstall()
+    assert threading.excepthook is prev
+
+
+def test_missing_sentry_sdk_is_tolerated(hooks):
+    # the image has no sentry_sdk; a DSN must not break installation
+    crash.install(sentry_dsn="https://key@example.invalid/1",
+                  terminate=False)
+    assert crash._sentry is None
+
+    def boom():
+        raise ValueError("x")
+
+    t = threading.Thread(target=boom)
+    t.start()
+    t.join(5.0)
+    assert crash.panics_detected == 1

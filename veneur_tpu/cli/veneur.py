@@ -63,6 +63,13 @@ def main(argv=None) -> int:
         level=getattr(logging, cfg.debug and "DEBUG" or "INFO", logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
+    # panic plumbing: a dying thread reports (optionally to Sentry) and
+    # kills the process so the supervisor restarts it
+    # (cmd/veneur/main.go:63-79 + sentry.go:22-64)
+    from veneur_tpu import crash
+    crash.install(sentry_dsn=cfg.sentry_dsn, terminate=True)
+    logging.getLogger().addHandler(crash.SentryLogHandler())
+
     from veneur_tpu.core.server import Server
     from veneur_tpu.http_api import HttpApi
 

@@ -32,6 +32,10 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO)
 
+    from veneur_tpu import crash
+    crash.install(sentry_dsn=str(data.get("sentry_dsn") or ""),
+                  terminate=True)
+
     from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
     from veneur_tpu.util.matcher import TagMatcher
 

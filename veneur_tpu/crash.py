@@ -1,0 +1,144 @@
+"""Crash plumbing: panic consumption + error-log mirroring.
+
+Capability twin of `sentry.go:22-135`: the reference wraps every goroutine
+in `defer ConsumePanic()` (report to Sentry with a full traceback, flush,
+then re-panic so the supervisor restarts the process), and installs a
+logrus hook mirroring error/fatal logs to Sentry.
+
+Here the equivalents are process-wide:
+
+  * `install()` sets `threading.excepthook` (and `sys.excepthook`) so an
+    uncaught exception in ANY thread — a listener, a span worker, the
+    flush ticker — is logged with a structured traceback, optionally
+    reported to Sentry (when the `sentry_sdk` package is importable and a
+    DSN is configured; the package is not required), and, when
+    `terminate=True` (the production default, matching re-panic
+    semantics), kills the process so a supervisor restarts it instead of
+    limping along with a dead listener.
+  * `SentryLogHandler` mirrors ERROR+ log records (the logrus
+    `SentryHook`, sentry.go:67-135).
+
+State is kept so tests can assert a dying thread was detected
+(`panics_detected`, `last_panic`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import traceback
+from typing import Callable, Optional
+
+logger = logging.getLogger("veneur_tpu.crash")
+
+panics_detected = 0
+last_panic: Optional[dict] = None
+
+_sentry = None
+_installed = False
+_prev_threading_hook = None
+_prev_sys_hook = None
+
+
+def _init_sentry(dsn: str) -> None:
+    """Best-effort Sentry init; the SDK is optional."""
+    global _sentry
+    if not dsn:
+        return
+    try:
+        import sentry_sdk
+        sentry_sdk.init(dsn=dsn)
+        _sentry = sentry_sdk
+    except ImportError:
+        logger.info("sentry_dsn configured but sentry_sdk is not "
+                    "installed; crash reports go to the log only")
+    except Exception as e:
+        # a malformed DSN must not abort startup (reporting is best-effort)
+        logger.error("sentry init failed (dsn ignored): %s", e)
+
+
+def _report(exc_type, exc_value, exc_tb, thread_name: str,
+            terminate: bool, on_panic: Optional[Callable]) -> None:
+    global panics_detected, last_panic
+    panics_detected += 1
+    tb_str = "".join(traceback.format_exception(exc_type, exc_value, exc_tb))
+    last_panic = {"thread": thread_name, "type": exc_type.__name__,
+                  "value": str(exc_value), "traceback": tb_str}
+    logger.critical("panic in thread %s: %s\n%s",
+                    thread_name, exc_value, tb_str)
+    if _sentry is not None:
+        try:
+            _sentry.capture_exception(exc_value)
+            _sentry.flush(timeout=2.0)
+        except Exception:
+            pass
+    if on_panic is not None:
+        try:
+            on_panic(last_panic)
+        except Exception:
+            pass
+    if terminate:
+        # ConsumePanic re-panics after reporting (sentry.go:59-63): die so
+        # the supervisor restarts us rather than running with a dead thread
+        os._exit(2)
+
+
+def install(sentry_dsn: str = "", terminate: bool = True,
+            on_panic: Optional[Callable[[dict], None]] = None) -> None:
+    """Install the process-wide panic hooks.  Idempotent."""
+    global _installed, _prev_threading_hook, _prev_sys_hook
+    _init_sentry(sentry_dsn)
+    if _installed:
+        return
+    _installed = True
+    _prev_threading_hook = threading.excepthook
+    _prev_sys_hook = sys.excepthook
+
+    def thread_hook(args) -> None:
+        if args.exc_type is SystemExit:
+            return
+        name = args.thread.name if args.thread is not None else "?"
+        _report(args.exc_type, args.exc_value, args.exc_traceback,
+                name, terminate, on_panic)
+
+    def main_hook(exc_type, exc_value, exc_tb) -> None:
+        if exc_type is KeyboardInterrupt:
+            _prev_sys_hook(exc_type, exc_value, exc_tb)
+            return
+        _report(exc_type, exc_value, exc_tb, "MainThread",
+                terminate, on_panic)
+
+    threading.excepthook = thread_hook
+    sys.excepthook = main_hook
+
+
+def uninstall() -> None:
+    """Restore the previous hooks (tests)."""
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    threading.excepthook = _prev_threading_hook
+    sys.excepthook = _prev_sys_hook
+
+
+class SentryLogHandler(logging.Handler):
+    """Mirror ERROR+ records to Sentry (the logrus SentryHook,
+    sentry.go:67-135).  No-op when the SDK is unavailable."""
+
+    def __init__(self, level=logging.ERROR):
+        super().__init__(level=level)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        if _sentry is None:
+            return
+        try:
+            if record.exc_info:
+                _sentry.capture_exception(record.exc_info[1])
+            else:
+                _sentry.capture_message(record.getMessage(),
+                                        level=record.levelname.lower())
+        except Exception:
+            pass
