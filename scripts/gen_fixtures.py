@@ -1,0 +1,67 @@
+"""Generate the pinned wire fixtures in tests/testdata/ (run ONCE).
+
+The committed bytes are the regression baseline (the analog of the
+reference's `testdata/protobuf/*.pb`, `regression_test.go:16-107`); do
+not regenerate them casually — a regeneration that changes the bytes is
+exactly the kind of wire break the fixtures exist to catch.
+"""
+
+import os
+
+from veneur_tpu.protocol.gen.metricpb import metric_pb2
+from veneur_tpu.protocol.gen.ssf import sample_pb2
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "tests", "testdata")
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+
+    span = sample_pb2.SSFSpan(
+        version=0, trace_id=12345, id=678, parent_id=90,
+        start_timestamp=1700000000_000000000,
+        end_timestamp=1700000001_500000000,
+        error=False, service="veneur-tpu-test", indicator=True,
+        name="fixture.op")
+    span.tags["env"] = "test"
+    span.tags["az"] = "us-1"
+    s = sample_pb2.SSFSample(
+        metric=sample_pb2.SSFSample.HISTOGRAM, name="fixture.latency",
+        value=42.5, sample_rate=0.5, unit="ms")
+    s.tags["k"] = "v"
+    span.metrics.append(s)
+    with open(os.path.join(OUT, "ssf_span.pb"), "wb") as f:
+        f.write(span.SerializeToString())
+
+    hist = metric_pb2.Metric(name="fixture.hist", tags=["a:1", "b:2"],
+                             type=metric_pb2.Histogram,
+                             scope=metric_pb2.Global)
+    d = hist.histogram.t_digest
+    d.compression = 100.0
+    d.min = 0.25
+    d.max = 99.75
+    d.reciprocalSum = 3.5
+    for m, w in ((0.5, 2.0), (10.0, 5.0), (50.0, 1.0)):
+        c = d.main_centroids.add()
+        c.mean = m
+        c.weight = w
+    with open(os.path.join(OUT, "metricpb_histogram.pb"), "wb") as f:
+        f.write(hist.SerializeToString())
+
+    cnt = metric_pb2.Metric(name="fixture.count", tags=["x:y"],
+                            type=metric_pb2.Counter,
+                            scope=metric_pb2.Global)
+    cnt.counter.value = 1234
+    with open(os.path.join(OUT, "metricpb_counter.pb"), "wb") as f:
+        f.write(cnt.SerializeToString())
+
+    st = metric_pb2.Metric(name="fixture.set", type=metric_pb2.Set,
+                           scope=metric_pb2.Local)
+    st.set.hyper_log_log = b"\x00\x01\x02fixturehll"
+    with open(os.path.join(OUT, "metricpb_set.pb"), "wb") as f:
+        f.write(st.SerializeToString())
+    print("fixtures written to", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+
+fixture.countx:y*Ò	H
