@@ -65,19 +65,22 @@ def test_precision_mismatch_rejected():
         hll.HLLSketch(3)
 
 
-def test_codec_roundtrip_sparse_and_dense():
+def test_codec_roundtrip_dense():
+    """marshal always emits the axiomhq dense form (header + m/2 nibble
+    bytes); ranks round-trip exactly up to the 4-bit tailcut clamp the
+    vendor library itself applies (hyperloglog.go insert)."""
     small = hll.HLLSketch()
     small.insert_batch([f"s{i}".encode() for i in range(50)])
     data = small.marshal()
-    assert len(data) < 1000  # sparse encoding
+    assert len(data) == 8 + (1 << 14) // 2
     back = hll.HLLSketch.unmarshal(data)
-    np.testing.assert_array_equal(back.regs, small.regs)
+    np.testing.assert_array_equal(back.regs, np.minimum(small.regs, 15))
 
     big = hll.HLLSketch()
     big.insert_batch([f"d{i}".encode() for i in range(100_000)])
     back = hll.HLLSketch.unmarshal(big.marshal())
-    np.testing.assert_array_equal(back.regs, big.regs)
-    assert back.estimate() == big.estimate()
+    np.testing.assert_array_equal(back.regs, np.minimum(big.regs, 15))
+    assert back.estimate() == pytest.approx(big.estimate(), rel=0.01)
 
 
 def test_batched_estimate_rows_independent():

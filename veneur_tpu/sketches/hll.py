@@ -15,18 +15,26 @@ S set-type keys live as one dense uint8 tensor `[S, 2^p]`:
     reference uses).
 
 The reference keeps a sparse compressed list for small sets; we keep dense
-registers on device (static shapes) and use a sparse wire encoding only for
-serialization (codec below), which preserves the bandwidth win without
-dynamic shapes.  Byte-level compatibility with axiomhq's MarshalBinary is
-not implemented (documented gap; our own fleet uses the codec below).
+registers on device (static shapes).  The wire codec IS axiomhq's
+MarshalBinary format (vendor hyperloglog.go MarshalBinary/UnmarshalBinary):
+we *emit* the dense form and *accept* both dense and sparse forms, and set
+members are hashed with the same metro hash (seed 1337) — so Set sketches
+interoperate with a mixed fleet of real veneur instances in both
+directions.  (We never emit the sparse form; a real veneur accepts dense
+regardless of size, so nothing is lost but edge bandwidth on tiny sets.)
+The previous fleet-internal "VH" encoding is still accepted on read so a
+mixed-version fleet does not *error* during a rolling upgrade — but note
+that sketches built with the old blake2b member hash do not union
+meaningfully with metro-hashed ones (the same member lands on different
+registers), so global set estimates are inflated (up to ~2x for fully
+overlapping sets) until the whole fleet is on the metro hash.
 """
 
 from __future__ import annotations
 
+import functools
 import struct
 from typing import NamedTuple
-
-import hashlib
 
 import jax
 import jax.numpy as jnp
@@ -61,11 +69,69 @@ def _alpha(m: float) -> float:
 # Host-side hashing + register updates (the ingest hot path)
 # ---------------------------------------------------------------------------
 
-def hash64(data: bytes) -> int:
-    """Stable 64-bit hash of a set member (blake2b-8; the reference uses
-    metro hash — any well-mixed 64-bit hash gives the same estimator
-    guarantees)."""
-    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+_M64 = 0xFFFFFFFFFFFFFFFF
+_K0, _K1, _K2, _K3 = 0xD6D018F5, 0xA2AA033B, 0x62992FC1, 0x30BC5B29
+METRO_SEED = 1337  # the seed axiomhq/hyperloglog hashes members with
+
+
+def _rotr(x: int, r: int) -> int:
+    return ((x >> r) | (x << (64 - r))) & _M64
+
+
+@functools.lru_cache(maxsize=65536)
+def hash64(data: bytes, seed: int = METRO_SEED) -> int:
+    """MetroHash64 of a set member with axiomhq's seed, so a member
+    inserted here lands on the same register with the same rank as one
+    inserted by a real veneur (register-level Set interop; vendor
+    go-metro/metro64.go, hyperloglog/utils.go hashFunc).  Cached: set
+    members repeat heavily across intervals."""
+    h = ((seed + _K2) * _K0) & _M64
+    i, n = 0, len(data)
+    if n >= 32:
+        v = [h, h, h, h]
+        while n - i >= 32:
+            v[0] = (v[0] + int.from_bytes(data[i:i + 8], "little") * _K0) & _M64
+            v[0] = (_rotr(v[0], 29) + v[2]) & _M64
+            v[1] = (v[1] + int.from_bytes(data[i + 8:i + 16], "little") * _K1) & _M64
+            v[1] = (_rotr(v[1], 29) + v[3]) & _M64
+            v[2] = (v[2] + int.from_bytes(data[i + 16:i + 24], "little") * _K2) & _M64
+            v[2] = (_rotr(v[2], 29) + v[0]) & _M64
+            v[3] = (v[3] + int.from_bytes(data[i + 24:i + 32], "little") * _K3) & _M64
+            v[3] = (_rotr(v[3], 29) + v[1]) & _M64
+            i += 32
+        v[2] ^= (_rotr((((v[0] + v[3]) & _M64) * _K0 + v[1]) & _M64, 37) * _K1) & _M64
+        v[3] ^= (_rotr((((v[1] + v[2]) & _M64) * _K1 + v[0]) & _M64, 37) * _K0) & _M64
+        v[0] ^= (_rotr((((v[0] + v[2]) & _M64) * _K0 + v[3]) & _M64, 37) * _K1) & _M64
+        v[1] ^= (_rotr((((v[1] + v[3]) & _M64) * _K1 + v[2]) & _M64, 37) * _K0) & _M64
+        h = (h + (v[0] ^ v[1])) & _M64
+    if n - i >= 16:
+        v0 = (h + int.from_bytes(data[i:i + 8], "little") * _K2) & _M64
+        v0 = (_rotr(v0, 29) * _K3) & _M64
+        v1 = (h + int.from_bytes(data[i + 8:i + 16], "little") * _K2) & _M64
+        v1 = (_rotr(v1, 29) * _K3) & _M64
+        i += 16
+        v0 ^= (_rotr((v0 * _K0) & _M64, 21) + v1) & _M64
+        v1 ^= (_rotr((v1 * _K3) & _M64, 21) + v0) & _M64
+        h = (h + v1) & _M64
+    if n - i >= 8:
+        h = (h + int.from_bytes(data[i:i + 8], "little") * _K3) & _M64
+        i += 8
+        h ^= (_rotr(h, 55) * _K1) & _M64
+    if n - i >= 4:
+        h = (h + int.from_bytes(data[i:i + 4], "little") * _K3) & _M64
+        i += 4
+        h ^= (_rotr(h, 26) * _K1) & _M64
+    if n - i >= 2:
+        h = (h + int.from_bytes(data[i:i + 2], "little") * _K3) & _M64
+        i += 2
+        h ^= (_rotr(h, 48) * _K1) & _M64
+    if n - i >= 1:
+        h = (h + data[i] * _K3) & _M64
+        h ^= (_rotr(h, 37) * _K1) & _M64
+    h ^= _rotr(h, 28)
+    h = (h * _K0) & _M64
+    h ^= _rotr(h, 29)
+    return h
 
 
 def pos_val(h: int, p: int = DEFAULT_PRECISION) -> tuple[int, int]:
@@ -149,36 +215,133 @@ def estimate(regs: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# Wire codec (our fleet's format; axiomhq byte-compat is a documented gap)
+# Wire codec: axiomhq/hyperloglog MarshalBinary format
+# (vendor hyperloglog.go MarshalBinary/UnmarshalBinary; the Set sampler
+# ships these bytes in metricpb SetValue.hyper_log_log,
+# samplers/samplers.go:279-311)
 # ---------------------------------------------------------------------------
 
-_MAGIC = b"VH"
-_DENSE = 1
-_SPARSE = 2
+_AXIOMHQ_VERSION = 1
+_SPARSE_PP = 25          # sparse precision (vendor hyperloglog.go pp)
+_TAILCUT_CAP = 16        # 4-bit register capacity
+
+# legacy fleet-internal encoding, still accepted on read
+_VH_MAGIC = b"VH"
+_VH_DENSE = 1
+_VH_SPARSE = 2
 
 
 def marshal(regs: np.ndarray) -> bytes:
-    """Serialize one register row.  Sparse when <1/8 occupied."""
+    """One register row -> axiomhq dense MarshalBinary bytes:
+    [version=1][p][b=0][sparse=0][sz u32 BE][sz nibble-packed bytes]
+    where even register indices occupy the high nibble (vendor
+    registers.go reg.set offset 0).  Ranks are tailcut to 15 with base
+    b=0, exactly the clamp axiomhq itself applies on insert
+    (hyperloglog.go insert: min(r-b, capacity-1))."""
     regs = np.asarray(regs, np.uint8)
     m = regs.shape[0]
     p = int(m).bit_length() - 1
-    nz = np.nonzero(regs)[0]
-    if len(nz) * 5 < m:
-        payload = struct.pack("<BBBI", _SPARSE, p, 0, len(nz))
-        return (_MAGIC + payload + nz.astype(np.uint32).tobytes()
-                + regs[nz].tobytes())
-    return _MAGIC + struct.pack("<BBB", _DENSE, p, 0) + regs.tobytes()
+    clamped = np.minimum(regs, _TAILCUT_CAP - 1)
+    packed = (clamped[0::2] << 4) | clamped[1::2]
+    return (struct.pack(">BBBB", _AXIOMHQ_VERSION, p, 0, 0)
+            + struct.pack(">I", m // 2) + packed.tobytes())
+
+
+def _decode_sparse_keys(keys: np.ndarray, p: int
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized decodeHash (vendor sparse.go:24-40): sparse keys carry
+    either pp-precision index+rank (low bit set) or a raw 25-bit prefix."""
+    keys = keys.astype(np.uint32, copy=False)
+    flagged = (keys & np.uint32(1)) == 1
+    # rank for flagged keys: 6 bits after the flag, plus (pp - p)
+    r_flag = ((keys >> np.uint32(1)) & np.uint32(0x3F)).astype(np.int32) \
+        + (_SPARSE_PP - p)
+    # rank for unflagged: clz32(k << (32-pp+p-1)) + 1
+    w = (keys << np.uint32(32 - _SPARSE_PP + p - 1)).astype(np.uint32)
+    ww = w.copy()
+    for s in (1, 2, 4, 8, 16):
+        ww |= ww >> np.uint32(s)
+    r_plain = (33 - np.bitwise_count(ww)).astype(np.int32)
+    rank = np.where(flagged, r_flag, r_plain).astype(np.uint8)
+    idx_flag = (keys >> np.uint32(32 - p)) & np.uint32((1 << p) - 1)
+    idx_plain = (keys >> np.uint32(_SPARSE_PP - p + 1)) \
+        & np.uint32((1 << p) - 1)
+    idx = np.where(flagged, idx_flag, idx_plain).astype(np.int64)
+    return idx, rank
+
+
+def _decode_varint_list(buf: bytes, count: int) -> np.ndarray:
+    """compressedList deltas: 7-bit little-endian varints, cumulative
+    (vendor compressed.go variableLengthList/compressedList)."""
+    out = np.empty(count, np.uint32)
+    x = 0
+    last = 0
+    shift = 0
+    k = 0
+    for b in buf:
+        x |= (b & 0x7F) << shift
+        if b & 0x80:
+            shift += 7
+            continue
+        last = (last + x) & 0xFFFFFFFF
+        out[k] = last
+        k += 1
+        x = 0
+        shift = 0
+        if k == count:
+            break
+    return out[:k]
 
 
 def unmarshal(data: bytes) -> np.ndarray:
-    if data[:2] != _MAGIC:
-        raise ValueError("bad HLL magic")
+    """axiomhq UnmarshalBinary (both dense and sparse forms) -> full
+    register row [2^p] uint8.  Dense values are rebased by b (a stored
+    zero under base b counts as rank b, vendor registers.go sumAndZeros).
+    Also accepts the legacy fleet-internal 'VH' encoding."""
+    if data[:2] == _VH_MAGIC:
+        return _unmarshal_vh(data)
+    if len(data) < 8:
+        raise ValueError("short HLL payload")
+    _version, p, b, sparse = struct.unpack_from(">BBBB", data, 0)
+    if not 4 <= p <= 18:
+        raise ValueError(f"bad HLL precision {p}")
+    m = 1 << p
+    regs = np.zeros(m, np.uint8)
+    if sparse == 1:
+        (tssz,) = struct.unpack_from(">I", data, 4)
+        off = 8
+        tmp_keys = np.frombuffer(data, ">u4", tssz, off).astype(np.uint32)
+        off += 4 * tssz
+        count, _last = struct.unpack_from(">II", data, off)
+        off += 8
+        (blen,) = struct.unpack_from(">I", data, off)
+        off += 4
+        list_keys = _decode_varint_list(data[off:off + blen], count)
+        keys = np.concatenate([tmp_keys, list_keys]) \
+            if tssz else list_keys
+        if len(keys):
+            idx, rank = _decode_sparse_keys(keys, p)
+            np.maximum.at(regs, idx, rank)
+        return regs
+    (sz,) = struct.unpack_from(">I", data, 4)
+    if sz * 2 != m:
+        raise ValueError(f"dense size {sz} != m/2 for p={p}")
+    packed = np.frombuffer(data, np.uint8, sz, 8)
+    regs[0::2] = packed >> 4
+    regs[1::2] = packed & 0x0F
+    if b:
+        # stored value v represents rank b+v; stored 0 represents rank b
+        regs = (regs.astype(np.int32) + b).astype(np.uint8)
+    return regs
+
+
+def _unmarshal_vh(data: bytes) -> np.ndarray:
     kind, p, _ = struct.unpack_from("<BBB", data, 2)
     m = 1 << p
     regs = np.zeros(m, np.uint8)
-    if kind == _DENSE:
+    if kind == _VH_DENSE:
         regs[:] = np.frombuffer(data, np.uint8, m, 5)
-    elif kind == _SPARSE:
+    elif kind == _VH_SPARSE:
         (n,) = struct.unpack_from("<I", data, 5)
         off = 9
         idx = np.frombuffer(data, np.uint32, n, off)
