@@ -5,24 +5,36 @@ Mirrors the reference's global-aggregation hot path (`worker.go:402-459` +
 evaluates percentiles) as one device-resident program: staged centroid
 tensors -> all-lane digest merge -> batched compress -> quantile eval.
 
-Two arms:
-  * device arm  — the jitted flush_step on the default JAX backend (the
+Arms:
+  * device arm   — the jitted flush_step on the default JAX backend (the
     real TPU chip under the driver; CPU-XLA elsewhere), timed per flush.
-  * baseline arm — the faithful sequential merging-digest
-    (veneur_tpu/sketches/tdigest_cpu.py, the Go algorithm re-implemented
-    1:1), timed on a sample of merges and extrapolated to the full 100k,
-    then divided by 32 to model a *perfectly parallel* 32-core CPU global
-    node (generous to the baseline: real veneur shards merges over worker
-    goroutines but pays channel/lock/GC overhead we ignore).
+  * native baseline arm — the same sequential merging-digest algorithm the
+    reference's Go global node runs (shuffled re-Add per incoming digest,
+    `tdigest/merging_digest.go:374-389`), implemented in C++
+    (native/bench_baseline.cpp, mirroring our accuracy yardstick
+    veneur_tpu/sketches/tdigest_cpu.py), compiled with -O2 and *measured* on
+    the bench host.  ns/merge x 100k merges / 32 ideal cores = the
+    "32-core CPU global node" of BASELINE.json.  Compiled Go and C++ are
+    within small factors for this pointer-free numeric loop, so this is the
+    honest stand-in for the reference; the division by 32 assumes perfect
+    scaling and zero channel/lock/GC/deserialization overhead, which is
+    *generous to the baseline*.
+  * python arm   — the pure-Python sequential digest
+    (veneur_tpu/sketches/tdigest_cpu.py).  Reported to stderr only, for
+    continuity with round-1 numbers; it flatters the speedup (~60x slower
+    than the native arm) and is NOT used for vs_baseline.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": p99_ms, "unit": "ms", "vs_baseline": speedup}
-Diagnostics go to stderr.
+with vs_baseline computed against the *native* (calibrated) baseline.
+Diagnostics, including both baseline arms and the p50, go to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -33,10 +45,13 @@ N_LANES = 8                  # staged ingest lanes
 N_KEYS = N_DIGESTS // N_LANES  # distinct metric keys; lanes*keys = 100k
 N_SETS = 256
 PERCENTILES = (0.5, 0.9, 0.99)
-WARMUP = 3
-ITERS = 30
+WARMUP = 10
+ITERS = 100
 BASELINE_SAMPLE = 400        # sequential merges to time for extrapolation
 BASELINE_CORES = 32
+CENTROIDS_PER_INCOMING = 32
+
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def log(msg: str) -> None:
@@ -72,13 +87,41 @@ def bench_device() -> tuple[float, float]:
         lat.append((time.perf_counter() - t0) * 1e3)
     lat = np.asarray(lat)
     p50, p99 = float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
-    log(f"device arm: p50={p50:.2f}ms p99={p99:.2f}ms over {ITERS} flushes "
+    log(f"device arm: p50={p50:.3f}ms p99={p99:.3f}ms over {ITERS} flushes "
         f"({N_DIGESTS} digests + quantile eval each)")
     return p50, p99
 
 
-def bench_baseline() -> float:
-    """Sequential merging-digest arm, extrapolated to 100k merges / 32 cores."""
+def bench_baseline_native() -> float | None:
+    """Compile and run the C++ sequential arm; returns total ms for the
+    100k-merge interval on 32 ideal cores, or None if no toolchain."""
+    src = os.path.join(REPO, "native", "bench_baseline.cpp")
+    build = os.path.join(REPO, "native", ".build")
+    exe = os.path.join(build, "bench_baseline")
+    try:
+        if (not os.path.exists(exe)
+                or os.path.getmtime(exe) < os.path.getmtime(src)):
+            os.makedirs(build, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-march=native", "-o", exe, src],
+                check=True, capture_output=True, timeout=120)
+        out = subprocess.run(
+            [exe, "2000", str(CENTROIDS_PER_INCOMING), "100"],
+            check=True, capture_output=True, timeout=300)
+        ns = float(json.loads(out.stdout)["ns_per_merge"])
+    except (OSError, subprocess.SubprocessError, ValueError, KeyError) as e:
+        log(f"native baseline arm unavailable ({e}); falling back to "
+            f"python arm only")
+        return None
+    full = ns * N_DIGESTS / BASELINE_CORES / 1e6
+    log(f"native baseline arm: {ns:.0f}ns/merge sequential (C++ -O2) -> "
+        f"{full:.1f}ms for {N_DIGESTS} merges on {BASELINE_CORES} "
+        f"ideal cores")
+    return full
+
+
+def bench_baseline_python() -> float:
+    """Pure-Python sequential arm (round-1 continuity; stderr only)."""
     from veneur_tpu.sketches.tdigest_cpu import SequentialDigest
 
     rng = np.random.default_rng(1)
@@ -87,7 +130,7 @@ def bench_baseline() -> float:
     incoming = []
     for _ in range(BASELINE_SAMPLE):
         d = SequentialDigest(compression=100.0)
-        for v in rng.gamma(2.0, 10.0, 32):
+        for v in rng.gamma(2.0, 10.0, CENTROIDS_PER_INCOMING):
             d.add(float(v), 1.0)
         incoming.append(d)
 
@@ -102,17 +145,24 @@ def bench_baseline() -> float:
 
     per_merge = elapsed / BASELINE_SAMPLE
     full = per_merge * N_DIGESTS / BASELINE_CORES * 1e3
-    log(f"baseline arm: {per_merge * 1e6:.1f}us/merge sequential -> "
+    log(f"python baseline arm: {per_merge * 1e6:.1f}us/merge sequential -> "
         f"{full:.1f}ms for {N_DIGESTS} merges on {BASELINE_CORES} "
-        f"ideal cores")
+        f"ideal cores (NOT used for vs_baseline; ~60x slower than native)")
     return full
 
 
 def main() -> None:
-    baseline_ms = bench_baseline()
-    _, p99_ms = bench_device()
+    native_ms = bench_baseline_native()
+    python_ms = bench_baseline_python()
+    baseline_ms = native_ms if native_ms is not None else python_ms
+    p50_ms, p99_ms = bench_device()
     speedup = baseline_ms / p99_ms if p99_ms > 0 else 0.0
-    log(f"speedup vs ideal 32-core sequential baseline: {speedup:.1f}x")
+    log(f"speedup vs calibrated 32-core sequential baseline "
+        f"({'native C++' if native_ms is not None else 'python'} arm): "
+        f"p99 {speedup:.1f}x, p50 {baseline_ms / max(p50_ms, 1e-9):.1f}x")
+    if native_ms is not None:
+        log(f"(python-arm speedup for round-1 continuity: "
+            f"{python_ms / p99_ms:.1f}x)")
     print(json.dumps({
         "metric": "flush_p99_latency_100k_digest_merge",
         "value": round(p99_ms, 3),

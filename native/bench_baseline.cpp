@@ -1,0 +1,181 @@
+// Native sequential merging t-digest — the calibrated CPU baseline arm.
+//
+// The north-star baseline is "a 32-core CPU global node running the
+// reference's sequential merge loop" (worker.go:402-459 merging forwarded
+// digests via tdigest/merging_digest.go:374-389's shuffled re-Add).  The
+// reference is compiled Go; timing a *pure-Python* re-implementation
+// flatters the TPU arm, so this file re-implements the same sequential
+// algorithm (mirroring veneur_tpu/sketches/tdigest_cpu.py, our accuracy
+// yardstick) in C++ and measures real native ns/merge on the bench host.
+//
+// Usage: bench_baseline <n_incoming> <centroids_per_incoming> <compression>
+// Prints one line:  {"ns_per_merge": N}
+// With --check as argv[4], instead prints the merged digest's quantiles
+// {"q50": ..., "q90": ..., "q99": ...} so the algorithm can be validated
+// against veneur_tpu/sketches/tdigest_cpu.py on the same workload.
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string_view>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Digest {
+  double compression;
+  int size_bound, temp_cap;
+  std::vector<double> means, weights;  // main centroids, sorted by mean
+  std::vector<double> temp_v, temp_w;
+  double main_weight = 0, temp_weight = 0;
+  double mn = INFINITY, mx = -INFINITY, rsum = 0;
+
+  explicit Digest(double c) : compression(c) {
+    size_bound = static_cast<int>(M_PI * c / 2 + 0.5);
+    double tc = std::min(925.0, std::max(20.0, c));
+    temp_cap = static_cast<int>(7.5 + 0.37 * tc - 2e-4 * tc * tc);
+    means.reserve(size_bound + 1);
+    weights.reserve(size_bound + 1);
+    temp_v.reserve(temp_cap);
+    temp_w.reserve(temp_cap);
+  }
+
+  double k(double q) const {
+    return compression * (std::asin(2 * q - 1) / M_PI + 0.5);
+  }
+
+  void merge_temps() {
+    if (temp_v.empty()) return;
+    size_t nt = temp_v.size();
+    std::vector<int> order(nt);
+    for (size_t i = 0; i < nt; i++) order[i] = static_cast<int>(i);
+    std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
+      return temp_v[a] < temp_v[b];
+    });
+    double total = main_weight + temp_weight;
+    std::vector<double> out_m, out_w;
+    out_m.reserve(size_bound + 1);
+    out_w.reserve(size_bound + 1);
+    double merged = 0, last_idx = 0;
+    auto push = [&](double m, double w) {
+      double next_idx = k(std::min(1.0, (merged + w) / total));
+      if (out_m.empty() || next_idx - last_idx > 1) {
+        out_m.push_back(m);
+        out_w.push_back(w);
+        last_idx = k(merged / total);
+      } else {
+        // Welford update: weight before mean (merging_digest.go:229-262)
+        out_w.back() += w;
+        out_m.back() += (m - out_m.back()) * w / out_w.back();
+      }
+      merged += w;
+    };
+    size_t i = 0, j = 0;
+    while (i < means.size() || j < nt) {
+      bool take_main = j >= nt || (i < means.size() &&
+                                   means[i] <= temp_v[order[j]]);
+      if (take_main) {
+        push(means[i], weights[i]);
+        i++;
+      } else {
+        push(temp_v[order[j]], temp_w[order[j]]);
+        j++;
+      }
+    }
+    means.swap(out_m);
+    weights.swap(out_w);
+    main_weight = total;
+    temp_v.clear();
+    temp_w.clear();
+    temp_weight = 0;
+  }
+
+  void add(double v, double w) {
+    if (static_cast<int>(temp_v.size()) >= temp_cap) merge_temps();
+    mn = std::min(mn, v);
+    mx = std::max(mx, v);
+    rsum += v != 0 ? w / v : INFINITY;
+    temp_v.push_back(v);
+    temp_w.push_back(w);
+    temp_weight += w;
+  }
+
+  // shuffled re-Add merge (merging_digest.go:374-389)
+  void merge(Digest &other, std::mt19937 &rng) {
+    other.merge_temps();
+    double old_rsum = rsum;
+    size_t n = other.means.size();
+    std::vector<int> perm(n);
+    for (size_t i = 0; i < n; i++) perm[i] = static_cast<int>(i);
+    std::shuffle(perm.begin(), perm.end(), rng);
+    for (int i : perm) add(other.means[i], other.weights[i]);
+    rsum = old_rsum + other.rsum;
+  }
+
+  double quantile(double q) {
+    merge_temps();
+    size_t n = means.size();
+    if (n == 0) return NAN;
+    double target = q * main_weight, cum = 0;
+    for (size_t i = 0; i < n; i++) {
+      double lower = i == 0 ? mn : 0.5 * (means[i - 1] + means[i]);
+      double upper = i == n - 1 ? mx : 0.5 * (means[i] + means[i + 1]);
+      if (cum + weights[i] >= target || i == n - 1) {
+        double prop =
+            std::min(1.0, std::max(0.0, (target - cum) / weights[i]));
+        return lower + prop * (upper - lower);
+      }
+      cum += weights[i];
+    }
+    return mx;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  int n_incoming = argc > 1 ? std::atoi(argv[1]) : 2000;
+  int n_centroids = argc > 2 ? std::atoi(argv[2]) : 32;
+  double compression = argc > 3 ? std::atof(argv[3]) : 100.0;
+
+  std::mt19937 rng(1);
+  std::gamma_distribution<double> gamma(2.0, 10.0);
+
+  // pre-build incoming digests outside the timed region (the reference
+  // deserializes protobufs here, which we charitably exclude)
+  std::vector<Digest> incoming;
+  incoming.reserve(n_incoming);
+  for (int i = 0; i < n_incoming; i++) {
+    Digest d(compression);
+    for (int j = 0; j < n_centroids; j++) d.add(gamma(rng), 1.0);
+    d.merge_temps();
+    incoming.push_back(std::move(d));
+  }
+
+  if (argc > 4 && std::string_view(argv[4]) == "--check") {
+    Digest target(compression);
+    for (auto &d : incoming) target.merge(d, rng);
+    printf("{\"q50\": %.6f, \"q90\": %.6f, \"q99\": %.6f}\n",
+           target.quantile(0.5), target.quantile(0.9), target.quantile(0.99));
+    return 0;
+  }
+
+  // repeat until >=0.5s of measured work so the clock resolution is moot
+  double total_s = 0, sink = 0;
+  long merges = 0;
+  while (total_s < 0.5) {
+    Digest target(compression);
+    auto t0 = std::chrono::steady_clock::now();
+    for (auto &d : incoming) target.merge(d, rng);
+    sink += target.quantile(0.5) + target.quantile(0.9) +
+            target.quantile(0.99);
+    auto t1 = std::chrono::steady_clock::now();
+    total_s += std::chrono::duration<double>(t1 - t0).count();
+    merges += n_incoming;
+  }
+  if (sink == 12345.6789) fprintf(stderr, "impossible\n");  // keep `sink` live
+  printf("{\"ns_per_merge\": %.1f}\n", total_s * 1e9 / merges);
+  return 0;
+}

@@ -290,7 +290,10 @@ def _decode_varint_list(buf: bytes, count: int) -> np.ndarray:
         shift = 0
         if k == count:
             break
-    return out[:k]
+    if k != count:
+        raise ValueError(
+            f"truncated HLL sparse list: {k} of {count} keys")
+    return out
 
 
 def unmarshal(data: bytes) -> np.ndarray:
@@ -302,9 +305,13 @@ def unmarshal(data: bytes) -> np.ndarray:
         return _unmarshal_vh(data)
     if len(data) < 8:
         raise ValueError("short HLL payload")
-    _version, p, b, sparse = struct.unpack_from(">BBBB", data, 0)
+    version, p, b, sparse = struct.unpack_from(">BBBB", data, 0)
+    if version != _AXIOMHQ_VERSION:
+        raise ValueError(f"bad HLL version {version}")
     if not 4 <= p <= 18:
         raise ValueError(f"bad HLL precision {p}")
+    if sparse not in (0, 1):
+        raise ValueError(f"bad HLL sparse flag {sparse}")
     m = 1 << p
     regs = np.zeros(m, np.uint8)
     if sparse == 1:
