@@ -103,7 +103,7 @@ def bench_baseline_native() -> float | None:
                 or os.path.getmtime(exe) < os.path.getmtime(src)):
             os.makedirs(build, exist_ok=True)
             subprocess.run(
-                ["g++", "-O2", "-march=native", "-o", exe, src],
+                ["g++", "-O2", "-std=c++17", "-march=native", "-o", exe, src],
                 check=True, capture_output=True, timeout=120)
         out = subprocess.run(
             [exe, "2000", str(CENTROIDS_PER_INCOMING), "100"],

@@ -1,0 +1,346 @@
+"""Native ingest engine tests: hash parity, parser parity with the Python
+reference implementation, drain application equivalence, intern GC, and the
+UDP reader path.
+
+The Python parser (veneur_tpu/samplers/parser.py) is the semantic reference
+(itself matching parser.go:349-503 error-for-error); the C++ engine must
+stage exactly what the Python chain would have aggregated.
+"""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import ingest as ingest_mod
+from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.samplers import parser as parser_mod
+from veneur_tpu.samplers.metric_key import MetricScope
+from veneur_tpu.sketches import hll as hll_mod
+from veneur_tpu.util import tagging
+
+
+# ---------------------------------------------------------------------------
+# metro64 parity
+# ---------------------------------------------------------------------------
+
+def test_metro64_matches_python_hash64():
+    rng = np.random.default_rng(7)
+    cases = [b"", b"a", b"ab", b"abc", b"user@example.com"]
+    cases += [bytes(rng.integers(0, 256, n, dtype=np.uint8))
+              for n in (1, 2, 3, 4, 7, 8, 9, 15, 16, 17, 31, 32, 33, 64, 100)]
+    for m in cases:
+        assert ingest_mod.metro64(m) == hll_mod.hash64(m)
+
+
+# ---------------------------------------------------------------------------
+# parser parity
+# ---------------------------------------------------------------------------
+
+VALID_LINES = [
+    b"a.b.c:1|c",
+    b"x:2.5|g",
+    b"lat:3.5|h",
+    b"lat2:9|d",
+    b"t:12|ms",
+    b"s1:member|s",
+    b"s1:|s",                       # empty set member is legal
+    b"multi:1:2:3|c",
+    b"rate:10|c|@0.1",
+    b"rh:4.5|h|@0.25|#svc:web",
+    b"tagged:1|c|#b:2,a:1,c",
+    b"scoped:1|h|#veneurlocalonly,x:y",
+    b"scoped2:1|h|#x:y,veneurglobalonly",
+    b"gauge.rated:7|g|@0.5",
+    b"neg:-42.5|g",
+    b"exp:1e3|c",
+]
+
+INVALID_LINES = [
+    b"foo",
+    b"foo:1",
+    b"foo:1||",
+    b"foo:|c|",
+    b"bad:nan|g|#shell",
+    b"bad:NaN|g",
+    b"bad:-inf|g",
+    b"bad:+inf|g",
+    b"foo:1|foo|",
+    b"foo:1|c||",
+    b"foo:1|c|foo",
+    b"foo:1|c|@-0.1",
+    b"foo:1|c|@1.1",
+    b"foo:1|c|@0.5|@0.2",
+    b"foo:1|c|#foo|#bar",
+    b":1|c",
+    b"foo:1_0|c",
+    b"foo:0x10|c",
+]
+
+
+def python_reference_parse(lines, extend_tags=None):
+    """Run lines through the Python parser, returning the staged-sample
+    view: {(name, type, joined, scope): [(value_or_member, weight)]}."""
+    p = parser_mod.Parser(extend_tags)
+    out = {}
+    for line in lines:
+        try:
+            p.parse_metric(line, lambda m: out.setdefault(
+                (m.name, m.type, m.joined_tags, m.scope), []).append(
+                    (m.value, m.sample_rate)))
+        except parser_mod.ParseError:
+            pass
+    return out
+
+
+def native_parse(lines, implicit_tags=None):
+    eng = ingest_mod.IngestEngine(4096, implicit_tags)
+    tid = eng.new_thread()
+    eng.ingest(tid, b"\n".join(lines))
+    batch = eng.drain()
+    eng.close()
+    return batch
+
+
+def test_valid_lines_match_python_parser():
+    ref = python_reference_parse(VALID_LINES)
+    batch = native_parse(VALID_LINES)
+    keys = {k.id: k for k in batch.new_keys}
+
+    got = {}
+    for i, kid in enumerate(batch.c_ids):
+        k = keys[kid]
+        got.setdefault((k.name, "counter", k.joined_tags, k.scope),
+                       []).append(batch.c_vals[i])
+    for i, kid in enumerate(batch.g_ids):
+        k = keys[kid]
+        got.setdefault((k.name, "gauge", k.joined_tags, k.scope),
+                       []).append(batch.g_vals[i])
+    for i, kid in enumerate(batch.h_ids):
+        k = keys[kid]
+        got.setdefault((k.name, k.mtype, k.joined_tags, k.scope),
+                       []).append((batch.h_vals[i], batch.h_wts[i]))
+    for i, kid in enumerate(batch.s_ids):
+        k = keys[kid]
+        got.setdefault((k.name, "set", k.joined_tags, k.scope),
+                       []).append(batch.s_hashes[i])
+
+    assert batch.malformed == 0
+    for (name, mtype, joined, scope), samples in ref.items():
+        gk = (name, mtype, joined, scope)
+        assert gk in got, f"missing {gk}"
+        if mtype == "counter":
+            want = [float(int(v / r)) for v, r in samples]
+            assert got[gk] == pytest.approx(want)
+        elif mtype == "gauge":
+            assert got[gk] == pytest.approx([v for v, _ in samples])
+        elif mtype in ("histogram", "timer"):
+            want = [(v, 1.0 / r) for v, r in samples]
+            assert got[gk] == pytest.approx(want)
+        else:  # set: members must hash identically
+            want = [hll_mod.hash64(str(v).encode()) for v, _ in samples]
+            assert got[gk] == want
+    assert len(got) == len(ref)
+
+
+def test_invalid_lines_counted_not_staged():
+    batch = native_parse(INVALID_LINES)
+    assert batch.malformed == len(INVALID_LINES)
+    assert len(batch.c_ids) == len(batch.g_ids) == len(batch.h_ids) == 0
+
+
+def test_multi_value_partial_emit():
+    # values before a malformed one are kept (parser.py values loop)
+    batch = native_parse([b"x:1:2:bad:4|c"])
+    assert batch.malformed == 1
+    assert batch.c_vals.tolist() == [1.0, 2.0]
+
+
+def test_implicit_tags_match_python():
+    implicit = ["env:prod", "svc:ignored-overrides"]
+    lines = [b"m1:1|c|#svc:web,b:2", b"m2:2|g"]
+    ref = python_reference_parse(lines, tagging.ExtendTags(implicit))
+    batch = native_parse(lines, implicit)
+    got = {(k.name, k.joined_tags) for k in batch.new_keys}
+    assert got == {(name, joined) for (name, _, joined, _) in ref}
+
+
+def test_events_and_service_checks_punted():
+    batch = native_parse([b"_e{5,4}:title|text", b"_sc|svc|0|m:ok"])
+    assert batch.other == [b"_e{5,4}:title|text", b"_sc|svc|0|m:ok"]
+    assert batch.processed == 0
+
+
+# ---------------------------------------------------------------------------
+# drain application equivalence
+# ---------------------------------------------------------------------------
+
+PACKETS = [
+    b"api.latency:3.5|h|#svc:web\napi.latency:9.1|h|#svc:web",
+    b"reqs:17|c\nreqs:3|c|@0.5",
+    b"cpu:64|g\ncpu:70|g",
+    b"users:u1|s\nusers:u2|s\nusers:u1|s",
+    b"g.only:5|h|#veneurglobalonly",
+    b"l.only:5|h|#veneurlocalonly",
+    b"rate.hist:1:2:3|ms|@0.25",
+]
+
+
+def flush_view(agg, is_local):
+    res = agg.flush(is_local=is_local, now=1234)
+    metrics = sorted((m.name, tuple(m.tags), m.type, round(m.value, 9))
+                     for m in res.metrics)
+    fwd = sorted((f.name, tuple(f.tags), f.kind, int(f.scope),
+                  round(f.digest_sum or 0, 6),
+                  round(sum(f.digest_weights or []), 6),
+                  f.counter_value, round(f.gauge_value or 0, 6))
+                 for f in res.forward)
+    return metrics, fwd
+
+
+@pytest.mark.parametrize("is_local", [True, False])
+def test_native_drain_equals_python_path(is_local):
+    pct = [0.5, 0.99]
+
+    agg_py = MetricAggregator(percentiles=pct)
+    p = parser_mod.Parser()
+    for pkt in PACKETS:
+        for line in pkt.split(b"\n"):
+            p.parse_metric(line, agg_py.process_metric)
+
+    agg_nat = MetricAggregator(percentiles=pct)
+    nat = ingest_mod.NativeIngest(agg_nat)
+    tid = nat.engine.new_thread()
+    for pkt in PACKETS:
+        nat.engine.ingest(tid, pkt)
+    nat.drain_into()
+    nat.close()
+
+    assert agg_py.processed == agg_nat.processed
+    m_py, f_py = flush_view(agg_py, is_local)
+    m_nat, f_nat = flush_view(agg_nat, is_local)
+    assert m_nat == m_py
+    assert f_nat == f_py
+
+
+def test_unique_timeseries_counted_on_drain():
+    agg = MetricAggregator(count_unique_timeseries=True, is_local=False)
+    nat = ingest_mod.NativeIngest(agg)
+    tid = nat.engine.new_thread()
+    for i in range(50):
+        nat.engine.ingest(tid, b"m%d:1|c" % (i % 10))
+    nat.drain_into()
+    res = agg.flush(is_local=False)
+    nat.close()
+    assert res.unique_ts == pytest.approx(10, abs=1)
+
+
+def test_intern_gc_reset_preserves_samples_and_identity():
+    agg = MetricAggregator()
+    nat = ingest_mod.NativeIngest(agg)
+    tid = nat.engine.new_thread()
+    nat.engine.ingest(tid, b"k1:1|c\nk2:5|c")
+    nat.reset_interning()          # applies the staged batch, then clears
+    assert nat.engine.intern_count() == 0
+    nat.engine.ingest(tid, b"k1:2|c\nk3:7|c")  # k1 re-interns under new id
+    batch = nat.drain_into()
+    # id space restarts at 0 after GC so the Python cache stays bounded
+    assert min(k.id for k in batch.new_keys) == 0
+    res = agg.flush(is_local=False)
+    nat.close()
+    by = {m.name: m.value for m in res.metrics}
+    assert by == {"k1": 3.0, "k2": 5.0, "k3": 7.0}
+
+
+def test_row_gc_revalidation():
+    """A row recycled by arena idle-GC must re-upsert, not scribble on a
+    stranger's row."""
+    from veneur_tpu.core import arena as arena_mod
+
+    agg = MetricAggregator()
+    nat = ingest_mod.NativeIngest(agg)
+    tid = nat.engine.new_thread()
+    nat.engine.ingest(tid, b"gc.me:1|c")
+    nat.drain_into()
+    agg.flush(is_local=False)
+    # idle long enough for the row to be collected
+    for _ in range(arena_mod.IDLE_GC_INTERVALS + 1):
+        agg.flush(is_local=False)
+    # a different key takes the freed row, then the old id comes back
+    agg.process_metric(parse_one(b"squatter:9|c"))
+    nat.engine.ingest(tid, b"gc.me:4|c")
+    nat.drain_into()
+    res = agg.flush(is_local=False)
+    nat.close()
+    by = {m.name: m.value for m in res.metrics}
+    assert by["gc.me"] == 4.0
+    assert by["squatter"] == 9.0
+
+
+def parse_one(line):
+    out = []
+    parser_mod.Parser().parse_metric(line, out.append)
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# UDP reader path (end-to-end through a real socket)
+# ---------------------------------------------------------------------------
+
+def test_native_udp_reader_end_to_end():
+    agg = MetricAggregator()
+    nat = ingest_mod.NativeIngest(agg)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    addr = sock.getsockname()
+    nat.engine.add_udp_reader(sock.fileno())
+
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for _ in range(200):
+        tx.sendto(b"udp.native:1|c\nudp.lat:5|ms", addr)
+    tx.close()
+
+    deadline = time.time() + 5.0
+    total = 0
+    while time.time() < deadline and total < 400:
+        time.sleep(0.05)
+        nat.drain_into()
+        total = agg.processed
+    nat.stop()
+    sock.close()
+    res = agg.flush(is_local=False)
+    nat.close()
+    by = {m.name: m.value for m in res.metrics}
+    assert by["udp.native"] == 200.0
+    assert by["udp.lat.count"] == 200.0
+
+
+def test_blast_udp_sender():
+    """The benchmark sender delivers packets the engine can parse."""
+    agg = MetricAggregator()
+    nat = ingest_mod.NativeIngest(agg)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+    addr = sock.getsockname()
+    nat.engine.add_udp_reader(sock.fileno())
+
+    sent = ingest_mod.blast_udp(addr[0], addr[1], 500,
+                                [b"blast:1|c", b"blast:2|c\nblast.h:3|h"])
+    assert sent == 500
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        time.sleep(0.05)
+        nat.drain_into()
+        _, _, packets, _ = nat.engine.totals()
+        if packets >= sent * 0.9:  # loopback may shed under pressure
+            break
+    nat.stop()
+    sock.close()
+    res = agg.flush(is_local=False)
+    nat.close()
+    by = {m.name: m.value for m in res.metrics}
+    assert by["blast"] > 0

@@ -100,6 +100,13 @@ class Config:
     # ingest
     num_workers: int = 1
     num_readers: int = 1
+    # native C++ data plane for UDP DogStatsD (recvmmsg readers + batch
+    # parser + columnar staging, native/ingest_engine.cpp); falls back to
+    # the Python path if the engine cannot be built
+    native_ingest: bool = True
+    ingest_drain_interval: float = 0.0  # 0 = auto (min(interval/10, 0.5s))
+    # intern-table GC threshold (distinct metric identities in the engine)
+    intern_gc_threshold: int = 1_000_000
     num_span_workers: int = 1
     metric_max_length: int = 4096
     trace_max_length_bytes: int = 16 * 1024 * 1024

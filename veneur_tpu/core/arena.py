@@ -205,6 +205,8 @@ class SetArena(_ArenaBase):
         # staging: raw hashes per batch (vectorized split at sync)
         self._stage_rows: list[int] = []
         self._stage_hashes: list[int] = []
+        # pre-hashed array staging from the native ingest engine
+        self._stage_chunks: list[tuple[np.ndarray, np.ndarray]] = []
 
     def _grow_state(self, old: int) -> None:
         self.regs = np.concatenate(
@@ -214,16 +216,29 @@ class SetArena(_ArenaBase):
         self._stage_rows.append(row)
         self._stage_hashes.append(hll_mod.hash64(member.encode()))
 
+    def stage_hash_batch(self, rows: np.ndarray, hashes: np.ndarray) -> None:
+        """Stage members already metro-hashed by the native ingest engine."""
+        self._stage_chunks.append((rows, hashes))
+
     def merge(self, row: int, payload: bytes) -> None:
         other = hll_mod.unmarshal(payload)
         np.maximum(self.regs[row], other, out=self.regs[row])
 
     def sync(self) -> None:
-        if not self._stage_rows:
+        if not self._stage_rows and not self._stage_chunks:
             return
-        rows = np.asarray(self._stage_rows, np.int64)
-        hs = np.asarray(self._stage_hashes, np.uint64)
-        self._stage_rows, self._stage_hashes = [], []
+        parts_r: list[np.ndarray] = []
+        parts_h: list[np.ndarray] = []
+        if self._stage_rows:
+            parts_r.append(np.asarray(self._stage_rows, np.int64))
+            parts_h.append(np.asarray(self._stage_hashes, np.uint64))
+            self._stage_rows, self._stage_hashes = [], []
+        for r, h in self._stage_chunks:
+            parts_r.append(r.astype(np.int64, copy=False))
+            parts_h.append(h)
+        self._stage_chunks = []
+        rows = parts_r[0] if len(parts_r) == 1 else np.concatenate(parts_r)
+        hs = parts_h[0] if len(parts_h) == 1 else np.concatenate(parts_h)
         idx, rank = hll_mod.split_hashes(hs, self.precision)
         hll_mod.update_registers(self.regs, rows, idx, rank)
 
@@ -305,6 +320,9 @@ class DigestArena(_ArenaBase):
         self._vals: list[float] = []
         self._wts: list[float] = []
         self._local: list[bool] = []
+        # array-chunk staging from the native ingest engine (always local
+        # samples; imports go through merge_digest)
+        self._chunks: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
 
     def _grow_state(self, old: int) -> None:
         nm = np.zeros((self.n_lanes, self.capacity, self.ccap), np.float32)
@@ -345,15 +363,36 @@ class DigestArena(_ArenaBase):
         self.d_max[row] = max(self.d_max[row], dmax)
         self.d_rsum[row] += drsum
 
+    def sample_batch(self, rows: np.ndarray, vals: np.ndarray,
+                     wts: np.ndarray) -> None:
+        """Stage a columnar batch of locally-observed samples (the native
+        ingest drain path)."""
+        self._chunks.append((rows, vals, wts))
+
     def sync(self) -> None:
         """Scatter COO staging into dense waves and ingest on device."""
-        if not self._rows:
+        if not self._rows and not self._chunks:
             return
-        rows = np.asarray(self._rows, np.int64)
-        vals = np.asarray(self._vals, np.float64)
-        wts = np.asarray(self._wts, np.float64)
-        local = np.asarray(self._local, bool)
-        self._rows, self._vals, self._wts, self._local = [], [], [], []
+        parts = []
+        if self._rows:
+            parts.append((np.asarray(self._rows, np.int64),
+                          np.asarray(self._vals, np.float64),
+                          np.asarray(self._wts, np.float64),
+                          np.asarray(self._local, bool)))
+            self._rows, self._vals, self._wts, self._local = [], [], [], []
+        for r, v, w in self._chunks:
+            parts.append((r.astype(np.int64, copy=False),
+                          v.astype(np.float64, copy=False),
+                          w.astype(np.float64, copy=False),
+                          np.ones(len(r), bool)))
+        self._chunks = []
+        if len(parts) == 1:
+            rows, vals, wts, local = parts[0]
+        else:
+            rows = np.concatenate([p[0] for p in parts])
+            vals = np.concatenate([p[1] for p in parts])
+            wts = np.concatenate([p[2] for p in parts])
+            local = np.concatenate([p[3] for p in parts])
 
         # host scalar updates (vectorized)
         np.minimum.at(self.d_min, rows, vals)

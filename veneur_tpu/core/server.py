@@ -210,8 +210,11 @@ class Server:
         self.flush_count = 0
         # per-protocol received-packet tallies, drained each flush into
         # listen.received_per_protocol_total (flusher.go:280,455-475).
-        # Plain int increments; GIL-atomic enough for telemetry.
+        # Plain int increments; GIL-atomic enough for telemetry.  Batch
+        # adds from the native drain and flush()'s swap take _proto_lock
+        # (a lost batch add is thousands of packets, not one).
         self.proto_received: collections.Counter = collections.Counter()
+        self._proto_lock = threading.Lock()
         # Bounded-concurrency forwarding: the reference gives each flush its
         # own goroutine with a one-interval ctx deadline (flusher.go:81-86),
         # so in-flight forwards are implicitly bounded by deadline/interval.
@@ -229,6 +232,8 @@ class Server:
         self.statsd_addrs: list[tuple[str, object]] = []
         self.ssf_addrs: list[tuple[str, object]] = []
         self.grpc_import = None
+        # native ingest data plane (created in start(); None = Python path)
+        self.native = None
         self.shutdown_hook: Callable[[], None] = lambda: os._exit(2)
 
     @property
@@ -268,6 +273,21 @@ class Server:
     # -- listeners (networking.go) ----------------------------------------
 
     def start(self) -> None:
+        if self.config.native_ingest:
+            # the C++ edge data plane (UDP readers + parser + staging);
+            # the Python chain stays as fallback and slow path
+            try:
+                from veneur_tpu.ingest import NativeIngest
+                self.native = NativeIngest(
+                    self.aggregator,
+                    max_packet=self.config.metric_max_length,
+                    implicit_tags=list(self.config.extend_tags),
+                    on_other=self.handle_metric_packet)
+            except Exception as e:
+                logger.warning(
+                    "native ingest engine unavailable (%s); "
+                    "using the Python packet path", e)
+                self.native = None
         for sspec, sink in self.metric_sinks:
             sink.start(None)
         for sink in self.span_sinks:
@@ -335,6 +355,37 @@ class Server:
             self.diagnostics.start()
         for source in self.sources:
             source.start(self.ingest_shim)
+        if self.native is not None:
+            t = threading.Thread(target=self._native_drain_loop, daemon=True,
+                                 name="ingest-drain")
+            t.start()
+            self._threads.append(t)
+
+    def _drain_native(self) -> None:
+        """Fold the native engine's staged batches into the arenas and
+        account the drained datagrams (the coarse-grained analog of the
+        reference's per-packet worker channel sends, worker.go:274-290)."""
+        if self.native is None:
+            return
+        batch = self.native.drain_into()
+        self._count_drained(batch)
+
+    def _count_drained(self, batch) -> None:
+        if batch.packets:
+            # under _proto_lock so flush()'s counter swap cannot strand a
+            # batch-sized increment on the already-reported Counter
+            with self._proto_lock:
+                self.proto_received["udp"] += batch.packets
+
+    def _native_drain_loop(self) -> None:
+        iv = self.config.ingest_drain_interval or min(
+            self.config.interval / 10.0, 0.5)
+        while not self._shutdown.wait(iv):
+            try:
+                self._count_drained(self.native.drain_or_gc(
+                    self.config.intern_gc_threshold))
+            except Exception:
+                logger.exception("native ingest drain failed")
 
     def stop_serving(self) -> None:
         """Unblock serve() without tearing down (signal-handler safe:
@@ -360,10 +411,14 @@ class Server:
                 else:
                     sock.bind((host, port))
                 self._listeners.append(sock)
-                t = threading.Thread(target=self._read_udp, args=(sock,),
-                                     daemon=True, name=f"statsd-udp-{i}")
-                t.start()
-                self._threads.append(t)
+                if self.native is not None:
+                    # C++ recvmmsg reader loop owns this socket's hot path
+                    self.native.engine.add_udp_reader(sock.fileno())
+                else:
+                    t = threading.Thread(target=self._read_udp, args=(sock,),
+                                         daemon=True, name=f"statsd-udp-{i}")
+                    t.start()
+                    self._threads.append(t)
             self.statsd_addrs.append(("udp", first_sock.getsockname()))
         elif scheme in ("tcp", "tcp+tls"):
             host, port = _split_hostport(rest)
@@ -651,6 +706,7 @@ class Server:
             tags={"veneurglobalonly": str(not self.is_local).lower()})
         flush_start = time.perf_counter()
 
+        self._drain_native()
         res = self.aggregator.flush(is_local=self.is_local)
         self.flush_count += 1
 
@@ -663,8 +719,9 @@ class Server:
                          tags=["global_veneur:"
                                + str(not self.is_local).lower()])
         # listen.received_per_protocol_total (flusher.go:280,455-475)
-        drained, self.proto_received = (self.proto_received,
-                                        collections.Counter())
+        with self._proto_lock:
+            drained, self.proto_received = (self.proto_received,
+                                            collections.Counter())
         for proto, n in drained.items():
             statsd.count("listen.received_per_protocol_total", n,
                          tags=[f"protocol:{proto}"])
@@ -872,6 +929,14 @@ class Server:
             self.trace_client.close()
         except Exception:
             pass
+        if self.native is not None:
+            # join the C++ reader threads BEFORE closing their fds — a
+            # recycled fd number must never be readable by a stale reader
+            try:
+                self.native.stop()
+                self.native.close()
+            except Exception:
+                logger.exception("native ingest shutdown failed")
         for sock in self._listeners:
             try:
                 sock.close()
