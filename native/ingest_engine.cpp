@@ -351,12 +351,14 @@ static uint8_t canonical_tags(Engine* e, ThreadScratch& sc,
 static uint32_t intern(Engine* e, ThreadScratch& sc, const char* name,
                        size_t nlen, uint8_t mt, const char* raw_tags,
                        size_t rtlen, bool has_tags) {
+  // Length-prefix the name so a 0x1F (or any byte) inside a name or tag
+  // can never alias two distinct identities onto one intern key.
   std::string& key = sc.key;
   key.clear();
+  uint32_t nl32 = (uint32_t)nlen;
+  key.append((const char*)&nl32, 4);
   key.append(name, nlen);
-  key.push_back('\x1f');
   key.push_back((char)('0' + mt));
-  key.push_back('\x1f');
   if (has_tags) key.append(raw_tags, rtlen);
   uint64_t h = hash_bytes(key.data(), key.size());
 
@@ -771,6 +773,7 @@ unsigned long long vn_metro64(const char* data, long n) {
 long long vn_blast_udp(const char* ip, int port, long long n_packets,
                        const char* blob, const long long* offs,
                        int n_payloads) {
+  if (n_payloads <= 0 || n_packets <= 0) return 0;
   int fd = socket(AF_INET, SOCK_DGRAM, 0);
   if (fd < 0) return -1;
   sockaddr_in addr{};

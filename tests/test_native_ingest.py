@@ -344,3 +344,16 @@ def test_blast_udp_sender():
     nat.close()
     by = {m.name: m.value for m in res.metrics}
     assert by["blast"] > 0
+
+
+def test_intern_key_no_separator_aliasing():
+    """Names/tags containing 0x1F must not alias distinct identities
+    (length-prefixed intern keys)."""
+    batch = native_parse([b"a\x1f0\x1fb:1|c|#c", b"a:2|c|#b\x1f0\x1fc"])
+    names = sorted((k.name, k.joined_tags) for k in batch.new_keys)
+    assert names == [("a", "b\x1f0\x1fc"), ("a\x1f0\x1fb", "c")]
+    assert len(batch.c_ids) == 2 and len(set(batch.c_ids)) == 2
+
+
+def test_blast_udp_empty_payloads():
+    assert ingest_mod.blast_udp("127.0.0.1", 1, 10, []) == 0

@@ -215,6 +215,8 @@ class Server:
         # (a lost batch add is thousands of packets, not one).
         self.proto_received: collections.Counter = collections.Counter()
         self._proto_lock = threading.Lock()
+        # last-reported native parse-error/too-long totals (flush deltas)
+        self._native_err_reported = (0, 0)
         # Bounded-concurrency forwarding: the reference gives each flush its
         # own goroutine with a one-interval ctx deadline (flusher.go:81-86),
         # so in-flight forwards are implicitly bounded by deadline/interval.
@@ -725,6 +727,17 @@ class Server:
         for proto, n in drained.items():
             statsd.count("listen.received_per_protocol_total", n,
                          tags=[f"protocol:{proto}"])
+        if self.native is not None:
+            # parse-error/too-long accounting from the native data plane
+            mal, tl = self.native.malformed, self.native.too_long
+            pm, pt = self._native_err_reported
+            if mal > pm:
+                statsd.count("listen.parse_errors_total", mal - pm,
+                             tags=["protocol:udp"])
+            if tl > pt:
+                statsd.count("listen.packets_too_long_total", tl - pt,
+                             tags=["protocol:udp"])
+            self._native_err_reported = (mal, tl)
         statsd.count("spans.received_total", self.ssf_received)
         self.ssf_received = 0
         # per-span-sink ingest accounting (worker.go:603-678)
