@@ -490,11 +490,15 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
 
 static void ingest_datagram(Engine* e, ThreadScratch& sc, const char* data,
                             size_t len, Batch& b) {
+  // count BEFORE the length guard: the Python path tallies proto_received
+  // on receipt, then drops oversized datagrams (server.py _read_udp ->
+  // process_packet_buffer), and received_per_protocol_total must agree
+  // whichever data plane is active
+  b.packets++;
   if ((int)len > e->max_packet) {
     b.too_long++;
     return;
   }
-  b.packets++;
   const char* p = data;
   const char* end = data + len;
   while (p < end) {

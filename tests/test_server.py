@@ -144,6 +144,8 @@ def _make_certs(tmp_path):
     run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
         "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
         "-subj", "/CN=test-ca")
+    ext = tmp_path / "san.cnf"
+    ext.write_text("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
     certs = {}
     for who in ("server", "client"):
         key = tmp_path / f"{who}.key"
@@ -152,9 +154,10 @@ def _make_certs(tmp_path):
         run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
             "-keyout", str(key), "-out", str(csr),
             "-subj", f"/CN=127.0.0.1")
+        # SANs required: gRPC's TLS stack ignores CN-only certs
         run("openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
             "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
-            "-out", str(crt))
+            "-extfile", str(ext), "-out", str(crt))
         certs[who] = (str(key), str(crt))
     return str(ca_crt), certs
 

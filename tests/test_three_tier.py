@@ -1,0 +1,248 @@
+"""Full 3-tier chain: local veneur -> proxy -> global veneurs over loopback
+gRPC, including a membership change mid-run (ring rebuild) — the e2e shape
+of `proxy/handlers/handlers_test.go:65-374` composed with the server fixture
+pattern of `server_test.go` (round-1 verdict item #9).
+
+Also covers the proxy's gRPC-TLS listener (proxy.go:190-306) and the
+connection open/close stats (grpcstats/stats.go:1-49).
+"""
+
+import queue
+import socket
+import subprocess
+import time
+
+import grpc
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward import convert
+from veneur_tpu.forward.client import SEND_METRICS_V2
+from veneur_tpu.protocol import metric_pb2
+from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope
+from veneur_tpu.sinks import simple as simple_sinks
+
+from tests.test_server import _make_certs  # self-signed CA + certs helper
+
+
+def boot_global(name):
+    cfg = config_mod.Config(
+        grpc_address="127.0.0.1:0", interval=0.05,
+        percentiles=[0.5], aggregates=["count"], hostname=name)
+    sink = simple_sinks.ChannelMetricSink()
+    srv = Server(cfg, extra_metric_sinks=[sink])
+    srv.start()
+    return srv, sink
+
+
+def collect_names(servers_sinks, want, prefix, timeout=15.0):
+    """Flush the globals until `want` distinct prefixed names appear;
+    returns {name: global_index}."""
+    seen = {}
+    deadline = time.time() + timeout
+    while time.time() < deadline and len(seen) < want:
+        for i, (srv, sink) in enumerate(servers_sinks):
+            srv.flush()
+            while True:
+                try:
+                    batch = sink.queue.get_nowait()
+                except queue.Empty:
+                    break
+                for m in batch:
+                    if m.name.startswith(prefix):
+                        seen.setdefault(m.name, i)
+        time.sleep(0.05)
+    return seen
+
+
+def test_three_tier_end_to_end_with_ring_rebuild():
+    g1, s1 = boot_global("g1")
+    g2, s2 = boot_global("g2")
+    g3, s3 = boot_global("g3")
+    addr1 = f"127.0.0.1:{g1.grpc_import.port}"
+    addr2 = f"127.0.0.1:{g2.grpc_import.port}"
+    addr3 = f"127.0.0.1:{g3.grpc_import.port}"
+
+    proxy = Proxy(ProxyConfig(static_destinations=[addr1, addr2],
+                              discovery_interval=3600))
+    proxy.start()
+
+    lsink = simple_sinks.ChannelMetricSink()
+    local = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        forward_address=f"127.0.0.1:{proxy.grpc_port}",
+        interval=0.05, percentiles=[0.5], hostname="l"),
+        extra_metric_sinks=[lsink])
+    local.start()
+    try:
+        _, uaddr = local.statsd_addrs[0]
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+        # ---- phase 1: two globals in the ring --------------------------
+        for i in range(60):
+            tx.sendto(b"tt.c%d:1|c|#veneurglobalonly" % i, uaddr)
+            tx.sendto(b"tt.h%d:3.5|h\ntt.h%d:9.25|h" % (i, i), uaddr)
+        deadline = time.time() + 10
+        while time.time() < deadline and local.aggregator.processed < 180:
+            time.sleep(0.05)
+            local._drain_native()
+        assert local.aggregator.processed == 180
+        local.flush()
+
+        seen1 = collect_names([(g1, s1), (g2, s2)], 120, "tt.")
+        # every forwarded key landed on exactly one global, both used
+        counters1 = {n for n in seen1 if n.startswith("tt.c")}
+        # mixed-scope digests emit percentiles on the GLOBAL tier; their
+        # count/min/max aggregates flush from local scalars on the LOCAL
+        # instance (flusher.go:57-74 duality)
+        pcts1 = {n for n in seen1 if n.endswith(".50percentile")}
+        assert len(counters1) == 60
+        assert len(pcts1) == 60
+        assert {seen1[n] for n in seen1} == {0, 1}
+        local_batch = []
+        while not lsink.queue.empty():
+            local_batch.extend(lsink.queue.get())
+        lnames = {m.name: m.value for m in local_batch}
+        for i in range(60):
+            assert lnames[f"tt.h{i}.count"] == 2.0
+
+        # ---- membership change: g1 leaves, g3 joins --------------------
+        proxy.destinations.set_members([addr2, addr3])
+        deadline = time.time() + 10
+        while time.time() < deadline and proxy.destinations.size() != 2:
+            time.sleep(0.05)
+
+        # ---- phase 2: rebuilt ring -------------------------------------
+        # `processed` is per-interval (reset by the phase-1 flush) and the
+        # flush's own trace span feeds a few self-metrics back in, so wait
+        # on the engine's cumulative line total instead
+        base_lines = local.native.engine.totals()[0]
+        for i in range(60):
+            tx.sendto(b"tt2.c%d:1|c|#veneurglobalonly" % i, uaddr)
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and local.native.engine.totals()[0] < base_lines + 60):
+            time.sleep(0.05)
+            local._drain_native()
+        assert local.native.engine.totals()[0] >= base_lines + 60
+        local.flush()
+        tx.close()
+
+        seen2 = collect_names([(g2, s2), (g3, s3)], 60, "tt2.")
+        assert len(seen2) == 60          # nothing lost across the rebuild
+        assert {seen2[n] for n in seen2} == {0, 1}  # g2 AND g3 both serve
+        # accounting: any in-flight loss must be visible, not silent
+        assert proxy.stats["no_destination"] == 0
+        total = proxy.stats["routed"] + proxy.stats["dropped"]
+        assert total == proxy.stats["received"]
+    finally:
+        local.shutdown()
+        proxy.stop()
+        for g in (g1, g2, g3):
+            g.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# gRPC-TLS listener (proxy.go:190-306)
+# ---------------------------------------------------------------------------
+
+needs_openssl = pytest.mark.skipif(
+    subprocess.run(["which", "openssl"],
+                   capture_output=True).returncode != 0,
+    reason="openssl unavailable")
+
+
+def _send_v2(target, creds, metrics, timeout=5.0):
+    channel = grpc.secure_channel(target, creds)
+    v2 = channel.stream_unary(
+        SEND_METRICS_V2,
+        request_serializer=metric_pb2.Metric.SerializeToString,
+        response_deserializer=lambda b: b)
+    try:
+        v2(iter(metrics), timeout=timeout)
+    finally:
+        channel.close()
+
+
+@needs_openssl
+def test_proxy_grpc_tls_requires_client_cert(tmp_path):
+    ca, certs = _make_certs(tmp_path)
+    skey, scrt = certs["server"]
+    ckey, ccrt = certs["client"]
+    g, gs = boot_global("gt")
+    proxy = Proxy(ProxyConfig(
+        static_destinations=[f"127.0.0.1:{g.grpc_import.port}"],
+        grpc_tls_address="127.0.0.1:0",
+        tls_certificate=scrt, tls_key=skey,
+        tls_authority_certificate=ca))
+    proxy.start()
+    try:
+        assert proxy.grpc_tls_port > 0
+        fm = sm.ForwardMetric(name="tls.fwd", tags=[], kind="counter",
+                              scope=MetricScope.GLOBAL_ONLY,
+                              counter_value=7)
+        pb = convert.to_pb(fm)
+        with open(ca, "rb") as f:
+            ca_bytes = f.read()
+
+        # without a client certificate the handshake must fail
+        bad = grpc.ssl_channel_credentials(root_certificates=ca_bytes)
+        with pytest.raises(grpc.RpcError):
+            _send_v2(f"127.0.0.1:{proxy.grpc_tls_port}", bad, [pb],
+                     timeout=3.0)
+
+        # with the client certificate the metric flows through to a global
+        with open(ckey, "rb") as f:
+            key_bytes = f.read()
+        with open(ccrt, "rb") as f:
+            crt_bytes = f.read()
+        good = grpc.ssl_channel_credentials(
+            root_certificates=ca_bytes, private_key=key_bytes,
+            certificate_chain=crt_bytes)
+        _send_v2(f"127.0.0.1:{proxy.grpc_tls_port}", good, [pb])
+        deadline = time.time() + 10
+        got = {}
+        while time.time() < deadline and "tls.fwd" not in got:
+            g.flush()
+            try:
+                for m in gs.queue.get(timeout=0.2):
+                    got[m.name] = m.value
+            except queue.Empty:
+                pass
+        assert got["tls.fwd"] == 7.0
+    finally:
+        proxy.stop()
+        g.shutdown()
+
+
+def test_grpcstats_connection_counters():
+    g, _ = boot_global("gc")
+    proxy = Proxy(ProxyConfig(
+        static_destinations=[f"127.0.0.1:{g.grpc_import.port}"]))
+    proxy.start()
+    try:
+        fm = sm.ForwardMetric(name="st.c", tags=[], kind="counter",
+                              scope=MetricScope.GLOBAL_ONLY, counter_value=1)
+        channel = grpc.insecure_channel(f"127.0.0.1:{proxy.grpc_port}")
+        v2 = channel.stream_unary(
+            SEND_METRICS_V2,
+            request_serializer=metric_pb2.Metric.SerializeToString,
+            response_deserializer=lambda b: b)
+        v2(iter([convert.to_pb(fm)]), timeout=5.0)
+        v2(iter([convert.to_pb(fm)]), timeout=5.0)
+        channel.close()
+        snap = proxy.grpc_stats.snapshot()
+        # two server-side stream opens+closes; the destination channel
+        # reached READY at least once
+        assert snap["opened"] >= 2 and snap["closed"] >= 2
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and proxy.grpc_stats.snapshot()["client_opened"] < 1):
+            time.sleep(0.05)
+        assert proxy.grpc_stats.snapshot()["client_opened"] >= 1
+    finally:
+        proxy.stop()
+        g.shutdown()

@@ -47,6 +47,10 @@ def main(argv=None) -> int:
         send_buffer_size=int(data.get("send_buffer_size", 1024)),
         ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
         static_destinations=list(data.get("static_destinations", [])),
+        grpc_tls_address=data.get("grpc_tls_address", ""),
+        tls_certificate=data.get("tls_certificate", ""),
+        tls_key=data.get("tls_key", ""),
+        tls_authority_certificate=data.get("tls_authority_certificate", ""),
     )
     discoverer = None
     disc_kind = data.get("discoverer", "")

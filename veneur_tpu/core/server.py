@@ -275,9 +275,14 @@ class Server:
     # -- listeners (networking.go) ----------------------------------------
 
     def start(self) -> None:
-        if self.config.native_ingest:
+        has_udp_statsd = any(
+            parse_listen_addr(a)[0] == "udp"
+            for a in self.config.statsd_listen_addresses)
+        if self.config.native_ingest and has_udp_statsd:
             # the C++ edge data plane (UDP readers + parser + staging);
-            # the Python chain stays as fallback and slow path
+            # the Python chain stays as fallback and slow path.  Only
+            # built when a UDP listener exists to feed it — TCP/unix-only
+            # configs skip the engine (and its first-run g++ compile)
             try:
                 from veneur_tpu.ingest import NativeIngest
                 self.native = NativeIngest(

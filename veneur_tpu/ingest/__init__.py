@@ -377,8 +377,35 @@ class NativeIngest:
         """Drain the engine and fold the batch into the arenas.  One brief
         aggregator-lock hold; events/service checks replay through the
         Python slow path afterwards."""
+        return self._drain(clear_intern=False)
+
+    def reset_interning(self) -> DrainBatch:
+        """Apply a final drain, then clear the engine's intern table + the
+        id cache (cardinality-churn GC: the intern map would otherwise grow
+        with every metric identity ever seen).  The engine restarts its id
+        space at 0, so the Python cache stays bounded by live cardinality."""
+        return self._drain(clear_intern=True)
+
+    def drain_or_gc(self, intern_threshold: int) -> DrainBatch:
+        """One drainer-loop tick: a plain drain, or a drain+intern-GC when
+        the engine's identity table has outgrown `intern_threshold`."""
+        return self._drain(clear_intern=False,
+                           intern_threshold=intern_threshold)
+
+    def _drain(self, clear_intern: bool,
+               intern_threshold: Optional[int] = None) -> DrainBatch:
+        """The single drain path: lock, consolidate+apply (optionally
+        wiping the intern table and id cache), then replay punted
+        events/service-check lines through the Python slow path.  All
+        engine access happens under the drain lock — close() takes the
+        same lock, so teardown cannot free the engine mid-call."""
         with self._drain_lock:
-            batch = self._drain_apply()
+            if intern_threshold is not None and not self.engine._closed:
+                clear_intern = (self.engine.intern_count()
+                                > intern_threshold)
+            batch = self._drain_apply(clear_intern)
+            if clear_intern:
+                self._info = []
         if self.on_other:
             for line in batch.other:
                 self.on_other(line)
@@ -411,36 +438,6 @@ class NativeIngest:
                 if len(batch.s_ids):
                     rows = self._rows_for(agg.sets, batch.s_ids)
                     agg.sets.stage_hash_batch(rows, batch.s_hashes)
-        return batch
-
-    def reset_interning(self) -> DrainBatch:
-        """Apply a final drain, then clear the engine's intern table + the
-        id cache (cardinality-churn GC: the intern map would otherwise grow
-        with every metric identity ever seen).  The engine restarts its id
-        space at 0, so the Python cache stays bounded by live cardinality."""
-        with self._drain_lock:
-            batch = self._drain_apply(clear_intern=True)
-            self._info = []
-        if self.on_other:
-            for line in batch.other:
-                self.on_other(line)
-        return batch
-
-    def drain_or_gc(self, intern_threshold: int) -> DrainBatch:
-        """One drainer-loop tick: a plain drain, or a drain+intern-GC when
-        the engine's identity table has outgrown `intern_threshold`.  All
-        engine access is under the drain lock (close() takes the same lock,
-        so a teardown cannot free the engine mid-call)."""
-        with self._drain_lock:
-            if self.engine._closed:
-                return DrainBatch.void()
-            clear = self.engine.intern_count() > intern_threshold
-            batch = self._drain_apply(clear_intern=clear)
-            if clear:
-                self._info = []
-        if self.on_other:
-            for line in batch.other:
-                self.on_other(line)
         return batch
 
     def stop(self) -> None:

@@ -20,8 +20,9 @@ logger = logging.getLogger("veneur_tpu.proxy.destinations")
 
 
 class Destinations:
-    def __init__(self, send_buffer_size: int = 1024):
+    def __init__(self, send_buffer_size: int = 1024, grpc_stats=None):
         self.send_buffer_size = send_buffer_size
+        self.grpc_stats = grpc_stats
         self._lock = threading.Lock()
         self._ring = ConsistentHash()
         self._dests: dict[str, Destination] = {}
@@ -56,8 +57,11 @@ class Destinations:
                                      daemon=True).start()
 
     def _connect(self, address: str) -> Destination:
-        return Destination(address, self.send_buffer_size,
+        dest = Destination(address, self.send_buffer_size,
                            on_closed=self._connection_closed)
+        if self.grpc_stats is not None:
+            self.grpc_stats.watch_channel(dest.channel)
+        return dest
 
     def _connection_closed(self, dest: Destination) -> None:
         self.remove(dest.address, expected=dest)

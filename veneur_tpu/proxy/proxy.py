@@ -24,6 +24,7 @@ from google.protobuf import empty_pb2
 from veneur_tpu.discovery import Discoverer, StaticDiscoverer
 from veneur_tpu.protocol import forward_pb2, metric_pb2
 from veneur_tpu.proxy.destinations import Destinations
+from veneur_tpu.proxy.grpcstats import GrpcStats
 from veneur_tpu.util.matcher import TagMatcher
 
 logger = logging.getLogger("veneur_tpu.proxy")
@@ -47,15 +48,26 @@ class ProxyConfig:
     send_buffer_size: int = 1024
     ignore_tags: list[TagMatcher] = field(default_factory=list)
     static_destinations: list[str] = field(default_factory=list)
+    # optional second, TLS-authenticated listener (proxy.go:190-306: the
+    # reference hosts plain gRPC and gRPC-TLS side by side); client certs
+    # are REQUIRED when an authority is configured (mTLS)
+    grpc_tls_address: str = ""
+    tls_certificate: str = ""            # PEM file paths
+    tls_key: str = ""
+    tls_authority_certificate: str = ""
 
 
 class Proxy:
     def __init__(self, cfg: ProxyConfig,
-                 discoverer: Optional[Discoverer] = None):
+                 discoverer: Optional[Discoverer] = None,
+                 statsd=None):
         self.cfg = cfg
         self.discoverer = discoverer or StaticDiscoverer(
             cfg.static_destinations)
-        self.destinations = Destinations(cfg.send_buffer_size)
+        # connection open/close accounting (grpcstats/stats.go:1-49)
+        self.grpc_stats = GrpcStats(statsd=statsd)
+        self.destinations = Destinations(cfg.send_buffer_size,
+                                         grpc_stats=self.grpc_stats)
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
                       "no_destination": 0}
         self._stats_lock = threading.Lock()
@@ -63,12 +75,20 @@ class Proxy:
 
         self.grpc_server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="proxy-grpc"))
+                max_workers=16, thread_name_prefix="proxy-grpc"),
+            interceptors=[self.grpc_stats.interceptor()])
         self.grpc_server.add_generic_rpc_handlers([self._handlers()])
         self.grpc_port = self.grpc_server.add_insecure_port(
             cfg.grpc_address)
         if self.grpc_port == 0:
             raise OSError(f"could not bind proxy to {cfg.grpc_address}")
+        self.grpc_tls_port = 0
+        if cfg.grpc_tls_address:
+            self.grpc_tls_port = self.grpc_server.add_secure_port(
+                cfg.grpc_tls_address, self._server_credentials())
+            if self.grpc_tls_port == 0:
+                raise OSError(
+                    f"could not bind proxy TLS to {cfg.grpc_tls_address}")
 
         host, _, port = cfg.http_address.rpartition(":")
         self.httpd = http.server.ThreadingHTTPServer(
@@ -76,6 +96,21 @@ class Proxy:
         self.httpd.daemon_threads = True
         self.http_port = self.httpd.server_address[1]
         self._started = False
+
+    def _server_credentials(self) -> grpc.ServerCredentials:
+        """mTLS server credentials (proxy.go:226-266 semantics: client
+        certificates required when an authority is configured)."""
+        with open(self.cfg.tls_key, "rb") as f:
+            key = f.read()
+        with open(self.cfg.tls_certificate, "rb") as f:
+            cert = f.read()
+        ca = None
+        if self.cfg.tls_authority_certificate:
+            with open(self.cfg.tls_authority_certificate, "rb") as f:
+                ca = f.read()
+        return grpc.ssl_server_credentials(
+            [(key, cert)], root_certificates=ca,
+            require_client_auth=ca is not None)
 
     # -- gRPC Forward service ---------------------------------------------
 
