@@ -1,0 +1,128 @@
+"""OpenTracing bridge tests (trace/opentracing.go parity: header dialects,
+parenting, active-scope nesting, error tagging, end-to-end submission)."""
+
+import pytest
+
+from veneur_tpu import trace as trace_mod
+from veneur_tpu.trace import opentracing as ot
+
+
+def collecting_tracer():
+    spans = []
+    client = trace_mod.new_channel_client(spans.append)
+    return ot.Tracer(client, service="svc"), client, spans
+
+
+def test_span_lifecycle_and_tags():
+    tracer, client, spans = collecting_tracer()
+    with tracer.start_span("op", tags={"k": "v"}) as span:
+        span.set_tag("n", 42)
+        span.log_kv({"event": "cache_miss"})
+    client.flush()
+    client.close()
+    assert len(spans) == 1
+    s = spans[0]
+    assert s.name == "op" and s.service == "svc"
+    assert s.tags["k"] == "v" and s.tags["n"] == "42"
+    assert s.tags["event"] == "cache_miss"
+    assert not s.error
+
+
+def test_child_of_parenting():
+    tracer, client, spans = collecting_tracer()
+    parent = tracer.start_span("parent")
+    child = tracer.start_span("child", child_of=parent)
+    child.finish()
+    parent.finish()
+    client.flush()
+    client.close()
+    by_name = {s.name: s for s in spans}
+    assert by_name["child"].trace_id == by_name["parent"].trace_id
+    assert by_name["child"].parent_id == by_name["parent"].id
+
+
+def test_active_scope_nesting_and_error():
+    tracer, client, spans = collecting_tracer()
+    with pytest.raises(RuntimeError):
+        with tracer.start_active_span("outer"):
+            with tracer.start_active_span("inner"):
+                assert tracer.active_span.inner.name == "inner"
+                raise RuntimeError("boom")
+    assert tracer.active_span is None
+    client.flush()
+    client.close()
+    by_name = {s.name: s for s in spans}
+    assert by_name["inner"].parent_id == by_name["outer"].id
+    assert by_name["inner"].error and by_name["outer"].error
+
+
+def test_inject_extract_roundtrip():
+    tracer, client, _ = collecting_tracer()
+    span = tracer.start_span("op")
+    carrier = {}
+    tracer.inject(span, ot.Format.HTTP_HEADERS, carrier)
+    # Envoy/Lightstep dialect, hex (opentracing.go defaultHeaderFormat)
+    assert carrier["ot-tracer-traceid"] == f"{span.inner.trace_id:x}"
+    assert carrier["ot-tracer-sampled"] == "true"
+    ctx = tracer.extract(ot.Format.HTTP_HEADERS, carrier)
+    assert ctx.trace_id == span.inner.trace_id
+    assert ctx.span_id == span.inner.span_id
+    # a span continued from the extracted context joins the trace
+    cont = tracer.start_span("cont", child_of=ctx)
+    assert cont.inner.trace_id == span.inner.trace_id
+    assert cont.inner.parent_id == span.inner.span_id
+    client.close()
+
+
+@pytest.mark.parametrize("headers,tid,sid", [
+    ({"Trace-Id": "12345", "Span-Id": "678"}, 12345, 678),          # OT
+    ({"X-Trace-Id": "99", "X-Span-Id": "7"}, 99, 7),                # Ruby
+    ({"Traceid": "424242", "Spanid": "111"}, 424242, 111),          # veneur
+    ({"ot-tracer-traceid": "ff", "ot-tracer-spanid": "a"}, 255, 10),
+])
+def test_extract_accepts_reference_dialects(headers, tid, sid):
+    tracer = ot.Tracer()
+    ctx = tracer.extract(ot.Format.HTTP_HEADERS, headers)
+    assert (ctx.trace_id, ctx.span_id) == (tid, sid)
+
+
+def test_extract_corrupted_and_unsupported():
+    tracer = ot.Tracer()
+    with pytest.raises(ot.SpanContextCorrupted):
+        tracer.extract(ot.Format.HTTP_HEADERS, {"Trace-Id": "not-a-number"})
+    with pytest.raises(ot.SpanContextCorrupted):
+        tracer.extract(ot.Format.HTTP_HEADERS, {"unrelated": "1"})
+    with pytest.raises(ot.UnsupportedFormatException):
+        tracer.extract("binary", {})
+    with pytest.raises(ot.UnsupportedFormatException):
+        tracer.inject(ot.SpanContext(1, 2), "binary", {})
+
+
+def test_scope_manager_restores_active_scope():
+    """After a nested scope closes, ScopeManager.active is the OUTER
+    scope (not a stale closed one), and double-close is a no-op."""
+    tracer, client, _ = collecting_tracer()
+    outer = tracer.start_active_span("outer")
+    assert tracer.scope_manager.active is outer
+    inner = tracer.start_active_span("inner")
+    assert tracer.scope_manager.active is inner
+    inner.close()
+    assert tracer.scope_manager.active is outer
+    assert tracer.active_span is outer.span
+    inner.close()  # idempotent: must not clobber the restored state
+    assert tracer.scope_manager.active is outer
+    outer.close()
+    assert tracer.scope_manager.active is None
+    client.close()
+
+
+def test_finish_time_honored():
+    tracer, client, spans = collecting_tracer()
+    import time as time_mod
+    t0 = time_mod.time()
+    span = tracer.start_span("past", start_time=t0 - 10)
+    span.finish(finish_time=t0 - 5)
+    client.flush()
+    client.close()
+    dur_ns = spans[0].end_timestamp - spans[0].start_timestamp
+    assert abs(dur_ns - 5e9) < 1e6

@@ -330,3 +330,31 @@ def test_matcher_semantics():
     assert not mm.match(cfgs, "web.hits", ["env:prod"])
     assert not mm.match(cfgs, "api.hits", ["env:dev"])
     assert not mm.match(cfgs, "api.hits", ["env:prod", "canary:true"])
+
+
+def test_http_debug_profile(fixture_server):
+    """JAX profiler trace endpoint (SURVEY §5.1 analog of pprof)."""
+    import json as json_mod
+
+    srv, _ = fixture_server(enable_profiling=True)
+    api = http_api.HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    host, port = api.address
+    base = f"http://{host}:{port}"
+    body = urllib.request.urlopen(
+        base + "/debug/profile?seconds=0.2", timeout=30).read()
+    out = json_mod.loads(body)
+    assert out["files"] > 0 and "veneur-jax-trace-" in out["trace_dir"]
+    api.stop()
+
+
+def test_http_debug_profile_disabled(fixture_server):
+    srv, _ = fixture_server()  # enable_profiling defaults off
+    api = http_api.HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    host, port = api.address
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(
+            f"http://{host}:{port}/debug/profile", timeout=10)
+    assert exc.value.code == 403
+    api.stop()

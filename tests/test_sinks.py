@@ -509,3 +509,73 @@ def test_mock_sinks_record():
     ss.ingest(mkspan())
     ss.flush()
     assert len(ss.spans) == 1 and ss.flush_count == 1
+
+
+# ------------------------------------------------- datadog retry/backoff
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(length)
+        self.server.requests += 1
+        code = self.server.responses.pop(0) if self.server.responses else 200
+        self.send_response(code)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def do_GET(self):
+        self.do_POST()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def flaky_server():
+    srv = HTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    srv.requests = 0
+    srv.responses = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_datadog_retries_transient_then_succeeds(flaky_server):
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    flaky_server.responses = [503, 429]  # two transient errors, then 200
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "k", "flush_retries": 3,
+        "api_hostname": f"http://127.0.0.1:{flaky_server.server_port}"}))
+    res = sink.flush([im("dd.retry", 1.0, "counter")])
+    assert res.flushed == 1 and res.dropped == 0
+    assert flaky_server.requests == 3
+
+
+def test_datadog_no_retry_on_client_error(flaky_server):
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    flaky_server.responses = [403]
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "bad", "flush_retries": 3,
+        "api_hostname": f"http://127.0.0.1:{flaky_server.server_port}"}))
+    res = sink.flush([im("dd.permfail", 1.0)])
+    assert res.dropped == 1
+    assert flaky_server.requests == 1  # permanent 4xx never retries
+
+
+def test_datadog_validate_on_start(flaky_server, caplog):
+    import logging as _logging
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    flaky_server.responses = [403]
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "bad", "validate_on_start": True,
+        "api_hostname": f"http://127.0.0.1:{flaky_server.server_port}"}))
+    with caplog.at_level(_logging.ERROR, logger="veneur_tpu.sinks.datadog"):
+        sink.start(None)
+    assert flaky_server.requests == 1
+    assert any("rejected" in r.message for r in caplog.records)

@@ -208,6 +208,7 @@ class Server:
             thread_name_prefix="flush")
         self.last_flush_unix = time.time()
         self.flush_count = 0
+        self._flush_serial = threading.Lock()
         # per-protocol received-packet tallies, drained each flush into
         # listen.received_per_protocol_total (flusher.go:280,455-475).
         # Plain int increments; GIL-atomic enough for telemetry.  Batch
@@ -702,7 +703,13 @@ class Server:
     def flush(self) -> None:
         """One flush interval, traced as a span through the server's own
         pipeline (flusher.go:26-122: Flush is itself a span, and the flush
-        path reports the standard self-metrics)."""
+        path reports the standard self-metrics).  Serialized: callers
+        beyond the ticker (tests, /debug/profile, flush_on_shutdown) race
+        the non-atomic per-interval counters otherwise."""
+        with self._flush_serial:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
         from veneur_tpu import scopedstatsd
         from veneur_tpu import ssf as ssf_mod
 
@@ -767,12 +774,12 @@ class Server:
                     hit = matcher_mod.match(rc.match, m.name, m.tags)
                     m.sinks.update(rc.matched if hit else rc.not_matched)
 
-        futures = []
+        futures = {}
         if self.forwarder is not None and self.is_local and res.forward:
             if self._forward_slots.acquire(blocking=False):
                 try:
-                    futures.append(self._flush_pool.submit(
-                        self._forward_safely, res.forward, span))
+                    futures[self._flush_pool.submit(
+                        self._forward_safely, res.forward, span)] = "forward"
                 except RuntimeError:  # pool shut down mid-flush
                     self._forward_slots.release()
             else:
@@ -785,20 +792,26 @@ class Server:
                                "forward metrics",
                                self.FORWARD_MAX_IN_FLIGHT, len(res.forward))
         for spec, sink in self.metric_sinks:
-            futures.append(self._flush_pool.submit(
-                self._flush_sink, spec, sink, res.metrics, events, statsd))
+            futures[self._flush_pool.submit(
+                self._flush_sink, spec, sink, res.metrics, events,
+                statsd)] = f"metric:{spec.name or spec.kind}"
         for sink in self.span_sinks:
-            futures.append(self._flush_pool.submit(
-                self._flush_span_sink, sink, statsd))
+            futures[self._flush_pool.submit(
+                self._flush_span_sink, sink,
+                statsd)] = f"span:{sink.name()}"
         done, not_done = concurrent.futures.wait(
             futures, timeout=self.config.interval)
-        # deadline classification (flusher.go:553-566 / weak-3): a sink
-        # still running after one full interval is a straggler; it keeps
-        # running (we cannot safely interrupt it) but is counted.
+        # deadline classification (flusher.go:553-566): a sink still
+        # running after one full interval is a straggler; it keeps running
+        # (we cannot safely interrupt it) but is counted per sink so the
+        # slow backend is identifiable from self-metrics alone.
+        for fut in not_done:
+            statsd.count("flush.stragglers_total", 1,
+                         tags=[f"flush:{futures[fut]}"])
         if not_done:
-            statsd.count("flush.stragglers_total", len(not_done))
-            logger.warning("flush deadline: %d sink flushes still running "
-                           "after %.1fs", len(not_done), self.config.interval)
+            logger.warning("flush deadline: still running after %.1fs: %s",
+                           self.config.interval,
+                           ", ".join(sorted(futures[f] for f in not_done)))
         span.add(ssf_mod.timing(
             "flush.total_duration_ns",
             time.perf_counter() - flush_start))

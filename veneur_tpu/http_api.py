@@ -3,16 +3,23 @@
 Mirrors `http.go:15-67`: /healthcheck, /version, /builddate, optional
 /config/json + /config/yaml (secret-redacted, util/config/config.go:65-96),
 optional /quitquitquit, and Python-flavored debug endpoints in place of Go's
-pprof suite (/debug/vars runtime stats; /debug/threads stack dump).
+pprof suite (/debug/vars runtime stats; /debug/threads stack dump;
+/debug/profile JAX device trace — the TPU analog of `enable_profiling` +
+pprof, server.go:1366-1383 / SURVEY §5.1).
 """
 
 from __future__ import annotations
 
 import http.server
 import json
+import logging
+import os
 import sys
+import tempfile
 import threading
+import time
 import traceback
+import urllib.parse
 from typing import Optional
 
 import yaml
@@ -73,6 +80,21 @@ def make_handler(server) -> type:
                 }
                 self._reply(200, json.dumps(stats, indent=2).encode(),
                             "application/json")
+            elif self.path.startswith("/debug/profile"):
+                if not cfg.enable_profiling:
+                    self._reply(403, b"profiling disabled "
+                                b"(set enable_profiling)\n")
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                try:
+                    seconds = min(float(q.get("seconds", ["2"])[0]), 60.0)
+                except ValueError:
+                    self._reply(400, b"bad seconds\n")
+                    return
+                out = _jax_profile(server, seconds)
+                self._reply(200, json.dumps(out, indent=2).encode(),
+                            "application/json")
             elif self.path == "/debug/threads":
                 frames = sys._current_frames()
                 out = []
@@ -84,6 +106,39 @@ def make_handler(server) -> type:
                 self._reply(404, b"not found\n")
 
     return Handler
+
+
+# one profile at a time; concurrent requests queue here
+_profile_lock = threading.Lock()
+
+
+def _jax_profile(server, seconds: float) -> dict:
+    """Capture a JAX profiler trace while the serving flush path runs.
+
+    Writes a TensorBoard-loadable trace directory and, to guarantee the
+    window contains the device program (flush may be seconds away on a
+    long interval), drives one flush during the capture.  Returns the
+    trace path for `tensorboard --logdir` / `xprof`.
+    """
+    import jax
+
+    with _profile_lock:
+        trace_dir = tempfile.mkdtemp(prefix="veneur-jax-trace-")
+        t0 = time.perf_counter()
+        with jax.profiler.trace(trace_dir):
+            try:
+                server.flush()
+            except Exception:
+                logging.getLogger("veneur_tpu.http").exception(
+                    "flush under profiler failed")
+            remaining = seconds - (time.perf_counter() - t0)
+            if remaining > 0:
+                time.sleep(remaining)
+        files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
+        return {"trace_dir": trace_dir,
+                "seconds": round(time.perf_counter() - t0, 3),
+                "files": files,
+                "hint": f"tensorboard --logdir {trace_dir}"}
 
 
 class HttpApi:

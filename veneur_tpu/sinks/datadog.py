@@ -37,22 +37,35 @@ DEFAULT_SPAN_BUFFER = 16_384
 
 
 def _post_json(session: requests.Session, url: str, payload,
-               timeout: float = 10.0, headers: Optional[dict] = None) -> bool:
+               timeout: float = 10.0, headers: Optional[dict] = None,
+               retries: int = 0, backoff_s: float = 0.2) -> bool:
+    """gzip JSON POST with bounded retry.  Transient failures (connection
+    errors, 5xx, 429) retry with exponential backoff; other 4xx are
+    permanent client errors and fail immediately (the classification of
+    flusher.go:553-566 applied to the sink transport)."""
     body = gzip.compress(json.dumps(payload).encode())
     hdrs = {"Content-Type": "application/json",
             "Content-Encoding": "gzip"}
     if headers:
         hdrs.update(headers)
-    try:
-        resp = session.post(url, data=body, headers=hdrs, timeout=timeout)
-        if resp.status_code >= 400:
-            logger.warning("datadog POST %s -> %d: %.200s", url,
-                           resp.status_code, resp.text)
-            return False
-        return True
-    except requests.RequestException as e:
-        logger.warning("datadog POST %s failed: %s", url, e)
-        return False
+    for attempt in range(retries + 1):
+        try:
+            resp = session.post(url, data=body, headers=hdrs,
+                                timeout=timeout)
+            if resp.status_code < 400:
+                return True
+            transient = resp.status_code >= 500 or resp.status_code == 429
+            logger.warning("datadog POST %s -> %d (%s): %.200s", url,
+                           resp.status_code,
+                           "transient" if transient else "permanent",
+                           resp.text)
+            if not transient:
+                return False
+        except requests.RequestException as e:
+            logger.warning("datadog POST %s failed: %s", url, e)
+        if attempt < retries:
+            time.sleep(backoff_s * (2 ** attempt))
+    return False
 
 
 def series_payload(metrics: list[InterMetric], hostname: str,
@@ -106,7 +119,27 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         self.interval_s = float(
             getattr(server_config, "interval", 10.0) or 10.0)
         self.extra_tags = list(cfg.get("tags", []))
+        self.flush_retries = int(cfg.get("flush_retries", 2))
+        self.validate_on_start = bool(cfg.get("validate_on_start", False))
         self.session = session or requests.Session()
+
+    def start(self, trace_client=None) -> None:
+        """Optional API-key validation against /api/v1/validate — a bad
+        key is surfaced at startup instead of as silent flush drops."""
+        if not self.validate_on_start:
+            return
+        try:
+            resp = self.session.get(
+                f"{self.api_url}/api/v1/validate",
+                headers={"DD-API-KEY": self.api_key}, timeout=5.0)
+            if resp.status_code == 403:
+                logger.error("datadog API key rejected (403) — metrics "
+                             "will be dropped until the key is fixed")
+            elif resp.status_code >= 400:
+                logger.warning("datadog key validation returned %d",
+                               resp.status_code)
+        except requests.RequestException as e:
+            logger.warning("datadog key validation unreachable: %s", e)
 
     def flush(self, metrics):
         if not metrics:
@@ -119,7 +152,8 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
             chunk = metrics[i:i + self.flush_max_per_body]
             payload = series_payload(chunk, self.hostname, self.interval_s,
                                      self.extra_tags)
-            if _post_json(self.session, url, payload, headers=auth):
+            if _post_json(self.session, url, payload, headers=auth,
+                          retries=self.flush_retries):
                 flushed += len(chunk)
             else:
                 dropped += len(chunk)

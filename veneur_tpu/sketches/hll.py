@@ -182,21 +182,14 @@ def union(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.maximum(a, b)
 
 
-@jax.jit
-def estimate(regs: jax.Array) -> jax.Array:
-    """Batched cardinality estimate for every row of `[S, m]` uint8
-    registers; returns [S] f32.
-
-    Uses LogLog-Beta (est = alpha*m*(m-ez) / (beta(ez) + sum 2^-r), vendor
+def estimate_from_moments(ez: jax.Array, ssum: jax.Array,
+                          m: int) -> jax.Array:
+    """The estimator tail shared by the XLA and Pallas paths: LogLog-Beta
+    (est = alpha*m*(m-ez) / (beta(ez) + sum 2^-r), vendor
     hyperloglog.go:207-228) for precisions with published beta constants
     (14, 16); classic bias-corrected HyperLogLog with linear counting
-    otherwise (non-default precisions and small test meshes).
-    """
-    s, m = regs.shape
+    otherwise (non-default precisions and small test meshes)."""
     p = int(m).bit_length() - 1
-    r = regs.astype(jnp.float32)
-    ez = jnp.sum((regs == 0).astype(jnp.float32), axis=1)          # [S]
-    ssum = jnp.sum(jnp.exp2(-r), axis=1)                           # [S]
     mf = float(m)
     beta_c = _BETAS.get(p)
     if beta_c is not None:
@@ -212,6 +205,16 @@ def estimate(regs: jax.Array) -> jax.Array:
         linear = mf * jnp.log(mf / jnp.maximum(ez, 1.0))
         est = jnp.where((raw <= 2.5 * mf) & (ez > 0), linear, raw) + 0.5
     return jnp.floor(est)
+
+
+@jax.jit
+def estimate(regs: jax.Array) -> jax.Array:
+    """Batched cardinality estimate for every row of `[S, m]` uint8
+    registers; returns [S] f32 (see estimate_from_moments)."""
+    r = regs.astype(jnp.float32)
+    ez = jnp.sum((regs == 0).astype(jnp.float32), axis=1)          # [S]
+    ssum = jnp.sum(jnp.exp2(-r), axis=1)                           # [S]
+    return estimate_from_moments(ez, ssum, regs.shape[1])
 
 
 # ---------------------------------------------------------------------------

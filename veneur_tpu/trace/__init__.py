@@ -76,7 +76,8 @@ class Span:
         if self._finished:
             return
         self._finished = True
-        self.end_ns = time.time_ns()
+        # honor a pre-set end time (the OpenTracing bridge's finish_time)
+        self.end_ns = self.end_ns or time.time_ns()
         self.error = self.error or error
 
         if self.client is not None:

@@ -1,0 +1,268 @@
+"""OpenTracing bridge for the trace client.
+
+Capability twin of `trace/opentracing.go`: an OpenTracing-style `Tracer`
+over `veneur_tpu.trace.Span`/`Client`, with text-map / HTTP-header
+propagation speaking the same header dialects the reference accepts
+(`HeaderFormats`, opentracing.go:38-69) — Envoy/Lightstep
+(`ot-tracer-traceid`, hex), plain OpenTracing (`Trace-Id`), Ruby
+(`X-Trace-Id`), and veneur (`Traceid`), decimal unless noted.  Inject
+writes the Envoy dialect (the reference's default) plus
+`ot-tracer-sampled: true`.
+
+The classes duck-type the `opentracing-python` API (`start_span`,
+`start_active_span`, `inject`, `extract`, `Span.set_tag/log_kv/finish`,
+`Format.TEXT_MAP/HTTP_HEADERS`), so code written against that API runs
+unchanged; the pypi package itself is not required.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from veneur_tpu import trace as trace_mod
+
+
+class Format:
+    """opentracing.Format equivalents (BINARY is unsupported, as in the
+    reference: opentracing.go Inject returns ErrUnsupportedFormat)."""
+    TEXT_MAP = "text_map"
+    HTTP_HEADERS = "http_headers"
+
+
+class SpanContextCorrupted(ValueError):
+    pass
+
+
+class UnsupportedFormatException(ValueError):
+    pass
+
+
+# (trace-id header, span-id header, base) — checked in the reference's
+# order, Envoy first (opentracing.go:38-69)
+HEADER_FORMATS = (
+    ("ot-tracer-traceid", "ot-tracer-spanid", 16),
+    ("trace-id", "span-id", 10),
+    ("x-trace-id", "x-span-id", 10),
+    ("traceid", "spanid", 10),
+)
+
+
+@dataclass
+class SpanContext:
+    trace_id: int = 0
+    span_id: int = 0
+    baggage: dict[str, str] = field(default_factory=dict)
+
+    def with_baggage_item(self, key: str, value: str) -> "SpanContext":
+        b = dict(self.baggage)
+        b[key] = value
+        return SpanContext(self.trace_id, self.span_id, b)
+
+
+class BridgeSpan:
+    """OpenTracing-style span wrapping trace_mod.Span
+    (opentracing.go Span, :240-330)."""
+
+    def __init__(self, tracer: "Tracer", inner: trace_mod.Span):
+        self._tracer = tracer
+        self.inner = inner
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.inner.trace_id, self.inner.span_id)
+
+    def tracer(self) -> "Tracer":
+        return self._tracer
+
+    def set_operation_name(self, name: str) -> "BridgeSpan":
+        self.inner.name = name
+        return self
+
+    def set_tag(self, key: str, value: Any) -> "BridgeSpan":
+        if key == "error":
+            self.inner.error = bool(value)
+        else:
+            self.inner.tags[str(key)] = str(value)
+        return self
+
+    def log_kv(self, key_values: dict[str, Any],
+               timestamp: Optional[float] = None) -> "BridgeSpan":
+        # logs become span tags, as the reference folds LogFields into
+        # the span's tag map (opentracing.go:300-318); the SSF span has
+        # no per-log timestamp representation, so `timestamp` is dropped
+        for k, v in key_values.items():
+            self.inner.tags[str(k)] = str(v)
+        return self
+
+    def set_baggage_item(self, key: str, value: str) -> "BridgeSpan":
+        self.inner.tags[f"baggage.{key}"] = value
+        return self
+
+    def get_baggage_item(self, key: str) -> Optional[str]:
+        return self.inner.tags.get(f"baggage.{key}")
+
+    def finish(self, finish_time: Optional[float] = None) -> None:
+        if finish_time is not None:
+            self.inner.end_ns = int(finish_time * 1e9)
+        self.inner.finish()
+
+    def __enter__(self) -> "BridgeSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.inner.finish(error=exc_type is not None)
+
+
+@dataclass
+class Scope:
+    span: BridgeSpan
+    _manager: "ScopeManager"
+    _to_restore: Optional[BridgeSpan] = None
+    _to_restore_scope: Optional["Scope"] = None
+    finish_on_close: bool = True
+    _closed: bool = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.finish_on_close:
+            self.span.finish()
+        slot = self._manager._active
+        slot.value = self._to_restore
+        slot.scope = self._to_restore_scope
+
+    def __enter__(self) -> "Scope":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.span.set_tag("error", True)
+        self.close()
+
+
+class ScopeManager:
+    """Thread-local active-span stack (opentracing-python ScopeManager)."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    @property
+    def _active(self):
+        if not hasattr(self._local, "slot"):
+            class _Slot:
+                value = None
+                scope = None
+            self._local.slot = _Slot()
+        return self._local.slot
+
+    @property
+    def active(self) -> Optional[Scope]:
+        return self._active.scope
+
+    def activate(self, span: BridgeSpan, finish_on_close: bool) -> Scope:
+        slot = self._active
+        scope = Scope(span, self, _to_restore=slot.value,
+                      _to_restore_scope=slot.scope,
+                      finish_on_close=finish_on_close)
+        slot.value = span
+        slot.scope = scope
+        return scope
+
+    @property
+    def active_span(self) -> Optional[BridgeSpan]:
+        return self._active.value
+
+
+class Tracer:
+    """OpenTracing-style tracer over a trace client
+    (opentracing.go Tracer, :388-483)."""
+
+    def __init__(self, client: Optional[trace_mod.Client] = None,
+                 service: str = ""):
+        self.client = client
+        self.service = service
+        self.scope_manager = ScopeManager()
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def start_span(self, operation_name: str = "",
+                   child_of=None, references=None,
+                   tags: Optional[dict] = None,
+                   start_time: Optional[float] = None,
+                   ignore_active_span: bool = False) -> BridgeSpan:
+        parent_ctx = None
+        if child_of is not None:
+            parent_ctx = (child_of.context
+                          if isinstance(child_of, BridgeSpan) else child_of)
+        elif not ignore_active_span and self.scope_manager.active_span:
+            parent_ctx = self.scope_manager.active_span.context
+
+        inner = trace_mod.Span(operation_name, service=self.service,
+                               client=self.client)
+        if parent_ctx is not None and parent_ctx.trace_id:
+            inner.trace_id = parent_ctx.trace_id
+            inner.parent_id = parent_ctx.span_id
+        if start_time is not None:
+            inner.start_ns = int(start_time * 1e9)
+        span = BridgeSpan(self, inner)
+        for k, v in (tags or {}).items():
+            span.set_tag(k, v)
+        return span
+
+    def start_active_span(self, operation_name: str,
+                          child_of=None, references=None,
+                          tags: Optional[dict] = None,
+                          start_time: Optional[float] = None,
+                          ignore_active_span: bool = False,
+                          finish_on_close: bool = True) -> Scope:
+        span = self.start_span(operation_name, child_of=child_of,
+                               references=references, tags=tags,
+                               start_time=start_time,
+                               ignore_active_span=ignore_active_span)
+        return self.scope_manager.activate(span, finish_on_close)
+
+    @property
+    def active_span(self) -> Optional[BridgeSpan]:
+        return self.scope_manager.active_span
+
+    # -- propagation -------------------------------------------------------
+
+    def inject(self, span_context, fmt: str, carrier: dict) -> None:
+        """Write the Envoy/Lightstep dialect, the reference's default
+        (opentracing.go:69, InjectHeader :490-501)."""
+        if fmt not in (Format.TEXT_MAP, Format.HTTP_HEADERS):
+            raise UnsupportedFormatException(fmt)
+        if isinstance(span_context, BridgeSpan):
+            span_context = span_context.context
+        carrier["ot-tracer-traceid"] = f"{span_context.trace_id:x}"
+        carrier["ot-tracer-spanid"] = f"{span_context.span_id:x}"
+        carrier["ot-tracer-sampled"] = "true"
+
+    def extract(self, fmt: str, carrier: dict) -> SpanContext:
+        """Accept any of the reference's four header dialects, checked in
+        its order (opentracing.go:38-69, ExtractRequestChild)."""
+        if fmt not in (Format.TEXT_MAP, Format.HTTP_HEADERS):
+            raise UnsupportedFormatException(fmt)
+        lowered = {str(k).lower(): v for k, v in carrier.items()}
+        for tid_key, sid_key, base in HEADER_FORMATS:
+            if tid_key in lowered:
+                try:
+                    trace_id = int(lowered[tid_key], base)
+                    span_id = int(lowered.get(sid_key, "0") or "0", base)
+                except ValueError as e:
+                    raise SpanContextCorrupted(
+                        f"bad {tid_key}: {e}") from e
+                return SpanContext(trace_id=trace_id, span_id=span_id)
+        raise SpanContextCorrupted("no trace headers found in carrier")
+
+
+def global_tracer_for(server) -> Tracer:
+    """Convenience: a Tracer bound to a Server's loopback trace client, so
+    in-process code instrumented with the OpenTracing API feeds the
+    server's own span pipeline (the NewChannelClient pattern,
+    server.go:518-521)."""
+    return Tracer(server.trace_client, service="veneur_tpu")
