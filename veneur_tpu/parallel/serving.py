@@ -102,6 +102,17 @@ def lane_ingest(lanes_mean: jax.Array, lanes_weight: jax.Array,
     return lanes_mean.at[lane].set(nm), lanes_weight.at[lane].set(nw)
 
 
+@functools.partial(jax.jit, static_argnames=("compression", "cap"))
+def partial_digests(dense_v: jax.Array, dense_w: jax.Array,
+                    compression: float, cap: int
+                    ) -> tuple[jax.Array, jax.Array]:
+    """One batched compress of a dense `[U, W]` sample matrix into per-row
+    partial digests `[U, cap]` — stage 1 of the hot-key ingest path (the
+    tree form of `mergeAllTemps`: any W collapses in a single launch
+    instead of a W/wave-width sequential chain)."""
+    return td.compress(dense_v, dense_w, compression, cap)
+
+
 @jax.jit
 def reset_rows(lanes_mean: jax.Array, lanes_weight: jax.Array,
                rows: jax.Array) -> tuple[jax.Array, jax.Array]:
