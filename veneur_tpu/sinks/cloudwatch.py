@@ -25,6 +25,61 @@ MAX_DATA_PER_CALL = 1000  # PutMetricData API limit
 MAX_DIMENSIONS = 30
 
 
+def flatten_query_params(namespace: str, metric_data: list[dict]) -> dict:
+    """PutMetricData in the AWS Query protocol: nested structures flatten
+    to `MetricData.member.N.<field>` form parameters."""
+    import datetime as dt
+
+    params = {"Action": "PutMetricData", "Version": "2010-08-01",
+              "Namespace": namespace}
+    for i, d in enumerate(metric_data, 1):
+        p = f"MetricData.member.{i}"
+        params[f"{p}.MetricName"] = d["MetricName"]
+        ts = d["Timestamp"]
+        if isinstance(ts, (int, float)):
+            ts = dt.datetime.fromtimestamp(
+                ts, dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        params[f"{p}.Timestamp"] = ts
+        params[f"{p}.Value"] = repr(float(d["Value"]))
+        params[f"{p}.Unit"] = d.get("Unit", "None")
+        for j, dim in enumerate(d.get("Dimensions", []), 1):
+            params[f"{p}.Dimensions.member.{j}.Name"] = dim["Name"]
+            params[f"{p}.Dimensions.member.{j}.Value"] = dim["Value"]
+    return params
+
+
+def _sigv4_uploader(cfg: dict):
+    """Build `put_metric_data(namespace, metric_data)` doing SigV4-signed
+    Query-API POSTs to CloudWatch (or an `aws_endpoint` override).
+    Returns None without credentials."""
+    import urllib.parse
+
+    import requests
+
+    from veneur_tpu.util import awsauth
+
+    creds = awsauth.Credentials.resolve(cfg)
+    if creds is None:
+        return None
+    region = cfg.get("aws_region") or "us-east-1"
+    endpoint = ((cfg.get("aws_endpoint") or "").rstrip("/")
+                or f"https://monitoring.{region}.amazonaws.com")
+    session = requests.Session()
+
+    def put(namespace, metric_data):
+        body = urllib.parse.urlencode(
+            flatten_query_params(namespace, metric_data)).encode()
+        headers = awsauth.sign_request(
+            "POST", endpoint + "/",
+            {"content-type": "application/x-www-form-urlencoded"},
+            body, creds, region, "monitoring")
+        resp = session.post(endpoint + "/", data=body, headers=headers,
+                            timeout=30)
+        resp.raise_for_status()
+
+    return put
+
+
 def metric_datum(m, interval_s: float, standard_unit_tag: str = "") -> dict:
     dims = []
     unit = "None"
@@ -67,23 +122,28 @@ class CloudWatchMetricSink(sink_mod.BaseMetricSink):
         self._warned = False
 
     def start(self, trace_client=None) -> None:
-        if self.put_metric_data is None:
-            try:
-                import boto3  # gated: not in this image by default
-                region = self.config.get("aws_region") or None
-                client = boto3.client("cloudwatch", region_name=region)
+        if self.put_metric_data is not None:
+            return
+        try:
+            import boto3  # gated: not in this image by default
+            region = self.config.get("aws_region") or None
+            client = boto3.client("cloudwatch", region_name=region)
 
-                def put(namespace, metric_data):
-                    client.put_metric_data(Namespace=namespace,
-                                           MetricData=metric_data)
-                self.put_metric_data = put
-            except ImportError:
-                if not self._warned:
-                    logger.warning(
-                        "cloudwatch sink %s: boto3 unavailable and no "
-                        "uploader injected; metrics will be dropped",
-                        self._name)
-                    self._warned = True
+            def put(namespace, metric_data):
+                client.put_metric_data(Namespace=namespace,
+                                       MetricData=metric_data)
+            self.put_metric_data = put
+            return
+        except ImportError:
+            pass
+        # boto3-free real path: SigV4-signed Query-API POSTs
+        self.put_metric_data = _sigv4_uploader(self.config)
+        if self.put_metric_data is None and not self._warned:
+            logger.warning(
+                "cloudwatch sink %s: no uploader injected, boto3 "
+                "unavailable, and no AWS credentials configured; metrics "
+                "will be dropped", self._name)
+            self._warned = True
 
     def flush(self, metrics):
         if not metrics:

@@ -25,6 +25,34 @@ from veneur_tpu.sinks.simple import encode_tsv_row
 logger = logging.getLogger("veneur_tpu.sinks.s3")
 
 
+def _sigv4_uploader(cfg: dict):
+    """Build a `put_object(bucket, key, body)` doing SigV4-signed HTTP
+    PUTs straight to S3 (or an `aws_endpoint` override for minio/tests).
+    Returns None without credentials."""
+    import requests
+
+    from veneur_tpu.util import awsauth
+
+    creds = awsauth.Credentials.resolve(cfg)
+    if creds is None:
+        return None
+    region = cfg.get("aws_region") or "us-east-1"
+    endpoint = (cfg.get("aws_endpoint") or "").rstrip("/")
+    session = requests.Session()
+
+    def put(bucket, key, body):
+        base = endpoint or f"https://{bucket}.s3.{region}.amazonaws.com"
+        path_prefix = f"/{bucket}" if endpoint else ""
+        url = f"{base}{path_prefix}/{key}"
+        headers = awsauth.sign_request(
+            "PUT", url, {"content-type": "application/octet-stream"},
+            body, creds, region, "s3")
+        resp = session.put(url, data=body, headers=headers, timeout=30)
+        resp.raise_for_status()
+
+    return put
+
+
 class S3MetricSink(sink_mod.BaseMetricSink):
     KIND = "s3"
 
@@ -43,21 +71,27 @@ class S3MetricSink(sink_mod.BaseMetricSink):
         self._warned = False
 
     def start(self, trace_client=None) -> None:
-        if self.put_object is None:
-            try:
-                import boto3  # gated: not in this image by default
-                region = self.config.get("aws_region") or None
-                client = boto3.client("s3", region_name=region)
+        if self.put_object is not None:
+            return
+        try:
+            import boto3  # gated: not in this image by default
+            region = self.config.get("aws_region") or None
+            client = boto3.client("s3", region_name=region)
 
-                def put(bucket, key, body):
-                    client.put_object(Bucket=bucket, Key=key, Body=body)
-                self.put_object = put
-            except ImportError:
-                if not self._warned:
-                    logger.warning(
-                        "s3 sink %s: boto3 unavailable and no uploader "
-                        "injected; metrics will be dropped", self._name)
-                    self._warned = True
+            def put(bucket, key, body):
+                client.put_object(Bucket=bucket, Key=key, Body=body)
+            self.put_object = put
+            return
+        except ImportError:
+            pass
+        # boto3-free real path: SigV4-signed PUTs (util/awsauth.py)
+        self.put_object = _sigv4_uploader(self.config)
+        if self.put_object is None and not self._warned:
+            logger.warning(
+                "s3 sink %s: no uploader injected, boto3 unavailable, and "
+                "no AWS credentials configured; metrics will be dropped",
+                self._name)
+            self._warned = True
 
     def object_key(self, now: Optional[float] = None) -> str:
         now = now if now is not None else time.time()
