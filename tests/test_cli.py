@@ -182,3 +182,41 @@ def test_diagnostics_collect_and_report():
     assert stats["threads"] >= 1
     assert "mem.rss_bytes" in stats
     assert rec.gauges["veneur.threads"] == stats["threads"]
+
+
+def test_example_configs_load():
+    """The annotated example configs must stay valid against the real
+    loaders (the reference ships example.yaml/example_host.yaml/
+    example_proxy.yaml; these are their capability twins)."""
+    import os
+
+    import yaml
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.proxy.proxy import proxy_config_from_dict
+
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    env = {"DATADOG_API_KEY": "k", "SPLUNK_HEC_TOKEN": "t"}
+
+    cfg = config_mod.read_config(os.path.join(root, "example.yaml"),
+                                 strict=True, environ=env)
+    assert cfg.grpc_address and not cfg.is_local
+    assert cfg.interval == 10.0
+    assert cfg.mesh_devices == 4
+    assert {s.kind for s in cfg.metric_sinks} >= {"datadog", "s3", "cortex"}
+    assert cfg.metric_sinks[0].config["api_key"] == "k"  # $ENV expanded
+    assert cfg.metric_sink_routing[0].matched == [
+        "s3-archive", "datadog", "cortex"]
+    assert cfg.sources[0].kind == "openmetrics"
+
+    host = config_mod.read_config(os.path.join(root, "example_host.yaml"),
+                                  strict=True, environ={})
+    assert host.is_local and host.forward_timeout == 10.0
+
+    with open(os.path.join(root, "example_proxy.yaml")) as f:
+        pdata = yaml.safe_load(f)
+    # the REAL loader the proxy CLI uses (durations included)
+    pcfg = proxy_config_from_dict(pdata)
+    assert pcfg.static_destinations
+    assert pcfg.discovery_interval == 10.0
+    assert pcfg.grpc_tls_address and pcfg.ignore_tags

@@ -36,22 +36,9 @@ def main(argv=None) -> int:
     crash.install(sentry_dsn=str(data.get("sentry_dsn") or ""),
                   terminate=True)
 
-    from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
-    from veneur_tpu.util.matcher import TagMatcher
+    from veneur_tpu.proxy.proxy import Proxy, proxy_config_from_dict
 
-    cfg = ProxyConfig(
-        grpc_address=data.get("grpc_address", "0.0.0.0:8128"),
-        http_address=data.get("http_address", "0.0.0.0:8127"),
-        forward_service=data.get("forward_service", "veneur-global"),
-        discovery_interval=float(data.get("discovery_interval", 10.0)),
-        send_buffer_size=int(data.get("send_buffer_size", 1024)),
-        ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
-        static_destinations=list(data.get("static_destinations", [])),
-        grpc_tls_address=data.get("grpc_tls_address", ""),
-        tls_certificate=data.get("tls_certificate", ""),
-        tls_key=data.get("tls_key", ""),
-        tls_authority_certificate=data.get("tls_authority_certificate", ""),
-    )
+    cfg = proxy_config_from_dict(data)
     discoverer = None
     disc_kind = data.get("discoverer", "")
     if disc_kind == "kubernetes":

@@ -188,10 +188,12 @@ class Config:
 
 
 _LIST_FIELDS_OF_FLOAT = {"percentiles"}
+# fields accepting Go-style duration strings ("10s", "500ms")
+_DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval"}
 
 
 def _coerce(key: str, value: Any) -> Any:
-    if key == "interval":
+    if key in _DURATION_FIELDS:
         return parse_duration(value)
     if key in _LIST_FIELDS_OF_FLOAT:
         return [float(x) for x in value]
@@ -256,7 +258,7 @@ def _env_overrides(cfg: Config, environ: dict[str, str]) -> None:
             setattr(cfg, f.name, int(raw))
         elif isinstance(cur, float):
             setattr(cfg, f.name, parse_duration(raw)
-                    if f.name == "interval" else float(raw))
+                    if f.name in _DURATION_FIELDS else float(raw))
         elif isinstance(cur, list):
             items = [x for x in raw.split(",") if x]
             if f.name in _LIST_FIELDS_OF_FLOAT:

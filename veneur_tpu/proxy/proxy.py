@@ -57,6 +57,28 @@ class ProxyConfig:
     tls_authority_certificate: str = ""
 
 
+def proxy_config_from_dict(data: dict) -> ProxyConfig:
+    """The one YAML->ProxyConfig loader (CLI and tests share it so the
+    shipped example configs are exercised by the real parsing, Go-style
+    durations included)."""
+    from veneur_tpu.config import parse_duration
+
+    return ProxyConfig(
+        grpc_address=data.get("grpc_address", "0.0.0.0:8128"),
+        http_address=data.get("http_address", "0.0.0.0:8127"),
+        forward_service=data.get("forward_service", "veneur-global"),
+        discovery_interval=parse_duration(
+            data.get("discovery_interval", 10.0)),
+        send_buffer_size=int(data.get("send_buffer_size", 1024)),
+        ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
+        static_destinations=list(data.get("static_destinations", [])),
+        grpc_tls_address=data.get("grpc_tls_address", ""),
+        tls_certificate=data.get("tls_certificate", ""),
+        tls_key=data.get("tls_key", ""),
+        tls_authority_certificate=data.get(
+            "tls_authority_certificate", ""))
+
+
 class Proxy:
     def __init__(self, cfg: ProxyConfig,
                  discoverer: Optional[Discoverer] = None,

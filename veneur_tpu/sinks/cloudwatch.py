@@ -122,20 +122,25 @@ class CloudWatchMetricSink(sink_mod.BaseMetricSink):
         self._warned = False
 
     def start(self, trace_client=None) -> None:
+        from veneur_tpu.util import awsauth
+
         if self.put_metric_data is not None:
             return
-        try:
-            import boto3  # gated: not in this image by default
-            region = self.config.get("aws_region") or None
-            client = boto3.client("cloudwatch", region_name=region)
+        # explicit config creds/endpoint: honor them via the SigV4 path,
+        # never boto3's ambient chain (see s3.py start())
+        if not awsauth.Credentials.config_has_explicit(self.config):
+            try:
+                import boto3  # gated: not in this image by default
+                region = self.config.get("aws_region") or None
+                client = boto3.client("cloudwatch", region_name=region)
 
-            def put(namespace, metric_data):
-                client.put_metric_data(Namespace=namespace,
-                                       MetricData=metric_data)
-            self.put_metric_data = put
-            return
-        except ImportError:
-            pass
+                def put(namespace, metric_data):
+                    client.put_metric_data(Namespace=namespace,
+                                           MetricData=metric_data)
+                self.put_metric_data = put
+                return
+            except ImportError:
+                pass
         # boto3-free real path: SigV4-signed Query-API POSTs
         self.put_metric_data = _sigv4_uploader(self.config)
         if self.put_metric_data is None and not self._warned:

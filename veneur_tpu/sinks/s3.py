@@ -71,19 +71,25 @@ class S3MetricSink(sink_mod.BaseMetricSink):
         self._warned = False
 
     def start(self, trace_client=None) -> None:
+        from veneur_tpu.util import awsauth
+
         if self.put_object is not None:
             return
-        try:
-            import boto3  # gated: not in this image by default
-            region = self.config.get("aws_region") or None
-            client = boto3.client("s3", region_name=region)
+        # explicit config creds/endpoint mean the operator wants THIS
+        # identity/target — never silently substitute boto3's ambient
+        # credential chain and the real AWS endpoint for them
+        if not awsauth.Credentials.config_has_explicit(self.config):
+            try:
+                import boto3  # gated: not in this image by default
+                region = self.config.get("aws_region") or None
+                client = boto3.client("s3", region_name=region)
 
-            def put(bucket, key, body):
-                client.put_object(Bucket=bucket, Key=key, Body=body)
-            self.put_object = put
-            return
-        except ImportError:
-            pass
+                def put(bucket, key, body):
+                    client.put_object(Bucket=bucket, Key=key, Body=body)
+                self.put_object = put
+                return
+            except ImportError:
+                pass
         # boto3-free real path: SigV4-signed PUTs (util/awsauth.py)
         self.put_object = _sigv4_uploader(self.config)
         if self.put_object is None and not self._warned:

@@ -38,11 +38,27 @@ class Credentials:
     def resolve(cls, cfg: dict) -> Optional["Credentials"]:
         """Sink-config credentials, falling back to the environment —
         the shared resolution for every AWS-speaking sink."""
-        if cfg.get("aws_access_key_id") and cfg.get("aws_secret_access_key"):
-            return cls(cfg["aws_access_key_id"],
-                       cfg["aws_secret_access_key"],
-                       cfg.get("aws_session_token") or "")
+        ak = cfg.get("aws_access_key_id")
+        sk = cfg.get("aws_secret_access_key")
+        if ak and sk:
+            return cls(ak, sk, cfg.get("aws_session_token") or "")
+        if ak or sk:
+            import logging
+            logging.getLogger("veneur_tpu.awsauth").warning(
+                "half-configured AWS credentials (only %s set in sink "
+                "config); ignoring them and falling back to the "
+                "environment",
+                "aws_access_key_id" if ak else "aws_secret_access_key")
         return cls.from_env()
+
+    @classmethod
+    def config_has_explicit(cls, cfg: dict) -> bool:
+        """True when the sink config itself names credentials or an
+        endpoint override — the operator wants THIS identity/target, not
+        whatever ambient chain an SDK would pick."""
+        return bool((cfg.get("aws_access_key_id")
+                     and cfg.get("aws_secret_access_key"))
+                    or cfg.get("aws_endpoint"))
 
 
 def _split_query(query: str) -> list[tuple[str, str]]:
@@ -65,6 +81,38 @@ def _hmac(key: bytes, msg: str) -> bytes:
 def _uri_encode(s: str, encode_slash: bool = True) -> str:
     safe = "-_.~" if encode_slash else "-_.~/"
     return urllib.parse.quote(s, safe=safe)
+
+
+def _canonical_request(method: str, url: str, lower_headers: dict,
+                       signed_names: list[str], payload_hash: str) -> str:
+    """The shared canonicalization used by both signing and the test
+    fake's verification — one algorithm, not two drifting copies."""
+    parsed = urllib.parse.urlparse(url)
+    canonical_uri = _uri_encode(parsed.path or "/", encode_slash=False)
+    canonical_query = "&".join(
+        f"{_uri_encode(k)}={_uri_encode(v)}"
+        for k, v in sorted(_split_query(parsed.query)))
+    canonical_headers = "".join(
+        f"{k}:{lower_headers.get(k, '')}\n" for k in signed_names)
+    return "\n".join([
+        method.upper(), canonical_uri, canonical_query,
+        canonical_headers, ";".join(signed_names), payload_hash])
+
+
+def _signature(canonical_request: str, amz_date: str, datestamp: str,
+               region: str, service: str, secret_key: str
+               ) -> tuple[str, str]:
+    """(scope, hex signature) for a canonical request."""
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    return scope, hmac.new(k, string_to_sign.encode(),
+                           hashlib.sha256).hexdigest()
 
 
 def sign_request(method: str, url: str, headers: dict, body: bytes,
@@ -90,35 +138,15 @@ def sign_request(method: str, url: str, headers: dict, body: bytes,
     if creds.session_token:
         out["x-amz-security-token"] = creds.session_token
 
-    canonical_uri = _uri_encode(parsed.path or "/", encode_slash=False)
-    canonical_query = "&".join(
-        f"{_uri_encode(k)}={_uri_encode(v)}"
-        for k, v in sorted(_split_query(parsed.query)))
-
     signed_names = sorted(k.lower() for k in out)
     lower = {k.lower(): str(v).strip() for k, v in out.items()}
-    canonical_headers = "".join(f"{k}:{lower[k]}\n" for k in signed_names)
-    signed_headers = ";".join(signed_names)
-
-    canonical_request = "\n".join([
-        method.upper(), canonical_uri, canonical_query,
-        canonical_headers, signed_headers, payload_hash])
-
-    scope = f"{datestamp}/{region}/{service}/aws4_request"
-    string_to_sign = "\n".join([
-        "AWS4-HMAC-SHA256", amz_date, scope,
-        hashlib.sha256(canonical_request.encode()).hexdigest()])
-
-    k = _hmac(("AWS4" + creds.secret_key).encode(), datestamp)
-    k = _hmac(k, region)
-    k = _hmac(k, service)
-    k = _hmac(k, "aws4_request")
-    signature = hmac.new(k, string_to_sign.encode(),
-                         hashlib.sha256).hexdigest()
-
+    canonical = _canonical_request(method, url, lower, signed_names,
+                                   payload_hash)
+    scope, signature = _signature(canonical, amz_date, datestamp, region,
+                                  service, creds.secret_key)
     out["Authorization"] = (
         f"AWS4-HMAC-SHA256 Credential={creds.access_key}/{scope}, "
-        f"SignedHeaders={signed_headers}, Signature={signature}")
+        f"SignedHeaders={';'.join(signed_names)}, Signature={signature}")
     # `host` travels via the connection; requests sets it itself
     del out["host"]
     return out
@@ -140,25 +168,9 @@ def verify_signature(method: str, url: str, headers: dict, body: bytes,
     amz_date = headers.get("x-amz-date") or headers.get("X-Amz-Date", "")
     payload_hash = hashlib.sha256(body or b"").hexdigest()
 
-    parsed = urllib.parse.urlparse(url)
-    canonical_uri = _uri_encode(parsed.path or "/", encode_slash=False)
-    canonical_query = "&".join(
-        f"{_uri_encode(k)}={_uri_encode(v)}"
-        for k, v in sorted(_split_query(parsed.query)))
     lower = {k.lower(): str(v).strip() for k, v in headers.items()}
-    canonical_headers = "".join(
-        f"{h}:{lower.get(h, '')}\n" for h in signed_headers)
-    canonical_request = "\n".join([
-        method.upper(), canonical_uri, canonical_query,
-        canonical_headers, ";".join(signed_headers), payload_hash])
-    scope = f"{datestamp}/{region}/{service}/aws4_request"
-    string_to_sign = "\n".join([
-        "AWS4-HMAC-SHA256", amz_date, scope,
-        hashlib.sha256(canonical_request.encode()).hexdigest()])
-    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
-    k = _hmac(k, region)
-    k = _hmac(k, service)
-    k = _hmac(k, "aws4_request")
-    want = hmac.new(k, string_to_sign.encode(),
-                    hashlib.sha256).hexdigest()
+    canonical = _canonical_request(method, url, lower, signed_headers,
+                                   payload_hash)
+    _, want = _signature(canonical, amz_date, datestamp, region, service,
+                         secret_key)
     return hmac.compare_digest(want, parts["Signature"])
