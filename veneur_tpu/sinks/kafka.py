@@ -25,6 +25,19 @@ logger = logging.getLogger("veneur_tpu.sinks.kafka")
 Producer = Callable[[str, bytes, bytes], None]  # (topic, key, value)
 
 
+def _wire_producer(cfg: dict):
+    """Native wire-protocol producer when `kafka_brokers` is configured
+    (veneur_tpu/util/kafka_wire.py — no client library needed)."""
+    brokers = cfg.get("kafka_brokers")
+    if not brokers:
+        return None
+    if isinstance(brokers, str):
+        brokers = [b.strip() for b in brokers.split(",") if b.strip()]
+    from veneur_tpu.util.kafka_wire import KafkaProducer
+    return KafkaProducer(brokers,
+                         client_id=cfg.get("client_id", "veneur-tpu"))
+
+
 def metric_to_json(m, interval_s: float) -> bytes:
     return json.dumps({
         "Name": m.name,
@@ -61,23 +74,35 @@ class KafkaMetricSink(sink_mod.BaseMetricSink):
         self.interval_s = float(
             getattr(server_config, "interval", 10.0) or 10.0)
         self.producer = producer
+        self._wire = None   # native wire-protocol producer (kafka_brokers)
         self._warned = False
 
     def start(self, trace_client=None) -> None:
-        if self.producer is None and not self._warned:
+        if self.producer is None and self._wire is None:
+            self._wire = _wire_producer(self.config)
+        if self.producer is None and self._wire is None \
+                and not self._warned:
             logger.warning(
-                "kafka sink %s has no producer injected; metrics will be "
-                "encoded then dropped", self._name)
+                "kafka sink %s has no producer injected and no "
+                "kafka_brokers configured; metrics will be encoded then "
+                "dropped", self._name)
             self._warned = True
 
     def flush(self, metrics):
         if not metrics:
             return sink_mod.MetricFlushResult()
-        flushed = dropped = 0
+        messages = []
         for m in metrics:
             key = f"{m.name}{m.type}".encode()
             value = (metric_to_proto(m) if self.serializer == "protobuf"
                      else metric_to_json(m, self.interval_s))
+            messages.append((key, value))
+        if self._wire is not None:
+            acked = self._wire.produce_batch(self.topic, messages)
+            return sink_mod.MetricFlushResult(
+                flushed=acked, dropped=len(messages) - acked)
+        flushed = dropped = 0
+        for key, value in messages:
             if self.producer is None:
                 dropped += 1
                 continue
@@ -104,8 +129,22 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
         self.sample_pct = float(cfg.get("span_sample_rate_percent", 100))
         self.sample_tag = cfg.get("span_sample_tag", "")
         self.producer = producer
+        self._wire = None
+        self._buffer: list = []   # wire path batches per flush interval
+        self._buffer_cap = int(cfg.get("span_buffer_size", 16384))
         self.sampled_out = 0
         self.dropped = 0
+
+    def start(self, trace_client=None) -> None:
+        if self.producer is None and self._wire is None:
+            self._wire = _wire_producer(self.config)
+
+    def flush(self) -> None:
+        if self._wire is None or not self._buffer:
+            return
+        batch, self._buffer = self._buffer, []
+        acked = self._wire.produce_batch(self.topic, batch)
+        self.dropped += len(batch) - acked
 
     def ingest(self, span) -> None:
         if self.sample_pct < 100:
@@ -116,7 +155,7 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
             if (zlib.crc32(basis) % 100) >= self.sample_pct:
                 self.sampled_out += 1
                 return
-        if self.producer is None:
+        if self.producer is None and self._wire is None:
             self.dropped += 1
             return
         value = (span.SerializeToString() if self.serializer == "protobuf"
@@ -127,10 +166,16 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
                      "start_timestamp": span.start_timestamp,
                      "end_timestamp": span.end_timestamp,
                      "tags": dict(span.tags)}).encode())
+        key = span.trace_id.to_bytes(8, "big", signed=True)
+        if self._wire is not None:
+            # batch for the interval flush (sarama's async-producer analog)
+            if len(self._buffer) >= self._buffer_cap:
+                self.dropped += 1
+                return
+            self._buffer.append((key, value))
+            return
         try:
-            self.producer(self.topic,
-                          span.trace_id.to_bytes(8, "big", signed=True),
-                          value)
+            self.producer(self.topic, key, value)
         except Exception as e:
             logger.warning("kafka span produce failed: %s", e)
             self.dropped += 1
