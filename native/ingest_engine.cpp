@@ -202,20 +202,30 @@ struct Batch {
   uint64_t packets = 0;            // datagrams ingested
   uint64_t too_long = 0;           // datagrams over max length
 
+  // Consumes `o` COMPLETELY: the non-move (insert) branch must clear the
+  // source, or a clear-drain that appends a still-live thread buffer
+  // leaves its samples behind to be re-collected next drain under dead
+  // (pre-GC) ids — double counts + unknown-id crashes.
   void append(Batch&& o) {
     auto cat = [](auto& a, auto& b) {
-      if (a.empty()) a = std::move(b);
-      else a.insert(a.end(), b.begin(), b.end());
+      if (a.empty()) {
+        a = std::move(b);
+      } else {
+        a.insert(a.end(), b.begin(), b.end());
+      }
+      b.clear();
     };
     cat(c_ids, o.c_ids); cat(c_vals, o.c_vals);
     cat(g_ids, o.g_ids); cat(g_vals, o.g_vals);
     cat(h_ids, o.h_ids); cat(h_vals, o.h_vals); cat(h_wts, o.h_wts);
     cat(s_ids, o.s_ids); cat(s_hashes, o.s_hashes);
     for (auto& s : o.other) other.emplace_back(std::move(s));
+    o.other.clear();
     processed += o.processed;
     malformed += o.malformed;
     packets += o.packets;
     too_long += o.too_long;
+    o.processed = o.malformed = o.packets = o.too_long = 0;
   }
 };
 
@@ -571,7 +581,11 @@ static DrainResult* drain(Engine* e, bool clear_intern) {
     std::lock_guard<std::mutex> l(e->bufs_mu);
     if (clear_intern) {
       for (auto& tb : e->bufs) tb->mu.lock();
-      for (auto& tb : e->bufs) d->b.append(std::move(tb->cur));
+      for (auto& tb : e->bufs) {
+        Batch tmp;
+        std::swap(tmp, tb->cur);
+        d->b.append(std::move(tmp));
+      }
       for (auto& sh : e->shards) {
         std::lock_guard<std::mutex> sl(sh.mu);
         for (auto& k : sh.fresh) keys.emplace_back(std::move(k));

@@ -322,3 +322,29 @@ def test_unreachable_broker_counts_errors_not_raises():
     acked = p.produce_batch("t", [(b"k", b"v")])
     assert acked == 0 and p.errors == 1
     p.close()
+
+
+def test_oversized_batch_splits_into_chunks():
+    """A flush larger than max_batch_bytes splits into multiple produce
+    rounds instead of one broker-rejected RecordBatch."""
+    broker = FakeBroker(n_partitions=1)
+    try:
+        p = kw.KafkaProducer([f"127.0.0.1:{broker.port}"],
+                             max_batch_bytes=10_000)
+        msgs = [(b"k%d" % i, b"v" * 500) for i in range(100)]  # ~57KB
+        acked = p.produce_batch("t", msgs)
+        assert acked == 100
+        assert broker.produce_requests >= 6  # genuinely chunked
+        got = broker.records[0]
+        assert sorted(got) == sorted(msgs)   # exactly once, all delivered
+        p.close()
+    finally:
+        broker.stop()
+
+
+def test_empty_key_hashes_like_java():
+    # empty key is hashed (sticky), not round-robined
+    pid = kw.partition_for(b"", 4)
+    assert all(kw.partition_for(b"", 4, counter=c) == pid
+               for c in range(8))
+    assert pid == (kw.murmur2(b"") & 0x7FFFFFFF) % 4

@@ -158,6 +158,11 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
         if self.producer is None and self._wire is None:
             self.dropped += 1
             return
+        if self._wire is not None and len(self._buffer) >= self._buffer_cap:
+            # check BEFORE serializing: overload must not also pay the
+            # encoding cost of spans it is about to drop
+            self.dropped += 1
+            return
         value = (span.SerializeToString() if self.serializer == "protobuf"
                  else json.dumps({
                      "trace_id": span.trace_id, "id": span.id,
@@ -169,9 +174,6 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
         key = span.trace_id.to_bytes(8, "big", signed=True)
         if self._wire is not None:
             # batch for the interval flush (sarama's async-producer analog)
-            if len(self._buffer) >= self._buffer_cap:
-                self.dropped += 1
-                return
             self._buffer.append((key, value))
             return
         try:

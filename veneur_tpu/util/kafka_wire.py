@@ -110,8 +110,9 @@ def murmur2(data: bytes) -> int:
 def partition_for(key: Optional[bytes], n_partitions: int,
                   counter: int = 0) -> int:
     """Java default partitioner: murmur2(key) with the sign bit masked;
-    round-robin when keyless."""
-    if not key:
+    round-robin only when the key is absent (an EMPTY key still hashes,
+    as in the Java client)."""
+    if key is None:
         return counter % n_partitions
     return (murmur2(key) & 0x7FFFFFFF) % n_partitions
 
@@ -285,8 +286,13 @@ class KafkaProducer:
     """Minimal synchronous producer: metadata-driven leader routing,
     per-flush batches, acks=1, reconnect-and-refresh on error."""
 
+    # stay under the broker's default message.max.bytes (~1MB) with room
+    # for batch/framing overhead
+    MAX_BATCH_BYTES = 900_000
+
     def __init__(self, brokers: list[str], client_id: str = "veneur-tpu",
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 max_batch_bytes: int = MAX_BATCH_BYTES):
         self.brokers = []
         for addr in brokers:
             host, _, port = addr.rpartition(":")
@@ -296,6 +302,7 @@ class KafkaProducer:
             self.brokers.append((host, int(port)))
         self.client_id = client_id
         self.timeout_s = timeout_s
+        self.max_batch_bytes = max_batch_bytes
         self._lock = threading.Lock()
         self._conns: dict[tuple[str, int], _Conn] = {}
         # topic -> {partition: (host, port)}
@@ -420,32 +427,62 @@ class KafkaProducer:
         acked = 0
         failed: list = []
         for (host, port), partitions in by_leader.items():
-            topic_data = _str(topic) + struct.pack(">i", len(partitions))
-            for pid, msgs in sorted(partitions.items()):
-                batch = encode_record_batch(msgs)
-                topic_data += struct.pack(">i", pid) + _bytes(batch)
-            body = (_str(None)                      # transactional_id
-                    + struct.pack(">hi", 1, int(self.timeout_s * 1000))
-                    + struct.pack(">i", 1) + topic_data)
-            try:
-                resp = self._conn(host, port).request(API_PRODUCE, 3, body)
-                part_errors = self._parse_produce_response(resp)
-            except _PROTO_ERRORS as e:
-                logger.warning("kafka produce to %s:%d failed: %s",
-                               host, port, e)
-                self._drop_conn(host, port)
-                for msgs in partitions.values():
-                    failed.extend(msgs)
-                continue
-            for pid, msgs in partitions.items():
-                err = part_errors.get(pid, -1)
-                if err == 0:
-                    acked += len(msgs)
-                else:
-                    logger.warning("kafka partition %d error code %d",
-                                   pid, err)
-                    failed.extend(msgs)
+            # split each partition's messages so no RecordBatch exceeds
+            # the broker's message size limit (MESSAGE_TOO_LARGE would
+            # fail the whole partition every interval otherwise); one
+            # Produce request per chunk round
+            chunked = {pid: self._chunk(msgs)
+                       for pid, msgs in partitions.items()}
+            rounds = max(len(c) for c in chunked.values())
+            for r in range(rounds):
+                round_parts = {pid: chunks[r]
+                               for pid, chunks in chunked.items()
+                               if r < len(chunks)}
+                topic_data = _str(topic) + struct.pack(
+                    ">i", len(round_parts))
+                for pid, msgs in sorted(round_parts.items()):
+                    batch = encode_record_batch(msgs)
+                    topic_data += struct.pack(">i", pid) + _bytes(batch)
+                body = (_str(None)                  # transactional_id
+                        + struct.pack(">hi", 1, int(self.timeout_s * 1000))
+                        + struct.pack(">i", 1) + topic_data)
+                try:
+                    resp = self._conn(host, port).request(
+                        API_PRODUCE, 3, body)
+                    part_errors = self._parse_produce_response(resp)
+                except _PROTO_ERRORS as e:
+                    logger.warning("kafka produce to %s:%d failed: %s",
+                                   host, port, e)
+                    self._drop_conn(host, port)
+                    for msgs in round_parts.values():
+                        failed.extend(msgs)
+                    continue
+                for pid, msgs in round_parts.items():
+                    err = part_errors.get(pid, -1)
+                    if err == 0:
+                        acked += len(msgs)
+                    else:
+                        logger.warning("kafka partition %d error code %d",
+                                       pid, err)
+                        failed.extend(msgs)
         return acked, failed
+
+    def _chunk(self, msgs: list) -> list[list]:
+        """Split messages into runs whose encoded size stays under
+        max_batch_bytes (~70B/record framing overhead bound)."""
+        chunks: list[list] = []
+        cur: list = []
+        size = 0
+        for key, value in msgs:
+            rec = len(value) + (len(key) if key else 0) + 70
+            if cur and size + rec > self.max_batch_bytes:
+                chunks.append(cur)
+                cur, size = [], 0
+            cur.append((key, value))
+            size += rec
+        if cur:
+            chunks.append(cur)
+        return chunks
 
     @staticmethod
     def _parse_produce_response(resp: bytes) -> dict[int, int]:
