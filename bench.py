@@ -189,7 +189,7 @@ def bench_ingest() -> float | None:
         statsd_listen_addresses=["udp://127.0.0.1:0"],
         interval=600.0,              # no flush during the run
         ingest_drain_interval=0.2,
-        num_readers=2,
+        num_readers=min(4, max(2, (os.cpu_count() or 2) - 1)),
         read_buffer_size_bytes=8 << 20,
         hostname="bench")
     srv = Server(cfg)
