@@ -358,3 +358,22 @@ def test_http_debug_profile_disabled(fixture_server):
             f"http://{host}:{port}/debug/profile", timeout=10)
     assert exc.value.code == 403
     api.stop()
+
+
+def test_tags_exclude_per_sink(fixture_server):
+    """tags_exclude: bare keys strip everywhere; "key|sinkname" strips for
+    that sink only (setSinkExcludedTags, server.go:660,1456-1463)."""
+    srv, sink = fixture_server(tags_exclude=["nonce", "region|channel"])
+    # the fixture's channel sink is named "channel"
+    _, addr = srv.statsd_addrs[0]
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(b"te.m:1|c|#nonce:abc,region:us,keep:yes", addr)
+    s.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and srv.aggregator.processed < 1:
+        time.sleep(0.05)
+        srv._drain_native()
+    srv.flush()
+    ms = drain_until(sink, lambda a: any(m.name == "te.m" for m in a))
+    m = [x for x in ms if x.name == "te.m"][0]
+    assert m.tags == ["keep:yes"], m.tags

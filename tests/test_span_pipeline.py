@@ -95,3 +95,33 @@ def test_span_ingest_duration_tracked(span_server):
         time.sleep(0.01)
     assert good_worker.ingested >= 1
     assert good_worker.ingest_duration_ns > 0
+
+
+def test_tags_exclude_applies_to_span_sinks():
+    """tags_exclude strips span tag KEYS per sink (setSinkExcludedTags
+    covers span sinks, server.go:1456-1463); other sinks still see the
+    original span object."""
+    import time as time_mod
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import ssf_pb2
+    from veneur_tpu.sinks.simple import ChannelSpanSink
+
+    sa, sb = ChannelSpanSink(), ChannelSpanSink()
+    sa._name, sb._name = "a", "b"
+    srv = Server(config_mod.Config(interval=0.5, hostname="sx",
+                                   tags_exclude=["secret", "env|a"]),
+                 extra_span_sinks=[sa, sb])
+    srv.start()
+    try:
+        srv.handle_span(ssf_pb2.SSFSpan(
+            version=0, trace_id=1, id=2, name="op", service="svc",
+            start_timestamp=1, end_timestamp=2,
+            tags={"secret": "x", "env": "prod", "team": "core"}))
+        span_a = sa.queue.get(timeout=5)
+        span_b = sb.queue.get(timeout=5)
+        assert dict(span_a.tags) == {"team": "core"}
+        assert dict(span_b.tags) == {"env": "prod", "team": "core"}
+    finally:
+        srv.shutdown()

@@ -61,9 +61,12 @@ class _SpanSinkWorker:
     `sink.span_ingest_total_duration_ns` metric (worker.go:647-652)."""
 
     def __init__(self, sink, capacity: int, n_threads: int,
-                 shutdown: threading.Event):
+                 shutdown: threading.Event, excluded_tags=None):
         import queue as queue_mod
         self.sink = sink
+        # tags_exclude keys stripped from spans before this sink sees them
+        # (setSinkExcludedTags covers span sinks too, server.go:1456-1463)
+        self.excluded_tags = excluded_tags or None
         self.queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=capacity)
         self.dropped = 0
         self.ingested = 0
@@ -100,6 +103,17 @@ class _SpanSinkWorker:
                 span = self.queue.get(timeout=0.1)
             except queue_mod.Empty:
                 continue
+            if self.excluded_tags and any(
+                    k in self.excluded_tags for k in span.tags):
+                # copy-on-strip: the same span object fans out to the
+                # other sinks, which may not share this exclusion
+                # (SSFSpan.tags is a map<string,string>)
+                stripped = type(span)()
+                stripped.CopyFrom(span)
+                for k in list(stripped.tags):
+                    if k in self.excluded_tags:
+                        del stripped.tags[k]
+                span = stripped
             t0 = time.perf_counter_ns()
             try:
                 self.sink.ingest(span)
@@ -209,6 +223,22 @@ class Server:
         self.last_flush_unix = time.time()
         self.flush_count = 0
         self._flush_serial = threading.Lock()
+        # tags_exclude rules: "key" (every sink) or "key|sink1|sink2"
+        # (those sinks only) — setSinkExcludedTags, server.go:660,1456-1463
+        self._tags_exclude_global: set[str] = set()
+        self._tags_exclude_by_sink: dict[str, set[str]] = {}
+        for rule in cfg.tags_exclude:
+            parts = str(rule).split("|")
+            key = parts[0]
+            if not key:
+                continue
+            if len(parts) > 1:
+                for sink_name in parts[1:]:
+                    if sink_name:
+                        self._tags_exclude_by_sink.setdefault(
+                            sink_name, set()).add(key)
+            else:
+                self._tags_exclude_global.add(key)
         # per-protocol received-packet tallies, drained each flush into
         # listen.received_per_protocol_total (flusher.go:280,455-475).
         # Plain int increments; GIL-atomic enough for telemetry.  Batch
@@ -307,7 +337,8 @@ class Server:
         for sink in self.span_sinks:
             self.span_workers.append(_SpanSinkWorker(
                 sink, self.config.span_channel_capacity,
-                self.config.num_span_workers, self._shutdown))
+                self.config.num_span_workers, self._shutdown,
+                excluded_tags=self._excluded_tags_for(sink.name())))
         if self.config.grpc_address:
             # global tier: gRPC import source (server.go:673-682)
             from veneur_tpu.sources.proxy import GrpcImportServer
@@ -817,6 +848,14 @@ class Server:
             time.perf_counter() - flush_start))
         span.finish()
 
+    def _excluded_tags_for(self, sink_name: str):
+        """tags_exclude keys applying to this sink (global ∪ sink-scoped);
+        None when no rules are configured (fast path)."""
+        per_sink = self._tags_exclude_by_sink.get(sink_name)
+        if per_sink is None:
+            return self._tags_exclude_global or None
+        return self._tags_exclude_global | per_sink
+
     def _forward_safely(self, forward: list[sm.ForwardMetric],
                         parent=None) -> None:
         """Forward with sub-timings on a child span
@@ -868,7 +907,8 @@ class Server:
         start = time.perf_counter()
         try:
             filtered, counts = sink_mod.filter_metrics_for_sink(
-                spec, self.config.enable_metric_sink_routing, metrics)
+                spec, self.config.enable_metric_sink_routing, metrics,
+                excluded_tags=self._excluded_tags_for(sink.name()))
             for status in ("skipped", "max_name_length", "max_tags",
                            "max_tag_length", "flushed"):
                 statsd.count("flushed_metrics", counts.get(status, 0),

@@ -166,14 +166,17 @@ def create_span_sink(spec: SinkSpec, server_config=None):
 
 
 def filter_metrics_for_sink(spec: SinkSpec, routing_enabled: bool,
-                            metrics: list[InterMetric]
+                            metrics: list[InterMetric],
+                            excluded_tags: Optional[set] = None
                             ) -> tuple[list[InterMetric], dict[str, int]]:
     """Central per-sink filtering (flusher.go:138-213): routing allowlist,
-    max name length, strip/length-check/add tags, max tag count.  Returns
+    max name length, strip/length-check/add tags, max tag count, plus the
+    server-level `tags_exclude` keys (setSinkExcludedTags,
+    server.go:1456-1463 — tag KEYS dropped for this sink).  Returns
     (filtered metrics, drop counters)."""
     counts = {"skipped": 0, "max_name_length": 0, "max_tags": 0,
               "max_tag_length": 0, "flushed": 0}
-    if not routing_enabled and not (
+    if not routing_enabled and not excluded_tags and not (
             spec.max_name_length or spec.max_tag_length or spec.max_tags
             or spec.strip_tags or spec.add_tags):
         counts["flushed"] = len(metrics)
@@ -189,10 +192,13 @@ def filter_metrics_for_sink(spec: SinkSpec, routing_enabled: bool,
             counts["max_name_length"] += 1
             continue
         tags = m.tags
-        if spec.strip_tags or spec.max_tag_length:
+        if spec.strip_tags or spec.max_tag_length or excluded_tags:
             tags = []
             dropped = False
             for tag in m.tags:
+                if (excluded_tags
+                        and tag.split(":", 1)[0] in excluded_tags):
+                    continue
                 if any(tm.match(tag) for tm in spec.strip_tags):
                     continue
                 if spec.max_tag_length and len(tag) > spec.max_tag_length:
@@ -206,6 +212,10 @@ def filter_metrics_for_sink(spec: SinkSpec, routing_enabled: bool,
             tags = list(tags)
             dropped = False
             for k, v in spec.add_tags.items():
+                if excluded_tags and k in excluded_tags:
+                    # exclusion wins over add_tags (the reference strips
+                    # excluded keys at serialization, after adds)
+                    continue
                 tag = f"{k}:{v}"
                 if spec.max_tag_length and len(tag) > spec.max_tag_length:
                     counts["max_tag_length"] += 1
