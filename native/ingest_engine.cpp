@@ -272,6 +272,10 @@ struct Engine {
 
   InternShard shards[NSHARDS];
   std::atomic<uint32_t> next_id{0};
+  // bumped on intern clear; per-thread caches compare against it
+  std::atomic<uint32_t> intern_gen{0};
+  // process-unique engine identity (thread_local caches outlive engines)
+  uint64_t nonce;
 
   std::mutex bufs_mu;
   std::vector<std::unique_ptr<ThreadBuf>> bufs;
@@ -300,6 +304,19 @@ struct Engine {
 struct ThreadScratch {
   std::string key;                 // composite intern key
   std::vector<std::string> tags;   // canonicalization scratch
+  // direct-mapped per-thread intern cache: most lines repeat a recent
+  // identity, so the common case skips the shard mutex + probe entirely.
+  // Entries are invalidated wholesale by the engine's intern generation
+  // (bumped on drain_clear while every thread is quiesced).
+  struct CacheEntry {
+    uint64_t h = 0;
+    uint64_t engine = 0;   // engine nonce: thread_local outlives engines
+    uint32_t id = UINT32_MAX;
+    uint32_t gen = UINT32_MAX;
+    std::string key;
+  };
+  static const int kCacheSlots = 4096;
+  std::vector<CacheEntry> cache{kCacheSlots};
 };
 
 // Canonicalize a raw tag chunk: magic scope tags (first match wins,
@@ -371,23 +388,48 @@ static uint32_t intern(Engine* e, ThreadScratch& sc, const char* name,
   key.push_back((char)('0' + mt));
   if (has_tags) key.append(raw_tags, rtlen);
   uint64_t h = hash_bytes(key.data(), key.size());
+  uint32_t gen = e->intern_gen.load(std::memory_order_relaxed);
+  auto& ce = sc.cache[h & (ThreadScratch::kCacheSlots - 1)];
+  if (ce.engine == e->nonce && ce.gen == gen && ce.h == h
+      && ce.id != UINT32_MAX && ce.key == key)
+    return ce.id;
 
   InternShard& sh = e->shards[h & (NSHARDS - 1)];
-  std::lock_guard<std::mutex> l(sh.mu);
-  size_t mask = sh.slots.size() - 1;
-  size_t i = h & mask;
-  while (sh.slots[i].id != UINT32_MAX) {
-    if (sh.slots[i].h == h && sh.slots[i].key == key) return sh.slots[i].id;
-    i = (i + 1) & mask;
+  uint32_t id;
+  {
+    std::lock_guard<std::mutex> l(sh.mu);
+    size_t mask = sh.slots.size() - 1;
+    size_t i = h & mask;
+    for (;;) {
+      if (sh.slots[i].id == UINT32_MAX) {
+        // miss: canonicalize and record
+        std::string joined;
+        uint8_t scope =
+            canonical_tags(e, sc, raw_tags, rtlen, has_tags, &joined);
+        id = e->next_id.fetch_add(1);
+        sh.fresh.push_back(NewKeyRec{id, mt, scope,
+                                     std::string(name, nlen),
+                                     std::move(joined)});
+        sh.slots[i] = InternSlot{h, id, key};
+        if (++sh.count * 10 > sh.slots.size() * 7) sh.grow();
+        break;
+      }
+      if (sh.slots[i].h == h && sh.slots[i].key == key) {
+        id = sh.slots[i].id;
+        break;
+      }
+      i = (i + 1) & mask;
+    }
   }
-  // miss: canonicalize and record
-  std::string joined;
-  uint8_t scope = canonical_tags(e, sc, raw_tags, rtlen, has_tags, &joined);
-  uint32_t id = e->next_id.fetch_add(1);
-  sh.fresh.push_back(NewKeyRec{id, mt, scope, std::string(name, nlen),
-                               std::move(joined)});
-  sh.slots[i] = InternSlot{h, id, key};
-  if (++sh.count * 10 > sh.slots.size() * 7) sh.grow();
+  if (key.size() <= 512) {
+    // don't pin oversized keys in the thread_local cache (it outlives
+    // the engine; rare giant tag sets would be retained indefinitely)
+    ce.h = h;
+    ce.engine = e->nonce;
+    ce.id = id;
+    ce.gen = gen;
+    ce.key = key;
+  }
   return id;
 }
 
@@ -594,8 +636,11 @@ static DrainResult* drain(Engine* e, bool clear_intern) {
         sh.count = 0;
       }
       // all old ids are dead (buffers drained, table wiped) — restart the
-      // id space so the Python id cache stays bounded by live cardinality
+      // id space so the Python id cache stays bounded by live cardinality,
+      // and invalidate every per-thread intern cache (threads are
+      // quiesced here: parsing requires the thread-buffer mutex)
       e->next_id.store(0);
+      e->intern_gen.fetch_add(1);
       for (auto& tb : e->bufs) tb->mu.unlock();
     } else {
       // Buffers BEFORE shards: a staged sample's intern happened before the
@@ -653,7 +698,9 @@ static DrainResult* drain(Engine* e, bool clear_intern) {
 extern "C" {
 
 void* vn_engine_new(int max_packet_len, const char* implicit_tags_nl) {
+  static std::atomic<uint64_t> g_engine_nonce{1};
   auto* e = new Engine();
+  e->nonce = g_engine_nonce.fetch_add(1);
   e->max_packet = max_packet_len;
   if (implicit_tags_nl && *implicit_tags_nl) {
     const char* p = implicit_tags_nl;
