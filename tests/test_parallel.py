@@ -215,3 +215,54 @@ def test_serving_server_1_vs_8_devices():
     for k in outs[0]:
         np.testing.assert_allclose(outs[0][k], outs[1][k],
                                    rtol=1e-4, atol=1e-4, err_msg=str(k))
+
+
+def test_multihost_init_and_meshed_server(tmp_path):
+    """Join a (single-process) jax.distributed cluster via the config hook
+    and run a meshed server flush over the global device set — the code
+    path a real multi-host deployment takes, exercised in a subprocess so
+    the cluster state cannot leak into this test process."""
+    import os
+    import socket as socket_mod
+    import subprocess
+    import sys
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = r'''
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks import simple as simple_sinks
+
+cfg = config_mod.Config(
+    interval=10.0, percentiles=[0.5], hostname="mh",
+    distributed_coordinator="127.0.0.1:COORD_PORT",
+    distributed_num_processes=1, distributed_process_id=0,
+    mesh_devices=8, mesh_replicas=2)
+sink = simple_sinks.ChannelMetricSink()
+srv = Server(cfg, extra_metric_sinks=[sink])
+assert jax.process_count() == 1
+assert len(jax.devices()) == 8
+srv.start()
+srv.process_packet_buffer(b"mh.c:5|c\nmh.lat:1|h\nmh.lat:3|h")
+srv.flush()
+batch = sink.queue.get(timeout=30)
+by = {m.name: m.value for m in batch}
+assert by["mh.c"] == 5.0
+assert by["mh.lat.count"] == 2.0
+srv.shutdown()
+print("MULTIHOST_OK", dict(srv.mesh.shape))
+'''
+    script = script.replace("COORD_PORT", str(port))
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=300,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert "MULTIHOST_OK" in out.stdout, (out.stdout, out.stderr[-2000:])

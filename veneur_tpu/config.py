@@ -96,6 +96,12 @@ class Config:
     mesh_devices: int = 0
     mesh_replicas: int = 0
     ingest_lanes: int = 0           # 0 = auto (2 per replica)
+    # multi-host (DCN) scaling: join a jax.distributed cluster before mesh
+    # construction so the mesh spans every host's chips
+    # (parallel/multihost.py; replica groups stay intra-host on ICI)
+    distributed_coordinator: str = ""     # "host:port"; "" = single host
+    distributed_num_processes: int = 0    # 0 = auto-detect
+    distributed_process_id: int = -1      # -1 = auto-detect
 
     # ingest
     num_workers: int = 1

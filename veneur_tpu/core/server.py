@@ -149,8 +149,13 @@ class Server:
         self.extend_tags = tagging.ExtendTags(cfg.extend_tags)
         self.parser = parser_mod.Parser(self.extend_tags)
         # device mesh: the sharded serving flush runs over (shard, replica)
-        # when mesh_devices is set (the multi-chip production path)
+        # when mesh_devices is set (the multi-chip production path).  With
+        # a distributed coordinator configured, join the multi-host
+        # cluster FIRST so the mesh spans every host's chips (DCN story:
+        # parallel/multihost.py).
         self.mesh = None
+        from veneur_tpu.parallel import multihost
+        multihost.maybe_init_from_config(cfg)  # no-op without coordinator
         if cfg.mesh_devices > 0:
             from veneur_tpu.parallel import mesh as mesh_mod
             self.mesh = mesh_mod.make_mesh(

@@ -42,6 +42,15 @@ def make_mesh(n_devices: int | None = None,
         replicas = 2 if n % 2 == 0 and n >= 2 else 1
     if n % replicas != 0:
         raise ValueError(f"{n} devices not divisible into {replicas} replicas")
+    if jax.process_count() > 1:
+        per_host = len(jax.local_devices())
+        if per_host and per_host % replicas != 0:
+            import logging
+            logging.getLogger("veneur_tpu.parallel.mesh").warning(
+                "mesh_replicas=%d does not divide the per-host device "
+                "count %d: replica groups will straddle hosts and the "
+                "flush all_gather will ride DCN instead of ICI",
+                replicas, per_host)
     shards = n // replicas
     dev_array = np.asarray(devices[:n]).reshape(shards, replicas)
     return Mesh(dev_array, (SHARD_AXIS, REPLICA_AXIS))

@@ -1,0 +1,71 @@
+"""Multi-host (DCN) scaling for the sharded aggregation tier.
+
+The reference scales across hosts with gRPC forwarding + the proxy's
+consistent-hash ring (SURVEY §2.3); the TPU-native global tier scales the
+same state over multiple accelerator hosts with `jax.distributed`: after
+`init_multihost`, `jax.devices()` returns every chip in the cluster, and
+`mesh.make_mesh` builds the (shard, replica) mesh over all of them.
+
+Axis/topology mapping (why the layout is DCN-friendly):
+
+  * `jax.devices()` orders devices process-by-process, and the mesh
+    reshape is row-major, so when `replicas` DIVIDES the per-host device
+    count each replica group is a contiguous intra-host run.  The
+    flush's only collective (the replica-axis `all_gather` in
+    `parallel/serving.py reduce_eval`) then rides ICI; `make_mesh` warns
+    when a configured replica count would straddle hosts;
+  * the `shard` axis (key-space partition) spans hosts but needs NO
+    collective — each key's digests live on exactly one shard, the
+    device analog of the proxy ring assigning each key to one global.
+    Cross-host traffic stays where the reference keeps it: the gRPC
+    forward/import edge.
+
+Single-host single-process remains the default; none of this is required
+until a deployment grows past one accelerator host.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger("veneur_tpu.parallel.multihost")
+
+_initialized = False
+
+
+def init_multihost(coordinator_address: str,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Join the JAX distributed cluster (idempotent).
+
+    With TPU metadata available (GKE/TPU-VM environments), the arguments
+    beyond the coordinator are auto-detected; pass them explicitly
+    elsewhere.  Must run before any other JAX call in the process."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    kwargs = {"coordinator_address": coordinator_address}
+    if num_processes is not None and num_processes >= 0:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None and process_id >= 0:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    logger.info("joined distributed cluster: process %d/%d, "
+                "%d global devices (%d local)",
+                jax.process_index(), jax.process_count(),
+                len(jax.devices()), len(jax.local_devices()))
+
+
+def maybe_init_from_config(cfg) -> None:
+    """Server bootstrap hook: join the cluster when the config names a
+    coordinator (no-op otherwise)."""
+    if getattr(cfg, "distributed_coordinator", ""):
+        init_multihost(
+            cfg.distributed_coordinator,
+            num_processes=cfg.distributed_num_processes or None,
+            process_id=(cfg.distributed_process_id
+                        if cfg.distributed_process_id >= 0 else None))
