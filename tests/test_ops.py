@@ -38,3 +38,36 @@ def test_pallas_estimate_accuracy():
                                            interpret=True))
     for row, n in enumerate(truth):
         assert abs(est[row] - n) / n < 0.02, (row, est[row], n)
+
+
+def test_pallas_quantile_matches_xla():
+    """The Pallas quantile kernel must match the XLA twin exactly on
+    random digests (occupied, sparse, and empty rows)."""
+    from veneur_tpu.ops import quantile_eval
+    from veneur_tpu.sketches import tdigest as td
+
+    rng = np.random.default_rng(5)
+    k, cap = 13, td.centroid_capacity(100.0)
+    state = td.TDigestState(
+        mean=jnp.zeros((k, cap), jnp.float32),
+        weight=jnp.zeros((k, cap), jnp.float32),
+        min=jnp.full((k,), np.inf, jnp.float32),
+        max=jnp.full((k,), -np.inf, jnp.float32),
+        rsum=jnp.zeros((k,), jnp.float32))
+    for row in range(k - 1):  # last row stays empty
+        n = int(rng.integers(1, 400))
+        vals = rng.gamma(2.0, 10.0, n).astype(np.float32)
+        vv = np.zeros((k, n), np.float32)
+        ww = np.zeros((k, n), np.float32)
+        vv[row] = vals
+        ww[row] = 1.0
+        state = td.ingest(state, jnp.asarray(vv), jnp.asarray(ww), 100.0)
+    qs = jnp.asarray([0.1, 0.5, 0.9, 0.99], jnp.float32)
+    want = np.asarray(td.quantile(state, qs))
+    got = np.asarray(quantile_eval.quantile(
+        state.mean, state.weight, state.min, state.max, qs,
+        interpret=True))
+    assert got.shape == want.shape == (k, 4)
+    # empty row -> NaN on both
+    assert np.isnan(got[-1]).all() and np.isnan(want[-1]).all()
+    np.testing.assert_allclose(got[:-1], want[:-1], rtol=1e-5, atol=1e-4)

@@ -9,3 +9,4 @@ on TPU).
 """
 
 from veneur_tpu.ops import hll_estimate  # noqa: F401
+from veneur_tpu.ops import quantile_eval  # noqa: F401

@@ -31,7 +31,8 @@ ROW_TILE = 8  # rows reduced per program instance ([8, 16384] f32 ≈ 512 KiB)
 def _reduce_kernel(regs_ref, out_ref):
     """One program: reduce a [ROW_TILE, m] register block to
     [ROW_TILE, 2] = (zero count, sum 2^-r)."""
-    r = regs_ref[...].astype(jnp.float32)
+    # via int32: Mosaic has no direct uint8->f32 cast
+    r = regs_ref[...].astype(jnp.int32).astype(jnp.float32)
     ez = jnp.sum((r == 0.0).astype(jnp.float32), axis=1)
     ssum = jnp.sum(jnp.exp2(-r), axis=1)
     out_ref[...] = jnp.stack([ez, ssum], axis=1)

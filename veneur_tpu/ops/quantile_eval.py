@@ -1,0 +1,112 @@
+"""Pallas TPU kernel: batched t-digest quantile evaluation.
+
+Drop-in for `veneur_tpu.sketches.tdigest.quantile` (itself mirroring
+`merging_digest.go:304-332`): for every key row of merged centroids,
+interpolate each requested quantile inside its containing centroid's
+uniform bounds.  The hand-tiled form keeps a row tile's centroids VMEM-
+resident and expresses the row-local scans as MXU work:
+
+  * prefix sums via a lower-triangular ones matmul (`w @ M`, M[k,j]=k<=j)
+    instead of `cumsum` — a guaranteed-lowering Mosaic primitive;
+  * `searchsorted` as a compare+reduce (`sum(cum < target)`);
+  * dynamic per-row centroid gathers as one-hot reductions.
+
+The quantile count P is static, so the per-quantile loop fully unrolls.
+Validated against the XLA twin in interpret mode (CPU tests) and compiled
+natively on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROW_TILE = 8
+
+
+def _kernel(mean_ref, weight_ref, dmin_ref, dmax_ref, qs_ref, out_ref):
+    mean = mean_ref[...]          # [T, C]
+    w = weight_ref[...]           # [T, C]
+    dmin = dmin_ref[...]          # [T, 1]
+    dmax = dmax_ref[...]          # [T, 1]
+    qs = qs_ref[...]              # [1, P]
+    t, c = mean.shape
+    p = qs.shape[1]
+
+    occ = (w > 0).astype(jnp.float32)
+    n = jnp.sum(occ, axis=1, keepdims=True)                    # [T, 1]
+    n_i = n.astype(jnp.int32)
+
+    # prefix sums as a triangular matmul (k contributes to cum_j iff
+    # k<=j).  HIGHEST precision: the MXU's default bf16 inputs would
+    # round weights and break both parity with the XLA twin and the
+    # monotonicity the count-below-target search depends on.
+    ks = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    js = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+    tri = (ks <= js).astype(jnp.float32)                       # [C, C]
+    cum = jnp.dot(w, tri, preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST)         # [T, C]
+    total = cum[:, c - 1:c]                                    # [T, 1]
+
+    # centroid bounds (merging_digest.go:355-370 semantics)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (t, c), 1)
+    mean_next = jnp.concatenate([mean[:, 1:], mean[:, c - 1:c]], axis=1)
+    mid = 0.5 * (mean + mean_next)
+    last = idx == (n_i - 1)
+    upper = jnp.where(last, dmax, mid)
+    upper = jnp.where(idx < n_i, upper, dmax)
+    lower = jnp.concatenate([dmin, upper[:, :c - 1]], axis=1)
+    cum_prev = jnp.concatenate([jnp.zeros((t, 1), jnp.float32),
+                                cum[:, :c - 1]], axis=1)
+    for j in range(p):                                         # P is static
+        target = qs[0, j] * total                              # [T, 1]
+        i = jnp.sum((cum < target).astype(jnp.int32), axis=1,
+                    keepdims=True)                             # [T, 1]
+        i = jnp.minimum(i, jnp.maximum(n_i - 1, 0))
+        onehot = (idx == i).astype(jnp.float32)                # [T, C]
+        w_i = jnp.sum(w * onehot, axis=1, keepdims=True)
+        lo = jnp.sum(lower * onehot, axis=1, keepdims=True)
+        up = jnp.sum(upper * onehot, axis=1, keepdims=True)
+        before = jnp.sum(cum_prev * onehot, axis=1, keepdims=True)
+        prop = jnp.where(w_i > 0, (target - before)
+                         / jnp.where(w_i > 0, w_i, 1.0), 0.0)
+        prop = jnp.clip(prop, 0.0, 1.0)
+        val = lo + prop * (up - lo)
+        out_ref[:, j:j + 1] = jnp.where(n > 0, val, jnp.nan)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantile(mean: jax.Array, weight: jax.Array, dmin: jax.Array,
+             dmax: jax.Array, qs: jax.Array,
+             interpret: bool = False) -> jax.Array:
+    """[K, C] centroids + [K] min/max + [P] quantiles -> [K, P]."""
+    k, c = mean.shape
+    qs = jnp.asarray(qs, jnp.float32).reshape(1, -1)
+    pad = (-k) % ROW_TILE
+    if pad:
+        z = ((0, pad), (0, 0))
+        mean = jnp.pad(mean, z)
+        weight = jnp.pad(weight, z)
+        dmin = jnp.pad(dmin, ((0, pad),))
+        dmax = jnp.pad(dmax, ((0, pad),))
+    kp = mean.shape[0]
+    out = pl.pallas_call(
+        _kernel,
+        grid=(kp // ROW_TILE,),
+        in_specs=[
+            pl.BlockSpec((ROW_TILE, c), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, c), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, qs.shape[1]), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROW_TILE, qs.shape[1]), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((kp, qs.shape[1]), jnp.float32),
+        interpret=interpret,
+    )(mean.astype(jnp.float32), weight.astype(jnp.float32),
+      dmin.astype(jnp.float32).reshape(-1, 1),
+      dmax.astype(jnp.float32).reshape(-1, 1), qs)
+    return out[:k]
