@@ -58,38 +58,59 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_device() -> tuple[float, float]:
+def _time_flush(n_keys: int, n_lanes: int, label: str,
+                warmup: int, iters: int) -> tuple[float, float]:
+    """Shared compile + warmup + timing loop for the device arms."""
     import jax
     import jax.numpy as jnp
 
     from veneur_tpu.parallel import flush_step as fs
 
     dev = jax.devices()[0]
-    log(f"device arm: backend={dev.platform} device={dev}")
-
-    inputs = fs.example_inputs(n_keys=N_KEYS, n_lanes=N_LANES, n_sets=N_SETS)
-    inputs = jax.device_put(inputs, dev)
+    inputs = jax.device_put(
+        fs.example_inputs(n_keys=n_keys, n_lanes=n_lanes, n_sets=N_SETS),
+        dev)
     percentiles = jnp.asarray(PERCENTILES, jnp.float32)
-
     t0 = time.perf_counter()
-    out = fs.flush_step(inputs, percentiles)
-    jax.block_until_ready(out)
-    log(f"first compile+run: {time.perf_counter() - t0:.1f}s")
-
-    for _ in range(WARMUP):
+    jax.block_until_ready(fs.flush_step(inputs, percentiles))
+    log(f"{label} compile+first run: {time.perf_counter() - t0:.1f}s")
+    for _ in range(warmup):
         jax.block_until_ready(fs.flush_step(inputs, percentiles))
-
     lat = []
-    for _ in range(ITERS):
+    for _ in range(iters):
         t0 = time.perf_counter()
-        out = fs.flush_step(inputs, percentiles)
-        jax.block_until_ready(out)
+        jax.block_until_ready(fs.flush_step(inputs, percentiles))
         lat.append((time.perf_counter() - t0) * 1e3)
     lat = np.asarray(lat)
-    p50, p99 = float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
+    return float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
+
+
+def bench_device() -> tuple[float, float]:
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"device arm: backend={dev.platform} device={dev}")
+    p50, p99 = _time_flush(N_KEYS, N_LANES, "device arm", WARMUP, ITERS)
     log(f"device arm: p50={p50:.3f}ms p99={p99:.3f}ms over {ITERS} flushes "
         f"({N_DIGESTS} digests + quantile eval each)")
     return p50, p99
+
+
+def bench_device_scale() -> float | None:
+    """Headroom arm: 10x the north-star cardinality (1M digests/interval)
+    on the same chip.  TPU-only — the CPU-XLA fallback would take minutes
+    compiling shapes this large for no signal."""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        log("scale arm skipped (non-TPU backend)")
+        return None
+    n_keys, lanes = 125_000, 8
+    _, p99 = _time_flush(n_keys, lanes, "scale arm", WARMUP // 2,
+                         ITERS // 3)
+    log(f"scale arm: {n_keys * lanes:,} digests/interval "
+        f"p99={p99:.3f}ms (10x the north-star cardinality)")
+    return p99
 
 
 def bench_baseline_native() -> float | None:
@@ -268,6 +289,14 @@ def main() -> None:
         result["ingest_udp_pkts_per_sec"] = round(ingest_pps)
         result["ingest_vs_baseline"] = round(
             ingest_pps / INGEST_BASELINE_PPS, 2)
+    try:
+        scale_p99 = bench_device_scale()
+    except Exception as e:
+        log(f"scale arm failed: {e}")
+        scale_p99 = None
+    if scale_p99 is not None:
+        # headroom: 10x the north-star cardinality on the same chip
+        result["flush_p99_latency_1m_digest_merge_ms"] = round(scale_p99, 3)
     print(json.dumps(result))
 
 
