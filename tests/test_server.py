@@ -377,3 +377,118 @@ def test_tags_exclude_per_sink(fixture_server):
     ms = drain_until(sink, lambda a: any(m.name == "te.m" for m in a))
     m = [x for x in ms if x.name == "te.m"][0]
     assert m.tags == ["keep:yes"], m.tags
+
+
+def test_grpc_listen_addresses_edge_ingest(fixture_server):
+    """grpc_listen_addresses hosts SSF SendSpan + dogstatsd SendPacket on
+    any instance (StartGRPC, networking.go:326-391) WITHOUT the Forward
+    import service (that is grpc_address's global-tier job)."""
+    import grpc as grpc_mod
+
+    from veneur_tpu.core.server import _SpanSinkWorker
+    from veneur_tpu.protocol import (dogstatsd_grpc_pb2, metric_pb2,
+                                     ssf_pb2)
+    from veneur_tpu.sinks.simple import ChannelSpanSink
+
+    span_sink = ChannelSpanSink()
+    srv, sink = fixture_server(
+        grpc_listen_addresses=["tcp://127.0.0.1:0"])
+    srv.span_sinks.append(span_sink)
+    srv.span_workers.append(
+        _SpanSinkWorker(span_sink, 100, 1, srv._shutdown))
+    port = srv.grpc_ingest_listeners[0].port
+    channel = grpc_mod.insecure_channel(f"127.0.0.1:{port}")
+
+    # dogstatsd bytes over gRPC
+    send_packet = channel.unary_unary(
+        "/dogstatsd.DogstatsdGRPC/SendPacket",
+        request_serializer=(
+            dogstatsd_grpc_pb2.DogstatsdPacket.SerializeToString),
+        response_deserializer=dogstatsd_grpc_pb2.Empty.FromString)
+    send_packet(dogstatsd_grpc_pb2.DogstatsdPacket(
+        packetBytes=b"grpc.edge:11|c"), timeout=5)
+
+    # SSF span over gRPC
+    send_span = channel.unary_unary(
+        "/ssf.SSFGRPC/SendSpan",
+        request_serializer=ssf_pb2.SSFSpan.SerializeToString,
+        response_deserializer=lambda b: b)
+    send_span(ssf_pb2.SSFSpan(version=0, trace_id=5, id=6, name="eop",
+                              service="svc", start_timestamp=1,
+                              end_timestamp=2), timeout=5)
+
+    # the Forward service must NOT be served on this listener
+    v2 = channel.stream_unary(
+        "/forwardrpc.Forward/SendMetricsV2",
+        request_serializer=metric_pb2.Metric.SerializeToString,
+        response_deserializer=lambda b: b)
+    with pytest.raises(grpc_mod.RpcError) as exc:
+        v2(iter([metric_pb2.Metric(name="x")]), timeout=5)
+    assert exc.value.code() == grpc_mod.StatusCode.UNIMPLEMENTED
+
+    # grpc.health.v1 probe (networking.go:377-384 analog)
+    health = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b)
+    assert health(b"", timeout=5) == b"\x08\x01"  # status=SERVING
+
+    # received-per-protocol accounting for both gRPC ingest kinds
+    assert srv.proto_received["dogstatsd-grpc"] == 1
+    assert srv.proto_received["ssf-grpc"] == 1
+
+    srv.flush()
+    ms = drain_until(sink, lambda a: any(m.name == "grpc.edge" for m in a))
+    assert [m for m in ms if m.name == "grpc.edge"][0].value == 11.0
+    got_span = span_sink.queue.get(timeout=5)
+    assert got_span.name == "eop"
+    channel.close()
+
+
+@pytest.mark.skipif(
+    subprocess.run(["which", "openssl"], capture_output=True).returncode != 0,
+    reason="openssl unavailable")
+def test_grpc_ingest_listener_honors_tls(fixture_server, tmp_path):
+    """With server TLS configured, the edge gRPC listener serves mTLS
+    (networking.go:363-374) — plaintext clients are rejected."""
+    import grpc as grpc_mod
+
+    from veneur_tpu.protocol import dogstatsd_grpc_pb2
+
+    ca, certs = _make_certs(tmp_path)
+    skey, scrt = certs["server"]
+    ckey, ccrt = certs["client"]
+    srv, sink = fixture_server(
+        grpc_listen_addresses=["tcp://127.0.0.1:0"],
+        tls_key=skey, tls_certificate=scrt,
+        tls_authority_certificate=ca)
+    port = srv.grpc_ingest_listeners[0].port
+
+    def send(channel):
+        rpc = channel.unary_unary(
+            "/dogstatsd.DogstatsdGRPC/SendPacket",
+            request_serializer=(
+                dogstatsd_grpc_pb2.DogstatsdPacket.SerializeToString),
+            response_deserializer=dogstatsd_grpc_pb2.Empty.FromString)
+        rpc(dogstatsd_grpc_pb2.DogstatsdPacket(
+            packetBytes=b"grpc.tls:3|c"), timeout=5)
+
+    # plaintext must fail
+    with pytest.raises(grpc_mod.RpcError):
+        ch = grpc_mod.insecure_channel(f"127.0.0.1:{port}")
+        send(ch)
+    # mTLS client works
+    with open(ca, "rb") as f:
+        ca_b = f.read()
+    with open(ckey, "rb") as f:
+        key_b = f.read()
+    with open(ccrt, "rb") as f:
+        crt_b = f.read()
+    creds = grpc_mod.ssl_channel_credentials(
+        root_certificates=ca_b, private_key=key_b, certificate_chain=crt_b)
+    ch = grpc_mod.secure_channel(f"127.0.0.1:{port}", creds)
+    send(ch)
+    ch.close()
+    srv.flush()
+    ms = drain_until(sink, lambda a: any(m.name == "grpc.tls" for m in a))
+    assert [m for m in ms if m.name == "grpc.tls"][0].value == 3.0

@@ -270,6 +270,8 @@ class Server:
         self.statsd_addrs: list[tuple[str, object]] = []
         self.ssf_addrs: list[tuple[str, object]] = []
         self.grpc_import = None
+        # edge gRPC ingest listeners (grpc_listen_addresses)
+        self.grpc_ingest_listeners: list = []
         # native ingest data plane (created in start(); None = Python path)
         self.native = None
         self.shutdown_hook: Callable[[], None] = lambda: os._exit(2)
@@ -339,6 +341,8 @@ class Server:
             self._start_statsd(addr)
         for addr in self.config.ssf_listen_addresses:
             self._start_ssf(addr)
+        for addr in self.config.grpc_listen_addresses:
+            self._start_grpc_ingest(addr)
         for sink in self.span_sinks:
             self.span_workers.append(_SpanSinkWorker(
                 sink, self.config.span_channel_capacity,
@@ -355,8 +359,8 @@ class Server:
             self.grpc_import = GrpcImportServer(
                 self.config.grpc_address,
                 _import_counted,
-                ingest_span=self.handle_span,
-                handle_packet=self.process_packet_buffer)
+                ingest_span=self._grpc_span_counted,
+                handle_packet=self._grpc_packet_counted)
             self.grpc_import.start()
         if self.config.forward_address and self.forwarder is None:
             # local tier: persistent forward connection (server.go:810-828)
@@ -508,6 +512,55 @@ class Server:
             self.statsd_addrs.append(("unix", path))
         else:
             raise ValueError(f"unknown statsd listener scheme {scheme!r}")
+
+    def _grpc_packet_counted(self, buf: bytes) -> None:
+        """dogstatsd bytes over gRPC (DOGSTATSD_GRPC, networking.go:347);
+        counted identically on edge and global-tier listeners."""
+        self.proto_received["dogstatsd-grpc"] += 1
+        self.process_packet_buffer(buf)
+
+    def _grpc_span_counted(self, span) -> None:
+        """SSF spans over gRPC (SSF_GRPC, networking.go:353)."""
+        self.proto_received["ssf-grpc"] += 1
+        self.handle_span(span)
+
+    def _grpc_server_credentials(self):
+        """mTLS credentials for gRPC listeners when the server TLS config
+        is set (networking.go:363-374: the reference encrypts the gRPC
+        listener with the same tlsConfig as the statsd TCP listener,
+        requiring client certs when an authority is configured)."""
+        if not (self.config.tls_key and self.config.tls_certificate):
+            return None
+        import grpc as grpc_mod
+        with open(self.config.tls_key, "rb") as f:
+            key = f.read()
+        with open(self.config.tls_certificate, "rb") as f:
+            cert = f.read()
+        ca = None
+        if self.config.tls_authority_certificate:
+            with open(self.config.tls_authority_certificate, "rb") as f:
+                ca = f.read()
+        return grpc_mod.ssl_server_credentials(
+            [(key, cert)], root_certificates=ca,
+            require_client_auth=ca is not None)
+
+    def _start_grpc_ingest(self, addr: str) -> None:
+        """Edge gRPC ingest: SSF SendSpan + raw dogstatsd SendPacket on
+        one listener (StartGRPC, networking.go:326-391) — available on
+        any instance, unlike grpc_address's global-tier Forward import."""
+        from veneur_tpu.sources.proxy import GrpcImportServer
+
+        scheme, rest = parse_listen_addr(addr)
+        if scheme not in ("tcp", "grpc"):
+            raise ValueError(
+                f"unknown grpc listener scheme {scheme!r} in {addr!r}")
+        srv = GrpcImportServer(
+            rest, import_metric=None,
+            ingest_span=self._grpc_span_counted,
+            handle_packet=self._grpc_packet_counted,
+            server_credentials=self._grpc_server_credentials())
+        srv.start()
+        self.grpc_ingest_listeners.append(srv)
 
     def _tls_context(self) -> ssl.SSLContext:
         """TLS with required client certs when an authority is configured
@@ -1033,6 +1086,11 @@ class Server:
                 pass
         if self.grpc_import is not None:
             self.grpc_import.stop()
+        for srv in self.grpc_ingest_listeners:
+            try:
+                srv.stop()
+            except Exception:
+                logger.exception("grpc ingest listener stop failed")
         if self.forwarder is not None and hasattr(self.forwarder, "close"):
             try:
                 self.forwarder.close()

@@ -33,11 +33,15 @@ class GrpcImportServer:
     grpc.Server."""
 
     def __init__(self, address: str,
-                 import_metric: Callable[[object], None],
+                 import_metric: Optional[Callable[[object], None]] = None,
                  ingest_span: Optional[Callable[[object], None]] = None,
                  handle_packet: Optional[Callable[[bytes], None]] = None,
                  max_workers: int = 8,
                  server_credentials: Optional[grpc.ServerCredentials] = None):
+        """With import_metric=None the Forward service is omitted — the
+        ingest-only shape of `grpc_listen_addresses` edge listeners
+        (StartGRPC, networking.go:326-391), vs the global tier's
+        `grpc_address` which serves all three."""
         self.import_metric = import_metric
         self.ingest_span = ingest_span
         self.handle_packet = handle_packet
@@ -78,18 +82,20 @@ class GrpcImportServer:
                 self.imported_count += count
             return empty_pb2.Empty()
 
-        forward_handlers = {
-            "SendMetrics": grpc.unary_unary_rpc_method_handler(
-                send_metrics,
-                request_deserializer=forward_pb2.MetricList.FromString,
-                response_serializer=empty_pb2.Empty.SerializeToString),
-            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
-                send_metrics_v2,
-                request_deserializer=metric_pb2.Metric.FromString,
-                response_serializer=empty_pb2.Empty.SerializeToString),
-        }
-        handlers = [grpc.method_handlers_generic_handler(
-            "forwardrpc.Forward", forward_handlers)]
+        handlers = []
+        if self.import_metric is not None:
+            forward_handlers = {
+                "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                    send_metrics,
+                    request_deserializer=forward_pb2.MetricList.FromString,
+                    response_serializer=empty_pb2.Empty.SerializeToString),
+                "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                    send_metrics_v2,
+                    request_deserializer=metric_pb2.Metric.FromString,
+                    response_serializer=empty_pb2.Empty.SerializeToString),
+            }
+            handlers.append(grpc.method_handlers_generic_handler(
+                "forwardrpc.Forward", forward_handlers))
 
         if self.ingest_span is not None:
             def send_span(request, context):
@@ -116,6 +122,20 @@ class GrpcImportServer:
                         response_serializer=(
                             dogstatsd_grpc_pb2.Empty.SerializeToString)),
                 }))
+
+        # grpc.health.v1 Health/Check, always registered (the reference
+        # sets SetServingStatus("veneur", SERVING), networking.go:377-384)
+        # — k8s gRPC probes expect it.  Hand-rolled proto: a
+        # HealthCheckResponse with status=SERVING is field 1 varint 1.
+        def health_check(request, context):
+            return b"\x08\x01"
+        handlers.append(grpc.method_handlers_generic_handler(
+            "grpc.health.v1.Health", {
+                "Check": grpc.unary_unary_rpc_method_handler(
+                    health_check,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b),
+            }))
 
         class _Multi(grpc.GenericRpcHandler):
             def service(self, handler_call_details):
