@@ -492,3 +492,30 @@ def test_grpc_ingest_listener_honors_tls(fixture_server, tmp_path):
     srv.flush()
     ms = drain_until(sink, lambda a: any(m.name == "grpc.tls" for m in a))
     assert [m for m in ms if m.name == "grpc.tls"][0].value == 3.0
+
+
+def test_grpc_health_unknown_service_not_found(fixture_server):
+    import grpc as grpc_mod
+
+    srv, _ = fixture_server(grpc_listen_addresses=["tcp://127.0.0.1:0"])
+    port = srv.grpc_ingest_listeners[0].port
+    ch = grpc_mod.insecure_channel(f"127.0.0.1:{port}")
+    health = ch.unary_unary("/grpc.health.v1.Health/Check",
+                            request_serializer=lambda b: b,
+                            response_deserializer=lambda b: b)
+    assert health(b"", timeout=5) == b"\x08\x01"
+    # service name "veneur" (field 1, len 6): SERVING
+    assert health(b"\x0a\x06veneur", timeout=5) == b"\x08\x01"
+    with pytest.raises(grpc_mod.RpcError) as exc:
+        health(b"\x0a\x04nope", timeout=5)
+    assert exc.value.code() == grpc_mod.StatusCode.NOT_FOUND
+    ch.close()
+
+
+def test_grpc_ingest_half_tls_config_fails_loud(tmp_path):
+    cfg = make_config(grpc_listen_addresses=["tcp://127.0.0.1:0"],
+                      tls_key=str(tmp_path / "only.key"))
+    srv = Server(cfg)
+    with pytest.raises(ValueError, match="both"):
+        srv.start()
+    srv.shutdown()

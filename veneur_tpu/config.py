@@ -133,6 +133,8 @@ class Config:
     enable_profiling: bool = False
     http_quit: bool = False
     http_config_endpoint: bool = False
+    # accepted for reference-config compatibility; Go-runtime-specific
+    # knobs with no Python analog (profiling here is /debug/profile)
     mutex_profile_fraction: int = 0
     block_profile_rate: int = 0
     sentry_dsn: str = ""

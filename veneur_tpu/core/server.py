@@ -529,8 +529,16 @@ class Server:
         is set (networking.go:363-374: the reference encrypts the gRPC
         listener with the same tlsConfig as the statsd TCP listener,
         requiring client certs when an authority is configured)."""
-        if not (self.config.tls_key and self.config.tls_certificate):
+        key_set = bool(self.config.tls_key)
+        cert_set = bool(self.config.tls_certificate)
+        if not key_set and not cert_set:
             return None
+        if key_set != cert_set:
+            # fail LOUD like the statsd TCP path's load_cert_chain would —
+            # a half-configured TLS setup must never bind plaintext
+            raise ValueError(
+                "tls_key and tls_certificate must both be set for TLS "
+                "gRPC listeners (got only one)")
         import grpc as grpc_mod
         with open(self.config.tls_key, "rb") as f:
             key = f.read()

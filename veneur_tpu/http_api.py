@@ -78,6 +78,15 @@ def make_handler(server) -> type:
                                      server.metric_sinks],
                     "threads": threading.active_count(),
                 }
+                native = getattr(server, "native", None)
+                if native is not None:
+                    lines, malformed, packets, too_long = \
+                        native.engine.totals()
+                    stats["native_ingest"] = {
+                        "lines": lines, "malformed": malformed,
+                        "packets": packets, "too_long": too_long,
+                        "intern_count": native.engine.intern_count(),
+                    }
                 self._reply(200, json.dumps(stats, indent=2).encode(),
                             "application/json")
             elif self.path.startswith("/debug/profile"):

@@ -125,9 +125,17 @@ class GrpcImportServer:
 
         # grpc.health.v1 Health/Check, always registered (the reference
         # sets SetServingStatus("veneur", SERVING), networking.go:377-384)
-        # — k8s gRPC probes expect it.  Hand-rolled proto: a
-        # HealthCheckResponse with status=SERVING is field 1 varint 1.
+        # — k8s gRPC probes expect it.  Hand-rolled protos: request field
+        # 1 is the service name; a SERVING response is field 1 varint 1.
+        # Unknown service names get NOT_FOUND per the health protocol.
         def health_check(request, context):
+            service = ""
+            if len(request) >= 2 and request[0] == 0x0A:
+                n = request[1]
+                service = request[2:2 + n].decode(errors="replace")
+            if service not in ("", "veneur"):
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"unknown service {service!r}")
             return b"\x08\x01"
         handlers.append(grpc.method_handlers_generic_handler(
             "grpc.health.v1.Health", {
