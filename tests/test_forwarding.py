@@ -186,3 +186,84 @@ def test_wire_compat_fixture():
     assert back.kind == "histogram"
     # field 1 is the name, wire type 2 (length-delimited): tag byte 0x0A
     assert data[0] == 0x0A
+
+
+def test_forward_survives_global_restart():
+    """Elasticity (§5.3): the local's persistent forward channel rides out
+    a global-tier restart — failed interval is dropped with accounting
+    (UDP-heritage loss model), then forwarding resumes on the same
+    address without restarting the local."""
+    import queue
+    import socket as socket_mod
+    import time
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks import simple as simple_sinks
+
+    def boot_global(port=0):
+        sink = simple_sinks.ChannelMetricSink()
+        srv = Server(config_mod.Config(
+            grpc_address=f"127.0.0.1:{port}", interval=0.05,
+            percentiles=[0.5], hostname="g"),
+            extra_metric_sinks=[sink])
+        srv.start()
+        return srv, sink
+
+    g1, s1 = boot_global()
+    port = g1.grpc_import.port
+    local = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        forward_address=f"127.0.0.1:{port}", interval=0.05,
+        forward_timeout=2.0, hostname="l"))
+    local.start()
+    try:
+        _, addr = local.statsd_addrs[0]
+        tx = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+
+        def send_and_flush(name):
+            tx.sendto(b"%s:1|c|#veneurglobalonly" % name, addr)
+            deadline = time.time() + 5
+            base = local.aggregator.processed
+            while time.time() < deadline:
+                local._drain_native()
+                if local.aggregator.processed > base:
+                    break
+                time.sleep(0.02)
+            local.flush()
+
+        def wait_for(srv, sink, name, timeout=10):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                srv.flush()
+                try:
+                    for m in sink.queue.get(timeout=0.2):
+                        if m.name == name.decode():
+                            return True
+                except queue.Empty:
+                    pass
+            return False
+
+        send_and_flush(b"fw.phase1")
+        assert wait_for(g1, s1, b"fw.phase1")
+
+        g1.shutdown()
+        send_and_flush(b"fw.lost")    # global down: dropped, not fatal
+        time.sleep(1.0)               # let the in-flight forward fail
+
+        g2, s2 = boot_global(port)    # same address, fresh global
+        try:
+            # the local's channel reconnects; retry a few intervals (gRPC
+            # backoff may delay the first successful stream)
+            ok = False
+            for i in range(15):
+                send_and_flush(b"fw.phase2")
+                if wait_for(g2, s2, b"fw.phase2", timeout=2):
+                    ok = True
+                    break
+            assert ok, "forwarding did not recover after global restart"
+        finally:
+            g2.shutdown()
+        tx.close()
+    finally:
+        local.shutdown()

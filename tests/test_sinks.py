@@ -710,3 +710,16 @@ def test_sigv4_against_published_aws_vector():
         "SignedHeaders=content-type;host;x-amz-date, "
         "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06"
         "b5924a6f2b5d7")
+
+
+def test_datadog_parallel_chunk_posts(http_capture):
+    """Multiple body chunks post concurrently (flushPart goroutines,
+    datadog.go:158-233) and the accounting still sums exactly."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "k", "flush_max_per_body": 10,
+        "api_hostname": f"http://127.0.0.1:{http_capture.server_port}"}))
+    res = sink.flush([im(f"dd.par.{i}", float(i)) for i in range(55)])
+    assert res.flushed == 55 and res.dropped == 0
+    assert len(http_capture.captured) == 6  # ceil(55/10) bodies

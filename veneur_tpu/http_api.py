@@ -80,13 +80,9 @@ def make_handler(server) -> type:
                 }
                 native = getattr(server, "native", None)
                 if native is not None:
-                    lines, malformed, packets, too_long = \
-                        native.engine.totals()
-                    stats["native_ingest"] = {
-                        "lines": lines, "malformed": malformed,
-                        "packets": packets, "too_long": too_long,
-                        "intern_count": native.engine.intern_count(),
-                    }
+                    ni = native.stats()  # None while tearing down
+                    if ni is not None:
+                        stats["native_ingest"] = ni
                 self._reply(200, json.dumps(stats, indent=2).encode(),
                             "application/json")
             elif self.path.startswith("/debug/profile"):

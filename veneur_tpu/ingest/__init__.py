@@ -440,6 +440,18 @@ class NativeIngest:
                     agg.sets.stage_hash_batch(rows, batch.s_hashes)
         return batch
 
+    def stats(self) -> Optional[dict]:
+        """Safe snapshot for observability endpoints: totals + intern
+        size under the drain lock (close() takes the same lock, so a
+        probe racing teardown reads None instead of freed memory)."""
+        with self._drain_lock:
+            if self.engine._closed:
+                return None
+            lines, malformed, packets, too_long = self.engine.totals()
+            return {"lines": lines, "malformed": malformed,
+                    "packets": packets, "too_long": too_long,
+                    "intern_count": self.engine.intern_count()}
+
     def stop(self) -> None:
         self.engine.stop()
 

@@ -122,6 +122,14 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         self.flush_retries = int(cfg.get("flush_retries", 2))
         self.validate_on_start = bool(cfg.get("validate_on_start", False))
         self.session = session or requests.Session()
+        self._chunk_pool = None
+
+    def _pool(self):
+        if self._chunk_pool is None:
+            import concurrent.futures
+            self._chunk_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="dd-flush")
+        return self._chunk_pool
 
     def start(self, trace_client=None) -> None:
         """Optional API-key validation against /api/v1/validate — a bad
@@ -144,19 +152,30 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
     def flush(self, metrics):
         if not metrics:
             return sink_mod.MetricFlushResult()
-        flushed = dropped = 0
         # key rides the DD-API-KEY header, never the (logged) URL
         url = f"{self.api_url}/api/v1/series"
         auth = {"DD-API-KEY": self.api_key}
-        for i in range(0, len(metrics), self.flush_max_per_body):
-            chunk = metrics[i:i + self.flush_max_per_body]
+        chunks = [metrics[i:i + self.flush_max_per_body]
+                  for i in range(0, len(metrics), self.flush_max_per_body)]
+
+        def post(chunk, session) -> bool:
             payload = series_payload(chunk, self.hostname, self.interval_s,
                                      self.extra_tags)
-            if _post_json(self.session, url, payload, headers=auth,
-                          retries=self.flush_retries):
-                flushed += len(chunk)
-            else:
-                dropped += len(chunk)
+            return _post_json(session, url, payload, headers=auth,
+                              retries=self.flush_retries)
+
+        if len(chunks) == 1:
+            results = [post(chunks[0], self.session)]
+        else:
+            # chunk posts run concurrently (flushPart goroutines,
+            # datadog.go:158-233) on a lazily-created persistent pool;
+            # requests.Session is NOT documented thread-safe (the cookie
+            # jar is shared mutable state), so each worker posts through
+            # its own session
+            results = list(self._pool().map(
+                lambda c: post(c, requests.Session()), chunks))
+        flushed = sum(len(c) for c, ok in zip(chunks, results) if ok)
+        dropped = len(metrics) - flushed
         return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
 
     def flush_other_samples(self, samples):
