@@ -357,3 +357,51 @@ def test_intern_key_no_separator_aliasing():
 
 def test_blast_udp_empty_payloads():
     assert ingest_mod.blast_udp("127.0.0.1", 1, 10, []) == 0
+
+
+def test_reference_vectors_cross_path():
+    """Vectors lifted from the reference's parser_test.go matrix: both
+    paths accept/reject identically, and raw tag ORDER canonicalizes to
+    one identity (UpdateTags sorts, parser.go:44-61)."""
+    valid = [
+        b"a.b.c:0.1716441474854946|d|#filter:flatulent",
+        b"a.b.c:1.234|ms",
+        b"a.b.c:1:2:3:4|ms|@0.1|#result:success,op:frob",
+        b"a.b.c:1|c|#",                  # empty tag section is legal
+        b"a.b.c:1|c|#baz:gorch,foo:bar",
+        b"a.b.c:1|c|@0.1|#foo:bar,baz:gorch",
+        b"a.b.c:1|h|#veneurglobalonly,tag2:quacks",
+        b"a.b.c:1|h|#veneurlocalonly,tag2:quacks",
+        b"a.b.c:foo|s",
+    ]
+    invalid = [b"a.b.c:fart|c", b"foo.bar|0", b"_sc"]
+    ref = python_reference_parse(valid + invalid)
+    batch = native_parse(valid + invalid)
+    # same accept count (per metric value) and same reject count
+    n_ref = sum(len(v) for v in ref.values())
+    assert batch.processed == n_ref
+    # "_sc" punts to the slow path (service-check prefix), the other two
+    # are malformed metric lines
+    assert batch.malformed == 2
+    assert batch.other == [b"_sc"]
+    # tag order canonicalization: both orderings intern to ONE identity
+    keys = {(k.name, k.joined_tags) for k in batch.new_keys
+            if k.mtype == "counter" and k.joined_tags}
+    assert ("a.b.c", "baz:gorch,foo:bar") in keys
+    # both raw orderings canonicalize to the same joined identity (the
+    # engine interns raw bytes, so two ids may exist; the Python drain
+    # dedupes them onto one arena row via the canonical MetricKey)
+    orderings = [k for k in batch.new_keys
+                 if k.mtype == "counter"
+                 and k.joined_tags == "baz:gorch,foo:bar"]
+    assert len(orderings) == 2
+    agg = MetricAggregator()
+    nat = ingest_mod.NativeIngest(agg)
+    tid = nat.engine.new_thread()
+    nat.engine.ingest(tid, b"a.b.c:1|c|#baz:gorch,foo:bar")
+    nat.engine.ingest(tid, b"a.b.c:2|c|#foo:bar,baz:gorch")
+    nat.drain_into()
+    res = agg.flush(is_local=False)
+    nat.close()
+    assert [round(m.value, 6) for m in res.metrics
+            if m.name == "a.b.c"] == [3.0]  # ONE row, summed

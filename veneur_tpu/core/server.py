@@ -1104,4 +1104,10 @@ class Server:
                 self.forwarder.close()
             except Exception:
                 pass
+        for _, sink in self.metric_sinks:
+            if hasattr(sink, "close"):
+                try:
+                    sink.close()
+                except Exception:
+                    logger.exception("sink close failed")
         self._flush_pool.shutdown(wait=False)

@@ -122,14 +122,25 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         self.flush_retries = int(cfg.get("flush_retries", 2))
         self.validate_on_start = bool(cfg.get("validate_on_start", False))
         self.session = session or requests.Session()
-        self._chunk_pool = None
+        # eager: spawns no threads until first submit, and overlapping
+        # straggler flushes cannot race a lazy check-then-set
+        import concurrent.futures
+        self._chunk_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="dd-flush")
+        self._tls = threading.local()
 
-    def _pool(self):
-        if self._chunk_pool is None:
-            import concurrent.futures
-            self._chunk_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="dd-flush")
-        return self._chunk_pool
+    def _worker_session(self) -> requests.Session:
+        """One long-lived session per pool worker (requests.Session is not
+        thread-safe, and per-chunk sessions would leak sockets and pay a
+        TLS handshake per chunk)."""
+        s = getattr(self._tls, "session", None)
+        if s is None:
+            s = requests.Session()
+            self._tls.session = s
+        return s
+
+    def close(self) -> None:
+        self._chunk_pool.shutdown(wait=False, cancel_futures=True)
 
     def start(self, trace_client=None) -> None:
         """Optional API-key validation against /api/v1/validate — a bad
@@ -168,12 +179,10 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
             results = [post(chunks[0], self.session)]
         else:
             # chunk posts run concurrently (flushPart goroutines,
-            # datadog.go:158-233) on a lazily-created persistent pool;
-            # requests.Session is NOT documented thread-safe (the cookie
-            # jar is shared mutable state), so each worker posts through
-            # its own session
-            results = list(self._pool().map(
-                lambda c: post(c, requests.Session()), chunks))
+            # datadog.go:158-233) on the sink's persistent pool, each
+            # worker through its own long-lived session
+            results = list(self._chunk_pool.map(
+                lambda c: post(c, self._worker_session()), chunks))
         flushed = sum(len(c) for c, ok in zip(chunks, results) if ok)
         dropped = len(metrics) - flushed
         return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
