@@ -154,10 +154,12 @@ def test_scopedstatsd_scope_tags():
         tags=["base:1"])
     client.count("c", 2, tags=["k:v"])
     data, _ = sock.recvfrom(65536)
-    assert data == b"c:2|c|#base:1,k:v,veneurglobalonly"
+    # self-metrics carry the reference's "veneur." namespace
+    # (cmd/veneur/main.go:92)
+    assert data == b"veneur.c:2|c|#base:1,k:v,veneurglobalonly"
     client.gauge("g", 1.5)
     data, _ = sock.recvfrom(65536)
-    assert data == b"g:1.5|g|#base:1,veneurlocalonly"
+    assert data == b"veneur.g:1.5|g|#base:1,veneurlocalonly"
     client.close()
     sock.close()
     # nil-safety
@@ -181,7 +183,9 @@ def test_diagnostics_collect_and_report():
     assert stats["uptime_ms"] >= 0
     assert stats["threads"] >= 1
     assert "mem.rss_bytes" in stats
-    assert rec.gauges["veneur.threads"] == stats["threads"]
+    # bare names: the "veneur." namespace is the statsd CLIENT's job
+    # (ScopedClient), never double-prefixed here
+    assert rec.gauges["threads"] == stats["threads"]
 
 
 def test_example_configs_load():

@@ -47,7 +47,10 @@ class Diagnostics:
 
     def __init__(self, statsd=None, interval_s: float = 10.0,
                  tags: Optional[list[str]] = None,
-                 prefix: str = "veneur."):
+                 prefix: str = ""):
+        # the "veneur." namespace comes from the statsd client
+        # (ScopedClient mirrors cmd/veneur/main.go:92); a non-empty
+        # prefix here would double it
         self.statsd = scopedstatsd.ensure(statsd)
         self.interval_s = interval_s
         self.tags = list(tags or [])

@@ -45,12 +45,17 @@ class ScopedClient:
 
     def __init__(self, address: str = "127.0.0.1:8125",
                  scopes: Optional[MetricScopes] = None,
-                 tags: Optional[list[str]] = None):
+                 tags: Optional[list[str]] = None,
+                 namespace: str = "veneur."):
         host, _, port = address.rpartition(":")
         self._dest = (host or "127.0.0.1", int(port or 8125))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.scopes = scopes or MetricScopes()
         self.tags = list(tags or [])
+        # the reference namespaces ALL self-metrics
+        # (statsd.WithNamespace("veneur."), cmd/veneur/main.go:92) —
+        # dashboards built against a reference fleet key on the prefix
+        self.namespace = namespace
 
     def _emit(self, name: str, value, mtype: str, tags: Optional[list[str]],
               scope: str, rate: float = 1.0) -> None:
@@ -58,7 +63,7 @@ class ScopedClient:
         st = scope_tag(scope)
         if st:
             all_tags.append(st)
-        line = f"{name}:{value}|{mtype}"
+        line = f"{self.namespace}{name}:{value}|{mtype}"
         if rate != 1.0:
             line += f"|@{rate}"
         if all_tags:

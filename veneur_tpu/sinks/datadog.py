@@ -121,6 +121,7 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         self.extra_tags = list(cfg.get("tags", []))
         self.flush_retries = int(cfg.get("flush_retries", 2))
         self.validate_on_start = bool(cfg.get("validate_on_start", False))
+        self._injected_session = session
         self.session = session or requests.Session()
         # eager: spawns no threads until first submit, and overlapping
         # straggler flushes cannot race a lazy check-then-set
@@ -128,19 +129,34 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         self._chunk_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="dd-flush")
         self._tls = threading.local()
+        self._sessions: list[requests.Session] = []
+        self._sessions_lock = threading.Lock()
 
     def _worker_session(self) -> requests.Session:
-        """One long-lived session per pool worker (requests.Session is not
-        thread-safe, and per-chunk sessions would leak sockets and pay a
-        TLS handshake per chunk)."""
+        """One long-lived session per calling thread (requests.Session is
+        not thread-safe — overlapping straggler flushes must never share
+        one — and per-chunk sessions would leak sockets and pay a TLS
+        handshake per chunk).  An injected test session is honored."""
+        if self._injected_session is not None:
+            return self._injected_session
         s = getattr(self._tls, "session", None)
         if s is None:
             s = requests.Session()
             self._tls.session = s
+            with self._sessions_lock:
+                self._sessions.append(s)
         return s
 
     def close(self) -> None:
         self._chunk_pool.shutdown(wait=False, cancel_futures=True)
+        with self._sessions_lock:
+            sessions, self._sessions = self._sessions, []
+        for s in sessions + ([] if self._injected_session else
+                             [self.session]):
+            try:
+                s.close()
+            except Exception:
+                pass
 
     def start(self, trace_client=None) -> None:
         """Optional API-key validation against /api/v1/validate — a bad
@@ -175,14 +191,21 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
             return _post_json(session, url, payload, headers=auth,
                               retries=self.flush_retries)
 
+        import concurrent.futures as cf
         if len(chunks) == 1:
-            results = [post(chunks[0], self.session)]
+            results = [post(chunks[0], self._worker_session())]
         else:
             # chunk posts run concurrently (flushPart goroutines,
             # datadog.go:158-233) on the sink's persistent pool, each
             # worker through its own long-lived session
-            results = list(self._chunk_pool.map(
-                lambda c: post(c, self._worker_session()), chunks))
+            try:
+                results = list(self._chunk_pool.map(
+                    lambda c: post(c, self._worker_session()), chunks))
+            except cf.CancelledError:
+                # close() raced a straggler flush: remaining chunks are
+                # dropped WITH accounting, not as an escaping exception
+                results = []
+        results += [False] * (len(chunks) - len(results))
         flushed = sum(len(c) for c, ok in zip(chunks, results) if ok)
         dropped = len(metrics) - flushed
         return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
@@ -222,11 +245,12 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
                     + self.extra_tags,
                 })
         auth = {"DD-API-KEY": self.api_key}
+        session = self._worker_session()
         if events:
-            _post_json(self.session, f"{self.api_url}/intake",
+            _post_json(session, f"{self.api_url}/intake",
                        {"events": {"api": events}}, headers=auth)
         if checks:
-            _post_json(self.session, f"{self.api_url}/api/v1/check_run",
+            _post_json(session, f"{self.api_url}/api/v1/check_run",
                        checks, headers=auth)
 
 
