@@ -394,3 +394,26 @@ def test_hot_key_mixed_with_many_shallow_rows():
     assert by["deep.lat.count"] == 40_000.0
     for i in range(300):
         assert by[f"shallow.{i}.count"] == 700.0
+
+
+def test_empty_imported_digest_does_not_crash_flush():
+    """A forwarded GLOBAL_ONLY histogram with an empty digest (zero
+    count) must flush NaN-valued aggregates, not abort the interval with
+    ZeroDivisionError."""
+    import math
+
+    g = MetricAggregator(
+        percentiles=[0.5],
+        aggregates=sm.parse_aggregates(["avg", "hmean", "count"]))
+    g.import_metric(sm.ForwardMetric(
+        name="empty.h", tags=[], kind="histogram",
+        scope=MetricScope.GLOBAL_ONLY, digest_means=[], digest_weights=[],
+        digest_min=float("inf"), digest_max=float("-inf"), digest_rsum=0.0))
+    g.import_metric(sm.ForwardMetric(
+        name="ok.c", tags=[], kind="counter",
+        scope=MetricScope.GLOBAL_ONLY, counter_value=5))
+    res = g.flush(is_local=False)
+    by = {m.name: m.value for m in res.metrics}
+    assert by["ok.c"] == 5.0        # the rest of the flush survived
+    assert math.isnan(by["empty.h.avg"])
+    assert math.isnan(by["empty.h.hmean"])

@@ -320,100 +320,115 @@ class MetricAggregator:
         pl = list(self.percentiles)
         out = part["flush_fn"](
             *part["lanes"], jnp.asarray([0.5] + pl, jnp.float32))
-        qs = np.asarray(out.quantiles)
-        counts = np.asarray(out.counts)
-        sums = np.asarray(out.sums)
-        mean_np = np.asarray(out.mean)
-        weight_np = np.asarray(out.weight)
+        # everything the per-row loop reads becomes plain Python floats up
+        # front: at 100k keys the loop is the host-side flush bottleneck,
+        # and numpy scalar indexing/conversions cost ~1us each inside it
+        rows_dev = jnp.asarray(rows)
+        qs = np.asarray(out.quantiles[rows_dev])
+        counts = np.asarray(out.counts[rows_dev]).tolist()
+        sums = np.asarray(out.sums[rows_dev]).tolist()
+        if is_local:
+            # centroid export is only needed for forwarding; gather the
+            # touched rows ON DEVICE so the host transfer is [n, C], not
+            # the whole [capacity, C] arena
+            sel_mean = np.asarray(out.mean[rows_dev])
+            sel_weight = np.asarray(out.weight[rows_dev])
+        else:
+            sel_mean = sel_weight = None
+        pcts = [(f".{int(p * 100)}percentile", j + 1)
+                for j, p in enumerate(pl)]
+        q_cols = [qs[:, j].tolist() for j in range(qs.shape[1])]
+        l_weight = part["l_weight"].tolist()
+        l_min = part["l_min"].tolist()
+        l_max = part["l_max"].tolist()
+        l_sum = part["l_sum"].tolist()
+        l_rsum = part["l_rsum"].tolist()
+        d_min = part["d_min"].tolist()
+        d_max = part["d_max"].tolist()
+        d_rsum = part["d_rsum"].tolist()
 
         aggs = self.aggregates.value
         A = sm.Aggregate
-        for i, (row, meta) in enumerate(zip(rows, part["meta"])):
+        want_max = bool(aggs & A.MAX)
+        want_min = bool(aggs & A.MIN)
+        want_sum = bool(aggs & A.SUM)
+        want_avg = bool(aggs & A.AVERAGE)
+        want_count = bool(aggs & A.COUNT)
+        want_median = bool(aggs & A.MEDIAN)
+        want_hmean = bool(aggs & A.HARMONIC_MEAN)
+        compression = self.digests.compression
+        metrics_out = res.metrics
+        forward_out = res.forward
+        MIXED, GLOBAL_ONLY = MetricScope.MIXED, MetricScope.GLOBAL_ONLY
+        InterMetric, ForwardMetric = sm.InterMetric, sm.ForwardMetric
+        GAUGE, COUNTER = sm.GAUGE, sm.COUNTER
+        inf = float("inf")
+
+        for i, meta in enumerate(part["meta"]):
             cls = meta.scope  # MIXED / GLOBAL_ONLY / LOCAL_ONLY row class
-            kind = meta.key.type
-            if cls == MetricScope.MIXED:
-                if is_local:
-                    # forward the digest; emit aggregates from local scalars
-                    occ = weight_np[row] > 0
-                    res.forward.append(sm.ForwardMetric(
-                        name=meta.key.name, tags=meta.tags, kind=kind,
-                        scope=MetricScope.MIXED,
-                        digest_means=mean_np[row][occ].tolist(),
-                        digest_weights=weight_np[row][occ].tolist(),
-                        digest_min=float(part["d_min"][i]),
-                        digest_max=float(part["d_max"][i]),
-                        digest_sum=float(sums[row]),
-                        digest_rsum=float(part["d_rsum"][i]),
-                        digest_compression=self.digests.compression))
-                    row_pcts = []
-                else:
-                    row_pcts = pl
-                use_global = False
-            elif cls == MetricScope.GLOBAL_ONLY:
-                if is_local:
-                    occ = weight_np[row] > 0
-                    res.forward.append(sm.ForwardMetric(
-                        name=meta.key.name, tags=meta.tags, kind=kind,
-                        scope=MetricScope.GLOBAL_ONLY,
-                        digest_means=mean_np[row][occ].tolist(),
-                        digest_weights=weight_np[row][occ].tolist(),
-                        digest_min=float(part["d_min"][i]),
-                        digest_max=float(part["d_max"][i]),
-                        digest_sum=float(sums[row]),
-                        digest_rsum=float(part["d_rsum"][i]),
-                        digest_compression=self.digests.compression))
+            forwarded = is_local and cls in (MIXED, GLOBAL_ONLY)
+            if forwarded:
+                occ = sel_weight[i] > 0
+                forward_out.append(ForwardMetric(
+                    name=meta.key.name, tags=meta.tags, kind=meta.key.type,
+                    scope=cls,
+                    digest_means=sel_mean[i][occ].tolist(),
+                    digest_weights=sel_weight[i][occ].tolist(),
+                    digest_min=d_min[i], digest_max=d_max[i],
+                    digest_sum=sums[i], digest_rsum=d_rsum[i],
+                    digest_compression=compression))
+                if cls is GLOBAL_ONLY:
                     continue  # nothing emitted locally for global-only
-                row_pcts = pl
-                use_global = True
-            else:  # LOCAL_ONLY: flushed fully here, never forwarded
-                row_pcts = pl
-                use_global = False
+            use_global = cls is GLOBAL_ONLY
+            emit_pcts = not forwarded
 
-            self._emit_histo_row(
-                res, meta, now, aggs, A, use_global,
-                l_weight=part["l_weight"][i], l_min=part["l_min"][i],
-                l_max=part["l_max"][i], l_sum=part["l_sum"][i],
-                l_rsum=part["l_rsum"][i],
-                d_min=part["d_min"][i], d_max=part["d_max"][i],
-                d_rsum=part["d_rsum"][i],
-                d_count=counts[row], d_sum=sums[row],
-                median=qs[row, 0],
-                pct_values={p: qs[row, 1 + pl.index(p)] for p in row_pcts})
-
-    def _emit_histo_row(self, res, meta, now, aggs, A, use_global, *,
-                        l_weight, l_min, l_max, l_sum, l_rsum,
-                        d_min, d_max, d_rsum, d_count, d_sum,
-                        median, pct_values):
-        """One histogram row's InterMetrics, mirroring Histo.Flush
-        (samplers/samplers.go:359-514): local-scalar aggregates with
-        sparse-emission guards, digest-backed values when global."""
-        name = meta.key.name
-        tags = meta.tags
-        out = res.metrics
-
-        def emit(suffix, value, mtype=sm.GAUGE):
-            out.append(sm.InterMetric(
-                name=meta.flush_name(suffix), timestamp=now,
-                value=float(value), tags=tags, type=mtype))
-
-        if aggs & A.MAX and (np.isfinite(l_max) or use_global):
-            emit(".max", d_max if use_global else l_max)
-        if aggs & A.MIN and (np.isfinite(l_min) or use_global):
-            emit(".min", d_min if use_global else l_min)
-        if aggs & A.SUM and (l_sum != 0 or use_global):
-            emit(".sum", d_sum if use_global else l_sum)
-        if aggs & A.AVERAGE and (use_global or (l_sum != 0 and l_weight != 0)):
-            emit(".avg", (d_sum / d_count) if use_global
-                 else (l_sum / l_weight))
-        if aggs & A.COUNT and (l_weight != 0 or use_global):
-            emit(".count", d_count if use_global else l_weight, sm.COUNTER)
-        if aggs & A.MEDIAN:
-            # emitted unconditionally when configured (samplers.go:466-479)
-            emit(".median", median)
-        if aggs & A.HARMONIC_MEAN and (use_global or
-                                       (l_rsum != 0 and l_weight != 0)):
-            emit(".hmean", (d_count / d_rsum) if use_global
-                 else (l_weight / l_rsum))
-        for p, v in pct_values.items():
-            # reference naming: int(p*100), samplers.go:495-507
-            emit(f".{int(p * 100)}percentile", v)
+            # one histogram row's InterMetrics, mirroring Histo.Flush
+            # (samplers/samplers.go:359-514): local-scalar aggregates with
+            # sparse-emission guards, digest-backed values when global
+            lw, ls, lr = l_weight[i], l_sum[i], l_rsum[i]
+            fname = meta.flush_name
+            if want_max and (use_global or -inf < l_max[i] < inf):
+                metrics_out.append(InterMetric(
+                    name=fname(".max"), timestamp=now,
+                    value=d_max[i] if use_global else l_max[i],
+                    tags=meta.tags, type=GAUGE))
+            if want_min and (use_global or -inf < l_min[i] < inf):
+                metrics_out.append(InterMetric(
+                    name=fname(".min"), timestamp=now,
+                    value=d_min[i] if use_global else l_min[i],
+                    tags=meta.tags, type=GAUGE))
+            if want_sum and (ls != 0 or use_global):
+                metrics_out.append(InterMetric(
+                    name=fname(".sum"), timestamp=now,
+                    value=sums[i] if use_global else ls,
+                    tags=meta.tags, type=GAUGE))
+            if want_avg and (use_global or (ls != 0 and lw != 0)):
+                metrics_out.append(InterMetric(
+                    name=fname(".avg"), timestamp=now,
+                    value=((sums[i] / counts[i]) if counts[i]
+                           else float("nan")) if use_global else ls / lw,
+                    tags=meta.tags, type=GAUGE))
+            if want_count and (lw != 0 or use_global):
+                metrics_out.append(InterMetric(
+                    name=fname(".count"), timestamp=now,
+                    value=counts[i] if use_global else lw,
+                    tags=meta.tags, type=COUNTER))
+            if want_median:
+                # emitted unconditionally when configured
+                # (samplers.go:466-479)
+                metrics_out.append(InterMetric(
+                    name=fname(".median"), timestamp=now,
+                    value=q_cols[0][i], tags=meta.tags, type=GAUGE))
+            if want_hmean and (use_global or
+                                           (lr != 0 and lw != 0)):
+                metrics_out.append(InterMetric(
+                    name=fname(".hmean"), timestamp=now,
+                    value=((counts[i] / d_rsum[i]) if d_rsum[i]
+                           else float("nan")) if use_global else lw / lr,
+                    tags=meta.tags, type=GAUGE))
+            if emit_pcts:
+                # reference naming: int(p*100), samplers.go:495-507
+                for suffix, col in pcts:
+                    metrics_out.append(InterMetric(
+                        name=fname(suffix), timestamp=now,
+                        value=q_cols[col][i], tags=meta.tags, type=GAUGE))
