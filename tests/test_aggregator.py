@@ -417,3 +417,15 @@ def test_empty_imported_digest_does_not_crash_flush():
     assert by["ok.c"] == 5.0        # the rest of the flush survived
     assert math.isnan(by["empty.h.avg"])
     assert math.isnan(by["empty.h.hmean"])
+
+
+def test_arena_initial_capacity_presizing():
+    """arena_initial_capacity pre-sizes every family (rounded to a power
+    of two) so big deployments skip growth copies."""
+    a = MetricAggregator(initial_capacity=5000)
+    assert a.digests.capacity == 8192
+    assert a.counters.capacity == 8192
+    assert a.sets.capacity == 8192
+    a.process_metric(mk("c", "counter", 1))
+    res = a.flush(is_local=False)
+    assert by_name(res.metrics)["c"].value == 1.0

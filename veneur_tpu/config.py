@@ -90,6 +90,10 @@ class Config:
     aggregates: list[str] = field(default_factory=lambda: ["min", "max", "count"])
     tdigest_compression: float = 100.0
     set_precision: int = 14
+    # initial arena rows (metric keys) per sampler family; arenas grow by
+    # doubling, but each growth copies device tensors — size for the
+    # expected live cardinality up front on big deployments (0 = default)
+    arena_initial_capacity: int = 0
     count_unique_timeseries: bool = False
     # device mesh for the sharded serving flush (veneur_tpu/parallel/):
     # 0 devices = single-device lanes; replicas 0 = auto (2 when even)

@@ -57,17 +57,24 @@ class MetricAggregator:
                  set_precision: int = hll_mod.DEFAULT_PRECISION,
                  count_unique_timeseries: bool = False,
                  mesh=None, ingest_lanes: Optional[int] = None,
-                 is_local: bool = True):
+                 is_local: bool = True, initial_capacity: int = 0):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
         self.mesh = mesh
+        # pre-size for expected cardinality (arena growth copies device
+        # tensors); rounded up to a power of two
+        cap = arena_mod._INITIAL_CAPACITY
+        if initial_capacity > cap:
+            cap = 1 << (initial_capacity - 1).bit_length()
         self.digests = arena_mod.DigestArena(
-            compression=compression, mesh=mesh, n_lanes=ingest_lanes)
-        self.sets = arena_mod.SetArena(precision=set_precision)
-        self.counters = arena_mod.CounterArena()
-        self.gauges = arena_mod.GaugeArena()
-        self.status = arena_mod.StatusArena()
+            capacity=cap, compression=compression, mesh=mesh,
+            n_lanes=ingest_lanes)
+        self.sets = arena_mod.SetArena(capacity=cap,
+                                       precision=set_precision)
+        self.counters = arena_mod.CounterArena(capacity=cap)
+        self.gauges = arena_mod.GaugeArena(capacity=cap)
+        self.status = arena_mod.StatusArena(capacity=cap)
         self.processed = 0
         self.imported = 0
         self.count_unique_timeseries = count_unique_timeseries

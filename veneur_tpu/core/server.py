@@ -168,7 +168,8 @@ class Server:
             count_unique_timeseries=cfg.count_unique_timeseries,
             mesh=self.mesh,
             ingest_lanes=cfg.ingest_lanes or None,
-            is_local=cfg.is_local)
+            is_local=cfg.is_local,
+            initial_capacity=cfg.arena_initial_capacity)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
