@@ -85,9 +85,23 @@ def _time_flush(n_keys: int, n_lanes: int, label: str,
     return float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache: repeated bench runs skip the ~20-40s
+    cold compiles of the flush shapes."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:
+        log(f"compile cache unavailable: {e}")
+
+
 def bench_device() -> tuple[float, float]:
     import jax
 
+    _enable_compile_cache()
     dev = jax.devices()[0]
     log(f"device arm: backend={dev.platform} device={dev}")
     p50, p99 = _time_flush(N_KEYS, N_LANES, "device arm", WARMUP, ITERS)
@@ -106,8 +120,7 @@ def bench_device_scale() -> float | None:
         log("scale arm skipped (non-TPU backend)")
         return None
     n_keys, lanes = 125_000, 8
-    _, p99 = _time_flush(n_keys, lanes, "scale arm", WARMUP // 2,
-                         ITERS // 3)
+    _, p99 = _time_flush(n_keys, lanes, "scale arm", WARMUP, ITERS)
     log(f"scale arm: {n_keys * lanes:,} digests/interval "
         f"p99={p99:.3f}ms (10x the north-star cardinality)")
     return p99

@@ -63,18 +63,23 @@ class MetricAggregator:
         self.lock = threading.Lock()
         self.mesh = mesh
         # pre-size for expected cardinality (arena growth copies device
-        # tensors); rounded up to a power of two
-        cap = arena_mod._INITIAL_CAPACITY
-        if initial_capacity > cap:
+        # tensors); rounded up to a power of two.  SetArena's per-row cost
+        # is 2^precision register BYTES (16 KiB at p=14, vs 8 B for a
+        # counter), so its pre-size is capped — sets grow on demand past
+        # it rather than pinning gigabytes for a digest-sized knob.
+        kw = {}
+        set_kw = {}
+        if initial_capacity > 0:
             cap = 1 << (initial_capacity - 1).bit_length()
+            kw = {"capacity": cap}
+            set_kw = {"capacity": min(cap, 8192)}
         self.digests = arena_mod.DigestArena(
-            capacity=cap, compression=compression, mesh=mesh,
-            n_lanes=ingest_lanes)
-        self.sets = arena_mod.SetArena(capacity=cap,
-                                       precision=set_precision)
-        self.counters = arena_mod.CounterArena(capacity=cap)
-        self.gauges = arena_mod.GaugeArena(capacity=cap)
-        self.status = arena_mod.StatusArena(capacity=cap)
+            compression=compression, mesh=mesh, n_lanes=ingest_lanes,
+            **kw)
+        self.sets = arena_mod.SetArena(precision=set_precision, **set_kw)
+        self.counters = arena_mod.CounterArena(**kw)
+        self.gauges = arena_mod.GaugeArena(**kw)
+        self.status = arena_mod.StatusArena(**kw)
         self.processed = 0
         self.imported = 0
         self.count_unique_timeseries = count_unique_timeseries
