@@ -723,3 +723,52 @@ def test_datadog_parallel_chunk_posts(http_capture):
     res = sink.flush([im(f"dd.par.{i}", float(i)) for i in range(55)])
     assert res.flushed == 55 and res.dropped == 0
     assert len(http_capture.captured) == 6  # ceil(55/10) bodies
+
+
+def test_splunk_concurrent_submitters():
+    """hec_submission_workers > 1 posts HEC batches concurrently
+    (splunk.go worker goroutines) with exact delivery."""
+    import time as time_mod
+    from http.server import ThreadingHTTPServer
+
+    from veneur_tpu.protocol import ssf_pb2
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+    class Slow(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            time_mod.sleep(0.1)
+            with self.server.lock:
+                self.server.bodies.append(body)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Slow)
+    srv.bodies = []
+    srv.lock = threading.Lock()
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        sink = SplunkSpanSink(sink_mod.SinkSpec(kind="splunk", config={
+            "hec_address": f"http://127.0.0.1:{srv.server_port}",
+            "hec_token": "t", "hec_batch_size": 10,
+            "hec_submission_workers": 8}))
+        for i in range(60):
+            sink.ingest(mkspan(trace_id=i, sid=i + 1))
+        t0 = time_mod.time()
+        sink.flush()
+        elapsed = time_mod.time() - t0
+        # 6 batches x 100ms serially = 600ms; concurrent must beat it
+        assert elapsed < 0.45, elapsed
+        total = sum(b.count(b'"trace_id"') for b in srv.bodies)
+        assert total == 60
+        sink.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()

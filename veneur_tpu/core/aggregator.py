@@ -69,7 +69,9 @@ class MetricAggregator:
         # it rather than pinning gigabytes for a digest-sized knob.
         kw = {}
         set_kw = {}
-        if initial_capacity > 0:
+        if initial_capacity > arena_mod._INITIAL_CAPACITY:
+            # enlarge-only: a small value never shrinks below the arena
+            # default (that would reintroduce the growth copies)
             cap = 1 << (initial_capacity - 1).bit_length()
             kw = {"capacity": cap}
             set_kw = {"capacity": min(cap, 8192)}

@@ -1111,4 +1111,10 @@ class Server:
                     sink.close()
                 except Exception:
                     logger.exception("sink close failed")
+        for sink in self.span_sinks:
+            if hasattr(sink, "close"):
+                try:
+                    sink.close()
+                except Exception:
+                    logger.exception("span sink close failed")
         self._flush_pool.shutdown(wait=False)

@@ -56,6 +56,73 @@ class SpanSink(Protocol):
     def flush(self) -> None: ...
 
 
+class ParallelPoster:
+    """Shared HTTP fan-out used by sinks with per-flush body chunks (the
+    reference's flushPart goroutines / hec submission workers): a
+    persistent pool whose workers each hold one long-lived
+    `requests.Session` (Session is not thread-safe), with a close() that
+    shuts the pool and sessions so process exit is never delayed by a
+    mid-retry worker.
+    """
+
+    def __init__(self, max_workers: int = 8,
+                 thread_name_prefix: str = "sink-post",
+                 injected_session=None):
+        import concurrent.futures
+        import threading
+
+        self._injected_session = injected_session
+        # eager: spawns no threads until first submit, and overlapping
+        # straggler flushes cannot race a lazy check-then-set
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix=thread_name_prefix)
+        self._tls = threading.local()
+        self._sessions: list = []
+        self._sessions_lock = threading.Lock()
+
+    def session(self):
+        """One long-lived session per calling thread; an injected test
+        session is honored."""
+        import requests
+
+        if self._injected_session is not None:
+            return self._injected_session
+        s = getattr(self._tls, "session", None)
+        if s is None:
+            s = requests.Session()
+            self._tls.session = s
+            with self._sessions_lock:
+                self._sessions.append(s)
+        return s
+
+    def map(self, fn: Callable, items: list) -> list:
+        """fn(item, session) over items; serial for one item, pooled
+        otherwise.  A close() racing a straggler flush yields a SHORT
+        result list (missing entries = not posted) instead of an escaping
+        CancelledError."""
+        import concurrent.futures as cf
+
+        if len(items) <= 1:
+            return [fn(item, self.session()) for item in items]
+        try:
+            return list(self._pool.map(
+                lambda item: fn(item, self.session()), items))
+        except (cf.CancelledError, RuntimeError):
+            # close() raced (cancelled futures) or preceded (submit after
+            # shutdown) this flush; unposted items are the caller's drops
+            return []
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._sessions_lock:
+            sessions, self._sessions = self._sessions, []
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
 class BaseMetricSink:
     """Convenience base with no-op hooks."""
 

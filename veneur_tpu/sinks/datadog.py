@@ -123,38 +123,18 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         self.validate_on_start = bool(cfg.get("validate_on_start", False))
         self._injected_session = session
         self.session = session or requests.Session()
-        # eager: spawns no threads until first submit, and overlapping
-        # straggler flushes cannot race a lazy check-then-set
-        import concurrent.futures
-        self._chunk_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="dd-flush")
-        self._tls = threading.local()
-        self._sessions: list[requests.Session] = []
-        self._sessions_lock = threading.Lock()
+        self._poster = sink_mod.ParallelPoster(
+            max_workers=8, thread_name_prefix="dd-flush",
+            injected_session=session)
 
     def _worker_session(self) -> requests.Session:
-        """One long-lived session per calling thread (requests.Session is
-        not thread-safe — overlapping straggler flushes must never share
-        one — and per-chunk sessions would leak sockets and pay a TLS
-        handshake per chunk).  An injected test session is honored."""
-        if self._injected_session is not None:
-            return self._injected_session
-        s = getattr(self._tls, "session", None)
-        if s is None:
-            s = requests.Session()
-            self._tls.session = s
-            with self._sessions_lock:
-                self._sessions.append(s)
-        return s
+        return self._poster.session()
 
     def close(self) -> None:
-        self._chunk_pool.shutdown(wait=False, cancel_futures=True)
-        with self._sessions_lock:
-            sessions, self._sessions = self._sessions, []
-        for s in sessions + ([] if self._injected_session else
-                             [self.session]):
+        self._poster.close()
+        if self._injected_session is None:
             try:
-                s.close()
+                self.session.close()
             except Exception:
                 pass
 
@@ -191,20 +171,9 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
             return _post_json(session, url, payload, headers=auth,
                               retries=self.flush_retries)
 
-        import concurrent.futures as cf
-        if len(chunks) == 1:
-            results = [post(chunks[0], self._worker_session())]
-        else:
-            # chunk posts run concurrently (flushPart goroutines,
-            # datadog.go:158-233) on the sink's persistent pool, each
-            # worker through its own long-lived session
-            try:
-                results = list(self._chunk_pool.map(
-                    lambda c: post(c, self._worker_session()), chunks))
-            except cf.CancelledError:
-                # close() raced a straggler flush: remaining chunks are
-                # dropped WITH accounting, not as an escaping exception
-                results = []
+        # chunk posts run concurrently (flushPart goroutines,
+        # datadog.go:158-233); short results = not-posted (drop-counted)
+        results = self._poster.map(post, chunks)
         results += [False] * (len(chunks) - len(results))
         flushed = sum(len(c) for c, ok in zip(chunks, results) if ok)
         dropped = len(metrics) - flushed

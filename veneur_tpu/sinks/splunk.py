@@ -65,12 +65,20 @@ class SplunkSpanSink(sink_mod.BaseSpanSink):
         self.sample_rate = max(int(cfg.get("span_sample_rate", 1)), 1)
         self.buffer_size = int(cfg.get("buffer_size", 16_384))
         self.batch_size = int(cfg.get("hec_batch_size", 100))
+        # concurrent HEC submitters (splunk.go hec_submission_workers)
+        self.submission_workers = max(
+            1, int(cfg.get("hec_submission_workers", 1)))
         self.hostname = getattr(server_config, "hostname", "") or ""
-        self.session = session or requests.Session()
+        self._poster = sink_mod.ParallelPoster(
+            max_workers=self.submission_workers,
+            thread_name_prefix="splunk-hec", injected_session=session)
         self._lock = threading.Lock()
         self._buffer: list = []
         self.sampled_out = 0
         self.dropped = 0
+
+    def close(self) -> None:
+        self._poster.close()
 
     def ingest(self, span) -> None:
         # error/indicator spans bypass sampling (splunk.go keep rules)
@@ -93,20 +101,30 @@ class SplunkSpanSink(sink_mod.BaseSpanSink):
         url = f"{self.hec_url}/services/collector/event"
         headers = {"Authorization": f"Splunk {self.token}"}
         t0 = time.perf_counter()
-        for i in range(0, len(spans), self.batch_size):
-            chunk = spans[i:i + self.batch_size]
+        chunks = [spans[i:i + self.batch_size]
+                  for i in range(0, len(spans), self.batch_size)]
+
+        def submit(chunk, session) -> None:
             # HEC wants newline-delimited JSON objects in one body
             body = "\n".join(
                 json.dumps(span_to_hec(s, self.hostname)) for s in chunk)
             try:
-                resp = self.session.post(url, data=body.encode(),
-                                         headers=headers, timeout=10.0,
-                                         verify=self.validate_tls)
+                resp = session.post(url, data=body.encode(),
+                                    headers=headers, timeout=10.0,
+                                    verify=self.validate_tls)
                 if resp.status_code >= 400:
                     logger.warning("splunk HEC -> %d: %.200s",
                                    resp.status_code, resp.text)
             except requests.RequestException as e:
                 logger.warning("splunk HEC submit failed: %s", e)
+
+        if self.submission_workers > 1:
+            # concurrent submitters (splunk.go's worker goroutines)
+            self._poster.map(submit, chunks)
+        else:
+            session = self._poster.session()
+            for chunk in chunks:
+                submit(chunk, session)
         logger.debug("splunk flushed %d spans in %.1fms", len(spans),
                      (time.perf_counter() - t0) * 1e3)
 
