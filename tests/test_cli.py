@@ -224,3 +224,36 @@ def test_example_configs_load():
     assert pcfg.static_destinations
     assert pcfg.discovery_interval == 10.0
     assert pcfg.grpc_tls_address and pcfg.ignore_tags
+
+
+def test_netaddr_parsing():
+    import pytest as _pytest
+
+    from veneur_tpu.util import netaddr
+
+    assert netaddr.split_hostport("127.0.0.1:8126") == ("127.0.0.1", 8126)
+    assert netaddr.split_hostport("[::1]:8126") == ("::1", 8126)
+    assert netaddr.split_hostport(":8126") == ("127.0.0.1", 8126)
+    assert netaddr.split_hostport("host", default_port=9) == ("host", 9)
+    with _pytest.raises(ValueError, match="bracketed"):
+        netaddr.split_hostport("::1")          # unbracketed v6: loud
+    with _pytest.raises(ValueError, match="bracketed"):
+        netaddr.split_hostport("2001:db8::1:8126")  # ambiguous: loud
+    with _pytest.raises(ValueError, match="missing port"):
+        netaddr.split_hostport("host")
+    import socket as s
+    assert netaddr.family("::1") == s.AF_INET6
+    assert netaddr.family("10.0.0.1") == s.AF_INET
+
+
+def test_emit_ipv6_destination():
+    sock = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+    sock.bind(("::1", 0))
+    sock.settimeout(3.0)
+    port = sock.getsockname()[1]
+    rc = cli_emit.main(["-hostport", f"udp://[::1]:{port}",
+                        "-name", "v6.e", "-count", "1"])
+    assert rc == 0
+    data, _ = sock.recvfrom(65536)
+    sock.close()
+    assert data == b"v6.e:1|c"

@@ -519,3 +519,21 @@ def test_grpc_ingest_half_tls_config_fails_loud(tmp_path):
     with pytest.raises(ValueError, match="both"):
         srv.start()
     srv.shutdown()
+
+
+def test_ipv6_udp_listener(fixture_server):
+    """udp://[::1]:0 binds an AF_INET6 listener and ingests normally
+    (the reference resolves either address family)."""
+    srv, sink = fixture_server(
+        statsd_listen_addresses=["udp://[::1]:0"])
+    kind, addr = srv.statsd_addrs[0]
+    s = socket.socket(socket.AF_INET6, socket.SOCK_DGRAM)
+    s.sendto(b"v6.c:6|c", (addr[0], addr[1]))
+    s.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and srv.aggregator.processed < 1:
+        time.sleep(0.05)
+        srv._drain_native()
+    srv.flush()
+    ms = drain_until(sink, lambda a: any(m.name == "v6.c" for m in a))
+    assert [m for m in ms if m.name == "v6.c"][0].value == 6.0

@@ -46,9 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _dest(hostport: str) -> tuple[str, int]:
+    from veneur_tpu.util import netaddr
     addr = hostport.split("://", 1)[-1]
-    host, _, port = addr.rpartition(":")
-    return host or "127.0.0.1", int(port)
+    return netaddr.split_hostport(addr)
 
 
 def statsd_lines(args) -> list[bytes]:
@@ -98,7 +98,8 @@ def emit_ssf(args, dest: tuple[str, int],
     if duration_ns:
         pb.end_timestamp = pb.start_timestamp + duration_ns
     pb.error = error
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    from veneur_tpu.util import netaddr
+    sock = socket.socket(netaddr.family(dest[0]), socket.SOCK_DGRAM)
     sock.sendto(pb.SerializeToString(), dest)
     sock.close()
 
@@ -136,7 +137,8 @@ def main(argv=None) -> int:
         print("nothing to emit (need -count/-gauge/-timing/-set/"
               "-event_title/-sc_name)", file=sys.stderr)
         return 1
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    from veneur_tpu.util import netaddr
+    sock = socket.socket(netaddr.family(dest[0]), socket.SOCK_DGRAM)
     sock.sendto(b"\n".join(lines), dest)
     sock.close()
     return rc

@@ -38,9 +38,9 @@ def main(argv=None) -> int:
                 "scrape_interval": args.interval,
                 "tags": args.added_tags}))
 
-    host, _, port = args.statsd.rpartition(":")
-    dest = (host or "127.0.0.1", int(port))
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    from veneur_tpu.util import netaddr
+    dest = netaddr.split_hostport(args.statsd)
+    sock = socket.socket(netaddr.family(dest[0]), socket.SOCK_DGRAM)
 
     class StatsdIngest:
         """Ingest shim that re-emits as DogStatsD lines."""

@@ -32,6 +32,7 @@ from veneur_tpu.core.aggregator import MetricAggregator
 from veneur_tpu.samplers import parser as parser_mod
 from veneur_tpu.samplers import samplers as sm
 from veneur_tpu.util import matcher as matcher_mod
+from veneur_tpu.util import netaddr
 from veneur_tpu.util import tagging
 
 logger = logging.getLogger("veneur_tpu.server")
@@ -46,8 +47,14 @@ def parse_listen_addr(addr: str) -> tuple[str, str]:
 
 
 def _split_hostport(rest: str) -> tuple[str, int]:
-    host, _, port = rest.rpartition(":")
-    return host or "127.0.0.1", int(port)
+    """host:port with RFC-3986 bracketed IPv6 support; unbracketed IPv6
+    literals fail loudly (util/netaddr.py, the reference's ResolveAddr
+    dialect)."""
+    return netaddr.split_hostport(rest)
+
+
+def _sock_family(host: str) -> int:
+    return netaddr.family(host)
 
 
 class _SpanSinkWorker:
@@ -447,7 +454,8 @@ class Server:
             host, port = _split_hostport(rest)
             first_sock = None
             for i in range(max(1, self.config.num_readers)):
-                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sock = socket.socket(_sock_family(host),
+                                     socket.SOCK_DGRAM)
                 # SO_REUSEPORT kernel load balancing (socket_linux.go:26-28)
                 if hasattr(socket, "SO_REUSEPORT"):
                     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
@@ -471,7 +479,7 @@ class Server:
             self.statsd_addrs.append(("udp", first_sock.getsockname()))
         elif scheme in ("tcp", "tcp+tls"):
             host, port = _split_hostport(rest)
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock = socket.socket(_sock_family(host), socket.SOCK_STREAM)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             sock.bind((host, port))
             sock.listen(128)
@@ -709,7 +717,7 @@ class Server:
         scheme, rest = parse_listen_addr(addr)
         if scheme == "udp":
             host, port = _split_hostport(rest)
-            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock = socket.socket(_sock_family(host), socket.SOCK_DGRAM)
             if hasattr(socket, "SO_REUSEPORT"):
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
@@ -730,7 +738,8 @@ class Server:
                 bound = rest
             else:
                 host, port = _split_hostport(rest)
-                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock = socket.socket(_sock_family(host),
+                                     socket.SOCK_STREAM)
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
                 sock.bind((host, port))
                 bound = sock.getsockname()

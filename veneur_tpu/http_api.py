@@ -148,9 +148,14 @@ def _jax_profile(server, seconds: float) -> dict:
 
 class HttpApi:
     def __init__(self, server, address: str):
-        host, _, port = address.rpartition(":")
-        self.httpd = http.server.ThreadingHTTPServer(
-            (host or "127.0.0.1", int(port)), make_handler(server))
+        from veneur_tpu.util import netaddr
+
+        host, port = netaddr.split_hostport(address)
+
+        class _Server(http.server.ThreadingHTTPServer):
+            address_family = netaddr.family(host)
+
+        self.httpd = _Server((host, port), make_handler(server))
         self.httpd.daemon_threads = True
         self.address = self.httpd.server_address
         self._thread: Optional[threading.Thread] = None

@@ -112,9 +112,13 @@ class Proxy:
                 raise OSError(
                     f"could not bind proxy TLS to {cfg.grpc_tls_address}")
 
-        host, _, port = cfg.http_address.rpartition(":")
-        self.httpd = http.server.ThreadingHTTPServer(
-            (host or "127.0.0.1", int(port)), self._http_handler())
+        from veneur_tpu.util import netaddr
+        hhost, hport = netaddr.split_hostport(cfg.http_address)
+
+        class _HttpServer(http.server.ThreadingHTTPServer):
+            address_family = netaddr.family(hhost)
+
+        self.httpd = _HttpServer((hhost, hport), self._http_handler())
         self.httpd.daemon_threads = True
         self.http_port = self.httpd.server_address[1]
         self._started = False

@@ -47,9 +47,10 @@ class ScopedClient:
                  scopes: Optional[MetricScopes] = None,
                  tags: Optional[list[str]] = None,
                  namespace: str = "veneur."):
-        host, _, port = address.rpartition(":")
-        self._dest = (host or "127.0.0.1", int(port or 8125))
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        from veneur_tpu.util import netaddr
+        self._dest = netaddr.split_hostport(address, default_port=8125)
+        self._sock = socket.socket(netaddr.family(self._dest[0]),
+                                   socket.SOCK_DGRAM)
         self.scopes = scopes or MetricScopes()
         self.tags = list(tags or [])
         # the reference namespaces ALL self-metrics

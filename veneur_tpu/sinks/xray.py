@@ -66,9 +66,9 @@ class XRaySpanSink(sink_mod.BaseSpanSink):
         spec = spec or sink_mod.SinkSpec(kind=self.KIND)
         super().__init__(spec.name, spec.config)
         cfg = self.config
+        from veneur_tpu.util import netaddr
         addr = cfg.get("address", "127.0.0.1:2000")
-        host, _, port = addr.rpartition(":")
-        self.daemon = (host or "127.0.0.1", int(port or 2000))
+        self.daemon = netaddr.split_hostport(addr, default_port=2000)
         self.sample_pct = float(cfg.get("sample_percentage", 100))
         self.annotation_tags = set(cfg.get("annotation_tags", []))
         self._sock: Optional[socket.socket] = None
@@ -76,7 +76,9 @@ class XRaySpanSink(sink_mod.BaseSpanSink):
         self.sent = 0
 
     def start(self, trace_client=None) -> None:
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        from veneur_tpu.util import netaddr
+        self._sock = socket.socket(netaddr.family(self.daemon[0]),
+                                   socket.SOCK_DGRAM)
 
     def ingest(self, span) -> None:
         if self.sample_pct < 100:

@@ -1,0 +1,37 @@
+"""host:port parsing shared by every listener/emitter.
+
+One parser for the dialect the reference's ResolveAddr accepts: IPv4
+`host:port`, bracketed IPv6 `[::1]:port` (RFC 3986 — an UNbracketed IPv6
+literal is rejected loudly rather than silently misparsed as
+host="2001:db8" port=...), and hostname:port.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+def split_hostport(rest: str, default_host: str = "127.0.0.1",
+                   default_port: int | None = None) -> tuple[str, int]:
+    """-> (host, port).  Raises ValueError on a missing port with no
+    default, a non-numeric port, or an unbracketed IPv6 literal."""
+    host, sep, port = rest.rpartition(":")
+    if not sep:
+        host, port = rest, ""
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    elif ":" in host:
+        raise ValueError(
+            f"IPv6 host in {rest!r} must be bracketed, e.g. [::1]:8126")
+    if not port:
+        if default_port is None:
+            raise ValueError(f"missing port in {rest!r}")
+        return host or default_host, default_port
+    if not port.lstrip("-").isdigit():
+        raise ValueError(f"invalid port in {rest!r}")
+    return host or default_host, int(port)
+
+
+def family(host: str) -> int:
+    """Socket family for a parsed (unbracketed) host."""
+    return socket.AF_INET6 if ":" in host else socket.AF_INET
