@@ -115,6 +115,10 @@ class Config:
     # the Python path if the engine cannot be built
     native_ingest: bool = True
     ingest_drain_interval: float = 0.0  # 0 = auto (min(interval/10, 0.5s))
+    # sync staged samples into device lanes on every drain tick instead
+    # of all at once during the flush snapshot (P7: pipelined flush vs
+    # ingest — spreads device work across the interval)
+    eager_device_sync: bool = True
     # intern-table GC threshold (distinct metric identities in the engine)
     intern_gc_threshold: int = 1_000_000
     num_span_workers: int = 1

@@ -181,6 +181,20 @@ class MetricAggregator:
             else:
                 raise ValueError(f"unknown metric kind {fm.kind!r}")
 
+    def sync_staged(self, min_samples: int = 256) -> bool:
+        """Push staged samples into device state NOW if the backlog is
+        worth a launch (P7 pipelining: the drain loop calls this each tick
+        so flush-time sync only covers the final partial tick; the
+        threshold keeps idle servers from paying a fixed-cost device wave
+        per trickle of samples)."""
+        with self.lock:
+            if (self.digests.staged_count()
+                    + self.sets.staged_count() < min_samples):
+                return False
+            self.digests.sync()
+            self.sets.sync()
+            return True
+
     # -- flush -------------------------------------------------------------
 
     def flush(self, is_local: bool, now: Optional[int] = None) -> FlushResult:

@@ -226,6 +226,10 @@ class SetArena(_ArenaBase):
         """Stage members already metro-hashed by the native ingest engine."""
         self._stage_chunks.append((rows, hashes))
 
+    def staged_count(self) -> int:
+        return (len(self._stage_rows)
+                + sum(len(r) for r, _ in self._stage_chunks))
+
     def merge(self, row: int, payload: bytes) -> None:
         other = hll_mod.unmarshal(payload)
         np.maximum(self.regs[row], other, out=self.regs[row])
@@ -374,6 +378,9 @@ class DigestArena(_ArenaBase):
         """Stage a columnar batch of locally-observed samples (the native
         ingest drain path)."""
         self._chunks.append((rows, vals, wts))
+
+    def staged_count(self) -> int:
+        return len(self._rows) + sum(len(r) for r, _, _ in self._chunks)
 
     def sync(self) -> None:
         """Scatter COO staging into dense waves and ingest on device."""
