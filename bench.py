@@ -223,6 +223,9 @@ def bench_ingest() -> float | None:
         statsd_listen_addresses=["udp://127.0.0.1:0"],
         interval=600.0,              # no flush during the run
         ingest_drain_interval=0.2,
+        # measure INGEST only: eager device sync would interleave tunnel
+        # launches with the packet path and skew the number
+        eager_device_sync=False,
         num_readers=min(4, max(2, (os.cpu_count() or 2) - 1)),
         read_buffer_size_bytes=8 << 20,
         hostname="bench")

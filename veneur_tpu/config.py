@@ -117,7 +117,9 @@ class Config:
     ingest_drain_interval: float = 0.0  # 0 = auto (min(interval/10, 0.5s))
     # sync staged samples into device lanes on every drain tick instead
     # of all at once during the flush snapshot (P7: pipelined flush vs
-    # ingest — spreads device work across the interval)
+    # ingest — spreads device work across the interval).  Rides the
+    # native drain loop, so it has no effect on the Python fallback
+    # ingest path (which stages at flush only).
     eager_device_sync: bool = True
     # intern-table GC threshold (distinct metric identities in the engine)
     intern_gc_threshold: int = 1_000_000

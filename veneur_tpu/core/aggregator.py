@@ -181,13 +181,18 @@ class MetricAggregator:
             else:
                 raise ValueError(f"unknown metric kind {fm.kind!r}")
 
-    def sync_staged(self, min_samples: int = 256) -> bool:
+    def sync_staged(self, min_samples: int = 0) -> bool:
         """Push staged samples into device state NOW if the backlog is
         worth a launch (P7 pipelining: the drain loop calls this each tick
         so flush-time sync only covers the final partial tick; the
         threshold keeps idle servers from paying a fixed-cost device wave
         per trickle of samples)."""
         with self.lock:
+            if min_samples <= 0:
+                # a sync's fixed cost scales with arena capacity (the
+                # dense scatter is capacity-wide), so the default
+                # threshold does too
+                min_samples = max(256, self.digests.capacity // 16)
             if (self.digests.staged_count()
                     + self.sets.staged_count() < min_samples):
                 return False

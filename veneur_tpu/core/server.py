@@ -440,14 +440,18 @@ class Server:
             try:
                 self._count_drained(self.native.drain_or_gc(
                     self.config.intern_gc_threshold))
-                if self.config.eager_device_sync:
-                    # P7 pipelining: push this tick's staged samples into
-                    # the device lanes NOW so flush-time sync only covers
-                    # the final partial tick, instead of the whole
-                    # interval's backlog arriving at the snapshot
-                    self.aggregator.sync_staged()
             except Exception:
                 logger.exception("native ingest drain failed")
+                continue
+            if self.config.eager_device_sync:
+                # P7 pipelining: push this tick's staged samples into
+                # the device lanes NOW so flush-time sync only covers
+                # the final partial tick, instead of the whole
+                # interval's backlog arriving at the snapshot
+                try:
+                    self.aggregator.sync_staged()
+                except Exception:
+                    logger.exception("eager device sync failed")
 
     def stop_serving(self) -> None:
         """Unblock serve() without tearing down (signal-handler safe:
