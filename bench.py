@@ -58,6 +58,10 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+ARM_TIME_BUDGET_S = 120.0    # per-arm iteration budget (a congested
+                             # device link must not stall the whole bench)
+
+
 def _time_flush(n_keys: int, n_lanes: int, label: str,
                 warmup: int, iters: int) -> tuple[float, float]:
     """Shared compile + warmup + timing loop for the device arms."""
@@ -77,10 +81,16 @@ def _time_flush(n_keys: int, n_lanes: int, label: str,
     for _ in range(warmup):
         jax.block_until_ready(fs.flush_step(inputs, percentiles))
     lat = []
+    deadline = time.perf_counter() + ARM_TIME_BUDGET_S
     for _ in range(iters):
         t0 = time.perf_counter()
         jax.block_until_ready(fs.flush_step(inputs, percentiles))
         lat.append((time.perf_counter() - t0) * 1e3)
+        if time.perf_counter() > deadline:
+            log(f"{label}: time budget hit after {len(lat)}/{iters} "
+                f"iters (device link likely congested); reporting from "
+                f"the completed samples")
+            break
     lat = np.asarray(lat)
     return float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
 
