@@ -25,7 +25,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from veneur_tpu.parallel import serving
 from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS

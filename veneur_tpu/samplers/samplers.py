@@ -11,7 +11,7 @@ types both sides exchange.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 # Metric type constants (samplers/samplers.go:50-60).

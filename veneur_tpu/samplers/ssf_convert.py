@@ -9,7 +9,6 @@ ConvertSpanUniquenessMetrics (sampled Set of span names per service).
 
 from __future__ import annotations
 
-from typing import Optional
 
 from veneur_tpu import ssf as ssf_mod
 from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric

@@ -10,7 +10,6 @@ indicator/objective SLI timers.
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 from veneur_tpu import sinks as sink_mod
 from veneur_tpu.samplers import ssf_convert

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import functools
 import struct
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

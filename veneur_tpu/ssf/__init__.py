@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import random
 import struct
-import time
 from typing import BinaryIO, Optional
 
 from veneur_tpu.protocol import ssf_pb2

@@ -18,7 +18,6 @@ unchanged; the pypi package itself is not required.
 from __future__ import annotations
 
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
