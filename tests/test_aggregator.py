@@ -425,11 +425,18 @@ def test_arena_initial_capacity_presizing():
     a = MetricAggregator(initial_capacity=5000)
     assert a.digests.capacity == 8192
     assert a.counters.capacity == 8192
-    # sets are register-heavy (16 KiB/row at p=14): pre-size is capped
     assert a.sets.capacity == 8192
+    # sets are register-heavy (16 KiB/lane/row at p=14): by default they
+    # follow arena_initial_capacity only up to 8192 rows, and their own
+    # knob overrides in either direction
     b = MetricAggregator(initial_capacity=20_000)
     assert b.digests.capacity == 2 ** 15
-    assert b.sets.capacity == 8192  # register-heavy family stays capped
+    assert b.sets.capacity == 8192
+    c = MetricAggregator(initial_capacity=20_000,
+                         set_initial_capacity=2048)
+    assert c.sets.capacity == 2048
+    d = MetricAggregator(set_initial_capacity=20_000)
+    assert d.sets.capacity == 2 ** 15
     a.process_metric(mk("c", "counter", 1))
     res = a.flush(is_local=False)
     assert by_name(res.metrics)["c"].value == 1.0

@@ -94,6 +94,11 @@ class Config:
     # doubling, but each growth copies device tensors — size for the
     # expected live cardinality up front on big deployments (0 = default)
     arena_initial_capacity: int = 0
+    # set (HLL) rows are register-heavy (2^set_precision bytes per lane =
+    # 16 KiB at p=14): size the set arena for its OWN expected cardinality.
+    # 0 = follow arena_initial_capacity up to 8192 rows (128 MiB/lane);
+    # sets grow on demand past the pre-size either way
+    set_arena_initial_capacity: int = 0
     count_unique_timeseries: bool = False
     # device mesh for the sharded serving flush (veneur_tpu/parallel/):
     # 0 devices = single-device lanes; replicas 0 = auto (2 when even)

@@ -28,10 +28,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.core import arena as arena_mod
+from veneur_tpu.parallel import serving
 from veneur_tpu.samplers import samplers as sm
 from veneur_tpu.samplers.metric_key import MetricKey, MetricScope, UDPMetric
 from veneur_tpu.sketches import hll as hll_mod
@@ -57,16 +59,20 @@ class MetricAggregator:
                  set_precision: int = hll_mod.DEFAULT_PRECISION,
                  count_unique_timeseries: bool = False,
                  mesh=None, ingest_lanes: Optional[int] = None,
-                 is_local: bool = True, initial_capacity: int = 0):
+                 is_local: bool = True, initial_capacity: int = 0,
+                 set_initial_capacity: int = 0):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
         self.mesh = mesh
         # pre-size for expected cardinality (arena growth copies device
         # tensors); rounded up to a power of two.  SetArena's per-row cost
-        # is 2^precision register BYTES (16 KiB at p=14, vs 8 B for a
-        # counter), so its pre-size is capped — sets grow on demand past
-        # it rather than pinning gigabytes for a digest-sized knob.
+        # is R_s * 2^precision register BYTES (16 KiB/lane at p=14, vs
+        # 8 B for a counter), so it has its own knob
+        # (set_arena_initial_capacity) for fleets with genuinely large set
+        # cardinality; by default it follows initial_capacity only up to
+        # 8192 rows (128 MiB/lane) so a digest-sized knob cannot silently
+        # pin gigabytes of device registers — sets grow on demand past it.
         kw = {}
         set_kw = {}
         if initial_capacity > arena_mod._INITIAL_CAPACITY:
@@ -75,11 +81,15 @@ class MetricAggregator:
             cap = 1 << (initial_capacity - 1).bit_length()
             kw = {"capacity": cap}
             set_kw = {"capacity": min(cap, 8192)}
+        if set_initial_capacity > arena_mod._INITIAL_CAPACITY:
+            set_kw = {"capacity":
+                      1 << (set_initial_capacity - 1).bit_length()}
         self.digests = arena_mod.DigestArena(
             compression=compression, mesh=mesh, n_lanes=ingest_lanes,
             **kw)
-        self.sets = arena_mod.SetArena(precision=set_precision, **set_kw)
-        self.counters = arena_mod.CounterArena(**kw)
+        self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
+                                       **set_kw)
+        self.counters = arena_mod.CounterArena(mesh=mesh, **kw)
         self.gauges = arena_mod.GaugeArena(**kw)
         self.status = arena_mod.StatusArena(**kw)
         self.processed = 0
@@ -87,6 +97,15 @@ class MetricAggregator:
         self.count_unique_timeseries = count_unique_timeseries
         self.unique_ts = hll_mod.HLLSketch() if count_unique_timeseries else None
         self.is_local = is_local
+        # ONE SPMD program evaluates every family at flush (digest lane
+        # gather+compress+quantiles, HLL pmax+estimate, counter psum,
+        # unique-timeseries estimate) — the production path and the
+        # benchmark flush_step share this math (parallel/serving.py).
+        self.flush_fn = serving.make_family_flush(mesh, compression)
+        self._uts_m = self.unique_ts.m if self.unique_ts is not None \
+            else 1 << hll_mod.DEFAULT_PRECISION
+        self._pct_arr = jnp.asarray([0.5] + list(self.percentiles),
+                                    jnp.float32)
 
     # -- ingest (ProcessMetric, worker.go:348-396) -------------------------
 
@@ -209,14 +228,33 @@ class MetricAggregator:
         with self.lock:
             snap = self._snapshot_and_reset()
             res.processed, res.imported = snap.pop("counts")
-        if "unique_ts" in snap:
-            res.unique_ts = snap["unique_ts"].estimate()
 
-        self._emit_counters(res, snap, is_local, now)
+        # ONE SPMD program call evaluates every family: digest lane reduce
+        # (replica-axis all_gather when meshed) -> batched compress ->
+        # quantiles, plus HLL pmax+estimate, counter psum, unique-ts
+        # estimate.  This IS the serving path of the north-star flush
+        # (flusher.go:26-122 + worker.go:402-459 as one device program);
+        # it runs on the snapshot outside the lock so ingest continues.
+        # Idle fast path: an interval that touched nothing skips the
+        # device dispatch entirely (every emitter would no-op anyway).
+        idle = (len(snap["digests"]["rows"]) == 0
+                and len(snap["sets"]["rows"]) == 0
+                and len(snap["counters"]["rows"]) == 0
+                and not snap["have_uts"])
+        out = None
+        if not idle:
+            out = self.flush_fn(
+                *snap["digests"]["lanes"], self._pct_arr,
+                snap["sets"]["lanes"], snap["counter_planes"](),
+                snap["uts_regs"])
+        if snap.pop("have_uts"):
+            res.unique_ts = int(out.unique_ts)
+
+        self._emit_counters(res, snap, out, is_local, now)
         self._emit_gauges(res, snap, is_local, now)
         self._emit_status(res, snap, now)
-        self._emit_sets(res, snap, is_local, now)
-        self._emit_digests(res, snap, is_local, now)
+        self._emit_sets(res, snap, out, is_local, now)
+        self._emit_digests(res, snap, out, is_local, now)
         return res
 
     def _snapshot_and_reset(self) -> dict:
@@ -230,11 +268,17 @@ class MetricAggregator:
         snap = {"counts": (self.processed, self.imported)}
         self.processed = 0
         self.imported = 0
+        snap["have_uts"] = self.unique_ts is not None
         if self.unique_ts is not None:
-            snap["unique_ts"] = self.unique_ts
-            self.unique_ts = hll_mod.HLLSketch()
+            uts = self.unique_ts.regs
+            self.unique_ts = hll_mod.HLLSketch(self.unique_ts.p)
+        else:
+            uts = np.zeros(self._uts_m, np.uint8)
+        snap["uts_regs"] = serving.put(
+            uts, None if self.mesh is None else
+            jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()))
 
-        for name, ar in (("counters", c), ("gauges", g), ("status", st)):
+        for name, ar in (("gauges", g), ("status", st)):
             rows = ar.touched_rows()
             snap[name] = {
                 "rows": rows,
@@ -248,11 +292,19 @@ class MetricAggregator:
             int(r): st.hostnames.get(int(r), "")
             for r in snap["status"]["rows"]}
 
+        crows = c.touched_rows()
+        snap["counters"] = {
+            "rows": crows,
+            "meta": [c.meta[r] for r in crows],
+        }
+        cvals = c.snapshot_values()
+        snap["counter_planes"] = lambda: c.planes_from(cvals)
+
         srows = s.touched_rows()
         snap["sets"] = {
             "rows": srows,
             "meta": [s.meta[r] for r in srows],
-            "regs": s.regs[srows].copy(),
+            "lanes": s.snapshot_lanes(),
         }
 
         drows = d.touched_rows()
@@ -261,7 +313,6 @@ class MetricAggregator:
             "meta": [d.meta[r] for r in drows],
             # immutable device refs + scalar uploads for the SPMD flush
             "lanes": d.snapshot_lanes(),
-            "flush_fn": d.flush_fn,
             "l_weight": d.l_weight[drows].copy(),
             "l_min": d.l_min[drows].copy(),
             "l_max": d.l_max[drows].copy(),
@@ -272,7 +323,7 @@ class MetricAggregator:
             "d_rsum": d.d_rsum[drows].copy(),
         }
 
-        for ar, rows in ((c, snap["counters"]["rows"]),
+        for ar, rows in ((c, crows),
                          (g, snap["gauges"]["rows"]),
                          (st, snap["status"]["rows"]),
                          (s, srows), (d, drows)):
@@ -282,10 +333,17 @@ class MetricAggregator:
 
     # -- emitters ----------------------------------------------------------
 
-    def _emit_counters(self, res, snap, is_local, now):
+    def _emit_counters(self, res, snap, out, is_local, now):
         part = snap["counters"]
-        for row, meta, val in zip(part["rows"], part["meta"],
-                                  part["values"]):
+        rows = part["rows"]
+        if len(rows) == 0:
+            return
+        # device psum'd hi/lo planes -> exact totals (< 2^48) on host
+        rows_dev = jnp.asarray(rows)
+        hi = np.asarray(out.counter_hi[rows_dev]).astype(np.float64)
+        lo = np.asarray(out.counter_lo[rows_dev]).astype(np.float64)
+        vals = hi * serving.COUNTER_SPLIT + lo
+        for meta, val in zip(part["meta"], vals):
             if meta.scope == MetricScope.GLOBAL_ONLY:
                 if is_local:
                     res.forward.append(sm.ForwardMetric(
@@ -324,35 +382,38 @@ class MetricAggregator:
                 message=part["messages"][int(row)],
                 hostname=part["hostnames"][int(row)]))
 
-    def _emit_sets(self, res, snap, is_local, now):
+    def _emit_sets(self, res, snap, out, is_local, now):
         part = snap["sets"]
-        if len(part["rows"]) == 0:
+        rows = part["rows"]
+        if len(rows) == 0:
             return
-        ests = np.asarray(hll_mod.estimate(jnp.asarray(part["regs"])))
-        for i, (row, meta) in enumerate(zip(part["rows"], part["meta"])):
+        rows_dev = jnp.asarray(rows)
+        ests = np.asarray(out.set_estimates[rows_dev])
+        regs = None
+        if is_local and any(m.scope == MetricScope.MIXED
+                            for m in part["meta"]):
+            # forwarding needs the merged registers on host; gather the
+            # touched rows ON DEVICE so the transfer is [n, m], not the
+            # whole lane tensor
+            regs = np.asarray(out.set_regs[rows_dev])
+        for i, meta in enumerate(part["meta"]):
             if meta.scope == MetricScope.MIXED:
                 if is_local:
                     res.forward.append(sm.ForwardMetric(
                         name=meta.key.name, tags=meta.tags,
                         kind=sm.TYPE_SET, scope=MetricScope.MIXED,
-                        hll=hll_mod.marshal(part["regs"][i])))
+                        hll=hll_mod.marshal(regs[i])))
                     continue
             res.metrics.append(sm.InterMetric(
                 name=meta.key.name, timestamp=now, value=float(ests[i]),
                 tags=meta.tags, type=sm.GAUGE))
 
-    def _emit_digests(self, res, snap, is_local, now):
+    def _emit_digests(self, res, snap, out, is_local, now):
         part = snap["digests"]
         rows = part["rows"]
         if len(rows) == 0:
             return
-        # One SPMD program call evaluates every key: lane reduce (replica-
-        # axis all_gather when meshed) -> batched compress -> quantiles.
-        # This IS the serving path of the north-star flush (flusher.go:26-122
-        # + worker.go:402-459 as one device program).
         pl = list(self.percentiles)
-        out = part["flush_fn"](
-            *part["lanes"], jnp.asarray([0.5] + pl, jnp.float32))
         # everything the per-row loop reads becomes plain Python floats up
         # front: at 100k keys the loop is the host-side flush bottleneck,
         # and numpy scalar indexing/conversions cost ~1us each inside it

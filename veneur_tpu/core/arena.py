@@ -82,6 +82,44 @@ class _ArenaBase:
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self.lock = threading.Lock()
 
+    def _init_mesh_lanes(self, mesh, family: str) -> int:
+        """Shared mesh plumbing for device-resident arenas: validate the
+        key-shard divisibility, record the lane sharding, and return the
+        replica count (= lane count for families whose lanes exist only to
+        feed the replica axis)."""
+        self.mesh = mesh
+        if mesh is not None:
+            from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+            if self.capacity % mesh.shape[SHARD_AXIS]:
+                raise ValueError(
+                    f"{family} arena capacity {self.capacity} not "
+                    f"divisible by {mesh.shape[SHARD_AXIS]} key shards")
+            n_replicas = mesh.shape[REPLICA_AXIS]
+        else:
+            n_replicas = 1
+        self._lane_shd = serving.lane_sharding(mesh)
+        return n_replicas
+
+    @staticmethod
+    def _pad_pow2(n: int) -> int:
+        return 1 << (n - 1).bit_length() if n > 1 else 1
+
+    def _reset_index(self, rows: np.ndarray) -> np.ndarray:
+        """Padded row-index vector for the device reset kernels.  Empty
+        `rows` yields [0]: zeroing row 0 is a no-op THEN (an interval that
+        touched no rows left every row zeroed by its own flush), but the
+        kernel still returns a FRESH buffer — required so the flush
+        snapshot never aliases the live buffer a later donating ingest
+        kernel would delete."""
+        n = len(rows)
+        if n == 0:
+            return np.zeros(1, np.int64)
+        padded = self._pad_pow2(n)
+        idx = np.empty(padded, np.int64)
+        idx[:n] = rows
+        idx[n:] = rows[0]
+        return idx
+
     def _grow(self) -> None:
         old = self.capacity
         self.capacity = old * 2
@@ -129,24 +167,52 @@ class _ArenaBase:
 
 class CounterArena(_ArenaBase):
     """int64 accumulators (samplers/samplers.go:97-150); mixed and
-    global-only counters share the arena, separated by row scope."""
+    global-only counters share the arena, separated by row scope.
 
-    def __init__(self, capacity: int = _INITIAL_CAPACITY):
+    Values accumulate host-side in float64 (integer-exact below 2^53) as
+    `[R_c, capacity]` lane stripes, lane = row % R_c.  At flush the lanes
+    upload as (hi, lo) float32 planes (value = hi * 2^24 + lo, each plane
+    exact below 2^24 so the device total is exact below 2^48) and the
+    family flush program reduces them with `lax.psum` over the mesh replica
+    axis — the device-collective form of Counter.Merge
+    (`samplers/samplers.go:143-145` / `worker.go:402-459`)."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY, mesh=None):
         super().__init__(capacity)
-        self.values = np.zeros(capacity, np.float64)
+        self.n_lanes = self._init_mesh_lanes(mesh, "counter")
+        self.values = np.zeros((self.n_lanes, capacity), np.float64)
 
     def _grow_state(self, old: int) -> None:
-        self.values = np.concatenate([self.values, np.zeros(old, np.float64)])
+        self.values = np.concatenate(
+            [self.values, np.zeros((self.n_lanes, old), np.float64)], axis=1)
 
     def sample(self, row: int, value: float, sample_rate: float) -> None:
         # Sample divides by rate at ingest (samplers.go:109-111)
-        self.values[row] += int(value / sample_rate)
+        self.values[row % self.n_lanes, row] += int(value / sample_rate)
+
+    def sample_batch(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        """Columnar pre-divided counter increments (native drain path)."""
+        np.add.at(self.values, (rows % self.n_lanes, rows), vals)
 
     def merge(self, row: int, value: int) -> None:
-        self.values[row] += value
+        self.values[row % self.n_lanes, row] += value
+
+    def snapshot_values(self) -> np.ndarray:
+        """Cheap host copy of the lane stripes (call under the aggregator
+        lock, before reset zeroes them in place)."""
+        return self.values.copy()
+
+    def planes_from(self, vals: np.ndarray):
+        """Device-put the (hi, lo) split of snapshotted lane stripes as
+        `[R_c, capacity, 2]` f32 for the family flush program (runs
+        outside the lock; the split + transfer are the expensive part)."""
+        hi = np.floor(vals / serving.COUNTER_SPLIT)
+        lo = vals - hi * serving.COUNTER_SPLIT
+        planes = np.stack([hi, lo], axis=-1).astype(np.float32)
+        return serving.put(planes, self._lane_shd)
 
     def reset_rows(self, rows: np.ndarray) -> None:
-        self.values[rows] = 0
+        self.values[:, rows] = 0
 
 
 class GaugeArena(_ArenaBase):
@@ -196,27 +262,42 @@ class StatusArena(_ArenaBase):
 
 
 class SetArena(_ArenaBase):
-    """HLL register arenas [capacity, 2^p] (samplers/samplers.go:236-311).
+    """HLL register arenas as lane-striped device tensors `[R_s, S, 2^p]`
+    (samplers/samplers.go:236-311).
 
-    Registers stay in host numpy (the insert path is scatter-max, which is
-    host-friendly); the batched estimate runs on device at flush.
+    Ingest stages (row, metro-hash) pairs host-side; `sync()` splits them
+    into (register index, rank) and scatter-maxes one padded batch into a
+    round-robin lane on device.  Imported register rows (Set.Merge) union
+    host-side per row first, then scatter as full rows.  With a mesh the
+    state is sharded (rows over 'shard', lanes over 'replica') and the
+    family flush program reduces lanes with `lax.pmax` over ICI — the
+    production form of the global set union.  Estimation and forwarding
+    marshal read the flush program's merged registers, so host code never
+    touches the full register tensor on the flush path.
     """
 
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
-                 precision: int = hll_mod.DEFAULT_PRECISION):
+                 precision: int = hll_mod.DEFAULT_PRECISION, mesh=None):
         super().__init__(capacity)
         self.precision = precision
         self.m = 1 << precision
-        self.regs = np.zeros((capacity, self.m), np.uint8)
+        self.n_lanes = self._init_mesh_lanes(mesh, "set")
+        self.lanes_regs = serving.put(
+            np.zeros((self.n_lanes, capacity, self.m), np.uint8),
+            self._lane_shd)
+        self._seq = 0
         # staging: raw hashes per batch (vectorized split at sync)
         self._stage_rows: list[int] = []
         self._stage_hashes: list[int] = []
         # pre-hashed array staging from the native ingest engine
         self._stage_chunks: list[tuple[np.ndarray, np.ndarray]] = []
+        # imported register rows, unioned host-side until sync
+        self._merge_rows: dict[int, np.ndarray] = {}
 
     def _grow_state(self, old: int) -> None:
-        self.regs = np.concatenate(
-            [self.regs, np.zeros((old, self.m), np.uint8)])
+        nr = np.zeros((self.n_lanes, self.capacity, self.m), np.uint8)
+        nr[:, :old] = np.asarray(self.lanes_regs)
+        self.lanes_regs = serving.put(nr, self._lane_shd)
 
     def sample(self, row: int, member: str) -> None:
         self._stage_rows.append(row)
@@ -228,42 +309,76 @@ class SetArena(_ArenaBase):
 
     def staged_count(self) -> int:
         return (len(self._stage_rows)
-                + sum(len(r) for r, _ in self._stage_chunks))
+                + sum(len(r) for r, _ in self._stage_chunks)
+                + len(self._merge_rows))
 
     def merge(self, row: int, payload: bytes) -> None:
         other = hll_mod.unmarshal(payload)
-        np.maximum(self.regs[row], other, out=self.regs[row])
+        mine = self._merge_rows.get(row)
+        if mine is None:
+            self._merge_rows[row] = other.copy()
+        else:
+            np.maximum(mine, other, out=mine)
 
     def sync(self) -> None:
-        if not self._stage_rows and not self._stage_chunks:
-            return
-        parts_r: list[np.ndarray] = []
-        parts_h: list[np.ndarray] = []
-        if self._stage_rows:
-            parts_r.append(np.asarray(self._stage_rows, np.int64))
-            parts_h.append(np.asarray(self._stage_hashes, np.uint64))
-            self._stage_rows, self._stage_hashes = [], []
-        for r, h in self._stage_chunks:
-            parts_r.append(r.astype(np.int64, copy=False))
-            parts_h.append(h)
-        self._stage_chunks = []
-        rows = parts_r[0] if len(parts_r) == 1 else np.concatenate(parts_r)
-        hs = parts_h[0] if len(parts_h) == 1 else np.concatenate(parts_h)
-        idx, rank = hll_mod.split_hashes(hs, self.precision)
-        hll_mod.update_registers(self.regs, rows, idx, rank)
+        """Scatter staged inserts and imported rows into the device lanes.
+        Padding entries are all-zero ranks/registers, which max() ignores,
+        so the pow-of-two padding only buys jit-cache reuse."""
+        if self._stage_rows or self._stage_chunks:
+            parts_r: list[np.ndarray] = []
+            parts_h: list[np.ndarray] = []
+            if self._stage_rows:
+                parts_r.append(np.asarray(self._stage_rows, np.int64))
+                parts_h.append(np.asarray(self._stage_hashes, np.uint64))
+                self._stage_rows, self._stage_hashes = [], []
+            for r, h in self._stage_chunks:
+                parts_r.append(r.astype(np.int64, copy=False))
+                parts_h.append(h)
+            self._stage_chunks = []
+            rows = (parts_r[0] if len(parts_r) == 1
+                    else np.concatenate(parts_r))
+            hs = parts_h[0] if len(parts_h) == 1 else np.concatenate(parts_h)
+            idx, rank = hll_mod.split_hashes(hs, self.precision)
+            n = len(rows)
+            padded = self._pad_pow2(n)
+            pr = np.zeros(padded, np.int32)
+            pi = np.zeros(padded, np.int32)
+            pk = np.zeros(padded, np.uint8)
+            pr[:n] = rows
+            pi[:n] = idx
+            pk[:n] = rank
+            lane = self._seq % self.n_lanes
+            self._seq += 1
+            self.lanes_regs = serving.set_lane_scatter(
+                self.lanes_regs, jnp.asarray(pr), jnp.asarray(pi),
+                jnp.asarray(pk), lane)
+        if self._merge_rows:
+            items = sorted(self._merge_rows.items())
+            self._merge_rows = {}
+            n = len(items)
+            padded = self._pad_pow2(n)
+            pr = np.zeros(padded, np.int32)
+            mat = np.zeros((padded, self.m), np.uint8)
+            for i, (row, regs) in enumerate(items):
+                pr[i] = row
+                mat[i] = regs
+            lane = self._seq % self.n_lanes
+            self._seq += 1
+            self.lanes_regs = serving.set_lane_merge_rows(
+                self.lanes_regs, jnp.asarray(pr), jnp.asarray(mat), lane)
 
-    def estimates(self) -> np.ndarray:
-        """Batched device estimate for all rows; returns [capacity] f32."""
+    def snapshot_lanes(self) -> jnp.ndarray:
+        """Immutable ref to the current lane registers (sync first); the
+        family flush program pmax-merges and estimates them."""
         self.sync()
-        return np.asarray(hll_mod.estimate(jnp.asarray(self.regs)))
-
-    def marshal_row(self, row: int) -> bytes:
-        self.sync()
-        return hll_mod.marshal(self.regs[row])
+        return self.lanes_regs
 
     def reset_rows(self, rows: np.ndarray) -> None:
         self.sync()
-        self.regs[rows] = 0
+        # runs even for empty rows: the kernel swaps in a fresh buffer so
+        # the flush snapshot never aliases the live (donatable) one
+        self.lanes_regs = serving.set_reset_rows(
+            self.lanes_regs, jnp.asarray(self._reset_index(rows)))
 
 
 class DigestArena(_ArenaBase):
@@ -290,30 +405,18 @@ class DigestArena(_ArenaBase):
         super().__init__(capacity)
         self.compression = compression
         self.ccap = td.centroid_capacity(compression)
-        self.mesh = mesh
-        if mesh is not None:
-            from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
-            n_shards = mesh.shape[SHARD_AXIS]
-            n_replicas = mesh.shape[REPLICA_AXIS]
-            if capacity % n_shards:
-                raise ValueError(
-                    f"arena capacity {capacity} not divisible by "
-                    f"{n_shards} key shards")
-        else:
-            n_replicas = 1
+        n_replicas = self._init_mesh_lanes(mesh, "digest")
         # n_lanes None or <1 means auto (Config documents 0 as auto)
         r = n_lanes if n_lanes and n_lanes > 0 else max(2, 2 * n_replicas)
         # lanes must tile the replica axis evenly
         r = ((r + n_replicas - 1) // n_replicas) * n_replicas
         self.n_lanes = r
-        self._lane_shd = serving.lane_sharding(mesh)
         self._row_shd = serving.row_sharding(mesh)
         self._wave_shd = serving.row_sharding(mesh, ndim=2)
         self.lanes_mean = serving.put(
             np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
         self.lanes_weight = serving.put(
             np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
-        self.flush_fn = serving.make_flush(mesh, compression)
         self._wave_seq = 0
         # true digest scalars (local samples + imports)
         self.d_min = np.full(capacity, np.inf)
@@ -529,16 +632,13 @@ class DigestArena(_ArenaBase):
                 serving.put(self.d_max.astype(np.float32), self._row_shd))
 
     def reset_rows(self, rows: np.ndarray) -> None:
+        # runs even for empty rows: the kernel swaps in fresh buffers so
+        # the flush snapshot never aliases the live (donatable) ones
+        self.lanes_mean, self.lanes_weight = serving.reset_rows(
+            self.lanes_mean, self.lanes_weight,
+            jnp.asarray(self._reset_index(rows)))
         if len(rows) == 0:
             return
-        # pad to the next power of two (repeat of row 0) for jit-cache reuse
-        n = len(rows)
-        padded = 1 << (n - 1).bit_length() if n > 1 else 1
-        idx = np.empty(padded, np.int64)
-        idx[:n] = rows
-        idx[n:] = rows[0]
-        self.lanes_mean, self.lanes_weight = serving.reset_rows(
-            self.lanes_mean, self.lanes_weight, jnp.asarray(idx))
         self.d_min[rows] = np.inf
         self.d_max[rows] = -np.inf
         self.d_rsum[rows] = 0

@@ -176,7 +176,8 @@ class Server:
             mesh=self.mesh,
             ingest_lanes=cfg.ingest_lanes or None,
             is_local=cfg.is_local,
-            initial_capacity=cfg.arena_initial_capacity)
+            initial_capacity=cfg.arena_initial_capacity,
+            set_initial_capacity=cfg.set_arena_initial_capacity)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances

@@ -427,7 +427,7 @@ class NativeIngest:
                 agg.processed += batch.processed
                 if len(batch.c_ids):
                     rows = self._rows_for(agg.counters, batch.c_ids)
-                    np.add.at(agg.counters.values, rows, batch.c_vals)
+                    agg.counters.sample_batch(rows, batch.c_vals)
                 if len(batch.g_ids):
                     rows = self._rows_for(agg.gauges, batch.g_ids)
                     # in-order fancy assignment: last write wins

@@ -1,10 +1,11 @@
-"""The serving-path SPMD programs: lane ingest + the per-flush digest reduce.
+"""The serving-path SPMD programs: lane ingest + the per-flush family reduce.
 
 This wires the sharded flush (veneur_tpu/parallel/flush_step.py) into the
 *production* aggregation tier: `DigestArena` keeps its centroid state as
 lane-striped device tensors `[R, K, C]` (R ingest lanes x K keys x C
-centroid slots), sharded over a (shard, replica) `Mesh` when one is
-configured —
+centroid slots), `SetArena` keeps its HLL registers as `[R_s, S, m]`
+lane-striped uint8 tensors, both sharded over a (shard, replica) `Mesh`
+when one is configured —
 
   - the **shard** axis partitions the key space K (the device analog of the
     reference's fnv1a-hash worker sharding, `server.go:997-1011` /
@@ -15,22 +16,35 @@ configured —
     compress — the collective form of the gRPC ImportMetric merge loop
     (`worker.go:402-459`).
 
-Three programs:
+The programs:
 
   * `lane_ingest`   — fold one dense sample wave `[K, W]` into lane r of the
                       striped state (the device half of `DigestArena.sync`).
                       Striping waves across lanes both feeds the replica
                       axis and cuts the sequential kernel-launch depth for a
                       hot key by R (each lane's chain is independent).
-  * `make_flush`    — build the per-flush evaluation: gather lanes over the
-                      replica axis, merge into one digest per key, evaluate
-                      all percentiles/aggregates at once.  With `mesh=None`
-                      this is the same math under plain `jit` on the default
-                      device, so single-chip and multi-chip serving share
-                      one code path.
-  * `reset_rows`    — zero the touched rows across every lane after flush
-                      (the map-swap of `worker.go:462-481`; rows persist,
-                      state is interval-scoped).
+  * `set_lane_scatter` / `set_lane_merge_rows` — scatter-max staged HLL
+                      (row, register, rank) updates / imported register rows
+                      into lane r of the set state (Sketch.Insert / Merge,
+                      `samplers/samplers.go:242-244,299-311`).
+  * `make_family_flush` — build the per-flush evaluation for EVERY sampler
+                      family in one program: gather digest lanes over the
+                      replica axis and merge+evaluate percentiles, pmax the
+                      HLL set lanes and estimate cardinalities, psum the
+                      hi/lo counter planes, and estimate the
+                      unique-timeseries HLL (tallyTimeseries,
+                      `flusher.go:249-258`).  With `mesh=None` this is the
+                      same math under plain `jit` on the default device, so
+                      single-chip and multi-chip serving share one code
+                      path.
+  * `reset_rows` / `set_reset_rows` — zero the touched rows across every
+                      lane after flush (the map-swap of `worker.go:462-481`;
+                      rows persist, state is interval-scoped).
+
+Counters ride as two float32 planes (hi, lo) with value = hi * 2^24 + lo:
+each plane is integer-exact below 2^24, so the psum'd total is exact below
+2^48 without relying on x64 mode — int64 counter semantics
+(`samplers/samplers.go:97-150`) on an f32-native device.
 """
 
 from __future__ import annotations
@@ -43,7 +57,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+from veneur_tpu.sketches import hll as hll_mod
 from veneur_tpu.sketches import tdigest as td
+
+# counters travel as (hi, lo) f32 planes: value = hi * COUNTER_SPLIT + lo,
+# each plane integer-exact below 2^24 => totals exact below 2^48
+COUNTER_SPLIT = float(1 << 24)
 
 
 class ServingFlushOutputs(NamedTuple):
@@ -52,6 +71,20 @@ class ServingFlushOutputs(NamedTuple):
     quantiles: jax.Array  # [K, P]
     counts: jax.Array     # [K] total weight
     sums: jax.Array       # [K] weighted sum
+
+
+class FamilyFlushOutputs(NamedTuple):
+    """One production flush, every sampler family reduced on device."""
+    mean: jax.Array           # [K, C] merged centroids (forwarding export)
+    weight: jax.Array         # [K, C]
+    quantiles: jax.Array      # [K, P]
+    counts: jax.Array         # [K] total digest weight
+    sums: jax.Array           # [K] weighted sum
+    set_regs: jax.Array       # [S, m] uint8 merged HLL registers
+    set_estimates: jax.Array  # [S] f32 cardinality estimates
+    counter_hi: jax.Array     # [K2] f32 psum'd high counter plane
+    counter_lo: jax.Array     # [K2] f32 psum'd low counter plane
+    unique_ts: jax.Array      # [] f32 distinct-timeseries estimate
 
 
 # ---------------------------------------------------------------------------
@@ -124,6 +157,37 @@ def reset_rows(lanes_mean: jax.Array, lanes_weight: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Set (HLL) lane ingest
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("lane",), donate_argnums=(0,))
+def set_lane_scatter(lanes_regs: jax.Array, rows: jax.Array,
+                     idx: jax.Array, rank: jax.Array,
+                     lane: int) -> jax.Array:
+    """Scatter-max staged (set row, register index, rank) triples into lane
+    `lane` of the `[R_s, S, m]` register state — the device half of
+    Sketch.Insert (`samplers/samplers.go:242-244`).  Padding entries with
+    rank 0 are no-ops (max against an empty register)."""
+    return lanes_regs.at[lane, rows, idx].max(rank)
+
+
+@functools.partial(jax.jit, static_argnames=("lane",), donate_argnums=(0,))
+def set_lane_merge_rows(lanes_regs: jax.Array, rows: jax.Array,
+                        regmat: jax.Array, lane: int) -> jax.Array:
+    """Register-wise max of imported full register rows `[n, m]` into lane
+    `lane` (Set.Merge, `samplers/samplers.go:299-311`).  All-zero padding
+    rows are no-ops."""
+    return lanes_regs.at[lane, rows].max(regmat)
+
+
+@jax.jit
+def set_reset_rows(lanes_regs: jax.Array, rows: jax.Array) -> jax.Array:
+    """Zero the given set rows in every lane (NOT donating — see
+    reset_rows)."""
+    return lanes_regs.at[:, rows].set(0)
+
+
+# ---------------------------------------------------------------------------
 # Flush evaluation
 # ---------------------------------------------------------------------------
 
@@ -163,22 +227,44 @@ def reduce_eval(lanes_mean, lanes_weight, d_min, d_max, d_rsum,
         sums=td.sum_values(merged))
 
 
-def make_flush(mesh: Optional[Mesh],
-               compression: float = td.DEFAULT_COMPRESSION):
-    """Build the per-flush program.
+def make_family_flush(mesh: Optional[Mesh],
+                      compression: float = td.DEFAULT_COMPRESSION):
+    """Build the per-flush program covering every sampler family.
 
     Returns fn(lanes_mean [R,K,C], lanes_weight, d_min [K], d_max,
-    percentiles [P]) -> ServingFlushOutputs.  With a mesh, the function is a
-    shard_map'd SPMD program (keys sharded, lanes gathered over the replica
-    axis); without, the identical math under plain jit.  rsum stays
-    host-side (hmean is emitted from host scalars; no device computation
-    needs it).
+    percentiles [P], set_lanes [R_s,S,m] u8, counter_planes [R_c,K2,2] f32,
+    uts_regs [m_u] u8) -> FamilyFlushOutputs.  With a mesh, the function is
+    a shard_map'd SPMD program: keys/set rows/counter rows are sharded over
+    'shard'; digest lanes all_gather, set lanes pmax, and counter planes
+    psum over 'replica'; the unique-timeseries registers pmax over both
+    axes (they are replicated within a process, so in-process this is an
+    identity — across processes it is the DCN union of per-host tallies).
+    Without a mesh, the identical math runs under plain jit.  Digest rsum
+    stays host-side (hmean is emitted from host scalars; no device
+    computation needs it).
     """
     def body_for(axis):
-        def body(lanes_mean, lanes_weight, d_min, d_max, percentiles):
-            return reduce_eval(lanes_mean, lanes_weight, d_min, d_max,
-                               jnp.zeros_like(d_min), percentiles,
-                               compression, axis)
+        def body(lanes_mean, lanes_weight, d_min, d_max, percentiles,
+                 set_lanes, counter_planes, uts_regs):
+            dig = reduce_eval(lanes_mean, lanes_weight, d_min, d_max,
+                              jnp.zeros_like(d_min), percentiles,
+                              compression, axis)
+            set_regs = jnp.max(set_lanes, axis=0)
+            chi = jnp.sum(counter_planes[..., 0], axis=0)
+            clo = jnp.sum(counter_planes[..., 1], axis=0)
+            uts = uts_regs
+            if axis is not None:
+                set_regs = jax.lax.pmax(set_regs, axis)
+                chi = jax.lax.psum(chi, axis)
+                clo = jax.lax.psum(clo, axis)
+                uts = jax.lax.pmax(jax.lax.pmax(uts, axis), SHARD_AXIS)
+            return FamilyFlushOutputs(
+                mean=dig.mean, weight=dig.weight, quantiles=dig.quantiles,
+                counts=dig.counts, sums=dig.sums,
+                set_regs=set_regs,
+                set_estimates=hll_mod.estimate(set_regs),
+                counter_hi=chi, counter_lo=clo,
+                unique_ts=hll_mod.estimate(uts[None, :])[0])
         return body
 
     if mesh is None:
@@ -189,9 +275,13 @@ def make_flush(mesh: Optional[Mesh],
     spec_kc = P(SHARD_AXIS, None)
     fn = jax.shard_map(
         body_for(REPLICA_AXIS), mesh=mesh,
-        in_specs=(spec_lanes, spec_lanes, spec_k, spec_k, P(None)),
-        out_specs=ServingFlushOutputs(
+        in_specs=(spec_lanes, spec_lanes, spec_k, spec_k, P(None),
+                  spec_lanes, spec_lanes, P(None)),
+        out_specs=FamilyFlushOutputs(
             mean=spec_kc, weight=spec_kc, quantiles=spec_kc,
-            counts=spec_k, sums=spec_k),
+            counts=spec_k, sums=spec_k,
+            set_regs=spec_kc, set_estimates=spec_k,
+            counter_hi=spec_k, counter_lo=spec_k,
+            unique_ts=P()),
         check_vma=False)
     return jax.jit(fn)
