@@ -42,7 +42,7 @@ from veneur_tpu.sketches import tdigest as td
 
 @dataclass
 class FlushResult:
-    metrics: list[sm.InterMetric] = field(default_factory=list)
+    metrics: sm.MetricBatch = field(default_factory=sm.MetricBatch)
     forward: list[sm.ForwardMetric] = field(default_factory=list)
     processed: int = 0
     imported: int = 0
@@ -97,6 +97,7 @@ class MetricAggregator:
         self.count_unique_timeseries = count_unique_timeseries
         self.unique_ts = hll_mod.HLLSketch() if count_unique_timeseries else None
         self.is_local = is_local
+        self._uts_zero = None  # cached zero uts registers (mesh-less)
         # ONE SPMD program evaluates every family at flush (digest lane
         # gather+compress+quantiles, HLL pmax+estimate, counter psum,
         # unique-timeseries estimate) — the production path and the
@@ -235,27 +236,94 @@ class MetricAggregator:
         # estimate.  This IS the serving path of the north-star flush
         # (flusher.go:26-122 + worker.go:402-459 as one device program);
         # it runs on the snapshot outside the lock so ingest continues.
-        # Idle fast path: an interval that touched nothing skips the
-        # device dispatch entirely (every emitter would no-op anyway).
+        # Idle fast path: skip the device dispatch when every touched
+        # family resolves on host (counters and unique-ts do, mesh-less).
         idle = (len(snap["digests"]["rows"]) == 0
                 and len(snap["sets"]["rows"]) == 0
-                and len(snap["counters"]["rows"]) == 0
-                and not snap["have_uts"])
-        out = None
+                and (len(snap["counters"]["rows"]) == 0
+                     or snap["counters"]["host_totals"] is not None)
+                and (not snap["have_uts"]
+                     or snap["uts_host"] is not None))
+        host = None
         if not idle:
             out = self.flush_fn(
                 *snap["digests"]["lanes"], self._pct_arr,
                 snap["sets"]["lanes"], snap["counter_planes"](),
                 snap["uts_regs"])
+            host = self._fetch_outputs(out, snap, is_local)
         if snap.pop("have_uts"):
-            res.unique_ts = int(out.unique_ts)
+            res.unique_ts = int(snap["uts_host"]
+                                if snap["uts_host"] is not None
+                                else host["unique_ts"])
 
-        self._emit_counters(res, snap, out, is_local, now)
+        self._emit_counters(res, snap, host, is_local, now)
         self._emit_gauges(res, snap, is_local, now)
         self._emit_status(res, snap, now)
-        self._emit_sets(res, snap, out, is_local, now)
-        self._emit_digests(res, snap, out, is_local, now)
+        self._emit_sets(res, snap, host, is_local, now)
+        self._emit_digests(res, snap, host, is_local, now)
         return res
+
+    @staticmethod
+    def _padded_rows(rows) -> np.ndarray:
+        """Pad a touched-row index array to a power of two (row 0
+        repeated) so the packed-readback jit cache stays bounded; the
+        padding lanes are sliced off after unpack."""
+        a = np.zeros(arena_mod._pow2(len(rows)), np.int32)
+        a[:len(rows)] = rows
+        return a
+
+    def _fetch_outputs(self, out, snap: dict, is_local: bool) -> dict:
+        """ONE packed device->host transfer for everything the emitters
+        need (plus one more per forwarding family when rows forward).
+        Eager per-family gathers would each pay a device round-trip and a
+        tiled-layout transfer — over a remote device link those dominate
+        the entire flush, and even over PCIe the batched linear read wins."""
+        dpart, cpart, spart = snap["digests"], snap["counters"], snap["sets"]
+        nd, nc, ns = len(dpart["rows"]), len(cpart["rows"]), len(spart["rows"])
+        pd = self._padded_rows(dpart["rows"])
+        # counter values resolved on host (no mesh): skip their readback
+        host_counters = cpart["host_totals"] is not None
+        pc = self._padded_rows([] if host_counters else cpart["rows"])
+        ps = self._padded_rows(spart["rows"])
+        flat = np.asarray(serving.flush_pack(
+            out.quantiles, out.counts, out.sums, out.counter_hi,
+            out.counter_lo, out.set_estimates, out.unique_ts,
+            jnp.asarray(pd), jnp.asarray(pc), jnp.asarray(ps)))
+        n_pct = out.quantiles.shape[1]
+        dp, cp, sp = len(pd), len(pc), len(ps)
+        o = 0
+        host = {}
+        host["qs"] = flat[o:o + dp * n_pct].reshape(dp, n_pct)[:nd]
+        o += dp * n_pct
+        host["counts"] = flat[o:o + dp][:nd].astype(np.float64)
+        o += dp
+        host["sums"] = flat[o:o + dp][:nd].astype(np.float64)
+        o += dp
+        if host_counters:
+            host["c_hi"] = host["c_lo"] = None
+            o += 2 * cp
+        else:
+            host["c_hi"] = flat[o:o + cp][:nc].astype(np.float64)
+            o += cp
+            host["c_lo"] = flat[o:o + cp][:nc].astype(np.float64)
+            o += cp
+        host["set_ests"] = flat[o:o + sp][:ns]
+        o += sp
+        host["unique_ts"] = flat[o]
+        if is_local:
+            if nd and any(m.scope != MetricScope.LOCAL_ONLY
+                          for m in dpart["meta"]):
+                fl = np.asarray(serving.forward_pack(
+                    out.mean, out.weight, jnp.asarray(pd)))
+                c_cap = out.mean.shape[1]
+                host["fwd_mean"] = fl[:dp * c_cap].reshape(dp, c_cap)[:nd]
+                host["fwd_weight"] = fl[dp * c_cap:].reshape(dp, c_cap)[:nd]
+            if ns and any(m.scope == MetricScope.MIXED
+                          for m in spart["meta"]):
+                regs = np.asarray(serving.set_regs_pack(
+                    out.set_regs, jnp.asarray(ps)))
+                host["set_regs"] = regs.reshape(sp, -1)[:ns]
+        return host
 
     def _snapshot_and_reset(self) -> dict:
         """Under lock: sync staging, snapshot state+metadata of touched
@@ -273,10 +341,23 @@ class MetricAggregator:
             uts = self.unique_ts.regs
             self.unique_ts = hll_mod.HLLSketch(self.unique_ts.p)
         else:
-            uts = np.zeros(self._uts_m, np.uint8)
-        snap["uts_regs"] = serving.put(
-            uts, None if self.mesh is None else
-            jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()))
+            uts = None
+        if self.mesh is None:
+            # nothing to pmax over without a mesh: estimate on host and
+            # hand the program a cached zero register vector (no upload)
+            snap["uts_host"] = (hll_mod.estimate_np(uts)
+                                if uts is not None else None)
+            if self._uts_zero is None:
+                self._uts_zero = serving.put(
+                    np.zeros(self._uts_m, np.uint8), None)
+            snap["uts_regs"] = self._uts_zero
+        else:
+            snap["uts_host"] = None
+            if uts is None:
+                uts = np.zeros(self._uts_m, np.uint8)
+            snap["uts_regs"] = serving.put(
+                uts, jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()))
 
         for name, ar in (("gauges", g), ("status", st)):
             rows = ar.touched_rows()
@@ -297,7 +378,14 @@ class MetricAggregator:
             "rows": crows,
             "meta": [c.meta[r] for r in crows],
         }
-        cvals = c.snapshot_values()
+        if self.mesh is None:
+            # no mesh => no psum; total the float64 host stripes directly
+            # (exact below 2^53, and no plane upload at all)
+            snap["counters"]["host_totals"] = c.values.sum(axis=0)[crows]
+            cvals = None
+        else:
+            snap["counters"]["host_totals"] = None
+            cvals = c.snapshot_values()
         snap["counter_planes"] = lambda: c.planes_from(cvals)
 
         srows = s.touched_rows()
@@ -333,44 +421,52 @@ class MetricAggregator:
 
     # -- emitters ----------------------------------------------------------
 
-    def _emit_counters(self, res, snap, out, is_local, now):
+    @staticmethod
+    def _scalar_family(res, meta, vals, is_local, now, mtype, fwd):
+        """Shared counter/gauge emission: forward global-only rows when
+        local, columnar-emit the rest as one segment."""
+        n = len(meta)
+        bases = [m.key.name for m in meta]
+        tags = [m.tags for m in meta]
+        if is_local:
+            glob = np.fromiter(
+                (m.scope == MetricScope.GLOBAL_ONLY for m in meta),
+                bool, n)
+            if glob.any():
+                for i in np.nonzero(glob)[0].tolist():
+                    res.forward.append(fwd(meta[i], vals[i]))
+                sel = np.nonzero(~glob)[0]
+                res.metrics.add_segment(sm.MetricSegment(
+                    bases, tags, "", vals[sel], mtype, now, sel=sel))
+                return
+        res.metrics.add_segment(sm.MetricSegment(
+            bases, tags, "", np.asarray(vals, np.float64), mtype, now))
+
+    def _emit_counters(self, res, snap, host, is_local, now):
         part = snap["counters"]
         rows = part["rows"]
         if len(rows) == 0:
             return
-        # device psum'd hi/lo planes -> exact totals (< 2^48) on host
-        rows_dev = jnp.asarray(rows)
-        hi = np.asarray(out.counter_hi[rows_dev]).astype(np.float64)
-        lo = np.asarray(out.counter_lo[rows_dev]).astype(np.float64)
-        vals = hi * serving.COUNTER_SPLIT + lo
-        for meta, val in zip(part["meta"], vals):
-            if meta.scope == MetricScope.GLOBAL_ONLY:
-                if is_local:
-                    res.forward.append(sm.ForwardMetric(
-                        name=meta.key.name, tags=meta.tags,
-                        kind=sm.TYPE_COUNTER,
-                        scope=MetricScope.GLOBAL_ONLY,
-                        counter_value=int(val)))
-                    continue
-            res.metrics.append(sm.InterMetric(
-                name=meta.key.name, timestamp=now, value=float(val),
-                tags=meta.tags, type=sm.COUNTER))
+        if part["host_totals"] is not None:
+            vals = part["host_totals"]  # float64 host sum (no mesh)
+        else:
+            # device psum'd hi/lo planes -> exact totals (< 2^48)
+            vals = host["c_hi"] * serving.COUNTER_SPLIT + host["c_lo"]
+        self._scalar_family(
+            res, part["meta"], vals, is_local, now, sm.COUNTER,
+            lambda m, v: sm.ForwardMetric(
+                name=m.key.name, tags=m.tags, kind=sm.TYPE_COUNTER,
+                scope=MetricScope.GLOBAL_ONLY, counter_value=int(v)))
 
     def _emit_gauges(self, res, snap, is_local, now):
         part = snap["gauges"]
-        for row, meta, val in zip(part["rows"], part["meta"],
-                                  part["values"]):
-            if meta.scope == MetricScope.GLOBAL_ONLY:
-                if is_local:
-                    res.forward.append(sm.ForwardMetric(
-                        name=meta.key.name, tags=meta.tags,
-                        kind=sm.TYPE_GAUGE,
-                        scope=MetricScope.GLOBAL_ONLY,
-                        gauge_value=float(val)))
-                    continue
-            res.metrics.append(sm.InterMetric(
-                name=meta.key.name, timestamp=now, value=float(val),
-                tags=meta.tags, type=sm.GAUGE))
+        if len(part["rows"]) == 0:
+            return
+        self._scalar_family(
+            res, part["meta"], part["values"], is_local, now, sm.GAUGE,
+            lambda m, v: sm.ForwardMetric(
+                name=m.key.name, tags=m.tags, kind=sm.TYPE_GAUGE,
+                scope=MetricScope.GLOBAL_ONLY, gauge_value=float(v)))
 
     def _emit_status(self, res, snap, now):
         part = snap["status"]
@@ -382,147 +478,135 @@ class MetricAggregator:
                 message=part["messages"][int(row)],
                 hostname=part["hostnames"][int(row)]))
 
-    def _emit_sets(self, res, snap, out, is_local, now):
+    def _emit_sets(self, res, snap, host, is_local, now):
         part = snap["sets"]
         rows = part["rows"]
         if len(rows) == 0:
             return
-        rows_dev = jnp.asarray(rows)
-        ests = np.asarray(out.set_estimates[rows_dev])
-        regs = None
-        if is_local and any(m.scope == MetricScope.MIXED
-                            for m in part["meta"]):
-            # forwarding needs the merged registers on host; gather the
-            # touched rows ON DEVICE so the transfer is [n, m], not the
-            # whole lane tensor
-            regs = np.asarray(out.set_regs[rows_dev])
-        for i, meta in enumerate(part["meta"]):
-            if meta.scope == MetricScope.MIXED:
-                if is_local:
+        ests = host["set_ests"]
+        meta = part["meta"]
+        n = len(meta)
+        bases = [m.key.name for m in meta]
+        tags = [m.tags for m in meta]
+        if is_local:
+            mixed = np.fromiter(
+                (m.scope == MetricScope.MIXED for m in meta), bool, n)
+            if mixed.any():
+                # merged registers for forwarding, prefetched in the
+                # packed readback ([n, m], never the whole lane tensor)
+                regs = host["set_regs"]
+                for i in np.nonzero(mixed)[0].tolist():
+                    m = meta[i]
                     res.forward.append(sm.ForwardMetric(
-                        name=meta.key.name, tags=meta.tags,
+                        name=m.key.name, tags=m.tags,
                         kind=sm.TYPE_SET, scope=MetricScope.MIXED,
                         hll=hll_mod.marshal(regs[i])))
-                    continue
-            res.metrics.append(sm.InterMetric(
-                name=meta.key.name, timestamp=now, value=float(ests[i]),
-                tags=meta.tags, type=sm.GAUGE))
+                sel = np.nonzero(~mixed)[0]
+                res.metrics.add_segment(sm.MetricSegment(
+                    bases, tags, "", ests[sel], sm.GAUGE, now, sel=sel))
+                return
+        res.metrics.add_segment(sm.MetricSegment(
+            bases, tags, "", ests, sm.GAUGE, now))
 
-    def _emit_digests(self, res, snap, out, is_local, now):
+    def _emit_digests(self, res, snap, host, is_local, now):
         part = snap["digests"]
         rows = part["rows"]
         if len(rows) == 0:
             return
-        pl = list(self.percentiles)
-        # everything the per-row loop reads becomes plain Python floats up
-        # front: at 100k keys the loop is the host-side flush bottleneck,
-        # and numpy scalar indexing/conversions cost ~1us each inside it
-        rows_dev = jnp.asarray(rows)
-        qs = np.asarray(out.quantiles[rows_dev])
-        counts = np.asarray(out.counts[rows_dev]).tolist()
-        sums = np.asarray(out.sums[rows_dev]).tolist()
-        if is_local:
-            # centroid export is only needed for forwarding; gather the
-            # touched rows ON DEVICE so the host transfer is [n, C], not
-            # the whole [capacity, C] arena
-            sel_mean = np.asarray(out.mean[rows_dev])
-            sel_weight = np.asarray(out.weight[rows_dev])
-        else:
-            sel_mean = sel_weight = None
-        pcts = [(f".{int(p * 100)}percentile", j + 1)
-                for j, p in enumerate(pl)]
-        q_cols = [qs[:, j].tolist() for j in range(qs.shape[1])]
-        l_weight = part["l_weight"].tolist()
-        l_min = part["l_min"].tolist()
-        l_max = part["l_max"].tolist()
-        l_sum = part["l_sum"].tolist()
-        l_rsum = part["l_rsum"].tolist()
-        d_min = part["d_min"].tolist()
-        d_max = part["d_max"].tolist()
-        d_rsum = part["d_rsum"].tolist()
+        meta = part["meta"]
+        n = len(meta)
+        qs = host["qs"]
+        counts = host["counts"]
+        sums = host["sums"]
+        l_weight = np.asarray(part["l_weight"], np.float64)
+        l_min = np.asarray(part["l_min"], np.float64)
+        l_max = np.asarray(part["l_max"], np.float64)
+        l_sum = np.asarray(part["l_sum"], np.float64)
+        l_rsum = np.asarray(part["l_rsum"], np.float64)
+        d_min = np.asarray(part["d_min"], np.float64)
+        d_max = np.asarray(part["d_max"], np.float64)
+        d_rsum = np.asarray(part["d_rsum"], np.float64)
 
+        bases = [m.key.name for m in meta]
+        tags = [m.tags for m in meta]
+        use_global = np.fromiter(
+            (m.scope == MetricScope.GLOBAL_ONLY for m in meta), bool, n)
+        if is_local:
+            forwarded = np.fromiter(
+                (m.scope != MetricScope.LOCAL_ONLY for m in meta), bool, n)
+        else:
+            forwarded = np.zeros(n, bool)
+
+        if forwarded.any():
+            # centroid export is only needed for forwarding (prefetched
+            # in the packed readback)
+            sel_mean = host["fwd_mean"]
+            sel_weight = host["fwd_weight"]
+            compression = self.digests.compression
+            fwd = res.forward
+            for i in np.nonzero(forwarded)[0].tolist():
+                m = meta[i]
+                w = sel_weight[i]
+                occ = w > 0
+                fwd.append(sm.ForwardMetric(
+                    name=m.key.name, tags=m.tags, kind=m.key.type,
+                    scope=m.scope,
+                    digest_means=sel_mean[i][occ].tolist(),
+                    digest_weights=w[occ].tolist(),
+                    digest_min=float(d_min[i]), digest_max=float(d_max[i]),
+                    digest_sum=float(sums[i]), digest_rsum=float(d_rsum[i]),
+                    digest_compression=compression))
+
+        # alive: rows that emit anything locally (a forwarded global-only
+        # row emits nothing here, flusher.go:57-74); sparse-emission
+        # guards per aggregate mirror Histo.Flush
+        # (samplers/samplers.go:359-514) as column masks.
+        alive = ~(forwarded & use_global)
         aggs = self.aggregates.value
         A = sm.Aggregate
-        want_max = bool(aggs & A.MAX)
-        want_min = bool(aggs & A.MIN)
-        want_sum = bool(aggs & A.SUM)
-        want_avg = bool(aggs & A.AVERAGE)
-        want_count = bool(aggs & A.COUNT)
-        want_median = bool(aggs & A.MEDIAN)
-        want_hmean = bool(aggs & A.HARMONIC_MEAN)
-        compression = self.digests.compression
-        metrics_out = res.metrics
-        forward_out = res.forward
-        MIXED, GLOBAL_ONLY = MetricScope.MIXED, MetricScope.GLOBAL_ONLY
-        InterMetric, ForwardMetric = sm.InterMetric, sm.ForwardMetric
-        GAUGE, COUNTER = sm.GAUGE, sm.COUNTER
-        inf = float("inf")
+        inf = np.inf
+        batch = res.metrics
 
-        for i, meta in enumerate(part["meta"]):
-            cls = meta.scope  # MIXED / GLOBAL_ONLY / LOCAL_ONLY row class
-            forwarded = is_local and cls in (MIXED, GLOBAL_ONLY)
-            if forwarded:
-                occ = sel_weight[i] > 0
-                forward_out.append(ForwardMetric(
-                    name=meta.key.name, tags=meta.tags, kind=meta.key.type,
-                    scope=cls,
-                    digest_means=sel_mean[i][occ].tolist(),
-                    digest_weights=sel_weight[i][occ].tolist(),
-                    digest_min=d_min[i], digest_max=d_max[i],
-                    digest_sum=sums[i], digest_rsum=d_rsum[i],
-                    digest_compression=compression))
-                if cls is GLOBAL_ONLY:
-                    continue  # nothing emitted locally for global-only
-            use_global = cls is GLOBAL_ONLY
-            emit_pcts = not forwarded
+        def seg(mask, values, suffix, mtype=sm.GAUGE):
+            if mask.all():
+                batch.add_segment(sm.MetricSegment(
+                    bases, tags, suffix, values, mtype, now))
+                return
+            sel = np.nonzero(mask)[0]
+            if sel.size:
+                batch.add_segment(sm.MetricSegment(
+                    bases, tags, suffix, values[sel], mtype, now, sel=sel))
 
-            # one histogram row's InterMetrics, mirroring Histo.Flush
-            # (samplers/samplers.go:359-514): local-scalar aggregates with
-            # sparse-emission guards, digest-backed values when global
-            lw, ls, lr = l_weight[i], l_sum[i], l_rsum[i]
-            fname = meta.flush_name
-            if want_max and (use_global or -inf < l_max[i] < inf):
-                metrics_out.append(InterMetric(
-                    name=fname(".max"), timestamp=now,
-                    value=d_max[i] if use_global else l_max[i],
-                    tags=meta.tags, type=GAUGE))
-            if want_min and (use_global or -inf < l_min[i] < inf):
-                metrics_out.append(InterMetric(
-                    name=fname(".min"), timestamp=now,
-                    value=d_min[i] if use_global else l_min[i],
-                    tags=meta.tags, type=GAUGE))
-            if want_sum and (ls != 0 or use_global):
-                metrics_out.append(InterMetric(
-                    name=fname(".sum"), timestamp=now,
-                    value=sums[i] if use_global else ls,
-                    tags=meta.tags, type=GAUGE))
-            if want_avg and (use_global or (ls != 0 and lw != 0)):
-                metrics_out.append(InterMetric(
-                    name=fname(".avg"), timestamp=now,
-                    value=((sums[i] / counts[i]) if counts[i]
-                           else float("nan")) if use_global else ls / lw,
-                    tags=meta.tags, type=GAUGE))
-            if want_count and (lw != 0 or use_global):
-                metrics_out.append(InterMetric(
-                    name=fname(".count"), timestamp=now,
-                    value=counts[i] if use_global else lw,
-                    tags=meta.tags, type=COUNTER))
-            if want_median:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if aggs & A.MAX:
+                seg(alive & (use_global | ((l_max > -inf) & (l_max < inf))),
+                    np.where(use_global, d_max, l_max), ".max")
+            if aggs & A.MIN:
+                seg(alive & (use_global | ((l_min > -inf) & (l_min < inf))),
+                    np.where(use_global, d_min, l_min), ".min")
+            if aggs & A.SUM:
+                seg(alive & ((l_sum != 0) | use_global),
+                    np.where(use_global, sums, l_sum), ".sum")
+            if aggs & A.AVERAGE:
+                seg(alive & (use_global | ((l_sum != 0) & (l_weight != 0))),
+                    np.where(use_global, sums / counts, l_sum / l_weight),
+                    ".avg")
+            if aggs & A.COUNT:
+                seg(alive & ((l_weight != 0) | use_global),
+                    np.where(use_global, counts, l_weight), ".count",
+                    sm.COUNTER)
+            if aggs & A.MEDIAN:
                 # emitted unconditionally when configured
                 # (samplers.go:466-479)
-                metrics_out.append(InterMetric(
-                    name=fname(".median"), timestamp=now,
-                    value=q_cols[0][i], tags=meta.tags, type=GAUGE))
-            if want_hmean and (use_global or
-                                           (lr != 0 and lw != 0)):
-                metrics_out.append(InterMetric(
-                    name=fname(".hmean"), timestamp=now,
-                    value=((counts[i] / d_rsum[i]) if d_rsum[i]
-                           else float("nan")) if use_global else lw / lr,
-                    tags=meta.tags, type=GAUGE))
-            if emit_pcts:
-                # reference naming: int(p*100), samplers.go:495-507
-                for suffix, col in pcts:
-                    metrics_out.append(InterMetric(
-                        name=fname(suffix), timestamp=now,
-                        value=q_cols[col][i], tags=meta.tags, type=GAUGE))
+                seg(alive, qs[:, 0], ".median")
+            if aggs & A.HARMONIC_MEAN:
+                # d_rsum == 0 with nonzero count -> nan, not inf
+                # (samplers.go hmean guard)
+                g_hmean = np.where(d_rsum != 0, counts / d_rsum, np.nan)
+                seg(alive & (use_global | ((l_rsum != 0) & (l_weight != 0))),
+                    np.where(use_global, g_hmean, l_weight / l_rsum),
+                    ".hmean")
+            # reference percentile naming: int(p*100), samplers.go:495-507
+            emit_pcts = alive & ~forwarded
+            for j, p in enumerate(self.percentiles):
+                seg(emit_pcts, qs[:, j + 1], f".{int(p * 100)}percentile")

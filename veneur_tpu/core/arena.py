@@ -51,6 +51,10 @@ HOT_DENSE_BUDGET = 1 << 23
 # flush intervals a key may stay untouched before its row is recycled
 IDLE_GC_INTERVALS = 10
 
+
+def _pow2(n: int) -> int:
+    return 1 << (int(n) - 1).bit_length() if n > 1 else 1
+
 _INITIAL_CAPACITY = 1024
 
 
@@ -181,6 +185,7 @@ class CounterArena(_ArenaBase):
         super().__init__(capacity)
         self.n_lanes = self._init_mesh_lanes(mesh, "counter")
         self.values = np.zeros((self.n_lanes, capacity), np.float64)
+        self._zero_planes = None
 
     def _grow_state(self, old: int) -> None:
         self.values = np.concatenate(
@@ -205,7 +210,17 @@ class CounterArena(_ArenaBase):
     def planes_from(self, vals: np.ndarray):
         """Device-put the (hi, lo) split of snapshotted lane stripes as
         `[R_c, capacity, 2]` f32 for the family flush program (runs
-        outside the lock; the split + transfer are the expensive part)."""
+        outside the lock; the split + transfer are the expensive part).
+
+        Without a mesh there is nothing to psum over, so the aggregator
+        totals the float64 host stripes directly (exact below 2^53) and
+        the program receives a cached [R_c, 1, 2] zero plane — no upload
+        at all."""
+        if self._lane_shd is None:
+            if self._zero_planes is None:
+                self._zero_planes = serving.put(
+                    np.zeros((self.n_lanes, 1, 2), np.float32), None)
+            return self._zero_planes
         hi = np.floor(vals / serving.COUNTER_SPLIT)
         lo = vals - hi * serving.COUNTER_SPLIT
         planes = np.stack([hi, lo], axis=-1).astype(np.float32)
@@ -413,6 +428,10 @@ class DigestArena(_ArenaBase):
         self.n_lanes = r
         self._row_shd = serving.row_sharding(mesh)
         self._wave_shd = serving.row_sharding(mesh, ndim=2)
+        # [2, K] stacked min/max rides ONE upload per flush
+        self._minmax_shd = (None if mesh is None else
+                            serving.NamedSharding(
+                                mesh, serving.P(None, serving.SHARD_AXIS)))
         self.lanes_mean = serving.put(
             np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
         self.lanes_weight = serving.put(
@@ -539,8 +558,13 @@ class DigestArena(_ArenaBase):
         col = pos % WAVE_WIDTH
         for wv in range(n_waves):
             m = wave == wv
-            dv = np.zeros((self.capacity, WAVE_WIDTH), np.float32)
-            dw = np.zeros((self.capacity, WAVE_WIDTH), np.float32)
+            # clamp the wave to the actual per-row depth (pow2 for jit
+            # cache reuse): a 4-samples/key interval uploads [K, 4], not
+            # [K, 128] — host->device bytes scale with samples, not with
+            # arena capacity x WAVE_WIDTH
+            width = _pow2(int(col[m].max()) + 1)
+            dv = np.zeros((self.capacity, width), np.float32)
+            dw = np.zeros((self.capacity, width), np.float32)
             dv[r[m], col[m]] = v[m]
             dw[r[m], col[m]] = w[m]
             lane = (self._wave_seq + wv) % self.n_lanes
@@ -576,7 +600,7 @@ class DigestArena(_ArenaBase):
         cid = chunk_id[order]
         n_chunks = int(cid[-1]) + 1
         bounds = np.searchsorted(cid, np.arange(n_chunks + 1))
-        pow2 = lambda n: 1 << (int(n) - 1).bit_length() if n > 1 else 1
+        pow2 = _pow2
         for c in range(n_chunks):
             lo, hi = int(bounds[c]), int(bounds[c + 1])
             if lo == hi:
@@ -627,9 +651,9 @@ class DigestArena(_ArenaBase):
         under the aggregator lock, then `reset_rows`; emission evaluates the
         snapshot outside the lock via `flush_fn`."""
         self.sync()
+        minmax = np.stack([self.d_min, self.d_max]).astype(np.float32)
         return (self.lanes_mean, self.lanes_weight,
-                serving.put(self.d_min.astype(np.float32), self._row_shd),
-                serving.put(self.d_max.astype(np.float32), self._row_shd))
+                serving.put(minmax, self._minmax_shd))
 
     def reset_rows(self, rows: np.ndarray) -> None:
         # runs even for empty rows: the kernel swaps in fresh buffers so

@@ -886,11 +886,8 @@ class Server:
 
         # sink routing (flusher.go:97-113)
         if self.config.enable_metric_sink_routing:
-            for m in res.metrics:
-                m.sinks = set()
-                for rc in self.config.metric_sink_routing:
-                    hit = matcher_mod.match(rc.match, m.name, m.tags)
-                    m.sinks.update(rc.matched if hit else rc.not_matched)
+            res.metrics.apply_routing(self.config.metric_sink_routing,
+                                      matcher_mod.match)
 
         futures = {}
         if self.forwarder is not None and self.is_local and res.forward:

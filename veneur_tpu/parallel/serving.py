@@ -231,9 +231,9 @@ def make_family_flush(mesh: Optional[Mesh],
                       compression: float = td.DEFAULT_COMPRESSION):
     """Build the per-flush program covering every sampler family.
 
-    Returns fn(lanes_mean [R,K,C], lanes_weight, d_min [K], d_max,
-    percentiles [P], set_lanes [R_s,S,m] u8, counter_planes [R_c,K2,2] f32,
-    uts_regs [m_u] u8) -> FamilyFlushOutputs.  With a mesh, the function is
+    Returns fn(lanes_mean [R,K,C], lanes_weight, d_minmax [2,K] (min;max,
+    one upload), percentiles [P], set_lanes [R_s,S,m] u8, counter_planes
+    [R_c,K2,2] f32, uts_regs [m_u] u8) -> FamilyFlushOutputs.  With a mesh, the function is
     a shard_map'd SPMD program: keys/set rows/counter rows are sharded over
     'shard'; digest lanes all_gather, set lanes pmax, and counter planes
     psum over 'replica'; the unique-timeseries registers pmax over both
@@ -244,8 +244,9 @@ def make_family_flush(mesh: Optional[Mesh],
     computation needs it).
     """
     def body_for(axis):
-        def body(lanes_mean, lanes_weight, d_min, d_max, percentiles,
+        def body(lanes_mean, lanes_weight, d_minmax, percentiles,
                  set_lanes, counter_planes, uts_regs):
+            d_min, d_max = d_minmax[0], d_minmax[1]
             dig = reduce_eval(lanes_mean, lanes_weight, d_min, d_max,
                               jnp.zeros_like(d_min), percentiles,
                               compression, axis)
@@ -275,7 +276,7 @@ def make_family_flush(mesh: Optional[Mesh],
     spec_kc = P(SHARD_AXIS, None)
     fn = jax.shard_map(
         body_for(REPLICA_AXIS), mesh=mesh,
-        in_specs=(spec_lanes, spec_lanes, spec_k, spec_k, P(None),
+        in_specs=(spec_lanes, spec_lanes, P(None, SHARD_AXIS), P(None),
                   spec_lanes, spec_lanes, P(None)),
         out_specs=FamilyFlushOutputs(
             mean=spec_kc, weight=spec_kc, quantiles=spec_kc,
@@ -285,3 +286,50 @@ def make_family_flush(mesh: Optional[Mesh],
             unique_ts=P()),
         check_vma=False)
     return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Flush readback packing
+# ---------------------------------------------------------------------------
+#
+# The host needs a small, fixed set of per-touched-row values out of each
+# flush (quantiles/counts/sums per digest row, hi/lo per counter row,
+# estimates per set row, the unique-ts scalar).  Reading them with eager
+# per-family gathers costs one device round-trip + one tiled-layout
+# transfer EACH; over a remote device link those round-trips dominate the
+# whole flush.  `flush_pack` gathers every family's touched rows inside
+# one jitted program and returns ONE flat f32 vector, so the host pays a
+# single linear-layout transfer per flush regardless of family count.
+# Row index arrays are padded to powers of two by the caller (row 0
+# repeated; the padding lanes are sliced off after unpack) to bound the
+# jit cache.
+
+@jax.jit
+def flush_pack(quantiles: jax.Array, counts: jax.Array, sums: jax.Array,
+               counter_hi: jax.Array, counter_lo: jax.Array,
+               set_estimates: jax.Array, unique_ts: jax.Array,
+               drows: jax.Array, crows: jax.Array, srows: jax.Array
+               ) -> jax.Array:
+    return jnp.concatenate([
+        quantiles[drows].reshape(-1),
+        counts[drows], sums[drows],
+        counter_hi[crows], counter_lo[crows],
+        set_estimates[srows],
+        unique_ts[None].astype(jnp.float32),
+    ])
+
+
+@jax.jit
+def forward_pack(mean: jax.Array, weight: jax.Array, rows: jax.Array
+                 ) -> jax.Array:
+    """Flat [2 * n * C] f32 readback of merged centroids for the rows a
+    local tier forwards (ForwardableMetrics, `worker.go:179-216`)."""
+    return jnp.concatenate([mean[rows].reshape(-1),
+                            weight[rows].reshape(-1)])
+
+
+@jax.jit
+def set_regs_pack(set_regs: jax.Array, rows: jax.Array) -> jax.Array:
+    """Flat [n * m] u8 readback of merged HLL registers for forwarding
+    (Set.Metric marshal, `samplers/samplers.go:279-295`)."""
+    return set_regs[rows].reshape(-1)
