@@ -29,25 +29,26 @@ def test_sharded_matches_single_device():
     step = fs.make_sharded_flush_step(mesh)
     sharded = step(inputs, percentiles)
 
-    np.testing.assert_allclose(np.asarray(single.quantiles),
-                               np.asarray(sharded.quantiles),
+    np.testing.assert_allclose(np.asarray(single.digest_eval),
+                               np.asarray(sharded.digest_eval),
                                rtol=1e-5, atol=1e-5)
-    np.testing.assert_allclose(np.asarray(single.counts),
-                               np.asarray(sharded.counts), rtol=1e-5)
-    np.testing.assert_allclose(np.asarray(single.counter_totals),
-                               np.asarray(sharded.counter_totals))
+    np.testing.assert_allclose(np.asarray(single.counter_hi),
+                               np.asarray(sharded.counter_hi))
+    np.testing.assert_allclose(np.asarray(single.counter_lo),
+                               np.asarray(sharded.counter_lo))
     np.testing.assert_allclose(np.asarray(single.set_estimates),
                                np.asarray(sharded.set_estimates))
     assert float(single.unique_ts) == float(sharded.unique_ts)
 
 
-def test_flush_step_merges_lanes():
-    """All R lanes' digests must land in the merged state."""
-    inputs = fs.example_inputs(n_keys=8, n_lanes=3, n_sets=4)
+def test_flush_step_counts_all_points():
+    """Every staged point (across all replica depth slices) must land in
+    the evaluation: total weight = n_lanes * depth per key."""
+    inputs = fs.example_inputs(n_keys=8, n_lanes=3, n_sets=4, depth=32)
     out = fs.flush_step(inputs, jnp.asarray([0.5], jnp.float32))
-    # state had 32 unit-weight samples per key, each of 3 lanes adds 32
-    np.testing.assert_allclose(np.asarray(out.counts),
-                               np.full(8, 32.0 * 4), rtol=1e-5)
+    # digest_eval columns: [quantiles..., total, sum]
+    np.testing.assert_allclose(np.asarray(out.digest_eval)[:, 1],
+                               np.full(8, 3 * 32.0), rtol=1e-5)
 
 
 def test_dryrun_entrypoints():
@@ -55,7 +56,7 @@ def test_dryrun_entrypoints():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
-    assert out.quantiles.shape == (64, 3)
+    assert out.digest_eval.shape == (64, 3 + 2)
     g.dryrun_multichip(8)
     g.dryrun_multichip(4)
 

@@ -97,12 +97,12 @@ class MetricAggregator:
         self.count_unique_timeseries = count_unique_timeseries
         self.unique_ts = hll_mod.HLLSketch() if count_unique_timeseries else None
         self.is_local = is_local
-        self._uts_zero = None  # cached zero uts registers (mesh-less)
-        # ONE SPMD program evaluates every family at flush (digest lane
-        # gather+compress+quantiles, HLL pmax+estimate, counter psum,
-        # unique-timeseries estimate) — the production path and the
-        # benchmark flush_step share this math (parallel/serving.py).
-        self.flush_fn = serving.make_family_flush(mesh, compression)
+        # ONE device program evaluates the flush (parallel/serving.py):
+        # mesh-less it is the digest sorted-eval alone (sets/counters/
+        # unique-ts resolve on host); meshed it is the shard_map'd
+        # full-family program (all_gather over sample depth, set pmax,
+        # counter psum, unique-ts union).
+        self.flush_fn = serving.make_serving_flush(mesh)
         self._uts_m = self.unique_ts.m if self.unique_ts is not None \
             else 1 << hll_mod.DEFAULT_PRECISION
         self._pct_arr = jnp.asarray([0.5] + list(self.percentiles),
@@ -209,10 +209,11 @@ class MetricAggregator:
         per trickle of samples)."""
         with self.lock:
             if min_samples <= 0:
-                # a sync's fixed cost scales with arena capacity (the
-                # dense scatter is capacity-wide), so the default
-                # threshold does too
-                min_samples = max(256, self.digests.capacity // 16)
+                # sync is host-side COO consolidation (cost scales with
+                # staged samples, plus hot-key pre-reduction when a row
+                # outgrows the dense cap); batch enough samples per tick
+                # to amortize the fixed numpy overheads
+                min_samples = 4096
             if (self.digests.staged_count()
                     + self.sets.staged_count() < min_samples):
                 return False
@@ -230,27 +231,17 @@ class MetricAggregator:
             snap = self._snapshot_and_reset()
             res.processed, res.imported = snap.pop("counts")
 
-        # ONE SPMD program call evaluates every family: digest lane reduce
-        # (replica-axis all_gather when meshed) -> batched compress ->
-        # quantiles, plus HLL pmax+estimate, counter psum, unique-ts
-        # estimate.  This IS the serving path of the north-star flush
-        # (flusher.go:26-122 + worker.go:402-459 as one device program);
-        # it runs on the snapshot outside the lock so ingest continues.
-        # Idle fast path: skip the device dispatch when every touched
-        # family resolves on host (counters and unique-ts do, mesh-less).
+        # ONE device program call evaluates the flush on the snapshot
+        # OUTSIDE the lock, so ingest continues (flusher.go:26-122 +
+        # worker.go:402-459 as one program).  Mesh-less, sets/counters/
+        # unique-ts resolve on host and the program only runs when digest
+        # rows were touched; an idle interval skips the dispatch entirely.
         idle = (len(snap["digests"]["rows"]) == 0
                 and len(snap["sets"]["rows"]) == 0
-                and (len(snap["counters"]["rows"]) == 0
-                     or snap["counters"]["host_totals"] is not None)
+                and len(snap["counters"]["rows"]) == 0
                 and (not snap["have_uts"]
                      or snap["uts_host"] is not None))
-        host = None
-        if not idle:
-            out = self.flush_fn(
-                *snap["digests"]["lanes"], self._pct_arr,
-                snap["sets"]["lanes"], snap["counter_planes"](),
-                snap["uts_regs"])
-            host = self._fetch_outputs(out, snap, is_local)
+        host = {} if idle else self._run_flush(snap, is_local)
         if snap.pop("have_uts"):
             res.unique_ts = int(snap["uts_host"]
                                 if snap["uts_host"] is not None
@@ -265,64 +256,70 @@ class MetricAggregator:
 
     @staticmethod
     def _padded_rows(rows) -> np.ndarray:
-        """Pad a touched-row index array to a power of two (row 0
-        repeated) so the packed-readback jit cache stays bounded; the
-        padding lanes are sliced off after unpack."""
+        """Pad an index array to a power of two (index 0 repeated) so the
+        gather jit cache stays bounded; padding lanes are sliced off after
+        the readback."""
         a = np.zeros(arena_mod._pow2(len(rows)), np.int32)
         a[:len(rows)] = rows
         return a
 
-    def _fetch_outputs(self, out, snap: dict, is_local: bool) -> dict:
-        """ONE packed device->host transfer for everything the emitters
-        need (plus one more per forwarding family when rows forward).
-        Eager per-family gathers would each pay a device round-trip and a
-        tiled-layout transfer — over a remote device link those dominate
-        the entire flush, and even over PCIe the batched linear read wins."""
-        dpart, cpart, spart = snap["digests"], snap["counters"], snap["sets"]
-        nd, nc, ns = len(dpart["rows"]), len(cpart["rows"]), len(spart["rows"])
-        pd = self._padded_rows(dpart["rows"])
-        # counter values resolved on host (no mesh): skip their readback
-        host_counters = cpart["host_totals"] is not None
-        pc = self._padded_rows([] if host_counters else cpart["rows"])
-        ps = self._padded_rows(spart["rows"])
-        flat = np.asarray(serving.flush_pack(
-            out.quantiles, out.counts, out.sums, out.counter_hi,
-            out.counter_lo, out.set_estimates, out.unique_ts,
-            jnp.asarray(pd), jnp.asarray(pc), jnp.asarray(ps)))
-        n_pct = out.quantiles.shape[1]
-        dp, cp, sp = len(pd), len(pc), len(ps)
-        o = 0
-        host = {}
-        host["qs"] = flat[o:o + dp * n_pct].reshape(dp, n_pct)[:nd]
-        o += dp * n_pct
-        host["counts"] = flat[o:o + dp][:nd].astype(np.float64)
-        o += dp
-        host["sums"] = flat[o:o + dp][:nd].astype(np.float64)
-        o += dp
-        if host_counters:
-            host["c_hi"] = host["c_lo"] = None
-            o += 2 * cp
+    def _run_flush(self, snap: dict, is_local: bool) -> dict:
+        """Run the per-flush device program on the snapshot and read the
+        results back as host numpy (outside the lock).
+
+        Mesh-less: one digest program call (dense upload -> [K, P+2]
+        readback); sets/counters/unique-ts were already resolved on host
+        at snapshot.  Meshed: the full-family shard_map'd program.  Either
+        way the readback is a handful of slim arrays — device traffic
+        scales with the interval's samples and touched keys."""
+        dpart = snap["digests"]
+        nd = len(dpart["rows"])
+        n_cols = len(self._pct_arr)  # median + configured percentiles
+        host: dict = {}
+        if self.mesh is None:
+            host["set_ests"] = snap["sets"]["estimates"]
+            if nd == 0:
+                return host
+            dv, dw, minmax = self.digests.build_dense(
+                dpart["staged"], dpart["rows"],
+                dpart["d_min"], dpart["d_max"])
+            dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
+            ev = np.asarray(self.flush_fn(dvd, dwd, mmd, self._pct_arr))
+            host["dense_dev"] = (dvd, dwd)
         else:
-            host["c_hi"] = flat[o:o + cp][:nc].astype(np.float64)
-            o += cp
-            host["c_lo"] = flat[o:o + cp][:nc].astype(np.float64)
-            o += cp
-        host["set_ests"] = flat[o:o + sp][:ns]
-        o += sp
-        host["unique_ts"] = flat[o]
-        if is_local:
-            if nd and any(m.scope != MetricScope.LOCAL_ONLY
-                          for m in dpart["meta"]):
-                fl = np.asarray(serving.forward_pack(
-                    out.mean, out.weight, jnp.asarray(pd)))
-                c_cap = out.mean.shape[1]
-                host["fwd_mean"] = fl[:dp * c_cap].reshape(dp, c_cap)[:nd]
-                host["fwd_weight"] = fl[dp * c_cap:].reshape(dp, c_cap)[:nd]
-            if ns and any(m.scope == MetricScope.MIXED
-                          for m in spart["meta"]):
-                regs = np.asarray(serving.set_regs_pack(
-                    out.set_regs, jnp.asarray(ps)))
-                host["set_regs"] = regs.reshape(sp, -1)[:ns]
+            dv, dw, minmax = self.digests.build_dense(
+                dpart["staged"], dpart["rows"],
+                dpart["d_min"], dpart["d_max"])
+            dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
+            inputs = serving.FlushInputs(
+                dense_v=dvd, dense_w=dwd, minmax=mmd,
+                hll_regs=snap["sets"]["lanes"],
+                counter_planes=snap["counter_planes"](),
+                uts_regs=snap["uts_regs"])
+            out = self.flush_fn(inputs, self._pct_arr)
+            host["dense_dev"] = (dvd, dwd)
+            host["unique_ts"] = float(out.unique_ts)
+            crows = snap["counters"]["rows"]
+            if len(crows):
+                chi = np.asarray(out.counter_hi).astype(np.float64)
+                clo = np.asarray(out.counter_lo).astype(np.float64)
+                host["c_hi"], host["c_lo"] = chi[crows], clo[crows]
+            srows = snap["sets"]["rows"]
+            ns = len(srows)
+            if ns:
+                host["set_ests"] = np.asarray(out.set_estimates)[srows]
+                if is_local and any(m.scope == MetricScope.MIXED
+                                    for m in snap["sets"]["meta"]):
+                    ps = self._padded_rows(srows)
+                    regs = np.asarray(serving.set_regs_pack(
+                        out.set_regs, jnp.asarray(ps)))
+                    host["set_regs"] = regs.reshape(len(ps), -1)[:ns]
+            if nd == 0:
+                return host
+            ev = np.asarray(out.digest_eval)
+        host["qs"] = ev[:nd, :n_cols]
+        host["counts"] = ev[:nd, n_cols].astype(np.float64)
+        host["sums"] = ev[:nd, n_cols + 1].astype(np.float64)
         return host
 
     def _snapshot_and_reset(self) -> dict:
@@ -343,21 +340,25 @@ class MetricAggregator:
         else:
             uts = None
         if self.mesh is None:
-            # nothing to pmax over without a mesh: estimate on host and
-            # hand the program a cached zero register vector (no upload)
+            # nothing to pmax over without a mesh: estimate on host (the
+            # digest-only program never sees these registers)
             snap["uts_host"] = (hll_mod.estimate_np(uts)
                                 if uts is not None else None)
-            if self._uts_zero is None:
-                self._uts_zero = serving.put(
-                    np.zeros(self._uts_m, np.uint8), None)
-            snap["uts_regs"] = self._uts_zero
+            snap["uts_regs"] = None
         else:
+            # [R, m] register lanes, this process's tally in lane 0; the
+            # program pmaxes over both mesh axes (across processes this is
+            # the DCN union of per-host tallies)
             snap["uts_host"] = None
-            if uts is None:
-                uts = np.zeros(self._uts_m, np.uint8)
+            from veneur_tpu.parallel.mesh import REPLICA_AXIS
+            r = self.mesh.shape[REPLICA_AXIS]
+            lanes = np.zeros((r, self._uts_m), np.uint8)
+            if uts is not None:
+                lanes[0] = uts
             snap["uts_regs"] = serving.put(
-                uts, jax.sharding.NamedSharding(
-                    self.mesh, jax.sharding.PartitionSpec()))
+                lanes, jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(
+                        REPLICA_AXIS, None)))
 
         for name, ar in (("gauges", g), ("status", st)):
             rows = ar.touched_rows()
@@ -392,15 +393,24 @@ class MetricAggregator:
         snap["sets"] = {
             "rows": srows,
             "meta": [s.meta[r] for r in srows],
-            "lanes": s.snapshot_lanes(),
         }
+        if self.mesh is None:
+            # host registers: estimates now, register copies only if rows
+            # will forward (Set.Metric marshal needs them post-reset)
+            snap["sets"]["estimates"] = s.host_estimates(srows)
+            if len(srows) and any(m.scope == MetricScope.MIXED
+                                  for m in snap["sets"]["meta"]):
+                snap["sets"]["host_regs"] = s.host_regs_copy(srows)
+        else:
+            snap["sets"]["lanes"] = s.snapshot_lanes()
 
         drows = d.touched_rows()
         snap["digests"] = {
             "rows": drows,
             "meta": [d.meta[r] for r in drows],
-            # immutable device refs + scalar uploads for the SPMD flush
-            "lanes": d.snapshot_lanes(),
+            # the interval's staged weighted points (consumed); the flush
+            # program evaluates them in one dense pass outside the lock
+            "staged": d.take_staged(),
             "l_weight": d.l_weight[drows].copy(),
             "l_min": d.l_min[drows].copy(),
             "l_max": d.l_max[drows].copy(),
@@ -492,9 +502,12 @@ class MetricAggregator:
             mixed = np.fromiter(
                 (m.scope == MetricScope.MIXED for m in meta), bool, n)
             if mixed.any():
-                # merged registers for forwarding, prefetched in the
-                # packed readback ([n, m], never the whole lane tensor)
-                regs = host["set_regs"]
+                # merged registers for forwarding: host snapshot copies
+                # (mesh-less) or the packed device readback (meshed) —
+                # [n, m] either way, never the whole register state
+                regs = part.get("host_regs")
+                if regs is None:
+                    regs = host["set_regs"]
                 for i in np.nonzero(mixed)[0].tolist():
                     m = meta[i]
                     res.forward.append(sm.ForwardMetric(
@@ -538,20 +551,28 @@ class MetricAggregator:
             forwarded = np.zeros(n, bool)
 
         if forwarded.any():
-            # centroid export is only needed for forwarding (prefetched
-            # in the packed readback)
-            sel_mean = host["fwd_mean"]
-            sel_weight = host["fwd_weight"]
+            # wire centroids for forwarding: ONE bounded compress over the
+            # forwarded rows' staged points (MergingDigest.Data,
+            # merging_digest.go:474-483) — compute and readback scale with
+            # the forwarded subset
+            dvd, dwd = host["dense_dev"]
+            fidx = np.nonzero(forwarded)[0]
+            fpad = self._padded_rows(fidx)
             compression = self.digests.compression
+            mexp, wexp = serving.digest_export(
+                dvd, dwd, jnp.asarray(fpad), compression,
+                self.digests.ccap)
+            sel_mean = np.asarray(mexp)[:len(fidx)]
+            sel_weight = np.asarray(wexp)[:len(fidx)]
             fwd = res.forward
-            for i in np.nonzero(forwarded)[0].tolist():
+            for j, i in enumerate(fidx.tolist()):
                 m = meta[i]
-                w = sel_weight[i]
+                w = sel_weight[j]
                 occ = w > 0
                 fwd.append(sm.ForwardMetric(
                     name=m.key.name, tags=m.tags, kind=m.key.type,
                     scope=m.scope,
-                    digest_means=sel_mean[i][occ].tolist(),
+                    digest_means=sel_mean[j][occ].tolist(),
                     digest_weights=w[occ].tolist(),
                     digest_min=float(d_min[i]), digest_max=float(d_max[i]),
                     digest_sum=float(sums[i]), digest_rsum=float(d_rsum[i]),

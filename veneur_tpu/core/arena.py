@@ -40,13 +40,13 @@ from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
 from veneur_tpu.sketches import hll as hll_mod
 from veneur_tpu.sketches import tdigest as td
 
-# samples per device-ingest wave (column width of the dense scatter)
-WAVE_WIDTH = 128
-# beyond this many waves per sync, switch to the two-stage hot-key path
-HOT_WAVE_THRESHOLD = 4
-# dense-matrix column bound for the hot path (per-row depth per chunk)
+# staged depth beyond which a row pre-reduces into <= C weighted points
+# (bounds the flush dense matrix width)
+DENSE_DEPTH_CAP = 512
+# per-row column bound inside one pre-reduction launch: a single key with
+# millions of staged samples splits into chunks of this depth
 HOT_CHUNK_WIDTH = 16_384
-# dense-matrix element bound per stage-1 launch (32 MiB f32 per array)
+# dense-matrix element bound per pre-reduction launch (32 MiB f32/array)
 HOT_DENSE_BUDGET = 1 << 23
 # flush intervals a key may stay untouched before its row is recycled
 IDLE_GC_INTERVALS = 10
@@ -277,18 +277,20 @@ class StatusArena(_ArenaBase):
 
 
 class SetArena(_ArenaBase):
-    """HLL register arenas as lane-striped device tensors `[R_s, S, 2^p]`
-    (samplers/samplers.go:236-311).
+    """Unique-count sets as HLL register rows (Set sampler,
+    `samplers/samplers.go:242-311`).
 
-    Ingest stages (row, metro-hash) pairs host-side; `sync()` splits them
-    into (register index, rank) and scatter-maxes one padded batch into a
-    round-robin lane on device.  Imported register rows (Set.Merge) union
-    host-side per row first, then scatter as full rows.  With a mesh the
-    state is sharded (rows over 'shard', lanes over 'replica') and the
-    family flush program reduces lanes with `lax.pmax` over ICI — the
-    production form of the global set union.  Estimation and forwarding
-    marshal read the flush program's merged registers, so host code never
-    touches the full register tensor on the flush path.
+    Without a mesh the registers live on HOST (`[capacity, m]` uint8):
+    inserts are one vectorized `np.maximum.at`, merges a register-wise
+    max, estimates a batched numpy LogLog-Beta — there is nothing to
+    reduce over on a single device, and keeping 16 KiB/row off the device
+    keeps flush traffic at zero for this family.
+
+    With a mesh the registers are device-resident lane stripes
+    `[R_s, S, m]` sharded (rows over 'shard', lanes over 'replica');
+    staged inserts scatter-max into a round-robin lane and the flush
+    program pmaxes the lanes over ICI and estimates all rows at once —
+    the collective form of Set.Merge (`samplers/samplers.go:299-311`).
     """
 
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
@@ -297,9 +299,14 @@ class SetArena(_ArenaBase):
         self.precision = precision
         self.m = 1 << precision
         self.n_lanes = self._init_mesh_lanes(mesh, "set")
-        self.lanes_regs = serving.put(
-            np.zeros((self.n_lanes, capacity, self.m), np.uint8),
-            self._lane_shd)
+        if mesh is None:
+            self.host_regs = np.zeros((capacity, self.m), np.uint8)
+            self.lanes_regs = None
+        else:
+            self.host_regs = None
+            self.lanes_regs = serving.put(
+                np.zeros((self.n_lanes, capacity, self.m), np.uint8),
+                self._lane_shd)
         self._seq = 0
         # staging: raw hashes per batch (vectorized split at sync)
         self._stage_rows: list[int] = []
@@ -310,6 +317,11 @@ class SetArena(_ArenaBase):
         self._merge_rows: dict[int, np.ndarray] = {}
 
     def _grow_state(self, old: int) -> None:
+        if self.host_regs is not None:
+            self.host_regs = np.concatenate(
+                [self.host_regs,
+                 np.zeros((old, self.m), np.uint8)], axis=0)
+            return
         nr = np.zeros((self.n_lanes, self.capacity, self.m), np.uint8)
         nr[:, :old] = np.asarray(self.lanes_regs)
         self.lanes_regs = serving.put(nr, self._lane_shd)
@@ -335,25 +347,41 @@ class SetArena(_ArenaBase):
         else:
             np.maximum(mine, other, out=mine)
 
+    def _staged_triples(self):
+        """Consume raw staging into (rows, register index, rank) arrays."""
+        parts_r: list[np.ndarray] = []
+        parts_h: list[np.ndarray] = []
+        if self._stage_rows:
+            parts_r.append(np.asarray(self._stage_rows, np.int64))
+            parts_h.append(np.asarray(self._stage_hashes, np.uint64))
+            self._stage_rows, self._stage_hashes = [], []
+        for r, h in self._stage_chunks:
+            parts_r.append(r.astype(np.int64, copy=False))
+            parts_h.append(h)
+        self._stage_chunks = []
+        rows = (parts_r[0] if len(parts_r) == 1
+                else np.concatenate(parts_r))
+        hs = parts_h[0] if len(parts_h) == 1 else np.concatenate(parts_h)
+        idx, rank = hll_mod.split_hashes(hs, self.precision)
+        return rows, idx, rank
+
     def sync(self) -> None:
-        """Scatter staged inserts and imported rows into the device lanes.
-        Padding entries are all-zero ranks/registers, which max() ignores,
-        so the pow-of-two padding only buys jit-cache reuse."""
+        """Fold staged inserts and imported rows into the registers."""
+        if self.host_regs is not None:
+            if self._stage_rows or self._stage_chunks:
+                rows, idx, rank = self._staged_triples()
+                np.maximum.at(self.host_regs, (rows, idx), rank)
+            if self._merge_rows:
+                for row, regs in self._merge_rows.items():
+                    np.maximum(self.host_regs[row], regs,
+                               out=self.host_regs[row])
+                self._merge_rows = {}
+            return
+        # meshed: scatter into the device lanes (padding entries are
+        # all-zero ranks/registers, which max() ignores, so the pow-of-two
+        # padding only buys jit-cache reuse)
         if self._stage_rows or self._stage_chunks:
-            parts_r: list[np.ndarray] = []
-            parts_h: list[np.ndarray] = []
-            if self._stage_rows:
-                parts_r.append(np.asarray(self._stage_rows, np.int64))
-                parts_h.append(np.asarray(self._stage_hashes, np.uint64))
-                self._stage_rows, self._stage_hashes = [], []
-            for r, h in self._stage_chunks:
-                parts_r.append(r.astype(np.int64, copy=False))
-                parts_h.append(h)
-            self._stage_chunks = []
-            rows = (parts_r[0] if len(parts_r) == 1
-                    else np.concatenate(parts_r))
-            hs = parts_h[0] if len(parts_h) == 1 else np.concatenate(parts_h)
-            idx, rank = hll_mod.split_hashes(hs, self.precision)
+            rows, idx, rank = self._staged_triples()
             n = len(rows)
             padded = self._pad_pow2(n)
             pr = np.zeros(padded, np.int32)
@@ -383,13 +411,28 @@ class SetArena(_ArenaBase):
                 self.lanes_regs, jnp.asarray(pr), jnp.asarray(mat), lane)
 
     def snapshot_lanes(self) -> jnp.ndarray:
-        """Immutable ref to the current lane registers (sync first); the
-        family flush program pmax-merges and estimates them."""
+        """Meshed only: immutable ref to the current lane registers (sync
+        first); the flush program pmax-merges and estimates them."""
         self.sync()
         return self.lanes_regs
 
+    def host_estimates(self, rows: np.ndarray) -> np.ndarray:
+        """Mesh-less only: batched LogLog-Beta estimates of the given
+        rows' host registers (sync first)."""
+        self.sync()
+        return hll_mod.estimate_np_rows(self.host_regs[rows])
+
+    def host_regs_copy(self, rows: np.ndarray) -> np.ndarray:
+        """Mesh-less only: snapshot of the given rows' registers for
+        forwarding marshal (call under the aggregator lock)."""
+        return self.host_regs[rows].copy()
+
     def reset_rows(self, rows: np.ndarray) -> None:
         self.sync()
+        if self.host_regs is not None:
+            if len(rows):
+                self.host_regs[rows] = 0
+            return
         # runs even for empty rows: the kernel swaps in a fresh buffer so
         # the flush snapshot never aliases the live (donatable) one
         self.lanes_regs = serving.set_reset_rows(
@@ -397,20 +440,29 @@ class SetArena(_ArenaBase):
 
 
 class DigestArena(_ArenaBase):
-    """All histogram/timer digests as lane-striped batched centroid tensors.
+    """All histogram/timer digests as host-staged weighted points plus
+    host scalar accumulators; one device program per flush evaluates every
+    touched key at once (veneur_tpu/parallel/serving.py).
 
-    Device state is `[R, capacity, C]` mean/weight tensors — R independent
-    ingest *lanes* per key.  Sample waves stripe across lanes, which (a)
-    cuts a hot key's sequential compress-chain depth by R and (b) is the
-    replica axis of the sharded serving flush
-    (veneur_tpu/parallel/serving.py): with a device mesh, keys shard over
-    the 'shard' axis, lanes over 'replica', and the flush reduces lanes
-    with an ICI all_gather + batched compress — the production form of the
-    gRPC ImportMetric merge loop (`worker.go:402-459`).
+    There is NO persistent device centroid state.  An interval's samples —
+    and imported digest centroids (`Histo.Merge`,
+    `samplers/samplers.go:539-543`), which are just weighted points —
+    accumulate in host COO staging; flush uploads ONE compact dense
+    `[K_t, D]` matrix (touched rows only, D = pow2 max per-key depth) and
+    reads back one `[K_t, P+2]` evaluation.  Device traffic is therefore
+    proportional to the interval's samples, and nothing rewrites
+    hundreds of MB of HBM state per flush.  Hot keys whose staged depth
+    outgrows DENSE_DEPTH_CAP pre-reduce on device into <= C weighted
+    points via `serving.partial_digests` and re-stage — the two-stage
+    amortization of `mergeAllTemps` (`merging_digest.go:105-137`).
 
-    Host numpy tracks the true digest scalars (min/max/rsum — see module
-    docstring) and the *local-samples-only* scalar accumulators that back
-    the mixed-scope flush duality (`samplers/samplers.go:315-342`:
+    With a mesh, the dense matrix shards keys over 'shard' and depth over
+    'replica'; the flush all_gathers depth slices over ICI (the
+    collective ImportMetric merge, `worker.go:402-459`).
+
+    Host numpy tracks the true digest scalars (min/max/rsum) and the
+    *local-samples-only* scalar accumulators that back the mixed-scope
+    flush duality (`samplers/samplers.go:315-342`:
     LocalWeight/Min/Max/Sum/ReciprocalSum).
     """
 
@@ -420,23 +472,17 @@ class DigestArena(_ArenaBase):
         super().__init__(capacity)
         self.compression = compression
         self.ccap = td.centroid_capacity(compression)
-        n_replicas = self._init_mesh_lanes(mesh, "digest")
-        # n_lanes None or <1 means auto (Config documents 0 as auto)
-        r = n_lanes if n_lanes and n_lanes > 0 else max(2, 2 * n_replicas)
-        # lanes must tile the replica axis evenly
-        r = ((r + n_replicas - 1) // n_replicas) * n_replicas
-        self.n_lanes = r
-        self._row_shd = serving.row_sharding(mesh)
-        self._wave_shd = serving.row_sharding(mesh, ndim=2)
-        # [2, K] stacked min/max rides ONE upload per flush
-        self._minmax_shd = (None if mesh is None else
-                            serving.NamedSharding(
-                                mesh, serving.P(None, serving.SHARD_AXIS)))
-        self.lanes_mean = serving.put(
-            np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
-        self.lanes_weight = serving.put(
-            np.zeros((r, capacity, self.ccap), np.float32), self._lane_shd)
-        self._wave_seq = 0
+        self.n_replicas = self._init_mesh_lanes(mesh, "digest")
+        if mesh is not None:
+            from veneur_tpu.parallel.mesh import SHARD_AXIS
+            self.n_shards = mesh.shape[SHARD_AXIS]
+        else:
+            self.n_shards = 1
+        self._dense_shd = serving.dense_sharding(mesh)
+        self._minmax_shd = serving.minmax_sharding(mesh)
+        # n_lanes is accepted for config compatibility; the stateless
+        # design has no ingest lanes (depth shards over 'replica' instead)
+        del n_lanes
         # true digest scalars (local samples + imports)
         self.d_min = np.full(capacity, np.inf)
         self.d_max = np.full(capacity, -np.inf)
@@ -447,7 +493,7 @@ class DigestArena(_ArenaBase):
         self.l_max = np.full(capacity, -np.inf)
         self.l_sum = np.zeros(capacity)
         self.l_rsum = np.zeros(capacity)
-        # COO staging
+        # raw COO staging (scalars not yet applied)
         self._rows: list[int] = []
         self._vals: list[float] = []
         self._wts: list[float] = []
@@ -455,14 +501,12 @@ class DigestArena(_ArenaBase):
         # array-chunk staging from the native ingest engine (always local
         # samples; imports go through merge_digest)
         self._chunks: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        # consolidated interval accumulator: scalar-applied (rows, vals,
+        # wts) parts + per-row staged depth
+        self._acc: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._depth = np.zeros(capacity, np.int64)
 
     def _grow_state(self, old: int) -> None:
-        nm = np.zeros((self.n_lanes, self.capacity, self.ccap), np.float32)
-        nw = np.zeros_like(nm)
-        nm[:, :old] = np.asarray(self.lanes_mean)
-        nw[:, :old] = np.asarray(self.lanes_weight)
-        self.lanes_mean = serving.put(nm, self._lane_shd)
-        self.lanes_weight = serving.put(nw, self._lane_shd)
         pad = lambda a, fill: np.concatenate(
             [a, np.full(old, fill, a.dtype)])
         self.d_min = pad(self.d_min, np.inf)
@@ -473,6 +517,9 @@ class DigestArena(_ArenaBase):
         self.l_max = pad(self.l_max, -np.inf)
         self.l_sum = pad(self.l_sum, 0)
         self.l_rsum = pad(self.l_rsum, 0)
+        self._depth = pad(self._depth, 0)
+
+    # -- staging ----------------------------------------------------------
 
     def sample(self, row: int, value: float, sample_rate: float) -> None:
         """A locally-observed sample (Histo.Sample, samplers.go:331-342)."""
@@ -485,7 +532,7 @@ class DigestArena(_ArenaBase):
     def merge_digest(self, row: int, means, weights, dmin: float,
                      dmax: float, drsum: float) -> None:
         """Fold a forwarded digest into a row (Histo.Merge,
-        samplers.go:539-543): centroids re-ingested as weighted points,
+        samplers.go:539-543): centroids re-staged as weighted points,
         scalars merged exactly from the wire values."""
         self._rows.extend([row] * len(means))
         self._vals.extend(float(m) for m in means)
@@ -504,8 +551,14 @@ class DigestArena(_ArenaBase):
     def staged_count(self) -> int:
         return len(self._rows) + sum(len(r) for r, _, _ in self._chunks)
 
+    # -- consolidation / hot-key pre-reduction ----------------------------
+
     def sync(self) -> None:
-        """Scatter COO staging into dense waves and ingest on device."""
+        """Consolidate raw staging into the interval accumulator: apply
+        the host scalar updates, track per-row depth, and pre-reduce any
+        row whose backlog outgrew DENSE_DEPTH_CAP.  Called from the P7
+        drain ticks (so flush-time work covers only the final partial
+        tick) and at snapshot."""
         if not self._rows and not self._chunks:
             return
         parts = []
@@ -543,124 +596,142 @@ class DigestArena(_ArenaBase):
         with np.errstate(divide="ignore"):
             np.add.at(self.l_rsum, lr, lw / lv)
 
-        # dense waves: position of each sample within its row.  Wave w goes
-        # to lane (seq + w) % R, so a hot key's waves run on independent
-        # lane chains instead of one sequential compress chain.
-        order = np.argsort(rows, kind="stable")
-        r, v, w = rows[order], vals[order], wts[order]
-        first = np.searchsorted(r, np.arange(self.capacity))
-        pos = np.arange(len(r)) - first[r]
-        n_waves = int(pos.max()) // WAVE_WIDTH + 1
-        if n_waves > HOT_WAVE_THRESHOLD:
-            self._sync_hot(r, v, w, pos)
+        self._acc.append((rows, vals, wts))
+        np.add.at(self._depth, rows, 1)
+        # pre-reduce until every row fits the dense cap; each pass
+        # collapses a row's samples ~HOT_CHUNK_WIDTH -> ccap, so this
+        # converges in O(log) passes even for absurd backlogs
+        while int(self._depth.max()) > DENSE_DEPTH_CAP:
+            before = int(self._depth.max())
+            self._pre_reduce()
+            if int(self._depth.max()) >= before:
+                break
+
+    def _consolidated(self):
+        """Collapse _acc into single (rows, vals, wts) arrays."""
+        if not self._acc:
+            z = np.zeros(0)
+            return z.astype(np.int64), z, z
+        if len(self._acc) > 1:
+            rows = np.concatenate([p[0] for p in self._acc])
+            vals = np.concatenate([p[1] for p in self._acc])
+            wts = np.concatenate([p[2] for p in self._acc])
+            self._acc = [(rows, vals, wts)]
+        return self._acc[0]
+
+    def _pre_reduce(self) -> None:
+        """Collapse rows deeper than DENSE_DEPTH_CAP into <= ccap weighted
+        points each: group deep rows under a padded-element budget, run
+        one batched device compress per group (slim [U, C] readbacks), and
+        re-stage the centroids.  Scalars are NOT re-applied (the original
+        samples already updated them)."""
+        rows, vals, wts = self._consolidated()
+        deep = np.nonzero(self._depth > DENSE_DEPTH_CAP)[0]
+        if len(deep) == 0:
             return
-        wave = pos // WAVE_WIDTH
-        col = pos % WAVE_WIDTH
-        for wv in range(n_waves):
-            m = wave == wv
-            # clamp the wave to the actual per-row depth (pow2 for jit
-            # cache reuse): a 4-samples/key interval uploads [K, 4], not
-            # [K, 128] — host->device bytes scale with samples, not with
-            # arena capacity x WAVE_WIDTH
-            width = _pow2(int(col[m].max()) + 1)
-            dv = np.zeros((self.capacity, width), np.float32)
-            dw = np.zeros((self.capacity, width), np.float32)
-            dv[r[m], col[m]] = v[m]
-            dw[r[m], col[m]] = w[m]
-            lane = (self._wave_seq + wv) % self.n_lanes
-            self.lanes_mean, self.lanes_weight = serving.lane_ingest(
-                self.lanes_mean, self.lanes_weight,
-                serving.put(dv, self._wave_shd),
-                serving.put(dw, self._wave_shd),
-                lane, self.compression)
-        self._wave_seq = (self._wave_seq + n_waves) % self.n_lanes
+        is_deep = np.zeros(self.capacity, bool)
+        is_deep[deep] = True
+        sel = is_deep[rows]
+        keep = (rows[~sel], vals[~sel], wts[~sel])
+        drows, dvals, dwts = rows[sel], vals[sel], wts[sel]
+        order = np.argsort(drows, kind="stable")
+        drows, dvals, dwts = drows[order], dvals[order], dwts[order]
+        # split each row's samples into HOT_CHUNK_WIDTH-deep column
+        # chunks ("virtual rows"), so one pathological key never builds
+        # an unbounded-width dense matrix or a fresh jit shape per depth
+        rstarts = np.searchsorted(drows, drows)
+        rpos = np.arange(len(drows)) - rstarts
+        vrows = (drows << np.int64(20)) | (rpos // HOT_CHUNK_WIDTH)
+        urows, counts = np.unique(vrows, return_counts=True)
+        row_starts = np.concatenate([[0], np.cumsum(counts)])
+        out_r: list[np.ndarray] = []
+        out_v: list[np.ndarray] = []
+        out_w: list[np.ndarray] = []
+        g0 = 0
+        while g0 < len(urows):
+            g1 = g0 + 1
+            wmax = int(counts[g0])
+            while g1 < len(urows):
+                nw = max(wmax, int(counts[g1]))
+                if _pow2(g1 + 1 - g0) * _pow2(nw) > HOT_DENSE_BUDGET:
+                    break
+                wmax = nw
+                g1 += 1
+            slo, shi = int(row_starts[g0]), int(row_starts[g1])
+            group_rows = urows[g0:g1]
+            u_pad, w_pad = _pow2(g1 - g0), _pow2(wmax)
+            dv = np.zeros((u_pad, w_pad), np.float32)
+            dw = np.zeros_like(dv)
+            ridx = np.searchsorted(group_rows, vrows[slo:shi])
+            # position within virtual row = running index - its start
+            pos = np.arange(slo, shi) - row_starts[ridx + g0]
+            dv[ridx, pos] = dvals[slo:shi]
+            dw[ridx, pos] = dwts[slo:shi]
+            pm, pw = serving.partial_digests(
+                jnp.asarray(dv), jnp.asarray(dw), self.compression,
+                self.ccap)
+            pm = np.asarray(pm)[:len(group_rows)]
+            pw = np.asarray(pw)[:len(group_rows)]
+            occ = pw > 0
+            n_per = occ.sum(axis=1)
+            out_r.append(np.repeat(group_rows >> np.int64(20), n_per))
+            out_v.append(pm[occ].astype(np.float64))
+            out_w.append(pw[occ].astype(np.float64))
+            g0 = g1
+        new_r = np.concatenate([keep[0]] + out_r)
+        new_v = np.concatenate([keep[1]] + out_v)
+        new_w = np.concatenate([keep[2]] + out_w)
+        self._acc = [(new_r, new_v, new_w)]
+        self._depth[:] = 0
+        np.add.at(self._depth, new_r, 1)
 
-    def _sync_hot(self, r: np.ndarray, v: np.ndarray, w: np.ndarray,
-                  pos: np.ndarray) -> None:
-        """Hot-key ingest: collapse an arbitrarily deep sample backlog in
-        O(dense-elements / budget) launches instead of
-        O(samples/WAVE_WIDTH) sequential compress chains (round-1 verdict
-        weak #8).
+    # -- flush ------------------------------------------------------------
 
-        Stage 1 packs samples densely over only the touched rows and
-        batch-compresses them into per-row partial digests `[U, ccap]`;
-        stage 2 scatters the partials of a chunk into ONE capacity-wide
-        wave and folds it with a single `lane_ingest`.  Both dense axes
-        are bounded: columns by HOT_CHUNK_WIDTH (per-row depth chunking),
-        and the per-launch element count by HOT_DENSE_BUDGET (rows are
-        grouped so u_pad * w_pad never exceeds it — a sync staging many
-        shallow rows next to one deep row builds small matrices for the
-        shallow groups instead of one giant [U, w_max] slab).  Sample
-        partitioning is one stable sort + slicing, O(N log N) total."""
-        cw = HOT_CHUNK_WIDTH
-        chunk_id = pos // cw
-        order = np.argsort(chunk_id, kind="stable")  # rows stay sorted
-        r2, v2, w2 = r[order], v[order], w[order]
-        p2 = pos[order] - chunk_id[order] * cw       # col within chunk
-        cid = chunk_id[order]
-        n_chunks = int(cid[-1]) + 1
-        bounds = np.searchsorted(cid, np.arange(n_chunks + 1))
-        pow2 = _pow2
-        for c in range(n_chunks):
-            lo, hi = int(bounds[c]), int(bounds[c + 1])
-            if lo == hi:
-                continue
-            rc, vc, wc, pc = r2[lo:hi], v2[lo:hi], w2[lo:hi], p2[lo:hi]
-            urows, counts = np.unique(rc, return_counts=True)
-            row_starts = np.concatenate([[0], np.cumsum(counts)])
-            fv = np.zeros((self.capacity, self.ccap), np.float32)
-            fw = np.zeros((self.capacity, self.ccap), np.float32)
-            g0 = 0
-            while g0 < len(urows):
-                # grow the row group while the padded matrix fits budget
-                g1 = g0 + 1
-                wmax = int(counts[g0])
-                while g1 < len(urows):
-                    nw = max(wmax, int(counts[g1]))
-                    if (pow2(g1 + 1 - g0) * pow2(nw)
-                            > HOT_DENSE_BUDGET):
-                        break
-                    wmax = nw
-                    g1 += 1
-                slo, shi = int(row_starts[g0]), int(row_starts[g1])
-                group_rows = urows[g0:g1]
-                ridx = np.searchsorted(group_rows, rc[slo:shi])
-                dv = np.zeros((pow2(g1 - g0), pow2(wmax)), np.float32)
-                dw = np.zeros_like(dv)
-                dv[ridx, pc[slo:shi]] = vc[slo:shi]
-                dw[ridx, pc[slo:shi]] = wc[slo:shi]
-                pm, pw = serving.partial_digests(
-                    jnp.asarray(dv), jnp.asarray(dw), self.compression,
-                    self.ccap)
-                fv[group_rows] = np.asarray(pm)[:len(group_rows)]
-                fw[group_rows] = np.asarray(pw)[:len(group_rows)]
-                g0 = g1
-            # stage 2: one capacity-wide fold per chunk
-            lane = self._wave_seq % self.n_lanes
-            self.lanes_mean, self.lanes_weight = serving.lane_ingest(
-                self.lanes_mean, self.lanes_weight,
-                serving.put(fv, self._wave_shd),
-                serving.put(fw, self._wave_shd),
-                lane, self.compression)
-            self._wave_seq = (self._wave_seq + 1) % self.n_lanes
+    def take_staged(self):
+        """Consume the interval accumulator (call under the aggregator
+        lock, after sync()): returns (rows, vals, wts) COO arrays."""
+        rows, vals, wts = self._consolidated()
+        self._acc = []
+        return rows, vals, wts
 
-    def snapshot_lanes(self) -> tuple:
-        """Immutable refs to the current lane tensors plus f32 copies of the
-        authoritative min/max scalars — everything the flush program needs
-        (rsum stays host-side; hmean is emitted from host scalars).  Call
-        under the aggregator lock, then `reset_rows`; emission evaluates the
-        snapshot outside the lock via `flush_fn`."""
-        self.sync()
-        minmax = np.stack([self.d_min, self.d_max]).astype(np.float32)
-        return (self.lanes_mean, self.lanes_weight,
+    def build_dense(self, staged, touched: np.ndarray,
+                    d_min_t: np.ndarray, d_max_t: np.ndarray):
+        """Compact dense build for the flush program: map the staged COO
+        onto touched-row-ordered dense matrices `[U, D]` (U = padded
+        touched count, D = padded max depth), plus the stacked [2, U]
+        min/max from the SNAPSHOT scalar copies (the live arrays are
+        already reset by the time this runs).  Pure host numpy; the
+        caller device_puts the result (outside the aggregator lock)."""
+        rows, vals, wts = staged
+        nd = len(touched)
+        u_pad = self.n_shards * _pow2(-(-max(nd, 1) // self.n_shards))
+        dense_id = np.full(self.capacity, -1, np.int64)
+        dense_id[touched] = np.arange(nd)
+        r = dense_id[rows]
+        order = np.argsort(r, kind="stable")
+        r, v, w = r[order], vals[order], wts[order]
+        first = np.searchsorted(r, np.arange(nd))
+        pos = np.arange(len(r)) - first[r]
+        depth = int(pos.max()) + 1 if len(r) else 1
+        d_pad = max(2, self.n_replicas * _pow2(
+            -(-depth // self.n_replicas)))
+        dv = np.zeros((u_pad, d_pad), np.float32)
+        dw = np.zeros((u_pad, d_pad), np.float32)
+        dv[r, pos] = v
+        dw[r, pos] = w
+        minmax = np.zeros((2, u_pad), np.float32)
+        minmax[0, :nd] = d_min_t
+        minmax[1, :nd] = d_max_t
+        return dv, dw, minmax
+
+    def put_dense(self, dv: np.ndarray, dw: np.ndarray,
+                  minmax: np.ndarray):
+        """Device-put the dense build with the mesh shardings."""
+        return (serving.put(dv, self._dense_shd),
+                serving.put(dw, self._dense_shd),
                 serving.put(minmax, self._minmax_shd))
 
     def reset_rows(self, rows: np.ndarray) -> None:
-        # runs even for empty rows: the kernel swaps in fresh buffers so
-        # the flush snapshot never aliases the live (donatable) ones
-        self.lanes_mean, self.lanes_weight = serving.reset_rows(
-            self.lanes_mean, self.lanes_weight,
-            jnp.asarray(self._reset_index(rows)))
         if len(rows) == 0:
             return
         self.d_min[rows] = np.inf
@@ -671,3 +742,4 @@ class DigestArena(_ArenaBase):
         self.l_max[rows] = -np.inf
         self.l_sum[rows] = 0
         self.l_rsum[rows] = 0
+        self._depth[rows] = 0

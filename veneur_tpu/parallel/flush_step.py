@@ -1,27 +1,28 @@
 """The sharded global-aggregation flush step — the framework's flagship
 SPMD program.
 
-One call evaluates the whole global tier's flush: staged partial sketches
-from R ingest lanes are reduced into the persistent per-key state and every
-key's percentiles/aggregates/cardinalities come back, with
-  - t-digest reduce  = all_gather(centroids) over the replica axis +
-    batched compress (the collective form of Histo.Merge,
+One call evaluates the whole global tier's flush: the interval's staged
+weighted points (raw samples and forwarded digest centroids alike) are
+evaluated for every key at once, with
+  - t-digest reduce  = all_gather(sample slices) over the replica axis +
+    one batched sorted evaluation (the collective form of Histo.Merge,
     `samplers/samplers.go:539-543` / `worker.go:402-459`),
-  - HLL reduce       = lax.pmax over replica registers,
-  - counter reduce   = lax.psum,
+  - HLL reduce       = lax.pmax over replica register lanes,
+  - counter reduce   = lax.psum over (hi, lo) f32 planes,
   - unique-timeseries tally = pmax over *both* axes + estimate
     (the device analog of tallyTimeseries, `flusher.go:249-258`).
 
 Keys are sharded over the 'shard' mesh axis, so each device only touches
 its K/n_shards rows; collectives ride ICI within the replica groups.
-Single-device use (entry() in __graft_entry__.py) is the same function with
-a 1x1 mesh.
+Single-device use (entry() in __graft_entry__.py) is the same body with no
+collectives.  The body is shared with the production serving path
+(veneur_tpu/parallel/serving.py flush_body) — this module only packages it
+with example inputs for compile checks and the benchmark.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -29,168 +30,72 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from veneur_tpu.parallel import serving
 from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
-from veneur_tpu.sketches import hll as hll_mod
 from veneur_tpu.sketches import tdigest as td
 
-
-class FlushInputs(NamedTuple):
-    """Device-resident inputs to one global flush.
-
-    Shapes (K = keys, R = ingest lanes/replicas, C = centroid cap,
-    S = set keys, m = HLL registers, P implicit in percentiles arg):
-    """
-    state_mean: jax.Array      # [K, C]   persistent digest state
-    state_weight: jax.Array    # [K, C]
-    state_min: jax.Array       # [K]
-    state_max: jax.Array       # [K]
-    state_rsum: jax.Array      # [K]
-    in_means: jax.Array        # [R, K, C] staged incoming digests
-    in_weights: jax.Array      # [R, K, C]
-    in_min: jax.Array          # [R, K]
-    in_max: jax.Array          # [R, K]
-    in_rsum: jax.Array         # [R, K]
-    hll_regs: jax.Array        # [R, S, m] staged incoming HLL registers
-    counters: jax.Array        # [R, K] staged counter partials
-    uts_regs: jax.Array        # [R, m] unique-timeseries HLL partials
+FlushInputs = serving.FlushInputs
+FlushOutputs = serving.FlushOutputs
 
 
-class FlushOutputs(NamedTuple):
-    new_mean: jax.Array        # [K, C] merged digest state
-    new_weight: jax.Array      # [K, C]
-    new_min: jax.Array         # [K]
-    new_max: jax.Array         # [K]
-    new_rsum: jax.Array        # [K]
-    quantiles: jax.Array       # [K, P]
-    counts: jax.Array          # [K]
-    sums: jax.Array            # [K]
-    counter_totals: jax.Array  # [K]
-    set_estimates: jax.Array   # [S]
-    unique_ts: jax.Array       # [] scalar
-
-
-def _local_flush(inputs: FlushInputs, percentiles: jax.Array,
-                 compression: float, axis: str | None) -> FlushOutputs:
-    """Per-shard flush body; `axis` names the replica mesh axis for
-    collectives (None = no mesh, plain single-device math)."""
-    if axis is not None:
-        # Reduce staged scalar partials across the replica axis; the
-        # centroid-lane gather happens inside serving.reduce_eval (the
-        # shared digest-flush core used by the serving path too).
-        in_min = jax.lax.pmin(jnp.min(inputs.in_min, axis=0), axis)
-        in_max = jax.lax.pmax(jnp.max(inputs.in_max, axis=0), axis)
-        in_rsum = jax.lax.psum(jnp.sum(inputs.in_rsum, axis=0), axis)
-        hll_regs = jax.lax.pmax(jnp.max(inputs.hll_regs, axis=0), axis)
-        counter_totals = jax.lax.psum(jnp.sum(inputs.counters, axis=0), axis)
-        uts = jax.lax.pmax(jnp.max(inputs.uts_regs, axis=0), axis)
-    else:
-        in_min = jnp.min(inputs.in_min, axis=0)
-        in_max = jnp.max(inputs.in_max, axis=0)
-        in_rsum = jnp.sum(inputs.in_rsum, axis=0)
-        hll_regs = jnp.max(inputs.hll_regs, axis=0)
-        counter_totals = jnp.sum(inputs.counters, axis=0)
-        uts = jnp.max(inputs.uts_regs, axis=0)
-
-    new_min = jnp.minimum(inputs.state_min, in_min)
-    new_max = jnp.maximum(inputs.state_max, in_max)
-    new_rsum = inputs.state_rsum + in_rsum
-    merged = serving.reduce_eval(
-        inputs.in_means, inputs.in_weights,
-        new_min, new_max, new_rsum,
-        percentiles, compression, axis,
-        state_mean=inputs.state_mean, state_weight=inputs.state_weight)
-
-    set_est = hll_mod.estimate(hll_regs)
-
-    if axis is not None:
-        # union the unique-timeseries registers across shards too
-        uts = jax.lax.pmax(uts, SHARD_AXIS)
-    uts_est = hll_mod.estimate(uts[None, :])[0]
-
-    return FlushOutputs(
-        new_mean=merged.mean, new_weight=merged.weight,
-        new_min=new_min, new_max=new_max, new_rsum=new_rsum,
-        quantiles=merged.quantiles, counts=merged.counts, sums=merged.sums,
-        counter_totals=counter_totals, set_estimates=set_est,
-        unique_ts=uts_est)
-
-
-@functools.partial(jax.jit, static_argnames=("compression",))
-def flush_step(inputs: FlushInputs, percentiles: jax.Array,
-               compression: float = td.DEFAULT_COMPRESSION) -> FlushOutputs:
+@jax.jit
+def flush_step(inputs: FlushInputs, percentiles: jax.Array) -> FlushOutputs:
     """Single-device flush step (the compile-checked entry point)."""
-    return _local_flush(inputs, percentiles, compression, axis=None)
+    return serving.flush_body(inputs, percentiles, axis=None)
 
 
-def make_sharded_flush_step(mesh: Mesh,
-                            compression: float = td.DEFAULT_COMPRESSION):
-    """Build the pjit'd multi-chip flush step over a (shard, replica) mesh.
+def make_sharded_flush_step(mesh: Mesh):
+    """Build the shard_map'd multi-chip flush step over a
+    (shard, replica) mesh.
 
-    Returns a function (FlushInputs, percentiles) -> FlushOutputs whose
-    inputs/outputs carry these shardings:
-      state/K-arrays:      P(shard)           (key-space partition)
-      staged [R, ...]:     P(replica, shard)  (lane-partitioned partials)
-      uts_regs [R, m]:     P(replica)
-      outputs:             P(shard) / replicated scalars
+    Input shardings: dense sample matrices `[K, D]` carry keys over
+    'shard' and depth over 'replica'; register/counter lanes `[R, ...]`
+    carry lanes over 'replica' with rows over 'shard'; outputs come back
+    sharded over 'shard' (scalars replicated).
     """
-    spec_k = P(SHARD_AXIS)
-    spec_kc = P(SHARD_AXIS, None)
-    spec_rkc = P(REPLICA_AXIS, SHARD_AXIS, None)
-    spec_rk = P(REPLICA_AXIS, SHARD_AXIS)
-    spec_rsm = P(REPLICA_AXIS, SHARD_AXIS, None)
-    spec_rm = P(REPLICA_AXIS, None)
-
-    in_specs = (FlushInputs(
-        state_mean=spec_kc, state_weight=spec_kc,
-        state_min=spec_k, state_max=spec_k, state_rsum=spec_k,
-        in_means=spec_rkc, in_weights=spec_rkc,
-        in_min=spec_rk, in_max=spec_rk, in_rsum=spec_rk,
-        hll_regs=spec_rsm, counters=spec_rk, uts_regs=spec_rm),
-        P(None))
-    out_specs = FlushOutputs(
-        new_mean=spec_kc, new_weight=spec_kc,
-        new_min=spec_k, new_max=spec_k, new_rsum=spec_k,
-        quantiles=spec_kc, counts=spec_k, sums=spec_k,
-        counter_totals=spec_k, set_estimates=spec_k,
-        unique_ts=P())
-
-    def body(inputs: FlushInputs, percentiles: jax.Array) -> FlushOutputs:
-        return _local_flush(inputs, percentiles, compression, REPLICA_AXIS)
-
-    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
+    spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
+    fn = jax.shard_map(
+        functools.partial(serving.flush_body, axis=REPLICA_AXIS),
+        mesh=mesh,
+        in_specs=(FlushInputs(
+            dense_v=P(SHARD_AXIS, REPLICA_AXIS),
+            dense_w=P(SHARD_AXIS, REPLICA_AXIS),
+            minmax=P(None, SHARD_AXIS),
+            hll_regs=spec_lanes,
+            counter_planes=spec_lanes,
+            uts_regs=P(REPLICA_AXIS, None)), P(None)),
+        out_specs=FlushOutputs(
+            digest_eval=P(SHARD_AXIS, None),
+            counter_hi=P(SHARD_AXIS), counter_lo=P(SHARD_AXIS),
+            set_regs=P(SHARD_AXIS, None), set_estimates=P(SHARD_AXIS),
+            unique_ts=P()),
+        check_vma=False)
     return jax.jit(fn)
 
 
 def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,
+                   depth: int = 32,
                    compression: float = td.DEFAULT_COMPRESSION,
                    hll_p: int = 10, seed: int = 0) -> FlushInputs:
-    """Small synthetic inputs for compile checks and dry runs."""
+    """Small synthetic inputs for compile checks and dry runs: every key
+    holds `n_lanes * depth` staged weighted points (the dense depth axis
+    tiles the replica mesh axis evenly)."""
     import numpy as np
     rng = np.random.default_rng(seed)
-    C = td.centroid_capacity(compression)
     m = 1 << hll_p
     k, r, s = n_keys, n_lanes, n_sets
+    d = r * depth
 
-    def digest_batch(shape_prefix):
-        vals = rng.gamma(2.0, 10.0, shape_prefix + (32,)).astype(np.float32)
-        means = np.zeros(shape_prefix + (C,), np.float32)
-        weights = np.zeros(shape_prefix + (C,), np.float32)
-        means[..., :32] = np.sort(vals, axis=-1)
-        weights[..., :32] = 1.0
-        return means, weights, vals.min(-1), vals.max(-1), (1 / vals).sum(-1)
-
-    sm, sw, smin, smax, srs = digest_batch((k,))
-    im, iw, imin, imax, irs = digest_batch((r, k))
+    vals = rng.gamma(2.0, 10.0, (k, d)).astype(np.float32)
+    wts = np.ones((k, d), np.float32)
+    minmax = np.stack([vals.min(axis=1), vals.max(axis=1)]).astype(
+        np.float32)
+    counters = rng.integers(0, 100, (r, k)).astype(np.float32)
+    planes = np.stack(
+        [np.zeros_like(counters), counters], axis=-1)  # values < 2^24
     return FlushInputs(
-        state_mean=jnp.asarray(sm), state_weight=jnp.asarray(sw),
-        state_min=jnp.asarray(smin), state_max=jnp.asarray(smax),
-        state_rsum=jnp.asarray(srs),
-        in_means=jnp.asarray(im), in_weights=jnp.asarray(iw),
-        in_min=jnp.asarray(imin), in_max=jnp.asarray(imax),
-        in_rsum=jnp.asarray(irs),
+        dense_v=jnp.asarray(vals), dense_w=jnp.asarray(wts),
+        minmax=jnp.asarray(minmax),
         hll_regs=jnp.asarray(
             rng.integers(0, 20, (r, s, m)).astype(np.uint8)),
-        counters=jnp.asarray(
-            rng.integers(0, 100, (r, k)).astype(np.float32)),
+        counter_planes=jnp.asarray(planes),
         uts_regs=jnp.asarray(
             rng.integers(0, 20, (r, m)).astype(np.uint8)))

@@ -12,7 +12,7 @@ Axis/topology mapping (why the layout is DCN-friendly):
     reshape is row-major, so when `replicas` DIVIDES the per-host device
     count each replica group is a contiguous intra-host run.  The
     flush's only collective (the replica-axis `all_gather` in
-    `parallel/serving.py reduce_eval`) then rides ICI; `make_mesh` warns
+    `parallel/serving.py flush_body`) then rides ICI; `make_mesh` warns
     when a configured replica count would straddle hosts;
   * the `shard` axis (key-space partition) spans hosts but needs NO
     collective — each key's digests live on exactly one shard, the

@@ -1,45 +1,31 @@
-"""The serving-path SPMD programs: lane ingest + the per-flush family reduce.
+"""The serving-path SPMD programs: the per-flush family evaluation.
 
-This wires the sharded flush (veneur_tpu/parallel/flush_step.py) into the
-*production* aggregation tier: `DigestArena` keeps its centroid state as
-lane-striped device tensors `[R, K, C]` (R ingest lanes x K keys x C
-centroid slots), `SetArena` keeps its HLL registers as `[R_s, S, m]`
-lane-striped uint8 tensors, both sharded over a (shard, replica) `Mesh`
-when one is configured —
+This wires the sharded flush into the *production* aggregation tier.  The
+digest pipeline is **stateless on device**: every interval's samples (and
+imported digest centroids, which are just weighted points) stage host-side
+in `DigestArena`, and one program per flush evaluates the whole tier —
 
-  - the **shard** axis partitions the key space K (the device analog of the
-    reference's fnv1a-hash worker sharding, `server.go:997-1011` /
-    `worker.go:34-50`, and of the proxy's consistent-hash ring);
-  - the **replica** axis partitions the R ingest lanes, so each replica
-    group accumulates a subset of lanes' partial digests and the flush
-    reduces them with an `all_gather` over ICI followed by one batched
-    compress — the collective form of the gRPC ImportMetric merge loop
+  - the **shard** mesh axis partitions the touched-key space (the device
+    analog of the reference's fnv1a-hash worker sharding,
+    `server.go:997-1011` / `worker.go:34-50`, and of the proxy's
+    consistent-hash ring);
+  - the **replica** axis partitions the sample depth `D`: each replica
+    group holds a slice of every key's staged points, and the flush
+    all_gathers the slices over ICI before one batched sorted evaluation —
+    the collective form of the gRPC ImportMetric merge loop
     (`worker.go:402-459`).
 
-The programs:
+Per-flush device traffic is minimal by design: upload = the interval's
+staged points (`[K, D]`, proportional to samples), download = one
+`[K, P+2]` evaluation matrix.  No persistent centroid state is rewritten
+per flush — t-digest *compression* runs only where the sketch must stay
+bounded: forwarding export (`digest_export`) and hot-key
+pre-reduction (`partial_digests`), both of which return slim arrays.
 
-  * `lane_ingest`   — fold one dense sample wave `[K, W]` into lane r of the
-                      striped state (the device half of `DigestArena.sync`).
-                      Striping waves across lanes both feeds the replica
-                      axis and cuts the sequential kernel-launch depth for a
-                      hot key by R (each lane's chain is independent).
-  * `set_lane_scatter` / `set_lane_merge_rows` — scatter-max staged HLL
-                      (row, register, rank) updates / imported register rows
-                      into lane r of the set state (Sketch.Insert / Merge,
-                      `samplers/samplers.go:242-244,299-311`).
-  * `make_family_flush` — build the per-flush evaluation for EVERY sampler
-                      family in one program: gather digest lanes over the
-                      replica axis and merge+evaluate percentiles, pmax the
-                      HLL set lanes and estimate cardinalities, psum the
-                      hi/lo counter planes, and estimate the
-                      unique-timeseries HLL (tallyTimeseries,
-                      `flusher.go:249-258`).  With `mesh=None` this is the
-                      same math under plain `jit` on the default device, so
-                      single-chip and multi-chip serving share one code
-                      path.
-  * `reset_rows` / `set_reset_rows` — zero the touched rows across every
-                      lane after flush (the map-swap of `worker.go:462-481`;
-                      rows persist, state is interval-scoped).
+Sets (HLL registers) and counters keep device-resident lane state only
+when a mesh is configured (the registers then pmax over 'replica' and the
+counter hi/lo planes psum); without a mesh both families resolve on host
+(see core/arena.py) and the program evaluates digests only.
 
 Counters ride as two float32 planes (hi, lo) with value = hi * 2^24 + lo:
 each plane is integer-exact below 2^24, so the psum'd total is exact below
@@ -65,26 +51,25 @@ from veneur_tpu.sketches import tdigest as td
 COUNTER_SPLIT = float(1 << 24)
 
 
-class ServingFlushOutputs(NamedTuple):
-    mean: jax.Array       # [K, C] merged centroids (forwarding export)
-    weight: jax.Array     # [K, C]
-    quantiles: jax.Array  # [K, P]
-    counts: jax.Array     # [K] total weight
-    sums: jax.Array       # [K] weighted sum
+class FlushInputs(NamedTuple):
+    """Device inputs to one full flush (shapes: K touched digest keys
+    padded pow2, D staged depth padded pow2, R replica lanes, S set rows,
+    m HLL registers, K2 counter rows)."""
+    dense_v: jax.Array        # [K, D] f32 staged values / centroid means
+    dense_w: jax.Array        # [K, D] f32 weights (0 = empty cell)
+    minmax: jax.Array         # [2, K] f32 authoritative min;max
+    hll_regs: jax.Array       # [R, S, m] u8 set register lanes
+    counter_planes: jax.Array  # [R, K2, 2] f32 (hi, lo)
+    uts_regs: jax.Array       # [R, m_u] u8 unique-timeseries registers
 
 
-class FamilyFlushOutputs(NamedTuple):
-    """One production flush, every sampler family reduced on device."""
-    mean: jax.Array           # [K, C] merged centroids (forwarding export)
-    weight: jax.Array         # [K, C]
-    quantiles: jax.Array      # [K, P]
-    counts: jax.Array         # [K] total digest weight
-    sums: jax.Array           # [K] weighted sum
-    set_regs: jax.Array       # [S, m] uint8 merged HLL registers
-    set_estimates: jax.Array  # [S] f32 cardinality estimates
-    counter_hi: jax.Array     # [K2] f32 psum'd high counter plane
-    counter_lo: jax.Array     # [K2] f32 psum'd low counter plane
-    unique_ts: jax.Array      # [] f32 distinct-timeseries estimate
+class FlushOutputs(NamedTuple):
+    digest_eval: jax.Array    # [K, P+2]: P quantiles, total weight, sum
+    counter_hi: jax.Array     # [K2]
+    counter_lo: jax.Array     # [K2]
+    set_regs: jax.Array       # [S, m] u8 merged registers (forwarding)
+    set_estimates: jax.Array  # [S] f32
+    unique_ts: jax.Array      # [] f32
 
 
 # ---------------------------------------------------------------------------
@@ -92,18 +77,25 @@ class FamilyFlushOutputs(NamedTuple):
 # ---------------------------------------------------------------------------
 
 def lane_sharding(mesh: Optional[Mesh]):
-    """[R, K, C] lane-striped state: lanes over 'replica', keys over
+    """[R, K, ...] lane-striped state: lanes over 'replica', keys over
     'shard'."""
     if mesh is None:
         return None
     return NamedSharding(mesh, P(REPLICA_AXIS, SHARD_AXIS, None))
 
 
-def row_sharding(mesh: Optional[Mesh], ndim: int = 1):
-    """[K, ...] per-key arrays: keys over 'shard'."""
+def dense_sharding(mesh: Optional[Mesh]):
+    """[K, D] staged sample matrices: keys over 'shard', depth over
+    'replica' (the replica groups each evaluate a sample slice)."""
     if mesh is None:
         return None
-    return NamedSharding(mesh, P(SHARD_AXIS, *([None] * (ndim - 1))))
+    return NamedSharding(mesh, P(SHARD_AXIS, REPLICA_AXIS))
+
+
+def minmax_sharding(mesh: Optional[Mesh]):
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(None, SHARD_AXIS))
 
 
 def put(x, sharding):
@@ -112,27 +104,85 @@ def put(x, sharding):
 
 
 # ---------------------------------------------------------------------------
-# Lane ingest
+# Flush body (shared by the serving path and the bench's flush_step)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("lane", "compression"),
-                   donate_argnums=(0, 1))
-def lane_ingest(lanes_mean: jax.Array, lanes_weight: jax.Array,
-                values: jax.Array, vweights: jax.Array,
-                lane: int, compression: float
-                ) -> tuple[jax.Array, jax.Array]:
-    """Fold a dense sample wave `[K, W]` into lane `lane` of `[R, K, C]`.
+def flush_body(inputs: FlushInputs, percentiles: jax.Array,
+               axis: Optional[str]) -> FlushOutputs:
+    """Evaluate every family for one flush.  `axis` names the replica mesh
+    axis for collectives (None = single device, identical math)."""
+    dv, dw = inputs.dense_v, inputs.dense_w
+    if axis is not None:
+        # gather every replica's sample slice: [K_s, D/R] -> [K_s, D]
+        dv = jax.lax.all_gather(dv, axis, axis=1, tiled=True)
+        dw = jax.lax.all_gather(dw, axis, axis=1, tiled=True)
+    ev = td.weighted_eval(dv, dw, inputs.minmax[0], inputs.minmax[1],
+                          percentiles)
 
-    Device half of `MergingDigest.Add`/`mergeAllTemps`
-    (`merging_digest.go:115-224`) batched over all keys; min/max/rsum are
-    tracked host-side by the arena (they are authoritative there — see
-    DigestArena docstring) so only centroids live here.
+    set_regs = jnp.max(inputs.hll_regs, axis=0)
+    chi = jnp.sum(inputs.counter_planes[..., 0], axis=0)
+    clo = jnp.sum(inputs.counter_planes[..., 1], axis=0)
+    uts = jnp.max(inputs.uts_regs, axis=0)
+    if axis is not None:
+        set_regs = jax.lax.pmax(set_regs, axis)
+        chi = jax.lax.psum(chi, axis)
+        clo = jax.lax.psum(clo, axis)
+        uts = jax.lax.pmax(jax.lax.pmax(uts, axis), SHARD_AXIS)
+    return FlushOutputs(
+        digest_eval=ev, counter_hi=chi, counter_lo=clo,
+        set_regs=set_regs, set_estimates=hll_mod.estimate(set_regs),
+        unique_ts=hll_mod.estimate(uts[None, :])[0])
+
+
+def make_serving_flush(mesh: Optional[Mesh]):
+    """Build the per-flush program.
+
+    Without a mesh, returns fn(dense_v, dense_w, minmax, percentiles) ->
+    [K, P+2] — digests only, because sets/counters/unique-ts resolve on
+    host when there is nothing to reduce over (core/arena.py).
+
+    With a mesh, returns the shard_map'd full-family program
+    fn(FlushInputs, percentiles) -> FlushOutputs: keys and set/counter
+    rows shard over 'shard'; staged sample depth, set register lanes and
+    counter planes reduce over 'replica' (all_gather / pmax / psum); the
+    unique-timeseries registers pmax over both axes (across processes
+    this is the DCN union of per-host tallies).
     """
-    cap = lanes_mean.shape[2]
-    cat_m = jnp.concatenate([lanes_mean[lane], values], axis=1)
-    cat_w = jnp.concatenate([lanes_weight[lane], vweights], axis=1)
-    nm, nw = td.compress(cat_m, cat_w, compression, cap)
-    return lanes_mean.at[lane].set(nm), lanes_weight.at[lane].set(nw)
+    if mesh is None:
+        return jax.jit(
+            lambda dv, dw, minmax, pct: td.weighted_eval(
+                dv, dw, minmax[0], minmax[1], pct))
+
+    spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
+    fn = jax.shard_map(
+        functools.partial(flush_body, axis=REPLICA_AXIS),
+        mesh=mesh,
+        in_specs=(FlushInputs(
+            dense_v=P(SHARD_AXIS, REPLICA_AXIS),
+            dense_w=P(SHARD_AXIS, REPLICA_AXIS),
+            minmax=P(None, SHARD_AXIS),
+            hll_regs=spec_lanes,
+            counter_planes=spec_lanes,
+            uts_regs=P(REPLICA_AXIS, None)), P(None)),
+        out_specs=FlushOutputs(
+            digest_eval=P(SHARD_AXIS, None),
+            counter_hi=P(SHARD_AXIS), counter_lo=P(SHARD_AXIS),
+            set_regs=P(SHARD_AXIS, None), set_estimates=P(SHARD_AXIS),
+            unique_ts=P()),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.partial(jax.jit, static_argnames=("compression", "cap"))
+def digest_export(dense_v: jax.Array, dense_w: jax.Array,
+                  rows: jax.Array, compression: float, cap: int
+                  ) -> tuple[jax.Array, jax.Array]:
+    """Compress the staged points of the given (compacted) rows into wire
+    centroids `[F, cap]` for forwarding (ForwardableMetrics,
+    `worker.go:179-216` / `MergingDigest.Data`,
+    `merging_digest.go:474-483`).  Gathers rows first so both the compute
+    and the readback scale with the forwarded subset, not the arena."""
+    return td.compress(dense_v[rows], dense_w[rows], compression, cap)
 
 
 @functools.partial(jax.jit, static_argnames=("compression", "cap"))
@@ -140,24 +190,14 @@ def partial_digests(dense_v: jax.Array, dense_w: jax.Array,
                     compression: float, cap: int
                     ) -> tuple[jax.Array, jax.Array]:
     """One batched compress of a dense `[U, W]` sample matrix into per-row
-    partial digests `[U, cap]` — stage 1 of the hot-key ingest path (the
-    tree form of `mergeAllTemps`: any W collapses in a single launch
-    instead of a W/wave-width sequential chain)."""
+    partial digests `[U, cap]` — the hot-key pre-reduction: an arbitrarily
+    deep backlog collapses into <= cap weighted points per row, which
+    re-stage as ordinary samples (weight-preserving, order-invariant)."""
     return td.compress(dense_v, dense_w, compression, cap)
 
 
-@jax.jit
-def reset_rows(lanes_mean: jax.Array, lanes_weight: jax.Array,
-               rows: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Zero the given key rows in every lane.  NOT donating: the flush
-    snapshot may still reference the pre-reset buffers while emission runs
-    outside the aggregator lock."""
-    return (lanes_mean.at[:, rows].set(0.0),
-            lanes_weight.at[:, rows].set(0.0))
-
-
 # ---------------------------------------------------------------------------
-# Set (HLL) lane ingest
+# Set (HLL) lane kernels — device-resident register state (meshed tiers)
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("lane",), donate_argnums=(0,))
@@ -182,150 +222,10 @@ def set_lane_merge_rows(lanes_regs: jax.Array, rows: jax.Array,
 
 @jax.jit
 def set_reset_rows(lanes_regs: jax.Array, rows: jax.Array) -> jax.Array:
-    """Zero the given set rows in every lane (NOT donating — see
-    reset_rows)."""
+    """Zero the given set rows in every lane.  NOT donating: the flush
+    snapshot may still reference the pre-reset buffer while emission runs
+    outside the aggregator lock."""
     return lanes_regs.at[:, rows].set(0)
-
-
-# ---------------------------------------------------------------------------
-# Flush evaluation
-# ---------------------------------------------------------------------------
-
-def reduce_eval(lanes_mean, lanes_weight, d_min, d_max, d_rsum,
-                percentiles, compression, replica_axis,
-                state_mean=None, state_weight=None) -> ServingFlushOutputs:
-    """THE digest-flush core, shared by the serving path and the benchmark
-    flush_step: all_gather lanes over the replica axis -> one batched
-    compress (optionally folding a persistent [K, C] state in) -> evaluate
-    quantiles/counts/sums for every key at once.
-
-    `replica_axis` names the mesh axis to gather over (None = single
-    device).  The merged min/max/rsum come from the caller's authoritative
-    scalars (re-ingested centroid means never reach the true extremes —
-    `worker.go:402-459` semantics); pass zeros for rsum if the caller
-    tracks it host-side (no device computation consumes it).
-    """
-    if replica_axis is not None:
-        lanes_mean = jax.lax.all_gather(
-            lanes_mean, replica_axis, axis=0, tiled=True)
-        lanes_weight = jax.lax.all_gather(
-            lanes_weight, replica_axis, axis=0, tiled=True)
-    k = lanes_mean.shape[1]
-    cap = lanes_mean.shape[2]
-    flat_m = jnp.transpose(lanes_mean, (1, 0, 2)).reshape(k, -1)
-    flat_w = jnp.transpose(lanes_weight, (1, 0, 2)).reshape(k, -1)
-    if state_mean is not None:
-        flat_m = jnp.concatenate([state_mean, flat_m], axis=1)
-        flat_w = jnp.concatenate([state_weight, flat_w], axis=1)
-    mm, mw = td.compress(flat_m, flat_w, compression, cap)
-    merged = td.TDigestState(mean=mm, weight=mw,
-                             min=d_min, max=d_max, rsum=d_rsum)
-    return ServingFlushOutputs(
-        mean=mm, weight=mw,
-        quantiles=td.quantile(merged, percentiles),
-        counts=td.total_weight(merged),
-        sums=td.sum_values(merged))
-
-
-def make_family_flush(mesh: Optional[Mesh],
-                      compression: float = td.DEFAULT_COMPRESSION):
-    """Build the per-flush program covering every sampler family.
-
-    Returns fn(lanes_mean [R,K,C], lanes_weight, d_minmax [2,K] (min;max,
-    one upload), percentiles [P], set_lanes [R_s,S,m] u8, counter_planes
-    [R_c,K2,2] f32, uts_regs [m_u] u8) -> FamilyFlushOutputs.  With a mesh, the function is
-    a shard_map'd SPMD program: keys/set rows/counter rows are sharded over
-    'shard'; digest lanes all_gather, set lanes pmax, and counter planes
-    psum over 'replica'; the unique-timeseries registers pmax over both
-    axes (they are replicated within a process, so in-process this is an
-    identity — across processes it is the DCN union of per-host tallies).
-    Without a mesh, the identical math runs under plain jit.  Digest rsum
-    stays host-side (hmean is emitted from host scalars; no device
-    computation needs it).
-    """
-    def body_for(axis):
-        def body(lanes_mean, lanes_weight, d_minmax, percentiles,
-                 set_lanes, counter_planes, uts_regs):
-            d_min, d_max = d_minmax[0], d_minmax[1]
-            dig = reduce_eval(lanes_mean, lanes_weight, d_min, d_max,
-                              jnp.zeros_like(d_min), percentiles,
-                              compression, axis)
-            set_regs = jnp.max(set_lanes, axis=0)
-            chi = jnp.sum(counter_planes[..., 0], axis=0)
-            clo = jnp.sum(counter_planes[..., 1], axis=0)
-            uts = uts_regs
-            if axis is not None:
-                set_regs = jax.lax.pmax(set_regs, axis)
-                chi = jax.lax.psum(chi, axis)
-                clo = jax.lax.psum(clo, axis)
-                uts = jax.lax.pmax(jax.lax.pmax(uts, axis), SHARD_AXIS)
-            return FamilyFlushOutputs(
-                mean=dig.mean, weight=dig.weight, quantiles=dig.quantiles,
-                counts=dig.counts, sums=dig.sums,
-                set_regs=set_regs,
-                set_estimates=hll_mod.estimate(set_regs),
-                counter_hi=chi, counter_lo=clo,
-                unique_ts=hll_mod.estimate(uts[None, :])[0])
-        return body
-
-    if mesh is None:
-        return jax.jit(body_for(None))
-
-    spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
-    spec_k = P(SHARD_AXIS)
-    spec_kc = P(SHARD_AXIS, None)
-    fn = jax.shard_map(
-        body_for(REPLICA_AXIS), mesh=mesh,
-        in_specs=(spec_lanes, spec_lanes, P(None, SHARD_AXIS), P(None),
-                  spec_lanes, spec_lanes, P(None)),
-        out_specs=FamilyFlushOutputs(
-            mean=spec_kc, weight=spec_kc, quantiles=spec_kc,
-            counts=spec_k, sums=spec_k,
-            set_regs=spec_kc, set_estimates=spec_k,
-            counter_hi=spec_k, counter_lo=spec_k,
-            unique_ts=P()),
-        check_vma=False)
-    return jax.jit(fn)
-
-
-# ---------------------------------------------------------------------------
-# Flush readback packing
-# ---------------------------------------------------------------------------
-#
-# The host needs a small, fixed set of per-touched-row values out of each
-# flush (quantiles/counts/sums per digest row, hi/lo per counter row,
-# estimates per set row, the unique-ts scalar).  Reading them with eager
-# per-family gathers costs one device round-trip + one tiled-layout
-# transfer EACH; over a remote device link those round-trips dominate the
-# whole flush.  `flush_pack` gathers every family's touched rows inside
-# one jitted program and returns ONE flat f32 vector, so the host pays a
-# single linear-layout transfer per flush regardless of family count.
-# Row index arrays are padded to powers of two by the caller (row 0
-# repeated; the padding lanes are sliced off after unpack) to bound the
-# jit cache.
-
-@jax.jit
-def flush_pack(quantiles: jax.Array, counts: jax.Array, sums: jax.Array,
-               counter_hi: jax.Array, counter_lo: jax.Array,
-               set_estimates: jax.Array, unique_ts: jax.Array,
-               drows: jax.Array, crows: jax.Array, srows: jax.Array
-               ) -> jax.Array:
-    return jnp.concatenate([
-        quantiles[drows].reshape(-1),
-        counts[drows], sums[drows],
-        counter_hi[crows], counter_lo[crows],
-        set_estimates[srows],
-        unique_ts[None].astype(jnp.float32),
-    ])
-
-
-@jax.jit
-def forward_pack(mean: jax.Array, weight: jax.Array, rows: jax.Array
-                 ) -> jax.Array:
-    """Flat [2 * n * C] f32 readback of merged centroids for the rows a
-    local tier forwards (ForwardableMetrics, `worker.go:179-216`)."""
-    return jnp.concatenate([mean[rows].reshape(-1),
-                            weight[rows].reshape(-1)])
 
 
 @jax.jit

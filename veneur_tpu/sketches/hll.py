@@ -216,22 +216,23 @@ def estimate(regs: jax.Array) -> jax.Array:
     return estimate_from_moments(ez, ssum, regs.shape[1])
 
 
-def estimate_np(regs: np.ndarray) -> float:
-    """Pure-numpy twin of `estimate` for one register row — used on
-    host-resident sketches (e.g. unique-timeseries without a device mesh)
-    where a device round-trip per flush would cost more than the math.
-    Kept numerically identical to the XLA path (parity-tested)."""
+def estimate_np_rows(regs: np.ndarray) -> np.ndarray:
+    """Batched numpy twin of `estimate` for `[S, m]` register rows —
+    used by the mesh-less SetArena where a device round-trip per flush
+    would cost more than the math (parity-tested against the XLA path)."""
+    if regs.shape[0] == 0:
+        return np.zeros(0, np.float32)
     r = regs.astype(np.float32)
-    ez = np.float32(np.count_nonzero(regs == 0))
-    ssum = np.exp2(-r).sum(dtype=np.float32)
-    m = regs.shape[0]
+    ez = (regs == 0).sum(axis=1).astype(np.float32)
+    ssum = np.exp2(-r).sum(axis=1, dtype=np.float32)
+    m = regs.shape[1]
     p = int(m).bit_length() - 1
     mf = np.float32(m)
     beta_c = _BETAS.get(p)
     if beta_c is not None:
         zl = np.log(ez + np.float32(1.0), dtype=np.float32)
         beta = np.float32(beta_c[0]) * ez
-        acc = np.float32(1.0)
+        acc = np.ones_like(zl)
         for c in beta_c[1:]:
             acc = acc * zl
             beta = beta + np.float32(c) * acc
@@ -239,10 +240,17 @@ def estimate_np(regs: np.ndarray) -> float:
                + np.float32(0.5))
     else:
         raw = np.float32(_alpha(mf)) * mf * mf / ssum
-        linear = mf * np.log(mf / max(float(ez), 1.0), dtype=np.float32)
-        est = ((linear if (raw <= 2.5 * mf and ez > 0) else raw)
-               + np.float32(0.5))
-    return float(np.floor(est))
+        linear = mf * np.log(mf / np.maximum(ez, np.float32(1.0)),
+                             dtype=np.float32)
+        est = np.where((raw <= 2.5 * mf) & (ez > 0), linear, raw) \
+            + np.float32(0.5)
+    return np.floor(est)
+
+
+def estimate_np(regs: np.ndarray) -> float:
+    """Single-row numpy estimate (see estimate_np_rows) — used for the
+    host-resident unique-timeseries sketch."""
+    return float(estimate_np_rows(regs[None, :])[0])
 
 
 # ---------------------------------------------------------------------------

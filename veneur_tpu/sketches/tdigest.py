@@ -161,12 +161,14 @@ def compress(mean: jax.Array, weight: jax.Array, compression: float,
     s_w = cum                                                # [K, M]
     s_wm = jnp.cumsum(weight * mean, axis=1)                 # [K, M]
 
+    # Last input index with bucket <= b, for every target bucket b.
+    # `bucket` is monotone per row, so this is a counting reduce —
+    # formulated as one fused [K, M, C] comparison-sum instead of a
+    # vmapped binary search (dynamic gathers inside vmapped searchsorted
+    # lower catastrophically on TPU).
     targets = jnp.arange(c, dtype=jnp.int32)                 # [C]
-
-    def row_bounds(b_row):
-        return jnp.searchsorted(b_row, targets, side='right')  # [C]
-
-    pos = jax.vmap(row_bounds)(bucket) - 1                   # [K, C], -1 = none
+    pos = jnp.sum((bucket[:, :, None] <= targets[None, None, :])
+                  .astype(jnp.int32), axis=1) - 1            # [K, C], -1 = none
 
     def gather_prefix(s):
         g = jnp.take_along_axis(s, jnp.maximum(pos, 0), axis=1)
@@ -310,10 +312,10 @@ def quantile(state: TDigestState, qs: Sequence[float] | jax.Array) -> jax.Array:
     tot = cum[:, -1]
     target = qs[None, :] * tot[:, None]                              # [K, P]
 
-    # First occupied centroid i with cum[i] >= target  (q <= weightSoFar + w).
-    def row_search(cum_row, t_row):
-        return jnp.searchsorted(cum_row, t_row, side='left')
-    i = jax.vmap(row_search)(cum, target)                            # [K, P]
+    # First occupied centroid i with cum[i] >= target (q <= weightSoFar
+    # + w) — a fused comparison-count, not a vmapped binary search (TPU).
+    i = jnp.sum((cum[:, :, None] < target[:, None, :]).astype(jnp.int32),
+                axis=1)                                              # [K, P]
     i = jnp.minimum(i, jnp.maximum(n[:, None] - 1, 0))
 
     cum_before = jnp.take_along_axis(
@@ -343,10 +345,10 @@ def cdf(state: TDigestState, xs: Sequence[float] | jax.Array) -> jax.Array:
     tot = cum[:, -1]
     x = jnp.broadcast_to(xs[None, :], (state.num_keys, xs.shape[0]))  # [K, P]
 
-    # First centroid with upper > x holds the query point.
-    def row_search(upper_row, x_row):
-        return jnp.searchsorted(upper_row, x_row, side='right')
-    i = jax.vmap(row_search)(upper, x)                                # [K, P]
+    # First centroid with upper > x holds the query point (fused
+    # comparison-count; see quantile()).
+    i = jnp.sum((upper[:, :, None] <= x[:, None, :]).astype(jnp.int32),
+                axis=1)                                               # [K, P]
     i = jnp.minimum(i, jnp.maximum(n[:, None] - 1, 0))
 
     w_i = jnp.take_along_axis(w, i, axis=1)
@@ -363,6 +365,62 @@ def cdf(state: TDigestState, xs: Sequence[float] | jax.Array) -> jax.Array:
     out = jnp.where(x >= state.max[:, None], 1.0, out)
     out = jnp.where(x <= state.min[:, None], 0.0, out)
     return jnp.where((n > 0)[:, None], out, jnp.nan)
+
+
+def weighted_eval(mean: jax.Array, weight: jax.Array,
+                  d_min: jax.Array, d_max: jax.Array,
+                  percentiles: jax.Array) -> jax.Array:
+    """Quantiles + total weight + weighted sum for rows of weighted points
+    `[K, D]` (raw samples and/or merged centroids), in one pass: sort by
+    value, cumulative-weight midpoint interpolation, clamp to the
+    authoritative [min, max].  Returns `[K, P + 2]`: the P quantile
+    columns, then total weight, then weighted sum.
+
+    This IS the serving flush's evaluation core.  The reference merges
+    incoming digests into a compressed t-digest and interpolates within
+    its centroids (`worker.go:402-459` -> `merging_digest.go:304-332`);
+    evaluating directly on the *uncompressed* merged point cloud gives
+    strictly finer quantiles for the interval being flushed, and — unlike
+    compress — needs nothing but a sort, cumsums, and fused comparison
+    reductions, all of which map cleanly onto the TPU's vector unit.
+    Compression still runs where the sketch must stay bounded: forwarding
+    export (serving.digest_export) and hot-key pre-reduction
+    (partial_digests).
+
+    Rows must have D >= 2 (callers pad).  Empty cells are weight == 0;
+    fully-empty rows return zeros.
+    """
+    kdim, d = mean.shape
+    key = jnp.where(weight > 0, mean, _INF)
+    key, mean, weight = jax.lax.sort((key, mean, weight), dimension=1,
+                                     num_keys=1)
+    cum = jnp.cumsum(weight, axis=1)                         # [K, D]
+    total = cum[:, -1:]                                      # [K, 1]
+    sums = jnp.sum(mean * weight, axis=1, keepdims=True)     # [K, 1]
+    n_real = jnp.sum((weight > 0).astype(jnp.int32), axis=1,
+                     keepdims=True)                          # [K, 1]
+
+    # midpoint rule: the i-th sorted point sits at cumulative position
+    # cum_i - w_i/2 (uniform-in-centroid semantics for unit weights,
+    # merging_digest.go:266-332)
+    cmid = cum - 0.5 * weight
+    tq = percentiles[None, :] * total                        # [K, P]
+    # fused comparison-count instead of a vmapped binary search
+    idx = jnp.sum((cmid[:, :, None] < tq[:, None, :])
+                  .astype(jnp.int32), axis=1)                # [K, P]
+    hi_bound = jnp.maximum(n_real - 1, 1)
+    ii = jnp.clip(idx, 1, hi_bound)
+    g = lambda a, i: jnp.take_along_axis(a, i, axis=1)
+    m_lo, m_hi = g(mean, ii - 1), g(mean, ii)
+    c_lo, c_hi = g(cmid, ii - 1), g(cmid, ii)
+    t = jnp.where(c_hi > c_lo,
+                  (tq - c_lo) / jnp.maximum(c_hi - c_lo, 1e-30), 0.0)
+    q = m_lo + (m_hi - m_lo) * jnp.clip(t, 0.0, 1.0)
+    # single-point rows interpolate against padding; take the point itself
+    q = jnp.where(n_real <= 1, mean[:, :1], q)
+    q = jnp.clip(q, d_min[:, None], d_max[:, None])
+    q = jnp.where(total > 0, q, 0.0)
+    return jnp.concatenate([q, total, sums], axis=1)
 
 
 def aggregates(state: TDigestState) -> dict[str, jax.Array]:
