@@ -1,0 +1,150 @@
+"""Two-process DCN exercise of the multi-host serving tier.
+
+SURVEY §2.3's cross-node path: the reference scales the global tier with
+gRPC forwarding + the proxy's consistent-hash key ownership
+(`flusher.go:516-591` → `sources/proxy/server.go:144-162`).  Here two REAL
+`jax.distributed` processes (CPU backend, 4 virtual devices each) form one
+8-device (shard×replica) mesh, each boots a real Server via
+`multihost.maybe_init_from_config`, stages samples for the KEYS ITS SHARDS
+OWN (the device analog of ring ownership), and the lockstep SPMD flush
+evaluates the global key space — with the unique-timeseries union crossing
+hosts over the DCN collective transport.
+
+The test fails if `maybe_init_from_config` stops joining the cluster, if
+the multi-controller array construction (serving.put's
+make_array_from_callback path) or readback (serving.fetch's
+process_allgather path) breaks, or if cross-host results diverge.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+pid = int(sys.argv[1])
+port = int(sys.argv[2])
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+from veneur_tpu.sinks import simple as simple_sinks
+
+cfg = config_mod.Config(
+    interval=10.0, percentiles=[0.5, 0.99], hostname=f"mh{pid}",
+    aggregates=["min", "max", "count"],
+    count_unique_timeseries=True,
+    distributed_coordinator=f"127.0.0.1:{port}",
+    distributed_num_processes=2, distributed_process_id=pid,
+    mesh_devices=8, mesh_replicas=2)
+sink = simple_sinks.ChannelMetricSink()
+srv = Server(cfg, extra_metric_sinks=[sink])
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+# Mesh (shard=4, replica=2), device order process-major => this process
+# owns shards [2*pid, 2*pid+2), i.e. compact dense rows [2*pid, 2*pid+2)
+# of a 4-row flush.  Register the SAME four keys in the same order on
+# both processes (the global key dictionary both controllers agree on),
+# then stage samples ONLY for the keys this process owns — exactly the
+# proxy-ring ownership model carried onto the mesh.
+agg = srv.aggregator
+rng = np.random.default_rng(7)
+datasets = {
+    0: rng.gamma(2.0, 10.0, 500),
+    1: rng.normal(50.0, 5.0, 300),
+    2: rng.exponential(4.0, 400),
+    3: rng.uniform(10.0, 20.0, 256),
+}
+with agg.lock:
+    rows = {}
+    for i in range(4):
+        rows[i] = agg.digests.row_for(
+            MetricKey(f"mh.lat{i}", sm.TYPE_HISTOGRAM, ""),
+            MetricScope.MIXED, [])
+    owned = (0, 1) if pid == 0 else (2, 3)
+    for i in owned:
+        vals = datasets[i]
+        agg.digests.sample_batch(
+            np.full(len(vals), rows[i]), vals, np.ones(len(vals)))
+# per-process unique-timeseries tallies: disjoint member sets whose
+# union (and ONLY the union) gives the right global estimate
+for i in range(200):
+    agg.unique_ts.insert(f"proc{pid}-series-{i}".encode())
+
+# DIVERGENT families: only process 0 touches counters and sets this
+# interval — the lockstep flag gather must keep both controllers on the
+# same collective sequence anyway (no deadlock, no shape mismatch)
+if pid == 0:
+    srv.process_packet_buffer(b"mh.reqs:5|c\nmh.users:a|s\nmh.users:b|s")
+
+res = agg.flush(is_local=False, now=1234567)
+by = {m.name: m.value for m in res.metrics}
+
+# every process sees the GLOBAL percentile evaluation (the dense rows
+# and min/max of non-owned keys came from the OTHER process's shards via
+# the multi-controller array construction + allgather readback)
+for i in range(4):
+    vals = datasets[i]
+    p50 = by[f"mh.lat{i}.50percentile"]
+    t50 = np.percentile(vals, 50)
+    assert abs(p50 - t50) / abs(t50) < 0.02, (i, p50, t50)
+# scalar-backed aggregates (count/max from host accumulators) exist only
+# on the process that owns the key's samples — ring-ownership discipline
+for i in owned:
+    vals = datasets[i]
+    assert by[f"mh.lat{i}.count"] == float(len(vals)), i
+    assert abs(by[f"mh.lat{i}.max"] - vals.max()) < 1e-3, i
+for i in set(range(4)) - set(owned):
+    assert f"mh.lat{i}.count" not in by, i
+if pid == 0:
+    assert by["mh.reqs"] == 5.0 and by["mh.users"] == 2.0
+else:
+    assert "mh.reqs" not in by and "mh.users" not in by
+
+# cross-host DCN union: 200 + 200 disjoint series -> ~400
+assert res.unique_ts is not None
+assert abs(res.unique_ts - 400) / 400 < 0.05, res.unique_ts
+
+srv.shutdown()
+print(f"MULTIHOST2_OK pid={pid} uts={res.unique_ts}")
+'''
+
+
+def test_two_process_dcn_flush(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "mh_worker.py"
+    script.write_text(_WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=env) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0 and "MULTIHOST2_OK" in out, (rc, out, err[-3000:])
+    # both controllers converged on the same global union
+    uts = {o.split("uts=")[1].strip() for _, o, _ in outs}
+    assert len(uts) == 1, outs

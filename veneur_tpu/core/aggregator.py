@@ -284,12 +284,39 @@ class MetricAggregator:
                 dpart["staged"], dpart["rows"],
                 dpart["d_min"], dpart["d_max"])
             dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
-            ev = np.asarray(self.flush_fn(dvd, dwd, mmd, self._pct_arr))
+            ev = serving.fetch(self.flush_fn(dvd, dwd, mmd,
+                                             self._pct_arr))
             host["dense_dev"] = (dvd, dwd)
         else:
+            multi = jax.process_count() > 1
+            if multi and is_local:
+                # a local/forwarding tier is a single-process server; the
+                # multi-process mesh serves the GLOBAL tier (the gRPC
+                # forward/import edge is the cross-host transport, like
+                # the reference's proxy ring — multihost.py)
+                raise NotImplementedError(
+                    "multi-process meshed serving supports the global "
+                    "tier only (is_local=False)")
+            crows = snap["counters"]["rows"]
+            srows = snap["sets"]["rows"]
+            if multi:
+                # lockstep agreement: every controller must run the same
+                # program on the same global shapes and the same fetch
+                # sequence, whatever ITS families touched this interval —
+                # one tiny DCN gather of (touched counts, depth) decides
+                # for everyone
+                from jax.experimental import multihost_utils
+                local_depth = self.digests.staged_depth(dpart["staged"])
+                flags = multihost_utils.process_allgather(np.asarray(
+                    [nd, local_depth, len(crows), len(srows)], np.int64))
+                g_nd, g_depth, g_nc, g_ns = flags.max(axis=0).tolist()
+            else:
+                g_nd, g_depth = nd, 0
+                g_nc, g_ns = len(crows), len(srows)
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
-                dpart["d_min"], dpart["d_max"])
+                dpart["d_min"], dpart["d_max"],
+                u_floor=g_nd, d_floor=g_depth)
             dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
             inputs = serving.FlushInputs(
                 dense_v=dvd, dense_w=dwd, minmax=mmd,
@@ -298,25 +325,32 @@ class MetricAggregator:
                 uts_regs=snap["uts_regs"])
             out = self.flush_fn(inputs, self._pct_arr)
             host["dense_dev"] = (dvd, dwd)
-            host["unique_ts"] = float(out.unique_ts)
-            crows = snap["counters"]["rows"]
-            if len(crows):
-                chi = np.asarray(out.counter_hi).astype(np.float64)
-                clo = np.asarray(out.counter_lo).astype(np.float64)
-                host["c_hi"], host["c_lo"] = chi[crows], clo[crows]
-            srows = snap["sets"]["rows"]
-            ns = len(srows)
-            if ns:
-                host["set_ests"] = np.asarray(out.set_estimates)[srows]
-                if is_local and any(m.scope == MetricScope.MIXED
-                                    for m in snap["sets"]["meta"]):
-                    ps = self._padded_rows(srows)
-                    regs = np.asarray(serving.set_regs_pack(
-                        out.set_regs, jnp.asarray(ps)))
-                    host["set_regs"] = regs.reshape(len(ps), -1)[:ns]
+            # ONE batched readback for everything the emitters need
+            set_regs_dev = None
+            if (g_ns and is_local
+                    and any(m.scope == MetricScope.MIXED
+                            for m in snap["sets"]["meta"])):
+                ps = self._padded_rows(srows)
+                set_regs_dev = serving.set_regs_pack(
+                    out.set_regs, jnp.asarray(ps))
+            fetched = serving.fetch((
+                out.digest_eval if g_nd else None,
+                (out.counter_hi, out.counter_lo) if g_nc else None,
+                out.set_estimates if g_ns else None,
+                set_regs_dev, out.unique_ts))
+            ev_t, counters_t, set_ests_t, set_regs_t, uts_t = fetched
+            host["unique_ts"] = float(uts_t)
+            if counters_t is not None and len(crows):
+                host["c_hi"] = counters_t[0].astype(np.float64)[crows]
+                host["c_lo"] = counters_t[1].astype(np.float64)[crows]
+            if set_ests_t is not None and len(srows):
+                host["set_ests"] = set_ests_t[srows]
+            if set_regs_t is not None:
+                host["set_regs"] = set_regs_t.reshape(
+                    len(ps), -1)[:len(srows)]
             if nd == 0:
                 return host
-            ev = np.asarray(out.digest_eval)
+            ev = ev_t
         host["qs"] = ev[:nd, :n_cols]
         host["counts"] = ev[:nd, n_cols].astype(np.float64)
         host["sums"] = ev[:nd, n_cols + 1].astype(np.float64)
@@ -562,8 +596,8 @@ class MetricAggregator:
             mexp, wexp = serving.digest_export(
                 dvd, dwd, jnp.asarray(fpad), compression,
                 self.digests.ccap)
-            sel_mean = np.asarray(mexp)[:len(fidx)]
-            sel_weight = np.asarray(wexp)[:len(fidx)]
+            sel_mean = serving.fetch(mexp)[:len(fidx)]
+            sel_weight = serving.fetch(wexp)[:len(fidx)]
             fwd = res.forward
             for j, i in enumerate(fidx.tolist()):
                 m = meta[i]

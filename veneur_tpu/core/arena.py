@@ -322,6 +322,13 @@ class SetArena(_ArenaBase):
                 [self.host_regs,
                  np.zeros((old, self.m), np.uint8)], axis=0)
             return
+        import jax
+        if jax.process_count() > 1:
+            # one-sided growth would diverge the controllers' global
+            # shapes; multi-process meshes must pre-size instead
+            raise RuntimeError(
+                "set arena cannot grow under a multi-process mesh; "
+                "pre-size with set_arena_initial_capacity")
         nr = np.zeros((self.n_lanes, self.capacity, self.m), np.uint8)
         nr[:, :old] = np.asarray(self.lanes_regs)
         self.lanes_regs = serving.put(nr, self._lane_shd)
@@ -694,8 +701,18 @@ class DigestArena(_ArenaBase):
         self._acc = []
         return rows, vals, wts
 
+    @staticmethod
+    def staged_depth(staged) -> int:
+        """Max per-row staged depth of a take_staged() result (cheap; used
+        for the multi-controller shape agreement)."""
+        rows = staged[0]
+        if len(rows) == 0:
+            return 0
+        return int(np.bincount(rows).max())
+
     def build_dense(self, staged, touched: np.ndarray,
-                    d_min_t: np.ndarray, d_max_t: np.ndarray):
+                    d_min_t: np.ndarray, d_max_t: np.ndarray,
+                    u_floor: int = 0, d_floor: int = 0):
         """Compact dense build for the flush program: map the staged COO
         onto touched-row-ordered dense matrices `[U, D]` (U = padded
         touched count, D = padded max depth), plus the stacked [2, U]
@@ -704,7 +721,8 @@ class DigestArena(_ArenaBase):
         caller device_puts the result (outside the aggregator lock)."""
         rows, vals, wts = staged
         nd = len(touched)
-        u_pad = self.n_shards * _pow2(-(-max(nd, 1) // self.n_shards))
+        u_pad = self.n_shards * _pow2(
+            -(-max(nd, u_floor, 1) // self.n_shards))
         dense_id = np.full(self.capacity, -1, np.int64)
         dense_id[touched] = np.arange(nd)
         r = dense_id[rows]
@@ -712,7 +730,7 @@ class DigestArena(_ArenaBase):
         r, v, w = r[order], vals[order], wts[order]
         first = np.searchsorted(r, np.arange(nd))
         pos = np.arange(len(r)) - first[r]
-        depth = int(pos.max()) + 1 if len(r) else 1
+        depth = max(int(pos.max()) + 1 if len(r) else 1, d_floor)
         d_pad = max(2, self.n_replicas * _pow2(
             -(-depth // self.n_replicas)))
         dv = np.zeros((u_pad, d_pad), np.float32)

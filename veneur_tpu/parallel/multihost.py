@@ -20,6 +20,22 @@ Axis/topology mapping (why the layout is DCN-friendly):
     Cross-host traffic stays where the reference keeps it: the gRPC
     forward/import edge.
 
+**Lockstep contract.** Multi-controller serving is SPMD: every process
+runs the same flush program on the same global shapes.  The framework
+enforces the mechanics — `serving.put` builds global arrays from each
+process's shard view, `serving.fetch` batches readbacks into one DCN
+all-gather per flush, and the aggregator agrees on touched-family flags
+and dense dimensions with a single small gather before each flush — but
+the deployment must provide: (a) a consistent key-registration order
+across processes (the control plane's analog of the proxy ring's
+membership view), (b) pre-sized set arenas (one-sided growth would
+diverge global shapes), and (c) a synchronized flush schedule
+(`synchronize_with_interval`).  The multi-process mesh serves the GLOBAL
+tier; local/forwarding tiers stay single-process and reach it over the
+gRPC forward edge, exactly like the reference's proxy ring
+(tests/test_multihost.py exercises two real jax.distributed processes
+end to end).
+
 Single-host single-process remains the default; none of this is required
 until a deployment grows past one accelerator host.
 """

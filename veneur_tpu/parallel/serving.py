@@ -99,8 +99,36 @@ def minmax_sharding(mesh: Optional[Mesh]):
 
 
 def put(x, sharding):
-    x = jnp.asarray(x)
-    return x if sharding is None else jax.device_put(x, sharding)
+    """Host array -> (sharded) device array.
+
+    Multi-controller runs (jax.distributed, multihost.py) construct the
+    global array from each process's view via make_array_from_callback:
+    every process supplies the slices its devices own, so per-process
+    staging lands on the shards that process is responsible for — the
+    key-ownership model of the proxy ring (`destinations.go:129-142`)
+    carried onto the device mesh."""
+    if sharding is None:
+        return jnp.asarray(x)
+    if jax.process_count() > 1:
+        import numpy as _np
+        arr = _np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+    return jax.device_put(x, sharding)
+
+
+def fetch(x):
+    """Device array (or pytree of arrays) -> host numpy.  Multi-controller:
+    ONE process_allgather over DCN for the whole tree (callers batch every
+    readback of a flush into a single fetch so each flush pays one
+    cross-process barrier, not one per family)."""
+    import numpy as _np
+    if jax.process_count() > 1:
+        leaves = jax.tree_util.tree_leaves(x)
+        if leaves and not all(l.is_fully_addressable for l in leaves):
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(x, tiled=True)
+    return jax.tree_util.tree_map(_np.asarray, x)
 
 
 # ---------------------------------------------------------------------------
