@@ -1,0 +1,144 @@
+"""Graceful zero-drop restart, abstract unix sockets, flock path guard
+(round-2 verdict #4; reference: server.go:1365-1413 einhorn SIGUSR2
+handoff, networking.go:395-408 flock, server_test.go:477-1053 abstract
+sockets)."""
+
+import os
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.simple import ChannelMetricSink
+
+
+def _drain(sink):
+    out = []
+    while True:
+        try:
+            out.extend(sink.queue.get_nowait())
+        except queue.Empty:
+            return out
+
+
+def _counter_total(sink, name):
+    return sum(m.value for m in _drain(sink) if m.name == name)
+
+
+def test_graceful_restart_zero_drop():
+    """Restart under sustained UDP load: the replacement joins the
+    SO_REUSEPORT group, the old instance drains (connect()-steering new
+    datagrams away) and final-flushes; every sent increment lands on
+    exactly one of the two servers."""
+    sink_a = ChannelMetricSink()
+    cfg = dict(interval=600.0, hostname="a", flush_on_shutdown=True,
+               read_buffer_size_bytes=8 << 20, num_readers=2)
+    srv_a = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"], **cfg),
+        extra_metric_sinks=[sink_a])
+    srv_a.start()
+    _, addr = srv_a.statsd_addrs[0]
+    port = addr[1]
+
+    sent = 0
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def sender():
+        nonlocal sent
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        while not stop.is_set():
+            for _ in range(20):
+                s.sendto(b"gr.hits:1|c", ("127.0.0.1", port))
+            with lock:
+                sent += 20
+            time.sleep(0.002)  # paced: measure the restart, not UDP shed
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    time.sleep(0.4)
+
+    # replacement process (same port, SO_REUSEPORT group)
+    sink_b = ChannelMetricSink()
+    srv_b = Server(config_mod.Config(
+        statsd_listen_addresses=[f"udp://127.0.0.1:{port}"],
+        **{**cfg, "hostname": "b"}),
+        extra_metric_sinks=[sink_b])
+    srv_b.start()
+    time.sleep(0.3)
+
+    # old instance drains + final-flushes (flush_on_shutdown)
+    srv_a.graceful_restart_drain(grace_s=0.5)
+
+    time.sleep(0.3)
+    stop.set()
+    t.join(timeout=5)
+    with lock:
+        total_sent = sent
+
+    # let the replacement settle, then flush it
+    deadline = time.time() + 10
+    last = -1
+    while time.time() < deadline:
+        time.sleep(0.1)
+        srv_b._drain_native()
+        cur = srv_b.aggregator.processed
+        if cur == last:
+            break
+        last = cur
+    srv_b.flush()
+    srv_b.shutdown()
+
+    got_a = _counter_total(sink_a, "gr.hits")
+    got_b = _counter_total(sink_b, "gr.hits")
+    assert got_a > 0, "old instance flushed nothing"
+    assert got_b > 0, "replacement received nothing after the handoff"
+    assert got_a + got_b == total_sent, (
+        f"dropped {total_sent - got_a - got_b} of {total_sent} "
+        f"(a={got_a}, b={got_b})")
+
+
+def test_abstract_unix_socket_statsd():
+    """`@`-prefixed statsd listeners bind the Linux abstract namespace:
+    no filesystem entry, no unlink, datagrams flow end to end."""
+    name = f"@vnr-test-{os.getpid()}"
+    sink = ChannelMetricSink()
+    srv = Server(config_mod.Config(
+        statsd_listen_addresses=[f"unixgram://{name}"],
+        interval=600.0, hostname="abs"), extra_metric_sinks=[sink])
+    srv.start()
+    assert not os.path.exists(name)
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    c.sendto(b"abs.c:3|c", "\0" + name[1:])
+    deadline = time.time() + 10
+    while time.time() < deadline and srv.aggregator.processed < 1:
+        time.sleep(0.02)
+    srv.flush()
+    srv.shutdown()
+    assert _counter_total(sink, "abs.c") == 3.0
+
+
+def test_unix_socket_flock_guard(tmp_path):
+    """A second server must not steal a live unix socket path
+    (networking.go:395-408): the sidecar flock rejects it loudly."""
+    path = str(tmp_path / "veneur.sock")
+    srv = Server(config_mod.Config(
+        statsd_listen_addresses=[f"unixgram://{path}"],
+        interval=600.0, hostname="one"))
+    srv.start()
+    with pytest.raises(RuntimeError, match="locked by another"):
+        Server(config_mod.Config(
+            statsd_listen_addresses=[f"unixgram://{path}"],
+            interval=600.0, hostname="two")).start()
+    srv.shutdown()
+    assert not os.path.exists(path + ".lock")
+    # after release, the path is reusable
+    srv3 = Server(config_mod.Config(
+        statsd_listen_addresses=[f"unixgram://{path}"],
+        interval=600.0, hostname="three"))
+    srv3.start()
+    srv3.shutdown()

@@ -87,13 +87,21 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
+    # SIGUSR2 = zero-drop restart handoff (server.go:1365-1413): the
+    # supervisor starts the replacement (which joins the SO_REUSEPORT
+    # group), then signals this process to drain and exit
+    signal.signal(signal.SIGUSR2,
+                  lambda s, f: server.request_graceful_restart())
 
     try:
         server.serve()  # blocking flush-ticker loop
     finally:
         if api is not None:
             api.stop()
-        server.shutdown()
+        if server._graceful_restart:
+            server.graceful_restart_drain()
+        else:
+            server.shutdown()
     return 0
 
 

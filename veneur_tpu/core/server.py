@@ -228,6 +228,10 @@ class Server:
         self.diagnostics = None   # runtime stats loop
 
         self._listeners: list[socket.socket] = []
+        # (lockfile path, open file) pairs guarding unix socket paths
+        self._socket_locks: list[tuple[str, object]] = []
+        # set by request_graceful_restart (SIGUSR2)
+        self._graceful_restart = False
         self._threads: list[threading.Thread] = []
         self._shutdown = threading.Event()
         self._flush_pool = concurrent.futures.ThreadPoolExecutor(
@@ -459,6 +463,72 @@ class Server:
         takes no locks, so it may run while a flush is mid-flight)."""
         self._shutdown.set()
 
+    def request_graceful_restart(self) -> None:
+        """Signal-handler-safe SIGUSR2 entry: flag the serve loop to run
+        the zero-drop handoff (the einhorn/goji analog of
+        server.go:1365-1413)."""
+        self._graceful_restart = True
+        self._shutdown.set()
+
+    def graceful_restart_drain(self, grace_s: float = 0.5) -> None:
+        """Zero-drop restart handoff (server.go:1365-1413 SIGUSR2
+        semantics, re-imagined on SO_REUSEPORT): the REPLACEMENT process
+        binds the same UDP addresses first (the kernel's reuseport group
+        admits it immediately), then this process
+
+          1. connect()s each of its UDP sockets to a blackhole peer —
+             atomically steering all NEW datagrams to the replacement's
+             sockets while the already-queued tail stays readable;
+          2. keeps its readers running for `grace_s` to consume that
+             tail;
+          3. drains the native engine and runs the final flush
+             (flush_on_shutdown path) before tearing down.
+
+        Unix/abstract sockets need no reuseport dance: the replacement
+        re-binds the path (flock released at teardown) and the old
+        socket simply stops receiving."""
+        for sock in self._listeners:
+            if sock.type != socket.SOCK_DGRAM:
+                continue
+            if sock.family == socket.AF_UNIX:
+                continue
+            try:
+                # discard port; never actually sent to
+                target = ("127.0.0.1", 9) if sock.family == socket.AF_INET \
+                    else ("::1", 9)
+                sock.connect(target)
+            except OSError:
+                logger.exception("graceful restart: connect() failed")
+        time.sleep(grace_s)      # readers consume the queued tail
+        self._drain_native()
+        self.shutdown()
+
+    def _bind_unix(self, path: str, socktype: int) -> socket.socket:
+        """Bind a unix socket path with the reference's semantics:
+        `@`-prefixed paths use the Linux abstract namespace (tested
+        server_test.go:477-1053 — no filesystem entry, no unlink), and
+        filesystem paths take an exclusive flock on a sidecar lockfile
+        before unlinking a possibly-live socket (networking.go:395-408),
+        so two servers cannot silently steal each other's path."""
+        sock = socket.socket(socket.AF_UNIX, socktype)
+        if path.startswith("@"):
+            sock.bind("\0" + path[1:])
+            return sock
+        import fcntl
+        lock_f = open(path + ".lock", "w")
+        try:
+            fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            lock_f.close()
+            sock.close()
+            raise RuntimeError(
+                f"socket path {path!r} is locked by another instance")
+        self._socket_locks.append((path + ".lock", lock_f))
+        if os.path.exists(path):
+            os.unlink(path)
+        sock.bind(path)
+        return sock
+
     def _start_statsd(self, addr: str) -> None:
         scheme, rest = parse_listen_addr(addr)
         if scheme == "udp":
@@ -505,10 +575,7 @@ class Server:
             self.statsd_addrs.append(("tcp", sock.getsockname()))
         elif scheme == "unixgram":
             path = rest
-            if os.path.exists(path):
-                os.unlink(path)
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
-            sock.bind(path)
+            sock = self._bind_unix(path, socket.SOCK_DGRAM)
             self._listeners.append(sock)
             t = threading.Thread(target=self._read_udp,
                                  args=(sock, "unixgram"),
@@ -518,10 +585,7 @@ class Server:
             self.statsd_addrs.append(("unixgram", path))
         elif scheme == "unix":
             path = rest
-            if os.path.exists(path):
-                os.unlink(path)
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.bind(path)
+            sock = self._bind_unix(path, socket.SOCK_STREAM)
             sock.listen(128)
             self._listeners.append(sock)
             t = threading.Thread(target=self._accept_tcp,
@@ -742,10 +806,7 @@ class Server:
             self.ssf_addrs.append(("udp", sock.getsockname()))
         elif scheme in ("unix", "tcp"):
             if scheme == "unix":
-                if os.path.exists(rest):
-                    os.unlink(rest)
-                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                sock.bind(rest)
+                sock = self._bind_unix(rest, socket.SOCK_STREAM)
                 bound = rest
             else:
                 host, port = _split_hostport(rest)
@@ -1097,6 +1158,13 @@ class Server:
                 sock.close()
             except OSError:
                 pass
+        for lock_path, lock_f in self._socket_locks:
+            try:
+                lock_f.close()
+                os.unlink(lock_path)
+            except OSError:
+                pass
+        self._socket_locks = []
         # unblock reader threads parked in recv on accepted streams
         with self._stream_conns_lock:
             conns = list(self._stream_conns)
