@@ -241,6 +241,13 @@ def test_netaddr_parsing():
         netaddr.split_hostport("2001:db8::1:8126")  # ambiguous: loud
     with _pytest.raises(ValueError, match="missing port"):
         netaddr.split_hostport("host")
+    # bracketed v6 with no port takes the default (ADVICE r2)
+    assert netaddr.split_hostport("[::1]", default_port=9) == ("::1", 9)
+    # negative and out-of-range ports are loud, not int("-1")
+    with _pytest.raises(ValueError, match="invalid port"):
+        netaddr.split_hostport("host:-1")
+    with _pytest.raises(ValueError, match="invalid port"):
+        netaddr.split_hostport("host:65536")
     import socket as s
     assert netaddr.family("::1") == s.AF_INET6
     assert netaddr.family("10.0.0.1") == s.AF_INET

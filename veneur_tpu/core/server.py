@@ -600,12 +600,14 @@ class Server:
     def _grpc_packet_counted(self, buf: bytes) -> None:
         """dogstatsd bytes over gRPC (DOGSTATSD_GRPC, networking.go:347);
         counted identically on edge and global-tier listeners."""
-        self.proto_received["dogstatsd-grpc"] += 1
+        with self._proto_lock:
+            self.proto_received["dogstatsd-grpc"] += 1
         self.process_packet_buffer(buf)
 
     def _grpc_span_counted(self, span) -> None:
         """SSF spans over gRPC (SSF_GRPC, networking.go:353)."""
-        self.proto_received["ssf-grpc"] += 1
+        with self._proto_lock:
+            self.proto_received["ssf-grpc"] += 1
         self.handle_span(span)
 
     def _grpc_server_credentials(self):

@@ -14,6 +14,7 @@ identical either way.
 from __future__ import annotations
 
 import json
+import threading
 import logging
 from typing import Callable, Optional
 
@@ -132,6 +133,9 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
         self._wire = None
         self._buffer: list = []   # wire path batches per flush interval
         self._buffer_cap = int(cfg.get("span_buffer_size", 16384))
+        # span-worker threads append while flush() swaps; guard both
+        # (SplunkSpanSink pattern)
+        self._buffer_lock = threading.Lock()
         self.sampled_out = 0
         self.dropped = 0
 
@@ -142,7 +146,8 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
     def flush(self) -> None:
         if self._wire is None or not self._buffer:
             return
-        batch, self._buffer = self._buffer, []
+        with self._buffer_lock:
+            batch, self._buffer = self._buffer, []
         acked = self._wire.produce_batch(self.topic, batch)
         self.dropped += len(batch) - acked
 
@@ -174,7 +179,8 @@ class KafkaSpanSink(sink_mod.BaseSpanSink):
         key = span.trace_id.to_bytes(8, "big", signed=True)
         if self._wire is not None:
             # batch for the interval flush (sarama's async-producer analog)
-            self._buffer.append((key, value))
+            with self._buffer_lock:
+                self._buffer.append((key, value))
             return
         try:
             self.producer(self.topic, key, value)

@@ -131,8 +131,17 @@ class GrpcImportServer:
         def health_check(request, context):
             service = ""
             if len(request) >= 2 and request[0] == 0x0A:
-                n = request[1]
-                service = request[2:2 + n].decode(errors="replace")
+                # length is a varint: service names of 128+ bytes use
+                # multiple bytes
+                n, shift, i = 0, 0, 1
+                while i < len(request):
+                    b = request[i]
+                    n |= (b & 0x7F) << shift
+                    i += 1
+                    if not b & 0x80:
+                        break
+                    shift += 7
+                service = request[i:i + n].decode(errors="replace")
             if service not in ("", "veneur"):
                 context.abort(grpc.StatusCode.NOT_FOUND,
                               f"unknown service {service!r}")

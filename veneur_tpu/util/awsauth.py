@@ -74,6 +74,14 @@ def _split_query(query: str) -> list[tuple[str, str]]:
     return pairs
 
 
+def _sorted_encoded(pairs):
+    """SigV4 sorts canonical query parameters by their URI-ENCODED names
+    (and values), not the decoded forms — the orders differ when encoded
+    characters sort around literals."""
+    return sorted(pairs, key=lambda kv: (_uri_encode(kv[0]),
+                                         _uri_encode(kv[1])))
+
+
 def _hmac(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
@@ -91,7 +99,7 @@ def _canonical_request(method: str, url: str, lower_headers: dict,
     canonical_uri = _uri_encode(parsed.path or "/", encode_slash=False)
     canonical_query = "&".join(
         f"{_uri_encode(k)}={_uri_encode(v)}"
-        for k, v in sorted(_split_query(parsed.query)))
+        for k, v in _sorted_encoded(_split_query(parsed.query)))
     canonical_headers = "".join(
         f"{k}:{lower_headers.get(k, '')}\n" for k in signed_names)
     return "\n".join([

@@ -14,10 +14,15 @@ import socket
 def split_hostport(rest: str, default_host: str = "127.0.0.1",
                    default_port: int | None = None) -> tuple[str, int]:
     """-> (host, port).  Raises ValueError on a missing port with no
-    default, a non-numeric port, or an unbracketed IPv6 literal."""
-    host, sep, port = rest.rpartition(":")
-    if not sep:
+    default, a non-numeric or out-of-range port, or an unbracketed IPv6
+    literal."""
+    if rest.startswith("[") and rest.endswith("]"):
+        # bracketed IPv6 with no port, e.g. "[::1]"
         host, port = rest, ""
+    else:
+        host, sep, port = rest.rpartition(":")
+        if not sep:
+            host, port = rest, ""
     if host.startswith("[") and host.endswith("]"):
         host = host[1:-1]
     elif ":" in host:
@@ -27,7 +32,7 @@ def split_hostport(rest: str, default_host: str = "127.0.0.1",
         if default_port is None:
             raise ValueError(f"missing port in {rest!r}")
         return host or default_host, default_port
-    if not port.lstrip("-").isdigit():
+    if not port.isdigit() or not 0 <= int(port) <= 65535:
         raise ValueError(f"invalid port in {rest!r}")
     return host or default_host, int(port)
 
