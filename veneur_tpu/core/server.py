@@ -31,6 +31,7 @@ from veneur_tpu import sinks as sink_mod
 from veneur_tpu.core.aggregator import MetricAggregator
 from veneur_tpu.samplers import parser as parser_mod
 from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.sketches import hll as hll_mod
 from veneur_tpu.util import matcher as matcher_mod
 from veneur_tpu.util import netaddr
 from veneur_tpu.util import tagging
@@ -232,6 +233,7 @@ class Server:
         self._socket_locks: list[tuple[str, object]] = []
         # set by request_graceful_restart (SIGUSR2)
         self._graceful_restart = False
+        self._legacy_hll_reported = 0
         self._threads: list[threading.Thread] = []
         self._shutdown = threading.Event()
         self._flush_pool = concurrent.futures.ThreadPoolExecutor(
@@ -931,6 +933,13 @@ class Server:
                 statsd.count("listen.packets_too_long_total", tl - pt,
                              tags=["protocol:udp"])
             self._native_err_reported = (mal, tl)
+        # legacy VH HLL payload accounting (mixed-hash inflation warning
+        # lives in sketches/hll.py; the metric makes it monitorable)
+        vh_total = hll_mod.legacy_vh_total
+        if vh_total > self._legacy_hll_reported:
+            statsd.count("listen.legacy_hll_total",
+                         vh_total - self._legacy_hll_reported)
+            self._legacy_hll_reported = vh_total
         statsd.count("spans.received_total", self.ssf_received)
         self.ssf_received = 0
         # per-span-sink ingest accounting (worker.go:603-678)

@@ -381,7 +381,30 @@ def unmarshal(data: bytes) -> np.ndarray:
     return regs
 
 
+# legacy "VH" payloads seen since process start: mixed-hash fleets
+# silently inflate union estimates (module docstring), so readers get a
+# metric (listen.legacy_hll_total, reported by the server flush) and a
+# one-time runtime warning instead of a comment-only footgun
+legacy_vh_total = 0
+_vh_warned = False
+
+
+def _note_legacy_vh() -> None:
+    global legacy_vh_total, _vh_warned
+    legacy_vh_total += 1
+    if not _vh_warned:
+        _vh_warned = True
+        import logging
+        logging.getLogger("veneur_tpu.hll").warning(
+            "received a legacy VH-encoded HLL payload: sketches built "
+            "with the old member hash do not union meaningfully with "
+            "metro-hashed ones, so global set estimates are inflated "
+            "(up to ~2x) until the whole fleet is upgraded; counted in "
+            "listen.legacy_hll_total")
+
+
 def _unmarshal_vh(data: bytes) -> np.ndarray:
+    _note_legacy_vh()
     kind, p, _ = struct.unpack_from("<BBB", data, 2)
     m = 1 << p
     regs = np.zeros(m, np.uint8)
