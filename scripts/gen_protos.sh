@@ -7,8 +7,9 @@ set -e
 cd "$(dirname "$0")/../veneur_tpu/protocol/protos"
 protoc -I. --python_out=../gen \
     tdigest/tdigest.proto metricpb/metric.proto forwardrpc/forward.proto \
-    ssf/sample.proto ssf/grpc.proto dogstatsd/grpc.proto
+    ssf/sample.proto ssf/grpc.proto dogstatsd/grpc.proto \
+    signalfxpb/signalfx.proto lightsteppb/collector.proto
 cd ../gen
 for f in */*_pb2.py; do
-  sed -i -E 's/^from (tdigest|metricpb|forwardrpc|ssf|dogstatsd) import/from veneur_tpu.protocol.gen.\1 import/' "$f"
+  sed -i -E 's/^from (tdigest|metricpb|forwardrpc|ssf|dogstatsd|signalfxpb|lightsteppb) import/from veneur_tpu.protocol.gen.\1 import/' "$f"
 done

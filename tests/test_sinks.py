@@ -151,7 +151,11 @@ def test_datadog_events_and_checks(http_capture):
 
 # ---------------------------------------------------------------- signalfx
 
-def test_signalfx_datapoints_and_vary_key_by(http_capture):
+def test_signalfx_protobuf_datapoints_and_vary_key_by(http_capture):
+    """Default wire protocol is the sfxclient protobuf
+    (DataPointUploadMessage, signalfx.go:168/491 parity); the fake
+    DECODES the bytes with the mirrored schema."""
+    from veneur_tpu.protocol.gen.signalfxpb import signalfx_pb2 as sfx
     from veneur_tpu.sinks.signalfx import SignalFxMetricSink
     port = http_capture.server_address[1]
     sink = SignalFxMetricSink(sink_mod.SinkSpec(kind="signalfx", config={
@@ -164,15 +168,47 @@ def test_signalfx_datapoints_and_vary_key_by(http_capture):
         im("api.lat", 2.5, "gauge", tags=["region:us"]),
     ])
     assert res.flushed == 2
-    by_token = {c["headers"]["X-SF-Token"]: json.loads(c["body"])
-                for c in http_capture.captured}
+    by_token = {}
+    for c in http_capture.captured:
+        assert c["headers"]["Content-Type"] == "application/x-protobuf"
+        msg = sfx.DataPointUploadMessage()
+        msg.ParseFromString(c["body"])
+        by_token[c["headers"]["X-SF-Token"]] = msg
     assert set(by_token) == {"default-key", "acme-key"}
-    acme = by_token["acme-key"]["counter"][0]
-    assert acme["metric"] == "api.hits"
-    assert acme["dimensions"]["customer"] == "acme"
-    assert by_token["default-key"]["gauge"][0]["dimensions"]["region"] == "us"
-    # ms timestamps
-    assert acme["timestamp"] == 1700000000 * 1000
+    acme = by_token["acme-key"].datapoints[0]
+    assert acme.metric == "api.hits"
+    assert acme.metricType == sfx.COUNTER
+    assert acme.value.doubleValue == 5.0
+    assert {d.key: d.value for d in acme.dimensions}["customer"] == "acme"
+    assert acme.timestamp == 1700000000 * 1000  # ms epoch
+    other = by_token["default-key"].datapoints[0]
+    assert other.metricType == sfx.GAUGE
+    assert {d.key: d.value for d in other.dimensions}["region"] == "us"
+
+
+def test_signalfx_json_protocol_mode(http_capture):
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+    port = http_capture.server_address[1]
+    sink = SignalFxMetricSink(sink_mod.SinkSpec(kind="signalfx", config={
+        "api_key": "k", "protocol": "json",
+        "endpoint_base": f"http://127.0.0.1:{port}"}))
+    res = sink.flush([im("api.lat", 2.5, "gauge", tags=["region:us"])])
+    assert res.flushed == 1
+    body = json.loads(http_capture.captured[0]["body"])
+    assert body["gauge"][0]["metric"] == "api.lat"
+    assert body["gauge"][0]["dimensions"]["region"] == "us"
+
+
+def test_signalfx_name_prefix_drops(http_capture):
+    from veneur_tpu.sinks.signalfx import SignalFxMetricSink
+    port = http_capture.server_address[1]
+    sink = SignalFxMetricSink(sink_mod.SinkSpec(kind="signalfx", config={
+        "api_key": "k",
+        "metric_name_prefix_drops": ["internal."],
+        "endpoint_base": f"http://127.0.0.1:{port}"}))
+    res = sink.flush([im("internal.debug", 1, "counter"),
+                      im("api.hits", 2, "counter")])
+    assert res.flushed == 1 and res.skipped == 1
 
 
 # ---------------------------------------------------------------- cortex
@@ -301,6 +337,58 @@ def test_splunk_hec_sampling_and_format(http_capture):
     assert events[0]["sourcetype"] == "svc"
 
 
+def test_splunk_partial_indicator_and_ingest_timeout(http_capture):
+    """splunk.go:475-545 parity: a sampled-out INDICATOR span is kept and
+    marked partial; a full ring blocks Ingest up to hec_ingest_timeout
+    and unblocks when flush makes space (zero drop), while a timeout
+    with no flush drops with accounting."""
+    import threading
+    import time as time_mod
+
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+    port = http_capture.server_address[1]
+    sink = SplunkSpanSink(sink_mod.SinkSpec(kind="splunk", config={
+        "hec_address": f"http://127.0.0.1:{port}",
+        "hec_token": "tok", "span_sample_rate": 10,
+        "buffer_size": 2, "hec_ingest_timeout": 5.0}))
+    ind = mkspan(trace_id=7)
+    ind.indicator = True
+    sink.ingest(ind)                       # 7 % 10 != 0 but indicator
+    sink.ingest(mkspan(trace_id=20))       # fills the 2-slot ring
+    # ring full: a concurrent ingest blocks until flush makes space
+    done = threading.Event()
+
+    def blocked_ingest():
+        sink.ingest(mkspan(trace_id=30))
+        done.set()
+
+    t = threading.Thread(target=blocked_ingest, daemon=True)
+    t.start()
+    time_mod.sleep(0.15)
+    assert not done.is_set(), "ingest should be waiting for ring space"
+    sink.flush()                           # makes space + notifies
+    assert done.wait(5), "ingest did not unblock after flush"
+    assert sink.dropped == 0
+    sink.flush()
+    events = []
+    for cap in http_capture.captured:
+        events += [json.loads(line)
+                   for line in cap["body"].decode().split("\n")]
+    by_trace = {ev["event"]["trace_id"]: ev["event"] for ev in events}
+    assert by_trace[format(7, "x")]["partial"] is True
+    assert "partial" not in by_trace[format(20, "x")]
+    # timeout path: nothing flushes, so the wait expires and drops count
+    quick = SplunkSpanSink(sink_mod.SinkSpec(kind="splunk", config={
+        "hec_address": f"http://127.0.0.1:{port}",
+        "hec_token": "tok", "buffer_size": 1,
+        "hec_ingest_timeout": 0.05}))
+    quick.ingest(mkspan(trace_id=20))
+    t0 = time_mod.perf_counter()
+    quick.ingest(mkspan(trace_id=30))
+    assert time_mod.perf_counter() - t0 >= 0.05
+    assert quick.dropped == 1
+
+
 # ---------------------------------------------------------------- kafka
 
 def test_kafka_encoding_and_producer_injection():
@@ -421,21 +509,42 @@ def test_falconer_grpc_send():
 
 # ---------------------------------------------------------------- lightstep
 
-def test_lightstep_report(http_capture):
+def test_lightstep_collector_report(http_capture):
+    """Real collector protocol (lightstep.go:41 parity): the fake decodes
+    the ReportRequest protobuf with the mirrored collectorpb schema."""
+    from veneur_tpu.protocol.gen.lightsteppb import collector_pb2 as lpb
     from veneur_tpu.sinks.lightstep import LightStepSpanSink
     port = http_capture.server_address[1]
     sink = LightStepSpanSink(sink_mod.SinkSpec(kind="lightstep", config={
         "access_token": "at",
         "collector_host": f"http://127.0.0.1:{port}",
         "num_clients": 2}))
-    sink.ingest(mkspan(trace_id=2, sid=1))   # client 0
-    sink.ingest(mkspan(trace_id=3, sid=2))   # client 1
+    sink.ingest(mkspan(trace_id=2, sid=1, parent=7))   # client 0
+    sink.ingest(mkspan(trace_id=3, sid=2))             # client 1
     sink.flush()
     assert len(http_capture.captured) == 2
-    body = json.loads(http_capture.captured[0]["body"])
-    assert body["auth"]["access_token"] == "at"
-    rec = body["span_records"][0]
-    assert rec["youngest_micros"] - rec["oldest_micros"] == 5_000
+    reports = []
+    for c in http_capture.captured:
+        assert c["path"].endswith("/api/v2/reports")
+        assert c["headers"]["Content-Type"] == "application/octet-stream"
+        assert c["headers"]["Lightstep-Access-Token"] == "at"
+        r = lpb.ReportRequest()
+        r.ParseFromString(c["body"])
+        reports.append(r)
+    by_trace = {r.spans[0].span_context.trace_id: r for r in reports}
+    assert set(by_trace) == {2, 3}
+    r2 = by_trace[2]
+    assert r2.auth.access_token == "at"
+    assert r2.reporter.reporter_id != 0
+    sp = r2.spans[0]
+    assert sp.span_context.span_id == 1
+    assert sp.duration_micros == 5_000
+    ref = sp.references[0]
+    assert ref.relationship == lpb.Reference.CHILD_OF
+    assert ref.span_context.span_id == 7
+    # distinct reporter identity per client connection
+    assert (by_trace[2].reporter.reporter_id
+            != by_trace[3].reporter.reporter_id)
 
 
 # ---------------------------------------------------------------- aws

@@ -1,16 +1,21 @@
-"""LightStep span sink: collector-bound span reporting.
+"""LightStep span sink: real collector-protocol span reporting.
 
 Capability twin of `sinks/lightstep/lightstep.go` (`lightstep.go:41`): the
 reference fans spans out over N opentracing tracer clients keyed by
 trace-id modulo (`num_clients`), each holding a collector connection.  We
-keep that shape — per-client buffers keyed by trace id — and report spans
-to the collector's public JSON report endpoint with the access token.
+keep that shape — per-client buffers keyed by trace id — and report each
+client's batch as a `lightstep.collector.ReportRequest` protobuf (field
+numbers mirrored from lightstep-tracer-go's collectorpb in
+protocol/protos/lightsteppb/collector.proto) POSTed to the collector's
+HTTP report endpoint (`/api/v2/reports`, content-type
+application/octet-stream) with the access token in the Auth block —
+the same bytes the vendored tracers put on the wire.
 """
 
 from __future__ import annotations
 
-import json
 import logging
+import random
 import threading
 from typing import Optional
 
@@ -21,21 +26,42 @@ from veneur_tpu import sinks as sink_mod
 logger = logging.getLogger("veneur_tpu.sinks.lightstep")
 
 
-def span_record(span) -> dict:
-    return {
-        "span_guid": format(span.id & (2**64 - 1), "x"),
-        "trace_guid": format(span.trace_id & (2**64 - 1), "x"),
-        "runtime_guid": span.service,
-        "span_name": span.name,
-        "oldest_micros": span.start_timestamp // 1000,
-        "youngest_micros": span.end_timestamp // 1000,
-        "error_flag": bool(span.error),
-        "attributes": [{"Key": k, "Value": v}
-                       for k, v in sorted(span.tags.items())]
-        + ([{"Key": "parent_span_guid",
-             "Value": format(span.parent_id & (2**64 - 1), "x")}]
-           if span.parent_id else []),
-    }
+def _pb():
+    from veneur_tpu.protocol.gen.lightsteppb import collector_pb2
+    return collector_pb2
+
+
+def span_to_collector(span, out) -> None:
+    """SSFSpan -> collectorpb.Span (opentracing mapping the reference's
+    tracer performs: CHILD_OF reference for the parent, error tag,
+    microsecond timestamps)."""
+    out.span_context.trace_id = span.trace_id & (2**64 - 1)
+    out.span_context.span_id = span.id & (2**64 - 1)
+    out.operation_name = span.name
+    if span.parent_id:
+        ref = out.references.add()
+        ref.relationship = _pb().Reference.CHILD_OF
+        ref.span_context.trace_id = span.trace_id & (2**64 - 1)
+        ref.span_context.span_id = span.parent_id & (2**64 - 1)
+    out.start_timestamp.FromNanoseconds(span.start_timestamp)
+    out.duration_micros = max(
+        (span.end_timestamp - span.start_timestamp) // 1000, 0)
+    for k in sorted(span.tags):
+        kv = out.tags.add()
+        kv.key = k
+        kv.string_value = span.tags[k]
+    if span.service:
+        kv = out.tags.add()
+        kv.key = "service"
+        kv.string_value = span.service
+    if span.error:
+        kv = out.tags.add()
+        kv.key = "error"
+        kv.bool_value = True
+    if span.indicator:
+        kv = out.tags.add()
+        kv.key = "indicator"
+        kv.bool_value = True
 
 
 class LightStepSpanSink(sink_mod.BaseSpanSink):
@@ -52,11 +78,15 @@ class LightStepSpanSink(sink_mod.BaseSpanSink):
         # reference load-balances spans across num_clients tracers by
         # trace_id % n (lightstep.go round-robin comment)
         self.num_clients = max(int(cfg.get("num_clients", 1)), 1)
-        self.reconnect_period = cfg.get("reconnect_period", "5m")
         self.maximum_spans = int(cfg.get("maximum_spans", 16_384))
+        self.hostname = getattr(server_config, "hostname", "") or ""
         self.session = session or requests.Session()
         self._lock = threading.Lock()
         self._buffers: list[list] = [[] for _ in range(self.num_clients)]
+        # one reporter identity per client connection (guid the tracers
+        # generate per reporter)
+        self._reporter_ids = [random.getrandbits(63) | 1
+                              for _ in range(self.num_clients)]
         self.dropped = 0
 
     def ingest(self, span) -> None:
@@ -72,18 +102,30 @@ class LightStepSpanSink(sink_mod.BaseSpanSink):
         with self._lock:
             buffers, self._buffers = self._buffers, [
                 [] for _ in range(self.num_clients)]
-        for buf in buffers:
+        pb = _pb()
+        for idx, buf in enumerate(buffers):
             if not buf:
                 continue
-            payload = {
-                "auth": {"access_token": self.access_token},
-                "span_records": [span_record(s) for s in buf],
-            }
+            report = pb.ReportRequest()
+            report.auth.access_token = self.access_token
+            report.reporter.reporter_id = self._reporter_ids[idx]
+            kv = report.reporter.tags.add()
+            kv.key = "lightstep.component_name"
+            kv.string_value = "veneur"
+            if self.hostname:
+                kv = report.reporter.tags.add()
+                kv.key = "lightstep.hostname"
+                kv.string_value = self.hostname
+            for s in buf:
+                span_to_collector(s, report.spans.add())
             try:
                 resp = self.session.post(
-                    f"{self.collector_host}/api/v0/reports",
-                    data=json.dumps(payload),
-                    headers={"Content-Type": "application/json"},
+                    f"{self.collector_host}/api/v2/reports",
+                    data=report.SerializeToString(),
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "Lightstep-Access-Token": self.access_token,
+                    },
                     timeout=10.0)
                 if resp.status_code >= 400:
                     logger.warning("lightstep report -> %d",

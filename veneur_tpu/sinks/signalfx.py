@@ -4,9 +4,13 @@ Capability twin of `sinks/signalfx/signalfx.go` (`signalfx.go:168,491`):
 metrics become SignalFx datapoints (`gauge`/`counter`/`cumulative_counter`)
 with tags as dimensions; `vary_key_by` routes each metric to a per-tag-value
 API token (the reference's per-key client fan-out); events submit via
-`/v2/event`.  We speak the JSON protocol (`/v2/datapoint`, documented
-public wire format) instead of the Go SDK's protobuf — same data, simpler
-dependency surface.
+`/v2/event`.
+
+Wire protocol: `application/x-protobuf` DataPointUploadMessage /
+EventUploadMessage by default — the same bytes the reference's sfxclient
+HTTPSink puts on the wire (vendored com_signalfx_metrics_protobuf field
+numbers, mirrored in protocol/protos/signalfxpb/signalfx.proto) — with
+the documented JSON protocol available via `protocol: json`.
 """
 
 from __future__ import annotations
@@ -65,7 +69,18 @@ class SignalFxMetricSink(sink_mod.BaseMetricSink):
             cfg.get("per_tag_api_keys", {}))
         self.hostname = getattr(server_config, "hostname", "") or ""
         self.exclude_prefixes = list(cfg.get("metric_tag_prefix_drops", []))
+        # reference parity: drop whole metrics by name prefix
+        # (metricNamePrefixDrops, signalfx.go)
+        self.name_prefix_drops = list(cfg.get("metric_name_prefix_drops",
+                                              []))
+        # wire protocol: protobuf (sfxclient parity) or json
+        self.protocol = cfg.get("protocol", "protobuf")
+        self.max_per_batch = int(cfg.get("flush_max_per_body", 10_000))
         self.session = session or requests.Session()
+
+    def _pb(self):
+        from veneur_tpu.protocol.gen.signalfxpb import signalfx_pb2
+        return signalfx_pb2
 
     def _token_for(self, m) -> str:
         if self.vary_key_by:
@@ -80,31 +95,65 @@ class SignalFxMetricSink(sink_mod.BaseMetricSink):
         if not metrics:
             return sink_mod.MetricFlushResult()
         # group by token so each POST authenticates correctly
-        by_token: dict[str, dict[str, list]] = {}
+        # (clientsByTagValue, signalfx.go:168-191)
+        by_token: dict[str, list] = {}
+        skipped = 0
         for m in metrics:
+            if self.name_prefix_drops and any(
+                    m.name.startswith(p) for p in self.name_prefix_drops):
+                skipped += 1
+                continue
             tok = self._token_for(m)
             cat, dp = datapoint(m, self.hostname, self.exclude_prefixes)
-            by_token.setdefault(tok, {}).setdefault(cat, []).append(dp)
+            by_token.setdefault(tok, []).append((cat, dp))
         flushed = dropped = 0
-        for tok, body in by_token.items():
-            n = sum(len(v) for v in body.values())
-            try:
-                resp = self.session.post(
-                    f"{self.endpoint}/v2/datapoint",
-                    data=json.dumps(body),
-                    headers={"Content-Type": "application/json",
-                             "X-SF-Token": tok},
-                    timeout=10.0)
-                if resp.status_code >= 400:
-                    logger.warning("signalfx POST -> %d: %.200s",
-                                   resp.status_code, resp.text)
-                    dropped += n
+        for tok, points in by_token.items():
+            for i in range(0, len(points), self.max_per_batch):
+                chunk = points[i:i + self.max_per_batch]
+                if self._post_datapoints(tok, chunk):
+                    flushed += len(chunk)
                 else:
-                    flushed += n
-            except requests.RequestException as e:
-                logger.warning("signalfx POST failed: %s", e)
-                dropped += n
-        return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
+                    dropped += len(chunk)
+        return sink_mod.MetricFlushResult(flushed=flushed,
+                                          dropped=dropped,
+                                          skipped=skipped)
+
+    def _post_datapoints(self, tok: str, points: list) -> bool:
+        if self.protocol == "json":
+            body: dict[str, list] = {}
+            for cat, dp in points:
+                body.setdefault(cat, []).append(dp)
+            data = json.dumps(body)
+            ctype = "application/json"
+        else:
+            pb = self._pb()
+            msg = pb.DataPointUploadMessage()
+            for cat, dp in points:
+                p = msg.datapoints.add()
+                p.metric = dp["metric"]
+                p.timestamp = dp["timestamp"]
+                p.value.doubleValue = float(dp["value"])
+                p.metricType = (pb.COUNTER if cat == "counter"
+                                else pb.GAUGE)
+                for k in sorted(dp["dimensions"]):
+                    d = p.dimensions.add()
+                    d.key = k
+                    d.value = dp["dimensions"][k]
+            data = msg.SerializeToString()
+            ctype = "application/x-protobuf"
+        try:
+            resp = self.session.post(
+                f"{self.endpoint}/v2/datapoint", data=data,
+                headers={"Content-Type": ctype, "X-SF-Token": tok},
+                timeout=10.0)
+            if resp.status_code >= 400:
+                logger.warning("signalfx POST -> %d: %.200s",
+                               resp.status_code, resp.text)
+                return False
+            return True
+        except requests.RequestException as e:
+            logger.warning("signalfx POST failed: %s", e)
+            return False
 
     def flush_other_samples(self, samples):
         events = []
@@ -122,10 +171,31 @@ class SignalFxMetricSink(sink_mod.BaseMetricSink):
             })
         if not events:
             return
+        if self.protocol == "json":
+            data = json.dumps(events)
+            ctype = "application/json"
+        else:
+            pb = self._pb()
+            msg = pb.EventUploadMessage()
+            for e in events:
+                ev = msg.events.add()
+                ev.eventType = e["eventType"]
+                ev.category = pb.USER_DEFINED
+                ev.timestamp = e["timestamp"]
+                for k in sorted(e["dimensions"]):
+                    d = ev.dimensions.add()
+                    d.key = k
+                    d.value = e["dimensions"][k]
+                for k, v in e["properties"].items():
+                    p = ev.properties.add()
+                    p.key = k
+                    p.value.strValue = str(v)
+            data = msg.SerializeToString()
+            ctype = "application/x-protobuf"
         try:
             self.session.post(
-                f"{self.endpoint}/v2/event", data=json.dumps(events),
-                headers={"Content-Type": "application/json",
+                f"{self.endpoint}/v2/event", data=data,
+                headers={"Content-Type": ctype,
                          "X-SF-Token": self.api_key},
                 timeout=10.0)
         except requests.RequestException as e:

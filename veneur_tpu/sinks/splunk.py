@@ -4,10 +4,12 @@ Capability twin of `sinks/splunk/splunk.go` (`splunk.go:60,217,475`): spans
 are trace-ID-sampled (`1/sample_rate` of traces kept, error spans and
 indicator spans always kept), serialized as HEC events
 (`/services/collector/event` with `Authorization: Splunk <token>`), and
-submitted in batches by a bounded in-memory buffer.  The reference's
-concurrent submitter goroutines + ring timeout become a single batched
-POST per flush here; `hec_submission_workers`-style concurrency can ride
-the server's sink fan-out thread.
+submitted in batches by a bounded in-memory buffer with the reference's
+backpressure semantics: `hec_ingest_timeout` bounds how long Ingest may
+block waiting for ring space before the span is dropped with accounting
+(`splunk.go:475-545`), sampled-out indicator spans are kept and marked
+`partial` so full traces stay searchable, and `hec_submission_workers`
+submit batches concurrently.
 """
 
 from __future__ import annotations
@@ -25,7 +27,8 @@ from veneur_tpu import sinks as sink_mod
 logger = logging.getLogger("veneur_tpu.sinks.splunk")
 
 
-def span_to_hec(span, hostname: str, local_veneur: str = "") -> dict:
+def span_to_hec(span, hostname: str, local_veneur: str = "",
+                partial: bool = False) -> dict:
     event = {
         "trace_id": format(span.trace_id & 0xFFFFFFFFFFFFFFFF, "x"),
         "id": format(span.id & 0xFFFFFFFFFFFFFFFF, "x"),
@@ -42,6 +45,10 @@ def span_to_hec(span, hostname: str, local_veneur: str = "") -> dict:
     }
     if local_veneur:
         event["local_veneur"] = local_veneur
+    if partial:
+        # an indicator span whose trace was sampled out: marked so
+        # searches can tell full traces from partial ones (splunk.go:522)
+        event["partial"] = True
     return {
         "time": span.start_timestamp / 1e9,
         "sourcetype": span.service or "veneur",
@@ -68,11 +75,15 @@ class SplunkSpanSink(sink_mod.BaseSpanSink):
         # concurrent HEC submitters (splunk.go hec_submission_workers)
         self.submission_workers = max(
             1, int(cfg.get("hec_submission_workers", 1)))
+        # how long Ingest may block for ring space before dropping
+        # (splunk.go hec_ingest_timeout; 0 = drop immediately)
+        self.ingest_timeout = float(cfg.get("hec_ingest_timeout", 0.0))
         self.hostname = getattr(server_config, "hostname", "") or ""
         self._poster = sink_mod.ParallelPoster(
             max_workers=self.submission_workers,
             thread_name_prefix="splunk-hec", injected_session=session)
         self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)
         self._buffer: list = []
         self.sampled_out = 0
         self.dropped = 0
@@ -81,21 +92,32 @@ class SplunkSpanSink(sink_mod.BaseSpanSink):
         self._poster.close()
 
     def ingest(self, span) -> None:
-        # error/indicator spans bypass sampling (splunk.go keep rules)
-        if not span.error and not span.indicator and \
-                self.sample_rate > 1 and \
-                (span.trace_id % self.sample_rate) != 0:
+        # sampling (splunk.go:483-492): 1/N of traces kept; error and
+        # indicator spans always kept — a sampled-out indicator span is
+        # marked partial (its trace is incomplete in the index)
+        would_drop = (self.sample_rate > 1
+                      and (span.trace_id % self.sample_rate) != 0)
+        if would_drop and not span.error and not span.indicator:
             self.sampled_out += 1
             return
-        with self._lock:
+        partial = would_drop and span.indicator
+        with self._space:
             if len(self._buffer) >= self.buffer_size:
-                self.dropped += 1
-                return
-            self._buffer.append(span)
+                if self.ingest_timeout > 0:
+                    # ring-full backpressure: wait up to the ingest
+                    # timeout for a flush to make space (splunk.go:505)
+                    self._space.wait_for(
+                        lambda: len(self._buffer) < self.buffer_size,
+                        timeout=self.ingest_timeout)
+                if len(self._buffer) >= self.buffer_size:
+                    self.dropped += 1
+                    return
+            self._buffer.append((span, partial))
 
     def flush(self) -> None:
-        with self._lock:
+        with self._space:
             spans, self._buffer = self._buffer, []
+            self._space.notify_all()   # wake ingest() waiters
         if not spans or not self.hec_url:
             return
         url = f"{self.hec_url}/services/collector/event"
@@ -107,7 +129,8 @@ class SplunkSpanSink(sink_mod.BaseSpanSink):
         def submit(chunk, session) -> None:
             # HEC wants newline-delimited JSON objects in one body
             body = "\n".join(
-                json.dumps(span_to_hec(s, self.hostname)) for s in chunk)
+                json.dumps(span_to_hec(s, self.hostname, partial=p))
+                for s, p in chunk)
             try:
                 resp = session.post(url, data=body.encode(),
                                     headers=headers, timeout=10.0,
