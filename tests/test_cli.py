@@ -118,7 +118,9 @@ def test_veneur_prometheus_once():
             "-s", f"127.0.0.1:{port}", "-p", "prom.", "-once"])
         assert rc == 0
         data, _ = sock.recvfrom(65536)
-        assert data == b"prom.up:1.0|g"
+        assert data == b"prom.up:1|g"
+        data, _ = sock.recvfrom(65536)   # self-stat follows
+        assert data.startswith(b"prom.veneur.prometheus.metrics_flushed")
     finally:
         sock.close()
         httpd.shutdown()
@@ -264,3 +266,58 @@ def test_emit_ipv6_destination():
     data, _ = sock.recvfrom(65536)
     sock.close()
     assert data == b"v6.e:1|c"
+
+def test_veneur_prometheus_translation_semantics():
+    """cmd/veneur-prometheus translate.go parity: histogram bucket ->
+    `.le%f` count deltas, summary quantiles -> percentile gauges, label
+    ignore/rename/add, ignored metric families, counter delta cache."""
+    from veneur_tpu.cli.veneur_prometheus import Translator
+
+    tr = Translator(ignored_labels="^secret", renamed={"env": "stage"},
+                    added={"team": "infra"}, ignored_metrics="^skip_me")
+    scrape1 = """
+# TYPE reqs counter
+reqs{env="prod",secret_id="x"} 10
+# TYPE temp gauge
+temp 21.5
+# TYPE skip_me counter
+skip_me 5
+# TYPE lat histogram
+lat_bucket{le="0.5"} 3
+lat_bucket{le="+Inf"} 7
+lat_sum 9.5
+lat_count 7
+# TYPE rt summary
+rt{quantile="0.5"} 0.2
+rt{quantile="0.99"} NaN
+rt_sum 12.5
+rt_count 30
+"""
+    first = tr.translate(scrape1)
+    by = {(n, tuple(t)): (v, mt) for n, v, mt, t in first}
+    # first scrape: counters/buckets/counts have no delta yet; gauges and
+    # quantiles emit immediately
+    assert by[("temp", ("team:infra",))] == (21.5, "g")
+    assert by[("lat.sum", ("team:infra",))] == (9.5, "g")
+    assert by[("rt.sum", ("team:infra",))] == (12.5, "g")
+    assert by[("rt.50percentile", ("team:infra",))] == (0.2, "g")
+    assert not any(n.startswith(("reqs", "lat.le", "lat.count", "rt.count",
+                                 "skip_me")) for n, *_ in first)
+
+    scrape2 = scrape1.replace('reqs{env="prod",secret_id="x"} 10',
+                              'reqs{env="prod",secret_id="x"} 14') \
+        .replace('lat_bucket{le="0.5"} 3', 'lat_bucket{le="0.5"} 5') \
+        .replace('lat_bucket{le="+Inf"} 7', 'lat_bucket{le="+Inf"} 10') \
+        .replace('lat_count 7', 'lat_count 10') \
+        .replace('rt_count 30', 'rt_count 33')
+    second = tr.translate(scrape2)
+    by2 = {(n, tuple(t)): (v, mt) for n, v, mt, t in second}
+    # counter delta with ignored label dropped, env renamed, team added
+    assert by2[("reqs", ("stage:prod", "team:infra"))] == (4, "c")
+    # histogram buckets: reference %f naming, cumulative deltas, le tag
+    # stripped
+    assert by2[("lat.le0.500000", ("team:infra",))] == (2, "c")
+    assert by2[("lat.count", ("team:infra",))] == (3, "c")
+    assert by2[("rt.count", ("team:infra",))] == (3, "c")
+    # NaN quantile never emits
+    assert not any(n == "rt.99percentile" for n, *_ in second)

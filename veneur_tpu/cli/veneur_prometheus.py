@@ -1,69 +1,244 @@
 """Legacy Prometheus poller CLI (capability twin of `cmd/veneur-prometheus`).
 
 Scrapes a Prometheus /metrics endpoint on an interval and re-emits the
-samples as DogStatsD datagrams (`cmd/veneur-prometheus/main.go:32-108`) —
-the predecessor of the in-server openmetrics source, kept for CLI parity.
+samples as DogStatsD datagrams with the reference's translation semantics
+(`cmd/veneur-prometheus/main.go:32-108`, `translate.go`):
+
+  * counter            -> count of the delta since the previous scrape
+                          (cumulative->delta cache; first sight skipped,
+                          reset emits the new total)
+  * gauge / untyped    -> gauge
+  * summary            -> `.sum` gauge, `.count` count delta, and one
+                          `name.<N>percentile` gauge per quantile (NaN
+                          quantiles skipped)
+  * histogram          -> `.sum` gauge, `.count` count delta, and one
+                          `name.le<bound %f>` count delta per bucket
+
+plus the reference's label pipeline: `-ignored-labels` name regexes,
+`-r old=new` renames, `-a k=v` added tags (sorted), `-ignored-metrics`
+family regexes, `-p` prefix, mTLS scrape flags, and the
+`veneur.prometheus.*` self-stats.
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
+import math
+import re
 import socket
 import sys
 import time
+from typing import Optional
+
+from veneur_tpu.sources.openmetrics import parse_exposition
+
+logger = logging.getLogger("veneur_tpu.cli.veneur_prometheus")
+
+
+class Translator:
+    """Label pipeline + cumulative->delta cache (translate.go + cache.go)."""
+
+    def __init__(self, ignored_labels: Optional[str] = None,
+                 renamed: Optional[dict] = None,
+                 added: Optional[dict] = None,
+                 ignored_metrics: Optional[str] = None):
+        self.ignored = re.compile(ignored_labels) if ignored_labels else None
+        self.renamed = renamed or {}
+        self.added = added or {}
+        self.ignored_metrics = (re.compile(ignored_metrics)
+                                if ignored_metrics else None)
+        self._cache: dict[tuple, float] = {}
+        self.decode_errors = 0
+        self.unknown_types = 0
+
+    def tags(self, labels: list[tuple[str, str]],
+             drop: tuple = ()) -> list[str]:
+        out = []
+        for k, v in labels:
+            if k in drop:
+                continue
+            if self.ignored is not None and self.ignored.search(k):
+                continue
+            out.append(f"{self.renamed.get(k, k)}:{v}")
+        # added tags in sorted name order (cache-key stability,
+        # translate.go Tags)
+        for k in sorted(self.added):
+            out.append(f"{k}:{self.added[k]}")
+        return out
+
+    def _count_delta(self, name: str, tags: list[str],
+                     value: float) -> Optional[float]:
+        key = (name, tuple(sorted(tags)))
+        prev = self._cache.get(key)
+        self._cache[key] = value
+        if prev is None:
+            return None             # first observation: no delta yet
+        delta = value - prev
+        if delta < 0:
+            return value            # counter reset: emit the new total
+        if delta == 0:
+            return None
+        return delta
+
+    def translate(self, text: str) -> list[tuple[str, float, str, list]]:
+        """Exposition text -> [(name, value, statsd type, tags)]."""
+        out = []
+        for name, labels, value, mtype in parse_exposition(text):
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+                    break
+            if self.ignored_metrics is not None and \
+                    self.ignored_metrics.search(base):
+                continue
+            if mtype == "counter":
+                tags = self.tags(labels)
+                d = self._count_delta(name, tags, value)
+                if d is not None:
+                    out.append((name, d, "c", tags))
+            elif mtype in ("gauge", "untyped"):
+                out.append((name, value, "g", self.tags(labels)))
+            elif mtype == "summary":
+                tags = self.tags(labels, drop=("quantile",))
+                if name.endswith("_sum"):
+                    out.append((f"{base}.sum", value, "g", tags))
+                elif name.endswith("_count"):
+                    d = self._count_delta(f"{base}.count", tags, value)
+                    if d is not None:
+                        out.append((f"{base}.count", d, "c", tags))
+                else:
+                    q = dict(labels).get("quantile", "")
+                    if not q or math.isnan(value):
+                        continue
+                    out.append((
+                        f"{name}.{int(float(q) * 100)}percentile",
+                        value, "g", tags))
+            elif mtype == "histogram":
+                tags = self.tags(labels, drop=("le",))
+                if name.endswith("_sum"):
+                    out.append((f"{base}.sum", value, "g", tags))
+                elif name.endswith("_count"):
+                    d = self._count_delta(f"{base}.count", tags, value)
+                    if d is not None:
+                        out.append((f"{base}.count", d, "c", tags))
+                elif name.endswith("_bucket"):
+                    le = dict(labels).get("le", "")
+                    try:
+                        bound = float(le)
+                    except ValueError:
+                        continue
+                    if math.isnan(bound):
+                        continue
+                    # reference naming: %s.le%f (translate.go:176)
+                    mname = f"{base}.le{bound:f}"
+                    d = self._count_delta(mname, tags, value)
+                    if d is not None:
+                        out.append((mname, d, "c", tags))
+            else:
+                self.unknown_types += 1
+        return out
+
+
+def statsd_lines(stats, prefix: str = "") -> list[bytes]:
+    lines = []
+    for name, value, mtype, tags in stats:
+        v = int(value) if float(value).is_integer() else value
+        line = f"{prefix}{name}:{v}|{mtype}"
+        if tags:
+            line += "|#" + ",".join(tags)
+        lines.append(line.encode())
+    return lines
+
+
+def _parse_kv(s: str) -> dict:
+    out = {}
+    for part in (s or "").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k] = v
+    return out
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="veneur-tpu-prometheus")
-    p.add_argument("-m", dest="metrics_url", required=True,
-                   help="Prometheus /metrics URL to scrape")
-    p.add_argument("-s", dest="statsd", default="127.0.0.1:8125",
+    p.add_argument("-m", "--metrics-url", dest="metrics_url",
+                   help="deprecated alias of -host")
+    p.add_argument("-host", dest="host",
+                   default="http://localhost:9090/metrics",
+                   help="full URL to query for Prometheus metrics")
+    p.add_argument("-s", dest="statsd", default="127.0.0.1:8126",
                    help="statsd host:port to emit to")
     p.add_argument("-i", dest="interval", type=float, default=10.0)
-    p.add_argument("-p", dest="prefix", default="")
-    p.add_argument("-a", dest="added_tags", action="append", default=[])
+    p.add_argument("-p", dest="prefix", default="",
+                   help="prefix for emitted metrics (trailing period)")
+    p.add_argument("-a", dest="added", default="",
+                   help="comma-separated tags to add (k=v,...)")
+    p.add_argument("-r", dest="renamed", default="",
+                   help="comma-separated label renames (old=new,...)")
+    p.add_argument("-ignored-labels", dest="ignored_labels", default="")
+    p.add_argument("-ignored-metrics", dest="ignored_metrics", default="")
+    p.add_argument("-cert", default="", help="mTLS client cert for scrapes")
+    p.add_argument("-key", default="", help="mTLS client key for scrapes")
+    p.add_argument("-cacert", default="",
+                   help="CA cert validating the scraped server")
+    p.add_argument("-d", dest="debug", action="store_true")
     p.add_argument("-once", action="store_true",
                    help="scrape once and exit (for tests)")
     args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO)
 
-    logging.basicConfig(level=logging.INFO)
+    import requests
 
-    from veneur_tpu.config import SourceSpec
-    from veneur_tpu.sources.openmetrics import OpenMetricsSource
-
-    source = OpenMetricsSource(SourceSpec(
-        kind="openmetrics", name="veneur-prometheus",
-        config={"scrape_target": args.metrics_url,
-                "scrape_interval": args.interval,
-                "tags": args.added_tags}))
+    url = args.metrics_url or args.host
+    session = requests.Session()
+    if args.cert and args.key:
+        session.cert = (args.cert, args.key)
+    if args.cacert:
+        session.verify = args.cacert
 
     from veneur_tpu.util import netaddr
     dest = netaddr.split_hostport(args.statsd)
     sock = socket.socket(netaddr.family(dest[0]), socket.SOCK_DGRAM)
+    tr = Translator(ignored_labels=args.ignored_labels or None,
+                    renamed=_parse_kv(args.renamed),
+                    added=_parse_kv(args.added),
+                    ignored_metrics=args.ignored_metrics or None)
 
-    class StatsdIngest:
-        """Ingest shim that re-emits as DogStatsD lines."""
+    def scrape_once() -> None:
+        try:
+            resp = session.get(url, timeout=args.interval)
+            resp.raise_for_status()
+            stats = tr.translate(resp.text)
+        except Exception:
+            tr.decode_errors += 1
+            logger.exception("scrape failed")
+            stats = []
+        # self-stats mirror translate.go's statID set
+        stats = list(stats) + [
+            ("veneur.prometheus.metrics_flushed_total",
+             len(stats) + 2, "c", []),
+        ]
+        if tr.unknown_types:
+            stats.append(("veneur.prometheus.unknown_metric_type_total",
+                          tr.unknown_types, "c", []))
+            tr.unknown_types = 0
+        if tr.decode_errors:
+            stats.append(("veneur.prometheus.decode_errors_total",
+                          tr.decode_errors, "c", []))
+            tr.decode_errors = 0
+        for line in statsd_lines(stats, args.prefix):
+            sock.sendto(line, dest)
 
-        def ingest_metric(self, m):
-            name = args.prefix + m.name
-            mtype = "c" if m.type == "counter" else "g"
-            line = f"{name}:{m.value}|{mtype}"
-            if m.tags:
-                line += "|#" + ",".join(m.tags)
-            sock.sendto(line.encode(), dest)
-
-    ingest = StatsdIngest()
     if args.once:
-        source.scrape_once(ingest)
+        scrape_once()
         return 0
     try:
         while True:
             t0 = time.time()
-            try:
-                source.scrape_once(ingest)
-            except Exception:
-                logging.exception("scrape failed")
+            scrape_once()
             time.sleep(max(0.0, args.interval - (time.time() - t0)))
     except KeyboardInterrupt:
         return 0
