@@ -1,0 +1,185 @@
+"""High-cardinality histogram soak with the device in the loop.
+
+Round-2 verdict #5 / SURVEY §7.3 ("1M samples/s doesn't stall ingest
+during flush"): sustain N histogram keys through the REAL server path —
+native engine ingest, eager device sync ticks, interval flushes through
+the serving device program — and assert
+
+  * exact conservation: sum of flushed `.count` values == samples fed
+    (lossless feed via direct engine ingest, no UDP shed),
+  * flat RSS (late-run vs early-run growth bounded),
+  * flush-interval adherence (p99 inter-flush gap).
+
+Usage:  python scripts/soak_high_cardinality.py [seconds] [keys] [interval]
+CI runs a short smoke via tests/test_stress.py; the 90 s run's numbers
+live in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def rss_bytes() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) * 1024
+    return 0
+
+
+def run_soak(duration_s: float = 90.0, n_keys: int = 100_000,
+             interval_s: float = 5.0, lines_per_packet: int = 8,
+             target_rate: float = 400_000.0, verbose: bool = True) -> dict:
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import ChannelMetricSink
+
+    sink = ChannelMetricSink()
+    cfg = config_mod.Config(
+        # the UDP listener spins up the native engine + drain loop; the
+        # feed itself goes through engine.ingest directly (lossless)
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        interval=interval_s,
+        eager_device_sync=True,
+        ingest_drain_interval=0.2,
+        arena_initial_capacity=n_keys,
+        hostname="soak")
+    srv = Server(cfg, extra_metric_sinks=[sink])
+    srv.start()
+    assert srv.native is not None, "soak needs the native engine"
+    eng = srv.native.engine
+    tid = eng.new_thread()
+
+    # pre-built datagrams cycling through every key (weight-1 samples)
+    rng = np.random.default_rng(5)
+    packets = []
+    key = 0
+    while key < n_keys:
+        lines = []
+        for _ in range(lines_per_packet):
+            lines.append(b"soak.lat.k%d:%.4f|h" % (key % n_keys,
+                                                   rng.gamma(2.0, 10.0)))
+            key += 1
+        packets.append(b"\n".join(lines))
+
+    feed_stop = threading.Event()
+    coll_stop = threading.Event()
+    sent = 0
+    sent_lock = threading.Lock()
+
+    def feeder():
+        nonlocal sent
+        i = 0
+        start = time.perf_counter()
+        while not feed_stop.is_set():
+            # count BEFORE ingest: the pair is uninterruptible within
+            # this thread, so `sent` is exact at join time
+            with sent_lock:
+                sent += lines_per_packet
+            eng.ingest(tid, packets[i % len(packets)])
+            i += 1
+            if i % 64 == 0:
+                # rate control: stay at the target so staging cannot
+                # grow unboundedly ahead of the drain ticks
+                ahead = (i * lines_per_packet / target_rate
+                         - (time.perf_counter() - start))
+                if ahead > 0:
+                    time.sleep(min(ahead, 0.05))
+
+    flush_times: list[float] = []
+    counted = 0.0
+
+    def collector():
+        nonlocal counted
+        while True:
+            try:
+                batch = sink.queue.get(timeout=1.0)
+            except queue.Empty:
+                if coll_stop.is_set() and sink.queue.empty():
+                    return
+                continue
+            # only the soak keys: the server's own flush-span timers
+            # also emit histogram .count series via ssfmetrics
+            got = sum(m.value for m in batch
+                      if m.name.startswith("soak.lat.")
+                      and m.name.endswith(".count"))
+            if got:
+                counted += got
+                flush_times.append(time.time())
+
+    rss_samples = []
+    t_serve = threading.Thread(target=srv.serve, daemon=True)
+    t_feed = threading.Thread(target=feeder, daemon=True)
+    t_coll = threading.Thread(target=collector, daemon=True)
+    t_serve.start()
+    t_feed.start()
+    t_coll.start()
+    t0 = time.time()
+    while time.time() - t0 < duration_s:
+        time.sleep(1.0)
+        rss_samples.append(rss_bytes())
+        if verbose:
+            with sent_lock:
+                s = sent
+            print(f"  t={time.time() - t0:5.1f}s sent={s:,} "
+                  f"counted={int(counted):,} rss={rss_samples[-1] >> 20}MiB",
+                  file=sys.stderr, flush=True)
+    feed_stop.set()
+    t_feed.join(timeout=5)
+    with sent_lock:
+        total_sent = sent
+    soak_end = time.time()
+    # drain the tail: final drains + flushes until conservation holds
+    srv.stop_serving()
+    t_serve.join(timeout=2 * interval_s + 10)
+    deadline = time.time() + max(6 * interval_s, 30)
+    while counted < total_sent and time.time() < deadline:
+        srv._drain_native()
+        srv.flush()
+        time.sleep(0.2)
+    coll_stop.set()
+    t_coll.join(timeout=10)
+    srv.shutdown()
+
+    # interval adherence over the soak window only (tail flushes are
+    # back-to-back by design)
+    in_soak = [t for t in flush_times if t <= soak_end]
+    gaps = np.diff(in_soak) if len(in_soak) > 2 else np.array([0.0])
+    # skip the warmup third (first-compile + arena faulting dominate it)
+    steady = rss_samples[len(rss_samples) // 3:] or rss_samples
+    q = len(steady) // 4 or 1
+    early = float(np.mean(steady[:q]))
+    late = float(np.mean(steady[-q:]))
+    return {
+        "duration_s": duration_s,
+        "keys": n_keys,
+        "sent": total_sent,
+        "counted": int(counted),
+        "lost": total_sent - int(counted),
+        "rate_per_s": round(total_sent / duration_s),
+        "flushes": len(flush_times),
+        "gap_p50_s": round(float(np.percentile(gaps, 50)), 2),
+        "gap_p99_s": round(float(np.percentile(gaps, 99)), 2),
+        "rss_early_mb": round(early / 2**20),
+        "rss_late_mb": round(late / 2**20),
+        "rss_growth_pct": round(100.0 * (late - early) / early, 1),
+    }
+
+
+if __name__ == "__main__":
+    dur = float(sys.argv[1]) if len(sys.argv) > 1 else 90.0
+    keys = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    iv = float(sys.argv[3]) if len(sys.argv) > 3 else 5.0
+    rate = float(sys.argv[4]) if len(sys.argv) > 4 else 400_000.0
+    out = run_soak(dur, keys, iv, target_rate=rate)
+    print(json.dumps(out))
+    if out["lost"] != 0:
+        sys.exit(1)

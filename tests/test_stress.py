@@ -109,3 +109,25 @@ def test_ingest_flush_gc_conservation():
     # (flush latency varies with host speed; the conservation asserts
     # above are the actual race detector)
     assert flush_batches[0] >= 3
+
+
+def test_high_cardinality_soak_smoke():
+    """Short CI variant of scripts/soak_high_cardinality.py (round-2
+    verdict #5): sustained histogram traffic across many keys through the
+    real server — native ingest, eager sync ticks, ticker flushes through
+    the device program — with EXACT conservation, bounded RSS growth, and
+    interval adherence.  The 90 s / 100k-key run's numbers live in
+    BASELINE.md."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "scripts"))
+    from soak_high_cardinality import run_soak
+
+    out = run_soak(duration_s=8.0, n_keys=5_000, interval_s=2.0,
+                   target_rate=150_000.0, verbose=False)
+    assert out["lost"] == 0, out
+    assert out["flushes"] >= 2, out
+    assert out["gap_p99_s"] < 2.0 * 2.0, out
+    assert out["rss_growth_pct"] < 25.0, out
