@@ -71,3 +71,46 @@ def test_pallas_quantile_matches_xla():
     # empty row -> NaN on both
     assert np.isnan(got[-1]).all() and np.isnan(want[-1]).all()
     np.testing.assert_allclose(got[:-1], want[:-1], rtol=1e-5, atol=1e-4)
+
+
+def test_sorted_eval_pallas_parity_interpret():
+    """The fused Pallas flush kernel (ops/sorted_eval.py) must match the
+    XLA weighted_eval on dense/sparse/tied/empty/single-point rows."""
+    import numpy as np
+
+    from veneur_tpu.ops import sorted_eval as se
+    from veneur_tpu.sketches import tdigest as td
+
+    rng = np.random.default_rng(3)
+    for (u, d) in ((64, 32), (16, 256), (8, 2), (32, 512)):
+        m = rng.gamma(2.0, 10.0, (u, d)).astype(np.float32)
+        w = ((rng.random((u, d)) < 0.7)
+             * rng.integers(1, 4, (u, d))).astype(np.float32)
+        m[1, :] = 5.0                    # ties: pairs must not split
+        w[2, :] = 0.0                    # empty row
+        w[3, :] = 0.0
+        w[3, 0] = 2.0                    # single-point row
+        dmin = np.where(w.sum(1) > 0,
+                        np.where(w > 0, m, np.inf).min(1), 0.0)
+        dmax = np.where(w.sum(1) > 0,
+                        np.where(w > 0, m, -np.inf).max(1), 0.0)
+        pct = jnp.asarray([0.5, 0.9, 0.99], jnp.float32)
+        ref = np.asarray(td.weighted_eval(
+            jnp.asarray(m), jnp.asarray(w),
+            jnp.asarray(dmin.astype(np.float32)),
+            jnp.asarray(dmax.astype(np.float32)), pct))
+        got = np.asarray(se.weighted_eval(
+            jnp.asarray(m), jnp.asarray(w),
+            jnp.asarray(dmin.astype(np.float32)),
+            jnp.asarray(dmax.astype(np.float32)), pct, interpret=True))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-4,
+                                   err_msg=f"{u}x{d}")
+
+
+def test_sorted_eval_usable_predicate():
+    from veneur_tpu.ops import sorted_eval as se
+    assert se.usable(256, 256, "tpu")
+    assert not se.usable(256, 256, "cpu")
+    assert not se.usable(256, 3, "tpu")      # non-pow2 depth
+    assert not se.usable(4, 256, "tpu")      # sub-sublane row count
+    assert not se.usable(12, 256, "tpu")     # non-multiple of 8

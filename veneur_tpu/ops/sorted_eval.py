@@ -1,0 +1,154 @@
+"""Pallas TPU kernel: the fused flush evaluation (bitonic sort + quantiles).
+
+Drop-in for `veneur_tpu.sketches.tdigest.weighted_eval` — THE serving
+flush's compute core.  One kernel invocation per row tile does everything
+the flush needs while the tile stays VMEM-resident:
+
+  * in-register bitonic sort of the (value, weight) pairs along the depth
+    axis (compare-exchange stages built from `pltpu.roll` + selects;
+    pair-consistent strict comparisons keep tied values' weights with
+    their owners);
+  * cumulative weights as a triangular ones matmul on the MXU (the
+    guaranteed-lowering form of `cumsum`);
+  * per-quantile rank search as compare+reduce, and the neighbor value
+    gathers as one-hot reductions (Mosaic has no cheap dynamic lane
+    gather);
+  * midpoint interpolation, single-point/empty-row handling, min/max
+    clamping — numerically identical to the XLA twin (parity-tested in
+    interpret mode and natively).
+
+HBM traffic is exactly one read of the `[K, D]` inputs and one `[K, P+2]`
+write; everything else lives in VMEM.  XLA's stock `lax.sort` lowers to a
+far slower generic network with full HBM round-trips per stage — this
+kernel is why the flush beats the 32-core native baseline by a wide
+margin instead of a narrow one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_TILE = 256
+# padding sentinel: large finite (inf * 0 would make NaNs in the sums)
+_BIG = 3.0e38  # python float: jnp scalars would be captured consts
+
+
+def _cmp_exchange(key, w, j, k, idx):
+    """One bitonic compare-exchange stage: partner = lane ^ j, direction
+    by bit k.  Strict per-side comparisons make tie handling consistent
+    for both partners, so (key, weight) pairs never split."""
+    d = key.shape[1]
+    lower = (idx & j) == 0
+    # pltpu.roll requires non-negative shifts: roll by d-j == roll by -j
+    pk = jnp.where(lower, pltpu.roll(key, d - j, axis=1),
+                   pltpu.roll(key, j, axis=1))
+    pw = jnp.where(lower, pltpu.roll(w, d - j, axis=1),
+                   pltpu.roll(w, j, axis=1))
+    up = (idx & k) == 0
+    want_small = lower == up
+    # logical form, not a bool-valued where: Mosaic cannot truncate the
+    # intermediate i8 select result back to i1
+    take = (want_small & (pk < key)) | (~want_small & (pk > key))
+    return jnp.where(take, pk, key), jnp.where(take, pw, w)
+
+
+def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
+    m = mean_ref[...]             # [T, D]
+    w = weight_ref[...]           # [T, D]
+    mm = minmax_ref[...]          # [T, 2] (min; max)
+    qs = qs_ref[...]              # [1, P]
+    t, d = m.shape
+    n_pct = qs.shape[1]
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (t, d), 1)
+    key = jnp.where(w > 0, m, _BIG)
+    k = 2
+    while k <= d:                 # static: fully unrolled network
+        j = k // 2
+        while j >= 1:
+            key, w = _cmp_exchange(key, w, j, k, idx)
+            j //= 2
+        k *= 2
+    occ = w > 0
+    m_clean = jnp.where(occ, key, 0.0)
+
+    # prefix sums as a triangular matmul (HIGHEST precision: bf16 MXU
+    # rounding would break the monotone rank search)
+    ks = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
+    js = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
+    # int arithmetic instead of a bool mask: Mosaic cannot truncate the
+    # intermediate i8 compare vector back to i1 at this shape
+    tri = jnp.clip(js - ks + 1, 0, 1).astype(jnp.float32)
+    cum = jnp.dot(w, tri, preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST)          # [T, D]
+    total = cum[:, d - 1:d]                                     # [T, 1]
+    sums = jnp.sum(m_clean * w, axis=1, keepdims=True)          # [T, 1]
+    n_real = jnp.sum(occ.astype(jnp.int32), axis=1,
+                     keepdims=True)                             # [T, 1]
+    cmid = cum - 0.5 * w
+    hi_bound = jnp.maximum(n_real - 1, 1)
+    first_mean = jnp.sum(
+        jnp.where(idx == 0, m_clean, 0.0), axis=1, keepdims=True)
+    dmin, dmax = mm[:, 0:1], mm[:, 1:2]
+
+    cols = []
+    for p in range(n_pct):        # static: unrolled per quantile
+        tq = qs[0, p] * total                                   # [T, 1]
+        rank = jnp.sum((cmid < tq).astype(jnp.int32), axis=1,
+                       keepdims=True)
+        ii = jnp.clip(rank, 1, hi_bound)
+        oh_hi = (idx == ii).astype(jnp.float32)
+        oh_lo = (idx == ii - 1).astype(jnp.float32)
+        m_hi = jnp.sum(oh_hi * m_clean, axis=1, keepdims=True)
+        m_lo = jnp.sum(oh_lo * m_clean, axis=1, keepdims=True)
+        c_hi = jnp.sum(oh_hi * cmid, axis=1, keepdims=True)
+        c_lo = jnp.sum(oh_lo * cmid, axis=1, keepdims=True)
+        tt = jnp.where(c_hi > c_lo,
+                       (tq - c_lo) / jnp.maximum(c_hi - c_lo, 1e-30),
+                       0.0)
+        q = m_lo + (m_hi - m_lo) * jnp.clip(tt, 0.0, 1.0)
+        q = jnp.where(n_real <= 1, first_mean, q)
+        q = jnp.clip(q, dmin, dmax)
+        q = jnp.where(total > 0, q, 0.0)
+        cols.append(q)
+    out_ref[...] = jnp.concatenate(cols + [total, sums], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def weighted_eval(mean: jax.Array, weight: jax.Array,
+                  d_min: jax.Array, d_max: jax.Array,
+                  percentiles: jax.Array,
+                  interpret: bool = False) -> jax.Array:
+    """Pallas twin of `td.weighted_eval`: `[K, D]` weighted points ->
+    `[K, P+2]` (quantiles, total weight, weighted sum).  K must be a
+    multiple of 8 and D a power of two (the dense builder guarantees
+    both)."""
+    u, d = mean.shape
+    n_pct = percentiles.shape[0]
+    tile = min(ROW_TILE, u)
+    minmax = jnp.stack([d_min, d_max], axis=1)                  # [U, 2]
+    qs = percentiles.reshape(1, n_pct).astype(jnp.float32)
+    return pl.pallas_call(
+        _kernel,
+        grid=(u // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 2), lambda i: (i, 0)),
+            pl.BlockSpec((1, n_pct), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, n_pct + 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((u, n_pct + 2), jnp.float32),
+        interpret=interpret,
+    )(mean.astype(jnp.float32), weight.astype(jnp.float32), minmax, qs)
+
+
+def usable(u: int, d: int, backend: str) -> bool:
+    """Static predicate: can the Pallas path evaluate this dense shape?"""
+    return (backend == "tpu" and d >= 2 and (d & (d - 1)) == 0
+            and d <= 1024 and u >= 8 and u % 8 == 0)

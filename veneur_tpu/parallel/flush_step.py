@@ -77,15 +77,20 @@ def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,
                    hll_p: int = 10, seed: int = 0) -> FlushInputs:
     """Small synthetic inputs for compile checks and dry runs: every key
     holds `n_lanes * depth` staged weighted points (the dense depth axis
-    tiles the replica mesh axis evenly)."""
+    tiles the replica mesh axis evenly).  Rows pad up to a power of two
+    with zero-weight rows, exactly like the production dense builder
+    (arena.py build_dense) — the padded rows are part of the honest
+    workload."""
     import numpy as np
     rng = np.random.default_rng(seed)
     m = 1 << hll_p
-    k, r, s = n_keys, n_lanes, n_sets
+    r, s = n_lanes, n_sets
+    k = 1 << (n_keys - 1).bit_length() if n_keys > 1 else 1
     d = r * depth
 
     vals = rng.gamma(2.0, 10.0, (k, d)).astype(np.float32)
-    wts = np.ones((k, d), np.float32)
+    wts = np.zeros((k, d), np.float32)
+    wts[:n_keys] = 1.0
     minmax = np.stack([vals.min(axis=1), vals.max(axis=1)]).astype(
         np.float32)
     counters = rng.integers(0, 100, (r, k)).astype(np.float32)

@@ -135,6 +135,22 @@ def fetch(x):
 # Flush body (shared by the serving path and the bench's flush_step)
 # ---------------------------------------------------------------------------
 
+def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
+                d_max: jax.Array, percentiles: jax.Array) -> jax.Array:
+    """The flush's evaluation core, routed to the fused Pallas kernel
+    (ops/sorted_eval.py: in-VMEM bitonic sort + MXU prefix sums) when the
+    backend and static shapes allow, else the XLA formulation — bitwise
+    parity between the two is test-enforced."""
+    import os
+
+    from veneur_tpu.ops import sorted_eval as se
+    u, d = dv.shape
+    if (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
+            and se.usable(u, d, jax.default_backend())):
+        return se.weighted_eval(dv, dw, d_min, d_max, percentiles)
+    return td.weighted_eval(dv, dw, d_min, d_max, percentiles)
+
+
 def flush_body(inputs: FlushInputs, percentiles: jax.Array,
                axis: Optional[str]) -> FlushOutputs:
     """Evaluate every family for one flush.  `axis` names the replica mesh
@@ -144,8 +160,8 @@ def flush_body(inputs: FlushInputs, percentiles: jax.Array,
         # gather every replica's sample slice: [K_s, D/R] -> [K_s, D]
         dv = jax.lax.all_gather(dv, axis, axis=1, tiled=True)
         dw = jax.lax.all_gather(dw, axis, axis=1, tiled=True)
-    ev = td.weighted_eval(dv, dw, inputs.minmax[0], inputs.minmax[1],
-                          percentiles)
+    ev = digest_eval(dv, dw, inputs.minmax[0], inputs.minmax[1],
+                     percentiles)
 
     set_regs = jnp.max(inputs.hll_regs, axis=0)
     chi = jnp.sum(inputs.counter_planes[..., 0], axis=0)
@@ -178,7 +194,7 @@ def make_serving_flush(mesh: Optional[Mesh]):
     """
     if mesh is None:
         return jax.jit(
-            lambda dv, dw, minmax, pct: td.weighted_eval(
+            lambda dv, dw, minmax, pct: digest_eval(
                 dv, dw, minmax[0], minmax[1], pct))
 
     spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
