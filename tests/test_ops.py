@@ -110,7 +110,39 @@ def test_sorted_eval_pallas_parity_interpret():
 def test_sorted_eval_usable_predicate():
     from veneur_tpu.ops import sorted_eval as se
     assert se.usable(256, 256, "tpu")
+    assert se.usable(512, 256, "tpu")
+    assert se.usable(24, 256, "tpu")         # single-tile, sublane mult
     assert not se.usable(256, 256, "cpu")
     assert not se.usable(256, 3, "tpu")      # non-pow2 depth
     assert not se.usable(4, 256, "tpu")      # sub-sublane row count
     assert not se.usable(12, 256, "tpu")     # non-multiple of 8
+    # > ROW_TILE but not a tile multiple: trailing rows would be
+    # unwritten garbage (review finding)
+    assert not se.usable(264, 256, "tpu")
+    assert not se.usable(384, 256, "tpu")
+
+
+def test_sorted_eval_extreme_float32_values():
+    """Values near float32 max must sort before the +inf padding key —
+    a finite sentinel would order them after padding and corrupt the
+    quantiles (review finding)."""
+    import numpy as np
+
+    from veneur_tpu.ops import sorted_eval as se
+    from veneur_tpu.sketches import tdigest as td
+
+    m = np.zeros((8, 8), np.float32)
+    w = np.zeros((8, 8), np.float32)
+    m[0, :3] = [1.0, 3.3e38, 2.0]
+    w[0, :3] = 1.0
+    dmin = np.array([1.0] + [0] * 7, np.float32)
+    dmax = np.array([3.3e38] + [0] * 7, np.float32)
+    pct = jnp.asarray([0.5, 0.99], jnp.float32)
+    ref = np.asarray(td.weighted_eval(
+        jnp.asarray(m), jnp.asarray(w), jnp.asarray(dmin),
+        jnp.asarray(dmax), pct))
+    got = np.asarray(se.weighted_eval(
+        jnp.asarray(m), jnp.asarray(w), jnp.asarray(dmin),
+        jnp.asarray(dmax), pct, interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    assert got[0, 0] == 2.0  # median of {1, 2, 3.3e38}

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from veneur_tpu.ops import mxu
+
 ROW_TILE = 8
 
 
@@ -44,11 +46,7 @@ def _kernel(mean_ref, weight_ref, dmin_ref, dmax_ref, qs_ref, out_ref):
     # k<=j).  HIGHEST precision: the MXU's default bf16 inputs would
     # round weights and break both parity with the XLA twin and the
     # monotonicity the count-below-target search depends on.
-    ks = jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
-    js = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
-    tri = (ks <= js).astype(jnp.float32)                       # [C, C]
-    cum = jnp.dot(w, tri, preferred_element_type=jnp.float32,
-                  precision=jax.lax.Precision.HIGHEST)         # [T, C]
+    cum = mxu.tri_cumsum(w)                                    # [T, C]
     total = cum[:, c - 1:c]                                    # [T, 1]
 
     # centroid bounds (merging_digest.go:355-370 semantics)

@@ -33,9 +33,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from veneur_tpu.ops import mxu
+
 ROW_TILE = 256
-# padding sentinel: large finite (inf * 0 would make NaNs in the sums)
-_BIG = 3.0e38  # python float: jnp scalars would be captured consts
+# padding sort key: +inf never collides with real values (the parser
+# rejects non-finite samples; m_clean masks padding before any product,
+# so no inf*0 NaN can arise).  A plain python float — jnp scalars would
+# be captured constants, which pallas_call rejects.
+_PAD_KEY = float("inf")
 
 
 def _cmp_exchange(key, w, j, k, idx):
@@ -66,7 +71,7 @@ def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
     n_pct = qs.shape[1]
 
     idx = jax.lax.broadcasted_iota(jnp.int32, (t, d), 1)
-    key = jnp.where(w > 0, m, _BIG)
+    key = jnp.where(w > 0, m, _PAD_KEY)
     k = 2
     while k <= d:                 # static: fully unrolled network
         j = k // 2
@@ -77,15 +82,7 @@ def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
     occ = w > 0
     m_clean = jnp.where(occ, key, 0.0)
 
-    # prefix sums as a triangular matmul (HIGHEST precision: bf16 MXU
-    # rounding would break the monotone rank search)
-    ks = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
-    js = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
-    # int arithmetic instead of a bool mask: Mosaic cannot truncate the
-    # intermediate i8 compare vector back to i1 at this shape
-    tri = jnp.clip(js - ks + 1, 0, 1).astype(jnp.float32)
-    cum = jnp.dot(w, tri, preferred_element_type=jnp.float32,
-                  precision=jax.lax.Precision.HIGHEST)          # [T, D]
+    cum = mxu.tri_cumsum(w)                                     # [T, D]
     total = cum[:, d - 1:d]                                     # [T, 1]
     sums = jnp.sum(m_clean * w, axis=1, keepdims=True)          # [T, 1]
     n_real = jnp.sum(occ.astype(jnp.int32), axis=1,
@@ -149,6 +146,10 @@ def weighted_eval(mean: jax.Array, weight: jax.Array,
 
 
 def usable(u: int, d: int, backend: str) -> bool:
-    """Static predicate: can the Pallas path evaluate this dense shape?"""
+    """Static predicate: can the Pallas path evaluate this dense shape?
+    Rows must tile the grid exactly: u <= ROW_TILE runs as one tile (so
+    any sublane multiple works), larger row counts must be ROW_TILE
+    multiples or trailing rows would never be written."""
+    rows_ok = (u % 8 == 0 if u <= ROW_TILE else u % ROW_TILE == 0)
     return (backend == "tpu" and d >= 2 and (d & (d - 1)) == 0
-            and d <= 1024 and u >= 8 and u % 8 == 0)
+            and d <= 1024 and u >= 8 and rows_ok)

@@ -140,7 +140,10 @@ def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
     """The flush's evaluation core, routed to the fused Pallas kernel
     (ops/sorted_eval.py: in-VMEM bitonic sort + MXU prefix sums) when the
     backend and static shapes allow, else the XLA formulation — bitwise
-    parity between the two is test-enforced."""
+    parity between the two is test-enforced.
+
+    VENEUR_TPU_DISABLE_PALLAS_EVAL is read at TRACE time (the choice is
+    baked into each compiled program): set it before process start."""
     import os
 
     from veneur_tpu.ops import sorted_eval as se
