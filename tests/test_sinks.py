@@ -211,7 +211,29 @@ def test_signalfx_name_prefix_drops(http_capture):
     assert res.flushed == 1 and res.skipped == 1
 
 
-# ---------------------------------------------------------------- cortex
+def test_datadog_status_metrics_become_service_checks(http_capture):
+    """finalizeMetrics parity (datadog.go:371-383): status-type
+    InterMetrics post to /api/v1/check_run as DDServiceCheck JSON, not as
+    series points."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    port = http_capture.server_address[1]
+    sink = DatadogMetricSink(sink_mod.SinkSpec(kind="datadog", config={
+        "api_key": "k", "api_hostname": f"http://127.0.0.1:{port}"}))
+    status = im("db.up", 1.0, "status", tags=["host:db7", "az:a"])
+    status.message = "replica lag"
+    res = sink.flush([status, im("api.hits", 5, "counter")])
+    assert res.flushed == 2
+    by_path = {c["path"].split("?")[0]: c for c in http_capture.captured}
+    checks = json.loads(gzip.decompress(by_path["/api/v1/check_run"]["body"]))
+    assert checks == [{"check": "db.up", "status": 1,
+                       "host_name": "db7", "timestamp": 1700000000,
+                       "tags": ["az:a"], "message": "replica lag"}]
+    series = json.loads(gzip.decompress(
+        by_path["/api/v1/series"]["body"]))["series"]
+    assert [s["metric"] for s in series] == ["api.hits"]
+
+
+# ---------------------------------------------------------------- cortex# ---------------------------------------------------------------- cortex
 
 def _parse_write_request(data: bytes):
     """Minimal prompb decoder for assertions."""

@@ -68,6 +68,34 @@ def _post_json(session: requests.Session, url: str, payload,
     return False
 
 
+def split_status_checks(metrics, hostname: str) -> tuple[list, list]:
+    """Partition flush metrics into (series metrics, DDServiceCheck
+    dicts): a status-type InterMetric IS a service check at the Datadog
+    boundary (finalizeMetrics, datadog.go:371-383), posted to
+    /api/v1/check_run instead of riding the series body."""
+    plain, checks = [], []
+    for m in metrics:
+        if m.type != "status":
+            plain.append(m)
+            continue
+        host = hostname or m.hostname
+        tags = []
+        for t in m.tags:
+            if t.startswith("host:"):
+                host = t[len("host:"):]
+            else:
+                tags.append(t)
+        checks.append({
+            "check": m.name,
+            "status": int(m.value),
+            "host_name": host,
+            "timestamp": int(m.timestamp),
+            "tags": tags,
+            "message": m.message,
+        })
+    return plain, checks
+
+
 def series_payload(metrics: list[InterMetric], hostname: str,
                    interval_s: float, tags: list[str]) -> dict:
     """Build the `/api/v1/series` body (datadog.go flush conversion)."""
@@ -162,6 +190,18 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         # key rides the DD-API-KEY header, never the (logged) URL
         url = f"{self.api_url}/api/v1/series"
         auth = {"DD-API-KEY": self.api_key}
+        metrics, checks = split_status_checks(metrics, self.hostname)
+        n_checks = 0
+        if checks:
+            # status metrics are service checks at this boundary
+            # (flush_checks, datadog.go:164-180)
+            ok = _post_json(self._poster.session(),
+                            f"{self.api_url}/api/v1/check_run", checks,
+                            headers=auth, retries=self.flush_retries)
+            n_checks = len(checks) if ok else 0
+        if not metrics:
+            return sink_mod.MetricFlushResult(
+                flushed=n_checks, dropped=len(checks) - n_checks)
         chunks = [metrics[i:i + self.flush_max_per_body]
                   for i in range(0, len(metrics), self.flush_max_per_body)]
 
@@ -177,7 +217,9 @@ class DatadogMetricSink(sink_mod.BaseMetricSink):
         results += [False] * (len(chunks) - len(results))
         flushed = sum(len(c) for c, ok in zip(chunks, results) if ok)
         dropped = len(metrics) - flushed
-        return sink_mod.MetricFlushResult(flushed=flushed, dropped=dropped)
+        return sink_mod.MetricFlushResult(
+            flushed=flushed + n_checks,
+            dropped=dropped + len(checks) - n_checks)
 
     def flush_other_samples(self, samples):
         """Events + service checks (datadog.go:451 FlushOtherSamples)."""
