@@ -317,6 +317,8 @@ rt_count 30
     # histogram buckets: reference %f naming, cumulative deltas, le tag
     # stripped
     assert by2[("lat.le0.500000", ("team:infra",))] == (2, "c")
+    # +Inf bucket keeps Go's %f rendering (translate.go:176)
+    assert by2[("lat.le+Inf", ("team:infra",))] == (3, "c")
     assert by2[("lat.count", ("team:infra",))] == (3, "c")
     assert by2[("rt.count", ("team:infra",))] == (3, "c")
     # NaN quantile never emits

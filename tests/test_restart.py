@@ -102,6 +102,106 @@ def test_graceful_restart_zero_drop():
         f"(a={got_a}, b={got_b})")
 
 
+def test_graceful_restart_zero_drop_python_readers():
+    """Same handoff with the pure-Python reader path (native_ingest off):
+    the datagram readers must stay alive through the drain grace — they
+    stop on the dedicated readers event, not on _shutdown (review
+    finding)."""
+    sink_a = ChannelMetricSink()
+    cfg = dict(interval=600.0, flush_on_shutdown=True,
+               read_buffer_size_bytes=8 << 20, num_readers=2,
+               native_ingest=False)
+    srv_a = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"], hostname="a",
+        **cfg), extra_metric_sinks=[sink_a])
+    srv_a.start()
+    _, addr = srv_a.statsd_addrs[0]
+    port = addr[1]
+    sent = 0
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def sender():
+        nonlocal sent
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        while not stop.is_set():
+            for _ in range(10):
+                s.sendto(b"grp.hits:1|c", ("127.0.0.1", port))
+            with lock:
+                sent += 10
+            time.sleep(0.002)
+
+    t = threading.Thread(target=sender, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    sink_b = ChannelMetricSink()
+    srv_b = Server(config_mod.Config(
+        statsd_listen_addresses=[f"udp://127.0.0.1:{port}"],
+        hostname="b", **cfg), extra_metric_sinks=[sink_b])
+    srv_b.start()
+    time.sleep(0.2)
+    # the SIGUSR2 path: request (sets _shutdown) THEN drain — readers
+    # must still consume the tail
+    srv_a.request_graceful_restart()
+    srv_a.graceful_restart_drain(grace_s=0.5)
+    time.sleep(0.3)
+    stop.set()
+    t.join(timeout=5)
+    with lock:
+        total_sent = sent
+    deadline = time.time() + 10
+    last = -1
+    while time.time() < deadline:
+        time.sleep(0.1)
+        cur = srv_b.aggregator.processed
+        if cur == last:
+            break
+        last = cur
+    srv_b.flush()
+    srv_b.shutdown()
+    got = (_counter_total(sink_a, "grp.hits")
+           + _counter_total(sink_b, "grp.hits"))
+    assert got == total_sent, f"dropped {total_sent - got} of {total_sent}"
+
+
+def test_graceful_restart_releases_unix_path_during_drain(tmp_path):
+    """Unix listeners close and release their flock at the START of the
+    drain, and _bind_unix retries briefly — so a replacement started
+    around the SIGUSR2 can take over the path (review finding)."""
+    path = str(tmp_path / "gr.sock")
+    srv_a = Server(config_mod.Config(
+        statsd_listen_addresses=[f"unixgram://{path}"],
+        interval=600.0, hostname="a"))
+    srv_a.start()
+    result = {}
+
+    def replace():
+        srv_b = Server(config_mod.Config(
+            statsd_listen_addresses=[f"unixgram://{path}"],
+            interval=600.0, hostname="b"))
+        try:
+            srv_b.start()     # retries the flock while a drains
+            result["ok"] = True
+            c = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+            c.sendto(b"ur.c:1|c", path)
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    srv_b.aggregator.processed < 1:
+                time.sleep(0.02)
+            result["processed"] = srv_b.aggregator.processed
+        finally:
+            srv_b.shutdown()
+
+    t = threading.Thread(target=replace, daemon=True)
+    t.start()
+    time.sleep(0.05)          # replacement is now retrying the lock
+    srv_a.request_graceful_restart()
+    srv_a.graceful_restart_drain(grace_s=0.3)
+    t.join(timeout=10)
+    assert result.get("ok"), "replacement failed to bind during drain"
+    assert result.get("processed") == 1
+
+
 def test_abstract_unix_socket_statsd():
     """`@`-prefixed statsd listeners bind the Linux abstract namespace:
     no filesystem entry, no unlink, datagrams flow end to end."""

@@ -131,8 +131,14 @@ class Translator:
                         continue
                     if math.isnan(bound):
                         continue
-                    # reference naming: %s.le%f (translate.go:176)
-                    mname = f"{base}.le{bound:f}"
+                    # reference naming: %s.le%f (translate.go:176); Go %f
+                    # renders infinities as "+Inf"/"-Inf", python as
+                    # "inf" — match Go for name parity
+                    if math.isinf(bound):
+                        le_str = "+Inf" if bound > 0 else "-Inf"
+                    else:
+                        le_str = f"{bound:f}"
+                    mname = f"{base}.le{le_str}"
                     d = self._count_delta(mname, tags, value)
                     if d is not None:
                         out.append((mname, d, "c", tags))

@@ -233,6 +233,10 @@ class Server:
         self._socket_locks: list[tuple[str, object]] = []
         # set by request_graceful_restart (SIGUSR2)
         self._graceful_restart = False
+        # datagram readers stop on THIS event, not _shutdown: a graceful
+        # restart sets _shutdown to unblock serve() but must keep readers
+        # alive through the drain grace so the queued tail is consumed
+        self._readers_stop = threading.Event()
         self._legacy_hll_reported = 0
         self._threads: list[threading.Thread] = []
         self._shutdown = threading.Event()
@@ -486,9 +490,37 @@ class Server:
           3. drains the native engine and runs the final flush
              (flush_on_shutdown path) before tearing down.
 
-        Unix/abstract sockets need no reuseport dance: the replacement
-        re-binds the path (flock released at teardown) and the old
-        socket simply stops receiving."""
+        Unix sockets have no reuseport group: their listeners drain and
+        close FIRST (flock released immediately), so the replacement can
+        bind the path during the grace window — `_bind_unix` retries a
+        locked path briefly for exactly this ordering.  A unixgram sender
+        hitting the brief gap gets ECONNREFUSED (visible, not silent
+        loss), which matches the reference's behavior without einhorn."""
+        unix_socks = [s for s in self._listeners
+                      if s.family == socket.AF_UNIX
+                      and s.type == socket.SOCK_DGRAM]
+        for sock in unix_socks:
+            # consume whatever is queued, then close + release the lock
+            sock.setblocking(False)
+            while True:
+                try:
+                    data = sock.recv(self.config.metric_max_length + 1)
+                except (BlockingIOError, OSError):
+                    break
+                if data:
+                    self.handle_metric_packet(data)
+            try:
+                self._listeners.remove(sock)
+                sock.close()
+            except (ValueError, OSError):
+                pass
+        for lock_path, lock_f in self._socket_locks:
+            try:
+                lock_f.close()
+                os.unlink(lock_path)
+            except OSError:
+                pass
+        self._socket_locks = []
         for sock in self._listeners:
             if sock.type != socket.SOCK_DGRAM:
                 continue
@@ -518,13 +550,22 @@ class Server:
             return sock
         import fcntl
         lock_f = open(path + ".lock", "w")
-        try:
-            fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
-            lock_f.close()
-            sock.close()
-            raise RuntimeError(
-                f"socket path {path!r} is locked by another instance")
+        # bounded retry: a replacement started just before the old
+        # instance's SIGUSR2 drain releases the lock within the grace
+        # window (graceful_restart_drain ordering)
+        deadline = time.time() + 1.0
+        while True:
+            try:
+                fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    lock_f.close()
+                    sock.close()
+                    raise RuntimeError(
+                        f"socket path {path!r} is locked by another "
+                        f"instance")
+                time.sleep(0.05)
         self._socket_locks.append((path + ".lock", lock_f))
         if os.path.exists(path):
             os.unlink(path)
@@ -674,7 +715,7 @@ class Server:
         # instead of being silently truncated into a parseable prefix
         # (the reference allocates metricMaxLength+1, server.go:734).
         bufsize = self.config.metric_max_length + 1
-        while not self._shutdown.is_set():
+        while not self._readers_stop.is_set():
             try:
                 data = sock.recv(bufsize)
             except OSError:
@@ -833,7 +874,7 @@ class Server:
         # a UDP datagram can't exceed 64KiB; don't allocate the full
         # (16MiB default) trace_max_length_bytes per recv
         bufsize = min(self.config.trace_max_length_bytes, 65536)
-        while not self._shutdown.is_set():
+        while not self._readers_stop.is_set():
             try:
                 data = sock.recv(bufsize)
             except OSError:
@@ -1143,6 +1184,7 @@ class Server:
             except Exception:
                 logger.exception("final flush failed")
         self._shutdown.set()
+        self._readers_stop.set()
         for source in self.sources:
             try:
                 source.stop()
