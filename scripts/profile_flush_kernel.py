@@ -1,0 +1,130 @@
+"""Decompose the fused flush-eval kernel's device time on the real chip.
+
+Times progressively larger slices of ops/sorted_eval.py under the
+pipelined protocol (N launches, one value fetch), so the axon tunnel's
+per-call RTT amortizes out:
+
+  dma      read both [K, D] inputs, write a row-reduce  -> HBM/launch floor
+  sort     + full bitonic network                       -> sort cost
+  cumsum   + MXU triangular prefix sum                  -> rank-base cost
+  full     the production kernel                        -> + quantile passes
+  xla      the lax.sort twin (td.weighted_eval)         -> XLA comparison
+
+Usage: python scripts/profile_flush_kernel.py [K] [D] [pipeline] [rounds]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, "/root/repo")
+
+from veneur_tpu.ops import sorted_eval as se
+from veneur_tpu.sketches import tdigest as td
+
+
+def _variant_kernel(mode: str, n_pct: int):
+    # v2 transposed layout: tiles are [D, T]
+    def kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
+        m = mean_ref[...]
+        w = weight_ref[...]
+        d, t = m.shape
+        idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
+        key = jnp.where(w > 0, m, se._PAD_KEY)
+        if mode in ("sort", "cumsum"):
+            k = 2
+            while k <= d:
+                j = k // 2
+                while j >= 1:
+                    key, w = se._cmp_exchange(key, w, j, k, idx)
+                    j //= 2
+                k *= 2
+        if mode == "cumsum":
+            cum = se._cumsum_depth(w)
+            out = jnp.concatenate(
+                [cum[d - 1:d, :]] * (n_pct + 2), axis=0)
+        else:
+            red = jnp.sum(key * w, axis=0, keepdims=True)
+            out = jnp.concatenate([red] * (n_pct + 2), axis=0)
+        out_ref[...] = out
+    return kernel
+
+
+def run_variant(mode: str, mean, weight, minmax, qs, tile: int):
+    u, d = mean.shape
+    n_pct = qs.shape[1]
+    if mode == "full":
+        return se.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
+                                qs[0])
+    if mode == "xla":
+        return td.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
+                                qs[0])
+    kern = _variant_kernel(mode, n_pct)
+    return pl.pallas_call(
+        kern,
+        grid=(u // tile,),
+        in_specs=[
+            pl.BlockSpec((d, tile), lambda i: (0, i)),
+            pl.BlockSpec((d, tile), lambda i: (0, i)),
+            pl.BlockSpec((2, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, n_pct), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_pct + 2, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n_pct + 2, u), jnp.float32),
+    )(mean.T, weight.T, minmax.T, qs)
+
+
+def main():
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    pipeline = int(sys.argv[3]) if len(sys.argv) > 3 else 25
+    rounds = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} K={k} D={d} pipeline={pipeline}", flush=True)
+    rng = np.random.default_rng(0)
+    mean = jax.device_put(rng.gamma(2.0, 10.0, (k, d)).astype(np.float32))
+    weight = jax.device_put(np.ones((k, d), np.float32))
+    mm = np.stack([np.asarray(mean).min(1), np.asarray(mean).max(1)], 1)
+    minmax = jax.device_put(mm.astype(np.float32))
+    qs = jax.device_put(
+        np.asarray([[0.5, 0.9, 0.99]], np.float32))
+
+    bytes_read = 2 * k * d * 4
+    for mode in ("dma", "sort", "cumsum", "full", "xla"):
+        fns = {}
+        def fn(pct_jitter, _mode=mode):
+            return run_variant(_mode, mean, weight, minmax,
+                               qs + pct_jitter, se._lane_tile(k, d))
+        jfn = jax.jit(fn)
+        t0 = time.perf_counter()
+        float(np.asarray(jfn(0.0)[0, 0]))
+        compile_s = time.perf_counter() - t0
+        # warmup with varied args
+        for i in range(4):
+            float(np.asarray(jfn(i * 1e-7)[0, 0]))
+        per = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            outs = [jfn(i * 1e-7) for i in range(pipeline)]
+            float(np.asarray(outs[-1][0, 0]))
+            per.append((time.perf_counter() - t0) / pipeline * 1e3)
+        p50 = float(np.percentile(per, 50))
+        bw = bytes_read / (p50 * 1e-3) / 1e9
+        print(f"{mode:7s} p50={p50:8.3f} ms/flush  "
+              f"eff-BW={bw:7.1f} GB/s  (compile {compile_s:.1f}s)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -436,3 +436,43 @@ def test_arena_initial_capacity_presizing():
     a.process_metric(mk("c", "counter", 1))
     res = a.flush(is_local=False)
     assert by_name(res.metrics)["c"].value == 1.0
+
+
+def test_hll_legacy_migration_lane():
+    """Rolling-upgrade mixed fleet (hll_legacy_migration): legacy 'VH'
+    payloads carry blake2b-hashed members that land on different
+    registers than metro-hashed ones, so hash-mixing inflates the union.
+    The migration lane keeps them separate and emits max(primary,
+    legacy) — bounded error for the upgrade window."""
+    import hashlib
+
+    from veneur_tpu.sketches import hll
+
+    members = [f"user-{i}".encode() for i in range(20_000)]
+
+    # the legacy half of the fleet: pre-metro build, blake2b member hash
+    legacy_regs = np.zeros(1 << 14, np.uint8)
+    hs = np.fromiter(
+        (int.from_bytes(hashlib.blake2b(m, digest_size=8).digest(), "big")
+         for m in members), np.uint64, len(members))
+    idx, rank = hll.split_hashes(hs)
+    np.maximum.at(legacy_regs, idx, rank)
+    legacy_payload = b"VH" + bytes([1, 14, 0]) + legacy_regs.tobytes()
+
+    # the upgraded half: metro-hashed axiomhq payload, SAME members
+    sk = hll.HLLSketch()
+    sk.insert_batch(members)
+    metro_payload = sk.marshal()
+
+    def run(migration: bool) -> float:
+        g = agg(is_local=False, hll_legacy_migration=migration)
+        for payload in (metro_payload, legacy_payload):
+            g.import_metric(sm.ForwardMetric(
+                name="users", tags=[], kind=sm.TYPE_SET,
+                scope=MetricScope.MIXED, hll=payload))
+        res = g.flush(is_local=False)
+        return by_name(res.metrics)["users"].value
+
+    assert run(True) == pytest.approx(20_000, rel=0.05)
+    inflated = run(False)
+    assert inflated > 20_000 * 1.5  # the documented hazard, for contrast

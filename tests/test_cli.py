@@ -295,14 +295,15 @@ rt_count 30
 """
     first = tr.translate(scrape1)
     by = {(n, tuple(t)): (v, mt) for n, v, mt, t in first}
-    # first scrape: counters/buckets/counts have no delta yet; gauges and
-    # quantiles emit immediately
+    # first sweep: the cache has no basis, so counters emit a ZERO delta
+    # (stats.go:78-83 returns 0); gauges and quantiles emit immediately
     assert by[("temp", ("team:infra",))] == (21.5, "g")
     assert by[("lat.sum", ("team:infra",))] == (9.5, "g")
     assert by[("rt.sum", ("team:infra",))] == (12.5, "g")
     assert by[("rt.50percentile", ("team:infra",))] == (0.2, "g")
-    assert not any(n.startswith(("reqs", "lat.le", "lat.count", "rt.count",
-                                 "skip_me")) for n, *_ in first)
+    assert by[("reqs", ("stage:prod", "team:infra"))] == (0.0, "c")
+    assert by[("lat.count", ("team:infra",))] == (0.0, "c")
+    assert not any(n.startswith("skip_me") for n, *_ in first)
 
     scrape2 = scrape1.replace('reqs{env="prod",secret_id="x"} 10',
                               'reqs{env="prod",secret_id="x"} 14') \
@@ -323,3 +324,12 @@ rt_count 30
     assert by2[("rt.count", ("team:infra",))] == (3, "c")
     # NaN quantile never emits
     assert not any(n == "rt.99percentile" for n, *_ in second)
+
+    # a series first appearing mid-stream counts its FULL value
+    # (stats.go:85-88: the cache has a basis, the series is new); an
+    # unchanged counter emits a zero delta rather than being suppressed
+    scrape3 = scrape2 + '# TYPE newcomer counter\nnewcomer 7\n'
+    third = tr.translate(scrape3)
+    by3 = {(n, tuple(t)): (v, mt) for n, v, mt, t in third}
+    assert by3[("newcomer", ("team:infra",))] == (7, "c")
+    assert by3[("reqs", ("stage:prod", "team:infra"))] == (0.0, "c")

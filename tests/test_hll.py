@@ -66,21 +66,45 @@ def test_precision_mismatch_rejected():
 
 
 def test_codec_roundtrip_dense():
-    """marshal always emits the axiomhq dense form (header + m/2 nibble
+    """Large sets emit the axiomhq dense form (header + m/2 nibble
     bytes); ranks round-trip exactly up to the 4-bit tailcut clamp the
     vendor library itself applies (hyperloglog.go insert)."""
-    small = hll.HLLSketch()
-    small.insert_batch([f"s{i}".encode() for i in range(50)])
-    data = small.marshal()
-    assert len(data) == 8 + (1 << 14) // 2
-    back = hll.HLLSketch.unmarshal(data)
-    np.testing.assert_array_equal(back.regs, np.minimum(small.regs, 15))
-
     big = hll.HLLSketch()
     big.insert_batch([f"d{i}".encode() for i in range(100_000)])
-    back = hll.HLLSketch.unmarshal(big.marshal())
+    data = big.marshal()
+    assert len(data) == 8 + (1 << 14) // 2
+    back = hll.HLLSketch.unmarshal(data)
     np.testing.assert_array_equal(back.regs, np.minimum(big.regs, 15))
     assert back.estimate() == pytest.approx(big.estimate(), rel=0.01)
+
+
+def test_codec_roundtrip_sparse_small_sets():
+    """Small sets emit the axiomhq sparse MarshalBinary form (vendor
+    hyperloglog.go:274-299): O(members) bytes instead of the 8 KiB dense
+    payload, ranks round-tripping EXACTLY (no tailcut in sparse)."""
+    small = hll.HLLSketch()
+    small.insert_batch([f"s{i}".encode() for i in range(10)])
+    data = small.marshal()
+    assert data[3] == 1                       # sparse flag
+    assert len(data) < 100                    # ~50 bytes, not 8 KiB
+    back = hll.HLLSketch.unmarshal(data)
+    np.testing.assert_array_equal(back.regs, small.regs)
+    assert back.estimate() == small.estimate()
+
+    # every (register, rank) combination synthesizes keys that decode
+    # back exactly — including ranks past the flagged/unflagged split
+    # (sub-width = pp - p = 11) and the max rank 64 - p + 1
+    probe = hll.HLLSketch()
+    idx = np.asarray([0, 1, 77, 5000, (1 << 14) - 1, 9000, 12345])
+    rank = np.asarray([1, 11, 12, 31, 51, 2, 40], np.uint8)
+    probe.regs[idx] = rank
+    back = hll.HLLSketch.unmarshal(probe.marshal())
+    np.testing.assert_array_equal(back.regs, probe.regs)
+
+    # crossover: at ~2k occupied registers the dense form is smaller
+    mid = hll.HLLSketch()
+    mid.insert_batch([f"m{i}".encode() for i in range(40_000)])
+    assert mid.marshal()[3] == 0              # dense flag
 
 
 def test_batched_estimate_rows_independent():

@@ -82,7 +82,8 @@ def test_sorted_eval_pallas_parity_interpret():
     from veneur_tpu.sketches import tdigest as td
 
     rng = np.random.default_rng(3)
-    for (u, d) in ((64, 32), (16, 256), (8, 2), (32, 512)):
+    for (u, d) in ((64, 32), (16, 256), (8, 2), (32, 512), (256, 4),
+                   (8, 1024)):
         m = rng.gamma(2.0, 10.0, (u, d)).astype(np.float32)
         w = ((rng.random((u, d)) < 0.7)
              * rng.integers(1, 4, (u, d))).astype(np.float32)
@@ -111,15 +112,18 @@ def test_sorted_eval_usable_predicate():
     from veneur_tpu.ops import sorted_eval as se
     assert se.usable(256, 256, "tpu")
     assert se.usable(512, 256, "tpu")
-    assert se.usable(24, 256, "tpu")         # single-tile, sublane mult
+    assert se.usable(128, 256, "tpu")        # one full lane tile
+    assert se.usable(131072, 4, "tpu")       # shallow prod depth
+    assert se.usable(16384, 1024, "tpu")     # max depth
     assert not se.usable(256, 256, "cpu")
     assert not se.usable(256, 3, "tpu")      # non-pow2 depth
-    assert not se.usable(4, 256, "tpu")      # sub-sublane row count
-    assert not se.usable(12, 256, "tpu")     # non-multiple of 8
-    # > ROW_TILE but not a tile multiple: trailing rows would be
-    # unwritten garbage (review finding)
-    assert not se.usable(264, 256, "tpu")
-    assert not se.usable(384, 256, "tpu")
+    assert not se.usable(256, 2048, "tpu")   # past MAX_DEPTH
+    assert not se.usable(24, 256, "tpu")     # sub-lane-tile key count
+    assert not se.usable(4, 256, "tpu")
+    assert se.usable(384, 256, "tpu")        # single 384-lane tile
+    # not a whole number of lane tiles: trailing keys would be
+    # unwritten garbage
+    assert not se.usable(131072 + 128, 256, "tpu")
 
 
 def test_sorted_eval_extreme_float32_values():

@@ -489,9 +489,61 @@ def test_xray_segments_over_udp():
     seg = json.loads(seg_json)
     assert seg["trace_id"].startswith("1-")
     assert len(seg["trace_id"].split("-")[2]) == 24
-    assert seg["annotations"] == {"env": "prod"}
-    assert seg["metadata"] == {"extra": "stuff"}
+    # annotations are allow-listed (+ indicator); metadata carries ALL
+    # tags (+ indicator), like the reference (`xray.go:203-231`)
+    assert seg["annotations"] == {"env": "prod", "indicator": "false"}
+    assert seg["metadata"] == {"env": "prod", "extra": "stuff",
+                               "indicator": "false"}
     assert seg["type"] == "subsegment" and seg["parent_id"].endswith("37")
+    assert seg["namespace"] == "remote"
+
+
+def test_xray_segment_classification_and_http_block():
+    """Segment-document fidelity (`xray.go:180-256` + the X-Ray segment
+    spec): fault for 5xx, throttle (and error) for 429, error for 4xx,
+    the http sub-document from span tags, name cleaning, indicator
+    suffix."""
+    from veneur_tpu.sinks.xray import segment
+
+    def seg_for(status=None, error=False, tags=None, **kw):
+        t = dict(tags or {})
+        if status is not None:
+            t["http.status_code"] = str(status)
+        return segment(mkspan(tags=t, error=error, **kw), set())
+
+    s = seg_for(503, tags={"http.method": "GET",
+                           "http.url": "https://api/x",
+                           "xray_client_ip": "10.1.2.3"})
+    assert s["fault"] and not s["error"] and not s["throttle"]
+    assert s["http"]["request"] == {"url": "https://api/x",
+                                   "method": "GET",
+                                   "client_ip": "10.1.2.3"}
+    assert s["http"]["response"] == {"status": 503}
+    # the client-ip tag lives only in the http block, not metadata
+    assert "xray_client_ip" not in s["metadata"]
+
+    s = seg_for(429)
+    assert s["throttle"] and s["error"] and not s["fault"]
+    s = seg_for(404)
+    assert s["error"] and not s["fault"] and not s["throttle"]
+    s = seg_for(200)
+    assert not s["error"] and not s["fault"] and not s["throttle"]
+    # a span-level error with no status classifies as a fault and keeps
+    # the reference's error flag (`xray.go:254`)
+    s = seg_for(error=True)
+    assert s["fault"] and s["error"]
+    # default url is service:name; malformed statuses are dropped
+    s = seg_for(tags={"http.status_code": "banana"})
+    assert "response" not in s["http"]
+    assert s["http"]["request"]["url"].endswith(":op")
+
+    # name cleaning + indicator suffix (`xray.go:233-241`)
+    sp = mkspan(tags={})
+    sp.service = "svc|with{bad}chars"
+    sp.indicator = True
+    s2 = segment(sp, set())
+    assert s2["name"] == "svc_with_bad_chars-indicator"
+    assert s2["annotations"]["indicator"] == "true"
 
 
 # ---------------------------------------------------------------- falconer

@@ -48,7 +48,14 @@ class Translator:
         self.added = added or {}
         self.ignored_metrics = (re.compile(ignored_metrics)
                                 if ignored_metrics else None)
-        self._cache: dict[tuple, float] = {}
+        # Double-map cumulative->delta cache (cache.go:9-55): `_last` is
+        # the previous scrape sweep, `_next` accumulates the current one,
+        # swapped by _cycle_done().  Distinguishes "the cache is new"
+        # (global first sweep: no basis, delta 0) from "the metric is
+        # new" (count its full value, stats.go:85-88) and keeps memory
+        # bounded as series come and go.
+        self._last: Optional[dict[tuple, float]] = None
+        self._next: dict[tuple, float] = {}
         self.decode_errors = 0
         self.unknown_types = 0
 
@@ -70,16 +77,17 @@ class Translator:
     def _count_delta(self, name: str, tags: list[str],
                      value: float) -> Optional[float]:
         key = (name, tuple(sorted(tags)))
-        prev = self._cache.get(key)
-        self._cache[key] = value
+        self._next[key] = value
+        if self._last is None:
+            return 0.0              # global first sweep: no basis
+                                    # (stats.go:78-83 emits 0)
+        prev = self._last.get(key)
         if prev is None:
-            return None             # first observation: no delta yet
-        delta = value - prev
-        if delta < 0:
+            return value            # new series mid-stream: count it all
+        if prev > value:
             return value            # counter reset: emit the new total
-        if delta == 0:
-            return None
-        return delta
+        return value - prev         # normal diff (0 emitted, like the
+                                    # reference)
 
     def translate(self, text: str) -> list[tuple[str, float, str, list]]:
         """Exposition text -> [(name, value, statsd type, tags)]."""
@@ -144,6 +152,11 @@ class Translator:
                         out.append((mname, d, "c", tags))
             else:
                 self.unknown_types += 1
+        # one observation sweep done: swap the double-map cache so next
+        # sweep can tell a brand-new series from a returning one
+        # (cache.go Done, :40-55)
+        self._last = self._next
+        self._next = {}
         return out
 
 

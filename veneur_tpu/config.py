@@ -90,6 +90,12 @@ class Config:
     aggregates: list[str] = field(default_factory=lambda: ["min", "max", "count"])
     tdigest_compression: float = 100.0
     set_precision: int = 14
+    # evaluate t-digest flush quantiles in float64 (the reference's
+    # merging_digest.go float64 semantics): keeps integer exactness for
+    # values past 2^24 (epoch stamps, byte counters) at the cost of
+    # emulated-f64 device math (no Pallas fast path, slower flush).
+    # Single-device tiers only; sets jax_enable_x64 process-wide.
+    digest_float64: bool = False
     # initial arena rows (metric keys) per sampler family; arenas grow by
     # doubling, but each growth copies device tensors — size for the
     # expected live cardinality up front on big deployments (0 = default)
@@ -99,6 +105,12 @@ class Config:
     # 0 = follow arena_initial_capacity up to 8192 rows (128 MiB/lane);
     # sets grow on demand past the pre-size either way
     set_arena_initial_capacity: int = 0
+    # rolling-upgrade migration lane for sets: merge legacy 'VH'
+    # (blake2b-hashed) HLL imports into a side lane and emit
+    # max(primary, legacy) instead of hash-mixing the registers (which
+    # inflates union estimates up to ~2x); enable on global tiers while
+    # any forwarding host still runs a pre-metro build
+    hll_legacy_migration: bool = False
     count_unique_timeseries: bool = False
     # device mesh for the sharded serving flush (veneur_tpu/parallel/):
     # 0 devices = single-device lanes; replicas 0 = auto (2 when even)
@@ -144,6 +156,19 @@ class Config:
     flush_on_shutdown: bool = False
     flush_watchdog_missed_flushes: int = 0
     synchronize_with_interval: bool = False
+    # XLA compile-churn hardening: every new (keys, depth) pow2 bucket
+    # compiles a fresh flush program (tens of seconds at high
+    # cardinality).  The persistent cache makes recompiles across
+    # restarts near-free ("" disables); prewarm compiles the configured
+    # depth buckets for every pow2 key count up to the arena pre-size in
+    # a background thread at boot, so a cardinality ramp never pays a
+    # compile inside a flush interval.  Compile events surface as
+    # flush.compile_events_total / flush.compile_seconds self-metrics,
+    # and the flush watchdog is compile-aware (a first-bucket compile is
+    # not a hang).
+    compilation_cache_dir: str = "~/.cache/veneur-tpu-xla"
+    prewarm_flush_shapes: bool = False
+    prewarm_depths: list[int] = field(default_factory=lambda: [4, 32])
     debug: bool = False
     enable_profiling: bool = False
     http_quit: bool = False

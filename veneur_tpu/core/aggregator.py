@@ -51,6 +51,11 @@ class FlushResult:
     unique_ts: Optional[int] = None
 
 
+# Ceiling for the logical [rows, depth, ccap] intermediate of one
+# digest_export chunk (elements); see _emit_digests' forwarding branch.
+_EXPORT_ELEM_BUDGET = 1 << 26
+
+
 class MetricAggregator:
     def __init__(self,
                  percentiles: Optional[list[float]] = None,
@@ -60,11 +65,22 @@ class MetricAggregator:
                  count_unique_timeseries: bool = False,
                  mesh=None, ingest_lanes: Optional[int] = None,
                  is_local: bool = True, initial_capacity: int = 0,
-                 set_initial_capacity: int = 0):
+                 set_initial_capacity: int = 0,
+                 hll_legacy_migration: bool = False,
+                 digest_float64: bool = False):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
         self.mesh = mesh
+        if mesh is not None and is_local and jax.process_count() > 1:
+            # fail at startup, not at the first flush tick: the
+            # multi-process mesh serves the GLOBAL tier only (a local/
+            # forwarding tier is a single-process server; the gRPC
+            # forward/import edge is the cross-host transport)
+            raise ValueError(
+                "multi-process meshed serving supports the global tier "
+                "only: configure is_local=False (forwarding tiers run "
+                "single-process; see parallel/multihost.py)")
         # pre-size for expected cardinality (arena growth copies device
         # tensors); rounded up to a power of two.  SetArena's per-row cost
         # is R_s * 2^precision register BYTES (16 KiB/lane at p=14, vs
@@ -84,10 +100,24 @@ class MetricAggregator:
         if set_initial_capacity > arena_mod._INITIAL_CAPACITY:
             set_kw = {"capacity":
                       1 << (set_initial_capacity - 1).bit_length()}
+        if digest_float64:
+            # f64 digest evaluation (merging_digest.go:23-40 float64
+            # semantics): values past 2^24 keep integer exactness.
+            # Device f64 is emulated (slower) and the meshed program is
+            # f32-native, so the option is single-device only; x64 must
+            # be on before any jit traces.
+            if mesh is not None:
+                raise ValueError(
+                    "digest_float64 is unsupported with a device mesh; "
+                    "run f64 evaluation on an unmeshed tier")
+            jax.config.update("jax_enable_x64", True)
+        self.digest_float64 = digest_float64
         self.digests = arena_mod.DigestArena(
             compression=compression, mesh=mesh, n_lanes=ingest_lanes,
+            eval_dtype=np.float64 if digest_float64 else np.float32,
             **kw)
         self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
+                                       legacy_migration=hll_legacy_migration,
                                        **set_kw)
         self.counters = arena_mod.CounterArena(mesh=mesh, **kw)
         self.gauges = arena_mod.GaugeArena(**kw)
@@ -103,6 +133,16 @@ class MetricAggregator:
         # full-family program (all_gather over sample depth, set pmax,
         # counter psum, unique-ts union).
         self.flush_fn = serving.make_serving_flush(mesh)
+        # compile-churn observability: every new (keys, depth) pow2
+        # bucket traces+compiles a fresh program; the server reports the
+        # counters as self-metrics and the flush watchdog treats an
+        # in-progress first-bucket compile as progress, not a hang
+        self._compiled_shapes: set = set()
+        self._compile_lock = threading.Lock()
+        self._compiles_active = 0
+        self.compile_events = 0
+        self.compile_seconds_total = 0.0
+        self.compile_in_progress = threading.Event()
         self._uts_m = self.unique_ts.m if self.unique_ts is not None \
             else 1 << hll_mod.DEFAULT_PRECISION
         self._pct_arr = jnp.asarray([0.5] + list(self.percentiles),
@@ -236,7 +276,14 @@ class MetricAggregator:
         # worker.go:402-459 as one program).  Mesh-less, sets/counters/
         # unique-ts resolve on host and the program only runs when digest
         # rows were touched; an idle interval skips the dispatch entirely.
-        idle = (len(snap["digests"]["rows"]) == 0
+        # Multi-controller meshes may NEVER take the idle skip: the
+        # lockstep agreement gather inside _run_flush is a collective, and
+        # a controller that skipped it while a peer entered it would hang
+        # that peer for an interval and pair every later flush off by one
+        # — the gather itself decides (all-idle => zero-shape program).
+        multi_mesh = self.mesh is not None and jax.process_count() > 1
+        idle = (not multi_mesh
+                and len(snap["digests"]["rows"]) == 0
                 and len(snap["sets"]["rows"]) == 0
                 and len(snap["counters"]["rows"]) == 0
                 and (not snap["have_uts"]
@@ -263,6 +310,75 @@ class MetricAggregator:
         a[:len(rows)] = rows
         return a
 
+    class _CompileGuard:
+        """Marks a flush-program invocation that will trace+compile a
+        new (keys, depth) bucket, so the watchdog and self-metrics can
+        tell a compile from a hang.  compile_in_progress is
+        counter-backed under a lock: concurrent guards (prewarm thread +
+        flush thread) never clear each other's flag, and a shape only
+        registers as compiled when its guard exits WITHOUT an exception
+        — a failed first compile retries with full watchdog cover."""
+
+        def __init__(self, agg: "MetricAggregator", shape) -> None:
+            self.agg, self.shape = agg, shape
+            with agg._compile_lock:
+                self.new = shape not in agg._compiled_shapes
+
+        def __enter__(self):
+            if self.new:
+                with self.agg._compile_lock:
+                    self.agg._compiles_active += 1
+                    self.agg.compile_in_progress.set()
+                self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, exc_type, *exc):
+            if self.new:
+                with self.agg._compile_lock:
+                    self.agg.compile_events += 1
+                    self.agg.compile_seconds_total += (
+                        time.perf_counter() - self._t0)
+                    if exc_type is None:
+                        self.agg._compiled_shapes.add(self.shape)
+                    self.agg._compiles_active -= 1
+                    if self.agg._compiles_active == 0:
+                        self.agg.compile_in_progress.clear()
+            return False
+
+    def prewarm(self, depths, max_keys: int, min_keys: int = 128,
+                stop: Optional[threading.Event] = None) -> int:
+        """Compile the flush program for every pow2 key bucket in
+        [min_keys, max_keys] at the given staged depths, so a cardinality
+        ramp in production never pays a first-bucket XLA compile inside a
+        flush interval (the compiles land in the persistent cache, making
+        later boots near-free).  Meant for a background thread at boot;
+        `stop` aborts between buckets.  Returns buckets compiled.
+        Mesh-less only: meshed program shapes include per-family state
+        and are pre-sized by configuration instead."""
+        if self.mesh is not None:
+            return 0
+        n = 0
+        u = 1 << (max(min_keys, 2) - 1).bit_length()
+        max_keys = arena_mod._pow2(max_keys)   # arena rounds up too
+        buckets = []
+        while u <= max_keys:
+            for dpt in depths:
+                buckets.append((u, max(2, arena_mod._pow2(dpt))))
+            u *= 2
+        dt = self.digests.eval_dtype
+        for u_pad, d_pad in buckets:
+            if stop is not None and stop.is_set():
+                break
+            # AOT lower+compile from shape structs: populates the jit and
+            # persistent caches without allocating or executing anything
+            # on the device the live flushes are using
+            dv = jax.ShapeDtypeStruct((u_pad, d_pad), dt)
+            mm = jax.ShapeDtypeStruct((2, u_pad), dt)
+            with self._CompileGuard(self, (u_pad, d_pad)):
+                self.flush_fn.lower(dv, dv, mm, self._pct_arr).compile()
+            n += 1
+        return n
+
     def _run_flush(self, snap: dict, is_local: bool) -> dict:
         """Run the per-flush device program on the snapshot and read the
         results back as host numpy (outside the lock).
@@ -284,8 +400,9 @@ class MetricAggregator:
                 dpart["staged"], dpart["rows"],
                 dpart["d_min"], dpart["d_max"])
             dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
-            ev = serving.fetch(self.flush_fn(dvd, dwd, mmd,
-                                             self._pct_arr))
+            with self._CompileGuard(self, dv.shape):
+                out = self.flush_fn(dvd, dwd, mmd, self._pct_arr)
+            ev = serving.fetch(out)
             host["dense_dev"] = (dvd, dwd)
         else:
             multi = jax.process_count() > 1
@@ -323,7 +440,9 @@ class MetricAggregator:
                 hll_regs=snap["sets"]["lanes"],
                 counter_planes=snap["counter_planes"](),
                 uts_regs=snap["uts_regs"])
-            out = self.flush_fn(inputs, self._pct_arr)
+            shapes = tuple(x.shape for x in inputs)
+            with self._CompileGuard(self, shapes):
+                out = self.flush_fn(inputs, self._pct_arr)
             host["dense_dev"] = (dvd, dwd)
             # ONE batched readback for everything the emitters need
             set_regs_dev = None
@@ -427,6 +546,9 @@ class MetricAggregator:
         snap["sets"] = {
             "rows": srows,
             "meta": [s.meta[r] for r in srows],
+            # migration side lane (legacy blake2b imports): host-side
+            # estimates to max against the primary lane at emission
+            "legacy_ests": s.legacy_estimates(srows),
         }
         if self.mesh is None:
             # host registers: estimates now, register copies only if rows
@@ -528,6 +650,11 @@ class MetricAggregator:
         if len(rows) == 0:
             return
         ests = host["set_ests"]
+        if part.get("legacy_ests") is not None:
+            # migration lane: hash-incompatible legacy sketches never mix
+            # registers; the emitted estimate is max(primary, legacy)
+            ests = np.maximum(np.asarray(ests, np.float64),
+                              part["legacy_ests"])
         meta = part["meta"]
         n = len(meta)
         bases = [m.key.name for m in meta]
@@ -591,13 +718,30 @@ class MetricAggregator:
             # the forwarded subset
             dvd, dwd = host["dense_dev"]
             fidx = np.nonzero(forwarded)[0]
-            fpad = self._padded_rows(fidx)
             compression = self.digests.compression
-            mexp, wexp = serving.digest_export(
-                dvd, dwd, jnp.asarray(fpad), compression,
-                self.digests.ccap)
-            sel_mean = serving.fetch(mexp)[:len(fidx)]
-            sel_weight = serving.fetch(wexp)[:len(fidx)]
+            ccap = self.digests.ccap
+            depth = int(dvd.shape[1])
+            # Chunk the export so the fused [rows, depth, ccap]
+            # comparison-sum inside td.compress stays under an element
+            # budget whether or not XLA fuses it (a 100k-key forwarding
+            # tier with 512-deep staging would otherwise imply a
+            # multi-GB logical intermediate).  Full chunks share one
+            # compiled shape; only the final partial chunk pads down.
+            max_rows = _EXPORT_ELEM_BUDGET // max(1, depth * ccap)
+            max_rows = 1 << max(3, max_rows.bit_length() - 1)
+            m_parts, w_parts = [], []
+            for off in range(0, len(fidx), max_rows):
+                chunk = fidx[off:off + max_rows]
+                fpad = self._padded_rows(chunk)
+                mexp, wexp = serving.digest_export(
+                    dvd, dwd, jnp.asarray(fpad), compression, ccap)
+                fetched_m, fetched_w = serving.fetch((mexp, wexp))
+                m_parts.append(fetched_m[:len(chunk)])
+                w_parts.append(fetched_w[:len(chunk)])
+            sel_mean = (m_parts[0] if len(m_parts) == 1
+                        else np.concatenate(m_parts))
+            sel_weight = (w_parts[0] if len(w_parts) == 1
+                          else np.concatenate(w_parts))
             fwd = res.forward
             for j, i in enumerate(fidx.tolist()):
                 m = meta[i]

@@ -294,11 +294,22 @@ class SetArena(_ArenaBase):
     """
 
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
-                 precision: int = hll_mod.DEFAULT_PRECISION, mesh=None):
+                 precision: int = hll_mod.DEFAULT_PRECISION, mesh=None,
+                 legacy_migration: bool = False):
         super().__init__(capacity)
         self.precision = precision
         self.m = 1 << precision
         self.n_lanes = self._init_mesh_lanes(mesh, "set")
+        # Rolling-upgrade migration lane (hll_legacy_migration): legacy
+        # 'VH' imports carry blake2b-hashed members which do NOT union
+        # meaningfully with metro-hashed registers (the same member lands
+        # on different registers, inflating the union up to ~2x).  When
+        # enabled, legacy sketches merge into a host-side side lane and
+        # the flush estimate is max(primary, legacy) per row — exact for
+        # the common upgrade case (both fleet halves see the same member
+        # population), a lower bound otherwise, and never hash-mixing.
+        self.legacy_migration = legacy_migration
+        self._legacy_regs: dict[int, np.ndarray] = {}
         if mesh is None:
             self.host_regs = np.zeros((capacity, self.m), np.uint8)
             self.lanes_regs = None
@@ -347,12 +358,36 @@ class SetArena(_ArenaBase):
                 + len(self._merge_rows))
 
     def merge(self, row: int, payload: bytes) -> None:
-        other = hll_mod.unmarshal(payload)
+        other, legacy = hll_mod.unmarshal_ex(payload)
+        if legacy and self.legacy_migration:
+            mine = self._legacy_regs.get(row)
+            if mine is None:
+                self._legacy_regs[row] = other.copy()
+            else:
+                np.maximum(mine, other, out=mine)
+            return
         mine = self._merge_rows.get(row)
         if mine is None:
             self._merge_rows[row] = other.copy()
         else:
             np.maximum(mine, other, out=mine)
+
+    def legacy_estimates(self, rows: np.ndarray) -> "np.ndarray | None":
+        """Per-row LogLog-Beta estimates of the migration side lane (0
+        where a row has no legacy imports), or None when the lane is
+        idle.  Call under the aggregator lock at snapshot time."""
+        if not self._legacy_regs:
+            return None
+        out = np.zeros(len(rows), np.float64)
+        hits = [(i, self._legacy_regs[int(r)])
+                for i, r in enumerate(rows)
+                if int(r) in self._legacy_regs]
+        if hits:
+            ests = hll_mod.estimate_np_rows(
+                np.stack([regs for _, regs in hits]))
+            for (i, _), e in zip(hits, ests):
+                out[i] = e
+        return out
 
     def _staged_triples(self):
         """Consume raw staging into (rows, register index, rank) arrays."""
@@ -436,6 +471,10 @@ class SetArena(_ArenaBase):
 
     def reset_rows(self, rows: np.ndarray) -> None:
         self.sync()
+        if self._legacy_regs:
+            # the migration lane is interval-scoped like the registers
+            for r in rows:
+                self._legacy_regs.pop(int(r), None)
         if self.host_regs is not None:
             if len(rows):
                 self.host_regs[rows] = 0
@@ -475,10 +514,18 @@ class DigestArena(_ArenaBase):
 
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
                  compression: float = td.DEFAULT_COMPRESSION,
-                 mesh=None, n_lanes: Optional[int] = None):
+                 mesh=None, n_lanes: Optional[int] = None,
+                 eval_dtype=np.float32):
         super().__init__(capacity)
         self.compression = compression
         self.ccap = td.centroid_capacity(compression)
+        # float64 evaluation option (digest_float64): staging is ALWAYS
+        # host f64; this controls the dense matrices the flush program
+        # evaluates.  f64 preserves integer exactness past 2^24 (epoch
+        # stamps, byte counters) at the cost of emulated-f64 device math
+        # — the reference computes in float64 throughout
+        # (tdigest/merging_digest.go:23-40).  Requires jax_enable_x64.
+        self.eval_dtype = np.dtype(eval_dtype)
         self.n_replicas = self._init_mesh_lanes(mesh, "digest")
         if mesh is not None:
             from veneur_tpu.parallel.mesh import SHARD_AXIS
@@ -733,11 +780,11 @@ class DigestArena(_ArenaBase):
         depth = max(int(pos.max()) + 1 if len(r) else 1, d_floor)
         d_pad = max(2, self.n_replicas * _pow2(
             -(-depth // self.n_replicas)))
-        dv = np.zeros((u_pad, d_pad), np.float32)
-        dw = np.zeros((u_pad, d_pad), np.float32)
+        dv = np.zeros((u_pad, d_pad), self.eval_dtype)
+        dw = np.zeros((u_pad, d_pad), self.eval_dtype)
         dv[r, pos] = v
         dw[r, pos] = w
-        minmax = np.zeros((2, u_pad), np.float32)
+        minmax = np.zeros((2, u_pad), self.eval_dtype)
         minmax[0, :nd] = d_min_t
         minmax[1, :nd] = d_max_t
         return dv, dw, minmax

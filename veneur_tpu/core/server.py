@@ -162,6 +162,18 @@ class Server:
         # cluster FIRST so the mesh spans every host's chips (DCN story:
         # parallel/multihost.py).
         self.mesh = None
+        if cfg.compilation_cache_dir:
+            # persistent XLA compile cache: recompiles of known flush
+            # buckets across process restarts become disk hits instead
+            # of multi-second (or, at 1M keys, minute-scale) compiles
+            import jax as _jax
+            cache_dir = os.path.expanduser(cfg.compilation_cache_dir)
+            try:
+                _jax.config.update("jax_compilation_cache_dir", cache_dir)
+                _jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.5)
+            except Exception as e:
+                logger.warning("compilation cache unavailable: %s", e)
         from veneur_tpu.parallel import multihost
         multihost.maybe_init_from_config(cfg)  # no-op without coordinator
         if cfg.mesh_devices > 0:
@@ -178,7 +190,9 @@ class Server:
             ingest_lanes=cfg.ingest_lanes or None,
             is_local=cfg.is_local,
             initial_capacity=cfg.arena_initial_capacity,
-            set_initial_capacity=cfg.set_arena_initial_capacity)
+            set_initial_capacity=cfg.set_arena_initial_capacity,
+            hll_legacy_migration=cfg.hll_legacy_migration,
+            digest_float64=cfg.digest_float64)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -238,6 +252,7 @@ class Server:
         # alive through the drain grace so the queued tail is consumed
         self._readers_stop = threading.Event()
         self._legacy_hll_reported = 0
+        self._compiles_reported = (0, 0.0)
         self._threads: list[threading.Thread] = []
         self._shutdown = threading.Event()
         self._flush_pool = concurrent.futures.ThreadPoolExecutor(
@@ -398,6 +413,20 @@ class Server:
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")
+            t.start()
+            self._threads.append(t)
+        if self.config.prewarm_flush_shapes:
+            # boot-time background compile of the configured flush
+            # buckets (compile-churn hardening; persists via the
+            # compilation cache, so later boots replay from disk)
+            cap = self.config.arena_initial_capacity or 8192
+            # prewarm rounds up to the arena's pow2 capacity internally,
+            # so the top bucket a ramp can reach is always covered
+            t = threading.Thread(
+                target=lambda: self.aggregator.prewarm(
+                    list(self.config.prewarm_depths), cap,
+                    stop=self._shutdown),
+                daemon=True, name="flush-prewarm")
             t.start()
             self._threads.append(t)
         # self-metrics statsd client + runtime diagnostics loop
@@ -981,6 +1010,16 @@ class Server:
             statsd.count("listen.legacy_hll_total",
                          vh_total - self._legacy_hll_reported)
             self._legacy_hll_reported = vh_total
+        # compile-churn observability: first-bucket XLA compiles this
+        # interval (flush-path or prewarm) and their wall seconds
+        ce, cs = (self.aggregator.compile_events,
+                  self.aggregator.compile_seconds_total)
+        if ce > self._compiles_reported[0]:
+            statsd.count("flush.compile_events_total",
+                         ce - self._compiles_reported[0])
+            statsd.timing("flush.compile_seconds",
+                          cs - self._compiles_reported[1])
+            self._compiles_reported = (ce, cs)
         statsd.count("spans.received_total", self.ssf_received)
         self.ssf_received = 0
         # per-span-sink ingest accounting (worker.go:603-678)
@@ -1170,6 +1209,15 @@ class Server:
                 return
             overdue = time.time() - self.last_flush_unix
             if overdue > missed * interval:
+                if self.aggregator.compile_in_progress.is_set():
+                    # a first-bucket XLA compile is progress, not a hang
+                    # (VERDICT r3: a compile stall must not look like
+                    # one) — the guard clears the flag when the trace
+                    # returns, after which the deadline applies again
+                    logger.warning(
+                        "flush watchdog: flush %.1fs overdue but an XLA "
+                        "compile is in progress; holding fire", overdue)
+                    continue
                 logger.critical(
                     "flush watchdog: no flush for %.1fs (> %d intervals); "
                     "terminating", overdue, missed)

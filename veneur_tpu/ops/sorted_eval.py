@@ -1,27 +1,39 @@
 """Pallas TPU kernel: the fused flush evaluation (bitonic sort + quantiles).
 
 Drop-in for `veneur_tpu.sketches.tdigest.weighted_eval` — THE serving
-flush's compute core.  One kernel invocation per row tile does everything
-the flush needs while the tile stays VMEM-resident:
+flush's compute core.  One kernel invocation per tile does everything the
+flush needs while the tile stays VMEM-resident:
 
   * in-register bitonic sort of the (value, weight) pairs along the depth
     axis (compare-exchange stages built from `pltpu.roll` + selects;
     pair-consistent strict comparisons keep tied values' weights with
     their owners);
-  * cumulative weights as a triangular ones matmul on the MXU (the
-    guaranteed-lowering form of `cumsum`);
+  * cumulative weights as a triangular ones matmul on the MXU for MXU-
+    sized depths, or a log-step shift-add (Hillis-Steele) for shallow
+    ones;
   * per-quantile rank search as compare+reduce, and the neighbor value
     gathers as one-hot reductions (Mosaic has no cheap dynamic lane
     gather);
   * midpoint interpolation, single-point/empty-row handling, min/max
-    clamping — numerically identical to the XLA twin (parity-tested in
+    clamping — numerically matching the XLA twin (parity-tested in
     interpret mode and natively).
 
-HBM traffic is exactly one read of the `[K, D]` inputs and one `[K, P+2]`
-write; everything else lives in VMEM.  XLA's stock `lax.sort` lowers to a
-far slower generic network with full HBM round-trips per stage — this
-kernel is why the flush beats the 32-core native baseline by a wide
-margin instead of a narrow one.
+Layout (v2): tiles are TRANSPOSED — depth D on the sublane axis, keys on
+the 128-wide lane axis.  The v1 layout put D on lanes, so the network's
+rolls and selects ran at D/128 lane occupancy for shallow depths (a
+production flush with D=4 staged points used 3% of the VPU); transposed,
+every stage runs on full 128-lane vectors regardless of depth, and the
+sort's rolls become sublane rotations (static vreg permutes for the
+stride >= 8 stages).  The [K, D] operands are transposed once on device
+(one HBM pass XLA fuses with the upload) and the [P+2, K] result is
+transposed back — both negligible next to the sort.
+
+HBM traffic is exactly one read of the `[K, D]` inputs and one
+`[K, P+2]` write; everything else lives in VMEM.  XLA's stock `lax.sort`
+lowers to a far slower generic network with full HBM round-trips per
+stage — this kernel is why the flush beats the 32-core native baseline
+by a wide margin instead of a narrow one (cited path: `worker.go:402-459`
++ `flusher.go:26-122`).
 """
 
 from __future__ import annotations
@@ -33,27 +45,35 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from veneur_tpu.ops import mxu
-
-ROW_TILE = 256
 # padding sort key: +inf never collides with real values (the parser
 # rejects non-finite samples; m_clean masks padding before any product,
 # so no inf*0 NaN can arise).  A plain python float — jnp scalars would
 # be captured constants, which pallas_call rejects.
 _PAD_KEY = float("inf")
 
+MAX_DEPTH = 1024
+
+
+def _lane_tile(u: int, d: int) -> int:
+    """Lane-axis tile width: full-VPU 128 multiples, sized so the VMEM
+    working set (~8 live [D, T] f32 arrays) stays well under the 16 MiB
+    budget at every depth."""
+    cap = 512 if d <= 256 else 256
+    return min(cap, u)
+
 
 def _cmp_exchange(key, w, j, k, idx):
-    """One bitonic compare-exchange stage: partner = lane ^ j, direction
-    by bit k.  Strict per-side comparisons make tie handling consistent
-    for both partners, so (key, weight) pairs never split."""
-    d = key.shape[1]
+    """One bitonic compare-exchange stage over the sublane (depth) axis:
+    partner = row ^ j, direction by bit k.  Strict per-side comparisons
+    make tie handling consistent for both partners, so (key, weight)
+    pairs never split."""
+    d = key.shape[0]
     lower = (idx & j) == 0
     # pltpu.roll requires non-negative shifts: roll by d-j == roll by -j
-    pk = jnp.where(lower, pltpu.roll(key, d - j, axis=1),
-                   pltpu.roll(key, j, axis=1))
-    pw = jnp.where(lower, pltpu.roll(w, d - j, axis=1),
-                   pltpu.roll(w, j, axis=1))
+    pk = jnp.where(lower, pltpu.roll(key, d - j, axis=0),
+                   pltpu.roll(key, j, axis=0))
+    pw = jnp.where(lower, pltpu.roll(w, d - j, axis=0),
+                   pltpu.roll(w, j, axis=0))
     up = (idx & k) == 0
     want_small = lower == up
     # logical form, not a bool-valued where: Mosaic cannot truncate the
@@ -62,15 +82,38 @@ def _cmp_exchange(key, w, j, k, idx):
     return jnp.where(take, pk, key), jnp.where(take, pw, w)
 
 
+def _cumsum_depth(w):
+    """Inclusive prefix sum along the sublane (depth) axis.  MXU-sized
+    depths use the guaranteed-lowering triangular ones matmul (HIGHEST
+    precision keeps integer weights exact below 2^24, preserving the
+    monotonicity rank searches depend on); shallow and extreme depths
+    use log-step shift-adds, which are exact for the same reason."""
+    d = w.shape[0]
+    if 128 <= d <= 512:
+        ks = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
+        js = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
+        tri = jnp.clip(ks - js + 1, 0, 1).astype(jnp.float32)  # j <= i
+        return jnp.dot(tri, w, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+    idx = jax.lax.broadcasted_iota(jnp.int32, w.shape, 0)
+    cum = w
+    s = 1
+    while s < d:
+        shifted = pltpu.roll(cum, s, axis=0)
+        cum = cum + jnp.where(idx >= s, shifted, 0.0)
+        s *= 2
+    return cum
+
+
 def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
-    m = mean_ref[...]             # [T, D]
-    w = weight_ref[...]           # [T, D]
-    mm = minmax_ref[...]          # [T, 2] (min; max)
+    m = mean_ref[...]             # [D, T]
+    w = weight_ref[...]           # [D, T]
+    mm = minmax_ref[...]          # [2, T] (min; max)
     qs = qs_ref[...]              # [1, P]
-    t, d = m.shape
+    d, t = m.shape
     n_pct = qs.shape[1]
 
-    idx = jax.lax.broadcasted_iota(jnp.int32, (t, d), 1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
     key = jnp.where(w > 0, m, _PAD_KEY)
     k = 2
     while k <= d:                 # static: fully unrolled network
@@ -82,29 +125,28 @@ def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
     occ = w > 0
     m_clean = jnp.where(occ, key, 0.0)
 
-    cum = mxu.tri_cumsum(w)                                     # [T, D]
-    total = cum[:, d - 1:d]                                     # [T, 1]
-    sums = jnp.sum(m_clean * w, axis=1, keepdims=True)          # [T, 1]
-    n_real = jnp.sum(occ.astype(jnp.int32), axis=1,
-                     keepdims=True)                             # [T, 1]
+    cum = _cumsum_depth(w)                                      # [D, T]
+    total = cum[d - 1:d, :]                                     # [1, T]
+    sums = jnp.sum(m_clean * w, axis=0, keepdims=True)          # [1, T]
+    n_real = jnp.sum(occ.astype(jnp.int32), axis=0,
+                     keepdims=True)                             # [1, T]
     cmid = cum - 0.5 * w
     hi_bound = jnp.maximum(n_real - 1, 1)
-    first_mean = jnp.sum(
-        jnp.where(idx == 0, m_clean, 0.0), axis=1, keepdims=True)
-    dmin, dmax = mm[:, 0:1], mm[:, 1:2]
+    first_mean = m_clean[0:1, :]            # sorted: row 0 is the min
+    dmin, dmax = mm[0:1, :], mm[1:2, :]
 
-    cols = []
+    rows = []
     for p in range(n_pct):        # static: unrolled per quantile
-        tq = qs[0, p] * total                                   # [T, 1]
-        rank = jnp.sum((cmid < tq).astype(jnp.int32), axis=1,
+        tq = qs[0, p] * total                                   # [1, T]
+        rank = jnp.sum((cmid < tq).astype(jnp.int32), axis=0,
                        keepdims=True)
         ii = jnp.clip(rank, 1, hi_bound)
         oh_hi = (idx == ii).astype(jnp.float32)
         oh_lo = (idx == ii - 1).astype(jnp.float32)
-        m_hi = jnp.sum(oh_hi * m_clean, axis=1, keepdims=True)
-        m_lo = jnp.sum(oh_lo * m_clean, axis=1, keepdims=True)
-        c_hi = jnp.sum(oh_hi * cmid, axis=1, keepdims=True)
-        c_lo = jnp.sum(oh_lo * cmid, axis=1, keepdims=True)
+        m_hi = jnp.sum(oh_hi * m_clean, axis=0, keepdims=True)
+        m_lo = jnp.sum(oh_lo * m_clean, axis=0, keepdims=True)
+        c_hi = jnp.sum(oh_hi * cmid, axis=0, keepdims=True)
+        c_lo = jnp.sum(oh_lo * cmid, axis=0, keepdims=True)
         tt = jnp.where(c_hi > c_lo,
                        (tq - c_lo) / jnp.maximum(c_hi - c_lo, 1e-30),
                        0.0)
@@ -112,8 +154,8 @@ def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
         q = jnp.where(n_real <= 1, first_mean, q)
         q = jnp.clip(q, dmin, dmax)
         q = jnp.where(total > 0, q, 0.0)
-        cols.append(q)
-    out_ref[...] = jnp.concatenate(cols + [total, sums], axis=1)
+        rows.append(q)
+    out_ref[...] = jnp.concatenate(rows + [total, sums], axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -122,34 +164,38 @@ def weighted_eval(mean: jax.Array, weight: jax.Array,
                   percentiles: jax.Array,
                   interpret: bool = False) -> jax.Array:
     """Pallas twin of `td.weighted_eval`: `[K, D]` weighted points ->
-    `[K, P+2]` (quantiles, total weight, weighted sum).  K must be a
-    multiple of 8 and D a power of two (the dense builder guarantees
-    both)."""
+    `[K, P+2]` (quantiles, total weight, weighted sum).  Shapes must
+    satisfy `usable()`; the dense builder's pow2 padding guarantees it
+    for every at-scale flush."""
     u, d = mean.shape
     n_pct = percentiles.shape[0]
-    tile = min(ROW_TILE, u)
-    minmax = jnp.stack([d_min, d_max], axis=1)                  # [U, 2]
+    tile = _lane_tile(u, d)
+    mt = mean.astype(jnp.float32).T                             # [D, U]
+    wt = weight.astype(jnp.float32).T
+    minmax = jnp.stack([d_min, d_max], axis=0).astype(jnp.float32)
     qs = percentiles.reshape(1, n_pct).astype(jnp.float32)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _kernel,
         grid=(u // tile,),
         in_specs=[
-            pl.BlockSpec((tile, d), lambda i: (i, 0)),
-            pl.BlockSpec((tile, d), lambda i: (i, 0)),
-            pl.BlockSpec((tile, 2), lambda i: (i, 0)),
+            pl.BlockSpec((d, tile), lambda i: (0, i)),
+            pl.BlockSpec((d, tile), lambda i: (0, i)),
+            pl.BlockSpec((2, tile), lambda i: (0, i)),
             pl.BlockSpec((1, n_pct), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((tile, n_pct + 2), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((u, n_pct + 2), jnp.float32),
+        out_specs=pl.BlockSpec((n_pct + 2, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n_pct + 2, u), jnp.float32),
         interpret=interpret,
-    )(mean.astype(jnp.float32), weight.astype(jnp.float32), minmax, qs)
+    )(mt, wt, minmax, qs)
+    return out.T                                                # [U, P+2]
 
 
 def usable(u: int, d: int, backend: str) -> bool:
     """Static predicate: can the Pallas path evaluate this dense shape?
-    Rows must tile the grid exactly: u <= ROW_TILE runs as one tile (so
-    any sublane multiple works), larger row counts must be ROW_TILE
-    multiples or trailing rows would never be written."""
-    rows_ok = (u % 8 == 0 if u <= ROW_TILE else u % ROW_TILE == 0)
-    return (backend == "tpu" and d >= 2 and (d & (d - 1)) == 0
-            and d <= 1024 and u >= 8 and rows_ok)
+    Depth must be a power of two (bitonic network) up to MAX_DEPTH; the
+    key count must fill whole 128-lane tiles (`_lane_tile`) — smaller
+    flushes take the XLA twin, where sub-millisecond either way."""
+    t = _lane_tile(u, d)
+    return (backend == "tpu" and 2 <= d <= MAX_DEPTH
+            and (d & (d - 1)) == 0
+            and u >= 128 and u % t == 0 and t % 128 == 0)

@@ -149,6 +149,7 @@ def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
     from veneur_tpu.ops import sorted_eval as se
     u, d = dv.shape
     if (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
+            and dv.dtype == jnp.float32   # f64 option -> XLA twin
             and se.usable(u, d, jax.default_backend())):
         return se.weighted_eval(dv, dw, d_min, d_max, percentiles)
     return td.weighted_eval(dv, dw, d_min, d_max, percentiles)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import socket
 import zlib
 from typing import Optional
@@ -22,36 +23,95 @@ from veneur_tpu import sinks as sink_mod
 logger = logging.getLogger("veneur_tpu.sinks.xray")
 
 HEADER = b'{"format": "json", "version": 1}\n'
-# keys whose tags become annotations only when listed (xray.go annotation
-# allow-list behavior); everything else lands in metadata.
+
+# span tag names the reference promotes into the segment's http block
+# (`sinks/xray/xray.go:28-31`)
+TAG_CLIENT_IP = "xray_client_ip"
+TAG_HTTP_URL = "http.url"
+TAG_HTTP_STATUS = "http.status_code"
+TAG_HTTP_METHOD = "http.method"
+
+# characters allowed in segment names per the X-Ray segment-document spec;
+# everything else collapses to "_" (`xray.go:136`)
+_NAME_CLEAN = re.compile(r"[^a-zA-Z0-9_\.\:\/\%\&#=+\-\@\s\\]+")
 
 
 def xray_trace_id(span) -> str:
-    epoch = span.start_timestamp // 1_000_000_000
+    """X-Ray `1-<8 hex epoch s>-<24 hex>` id (`xray.go:290-308`): the
+    epoch comes from the ROOT span's start so every span of a trace gets
+    the identical id; without root_start_timestamp, bucket this span's
+    start into its ~4.6-minute window (clearing the low byte) as a stable
+    in-the-past stand-in."""
+    epoch = getattr(span, "root_start_timestamp", 0) // 1_000_000_000
+    if epoch == 0:
+        epoch = (span.start_timestamp // 1_000_000_000) & 0xFFFFFFFFFF00
     rand96 = span.trace_id & ((1 << 96) - 1)
     return f"1-{epoch & 0xFFFFFFFF:08x}-{rand96:024x}"
 
 
 def segment(span, annotation_tags: set[str]) -> dict:
+    """SSF span -> X-Ray segment document (`xray.go:180-256`) with the
+    http sub-document and error/fault/throttle classification from the
+    segment-document spec: fault for 5xx (or a span-level error with no
+    contradicting status), error for 4xx, throttle additionally for
+    429."""
     annotations = {}
     metadata = {}
+    http_req = {
+        "url": f"{span.service}:{span.name}",
+        "client_ip": span.tags.get(TAG_CLIENT_IP, ""),
+    }
+    status = 0
     for k, v in span.tags.items():
-        # allow-list only: X-Ray indexes (and caps at 50) annotation keys,
-        # so unlisted tags go to metadata
+        if k == TAG_CLIENT_IP:
+            continue                  # http-block only (`xray.go:205`)
+        if k == TAG_HTTP_URL:
+            http_req["url"] = v
+        elif k == TAG_HTTP_METHOD:
+            http_req["method"] = v
+        elif k == TAG_HTTP_STATUS:
+            try:
+                s = int(v)
+            except ValueError:
+                s = -1
+            if 100 <= s <= 599:
+                status = s
+            else:
+                logger.warning("malformed status code %r", v)
+        metadata[k] = v
+        # allow-list only: X-Ray indexes (and caps at 50) annotation
+        # keys, so unlisted tags go to metadata alone
         if k in annotation_tags:
             annotations[k] = v
-        else:
-            metadata[k] = v
+    indicator = "true" if getattr(span, "indicator", False) else "false"
+    metadata["indicator"] = indicator
+    annotations["indicator"] = indicator
+
+    name = _NAME_CLEAN.sub("_", span.service or span.name)[:190]
+    if getattr(span, "indicator", False):
+        name += "-indicator"
+
+    # segment-document classification: error = client error (4XX),
+    # throttle = 429, fault = server error (5XX); a span flagged error
+    # with no (or a non-4xx) status code counts as a fault
+    is_4xx = 400 <= status <= 499
+    is_5xx = 500 <= status <= 599
     seg = {
         "id": format(span.id & (2**64 - 1), "016x"),
         "trace_id": xray_trace_id(span),
-        "name": (span.service or span.name)[:200],
+        "name": name,
         "start_time": span.start_timestamp / 1e9,
         "end_time": span.end_timestamp / 1e9,
-        "error": bool(span.error),
+        "namespace": "remote",
+        "error": is_4xx or (bool(span.error) and not is_5xx),
+        "fault": is_5xx or (bool(span.error) and not is_4xx),
+        "throttle": status == 429,
         "annotations": annotations,
         "metadata": metadata,
+        "http": {"request": {k: v for k, v in http_req.items() if v}},
     }
+    if status:
+        seg["http"]["response"] = {"status": status}
     if span.parent_id:
         seg["parent_id"] = format(span.parent_id & (2**64 - 1), "016x")
         seg["type"] = "subsegment"
@@ -70,7 +130,9 @@ class XRaySpanSink(sink_mod.BaseSpanSink):
         addr = cfg.get("address", "127.0.0.1:2000")
         self.daemon = netaddr.split_hostport(addr, default_port=2000)
         self.sample_pct = float(cfg.get("sample_percentage", 100))
-        self.annotation_tags = set(cfg.get("annotation_tags", []))
+        # "key:value"-shaped entries configure by key (`xray.go:140-144`)
+        self.annotation_tags = {
+            t.split(":")[0] for t in cfg.get("annotation_tags", [])}
         self._sock: Optional[socket.socket] = None
         self.sampled_out = 0
         self.sent = 0

@@ -271,19 +271,74 @@ _VH_SPARSE = 2
 
 
 def marshal(regs: np.ndarray) -> bytes:
-    """One register row -> axiomhq dense MarshalBinary bytes:
-    [version=1][p][b=0][sparse=0][sz u32 BE][sz nibble-packed bytes]
-    where even register indices occupy the high nibble (vendor
-    registers.go reg.set offset 0).  Ranks are tailcut to 15 with base
-    b=0, exactly the clamp axiomhq itself applies on insert
-    (hyperloglog.go insert: min(r-b, capacity-1))."""
+    """One register row -> axiomhq MarshalBinary bytes, choosing the form
+    by size exactly where the break-even sits: the sparse form (~2-4
+    bytes per occupied register, lossless ranks) for small sets, the
+    dense nibble-packed form (fixed m/2 + 9 bytes, ranks tailcut to 15)
+    otherwise.  A 10-member set forwards as ~50 bytes instead of 8 KiB.
+
+    Dense layout: [version=1][p][b=0][sparse=0][sz u32 BE][sz nibble
+    bytes], even register indices in the high nibble (vendor
+    registers.go reg.set offset 0); ranks tailcut to 15 with base b=0,
+    the clamp axiomhq itself applies on insert (hyperloglog.go insert:
+    min(r-b, capacity-1)).  Sparse layout: empty tmpSet + the sorted
+    delta-varint compressedList of synthesized pp-precision keys
+    (vendor MarshalBinary sparse branch, hyperloglog.go:274-299)."""
     regs = np.asarray(regs, np.uint8)
     m = regs.shape[0]
     p = int(m).bit_length() - 1
+    occ = np.nonzero(regs)[0]
+    # sparse wins while worst-case key bytes (4/key as a raw delta
+    # varint) undercut the fixed dense payload
+    if len(occ) * 4 + 20 < m // 2 + 9:
+        keys = np.sort(_encode_sparse_keys(
+            occ.astype(np.uint32), regs[occ], p))
+        blob = _encode_varint_list(keys)
+        return (struct.pack(">BBBB", _AXIOMHQ_VERSION, p, 0, 1)
+                + struct.pack(">I", 0)                    # empty tmpSet
+                + struct.pack(">II", len(keys), int(keys[-1]) if
+                              len(keys) else 0)
+                + struct.pack(">I", len(blob)) + blob)
     clamped = np.minimum(regs, _TAILCUT_CAP - 1)
     packed = (clamped[0::2] << 4) | clamped[1::2]
     return (struct.pack(">BBBB", _AXIOMHQ_VERSION, p, 0, 0)
             + struct.pack(">I", m // 2) + packed.tobytes())
+
+
+def _encode_sparse_keys(idx: np.ndarray, rank: np.ndarray,
+                        p: int) -> np.ndarray:
+    """Inverse of `_decode_sparse_keys`: synthesize pp-precision sparse
+    keys that decodeHash (vendor sparse.go:24-40) maps back to exactly
+    (idx, rank).  The pp-p sub-index bits below p are not recoverable
+    from dense registers, so flagged keys zero them and unflagged keys
+    carry a single marker bit that reproduces the rank — any real
+    axiomhq reader lands the same (register, rank) pairs."""
+    idx = idx.astype(np.uint32)
+    rank = rank.astype(np.uint32)
+    sub_w = np.uint32(_SPARSE_PP - p)
+    flagged = rank > sub_w
+    k_flag = ((idx << np.uint32(32 - p))
+              | ((rank - np.minimum(rank, sub_w)) << np.uint32(1))
+              | np.uint32(1))
+    sub = np.uint32(1) << (sub_w - np.minimum(rank, sub_w))
+    k_plain = ((idx << sub_w) | sub) << np.uint32(1)
+    return np.where(flagged, k_flag, k_plain).astype(np.uint32)
+
+
+def _encode_varint_list(keys: np.ndarray) -> bytes:
+    """compressedList delta encoding (vendor compressed.go Append):
+    ascending keys -> 7-bit little-endian varints of successive
+    deltas."""
+    out = bytearray()
+    last = 0
+    for k in keys.tolist():
+        x = k - last
+        last = k
+        while x & 0xFFFFFF80:
+            out.append((x & 0x7F) | 0x80)
+            x >>= 7
+        out.append(x & 0x7F)
+    return bytes(out)
 
 
 def _decode_sparse_keys(keys: np.ndarray, p: int
@@ -333,6 +388,15 @@ def _decode_varint_list(buf: bytes, count: int) -> np.ndarray:
         raise ValueError(
             f"truncated HLL sparse list: {k} of {count} keys")
     return out
+
+
+def unmarshal_ex(data: bytes) -> tuple[np.ndarray, bool]:
+    """Like `unmarshal`, additionally reporting whether the payload was
+    the legacy fleet-internal 'VH' encoding (whose members were hashed
+    with blake2b, not metro — see the migration lane in
+    core/arena.py SetArena)."""
+    legacy = data[:2] == _VH_MAGIC
+    return unmarshal(data), legacy
 
 
 def unmarshal(data: bytes) -> np.ndarray:
