@@ -1,0 +1,109 @@
+"""Virtual-device SPMD scaling curve for the sharded flush (CPU backend).
+
+Run standalone (the env MUST be set before Python starts):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/bench_mesh_scaling.py
+
+Fixed GLOBAL problem size (the 100k-arm shape scaled for CPU runtime);
+for each device count n in 1, 2, 4, 8 the keys shard n-ways with a
+2-replica depth split where n allows.  For every n it also times a
+collective-free control: the identical per-device local program with
+axis=None (no all_gather / pmax / psum), isolating what the collectives
+cost.  CPU absolute times are meaningless; the SHAPE of the curve —
+near-flat sharded time as devices grow at fixed global size, bounded
+collective share — is the claim being measured.
+
+Prints one JSON line: {"devices": {n: {"flush_ms": .., "local_ms": ..,
+"collective_ms": ..}}, ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import functools
+
+    import jax.numpy as jnp
+
+    from veneur_tpu.parallel import flush_step as fs
+    from veneur_tpu.parallel import mesh as mesh_mod
+    from veneur_tpu.parallel import serving
+
+    n_dev = len(jax.devices())
+    n_keys, lanes, depth = 2048, 2, 32
+    pcts = jnp.asarray(np.asarray([0.5, 0.9, 0.99]), jnp.float32)
+    inputs_host = fs.example_inputs(n_keys=n_keys, n_lanes=lanes,
+                                    n_sets=64, depth=depth)
+
+    def timed(fn, inputs, iters=8) -> float:
+        np.asarray(fn(inputs, pcts).digest_eval[0, 0])   # compile
+        runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(iters):
+                out = fn(inputs, pcts)
+            float(np.asarray(out.digest_eval[0, 0]))
+            runs.append((time.perf_counter() - t0) / iters * 1e3)
+        return float(np.median(runs))
+
+    results = {}
+    for n in (1, 2, 4, 8):
+        if n > n_dev:
+            break
+        replicas = 2 if n >= 2 else 1
+        mesh = mesh_mod.make_mesh(n, replicas)
+        sharded = fs.make_sharded_flush_step(mesh)
+        put = lambda x, spec: jax.device_put(
+            x, jax.sharding.NamedSharding(mesh, spec))
+        from jax.sharding import PartitionSpec as P
+        lanes_spec = P(mesh_mod.REPLICA_AXIS, mesh_mod.SHARD_AXIS, None)
+        inputs = fs.FlushInputs(
+            dense_v=put(inputs_host.dense_v,
+                        P(mesh_mod.SHARD_AXIS, mesh_mod.REPLICA_AXIS)),
+            dense_w=put(inputs_host.dense_w,
+                        P(mesh_mod.SHARD_AXIS, mesh_mod.REPLICA_AXIS)),
+            minmax=put(inputs_host.minmax, P(None, mesh_mod.SHARD_AXIS)),
+            hll_regs=put(inputs_host.hll_regs, lanes_spec),
+            counter_planes=put(inputs_host.counter_planes, lanes_spec),
+            uts_regs=put(inputs_host.uts_regs,
+                         P(mesh_mod.REPLICA_AXIS, None)))
+        flush_ms = timed(sharded, inputs)
+
+        # collective-free control: the same per-device work on local
+        # shapes (keys/n over shard, depth/replicas slice), no mesh
+        local = fs.example_inputs(
+            n_keys=max(8, n_keys // (n // replicas)),
+            n_lanes=max(1, lanes // replicas), n_sets=64, depth=depth)
+        local_dev = jax.device_put(local, jax.devices()[0])
+        local_ms = timed(fs.flush_step, local_dev)
+        results[n] = {
+            "flush_ms": round(flush_ms, 3),
+            "local_ms": round(local_ms, 3),
+            "collective_ms": round(max(flush_ms - local_ms, 0.0), 3),
+        }
+        print(f"devices={n}: sharded {flush_ms:.2f} ms/flush, "
+              f"per-device local work {local_ms:.2f} ms, "
+              f"collective+orchestration share "
+              f"{max(flush_ms - local_ms, 0):.2f} ms",
+              file=sys.stderr, flush=True)
+
+    print(json.dumps({"global_keys": n_keys, "depth": lanes * depth,
+                      "devices": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -101,8 +101,9 @@ def main():
         np.asarray([[0.5, 0.9, 0.99]], np.float32))
 
     bytes_read = 2 * k * d * 4
-    for mode in ("dma", "sort", "cumsum", "full", "xla"):
-        fns = {}
+    modes = (sys.argv[5].split(",") if len(sys.argv) > 5
+             else ["dma", "sort", "cumsum", "full", "xla"])
+    for mode in modes:
         def fn(pct_jitter, _mode=mode):
             return run_variant(_mode, mean, weight, minmax,
                                qs + pct_jitter, se._lane_tile(k, d))

@@ -1,0 +1,187 @@
+"""Chaos/backpressure e2e (VERDICT r3 #9, SURVEY §5.3): a global-tier
+outage under sustained ingest must degrade with BOUNDED buffering and
+per-cause drop accounting (`flusher.go:553-566` classification heritage),
+then recover without restarting the local; a slow sink must never stall
+the flush loop or starve its sibling sinks."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks import simple as simple_sinks
+
+
+class _StatsCapture:
+    """Real UDP endpoint for the server's self-metric DogStatsD."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.settimeout(0.05)
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        self.lines: list[bytes] = []
+
+    def drain(self) -> bytes:
+        while True:
+            try:
+                data, _ = self.sock.recvfrom(65536)
+            except OSError:
+                break
+            self.lines.extend(data.split(b"\n"))
+        return b"\n".join(self.lines)
+
+
+def test_global_outage_bounded_buffering_and_recovery():
+    # the worst outage shape: the global's address ACCEPTS connections
+    # but never answers (a wedged host, a half-dead LB target) — every
+    # forward hangs to its deadline instead of failing fast
+    blackhole = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blackhole.bind(("127.0.0.1", 0))
+    blackhole.listen(16)
+    port = blackhole.getsockname()[1]
+
+    stats = _StatsCapture()
+    lsink = simple_sinks.ChannelMetricSink()
+    local = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        forward_address=f"127.0.0.1:{port}",
+        forward_timeout=3.0,                       # slow-failing forwards
+        stats_address=stats.addr,
+        interval=0.05, percentiles=[0.5], hostname="l"),
+        extra_metric_sinks=[lsink])
+    local.start()
+    try:
+        _, addr = local.statsd_addrs[0]
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rng = np.random.default_rng(5)
+
+        # sustained ingest while the global is down: enough flush ticks
+        # that every forward slot is stalled inside its 3s timeout, so
+        # later intervals must DROP (bounded buffering), while local
+        # emission keeps working untouched
+        batches = 0
+        for i in range(local.FORWARD_MAX_IN_FLIGHT + 3):
+            for v in rng.gamma(2.0, 10.0, 50):
+                tx.sendto(b"api.lat:%.2f|h" % v, addr)
+            tx.sendto(b"beat:1|c", addr)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                local._drain_native()
+                if local.aggregator.digests.staged_count() >= 50:
+                    break
+                time.sleep(0.01)
+            local.flush()
+            batches += 1
+        assert local.forward_dropped > 0           # bounded, accounted
+        # local pipeline unaffected: every interval's local aggregates
+        # and counters came out
+        got = []
+        while not lsink.queue.empty():
+            got.extend(lsink.queue.get())
+        names = {m.name for m in got}
+        assert "api.lat.count" in names and "beat" in names
+        blob = stats.drain()
+        assert b"forward.error_total" in blob
+        assert b"cause:slots_exhausted" in blob
+
+        # recovery: the wedged endpoint dies and a healthy global comes
+        # up ON THE SAME PORT; forwarding resumes on the live channel
+        # without restarting the local
+        blackhole.close()
+        g2sink = simple_sinks.ChannelMetricSink()
+        g2 = Server(config_mod.Config(grpc_address=f"127.0.0.1:{port}",
+                                      interval=0.05, percentiles=[0.5],
+                                      hostname="g2"),
+                    extra_metric_sinks=[g2sink])
+        g2.start()
+        try:
+            recovered = False
+            deadline = time.time() + 30
+            while time.time() < deadline and not recovered:
+                for v in rng.gamma(2.0, 10.0, 20):
+                    tx.sendto(b"api.lat:%.2f|h" % v, addr)
+                t0 = time.time() + 2
+                while time.time() < t0:
+                    local._drain_native()
+                    if local.aggregator.digests.staged_count() >= 20:
+                        break
+                    time.sleep(0.01)
+                local.flush()
+                g2.flush()
+                while not g2sink.queue.empty():
+                    for m in g2sink.queue.get():
+                        if m.name == "api.lat.50percentile":
+                            recovered = True
+            assert recovered, "forwarding did not recover after outage"
+        finally:
+            g2.shutdown()
+    finally:
+        local.shutdown()
+
+
+class _SlowSink(sink_mod.BaseMetricSink):
+    KIND = "slowtest"
+
+    def __init__(self, block_s: float):
+        super().__init__("slow", {})
+        self.block_s = block_s
+        self.flushes = 0
+
+    def start(self, trace_client=None) -> None:
+        pass
+
+    def flush(self, metrics) -> sink_mod.MetricFlushResult:
+        self.flushes += 1
+        time.sleep(self.block_s)
+        return sink_mod.MetricFlushResult(flushed=len(metrics))
+
+
+def test_slow_sink_straggler_isolation():
+    """One sink stuck far past the interval: siblings flush on time every
+    interval, the flush loop never blocks past its deadline, and the
+    straggler is identified per-sink in self-metrics
+    (flush.stragglers_total, the deadline classification heritage)."""
+    stats = _StatsCapture()
+    fast = simple_sinks.ChannelMetricSink()
+    slow = _SlowSink(block_s=3.0)
+    srv = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        stats_address=stats.addr,
+        interval=0.2, percentiles=[0.5], hostname="s"),
+        extra_metric_sinks=[fast, slow])
+    srv.start()
+    try:
+        _, addr = srv.statsd_addrs[0]
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        flush_walls = []
+        for i in range(3):
+            tx.sendto(b"tick:1|c", addr)
+            deadline = time.time() + 5
+            base = srv.aggregator.processed
+            while time.time() < deadline:
+                srv._drain_native()
+                if srv.aggregator.processed > base:
+                    break
+                time.sleep(0.01)
+            t0 = time.perf_counter()
+            srv.flush()
+            flush_walls.append(time.perf_counter() - t0)
+        # the fast sink saw every interval
+        batches = []
+        while not fast.queue.empty():
+            batches.append(fast.queue.get())
+        assert len(batches) == 3
+        assert all(any(m.name == "tick" for m in b) for b in batches)
+        # the flush loop is bounded by its deadline, not the straggler
+        assert max(flush_walls) < 3.0
+        # and the straggler is identified per sink
+        blob = stats.drain()
+        assert b"flush.stragglers_total" in blob
+        assert b"flush:metric:slow" in blob
+    finally:
+        srv.shutdown()

@@ -55,6 +55,10 @@ class FlushResult:
 # digest_export chunk (elements); see _emit_digests' forwarding branch.
 _EXPORT_ELEM_BUDGET = 1 << 26
 
+# A flush smaller than chunks * this many dense rows is not worth
+# splitting for upload/evaluate overlap (dispatch overhead dominates).
+_CHUNK_MIN_ROWS = 8192
+
 
 class MetricAggregator:
     def __init__(self,
@@ -67,7 +71,8 @@ class MetricAggregator:
                  is_local: bool = True, initial_capacity: int = 0,
                  set_initial_capacity: int = 0,
                  hll_legacy_migration: bool = False,
-                 digest_float64: bool = False):
+                 digest_float64: bool = False,
+                 flush_upload_chunks: int = 2):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -137,6 +142,15 @@ class MetricAggregator:
         # bucket traces+compiles a fresh program; the server reports the
         # counters as self-metrics and the flush watchdog treats an
         # in-progress first-bucket compile as progress, not a hang
+        # per-flush measured segments (snapshot/build/dispatch/device/
+        # emit seconds + upload/readback bytes): the e2e decomposition
+        # the bench and self-metrics report
+        self.last_flush_segments: dict = {}
+        # rounded DOWN to a power of two: dense row counts are pow2, so
+        # only pow2 chunk counts tile them exactly (a 3-way split would
+        # silently drop the tail rows)
+        self._upload_chunks = 1 << max(0, int(
+            flush_upload_chunks).bit_length() - 1)
         self._compiled_shapes: set = set()
         self._compile_lock = threading.Lock()
         self._compiles_active = 0
@@ -267,9 +281,12 @@ class MetricAggregator:
         now = int(now if now is not None else time.time())
         res = FlushResult()
 
+        seg = self.last_flush_segments = {}
+        t0 = time.perf_counter()
         with self.lock:
             snap = self._snapshot_and_reset()
             res.processed, res.imported = snap.pop("counts")
+        seg["snapshot_s"] = time.perf_counter() - t0
 
         # ONE device program call evaluates the flush on the snapshot
         # OUTSIDE the lock, so ingest continues (flusher.go:26-122 +
@@ -294,11 +311,13 @@ class MetricAggregator:
                                 if snap["uts_host"] is not None
                                 else host["unique_ts"])
 
+        t0 = time.perf_counter()
         self._emit_counters(res, snap, host, is_local, now)
         self._emit_gauges(res, snap, is_local, now)
         self._emit_status(res, snap, now)
         self._emit_sets(res, snap, host, is_local, now)
         self._emit_digests(res, snap, host, is_local, now)
+        seg["emit_s"] = time.perf_counter() - t0
         return res
 
     @staticmethod
@@ -396,14 +415,43 @@ class MetricAggregator:
             host["set_ests"] = snap["sets"]["estimates"]
             if nd == 0:
                 return host
+            seg = self.last_flush_segments
+            t0 = time.perf_counter()
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
                 dpart["d_min"], dpart["d_max"])
-            dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
-            with self._CompileGuard(self, dv.shape):
-                out = self.flush_fn(dvd, dwd, mmd, self._pct_arr)
-            ev = serving.fetch(out)
-            host["dense_dev"] = (dvd, dwd)
+            seg["build_s"] = time.perf_counter() - t0
+            seg["upload_bytes"] = dv.nbytes + dw.nbytes + minmax.nbytes
+            # Upload/evaluate overlap (the P7 double-buffer, on device
+            # streams): a big GLOBAL-tier flush splits into row chunks —
+            # chunk i+1's upload rides the transfer engine while chunk
+            # i's program runs.  Forwarding tiers keep one piece (the
+            # digest export gathers from the whole dense matrix).
+            n_chunks = 1
+            if (not is_local and self._upload_chunks > 1
+                    and dv.shape[0]
+                    >= self._upload_chunks * _CHUNK_MIN_ROWS):
+                n_chunks = self._upload_chunks
+            rows_per = dv.shape[0] // n_chunks
+            t0 = time.perf_counter()
+            outs = []
+            first_dev = None
+            for c in range(n_chunks):
+                sl = slice(c * rows_per, (c + 1) * rows_per)
+                dvd, dwd, mmd = self.digests.put_dense(
+                    dv[sl], dw[sl], minmax[:, sl])
+                if first_dev is None:
+                    first_dev = (dvd, dwd)
+                with self._CompileGuard(self, dv[sl].shape):
+                    outs.append(self.flush_fn(dvd, dwd, mmd,
+                                              self._pct_arr))
+            seg["dispatch_s"] = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            fetched = serving.fetch(tuple(outs))
+            ev = fetched[0] if n_chunks == 1 else np.concatenate(fetched)
+            seg["device_s"] = time.perf_counter() - t0
+            seg["readback_bytes"] = ev.nbytes
+            host["dense_dev"] = first_dev
         else:
             multi = jax.process_count() > 1
             if multi and is_local:

@@ -6,17 +6,24 @@ import jax
 import jax.numpy as jnp
 
 
-def tri_cumsum(w: jax.Array) -> jax.Array:
-    """Row-wise inclusive prefix sums `[T, D] -> [T, D]` as a triangular
-    ones matmul — the guaranteed-lowering Mosaic form of `cumsum`.
+def tri_cumsum(w: jax.Array, axis: int = -1) -> jax.Array:
+    """Inclusive prefix sums along `axis` (last or first of a 2-D tile)
+    as a triangular ones matmul — the guaranteed-lowering Mosaic form of
+    `cumsum`.
 
     The mask is built with int arithmetic (not a bool compare) because
     Mosaic cannot truncate the intermediate i8 compare vector back to i1
     at large shapes; HIGHEST precision because bf16 MXU rounding would
     break the monotonicity that rank searches depend on."""
-    d = w.shape[-1]
+    d = w.shape[axis]
     ks = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
     js = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
-    tri = jnp.clip(js - ks + 1, 0, 1).astype(jnp.float32)
-    return jnp.dot(w, tri, preferred_element_type=jnp.float32,
+    if axis in (-1, w.ndim - 1):
+        tri = jnp.clip(js - ks + 1, 0, 1).astype(jnp.float32)  # k <= j
+        return jnp.dot(w, tri, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+    if axis != 0:
+        raise ValueError("tri_cumsum supports the first or last axis")
+    tri = jnp.clip(ks - js + 1, 0, 1).astype(jnp.float32)      # j <= k
+    return jnp.dot(tri, w, preferred_element_type=jnp.float32,
                    precision=jax.lax.Precision.HIGHEST)

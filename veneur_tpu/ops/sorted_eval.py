@@ -45,6 +45,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from veneur_tpu.ops import mxu
+
 # padding sort key: +inf never collides with real values (the parser
 # rejects non-finite samples; m_clean masks padding before any product,
 # so no inf*0 NaN can arise).  A plain python float — jnp scalars would
@@ -84,17 +86,14 @@ def _cmp_exchange(key, w, j, k, idx):
 
 def _cumsum_depth(w):
     """Inclusive prefix sum along the sublane (depth) axis.  MXU-sized
-    depths use the guaranteed-lowering triangular ones matmul (HIGHEST
-    precision keeps integer weights exact below 2^24, preserving the
-    monotonicity rank searches depend on); shallow and extreme depths
-    use log-step shift-adds, which are exact for the same reason."""
+    depths use the shared triangular ones matmul (mxu.tri_cumsum:
+    HIGHEST precision keeps integer weights exact below 2^24, preserving
+    the monotonicity rank searches depend on); shallow and extreme
+    depths use log-step shift-adds, which are exact for the same
+    reason."""
     d = w.shape[0]
     if 128 <= d <= 512:
-        ks = jax.lax.broadcasted_iota(jnp.int32, (d, d), 0)
-        js = jax.lax.broadcasted_iota(jnp.int32, (d, d), 1)
-        tri = jnp.clip(ks - js + 1, 0, 1).astype(jnp.float32)  # j <= i
-        return jnp.dot(tri, w, preferred_element_type=jnp.float32,
-                       precision=jax.lax.Precision.HIGHEST)
+        return mxu.tri_cumsum(w, axis=0)
     idx = jax.lax.broadcasted_iota(jnp.int32, w.shape, 0)
     cum = w
     s = 1

@@ -17,11 +17,12 @@ S set-type keys live as one dense uint8 tensor `[S, 2^p]`:
 The reference keeps a sparse compressed list for small sets; we keep dense
 registers on device (static shapes).  The wire codec IS axiomhq's
 MarshalBinary format (vendor hyperloglog.go MarshalBinary/UnmarshalBinary):
-we *emit* the dense form and *accept* both dense and sparse forms, and set
-members are hashed with the same metro hash (seed 1337) — so Set sketches
-interoperate with a mixed fleet of real veneur instances in both
-directions.  (We never emit the sparse form; a real veneur accepts dense
-regardless of size, so nothing is lost but edge bandwidth on tiny sets.)
+we *accept* both dense and sparse forms, and *emit* whichever is smaller —
+the sparse compressedList (synthesized pp-precision keys, O(members)
+bytes, lossless ranks) for small sets, the dense nibble-packed form past
+the ~2k-occupied-register crossover.  Set members are hashed with the
+same metro hash (seed 1337), so Set sketches interoperate with a mixed
+fleet of real veneur instances in both directions.
 The previous fleet-internal "VH" encoding is still accepted on read so a
 mixed-version fleet does not *error* during a rolling upgrade — but note
 that sketches built with the old blake2b member hash do not union
