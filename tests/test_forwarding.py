@@ -134,18 +134,71 @@ def test_global_counters_gauges_sets_over_grpc():
         glob.shutdown()
 
 
-def test_v1_send_metrics_unimplemented():
-    glob, _ = boot_global()
+def test_v1_send_metrics_batch_import():
+    """V1 MetricList is the fleet-internal batch fast path: our global
+    imports it (python-grpc V2 streams cap at ~20k msgs/s); the
+    reference leaves V1 unimplemented, and the client/proxy probe +
+    fall back to V2 against such globals (see
+    test_forward_client_v2_fallback_on_unimplemented)."""
+    glob, sink = boot_global()
     try:
         client = ForwardClient(f"127.0.0.1:{glob.grpc_import.port}")
-        with pytest.raises(grpc.RpcError) as exc:
-            client.send_v1([sm.ForwardMetric(
-                name="x", tags=[], kind="counter",
-                scope=MetricScope.GLOBAL_ONLY, counter_value=1)])
-        assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        client.send_v1([sm.ForwardMetric(
+            name="x", tags=[], kind="counter",
+            scope=MetricScope.GLOBAL_ONLY, counter_value=7)])
+        got = flush_and_collect(
+            glob, sink, lambda ms: any(m.name == "x" for m in ms))
+        assert {m.name: m.value for m in got}["x"] == 7.0
         client.close()
     finally:
         glob.shutdown()
+
+
+def test_forward_client_v2_fallback_on_unimplemented():
+    """Against a reference-shaped global (V1 UNIMPLEMENTED), send()
+    probes once, falls back to the V2 stream, and delivers every
+    metric; later sends skip the probe."""
+    from concurrent import futures as cf
+
+    from google.protobuf import empty_pb2
+    from veneur_tpu.forward.client import SEND_METRICS, SEND_METRICS_V2
+    from veneur_tpu.protocol import forward_pb2, metric_pb2
+
+    got = []
+
+    def v1(request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "no V1 here")
+
+    def v2(request_iterator, context):
+        for pb in request_iterator:
+            got.append(pb.name)
+        return empty_pb2.Empty()
+
+    handlers = grpc.method_handlers_generic_handler(
+        "forwardrpc.Forward", {
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                v1, request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString),
+            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                v2, request_deserializer=metric_pb2.Metric.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+    server = grpc.server(cf.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handlers,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        client = ForwardClient(f"127.0.0.1:{port}")
+        fms = [sm.ForwardMetric(name=f"f{i}", tags=[], kind="counter",
+                                scope=MetricScope.GLOBAL_ONLY,
+                                counter_value=1) for i in range(10)]
+        client.send(fms)
+        assert client._use_v1 is False
+        assert sorted(got) == sorted(f"f{i}" for i in range(10))
+        client.send(fms)           # second send: straight to V2
+        assert len(got) == 20
+        client.close()
+    finally:
+        server.stop(0)
 
 
 def test_import_bad_metric_does_not_kill_stream():

@@ -82,6 +82,9 @@ class Config:
     grpc_address: str = ""          # gRPC import (global tier)
     forward_address: str = ""       # set => this is a LOCAL instance
     forward_timeout: float = 0.0    # 0 => max(interval, 10s)
+    # parallel SendMetricsV2 streams per forward flush for big batches
+    # (a single python-grpc client stream caps at ~20k msgs/s)
+    forward_streams: int = 8
     stats_address: str = ""         # self-metrics statsd target
 
     # aggregation

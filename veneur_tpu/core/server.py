@@ -417,7 +417,8 @@ class Server:
             self.forwarder = ForwardClient(
                 self.config.forward_address,
                 timeout_s=self.config.forward_timeout
-                or max(self.config.interval, 10.0))
+                or max(self.config.interval, 10.0),
+                max_streams=self.config.forward_streams)
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")

@@ -10,6 +10,7 @@ stubs.
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 from typing import Optional
 
@@ -26,12 +27,30 @@ SEND_METRICS = "/forwardrpc.Forward/SendMetrics"
 SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
 
 
+# A python-grpc client stream tops out at ~20k msgs/s (each message is a
+# cond-var handoff to the channel thread).  Against this framework's own
+# globals, flushes go as batched V1 MetricList RPCs (thousands of
+# metrics per call); a reference global answers the first V1 attempt
+# UNIMPLEMENTED (sources/proxy/server.go:138-142) and the client falls
+# back permanently to the reference's V2 stream protocol, fanned out
+# over parallel streams for big flushes (metrics are independent —
+# merges commute — so interleaving is safe).
+STREAM_CHUNK = 2048
+BATCH_MAX = 2000
+
+
+class _V1Unsupported(Exception):
+    """The first V1 batch answered UNIMPLEMENTED before anything was
+    imported: safe to fall back to V2 for the same metrics."""
+
+
 class ForwardClient:
     def __init__(self, address: str,
                  credentials: Optional[grpc.ChannelCredentials] = None,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, max_streams: int = 8):
         self.address = address
         self.timeout_s = timeout_s
+        self.max_streams = max(1, max_streams)
         if credentials is not None:
             self.channel = grpc.secure_channel(address, credentials)
         else:
@@ -44,18 +63,84 @@ class ForwardClient:
             SEND_METRICS,
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.max_streams,
+            thread_name_prefix=f"fwd-{address}")
+        self._use_v1: Optional[bool] = None   # None = not yet probed
 
     def __call__(self, metrics: list[sm.ForwardMetric]) -> None:
         self.send(metrics)
 
     def send(self, metrics: list[sm.ForwardMetric]) -> None:
-        """One stream per flush, one Send per metric
-        (flusher.go:578-591)."""
+        """One flush's forward: batched V1 against this framework's
+        globals, the reference's V2 stream protocol otherwise
+        (flusher.go:578-591 semantics — every metric is Sent exactly
+        once per flush)."""
         if not metrics:
             return
         pbs = [convert.to_pb(fm) for fm in metrics]
-        self._v2(iter(pbs), timeout=self.timeout_s)
-        logger.debug("forwarded %d metrics to %s", len(pbs), self.address)
+        if self._use_v1 is not False:
+            try:
+                self._send_v1_batches(pbs)
+                self._use_v1 = True
+                return
+            except _V1Unsupported:
+                # the FIRST batch (sent alone, nothing imported) got
+                # UNIMPLEMENTED — either the initial probe or the global
+                # failing over to a reference veneur on the same address
+                # mid-life: fall back, this flush double-sends nothing
+                logger.info("global %s has no V1 batch import; "
+                            "using V2 streams", self.address)
+                self._use_v1 = False
+        n_streams = min(self.max_streams,
+                        max(1, len(pbs) // STREAM_CHUNK))
+        if n_streams == 1:
+            self._v2(iter(pbs), timeout=self.timeout_s)
+        else:
+            futs = [self._pool.submit(self._v2, iter(pbs[i::n_streams]),
+                                      timeout=self.timeout_s)
+                    for i in range(n_streams)]
+            errs = []
+            for f in futs:
+                try:
+                    f.result()
+                except Exception as e:   # noqa: BLE001 - re-raised below
+                    errs.append(e)
+            if errs:
+                raise errs[0]
+        logger.debug("forwarded %d metrics to %s over %d streams",
+                     len(pbs), self.address, n_streams)
+
+    def _send_v1_batches(self, pbs: list) -> None:
+        """BATCH_MAX-sized MetricList RPCs, in parallel for big
+        flushes.  The first chunk is sent ALONE: if it answers
+        UNIMPLEMENTED nothing has been imported yet, so the V2 fallback
+        never double-sends.  UNIMPLEMENTED on a LATER chunk (a mixed-
+        version load balancer) is a plain forward error for this
+        interval — falling back there would duplicate the first
+        chunks."""
+        chunks = [pbs[i:i + BATCH_MAX]
+                  for i in range(0, len(pbs), BATCH_MAX)]
+        try:
+            self._v1(forward_pb2.MetricList(metrics=chunks[0]),
+                     timeout=self.timeout_s)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                raise _V1Unsupported() from e
+            raise
+        if len(chunks) == 1:
+            return
+        futs = [self._pool.submit(
+            self._v1, forward_pb2.MetricList(metrics=c),
+            timeout=self.timeout_s) for c in chunks[1:]]
+        errs = []
+        for f in futs:
+            try:
+                f.result()
+            except Exception as e:       # noqa: BLE001 - re-raised below
+                errs.append(e)
+        if errs:
+            raise errs[0]
 
     def send_v1(self, metrics: list[sm.ForwardMetric]) -> None:
         """Batch API; the reference global leaves this unimplemented
@@ -66,4 +151,5 @@ class ForwardClient:
         self._v1(req, timeout=self.timeout_s)
 
     def close(self) -> None:
+        self._pool.shutdown(wait=False)
         self.channel.close()

@@ -1,14 +1,31 @@
-"""Per-destination connection: long-lived forward stream + send queue.
+"""Per-destination connection: batched V1 fast path + V2 stream fallback.
 
-Mirrors `proxy/connect/connect.go`: each destination owns a gRPC channel, a
-long-lived `SendMetricsV2` client stream, a bounded send buffer drained by a
-sender thread (`sendMetrics`, connect.go:141-227), and close detection that
-notifies the destinations manager so in-flight metrics are counted as
-dropped (`listenForClose`, connect.go:231-245).
+Mirrors `proxy/connect/connect.go`: each destination owns a gRPC channel,
+a bounded send buffer drained by sender threads (`sendMetrics`,
+connect.go:141-227), and close detection that notifies the destinations
+manager so in-flight metrics are counted as dropped (`listenForClose`,
+connect.go:231-245).
+
+Transport: at connect time the destination probes `SendMetrics` (V1,
+`forwardrpc.MetricList`) with an empty batch.  This framework's globals
+implement it (sources/proxy.py), so batches of up to BATCH_MAX metrics
+travel as single unary RPCs — a python-grpc client STREAM tops out at
+~20k msgs/s (per-message cond-var handoffs under the GIL), while V1
+batches clear hundreds of thousands.  A reference veneur global answers
+the probe UNIMPLEMENTED (sources/proxy/server.go:138-142) and the
+destination falls back to the reference's own long-lived `SendMetricsV2`
+streams — wire behavior a real veneur fleet already expects.
+
+The buffer bound counts METRICS (not queue items), so a wedged
+destination backpressures at `send_buffer_size` metrics however they
+were enqueued; a graceful close() lets every sender drain its own
+backlog, while a broken stream/RPC counts all buffered and in-flight
+metrics as dropped.
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -17,24 +34,33 @@ from typing import Callable, Optional
 import grpc
 from google.protobuf import empty_pb2
 
-from veneur_tpu.forward.client import SEND_METRICS_V2
-from veneur_tpu.protocol import metric_pb2
+from veneur_tpu.forward.client import SEND_METRICS, SEND_METRICS_V2
+from veneur_tpu.protocol import forward_pb2, metric_pb2
 
 logger = logging.getLogger("veneur_tpu.proxy.connect")
 
-_CLOSE = object()  # sentinel terminating the stream iterator
+_CLOSE = object()  # sentinel terminating a sender
+BATCH_MAX = 2000   # metrics per V1 MetricList RPC
 
 
 class Destination:
     def __init__(self, address: str, send_buffer_size: int = 1024,
                  on_closed: Optional[Callable[["Destination"], None]] = None,
-                 dial_timeout_s: float = 5.0):
+                 dial_timeout_s: float = 5.0, n_streams: int = 8):
         self.address = address
-        self.queue: queue.Queue = queue.Queue(maxsize=send_buffer_size)
         self.closed = threading.Event()
+        self._closing = threading.Event()     # graceful close() marker
         self.on_closed = on_closed
+        self._closed_once = threading.Lock()
+        self._close_notified = False
         self.sent = 0
         self.dropped = 0
+        self._sent_lock = threading.Lock()
+        # metric-count buffer bound (send_buffer_size metrics total,
+        # whatever the queue-item granularity)
+        self._buf_cap = max(1, send_buffer_size)
+        self._buffered = 0
+        self._buf_cv = threading.Condition()
         self.channel = grpc.insecure_channel(address)
         grpc.channel_ready_future(self.channel).result(
             timeout=dial_timeout_s)
@@ -42,65 +68,225 @@ class Destination:
             SEND_METRICS_V2,
             request_serializer=metric_pb2.Metric.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
-        self._sender = threading.Thread(
-            target=self._send_loop, daemon=True,
-            name=f"dest-{address}")
-        self._sender.start()
+        self._v1 = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+        self.batch_mode = self._probe_v1(dial_timeout_s)
+        # batch mode needs few senders (each RPC carries thousands);
+        # stream mode keeps n_streams parallel queues
+        self.n_streams = 2 if self.batch_mode else max(1, n_streams)
+        self.queues: list[queue.Queue] = [
+            queue.Queue() for _ in range(self.n_streams)]
+        self._rr = itertools.count()
+        self._senders = []
+        for i in range(self.n_streams):
+            t = threading.Thread(
+                target=(self._batch_loop if self.batch_mode
+                        else self._stream_loop),
+                args=(self.queues[i],),
+                daemon=True, name=f"dest-{address}-{i}")
+            t.start()
+            self._senders.append(t)
 
-    def _request_iter(self):
+    def _probe_v1(self, timeout_s: float) -> bool:
+        """One empty MetricList decides the transport: OK -> fleet-
+        internal batch RPCs; UNIMPLEMENTED -> reference V2 streams."""
+        try:
+            self._v1(forward_pb2.MetricList(), timeout=timeout_s)
+            return True
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                logger.info("destination %s has no V1 batch import; "
+                            "using V2 streams", self.address)
+                return False
+            raise
+
+    # -- buffer accounting -------------------------------------------------
+
+    def _reserve(self, n: int, block_poll_s: float) -> bool:
+        """Block until n metrics fit the buffer (an oversized batch is
+        admitted alone into an empty buffer) or the destination closes."""
+        while not self.closed.is_set():
+            with self._buf_cv:
+                # oversized groups are admitted whenever the buffer is
+                # not already full (waiting for exactly-empty would let
+                # smaller sends starve them); the bound is therefore
+                # cap + one oversized group per concurrent producer —
+                # still finite backpressure, never an unbounded queue
+                if (self._buffered + n <= self._buf_cap
+                        or (n > self._buf_cap
+                            and self._buffered < self._buf_cap)):
+                    self._buffered += n
+                    return True
+                self._buf_cv.wait(timeout=block_poll_s)
+        return False
+
+    def _release(self, n: int) -> None:
+        with self._buf_cv:
+            self._buffered -= n
+            self._buf_cv.notify_all()
+
+    # -- V1 batch senders --------------------------------------------------
+
+    def _batch_loop(self, q: queue.Queue) -> None:
+        # queue items are single Metrics (send) or lists (send_many)
+        graceful = False
+        try:
+            while True:
+                item = q.get()
+                if item is _CLOSE:
+                    graceful = True
+                    return
+                batch = list(item) if isinstance(item, list) else [item]
+                while len(batch) < BATCH_MAX:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is _CLOSE:
+                        self._release(len(batch))
+                        self._send_batch(batch)
+                        graceful = True
+                        return
+                    if isinstance(item, list):
+                        batch.extend(item)
+                    else:
+                        batch.append(item)
+                self._release(len(batch))
+                self._send_batch(batch)
+        except grpc.RpcError as e:
+            logger.warning("destination %s batch send failed: %s",
+                           self.address, e)
+        finally:
+            self._mark_closed(graceful)
+
+    def _send_batch(self, batch: list) -> None:
+        """Per-chunk sent accounting; a failed chunk counts itself and
+        everything after it as dropped (in-flight-counted-as-dropped,
+        connect.go:231-245)."""
+        for i in range(0, len(batch), BATCH_MAX):
+            chunk = batch[i:i + BATCH_MAX]
+            try:
+                self._v1(forward_pb2.MetricList(metrics=chunk),
+                         timeout=30.0)
+            except grpc.RpcError:
+                with self._sent_lock:
+                    self.dropped += len(batch) - i
+                raise
+            with self._sent_lock:
+                self.sent += len(chunk)
+
+    # -- V2 stream senders (reference-global fallback) ---------------------
+
+    def _request_iter(self, q: queue.Queue):
         while True:
-            item = self.queue.get()
+            item = q.get()
             if item is _CLOSE:
                 return
-            self.sent += 1
+            self._release(1)
+            with self._sent_lock:
+                self.sent += 1
             yield item
 
-    def _send_loop(self) -> None:
-        """One long-lived stream; when it breaks, mark closed and drain
-        the buffer as dropped (connect.go:196-227)."""
+    def _stream_loop(self, q: queue.Queue) -> None:
+        """One long-lived stream; when it breaks, mark the DESTINATION
+        closed and drain every buffer as dropped (connect.go:196-227)."""
+        ok = [False]
+
+        def it():
+            yield from self._request_iter(q)
+            ok[0] = True    # iterator exhausted = _CLOSE consumed
+
         try:
-            self._v2(self._request_iter())
+            self._v2(it())
         except grpc.RpcError as e:
             logger.warning("destination %s stream closed: %s",
                            self.address, e)
         finally:
-            self.closed.set()
+            self._mark_closed(ok[0])
+
+    def _mark_closed(self, graceful: bool) -> None:
+        """Sender-exit cleanup.  `graceful` = this sender consumed its
+        _CLOSE sentinel during close(): siblings are still draining
+        their OWN backlogs, so nothing may be stolen.  Any OTHER exit
+        (stream break, failed RPC — even mid-close()) closes the whole
+        destination: drain every buffer as dropped (connect.go:231-245),
+        wake sibling senders with sentinels so their threads and streams
+        do not leak, and notify the manager once."""
+        if graceful and self._closing.is_set():
+            return
+        self.closed.set()
+        self._drain_dropped()
+        for qq in self.queues:
+            # wake any sibling blocked in q.get(); extra sentinels are
+            # harmless (consumers treat _CLOSE as final)
+            qq.put(_CLOSE)
+        notify = False
+        with self._closed_once:
+            if not self._close_notified:
+                self._close_notified = True
+                notify = True
+        if notify and self.on_closed is not None:
+            self.on_closed(self)
+
+    def _drain_dropped(self) -> None:
+        for qq in self.queues:
             while True:
                 try:
-                    item = self.queue.get_nowait()
+                    item = qq.get_nowait()
                 except queue.Empty:
                     break
                 if item is not _CLOSE:
-                    self.dropped += 1
-            if self.on_closed is not None:
-                self.on_closed(self)
+                    n = len(item) if isinstance(item, list) else 1
+                    self._release(n)
+                    with self._sent_lock:
+                        self.dropped += n
+
+    # -- enqueue -----------------------------------------------------------
 
     def send(self, metric: metric_pb2.Metric,
              block_poll_s: float = 0.05) -> str:
-        """Nonblocking enqueue, then blocking with closed-destination
-        escape (handlers.go:134-163).  Returns 'ok'|'enqueue'|'dropped'."""
-        if self.closed.is_set():
-            self.dropped += 1
+        """Backpressured enqueue with closed-destination escape
+        (handlers.go:134-163).  Returns 'ok'|'dropped'."""
+        if not self._reserve(1, block_poll_s):
+            with self._sent_lock:
+                self.dropped += 1
             return "dropped"
-        try:
-            self.queue.put_nowait(metric)
-            return "ok"
-        except queue.Full:
-            pass
-        while not self.closed.is_set():
-            try:
-                self.queue.put(metric, timeout=block_poll_s)
-                return "enqueue"
-            except queue.Full:
-                continue
-        self.dropped += 1
-        return "dropped"
+        self.queues[next(self._rr) % self.n_streams].put(metric)
+        if self.closed.is_set():
+            # the destination died between reserve and put: the senders
+            # are gone, so sweep whatever remains (possibly our item)
+            # into the dropped count rather than stranding it
+            self._drain_dropped()
+        return "ok"
+
+    def send_many(self, metrics: list,
+                  block_poll_s: float = 0.05) -> int:
+        """Enqueue a routed group (batch mode: one queue item; stream
+        mode: per-metric fan-out).  Returns how many metrics were
+        DROPPED (0 = all buffered)."""
+        if not metrics:
+            return 0
+        if not self.batch_mode:
+            return sum(1 for m in metrics
+                       if self.send(m, block_poll_s) == "dropped")
+        if not self._reserve(len(metrics), block_poll_s):
+            with self._sent_lock:
+                self.dropped += len(metrics)
+            return len(metrics)
+        self.queues[next(self._rr) % self.n_streams].put(list(metrics))
+        if self.closed.is_set():
+            self._drain_dropped()
+        return 0
 
     def close(self, drain_timeout_s: float = 5.0) -> None:
-        """Graceful: stop accepting, let the sender drain, close channel."""
-        try:
-            self.queue.put(_CLOSE, timeout=drain_timeout_s)
-        except queue.Full:
-            self.closed.set()
-        self._sender.join(timeout=drain_timeout_s)
+        """Graceful: stop accepting, let each sender drain its own
+        backlog, close the channel."""
+        self._closing.set()
+        for q in self.queues:
+            q.put(_CLOSE)
+        for t in self._senders:
+            t.join(timeout=drain_timeout_s)
+        self.closed.set()
         self.channel.close()

@@ -20,8 +20,10 @@ logger = logging.getLogger("veneur_tpu.proxy.destinations")
 
 
 class Destinations:
-    def __init__(self, send_buffer_size: int = 1024, grpc_stats=None):
+    def __init__(self, send_buffer_size: int = 1024, grpc_stats=None,
+                 n_streams: int = 8):
         self.send_buffer_size = send_buffer_size
+        self.n_streams = n_streams
         self.grpc_stats = grpc_stats
         self._lock = threading.Lock()
         self._ring = ConsistentHash()
@@ -58,7 +60,8 @@ class Destinations:
 
     def _connect(self, address: str) -> Destination:
         dest = Destination(address, self.send_buffer_size,
-                           on_closed=self._connection_closed)
+                           on_closed=self._connection_closed,
+                           n_streams=self.n_streams)
         if self.grpc_stats is not None:
             self.grpc_stats.watch_channel(dest.channel)
         return dest
@@ -109,5 +112,5 @@ class Destinations:
     def stats(self) -> dict[str, dict[str, int]]:
         with self._lock:
             return {a: {"sent": d.sent, "dropped": d.dropped,
-                        "queued": d.queue.qsize()}
+                        "queued": sum(q.qsize() for q in d.queues)}
                     for a, d in self._dests.items()}

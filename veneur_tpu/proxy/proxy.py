@@ -46,6 +46,9 @@ class ProxyConfig:
     forward_service: str = "veneur-global"
     discovery_interval: float = 10.0
     send_buffer_size: int = 1024
+    # parallel SendMetricsV2 streams per destination (a single python-
+    # grpc stream caps at ~20k msgs/s; see proxy/connect.py)
+    send_streams: int = 8
     ignore_tags: list[TagMatcher] = field(default_factory=list)
     static_destinations: list[str] = field(default_factory=list)
     # optional second, TLS-authenticated listener (proxy.go:190-306: the
@@ -70,6 +73,7 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
         discovery_interval=parse_duration(
             data.get("discovery_interval", 10.0)),
         send_buffer_size=int(data.get("send_buffer_size", 1024)),
+        send_streams=int(data.get("send_streams", 8)),
         ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
         static_destinations=list(data.get("static_destinations", [])),
         grpc_tls_address=data.get("grpc_tls_address", ""),
@@ -89,6 +93,7 @@ class Proxy:
         # connection open/close accounting (grpcstats/stats.go:1-49)
         self.grpc_stats = GrpcStats(statsd=statsd)
         self.destinations = Destinations(cfg.send_buffer_size,
+                                         n_streams=cfg.send_streams,
                                          grpc_stats=self.grpc_stats)
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
                       "no_destination": 0}
@@ -142,8 +147,9 @@ class Proxy:
 
     def _handlers(self):
         def send_metrics(request, context):
-            for m in request.metrics:
-                self.handle_metric(m)
+            # fleet-internal batch inbound: route the whole MetricList
+            # through the amortized path
+            self.handle_metrics(request.metrics)
             return empty_pb2.Empty()
 
         def send_metrics_v2(request_iterator, context):
@@ -171,20 +177,51 @@ class Proxy:
         return f"{m.name}{_TYPE_NAMES.get(m.type, '')}{','.join(tags)}"
 
     def handle_metric(self, m: metric_pb2.Metric) -> None:
-        with self._stats_lock:
-            self.stats["received"] += 1
         try:
             dest = self.destinations.get(self.routing_key(m))
         except LookupError:
             with self._stats_lock:
+                self.stats["received"] += 1
                 self.stats["no_destination"] += 1
             return
         outcome = dest.send(m)
         with self._stats_lock:
+            self.stats["received"] += 1
             if outcome == "dropped":
                 self.stats["dropped"] += 1
             else:
                 self.stats["routed"] += 1
+
+    def handle_metrics(self, ms) -> None:
+        """Batched routing (the V1 inbound path): group by destination,
+        enqueue each group as one unit, take the stats lock once.  Same
+        per-metric routing key and drop accounting as handle_metric —
+        just amortized, so one proxy process keeps up with the batched
+        fleet-internal transport it now speaks on both edges."""
+        groups: dict = {}
+        no_dest = 0
+        for m in ms:
+            try:
+                dest = self.destinations.get(self.routing_key(m))
+            except LookupError:
+                no_dest += 1
+                continue
+            g = groups.get(id(dest))
+            if g is None:
+                g = groups[id(dest)] = (dest, [])
+            g[1].append(m)
+        routed = 0
+        dropped = 0
+        for dest, batch in groups.values():
+            n_drop = dest.send_many(batch)
+            dropped += n_drop
+            routed += len(batch) - n_drop
+        with self._stats_lock:
+            self.stats["received"] += len(ms) if hasattr(ms, "__len__") \
+                else routed + dropped + no_dest
+            self.stats["no_destination"] += no_dest
+            self.stats["routed"] += routed
+            self.stats["dropped"] += dropped
 
     # -- HTTP healthcheck (handlers.go:30-38) ------------------------------
 

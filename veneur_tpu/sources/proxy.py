@@ -4,8 +4,12 @@ Mirrors `sources/proxy/server.go`: a Forward service whose
 `SendMetricsV2` recv-loop feeds each metric into the aggregation core
 (`server.go:144-162` -> `ingest.IngestMetricProto` -> worker
 `ImportMetric`), registered when `grpc_address` is configured
-(`server.go:673-682`).  `SendMetrics` (V1) returns UNIMPLEMENTED exactly
-like the reference (`sources/proxy/server.go:138-142`).
+(`server.go:673-682`).  `SendMetrics` (V1) — which the reference leaves
+UNIMPLEMENTED (`sources/proxy/server.go:138-142`) — is implemented here
+as the fleet-internal batch import fast path: a strict superset, since
+reference senders only ever call V2, while this framework's
+proxies/forwarders probe V1 and fall back to V2 against reference
+globals (python-grpc streams cap at ~20k msgs/s).
 
 Also exposes the gRPC ingest listeners for SSF spans and raw dogstatsd
 packet bytes (`networking.go:326-391`).
@@ -36,7 +40,7 @@ class GrpcImportServer:
                  import_metric: Optional[Callable[[object], None]] = None,
                  ingest_span: Optional[Callable[[object], None]] = None,
                  handle_packet: Optional[Callable[[bytes], None]] = None,
-                 max_workers: int = 8,
+                 max_workers: int = 64,
                  server_credentials: Optional[grpc.ServerCredentials] = None):
         """With import_metric=None the Forward service is omitted — the
         ingest-only shape of `grpc_listen_addresses` edge listeners
@@ -47,6 +51,10 @@ class GrpcImportServer:
         self.handle_packet = handle_packet
         self.imported_count = 0
         self._count_lock = threading.Lock()
+        # Each long-lived client stream (a proxy destination keeps 8 of
+        # them open per global, proxy/connect.py) pins one worker thread
+        # for its lifetime, so the pool is sized for a fleet of proxies
+        # plus per-flush forward streams, not for short RPCs.
         self.server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(
                 max_workers=max_workers, thread_name_prefix="grpc-import"))
@@ -65,9 +73,25 @@ class GrpcImportServer:
 
     def _make_handlers(self):
         def send_metrics(request, context):
-            # V1 unimplemented, matching sources/proxy/server.go:138-142
-            context.abort(grpc.StatusCode.UNIMPLEMENTED,
-                          "SendMetrics is not implemented")
+            # V1 batch import — the fleet-internal fast path.  The
+            # reference leaves this UNIMPLEMENTED (sources/proxy/
+            # server.go:138-142) and its locals/proxies only speak the
+            # V2 stream, so accepting batches here is a strict superset:
+            # reference senders are unaffected, while this framework's
+            # proxies/forwarders probe V1 and fall back to V2 against
+            # reference globals (python-grpc streams cap at ~20k msgs/s;
+            # one MetricList carries thousands per RPC).
+            count = 0
+            for pb in request.metrics:
+                try:
+                    self.import_metric(convert.from_pb(pb))
+                    count += 1
+                except Exception as e:
+                    logger.error("failed to import metric %s: %s",
+                                 pb.name, e)
+            with self._count_lock:
+                self.imported_count += count
+            return empty_pb2.Empty()
 
         def send_metrics_v2(request_iterator, context):
             count = 0
