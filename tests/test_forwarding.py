@@ -15,7 +15,7 @@ from google.protobuf import empty_pb2
 from veneur_tpu import config as config_mod
 from veneur_tpu.core.server import Server
 from veneur_tpu.forward import convert
-from veneur_tpu.forward.client import SEND_METRICS, ForwardClient
+from veneur_tpu.forward.client import ForwardClient
 from veneur_tpu.protocol import forward_pb2, metric_pb2, tdigest_pb2
 from veneur_tpu.samplers import samplers as sm
 from veneur_tpu.samplers.metric_key import MetricScope
@@ -161,7 +161,6 @@ def test_forward_client_v2_fallback_on_unimplemented():
     from concurrent import futures as cf
 
     from google.protobuf import empty_pb2
-    from veneur_tpu.forward.client import SEND_METRICS, SEND_METRICS_V2
     from veneur_tpu.protocol import forward_pb2, metric_pb2
 
     got = []

@@ -25,7 +25,6 @@ metrics as dropped.
 
 from __future__ import annotations
 
-import itertools
 import logging
 import queue
 import threading
@@ -34,13 +33,13 @@ from typing import Callable, Optional
 import grpc
 from google.protobuf import empty_pb2
 
-from veneur_tpu.forward.client import SEND_METRICS, SEND_METRICS_V2
+from veneur_tpu.forward.client import (BATCH_MAX, SEND_METRICS,
+                                       SEND_METRICS_V2)
 from veneur_tpu.protocol import forward_pb2, metric_pb2
 
 logger = logging.getLogger("veneur_tpu.proxy.connect")
 
 _CLOSE = object()  # sentinel terminating a sender
-BATCH_MAX = 2000   # metrics per V1 MetricList RPC
 
 
 class Destination:
@@ -78,7 +77,6 @@ class Destination:
         self.n_streams = 2 if self.batch_mode else max(1, n_streams)
         self.queues: list[queue.Queue] = [
             queue.Queue() for _ in range(self.n_streams)]
-        self._rr = itertools.count()
         self._senders = []
         for i in range(self.n_streams):
             t = threading.Thread(
@@ -99,8 +97,15 @@ class Destination:
             if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                 logger.info("destination %s has no V1 batch import; "
                             "using V2 streams", self.address)
-                return False
-            raise
+            else:
+                # transiently unavailable at probe time: do not reject
+                # the destination (the pre-probe design made no RPC at
+                # connect) — serve it via V2 streams, whose own failure
+                # handling covers a genuinely broken peer
+                logger.warning("destination %s V1 probe failed (%s); "
+                               "using V2 streams", self.address,
+                               e.code())
+            return False
 
     # -- buffer accounting -------------------------------------------------
 
@@ -127,6 +132,13 @@ class Destination:
             self._buffered -= n
             self._buf_cv.notify_all()
 
+    def _queue_for(self, name: str) -> queue.Queue:
+        """Key-affine queue choice: every metric of a given name rides
+        the same sender, so same-timeseries updates (gauges are
+        last-write-wins!) are never reordered across parallel senders —
+        the ordering the reference's single stream gave for free."""
+        return self.queues[hash(name) % self.n_streams]
+
     # -- V1 batch senders --------------------------------------------------
 
     def _batch_loop(self, q: queue.Queue) -> None:
@@ -145,16 +157,24 @@ class Destination:
                     except queue.Empty:
                         break
                     if item is _CLOSE:
-                        self._release(len(batch))
-                        self._send_batch(batch)
+                        try:
+                            self._send_batch(batch)
+                        finally:
+                            self._release(len(batch))
                         graceful = True
                         return
                     if isinstance(item, list):
                         batch.extend(item)
                     else:
                         batch.append(item)
-                self._release(len(batch))
-                self._send_batch(batch)
+                # release AFTER the send: the buffer bound covers
+                # in-flight batches too, so a wedged destination
+                # backpressures at ~cap metrics, not cap + what the
+                # senders have absorbed
+                try:
+                    self._send_batch(batch)
+                finally:
+                    self._release(len(batch))
         except grpc.RpcError as e:
             logger.warning("destination %s batch send failed: %s",
                            self.address, e)
@@ -253,7 +273,7 @@ class Destination:
             with self._sent_lock:
                 self.dropped += 1
             return "dropped"
-        self.queues[next(self._rr) % self.n_streams].put(metric)
+        self._queue_for(metric.name).put(metric)
         if self.closed.is_set():
             # the destination died between reserve and put: the senders
             # are gone, so sweep whatever remains (possibly our item)
@@ -271,14 +291,23 @@ class Destination:
         if not self.batch_mode:
             return sum(1 for m in metrics
                        if self.send(m, block_poll_s) == "dropped")
-        if not self._reserve(len(metrics), block_poll_s):
-            with self._sent_lock:
-                self.dropped += len(metrics)
-            return len(metrics)
-        self.queues[next(self._rr) % self.n_streams].put(list(metrics))
+        # key-affine bucketing (see _queue_for): same-name metrics stay
+        # on one sender so last-write-wins families keep their order
+        buckets: dict[int, list] = {}
+        for m in metrics:
+            buckets.setdefault(hash(m.name) % self.n_streams,
+                               []).append(m)
+        n_dropped = 0
+        for qi, group in buckets.items():
+            if not self._reserve(len(group), block_poll_s):
+                with self._sent_lock:
+                    self.dropped += len(group)
+                n_dropped += len(group)
+                continue
+            self.queues[qi].put(group)
         if self.closed.is_set():
             self._drain_dropped()
-        return 0
+        return n_dropped
 
     def close(self, drain_timeout_s: float = 5.0) -> None:
         """Graceful: stop accepting, let each sender drain its own
@@ -289,4 +318,8 @@ class Destination:
         for t in self._senders:
             t.join(timeout=drain_timeout_s)
         self.closed.set()
+        # a producer racing close() may have enqueued behind a sentinel
+        # after its sender exited: sweep the leftovers into the dropped
+        # count so sent + dropped always equals what was accepted
+        self._drain_dropped()
         self.channel.close()

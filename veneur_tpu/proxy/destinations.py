@@ -112,5 +112,5 @@ class Destinations:
     def stats(self) -> dict[str, dict[str, int]]:
         with self._lock:
             return {a: {"sent": d.sent, "dropped": d.dropped,
-                        "queued": sum(q.qsize() for q in d.queues)}
+                        "queued": d._buffered}
                     for a, d in self._dests.items()}
