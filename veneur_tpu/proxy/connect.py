@@ -252,16 +252,24 @@ class Destination:
 
     def _drain_dropped(self) -> None:
         for qq in self.queues:
+            saw_close = False
             while True:
                 try:
                     item = qq.get_nowait()
                 except queue.Empty:
                     break
-                if item is not _CLOSE:
-                    n = len(item) if isinstance(item, list) else 1
-                    self._release(n)
-                    with self._sent_lock:
-                        self.dropped += n
+                if item is _CLOSE:
+                    saw_close = True
+                    continue
+                n = len(item) if isinstance(item, list) else 1
+                self._release(n)
+                with self._sent_lock:
+                    self.dropped += n
+            if saw_close:
+                # a sender may still be mid-RPC and come back for its
+                # sentinel; consuming it would strand that thread in
+                # q.get() forever
+                qq.put(_CLOSE)
 
     # -- enqueue -----------------------------------------------------------
 
