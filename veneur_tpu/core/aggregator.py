@@ -495,8 +495,8 @@ class MetricAggregator:
             # ONE batched readback for everything the emitters need
             set_regs_dev = None
             if (g_ns and is_local
-                    and any(m.scope == MetricScope.MIXED
-                            for m in snap["sets"]["meta"])):
+                    and (snap["sets"]["scopes"]
+                         == int(MetricScope.MIXED)).any()):
                 ps = self._padded_rows(srows)
                 set_regs_dev = serving.set_regs_pack(
                     out.set_regs, jnp.asarray(ps))
@@ -565,7 +565,9 @@ class MetricAggregator:
             rows = ar.touched_rows()
             snap[name] = {
                 "rows": rows,
-                "meta": [ar.meta[r] for r in rows],
+                "names": ar.name_col[rows],
+                "tags": ar.tags_col[rows],
+                "scopes": ar.scope_col[rows].copy(),
                 "values": ar.values[rows].copy(),
             }
         snap["status"]["messages"] = {
@@ -578,7 +580,9 @@ class MetricAggregator:
         crows = c.touched_rows()
         snap["counters"] = {
             "rows": crows,
-            "meta": [c.meta[r] for r in crows],
+            "names": c.name_col[crows],
+            "tags": c.tags_col[crows],
+            "scopes": c.scope_col[crows].copy(),
         }
         if self.mesh is None:
             # no mesh => no psum; total the float64 host stripes directly
@@ -593,7 +597,9 @@ class MetricAggregator:
         srows = s.touched_rows()
         snap["sets"] = {
             "rows": srows,
-            "meta": [s.meta[r] for r in srows],
+            "names": s.name_col[srows],
+            "tags": s.tags_col[srows],
+            "scopes": s.scope_col[srows].copy(),
             # migration side lane (legacy blake2b imports): host-side
             # estimates to max against the primary lane at emission
             "legacy_ests": s.legacy_estimates(srows),
@@ -602,8 +608,8 @@ class MetricAggregator:
             # host registers: estimates now, register copies only if rows
             # will forward (Set.Metric marshal needs them post-reset)
             snap["sets"]["estimates"] = s.host_estimates(srows)
-            if len(srows) and any(m.scope == MetricScope.MIXED
-                                  for m in snap["sets"]["meta"]):
+            if len(srows) and (snap["sets"]["scopes"]
+                               == int(MetricScope.MIXED)).any():
                 snap["sets"]["host_regs"] = s.host_regs_copy(srows)
         else:
             snap["sets"]["lanes"] = s.snapshot_lanes()
@@ -611,7 +617,10 @@ class MetricAggregator:
         drows = d.touched_rows()
         snap["digests"] = {
             "rows": drows,
-            "meta": [d.meta[r] for r in drows],
+            "names": d.name_col[drows],
+            "tags": d.tags_col[drows],
+            "kinds": d.kind_col[drows],
+            "scopes": d.scope_col[drows].copy(),
             # the interval's staged weighted points (consumed); the flush
             # program evaluates them in one dense pass outside the lock
             "staged": d.take_staged(),
@@ -636,19 +645,18 @@ class MetricAggregator:
     # -- emitters ----------------------------------------------------------
 
     @staticmethod
-    def _scalar_family(res, meta, vals, is_local, now, mtype, fwd):
+    def _scalar_family(res, part, vals, is_local, now, mtype, fwd):
         """Shared counter/gauge emission: forward global-only rows when
-        local, columnar-emit the rest as one segment."""
-        n = len(meta)
-        bases = [m.key.name for m in meta]
-        tags = [m.tags for m in meta]
+        local, columnar-emit the rest as one segment.  Names/tags/scopes
+        come from the arena's columnar metadata (no per-row object
+        walks)."""
+        bases = part["names"].tolist()
+        tags = part["tags"].tolist()
         if is_local:
-            glob = np.fromiter(
-                (m.scope == MetricScope.GLOBAL_ONLY for m in meta),
-                bool, n)
+            glob = part["scopes"] == int(MetricScope.GLOBAL_ONLY)
             if glob.any():
                 for i in np.nonzero(glob)[0].tolist():
-                    res.forward.append(fwd(meta[i], vals[i]))
+                    res.forward.append(fwd(bases[i], tags[i], vals[i]))
                 sel = np.nonzero(~glob)[0]
                 res.metrics.add_segment(sm.MetricSegment(
                     bases, tags, "", vals[sel], mtype, now, sel=sel))
@@ -667,9 +675,9 @@ class MetricAggregator:
             # device psum'd hi/lo planes -> exact totals (< 2^48)
             vals = host["c_hi"] * serving.COUNTER_SPLIT + host["c_lo"]
         self._scalar_family(
-            res, part["meta"], vals, is_local, now, sm.COUNTER,
-            lambda m, v: sm.ForwardMetric(
-                name=m.key.name, tags=m.tags, kind=sm.TYPE_COUNTER,
+            res, part, vals, is_local, now, sm.COUNTER,
+            lambda name, tags, v: sm.ForwardMetric(
+                name=name, tags=tags, kind=sm.TYPE_COUNTER,
                 scope=MetricScope.GLOBAL_ONLY, counter_value=int(v)))
 
     def _emit_gauges(self, res, snap, is_local, now):
@@ -677,18 +685,18 @@ class MetricAggregator:
         if len(part["rows"]) == 0:
             return
         self._scalar_family(
-            res, part["meta"], part["values"], is_local, now, sm.GAUGE,
-            lambda m, v: sm.ForwardMetric(
-                name=m.key.name, tags=m.tags, kind=sm.TYPE_GAUGE,
+            res, part, part["values"], is_local, now, sm.GAUGE,
+            lambda name, tags, v: sm.ForwardMetric(
+                name=name, tags=tags, kind=sm.TYPE_GAUGE,
                 scope=MetricScope.GLOBAL_ONLY, gauge_value=float(v)))
 
     def _emit_status(self, res, snap, now):
         part = snap["status"]
-        for row, meta, val in zip(part["rows"], part["meta"],
-                                  part["values"]):
+        for row, name, tags, val in zip(part["rows"], part["names"],
+                                        part["tags"], part["values"]):
             res.metrics.append(sm.InterMetric(
-                name=meta.key.name, timestamp=now, value=float(val),
-                tags=meta.tags, type=sm.STATUS,
+                name=name, timestamp=now, value=float(val),
+                tags=tags, type=sm.STATUS,
                 message=part["messages"][int(row)],
                 hostname=part["hostnames"][int(row)]))
 
@@ -703,13 +711,10 @@ class MetricAggregator:
             # registers; the emitted estimate is max(primary, legacy)
             ests = np.maximum(np.asarray(ests, np.float64),
                               part["legacy_ests"])
-        meta = part["meta"]
-        n = len(meta)
-        bases = [m.key.name for m in meta]
-        tags = [m.tags for m in meta]
+        bases = part["names"].tolist()
+        tags = part["tags"].tolist()
         if is_local:
-            mixed = np.fromiter(
-                (m.scope == MetricScope.MIXED for m in meta), bool, n)
+            mixed = part["scopes"] == int(MetricScope.MIXED)
             if mixed.any():
                 # merged registers for forwarding: host snapshot copies
                 # (mesh-less) or the packed device readback (meshed) —
@@ -718,9 +723,8 @@ class MetricAggregator:
                 if regs is None:
                     regs = host["set_regs"]
                 for i in np.nonzero(mixed)[0].tolist():
-                    m = meta[i]
                     res.forward.append(sm.ForwardMetric(
-                        name=m.key.name, tags=m.tags,
+                        name=bases[i], tags=tags[i],
                         kind=sm.TYPE_SET, scope=MetricScope.MIXED,
                         hll=hll_mod.marshal(regs[i])))
                 sel = np.nonzero(~mixed)[0]
@@ -735,8 +739,7 @@ class MetricAggregator:
         rows = part["rows"]
         if len(rows) == 0:
             return
-        meta = part["meta"]
-        n = len(meta)
+        n = len(rows)
         qs = host["qs"]
         counts = host["counts"]
         sums = host["sums"]
@@ -749,13 +752,11 @@ class MetricAggregator:
         d_max = np.asarray(part["d_max"], np.float64)
         d_rsum = np.asarray(part["d_rsum"], np.float64)
 
-        bases = [m.key.name for m in meta]
-        tags = [m.tags for m in meta]
-        use_global = np.fromiter(
-            (m.scope == MetricScope.GLOBAL_ONLY for m in meta), bool, n)
+        bases = part["names"].tolist()
+        tags = part["tags"].tolist()
+        use_global = part["scopes"] == int(MetricScope.GLOBAL_ONLY)
         if is_local:
-            forwarded = np.fromiter(
-                (m.scope != MetricScope.LOCAL_ONLY for m in meta), bool, n)
+            forwarded = part["scopes"] != int(MetricScope.LOCAL_ONLY)
         else:
             forwarded = np.zeros(n, bool)
 
@@ -791,13 +792,14 @@ class MetricAggregator:
             sel_weight = (w_parts[0] if len(w_parts) == 1
                           else np.concatenate(w_parts))
             fwd = res.forward
+            kinds = part["kinds"]
+            scopes = part["scopes"]
             for j, i in enumerate(fidx.tolist()):
-                m = meta[i]
                 w = sel_weight[j]
                 occ = w > 0
                 fwd.append(sm.ForwardMetric(
-                    name=m.key.name, tags=m.tags, kind=m.key.type,
-                    scope=m.scope,
+                    name=bases[i], tags=tags[i], kind=kinds[i],
+                    scope=MetricScope(int(scopes[i])),
                     digest_means=sel_mean[j][occ].tolist(),
                     digest_weights=w[occ].tolist(),
                     digest_min=float(d_min[i]), digest_max=float(d_max[i]),
