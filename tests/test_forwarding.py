@@ -200,6 +200,71 @@ def test_forward_client_v2_fallback_on_unimplemented():
         server.stop(0)
 
 
+def test_forward_client_mixed_lb_later_chunk_unimplemented():
+    """A mixed-version load balancer can route the first V1 chunk to one
+    of our globals and a later chunk to a reference backend
+    (UNIMPLEMENTED).  The failed chunks — and only those — must be
+    re-sent over V2 in the same flush, and the client must stop using V1
+    afterwards (ADVICE r4, forward/client.py)."""
+    from concurrent import futures as cf
+
+    from veneur_tpu.forward import client as client_mod
+
+    import threading
+
+    v1_batches = []
+    v2_names = []
+    v1_calls = [0]
+    v1_lock = threading.Lock()   # handlers run on concurrent threads
+
+    def v1(request, context):
+        with v1_lock:
+            v1_calls[0] += 1
+            mine = v1_calls[0]
+        if mine > 1:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "reference backend: no V1")
+        v1_batches.append([m.name for m in request.metrics])
+        return empty_pb2.Empty()
+
+    def v2(request_iterator, context):
+        for pb in request_iterator:
+            v2_names.append(pb.name)
+        return empty_pb2.Empty()
+
+    handlers = grpc.method_handlers_generic_handler(
+        "forwardrpc.Forward", {
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                v1, request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString),
+            "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+                v2, request_deserializer=metric_pb2.Metric.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+    server = grpc.server(cf.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handlers,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        n = client_mod.BATCH_MAX * 2 + 10   # 3 chunks
+        client = ForwardClient(f"127.0.0.1:{port}")
+        fms = [sm.ForwardMetric(name=f"f{i}", tags=[], kind="counter",
+                                scope=MetricScope.GLOBAL_ONLY,
+                                counter_value=1) for i in range(n)]
+        client.send(fms)
+        # chunk 0 landed over V1; chunks 1-2 were re-sent over V2, each
+        # metric delivered exactly once
+        assert len(v1_batches) == 1
+        delivered = sorted(v1_batches[0] + v2_names)
+        assert delivered == sorted(f"f{i}" for i in range(n))
+        # the mixed path is now avoided entirely
+        assert client._use_v1 is False
+        client.send(fms[:5])
+        assert v1_calls[0] == 3   # the two aborted probes, nothing new
+        client.close()
+    finally:
+        server.stop(0)
+
+
 def test_import_bad_metric_does_not_kill_stream():
     """A nil-valued metric mid-stream is logged and skipped; the rest of
     the stream is still imported (worker.go:451-456 error handling)."""

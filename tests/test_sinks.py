@@ -499,10 +499,10 @@ def test_xray_segments_over_udp():
 
 
 def test_xray_segment_classification_and_http_block():
-    """Segment-document fidelity (`xray.go:180-256` + the X-Ray segment
-    spec): fault for 5xx, throttle (and error) for 429, error for 4xx,
-    the http sub-document from span tags, name cleaning, indicator
-    suffix."""
+    """Segment-document fidelity (`xray.go:180-256`): error mirrors
+    span.error exactly like the reference (`xray.go:254`), fault/throttle
+    derive purely from http status (5xx / 429), the http sub-document
+    comes from span tags, plus name cleaning and the indicator suffix."""
     from veneur_tpu.sinks.xray import segment
 
     def seg_for(status=None, error=False, tags=None, **kw):
@@ -523,15 +523,22 @@ def test_xray_segment_classification_and_http_block():
     assert "xray_client_ip" not in s["metadata"]
 
     s = seg_for(429)
-    assert s["throttle"] and s["error"] and not s["fault"]
+    assert s["throttle"] and not s["error"] and not s["fault"]
+    # 4xx alone does not set error: the reference's flag mirrors
+    # span.error and the emitter decides what counts as an error
     s = seg_for(404)
+    assert not s["error"] and not s["fault"] and not s["throttle"]
+    s = seg_for(404, error=True)
     assert s["error"] and not s["fault"] and not s["throttle"]
     s = seg_for(200)
     assert not s["error"] and not s["fault"] and not s["throttle"]
-    # a span-level error with no status classifies as a fault and keeps
-    # the reference's error flag (`xray.go:254`)
+    # a span-level error with no status sets ONLY error — fault stays a
+    # server-side (5xx) category, the flags are independent
     s = seg_for(error=True)
-    assert s["fault"] and s["error"]
+    assert s["error"] and not s["fault"] and not s["throttle"]
+    # and the two can coexist when both conditions hold
+    s = seg_for(500, error=True)
+    assert s["error"] and s["fault"] and not s["throttle"]
     # default url is service:name; malformed statuses are dropped
     s = seg_for(tags={"http.status_code": "banana"})
     assert "response" not in s["http"]

@@ -174,8 +174,9 @@ class Config:
     prewarm_depths: list[int] = field(default_factory=lambda: [4, 32])
     # global-tier flushes >= chunks*8192 dense rows split into this many
     # row chunks so chunk i+1's host->device upload overlaps chunk i's
-    # evaluation (1 disables; non-power-of-two values round down to one,
-    # since only pow2 chunk counts tile the pow2-padded row space)
+    # evaluation (1 disables; non-power-of-two values round down to the
+    # nearest power of two, since only pow2 chunk counts tile the
+    # pow2-padded row space)
     flush_upload_chunks: int = 2
     debug: bool = False
     enable_profiling: bool = False

@@ -152,6 +152,7 @@ class MetricAggregator:
         self._upload_chunks = 1 << max(0, int(
             flush_upload_chunks).bit_length() - 1)
         self._compiled_shapes: set = set()
+        self._compiling_shapes: set = set()   # claimed by an active guard
         self._compile_lock = threading.Lock()
         self._compiles_active = 0
         self.compile_events = 0
@@ -332,19 +333,28 @@ class MetricAggregator:
     class _CompileGuard:
         """Marks a flush-program invocation that will trace+compile a
         new (keys, depth) bucket, so the watchdog and self-metrics can
-        tell a compile from a hang.  compile_in_progress is
-        counter-backed under a lock: concurrent guards (prewarm thread +
-        flush thread) never clear each other's flag, and a shape only
-        registers as compiled when its guard exits WITHOUT an exception
-        — a failed first compile retries with full watchdog cover."""
+        tell a compile from a hang.  Two independent roles, both under
+        _compile_lock: COVER (compile_in_progress, counter-backed) is
+        taken by EVERY guard over a not-yet-compiled shape — concurrent
+        guards never clear each other's flag, and a loser thread that
+        ends up re-doing a failed winner's compile still has watchdog
+        cover; COUNT (compile_events/seconds) is taken only by the one
+        guard that claims the shape first, so prewarm + flush racing on
+        the same bucket count one compile, not two.  A shape registers
+        as compiled only when a covering guard exits without an
+        exception — a failed first compile retries with full cover."""
 
         def __init__(self, agg: "MetricAggregator", shape) -> None:
             self.agg, self.shape = agg, shape
             with agg._compile_lock:
-                self.new = shape not in agg._compiled_shapes
+                self.covering = shape not in agg._compiled_shapes
+                self.counted = (self.covering
+                                and shape not in agg._compiling_shapes)
+                if self.counted:
+                    agg._compiling_shapes.add(shape)
 
         def __enter__(self):
-            if self.new:
+            if self.covering:
                 with self.agg._compile_lock:
                     self.agg._compiles_active += 1
                     self.agg.compile_in_progress.set()
@@ -352,11 +362,13 @@ class MetricAggregator:
             return self
 
         def __exit__(self, exc_type, *exc):
-            if self.new:
+            if self.covering:
                 with self.agg._compile_lock:
-                    self.agg.compile_events += 1
-                    self.agg.compile_seconds_total += (
-                        time.perf_counter() - self._t0)
+                    if self.counted:
+                        self.agg.compile_events += 1
+                        self.agg.compile_seconds_total += (
+                            time.perf_counter() - self._t0)
+                        self.agg._compiling_shapes.discard(self.shape)
                     if exc_type is None:
                         self.agg._compiled_shapes.add(self.shape)
                     self.agg._compiles_active -= 1

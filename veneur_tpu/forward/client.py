@@ -82,7 +82,10 @@ class ForwardClient:
         if self._use_v1 is not False:
             try:
                 self._send_v1_batches(pbs)
-                self._use_v1 = True
+                # a later-chunk UNIMPLEMENTED inside the batch sender
+                # flips _use_v1 off; don't override that verdict
+                if self._use_v1 is not False:
+                    self._use_v1 = True
                 return
             except _V1Unsupported:
                 # the FIRST batch (sent alone, nothing imported) got
@@ -92,6 +95,12 @@ class ForwardClient:
                 logger.info("global %s has no V1 batch import; "
                             "using V2 streams", self.address)
                 self._use_v1 = False
+        self._send_v2_fanout(pbs)
+
+    def _send_v2_fanout(self, pbs: list) -> None:
+        """V2 streams, fanned out in parallel for big payloads — one
+        python-grpc client stream tops out around ~20k msgs/s, so large
+        flushes split round-robin across max_streams."""
         n_streams = min(self.max_streams,
                         max(1, len(pbs) // STREAM_CHUNK))
         if n_streams == 1:
@@ -116,9 +125,10 @@ class ForwardClient:
         flushes.  The first chunk is sent ALONE: if it answers
         UNIMPLEMENTED nothing has been imported yet, so the V2 fallback
         never double-sends.  UNIMPLEMENTED on a LATER chunk (a mixed-
-        version load balancer) is a plain forward error for this
-        interval — falling back there would duplicate the first
-        chunks."""
+        version load balancer routing chunks to a reference backend)
+        re-sends exactly those chunks over V2 — chunk boundaries are
+        known, so nothing double-sends — and flips _use_v1 off so the
+        next flush avoids the mixed path entirely."""
         chunks = [pbs[i:i + BATCH_MAX]
                   for i in range(0, len(pbs), BATCH_MAX)]
         try:
@@ -130,15 +140,39 @@ class ForwardClient:
             raise
         if len(chunks) == 1:
             return
-        futs = [self._pool.submit(
+        futs = [(c, self._pool.submit(
             self._v1, forward_pb2.MetricList(metrics=c),
-            timeout=self.timeout_s) for c in chunks[1:]]
+            timeout=self.timeout_s)) for c in chunks[1:]]
         errs = []
-        for f in futs:
+        v2_retry: list = []
+        n_failed_chunks = 0
+        for c, f in futs:
             try:
                 f.result()
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    v2_retry.extend(c)
+                    n_failed_chunks += 1
+                else:
+                    errs.append(e)
             except Exception as e:       # noqa: BLE001 - re-raised below
                 errs.append(e)
+        if v2_retry:
+            logger.info(
+                "global %s answered UNIMPLEMENTED on %d later V1 "
+                "chunk(s); re-sending those over V2 and disabling V1",
+                self.address, n_failed_chunks)
+            self._use_v1 = False
+            try:
+                self._send_v2_fanout(v2_retry)
+            except Exception as e:       # noqa: BLE001 - merged below
+                # surface the V1 errors too before this propagates: the
+                # operator needs both to diagnose a mixed-backend flush
+                for prior in errs:
+                    logger.warning(
+                        "V1 chunk to %s also failed (masked by V2 "
+                        "retry error): %s", self.address, prior)
+                raise e
         if errs:
             raise errs[0]
 

@@ -55,6 +55,7 @@ class Destination:
         self.sent = 0
         self.dropped = 0
         self._sent_lock = threading.Lock()
+        self._swept: list = []   # items reclaimed by close-time drains
         # metric-count buffer bound (send_buffer_size metrics total,
         # whatever the queue-item granularity)
         self._buf_cap = max(1, send_buffer_size)
@@ -251,6 +252,13 @@ class Destination:
             self.on_closed(self)
 
     def _drain_dropped(self) -> None:
+        """Sweep undelivered queue items into the dropped count.
+        Swept items are recorded on self._swept (append-only, bounded by
+        the buffer cap since sweeps only happen at close) so a producer
+        racing close() can tell by identity whether its just-enqueued
+        item was reclaimed — even when the sweep ran on a sender
+        thread's _mark_closed rather than the producer's own post-put
+        drain."""
         for qq in self.queues:
             saw_close = False
             while True:
@@ -265,6 +273,7 @@ class Destination:
                 self._release(n)
                 with self._sent_lock:
                     self.dropped += n
+                    self._swept.append(item)
             if saw_close:
                 # a sender may still be mid-RPC and come back for its
                 # sentinel; consuming it would strand that thread in
@@ -276,7 +285,24 @@ class Destination:
     def send(self, metric: metric_pb2.Metric,
              block_poll_s: float = 0.05) -> str:
         """Backpressured enqueue with closed-destination escape
-        (handlers.go:134-163).  Returns 'ok'|'dropped'."""
+        (handlers.go:134-163).  Returns 'ok'|'dropped'.
+
+        Stats contract: a closing/closed destination refuses new work
+        upfront, and the swept-item check below catches items reclaimed
+        by a concurrent abrupt close, so 'ok' vs 'dropped' agrees with
+        Destination.dropped in all interleavings except one unavoidable
+        put-ordering sliver: the close beginning only AFTER our
+        _closing/closed reads, then sweeping the item we just reported
+        'ok'.  Closing that needs a per-item handshake; a close is a
+        one-off event, so the discrepancy is bounded by the handful of
+        sends in flight at that instant."""
+        if self._closing.is_set() or self.closed.is_set():
+            # graceful close() drains sender backlogs for seconds; new
+            # items enqueued behind the sentinels would only be swept at
+            # the end — refuse them now so the accounting agrees
+            with self._sent_lock:
+                self.dropped += 1
+            return "dropped"
         if not self._reserve(1, block_poll_s):
             with self._sent_lock:
                 self.dropped += 1
@@ -284,9 +310,15 @@ class Destination:
         self._queue_for(metric.name).put(metric)
         if self.closed.is_set():
             # the destination died between reserve and put: the senders
-            # are gone, so sweep whatever remains (possibly our item)
-            # into the dropped count rather than stranding it
+            # are gone, so sweep whatever remains into the dropped
+            # count — and if OUR item was swept (by this drain or by a
+            # concurrent _mark_closed sweep on a sender thread), report
+            # it dropped so the caller's routed/dropped accounting stays
+            # consistent (the sweep already counted it in self.dropped)
             self._drain_dropped()
+            with self._sent_lock:
+                if any(s is metric for s in self._swept):
+                    return "dropped"
         return "ok"
 
     def send_many(self, metrics: list,
@@ -296,6 +328,11 @@ class Destination:
         DROPPED (0 = all buffered)."""
         if not metrics:
             return 0
+        if self._closing.is_set() or self.closed.is_set():
+            # see send(): refuse new work once a close has begun
+            with self._sent_lock:
+                self.dropped += len(metrics)
+            return len(metrics)
         if not self.batch_mode:
             return sum(1 for m in metrics
                        if self.send(m, block_poll_s) == "dropped")
@@ -306,6 +343,7 @@ class Destination:
             buckets.setdefault(hash(m.name) % self.n_streams,
                                []).append(m)
         n_dropped = 0
+        put_groups: list = []
         for qi, group in buckets.items():
             if not self._reserve(len(group), block_poll_s):
                 with self._sent_lock:
@@ -313,8 +351,16 @@ class Destination:
                 n_dropped += len(group)
                 continue
             self.queues[qi].put(group)
+            put_groups.append(group)
         if self.closed.is_set():
+            # report any of OUR groups the close-sweep reclaimed — by
+            # this drain or a sender thread's — their drops are already
+            # in self.dropped via _drain_dropped
             self._drain_dropped()
+            with self._sent_lock:
+                for g in put_groups:
+                    if any(s is g for s in self._swept):
+                        n_dropped += len(g)
         return n_dropped
 
     def close(self, drain_timeout_s: float = 5.0) -> None:

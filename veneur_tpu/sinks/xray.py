@@ -51,10 +51,10 @@ def xray_trace_id(span) -> str:
 
 def segment(span, annotation_tags: set[str]) -> dict:
     """SSF span -> X-Ray segment document (`xray.go:180-256`) with the
-    http sub-document and error/fault/throttle classification from the
-    segment-document spec: fault for 5xx (or a span-level error with no
-    contradicting status), error for 4xx, throttle additionally for
-    429."""
+    http sub-document.  Classification matches the reference: `error`
+    mirrors span.error exactly (`xray.go:254`); `fault` (5xx) and
+    `throttle` (429) derive purely from the http status tag, so the
+    three flags are independent."""
     annotations = {}
     metadata = {}
     http_req = {
@@ -91,11 +91,11 @@ def segment(span, annotation_tags: set[str]) -> dict:
     if getattr(span, "indicator", False):
         name += "-indicator"
 
-    # segment-document classification: error = client error (4XX),
-    # throttle = 429, fault = server error (5XX); a span flagged error
-    # with no (or a non-4xx) status code counts as a fault
-    is_4xx = 400 <= status <= 499
-    is_5xx = 500 <= status <= 599
+    # segment-document classification. Reference parity (xray.go:254):
+    # error mirrors span.error exactly; fault/throttle are derived purely
+    # from the http status (5XX -> fault, 429 -> throttle) so the three
+    # flags stay independent and a no-status errored span never claims
+    # to be a server fault.
     seg = {
         "id": format(span.id & (2**64 - 1), "016x"),
         "trace_id": xray_trace_id(span),
@@ -103,8 +103,8 @@ def segment(span, annotation_tags: set[str]) -> dict:
         "start_time": span.start_timestamp / 1e9,
         "end_time": span.end_timestamp / 1e9,
         "namespace": "remote",
-        "error": is_4xx or (bool(span.error) and not is_5xx),
-        "fault": is_5xx or (bool(span.error) and not is_4xx),
+        "error": bool(span.error),
+        "fault": 500 <= status <= 599,
         "throttle": status == 429,
         "annotations": annotations,
         "metadata": metadata,
