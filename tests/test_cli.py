@@ -333,3 +333,65 @@ rt_count 30
     by3 = {(n, tuple(t)): (v, mt) for n, v, mt, t in third}
     assert by3[("newcomer", ("team:infra",))] == (7, "c")
     assert by3[("reqs", ("stage:prod", "team:infra"))] == (0.0, "c")
+
+
+def test_emit_grpc_mode_statsd_and_ssf():
+    """-grpc routes the same payloads over the server's gRPC ingest edge
+    (cmd/veneur-emit/main.go:240-258 dogstatsd packets, 318-341 SSF
+    spans) instead of UDP."""
+    import time
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server, _SpanSinkWorker
+    from veneur_tpu.sinks import simple as simple_sinks
+    from veneur_tpu.sinks.simple import ChannelSpanSink
+
+    sink = simple_sinks.ChannelMetricSink()
+    span_sink = ChannelSpanSink()
+    srv = Server(config_mod.Config(
+        grpc_listen_addresses=["tcp://127.0.0.1:0"], interval=0.05,
+        percentiles=[0.5], hostname="h"), extra_metric_sinks=[sink])
+    srv.span_sinks.append(span_sink)
+    srv.span_workers.append(
+        _SpanSinkWorker(span_sink, 100, 1, srv._shutdown))
+    srv.start()
+    try:
+        port = srv.grpc_ingest_listeners[0].port
+
+        # statsd counter over DogstatsdGRPC/SendPacket
+        rc = cli_emit.main(["-hostport", f"127.0.0.1:{port}",
+                            "-name", "grpc.emit", "-count", "7",
+                            "-tag", "a:b", "-grpc"])
+        assert rc == 0
+        deadline = time.time() + 5
+        got = []
+        while time.time() < deadline:
+            srv._drain_native()
+            srv.flush()
+            while not sink.queue.empty():
+                got.extend(sink.queue.get())
+            if any(m.name == "grpc.emit" for m in got):
+                break
+            time.sleep(0.05)
+        by = {m.name: m for m in got}
+        assert by["grpc.emit"].value == 7.0
+        assert by["grpc.emit"].tags == ["a:b"]
+
+        # SSF span over SSFGRPC/SendSpan
+        rc = cli_emit.main(["-hostport", f"127.0.0.1:{port}",
+                            "-name", "op.grpc", "-gauge", "1.5",
+                            "-ssf", "-grpc"])
+        assert rc == 0
+        deadline = time.time() + 5
+        span = None
+        while time.time() < deadline and span is None:
+            try:
+                s = span_sink.queue.get(timeout=0.2)
+            except Exception:
+                continue
+            if s.name == "op.grpc":   # skip flush self-trace spans
+                span = s
+        assert span is not None and span.service == "veneur-emit"
+        assert span.metrics[0].value == 1.5
+    finally:
+        srv.shutdown()

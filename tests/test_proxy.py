@@ -279,3 +279,56 @@ def test_destination_oversized_group_not_starved():
         assert d.sent == 500 and d.dropped == 0
     finally:
         server.stop(0)
+
+
+def test_proxy_http_introspection_surface():
+    """The proxy serves /version, /builddate, /config/{json,yaml}
+    (redacted, gated) and /debug/{vars,threads} (gated) alongside the
+    healthcheck (cmd/veneur-proxy/main.go:84-102, proxy.go:190-306)."""
+    import yaml as yaml_mod
+
+    from veneur_tpu import __version__
+
+    proxy = Proxy(ProxyConfig(static_destinations=[],
+                              tls_key="sekrit-path",
+                              http_enable_config=True,
+                              http_enable_profiling=True))
+    proxy.start()
+    try:
+        base = f"http://127.0.0.1:{proxy.http_port}"
+        assert urllib.request.urlopen(
+            base + "/version").read().decode() == __version__
+        assert urllib.request.urlopen(base + "/builddate").read()
+
+        cfg_json = json.loads(urllib.request.urlopen(
+            base + "/config/json").read())
+        assert cfg_json["tls_key"] == "REDACTED"
+        assert cfg_json["http_enable_config"] is True
+        cfg_yaml = yaml_mod.safe_load(urllib.request.urlopen(
+            base + "/config/yaml").read())
+        assert cfg_yaml["tls_key"] == "REDACTED"
+        assert cfg_yaml["forward_service"] == cfg_json["forward_service"]
+
+        dvars = json.loads(urllib.request.urlopen(
+            base + "/debug/vars").read())
+        assert {"received", "routed", "dropped",
+                "destinations", "threads"} <= set(dvars)
+        threads = urllib.request.urlopen(
+            base + "/debug/threads").read().decode()
+        assert "--- thread" in threads
+    finally:
+        proxy.stop()
+
+
+def test_proxy_http_gated_endpoints_off_by_default():
+    proxy = Proxy(ProxyConfig(static_destinations=[]))
+    proxy.start()
+    try:
+        base = f"http://127.0.0.1:{proxy.http_port}"
+        for path in ("/config/json", "/config/yaml",
+                     "/debug/vars", "/debug/threads"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + path)
+            assert exc.value.code == 404
+    finally:
+        proxy.stop()

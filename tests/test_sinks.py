@@ -962,3 +962,105 @@ def test_splunk_concurrent_submitters():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+# ------------------------------------------------- HTTP phase tracing
+
+def test_parallel_poster_phase_tracing():
+    """Every poster session records connect/TTFB/total per POST
+    (`http/http.go:23-100` httptrace analog): the first request opens a
+    connection (connect_ms present), keep-alive reuse omits it."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"   # keep-alive so reuse happens
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    poster = sink_mod.ParallelPoster(max_workers=2)
+    try:
+        url = f"http://127.0.0.1:{port}/x"
+
+        def post(item, session):
+            return session.post(url, data=item).status_code
+
+        assert poster.map(post, [b"one"]) == [200]
+        assert poster.map(post, [b"two"]) == [200]
+        recs = poster.drain_phase_stats()
+        assert len(recs) == 2
+        first, second = recs
+        assert not first["reused"] and first["connect_ms"] > 0
+        assert second["reused"] and second["connect_ms"] is None
+        for r in recs:
+            assert r["total_ms"] >= r["ttfb_ms"] > 0
+        # drained: the accumulator is empty until the next POST
+        assert poster.drain_phase_stats() == []
+    finally:
+        poster.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_sink_http_phase_self_metrics_emitted():
+    """The server emits sink.http.* self-metrics from poster-backed
+    sinks after each flush."""
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+
+    class _CapturingStatsd:
+        def __init__(self):
+            self.timings = []
+            self.counts = []
+
+        def timing(self, name, value, tags=None):
+            self.timings.append((name, value, tuple(tags or ())))
+
+        def count(self, name, value, tags=None):
+            self.counts.append((name, value, tuple(tags or ())))
+
+        def gauge(self, name, value, tags=None):
+            pass
+
+    class _PosterSink(sink_mod.BaseMetricSink):
+        KIND = "fakeposter"
+
+        def __init__(self):
+            super().__init__("fakeposter")
+            self._poster = sink_mod.ParallelPoster(max_workers=1)
+            # seed one record as if a POST happened
+            self._poster._record_phases(
+                {"total_ms": 5.0, "ttfb_ms": 3.0,
+                 "connect_ms": 1.0, "reused": False})
+
+        def flush(self, metrics):
+            return sink_mod.MetricFlushResult(flushed=0)
+
+    srv = Server(config_mod.Config(interval=0.05, hostname="h"))
+    sink = _PosterSink()
+    stats = _CapturingStatsd()
+    try:
+        srv._flush_sink(sink_mod.SinkSpec(kind="fakeposter"), sink,
+                        [], [], statsd=stats)
+        names = {n for n, _, _ in stats.timings}
+        assert {"sink.http.connect_ms", "sink.http.ttfb_ms",
+                "sink.http.total_ms"} <= names
+        conn_counts = [(n, v, t) for n, v, t in stats.counts
+                       if n == "sink.http.connections_used_total"]
+        assert conn_counts and conn_counts[0][1] == 1
+        assert any("state:new" in t for _, _, t in conn_counts)
+    finally:
+        sink._poster.close()
+        srv.shutdown()

@@ -4,6 +4,10 @@ Modes, mirroring `cmd/veneur-emit/main.go:169,383,546,594`:
   * statsd datagrams:  -hostport udp://host:port -count/-gauge/-timing
     plus -tag k:v pairs
   * SSF:               -ssf sends the metric as an SSF span-sample frame
+  * -grpc:             route the same payloads over the server's gRPC
+    ingest edge instead of UDP (main.go:240-258,318-341): statsd bytes
+    as dogstatsd.DogstatsdGRPC/SendPacket, SSF spans as
+    ssf.SSFGRPC/SendSpan
   * -command:          run a subprocess, time it, emit a span (SSF) or
     timing metric (statsd)
   * events / service checks: -event_* / -sc_* flags build the DogStatsD
@@ -33,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tag, repeatable (k:v)")
     p.add_argument("-ssf", action="store_true",
                    help="send over SSF instead of statsd")
+    p.add_argument("-grpc", action="store_true", dest="grpc",
+                   help="send over gRPC: statsd packets via "
+                        "dogstatsd SendPacket, SSF spans via SendSpan")
     p.add_argument("-command", help="run command, emit its timing")
     # events
     p.add_argument("-event_title")
@@ -80,8 +87,7 @@ def statsd_lines(args) -> list[bytes]:
     return lines
 
 
-def emit_ssf(args, dest: tuple[str, int],
-             duration_ns: int = 0, error: bool = False) -> None:
+def _build_ssf_span(args, duration_ns: int = 0, error: bool = False):
     from veneur_tpu import ssf as ssf_mod
     from veneur_tpu.trace import Span
     span = Span(args.name or (args.command and "veneur-emit.command")
@@ -98,10 +104,64 @@ def emit_ssf(args, dest: tuple[str, int],
     if duration_ns:
         pb.end_timestamp = pb.start_timestamp + duration_ns
     pb.error = error
+    return pb
+
+
+def emit_ssf(args, dest: tuple[str, int],
+             duration_ns: int = 0, error: bool = False) -> None:
+    pb = _build_ssf_span(args, duration_ns, error)
+    if args.grpc:
+        _grpc_send_span(args.hostport, pb)
+        return
     from veneur_tpu.util import netaddr
     sock = socket.socket(netaddr.family(dest[0]), socket.SOCK_DGRAM)
     sock.sendto(pb.SerializeToString(), dest)
     sock.close()
+
+
+# -- gRPC emission (main.go:240-258 dogstatsd packets, 318-341 SSF) -------
+
+class EmitError(Exception):
+    """Emission failure surfaced as a clean CLI error, not a traceback."""
+
+
+def _grpc_channel(hostport: str):
+    import grpc
+    addr = hostport.split("://", 1)[-1]
+    ch = grpc.insecure_channel(addr)
+    try:
+        grpc.channel_ready_future(ch).result(timeout=10)
+    except grpc.FutureTimeoutError:
+        ch.close()
+        raise EmitError(f"could not connect to gRPC server at {addr} "
+                        "within 10s") from None
+    return ch
+
+
+def _grpc_send_span(hostport: str, span_pb) -> None:
+    from veneur_tpu.protocol import ssf_grpc_pb2, ssf_pb2
+    ch = _grpc_channel(hostport)
+    try:
+        send = ch.unary_unary(
+            "/ssf.SSFGRPC/SendSpan",
+            request_serializer=ssf_pb2.SSFSpan.SerializeToString,
+            response_deserializer=ssf_grpc_pb2.Empty.FromString)
+        send(span_pb, timeout=10)
+    finally:
+        ch.close()
+
+
+def _grpc_send_packet(hostport: str, packet: bytes) -> None:
+    from veneur_tpu.protocol import dogstatsd_grpc_pb2 as dg
+    ch = _grpc_channel(hostport)
+    try:
+        send = ch.unary_unary(
+            "/dogstatsd.DogstatsdGRPC/SendPacket",
+            request_serializer=dg.DogstatsdPacket.SerializeToString,
+            response_deserializer=dg.Empty.FromString)
+        send(dg.DogstatsdPacket(packetBytes=packet), timeout=10)
+    finally:
+        ch.close()
 
 
 def _tag_dict(tags: list[str]) -> dict:
@@ -114,6 +174,19 @@ def _tag_dict(tags: list[str]) -> dict:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    try:
+        return _run(args)
+    except EmitError as e:
+        print(f"veneur-emit: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:       # noqa: BLE001 - CLI boundary
+        # a clean one-line error beats a traceback for an emitter that
+        # runs inside cron jobs and deploy scripts
+        print(f"veneur-emit: emission failed: {e}", file=sys.stderr)
+        return 1
+
+
+def _run(args) -> int:
     dest = _dest(args.hostport)
     rc = 0
     if args.command:
@@ -137,6 +210,9 @@ def main(argv=None) -> int:
         print("nothing to emit (need -count/-gauge/-timing/-set/"
               "-event_title/-sc_name)", file=sys.stderr)
         return 1
+    if args.grpc:
+        _grpc_send_packet(args.hostport, b"\n".join(lines))
+        return rc
     from veneur_tpu.util import netaddr
     sock = socket.socket(netaddr.family(dest[0]), socket.SOCK_DGRAM)
     sock.sendto(b"\n".join(lines), dest)

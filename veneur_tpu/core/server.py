@@ -1182,6 +1182,35 @@ class Server:
             statsd.timing("sink.metric_flush_total_duration_ms",
                           (time.perf_counter() - start) * 1e3,
                           tags=sink_tags)
+            self._emit_http_phases(sink, sink_tags, statsd)
+
+    def _emit_http_phases(self, sink, sink_tags, statsd) -> None:
+        """Per-POST HTTP phase self-metrics for poster-backed sinks —
+        the reference traces DNS/connect/TTFB on every sink POST
+        (`http/http.go:23-100`); here the poster's tracing adapter
+        records them and this emits `sink.http.{connect,ttfb,total}_ms`
+        + `sink.http.connections_used_total` by state."""
+        poster = getattr(sink, "_poster", None)
+        if poster is None or not hasattr(poster, "drain_phase_stats"):
+            return
+        new_conns = reused = 0
+        for rec in poster.drain_phase_stats():
+            if rec["reused"]:
+                reused += 1
+            else:
+                new_conns += 1
+                statsd.timing("sink.http.connect_ms",
+                              rec["connect_ms"], tags=sink_tags)
+            statsd.timing("sink.http.ttfb_ms", rec["ttfb_ms"],
+                          tags=sink_tags)
+            statsd.timing("sink.http.total_ms", rec["total_ms"],
+                          tags=sink_tags)
+        if new_conns:
+            statsd.count("sink.http.connections_used_total", new_conns,
+                         tags=sink_tags + ["state:new"])
+        if reused:
+            statsd.count("sink.http.connections_used_total", reused,
+                         tags=sink_tags + ["state:reused"])
 
     def _flush_span_sink(self, sink, statsd=None) -> None:
         """One span sink's flush with per-sink timing
@@ -1197,6 +1226,9 @@ class Server:
             statsd.timing("worker.span.flush_duration_ns",
                           (time.perf_counter() - start) * 1e9,
                           tags=[f"sink:{sink.name()}"])
+            self._emit_http_phases(
+                sink, [f"sink_name:{sink.name()}",
+                       f"sink_kind:{sink.kind()}"], statsd)
 
     # -- lifecycle ---------------------------------------------------------
 

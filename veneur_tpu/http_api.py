@@ -31,6 +31,38 @@ BUILD_DATE = "dev"
 VERSION = __version__
 
 
+# -- helpers shared with the proxy's HTTP surface -------------------------
+
+def reply(handler, code: int, body: bytes,
+          ctype: str = "text/plain") -> None:
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def config_json_body(cfg_dict: dict) -> bytes:
+    """util/config/config.go:65-77 shape: indented JSON."""
+    return json.dumps(cfg_dict, default=str, indent=2).encode()
+
+
+def config_yaml_body(cfg_dict: dict) -> bytes:
+    """util/config/config.go:78-96 shape: YAML via a JSON round-trip so
+    non-scalar config values serialize the same way in both dumps."""
+    return yaml.safe_dump(
+        json.loads(json.dumps(cfg_dict, default=str))).encode()
+
+
+def thread_dump() -> bytes:
+    """/debug/threads payload: a stack for every live thread."""
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ---")
+        out.extend(traceback.format_stack(frame))
+    return "\n".join(out).encode()
+
+
 def make_handler(server) -> type:
     cfg = server.config
 
@@ -40,11 +72,7 @@ def make_handler(server) -> type:
 
         def _reply(self, code: int, body: bytes,
                    ctype: str = "text/plain") -> None:
-            self.send_response(code)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            reply(self, code, body, ctype)
 
         def do_POST(self):
             if self.path == "/quitquitquit" and cfg.http_quit:
@@ -61,14 +89,13 @@ def make_handler(server) -> type:
             elif self.path == "/builddate":
                 self._reply(200, BUILD_DATE.encode())
             elif self.path == "/config/json" and cfg.http_config_endpoint:
-                body = json.dumps(config_mod.redacted_dict(cfg),
-                                  default=str, indent=2).encode()
-                self._reply(200, body, "application/json")
+                self._reply(200,
+                            config_json_body(config_mod.redacted_dict(cfg)),
+                            "application/json")
             elif self.path == "/config/yaml" and cfg.http_config_endpoint:
-                body = yaml.safe_dump(
-                    json.loads(json.dumps(config_mod.redacted_dict(cfg),
-                                          default=str))).encode()
-                self._reply(200, body, "application/x-yaml")
+                self._reply(200,
+                            config_yaml_body(config_mod.redacted_dict(cfg)),
+                            "application/x-yaml")
             elif self.path == "/debug/vars":
                 stats = {
                     "flush_count": server.flush_count,
@@ -101,12 +128,7 @@ def make_handler(server) -> type:
                 self._reply(200, json.dumps(out, indent=2).encode(),
                             "application/json")
             elif self.path == "/debug/threads":
-                frames = sys._current_frames()
-                out = []
-                for tid, frame in frames.items():
-                    out.append(f"--- thread {tid} ---")
-                    out.extend(traceback.format_stack(frame))
-                self._reply(200, "\n".join(out).encode())
+                self._reply(200, thread_dump())
             else:
                 self._reply(404, b"not found\n")
 

@@ -58,6 +58,12 @@ class ProxyConfig:
     tls_certificate: str = ""            # PEM file paths
     tls_key: str = ""
     tls_authority_certificate: str = ""
+    # operator introspection endpoints (cmd/veneur-proxy/main.go:84-102:
+    # /version + /builddate always; /config/{json,yaml} behind
+    # http.enable_config; the pprof suite behind http.enable_profiling —
+    # here the Python-flavored /debug/vars + /debug/threads instead)
+    http_enable_config: bool = False
+    http_enable_profiling: bool = False
 
 
 def proxy_config_from_dict(data: dict) -> ProxyConfig:
@@ -80,7 +86,28 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
         tls_certificate=data.get("tls_certificate", ""),
         tls_key=data.get("tls_key", ""),
         tls_authority_certificate=data.get(
-            "tls_authority_certificate", ""))
+            "tls_authority_certificate", ""),
+        http_enable_config=bool(data.get("http_enable_config", False)),
+        http_enable_profiling=bool(
+            data.get("http_enable_profiling", False)))
+
+
+def redacted_proxy_dict(cfg: ProxyConfig, redact: bool = True) -> dict:
+    """ProxyConfig dump with secrets redacted, mirroring the server's
+    config endpoint contract (util/config/config.go:65-96 +
+    util/string_secret.go:13-36)."""
+    from dataclasses import fields
+
+    out = {}
+    for f in fields(ProxyConfig):
+        v = getattr(cfg, f.name)
+        if redact and f.name == "tls_key" and v:
+            v = "REDACTED"
+        if isinstance(v, list) and v and not isinstance(
+                v[0], (str, int, float)):
+            v = [str(x) for x in v]
+        out[f.name] = v
+    return out
 
 
 class Proxy:
@@ -223,27 +250,56 @@ class Proxy:
             self.stats["routed"] += routed
             self.stats["dropped"] += dropped
 
-    # -- HTTP healthcheck (handlers.go:30-38) ------------------------------
+    # -- HTTP surface (handlers.go:30-38 healthcheck +
+    #    cmd/veneur-proxy/main.go:84-102 version/builddate/config/debug) --
 
     def _http_handler(self):
         proxy = self
+        cfg = self.cfg
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
 
             def do_GET(self):
+                import json as json_mod
+
+                from veneur_tpu import http_api
+
                 if self.path == "/healthcheck":
                     if proxy.destinations.size() > 0:
-                        body, code = b"ok\n", 200
+                        http_api.reply(self, 200, b"ok\n")
                     else:
-                        body, code = b"no destinations\n", 503
+                        http_api.reply(self, 503, b"no destinations\n")
+                elif self.path == "/version":
+                    http_api.reply(self, 200, http_api.VERSION.encode())
+                elif self.path == "/builddate":
+                    http_api.reply(self, 200, http_api.BUILD_DATE.encode())
+                elif (self.path == "/config/json"
+                        and cfg.http_enable_config):
+                    http_api.reply(
+                        self, 200,
+                        http_api.config_json_body(redacted_proxy_dict(cfg)),
+                        "application/json")
+                elif (self.path == "/config/yaml"
+                        and cfg.http_enable_config):
+                    http_api.reply(
+                        self, 200,
+                        http_api.config_yaml_body(redacted_proxy_dict(cfg)),
+                        "application/x-yaml")
+                elif (self.path == "/debug/vars"
+                        and cfg.http_enable_profiling):
+                    with proxy._stats_lock:
+                        stats = dict(proxy.stats)
+                    stats["destinations"] = proxy.destinations.size()
+                    stats["threads"] = threading.active_count()
+                    http_api.reply(self, 200, json_mod.dumps(
+                        stats, indent=2).encode(), "application/json")
+                elif (self.path == "/debug/threads"
+                        and cfg.http_enable_profiling):
+                    http_api.reply(self, 200, http_api.thread_dump())
                 else:
-                    body, code = b"not found\n", 404
-                self.send_response(code)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    http_api.reply(self, 404, b"not found\n")
 
         return Handler
 

@@ -64,6 +64,14 @@ class ParallelPoster:
     `requests.Session` (Session is not thread-safe), with a close() that
     shuts the pool and sessions so process exit is never delayed by a
     mid-retry worker.
+
+    Every session is mounted with a phase-tracing adapter — the analog
+    of the reference's `net/http/httptrace` client tracer
+    (`http/http.go:23-100`): per-POST connect (DNS+TCP+TLS, absent on a
+    reused connection), time-to-first-byte, and total wall time, plus
+    new/reused connection counts.  `drain_phase_stats()` hands the
+    accumulated records to whoever emits self-metrics (the server's
+    _flush_sink does, as `sink.http.*`).
     """
 
     def __init__(self, max_workers: int = 8,
@@ -80,6 +88,23 @@ class ParallelPoster:
         self._tls = threading.local()
         self._sessions: list = []
         self._sessions_lock = threading.Lock()
+        self._phase_lock = threading.Lock()
+        self._phase_records: list[dict] = []
+
+    def _record_phases(self, rec: dict) -> None:
+        with self._phase_lock:
+            # bounded: a sink that never drains (no statsd configured)
+            # must not leak; keep the most recent window
+            if len(self._phase_records) >= 4096:
+                del self._phase_records[:2048]
+            self._phase_records.append(rec)
+
+    def drain_phase_stats(self) -> list[dict]:
+        """All phase records since the last drain, each
+        {total_ms, ttfb_ms, connect_ms|None, reused: bool}."""
+        with self._phase_lock:
+            out, self._phase_records = self._phase_records, []
+        return out
 
     def session(self):
         """One long-lived session per calling thread; an injected test
@@ -91,6 +116,9 @@ class ParallelPoster:
         s = getattr(self._tls, "session", None)
         if s is None:
             s = requests.Session()
+            adapter = _phase_tracing_adapter(self)
+            s.mount("http://", adapter)
+            s.mount("https://", adapter)
             self._tls.session = s
             with self._sessions_lock:
                 self._sessions.append(s)
@@ -122,6 +150,90 @@ class ParallelPoster:
                 s.close()
             except Exception:
                 pass
+
+
+# lazy singletons for the phase-tracing transport: the timed pool/
+# connection/adapter classes are built once at first use (importing
+# urllib3/requests at module load would tax every registry consumer)
+_PHASE_TRACING = None
+
+
+def _phase_tracing_adapter(poster):
+    """requests transport adapter recording per-request phase timings —
+    the `httptrace.ClientTrace` analog (`http/http.go:47-100`):
+    connect_ms (DNS + TCP + TLS, via timed urllib3 connection classes)
+    is present only when this request opened a new connection; ttfb_ms
+    is send->response-headers; total_ms includes the body read.  Both
+    direct and HTTP(S)-proxy pools get the timed connection classes."""
+    global _PHASE_TRACING
+    if _PHASE_TRACING is None:
+        import threading
+        import time as time_mod
+
+        import urllib3
+        from requests.adapters import HTTPAdapter
+
+        tls = threading.local()
+
+        class _TimedHTTPConnection(urllib3.connection.HTTPConnection):
+            def connect(self):
+                t0 = time_mod.perf_counter()
+                super().connect()
+                tls.connect_ms = (time_mod.perf_counter() - t0) * 1e3
+
+        class _TimedHTTPSConnection(urllib3.connection.HTTPSConnection):
+            def connect(self):
+                t0 = time_mod.perf_counter()
+                super().connect()
+                tls.connect_ms = (time_mod.perf_counter() - t0) * 1e3
+
+        class _TimedHTTPPool(urllib3.HTTPConnectionPool):
+            ConnectionCls = _TimedHTTPConnection
+
+        class _TimedHTTPSPool(urllib3.HTTPSConnectionPool):
+            ConnectionCls = _TimedHTTPSConnection
+
+        pool_classes = {"http": _TimedHTTPPool, "https": _TimedHTTPSPool}
+
+        class _Adapter(HTTPAdapter):
+            def __init__(self, poster, **kw):
+                self._phase_poster = poster
+                super().__init__(**kw)
+
+            def init_poolmanager(self, *a, **kw):
+                super().init_poolmanager(*a, **kw)
+                self.poolmanager.pool_classes_by_scheme = pool_classes
+
+            def proxy_manager_for(self, proxy, **kw):
+                # pools are created lazily, so swapping the classes on
+                # the (possibly cached) manager covers proxied requests
+                pm = super().proxy_manager_for(proxy, **kw)
+                pm.pool_classes_by_scheme = pool_classes
+                return pm
+
+            def send(self, request, stream=False, **kw):
+                tls.connect_ms = None
+                t0 = time_mod.perf_counter()
+                # HTTPAdapter.send returns once response HEADERS are
+                # parsed (body reads later), so this wall time IS the
+                # time-to-first-byte; forcing .content afterwards makes
+                # total_ms cover the body too (skipped for stream=True,
+                # where the caller owns the read)
+                resp = super().send(request, stream=stream, **kw)
+                ttfb_ms = (time_mod.perf_counter() - t0) * 1e3
+                if not stream:
+                    _ = resp.content
+                connect_ms = getattr(tls, "connect_ms", None)
+                self._phase_poster._record_phases({
+                    "total_ms": (time_mod.perf_counter() - t0) * 1e3,
+                    "ttfb_ms": ttfb_ms,
+                    "connect_ms": connect_ms,
+                    "reused": connect_ms is None,
+                })
+                return resp
+
+        _PHASE_TRACING = _Adapter
+    return _PHASE_TRACING(poster)
 
 
 class BaseMetricSink:
