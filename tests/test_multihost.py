@@ -148,3 +148,83 @@ def test_two_process_dcn_flush(tmp_path):
     # both controllers converged on the same global union
     uts = {o.split("uts=")[1].strip() for _, o, _ in outs}
     assert len(uts) == 1, outs
+
+
+_DIVERGE_WORKER = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+pid = int(sys.argv[1])
+port = int(sys.argv[2])
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+
+cfg = config_mod.Config(
+    interval=10.0, percentiles=[0.5], hostname=f"dv{pid}",
+    distributed_coordinator=f"127.0.0.1:{port}",
+    distributed_num_processes=2, distributed_process_id=pid,
+    mesh_devices=8, mesh_replicas=2)
+srv = Server(cfg)
+agg = srv.aggregator
+
+# pid 1 registers the first two keys in SWAPPED order: same key set,
+# different key->row mapping — the silent-misalignment case the
+# checksum gather must catch
+order = [0, 1, 2, 3] if pid == 0 else [1, 0, 2, 3]
+with agg.lock:
+    for i in order:
+        row = agg.digests.row_for(
+            MetricKey(f"dv.lat{i}", sm.TYPE_HISTOGRAM, ""),
+            MetricScope.MIXED, [])
+        agg.digests.sample_batch(
+            np.full(8, row), np.arange(8.0), np.ones(8))
+
+try:
+    agg.flush(is_local=False, now=1234567)
+except RuntimeError as e:
+    msg = str(e)
+    assert "lockstep violation" in msg and "digest" in msg, msg
+    print(f"LOCKSTEP_VIOLATION_CAUGHT pid={pid}")
+else:
+    print(f"LOCKSTEP_MISSED pid={pid}")
+srv.shutdown()
+'''
+
+
+def test_two_process_key_order_divergence_fails_loudly(tmp_path):
+    """A key-registration-order divergence between controllers must be a
+    crisp per-family lockstep error, not silently merged rows (VERDICT
+    r4 item 6; `destinations.go:129-142` membership-agreement analog)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "dv_worker.py"
+    script.write_text(_DIVERGE_WORKER)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(pid), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=repo, env=env) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0 and "LOCKSTEP_VIOLATION_CAUGHT" in out, \
+            (rc, out, err[-3000:])

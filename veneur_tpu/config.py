@@ -237,6 +237,15 @@ class Config:
             self.read_buffer_size_bytes = 2 * 1024 * 1024
         if self.span_channel_capacity <= 0:
             self.span_channel_capacity = 100
+        if self.digest_float64 and self.mesh_devices:
+            # config-level rejection (not a deep aggregator error): the
+            # meshed flush program is f32-native — hi/lo counter planes,
+            # f32 staged digests — and device f64 is emulated; run f64
+            # digest evaluation on an unmeshed tier instead
+            raise ValueError(
+                "digest_float64 is unsupported with a device mesh "
+                "(mesh_devices > 0); f64 digest evaluation is "
+                "single-device only — drop one of the two options")
 
     @property
     def is_local(self) -> bool:
@@ -351,16 +360,23 @@ def _expand(text: str, environ: dict[str, str]) -> str:
     return re.sub(r"\$(?:\{(\w+)\}|(\w+))", repl, text)
 
 
-def redacted_dict(cfg: Config, redact: bool = True) -> dict:
-    """Config dump with secrets redacted (util/string_secret.go:13-36);
-    redact=False is the -print-secrets escape hatch."""
+def redacted_fields(cfg_obj, secret_fields: set, redact: bool = True) -> dict:
+    """Dataclass config dump with the named secret fields redacted
+    (util/string_secret.go:13-36); shared by the server and proxy config
+    endpoints so redaction semantics cannot drift between them."""
     out = {}
-    for f in fields(Config):
-        v = getattr(cfg, f.name)
-        if redact and f.name in ("sentry_dsn", "tls_key") and v:
+    for f in fields(type(cfg_obj)):
+        v = getattr(cfg_obj, f.name)
+        if redact and f.name in secret_fields and v:
             v = "REDACTED"
         if isinstance(v, list) and v and not isinstance(
                 v[0], (str, int, float)):
             v = [str(x) for x in v]
         out[f.name] = v
     return out
+
+
+def redacted_dict(cfg: Config, redact: bool = True) -> dict:
+    """Server config dump; redact=False is the -print-secrets escape
+    hatch."""
+    return redacted_fields(cfg, {"sentry_dsn", "tls_key"}, redact)

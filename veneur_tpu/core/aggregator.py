@@ -481,12 +481,55 @@ class MetricAggregator:
                 # program on the same global shapes and the same fetch
                 # sequence, whatever ITS families touched this interval —
                 # one tiny DCN gather of (touched counts, depth) decides
-                # for everyone
+                # for everyone.  The same gather carries each arena's
+                # key-dictionary fingerprint: a registration-order
+                # divergence between controllers would silently misalign
+                # rows (every process indexes the same global arrays), so
+                # it must fail loudly here instead
                 from jax.experimental import multihost_utils
                 local_depth = self.digests.staged_depth(dpart["staged"])
-                flags = multihost_utils.process_allgather(np.asarray(
-                    [nd, local_depth, len(crows), len(srows)], np.int64))
-                g_nd, g_depth, g_nc, g_ns = flags.max(axis=0).tolist()
+                fams = snap["key_fingerprints"]   # lock-coherent snapshot
+                names = ("digest", "counter", "gauge", "set", "status")
+                cks = np.asarray(
+                    [fams[n][0] for n in names]
+                    + [fams[n][1] for n in names],
+                    np.uint64).view(np.int64)
+                flags = multihost_utils.process_allgather(np.concatenate(
+                    [np.asarray([nd, local_depth, len(crows), len(srows)],
+                                np.int64), cks]))
+                g_nd, g_depth, g_nc, g_ns = \
+                    flags[:, :4].max(axis=0).tolist()
+                nf = len(names)
+                keyset_all = flags[:, 4:4 + nf]
+                keyrow_all = flags[:, 4 + nf:4 + 2 * nf]
+                # same key SET everywhere but different key->row
+                # assignment = silent row misalignment (a registration-
+                # order divergence).  Differing key sets pass: with O(1)
+                # gathered state per family, a shared-key row conflict
+                # cannot be distinguished from benign one-sided keys, so
+                # this is a best-effort tripwire — it catches the
+                # canonical ordering bug outright, and catches an
+                # asymmetric-registration row conflict as soon as GC (or
+                # registration) makes the key sets converge (at which
+                # point the dictionaries genuinely ARE misaligned for
+                # the shared keys).  The strict contract remains: shared
+                # keys must be registered in the same order everywhere
+                diverged = [
+                    name for i, name in enumerate(names)
+                    if (keyset_all[:, i] == keyset_all[0, i]).all()
+                    and not (keyrow_all[:, i] == keyrow_all[0, i]).all()]
+                if diverged:
+                    raise RuntimeError(
+                        "lockstep violation: controllers hold the same "
+                        f"keys with DIFFERENT row assignments for famil"
+                        f"{'ies' if len(diverged) > 1 else 'y'} "
+                        f"{', '.join(diverged)} (process "
+                        f"{jax.process_index()} of "
+                        f"{jax.process_count()}).  All controllers must "
+                        "register shared keys in the same order "
+                        "(parallel/multihost.py lockstep contract); "
+                        "flushing with misaligned rows would silently "
+                        "merge unrelated timeseries")
             else:
                 g_nd, g_depth = nd, 0
                 g_nc, g_ns = len(crows), len(srows)
@@ -644,6 +687,20 @@ class MetricAggregator:
             "d_min": d.d_min[drows].copy(),
             "d_max": d.d_max[drows].copy(),
             "d_rsum": d.d_rsum[drows].copy(),
+        }
+
+        # key-dictionary fingerprints for the multi-controller lockstep
+        # gather — snapshotted HERE, under the lock and before the GC in
+        # end_interval, so the flush gathers one coherent (keyset,
+        # key->row) pair per family (a lock-free read during _run_flush
+        # could tear against a concurrent registration and trip a
+        # spurious lockstep error)
+        snap["key_fingerprints"] = {
+            "digest": (d.keyset_checksum, d.key_checksum),
+            "counter": (c.keyset_checksum, c.key_checksum),
+            "gauge": (g.keyset_checksum, g.key_checksum),
+            "set": (s.keyset_checksum, s.key_checksum),
+            "status": (st.keyset_checksum, st.key_checksum),
         }
 
         for ar, rows in ((c, crows),

@@ -98,6 +98,32 @@ class _ArenaBase:
         self.idle = np.zeros(capacity, np.int32)
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self.lock = threading.Lock()
+        # incremental fingerprints of the key dictionary: XOR-folds of
+        # fnv1a per live mapping (XOR is its own inverse, so register/GC
+        # keep them O(1)).  keyset_checksum covers the keys alone;
+        # key_checksum additionally binds each key's row.  Multi-
+        # controller serving gathers both per flush (lockstep contract,
+        # parallel/multihost.py): identical key sets with different row
+        # assignments — the silent-misalignment case — fail loudly,
+        # while ring-style asymmetric registration (a key registered
+        # only on its owning controller, destinations.go:129-142's
+        # membership analog) differs in BOTH and stays legal
+        self.key_checksum = 0
+        self.keyset_checksum = 0
+
+    @staticmethod
+    def _fnv1a(s: str) -> int:
+        h = 0xCBF29CE484222325  # FNV-1a 64-bit offset basis
+        for b in s.encode():
+            h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+        return h
+
+    def _fold_key_fingerprints(self, key: MetricKey, scope: MetricScope,
+                               row: int) -> None:
+        base = (f"{key.name}\x00{key.type}\x00{key.joined_tags}"
+                f"\x00{int(scope)}")
+        self.keyset_checksum ^= self._fnv1a(base)
+        self.key_checksum ^= self._fnv1a(f"{base}\x00{row}")
 
     def _init_mesh_lanes(self, mesh, family: str) -> int:
         """Shared mesh plumbing for device-resident arenas: validate the
@@ -168,6 +194,7 @@ class _ArenaBase:
                 self._grow()
             row = self._free.pop()
             self.kdict[dk] = row
+            self._fold_key_fingerprints(key, scope, row)
             self.meta[row] = RowMeta(key=key, tags=tags, scope=scope)
             self.name_col[row] = key.name
             self.tags_col[row] = tags
@@ -200,6 +227,7 @@ class _ArenaBase:
             self.scope_col[row] = 0
             self.idle[row] = 0
             del self.kdict[(m.key, m.scope)]
+            self._fold_key_fingerprints(m.key, m.scope, int(row))
             self._free.append(int(row))
         self.touched[:] = False
 

@@ -30,7 +30,13 @@ the deployment must provide: (a) a consistent key-registration order
 across processes (the control plane's analog of the proxy ring's
 membership view), (b) pre-sized set arenas (one-sided growth would
 diverge global shapes), and (c) a synchronized flush schedule
-(`synchronize_with_interval`).  The multi-process mesh serves the GLOBAL
+(`synchronize_with_interval`).  Contract (a) is now tripwired: the
+per-flush gather carries each arena's key-set and key->row fingerprints
+(`core/arena.py key_checksum`), and controllers holding the same keys
+with different row assignments raise a crisp per-family lockstep error
+instead of silently merging unrelated timeseries; ring-style asymmetric
+registration (a key present only on its owning controller) remains
+legal.  The multi-process mesh serves the GLOBAL
 tier; local/forwarding tiers stay single-process and reach it over the
 gRPC forward edge, exactly like the reference's proxy ring
 (tests/test_multihost.py exercises two real jax.distributed processes

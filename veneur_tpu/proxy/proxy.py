@@ -93,21 +93,12 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
 
 
 def redacted_proxy_dict(cfg: ProxyConfig, redact: bool = True) -> dict:
-    """ProxyConfig dump with secrets redacted, mirroring the server's
-    config endpoint contract (util/config/config.go:65-96 +
+    """ProxyConfig dump with secrets redacted, sharing the server's
+    redaction helper (util/config/config.go:65-96 +
     util/string_secret.go:13-36)."""
-    from dataclasses import fields
+    from veneur_tpu.config import redacted_fields
 
-    out = {}
-    for f in fields(ProxyConfig):
-        v = getattr(cfg, f.name)
-        if redact and f.name == "tls_key" and v:
-            v = "REDACTED"
-        if isinstance(v, list) and v and not isinstance(
-                v[0], (str, int, float)):
-            v = [str(x) for x in v]
-        out[f.name] = v
-    return out
+    return redacted_fields(cfg, {"tls_key"}, redact)
 
 
 class Proxy:
