@@ -1,0 +1,64 @@
+"""What makes up the per-launch floor on the axon tunnel?
+
+Measures pipelined per-launch wall for trivial programs with varying
+argument/output buffer counts, and for the real flush_step signature
+(6 inputs + pcts -> 6 outputs).  If the floor scales with handle count,
+packing the flush program's operands is a real sustained-latency lever.
+
+Usage: python scripts/floor_anatomy.py [pipeline] [rounds]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def measure(label, fn, args, pipeline, rounds, fetch):
+    jfn = jax.jit(fn)
+    out = jfn(*args)
+    float(np.asarray(fetch(out)))
+    per = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        outs = [jfn(*args) for _ in range(pipeline)]
+        float(np.asarray(fetch(outs[-1])))
+        per.append((time.perf_counter() - t0) / pipeline * 1e3)
+    p50 = float(np.percentile(per, 50))
+    print(f"{label:28s} {p50:8.4f} ms/launch", flush=True)
+    return p50
+
+
+def main():
+    pipeline = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    print(f"device: {jax.devices()[0]} pipeline={pipeline}", flush=True)
+
+    x = jax.device_put(jnp.float32(1.0))
+    xs = [jax.device_put(jnp.arange(128, dtype=jnp.float32) + i)
+          for i in range(13)]
+
+    measure("1 arg -> 1 out", lambda a: a + 1.0, (x,), pipeline, rounds,
+            lambda o: o)
+    measure("7 args -> 1 out",
+            lambda *a: sum(v[0] for v in a),
+            tuple(xs[:7]), pipeline, rounds, lambda o: o)
+    measure("7 args -> 6 outs",
+            lambda *a: tuple(v + 1.0 for v in a[:6]),
+            tuple(xs[:7]), pipeline, rounds, lambda o: o[0][0])
+    measure("13 args -> 6 outs",
+            lambda *a: tuple(v + 1.0 for v in a[:6]),
+            tuple(xs), pipeline, rounds, lambda o: o[0][0])
+    measure("1 arg -> 13 outs",
+            lambda a: tuple(a + float(i) for i in range(13)),
+            (x,), pipeline, rounds, lambda o: o[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Interleaved floor comparison: alternate configs within each round so
+tunnel congestion drift hits all configs equally."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    pipeline = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+    rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+    print(f"device: {jax.devices()[0]} pipeline={pipeline}", flush=True)
+
+    x = jax.device_put(jnp.float32(1.0))
+    xs = [jax.device_put(jnp.arange(128, dtype=jnp.float32) + i)
+          for i in range(13)]
+
+    configs = {
+        "1->1": (jax.jit(lambda a: a + 1.0), (x,), lambda o: o),
+        "13->1": (jax.jit(lambda *a: sum(v[0] for v in a)), tuple(xs),
+                  lambda o: o),
+        "1->6": (jax.jit(lambda a: tuple(a + float(i) for i in range(6))),
+                 (x,), lambda o: o[0]),
+        "13->6": (jax.jit(lambda *a: tuple(v + 1.0 for v in a[:6])),
+                  tuple(xs), lambda o: o[0][0]),
+    }
+    for name, (fn, args, fetch) in configs.items():
+        float(np.asarray(fetch(fn(*args))))
+
+    acc = {name: [] for name in configs}
+    for r in range(rounds):
+        for name, (fn, args, fetch) in configs.items():
+            t0 = time.perf_counter()
+            outs = [fn(*args) for _ in range(pipeline)]
+            float(np.asarray(fetch(outs[-1])))
+            acc[name].append((time.perf_counter() - t0) / pipeline * 1e3)
+    for name, v in acc.items():
+        print(f"{name:7s} p50={float(np.percentile(v, 50)):8.4f} "
+              f"min={min(v):8.4f} ms/launch", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,3 +150,41 @@ def test_sorted_eval_extreme_float32_values():
         jnp.asarray(dmax), pct, interpret=True))
     np.testing.assert_allclose(got, ref, rtol=1e-5)
     assert got[0, 0] == 2.0  # median of {1, 2, 3.3e38}
+
+
+def test_sorted_eval_uniform_kernel_parity_interpret():
+    """The uniform-weight specialization (key-only sort network) must be
+    numerically identical to the general kernel AND the XLA twin on
+    w in {0, 1} inputs — including empty rows, single-point rows, ties,
+    and padding columns."""
+    import numpy as np
+
+    from veneur_tpu.ops import sorted_eval as se
+    from veneur_tpu.sketches import tdigest as td
+
+    rng = np.random.default_rng(11)
+    for (u, d) in ((64, 32), (16, 256), (8, 2), (256, 4)):
+        m = rng.gamma(2.0, 10.0, (u, d)).astype(np.float32)
+        w = (rng.random((u, d)) < 0.7).astype(np.float32)  # 0/1 only
+        m[1, :] = 5.0                    # ties
+        w[2, :] = 0.0                    # empty row
+        w[3, :] = 0.0
+        w[3, 0] = 1.0                    # single-point row
+        dmin = np.where(w.sum(1) > 0,
+                        np.where(w > 0, m, np.inf).min(1), 0.0)
+        dmax = np.where(w.sum(1) > 0,
+                        np.where(w > 0, m, -np.inf).max(1), 0.0)
+        pct = jnp.asarray([0.5, 0.9, 0.99], jnp.float32)
+        args = (jnp.asarray(m), jnp.asarray(w),
+                jnp.asarray(dmin.astype(np.float32)),
+                jnp.asarray(dmax.astype(np.float32)), pct)
+        ref = np.asarray(td.weighted_eval(*args))
+        general = np.asarray(se.weighted_eval(*args, interpret=True))
+        fast = np.asarray(se.weighted_eval(*args, interpret=True,
+                                           uniform=True))
+        np.testing.assert_allclose(general, ref, rtol=1e-5, atol=1e-4,
+                                   err_msg=f"general {u}x{d}")
+        # identical arithmetic on w in {0,1}: positions are exact f32
+        # integers, so the two networks agree exactly
+        np.testing.assert_array_equal(fast, general,
+                                      err_msg=f"uniform {u}x{d}")

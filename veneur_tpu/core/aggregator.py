@@ -383,7 +383,8 @@ class MetricAggregator:
         ramp in production never pays a first-bucket XLA compile inside a
         flush interval (the compiles land in the persistent cache, making
         later boots near-free).  Meant for a background thread at boot;
-        `stop` aborts between buckets.  Returns buckets compiled.
+        `stop` aborts between buckets.  Returns programs compiled
+        (2 per bucket: the uniform and general sort networks).
         Mesh-less only: meshed program shapes include per-family state
         and are pre-sized by configuration instead."""
         if self.mesh is not None:
@@ -405,9 +406,16 @@ class MetricAggregator:
             # on the device the live flushes are using
             dv = jax.ShapeDtypeStruct((u_pad, d_pad), dt)
             mm = jax.ShapeDtypeStruct((2, u_pad), dt)
-            with self._CompileGuard(self, (u_pad, d_pad)):
-                self.flush_fn.lower(dv, dv, mm, self._pct_arr).compile()
-            n += 1
+            # both sort networks where the Pallas kernel applies (raw-
+            # sample intervals take the uniform network, weighted staging
+            # the general one); when the shape/backend routes to the XLA
+            # twin both variants lower identically, so compile just one
+            distinct = serving.pallas_eval_applies(u_pad, d_pad, dt)
+            for uniform in ((True, False) if distinct else (False,)):
+                with self._CompileGuard(self, ((u_pad, d_pad), uniform)):
+                    self.flush_fn.lower(dv, dv, mm, self._pct_arr,
+                                        uniform=uniform).compile()
+                n += 1
         return n
 
     def _run_flush(self, snap: dict, is_local: bool) -> dict:
@@ -448,15 +456,22 @@ class MetricAggregator:
             t0 = time.perf_counter()
             outs = []
             first_dev = None
+            # normalize the network choice to the EFFECTIVE program: on
+            # the XLA-twin route both variants are one program, so the
+            # static flag (and the compile-guard key) must not split it
+            uniform = (snap["digests"]["uniform"]
+                       and serving.pallas_eval_applies(
+                           rows_per, dv.shape[1], dv.dtype))
             for c in range(n_chunks):
                 sl = slice(c * rows_per, (c + 1) * rows_per)
                 dvd, dwd, mmd = self.digests.put_dense(
                     dv[sl], dw[sl], minmax[:, sl])
                 if first_dev is None:
                     first_dev = (dvd, dwd)
-                with self._CompileGuard(self, dv[sl].shape):
+                with self._CompileGuard(self, (dv[sl].shape, uniform)):
                     outs.append(self.flush_fn(dvd, dwd, mmd,
-                                              self._pct_arr))
+                                              self._pct_arr,
+                                              uniform=uniform))
             seg["dispatch_s"] = time.perf_counter() - t0
             t0 = time.perf_counter()
             fetched = serving.fetch(tuple(outs))
@@ -495,13 +510,17 @@ class MetricAggregator:
                     + [fams[n][1] for n in names],
                     np.uint64).view(np.int64)
                 flags = multihost_utils.process_allgather(np.concatenate(
-                    [np.asarray([nd, local_depth, len(crows), len(srows)],
+                    [np.asarray([nd, local_depth, len(crows), len(srows),
+                                 int(snap["digests"]["uniform"])],
                                 np.int64), cks]))
                 g_nd, g_depth, g_nc, g_ns = \
                     flags[:, :4].max(axis=0).tolist()
+                # the uniform kernel is a STATIC program choice — legal
+                # only when every controller's staging was uniform
+                g_uniform = bool(flags[:, 4].min())
                 nf = len(names)
-                keyset_all = flags[:, 4:4 + nf]
-                keyrow_all = flags[:, 4 + nf:4 + 2 * nf]
+                keyset_all = flags[:, 5:5 + nf]
+                keyrow_all = flags[:, 5 + nf:5 + 2 * nf]
                 # same key SET everywhere but different key->row
                 # assignment = silent row misalignment (a registration-
                 # order divergence).  Differing key sets pass: with O(1)
@@ -533,6 +552,7 @@ class MetricAggregator:
             else:
                 g_nd, g_depth = nd, 0
                 g_nc, g_ns = len(crows), len(srows)
+                g_uniform = snap["digests"]["uniform"]
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
                 dpart["d_min"], dpart["d_max"],
@@ -543,29 +563,39 @@ class MetricAggregator:
                 hll_regs=snap["sets"]["lanes"],
                 counter_planes=snap["counter_planes"](),
                 uts_regs=snap["uts_regs"])
+            from veneur_tpu.parallel.mesh import SHARD_AXIS
+            # per-device shard shape decides whether the Pallas network
+            # choice is a distinct program (see pallas_eval_applies)
+            g_uniform = (g_uniform and serving.pallas_eval_applies(
+                inputs.dense_v.shape[0] // self.mesh.shape[SHARD_AXIS],
+                inputs.dense_v.shape[1], inputs.dense_v.dtype))
             shapes = tuple(x.shape for x in inputs)
-            with self._CompileGuard(self, shapes):
-                out = self.flush_fn(inputs, self._pct_arr)
+            with self._CompileGuard(self, (shapes, g_uniform)):
+                # ONE flat f32 buffer + the u8 set registers — the
+                # packed launch shape (serving.pack_outputs): dispatch
+                # cost scales with output-handle count
+                flat_dev, set_regs_out = self.flush_fn(
+                    inputs, self._pct_arr, uniform=g_uniform)
             host["dense_dev"] = (dvd, dwd)
-            # ONE batched readback for everything the emitters need
             set_regs_dev = None
             if (g_ns and is_local
                     and (snap["sets"]["scopes"]
                          == int(MetricScope.MIXED)).any()):
                 ps = self._padded_rows(srows)
                 set_regs_dev = serving.set_regs_pack(
-                    out.set_regs, jnp.asarray(ps))
-            fetched = serving.fetch((
-                out.digest_eval if g_nd else None,
-                (out.counter_hi, out.counter_lo) if g_nc else None,
-                out.set_estimates if g_ns else None,
-                set_regs_dev, out.unique_ts))
-            ev_t, counters_t, set_ests_t, set_regs_t, uts_t = fetched
-            host["unique_ts"] = float(uts_t)
-            if counters_t is not None and len(crows):
-                host["c_hi"] = counters_t[0].astype(np.float64)[crows]
-                host["c_lo"] = counters_t[1].astype(np.float64)[crows]
-            if set_ests_t is not None and len(srows):
+                    set_regs_out, jnp.asarray(ps))
+            flat_t, set_regs_t = serving.fetch((flat_dev, set_regs_dev))
+            k_rows = inputs.dense_v.shape[0]
+            k2 = inputs.counter_planes.shape[1]
+            n_sets_cap = inputs.hll_regs.shape[1]
+            ev_t, c_hi_t, c_lo_t, set_ests_t, uts = \
+                serving.unpack_outputs(flat_t, k_rows, n_cols, k2,
+                                       n_sets_cap)
+            host["unique_ts"] = uts
+            if len(crows):
+                host["c_hi"] = c_hi_t.astype(np.float64)[crows]
+                host["c_lo"] = c_lo_t.astype(np.float64)[crows]
+            if len(srows):
                 host["set_ests"] = set_ests_t[srows]
             if set_regs_t is not None:
                 host["set_regs"] = set_regs_t.reshape(
@@ -678,6 +708,10 @@ class MetricAggregator:
             "scopes": d.scope_col[drows].copy(),
             # the interval's staged weighted points (consumed); the flush
             # program evaluates them in one dense pass outside the lock
+            # (uniform captured BEFORE take_staged resets the tracking —
+            # it selects the key-only sort network as a static program
+            # choice, ops/sorted_eval.py)
+            "uniform": d.staged_uniform,
             "staged": d.take_staged(),
             "l_weight": d.l_weight[drows].copy(),
             "l_min": d.l_min[drows].copy(),

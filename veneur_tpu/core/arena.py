@@ -624,6 +624,12 @@ class DigestArena(_ArenaBase):
         # wts) parts + per-row staged depth
         self._acc: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         self._depth = np.zeros(capacity, np.int64)
+        # True while every staged weight this interval is exactly 1.0
+        # (raw unsampled samples) — lets the flush pick the key-only
+        # sort network (ops/sorted_eval.py _kernel_uniform, ~1.8x);
+        # any sample_rate != 1, forwarded centroid weight != 1, or
+        # hot-key pre-reduction flips it off until the next interval
+        self._staged_nonuniform = False
 
     def _grow_state(self, old: int) -> None:
         pad = lambda a, fill: np.concatenate(
@@ -643,6 +649,8 @@ class DigestArena(_ArenaBase):
     def sample(self, row: int, value: float, sample_rate: float) -> None:
         """A locally-observed sample (Histo.Sample, samplers.go:331-342)."""
         w = 1.0 / sample_rate
+        if w != 1.0:
+            self._staged_nonuniform = True
         self._rows.append(row)
         self._vals.append(value)
         self._wts.append(w)
@@ -657,6 +665,9 @@ class DigestArena(_ArenaBase):
         self._vals.extend(float(m) for m in means)
         self._wts.extend(float(w) for w in weights)
         self._local.extend([False] * len(means))
+        if not self._staged_nonuniform and any(
+                float(w) != 1.0 for w in weights):
+            self._staged_nonuniform = True
         self.d_min[row] = min(self.d_min[row], dmin)
         self.d_max[row] = max(self.d_max[row], dmax)
         self.d_rsum[row] += drsum
@@ -665,6 +676,8 @@ class DigestArena(_ArenaBase):
                      wts: np.ndarray) -> None:
         """Stage a columnar batch of locally-observed samples (the native
         ingest drain path)."""
+        if not self._staged_nonuniform and not np.all(wts == 1.0):
+            self._staged_nonuniform = True
         self._chunks.append((rows, vals, wts))
 
     def staged_count(self) -> int:
@@ -748,6 +761,8 @@ class DigestArena(_ArenaBase):
         deep = np.nonzero(self._depth > DENSE_DEPTH_CAP)[0]
         if len(deep) == 0:
             return
+        # re-staged compressed centroids carry merged weights
+        self._staged_nonuniform = True
         is_deep = np.zeros(self.capacity, bool)
         is_deep[deep] = True
         sel = is_deep[rows]
@@ -806,11 +821,18 @@ class DigestArena(_ArenaBase):
 
     # -- flush ------------------------------------------------------------
 
+    @property
+    def staged_uniform(self) -> bool:
+        """True iff every weight staged this interval equals exactly 1.0
+        (capture BEFORE take_staged resets the tracking)."""
+        return not self._staged_nonuniform
+
     def take_staged(self):
         """Consume the interval accumulator (call under the aggregator
         lock, after sync()): returns (rows, vals, wts) COO arrays."""
         rows, vals, wts = self._consolidated()
         self._acc = []
+        self._staged_nonuniform = False
         return rows, vals, wts
 
     @staticmethod

@@ -24,9 +24,10 @@ rolls and selects ran at D/128 lane occupancy for shallow depths (a
 production flush with D=4 staged points used 3% of the VPU); transposed,
 every stage runs on full 128-lane vectors regardless of depth, and the
 sort's rolls become sublane rotations (static vreg permutes for the
-stride >= 8 stages).  The [K, D] operands are transposed once on device
-(one HBM pass XLA fuses with the upload) and the [P+2, K] result is
-transposed back — both negligible next to the sort.
+stride >= 8 stages).  As of r5 the transpose happens IN VMEM per tile
+(the kernel reads the natural [K, D] blocks and transposes in
+registers), so the operands cross HBM exactly once — the earlier XLA
+pre-transpose was a full extra HBM round-trip of both arrays per flush.
 
 HBM traffic is exactly one read of the `[K, D]` inputs and one
 `[K, P+2]` write; everything else lives in VMEM.  XLA's stock `lax.sort`
@@ -66,9 +67,15 @@ def _lane_tile(u: int, d: int) -> int:
 
 def _cmp_exchange(key, w, j, k, idx):
     """One bitonic compare-exchange stage over the sublane (depth) axis:
-    partner = row ^ j, direction by bit k.  Strict per-side comparisons
-    make tie handling consistent for both partners, so (key, weight)
-    pairs never split."""
+    partner = row ^ j, direction by bit k.
+
+    min/max formulation (r5): the kept key is directly
+    `min(key, partner)` on the keep-small side and `max` on the other —
+    two fewer compares and two fewer logical ops per stage than the
+    take-mask form, worth ~30% of the whole sort on chip.  The weight
+    follows whenever the kept key CHANGED (`moved`); for tied keys
+    min == max == key on both sides, so moved is false for both and each
+    partner keeps its own weight — (key, weight) pairs never split."""
     d = key.shape[0]
     lower = (idx & j) == 0
     # pltpu.roll requires non-negative shifts: roll by d-j == roll by -j
@@ -78,10 +85,10 @@ def _cmp_exchange(key, w, j, k, idx):
                    pltpu.roll(w, j, axis=0))
     up = (idx & k) == 0
     want_small = lower == up
-    # logical form, not a bool-valued where: Mosaic cannot truncate the
-    # intermediate i8 select result back to i1
-    take = (want_small & (pk < key)) | (~want_small & (pk > key))
-    return jnp.where(take, pk, key), jnp.where(take, pw, w)
+    newkey = jnp.where(want_small, jnp.minimum(key, pk),
+                       jnp.maximum(key, pk))
+    moved = newkey != key
+    return newkey, jnp.where(moved, pw, w)
 
 
 def _cumsum_depth(w):
@@ -104,32 +111,31 @@ def _cumsum_depth(w):
     return cum
 
 
-def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
-    m = mean_ref[...]             # [D, T]
-    w = weight_ref[...]           # [D, T]
-    mm = minmax_ref[...]          # [2, T] (min; max)
-    qs = qs_ref[...]              # [1, P]
-    d, t = m.shape
+def _cmp_exchange_keys(key, j, k, idx):
+    """Key-only compare-exchange for the uniform-weight network: no
+    weight array rides along (positions ARE the cumulative weights), so
+    a stage is 2 rolls + min/max + 2 selects instead of the paired
+    form's 11 passes."""
+    d = key.shape[0]
+    lower = (idx & j) == 0
+    pk = jnp.where(lower, pltpu.roll(key, d - j, axis=0),
+                   pltpu.roll(key, j, axis=0))
+    up = (idx & k) == 0
+    want_small = lower == up
+    return jnp.where(want_small, jnp.minimum(key, pk),
+                     jnp.maximum(key, pk))
+
+
+# finite padding sentinel for cmid lanes (inf would turn the one-hot
+# gathers' 0 * inf products into NaN)
+_PAD_CMID = 3.0e38
+
+
+def _eval_tail(idx, m_clean, cmid, total, sums, n_real, mm, qs, out_ref):
+    """Shared quantile-extraction tail: per-percentile rank search on
+    cmid + one-hot neighbor gathers + midpoint interpolation, matching
+    `td.weighted_eval` (Hazen convention) bit-for-bit."""
     n_pct = qs.shape[1]
-
-    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
-    key = jnp.where(w > 0, m, _PAD_KEY)
-    k = 2
-    while k <= d:                 # static: fully unrolled network
-        j = k // 2
-        while j >= 1:
-            key, w = _cmp_exchange(key, w, j, k, idx)
-            j //= 2
-        k *= 2
-    occ = w > 0
-    m_clean = jnp.where(occ, key, 0.0)
-
-    cum = _cumsum_depth(w)                                      # [D, T]
-    total = cum[d - 1:d, :]                                     # [1, T]
-    sums = jnp.sum(m_clean * w, axis=0, keepdims=True)          # [1, T]
-    n_real = jnp.sum(occ.astype(jnp.int32), axis=0,
-                     keepdims=True)                             # [1, T]
-    cmid = cum - 0.5 * w
     hi_bound = jnp.maximum(n_real - 1, 1)
     first_mean = m_clean[0:1, :]            # sorted: row 0 is the min
     dmin, dmax = mm[0:1, :], mm[1:2, :]
@@ -157,35 +163,116 @@ def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
     out_ref[...] = jnp.concatenate(rows + [total, sums], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
+    # [T, K-tile] HBM blocks transposed HERE, in VMEM: the [K, D] dense
+    # operands stream in untouched and the depth-on-sublanes layout the
+    # network needs is produced by an in-register transpose — one HBM
+    # read total, where an XLA pre-transpose cost a full extra HBM
+    # round-trip of both operands every flush (~0.07 ms at the 100k
+    # shape)
+    m = mean_ref[...].T           # [D, T]
+    w = weight_ref[...].T         # [D, T]
+    mm = minmax_ref[...]          # [2, T] (min; max)
+    qs = qs_ref[...]              # [1, P]
+    d, t = m.shape
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
+    key = jnp.where(w > 0, m, _PAD_KEY)
+    k = 2
+    while k <= d:                 # static: fully unrolled network
+        j = k // 2
+        while j >= 1:
+            key, w = _cmp_exchange(key, w, j, k, idx)
+            j //= 2
+        k *= 2
+    occ = w > 0
+    m_clean = jnp.where(occ, key, 0.0)
+
+    cum = _cumsum_depth(w)                                      # [D, T]
+    total = cum[d - 1:d, :]                                     # [1, T]
+    sums = jnp.sum(m_clean * w, axis=0, keepdims=True)          # [1, T]
+    n_real = jnp.sum(occ.astype(jnp.int32), axis=0,
+                     keepdims=True)                             # [1, T]
+    cmid = cum - 0.5 * w
+    _eval_tail(idx, m_clean, cmid, total, sums, n_real, mm, qs, out_ref)
+
+
+def _kernel_uniform(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
+    """Uniform-weight specialization: every staged point weighs exactly
+    1 (raw-sample staging — the local tier always, and any global merge
+    of under-compressed incoming digests, e.g. the 32-samples-at-
+    compression-100 digests of the reference's own benchmark, whose
+    centroids are all singletons).  The weight array then never enters
+    the sort network — sorted positions ARE the cumulative weights
+    (cum_i = i+1, cmid_i = i+0.5, total = n_real) — so a stage is 6
+    passes instead of 11 and the prefix-sum disappears.  Numerically
+    identical outputs to `_kernel` on w in {0, 1} inputs (enforced in
+    interpret mode by tests/test_ops.py; the compiled Mosaic path is
+    exercised natively by the bench and the verify flow — CI runs on
+    CPU and cannot lower Mosaic)."""
+    m = mean_ref[...].T           # [D, T]
+    w = weight_ref[...].T         # [D, T]
+    mm = minmax_ref[...]          # [2, T]
+    qs = qs_ref[...]              # [1, P]
+    d, t = m.shape
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
+    occ0 = w > 0
+    key = jnp.where(occ0, m, _PAD_KEY)
+    n_real = jnp.sum(occ0.astype(jnp.int32), axis=0,
+                     keepdims=True)                             # [1, T]
+    k = 2
+    while k <= d:                 # static: fully unrolled network
+        j = k // 2
+        while j >= 1:
+            key = _cmp_exchange_keys(key, j, k, idx)
+            j //= 2
+        k *= 2
+    occ_sorted = idx < n_real     # real points sort before +inf padding
+    m_clean = jnp.where(occ_sorted, key, 0.0)
+    # summed AFTER the sort, like the general kernel, so the two
+    # networks agree bit-for-bit (f32 summation order matters)
+    sums = jnp.sum(m_clean, axis=0, keepdims=True)
+    total = n_real.astype(jnp.float32)
+    cmid = jnp.where(occ_sorted, idx.astype(jnp.float32) + 0.5,
+                     _PAD_CMID)
+    _eval_tail(idx, m_clean, cmid, total, sums, n_real, mm, qs, out_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "uniform"))
 def weighted_eval(mean: jax.Array, weight: jax.Array,
                   d_min: jax.Array, d_max: jax.Array,
                   percentiles: jax.Array,
-                  interpret: bool = False) -> jax.Array:
+                  interpret: bool = False,
+                  uniform: bool = False) -> jax.Array:
     """Pallas twin of `td.weighted_eval`: `[K, D]` weighted points ->
     `[K, P+2]` (quantiles, total weight, weighted sum).  Shapes must
     satisfy `usable()`; the dense builder's pow2 padding guarantees it
-    for every at-scale flush."""
+    for every at-scale flush.
+
+    `uniform=True` selects the key-only network (`_kernel_uniform`,
+    ~1.8x faster) and is only legal when every nonzero weight equals
+    1.0 — the dense builder tracks that per interval
+    (`DigestArena.staged_uniform`) and the serving path threads it
+    through as a static program choice."""
     u, d = mean.shape
     n_pct = percentiles.shape[0]
     tile = _lane_tile(u, d)
-    mt = mean.astype(jnp.float32).T                             # [D, U]
-    wt = weight.astype(jnp.float32).T
     minmax = jnp.stack([d_min, d_max], axis=0).astype(jnp.float32)
     qs = percentiles.reshape(1, n_pct).astype(jnp.float32)
     out = pl.pallas_call(
-        _kernel,
+        _kernel_uniform if uniform else _kernel,
         grid=(u // tile,),
         in_specs=[
-            pl.BlockSpec((d, tile), lambda i: (0, i)),
-            pl.BlockSpec((d, tile), lambda i: (0, i)),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
             pl.BlockSpec((2, tile), lambda i: (0, i)),
             pl.BlockSpec((1, n_pct), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((n_pct + 2, tile), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((n_pct + 2, u), jnp.float32),
         interpret=interpret,
-    )(mt, wt, minmax, qs)
+    )(mean.astype(jnp.float32), weight.astype(jnp.float32), minmax, qs)
     return out.T                                                # [U, P+2]
 
 

@@ -36,10 +36,27 @@ FlushInputs = serving.FlushInputs
 FlushOutputs = serving.FlushOutputs
 
 
-@jax.jit
-def flush_step(inputs: FlushInputs, percentiles: jax.Array) -> FlushOutputs:
+@functools.partial(jax.jit, static_argnames=("uniform",))
+def flush_step(inputs: FlushInputs, percentiles: jax.Array,
+               uniform: bool = False) -> FlushOutputs:
     """Single-device flush step (the compile-checked entry point)."""
-    return serving.flush_body(inputs, percentiles, axis=None)
+    return serving.flush_body(inputs, percentiles, axis=None,
+                              uniform=uniform)
+
+
+@functools.partial(jax.jit, static_argnames=("uniform",))
+def flush_step_packed(inputs: FlushInputs, percentiles: jax.Array,
+                      uniform: bool = False
+                      ) -> tuple[jax.Array, jax.Array]:
+    """flush_step with its f32 outputs packed into ONE flat buffer
+    (serving.pack_outputs) — the production launch shape: per-launch
+    dispatch cost scales with output-handle count, so the global tier's
+    flush hands the host (flat_f32, set_regs_u8) instead of six arrays.
+    `uniform` (static) selects the key-only sort when every staged
+    weight is 1 (see ops/sorted_eval.py)."""
+    out = serving.flush_body(inputs, percentiles, axis=None,
+                              uniform=uniform)
+    return serving.pack_outputs(out), out.set_regs
 
 
 def make_sharded_flush_step(mesh: Mesh):
@@ -74,13 +91,16 @@ def make_sharded_flush_step(mesh: Mesh):
 def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,
                    depth: int = 32,
                    compression: float = td.DEFAULT_COMPRESSION,
-                   hll_p: int = 10, seed: int = 0) -> FlushInputs:
+                   hll_p: int = 10, seed: int = 0,
+                   weighted: bool = False) -> FlushInputs:
     """Small synthetic inputs for compile checks and dry runs: every key
     holds `n_lanes * depth` staged weighted points (the dense depth axis
     tiles the replica mesh axis evenly).  Rows pad up to a power of two
     with zero-weight rows, exactly like the production dense builder
     (arena.py build_dense) — the padded rows are part of the honest
-    workload."""
+    workload.  weighted=True stages integer centroid weights in [1, 8]
+    (the shape of re-compressed forwarded digests) instead of the
+    weight-1 singletons an under-compressed incoming digest carries."""
     import numpy as np
     rng = np.random.default_rng(seed)
     m = 1 << hll_p
@@ -90,7 +110,10 @@ def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,
 
     vals = rng.gamma(2.0, 10.0, (k, d)).astype(np.float32)
     wts = np.zeros((k, d), np.float32)
-    wts[:n_keys] = 1.0
+    if weighted:
+        wts[:n_keys] = rng.integers(1, 9, (n_keys, d)).astype(np.float32)
+    else:
+        wts[:n_keys] = 1.0
     minmax = np.stack([vals.min(axis=1), vals.max(axis=1)]).astype(
         np.float32)
     counters = rng.integers(0, 100, (r, k)).astype(np.float32)

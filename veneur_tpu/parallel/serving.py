@@ -135,12 +135,29 @@ def fetch(x):
 # Flush body (shared by the serving path and the bench's flush_step)
 # ---------------------------------------------------------------------------
 
+def pallas_eval_applies(u: int, d: int, dtype=jnp.float32) -> bool:
+    """True when digest_eval will route this shape to the fused Pallas
+    kernel (where the uniform/general network choice is a DISTINCT
+    program).  Callers normalize their `uniform` flag with this so the
+    XLA-twin fallback never compiles two identical programs under two
+    static keys."""
+    import os
+
+    from veneur_tpu.ops import sorted_eval as se
+    return (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
+            and dtype == jnp.float32
+            and se.usable(u, d, jax.default_backend()))
+
+
 def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
-                d_max: jax.Array, percentiles: jax.Array) -> jax.Array:
+                d_max: jax.Array, percentiles: jax.Array,
+                uniform: bool = False) -> jax.Array:
     """The flush's evaluation core, routed to the fused Pallas kernel
     (ops/sorted_eval.py: in-VMEM bitonic sort + MXU prefix sums) when the
     backend and static shapes allow, else the XLA formulation — bitwise
-    parity between the two is test-enforced.
+    parity between the two is test-enforced.  `uniform` (static) selects
+    the key-only sort network, legal when every nonzero staged weight is
+    exactly 1 (tracked per interval by the dense builder).
 
     VENEUR_TPU_DISABLE_PALLAS_EVAL is read at TRACE time (the choice is
     baked into each compiled program): set it before process start."""
@@ -151,12 +168,14 @@ def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
     if (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
             and dv.dtype == jnp.float32   # f64 option -> XLA twin
             and se.usable(u, d, jax.default_backend())):
-        return se.weighted_eval(dv, dw, d_min, d_max, percentiles)
+        return se.weighted_eval(dv, dw, d_min, d_max, percentiles,
+                                uniform=uniform)
     return td.weighted_eval(dv, dw, d_min, d_max, percentiles)
 
 
 def flush_body(inputs: FlushInputs, percentiles: jax.Array,
-               axis: Optional[str]) -> FlushOutputs:
+               axis: Optional[str],
+               uniform: bool = False) -> FlushOutputs:
     """Evaluate every family for one flush.  `axis` names the replica mesh
     axis for collectives (None = single device, identical math)."""
     dv, dw = inputs.dense_v, inputs.dense_w
@@ -165,7 +184,7 @@ def flush_body(inputs: FlushInputs, percentiles: jax.Array,
         dv = jax.lax.all_gather(dv, axis, axis=1, tiled=True)
         dw = jax.lax.all_gather(dw, axis, axis=1, tiled=True)
     ev = digest_eval(dv, dw, inputs.minmax[0], inputs.minmax[1],
-                     percentiles)
+                     percentiles, uniform=uniform)
 
     set_regs = jnp.max(inputs.hll_regs, axis=0)
     chi = jnp.sum(inputs.counter_planes[..., 0], axis=0)
@@ -182,6 +201,31 @@ def flush_body(inputs: FlushInputs, percentiles: jax.Array,
         unique_ts=hll_mod.estimate(uts[None, :])[0])
 
 
+def pack_outputs(out: FlushOutputs) -> jax.Array:
+    """Flatten every f32-representable flush output into ONE device
+    buffer.  Per-launch dispatch cost scales with the number of output
+    buffer handles (measured ~0.1 ms/handle on a congested link — see
+    BASELINE.md), so the production program hands the host one flat
+    vector to slice instead of six arrays; `set_regs` stays separate
+    (u8, 4x the bytes as f32, and only consumed when a local tier
+    forwards mixed-scope sets)."""
+    return jnp.concatenate([
+        out.digest_eval.ravel(), out.counter_hi, out.counter_lo,
+        out.set_estimates, out.unique_ts[None]])
+
+
+def unpack_outputs(flat, k: int, n_pct: int, k2: int, s: int):
+    """Host-side views into a fetched pack_outputs vector: returns
+    (digest_eval [k, n_pct+2], counter_hi [k2], counter_lo [k2],
+    set_estimates [s], unique_ts scalar)."""
+    ne = k * (n_pct + 2)
+    ev = flat[:ne].reshape(k, n_pct + 2)
+    chi = flat[ne:ne + k2]
+    clo = flat[ne + k2:ne + 2 * k2]
+    est = flat[ne + 2 * k2:ne + 2 * k2 + s]
+    return ev, chi, clo, est, float(flat[ne + 2 * k2 + s])
+
+
 def make_serving_flush(mesh: Optional[Mesh]):
     """Build the per-flush program.
 
@@ -190,35 +234,72 @@ def make_serving_flush(mesh: Optional[Mesh]):
     host when there is nothing to reduce over (core/arena.py).
 
     With a mesh, returns the shard_map'd full-family program
-    fn(FlushInputs, percentiles) -> FlushOutputs: keys and set/counter
-    rows shard over 'shard'; staged sample depth, set register lanes and
-    counter planes reduce over 'replica' (all_gather / pmax / psum); the
+    fn(FlushInputs, percentiles, uniform=False) ->
+    (packed_f32, set_regs_u8): keys and set/counter rows shard over
+    'shard'; staged sample depth, set register lanes and counter planes
+    reduce over 'replica' (all_gather / pmax / psum); the
     unique-timeseries registers pmax over both axes (across processes
-    this is the DCN union of per-host tallies).
+    this is the DCN union of per-host tallies).  The f32 outputs come
+    back as ONE flat buffer (pack_outputs; unpack with unpack_outputs)
+    — per-launch dispatch cost scales with output-handle count, so the
+    production flush hands the host two buffers, not six.
     """
     if mesh is None:
-        return jax.jit(
-            lambda dv, dw, minmax, pct: digest_eval(
-                dv, dw, minmax[0], minmax[1], pct))
+        @functools.partial(jax.jit, static_argnames=("uniform",))
+        def unmeshed(dv, dw, minmax, pct, uniform=False):
+            return digest_eval(dv, dw, minmax[0], minmax[1], pct,
+                               uniform=uniform)
+        return unmeshed
 
     spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
-    fn = jax.shard_map(
-        functools.partial(flush_body, axis=REPLICA_AXIS),
-        mesh=mesh,
-        in_specs=(FlushInputs(
-            dense_v=P(SHARD_AXIS, REPLICA_AXIS),
-            dense_w=P(SHARD_AXIS, REPLICA_AXIS),
-            minmax=P(None, SHARD_AXIS),
-            hll_regs=spec_lanes,
-            counter_planes=spec_lanes,
-            uts_regs=P(REPLICA_AXIS, None)), P(None)),
-        out_specs=FlushOutputs(
-            digest_eval=P(SHARD_AXIS, None),
-            counter_hi=P(SHARD_AXIS), counter_lo=P(SHARD_AXIS),
-            set_regs=P(SHARD_AXIS, None), set_estimates=P(SHARD_AXIS),
-            unique_ts=P()),
-        check_vma=False)
-    return jax.jit(fn)
+    progs: dict = {}
+
+    def _prog(uniform: bool):
+        prog = progs.get(uniform)
+        if prog is None:
+            fn = jax.shard_map(
+                functools.partial(flush_body, axis=REPLICA_AXIS,
+                                  uniform=uniform),
+                mesh=mesh,
+                in_specs=(FlushInputs(
+                    dense_v=P(SHARD_AXIS, REPLICA_AXIS),
+                    dense_w=P(SHARD_AXIS, REPLICA_AXIS),
+                    minmax=P(None, SHARD_AXIS),
+                    hll_regs=spec_lanes,
+                    counter_planes=spec_lanes,
+                    uts_regs=P(REPLICA_AXIS, None)), P(None)),
+                out_specs=FlushOutputs(
+                    digest_eval=P(SHARD_AXIS, None),
+                    counter_hi=P(SHARD_AXIS), counter_lo=P(SHARD_AXIS),
+                    set_regs=P(SHARD_AXIS, None),
+                    set_estimates=P(SHARD_AXIS),
+                    unique_ts=P()),
+                check_vma=False)
+            prog = progs[uniform] = jax.jit(fn)
+        return prog
+
+    packed_progs: dict = {}
+
+    def _packed_prog(uniform: bool):
+        prog = packed_progs.get(uniform)
+        if prog is None:
+            inner = _prog(uniform)
+
+            def run(inputs, pct):
+                out = inner(inputs, pct)
+                return pack_outputs(out), out.set_regs
+
+            prog = packed_progs[uniform] = jax.jit(run)
+        return prog
+
+    def meshed(inputs, pct, uniform=False):
+        return _packed_prog(uniform)(inputs, pct)
+
+    # expose lowering for HLO inspection (dryrun's replica-group check)
+    meshed.lower = (
+        lambda inputs, pct, uniform=False: _packed_prog(uniform).lower(
+            inputs, pct))
+    return meshed
 
 
 @functools.partial(jax.jit, static_argnames=("compression", "cap"))
