@@ -51,10 +51,9 @@ def test_prewarm_makes_ramp_compile_free():
     agg = MetricAggregator(percentiles=[0.5], is_local=False,
                            initial_capacity=1024)
     warmed = agg.prewarm([1], max_keys=1024, min_keys=128)
-    # 4 key buckets (128..1024); on the CPU backend both sort-network
-    # variants route to the same XLA twin, so prewarm compiles one
-    # program per bucket (on TPU it compiles uniform + general = 8)
-    assert warmed == 4
+    # 4 key buckets (128..1024) x 2 production programs per bucket: the
+    # depth-vector uniform flush and the general weighted flush
+    assert warmed == 8
     base = agg.compile_events
     for n in (128, 200, 400, 900, 1024):    # ramp within the buckets
         _stage(agg, n)

@@ -188,3 +188,37 @@ def test_sorted_eval_uniform_kernel_parity_interpret():
         # integers, so the two networks agree exactly
         np.testing.assert_array_equal(fast, general,
                                       err_msg=f"uniform {u}x{d}")
+
+
+def test_uniform_depth_vector_eval_parity_interpret():
+    """The depth-vector kernel (no weight matrix crosses HBM) must equal
+    the general kernel and XLA twin for contiguously-packed weight-1
+    points."""
+    import numpy as np
+
+    from veneur_tpu.ops import sorted_eval as se
+    from veneur_tpu.sketches import tdigest as td
+
+    rng = np.random.default_rng(13)
+    for (u, d) in ((64, 32), (16, 256), (256, 4)):
+        m = rng.gamma(2.0, 10.0, (u, d)).astype(np.float32)
+        depths = rng.integers(0, d + 1, u).astype(np.int32)
+        depths[2] = 0                    # empty row
+        depths[3] = 1                    # single-point row
+        w = (np.arange(d)[None, :] < depths[:, None]).astype(np.float32)
+        m[w == 0] = 0.0                  # padding cells are zeros (builder)
+        dmin = np.where(depths > 0,
+                        np.where(w > 0, m, np.inf).min(1), 0.0)
+        dmax = np.where(depths > 0,
+                        np.where(w > 0, m, -np.inf).max(1), 0.0)
+        pct = jnp.asarray([0.5, 0.9, 0.99], jnp.float32)
+        ref = np.asarray(td.weighted_eval(
+            jnp.asarray(m), jnp.asarray(w),
+            jnp.asarray(dmin.astype(np.float32)),
+            jnp.asarray(dmax.astype(np.float32)), pct))
+        got = np.asarray(se.uniform_eval(
+            jnp.asarray(m), jnp.asarray(depths), pct, interpret=True))
+        # the depth kernel returns the quantile columns only (totals
+        # come from host accumulators)
+        np.testing.assert_allclose(got, ref[:, :3], rtol=1e-5,
+                                   atol=1e-4, err_msg=f"{u}x{d}")

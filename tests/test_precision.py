@@ -95,3 +95,33 @@ def test_counter_host_stripes_exact_past_f32():
     c.values[0, 1] = float(2 ** 53 - 2)
     c.sample(1, 1, 1.0)
     assert c.values[0, 1] == float(2 ** 53 - 1)
+
+
+def test_bf16_staging_bounded_error():
+    """digest_bf16_staging halves the dense upload at bounded quantile
+    rounding: values stage at bf16 (~2^-8 relative), totals stay exact
+    (host f64 accumulators)."""
+    import numpy as np
+
+    from veneur_tpu.core.aggregator import MetricAggregator
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+
+    agg = MetricAggregator(percentiles=[0.5, 0.99],
+                           digest_bf16_staging=True)
+    rng = np.random.default_rng(5)
+    vals = rng.gamma(3.0, 20.0, 8000)
+    with agg.lock:
+        row = agg.digests.row_for(
+            MetricKey("lat", sm.TYPE_HISTOGRAM, ""), MetricScope.MIXED,
+            [])
+        agg.digests.sample_batch(
+            np.full(len(vals), row), vals, np.ones(len(vals)))
+    res = agg.flush(is_local=False)
+    by = {m.name: m.value for m in res.metrics}
+    # totals are EXACT despite the bf16 values
+    assert by["lat.count"] == float(len(vals))
+    # quantiles within the bf16 rounding envelope
+    for q, name in ((0.5, "lat.50percentile"), (0.99, "lat.99percentile")):
+        want = np.percentile(vals, q * 100, method="hazen")
+        assert abs(by[name] - want) / want < 0.01, (name, by[name], want)

@@ -99,6 +99,11 @@ class Config:
     # emulated-f64 device math (no Pallas fast path, slower flush).
     # Single-device tiers only; sets jax_enable_x64 process-wide.
     digest_float64: bool = False
+    # stage dense digest VALUES as bfloat16: halves the flush's dominant
+    # host->device bytes at ~2^-8 relative quantile rounding (within the
+    # t-digest accuracy envelope; weights/totals stay exact).  Mutually
+    # exclusive with digest_float64.
+    digest_bf16_staging: bool = False
     # initial arena rows (metric keys) per sampler family; arenas grow by
     # doubling, but each growth copies device tensors — size for the
     # expected live cardinality up front on big deployments (0 = default)
@@ -237,6 +242,14 @@ class Config:
             self.read_buffer_size_bytes = 2 * 1024 * 1024
         if self.span_channel_capacity <= 0:
             self.span_channel_capacity = 100
+        if self.digest_bf16_staging and self.digest_float64:
+            raise ValueError(
+                "digest_bf16_staging contradicts digest_float64 "
+                "(half- vs double-precision staging); drop one")
+        if self.digest_bf16_staging and self.mesh_devices:
+            raise ValueError(
+                "digest_bf16_staging is unsupported with a device mesh "
+                "(the meshed flush program is f32-native); drop one")
         if self.digest_float64 and self.mesh_devices:
             # config-level rejection (not a deep aggregator error): the
             # meshed flush program is f32-native — hi/lo counter planes,

@@ -72,6 +72,7 @@ class MetricAggregator:
                  set_initial_capacity: int = 0,
                  hll_legacy_migration: bool = False,
                  digest_float64: bool = False,
+                 digest_bf16_staging: bool = False,
                  flush_upload_chunks: int = 2):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
@@ -117,9 +118,14 @@ class MetricAggregator:
                     "run f64 evaluation on an unmeshed tier")
             jax.config.update("jax_enable_x64", True)
         self.digest_float64 = digest_float64
+        if digest_bf16_staging and digest_float64:
+            raise ValueError(
+                "digest_bf16_staging contradicts digest_float64 "
+                "(half- vs double-precision staging); drop one")
         self.digests = arena_mod.DigestArena(
             compression=compression, mesh=mesh, n_lanes=ingest_lanes,
             eval_dtype=np.float64 if digest_float64 else np.float32,
+            bf16_staging=digest_bf16_staging,
             **kw)
         self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
                                        legacy_migration=hll_legacy_migration,
@@ -406,16 +412,21 @@ class MetricAggregator:
             # on the device the live flushes are using
             dv = jax.ShapeDtypeStruct((u_pad, d_pad), dt)
             mm = jax.ShapeDtypeStruct((2, u_pad), dt)
-            # both sort networks where the Pallas kernel applies (raw-
-            # sample intervals take the uniform network, weighted staging
-            # the general one); when the shape/backend routes to the XLA
-            # twin both variants lower identically, so compile just one
-            distinct = serving.pallas_eval_applies(u_pad, d_pad, dt)
-            for uniform in ((True, False) if distinct else (False,)):
-                with self._CompileGuard(self, ((u_pad, d_pad), uniform)):
-                    self.flush_fn.lower(dv, dv, mm, self._pct_arr,
-                                        uniform=uniform).compile()
-                n += 1
+            # both production programs per bucket: the depth-vector
+            # uniform variant (raw-sample intervals — the common case on
+            # every backend) and the general weighted one
+            # int16: MUST match the production upload dtype
+            # (arena build_dense) or the prewarmed signature misses and
+            # the first flush pays an uncovered in-flush compile
+            dep = jax.ShapeDtypeStruct((u_pad,), np.int16)
+            with self._CompileGuard(self, ((u_pad, d_pad), True)):
+                self.flush_fn.depth_variant.lower(
+                    dv, dep, self._pct_arr).compile()
+            n += 1
+            with self._CompileGuard(self, ((u_pad, d_pad), False)):
+                self.flush_fn.lower(dv, dv, mm, self._pct_arr,
+                                    uniform=False).compile()
+            n += 1
         return n
 
     def _run_flush(self, snap: dict, is_local: bool) -> dict:
@@ -436,12 +447,17 @@ class MetricAggregator:
             if nd == 0:
                 return host
             seg = self.last_flush_segments
+            uniform = dpart["uniform"]
             t0 = time.perf_counter()
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
-                dpart["d_min"], dpart["d_max"])
+                dpart["d_min"], dpart["d_max"], uniform=uniform)
+            # uniform intervals: dw is the [U] int32 depth vector, not
+            # the [U, D] weight matrix, and minmax stays host-side —
+            # roughly half the build and the uploaded bytes
             seg["build_s"] = time.perf_counter() - t0
-            seg["upload_bytes"] = dv.nbytes + dw.nbytes + minmax.nbytes
+            seg["upload_bytes"] = dv.nbytes + dw.nbytes + (
+                0 if uniform else minmax.nbytes)
             # Upload/evaluate overlap (the P7 double-buffer, on device
             # streams): a big GLOBAL-tier flush splits into row chunks —
             # chunk i+1's upload rides the transfer engine while chunk
@@ -456,22 +472,25 @@ class MetricAggregator:
             t0 = time.perf_counter()
             outs = []
             first_dev = None
-            # normalize the network choice to the EFFECTIVE program: on
-            # the XLA-twin route both variants are one program, so the
-            # static flag (and the compile-guard key) must not split it
-            uniform = (snap["digests"]["uniform"]
-                       and serving.pallas_eval_applies(
-                           rows_per, dv.shape[1], dv.dtype))
             for c in range(n_chunks):
                 sl = slice(c * rows_per, (c + 1) * rows_per)
-                dvd, dwd, mmd = self.digests.put_dense(
-                    dv[sl], dw[sl], minmax[:, sl])
-                if first_dev is None:
-                    first_dev = (dvd, dwd)
-                with self._CompileGuard(self, (dv[sl].shape, uniform)):
-                    outs.append(self.flush_fn(dvd, dwd, mmd,
-                                              self._pct_arr,
-                                              uniform=uniform))
+                if uniform:
+                    dvd, depd = self.digests.put_dense_uniform(
+                        dv[sl], dw[sl])
+                    if first_dev is None:
+                        first_dev = (dvd, depd)
+                    with self._CompileGuard(self, (dv[sl].shape, True)):
+                        outs.append(self.flush_fn.depth_variant(
+                            dvd, depd, self._pct_arr))
+                else:
+                    dvd, dwd, mmd = self.digests.put_dense(
+                        dv[sl], dw[sl], minmax[:, sl])
+                    if first_dev is None:
+                        first_dev = (dvd, dwd)
+                    with self._CompileGuard(self, (dv[sl].shape, False)):
+                        outs.append(self.flush_fn(dvd, dwd, mmd,
+                                                  self._pct_arr,
+                                                  uniform=False))
             seg["dispatch_s"] = time.perf_counter() - t0
             t0 = time.perf_counter()
             fetched = serving.fetch(tuple(outs))
@@ -479,6 +498,15 @@ class MetricAggregator:
             seg["device_s"] = time.perf_counter() - t0
             seg["readback_bytes"] = ev.nbytes
             host["dense_dev"] = first_dev
+            host["dense_uniform"] = uniform
+            if uniform:
+                # slim readback: ev carries the quantile columns only;
+                # exact f64 totals come from the host accumulators
+                host["qs"] = ev[:nd, :n_cols]
+                host["counts"] = np.asarray(dpart["d_weight"],
+                                            np.float64)
+                host["sums"] = np.asarray(dpart["d_sum"], np.float64)
+                return host
         else:
             multi = jax.process_count() > 1
             if multi and is_local:
@@ -721,6 +749,8 @@ class MetricAggregator:
             "d_min": d.d_min[drows].copy(),
             "d_max": d.d_max[drows].copy(),
             "d_rsum": d.d_rsum[drows].copy(),
+            "d_weight": d.d_weight[drows].copy(),
+            "d_sum": d.d_sum[drows].copy(),
         }
 
         # key-dictionary fingerprints for the multi-controller lockstep
@@ -885,8 +915,14 @@ class MetricAggregator:
             for off in range(0, len(fidx), max_rows):
                 chunk = fidx[off:off + max_rows]
                 fpad = self._padded_rows(chunk)
-                mexp, wexp = serving.digest_export(
-                    dvd, dwd, jnp.asarray(fpad), compression, ccap)
+                if host.get("dense_uniform"):
+                    # depth-vector build: dwd holds per-row depths; the
+                    # 0/1 weights rebuild on device for the subset
+                    mexp, wexp = serving.digest_export_uniform(
+                        dvd, dwd, jnp.asarray(fpad), compression, ccap)
+                else:
+                    mexp, wexp = serving.digest_export(
+                        dvd, dwd, jnp.asarray(fpad), compression, ccap)
                 fetched_m, fetched_w = serving.fetch((mexp, wexp))
                 m_parts.append(fetched_m[:len(chunk)])
                 w_parts.append(fetched_w[:len(chunk)])

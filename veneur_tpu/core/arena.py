@@ -580,7 +580,7 @@ class DigestArena(_ArenaBase):
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
                  compression: float = td.DEFAULT_COMPRESSION,
                  mesh=None, n_lanes: Optional[int] = None,
-                 eval_dtype=np.float32):
+                 eval_dtype=np.float32, bf16_staging: bool = False):
         super().__init__(capacity)
         self.compression = compression
         self.ccap = td.centroid_capacity(compression)
@@ -591,6 +591,16 @@ class DigestArena(_ArenaBase):
         # — the reference computes in float64 throughout
         # (tdigest/merging_digest.go:23-40).  Requires jax_enable_x64.
         self.eval_dtype = np.dtype(eval_dtype)
+        # bf16 staging option (digest_bf16_staging): the dense VALUE
+        # matrix uploads as bfloat16 (half the flush's dominant upload),
+        # bounding quantile values to bf16's ~2^-8 relative rounding —
+        # within t-digest's own accuracy envelope (merging_digest's
+        # median bar is 2%) but NOT exact; weights/minmax stay f32
+        if bf16_staging:
+            import ml_dtypes
+            self.stage_dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            self.stage_dtype = self.eval_dtype
         self.n_replicas = self._init_mesh_lanes(mesh, "digest")
         if mesh is not None:
             from veneur_tpu.parallel.mesh import SHARD_AXIS
@@ -606,6 +616,12 @@ class DigestArena(_ArenaBase):
         self.d_min = np.full(capacity, np.inf)
         self.d_max = np.full(capacity, -np.inf)
         self.d_rsum = np.zeros(capacity)
+        # exact f64 interval totals (local samples land via sync's l_*
+        # adds; imported centroids via merge_digest) — the flush's
+        # count/sum emission reads THESE instead of fetching the device
+        # f32 totals, trimming two columns off every readback
+        self.d_weight = np.zeros(capacity)
+        self.d_sum = np.zeros(capacity)
         # local-samples-only accumulators
         self.l_weight = np.zeros(capacity)
         self.l_min = np.full(capacity, np.inf)
@@ -637,6 +653,8 @@ class DigestArena(_ArenaBase):
         self.d_min = pad(self.d_min, np.inf)
         self.d_max = pad(self.d_max, -np.inf)
         self.d_rsum = pad(self.d_rsum, 0)
+        self.d_weight = pad(self.d_weight, 0)
+        self.d_sum = pad(self.d_sum, 0)
         self.l_weight = pad(self.l_weight, 0)
         self.l_min = pad(self.l_min, np.inf)
         self.l_max = pad(self.l_max, -np.inf)
@@ -717,6 +735,10 @@ class DigestArena(_ArenaBase):
         # host scalar updates (vectorized)
         np.minimum.at(self.d_min, rows, vals)
         np.maximum.at(self.d_max, rows, vals)
+        # exact interval totals over ALL staged points (imported
+        # centroids stage through _rows too, so one pass covers both)
+        np.add.at(self.d_weight, rows, wts)
+        np.add.at(self.d_sum, rows, vals * wts)
         with np.errstate(divide="ignore"):
             np.add.at(self.d_rsum, rows[local],
                       wts[local] / vals[local])
@@ -846,13 +868,22 @@ class DigestArena(_ArenaBase):
 
     def build_dense(self, staged, touched: np.ndarray,
                     d_min_t: np.ndarray, d_max_t: np.ndarray,
-                    u_floor: int = 0, d_floor: int = 0):
+                    u_floor: int = 0, d_floor: int = 0,
+                    uniform: bool = False):
         """Compact dense build for the flush program: map the staged COO
         onto touched-row-ordered dense matrices `[U, D]` (U = padded
         touched count, D = padded max depth), plus the stacked [2, U]
         min/max from the SNAPSHOT scalar copies (the live arrays are
         already reset by the time this runs).  Pure host numpy; the
-        caller device_puts the result (outside the aggregator lock)."""
+        caller device_puts the result (outside the aggregator lock).
+
+        uniform=True (legal only when every staged weight is exactly 1,
+        `staged_uniform`): the middle return is a per-row int32 DEPTH
+        VECTOR `[U]` instead of the `[U, D]` weight matrix — staged
+        points pack contiguously from column 0, so `col < depth[row]`
+        is the occupancy.  Halves both the host build work and the
+        bytes crossing the host->device link (the e2e flush's dominant
+        cost; VERDICT r4 items 3-4)."""
         rows, vals, wts = staged
         nd = len(touched)
         u_pad = self.n_shards * _pow2(
@@ -861,19 +892,34 @@ class DigestArena(_ArenaBase):
         dense_id[touched] = np.arange(nd)
         r = dense_id[rows]
         order = np.argsort(r, kind="stable")
-        r, v, w = r[order], vals[order], wts[order]
+        r, v = r[order], vals[order]
         first = np.searchsorted(r, np.arange(nd))
         pos = np.arange(len(r)) - first[r]
         depth = max(int(pos.max()) + 1 if len(r) else 1, d_floor)
         d_pad = max(2, self.n_replicas * _pow2(
             -(-depth // self.n_replicas)))
+        if uniform:
+            # bf16 staging applies here only: the general (weighted)
+            # path must keep eval_dtype so device totals and exported
+            # centroid weights stay exact
+            dv = np.zeros((u_pad, d_pad), self.stage_dtype)
+            dv[r, pos] = v
+            # int16 is exact (depths <= DENSE_DEPTH_CAP < 2^15) and
+            # halves the vector's bytes on the link
+            depths_vec = np.zeros(u_pad, np.int16)
+            if len(r):
+                depths_vec[:nd] = np.bincount(
+                    r.astype(np.int64), minlength=nd)[:nd]
+            # minmax stays host-side on this path (never uploaded);
+            # returned as None so nobody builds it for nothing
+            return dv, depths_vec, None
         dv = np.zeros((u_pad, d_pad), self.eval_dtype)
-        dw = np.zeros((u_pad, d_pad), self.eval_dtype)
         dv[r, pos] = v
-        dw[r, pos] = w
         minmax = np.zeros((2, u_pad), self.eval_dtype)
         minmax[0, :nd] = d_min_t
         minmax[1, :nd] = d_max_t
+        dw = np.zeros((u_pad, d_pad), self.eval_dtype)
+        dw[r, pos] = wts[order]
         return dv, dw, minmax
 
     def put_dense(self, dv: np.ndarray, dw: np.ndarray,
@@ -883,12 +929,20 @@ class DigestArena(_ArenaBase):
                 serving.put(dw, self._dense_shd),
                 serving.put(minmax, self._minmax_shd))
 
+    def put_dense_uniform(self, dv: np.ndarray, depths: np.ndarray):
+        """Device-put the uniform (depth-vector) dense build — no
+        weight matrix and no minmax (see digest_eval_uniform)."""
+        return (serving.put(dv, self._dense_shd),
+                serving.put(depths, None))
+
     def reset_rows(self, rows: np.ndarray) -> None:
         if len(rows) == 0:
             return
         self.d_min[rows] = np.inf
         self.d_max[rows] = -np.inf
         self.d_rsum[rows] = 0
+        self.d_weight[rows] = 0
+        self.d_sum[rows] = 0
         self.l_weight[rows] = 0
         self.l_min[rows] = np.inf
         self.l_max[rows] = -np.inf

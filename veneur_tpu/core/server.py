@@ -200,6 +200,7 @@ class Server:
             set_initial_capacity=cfg.set_arena_initial_capacity,
             hll_legacy_migration=cfg.hll_legacy_migration,
             digest_float64=cfg.digest_float64,
+            digest_bf16_staging=cfg.digest_bf16_staging,
             flush_upload_chunks=cfg.flush_upload_chunks)
         self.forwarder = forwarder
 

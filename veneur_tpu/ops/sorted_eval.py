@@ -134,11 +134,17 @@ _PAD_CMID = 3.0e38
 def _eval_tail(idx, m_clean, cmid, total, sums, n_real, mm, qs, out_ref):
     """Shared quantile-extraction tail: per-percentile rank search on
     cmid + one-hot neighbor gathers + midpoint interpolation, matching
-    `td.weighted_eval` (Hazen convention) bit-for-bit."""
+    `td.weighted_eval` (Hazen convention) bit-for-bit.
+
+    mm=None skips the min/max clamp (a provable no-op on uniform
+    intervals, where interpolation stays between data values);
+    sums=None emits the quantile rows alone (totals come from host
+    accumulators on that path)."""
     n_pct = qs.shape[1]
     hi_bound = jnp.maximum(n_real - 1, 1)
     first_mean = m_clean[0:1, :]            # sorted: row 0 is the min
-    dmin, dmax = mm[0:1, :], mm[1:2, :]
+    if mm is not None:
+        dmin, dmax = mm[0:1, :], mm[1:2, :]
 
     rows = []
     for p in range(n_pct):        # static: unrolled per quantile
@@ -157,10 +163,13 @@ def _eval_tail(idx, m_clean, cmid, total, sums, n_real, mm, qs, out_ref):
                        0.0)
         q = m_lo + (m_hi - m_lo) * jnp.clip(tt, 0.0, 1.0)
         q = jnp.where(n_real <= 1, first_mean, q)
-        q = jnp.clip(q, dmin, dmax)
+        if mm is not None:
+            q = jnp.clip(q, dmin, dmax)
         q = jnp.where(total > 0, q, 0.0)
         rows.append(q)
-    out_ref[...] = jnp.concatenate(rows + [total, sums], axis=0)
+    if sums is not None:
+        rows = rows + [total, sums]
+    out_ref[...] = jnp.concatenate(rows, axis=0)
 
 
 def _kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
@@ -237,6 +246,74 @@ def _kernel_uniform(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
     cmid = jnp.where(occ_sorted, idx.astype(jnp.float32) + 0.5,
                      _PAD_CMID)
     _eval_tail(idx, m_clean, cmid, total, sums, n_real, mm, qs, out_ref)
+
+
+def _kernel_uniform_depth(mean_ref, depth_ref, qs_ref, out_ref):
+    """_kernel_uniform fed by a PER-ROW DEPTH VECTOR instead of the
+    [K, D] weight matrix: staged points pack contiguously from column 0
+    (arena build_dense), so `col < depth[row]` IS the occupancy — the
+    weight matrix never crosses HBM at all.
+
+    Also drops the minmax operand and the total/sums output rows: on a
+    uniform interval every staged point is a true sample, so the
+    quantile interpolation between data points cannot leave the data
+    range (the clip is a provable no-op), and the exact f64 totals
+    live in host accumulators (`DigestArena.d_weight`/`d_sum`).  The
+    flush's readback is therefore the quantile columns alone."""
+    m = mean_ref[...].T           # [D, T]
+    dep = depth_ref[...]          # [1, T] int32
+    qs = qs_ref[...]              # [1, P]
+    d, t = m.shape
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
+    occ0 = idx < dep
+    key = jnp.where(occ0, m, _PAD_KEY)
+    n_real = dep
+    k = 2
+    while k <= d:                 # static: fully unrolled network
+        j = k // 2
+        while j >= 1:
+            key = _cmp_exchange_keys(key, j, k, idx)
+            j //= 2
+        k *= 2
+    occ_sorted = idx < n_real     # real points sort before +inf padding
+    m_clean = jnp.where(occ_sorted, key, 0.0)
+    total = n_real.astype(jnp.float32)
+    cmid = jnp.where(occ_sorted, idx.astype(jnp.float32) + 0.5,
+                     _PAD_CMID)
+    _eval_tail(idx, m_clean, cmid, total, None, n_real, None, qs,
+               out_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def uniform_eval(mean: jax.Array, depths: jax.Array,
+                 percentiles: jax.Array,
+                 interpret: bool = False) -> jax.Array:
+    """Depth-vector flush evaluation: `[K, D]` values whose first
+    depths[k] columns are real weight-1 points -> `[K, P]` quantiles.
+    Matches weighted_eval(mean, w, ..., uniform=True)'s quantile
+    columns for w = (col < depths[row]), at half the HBM traffic and a
+    P-column readback (totals/sums come from the host accumulators)."""
+    u, d = mean.shape
+    n_pct = percentiles.shape[0]
+    tile = _lane_tile(u, d)
+    qs = percentiles.reshape(1, n_pct).astype(jnp.float32)
+    # narrow upload dtypes (bf16 values / int16 depths) widen here, on
+    # device, before the kernel reads them
+    out = pl.pallas_call(
+        _kernel_uniform_depth,
+        grid=(u // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, n_pct), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_pct, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n_pct, u), jnp.float32),
+        interpret=interpret,
+    )(mean.astype(jnp.float32),
+      depths.reshape(1, u).astype(jnp.int32), qs)
+    return out.T                                                # [U, P]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "uniform"))

@@ -173,6 +173,40 @@ def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
     return td.weighted_eval(dv, dw, d_min, d_max, percentiles)
 
 
+def digest_eval_uniform(dv: jax.Array, depths: jax.Array,
+                        percentiles: jax.Array) -> jax.Array:
+    """Depth-vector evaluation for uniform (all-weight-1) intervals ->
+    `[U, P]` quantiles only: the weight matrix never uploads (occupancy
+    is `col < depths[row]`), no minmax operand (each staged point is a
+    true sample, so interpolation cannot leave the data range), and the
+    totals come from host accumulators instead of the readback.  Routes
+    to the fused Pallas depth kernel when shapes allow, else
+    reconstructs the 0/1 weights and the row ranges ON DEVICE (free
+    next to uploading them) and runs the XLA twin."""
+    import os
+
+    from veneur_tpu.ops import sorted_eval as se
+    u, d = dv.shape
+    n_pct = percentiles.shape[0]
+    if (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
+            and dv.dtype in (jnp.float32, jnp.bfloat16)
+            and se.usable(u, d, jax.default_backend())):
+        return se.uniform_eval(dv, depths, percentiles)
+    # XLA-twin fallback: widen narrow staging, keep f64 as f64
+    dt = jnp.float64 if dv.dtype == jnp.float64 else jnp.float32
+    dv = dv.astype(dt)
+    dw = (jnp.arange(d, dtype=jnp.int32)[None, :]
+          < depths[:, None].astype(jnp.int32)).astype(dt)
+    occ = dw > 0
+    d_min = jnp.where(depths > 0,
+                      jnp.where(occ, dv, jnp.inf).min(axis=1), 0.0)
+    d_max = jnp.where(depths > 0,
+                      jnp.where(occ, dv, -jnp.inf).max(axis=1), 0.0)
+    return td.weighted_eval(dv, dw, d_min.astype(dt),
+                            d_max.astype(dt),
+                            percentiles)[:, :n_pct]
+
+
 def flush_body(inputs: FlushInputs, percentiles: jax.Array,
                axis: Optional[str],
                uniform: bool = False) -> FlushOutputs:
@@ -246,9 +280,22 @@ def make_serving_flush(mesh: Optional[Mesh]):
     """
     if mesh is None:
         @functools.partial(jax.jit, static_argnames=("uniform",))
-        def unmeshed(dv, dw, minmax, pct, uniform=False):
+        def general(dv, dw, minmax, pct, uniform=False):
             return digest_eval(dv, dw, minmax[0], minmax[1], pct,
                                uniform=uniform)
+
+        @jax.jit
+        def depth_variant(dv, depths, pct):
+            return digest_eval_uniform(dv, depths, pct)
+
+        def unmeshed(dv, dw, minmax, pct, uniform=False):
+            return general(dv, dw, minmax, pct, uniform=uniform)
+
+        unmeshed.lower = general.lower
+        # uniform intervals upload (values, per-row depths) instead of
+        # (values, weights) — half the bytes; the aggregator routes
+        # there whenever DigestArena.staged_uniform held
+        unmeshed.depth_variant = depth_variant
         return unmeshed
 
     spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
@@ -312,6 +359,24 @@ def digest_export(dense_v: jax.Array, dense_w: jax.Array,
     `merging_digest.go:474-483`).  Gathers rows first so both the compute
     and the readback scale with the forwarded subset, not the arena."""
     return td.compress(dense_v[rows], dense_w[rows], compression, cap)
+
+
+@functools.partial(jax.jit, static_argnames=("compression", "cap"))
+def digest_export_uniform(dense_v: jax.Array, depths: jax.Array,
+                          rows: jax.Array, compression: float, cap: int
+                          ) -> tuple[jax.Array, jax.Array]:
+    """digest_export for the depth-vector (uniform) dense build: the 0/1
+    weights of the gathered rows are reconstructed ON DEVICE from the
+    per-row depths (they never crossed the host->device link)."""
+    d = dense_v.shape[1]
+    sub_depths = depths[rows].astype(jnp.int32)
+    # weights in f32 regardless of the value staging dtype: bf16 cannot
+    # represent integer counts above 256, and compress() accumulates
+    # them (cumsum/total) — bf16 weights would corrupt exported digests
+    dw = (jnp.arange(d, dtype=jnp.int32)[None, :]
+          < sub_depths[:, None]).astype(jnp.float32)
+    return td.compress(dense_v[rows].astype(jnp.float32), dw,
+                       compression, cap)
 
 
 @functools.partial(jax.jit, static_argnames=("compression", "cap"))
