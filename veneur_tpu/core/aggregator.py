@@ -414,14 +414,18 @@ class MetricAggregator:
             mm = jax.ShapeDtypeStruct((2, u_pad), dt)
             # both production programs per bucket: the depth-vector
             # uniform variant (raw-sample intervals — the common case on
-            # every backend) and the general weighted one
-            # int16: MUST match the production upload dtype
-            # (arena build_dense) or the prewarmed signature misses and
-            # the first flush pays an uncovered in-flush compile
+            # every backend) and the general weighted one.
+            # The structs MUST match the production upload dtypes
+            # (arena build_dense: stage_dtype values — bf16 when the
+            # option is on — and int16 depths) or the prewarmed
+            # signature misses and the first flush pays an uncovered
+            # in-flush compile
+            dv_u = jax.ShapeDtypeStruct((u_pad, d_pad),
+                                        self.digests.stage_dtype)
             dep = jax.ShapeDtypeStruct((u_pad,), np.int16)
             with self._CompileGuard(self, ((u_pad, d_pad), True)):
                 self.flush_fn.depth_variant.lower(
-                    dv, dep, self._pct_arr).compile()
+                    dv_u, dep, self._pct_arr).compile()
             n += 1
             with self._CompileGuard(self, ((u_pad, d_pad), False)):
                 self.flush_fn.lower(dv, dv, mm, self._pct_arr,

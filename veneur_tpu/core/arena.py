@@ -43,6 +43,10 @@ from veneur_tpu.sketches import tdigest as td
 # staged depth beyond which a row pre-reduces into <= C weighted points
 # (bounds the flush dense matrix width)
 DENSE_DEPTH_CAP = 512
+
+# staged-element count above which the dense build uses the native C++
+# single-pass fill (vn_fill_dense) instead of numpy argsort+scatter
+_NATIVE_FILL_MIN = 65536
 # per-row column bound inside one pre-reduction launch: a single key with
 # millions of staged samples splits into chunks of this depth
 HOT_CHUNK_WIDTH = 16_384
@@ -890,7 +894,55 @@ class DigestArena(_ArenaBase):
             -(-max(nd, u_floor, 1) // self.n_shards))
         dense_id = np.full(self.capacity, -1, np.int64)
         dense_id[touched] = np.arange(nd)
-        r = dense_id[rows]
+
+        # native single-pass fill (vn_fill_dense): per-dense-row write
+        # cursors replace numpy's argsort + gathers + fancy scatter —
+        # ~5x the host build throughput at 1M keys.  Depth comes from
+        # the bincount (cheap) so the dense shape is known up front.
+        native_fill = None
+        # f32 eval only: the native fill writes f32 buffers, which would
+        # silently round digest_float64's exact-f64 staging
+        if len(rows) >= _NATIVE_FILL_MIN and self.eval_dtype == np.float32:
+            try:
+                from veneur_tpu import ingest as ingest_mod
+                ingest_mod.load_library()
+                native_fill = ingest_mod.fill_dense
+            except Exception:
+                native_fill = None
+        rid = dense_id[rows]
+        if native_fill is not None and len(rid) and rid.min() < 0:
+            # staged rows outside `touched` (shouldn't happen; invariant
+            # is touched >= staged) — the numpy path is the debuggable one
+            native_fill = None
+        if native_fill is not None:
+            counts = np.bincount(rid, minlength=nd)
+            depth = max(int(counts.max()) if len(rows) else 1, d_floor, 1)
+            d_pad = max(2, self.n_replicas * _pow2(
+                -(-depth // self.n_replicas)))
+            rows64 = np.ascontiguousarray(rows, np.int64)
+            vals64 = np.ascontiguousarray(vals, np.float64)
+            dv = np.zeros((u_pad, d_pad), np.float32)
+            depths_vec = np.zeros(u_pad, np.int16)
+            dw = (None if uniform
+                  else np.zeros((u_pad, d_pad), np.float32))
+            wts64 = (None if uniform
+                     else np.ascontiguousarray(wts, np.float64))
+            dropped = native_fill(rows64, vals64, wts64, dense_id,
+                                  dv, dw, depths_vec)
+            if dropped == 0:
+                minmax = None
+                if not uniform:
+                    minmax = np.zeros((2, u_pad), self.eval_dtype)
+                    minmax[0, :nd] = d_min_t
+                    minmax[1, :nd] = d_max_t
+                if uniform and self.stage_dtype != np.float32:
+                    dv = dv.astype(self.stage_dtype)
+                if uniform:
+                    return dv, depths_vec, None
+                return dv, dw, minmax
+            # overflow/unmapped rows: fall through to the numpy builder
+
+        r = rid
         order = np.argsort(r, kind="stable")
         r, v = r[order], vals[order]
         first = np.searchsorted(r, np.arange(nd))

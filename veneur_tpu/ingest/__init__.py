@@ -97,8 +97,35 @@ def load_library():
         lib.vn_blast_udp.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.vn_fill_dense.restype = ctypes.c_longlong
+        lib.vn_fill_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_longlong, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int]
         _lib = lib
         return lib
+
+
+def fill_dense(rows, vals, wts, dense_id, dv, dw, depths,
+               n_threads: int = 4) -> int:
+    """Native COO->dense fill (see vn_fill_dense in ingest_engine.cpp).
+    Arrays must be C-contiguous with dtypes int64/float64/float64/
+    int64/float32/float32/int16.  Returns dropped-element count (caller
+    falls back to the numpy builder when nonzero)."""
+    import numpy as np
+
+    lib = load_library()
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+
+    assert rows.dtype == np.int64 and vals.dtype == np.float64
+    assert dv.dtype == np.float32 and dense_id.dtype == np.int64
+    u_pad, d_pad = dv.shape
+    return int(lib.vn_fill_dense(
+        ptr(rows), ptr(vals), ptr(wts), len(rows), ptr(dense_id),
+        ptr(dv), ptr(dw), ptr(depths), u_pad, d_pad, n_threads))
 
 
 def metro64(data: bytes) -> int:
