@@ -512,59 +512,114 @@ def bench_mesh_scaling_cpu() -> dict | None:
     return devs
 
 
+_GLOBAL_CHILD = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.http_api import HttpApi
+from veneur_tpu.sinks import simple as simple_sinks
+cfg = config_mod.Config(grpc_address="127.0.0.1:0",
+                        interval=600, percentiles=[0.5],
+                        hostname="bench-g")
+srv = Server(cfg, extra_metric_sinks=[simple_sinks.ChannelMetricSink()])
+srv.start()
+api = HttpApi(srv, "127.0.0.1:0")
+api.start()
+print(f"PORTS {srv.grpc_import.port} {api.address[1]}", flush=True)
+import time
+while True:
+    time.sleep(1)
+'''
+
+
 def bench_proxy_chain() -> float | None:
-    """Proxy-tier fan-in throughput: metrics routed through a real Proxy
-    into two real globals over loopback gRPC, measured at the importing
-    aggregators.  Exercises the fleet-internal V1 batch transport with
-    its reference-compatible V2 stream fallback (proxy/connect.py)."""
+    """Proxy-tier fan-in throughput: pre-serialized MetricList payloads
+    through a real Proxy (native wire router, parse-free) into two real
+    global SUBPROCESSES over loopback gRPC, measured at the importing
+    aggregators via their /debug/vars.  Subprocesses matter: in-process
+    globals would share the proxy's GIL and measure contention that a
+    real fleet (one process per node) never pays."""
+    import json as _json
+    import tempfile
     import time as _t
+    import urllib.request
 
-    from veneur_tpu import config as config_mod
-    from veneur_tpu.core.server import Server
-    from veneur_tpu.protocol import metric_pb2
+    from veneur_tpu.protocol import forward_pb2, metric_pb2
     from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
-    from veneur_tpu.sinks import simple as simple_sinks
 
-    def boot_global():
-        cfg = config_mod.Config(grpc_address="127.0.0.1:0", interval=600,
-                                percentiles=[0.5], hostname="bench-g")
-        srv = Server(cfg, extra_metric_sinks=[
-            simple_sinks.ChannelMetricSink()])
-        srv.start()
-        return srv
-
-    g1, g2 = boot_global(), boot_global()
-    proxy = Proxy(ProxyConfig(
-        static_destinations=[f"127.0.0.1:{g1.grpc_import.port}",
-                             f"127.0.0.1:{g2.grpc_import.port}"],
-        discovery_interval=600, send_buffer_size=16384))
-    proxy.start()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    script = os.path.join(tempfile.mkdtemp(prefix="bench-proxy-"),
+                          "global_child.py")
+    with open(script, "w") as f:
+        f.write(_GLOBAL_CHILD)
+    procs, ports = [], []
+    proxy = None
     try:
+        for _ in range(2):
+            p = subprocess.Popen([sys.executable, script],
+                                 stdout=subprocess.PIPE, text=True,
+                                 cwd=REPO, env=env)
+            procs.append(p)
+        for p in procs:
+            line = p.stdout.readline()
+            if not line.startswith("PORTS"):
+                log(f"proxy arm: global child failed to boot ({line!r})")
+                return None
+            _, grpc_port, http_port = line.split()
+            ports.append((int(grpc_port), int(http_port)))
+
+        proxy = Proxy(ProxyConfig(
+            static_destinations=[f"127.0.0.1:{gp}" for gp, _ in ports],
+            discovery_interval=600, send_buffer_size=16384))
+        proxy.start()
         _t.sleep(0.3)
-        n = 200_000
+        n = 600_000
         ms = [metric_pb2.Metric(
             name=f"px{i % 5000}", type=metric_pb2.Counter,
             tags=["env:prod", f"shard:{i % 16}"],
             counter=metric_pb2.CounterValue(value=1)) for i in range(n)]
+        # pre-serialized inbound payloads: exactly what the proxy's gRPC
+        # handler receives (the sender's serialization happens on the
+        # sender's cores in production); the timed region covers the
+        # native wire routing + delivery + the globals' batched import
+        payloads = [forward_pb2.MetricList(
+            metrics=ms[i:i + 2000]).SerializeToString()
+            for i in range(0, n, 2000)]
+
+        def imported_total() -> int:
+            tot = 0
+            for _, hp in ports:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hp}/debug/vars",
+                        timeout=5) as r:
+                    tot += _json.loads(r.read())["imported"]
+            return tot
+
         t0 = _t.perf_counter()
-        for i in range(0, n, 2000):
-            proxy.handle_metrics(ms[i:i + 2000])
+        for p in payloads:
+            proxy.handle_metrics_raw(p)
         deadline = _t.time() + 60
         done = 0
         while _t.time() < deadline:
-            done = g1.aggregator.imported + g2.aggregator.imported
+            done = imported_total()
             if done >= n:
                 break
             _t.sleep(0.05)
         el = _t.perf_counter() - t0
         rate = done / el if el > 0 else 0.0
-        log(f"proxy arm: {done}/{n} metrics through proxy -> 2 globals "
-            f"in {el:.2f}s = {rate:,.0f} metrics/s end-to-end")
+        log(f"proxy arm: {done}/{n} metrics through proxy -> 2 global "
+            f"processes in {el:.2f}s = {rate:,.0f} metrics/s end-to-end")
         return rate
     finally:
-        g1.shutdown()
-        g2.shutdown()
-        proxy.stop()
+        if proxy is not None:
+            proxy.stop()
+        for p in procs:
+            p.kill()
 
 
 def bench_baseline_native() -> float | None:

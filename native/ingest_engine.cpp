@@ -937,3 +937,396 @@ long long vn_fill_dense(const long long* rows, const double* vals,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Proxy wire router (VERDICT r4 item 5): parse-free consistent-hash
+// routing of a serialized forwardrpc.MetricList.  A MetricList body is
+// `repeated Metric metrics = 1` — a sequence of (tag 0x0A, varint len,
+// Metric bytes) records — and protobuf messages concatenate, so
+// splitting the input at record boundaries and regrouping the raw
+// records per destination yields VALID MetricList bodies with zero
+// (de)serialization.  Only the three routing fields are scanned per
+// metric (name=1, tags=2, type=3; `metricpb/metric.proto`), the key is
+// name + typename + ",".join(tags) (proxy routing contract,
+// `handlers.go:111-112`), hashed with zlib-compatible CRC32 onto the
+// caller's consistent ring.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint32_t crc32_zlib(const uint8_t* p, size_t n, uint32_t seed) {
+  static uint32_t table[256];
+  static std::once_flag once;
+  std::call_once(once, [] {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
+      table[i] = c;
+    }
+  });
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+inline bool read_varint(const uint8_t*& p, const uint8_t* end,
+                        uint64_t& out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    v |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+static const char* kTypeNames[5] = {"counter", "gauge", "histogram",
+                                    "set", "timer"};
+
+struct RouteResult {
+  std::vector<uint8_t> blob;                 // dest regions, concatenated
+  std::vector<long long> dest_off;           // n_dests+1 prefix offsets
+  std::vector<long long> dest_count;         // metrics per dest
+  std::vector<std::vector<long long>> chunk_off;  // per dest, relative
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque RouteResult*, or null on malformed input (caller
+// falls back to the Python protobuf path).
+void* vn_route(const uint8_t* data, long long len,
+               const uint32_t* ring_hashes, const int32_t* ring_dests,
+               long long ring_len, int n_dests, int chunk_max) {
+  if (n_dests <= 0 || ring_len <= 0) return nullptr;
+  struct Rec {
+    const uint8_t* start;   // record start (incl. tag+len prefix)
+    long long size;
+    int dest;
+  };
+  std::vector<Rec> recs;
+  std::vector<uint8_t> key;
+  key.reserve(256);
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  while (p < end) {
+    uint64_t tag;
+    const uint8_t* rec_start = p;
+    if (!read_varint(p, end, tag)) return nullptr;
+    int field = (int)(tag >> 3), wt = (int)(tag & 7);
+    if (field != 1 || wt != 2) {
+      // non-metrics field in the list: unexpected; skip by wire type
+      uint64_t tmp;
+      switch (wt) {
+        case 0: if (!read_varint(p, end, tmp)) return nullptr; break;
+        case 1: if (end - p < 8) return nullptr; p += 8; break;
+        case 2: if (!read_varint(p, end, tmp) ||
+                    (uint64_t)(end - p) < tmp) return nullptr;
+                p += tmp; break;
+        case 5: if (end - p < 4) return nullptr; p += 4; break;
+        default: return nullptr;
+      }
+      continue;
+    }
+    uint64_t mlen;
+    if (!read_varint(p, end, mlen) || (uint64_t)(end - p) < mlen)
+      return nullptr;
+    const uint8_t* m = p;
+    const uint8_t* mend = p + mlen;
+    p = mend;
+    // scan the Metric for name/tags/type
+    const uint8_t* name = nullptr;
+    uint64_t name_len = 0;
+    uint64_t type_val = 0;
+    key.clear();
+    std::vector<std::pair<const uint8_t*, uint64_t>> tags;
+    const uint8_t* q = m;
+    while (q < mend) {
+      uint64_t mtag;
+      if (!read_varint(q, mend, mtag)) return nullptr;
+      int mf = (int)(mtag >> 3), mwt = (int)(mtag & 7);
+      if (mf == 1 && mwt == 2) {
+        if (!read_varint(q, mend, name_len) ||
+            (uint64_t)(mend - q) < name_len) return nullptr;
+        name = q;
+        q += name_len;
+      } else if (mf == 2 && mwt == 2) {
+        uint64_t tl;
+        if (!read_varint(q, mend, tl) ||
+            (uint64_t)(mend - q) < tl) return nullptr;
+        tags.emplace_back(q, tl);
+        q += tl;
+      } else if (mf == 3 && mwt == 0) {
+        if (!read_varint(q, mend, type_val)) return nullptr;
+      } else {
+        uint64_t tmp;
+        switch (mwt) {
+          case 0: if (!read_varint(q, mend, tmp)) return nullptr; break;
+          case 1: if (mend - q < 8) return nullptr; q += 8; break;
+          case 2: if (!read_varint(q, mend, tmp) ||
+                      (uint64_t)(mend - q) < tmp) return nullptr;
+                  q += tmp; break;
+          case 5: if (mend - q < 4) return nullptr; q += 4; break;
+          default: return nullptr;
+        }
+      }
+    }
+    // routing key: name + typename + ",".join(tags)
+    if (name) key.insert(key.end(), name, name + name_len);
+    if (type_val < 5) {
+      const char* tn = kTypeNames[type_val];
+      key.insert(key.end(), (const uint8_t*)tn,
+                 (const uint8_t*)tn + strlen(tn));
+    }
+    for (size_t t = 0; t < tags.size(); t++) {
+      if (t) key.push_back(',');
+      key.insert(key.end(), tags[t].first, tags[t].first + tags[t].second);
+    }
+    uint32_t h = crc32_zlib(key.data(), key.size(), 0);
+    // bisect_right(ring_hashes, h), wrapping to 0 (consistent.py)
+    long long lo = 0, hi = ring_len;
+    while (lo < hi) {
+      long long mid = (lo + hi) >> 1;
+      if (ring_hashes[mid] <= h) lo = mid + 1;
+      else hi = mid;
+    }
+    int dest = ring_dests[lo == ring_len ? 0 : lo];
+    if (dest < 0 || dest >= n_dests) return nullptr;
+    recs.push_back({rec_start, (long long)(p - rec_start), dest});
+  }
+
+  auto* res = new RouteResult();
+  res->dest_off.assign(n_dests + 1, 0);
+  res->dest_count.assign(n_dests, 0);
+  res->chunk_off.resize(n_dests);
+  for (auto& r : recs) {
+    res->dest_off[r.dest + 1] += r.size;
+    res->dest_count[r.dest]++;
+  }
+  for (int d = 0; d < n_dests; d++)
+    res->dest_off[d + 1] += res->dest_off[d];
+  res->blob.resize((size_t)res->dest_off[n_dests]);
+  std::vector<long long> cursor(res->dest_off.begin(),
+                                res->dest_off.end() - 1);
+  std::vector<long long> cnt(n_dests, 0);
+  for (auto& r : recs) {
+    if (cnt[r.dest] % chunk_max == 0)
+      res->chunk_off[r.dest].push_back(
+          cursor[r.dest] - res->dest_off[r.dest]);
+    memcpy(res->blob.data() + cursor[r.dest], r.start, (size_t)r.size);
+    cursor[r.dest] += r.size;
+    cnt[r.dest]++;
+  }
+  for (int d = 0; d < n_dests; d++)
+    res->chunk_off[d].push_back(
+        res->dest_off[d + 1] - res->dest_off[d]);   // end sentinel
+  return res;
+}
+
+void vn_route_dest(void* handle, int d, const uint8_t** ptr,
+                   long long* nbytes, long long* count) {
+  auto* res = (RouteResult*)handle;
+  *ptr = res->blob.data() + res->dest_off[d];
+  *nbytes = res->dest_off[d + 1] - res->dest_off[d];
+  *count = res->dest_count[d];
+}
+
+void vn_route_chunks(void* handle, int d, const long long** offs,
+                     long long* n_bounds) {
+  auto* res = (RouteResult*)handle;
+  *offs = res->chunk_off[d].data();
+  *n_bounds = (long long)res->chunk_off[d].size();
+}
+
+void vn_route_free(void* handle) { delete (RouteResult*)handle; }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Global-tier V1 import scanner: one pass over a serialized MetricList
+// producing columnar (identity hash, kind, value, record range) arrays,
+// so the importing aggregator's python does only dict lookups + one
+// vectorized merge per family — the per-metric python attribute reads
+// (tuple(pb.tags) alone is ~2 us) were the fleet-rate inbound ceiling.
+// Identity = metro64 of (name \0 type \x1F tag \x1E tag ...) under two
+// seeds (128 bits: collisions are ~1e-20 at 1M identities); set and
+// histogram records are handed back as byte ranges for the python slow
+// path (they carry sketches that python merges anyway).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct ImportScan {
+  std::vector<uint64_t> h_lo, h_hi;
+  std::vector<uint8_t> which;   // 0 none/unknown, 1 counter, 2 gauge,
+                                // 3 set, 4 histogram
+  std::vector<uint8_t> mtype;   // metricpb Type enum
+  std::vector<uint8_t> scope;   // metricpb Scope enum
+  std::vector<double> value;    // counter/gauge payload
+  std::vector<long long> rec_off, rec_len;  // Metric submessage range
+};
+
+}  // namespace
+
+extern "C" {
+
+void* vn_import_scan(const uint8_t* data, long long len) {
+  auto* res = new ImportScan();
+  std::vector<uint8_t> key;
+  key.reserve(256);
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, tag)) { delete res; return nullptr; }
+    int field = (int)(tag >> 3), wt = (int)(tag & 7);
+    if (field != 1 || wt != 2) {
+      uint64_t tmp;
+      switch (wt) {
+        case 0: if (!read_varint(p, end, tmp)) { delete res; return nullptr; } break;
+        case 1: if (end - p < 8) { delete res; return nullptr; } p += 8; break;
+        case 2: if (!read_varint(p, end, tmp) ||
+                    (uint64_t)(end - p) < tmp) { delete res; return nullptr; }
+                p += tmp; break;
+        case 5: if (end - p < 4) { delete res; return nullptr; } p += 4; break;
+        default: delete res; return nullptr;
+      }
+      continue;
+    }
+    uint64_t mlen;
+    if (!read_varint(p, end, mlen) || (uint64_t)(end - p) < mlen) {
+      delete res; return nullptr;
+    }
+    const uint8_t* m = p;
+    const uint8_t* mend = p + mlen;
+    p = mend;
+
+    const uint8_t* name = nullptr;
+    uint64_t name_len = 0;
+    uint64_t type_val = 0, scope_val = 0;
+    uint8_t which = 0;
+    double value = 0.0;
+    std::vector<std::pair<const uint8_t*, uint64_t>> tags;
+    const uint8_t* q = m;
+    bool ok = true;
+    while (q < mend && ok) {
+      uint64_t mtag;
+      if (!read_varint(q, mend, mtag)) { ok = false; break; }
+      int mf = (int)(mtag >> 3), mwt = (int)(mtag & 7);
+      if (mf == 1 && mwt == 2) {
+        if (!read_varint(q, mend, name_len) ||
+            (uint64_t)(mend - q) < name_len) { ok = false; break; }
+        name = q; q += name_len;
+      } else if (mf == 2 && mwt == 2) {
+        uint64_t tl;
+        if (!read_varint(q, mend, tl) ||
+            (uint64_t)(mend - q) < tl) { ok = false; break; }
+        tags.emplace_back(q, tl); q += tl;
+      } else if (mf == 3 && mwt == 0) {
+        if (!read_varint(q, mend, type_val)) { ok = false; break; }
+      } else if (mf == 9 && mwt == 0) {
+        if (!read_varint(q, mend, scope_val)) { ok = false; break; }
+      } else if (mf == 5 && mwt == 2) {          // CounterValue
+        uint64_t sl;
+        if (!read_varint(q, mend, sl) ||
+            (uint64_t)(mend - q) < sl) { ok = false; break; }
+        const uint8_t* s = q;
+        const uint8_t* send_ = q + sl;
+        q = send_;
+        which = 1;
+        while (s < send_) {
+          uint64_t st;
+          if (!read_varint(s, send_, st)) { ok = false; break; }
+          if ((st >> 3) == 1 && (st & 7) == 0) {  // int64 value
+            uint64_t v;
+            if (!read_varint(s, send_, v)) { ok = false; break; }
+            value = (double)(int64_t)v;
+          } else { ok = false; break; }
+        }
+      } else if (mf == 6 && mwt == 2) {          // GaugeValue
+        uint64_t sl;
+        if (!read_varint(q, mend, sl) ||
+            (uint64_t)(mend - q) < sl) { ok = false; break; }
+        const uint8_t* s = q;
+        const uint8_t* send_ = q + sl;
+        q = send_;
+        which = 2;
+        while (s < send_) {
+          uint64_t st;
+          if (!read_varint(s, send_, st)) { ok = false; break; }
+          if ((st >> 3) == 1 && (st & 7) == 1) {  // double value
+            if (send_ - s < 8) { ok = false; break; }
+            memcpy(&value, s, 8); s += 8;
+          } else { ok = false; break; }
+        }
+      } else if (mf == 7 && mwt == 2) {          // HistogramValue
+        uint64_t sl;
+        if (!read_varint(q, mend, sl) ||
+            (uint64_t)(mend - q) < sl) { ok = false; break; }
+        q += sl; which = 4;
+      } else if (mf == 8 && mwt == 2) {          // SetValue
+        uint64_t sl;
+        if (!read_varint(q, mend, sl) ||
+            (uint64_t)(mend - q) < sl) { ok = false; break; }
+        q += sl; which = 3;
+      } else {
+        uint64_t tmp;
+        switch (mwt) {
+          case 0: if (!read_varint(q, mend, tmp)) ok = false; break;
+          case 1: if (mend - q < 8) { ok = false; } else q += 8; break;
+          case 2: if (!read_varint(q, mend, tmp) ||
+                      (uint64_t)(mend - q) < tmp) { ok = false; }
+                  else q += tmp; break;
+          case 5: if (mend - q < 4) { ok = false; } else q += 4; break;
+          default: ok = false;
+        }
+      }
+    }
+    if (!ok) { delete res; return nullptr; }
+    key.clear();
+    if (name) key.insert(key.end(), name, name + name_len);
+    key.push_back(0);
+    key.push_back((uint8_t)type_val);
+    for (auto& t : tags) {
+      key.push_back(0x1E);
+      key.insert(key.end(), t.first, t.first + t.second);
+    }
+    res->h_lo.push_back(metro64(key.data(), key.size(), 1337));
+    res->h_hi.push_back(metro64(key.data(), key.size(), 7331));
+    res->which.push_back(which);
+    res->mtype.push_back((uint8_t)type_val);
+    res->scope.push_back((uint8_t)scope_val);
+    res->value.push_back(value);
+    res->rec_off.push_back((long long)(m - data));
+    res->rec_len.push_back((long long)mlen);
+  }
+  return res;
+}
+
+long long vn_import_scan_n(void* handle) {
+  return (long long)((ImportScan*)handle)->h_lo.size();
+}
+
+void vn_import_scan_arrays(void* handle, const uint64_t** h_lo,
+                           const uint64_t** h_hi, const uint8_t** which,
+                           const uint8_t** mtype, const uint8_t** scope,
+                           const double** value,
+                           const long long** rec_off,
+                           const long long** rec_len) {
+  auto* r = (ImportScan*)handle;
+  *h_lo = r->h_lo.data(); *h_hi = r->h_hi.data();
+  *which = r->which.data(); *mtype = r->mtype.data();
+  *scope = r->scope.data(); *value = r->value.data();
+  *rec_off = r->rec_off.data(); *rec_len = r->rec_len.data();
+}
+
+void vn_import_scan_free(void* handle) { delete (ImportScan*)handle; }
+
+}  // extern "C"

@@ -384,3 +384,65 @@ def test_forward_survives_global_restart():
         tx.close()
     finally:
         local.shutdown()
+
+
+def test_native_import_scan_matches_pb_path():
+    """aggregator.import_payload (native wire scan) must produce the
+    same aggregate state as import_pb_batch (protobuf path) across all
+    four families, and must count nil-valued metrics as failures."""
+    import numpy as np
+
+    import veneur_tpu.ingest as ingest_mod
+    from veneur_tpu.core.aggregator import MetricAggregator
+    from veneur_tpu.protocol import tdigest_pb2
+    from veneur_tpu.sketches import hll as hll_mod
+
+    ingest_mod.load_library()   # loud if the engine can't build
+
+    def mk_metrics():
+        out = []
+        for i in range(40):
+            out.append(metric_pb2.Metric(
+                name=f"c{i % 7}", type=metric_pb2.Counter,
+                tags=[f"env:prod", f"i:{i % 3}"],
+                counter=metric_pb2.CounterValue(value=i + 1)))
+            out.append(metric_pb2.Metric(
+                name=f"g{i % 5}", type=metric_pb2.Gauge,
+                tags=["zone:a"],
+                gauge=metric_pb2.GaugeValue(value=float(i))))
+        sk = hll_mod.HLLSketch()
+        for i in range(100):
+            sk.insert(b"m%d" % i)
+        out.append(metric_pb2.Metric(
+            name="users", type=metric_pb2.Set, tags=[],
+            set=metric_pb2.SetValue(hyper_log_log=sk.marshal())))
+        td = tdigest_pb2.MergingDigestData(
+            main_centroids=[
+                tdigest_pb2.Centroid(mean=float(v), weight=1.0)
+                for v in range(32)],
+            compression=100.0, min=0.0, max=31.0, reciprocalSum=1.0)
+        out.append(metric_pb2.Metric(
+            name="lat", type=metric_pb2.Histogram,
+            scope=metric_pb2.Global, tags=["svc:x"],
+            histogram=metric_pb2.HistogramValue(t_digest=td)))
+        out.append(metric_pb2.Metric(name="nil",
+                                     type=metric_pb2.Counter))
+        return out
+
+    results = []
+    for use_native in (True, False):
+        agg = MetricAggregator(percentiles=[0.5, 0.9])
+        ms = mk_metrics()
+        payload = forward_pb2.MetricList(
+            metrics=ms).SerializeToString()
+        if use_native:
+            ok, failed = agg.import_payload(payload)
+        else:
+            ok, failed = agg.import_pb_batch(ms)
+        assert ok == len(ms) - 1 and failed == 1, (use_native, ok,
+                                                   failed)
+        res = agg.flush(is_local=False)
+        results.append(sorted(
+            (m.name, tuple(m.tags), round(m.value, 6))
+            for m in res.metrics))
+    assert results[0] == results[1]

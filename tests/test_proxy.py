@@ -332,3 +332,59 @@ def test_proxy_http_gated_endpoints_off_by_default():
             assert exc.value.code == 404
     finally:
         proxy.stop()
+
+
+def test_native_wire_router_matches_python_routing():
+    """vn_route must route every metric of a serialized MetricList to
+    the same destination the python routing_key + consistent ring pick,
+    and its regrouped per-destination buffers must re-parse to the same
+    metrics (VERDICT r4 item 5)."""
+    import numpy as np
+
+    import veneur_tpu.ingest as ingest_mod
+    from veneur_tpu.protocol import forward_pb2, metric_pb2
+    from veneur_tpu.proxy.consistent import ConsistentHash
+
+    ingest_mod.load_library()   # loud failure if the engine can't build
+
+    members = ["a:1", "b:1", "c:1"]
+    ring = ConsistentHash(members)
+    rng = np.random.default_rng(3)
+    metrics = []
+    for i in range(500):
+        t = int(rng.integers(0, 5))
+        m = metric_pb2.Metric(
+            name=f"svc.metric.{i % 97}", type=t,
+            tags=[f"env:prod", f"shard:{i % 7}"][: int(rng.integers(0, 3))])
+        if t == 0:
+            m.counter.value = i
+        elif t == 1:
+            m.gauge.value = float(i)
+        metrics.append(m)
+    payload = forward_pb2.MetricList(metrics=metrics).SerializeToString()
+
+    hashes = np.asarray([h for h, _ in ring._ring], np.uint32)
+    didx = np.asarray([members.index(m) for _, m in ring._ring], np.int32)
+    routed = ingest_mod.route_metric_list(payload, hashes, didx,
+                                          len(members), chunk_max=64)
+    assert routed is not None
+
+    type_names = {0: "counter", 1: "gauge", 2: "histogram", 3: "set",
+                  4: "timer"}
+    want: dict[int, list] = {i: [] for i in range(len(members))}
+    for m in metrics:
+        key = f"{m.name}{type_names[m.type]}{','.join(m.tags)}"
+        want[members.index(ring.get(key))].append(m)
+
+    total = 0
+    for d, (chunks, chunk_counts, count) in enumerate(routed):
+        got = []
+        for ch, cn in zip(chunks, chunk_counts):
+            parsed = forward_pb2.MetricList.FromString(ch).metrics
+            assert len(parsed) == cn <= 64
+            got.extend(parsed)
+        assert len(got) == count == len(want[d]), d
+        for g, w in zip(got, want[d]):
+            assert g.SerializeToString() == w.SerializeToString()
+        total += count
+    assert total == len(metrics)

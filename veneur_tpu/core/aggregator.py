@@ -135,6 +135,10 @@ class MetricAggregator:
         self.status = arena_mod.StatusArena(**kw)
         self.processed = 0
         self.imported = 0
+        # V1 import identity->row cache; cleared at every snapshot so a
+        # later end_interval GC can never recycle a cached row
+        self._import_row_cache: dict = {}
+        self._native_import = None   # False once the engine is ruled out
         self.count_unique_timeseries = count_unique_timeseries
         self.unique_ts = hll_mod.HLLSketch() if count_unique_timeseries else None
         self.is_local = is_local
@@ -261,6 +265,183 @@ class MetricAggregator:
                     fm.digest_min, fm.digest_max, fm.digest_rsum)
             else:
                 raise ValueError(f"unknown metric kind {fm.kind!r}")
+
+    def import_pb_batch(self, pbs) -> tuple[int, int]:
+        """Batched V1 import: ONE lock for the whole MetricList, direct
+        protobuf field access, an identity->row cache (cleared every
+        flush, BEFORE end_interval's GC can recycle rows), and
+        vectorized counter/gauge merges — the per-metric dataclass
+        conversion, key construction, and numpy scalar stores of
+        import_metric are the global tier's V1 inbound bottleneck at
+        fleet rates.  Scope/nil/local semantics match import_metric
+        exactly.  Returns (imported, failed)."""
+        from veneur_tpu.protocol import metric_pb2
+
+        ok = failed = 0
+        counters, gauges, sets, digests = (
+            self.counters, self.gauges, self.sets, self.digests)
+        cache = self._import_row_cache
+        c_rows: list = []
+        c_vals: list = []
+        g_rows: list = []
+        g_vals: list = []
+        with self.lock:
+            for pb in pbs:
+                try:
+                    which = pb.WhichOneof("value")
+                    if which == "counter":
+                        ck = (pb.name, tuple(pb.tags), 0)
+                        row = cache.get(ck)
+                        if row is None:
+                            tags = list(pb.tags)
+                            row = counters.row_for(
+                                MetricKey(pb.name, sm.TYPE_COUNTER,
+                                          ",".join(sorted(tags))),
+                                MetricScope.GLOBAL_ONLY, tags)
+                            cache[ck] = row
+                        c_rows.append(row)
+                        c_vals.append(pb.counter.value)
+                    elif which == "gauge":
+                        ck = (pb.name, tuple(pb.tags), 1)
+                        row = cache.get(ck)
+                        if row is None:
+                            tags = list(pb.tags)
+                            row = gauges.row_for(
+                                MetricKey(pb.name, sm.TYPE_GAUGE,
+                                          ",".join(sorted(tags))),
+                                MetricScope.GLOBAL_ONLY, tags)
+                            cache[ck] = row
+                        g_rows.append(row)
+                        g_vals.append(pb.gauge.value)
+                    elif which in ("set", "histogram"):
+                        self._import_slow_pb(pb, which)
+                    else:
+                        raise ValueError("nil or unknown value")
+                    self.imported += 1
+                    ok += 1
+                except Exception:
+                    failed += 1
+            if c_rows:
+                counters.merge_batch(np.asarray(c_rows, np.int64),
+                                     np.asarray(c_vals, np.float64))
+            if g_rows:
+                gauges.merge_batch(np.asarray(g_rows, np.int64),
+                                   np.asarray(g_vals, np.float64))
+        return ok, failed
+
+    def _import_slow_pb(self, pb, which: str) -> None:
+        """Set/histogram import body (sketch merges; call under
+        self.lock) — shared by the batch and native-scan paths."""
+        from veneur_tpu.protocol import metric_pb2
+
+        if pb.scope == metric_pb2.Local:
+            raise ValueError("gRPC import does not accept local metrics")
+        tags = list(pb.tags)
+        joined = ",".join(sorted(tags))
+        if which == "set":
+            row = self.sets.row_for(
+                MetricKey(pb.name, sm.TYPE_SET, joined),
+                MetricScope.MIXED, tags)
+            self.sets.merge(row, pb.set.hyper_log_log)
+            return
+        kind = (sm.TYPE_TIMER if pb.type == metric_pb2.Timer
+                else sm.TYPE_HISTOGRAM)
+        cls = (MetricScope.GLOBAL_ONLY if pb.scope == metric_pb2.Global
+               else MetricScope.MIXED)
+        dig = pb.histogram.t_digest
+        row = self.digests.row_for(
+            MetricKey(pb.name, kind, joined), cls, tags)
+        self.digests.merge_digest(
+            row,
+            [c.mean for c in dig.main_centroids],
+            [c.weight for c in dig.main_centroids],
+            dig.min, dig.max, dig.reciprocalSum)
+
+    def import_payload(self, payload: bytes) -> tuple[int, int]:
+        """V1 import from the RAW MetricList bytes: the native scanner
+        (ingest.import_scan) extracts identity hashes + values in C++,
+        so python does one dict lookup per metric and one vectorized
+        merge per family.  Set/histogram records parse individually via
+        their byte ranges (they carry sketches python merges anyway).
+        Falls back to import_pb_batch when the native engine is
+        unavailable or rejects the payload."""
+        scan = None
+        if self._native_import is not False:
+            try:
+                from veneur_tpu import ingest as ingest_mod
+                ingest_mod.load_library()
+                scan = ingest_mod.import_scan(payload)
+            except Exception:
+                self._native_import = False
+        if scan is None:
+            from veneur_tpu.protocol import forward_pb2
+            return self.import_pb_batch(
+                forward_pb2.MetricList.FromString(payload).metrics)
+        n = scan["n"]
+        if n == 0:
+            return 0, 0
+        from veneur_tpu.protocol import metric_pb2
+        h_lo = scan["h_lo"].tolist()
+        h_hi = scan["h_hi"].tolist()
+        wl = scan["which"].tolist()
+        vals = scan["value"].tolist()
+        offs = scan["rec_off"].tolist()
+        lens = scan["rec_len"].tolist()
+        cache = self._import_row_cache
+        counters, gauges = self.counters, self.gauges
+        c_rows: list = []
+        c_vals: list = []
+        g_rows: list = []
+        g_vals: list = []
+        ok = failed = 0
+        with self.lock:
+            for i in range(n):
+                w = wl[i]
+                if w == 1 or w == 2:
+                    ck = (h_lo[i], h_hi[i], w)
+                    row = cache.get(ck)
+                    if row is None:
+                        pb = metric_pb2.Metric.FromString(
+                            payload[offs[i]:offs[i] + lens[i]])
+                        tags = list(pb.tags)
+                        joined = ",".join(sorted(tags))
+                        if w == 1:
+                            row = counters.row_for(
+                                MetricKey(pb.name, sm.TYPE_COUNTER,
+                                          joined),
+                                MetricScope.GLOBAL_ONLY, tags)
+                        else:
+                            row = gauges.row_for(
+                                MetricKey(pb.name, sm.TYPE_GAUGE,
+                                          joined),
+                                MetricScope.GLOBAL_ONLY, tags)
+                        cache[ck] = row
+                    if w == 1:
+                        c_rows.append(row)
+                        c_vals.append(vals[i])
+                    else:
+                        g_rows.append(row)
+                        g_vals.append(vals[i])
+                    ok += 1
+                elif w == 3 or w == 4:
+                    try:
+                        pb = metric_pb2.Metric.FromString(
+                            payload[offs[i]:offs[i] + lens[i]])
+                        self._import_slow_pb(
+                            pb, "set" if w == 3 else "histogram")
+                        ok += 1
+                    except Exception:
+                        failed += 1
+                else:
+                    failed += 1
+            self.imported += ok
+            if c_rows:
+                counters.merge_batch(np.asarray(c_rows, np.int64),
+                                     np.asarray(c_vals, np.float64))
+            if g_rows:
+                gauges.merge_batch(np.asarray(g_rows, np.int64),
+                                   np.asarray(g_vals, np.float64))
+        return ok, failed
 
     def sync_staged(self, min_samples: int = 0) -> bool:
         """Push staged samples into device state NOW if the backlog is
@@ -646,6 +827,7 @@ class MetricAggregator:
         reference; host arrays are fancy-index copies."""
         d, s, c, g, st = (self.digests, self.sets, self.counters,
                           self.gauges, self.status)
+        self._import_row_cache.clear()
         d.sync()
         s.sync()
         snap = {"counts": (self.processed, self.imported)}

@@ -269,6 +269,11 @@ class CounterArena(_ArenaBase):
     def merge(self, row: int, value: int) -> None:
         self.values[row % self.n_lanes, row] += value
 
+    def merge_batch(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        """Vectorized import merges (duplicate rows accumulate)."""
+        np.add.at(self.values, (rows % self.n_lanes, rows), vals)
+        self.touched[rows] = True
+
     def snapshot_values(self) -> np.ndarray:
         """Cheap host copy of the lane stripes (call under the aggregator
         lock, before reset zeroes them in place)."""
@@ -312,6 +317,12 @@ class GaugeArena(_ArenaBase):
 
     def merge(self, row: int, value: float) -> None:
         self.values[row] = value  # Merge overwrites (samplers.go:200-202)
+
+    def merge_batch(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        """Vectorized import merges: numpy fancy assignment applies in
+        order, so duplicate rows keep last-write-wins semantics."""
+        self.values[rows] = vals
+        self.touched[rows] = True
 
     def reset_rows(self, rows: np.ndarray) -> None:
         self.values[rows] = 0

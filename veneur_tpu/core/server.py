@@ -399,11 +399,18 @@ class Server:
                 self.proto_received["grpc"] += 1
                 self.aggregator.import_metric(fm)
 
+            def _import_payload_counted(payload):
+                ok, failed = self.aggregator.import_payload(payload)
+                with self._proto_lock:
+                    self.proto_received["grpc"] += ok
+                return ok, failed
+
             self.grpc_import = GrpcImportServer(
                 self.config.grpc_address,
                 _import_counted,
                 ingest_span=self._grpc_span_counted,
-                handle_packet=self._grpc_packet_counted)
+                handle_packet=self._grpc_packet_counted,
+                import_payload=_import_payload_counted)
             self.grpc_import.start()
         if self.config.forward_address and self.forwarder is None:
             # local tier: persistent forward connection (server.go:810-828)

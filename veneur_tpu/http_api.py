@@ -101,6 +101,11 @@ def make_handler(server) -> type:
                     "flush_count": server.flush_count,
                     "last_flush_unix": server.last_flush_unix,
                     "is_local": server.is_local,
+                    "processed": server.aggregator.processed,
+                    "imported": server.aggregator.imported,
+                    "imported_total": getattr(
+                        server.grpc_import, "imported_count", 0)
+                    if getattr(server, "grpc_import", None) else 0,
                     "metric_sinks": [s.name() for _, s in
                                      server.metric_sinks],
                     "threads": threading.active_count(),

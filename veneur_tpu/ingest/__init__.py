@@ -103,8 +103,120 @@ def load_library():
             ctypes.c_longlong, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int]
+        lib.vn_route.restype = ctypes.c_void_p
+        lib.vn_route.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_int]
+        lib.vn_route_dest.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.vn_route_chunks.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.vn_route_free.argtypes = [ctypes.c_void_p]
+        lib.vn_import_scan.restype = ctypes.c_void_p
+        lib.vn_import_scan.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+        lib.vn_import_scan_n.restype = ctypes.c_longlong
+        lib.vn_import_scan_n.argtypes = [ctypes.c_void_p]
+        lib.vn_import_scan_arrays.argtypes = [
+            ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_void_p)] * 8
+        lib.vn_import_scan_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+def import_scan(payload: bytes):
+    """Columnar scan of a serialized MetricList (vn_import_scan):
+    returns dict of numpy arrays {h_lo, h_hi (u64 identity hashes),
+    which (u8: 1 counter, 2 gauge, 3 set, 4 histogram), mtype, scope
+    (u8), value (f64), rec_off, rec_len (i64 Metric submessage
+    ranges)} — copies, safe after free — or None if the payload failed
+    the wire scan (caller falls back to protobuf parsing)."""
+    import numpy as np
+
+    lib = load_library()
+    handle = lib.vn_import_scan(payload, len(payload))
+    if not handle:
+        return None
+    try:
+        n = lib.vn_import_scan_n(handle)
+        ptrs = [ctypes.c_void_p() for _ in range(8)]
+        lib.vn_import_scan_arrays(handle, *map(ctypes.byref, ptrs))
+        if n == 0:
+            return {"n": 0}
+
+        def arr(ptr, dtype, count=n):
+            size = np.dtype(dtype).itemsize * count
+            return np.frombuffer(
+                ctypes.string_at(ptr.value, size), dtype).copy()
+
+        return {
+            "n": int(n),
+            "h_lo": arr(ptrs[0], np.uint64),
+            "h_hi": arr(ptrs[1], np.uint64),
+            "which": arr(ptrs[2], np.uint8),
+            "mtype": arr(ptrs[3], np.uint8),
+            "scope": arr(ptrs[4], np.uint8),
+            "value": arr(ptrs[5], np.float64),
+            "rec_off": arr(ptrs[6], np.int64),
+            "rec_len": arr(ptrs[7], np.int64),
+        }
+    finally:
+        lib.vn_import_scan_free(handle)
+
+
+def route_metric_list(payload: bytes, ring_hashes, ring_dests,
+                      n_dests: int, chunk_max: int = 2000):
+    """Parse-free consistent-hash routing of a serialized MetricList
+    (vn_route): returns a list with one entry per destination index,
+    each a tuple (chunks, chunk_counts, count) where chunks is a list
+    of bytes — each a VALID MetricList body of <= chunk_max metrics,
+    with chunk_counts its parallel per-chunk metric counts — or None if
+    the native router rejected the payload (caller falls back to the
+    protobuf path).  ring_hashes: uint32 sorted ndarray; ring_dests:
+    int32 ndarray of destination indices."""
+    lib = load_library()
+    handle = lib.vn_route(
+        payload, len(payload),
+        ring_hashes.ctypes.data_as(ctypes.c_void_p),
+        ring_dests.ctypes.data_as(ctypes.c_void_p),
+        len(ring_hashes), n_dests, chunk_max)
+    if not handle:
+        return None
+    try:
+        out = []
+        for d in range(n_dests):
+            ptr = ctypes.c_void_p()
+            nbytes = ctypes.c_longlong()
+            count = ctypes.c_longlong()
+            lib.vn_route_dest(handle, d, ctypes.byref(ptr),
+                              ctypes.byref(nbytes), ctypes.byref(count))
+            offs_ptr = ctypes.c_void_p()
+            n_bounds = ctypes.c_longlong()
+            lib.vn_route_chunks(handle, d, ctypes.byref(offs_ptr),
+                                ctypes.byref(n_bounds))
+            chunks = []
+            chunk_counts = []
+            if count.value:
+                region = ctypes.string_at(ptr.value, nbytes.value)
+                offs = ctypes.cast(
+                    offs_ptr,
+                    ctypes.POINTER(ctypes.c_longlong * n_bounds.value)
+                ).contents
+                remaining = count.value
+                for i in range(n_bounds.value - 1):
+                    chunks.append(region[offs[i]:offs[i + 1]])
+                    n = min(chunk_max, remaining)
+                    chunk_counts.append(n)
+                    remaining -= n
+            out.append((chunks, chunk_counts, count.value))
+        return out
+    finally:
+        lib.vn_route_free(handle)
 
 
 def fill_dense(rows, vals, wts, dense_id, dv, dw, depths,

@@ -42,6 +42,24 @@ logger = logging.getLogger("veneur_tpu.proxy.connect")
 _CLOSE = object()  # sentinel terminating a sender
 
 
+class _Raw:
+    """A pre-serialized routed group from the native wire router
+    (ingest.route_metric_list): `chunks` are VALID MetricList bodies
+    (chunk_counts holds their per-chunk metric counts), sent verbatim
+    (no re-serialization) and SEQUENTIALLY by one sender so ordering
+    within the inbound payload holds."""
+
+    __slots__ = ("chunks", "chunk_counts", "count")
+
+    def __init__(self, chunks: list, chunk_counts: list, count: int):
+        self.chunks = chunks
+        self.chunk_counts = chunk_counts
+        self.count = count
+
+    def __len__(self) -> int:   # buffer accounting treats items by size
+        return self.count
+
+
 class Destination:
     def __init__(self, address: str, send_buffer_size: int = 1024,
                  on_closed: Optional[Callable[["Destination"], None]] = None,
@@ -72,10 +90,19 @@ class Destination:
             SEND_METRICS,
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
+        # passthrough stub for pre-serialized MetricList bodies from the
+        # native router — the bytes ship verbatim
+        self._v1_raw = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
         self.batch_mode = self._probe_v1(dial_timeout_s)
-        # batch mode needs few senders (each RPC carries thousands);
-        # stream mode keeps n_streams parallel queues
-        self.n_streams = 2 if self.batch_mode else max(1, n_streams)
+        # batch mode uses ONE sender: every item kind (objects, lists,
+        # raw routed groups) shares one queue, so same-key updates keep
+        # a total order whatever transport they arrived on — and one
+        # sender of batched RPCs clears >1M metrics/s anyway.  Stream
+        # mode keeps n_streams parallel key-affine queues.
+        self.n_streams = 1 if self.batch_mode else max(1, n_streams)
         self.queues: list[queue.Queue] = [
             queue.Queue() for _ in range(self.n_streams)]
         self._senders = []
@@ -143,7 +170,8 @@ class Destination:
     # -- V1 batch senders --------------------------------------------------
 
     def _batch_loop(self, q: queue.Queue) -> None:
-        # queue items are single Metrics (send) or lists (send_many)
+        # queue items are single Metrics (send), lists (send_many), or
+        # pre-serialized _Raw groups (send_raw)
         graceful = False
         try:
             while True:
@@ -151,7 +179,14 @@ class Destination:
                 if item is _CLOSE:
                     graceful = True
                     return
+                if isinstance(item, _Raw):
+                    try:
+                        self._send_raw_item(item)
+                    finally:
+                        self._release(item.count)
+                    continue
                 batch = list(item) if isinstance(item, list) else [item]
+                raw_after = None
                 while len(batch) < BATCH_MAX:
                     try:
                         item = q.get_nowait()
@@ -164,10 +199,34 @@ class Destination:
                             self._release(len(batch))
                         graceful = True
                         return
+                    if isinstance(item, _Raw):
+                        # keep queue order: finish the batch, then send
+                        # the raw group before draining further
+                        raw_after = item
+                        break
                     if isinstance(item, list):
                         batch.extend(item)
                     else:
                         batch.append(item)
+                if raw_after is not None:
+                    batch_ok = False
+                    try:
+                        self._send_batch(batch)
+                        batch_ok = True
+                    finally:
+                        self._release(len(batch))
+                        if not batch_ok:
+                            # the parked raw group is no longer in the
+                            # queue, so the close-time sweep can't see
+                            # it — account it dropped here
+                            with self._sent_lock:
+                                self.dropped += raw_after.count
+                            self._release(raw_after.count)
+                    try:
+                        self._send_raw_item(raw_after)
+                    finally:
+                        self._release(raw_after.count)
+                    continue
                 # release AFTER the send: the buffer bound covers
                 # in-flight batches too, so a wedged destination
                 # backpressures at ~cap metrics, not cap + what the
@@ -197,6 +256,45 @@ class Destination:
                 raise
             with self._sent_lock:
                 self.sent += len(chunk)
+
+    def _send_raw_item(self, item: "_Raw") -> None:
+        """Send a routed raw group chunk by chunk (each chunk is already
+        a valid MetricList body; counts travel with the group)."""
+        remaining = item.count
+        for chunk, n in zip(item.chunks, item.chunk_counts):
+            try:
+                self._v1_raw(chunk, timeout=30.0)
+            except grpc.RpcError:
+                with self._sent_lock:
+                    self.dropped += remaining
+                raise
+            with self._sent_lock:
+                self.sent += n
+            remaining -= n
+
+    def send_raw(self, chunks: list, chunk_counts: list, count: int,
+                 block_poll_s: float = 0.05) -> int:
+        """Enqueue a native-routed raw group.  Returns metrics DROPPED
+        (0 = buffered).  Batch-mode destinations run ONE sender, so the
+        group keeps a total order with every other item kind."""
+        if not count:
+            return 0
+        if self._closing.is_set() or self.closed.is_set():
+            with self._sent_lock:
+                self.dropped += count
+            return count
+        if not self._reserve(count, block_poll_s):
+            with self._sent_lock:
+                self.dropped += count
+            return count
+        item = _Raw(chunks, chunk_counts, count)
+        self.queues[0].put(item)
+        if self.closed.is_set():
+            self._drain_dropped()
+            with self._sent_lock:
+                if any(s is item for s in self._swept):
+                    return count
+        return 0
 
     # -- V2 stream senders (reference-global fallback) ---------------------
 
@@ -269,7 +367,7 @@ class Destination:
                 if item is _CLOSE:
                     saw_close = True
                     continue
-                n = len(item) if isinstance(item, list) else 1
+                n = len(item) if isinstance(item, (list, _Raw)) else 1
                 self._release(n)
                 with self._sent_lock:
                     self.dropped += n

@@ -28,6 +28,7 @@ class Destinations:
         self._lock = threading.Lock()
         self._ring = ConsistentHash()
         self._dests: dict[str, Destination] = {}
+        self._ring_cache = None   # (hashes, didx, dests); see ring_arrays
 
     def add(self, addresses: list[str]) -> None:
         """Connect any new addresses in parallel; keep existing ones."""
@@ -54,6 +55,7 @@ class Destinations:
                     else:
                         self._dests[addr] = dest
                         self._ring.add(addr)
+                        self._ring_cache = None
                 if duplicate is not None:
                     threading.Thread(target=duplicate.close,
                                      daemon=True).start()
@@ -79,6 +81,7 @@ class Destinations:
                 return
             del self._dests[address]
             self._ring.remove(address)
+            self._ring_cache = None
         if not dest.closed.is_set():
             threading.Thread(target=dest.close, daemon=True).start()
 
@@ -97,6 +100,29 @@ class Destinations:
             addr = self._ring.get(key)
             return self._dests[addr]
 
+    def ring_arrays(self):
+        """Snapshot of the ring as flat arrays for the native router
+        (vn_route): (sorted uint32 ring hashes, parallel int32
+        destination indices, list of Destination objects).  Returns
+        None when the ring is empty.  Cached per membership (rebuilt by
+        add/remove/clear) — this runs once per inbound payload on the
+        routing hot path."""
+        import numpy as np
+
+        with self._lock:
+            if self._ring_cache is not None:
+                return self._ring_cache
+            if not self._ring._ring:
+                return None
+            dests = list(self._dests.values())
+            index = {d.address: i for i, d in enumerate(dests)}
+            hashes = np.asarray([h for h, _ in self._ring._ring],
+                                np.uint32)
+            didx = np.asarray([index[m] for _, m in self._ring._ring],
+                              np.int32)
+            self._ring_cache = (hashes, didx, dests)
+            return self._ring_cache
+
     def size(self) -> int:
         with self._lock:
             return len(self._dests)
@@ -106,6 +132,7 @@ class Destinations:
             dests = list(self._dests.values())
             self._dests.clear()
             self._ring = ConsistentHash()
+            self._ring_cache = None
         for d in dests:
             d.close()
 

@@ -117,6 +117,9 @@ class Proxy:
                       "no_destination": 0}
         self._stats_lock = threading.Lock()
         self._shutdown = threading.Event()
+        # native wire router, resolved lazily (None = untried,
+        # False = unavailable)
+        self._native_router = None
 
         self.grpc_server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(
@@ -164,10 +167,12 @@ class Proxy:
     # -- gRPC Forward service ---------------------------------------------
 
     def _handlers(self):
-        def send_metrics(request, context):
-            # fleet-internal batch inbound: route the whole MetricList
-            # through the amortized path
-            self.handle_metrics(request.metrics)
+        def send_metrics_raw(request_bytes, context):
+            # fleet-internal batch inbound, kept as RAW BYTES: the
+            # native wire router slices/regroups the MetricList without
+            # any python (de)serialization — the whole proxy data plane
+            # is bytes in -> C++ route -> bytes out
+            self.handle_metrics_raw(bytes(request_bytes))
             return empty_pb2.Empty()
 
         def send_metrics_v2(request_iterator, context):
@@ -178,8 +183,8 @@ class Proxy:
         return grpc.method_handlers_generic_handler(
             "forwardrpc.Forward", {
                 "SendMetrics": grpc.unary_unary_rpc_method_handler(
-                    send_metrics,
-                    request_deserializer=forward_pb2.MetricList.FromString,
+                    send_metrics_raw,
+                    request_deserializer=lambda b: b,
                     response_serializer=empty_pb2.Empty.SerializeToString),
                 "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
                     send_metrics_v2,
@@ -209,6 +214,58 @@ class Proxy:
                 self.stats["dropped"] += 1
             else:
                 self.stats["routed"] += 1
+
+    def handle_metrics_raw(self, payload: bytes) -> None:
+        """Route a serialized MetricList without deserializing it: the
+        native wire router (ingest.route_metric_list) slices the payload
+        at protobuf record boundaries, hashes each metric's routing key
+        (`handlers.go:111-112`), and regroups the raw records into valid
+        per-destination MetricList bodies; batch-mode destinations send
+        them verbatim.  Falls back to the protobuf path when ignore_tags
+        is configured (key filtering needs parsed tags), the native
+        library is unavailable, or a destination speaks V2 streams."""
+        if not payload:
+            return      # the V1 probe
+        router = self._native_router
+        if router is None and not self.cfg.ignore_tags:
+            try:
+                from veneur_tpu import ingest as ingest_mod
+                ingest_mod.load_library()
+                router = self._native_router = ingest_mod.route_metric_list
+            except Exception:
+                router = self._native_router = False
+        ring = (self.destinations.ring_arrays()
+                if router and not self.cfg.ignore_tags else None)
+        if not ring:
+            ml = forward_pb2.MetricList.FromString(payload)
+            self.handle_metrics(ml.metrics)
+            return
+        hashes, didx, dests = ring
+        routed = router(payload, hashes, didx, len(dests))
+        if routed is None:          # malformed for the wire scanner
+            ml = forward_pb2.MetricList.FromString(payload)
+            self.handle_metrics(ml.metrics)
+            return
+        received = routed_n = dropped = 0
+        for (chunks, chunk_counts, count), dest in zip(routed, dests):
+            if not count:
+                continue
+            received += count
+            if dest.batch_mode:
+                n_drop = dest.send_raw(chunks, chunk_counts, count)
+            else:
+                # reference-global destination (V2 streams): parse just
+                # this destination's share
+                ms = [m for ch in chunks
+                      for m in forward_pb2.MetricList.FromString(
+                          ch).metrics]
+                n_drop = dest.send_many(ms)
+            dropped += n_drop
+            routed_n += count - n_drop
+        with self._stats_lock:
+            self.stats["received"] += received
+            self.stats["routed"] += routed_n
+            self.stats["dropped"] += dropped
 
     def handle_metrics(self, ms) -> None:
         """Batched routing (the V1 inbound path): group by destination,

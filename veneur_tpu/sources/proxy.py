@@ -41,12 +41,17 @@ class GrpcImportServer:
                  ingest_span: Optional[Callable[[object], None]] = None,
                  handle_packet: Optional[Callable[[bytes], None]] = None,
                  max_workers: int = 64,
-                 server_credentials: Optional[grpc.ServerCredentials] = None):
+                 server_credentials: Optional[grpc.ServerCredentials] = None,
+                 import_payload: Optional[Callable] = None):
         """With import_metric=None the Forward service is omitted — the
         ingest-only shape of `grpc_listen_addresses` edge listeners
         (StartGRPC, networking.go:326-391), vs the global tier's
-        `grpc_address` which serves all three."""
+        `grpc_address` which serves all three.  import_payload, when
+        provided, takes the whole V1 MetricList as RAW BYTES in one
+        call (native wire scan + single aggregator lock — the
+        fleet-rate inbound path)."""
         self.import_metric = import_metric
+        self.import_payload = import_payload
         self.ingest_span = ingest_span
         self.handle_packet = handle_packet
         self.imported_count = 0
@@ -81,14 +86,23 @@ class GrpcImportServer:
             # proxies/forwarders probe V1 and fall back to V2 against
             # reference globals (python-grpc streams cap at ~20k msgs/s;
             # one MetricList carries thousands per RPC).
-            count = 0
-            for pb in request.metrics:
-                try:
-                    self.import_metric(convert.from_pb(pb))
-                    count += 1
-                except Exception as e:
-                    logger.error("failed to import metric %s: %s",
-                                 pb.name, e)
+            if self.import_payload is not None:
+                # RAW bytes straight to the native scan path — no
+                # python protobuf materialization on the fleet edge
+                count, failed = self.import_payload(bytes(request))
+                if failed:
+                    logger.error("failed to import %d metrics in a V1 "
+                                 "batch", failed)
+            else:
+                ml = forward_pb2.MetricList.FromString(bytes(request))
+                count = 0
+                for pb in ml.metrics:
+                    try:
+                        self.import_metric(convert.from_pb(pb))
+                        count += 1
+                    except Exception as e:
+                        logger.error("failed to import metric %s: %s",
+                                     pb.name, e)
             with self._count_lock:
                 self.imported_count += count
             return empty_pb2.Empty()
@@ -111,7 +125,7 @@ class GrpcImportServer:
             forward_handlers = {
                 "SendMetrics": grpc.unary_unary_rpc_method_handler(
                     send_metrics,
-                    request_deserializer=forward_pb2.MetricList.FromString,
+                    request_deserializer=lambda b: b,
                     response_serializer=empty_pb2.Empty.SerializeToString),
                 "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
                     send_metrics_v2,
