@@ -295,7 +295,10 @@ def bench_device() -> dict:
         N_KEYS, N_LANES, "device arm (weighted/general path)",
         rounds=4, pipeline=PIPELINE_100K, weighted=True)
     wdo50 = max(wdo50, 1e-3)
-    bytes_moved = 2 * N_KEYS * 8 * 32 * 4   # both [K, D] f32 operands
+    # the kernel reads the pow2-PADDED [K, D] operands — padding rows
+    # cross HBM like any others, so the roofline denominator counts them
+    k_pad = 1 << (N_KEYS - 1).bit_length()
+    bytes_moved = 2 * k_pad * 8 * 32 * 4   # both [K, D] f32 operands
     bw = bytes_moved / (do50 * 1e-3) / 1e9
     log(f"device arm: sustained p50={a50:.2f}ms p99={a99:.2f}ms/flush "
         f"({n_rounds} rounds x {PIPELINE_100K} pipelined); "
@@ -330,7 +333,8 @@ def bench_device_scale() -> tuple[float, int] | None:
     _, p99, n, (dev_only, _do99) = _amortized_flush(
         n_keys, lanes, "scale arm", rounds=4, pipeline=PIPELINE_1M)
     dev_only = max(dev_only, 1e-3)
-    bytes_moved = 2 * n_keys * lanes * 32 * 4
+    k_pad = 1 << (n_keys - 1).bit_length()
+    bytes_moved = 2 * k_pad * lanes * 32 * 4
     bw = bytes_moved / (dev_only * 1e-3) / 1e9
     log(f"scale arm: {n_keys * lanes:,} digests/interval "
         f"({n_keys * lanes * 32:,} staged points) sustained "

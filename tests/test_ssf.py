@@ -273,3 +273,77 @@ def test_server_self_telemetry_loopback():
         assert any(m.name == "veneur.internal" for m in got)
     finally:
         srv.shutdown()
+
+
+def test_unix_stream_backend_backoff_reconnect(tmp_path):
+    """The stream backend retries with additive backoff while the
+    listener is away and recovers once it returns
+    (trace/backend.go:130-180); the buffered client mode waits for
+    buffer space instead of dropping."""
+    import socket
+    import threading
+    import time
+
+    from veneur_tpu import ssf as ssf_mod
+    from veneur_tpu import trace as trace_mod
+
+    path = str(tmp_path / "ssf.sock")
+
+    def serve(n_expected, out):
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(1)
+        conn, _ = srv.accept()
+        buf = b""
+        while len(out) < n_expected:
+            data = conn.recv(65536)
+            if not data:
+                break
+            buf += data
+            import struct
+            while len(buf) >= 5:
+                _, ln = struct.unpack(">BI", buf[:5])
+                if len(buf) < 5 + ln:
+                    break
+                out.append(ssf_mod.SSFSpan.FromString(buf[5:5 + ln]))
+                buf = buf[5 + ln:]
+        conn.close()
+        srv.close()
+
+    # backend created while the listener does NOT exist yet: connect
+    # must retry with backoff and succeed once serve() binds
+    got: list = []
+    backend = trace_mod.unix_stream_backend(
+        path, backoff_s=0.01, max_backoff_s=0.05, connect_timeout_s=5.0)
+    t = threading.Thread(target=serve, args=(1, got), daemon=True)
+
+    def delayed_start():
+        time.sleep(0.3)
+        t.start()
+
+    threading.Thread(target=delayed_start, daemon=True).start()
+    span = ssf_mod.SSFSpan(version=0, trace_id=1, id=2, name="op",
+                           service="svc", start_timestamp=1,
+                           end_timestamp=2)
+    backend(span)          # blocks through the backoff loop, then sends
+    t.join(timeout=5)
+    assert len(got) == 1 and got[0].name == "op"
+
+    # buffered client mode: a full queue WAITS instead of dropping
+    slow_release = threading.Event()
+
+    def slow_backend(s):
+        slow_release.wait(5.0)
+
+    client = trace_mod.Client(slow_backend, capacity=1,
+                              block_timeout_s=2.0)
+    client.record(span)    # worker pops this and blocks in the backend
+    time.sleep(0.1)
+    client.record(span)    # fills the (empty again) 1-slot queue
+    t0 = time.time()
+    threading.Timer(0.3, slow_release.set).start()
+    client.record(span)    # queue genuinely full: must BLOCK for space
+    waited = time.time() - t0
+    assert waited >= 0.2, waited   # proves the buffered wait happened
+    assert client.dropped == 0
+    client.close()

@@ -401,20 +401,27 @@ class MetricAggregator:
                     ck = (h_lo[i], h_hi[i], w)
                     row = cache.get(ck)
                     if row is None:
-                        pb = metric_pb2.Metric.FromString(
-                            payload[offs[i]:offs[i] + lens[i]])
-                        tags = list(pb.tags)
-                        joined = ",".join(sorted(tags))
-                        if w == 1:
-                            row = counters.row_for(
-                                MetricKey(pb.name, sm.TYPE_COUNTER,
-                                          joined),
-                                MetricScope.GLOBAL_ONLY, tags)
-                        else:
-                            row = gauges.row_for(
-                                MetricKey(pb.name, sm.TYPE_GAUGE,
-                                          joined),
-                                MetricScope.GLOBAL_ONLY, tags)
+                        # per-metric guard like the pb path: one bad
+                        # record (e.g. invalid UTF-8 the wire scanner
+                        # can't see) must not abort the whole payload
+                        try:
+                            pb = metric_pb2.Metric.FromString(
+                                payload[offs[i]:offs[i] + lens[i]])
+                            tags = list(pb.tags)
+                            joined = ",".join(sorted(tags))
+                            if w == 1:
+                                row = counters.row_for(
+                                    MetricKey(pb.name, sm.TYPE_COUNTER,
+                                              joined),
+                                    MetricScope.GLOBAL_ONLY, tags)
+                            else:
+                                row = gauges.row_for(
+                                    MetricKey(pb.name, sm.TYPE_GAUGE,
+                                              joined),
+                                    MetricScope.GLOBAL_ONLY, tags)
+                        except Exception:
+                            failed += 1
+                            continue
                         cache[ck] = row
                     if w == 1:
                         c_rows.append(row)

@@ -92,13 +92,19 @@ class Span:
 
 class Client:
     """Async span submission (trace.Client, trace/client.go:57-128):
-    a worker thread drains a bounded buffer into the backend; overflow
-    drops (UDP heritage)."""
+    a worker thread drains a bounded buffer into the backend.
+
+    Overflow behavior mirrors the reference's two client modes: the
+    default (unbuffered) drops on a full buffer (UDP heritage);
+    `block_timeout_s > 0` is the buffered mode — record() waits up to
+    that long for space before dropping, trading submission latency for
+    fewer drops on bursty span traffic."""
 
     def __init__(self, backend: Callable[[ssf_mod.SSFSpan], None],
-                 capacity: int = 1024):
+                 capacity: int = 1024, block_timeout_s: float = 0.0):
         self._backend = backend
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._block_timeout_s = block_timeout_s
         self.dropped = 0
         self.sent = 0
         self._closed = threading.Event()
@@ -108,7 +114,10 @@ class Client:
 
     def record(self, span: ssf_mod.SSFSpan) -> None:
         try:
-            self._q.put_nowait(span)
+            if self._block_timeout_s > 0:
+                self._q.put(span, timeout=self._block_timeout_s)
+            else:
+                self._q.put_nowait(span)
         except queue.Full:
             self.dropped += 1
 
@@ -151,14 +160,37 @@ def udp_backend(address: tuple[str, int]):
     return send
 
 
-def unix_stream_backend(path: str):
-    """Framed spans on a UNIX stream with reconnect-on-error."""
+# stream-backend reconnect constants (trace/backend.go:10-30)
+STREAM_BACKOFF_S = 0.020        # DefaultBackoff
+STREAM_MAX_BACKOFF_S = 1.0      # DefaultMaxBackoff
+STREAM_CONNECT_TIMEOUT_S = 10.0  # DefaultConnectTimeout
+
+
+def unix_stream_backend(path: str,
+                        backoff_s: float = STREAM_BACKOFF_S,
+                        max_backoff_s: float = STREAM_MAX_BACKOFF_S,
+                        connect_timeout_s: float = STREAM_CONNECT_TIMEOUT_S):
+    """Framed spans on a UNIX stream with the reference's backoff
+    reconnect (`trace/backend.go:130-180`): each failed attempt adds
+    `backoff_s` to the wait (capped at `max_backoff_s`); if the
+    connection cannot be re-established within `connect_timeout_s` the
+    span is discarded (raises, counted as a drop by the Client)."""
     state = {"sock": None}
 
     def connect():
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.connect(path)
-        state["sock"] = s
+        deadline = time.time() + connect_timeout_s
+        wait = 0.0
+        while True:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(path)
+                state["sock"] = s
+                return
+            except OSError:
+                wait = min(wait + backoff_s, max_backoff_s)
+                if time.time() + wait > deadline:
+                    raise
+                time.sleep(wait)
 
     def send(span: ssf_mod.SSFSpan) -> None:
         if state["sock"] is None:
