@@ -222,3 +222,24 @@ def test_uniform_depth_vector_eval_parity_interpret():
         # come from host accumulators)
         np.testing.assert_allclose(got, ref[:, :3], rtol=1e-5,
                                    atol=1e-4, err_msg=f"{u}x{d}")
+
+
+def test_lane_tile_wide_boundary():
+    """The wide (1024-lane) tile applies only to the key-only kernel at
+    large 1024-divisible key counts; every previously-usable shape keeps
+    the Pallas path and the general kernels keep 512-lane tiles."""
+    from veneur_tpu.ops import sorted_eval as se
+
+    # general kernels: unchanged sizing
+    assert se._lane_tile(131072, 256) == 512
+    assert se._lane_tile(131072, 512) == 256
+    # wide: engages only at >=65536 AND 1024-divisible
+    assert se._lane_tile(131072, 256, wide=True) == 1024
+    assert se._lane_tile(65536, 256, wide=True) == 1024
+    assert se._lane_tile(66048, 256, wide=True) == 512   # not /1024
+    assert se._lane_tile(32768, 256, wide=True) == 512   # below cutoff
+    assert se._lane_tile(131072, 512, wide=True) == 256  # deep: VMEM
+    # usable() keeps accepting every 512-multiple shape it accepted
+    assert se.usable(66048, 256, "tpu")
+    assert se.usable(65536, 256, "tpu")
+    assert se.usable(131072, 256, "tpu")

@@ -57,11 +57,24 @@ _PAD_KEY = float("inf")
 MAX_DEPTH = 1024
 
 
-def _lane_tile(u: int, d: int) -> int:
+def _lane_tile(u: int, d: int, wide: bool = False) -> int:
     """Lane-axis tile width: full-VPU 128 multiples, sized so the VMEM
     working set (~8 live [D, T] f32 arrays) stays well under the 16 MiB
-    budget at every depth."""
-    cap = 512 if d <= 256 else 256
+    budget at every depth.
+
+    wide=True (the key-only depth-vector kernel, whose working set is
+    roughly half the paired kernels') takes 1024-wide tiles at large
+    key counts: per-grid-step overhead dominates past ~128 steps
+    (measured 2x on the 1M-digest shape: 256 steps of 512 lanes ran
+    ~2.5 ms where 128 steps of 1024 run ~1.25 ms).  Falls back to 512
+    when u is not a 1024-multiple so no previously-usable shape loses
+    the Pallas path."""
+    if d <= 256:
+        cap = 512
+        if wide and u >= 65536 and u % 1024 == 0:
+            cap = 1024
+    else:
+        cap = 256
     return min(cap, u)
 
 
@@ -296,7 +309,7 @@ def uniform_eval(mean: jax.Array, depths: jax.Array,
     P-column readback (totals/sums come from the host accumulators)."""
     u, d = mean.shape
     n_pct = percentiles.shape[0]
-    tile = _lane_tile(u, d)
+    tile = _lane_tile(u, d, wide=True)
     qs = percentiles.reshape(1, n_pct).astype(jnp.float32)
     # narrow upload dtypes (bf16 values / int16 depths) widen here, on
     # device, before the kernel reads them
