@@ -446,3 +446,47 @@ def test_native_import_scan_matches_pb_path():
             (m.name, tuple(m.tags), round(m.value, 6))
             for m in res.metrics))
     assert results[0] == results[1]
+
+
+def test_import_row_cache_survives_flush_and_gc_cycles():
+    """The V1 import identity->row cache must never serve a stale row:
+    it clears at every flush (before end_interval's GC can free rows),
+    and re-imports after GC re-register cleanly with correct totals."""
+    from veneur_tpu.core import arena as arena_mod
+    from veneur_tpu.core.aggregator import MetricAggregator
+
+    agg = MetricAggregator(percentiles=[0.5])
+    pbs_a = [metric_pb2.Metric(
+        name="a", type=metric_pb2.Counter, tags=["t:1"],
+        counter=metric_pb2.CounterValue(value=2)) for _ in range(5)]
+    pbs_b = [metric_pb2.Metric(
+        name="b", type=metric_pb2.Counter, tags=["t:2"],
+        counter=metric_pb2.CounterValue(value=3)) for _ in range(4)]
+
+    def flush_values():
+        res = agg.flush(is_local=False)
+        return {m.name: m.value for m in res.metrics}
+
+    pay = forward_pb2.MetricList(
+        metrics=pbs_a + pbs_b).SerializeToString()
+    agg.import_payload(pay)
+    assert agg._import_row_cache          # populated
+    by = flush_values()
+    assert by["a"] == 10.0 and by["b"] == 12.0
+    assert not agg._import_row_cache      # cleared at snapshot
+
+    # idle 'a' and 'b' long enough for the arena GC to free their rows,
+    # interleaving other keys so rows get recycled
+    for i in range(arena_mod.IDLE_GC_INTERVALS + 1):
+        filler = forward_pb2.MetricList(metrics=[metric_pb2.Metric(
+            name=f"f{i}", type=metric_pb2.Counter,
+            counter=metric_pb2.CounterValue(value=1))]
+        ).SerializeToString()
+        agg.import_payload(filler)
+        flush_values()
+
+    # re-import the original identities: fresh rows, exact totals
+    agg.import_payload(pay)
+    agg.import_payload(pay)
+    by = flush_values()
+    assert by["a"] == 20.0 and by["b"] == 24.0
