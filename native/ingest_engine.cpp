@@ -886,13 +886,18 @@ long long vn_blast_udp(const char* ip, int port, long long n_packets,
 // vals:  float64[n] staged values
 // wts:   float64[n] staged weights, or null for the uniform (all-1) path
 // dense_id: int64[capacity] arena row -> dense row (-1 = untouched)
+// capacity: length of dense_id — rows[i] outside [0, capacity) is a
+//   CORRUPT staged row id and is dropped (never indexed: NumPy-side
+//   negative indices would wrap, and here they would read out of
+//   bounds, so the guard lives on both sides of the FFI)
 // dv/dw: float32[u_pad*d_pad] outputs (dw null on the uniform path)
 // depths: int16[u_pad] per-dense-row fill counts (may be null)
-// Returns the number of DROPPED elements (rid < 0 or row overflow past
-// d_pad); the caller falls back to the numpy builder when nonzero.
+// Returns the number of DROPPED elements (row id out of bounds,
+// rid < 0, or row overflow past d_pad); the caller falls back to the
+// numpy builder when nonzero.
 long long vn_fill_dense(const long long* rows, const double* vals,
                         const double* wts, long long n,
-                        const long long* dense_id,
+                        const long long* dense_id, long long capacity,
                         float* dv, float* dw, short* depths,
                         long long u_pad, long long d_pad,
                         int n_threads) {
@@ -901,7 +906,12 @@ long long vn_fill_dense(const long long* rows, const double* vals,
   auto work = [&](long long lo, long long hi) {
     long long local_dropped = 0;
     for (long long i = 0; i < n; i++) {
-      long long rid = dense_id[rows[i]];
+      long long row = rows[i];
+      if (row < 0 || row >= capacity) {
+        if (lo == 0) local_dropped++;  // count once, thread 0
+        continue;
+      }
+      long long rid = dense_id[row];
       if (rid < lo || rid >= hi) {
         if (rid < 0 && lo == 0) local_dropped++;  // count once, thread 0
         continue;

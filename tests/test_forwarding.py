@@ -448,6 +448,74 @@ def test_native_import_scan_matches_pb_path():
     assert results[0] == results[1]
 
 
+def test_import_rejects_type_value_oneof_mismatch():
+    """A wire-legal Metric whose `type` field contradicts its value
+    oneof (e.g. type=Timer carrying a CounterValue) must be REJECTED —
+    counted in `failed`, landed in NO family — identically on the
+    protobuf batch path and the native wire-scan path.  The legacy
+    per-metric convert.from_pb path trusted `type` and would have
+    mis-filed the payload (a counter value merged into a digest row);
+    the batch paths make the mismatch loud and contractual
+    (aggregator._ONEOF_LEGAL_TYPES)."""
+    import veneur_tpu.ingest as ingest_mod
+    from veneur_tpu.core.aggregator import MetricAggregator
+    from veneur_tpu.protocol import tdigest_pb2
+
+    ingest_mod.load_library()   # loud if the engine can't build
+
+    def td():
+        return tdigest_pb2.MergingDigestData(
+            main_centroids=[tdigest_pb2.Centroid(mean=1.0, weight=1.0)],
+            compression=100.0, min=1.0, max=1.0, reciprocalSum=1.0)
+
+    def mk():
+        good = [
+            metric_pb2.Metric(name="okc", type=metric_pb2.Counter,
+                              counter=metric_pb2.CounterValue(value=5)),
+            metric_pb2.Metric(name="okg", type=metric_pb2.Gauge,
+                              gauge=metric_pb2.GaugeValue(value=2.5)),
+            # Timer carrying a HistogramValue is LEGAL (both digest
+            # kinds share the oneof field)
+            metric_pb2.Metric(name="okt", type=metric_pb2.Timer,
+                              histogram=metric_pb2.HistogramValue(
+                                  t_digest=td())),
+        ]
+        bad = [
+            # counter payload claiming to be a timer
+            metric_pb2.Metric(name="t.as.c", type=metric_pb2.Timer,
+                              counter=metric_pb2.CounterValue(value=9)),
+            # gauge payload claiming to be a set
+            metric_pb2.Metric(name="s.as.g", type=metric_pb2.Set,
+                              gauge=metric_pb2.GaugeValue(value=7.0)),
+            # histogram payload claiming to be a counter
+            metric_pb2.Metric(name="c.as.h", type=metric_pb2.Counter,
+                              histogram=metric_pb2.HistogramValue(
+                                  t_digest=td())),
+        ]
+        return good, bad
+
+    for use_native in (True, False):
+        agg = MetricAggregator(percentiles=[0.5])
+        good, bad = mk()
+        ms = good + bad
+        if use_native:
+            payload = forward_pb2.MetricList(
+                metrics=ms).SerializeToString()
+            ok, failed = agg.import_payload(payload)
+        else:
+            ok, failed = agg.import_pb_batch(ms)
+        assert (ok, failed) == (len(good), len(bad)), (use_native, ok,
+                                                       failed)
+        res = agg.flush(is_local=False)
+        names = {m.name for m in res.metrics}
+        for want in ("okc", "okg", "okt"):
+            assert any(n.startswith(want) for n in names), (use_native,
+                                                            want, names)
+        for reject in ("t.as.c", "s.as.g", "c.as.h"):
+            assert not any(n.startswith(reject) for n in names), (
+                use_native, reject, names)
+
+
 def test_import_row_cache_survives_flush_and_gc_cycles():
     """The V1 import identity->row cache must never serve a stale row:
     it clears at every flush (before end_interval's GC can free rows),

@@ -168,6 +168,49 @@ def test_serving_aggregator_1_vs_8_devices(is_local):
         assert fm.hll == other.hll
 
 
+@pytest.mark.parametrize("n_dev", [1, 2, 8])
+def test_presharded_overlapped_flush_conserves_intermetrics(n_dev):
+    """ISSUE 1 conservation: pre-sharded per-device staging + donated
+    input buffers + the dispatch/emit overlap (double-buffering) must
+    emit InterMetrics BYTE-identical — exact values, not approximate —
+    to the single process-wide device_put funnel, at 1, 2 and 8 virtual
+    devices.  Staging placement and donation are pure layout; both arms
+    run the identical kernel on identically-built dense matrices, so
+    any drift is a launch-path bug."""
+    from veneur_tpu.core.aggregator import MetricAggregator
+
+    kw = dict(percentiles=[0.5, 0.9, 0.99], ingest_lanes=4,
+              count_unique_timeseries=True)
+    funnel = MetricAggregator(mesh=mesh_mod.make_mesh(n_dev),
+                              flush_presharded_staging=False, **kw)
+    overlap = MetricAggregator(mesh=mesh_mod.make_mesh(n_dev),
+                               flush_presharded_staging=True, **kw)
+
+    _feed_aggregator(funnel)
+    _feed_aggregator(overlap)
+
+    def key(res):
+        return sorted((m.name, tuple(m.tags), m.type, m.value,
+                       m.timestamp, m.hostname) for m in res.metrics)
+
+    # funnel arm: the plain blocking flush
+    ref1 = funnel.flush(is_local=False, now=1234567)
+    # overlapped arm: interval 1 is dispatched but NOT yet emitted while
+    # interval 2's ingest is already staging into the arenas (the
+    # double-buffer window); emit() then fetches interval 1 — the
+    # snapshot must be immune to the concurrent staging
+    pend = overlap.flush_dispatch(is_local=False, now=1234567)
+    _feed_aggregator(overlap)          # interval 2 ingest mid-kernel
+    got1 = pend.emit()
+    assert key(got1) == key(ref1)
+
+    # interval 2: row reuse after a donated flush must conserve too
+    _feed_aggregator(funnel)
+    ref2 = funnel.flush(is_local=False, now=1234568)
+    got2 = overlap.flush(is_local=False, now=1234568)
+    assert key(got2) == key(ref2)
+
+
 def test_serving_aggregator_sharded_second_interval():
     """Row reset + reuse across intervals must behave identically when
     sharded (interval-scoped state, worker.go:462-481)."""

@@ -183,6 +183,10 @@ class Config:
     # nearest power of two, since only pow2 chunk counts tile the
     # pow2-padded row space)
     flush_upload_chunks: int = 2
+    # meshed flushes place each device's staged blocks directly on their
+    # owning device (pre-sharded staging) instead of one process-wide
+    # device_put funnel; off reverts to the funnel (A/B + debugging)
+    flush_presharded_staging: bool = True
     debug: bool = False
     enable_profiling: bool = False
     http_quit: bool = False

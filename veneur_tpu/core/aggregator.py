@@ -51,6 +51,39 @@ class FlushResult:
     unique_ts: Optional[int] = None
 
 
+class PendingFlush:
+    """A dispatched-but-not-yet-emitted flush (see
+    MetricAggregator.flush_dispatch): the snapshot is taken, the dense
+    staging is resident on device and the program is launched — but
+    nothing has waited on the device.  emit() performs the fetch and
+    generates the InterMetric batch; it must be called exactly once.
+    Between flush_dispatch() and emit() the caller may stage the next
+    interval (ingest continues regardless): the snapshot is immutable
+    and reset swapped in fresh device buffers, so an overlapping
+    dispatch can never alias this flush's inputs."""
+
+    __slots__ = ("_agg", "_snap", "_pend", "_res", "_is_local", "_now",
+                 "_seg", "_done")
+
+    def __init__(self, agg, snap, pend, res, is_local, now, seg):
+        self._agg = agg
+        self._snap = snap
+        self._pend = pend
+        self._res = res
+        self._is_local = is_local
+        self._now = now
+        self._seg = seg
+        self._done = False
+
+    def emit(self) -> "FlushResult":
+        if self._done:
+            raise RuntimeError("PendingFlush.emit() called twice")
+        self._done = True
+        return self._agg._emit_pending(self._snap, self._pend, self._res,
+                                       self._is_local, self._now,
+                                       self._seg)
+
+
 # Ceiling for the logical [rows, depth, ccap] intermediate of one
 # digest_export chunk (elements); see _emit_digests' forwarding branch.
 _EXPORT_ELEM_BUDGET = 1 << 26
@@ -73,7 +106,8 @@ class MetricAggregator:
                  hll_legacy_migration: bool = False,
                  digest_float64: bool = False,
                  digest_bf16_staging: bool = False,
-                 flush_upload_chunks: int = 2):
+                 flush_upload_chunks: int = 2,
+                 flush_presharded_staging: bool = True):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -126,6 +160,7 @@ class MetricAggregator:
             compression=compression, mesh=mesh, n_lanes=ingest_lanes,
             eval_dtype=np.float64 if digest_float64 else np.float32,
             bf16_staging=digest_bf16_staging,
+            presharded_staging=flush_presharded_staging,
             **kw)
         self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
                                        legacy_migration=hll_legacy_migration,
@@ -266,6 +301,23 @@ class MetricAggregator:
             else:
                 raise ValueError(f"unknown metric kind {fm.kind!r}")
 
+    # value-oneof field -> the wire `type` values it may legally carry
+    # (metricpb/metric.proto).  The metric family is dispatched from the
+    # ONEOF (it names the payload actually present); a wire-legal Metric
+    # whose `type` disagrees with its oneof — e.g. type=Timer carrying a
+    # CounterValue — is REJECTED (counted in `failed`) instead of being
+    # silently landed in either family.  The legacy per-metric path
+    # (forward/convert.from_pb) derived kind from `type` and would have
+    # merged the counter value into a digest row; neither behavior is
+    # defensible for such senders, so the batch paths make the mismatch
+    # loud and contractual.
+    _ONEOF_LEGAL_TYPES = {
+        "counter": (0,),        # metric_pb2.Counter
+        "gauge": (1,),          # metric_pb2.Gauge
+        "set": (3,),            # metric_pb2.Set
+        "histogram": (2, 4),    # metric_pb2.Histogram / Timer
+    }
+
     def import_pb_batch(self, pbs) -> tuple[int, int]:
         """Batched V1 import: ONE lock for the whole MetricList, direct
         protobuf field access, an identity->row cache (cleared every
@@ -273,14 +325,18 @@ class MetricAggregator:
         vectorized counter/gauge merges — the per-metric dataclass
         conversion, key construction, and numpy scalar stores of
         import_metric are the global tier's V1 inbound bottleneck at
-        fleet rates.  Scope/nil/local semantics match import_metric
-        exactly.  Returns (imported, failed)."""
+        fleet rates.  Scope/nil/local semantics match import_metric;
+        metrics whose `type` field contradicts their value oneof are
+        rejected (see _ONEOF_LEGAL_TYPES — the legacy convert.from_pb
+        path instead trusted `type` and mis-filed the payload).
+        Returns (imported, failed)."""
         from veneur_tpu.protocol import metric_pb2
 
         ok = failed = 0
         counters, gauges, sets, digests = (
             self.counters, self.gauges, self.sets, self.digests)
         cache = self._import_row_cache
+        legal = self._ONEOF_LEGAL_TYPES
         c_rows: list = []
         c_vals: list = []
         g_rows: list = []
@@ -289,6 +345,10 @@ class MetricAggregator:
             for pb in pbs:
                 try:
                     which = pb.WhichOneof("value")
+                    if which is not None and pb.type not in legal[which]:
+                        raise ValueError(
+                            f"type/value mismatch: type={pb.type} "
+                            f"carrying {which}")
                     if which == "counter":
                         ck = (pb.name, tuple(pb.tags), 0)
                         row = cache.get(ck)
@@ -336,6 +396,9 @@ class MetricAggregator:
 
         if pb.scope == metric_pb2.Local:
             raise ValueError("gRPC import does not accept local metrics")
+        if pb.type not in self._ONEOF_LEGAL_TYPES[which]:
+            raise ValueError(
+                f"type/value mismatch: type={pb.type} carrying {which}")
         tags = list(pb.tags)
         joined = ",".join(sorted(tags))
         if which == "set":
@@ -384,6 +447,7 @@ class MetricAggregator:
         h_lo = scan["h_lo"].tolist()
         h_hi = scan["h_hi"].tolist()
         wl = scan["which"].tolist()
+        mtypes = scan["mtype"].tolist()
         vals = scan["value"].tolist()
         offs = scan["rec_off"].tolist()
         lens = scan["rec_len"].tolist()
@@ -398,6 +462,14 @@ class MetricAggregator:
             for i in range(n):
                 w = wl[i]
                 if w == 1 or w == 2:
+                    # type/value-oneof agreement (same contract as
+                    # import_pb_batch): the wire scan already carries
+                    # the type field, so mismatches reject without a
+                    # protobuf parse — and before the row cache can
+                    # short-circuit the check
+                    if mtypes[i] != (0 if w == 1 else 1):
+                        failed += 1
+                        continue
                     ck = (h_lo[i], h_hi[i], w)
                     row = cache.get(ck)
                     if row is None:
@@ -473,6 +545,21 @@ class MetricAggregator:
     # -- flush -------------------------------------------------------------
 
     def flush(self, is_local: bool, now: Optional[int] = None) -> FlushResult:
+        return self.flush_dispatch(is_local, now).emit()
+
+    def flush_dispatch(self, is_local: bool,
+                       now: Optional[int] = None) -> "PendingFlush":
+        """Phase 1 of a flush: snapshot+reset under the lock, then
+        build, stage and LAUNCH the device program — everything up to
+        (but not including) waiting on device results.  Returns a
+        PendingFlush whose .emit() fetches the outputs and generates the
+        InterMetrics.  flush() == flush_dispatch().emit(); splitting
+        them lets a caller double-buffer across intervals — stage and
+        dispatch interval N+1 while interval N's kernel still runs, and
+        block (jax.block_until_ready semantics, via the fetch) only at
+        emit time.  Safe by construction: the snapshot is immutable
+        (reset swaps in fresh device buffers rather than zeroing shared
+        ones) and the emit phase touches only snapshot + fetched data."""
         now = int(now if now is not None else time.time())
         res = FlushResult()
 
@@ -489,10 +576,11 @@ class MetricAggregator:
         # unique-ts resolve on host and the program only runs when digest
         # rows were touched; an idle interval skips the dispatch entirely.
         # Multi-controller meshes may NEVER take the idle skip: the
-        # lockstep agreement gather inside _run_flush is a collective, and
-        # a controller that skipped it while a peer entered it would hang
-        # that peer for an interval and pair every later flush off by one
-        # — the gather itself decides (all-idle => zero-shape program).
+        # lockstep agreement gather inside _dispatch_flush is a
+        # collective, and a controller that skipped it while a peer
+        # entered it would hang that peer for an interval and pair every
+        # later flush off by one — the gather itself decides (all-idle
+        # => zero-shape program).
         multi_mesh = self.mesh is not None and jax.process_count() > 1
         idle = (not multi_mesh
                 and len(snap["digests"]["rows"]) == 0
@@ -500,7 +588,20 @@ class MetricAggregator:
                 and len(snap["counters"]["rows"]) == 0
                 and (not snap["have_uts"]
                      or snap["uts_host"] is not None))
-        host = {} if idle else self._run_flush(snap, is_local)
+        pend = None if idle else self._dispatch_flush(snap, is_local)
+        return PendingFlush(self, snap, pend, res, is_local, now, seg)
+
+    def _emit_pending(self, snap: dict, pend: Optional[dict],
+                      res: FlushResult, is_local: bool, now: int,
+                      seg: dict) -> FlushResult:
+        """Phase 2 of a flush (PendingFlush.emit body): fetch the
+        dispatched device outputs and generate the InterMetric batch."""
+        host = {} if pend is None else self._fetch_flush(snap, pend, seg)
+        if self.mesh is not None:
+            # the fetch above (or the idle skip) means the flush program
+            # can no longer read the snapshotted set registers: release
+            # the pin so lane updates go back to in-place donation
+            self.sets.unpin_lanes(snap.get("sets", {}).get("lanes"))
         if snap.pop("have_uts"):
             res.unique_ts = int(snap["uts_host"]
                                 if snap["uts_host"] is not None
@@ -611,40 +712,51 @@ class MetricAggregator:
             dv_u = jax.ShapeDtypeStruct((u_pad, d_pad),
                                         self.digests.stage_dtype)
             dep = jax.ShapeDtypeStruct((u_pad,), np.int16)
-            with self._CompileGuard(self, ((u_pad, d_pad), True)):
-                self.flush_fn.depth_variant.lower(
-                    dv_u, dep, self._pct_arr).compile()
+            # compile the variant production will launch: global tiers
+            # donate their per-flush buffers (donation is part of the
+            # executable — input/output aliasing — so the donated and
+            # plain programs cache separately)
+            donate = not self.is_local
+            du = (self.flush_fn.depth_variant_donated if donate
+                  else self.flush_fn.depth_variant)
+            dg = (self.flush_fn.lower_donated if donate
+                  else self.flush_fn.lower)
+            with self._CompileGuard(self, ((u_pad, d_pad), True, donate)):
+                du.lower(dv_u, dep, self._pct_arr).compile()
             n += 1
-            with self._CompileGuard(self, ((u_pad, d_pad), False)):
-                self.flush_fn.lower(dv, dv, mm, self._pct_arr,
-                                    uniform=False).compile()
+            with self._CompileGuard(self, ((u_pad, d_pad), False, donate)):
+                dg(dv, dv, mm, self._pct_arr, uniform=False).compile()
             n += 1
         return n
 
-    def _run_flush(self, snap: dict, is_local: bool) -> dict:
-        """Run the per-flush device program on the snapshot and read the
-        results back as host numpy (outside the lock).
+    def _dispatch_flush(self, snap: dict, is_local: bool) -> dict:
+        """Build, stage and LAUNCH the per-flush device program on the
+        snapshot (outside the lock) — everything asynchronous; no device
+        wait happens here.  Returns the pending-launch state that
+        _fetch_flush consumes at emit time.
 
-        Mesh-less: one digest program call (dense upload -> [K, P+2]
-        readback); sets/counters/unique-ts were already resolved on host
-        at snapshot.  Meshed: the full-family shard_map'd program.  Either
-        way the readback is a handful of slim arrays — device traffic
-        scales with the interval's samples and touched keys."""
+        Mesh-less: one digest program call per upload chunk (dense
+        upload -> [K, P+2] readback); sets/counters/unique-ts were
+        already resolved on host at snapshot.  Meshed: the full-family
+        shard_map'd program as ONE packed launch over pre-sharded staged
+        buffers.  On a non-forwarding (global) tier every per-flush
+        input buffer is DONATED to the program, killing XLA's
+        copy-on-entry; forwarding tiers keep the dense matrices alive
+        for digest export."""
         dpart = snap["digests"]
         nd = len(dpart["rows"])
-        n_cols = len(self._pct_arr)  # median + configured percentiles
-        host: dict = {}
+        seg = self.last_flush_segments
+        pend: dict = {"nd": nd, "meshed": self.mesh is not None}
         if self.mesh is None:
-            host["set_ests"] = snap["sets"]["estimates"]
             if nd == 0:
-                return host
-            seg = self.last_flush_segments
+                return pend
             uniform = dpart["uniform"]
+            donate = not is_local
             t0 = time.perf_counter()
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
                 dpart["d_min"], dpart["d_max"], uniform=uniform)
-            # uniform intervals: dw is the [U] int32 depth vector, not
+            # uniform intervals: dw is the [U] int16 depth vector, not
             # the [U, D] weight matrix, and minmax stays host-side —
             # roughly half the build and the uploaded bytes
             seg["build_s"] = time.perf_counter() - t0
@@ -661,44 +773,45 @@ class MetricAggregator:
                     >= self._upload_chunks * _CHUNK_MIN_ROWS):
                 n_chunks = self._upload_chunks
             rows_per = dv.shape[0] // n_chunks
-            t0 = time.perf_counter()
+            layout_s = dispatch_s = 0.0
             outs = []
             first_dev = None
             for c in range(n_chunks):
                 sl = slice(c * rows_per, (c + 1) * rows_per)
+                t0 = time.perf_counter()
                 if uniform:
                     dvd, depd = self.digests.put_dense_uniform(
                         dv[sl], dw[sl])
+                    layout_s += time.perf_counter() - t0
+                    t0 = time.perf_counter()
                     if first_dev is None:
                         first_dev = (dvd, depd)
-                    with self._CompileGuard(self, (dv[sl].shape, True)):
-                        outs.append(self.flush_fn.depth_variant(
-                            dvd, depd, self._pct_arr))
+                    fn = (self.flush_fn.depth_variant_donated if donate
+                          else self.flush_fn.depth_variant)
+                    with self._CompileGuard(
+                            self, (dv[sl].shape, True, donate)):
+                        outs.append(fn(dvd, depd, self._pct_arr))
                 else:
                     dvd, dwd, mmd = self.digests.put_dense(
                         dv[sl], dw[sl], minmax[:, sl])
+                    layout_s += time.perf_counter() - t0
+                    t0 = time.perf_counter()
                     if first_dev is None:
                         first_dev = (dvd, dwd)
-                    with self._CompileGuard(self, (dv[sl].shape, False)):
+                    with self._CompileGuard(
+                            self, (dv[sl].shape, False, donate)):
                         outs.append(self.flush_fn(dvd, dwd, mmd,
                                                   self._pct_arr,
-                                                  uniform=False))
-            seg["dispatch_s"] = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            fetched = serving.fetch(tuple(outs))
-            ev = fetched[0] if n_chunks == 1 else np.concatenate(fetched)
-            seg["device_s"] = time.perf_counter() - t0
-            seg["readback_bytes"] = ev.nbytes
-            host["dense_dev"] = first_dev
-            host["dense_uniform"] = uniform
-            if uniform:
-                # slim readback: ev carries the quantile columns only;
-                # exact f64 totals come from the host accumulators
-                host["qs"] = ev[:nd, :n_cols]
-                host["counts"] = np.asarray(dpart["d_weight"],
-                                            np.float64)
-                host["sums"] = np.asarray(dpart["d_sum"], np.float64)
-                return host
+                                                  uniform=False,
+                                                  donate=donate))
+                dispatch_s += time.perf_counter() - t0
+            seg["layout_s"] = layout_s
+            seg["dispatch_s"] = dispatch_s
+            # donated buffers are consumed by the program; a forwarding
+            # tier (never donating) keeps the first chunk for export
+            pend.update(outs=outs, n_chunks=n_chunks, uniform=uniform,
+                        first_dev=None if donate else first_dev)
+            return pend
         else:
             multi = jax.process_count() > 1
             if multi and is_local:
@@ -773,45 +886,106 @@ class MetricAggregator:
                 g_nd, g_depth = nd, 0
                 g_nc, g_ns = len(crows), len(srows)
                 g_uniform = snap["digests"]["uniform"]
+            t0 = time.perf_counter()
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
                 dpart["d_min"], dpart["d_max"],
                 u_floor=g_nd, d_floor=g_depth)
-            dvd, dwd, mmd = self.digests.put_dense(dv, dw, minmax)
+            seg["build_s"] = time.perf_counter() - t0
+            seg["upload_bytes"] = dv.nbytes + dw.nbytes + minmax.nbytes
+            # pre-sharded staging: each device's blocks are placed
+            # directly (no process-wide re-layout on program entry)
+            t0 = time.perf_counter()
+            dvd, dwd, mmd = self.digests.put_dense_sharded(dv, dw, minmax)
             inputs = serving.FlushInputs(
                 dense_v=dvd, dense_w=dwd, minmax=mmd,
                 hll_regs=snap["sets"]["lanes"],
                 counter_planes=snap["counter_planes"](),
                 uts_regs=snap["uts_regs"])
-            from veneur_tpu.parallel.mesh import SHARD_AXIS
-            # per-device shard shape decides whether the Pallas network
-            # choice is a distinct program (see pallas_eval_applies)
+            seg["layout_s"] = time.perf_counter() - t0
+            from veneur_tpu.parallel.mesh import REPLICA_AXIS, SHARD_AXIS
+            # per-device eval shape decides whether the Pallas network
+            # choice is a distinct program (see pallas_eval_applies):
+            # after the all_to_all repartition each device evaluates
+            # K/(S*R) rows at the full staged depth
+            n_dev_rows = (inputs.dense_v.shape[0]
+                          // self.mesh.shape[SHARD_AXIS]
+                          // self.mesh.shape[REPLICA_AXIS])
             g_uniform = (g_uniform and serving.pallas_eval_applies(
-                inputs.dense_v.shape[0] // self.mesh.shape[SHARD_AXIS],
-                inputs.dense_v.shape[1], inputs.dense_v.dtype))
+                n_dev_rows, inputs.dense_v.shape[1],
+                inputs.dense_v.dtype))
+            # a forwarding tier re-reads the dense matrices for digest
+            # export; only a global tier donates its staged buffers
+            donate = not is_local
             shapes = tuple(x.shape for x in inputs)
-            with self._CompileGuard(self, (shapes, g_uniform)):
+            t0 = time.perf_counter()
+            with self._CompileGuard(self, (shapes, g_uniform, donate)):
                 # ONE flat f32 buffer + the u8 set registers — the
                 # packed launch shape (serving.pack_outputs): dispatch
                 # cost scales with output-handle count
                 flat_dev, set_regs_out = self.flush_fn(
-                    inputs, self._pct_arr, uniform=g_uniform)
-            host["dense_dev"] = (dvd, dwd)
+                    inputs, self._pct_arr, uniform=g_uniform,
+                    donate=donate)
             set_regs_dev = None
+            ps = None
             if (g_ns and is_local
                     and (snap["sets"]["scopes"]
                          == int(MetricScope.MIXED)).any()):
                 ps = self._padded_rows(srows)
                 set_regs_dev = serving.set_regs_pack(
                     set_regs_out, jnp.asarray(ps))
-            flat_t, set_regs_t = serving.fetch((flat_dev, set_regs_dev))
-            k_rows = inputs.dense_v.shape[0]
-            k2 = inputs.counter_planes.shape[1]
-            n_sets_cap = inputs.hll_regs.shape[1]
+            seg["dispatch_s"] = time.perf_counter() - t0
+            pend.update(
+                flat_dev=flat_dev, set_regs_dev=set_regs_dev, ps=ps,
+                k_rows=inputs.dense_v.shape[0],
+                k2=inputs.counter_planes.shape[1],
+                n_sets_cap=inputs.hll_regs.shape[1],
+                crows=crows, srows=srows,
+                dense_dev=None if donate else (dvd, dwd))
+            return pend
+
+    def _fetch_flush(self, snap: dict, pend: dict, seg: dict) -> dict:
+        """Wait on a dispatched flush's device outputs and read them
+        back as host numpy — the ONLY place a flush blocks on the
+        device.  Either way the readback is a handful of slim arrays:
+        device traffic scales with the interval's samples and touched
+        keys."""
+        dpart = snap["digests"]
+        nd = pend["nd"]
+        n_cols = len(self._pct_arr)  # median + configured percentiles
+        host: dict = {}
+        if not pend["meshed"]:
+            host["set_ests"] = snap["sets"]["estimates"]
+            if nd == 0:
+                return host
+            t0 = time.perf_counter()
+            fetched = serving.fetch(tuple(pend["outs"]))
+            ev = (fetched[0] if pend["n_chunks"] == 1
+                  else np.concatenate(fetched))
+            seg["device_s"] = time.perf_counter() - t0
+            seg["readback_bytes"] = ev.nbytes
+            host["dense_dev"] = pend["first_dev"]
+            host["dense_uniform"] = pend["uniform"]
+            if pend["uniform"]:
+                # slim readback: ev carries the quantile columns only;
+                # exact f64 totals come from the host accumulators
+                host["qs"] = ev[:nd, :n_cols]
+                host["counts"] = np.asarray(dpart["d_weight"],
+                                            np.float64)
+                host["sums"] = np.asarray(dpart["d_sum"], np.float64)
+                return host
+        else:
+            t0 = time.perf_counter()
+            flat_t, set_regs_t = serving.fetch(
+                (pend["flat_dev"], pend["set_regs_dev"]))
+            seg["device_s"] = time.perf_counter() - t0
+            seg["readback_bytes"] = flat_t.nbytes + (
+                0 if set_regs_t is None else set_regs_t.nbytes)
             ev_t, c_hi_t, c_lo_t, set_ests_t, uts = \
-                serving.unpack_outputs(flat_t, k_rows, n_cols, k2,
-                                       n_sets_cap)
+                serving.unpack_outputs(flat_t, pend["k_rows"], n_cols,
+                                       pend["k2"], pend["n_sets_cap"])
             host["unique_ts"] = uts
+            crows, srows = pend["crows"], pend["srows"]
             if len(crows):
                 host["c_hi"] = c_hi_t.astype(np.float64)[crows]
                 host["c_lo"] = c_lo_t.astype(np.float64)[crows]
@@ -819,7 +993,8 @@ class MetricAggregator:
                 host["set_ests"] = set_ests_t[srows]
             if set_regs_t is not None:
                 host["set_regs"] = set_regs_t.reshape(
-                    len(ps), -1)[:len(srows)]
+                    len(pend["ps"]), -1)[:len(srows)]
+            host["dense_dev"] = pend["dense_dev"]
             if nd == 0:
                 return host
             ev = ev_t

@@ -319,8 +319,18 @@ class GaugeArena(_ArenaBase):
         self.values[row] = value  # Merge overwrites (samplers.go:200-202)
 
     def merge_batch(self, rows: np.ndarray, vals: np.ndarray) -> None:
-        """Vectorized import merges: numpy fancy assignment applies in
-        order, so duplicate rows keep last-write-wins semantics."""
+        """Vectorized import merges.  Gauge Merge is last-write-wins
+        (samplers.go:200-202), and NumPy documents the result of fancy
+        assignment with repeated indices as UNSPECIFIED — so duplicate
+        rows are deduplicated to their final occurrence before the
+        assignment instead of relying on in-practice ordering."""
+        if len(rows) > 1:
+            # np.unique on the reversed rows keeps the FIRST reversed
+            # occurrence = the LAST original one
+            uniq, rev_first = np.unique(rows[::-1], return_index=True)
+            if len(uniq) != len(rows):
+                rows = uniq
+                vals = vals[len(vals) - 1 - rev_first]
         self.values[rows] = vals
         self.touched[rows] = True
 
@@ -396,6 +406,15 @@ class SetArena(_ArenaBase):
             self.lanes_regs = serving.put(
                 np.zeros((self.n_lanes, capacity, self.m), np.uint8),
                 self._lane_shd)
+        # count of dispatched-but-not-yet-fetched flushes holding a
+        # lane-register snapshot (incremented by snapshot_lanes(),
+        # decremented by unpin_lanes() after the flush fetch): while
+        # nonzero — or always on the CPU backend, whose runtime
+        # mismanages donated sharded update chains (see
+        # serving.lane_donation_ok) — lane updates route through the
+        # COPYING kernels so the in-flight program's snapshot is never
+        # handed to XLA as scratch.
+        self._snapshot_inflight = 0
         self._seq = 0
         # staging: raw hashes per batch (vectorized split at sync)
         self._stage_rows: list[int] = []
@@ -512,7 +531,10 @@ class SetArena(_ArenaBase):
             pk[:n] = rank
             lane = self._seq % self.n_lanes
             self._seq += 1
-            self.lanes_regs = serving.set_lane_scatter(
+            scatter = (serving.set_lane_scatter
+                       if self._lane_donate_ok()
+                       else serving.set_lane_scatter_copy)
+            self.lanes_regs = scatter(
                 self.lanes_regs, jnp.asarray(pr), jnp.asarray(pi),
                 jnp.asarray(pk), lane)
         if self._merge_rows:
@@ -527,14 +549,36 @@ class SetArena(_ArenaBase):
                 mat[i] = regs
             lane = self._seq % self.n_lanes
             self._seq += 1
-            self.lanes_regs = serving.set_lane_merge_rows(
+            merge = (serving.set_lane_merge_rows
+                     if self._lane_donate_ok()
+                     else serving.set_lane_merge_rows_copy)
+            self.lanes_regs = merge(
                 self.lanes_regs, jnp.asarray(pr), jnp.asarray(mat), lane)
+
+    def _lane_donate_ok(self) -> bool:
+        """In-place (donating) lane updates are legal only when no
+        dispatched flush still reads a register snapshot AND the backend
+        handles donation correctly (serving.lane_donation_ok)."""
+        return (not self._snapshot_inflight
+                and serving.lane_donation_ok())
 
     def snapshot_lanes(self) -> jnp.ndarray:
         """Meshed only: immutable ref to the current lane registers (sync
-        first); the flush program pmax-merges and estimates them."""
+        first); the flush program pmax-merges and estimates them.  Marks
+        a flush IN FLIGHT until unpin_lanes(): from dispatch to fetch
+        the launched program reads this snapshot, and a donating
+        in-place lane update in that window corrupts it (updates route
+        through the copying kernels while the count is nonzero)."""
         self.sync()
+        self._snapshot_inflight += 1
         return self.lanes_regs
+
+    def unpin_lanes(self, ref=None) -> None:
+        """Release one snapshot hold (call once the flush that took it
+        has fetched its outputs — the program can no longer read the
+        registers, so in-place donating updates are safe again)."""
+        del ref  # kept for call-site symmetry; holds are counted
+        self._snapshot_inflight = max(0, self._snapshot_inflight - 1)
 
     def host_estimates(self, rows: np.ndarray) -> np.ndarray:
         """Mesh-less only: batched LogLog-Beta estimates of the given
@@ -595,9 +639,15 @@ class DigestArena(_ArenaBase):
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
                  compression: float = td.DEFAULT_COMPRESSION,
                  mesh=None, n_lanes: Optional[int] = None,
-                 eval_dtype=np.float32, bf16_staging: bool = False):
+                 eval_dtype=np.float32, bf16_staging: bool = False,
+                 presharded_staging: bool = True):
         super().__init__(capacity)
         self.compression = compression
+        # pre-sharded staging (put_dense_sharded): per-device block
+        # placement of the meshed dense build; off = the single
+        # process-wide device_put funnel (kept for A/B and conservation
+        # testing)
+        self.presharded_staging = presharded_staging
         self.ccap = td.centroid_capacity(compression)
         # float64 evaluation option (digest_float64): staging is ALWAYS
         # host f64; this controls the dense matrices the flush program
@@ -900,9 +950,27 @@ class DigestArena(_ArenaBase):
         bytes crossing the host->device link (the e2e flush's dominant
         cost; VERDICT r4 items 3-4)."""
         rows, vals, wts = staged
+        if len(rows) and (int(rows.min()) < 0
+                          or int(rows.max()) >= self.capacity):
+            # corrupt staged row ids: a negative id would WRAP through
+            # numpy negative indexing (and an out-of-bounds read in the
+            # native fill) into another key's row — drop loudly instead
+            bad = (rows < 0) | (rows >= self.capacity)
+            import logging
+            logging.getLogger("veneur_tpu.core.arena").error(
+                "dropping %d staged digest points with out-of-bounds "
+                "row ids (corrupt staging)", int(bad.sum()))
+            keep_mask = ~bad
+            rows, vals, wts = rows[keep_mask], vals[keep_mask], \
+                wts[keep_mask]
         nd = len(touched)
-        u_pad = self.n_shards * _pow2(
-            -(-max(nd, u_floor, 1) // self.n_shards))
+        # each shard's row block must split evenly over the replicas:
+        # the flush body's all_to_all re-partitions K_s rows R-ways
+        per_shard = _pow2(-(-max(nd, u_floor, 1) // self.n_shards))
+        if per_shard % self.n_replicas:
+            per_shard = self.n_replicas * _pow2(
+                -(-per_shard // self.n_replicas))
+        u_pad = self.n_shards * per_shard
         dense_id = np.full(self.capacity, -1, np.int64)
         dense_id[touched] = np.arange(nd)
 
@@ -991,6 +1059,21 @@ class DigestArena(_ArenaBase):
         return (serving.put(dv, self._dense_shd),
                 serving.put(dw, self._dense_shd),
                 serving.put(minmax, self._minmax_shd))
+
+    def put_dense_sharded(self, dv: np.ndarray, dw: np.ndarray,
+                          minmax: np.ndarray):
+        """Pre-sharded staging of the meshed dense build
+        (serving.place_dense_blocks: per-device block placement, no
+        process-wide re-layout on program entry).  Falls back to
+        put_dense when unmeshed, multi-controller (each process only
+        holds its own slices — serving.put's make_array_from_callback
+        handles that), or when the flag is off."""
+        import jax
+        if (self.mesh is None or not self.presharded_staging
+                or jax.process_count() > 1):
+            return self.put_dense(dv, dw, minmax)
+        return serving.place_dense_blocks(
+            self.mesh, dv, dw, minmax, self._dense_shd, self._minmax_shd)
 
     def put_dense_uniform(self, dv: np.ndarray, depths: np.ndarray):
         """Device-put the uniform (depth-vector) dense build — no

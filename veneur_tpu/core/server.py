@@ -201,7 +201,8 @@ class Server:
             hll_legacy_migration=cfg.hll_legacy_migration,
             digest_float64=cfg.digest_float64,
             digest_bf16_staging=cfg.digest_bf16_staging,
-            flush_upload_chunks=cfg.flush_upload_chunks)
+            flush_upload_chunks=cfg.flush_upload_chunks,
+            flush_presharded_staging=cfg.flush_presharded_staging)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -991,8 +992,19 @@ class Server:
         flush_start = time.perf_counter()
 
         self._drain_native()
-        res = self.aggregator.flush(is_local=self.is_local)
+        # overlapped launch: snapshot + stage + dispatch the device
+        # program, then run this interval's host-side self-metric
+        # accounting WHILE the kernel executes; pending.emit() — the
+        # only device wait — happens once the host work is done.  The
+        # try/finally guarantees exactly one emit even if an accounting
+        # statsd call raises.
+        pending = self.aggregator.flush_dispatch(is_local=self.is_local)
         self.flush_count += 1
+
+        try:
+            self._flush_interval_accounting(statsd)
+        finally:
+            res = pending.emit()
 
         # worker.metrics_processed_total (worker.go:477)
         statsd.count("worker.metrics_processed_total",
@@ -1002,43 +1014,9 @@ class Server:
             statsd.count("flush.unique_timeseries_total", res.unique_ts,
                          tags=["global_veneur:"
                                + str(not self.is_local).lower()])
-        # listen.received_per_protocol_total (flusher.go:280,455-475)
-        with self._proto_lock:
-            drained, self.proto_received = (self.proto_received,
-                                            collections.Counter())
-        for proto, n in drained.items():
-            statsd.count("listen.received_per_protocol_total", n,
-                         tags=[f"protocol:{proto}"])
-        if self.native is not None:
-            # parse-error/too-long accounting from the native data plane
-            mal, tl = self.native.malformed, self.native.too_long
-            pm, pt = self._native_err_reported
-            if mal > pm:
-                statsd.count("listen.parse_errors_total", mal - pm,
-                             tags=["protocol:udp"])
-            if tl > pt:
-                statsd.count("listen.packets_too_long_total", tl - pt,
-                             tags=["protocol:udp"])
-            self._native_err_reported = (mal, tl)
-        # legacy VH HLL payload accounting (mixed-hash inflation warning
-        # lives in sketches/hll.py; the metric makes it monitorable)
-        vh_total = hll_mod.legacy_vh_total
-        if vh_total > self._legacy_hll_reported:
-            statsd.count("listen.legacy_hll_total",
-                         vh_total - self._legacy_hll_reported)
-            self._legacy_hll_reported = vh_total
-        # compile-churn observability: first-bucket XLA compiles this
-        # interval (flush-path or prewarm) and their wall seconds
-        ce, cs = (self.aggregator.compile_events,
-                  self.aggregator.compile_seconds_total)
-        if ce > self._compiles_reported[0]:
-            statsd.count("flush.compile_events_total",
-                         ce - self._compiles_reported[0])
-            statsd.timing("flush.compile_duration_ms",
-                          (cs - self._compiles_reported[1]) * 1e3)
-            self._compiles_reported = (ce, cs)
         # measured decomposition of the flush that just ran (snapshot/
-        # build/dispatch/device/emit + bytes moved)
+        # build/layout/dispatch/device/emit + bytes moved) — read after
+        # emit so device_s reflects THIS flush, not the last one
         for seg_name, v in list(
                 self.aggregator.last_flush_segments.items()):
             if seg_name.endswith("_s"):
@@ -1046,18 +1024,6 @@ class Server:
                               v * 1e3)
             else:
                 statsd.gauge(f"flush.{seg_name}", float(v))
-        statsd.count("spans.received_total", self.ssf_received)
-        self.ssf_received = 0
-        # per-span-sink ingest accounting (worker.go:603-678)
-        for w in self.span_workers:
-            ingested, dropped, errors, dur_ns = w.interval_stats()
-            stags = [f"sink:{w.sink.name()}"]
-            statsd.count("worker.span.ingested_total", ingested, tags=stags)
-            statsd.count(sink_mod.SPANS_DROPPED_TOTAL, dropped, tags=stags)
-            if errors:
-                statsd.count("worker.span.ingest_errors_total", errors,
-                             tags=stags)
-            statsd.timing(sink_mod.SPAN_INGEST_DURATION, dur_ns, tags=stags)
 
         with self._events_lock:
             events, self._events = self._events, []
@@ -1109,6 +1075,58 @@ class Server:
             "flush.total_duration_ns",
             time.perf_counter() - flush_start))
         span.finish()
+
+    def _flush_interval_accounting(self, statsd) -> None:
+        """Host-side per-interval self-metric accounting that does not
+        depend on the flush result — run between flush_dispatch() and
+        emit() so it overlaps the device kernel."""
+        # listen.received_per_protocol_total (flusher.go:280,455-475)
+        with self._proto_lock:
+            drained, self.proto_received = (self.proto_received,
+                                            collections.Counter())
+        for proto, n in drained.items():
+            statsd.count("listen.received_per_protocol_total", n,
+                         tags=[f"protocol:{proto}"])
+        if self.native is not None:
+            # parse-error/too-long accounting from the native data plane
+            mal, tl = self.native.malformed, self.native.too_long
+            pm, pt = self._native_err_reported
+            if mal > pm:
+                statsd.count("listen.parse_errors_total", mal - pm,
+                             tags=["protocol:udp"])
+            if tl > pt:
+                statsd.count("listen.packets_too_long_total", tl - pt,
+                             tags=["protocol:udp"])
+            self._native_err_reported = (mal, tl)
+        # legacy VH HLL payload accounting (mixed-hash inflation warning
+        # lives in sketches/hll.py; the metric makes it monitorable)
+        vh_total = hll_mod.legacy_vh_total
+        if vh_total > self._legacy_hll_reported:
+            statsd.count("listen.legacy_hll_total",
+                         vh_total - self._legacy_hll_reported)
+            self._legacy_hll_reported = vh_total
+        # compile-churn observability: first-bucket XLA compiles this
+        # interval (flush-path or prewarm) and their wall seconds
+        ce, cs = (self.aggregator.compile_events,
+                  self.aggregator.compile_seconds_total)
+        if ce > self._compiles_reported[0]:
+            statsd.count("flush.compile_events_total",
+                         ce - self._compiles_reported[0])
+            statsd.timing("flush.compile_duration_ms",
+                          (cs - self._compiles_reported[1]) * 1e3)
+            self._compiles_reported = (ce, cs)
+        statsd.count("spans.received_total", self.ssf_received)
+        self.ssf_received = 0
+        # per-span-sink ingest accounting (worker.go:603-678)
+        for w in self.span_workers:
+            ingested, dropped, errors, dur_ns = w.interval_stats()
+            stags = [f"sink:{w.sink.name()}"]
+            statsd.count("worker.span.ingested_total", ingested, tags=stags)
+            statsd.count(sink_mod.SPANS_DROPPED_TOTAL, dropped, tags=stags)
+            if errors:
+                statsd.count("worker.span.ingest_errors_total", errors,
+                             tags=stags)
+            statsd.timing(sink_mod.SPAN_INGEST_DURATION, dur_ns, tags=stags)
 
     def _excluded_tags_for(self, sink_name: str):
         """tags_exclude keys applying to this sink (global ∪ sink-scoped);

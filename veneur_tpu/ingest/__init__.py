@@ -100,7 +100,7 @@ def load_library():
         lib.vn_fill_dense.restype = ctypes.c_longlong
         lib.vn_fill_dense.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_longlong, ctypes.c_void_p,
+            ctypes.c_longlong, ctypes.c_void_p, ctypes.c_longlong,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_longlong, ctypes.c_longlong, ctypes.c_int]
         lib.vn_route.restype = ctypes.c_void_p
@@ -223,8 +223,13 @@ def fill_dense(rows, vals, wts, dense_id, dv, dw, depths,
                n_threads: int = 4) -> int:
     """Native COO->dense fill (see vn_fill_dense in ingest_engine.cpp).
     Arrays must be C-contiguous with dtypes int64/float64/float64/
-    int64/float32/float32/int16.  Returns dropped-element count (caller
-    falls back to the numpy builder when nonzero)."""
+    int64/float32/float32/int16.  Row ids outside [0, len(dense_id))
+    are corrupt and count as dropped — both here (cheap vectorized
+    pre-check, so a poisoned batch never reaches native code) and in
+    the C++ fill itself (defense in depth: NumPy-style negative indices
+    would otherwise wrap into an out-of-bounds read).  Returns
+    dropped-element count (caller falls back to the numpy builder when
+    nonzero)."""
     import numpy as np
 
     lib = load_library()
@@ -234,10 +239,15 @@ def fill_dense(rows, vals, wts, dense_id, dv, dw, depths,
 
     assert rows.dtype == np.int64 and vals.dtype == np.float64
     assert dv.dtype == np.float32 and dense_id.dtype == np.int64
+    capacity = len(dense_id)
+    if len(rows) and (int(rows.min()) < 0
+                      or int(rows.max()) >= capacity):
+        return int(((rows < 0) | (rows >= capacity)).sum())
     u_pad, d_pad = dv.shape
     return int(lib.vn_fill_dense(
         ptr(rows), ptr(vals), ptr(wts), len(rows), ptr(dense_id),
-        ptr(dv), ptr(dw), ptr(depths), u_pad, d_pad, n_threads))
+        capacity, ptr(dv), ptr(dw), ptr(depths), u_pad, d_pad,
+        n_threads))
 
 
 def metro64(data: bytes) -> int:

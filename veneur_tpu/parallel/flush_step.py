@@ -59,18 +59,21 @@ def flush_step_packed(inputs: FlushInputs, percentiles: jax.Array,
     return serving.pack_outputs(out), out.set_regs
 
 
-def make_sharded_flush_step(mesh: Mesh):
-    """Build the shard_map'd multi-chip flush step over a
-    (shard, replica) mesh.
-
-    Input shardings: dense sample matrices `[K, D]` carry keys over
-    'shard' and depth over 'replica'; register/counter lanes `[R, ...]`
-    carry lanes over 'replica' with rows over 'shard'; outputs come back
-    sharded over 'shard' (scalars replicated).
-    """
+def _sharded_body(mesh: Mesh):
+    """The shard_map'd flush body over a (shard, replica) mesh: keys
+    over 'shard', staged depth repartitioned over 'replica' with one
+    all_to_all (each device evaluates K_s/R keys at full depth), lane
+    reductions over 'replica'.  When the replica axis has size 1 the
+    collectives are elided at trace time (the mesh=1 specialization)."""
+    from veneur_tpu.parallel import mesh as mesh_mod
+    n_replicas = int(mesh.shape[REPLICA_AXIS])
+    axis = REPLICA_AXIS if n_replicas > 1 else None
+    ev_spec = (P((SHARD_AXIS, REPLICA_AXIS), None) if n_replicas > 1
+               else P(SHARD_AXIS, None))
     spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
-    fn = jax.shard_map(
-        functools.partial(serving.flush_body, axis=REPLICA_AXIS),
+    return mesh_mod.shard_map(
+        functools.partial(serving.flush_body, axis=axis,
+                          shard_axis=SHARD_AXIS),
         mesh=mesh,
         in_specs=(FlushInputs(
             dense_v=P(SHARD_AXIS, REPLICA_AXIS),
@@ -80,12 +83,46 @@ def make_sharded_flush_step(mesh: Mesh):
             counter_planes=spec_lanes,
             uts_regs=P(REPLICA_AXIS, None)), P(None)),
         out_specs=FlushOutputs(
-            digest_eval=P(SHARD_AXIS, None),
+            digest_eval=ev_spec,
             counter_hi=P(SHARD_AXIS), counter_lo=P(SHARD_AXIS),
             set_regs=P(SHARD_AXIS, None), set_estimates=P(SHARD_AXIS),
-            unique_ts=P()),
-        check_vma=False)
-    return jax.jit(fn)
+            unique_ts=P()))
+
+
+def make_sharded_flush_step(mesh: Mesh):
+    """Build the shard_map'd multi-chip flush step over a
+    (shard, replica) mesh, returning unpacked FlushOutputs (the
+    compile-check / parity-test shape; production and the benches use
+    make_sharded_flush_step_packed)."""
+    return jax.jit(_sharded_body(mesh))
+
+
+def make_sharded_flush_step_packed(mesh: Mesh, donate: bool = False):
+    """The production launch shape of the sharded step: ONE flat f32
+    buffer + the u8 set registers (serving.pack_outputs) — dispatch
+    cost scales with output-handle count.  `donate=True` donates the
+    PER-FLUSH f32 buffers (dense matrices, minmax, counter planes) the
+    way the serving path does — legal only when the caller stages fresh
+    buffers each flush; the register lanes (set + unique-ts) stay
+    undonated, mirroring their device-resident production role."""
+    body = _sharded_body(mesh)
+
+    def run(dense_v, dense_w, minmax, counter_planes, uts_regs,
+            hll_regs, pct):
+        out = body(FlushInputs(
+            dense_v=dense_v, dense_w=dense_w, minmax=minmax,
+            hll_regs=hll_regs, counter_planes=counter_planes,
+            uts_regs=uts_regs), pct)
+        return serving.pack_outputs(out), out.set_regs
+
+    jitted = jax.jit(run, donate_argnums=(0, 1, 2, 3) if donate else ())
+
+    def step(inputs: FlushInputs, pct):
+        return jitted(inputs.dense_v, inputs.dense_w, inputs.minmax,
+                      inputs.counter_planes, inputs.uts_regs,
+                      inputs.hll_regs, pct)
+
+    return step
 
 
 def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,

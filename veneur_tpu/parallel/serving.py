@@ -117,6 +117,43 @@ def put(x, sharding):
     return jax.device_put(x, sharding)
 
 
+def place_dense_blocks(mesh: Mesh, dv, dw, minmax,
+                       dense_shd: NamedSharding,
+                       mm_shd: NamedSharding):
+    """Pre-sharded staging of the dense build: every device's blocks —
+    its row block of each shard (the dense builder's row order IS shard
+    order) and its depth slice — are placed DIRECTLY on their owning
+    device with one batched jax.device_put, then assembled with
+    make_array_from_single_device_arrays.  The mesh program consumes
+    already-resident shards instead of re-laying-out one process-wide
+    host matrix on entry, and the per-device transfers overlap on real
+    hardware.  Shared by DigestArena.put_dense_sharded (production) and
+    scripts/bench_mesh_scaling.py (so the bench times the REAL staging
+    path, not a copy of it).  minmax is key-sharded, replica-replicated:
+    every replica gets its shard's columns."""
+    from jax.sharding import SingleDeviceSharding
+    S = int(mesh.shape[SHARD_AXIS])
+    R = int(mesh.shape[REPLICA_AXIS])
+    ps, dr = dv.shape[0] // S, dv.shape[1] // R
+    devs = mesh.devices  # [S, R] device grid
+    blocks: list = []
+    tgts: list = []
+    for s in range(S):
+        for r in range(R):
+            dev = SingleDeviceSharding(devs[s][r])
+            blocks.append(dv[s * ps:(s + 1) * ps, r * dr:(r + 1) * dr])
+            tgts.append(dev)
+            blocks.append(dw[s * ps:(s + 1) * ps, r * dr:(r + 1) * dr])
+            tgts.append(dev)
+            blocks.append(minmax[:, s * ps:(s + 1) * ps])
+            tgts.append(dev)
+    arrs = jax.device_put(blocks, tgts)
+    asm = jax.make_array_from_single_device_arrays
+    return (asm(dv.shape, dense_shd, arrs[0::3]),
+            asm(dw.shape, dense_shd, arrs[1::3]),
+            asm(minmax.shape, mm_shd, arrs[2::3]))
+
+
 def fetch(x):
     """Device array (or pytree of arrays) -> host numpy.  Multi-controller:
     ONE process_allgather over DCN for the whole tree (callers batch every
@@ -209,26 +246,59 @@ def digest_eval_uniform(dv: jax.Array, depths: jax.Array,
 
 def flush_body(inputs: FlushInputs, percentiles: jax.Array,
                axis: Optional[str],
-               uniform: bool = False) -> FlushOutputs:
-    """Evaluate every family for one flush.  `axis` names the replica mesh
-    axis for collectives (None = single device, identical math)."""
+               uniform: bool = False,
+               shard_axis: Optional[str] = None) -> FlushOutputs:
+    """Evaluate every family for one flush.
+
+    `axis` names the replica mesh axis for cross-replica collectives;
+    None means the replica axis has size 1 (or no mesh at all) and the
+    math is identical with every collective elided at TRACE time — the
+    axis-size-1 specialization that keeps the mesh=1 wrapper overhead at
+    dispatch cost only.  `shard_axis` names the shard axis when meshed
+    (the unique-timeseries union must span it even when R == 1).
+
+    The digest repartition is an **all_to_all**, not an all_gather: each
+    replica group re-splits its key rows over the replicas while
+    concatenating the depth slices, so every device evaluates
+    K_s/R keys at FULL depth.  The old all_gather form materialized all
+    K_s keys at full depth on EVERY replica — R× the eval work and R×
+    the collective bytes for identical output (t-digest mergeability,
+    arxiv 1902.04023, is what makes any per-shard split legal; the
+    quantile evaluation itself is row-local either way)."""
     dv, dw = inputs.dense_v, inputs.dense_w
     if axis is not None:
-        # gather every replica's sample slice: [K_s, D/R] -> [K_s, D]
-        dv = jax.lax.all_gather(dv, axis, axis=1, tiled=True)
-        dw = jax.lax.all_gather(dw, axis, axis=1, tiled=True)
-    ev = digest_eval(dv, dw, inputs.minmax[0], inputs.minmax[1],
-                     percentiles, uniform=uniform)
+        # repartition [K_s, D/R] -> [K_s/R, D]: split keys, concat depth.
+        # BOTH matrices ride ONE all_to_all (stacked on a leading axis):
+        # every collective is a cross-device rendezvous, and the flush's
+        # wall-clock overhead scales with rendezvous count, not bytes —
+        # the stack copy is plain HBM traffic the combiner pays anyway.
+        both = jax.lax.all_to_all(jnp.stack([dv, dw]), axis,
+                                  split_axis=1, concat_axis=2,
+                                  tiled=True)
+        dv, dw = both[0], both[1]
+        # this replica's key sub-block of the (replica-replicated) minmax
+        j = jax.lax.axis_index(axis)
+        mm = jax.lax.dynamic_slice_in_dim(
+            inputs.minmax, j * dv.shape[0], dv.shape[0], axis=1)
+    else:
+        mm = inputs.minmax
+    ev = digest_eval(dv, dw, mm[0], mm[1], percentiles, uniform=uniform)
 
     set_regs = jnp.max(inputs.hll_regs, axis=0)
-    chi = jnp.sum(inputs.counter_planes[..., 0], axis=0)
-    clo = jnp.sum(inputs.counter_planes[..., 1], axis=0)
+    planes = jnp.sum(inputs.counter_planes, axis=0)   # [K2_s, 2]
     uts = jnp.max(inputs.uts_regs, axis=0)
     if axis is not None:
-        set_regs = jax.lax.pmax(set_regs, axis)
-        chi = jax.lax.psum(chi, axis)
-        clo = jax.lax.psum(clo, axis)
-        uts = jax.lax.pmax(jax.lax.pmax(uts, axis), SHARD_AXIS)
+        # one psum for both counter planes, one u8 pmax for both
+        # register families (same rendezvous-count argument as above)
+        planes = jax.lax.psum(planes, axis)
+        n_set = set_regs.size
+        regs = jax.lax.pmax(
+            jnp.concatenate([set_regs.ravel(), uts]), axis)
+        set_regs = regs[:n_set].reshape(set_regs.shape)
+        uts = regs[n_set:]
+    chi, clo = planes[..., 0], planes[..., 1]
+    if shard_axis is not None:
+        uts = jax.lax.pmax(uts, shard_axis)
     return FlushOutputs(
         digest_eval=ev, counter_hi=chi, counter_lo=clo,
         set_regs=set_regs, set_estimates=hll_mod.estimate(set_regs),
@@ -268,15 +338,30 @@ def make_serving_flush(mesh: Optional[Mesh]):
     host when there is nothing to reduce over (core/arena.py).
 
     With a mesh, returns the shard_map'd full-family program
-    fn(FlushInputs, percentiles, uniform=False) ->
+    fn(FlushInputs, percentiles, uniform=False, donate=False) ->
     (packed_f32, set_regs_u8): keys and set/counter rows shard over
-    'shard'; staged sample depth, set register lanes and counter planes
-    reduce over 'replica' (all_gather / pmax / psum); the
-    unique-timeseries registers pmax over both axes (across processes
-    this is the DCN union of per-host tallies).  The f32 outputs come
-    back as ONE flat buffer (pack_outputs; unpack with unpack_outputs)
-    — per-launch dispatch cost scales with output-handle count, so the
-    production flush hands the host two buffers, not six.
+    'shard'; staged sample depth repartitions over 'replica' with ONE
+    all_to_all (each device evaluates K_s/R keys at full depth — no
+    redundant replica evaluation), set register lanes and counter planes
+    reduce over 'replica' (pmax / psum); the unique-timeseries registers
+    pmax over both axes (across processes this is the DCN union of
+    per-host tallies).  When the replica axis has size 1 every
+    collective is elided at trace time, so the mesh=1 program is the
+    single-device program plus wrapper dispatch only.  The f32 outputs
+    come back as ONE flat buffer (pack_outputs; unpack with
+    unpack_outputs) — per-launch dispatch cost scales with
+    output-handle count, so the production flush hands the host two
+    buffers, not six.  `donate=True` (static) donates the PER-FLUSH f32
+    input buffers — the staged dense matrices, minmax and counter
+    planes — killing XLA's copy-on-entry; the u8 unique-ts registers
+    (fresh each flush but with no aliasable u8 output) and the live
+    set-register lanes (arena state that must survive the call) are
+    never donated.  Donate only when the caller will not touch the
+    staged buffers again (a forwarding tier re-reads the dense matrices
+    for digest export).  On CPU the donations are reported unusable at
+    compile (one UserWarning per shape — no f32 output matches the
+    staged buffers' layouts); they stay marked for the TPU backend,
+    where XLA reuses the donated HBM as scratch.
     """
     if mesh is None:
         @functools.partial(jax.jit, static_argnames=("uniform",))
@@ -284,28 +369,51 @@ def make_serving_flush(mesh: Optional[Mesh]):
             return digest_eval(dv, dw, minmax[0], minmax[1], pct,
                                uniform=uniform)
 
+        general_d = jax.jit(
+            lambda dv, dw, minmax, pct, uniform=False: digest_eval(
+                dv, dw, minmax[0], minmax[1], pct, uniform=uniform),
+            static_argnames=("uniform",), donate_argnums=(0, 1, 2))
+
         @jax.jit
         def depth_variant(dv, depths, pct):
             return digest_eval_uniform(dv, depths, pct)
 
-        def unmeshed(dv, dw, minmax, pct, uniform=False):
-            return general(dv, dw, minmax, pct, uniform=uniform)
+        # the int16 depth vector stays undonated: no int16 output
+        # exists to alias it into, and jax warns on unusable donations
+        depth_variant_d = jax.jit(
+            lambda dv, depths, pct: digest_eval_uniform(dv, depths, pct),
+            donate_argnums=(0,))
+
+        def unmeshed(dv, dw, minmax, pct, uniform=False, donate=False):
+            fn = general_d if donate else general
+            return fn(dv, dw, minmax, pct, uniform=uniform)
 
         unmeshed.lower = general.lower
+        unmeshed.lower_donated = general_d.lower
         # uniform intervals upload (values, per-row depths) instead of
         # (values, weights) — half the bytes; the aggregator routes
         # there whenever DigestArena.staged_uniform held
         unmeshed.depth_variant = depth_variant
+        unmeshed.depth_variant_donated = depth_variant_d
         return unmeshed
 
+    n_replicas = int(mesh.shape[REPLICA_AXIS])
+    axis = REPLICA_AXIS if n_replicas > 1 else None
     spec_lanes = P(REPLICA_AXIS, SHARD_AXIS, None)
+    # with the all_to_all repartition the evaluation rows shard over
+    # BOTH axes (shard-major, replica-minor — exactly the dense build's
+    # row order); at R == 1 nothing repartitions
+    ev_spec = (P((SHARD_AXIS, REPLICA_AXIS), None) if n_replicas > 1
+               else P(SHARD_AXIS, None))
     progs: dict = {}
 
-    def _prog(uniform: bool):
-        prog = progs.get(uniform)
+    def _prog(uniform: bool, donate: bool):
+        prog = progs.get((uniform, donate))
         if prog is None:
-            fn = jax.shard_map(
-                functools.partial(flush_body, axis=REPLICA_AXIS,
+            from veneur_tpu.parallel import mesh as mesh_mod
+            fn = mesh_mod.shard_map(
+                functools.partial(flush_body, axis=axis,
+                                  shard_axis=SHARD_AXIS,
                                   uniform=uniform),
                 mesh=mesh,
                 in_specs=(FlushInputs(
@@ -316,36 +424,42 @@ def make_serving_flush(mesh: Optional[Mesh]):
                     counter_planes=spec_lanes,
                     uts_regs=P(REPLICA_AXIS, None)), P(None)),
                 out_specs=FlushOutputs(
-                    digest_eval=P(SHARD_AXIS, None),
+                    digest_eval=ev_spec,
                     counter_hi=P(SHARD_AXIS), counter_lo=P(SHARD_AXIS),
                     set_regs=P(SHARD_AXIS, None),
                     set_estimates=P(SHARD_AXIS),
-                    unique_ts=P()),
-                check_vma=False)
-            prog = progs[uniform] = jax.jit(fn)
-        return prog
+                    unique_ts=P()))
 
-    packed_progs: dict = {}
-
-    def _packed_prog(uniform: bool):
-        prog = packed_progs.get(uniform)
-        if prog is None:
-            inner = _prog(uniform)
-
-            def run(inputs, pct):
-                out = inner(inputs, pct)
+            # leaf-splayed signature: jit donation is per-argument, and
+            # the live set registers (hll_regs) must NOT be donated —
+            # so the per-flush buffers travel as the leading arguments
+            def run(dense_v, dense_w, minmax, counter_planes, uts_regs,
+                    hll_regs, pct):
+                out = fn(FlushInputs(
+                    dense_v=dense_v, dense_w=dense_w, minmax=minmax,
+                    hll_regs=hll_regs, counter_planes=counter_planes,
+                    uts_regs=uts_regs), pct)
                 return pack_outputs(out), out.set_regs
 
-            prog = packed_progs[uniform] = jax.jit(run)
+            # donate the f32 per-flush buffers only: the u8 unique-ts
+            # registers are tiny and have no aliasable u8 output (jax
+            # warns on unusable donations), and the live set-register
+            # lanes must survive the call
+            prog = progs[(uniform, donate)] = jax.jit(
+                run, donate_argnums=(0, 1, 2, 3) if donate else ())
         return prog
 
-    def meshed(inputs, pct, uniform=False):
-        return _packed_prog(uniform)(inputs, pct)
+    def _splay(inputs):
+        return (inputs.dense_v, inputs.dense_w, inputs.minmax,
+                inputs.counter_planes, inputs.uts_regs, inputs.hll_regs)
+
+    def meshed(inputs, pct, uniform=False, donate=False):
+        return _prog(uniform, donate)(*_splay(inputs), pct)
 
     # expose lowering for HLO inspection (dryrun's replica-group check)
     meshed.lower = (
-        lambda inputs, pct, uniform=False: _packed_prog(uniform).lower(
-            inputs, pct))
+        lambda inputs, pct, uniform=False: _prog(uniform, False).lower(
+            *_splay(inputs), pct))
     return meshed
 
 
@@ -394,10 +508,9 @@ def partial_digests(dense_v: jax.Array, dense_w: jax.Array,
 # Set (HLL) lane kernels — device-resident register state (meshed tiers)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("lane",), donate_argnums=(0,))
-def set_lane_scatter(lanes_regs: jax.Array, rows: jax.Array,
-                     idx: jax.Array, rank: jax.Array,
-                     lane: int) -> jax.Array:
+def _set_lane_scatter(lanes_regs: jax.Array, rows: jax.Array,
+                      idx: jax.Array, rank: jax.Array,
+                      lane: int) -> jax.Array:
     """Scatter-max staged (set row, register index, rank) triples into lane
     `lane` of the `[R_s, S, m]` register state — the device half of
     Sketch.Insert (`samplers/samplers.go:242-244`).  Padding entries with
@@ -405,13 +518,43 @@ def set_lane_scatter(lanes_regs: jax.Array, rows: jax.Array,
     return lanes_regs.at[lane, rows, idx].max(rank)
 
 
-@functools.partial(jax.jit, static_argnames=("lane",), donate_argnums=(0,))
-def set_lane_merge_rows(lanes_regs: jax.Array, rows: jax.Array,
-                        regmat: jax.Array, lane: int) -> jax.Array:
+def _set_lane_merge_rows(lanes_regs: jax.Array, rows: jax.Array,
+                         regmat: jax.Array, lane: int) -> jax.Array:
     """Register-wise max of imported full register rows `[n, m]` into lane
     `lane` (Set.Merge, `samplers/samplers.go:299-311`).  All-zero padding
     rows are no-ops."""
     return lanes_regs.at[lane, rows].max(regmat)
+
+
+# In-place (donating) updates for the common case, plus COPYING twins.
+# SetArena.sync picks per call (see lane_donation_ok): the PJRT CPU
+# runtime double-frees donated sharded-update buffers that race an
+# in-flight reader on another executable — observed as corrupted set
+# estimates and interpreter segfaults under the overlapped flush
+# pipeline (tests/test_parallel.py conservation stress) — and a
+# dispatched-but-not-fetched flush additionally holds a snapshot the
+# update must never scribble over on ANY backend.
+set_lane_scatter = functools.partial(
+    jax.jit, static_argnames=("lane",),
+    donate_argnums=(0,))(_set_lane_scatter)
+set_lane_scatter_copy = functools.partial(
+    jax.jit, static_argnames=("lane",))(_set_lane_scatter)
+set_lane_merge_rows = functools.partial(
+    jax.jit, static_argnames=("lane",),
+    donate_argnums=(0,))(_set_lane_merge_rows)
+set_lane_merge_rows_copy = functools.partial(
+    jax.jit, static_argnames=("lane",))(_set_lane_merge_rows)
+
+
+@functools.lru_cache(maxsize=None)
+def lane_donation_ok() -> bool:
+    """Whether the in-place (donating) lane-update kernels are safe on
+    this backend.  PJRT:CPU mismanages donation of sharded u8 update
+    chains when another executable is concurrently in flight (the
+    symptom is silent register corruption, sometimes a hard segfault);
+    the TPU runtime — where donation is the production norm — is fine.
+    Cached once: the backend cannot change within a process."""
+    return jax.default_backend() != "cpu"
 
 
 @jax.jit
