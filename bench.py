@@ -716,10 +716,16 @@ def _ingest_payloads(rng: np.random.Generator) -> list[bytes]:
     return payloads
 
 
-def bench_ingest() -> float | None:
+def bench_ingest() -> dict | None:
     """UDP packets/s end-to-end: real datagrams through the native engine's
     recvmmsg readers, parsed, staged, and drained into the serving arenas.
-    Sender and readers share this host's cores (as they would in prod)."""
+    Sender and readers share this host's cores (as they would in prod).
+
+    Returns {"pps", "stage_ns", "stage_pkts"}: the headline plus the
+    run's per-stage nanosecond/unit totals from the engine's stage
+    counters (the profiling subsystem's data-plane accounting; see
+    scripts/ingest_ceiling.py for the saturation harness that reads the
+    same counters)."""
     from veneur_tpu import config as config_mod
     from veneur_tpu import ingest as ingest_mod
     from veneur_tpu.core.server import Server
@@ -777,7 +783,22 @@ def bench_ingest() -> float | None:
             f"(UDP socket shed under pressure), malformed={malformed}")
         log(f"ingest vs reference headline (>{INGEST_BASELINE_PPS} pkt/s, "
             f"README.md:363): {pps / INGEST_BASELINE_PPS:.1f}x")
-        return pps
+        # per-stage decomposition of the run (monotonic counters over
+        # the whole arm; units: packets for recvmmsg/parse/drain, calls
+        # for intern, staged values for stage)
+        stage_ns: dict = {}
+        stage_pkts: dict = {}
+        st = srv.native.stage_stats()
+        if st is not None:
+            from veneur_tpu.profiling import STAGE_UNITS
+            for stage, c in st["totals"].items():
+                stage_ns[stage] = int(c["ns"])
+                stage_pkts[stage] = int(c[STAGE_UNITS[stage]])
+            log("ingest stages (ns/unit): " + ", ".join(
+                f"{s}={stage_ns[s] / max(1, stage_pkts[s]):,.0f}"
+                for s in ingest_mod.STAGE_NAMES))
+        return {"pps": pps, "stage_ns": stage_ns,
+                "stage_pkts": stage_pkts}
     finally:
         srv.shutdown()
 
@@ -787,10 +808,11 @@ def main() -> None:
     python_ms = bench_baseline_python()
     baseline_ms = native_ms if native_ms is not None else python_ms
     try:
-        ingest_pps = bench_ingest()
+        ingest_res = bench_ingest()
     except Exception as e:
         log(f"ingest arm failed: {e}")
-        ingest_pps = None
+        ingest_res = None
+    ingest_pps = ingest_res["pps"] if ingest_res else None
     dv = bench_device()
     p50_ms, p99_ms = dv["p50"], dv["p99"]
     speedup = baseline_ms / p99_ms if p99_ms > 0 else 0.0
@@ -829,6 +851,13 @@ def main() -> None:
         result["ingest_udp_pkts_per_sec"] = round(ingest_pps)
         result["ingest_vs_baseline"] = round(
             ingest_pps / INGEST_BASELINE_PPS, 2)
+        # per-stage decomposition of the ingest arm (the profiling
+        # subsystem's data-plane counters; BASELINE.md documents how to
+        # read the table, scripts/ingest_ceiling.py is the saturation
+        # harness)
+        if ingest_res["stage_ns"]:
+            result["ingest_stage_ns"] = ingest_res["stage_ns"]
+            result["ingest_stage_pkts"] = ingest_res["stage_pkts"]
     try:
         scale = bench_device_scale()
     except Exception as e:
@@ -910,6 +939,8 @@ def main() -> None:
                 "weighted_dev_only_p50"]
     if "mesh_scaling_per_device_work_ms" in result:
         promised += ["mesh_scaling_e2e_ms", "mesh_scaling_segments_ms"]
+    if "ingest_udp_pkts_per_sec" in result:
+        promised += ["ingest_stage_ns", "ingest_stage_pkts"]
     missing = [k for k in promised if k not in result]
     assert not missing, (
         f"bench JSON is missing keys BASELINE.md promises: {missing}")

@@ -30,6 +30,7 @@
 #include <atomic>
 #include <algorithm>
 #include <cerrno>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -133,6 +134,72 @@ static inline uint64_t hash_bytes(const char* p, size_t n) {
 }
 
 // ---------------------------------------------------------------------------
+// Stage accounting clock
+// ---------------------------------------------------------------------------
+//
+// Per-thread, per-stage counters over the data-plane pipeline
+// (recvmmsg -> parse -> intern -> stage, plus the engine-level drain).
+// The hot path records raw TSC ticks (~6 ns/read on x86_64, vs ~20-25 ns
+// for clock_gettime) and the stats reader converts ticks to nanoseconds
+// with a ratio measured over the engine's whole lifetime — two
+// (steady_clock, tick) sample pairs, one at engine creation and one at
+// read time — so the hot path never pays a calibration.
+
+static inline uint64_t wall_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+#if defined(__x86_64__)
+static inline uint64_t tick_now() {
+  uint32_t lo, hi;
+  __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((uint64_t)hi << 32) | lo;
+}
+#else
+static inline uint64_t tick_now() { return wall_ns(); }
+#endif
+
+// Elapsed ticks since t0, clamped at 0: on hosts without an invariant/
+// cross-core-synchronized TSC a thread migrating cores mid-window can
+// read a SMALLER counter, and the unsigned underflow (~1.8e19) would be
+// fetch_add'ed into a stage counter and locked in forever by the
+// monotonic report latch.  A clamped window undercounts by one burst;
+// an underflow poisons the subsystem for the process lifetime.
+static inline uint64_t ticks_since(uint64_t t0) {
+  uint64_t t1 = tick_now();
+  return t1 > t0 ? t1 - t0 : 0;
+}
+
+// Per-reader-thread stage counters (ticks, converted at read time).
+// recvmmsg covers poll+recvmmsg syscall time INCLUDING the wait for
+// packets — at saturation that wait is the kernel handing datagrams
+// over (the socket-bound share); at idle it is simply idle time.
+struct StageCounters {
+  std::atomic<uint64_t> recv_pkts{0}, recv_ticks{0};
+  std::atomic<uint64_t> parse_pkts{0}, parse_ticks{0};
+  std::atomic<uint64_t> intern_calls{0}, intern_ticks{0};
+  std::atomic<uint64_t> stage_vals{0}, stage_ticks{0};
+  // reported-ns latches: the tick->ns ratio is re-measured per stats
+  // read, so a raw conversion can jitter a few ns BACKWARDS between two
+  // reads whose tick counter didn't grow; reported values latch to
+  // their maximum so the exported counters are strictly monotonic (the
+  // documented contract; /debug/vars scrapers take rate() over them)
+  std::atomic<uint64_t> rep_recv_ns{0}, rep_parse_ns{0},
+      rep_intern_ns{0}, rep_stage_ns{0};
+};
+
+// Raise `latch` to v if higher; return the latched (monotonic) value.
+static uint64_t mono_latch(std::atomic<uint64_t>& latch, uint64_t v) {
+  uint64_t cur = latch.load(std::memory_order_relaxed);
+  while (cur < v && !latch.compare_exchange_weak(
+                        cur, v, std::memory_order_relaxed)) {
+  }
+  return cur < v ? v : cur;
+}
+
+// ---------------------------------------------------------------------------
 // Strict float parsing (match veneur_tpu.samplers.parser._strict_float:
 // no whitespace, no underscores, no hex — Python float() rejects 0x forms)
 // ---------------------------------------------------------------------------
@@ -232,6 +299,7 @@ struct Batch {
 struct ThreadBuf {
   std::mutex mu;
   Batch cur;
+  StageCounters stages;
 };
 
 struct InternSlot {
@@ -287,6 +355,19 @@ struct Engine {
   std::atomic<uint64_t> tot_processed{0}, tot_malformed{0}, tot_packets{0},
       tot_too_long{0};
 
+  // stage-clock calibration baseline (ticks -> ns at stats-read time)
+  // and the engine-level drain stage (runs on the Python drainer thread)
+  uint64_t cal_ticks0 = 0, cal_ns0 = 0;
+  std::atomic<uint64_t> drain_calls{0}, drain_pkts{0}, drain_ticks{0};
+  std::atomic<uint64_t> rep_drain_ns{0};  // see StageCounters latches
+
+  double ns_per_tick() const {
+    uint64_t t1 = tick_now();
+    uint64_t n1 = wall_ns();
+    if (t1 <= cal_ticks0 || n1 <= cal_ns0) return 1.0;
+    return (double)(n1 - cal_ns0) / (double)(t1 - cal_ticks0);
+  }
+
   int new_thread() {
     std::lock_guard<std::mutex> l(bufs_mu);
     bufs.emplace_back(new ThreadBuf());
@@ -317,7 +398,35 @@ struct ThreadScratch {
   };
   static const int kCacheSlots = 4096;
   std::vector<CacheEntry> cache{kCacheSlots};
+
+  // per-burst stage-tick accumulators, flushed into the thread's
+  // StageCounters by account_burst (keeps the hot path at plain adds;
+  // the atomics are touched a handful of times per burst, not per line)
+  uint64_t acc_intern_ticks = 0, acc_intern_calls = 0;
+  uint64_t acc_stage_ticks = 0, acc_stage_vals = 0;
 };
+
+// Fold one burst's accumulated stage ticks into the thread counters.
+// `total_ticks` spans the whole parse burst; the parse stage is what
+// remains after intern + stage are carved out.
+static void account_burst(StageCounters& st, ThreadScratch& sc,
+                          uint64_t pkts, uint64_t total_ticks) {
+  uint64_t it = sc.acc_intern_ticks, ic = sc.acc_intern_calls;
+  uint64_t stt = sc.acc_stage_ticks, sv = sc.acc_stage_vals;
+  sc.acc_intern_ticks = sc.acc_intern_calls = 0;
+  sc.acc_stage_ticks = sc.acc_stage_vals = 0;
+  uint64_t carved = it + stt;
+  uint64_t pt = total_ticks > carved ? total_ticks - carved : 0;
+  auto add = [](std::atomic<uint64_t>& a, uint64_t v) {
+    if (v) a.fetch_add(v, std::memory_order_relaxed);
+  };
+  add(st.parse_pkts, pkts);
+  add(st.parse_ticks, pt);
+  add(st.intern_calls, ic);
+  add(st.intern_ticks, it);
+  add(st.stage_vals, sv);
+  add(st.stage_ticks, stt);
+}
 
 // Canonicalize a raw tag chunk: magic scope tags (first match wins,
 // parser.go:444-456), implicit-tag override (extend_tags.go:90-147), sort,
@@ -378,6 +487,12 @@ static uint8_t canonical_tags(Engine* e, ThreadScratch& sc,
 static uint32_t intern(Engine* e, ThreadScratch& sc, const char* name,
                        size_t nlen, uint8_t mt, const char* raw_tags,
                        size_t rtlen, bool has_tags) {
+  struct Timed {  // attribute this whole call to the intern stage
+    ThreadScratch& sc;
+    uint64_t t0 = tick_now();
+    explicit Timed(ThreadScratch& s) : sc(s) { sc.acc_intern_calls++; }
+    ~Timed() { sc.acc_intern_ticks += ticks_since(t0); }
+  } timed(sc);
   // Length-prefix the name so a 0x1F (or any byte) inside a name or tag
   // can never alias two distinct identities onto one intern key.
   std::string& key = sc.key;
@@ -504,6 +619,20 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
   uint32_t id =
       intern(e, sc, p, name_len, mt, raw_tags, raw_tags_len, found_tags);
 
+  // value loop = the stage stage: float-parse each value and append it
+  // to the per-thread columnar buffers (RAII so the malformed-value
+  // early return is accounted too)
+  struct StageTimed {
+    ThreadScratch& sc;
+    const Batch& b;
+    uint64_t t0, v0;
+    StageTimed(ThreadScratch& s, const Batch& bb)
+        : sc(s), b(bb), t0(tick_now()), v0(bb.processed) {}
+    ~StageTimed() {
+      sc.acc_stage_ticks += ticks_since(t0);
+      sc.acc_stage_vals += b.processed - v0;
+    }
+  } stage_timed(sc, b);
   const char* v = val_begin;
   for (;;) {
     const char* vc = (const char*)memchr(v, ':', val_end - v);
@@ -579,12 +708,16 @@ static void reader_loop(Engine* e, int fd, ThreadBuf* tb) {
     msgs[i].msg_hdr.msg_iov = &iov[i];
     msgs[i].msg_hdr.msg_iovlen = 1;
   }
+  StageCounters& st = tb->stages;
   while (!e->stop.load(std::memory_order_relaxed)) {
+    uint64_t recv_t0 = tick_now();
     pollfd pfd{fd, POLLIN, 0};
     int pr = poll(&pfd, 1, 100);
     if (pr < 0 && errno != EINTR) return;
     if (pr <= 0 || !(pfd.revents & POLLIN)) {
       if (pfd.revents & (POLLERR | POLLNVAL | POLLHUP)) return;
+      st.recv_ticks.fetch_add(ticks_since(recv_t0),
+                              std::memory_order_relaxed);
       continue;
     }
     int r = recvmmsg(fd, msgs.data(), VLEN, MSG_DONTWAIT, nullptr);
@@ -592,10 +725,17 @@ static void reader_loop(Engine* e, int fd, ThreadBuf* tb) {
       if (r < 0 && (errno == EAGAIN || errno == EINTR)) continue;
       return;
     }
-    std::lock_guard<std::mutex> l(tb->mu);
-    for (int i = 0; i < r; i++)
-      ingest_datagram(e, sc, (const char*)iov[i].iov_base, msgs[i].msg_len,
-                      tb->cur);
+    st.recv_ticks.fetch_add(ticks_since(recv_t0),
+                            std::memory_order_relaxed);
+    st.recv_pkts.fetch_add((uint64_t)r, std::memory_order_relaxed);
+    uint64_t parse_t0 = tick_now();
+    {
+      std::lock_guard<std::mutex> l(tb->mu);
+      for (int i = 0; i < r; i++)
+        ingest_datagram(e, sc, (const char*)iov[i].iov_base,
+                        msgs[i].msg_len, tb->cur);
+    }
+    account_burst(st, sc, (uint64_t)r, ticks_since(parse_t0));
   }
 }
 
@@ -612,6 +752,7 @@ struct DrainResult {
 };
 
 static DrainResult* drain(Engine* e, bool clear_intern) {
+  uint64_t drain_t0 = tick_now();
   auto* d = new DrainResult();
   std::vector<NewKeyRec> keys;
   {
@@ -686,6 +827,10 @@ static DrainResult* drain(Engine* e, bool clear_intern) {
   e->tot_malformed += d->b.malformed;
   e->tot_packets += d->b.packets;
   e->tot_too_long += d->b.too_long;
+  e->drain_calls.fetch_add(1, std::memory_order_relaxed);
+  e->drain_pkts.fetch_add(d->b.packets, std::memory_order_relaxed);
+  e->drain_ticks.fetch_add(ticks_since(drain_t0),
+                           std::memory_order_relaxed);
   return d;
 }
 
@@ -702,6 +847,8 @@ void* vn_engine_new(int max_packet_len, const char* implicit_tags_nl) {
   auto* e = new Engine();
   e->nonce = g_engine_nonce.fetch_add(1);
   e->max_packet = max_packet_len;
+  e->cal_ns0 = wall_ns();
+  e->cal_ticks0 = tick_now();
   if (implicit_tags_nl && *implicit_tags_nl) {
     const char* p = implicit_tags_nl;
     while (*p) {
@@ -738,8 +885,12 @@ void vn_ingest(void* ep, int tid, const char* data, long len) {
   auto* e = (Engine*)ep;
   thread_local ThreadScratch sc;
   ThreadBuf* tb = e->buf_for(tid);
-  std::lock_guard<std::mutex> l(tb->mu);
-  ingest_datagram(e, sc, data, (size_t)len, tb->cur);
+  uint64_t t0 = tick_now();
+  {
+    std::lock_guard<std::mutex> l(tb->mu);
+    ingest_datagram(e, sc, data, (size_t)len, tb->cur);
+  }
+  account_burst(tb->stages, sc, 1, ticks_since(t0));
 }
 
 // Spawn a native reader thread on an already-bound UDP socket fd.
@@ -816,6 +967,64 @@ void vn_totals(void* ep, unsigned long long* out4) {
   out4[1] = e->tot_malformed.load();
   out4[2] = e->tot_packets.load();
   out4[3] = e->tot_too_long.load();
+}
+
+// -- stage accounting (profiling subsystem; roadmap #4) ---------------------
+
+long long vn_stage_thread_count(void* ep) {
+  auto* e = (Engine*)ep;
+  std::lock_guard<std::mutex> l(e->bufs_mu);
+  return (long long)e->bufs.size();
+}
+
+// Per-thread stage counters, nanoseconds already converted: writes up to
+// cap_threads rows of 8 u64 each — {recv_pkts, recv_ns, parse_pkts,
+// parse_ns, intern_calls, intern_ns, stage_vals, stage_ns} — and returns
+// the number of rows written.  Monotonic (counters only ever grow).
+long long vn_stage_stats(void* ep, unsigned long long* out,
+                         long long cap_threads) {
+  auto* e = (Engine*)ep;
+  double r = e->ns_per_tick();
+  std::vector<ThreadBuf*> tbs;
+  {
+    std::lock_guard<std::mutex> l(e->bufs_mu);
+    for (auto& tb : e->bufs) tbs.push_back(tb.get());
+  }
+  long long n = 0;
+  auto ns = [r](const std::atomic<uint64_t>& t) {
+    return (unsigned long long)((double)t.load(std::memory_order_relaxed)
+                                * r);
+  };
+  auto raw = [](const std::atomic<uint64_t>& c) {
+    return (unsigned long long)c.load(std::memory_order_relaxed);
+  };
+  for (ThreadBuf* tb : tbs) {
+    if (n >= cap_threads) break;
+    StageCounters& st = tb->stages;
+    unsigned long long* row = out + n * 8;
+    row[0] = raw(st.recv_pkts);
+    row[1] = mono_latch(st.rep_recv_ns, ns(st.recv_ticks));
+    row[2] = raw(st.parse_pkts);
+    row[3] = mono_latch(st.rep_parse_ns, ns(st.parse_ticks));
+    row[4] = raw(st.intern_calls);
+    row[5] = mono_latch(st.rep_intern_ns, ns(st.intern_ticks));
+    row[6] = raw(st.stage_vals);
+    row[7] = mono_latch(st.rep_stage_ns, ns(st.stage_ticks));
+    n++;
+  }
+  return n;
+}
+
+// Engine-level drain stage: {calls, packets drained, ns}.
+void vn_stage_drain(void* ep, unsigned long long* out3) {
+  auto* e = (Engine*)ep;
+  double r = e->ns_per_tick();
+  out3[0] = e->drain_calls.load(std::memory_order_relaxed);
+  out3[1] = e->drain_pkts.load(std::memory_order_relaxed);
+  out3[2] = mono_latch(
+      e->rep_drain_ns,
+      (unsigned long long)(
+          (double)e->drain_ticks.load(std::memory_order_relaxed) * r));
 }
 
 unsigned long long vn_intern_count(void* ep) {
@@ -1291,9 +1500,14 @@ void* vn_import_scan(const uint8_t* data, long long len) {
         switch (mwt) {
           case 0: if (!read_varint(q, mend, tmp)) ok = false; break;
           case 1: if (mend - q < 8) { ok = false; } else q += 8; break;
-          case 2: if (!read_varint(q, mend, tmp) ||
-                      (uint64_t)(mend - q) < tmp) { ok = false; }
-                  else q += tmp; break;
+          case 2:
+            if (!read_varint(q, mend, tmp) ||
+                (uint64_t)(mend - q) < tmp) {
+              ok = false;
+            } else {
+              q += tmp;
+            }
+            break;
           case 5: if (mend - q < 4) { ok = false; } else q += 4; break;
           default: ok = false;
         }

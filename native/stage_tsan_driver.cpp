@@ -1,0 +1,116 @@
+// ThreadSanitizer exercise of the ingest engine's stage counters.
+//
+// Built and run by tests/test_profiling.py (slow-marked):
+//   g++ -fsanitize=thread -O1 -g -std=c++17 -pthread
+//       native/stage_tsan_driver.cpp native/ingest_engine.cpp -o <bin>
+//
+// Hammers the counters from every direction at once — ingest threads
+// (vn_ingest), a drain thread (vn_drain / vn_drain_clear), and a stats
+// reader (vn_stage_stats / vn_stage_drain / vn_totals / vn_intern_count)
+// — so a data race anywhere on the accounting path is a TSan report
+// (nonzero exit), and finishes with a conservation check: after a final
+// drain, parse-stage packets must equal the engine's packet total and
+// stage-stage values its processed total.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* vn_engine_new(int max_packet_len, const char* implicit_tags_nl);
+void vn_engine_free(void* ep);
+int vn_thread_new(void* ep);
+void vn_ingest(void* ep, int tid, const char* data, long len);
+void* vn_drain(void* ep);
+void* vn_drain_clear(void* ep);
+void vn_drain_free(void* dp);
+void vn_totals(void* ep, unsigned long long* out4);
+unsigned long long vn_intern_count(void* ep);
+long long vn_stage_thread_count(void* ep);
+long long vn_stage_stats(void* ep, unsigned long long* out,
+                         long long cap_threads);
+void vn_stage_drain(void* ep, unsigned long long* out3);
+}
+
+int main() {
+  void* e = vn_engine_new(4096, "env:tsan");
+  const int kIngestThreads = 4;
+  const int kIters = 20000;
+  std::atomic<bool> stop{false};
+
+  std::vector<std::thread> workers;
+  for (int t = 0; t < kIngestThreads; t++) {
+    int tid = vn_thread_new(e);
+    workers.emplace_back([e, tid, t] {
+      char buf[128];
+      for (int i = 0; i < kIters; i++) {
+        int n = snprintf(buf, sizeof(buf),
+                         "tsan.m%d:%d|c|#thr:%d\ntsan.h:%d|h|@0.5\n"
+                         "tsan.s:u%d|s\nbad line",
+                         i % 37, i, t, i % 101, i % 17);
+        vn_ingest(e, tid, buf, n);
+      }
+    });
+  }
+  std::thread drainer([e, &stop] {
+    int i = 0;
+    while (!stop.load(std::memory_order_relaxed)) {
+      void* d = (++i % 16 == 0) ? vn_drain_clear(e) : vn_drain(e);
+      vn_drain_free(d);
+    }
+  });
+  std::thread reader([e, &stop] {
+    unsigned long long rows[64 * 8], d3[3], t4[4];
+    while (!stop.load(std::memory_order_relaxed)) {
+      vn_stage_stats(e, rows, 64);
+      vn_stage_drain(e, d3);
+      vn_totals(e, t4);
+      vn_intern_count(e);
+    }
+  });
+
+  for (auto& w : workers) w.join();
+  stop.store(true);
+  drainer.join();
+  reader.join();
+  vn_drain_free(vn_drain(e));  // consolidate the tail
+
+  // conservation: per-stage counters must reconcile with engine totals
+  unsigned long long t4[4];
+  vn_totals(e, t4);  // processed, malformed, packets, too_long
+  long long n = vn_stage_thread_count(e);
+  std::vector<unsigned long long> rows((size_t)n * 8);
+  n = vn_stage_stats(e, rows.data(), n);
+  unsigned long long parse_pkts = 0, stage_vals = 0;
+  for (long long i = 0; i < n; i++) {
+    parse_pkts += rows[i * 8 + 2];
+    stage_vals += rows[i * 8 + 6];
+  }
+  unsigned long long d3[3];
+  vn_stage_drain(e, d3);
+  int rc = 0;
+  unsigned long long want_pkts =
+      (unsigned long long)kIngestThreads * kIters;
+  if (parse_pkts != want_pkts || t4[2] != want_pkts) {
+    fprintf(stderr, "packet conservation failed: parse=%llu totals=%llu "
+                    "want=%llu\n", parse_pkts, t4[2], want_pkts);
+    rc = 1;
+  }
+  if (stage_vals != t4[0]) {
+    fprintf(stderr, "value conservation failed: stage=%llu "
+                    "processed=%llu\n", stage_vals, t4[0]);
+    rc = 1;
+  }
+  if (d3[1] != t4[2]) {
+    fprintf(stderr, "drain conservation failed: drained=%llu "
+                    "packets=%llu\n", d3[1], t4[2]);
+    rc = 1;
+  }
+  vn_engine_free(e);
+  if (rc == 0) fprintf(stderr, "tsan driver ok: %llu pkts, %llu values\n",
+                       parse_pkts, stage_vals);
+  return rc;
+}

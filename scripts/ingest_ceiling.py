@@ -1,0 +1,236 @@
+"""Drive the C++ ingest data plane to saturation and tabulate where the
+time goes (roadmap #4: find and document the ingest ceiling).
+
+Boots a real Server (native UDP readers + drain loop), blasts DogStatsD
+datagrams at it from sender threads on the same host via sendmmsg
+(`vn_blast_udp`) for a measurement window, and emits a per-stage
+saturation table built from the engine's stage counters
+(recvmmsg / parse / intern / stage / drain — the profiling subsystem's
+data-plane pillar, also live at /debug/vars on any running server).
+
+Reading the table:
+
+  * `recvmmsg` covers the readers' poll+recvmmsg syscall time INCLUDING
+    the wait for the kernel to hand over datagrams.  At saturation a
+    dominant recvmmsg share means the bound is the loopback/NIC delivery
+    path (socket queues, kernel-side skb work, sender contention), not
+    this engine's CPU.
+  * `parse` / `intern` / `stage` are the engine's own CPU: line
+    scanning, identity interning, value float-parse + columnar append.
+    A dominant share here names the code to optimize.
+  * `drain` is the consolidation pass on the Python drainer thread.
+  * `wall_accounting` checks the decomposition is honest: per reader
+    thread, the four stage times must sum to ~the measurement window
+    (the acceptance bar is within 10% at saturation).
+
+Usage:
+    python scripts/ingest_ceiling.py [--seconds N] [--senders N]
+        [--readers N] [--lines-per-packet N] [--payloads N]
+
+Prints one JSON document to stdout; human-readable progress on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_payloads(rng: np.random.Generator, n_payloads: int,
+                  lines_per_packet: int) -> list[bytes]:
+    """Representative DogStatsD mix (the bench's traffic shape):
+    counters, tagged histograms with sample rates, gauges, sets,
+    timers — ~240 distinct identities."""
+    lines = []
+    for i in range(60):
+        lines.append(b"ceil.requests.total:1|c|#service:web,endpoint:/api/%d"
+                     % (i % 20))
+        lines.append(b"ceil.latency:%.3f|h|@0.5|#service:web,code:200"
+                     % rng.gamma(2.0, 10.0))
+        lines.append(b"ceil.queue.depth:%d|g|#shard:%d"
+                     % (rng.integers(0, 500), i % 8))
+        lines.append(b"ceil.users:u%d|s" % rng.integers(0, 5000))
+        lines.append(b"ceil.rpc.time:%.3f|ms|#dest:db%d"
+                     % (rng.gamma(3.0, 2.0), i % 4))
+    payloads = []
+    for _ in range(n_payloads):
+        pick = rng.choice(len(lines), lines_per_packet, replace=False)
+        payloads.append(b"\n".join(lines[j] for j in pick))
+    return payloads
+
+
+def stage_totals(srv) -> dict:
+    st = srv.native.stage_stats()
+    return st["totals"], st["threads"]
+
+
+def delta(after: dict, before: dict) -> dict:
+    return {stage: {k: after[stage][k] - before[stage][k]
+                    for k in after[stage]}
+            for stage in after}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="measurement window (default 10)")
+    ap.add_argument("--senders", type=int, default=2,
+                    help="sendmmsg blaster threads (default 2)")
+    ap.add_argument("--readers", type=int, default=0,
+                    help="native reader threads (0 = auto)")
+    ap.add_argument("--lines-per-packet", type=int, default=4)
+    ap.add_argument("--payloads", type=int, default=128)
+    args = ap.parse_args()
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu import ingest as ingest_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.profiling import STAGE_UNITS, STAGES
+
+    n_readers = args.readers or min(4, max(2, (os.cpu_count() or 2) - 1))
+    cfg = config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        interval=3600.0,             # no flush during the run
+        ingest_drain_interval=0.05,
+        eager_device_sync=False,     # measure the ingest plane only
+        num_readers=n_readers,
+        read_buffer_size_bytes=8 << 20,
+        hostname="ceiling")
+    srv = Server(cfg)
+    srv.start()
+    try:
+        if srv.native is None:
+            log("native engine unavailable; nothing to measure")
+            print(json.dumps({"error": "no native engine"}))
+            return
+        _, addr = srv.statsd_addrs[0]
+        payloads = make_payloads(np.random.default_rng(11),
+                                 args.payloads, args.lines_per_packet)
+
+        # warmup: intern the identities, fault the arenas, warm the caches
+        ingest_mod.blast_udp(addr[0], addr[1], 8192, payloads)
+        time.sleep(0.3)
+        srv._drain_native()
+
+        stop = threading.Event()
+        sent_counts = [0] * args.senders
+
+        def blaster(i: int) -> None:
+            while not stop.is_set():
+                sent_counts[i] += ingest_mod.blast_udp(
+                    addr[0], addr[1], 100_000, payloads)
+
+        before_tot, before_thr = stage_totals(srv)
+        pkts0 = srv.native.engine.totals()[2]
+        senders = [threading.Thread(target=blaster, args=(i,), daemon=True)
+                   for i in range(args.senders)]
+        t0 = time.perf_counter()
+        for t in senders:
+            t.start()
+        # drain on the main thread while the blasters saturate the socket
+        deadline = t0 + args.seconds
+        while time.perf_counter() < deadline:
+            time.sleep(0.05)
+            srv._drain_native()
+        # sample the window BEFORE the senders wind down so the stage
+        # shares reflect saturation, not the cooldown tail
+        window_s = time.perf_counter() - t0
+        after_tot, after_thr = stage_totals(srv)
+        pkts1 = srv.native.engine.totals()[2]
+        stop.set()
+        for t in senders:
+            t.join(timeout=10.0)
+        # cooldown: consume whatever the socket still holds, so the
+        # conservation totals below settle
+        settle_end = time.perf_counter() + 2.0
+        while time.perf_counter() < settle_end:
+            time.sleep(0.05)
+            srv._drain_native()
+
+        sent = sum(sent_counts)
+        received = pkts1 - pkts0
+        pps = received / window_s
+        lines_ps = pps * args.lines_per_packet
+        d_tot = delta(after_tot, before_tot)
+        d_thr = [delta(a, b) for a, b in zip(after_thr, before_thr)]
+
+        # ---------------- per-stage saturation table ----------------
+        window_ns = window_s * 1e9
+        reader_rows = [t for t in d_thr
+                       if t["recvmmsg"]["packets"] > 0]
+        table = {}
+        busy_ns = 0
+        for stage in STAGES:
+            c = d_tot[stage]
+            ns = c["ns"]
+            unit_name = STAGE_UNITS[stage]
+            units = c[unit_name]
+            table[stage] = {
+                unit_name: units,
+                "ns_total": ns,
+                "ns_per_unit": round(ns / units, 1) if units else None,
+                # share of ALL reader-thread wall time (+ drain): what
+                # fraction of the plane's capacity this stage consumed
+                "share_of_wall": round(
+                    ns / (window_ns * max(1, len(reader_rows))), 4),
+            }
+            if stage != "recvmmsg":
+                busy_ns += ns
+
+        # wall-clock accounting: per reader thread the four stages must
+        # cover ~the whole window (recvmmsg includes the packet wait)
+        coverage = []
+        for t in reader_rows:
+            covered = sum(t[s]["ns"] for s in STAGES[:-1])
+            coverage.append(round(covered / window_ns, 3))
+        recv_share = table["recvmmsg"]["share_of_wall"]
+        cpu_stage = max(STAGES[1:],
+                        key=lambda s: table[s]["ns_total"])
+        bound = ("socket/kernel delivery (loopback/NIC)"
+                 if recv_share >= 0.5 else f"engine CPU: {cpu_stage}")
+
+        out = {
+            "window_s": round(window_s, 3),
+            "senders": args.senders,
+            "readers": n_readers,
+            "lines_per_packet": args.lines_per_packet,
+            "sent_pkts": sent,
+            "received_pkts": received,
+            "shed_frac": round(max(0, sent - received) / max(sent, 1), 4),
+            "pkts_per_sec": round(pps),
+            "lines_per_sec": round(lines_ps),
+            "stages": table,
+            "wall_accounting": {
+                "per_reader_coverage": coverage,
+                "engine_cpu_ns": busy_ns,
+                "engine_cpu_cores": round(busy_ns / window_ns, 3),
+            },
+            "bound": bound,
+        }
+        log(f"ceiling: {pps:,.0f} pkt/s ({lines_ps:,.0f} lines/s), "
+            f"shed {out['shed_frac']:.1%}, bound = {bound}")
+        for stage, row in table.items():
+            log(f"  {stage:9s} {row['ns_total'] / 1e6:10.1f} ms  "
+                f"share {row['share_of_wall']:.3f}  "
+                f"ns/unit {row['ns_per_unit']}")
+        log(f"  reader wall coverage: {coverage} (1.0 = fully accounted)")
+        print(json.dumps(out, indent=2))
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -287,6 +287,100 @@ def parse_one(line):
 
 
 # ---------------------------------------------------------------------------
+# stage counters (profiling subsystem: recvmmsg/parse/intern/stage/drain)
+# ---------------------------------------------------------------------------
+
+def test_stage_counters_conserve_and_stay_monotonic():
+    """Per-stage counters must reconcile with the engine's own totals:
+    parse packets == datagrams ingested, staged values == processed,
+    intern calls == metric lines that reached interning — and every
+    counter is monotonic across drains (including an intern-clearing
+    GC drain)."""
+    eng = ingest_mod.IngestEngine(4096)
+    tid = eng.new_thread()
+    reps = 3
+    for _ in range(reps):
+        eng.ingest(tid, b"\n".join(VALID_LINES))
+    batch = eng.drain()
+    st = eng.stage_stats()
+    tot = st["totals"]
+    # one vn_ingest call per rep == one datagram each
+    assert tot["parse"]["packets"] == reps == batch.packets
+    assert tot["stage"]["values"] == batch.processed
+    # every VALID_LINE interns exactly once (multi-value lines intern
+    # once; none of these are events/service checks)
+    assert tot["intern"]["calls"] == reps * len(VALID_LINES)
+    assert tot["drain"]["calls"] == 1
+    assert tot["drain"]["packets"] == reps
+    # a vn_ingest-fed thread never touches recvmmsg
+    assert tot["recvmmsg"]["packets"] == 0
+    for stage in ("parse", "intern", "stage", "drain"):
+        assert tot[stage]["ns"] > 0, f"{stage} accrued no time"
+
+    # malformed lines and punted events still count parse packets but
+    # stage no values
+    eng.ingest(tid, b"\n".join(INVALID_LINES))
+    eng.ingest(tid, b"_e{5,4}:title|text")
+    batch2 = eng.drain(clear_intern=True)     # GC drain keeps counting
+    assert batch2.processed == 0
+    st2 = eng.stage_stats()
+    tot2 = st2["totals"]
+    assert tot2["parse"]["packets"] == reps + 2
+    assert tot2["stage"]["values"] == tot["stage"]["values"]
+    assert tot2["drain"]["calls"] == 2
+    # monotonicity: nothing ever decreases, drain included
+    for stage, counters in tot2.items():
+        for k, v in counters.items():
+            assert v >= tot[stage][k], f"{stage}.{k} went backwards"
+    # engine-total reconciliation after all drains
+    processed, malformed, packets, _ = eng.totals()
+    assert tot2["parse"]["packets"] == packets
+    assert tot2["stage"]["values"] == processed
+    assert tot2["drain"]["packets"] == packets
+    assert malformed == len(INVALID_LINES)
+    eng.close()
+
+
+def test_stage_counters_cover_udp_reader_path():
+    """recvmmsg accounting: packets received by the C++ reader loop show
+    up in both the recvmmsg and parse stages, reconciling with the
+    drained totals."""
+    agg = MetricAggregator()
+    nat = ingest_mod.NativeIngest(agg)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    addr = sock.getsockname()
+    nat.engine.add_udp_reader(sock.fileno())
+
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for _ in range(100):
+        tx.sendto(b"stg.udp:1|c\nstg.lat:5|ms", addr)
+    tx.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and agg.processed < 200:
+        time.sleep(0.05)
+        nat.drain_into()
+    nat.stop()
+    sock.close()
+    nat.drain_into()   # consolidate the tail so totals cover every packet
+    st = nat.stage_stats()
+    tot = st["totals"]
+    _, _, packets, _ = nat.engine.totals()
+    assert packets > 0
+    assert tot["recvmmsg"]["packets"] == packets
+    assert tot["parse"]["packets"] == packets
+    assert tot["drain"]["packets"] == packets
+    assert tot["stage"]["values"] == 2 * packets  # two lines per packet
+    # recvmmsg time includes the poll wait, so it accrues regardless;
+    # parse must have accrued real work too
+    assert tot["recvmmsg"]["ns"] > 0 and tot["parse"]["ns"] > 0
+    # the reader thread appears in the per-thread view
+    assert any(t["recvmmsg"]["packets"] == packets for t in st["threads"])
+    nat.close()
+    assert nat.stage_stats() is None  # safe after teardown
+
+
+# ---------------------------------------------------------------------------
 # UDP reader path (end-to-end through a real socket)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,259 @@
+"""Profiling subsystem: CPU sampler folded output, flush-timeline ring,
+the /debug/pprof suite + /debug/flush_timeline on a live server, and the
+slow-marked TSan build of the stage-counter accounting.
+
+(The stage counters' parity/conservation tests live in
+tests/test_native_ingest.py next to the engine they instrument;
+/debug/vars monotonicity is in tests/test_self_telemetry.py.)
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import http_api
+from veneur_tpu import profiling
+from veneur_tpu.core.server import Server
+from veneur_tpu.profiling.cpu import CpuProfiler, profile_cpu
+from veneur_tpu.profiling.timeline import (FlushTimeline,
+                                           record_from_segments)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FOLDED_LINE = re.compile(r"^\S.*?(;.*?)* \d+$")
+
+
+# ---------------------------------------------------------------------------
+# CPU profiler
+# ---------------------------------------------------------------------------
+
+def _burn(stop: threading.Event) -> None:
+    x = 0
+    while not stop.is_set():
+        for i in range(1000):
+            x += i * i
+
+
+def test_cpu_sampler_folds_busy_thread():
+    stop = threading.Event()
+    t = threading.Thread(target=_burn, args=(stop,), daemon=True,
+                         name="burner")
+    t.start()
+    try:
+        folded = CpuProfiler(hz=200).run(0.5)
+    finally:
+        stop.set()
+        t.join()
+    lines = folded.strip().splitlines()
+    assert lines, "sampler collected nothing"
+    for line in lines:
+        assert FOLDED_LINE.match(line), f"bad folded line: {line!r}"
+    # the burner thread must show up, attributed to _burn, rooted at the
+    # thread name
+    burner = [ln for ln in lines if ln.startswith("thread:burner")]
+    assert burner and any("_burn" in ln for ln in burner)
+
+
+def test_profile_cpu_fallback_reports_backend():
+    text, backend = profile_cpu(0.1, hz=100,
+                                use_pyspy=shutil.which("py-spy") is not None)
+    assert backend in ("py-spy", "sampler")
+    assert isinstance(text, str)
+
+
+def test_cpu_sampler_excludes_itself():
+    folded = CpuProfiler(hz=100).run(0.2)
+    assert "cpu.py:_sample_once" not in folded
+
+
+# ---------------------------------------------------------------------------
+# Flush timeline ring
+# ---------------------------------------------------------------------------
+
+def test_timeline_ring_bounds_and_order():
+    tl = FlushTimeline(capacity=4)
+    for i in range(10):
+        tl.record(interval=i, unix_ts=1000.0 + i, total_s=0.001 * i,
+                  segments={"emit_s": 0.0005, "upload_bytes": 64},
+                  devices=1, processed=i)
+    assert len(tl) == 4
+    assert tl.total_recorded == 10
+    recs = tl.snapshot()
+    assert [r["interval"] for r in recs] == [6, 7, 8, 9]
+    assert tl.snapshot(last=2)[0]["interval"] == 8
+    assert tl.snapshot(last=0) == []
+    r = recs[-1]
+    assert r["emit_ms"] == pytest.approx(0.5)
+    assert r["upload_bytes"] == 64 and r["total_ms"] == pytest.approx(9.0)
+
+
+def test_record_from_segments_converts_units():
+    rec = record_from_segments(
+        3, 1234.5678, 0.25,
+        segments={"snapshot_s": 0.01, "device_s": 0.2,
+                  "readback_bytes": 4096, "keys_digest": 17},
+        devices=8, imported=5)
+    assert rec["snapshot_ms"] == 10.0 and rec["device_ms"] == 200.0
+    assert rec["readback_bytes"] == 4096 and rec["keys_digest"] == 17
+    assert rec["devices"] == 8 and rec["imported"] == 5
+    assert rec["total_ms"] == 250.0
+    for k in rec:
+        assert not k.endswith("_s"), f"unconverted segment {k}"
+
+
+def test_stage_names_exported():
+    assert profiling.STAGES == ("recvmmsg", "parse", "intern", "stage",
+                                "drain")
+    # the canonical unit map covers every stage (consumers are
+    # table-driven off it: ingest.stage_stats, bench, ingest_ceiling)
+    assert set(profiling.STAGE_UNITS) == set(profiling.STAGES)
+    assert profiling.STAGE_UNITS["intern"] == "calls"
+    assert profiling.STAGE_UNITS["stage"] == "values"
+
+
+# ---------------------------------------------------------------------------
+# Live-server HTTP suite
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def profiled_server():
+    cfg = config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        interval=0.05, percentiles=[0.5], hostname="prof",
+        enable_profiling=True, profiling_use_pyspy=False)
+    srv = Server(cfg)
+    srv.start()
+    api = http_api.HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    host, port = api.address
+    yield srv, f"http://{host}:{port}"
+    api.stop()
+    srv.shutdown()
+
+
+def test_pprof_index_and_profile_endpoint(profiled_server):
+    srv, base = profiled_server
+    idx = urllib.request.urlopen(base + "/debug/pprof/").read()
+    assert b"/debug/pprof/profile" in idx
+    assert b"/debug/flush_timeline" in idx
+    stop = threading.Event()
+    t = threading.Thread(target=_burn, args=(stop,), daemon=True,
+                         name="http-burner")
+    t.start()
+    try:
+        resp = urllib.request.urlopen(
+            base + "/debug/pprof/profile?seconds=0.3&hz=200", timeout=30)
+        body = resp.read().decode()
+    finally:
+        stop.set()
+        t.join()
+    assert resp.headers["X-Profile-Backend"] == "sampler"
+    lines = body.strip().splitlines()
+    assert lines and all(FOLDED_LINE.match(ln) for ln in lines)
+    assert any("http-burner" in ln for ln in lines)
+
+
+def test_pprof_profile_rejects_bad_params(profiled_server):
+    """seconds=nan must 400, not slip past the cap into a sampler whose
+    deadline comparison never fires (it would hold the process-wide
+    profile lock forever)."""
+    _, base = profiled_server
+    for bad in ("seconds=nan", "seconds=-1", "seconds=0", "seconds=x",
+                "seconds=1&hz=0", "seconds=1&hz=nope"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                base + "/debug/pprof/profile?" + bad, timeout=10)
+        assert exc.value.code == 400, bad
+
+
+def test_pprof_profile_gated_by_enable_profiling():
+    cfg = config_mod.Config(hostname="gated")  # enable_profiling off
+    srv = Server(cfg)
+    api = http_api.HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    host, port = api.address
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/debug/pprof/profile?seconds=0.1",
+                timeout=10)
+        assert exc.value.code == 403
+        # the index still serves, flagging the gate
+        idx = urllib.request.urlopen(
+            f"http://{host}:{port}/debug/pprof/").read()
+        assert b"disabled" in idx
+    finally:
+        api.stop()
+
+
+def test_flush_timeline_endpoint_live(profiled_server):
+    import socket
+    srv, base = profiled_server
+    _, addr = srv.statsd_addrs[0]
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(b"tl.counter:1|c\ntl.hist:2.5|h", addr)
+    s.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and srv.aggregator.processed < 2:
+        time.sleep(0.01)
+        srv._drain_native()
+    srv.flush()
+    srv.flush()
+    out = json.loads(urllib.request.urlopen(
+        base + "/debug/flush_timeline").read())
+    assert out["recorded_total"] >= 2
+    recs = out["records"]
+    assert len(recs) >= 2
+    # intervals ascend; every record carries the required shape
+    assert [r["interval"] for r in recs] == sorted(
+        r["interval"] for r in recs)
+    first = recs[0]
+    for key in ("interval", "unix_ts", "total_ms", "devices",
+                "snapshot_ms", "emit_ms", "processed"):
+        assert key in first, f"missing {key}: {first}"
+    # the flush that carried the histogram has device-side segments
+    assert any("device_ms" in r and "dispatch_ms" in r for r in recs)
+    # ?last=N limits the window
+    out1 = json.loads(urllib.request.urlopen(
+        base + "/debug/flush_timeline?last=1").read())
+    assert len(out1["records"]) == 1
+    assert out1["records"][0]["interval"] == recs[-1]["interval"]
+
+
+# ---------------------------------------------------------------------------
+# TSan build of the stage-counter accounting (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_stage_counters_under_tsan(tmp_path):
+    """Race-detect the whole accounting path: concurrent ingest threads,
+    a drain/drain_clear churner, and a stats reader, under
+    -fsanitize=thread.  TSan exits nonzero on any report; the driver
+    additionally checks packet/value conservation."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    binary = tmp_path / "stage_tsan"
+    build = subprocess.run(
+        ["g++", "-fsanitize=thread", "-O1", "-g", "-std=c++17",
+         "-pthread", "-Wall", "-Wextra", "-Werror",
+         os.path.join(REPO, "native", "stage_tsan_driver.cpp"),
+         os.path.join(REPO, "native", "ingest_engine.cpp"),
+         "-o", str(binary)],
+        capture_output=True, text=True)
+    if build.returncode != 0 and "thread" in build.stderr:
+        pytest.skip(f"TSan unavailable: {build.stderr[-200:]}")
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([str(binary)], capture_output=True, text=True,
+                         timeout=600)
+    sys.stderr.write(run.stderr[-2000:])
+    assert "WARNING: ThreadSanitizer" not in run.stderr
+    assert run.returncode == 0, run.stderr[-2000:]

@@ -3,16 +3,22 @@ itself traced as a span through the server's own pipeline.
 
 Mirrors the reference's flush accounting (`flusher.go:27,42-44,150-229,
 455-475`, `worker.go:477`) and the traced flush
-(`flusher.go:26-34`, forward sub-timings `flusher.go:530-574`).
+(`flusher.go:26-34`, forward sub-timings `flusher.go:530-574`) — plus the
+profiling subsystem's always-on observability: the data-plane stage
+counters under /debug/vars (monotonic across drains) and the per-flush
+timeline records, both against a live Server.
 """
 
+import json
 import queue
 import socket
 import time
+import urllib.request
 
 import pytest
 
 from veneur_tpu import config as config_mod
+from veneur_tpu import http_api
 from veneur_tpu.core.server import Server
 from veneur_tpu.sinks import simple as simple_sinks
 
@@ -143,6 +149,74 @@ def test_flush_is_traced_as_span(telemetry_server):
             assert "flush.total_duration_ns" in sample_names
             return
     raise AssertionError(f"no flush span observed; saw {names}")
+
+
+def _stage_counters(vars_doc: dict) -> dict:
+    assert "ingest_stages" in vars_doc, sorted(vars_doc)
+    return vars_doc["ingest_stages"]["totals"]
+
+
+def test_debug_vars_stage_counters_monotonic(telemetry_server):
+    """/debug/vars serves the native data plane's per-stage counters,
+    monotonic across drains, reconciling with the drained totals."""
+    srv, _, _ = telemetry_server
+    assert srv.native is not None, "fixture must run the native plane"
+    api = http_api.HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    host, port = api.address
+    base = f"http://{host}:{port}"
+    try:
+        _send_udp(srv, b"stage.a:1|c\nstage.b:2.5|g")
+        _wait_processed(srv, 2)
+        srv._drain_native()
+        doc1 = json.loads(urllib.request.urlopen(
+            base + "/debug/vars").read())
+        tot1 = _stage_counters(doc1)
+        assert tot1["stage"]["values"] >= 2
+        assert tot1["parse"]["packets"] >= 1
+        assert tot1["drain"]["calls"] >= 1
+        assert doc1["ingest_stages"]["threads"], "per-thread view missing"
+
+        # more traffic + more drains: every counter is >= its old value
+        _send_udp(srv, b"stage.a:3|c\nstage.c:4|ms")
+        _wait_processed(srv, 2)
+        srv._drain_native()
+        srv.flush()               # flush drains too; still monotonic
+        doc2 = json.loads(urllib.request.urlopen(
+            base + "/debug/vars").read())
+        tot2 = _stage_counters(doc2)
+        for stage, counters in tot2.items():
+            for k, v in counters.items():
+                assert v >= tot1[stage][k], \
+                    f"{stage}.{k}: {v} < {tot1[stage][k]}"
+        assert tot2["stage"]["values"] >= tot1["stage"]["values"] + 2
+        assert tot2["drain"]["calls"] > tot1["drain"]["calls"]
+        # packet conservation against the engine's own totals
+        ni = doc2["native_ingest"]
+        assert tot2["parse"]["packets"] == ni["packets"]
+        assert tot2["drain"]["packets"] == ni["packets"]
+        # the flush-timeline counter rides the same document
+        assert doc2["flush_timeline_recorded"] >= 1
+    finally:
+        api.stop()
+
+
+def test_flush_timeline_records_on_ticker_flush(telemetry_server):
+    """Every flush appends one timeline record whose interval id matches
+    the server's flush counter."""
+    srv, _, _ = telemetry_server
+    _send_udp(srv, b"tlm.h:4.2|h")
+    _wait_processed(srv, 1)
+    srv.flush()
+    srv.flush()
+    assert len(srv.flush_timeline) >= 2
+    recs = srv.flush_timeline.snapshot()
+    assert recs[-1]["interval"] == srv.flush_count
+    assert recs[-1]["total_ms"] >= 0
+    # the interval that carried the histogram dispatched a device
+    # program: its record carries the full segment decomposition
+    assert any("device_ms" in r and r.get("keys_digest", 0) >= 1
+               for r in recs)
 
 
 def test_forward_subspan_records_timing(telemetry_server):

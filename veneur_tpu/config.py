@@ -189,6 +189,15 @@ class Config:
     flush_presharded_staging: bool = True
     debug: bool = False
     enable_profiling: bool = False
+    # profiling subsystem (veneur_tpu/profiling/): the /debug/pprof
+    # suite, the flush-timeline ring, and the data-plane stage counters.
+    # The CPU profile endpoint is gated by enable_profiling (above);
+    # stage counters and the flush timeline are always on (their hot-path
+    # cost is a handful of TSC reads per burst / one dict per flush).
+    profiling_cpu_hz: int = 100          # sampling rate (samples/s)
+    profiling_cpu_max_seconds: float = 60.0  # per-request duration cap
+    profiling_timeline_capacity: int = 512   # flush records in the ring
+    profiling_use_pyspy: bool = True     # py-spy subprocess when on PATH
     http_quit: bool = False
     http_config_endpoint: bool = False
     # accepted for reference-config compatibility; Go-runtime-specific

@@ -569,6 +569,12 @@ class MetricAggregator:
             snap = self._snapshot_and_reset()
             res.processed, res.imported = snap.pop("counts")
         seg["snapshot_s"] = time.perf_counter() - t0
+        # per-family touched-key counts ride the segment dict so the
+        # flush timeline (and the flush.* self-metric gauges) can relate
+        # segment times to interval size
+        seg["keys_digest"] = len(snap["digests"]["rows"])
+        seg["keys_counter"] = len(snap["counters"]["rows"])
+        seg["keys_set"] = len(snap["sets"]["rows"])
 
         # ONE device program call evaluates the flush on the snapshot
         # OUTSIDE the lock, so ingest continues (flusher.go:26-122 +

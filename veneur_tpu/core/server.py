@@ -29,6 +29,7 @@ from typing import Callable, Optional
 from veneur_tpu import config as config_mod
 from veneur_tpu import sinks as sink_mod
 from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.profiling.timeline import FlushTimeline
 from veneur_tpu.samplers import parser as parser_mod
 from veneur_tpu.samplers import samplers as sm
 from veneur_tpu.sketches import hll as hll_mod
@@ -272,6 +273,9 @@ class Server:
         self.last_flush_unix = time.time()
         self.flush_count = 0
         self._flush_serial = threading.Lock()
+        # profiling subsystem: per-flush structured records, served at
+        # /debug/flush_timeline (veneur_tpu/profiling/timeline.py)
+        self.flush_timeline = FlushTimeline(cfg.profiling_timeline_capacity)
         # tags_exclude rules: "key" (every sink) or "key|sink1|sink2"
         # (those sinks only) — setSinkExcludedTags, server.go:660,1456-1463
         self._tags_exclude_global: set[str] = set()
@@ -462,10 +466,14 @@ class Server:
                     timing=sc.get("timing", "")),
                 tags=list(self.config.veneur_metrics_additional_tags))
         if self.config.diagnostics_metrics_enabled:
-            from veneur_tpu.diagnostics import Diagnostics
-            self.diagnostics = Diagnostics(
+            from veneur_tpu import diagnostics as diag_mod
+            self.diagnostics = diag_mod.Diagnostics(
                 self.statsd, interval_s=self.config.interval,
-                tags=list(self.config.veneur_metrics_additional_tags))
+                tags=list(self.config.veneur_metrics_additional_tags),
+                # push the data-plane stage totals alongside the runtime
+                # stats (reads self.native at call time: safe across the
+                # engine's whole lifecycle, {} once it is torn down)
+                sources=[lambda: diag_mod.ingest_stage_gauges(self.native)])
             self.diagnostics.start()
         for source in self.sources:
             source.start(self.ingest_shim)
@@ -1075,6 +1083,20 @@ class Server:
             "flush.total_duration_ns",
             time.perf_counter() - flush_start))
         span.finish()
+        # one structured record per flush into the timeline ring: the
+        # measured segment decomposition (snapshot/build/layout/dispatch/
+        # device/emit + bytes + per-family key counts), the interval id,
+        # and what the interval carried
+        from veneur_tpu.parallel import serving as serving_mod
+        self.flush_timeline.record(
+            interval=self.flush_count,
+            unix_ts=self.last_flush_unix,
+            total_s=time.perf_counter() - flush_start,
+            segments=self.aggregator.last_flush_segments,
+            devices=serving_mod.mesh_device_count(self.mesh),
+            processed=res.processed, imported=res.imported,
+            metrics_emitted=len(res.metrics),
+            forward_metrics=len(res.forward))
 
     def _flush_interval_accounting(self, statsd) -> None:
         """Host-side per-interval self-metric accounting that does not

@@ -4,6 +4,13 @@ Capability twin of `diagnostics/diagnostics_metrics.go:11,38`: every flush
 interval, report uptime plus runtime memory/GC statistics as self-metrics.
 The Go memstats become the CPython equivalents: RSS, GC generation
 counts/collections, thread count, and open-fd count.
+
+The loop also accepts extra gauge SOURCES (callables returning
+name -> value): the server plugs in the profiling subsystem's data-plane
+stage counters (`ingest_stage_gauges`) so the per-stage nanosecond/packet
+totals that /debug/vars serves on demand are ALSO pushed as periodic
+self-metrics — dashboards get the stage decomposition without polling
+the debug port.
 """
 
 from __future__ import annotations
@@ -42,12 +49,29 @@ def collect(start_time: float) -> dict[str, float]:
     return stats
 
 
+def ingest_stage_gauges(native) -> dict[str, float]:
+    """Flatten the native data plane's per-stage totals into gauge names
+    (`ingest.stage.<stage>.{ns,packets|calls|values}`).  `native` is the
+    server's NativeIngest (or None); returns {} when the engine is gone,
+    so the source is safe to leave wired across teardown."""
+    if native is None:
+        return {}
+    st = native.stage_stats()
+    if st is None:
+        return {}
+    out: dict[str, float] = {}
+    for stage, counters in st["totals"].items():
+        for k, v in counters.items():
+            out[f"ingest.stage.{stage}.{k}"] = float(v)
+    return out
+
+
 class Diagnostics:
     """Background reporter thread (CollectDiagnosticsMetrics loop)."""
 
     def __init__(self, statsd=None, interval_s: float = 10.0,
                  tags: Optional[list[str]] = None,
-                 prefix: str = ""):
+                 prefix: str = "", sources=None):
         # the "veneur." namespace comes from the statsd client
         # (ScopedClient mirrors cmd/veneur/main.go:92); a non-empty
         # prefix here would double it
@@ -55,12 +79,20 @@ class Diagnostics:
         self.interval_s = interval_s
         self.tags = list(tags or [])
         self.prefix = prefix
+        # extra gauge sources: callables returning name -> value, merged
+        # into every report (a failing source skips that report only)
+        self.sources = list(sources or [])
         self.start_time = time.time()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def report_once(self) -> dict[str, float]:
         stats = collect(self.start_time)
+        for source in self.sources:
+            try:
+                stats.update(source())
+            except Exception:
+                pass
         for name, value in stats.items():
             self.statsd.gauge(self.prefix + name, value, tags=self.tags)
         return stats

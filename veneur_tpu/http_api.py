@@ -2,10 +2,17 @@
 
 Mirrors `http.go:15-67`: /healthcheck, /version, /builddate, optional
 /config/json + /config/yaml (secret-redacted, util/config/config.go:65-96),
-optional /quitquitquit, and Python-flavored debug endpoints in place of Go's
-pprof suite (/debug/vars runtime stats; /debug/threads stack dump;
-/debug/profile JAX device trace — the TPU analog of `enable_profiling` +
-pprof, server.go:1366-1383 / SURVEY §5.1).
+optional /quitquitquit, and the debug suite (server.go:1366-1383 /
+SURVEY §5.1):
+
+  /debug/vars            runtime stats + native data-plane stage counters
+  /debug/threads         stack dump of every live thread
+  /debug/profile         JAX device trace (the TPU-side profile)
+  /debug/pprof/          index of the host-side profile suite
+  /debug/pprof/profile   sampling HOST CPU profile -> folded stacks
+                         (?seconds=N&hz=M; py-spy when available, else
+                         the in-process sampler — veneur_tpu/profiling)
+  /debug/flush_timeline  ring of structured per-flush records (?last=N)
 """
 
 from __future__ import annotations
@@ -115,7 +122,69 @@ def make_handler(server) -> type:
                     ni = native.stats()  # None while tearing down
                     if ni is not None:
                         stats["native_ingest"] = ni
+                    st = native.stage_stats()
+                    if st is not None:
+                        # monotonic per-stage packet/ns counters
+                        # (recvmmsg/parse/intern/stage/drain), per reader
+                        # thread + totals — the live view the ceiling
+                        # harness (scripts/ingest_ceiling.py) tabulates
+                        stats["ingest_stages"] = st
+                timeline = getattr(server, "flush_timeline", None)
+                if timeline is not None:
+                    stats["flush_timeline_recorded"] = \
+                        timeline.total_recorded
                 self._reply(200, json.dumps(stats, indent=2).encode(),
+                            "application/json")
+            elif self.path.rstrip("/") == "/debug/pprof":
+                self._reply(200, _pprof_index(cfg))
+            elif self.path.startswith("/debug/pprof/profile"):
+                if not cfg.enable_profiling:
+                    self._reply(403, b"profiling disabled "
+                                b"(set enable_profiling)\n")
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                try:
+                    seconds = float(q.get("seconds", ["2"])[0])
+                    hz = int(q.get("hz", [cfg.profiling_cpu_hz])[0])
+                except ValueError:
+                    self._reply(400, b"bad seconds/hz\n")
+                    return
+                # positive-check BEFORE the cap: nan fails every
+                # comparison, so `not (seconds > 0)` rejects it — while
+                # `min(nan, cap) <= 0` would let it through into a
+                # sampler that never reaches its deadline
+                if not (seconds > 0 and hz > 0):
+                    self._reply(400, b"bad seconds/hz\n")
+                    return
+                seconds = min(seconds,
+                              float(cfg.profiling_cpu_max_seconds))
+                from veneur_tpu.profiling import cpu as cpu_prof
+                folded, backend = cpu_prof.profile_cpu(
+                    seconds, hz=hz, use_pyspy=cfg.profiling_use_pyspy)
+                self.send_response(200)
+                body = folded.encode()
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Profile-Backend", backend)
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/debug/flush_timeline"):
+                timeline = getattr(server, "flush_timeline", None)
+                if timeline is None:
+                    self._reply(404, b"no flush timeline\n")
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                try:
+                    last = (int(q["last"][0]) if "last" in q else None)
+                except ValueError:
+                    self._reply(400, b"bad last\n")
+                    return
+                out = {"capacity": timeline.capacity,
+                       "recorded_total": timeline.total_recorded,
+                       "records": timeline.snapshot(last)}
+                self._reply(200, json.dumps(out, indent=2).encode(),
                             "application/json")
             elif self.path.startswith("/debug/profile"):
                 if not cfg.enable_profiling:
@@ -138,6 +207,31 @@ def make_handler(server) -> type:
                 self._reply(404, b"not found\n")
 
     return Handler
+
+
+def _pprof_index(cfg) -> bytes:
+    """/debug/pprof/ index — parity with the reference's pprof suite
+    (net/http/pprof's index page, registered when enable_profiling is on,
+    server.go:1366-1383): one line per profile with where to get it."""
+    gate = ("" if cfg.enable_profiling
+            else "  [disabled: set enable_profiling]")
+    lines = [
+        "veneur_tpu /debug/pprof/",
+        "",
+        f"profile         /debug/pprof/profile?seconds=N&hz=M{gate}",
+        "                host CPU, folded stacks (flamegraph.pl ready)",
+        "threads         /debug/threads",
+        "                stack dump of every live thread (goroutine "
+        "analog)",
+        "vars            /debug/vars",
+        "                runtime stats + per-stage data-plane counters",
+        "flush_timeline  /debug/flush_timeline?last=N",
+        "                structured per-flush segment records",
+        f"device          /debug/profile?seconds=N{gate}",
+        "                JAX device trace (tensorboard-loadable)",
+        "",
+    ]
+    return "\n".join(lines).encode()
 
 
 # one profile at a time; concurrent requests queue here

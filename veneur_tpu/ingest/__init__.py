@@ -41,6 +41,13 @@ _SO = os.path.join(_REPO_ROOT, "native", ".build", "libvningest.so")
 
 _TYPE_NAMES = ("counter", "gauge", "histogram", "timer", "set")
 
+# Data-plane stage names in pipeline order; the first four are
+# per-reader-thread, drain is engine-level (the Python drainer thread).
+# veneur_tpu.profiling owns the canonical tuple + unit map (tests pin
+# them); re-exported here for callers working at the engine level.
+from veneur_tpu.profiling import STAGE_UNITS  # noqa: E402
+from veneur_tpu.profiling import STAGES as STAGE_NAMES  # noqa: E402
+
 _build_lock = threading.Lock()
 _lib = None
 
@@ -49,7 +56,12 @@ def _compile() -> None:
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
     tmp = _SO + f".tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", tmp, _SRC]
+           "-Wall", "-Wextra"]
+    if os.environ.get("VENEUR_TPU_TEST"):
+        # the test build path promotes warnings to errors so a warning
+        # introduced by a change fails the suite, not just stderr
+        cmd.append("-Werror")
+    cmd += ["-o", tmp, _SRC]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, _SO)
 
@@ -91,6 +103,14 @@ def load_library():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
         lib.vn_intern_count.restype = ctypes.c_ulonglong
         lib.vn_intern_count.argtypes = [ctypes.c_void_p]
+        lib.vn_stage_thread_count.restype = ctypes.c_longlong
+        lib.vn_stage_thread_count.argtypes = [ctypes.c_void_p]
+        lib.vn_stage_stats.restype = ctypes.c_longlong
+        lib.vn_stage_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.c_longlong]
+        lib.vn_stage_drain.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong)]
         lib.vn_metro64.restype = ctypes.c_ulonglong
         lib.vn_metro64.argtypes = [ctypes.c_char_p, ctypes.c_long]
         lib.vn_blast_udp.restype = ctypes.c_longlong
@@ -436,6 +456,41 @@ class IngestEngine:
     def intern_count(self) -> int:
         return int(self.lib.vn_intern_count(self.handle))
 
+    def stage_stats(self) -> dict:
+        """Per-stage data-plane accounting (profiling subsystem).
+
+        Returns {"threads": [...], "totals": {...}} where each thread
+        entry and the totals carry monotonic packet/call and nanosecond
+        counters per pipeline stage (STAGE_NAMES order): recvmmsg covers
+        the poll+recvmmsg syscalls INCLUDING the wait for packets (only
+        native UDP reader threads accrue it; vn_ingest-fed threads show
+        zero), parse is datagram/line scanning minus the carved-out
+        intern and stage shares, intern is identity interning, stage is
+        value float-parse + columnar append, drain is the engine-level
+        consolidation pass (runs on the drainer thread)."""
+        n = int(self.lib.vn_stage_thread_count(self.handle))
+        threads = []
+        if n > 0:
+            buf = (ctypes.c_ulonglong * (n * 8))()
+            n = int(self.lib.vn_stage_stats(self.handle, buf, n))
+            for t in range(n):
+                row = buf[t * 8:(t + 1) * 8]
+                threads.append({
+                    "recvmmsg": {"packets": int(row[0]), "ns": int(row[1])},
+                    "parse": {"packets": int(row[2]), "ns": int(row[3])},
+                    "intern": {"calls": int(row[4]), "ns": int(row[5])},
+                    "stage": {"values": int(row[6]), "ns": int(row[7])},
+                })
+        d3 = (ctypes.c_ulonglong * 3)()
+        self.lib.vn_stage_drain(self.handle, d3)
+        totals: dict = {
+            name: {k: sum(t[name][k] for t in threads)
+                   for k in (STAGE_UNITS[name], "ns")}
+            for name in STAGE_NAMES[:-1]}
+        totals["drain"] = {"calls": int(d3[0]), "packets": int(d3[1]),
+                           "ns": int(d3[2])}
+        return {"threads": threads, "totals": totals}
+
 
 @dataclass
 class _IdInfo:
@@ -600,6 +655,14 @@ class NativeIngest:
             return {"lines": lines, "malformed": malformed,
                     "packets": packets, "too_long": too_long,
                     "intern_count": self.engine.intern_count()}
+
+    def stage_stats(self) -> Optional[dict]:
+        """Per-stage counters for /debug/vars, under the drain lock so a
+        probe racing teardown reads None instead of freed memory."""
+        with self._drain_lock:
+            if self.engine._closed:
+                return None
+            return self.engine.stage_stats()
 
     def stop(self) -> None:
         self.engine.stop()

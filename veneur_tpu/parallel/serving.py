@@ -76,6 +76,15 @@ class FlushOutputs(NamedTuple):
 # Shardings
 # ---------------------------------------------------------------------------
 
+def mesh_device_count(mesh: Optional[Mesh]) -> int:
+    """Devices a flush program runs over: 1 unmeshed, else the full
+    (shard x replica) grid.  The flush-timeline records carry this so a
+    live server's segment decomposition is comparable across mesh
+    reconfigurations (the bench's mesh-scaling curve, observable in
+    production)."""
+    return 1 if mesh is None else int(mesh.size)
+
+
 def lane_sharding(mesh: Optional[Mesh]):
     """[R, K, ...] lane-striped state: lanes over 'replica', keys over
     'shard'."""

@@ -1,0 +1,156 @@
+"""Host CPU sampling profiler -> folded-stack text.
+
+The Python analog of the reference's `/debug/pprof/profile` (net/http/pprof
+wired in `server.go:1366-1383`): sample what the host process is doing for
+N seconds and hand back something a flamegraph renders directly.
+
+Two backends, picked at call time:
+
+  * **py-spy** (subprocess, when the binary is on PATH): samples the
+    interpreter from OUTSIDE the process, so it sees native frames and is
+    immune to GIL skew.  `py-spy record --format raw` already emits
+    folded stacks.
+  * **in-process sampler** (always available): a background thread walks
+    `sys._current_frames()` at the configured rate and aggregates folded
+    stacks per thread.  This is the `setitimer`/cProfile-class fallback —
+    pure stdlib, no signal handler (signals only reach the main thread in
+    CPython, which would blind the profile to the reader/flush threads
+    that actually matter here), and safe to run inside a serving process.
+
+Output format (both backends): one stack per line, frames root-first
+joined by ';', a space, then the sample count —
+
+    thread:ingest-drain;server.py:_native_drain_loop;... 42
+
+which is exactly what `flamegraph.pl` / speedscope / pprof's folded
+importer consume.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import Counter
+from typing import Optional
+
+# One profile at a time per process: overlapping samplers would double
+# the sampling overhead and interleave py-spy subprocesses.
+_profile_lock = threading.Lock()
+
+DEFAULT_HZ = 100
+MAX_HZ = 1000
+MAX_STACK_DEPTH = 64
+
+
+def _frame_name(frame) -> str:
+    code = frame.f_code
+    return f"{os.path.basename(code.co_filename)}:{code.co_name}"
+
+
+def _thread_names() -> dict[int, str]:
+    return {t.ident: t.name for t in threading.enumerate()
+            if t.ident is not None}
+
+
+class CpuProfiler:
+    """In-process sampling profiler over `sys._current_frames()`.
+
+    Collects folded stacks for every live thread; the sampling thread
+    excludes itself.  Sampling is cooperative with the GIL: a thread
+    blocked in a C extension that released the GIL (recvmmsg readers,
+    device waits) shows its last Python frame — which is the right
+    attribution for "what is the HOST interpreter spending time on".
+    """
+
+    def __init__(self, hz: int = DEFAULT_HZ):
+        self.hz = max(1, min(int(hz), MAX_HZ))
+        self.samples: Counter = Counter()
+        self.sample_count = 0
+
+    def _sample_once(self, own_ident: Optional[int]) -> None:
+        names = _thread_names()
+        for ident, frame in sys._current_frames().items():
+            if ident == own_ident:
+                continue
+            stack = []
+            depth = 0
+            while frame is not None and depth < MAX_STACK_DEPTH:
+                stack.append(_frame_name(frame))
+                frame = frame.f_back
+                depth += 1
+            stack.append("thread:" + names.get(ident, str(ident)))
+            # frames were collected leaf-first; folded format is
+            # root-first
+            self.samples[";".join(reversed(stack))] += 1
+        self.sample_count += 1
+
+    def run(self, seconds: float) -> str:
+        """Sample for `seconds`, then return the folded-stack text."""
+        period = 1.0 / self.hz
+        own = threading.get_ident()
+        deadline = time.perf_counter() + seconds
+        next_tick = time.perf_counter()
+        while True:
+            now = time.perf_counter()
+            if now >= deadline:
+                break
+            self._sample_once(own)
+            next_tick += period
+            delay = next_tick - time.perf_counter()
+            if delay > 0:
+                time.sleep(min(delay, deadline - now))
+            else:
+                next_tick = time.perf_counter()  # fell behind; re-anchor
+        return self.folded()
+
+    def folded(self) -> str:
+        return "".join(f"{stack} {n}\n"
+                       for stack, n in sorted(self.samples.items()))
+
+
+def _pyspy_profile(seconds: float, hz: int) -> Optional[str]:
+    """Shell out to py-spy against our own pid; None if unavailable or
+    it failed (no ptrace permission, unsupported interpreter, ...)."""
+    binary = shutil.which("py-spy")
+    if binary is None:
+        return None
+    fd, path = tempfile.mkstemp(prefix="veneur-pyspy-", suffix=".folded")
+    os.close(fd)
+    try:
+        proc = subprocess.run(
+            [binary, "record", "--pid", str(os.getpid()),
+             "--duration", str(max(1, int(round(seconds)))),
+             "--rate", str(hz), "--format", "raw", "--output", path,
+             "--nonblocking"],
+            capture_output=True, timeout=seconds + 30.0)
+        if proc.returncode != 0:
+            return None
+        with open(path) as f:
+            text = f.read()
+        return text if text.strip() else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def profile_cpu(seconds: float, hz: int = DEFAULT_HZ,
+                use_pyspy: bool = True) -> tuple[str, str]:
+    """Profile this process's CPU for `seconds`; returns
+    (folded_stack_text, backend) where backend is "py-spy" or
+    "sampler".  Serialized process-wide: concurrent callers queue."""
+    hz = max(1, min(int(hz), MAX_HZ))
+    with _profile_lock:
+        if use_pyspy:
+            text = _pyspy_profile(seconds, hz)
+            if text is not None:
+                return text, "py-spy"
+        return CpuProfiler(hz).run(seconds), "sampler"

@@ -1,0 +1,89 @@
+"""Flush timeline: a fixed-size ring of structured per-flush records.
+
+PR 1 rebuilt the flush launch path and made the bench emit a segment
+decomposition (layout/dispatch/collective/readback) — but only the bench
+could see it.  This ring makes the same decomposition observable on a
+LIVE server: `core/server.py` appends one record per flush from the
+aggregator's measured `last_flush_segments`, and `/debug/flush_timeline`
+serves the ring as JSON.  The records double as the raw material for the
+t-digest accuracy dossier (each carries the interval's key counts and
+bytes moved alongside the timings).
+
+Appends are O(1) under a lock and allocate one small dict per flush;
+with the default capacity (512 records ≈ 85 minutes at a 10 s interval)
+the ring holds a few hundred KiB.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+DEFAULT_CAPACITY = 512
+
+
+class FlushRecord(dict):
+    """One flush's structured record.  A dict subclass (not a dataclass)
+    so the segment set can grow without a schema migration — the
+    aggregator's measured segments vary by tier (meshed flushes have no
+    per-chunk layout split; idle intervals have no device segment)."""
+
+    REQUIRED = ("interval", "unix_ts", "total_ms")
+
+
+def record_from_segments(interval: int, unix_ts: float, total_s: float,
+                         segments: Optional[dict] = None,
+                         devices: int = 1, **extra) -> FlushRecord:
+    """Build a FlushRecord from the aggregator's `last_flush_segments`:
+    `*_s` second segments become `*_ms` milliseconds, byte/count gauges
+    pass through unchanged."""
+    rec = FlushRecord(interval=int(interval),
+                      unix_ts=round(float(unix_ts), 3),
+                      total_ms=round(total_s * 1e3, 3),
+                      devices=int(devices))
+    for name, v in (segments or {}).items():
+        if name.endswith("_s"):
+            rec[name[:-2] + "_ms"] = round(float(v) * 1e3, 3)
+        else:
+            rec[name] = int(v) if float(v).is_integer() else float(v)
+    for name, v in extra.items():
+        if v is not None:
+            rec[name] = v
+    return rec
+
+
+class FlushTimeline:
+    """Thread-safe bounded ring of FlushRecords (newest last)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.total_recorded = 0
+
+    def append(self, rec: FlushRecord) -> None:
+        with self._lock:
+            self._ring.append(rec)
+            self.total_recorded += 1
+
+    def record(self, interval: int, unix_ts: float, total_s: float,
+               segments: Optional[dict] = None, devices: int = 1,
+               **extra) -> FlushRecord:
+        """Build + append in one call (the server's per-flush hook)."""
+        rec = record_from_segments(interval, unix_ts, total_s,
+                                   segments, devices, **extra)
+        self.append(rec)
+        return rec
+
+    def snapshot(self, last: Optional[int] = None) -> list[dict]:
+        """Newest-last copy of the ring (optionally only the last N)."""
+        with self._lock:
+            recs = list(self._ring)
+        if last is not None and last >= 0:
+            recs = recs[-last:] if last else []
+        return [dict(r) for r in recs]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
