@@ -121,7 +121,8 @@ def _time_flush(n_keys: int, n_lanes: int, label: str,
 def _amortized_flush(n_keys: int, n_lanes: int, label: str,
                      rounds: int, pipeline: int,
                      depth: int = 32, weighted: bool = False
-                     ) -> tuple[float, float, int, float]:
+                     ) -> tuple[float, float, int,
+                                tuple[float, float], int]:
     """Sustained per-flush cost: issue `pipeline` flushes back-to-back,
     force execution with ONE value fetch at the end, divide.  This
     amortizes the device-link round-trip (~100ms on the axon tunnel,
@@ -133,7 +134,11 @@ def _amortized_flush(n_keys: int, n_lanes: int, label: str,
     pipelined protocol on a trivial program), so the device-only
     residual is a per-round difference rather than two arms measured
     minutes apart under drifting tunnel congestion.  Returns (p50_ms,
-    p99_ms, rounds_measured, device_only_p50_ms)."""
+    p99_ms, rounds_measured, (device_only_p50_ms, device_only_p99_ms),
+    operand_bytes) — operand_bytes is the HBM-facing read the flush
+    kernel performs, counted from the ACTUAL staged arrays' dtypes (the
+    roofline denominator must not assume f32: bf16/depth-vector staging
+    halves real bytes moved)."""
     import jax
     import jax.numpy as jnp
 
@@ -181,9 +186,12 @@ def _amortized_flush(n_keys: int, n_lanes: int, label: str,
             break
     arr = np.asarray(per_flush)
     d = np.asarray(diffs)
+    # the kernel reads BOTH dense operands (pow2-padded rows cross HBM
+    # like any others) at their staged dtypes
+    operand_bytes = int(inputs.dense_v.nbytes + inputs.dense_w.nbytes)
     return (float(np.percentile(arr, 50)), float(np.percentile(arr, 99)),
             len(arr), (float(np.percentile(d, 50)),
-                       float(np.percentile(d, 99))))
+                       float(np.percentile(d, 99))), operand_bytes)
 
 
 def bench_link_floor(pipeline: int = 200, rounds: int = 3) -> float:
@@ -283,7 +291,7 @@ def bench_device() -> dict:
     floor = bench_link_floor(pipeline=PIPELINE_100K)
     c50, c99, n_calls = _time_flush(N_KEYS, N_LANES, "device arm (per-call)",
                                     WARMUP, CALL_ITERS)
-    a50, a99, n_rounds, (do50, do99) = _amortized_flush(
+    a50, a99, n_rounds, (do50, do99), bytes_moved = _amortized_flush(
         N_KEYS, N_LANES, "device arm (sustained)",
         rounds=12, pipeline=PIPELINE_100K)
     do50, do99 = max(do50, 1e-3), max(do99, 1e-3)
@@ -291,14 +299,13 @@ def bench_device() -> dict:
     # the same shape — what a re-compressed forwarded-digest interval
     # costs (the headline's weight-1 centroids match the baseline's own
     # under-compressed incoming digests and take the key-only network)
-    _, w99, wn, (wdo50, _wdo99) = _amortized_flush(
+    _, w99, wn, (wdo50, _wdo99), _wb = _amortized_flush(
         N_KEYS, N_LANES, "device arm (weighted/general path)",
         rounds=4, pipeline=PIPELINE_100K, weighted=True)
     wdo50 = max(wdo50, 1e-3)
-    # the kernel reads the pow2-PADDED [K, D] operands — padding rows
-    # cross HBM like any others, so the roofline denominator counts them
-    k_pad = 1 << (N_KEYS - 1).bit_length()
-    bytes_moved = 2 * k_pad * 8 * 32 * 4   # both [K, D] f32 operands
+    # roofline numerator: the ACTUAL operand bytes of the launched
+    # program (per-dtype; _amortized_flush counts the staged arrays) —
+    # no silent f32 assumption
     bw = bytes_moved / (do50 * 1e-3) / 1e9
     log(f"device arm: sustained p50={a50:.2f}ms p99={a99:.2f}ms/flush "
         f"({n_rounds} rounds x {PIPELINE_100K} pipelined); "
@@ -330,11 +337,9 @@ def bench_device_scale() -> tuple[float, int] | None:
         log("scale arm skipped (non-TPU backend)")
         return None
     n_keys, lanes = 125_000, 8
-    _, p99, n, (dev_only, _do99) = _amortized_flush(
+    _, p99, n, (dev_only, _do99), bytes_moved = _amortized_flush(
         n_keys, lanes, "scale arm", rounds=4, pipeline=PIPELINE_1M)
     dev_only = max(dev_only, 1e-3)
-    k_pad = 1 << (n_keys - 1).bit_length()
-    bytes_moved = 2 * k_pad * lanes * 32 * 4
     bw = bytes_moved / (dev_only * 1e-3) / 1e9
     log(f"scale arm: {n_keys * lanes:,} digests/interval "
         f"({n_keys * lanes * 32:,} staged points) sustained "
@@ -342,6 +347,151 @@ def bench_device_scale() -> tuple[float, int] | None:
         f"cardinality); device-only ~{dev_only:.2f}ms = {bw:.0f} GB/s "
         f"effective ({100 * bw / HBM_GBPS:.0f}% of HBM roofline)")
     return p99, n
+
+
+def bench_kernel_stages() -> dict:
+    """Per-stage decomposition of the flush evaluation — the
+    `kernel_stage_ms` breakdown BASELINE.md promises (cumulative
+    slices: read -> +sort -> +prefix-sum -> full kernel, each timed
+    under the pipelined protocol).
+
+    On TPU the slices are progressively larger cuts of the PRODUCTION
+    Pallas kernel (scripts/profile_flush_kernel.py is the standalone,
+    knob-rich version) at the north-star 100k shape.  On CPU — the
+    simulated path the driver cross-checks byte accounting on — the
+    same cuts of the XLA twin formulation run at a reduced shape
+    (CPU lax.sort at the full shape burns minutes for no signal); the
+    shape is recorded in the emitted dict so nobody compares across
+    backends by accident."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import sorted_eval as se
+    from veneur_tpu.sketches import tdigest as td
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        u, d = 1 << (N_KEYS - 1).bit_length(), N_LANES * 32
+        pipeline, rounds = 50, 3
+    else:
+        u, d = 8192, 64
+        pipeline, rounds = 4, 3
+    rng = np.random.default_rng(0)
+    mean = jnp.asarray(rng.gamma(2.0, 10.0, (u, d)).astype(np.float32))
+    weight = jnp.asarray(np.ones((u, d), np.float32))
+    dmin = jnp.asarray(np.asarray(mean).min(1))
+    dmax = jnp.asarray(np.asarray(mean).max(1))
+    pct = jnp.asarray(np.asarray(PERCENTILES), jnp.float32)
+
+    def pallas_slice(mode):
+        from jax.experimental import pallas as pl
+
+        tile = se._lane_tile(u, d)
+        kernel = se.stage_slice_kernel(mode)   # shared with the
+        # profile script — the cuts are built from the production
+        # stage functions and cannot drift from the kernel
+
+        def fn(eps):
+            return pl.pallas_call(
+                kernel, grid=(u // tile,),
+                in_specs=[pl.BlockSpec((tile, d), lambda i: (i, 0)),
+                          pl.BlockSpec((tile, d), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+                out_shape=jax.ShapeDtypeStruct((1, u), jnp.float32),
+            )(mean + eps, weight)
+        return fn
+
+    def xla_slice(mode):
+        def fn(eps):
+            m = mean + eps
+            key = jnp.where(weight > 0, m, jnp.inf)
+            if mode == "read":
+                return jnp.sum(m * weight, axis=1, keepdims=True)
+            key, m2, w2 = jax.lax.sort((key, m, weight), dimension=1,
+                                       num_keys=1)
+            if mode == "sort":
+                return jnp.sum(key[:, :1] * w2[:, :1], axis=1,
+                               keepdims=True)
+            cum = jnp.cumsum(w2, axis=1)
+            return cum[:, -1:]
+        return fn
+
+    def full(eps):
+        if on_tpu:
+            return se.weighted_eval(mean + eps, weight, dmin, dmax, pct)
+        return td.weighted_eval(mean + eps, weight, dmin, dmax, pct)
+
+    out: dict = {"u": u, "d": d,
+                 "backend": "tpu" if on_tpu else "cpu"}
+    for mode in ("read", "sort", "cumsum", "full"):
+        if mode == "full":
+            base = full
+        else:
+            base = pallas_slice(mode) if on_tpu else xla_slice(mode)
+        jfn = jax.jit(base)
+        # warm up with the SAME dtype the timed loop passes: a python
+        # float is weak-typed and would trace a second program, folding
+        # a full compile into the first timed round
+        float(np.asarray(jfn(np.float32(0.0))).ravel()[0])
+        per = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            outs = [jfn(np.float32(i * 1e-7)) for i in range(pipeline)]
+            float(np.asarray(outs[-1]).ravel()[0])
+            per.append((time.perf_counter() - t0) / pipeline * 1e3)
+        out[mode] = round(float(np.percentile(per, 50)), 3)
+    log(f"kernel-stage arm [{u}x{d}, "
+        f"{'pallas' if on_tpu else 'xla-twin'} slices]: "
+        + " ".join(f"{m}={out[m]}ms"
+                   for m in ("read", "sort", "cumsum", "full")))
+    return out
+
+
+def bench_depth_vector() -> dict | None:
+    """The production unmeshed uniform-interval program (depth-vector
+    staging, serving.make_serving_flush(None).depth_variant): values +
+    a [K] int16 depth vector cross the link — no weight matrix — and
+    the v3 kernel sorts bf16-staged values at 16-bit width.  Reports
+    both staging dtypes with their ACTUAL operand bytes, so the
+    per-dtype roofline math is visible side by side.  TPU-only: the
+    CPU fallback routes to the XLA twin and measures nothing about the
+    kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform != "tpu":
+        return None
+    from veneur_tpu.parallel import flush_step as fs
+    from veneur_tpu.parallel import serving
+
+    flush = serving.make_serving_flush(None)
+    pcts = [jnp.asarray(np.asarray(PERCENTILES) + i * 1e-7, jnp.float32)
+            for i in range(8)]
+    out: dict = {}
+    for bf16 in (False, True):
+        tag = "bf16" if bf16 else "f32"
+        dv, dep = fs.example_depth_inputs(N_KEYS, N_LANES, depth=32,
+                                          bf16=bf16)
+        dv = jax.device_put(dv)
+        dep = jax.device_put(dep)
+        float(np.asarray(flush.depth_variant(dv, dep, pcts[0])[0, 0]))
+        per = []
+        for r in range(6):
+            t0 = time.perf_counter()
+            outs = [flush.depth_variant(dv, dep, pcts[i % 8])
+                    for i in range(PIPELINE_100K)]
+            float(np.asarray(outs[-1][0, 0]))
+            per.append((time.perf_counter() - t0) / PIPELINE_100K * 1e3)
+        p50 = float(np.percentile(per, 50))
+        p99 = float(np.percentile(per, 99))
+        bytes_moved = int(dv.nbytes + dep.nbytes)
+        out[f"{tag}_p50"] = round(p50, 3)
+        out[f"{tag}_p99"] = round(p99, 3)
+        out[f"{tag}_operand_mb"] = round(bytes_moved / 1e6, 2)
+        log(f"depth-vector arm [{tag}]: sustained p50={p50:.2f}ms "
+            f"p99={p99:.2f}ms/flush, {bytes_moved / 1e6:.1f} MB operands "
+            f"({bytes_moved / (p50 * 1e-3) / 1e9:.0f} GB/s effective)")
+    return out
 
 
 def bench_e2e_flush(n_keys: int, warmup: int, iters: int,
@@ -858,6 +1008,25 @@ def main() -> None:
         if ingest_res["stage_ns"]:
             result["ingest_stage_ns"] = ingest_res["stage_ns"]
             result["ingest_stage_pkts"] = ingest_res["stage_pkts"]
+    # stage-level decomposition of the kernel (BASELINE.md-promised:
+    # the roofline narrative needs to show WHICH stage eats the gap).
+    # The promised key is ALWAYS present; a failure in the arm's ad-hoc
+    # slice kernels (e.g. a Mosaic lowering gap CI's CPU-only interpret
+    # tests cannot catch) must not discard every arm already measured —
+    # it surfaces as an explicit error value instead
+    try:
+        result["kernel_stage_ms"] = bench_kernel_stages()
+    except Exception as e:
+        log(f"kernel-stage arm failed: {e}")
+        result["kernel_stage_ms"] = {"error": str(e)[:200]}
+    try:
+        dvec = bench_depth_vector()
+        if dvec is not None:
+            # production uniform-interval program, per staging dtype,
+            # with actual operand bytes (the per-dtype roofline view)
+            result["depth_vector_ms"] = dvec
+    except Exception as e:
+        log(f"depth-vector arm failed: {e}")
     try:
         scale = bench_device_scale()
     except Exception as e:
@@ -936,7 +1105,7 @@ def main() -> None:
     promised = ["metric", "value", "unit", "vs_baseline", "link_floor_ms",
                 "device_only_p50_ms", "device_only_p99_ms",
                 "hbm_roofline_frac", "weighted_p99",
-                "weighted_dev_only_p50"]
+                "weighted_dev_only_p50", "kernel_stage_ms"]
     if "mesh_scaling_per_device_work_ms" in result:
         promised += ["mesh_scaling_e2e_ms", "mesh_scaling_segments_ms"]
     if "ingest_udp_pkts_per_sec" in result:

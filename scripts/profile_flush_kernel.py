@@ -4,13 +4,19 @@ Times progressively larger slices of ops/sorted_eval.py under the
 pipelined protocol (N launches, one value fetch), so the axon tunnel's
 per-call RTT amortizes out:
 
-  dma      read both [K, D] inputs, write a row-reduce  -> HBM/launch floor
-  sort     + full bitonic network                       -> sort cost
-  cumsum   + MXU triangular prefix sum                  -> rank-base cost
-  full     the production kernel                        -> + quantile passes
-  xla      the lax.sort twin (td.weighted_eval)         -> XLA comparison
+  dma        read both [K, D] inputs, write a row-reduce -> HBM/launch floor
+  sort       + full bitonic network                      -> sort cost
+  cumsum     + MXU triangular prefix sum                 -> rank-base cost
+  full       the production kernel (auto tile/nbuf)      -> + quantile passes
+  full_nodma the production kernel, classic grid forced  -> DMA-pipeline A/B
+  full_dma   the production kernel, nbuf=4 forced        -> DMA-pipeline A/B
+  compact    the packed compact-key general network      -> v3 evidence
+  depth      the depth-vector (uniform) kernel, f32      -> key-only network
+  depth_bf16 the depth-vector kernel on bf16 staging     -> 16-bit keys
+  xla        the lax.sort twin (td.weighted_eval)        -> XLA comparison
 
 Usage: python scripts/profile_flush_kernel.py [K] [D] [pipeline] [rounds]
+       [modes]
 """
 
 from __future__ import annotations
@@ -30,55 +36,45 @@ from veneur_tpu.ops import sorted_eval as se
 from veneur_tpu.sketches import tdigest as td
 
 
-def _variant_kernel(mode: str, n_pct: int):
-    # v2 transposed layout: tiles are [D, T]
-    def kernel(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref):
-        m = mean_ref[...]
-        w = weight_ref[...]
-        d, t = m.shape
-        idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
-        key = jnp.where(w > 0, m, se._PAD_KEY)
-        if mode in ("sort", "cumsum"):
-            k = 2
-            while k <= d:
-                j = k // 2
-                while j >= 1:
-                    key, w = se._cmp_exchange(key, w, j, k, idx)
-                    j //= 2
-                k *= 2
-        if mode == "cumsum":
-            cum = se._cumsum_depth(w)
-            out = jnp.concatenate(
-                [cum[d - 1:d, :]] * (n_pct + 2), axis=0)
-        else:
-            red = jnp.sum(key * w, axis=0, keepdims=True)
-            out = jnp.concatenate([red] * (n_pct + 2), axis=0)
-        out_ref[...] = out
-    return kernel
-
-
 def run_variant(mode: str, mean, weight, minmax, qs, tile: int):
     u, d = mean.shape
     n_pct = qs.shape[1]
     if mode == "full":
         return se.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
                                 qs[0])
+    if mode == "full_nodma":
+        return se.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
+                                qs[0], nbuf=1)
+    if mode == "full_dma":
+        return se.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
+                                qs[0], nbuf=4)
+    if mode == "compact":
+        if d > se.MAX_COMPACT_DEPTH:
+            raise ValueError(f"compact needs D <= "
+                             f"{se.MAX_COMPACT_DEPTH} (got {d})")
+        return se.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
+                                qs[0], compact=True)
+    if mode in ("depth", "depth_bf16"):
+        depths = jnp.full((u,), d, jnp.int32)
+        mv = mean.astype(jnp.bfloat16) if mode == "depth_bf16" else mean
+        return se.uniform_eval(mv, depths, qs[0])
     if mode == "xla":
         return td.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
                                 qs[0])
-    kern = _variant_kernel(mode, n_pct)
+    # cumulative stage cuts shared with bench.bench_kernel_stages:
+    # built from the production stage functions (sorted_eval
+    # stage_slice_kernel), so they cannot drift from the kernel
+    kern = se.stage_slice_kernel("read" if mode == "dma" else mode)
     return pl.pallas_call(
         kern,
         grid=(u // tile,),
         in_specs=[
-            pl.BlockSpec((d, tile), lambda i: (0, i)),
-            pl.BlockSpec((d, tile), lambda i: (0, i)),
-            pl.BlockSpec((2, tile), lambda i: (0, i)),
-            pl.BlockSpec((1, n_pct), lambda i: (0, 0)),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((n_pct + 2, tile), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((n_pct + 2, u), jnp.float32),
-    )(mean.T, weight.T, minmax.T, qs)
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, u), jnp.float32),
+    )(mean, weight)
 
 
 def main():
@@ -100,9 +96,19 @@ def main():
     qs = jax.device_put(
         np.asarray([[0.5, 0.9, 0.99]], np.float32))
 
-    bytes_read = 2 * k * d * 4
+    def mode_bytes(mode: str) -> int:
+        """HBM-facing operand bytes of each mode, per dtype — the
+        eff-BW column must not assume two f32 operands (the depth and
+        bf16 modes exist precisely because they move fewer bytes)."""
+        if mode == "depth":
+            return k * d * 4 + k * 4          # f32 values + i32 depths
+        if mode == "depth_bf16":
+            return k * d * 2 + k * 4          # bf16 values + i32 depths
+        return 2 * k * d * 4                  # both [K, D] f32 operands
+
     modes = (sys.argv[5].split(",") if len(sys.argv) > 5
-             else ["dma", "sort", "cumsum", "full", "xla"])
+             else ["dma", "sort", "cumsum", "full", "full_nodma",
+                   "full_dma", "depth", "depth_bf16", "xla"])
     for mode in modes:
         def fn(pct_jitter, _mode=mode):
             return run_variant(_mode, mean, weight, minmax,
@@ -121,7 +127,7 @@ def main():
             float(np.asarray(outs[-1][0, 0]))
             per.append((time.perf_counter() - t0) / pipeline * 1e3)
         p50 = float(np.percentile(per, 50))
-        bw = bytes_read / (p50 * 1e-3) / 1e9
+        bw = mode_bytes(mode) / (p50 * 1e-3) / 1e9
         print(f"{mode:7s} p50={p50:8.3f} ms/flush  "
               f"eff-BW={bw:7.1f} GB/s  (compile {compile_s:.1f}s)",
               flush=True)

@@ -3,7 +3,20 @@
 Variants of the [D, T]-tile sort network, timed with the in-launch scan
 harness (launch cost amortized out).  All variants must produce the same
 sorted keys + paired weights; v0 is the production kernel's current
-formulation.
+formulation.  Input values are quantized to bf16-exact so the compact
+(16-bit key) formulations are output-identical to the f32 ones — the
+quantization changes no variant's instruction mix.
+
+Compact-key formulations (v3 kernel evidence; ops/sorted_eval.py):
+  c0  packed (bf16-key | depth-index) int32 single-array network +
+      permutation-apply weight reconstruct — the production
+      `compact=True` general kernel's formulation.  Stage cost ~6
+      passes vs the paired form's ~11, paid back by O(D) selects in the
+      reconstruct: the crossover depth measured here is what
+      MAX_COMPACT_DEPTH pins.
+  c1  bf16 key-only network, widen after the last stage — the
+      uniform/depth-vector kernel's 16-bit path (no payload at all;
+      legal on this harness because the weights are all 1).
 
 Usage: python scripts/sort_variants.py [K] [D] [inner] [pipeline] [modes]
 """
@@ -131,8 +144,48 @@ STAGES = {"v0": (_stage_v0, 2), "v1": (_stage_v1, 2),
 STAGES["v5"] = (_stage_v5, 1)
 STAGES["v6"] = (_stage_v6, 1)
 
+COMPACT_MODES = ("c0", "c1")
+
+
+def _emit(key, w, out_ref):
+    d = key.shape[0]
+    out_ref[...] = jnp.concatenate(
+        [key[0:1], key[d // 2:d // 2 + 1],
+         jnp.sum(key * jnp.where(key != _PAD, w, 0.0),
+                 axis=0, keepdims=True)], axis=0)
+
+
+def _kernel_c0(mean_ref, weight_ref, out_ref):
+    """Packed compact general network (production compact=True)."""
+    m = mean_ref[...]
+    w = weight_ref[...]
+    d, t = m.shape
+    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
+    key, w_s = se._compact_sort_tile(m, w, idx)
+    # padding reconstructs as +inf like the f32 network's pad key
+    key = jnp.where(w_s > 0, key, _PAD)
+    _emit(key, w_s, out_ref)
+
+
+def _kernel_c1(mean_ref, weight_ref, out_ref):
+    """bf16 key-only network (the uniform/depth kernels' 16-bit path);
+    weights are all 1 on this harness, so sorted keys + the pre-sort
+    weight array emit the same outputs as the paired variants."""
+    m = mean_ref[...]
+    w = weight_ref[...]
+    d, t = m.shape
+    idx = jax.lax.broadcasted_iota(jnp.int32, (d, t), 0)
+    key = jnp.where(w > 0, m.astype(jnp.bfloat16),
+                    jnp.asarray(_PAD, jnp.bfloat16))
+    key = se._sort_keys(key, idx).astype(jnp.float32)
+    _emit(key, w, out_ref)
+
 
 def make_kernel(mode: str):
+    if mode == "c0":
+        return _kernel_c0
+    if mode == "c1":
+        return _kernel_c1
     stage, iota_kind = STAGES[mode]
 
     def kernel(mean_ref, weight_ref, out_ref):
@@ -151,10 +204,7 @@ def make_kernel(mode: str):
                 key, w = stage(key, w, j, k, idx)
                 j //= 2
             k *= 2
-        out_ref[...] = jnp.concatenate(
-            [key[0:1], key[d // 2:d // 2 + 1],
-             jnp.sum(key * jnp.where(key != _PAD, w, 0.0),
-                     axis=0, keepdims=True)], axis=0)
+        _emit(key, w, out_ref)
     return kernel
 
 
@@ -176,17 +226,24 @@ def main():
     inner = int(sys.argv[3]) if len(sys.argv) > 3 else 32
     pipeline = int(sys.argv[4]) if len(sys.argv) > 4 else 8
     modes = (sys.argv[5].split(",") if len(sys.argv) > 5
-             else list(STAGES))
+             else list(STAGES) + list(COMPACT_MODES))
 
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     print(f"device: {jax.devices()[0]} K={k} D={d} inner={inner} "
           f"pipeline={pipeline}", flush=True)
     rng = np.random.default_rng(0)
-    mt = jax.device_put(
-        rng.gamma(2.0, 10.0, (d, k)).astype(np.float32))
+    import ml_dtypes
+    vals = (rng.gamma(2.0, 10.0, (d, k)).astype(np.float32)
+            .astype(ml_dtypes.bfloat16).astype(np.float32))
+    mt = jax.device_put(vals)   # bf16-exact: compact modes match v0
     wt = jax.device_put(np.ones((d, k), np.float32))
     tile = se._lane_tile(k, d)
+    if "c0" in modes and d > se.MAX_COMPACT_DEPTH:
+        print(f"c0 skipped: d={d} > MAX_COMPACT_DEPTH="
+              f"{se.MAX_COMPACT_DEPTH} (the permutation-apply "
+              f"reconstruct is O(D) selects)", flush=True)
+        modes = [m for m in modes if m != "c0"]
 
     # correctness vs v0 first (on a small slice, via CPU comparison)
     small_m, small_w = np.asarray(mt[:, :tile]), np.asarray(wt[:, :tile])

@@ -40,6 +40,10 @@ def distributions(rng):
         "bimodal": lambda n: np.concatenate(
             [rng.normal(10, 1, n // 2), rng.normal(100, 5, n - n // 2)]),
         "heavy_tail": lambda n: rng.pareto(1.5, n) + 1.0,
+        # pre-sorted ascending input: the classic order-bias stressor
+        # for streaming digests (a sequential digest's clusters form
+        # left-to-right; the batched compressor must not care)
+        "adversarial_sorted": lambda n: np.sort(rng.gamma(2.0, 10.0, n)),
     }
 
 

@@ -429,3 +429,47 @@ def test_digest_float64_mesh_rejected_at_config_layer():
     # each alone stays legal
     config_mod.load_config_dict({"digest_float64": True})
     config_mod.load_config_dict({"mesh_devices": 8})
+
+
+def test_failed_dispatch_releases_lane_pin():
+    """A flush dispatch that raises after the snapshot (device OOM, an
+    in-flush compile error) must release the set-lane snapshot pin —
+    a leaked pin routes every later lane update through the copying
+    kernels for the process lifetime (review finding, round 7)."""
+    from veneur_tpu.core.aggregator import MetricAggregator
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+
+    agg = MetricAggregator(mesh=mesh_mod.make_mesh(8),
+                           percentiles=[0.5], ingest_lanes=4)
+    with agg.lock:
+        row = agg.digests.row_for(
+            MetricKey("pin.k", sm.TYPE_HISTOGRAM, ""),
+            MetricScope.GLOBAL_ONLY, [])
+        agg.digests.sample(row, 1.0, 1.0)
+        agg.digests.touched[row] = True
+    agg.sync_staged(min_samples=1)
+
+    def boom(snap, is_local):
+        raise RuntimeError("synthetic dispatch failure")
+
+    agg._dispatch_flush = boom
+    with pytest.raises(RuntimeError, match="synthetic"):
+        agg.flush_dispatch(is_local=False)
+    assert agg.sets._snapshot_inflight == 0
+
+    # and the emit/fetch side: a raising fetch must also unpin
+    del agg._dispatch_flush          # restore the real dispatch
+    with agg.lock:
+        agg.digests.sample(row, 2.0, 1.0)
+        agg.digests.touched[row] = True
+    agg.sync_staged(min_samples=1)
+    pending = agg.flush_dispatch(is_local=False)
+
+    def fetch_boom(snap, pend, seg):
+        raise RuntimeError("synthetic fetch failure")
+
+    agg._fetch_flush = fetch_boom
+    with pytest.raises(RuntimeError, match="synthetic"):
+        pending.emit()
+    assert agg.sets._snapshot_inflight == 0

@@ -594,7 +594,15 @@ class MetricAggregator:
                 and len(snap["counters"]["rows"]) == 0
                 and (not snap["have_uts"]
                      or snap["uts_host"] is not None))
-        pend = None if idle else self._dispatch_flush(snap, is_local)
+        try:
+            pend = None if idle else self._dispatch_flush(snap, is_local)
+        except BaseException:
+            # a failed dispatch (device OOM, in-flush compile error)
+            # must release the set-lane snapshot pin, or lane updates
+            # stay on the copying kernels for the process lifetime
+            if self.mesh is not None:
+                self.sets.unpin_lanes(snap.get("sets", {}).get("lanes"))
+            raise
         return PendingFlush(self, snap, pend, res, is_local, now, seg)
 
     def _emit_pending(self, snap: dict, pend: Optional[dict],
@@ -602,12 +610,17 @@ class MetricAggregator:
                       seg: dict) -> FlushResult:
         """Phase 2 of a flush (PendingFlush.emit body): fetch the
         dispatched device outputs and generate the InterMetric batch."""
-        host = {} if pend is None else self._fetch_flush(snap, pend, seg)
-        if self.mesh is not None:
-            # the fetch above (or the idle skip) means the flush program
-            # can no longer read the snapshotted set registers: release
-            # the pin so lane updates go back to in-place donation
-            self.sets.unpin_lanes(snap.get("sets", {}).get("lanes"))
+        try:
+            host = {} if pend is None else self._fetch_flush(snap, pend,
+                                                             seg)
+        finally:
+            if self.mesh is not None:
+                # fetched, idle-skipped, OR the fetch raised: either way
+                # the flush program can no longer read the snapshotted
+                # set registers — release the pin so lane updates go
+                # back to in-place donation (a leaked pin would pin the
+                # copying kernels forever)
+                self.sets.unpin_lanes(snap.get("sets", {}).get("lanes"))
         if snap.pop("have_uts"):
             res.unique_ts = int(snap["uts_host"]
                                 if snap["uts_host"] is not None
@@ -699,13 +712,21 @@ class MetricAggregator:
                 buckets.append((u, max(2, arena_mod._pow2(dpt))))
             u *= 2
         dt = self.digests.eval_dtype
+        # compact_general staging uploads bf16 general values — the
+        # prewarmed struct dtype must match or the signature misses
+        gen_dt = (self.digests.stage_dtype
+                  if self.digests.compact_general else dt)
         for u_pad, d_pad in buckets:
             if stop is not None and stop.is_set():
                 break
             # AOT lower+compile from shape structs: populates the jit and
             # persistent caches without allocating or executing anything
-            # on the device the live flushes are using
-            dv = jax.ShapeDtypeStruct((u_pad, d_pad), dt)
+            # on the device the live flushes are using.  The WEIGHT
+            # struct stays eval_dtype even under compact_general —
+            # build_dense narrows values only — or the prewarmed
+            # signature would never match a live flush
+            dv = jax.ShapeDtypeStruct((u_pad, d_pad), gen_dt)
+            dw_s = jax.ShapeDtypeStruct((u_pad, d_pad), dt)
             mm = jax.ShapeDtypeStruct((2, u_pad), dt)
             # both production programs per bucket: the depth-vector
             # uniform variant (raw-sample intervals — the common case on
@@ -731,7 +752,7 @@ class MetricAggregator:
                 du.lower(dv_u, dep, self._pct_arr).compile()
             n += 1
             with self._CompileGuard(self, ((u_pad, d_pad), False, donate)):
-                dg(dv, dv, mm, self._pct_arr, uniform=False).compile()
+                dg(dv, dw_s, mm, self._pct_arr, uniform=False).compile()
             n += 1
         return n
 

@@ -666,6 +666,15 @@ class DigestArena(_ArenaBase):
             self.stage_dtype = np.dtype(ml_dtypes.bfloat16)
         else:
             self.stage_dtype = self.eval_dtype
+        # compact-key general staging (v3 kernel): with bf16 staging on,
+        # the GENERAL (weighted) dense values also upload as bf16 and
+        # the flush routes shallow shapes to the packed compact-key sort
+        # network (ops/sorted_eval.py usable_compact) — weights, minmax
+        # and exported centroids stay f32-exact (serving.digest_export
+        # widens before compress).  Unmeshed only: the meshed program
+        # stacks dense_v/dense_w into one all_to_all, which requires one
+        # dtype
+        self.compact_general = bool(bf16_staging) and mesh is None
         self.n_replicas = self._init_mesh_lanes(mesh, "digest")
         if mesh is not None:
             from veneur_tpu.parallel.mesh import SHARD_AXIS
@@ -1014,7 +1023,8 @@ class DigestArena(_ArenaBase):
                     minmax = np.zeros((2, u_pad), self.eval_dtype)
                     minmax[0, :nd] = d_min_t
                     minmax[1, :nd] = d_max_t
-                if uniform and self.stage_dtype != np.float32:
+                if self.stage_dtype != np.float32 and (
+                        uniform or self.compact_general):
                     dv = dv.astype(self.stage_dtype)
                 if uniform:
                     return dv, depths_vec, None
@@ -1030,9 +1040,8 @@ class DigestArena(_ArenaBase):
         d_pad = max(2, self.n_replicas * _pow2(
             -(-depth // self.n_replicas)))
         if uniform:
-            # bf16 staging applies here only: the general (weighted)
-            # path must keep eval_dtype so device totals and exported
-            # centroid weights stay exact
+            # bf16 staging narrows the VALUE matrix only; weights (0/1,
+            # implicit here) and exported centroid weights stay exact
             dv = np.zeros((u_pad, d_pad), self.stage_dtype)
             dv[r, pos] = v
             # int16 is exact (depths <= DENSE_DEPTH_CAP < 2^15) and
@@ -1044,7 +1053,11 @@ class DigestArena(_ArenaBase):
             # minmax stays host-side on this path (never uploaded);
             # returned as None so nobody builds it for nothing
             return dv, depths_vec, None
-        dv = np.zeros((u_pad, d_pad), self.eval_dtype)
+        # compact_general: bf16 VALUES on the general path too (weights
+        # and minmax stay eval_dtype — they feed exact accumulations)
+        dv = np.zeros((u_pad, d_pad),
+                      self.stage_dtype if self.compact_general
+                      else self.eval_dtype)
         dv[r, pos] = v
         minmax = np.zeros((2, u_pad), self.eval_dtype)
         minmax[0, :nd] = d_min_t

@@ -5,6 +5,27 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# opaque sentinel for pin(): a value the pinned expressions never equal
+# in practice (and whose collision cost is bounded — see call sites)
+_PIN_SENTINEL = -3.0303e38
+
+
+def pin(x: jax.Array) -> jax.Array:
+    """Force `x` to be materialized (rounded to its dtype) instead of
+    living on as a fused-multiply-add intermediate.
+
+    Compilers contract `a * b + c` / `a * b - c` into FMA/FMS PER
+    PROGRAM: the same expression compiled at two tile widths (or in the
+    Pallas kernel vs its XLA twin) may round the product differently,
+    producing last-ulp drift between programs that are supposed to be
+    bit-identical — the flush kernel's tiling-invariance and twin-parity
+    contracts forbid that.  The data-dependent compare makes the select
+    unfoldable, so the product feeds a real select and is rounded
+    exactly once everywhere.  (`lax.optimization_barrier` would say
+    this directly, but Mosaic has no lowering for it, and this must
+    lower inside Pallas TPU kernels.)"""
+    return jnp.where(x == _PIN_SENTINEL, 0.0, x)
+
 
 def tri_cumsum(w: jax.Array, axis: int = -1) -> jax.Array:
     """Inclusive prefix sums along `axis` (last or first of a 2-D tile)

@@ -125,6 +125,29 @@ def make_sharded_flush_step_packed(mesh: Mesh, donate: bool = False):
     return step
 
 
+def example_depth_inputs(n_keys: int = 64, n_lanes: int = 2,
+                         depth: int = 32, seed: int = 0,
+                         bf16: bool = False):
+    """Synthetic (dense values, per-row depth vector) pair for the
+    depth-vector flush program (serving.digest_eval_uniform) — the
+    production unmeshed uniform-interval launch shape: the weight matrix
+    never crosses the link, occupancy is `col < depths[row]`.
+    bf16=True stages the values at wire width (digest_bf16_staging), the
+    shape whose sort network runs on compact 16-bit keys."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    k = 1 << (n_keys - 1).bit_length() if n_keys > 1 else 1
+    d = n_lanes * depth
+    vals = rng.gamma(2.0, 10.0, (k, d)).astype(np.float32)
+    depths = np.zeros(k, np.int16)
+    depths[:n_keys] = d
+    vals[n_keys:] = 0.0
+    dv = jnp.asarray(vals)
+    if bf16:
+        dv = dv.astype(jnp.bfloat16)
+    return dv, jnp.asarray(depths)
+
+
 def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,
                    depth: int = 32,
                    compression: float = td.DEFAULT_COMPRESSION,

@@ -186,12 +186,14 @@ def pallas_eval_applies(u: int, d: int, dtype=jnp.float32) -> bool:
     kernel (where the uniform/general network choice is a DISTINCT
     program).  Callers normalize their `uniform` flag with this so the
     XLA-twin fallback never compiles two identical programs under two
-    static keys."""
+    static keys.  bf16 staging takes the Pallas path too: the compact
+    packed-key network at shallow depths, the f32 paired network on
+    in-kernel-widened values otherwise."""
     import os
 
     from veneur_tpu.ops import sorted_eval as se
     return (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
-            and dtype == jnp.float32
+            and dtype in (jnp.float32, jnp.bfloat16)
             and se.usable(u, d, jax.default_backend()))
 
 
@@ -205,17 +207,37 @@ def digest_eval(dv: jax.Array, dw: jax.Array, d_min: jax.Array,
     the key-only sort network, legal when every nonzero staged weight is
     exactly 1 (tracked per interval by the dense builder).
 
+    bf16-staged dense values (the arena's compact_general staging) keep
+    their wire width into the kernel where the compact packed-key
+    network applies (usable_compact: the value-exactness half of the
+    gate is the bf16 dtype itself — every staged value IS
+    bf16-representable by construction); deeper bf16 shapes widen
+    in-kernel and run the f32 paired network.
+
     VENEUR_TPU_DISABLE_PALLAS_EVAL is read at TRACE time (the choice is
     baked into each compiled program): set it before process start."""
     import os
 
     from veneur_tpu.ops import sorted_eval as se
     u, d = dv.shape
+    backend = jax.default_backend()
     if (not os.environ.get("VENEUR_TPU_DISABLE_PALLAS_EVAL")
-            and dv.dtype == jnp.float32   # f64 option -> XLA twin
-            and se.usable(u, d, jax.default_backend())):
-        return se.weighted_eval(dv, dw, d_min, d_max, percentiles,
-                                uniform=uniform)
+            and dv.dtype in (jnp.float32, jnp.bfloat16)  # f64 -> twin
+            and se.usable(u, d, backend)):
+        if uniform:
+            # the key-only network beats the compact one (~1.8x: no
+            # payload, no prefix-sum) and sorts bf16 keys natively —
+            # checked FIRST so bf16 uniform intervals never pay the
+            # packed network's permutation-apply
+            return se.weighted_eval(dv, dw, d_min, d_max, percentiles,
+                                    uniform=True)
+        if (dv.dtype == jnp.bfloat16
+                and se.usable_compact(u, d, backend)):
+            return se.weighted_eval(dv, dw, d_min, d_max, percentiles,
+                                    compact=True)
+        # bf16 stays bf16 into the kernel here too: the paired network
+        # widens in-register, so no f32 copy ever lands in HBM
+        return se.weighted_eval(dv, dw, d_min, d_max, percentiles)
     return td.weighted_eval(dv, dw, d_min, d_max, percentiles)
 
 
@@ -275,6 +297,11 @@ def flush_body(inputs: FlushInputs, percentiles: jax.Array,
     arxiv 1902.04023, is what makes any per-shard split legal; the
     quantile evaluation itself is row-local either way)."""
     dv, dw = inputs.dense_v, inputs.dense_w
+    if axis is not None and dv.dtype != dw.dtype:
+        # the stacked all_to_all needs one dtype; bf16 staging is an
+        # unmeshed option (arena.compact_general), so this only guards
+        # hand-built inputs
+        dv = dv.astype(dw.dtype)
     if axis is not None:
         # repartition [K_s, D/R] -> [K_s/R, D]: split keys, concat depth.
         # BOTH matrices ride ONE all_to_all (stacked on a leading axis):
@@ -480,8 +507,13 @@ def digest_export(dense_v: jax.Array, dense_w: jax.Array,
     centroids `[F, cap]` for forwarding (ForwardableMetrics,
     `worker.go:179-216` / `MergingDigest.Data`,
     `merging_digest.go:474-483`).  Gathers rows first so both the compute
-    and the readback scale with the forwarded subset, not the arena."""
-    return td.compress(dense_v[rows], dense_w[rows], compression, cap)
+    and the readback scale with the forwarded subset, not the arena.
+    bf16-staged values (compact_general staging) widen here: compress
+    accumulates weighted sums, which bf16 would corrupt."""
+    dv_r = dense_v[rows]
+    if dv_r.dtype == jnp.bfloat16:
+        dv_r = dv_r.astype(jnp.float32)
+    return td.compress(dv_r, dense_w[rows], compression, cap)
 
 
 @functools.partial(jax.jit, static_argnames=("compression", "cap"))

@@ -389,7 +389,19 @@ def weighted_eval(mean: jax.Array, weight: jax.Array,
 
     Rows must have D >= 2 (callers pad).  Empty cells are weight == 0;
     fully-empty rows return zeros.
+
+    This is the exactness REFERENCE for the fused Pallas kernel
+    (ops/sorted_eval.py): lax.sort is stable, so tied values keep their
+    staging order — the Pallas compact (packed-key) network matches that
+    order exactly via its index payload, while the f32 paired bitonic
+    network may order equal-valued points arbitrarily (pair-consistent
+    either way: a weight never separates from its value, so totals,
+    sums, and any quantile not straddling a tied run are unaffected).
+    bf16-staged values widen here so the twin evaluates exactly what the
+    kernel reconstructs.
     """
+    if mean.dtype == jnp.bfloat16:
+        mean = mean.astype(jnp.float32)
     kdim, d = mean.shape
     key = jnp.where(weight > 0, mean, _INF)
     key, mean, weight = jax.lax.sort((key, mean, weight), dimension=1,
@@ -404,7 +416,11 @@ def weighted_eval(mean: jax.Array, weight: jax.Array,
     # cum_i - w_i/2 (uniform-in-centroid semantics for unit weights,
     # merging_digest.go:266-332)
     cmid = cum - 0.5 * weight
-    tq = percentiles[None, :] * total                        # [K, P]
+    # pinned like the Pallas kernel's tq (ops/mxu.py pin): the rank
+    # compares and `tq - c_lo` must see the ROUNDED product, not a
+    # per-program-contracted FMS intermediate
+    from veneur_tpu.ops.mxu import pin as _pin
+    tq = _pin(percentiles[None, :] * total)                  # [K, P]
     # fused comparison-count instead of a vmapped binary search
     idx = jnp.sum((cmid[:, :, None] < tq[:, None, :])
                   .astype(jnp.int32), axis=1)                # [K, P]
@@ -415,7 +431,11 @@ def weighted_eval(mean: jax.Array, weight: jax.Array,
     c_lo, c_hi = g(cmid, ii - 1), g(cmid, ii)
     t = jnp.where(c_hi > c_lo,
                   (tq - c_lo) / jnp.maximum(c_hi - c_lo, 1e-30), 0.0)
-    q = m_lo + (m_hi - m_lo) * jnp.clip(t, 0.0, 1.0)
+    # the interpolation product is pinned too: per-program FMA
+    # contraction would otherwise leave last-ulp differences between
+    # the twin and the kernel (and between kernel tilings), breaking
+    # the bit-parity contract
+    q = m_lo + _pin((m_hi - m_lo) * jnp.clip(t, 0.0, 1.0))
     # single-point rows interpolate against padding; take the point itself
     q = jnp.where(n_real <= 1, mean[:, :1], q)
     q = jnp.clip(q, d_min[:, None], d_max[:, None])
