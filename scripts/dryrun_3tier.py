@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Run the in-process 3-tier dryrun and emit its JSON report.
+
+Local tier -> consistent-hash proxy -> (optionally meshed) global tier in
+ONE process: seeded deterministic traffic with a CPU oracle, K flush
+intervals, then conservation / percentile-envelope / routing checks and
+(optionally) the failpoint chaos matrix.  ROADMAP #3's one command.
+
+Usage:
+  python scripts/dryrun_3tier.py                         # 1x1 smoke, CPU
+  python scripts/dryrun_3tier.py --locals 3 --globals 2 --intervals 4
+  python scripts/dryrun_3tier.py --mesh-devices 2        # meshed globals
+  python scripts/dryrun_3tier.py --chaos all             # full matrix
+  python scripts/dryrun_3tier.py --chaos forward-outage --out report.json
+
+Exit status is nonzero when any check fails, so CI can gate on it.
+Report keys are promised (veneur_tpu.testbed.dryrun.PROMISED_KEYS,
+pinned by tests/test_testbed.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--locals", type=int, default=1, dest="n_locals")
+    ap.add_argument("--globals", type=int, default=1, dest="n_globals")
+    ap.add_argument("--intervals", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="virtual-device mesh size on the global tier")
+    ap.add_argument("--counter-keys", type=int, default=8)
+    ap.add_argument("--histo-keys", type=int, default=4)
+    ap.add_argument("--set-keys", type=int, default=2)
+    ap.add_argument("--histo-samples", type=int, default=200)
+    ap.add_argument("--interval-s", type=float, default=0.05)
+    ap.add_argument("--chaos", default=None,
+                    help="chaos arm name, or 'all' for the full matrix")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force JAX onto CPU (the dryrun's default "
+                    "posture off the driver host)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON report here (default stdout)")
+    args = ap.parse_args(argv)
+
+    if args.cpu or os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.mesh_devices > 1:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{max(8, args.mesh_devices)}").strip()
+
+    from veneur_tpu.testbed.dryrun import run_dryrun
+
+    report = run_dryrun(
+        n_locals=args.n_locals, n_globals=args.n_globals,
+        intervals=args.intervals, seed=args.seed,
+        mesh_devices=args.mesh_devices,
+        counter_keys=args.counter_keys, histo_keys=args.histo_keys,
+        set_keys=args.set_keys, histo_samples=args.histo_samples,
+        interval_s=args.interval_s, chaos=args.chaos)
+
+    body = json.dumps(report, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body + "\n")
+    else:
+        print(body)
+    if not report["ok"]:
+        print("DRYRUN FAILED", file=sys.stderr)
+        return 1
+    print(f"# 3-tier dryrun OK: {report['forwarded']} forwarded, "
+          f"{report['imported']} imported, {report['retried']} retried, "
+          f"{report['dropped']} dropped; "
+          f"{len(report['chaos_matrix'])} chaos arm(s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

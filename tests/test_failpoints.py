@@ -1,0 +1,481 @@
+"""Failpoint registry + the robustness it forces: bounded forward
+retries with exact-once chunk accounting, per-destination circuit
+breaking with half-open restore, and drop accounting visible at
+/debug/vars (ISSUE 5 tentpole, forward/client.py + proxy/destinations.py
++ veneur_tpu/failpoints)."""
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent import futures as cf
+
+import grpc
+import pytest
+from google.protobuf import empty_pb2
+
+from veneur_tpu import failpoints
+from veneur_tpu.forward import convert
+from veneur_tpu.forward.client import BATCH_MAX, ForwardClient, RetryPolicy
+from veneur_tpu.protocol import forward_pb2, metric_pb2
+from veneur_tpu.proxy.destinations import Destinations
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_inject_is_noop_when_disarmed():
+    # must not raise, must not track anything
+    failpoints.inject("forward.send")
+    assert failpoints.stats() == {}
+
+
+def test_times_bound_and_counters():
+    fp = failpoints.configure("x", "drop", times=2)
+    fired = 0
+    for _ in range(5):
+        try:
+            failpoints.inject("x")
+        except failpoints.FailpointDrop:
+            fired += 1
+    assert fired == 2
+    assert fp.evaluated == 5 and fp.fired == 2
+    failpoints.disarm("x")
+    failpoints.inject("x")      # disarmed: no-op again
+
+
+def test_prob_is_seed_deterministic():
+    def run(seed):
+        fp = failpoints.configure("p", "drop", prob=0.5, seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                failpoints.inject("p")
+                out.append(0)
+            except failpoints.FailpointDrop:
+                out.append(1)
+        failpoints.disarm("p")
+        return out, fp.fired
+
+    a, fa = run(7)
+    b, fb = run(7)
+    c, _ = run(8)
+    assert a == b and fa == fb
+    assert a != c                       # a different seed differs
+    assert 0 < fa < 32                  # the coin actually flips
+
+
+def test_grpc_error_action_is_a_real_rpc_error():
+    failpoints.configure("g", "grpc-error", code="RESOURCE_EXHAUSTED")
+    with pytest.raises(grpc.RpcError) as exc:
+        failpoints.inject("g")
+    assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+
+
+def test_delay_action_sleeps():
+    failpoints.configure("d", "delay", delay_s=0.05, times=1)
+    t0 = time.perf_counter()
+    failpoints.inject("d")
+    assert time.perf_counter() - t0 >= 0.04
+    failpoints.inject("d")      # times exhausted: no further delay
+
+
+def test_active_context_manager_scopes_the_arm():
+    with failpoints.active("a", "drop", times=1) as fp:
+        with pytest.raises(failpoints.FailpointDrop):
+            failpoints.inject("a")
+        assert fp.fired == 1
+    failpoints.inject("a")      # disarmed on exit
+
+
+def test_retry_policy_backoff_deterministic_and_bounded():
+    import random
+    p = RetryPolicy(attempts=5, backoff_base_s=0.05, backoff_max_s=0.3,
+                    jitter=0.5, seed=3)
+    d1 = [p.delay_s(i, random.Random(3)) for i in range(6)]
+    d2 = [p.delay_s(i, random.Random(3)) for i in range(6)]
+    assert d1 == d2
+    for i, d in enumerate(d1):
+        base = min(0.3, 0.05 * 2 ** i)
+        assert base <= d <= base * 1.5
+
+
+# ---------------------------------------------------------------------------
+# forward client retry policy (against a real loopback gRPC server)
+# ---------------------------------------------------------------------------
+
+class _FlakyGlobal:
+    """V1-capable global whose SendMetrics fails the first `fail_first`
+    calls with `code`, then succeeds; records every imported name."""
+
+    def __init__(self, fail_first=0, code=grpc.StatusCode.UNAVAILABLE):
+        self.fail_first = fail_first
+        self.code = code
+        self.names = []
+        self.calls = 0
+        self._lock = threading.Lock()
+
+        def v1(request, context):
+            with self._lock:
+                self.calls += 1
+                mine = self.calls
+            if mine <= self.fail_first:
+                context.abort(self.code, "flaky")
+            with self._lock:
+                self.names.extend(m.name for m in request.metrics)
+            return empty_pb2.Empty()
+
+        h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+            "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                v1, request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+        self.server = grpc.server(cf.ThreadPoolExecutor(max_workers=8))
+        self.server.add_generic_rpc_handlers((h,))
+        self.port = self.server.add_insecure_port("127.0.0.1:0")
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(0)
+
+
+def _fms(n, prefix="r"):
+    return [sm.ForwardMetric(name=f"{prefix}{i}", tags=[], kind="counter",
+                             scope=MetricScope.GLOBAL_ONLY,
+                             counter_value=1) for i in range(n)]
+
+
+def test_forward_retry_recovers_transient_unavailable():
+    g = _FlakyGlobal(fail_first=2)
+    try:
+        client = ForwardClient(
+            f"127.0.0.1:{g.port}",
+            retry=RetryPolicy(attempts=3, backoff_base_s=0.01, seed=1))
+        client.send(_fms(10))
+        assert sorted(g.names) == sorted(f"r{i}" for i in range(10))
+        st = client.stats()
+        assert st["retries"] == 2 and st["dropped"] == 0
+        assert st["sent"] == 10
+        client.close()
+    finally:
+        g.stop()
+
+
+def test_forward_retry_exhaustion_accounts_dropped_and_raises():
+    g = _FlakyGlobal(fail_first=10**9)
+    try:
+        client = ForwardClient(
+            f"127.0.0.1:{g.port}",
+            retry=RetryPolicy(attempts=3, backoff_base_s=0.01, seed=1))
+        with pytest.raises(grpc.RpcError):
+            client.send(_fms(7))
+        st = client.stats()
+        assert st["retries"] == 2           # attempts-1
+        assert st["dropped"] == 7           # accounted, not silent
+        assert g.names == []
+        client.close()
+    finally:
+        g.stop()
+
+
+def test_forward_retry_resends_only_failed_chunks():
+    """Multi-chunk V1 flush where one later chunk fails once: the retry
+    re-sends exactly that chunk — every metric imported EXACTLY once."""
+    fail_on = [3]                 # the 3rd V1 RPC (a later chunk)
+    names = []
+    calls = [0]
+    lock = threading.Lock()
+
+    def v1(request, context):
+        with lock:
+            calls[0] += 1
+            mine = calls[0]
+        if mine in fail_on:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "one-shot flake")
+        with lock:
+            names.extend(m.name for m in request.metrics)
+        return empty_pb2.Empty()
+
+    h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+        "SendMetrics": grpc.unary_unary_rpc_method_handler(
+            v1, request_deserializer=forward_pb2.MetricList.FromString,
+            response_serializer=empty_pb2.Empty.SerializeToString)})
+    server = grpc.server(cf.ThreadPoolExecutor(max_workers=1))
+    server.add_generic_rpc_handlers((h,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        n = BATCH_MAX * 2 + 17    # 3 chunks
+        client = ForwardClient(
+            f"127.0.0.1:{port}", max_streams=1,
+            retry=RetryPolicy(attempts=3, backoff_base_s=0.01, seed=1))
+        client.send(_fms(n))
+        assert sorted(names) == sorted(f"r{i}" for i in range(n))  # no dup
+        st = client.stats()
+        assert st["retries"] == 1 and st["sent"] == n
+        client.close()
+    finally:
+        server.stop(0)
+
+
+def test_forward_send_failpoint_drop_is_retried():
+    g = _FlakyGlobal()
+    try:
+        client = ForwardClient(
+            f"127.0.0.1:{g.port}",
+            retry=RetryPolicy(attempts=3, backoff_base_s=0.01, seed=1))
+        with failpoints.active("forward.send", "drop", times=2) as fp:
+            client.send(_fms(5))
+        assert fp.fired == 2
+        assert sorted(g.names) == sorted(f"r{i}" for i in range(5))
+        assert client.stats()["retries"] == 2
+        client.close()
+    finally:
+        g.stop()
+
+
+def test_v2_mid_stream_break_is_not_blind_retried():
+    """The V2 import path applies messages incrementally, so a stream
+    that breaks after partial delivery must NOT be re-sent wholesale
+    (double-counted counters) — it is dropped and accounted instead
+    (review finding: only zero-messages-pulled V2 failures retry)."""
+    from veneur_tpu.forward.client import SEND_METRICS_V2  # noqa: F401
+
+    imported = []
+    calls = [0]
+    lock = threading.Lock()
+
+    def v1(request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "reference global")
+
+    def v2(request_iterator, context):
+        with lock:
+            calls[0] += 1
+        for i, pb in enumerate(request_iterator):
+            with lock:
+                imported.append(pb.name)
+            if i == 2:      # partial import, then a mid-stream reset
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              "mid-stream reset")
+        return empty_pb2.Empty()
+
+    h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+        "SendMetrics": grpc.unary_unary_rpc_method_handler(
+            v1, request_deserializer=forward_pb2.MetricList.FromString,
+            response_serializer=empty_pb2.Empty.SerializeToString),
+        "SendMetricsV2": grpc.stream_unary_rpc_method_handler(
+            v2, request_deserializer=metric_pb2.Metric.FromString,
+            response_serializer=empty_pb2.Empty.SerializeToString)})
+    server = grpc.server(cf.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((h,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        client = ForwardClient(
+            f"127.0.0.1:{port}",
+            retry=RetryPolicy(attempts=3, backoff_base_s=0.01, seed=1))
+        with pytest.raises(grpc.RpcError):
+            client.send(_fms(10))
+        # exactly ONE stream attempt: no blind re-send of a partially
+        # imported slice, so nothing is ever imported twice
+        assert calls[0] == 1
+        assert len(imported) == len(set(imported))
+        st = client.stats()
+        assert st["retries"] == 0
+        assert st["dropped"] == 10      # pessimistic but ACCOUNTED
+        client.close()
+    finally:
+        server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (proxy/destinations.py)
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_routes_around_and_half_open_restores():
+    # reserve a port that refuses connections (dial fails fast-ish)
+    import socket as socket_mod
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()               # nothing listening now
+    dead = f"127.0.0.1:{dead_port}"
+
+    live = _FlakyGlobal()       # a healthy V1 peer
+    live_addr = f"127.0.0.1:{live.port}"
+    dests = Destinations(send_buffer_size=64, dial_timeout_s=0.3,
+                         breaker_threshold=2, breaker_reset_s=0.4)
+    try:
+        # two failed dials trip the breaker
+        dests.add([dead, live_addr])
+        dests.add([dead])
+        bs = dests.breaker_stats()
+        assert bs[dead]["state"] == "open" and bs[dead]["failures"] == 2
+        assert dests.size() == 1          # the live peer is in the ring
+
+        # while open, offers are refused without dialing (instant)
+        t0 = time.perf_counter()
+        dests.add([dead])
+        assert time.perf_counter() - t0 < 0.05
+        assert dests.size() == 1
+        # keys route around via the ring: every key lands on the survivor
+        for i in range(10):
+            assert dests.get(f"k{i}").address == live_addr
+
+        # after the cooldown the next offer becomes the half-open probe;
+        # the peer is still dead, so the probe fails and RE-TRIPS with a
+        # doubled cooldown
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                dests.breaker_stats()[dead]["state"] != "probe_due":
+            time.sleep(0.05)
+        dests.add([dead])
+        bs = dests.breaker_stats()
+        assert bs[dead]["trips"] >= 2
+        assert bs[dead]["state"] == "open"
+        assert bs[dead]["retry_in_s"] > 0.4   # doubled vs the base 0.4
+
+        # a deliberate membership change (discovery) sheds breaker state
+        # for addresses leaving the wanted set; a healthy replacement
+        # joins cleanly
+        revived = _FlakyGlobal()
+        revived_addr = f"127.0.0.1:{revived.port}"
+        try:
+            dests.set_members([live_addr, revived_addr])
+            assert dests.size() == 2
+            assert dests.breaker_stats() == {}
+        finally:
+            revived.stop()
+    finally:
+        dests.clear()
+        live.stop()
+
+
+def test_breaker_counts_failures_across_successful_dials():
+    """A successful DIAL must not reset the consecutive-failure count —
+    a half-broken peer that accepts connections but kills every RPC
+    would otherwise flap connect/fail/reconnect forever without ever
+    tripping (review finding).  Only a post-trip half-open probe
+    success closes the breaker."""
+    d = Destinations(breaker_threshold=2, breaker_reset_s=0.2)
+    try:
+        d._record_failure("a:1")                  # life 1: died, 0 sent
+        d._record_success("a:1")                  # re-dial succeeded
+        assert d.breaker_stats()["a:1"]["failures"] == 1   # history kept
+        d._record_failure("a:1")                  # life 2: died again
+        assert d.breaker_stats()["a:1"]["state"] == "open"  # tripped
+        # after the cooldown, the half-open probe's success closes it
+        time.sleep(0.25)
+        assert d._admit("a:1")                    # the probe slot
+        d._record_success("a:1")
+        assert d.breaker_stats() == {}
+    finally:
+        d.clear()
+
+
+def test_breaker_half_open_probe_success_clears_state():
+    live = _FlakyGlobal()
+    addr = f"127.0.0.1:{live.port}"
+    live.stop()                 # dead at first dial
+    dests = Destinations(send_buffer_size=64, dial_timeout_s=0.3,
+                         breaker_threshold=1, breaker_reset_s=0.2)
+    try:
+        dests.add([addr])       # 1 failure >= threshold 1: trips
+        assert dests.breaker_stats()[addr]["state"] == "open"
+        dests.add([addr])       # still open: refused, no dial
+        assert dests.size() == 0
+        time.sleep(0.25)
+        # cooldown expired; bring the peer back on the SAME port and probe
+        revived = _FlakyGlobal()
+
+        def rebind(port):
+            h = grpc.method_handlers_generic_handler("forwardrpc.Forward", {
+                "SendMetrics": grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: empty_pb2.Empty(),
+                    request_deserializer=forward_pb2.MetricList.FromString,
+                    response_serializer=(
+                        empty_pb2.Empty.SerializeToString))})
+            s = grpc.server(cf.ThreadPoolExecutor(max_workers=2))
+            s.add_generic_rpc_handlers((h,))
+            if s.add_insecure_port(f"127.0.0.1:{port}") != port:
+                return None
+            s.start()
+            return s
+
+        revived.stop()
+        srv = rebind(live.port)
+        if srv is None:
+            pytest.skip("could not rebind the breaker port")
+        try:
+            dests.add([addr])   # the half-open probe
+            assert dests.size() == 1
+            assert addr not in dests.breaker_stats()   # closed + cleared
+        finally:
+            srv.stop(0)
+    finally:
+        dests.clear()
+
+
+# ---------------------------------------------------------------------------
+# /debug/vars visibility of forward retry/drop accounting
+# ---------------------------------------------------------------------------
+
+def test_forward_drop_counters_visible_at_debug_vars():
+    """A local whose global is gone: exhausted retries must surface in
+    /debug/vars -> forward.{retries,dropped} (ISSUE 5: dropped-forward
+    counters visible, never silent)."""
+    import socket as socket_mod
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.http_api import HttpApi
+
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    local = Server(config_mod.Config(
+        statsd_listen_addresses=["udp://127.0.0.1:0"],
+        forward_address=f"127.0.0.1:{dead_port}",
+        forward_timeout=1.0, forward_max_retries=1,
+        forward_retry_backoff=0.01,
+        interval=0.05, percentiles=[0.5], hostname="l"))
+    local.start()
+    api = HttpApi(local, "127.0.0.1:0")
+    api.start()
+    try:
+        _, addr = local.statsd_addrs[0]
+        tx = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+        tx.sendto(b"dv.c:3|c|#veneurglobalonly", addr)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            local._drain_native()
+            if local.aggregator.processed >= 1:
+                break
+            time.sleep(0.02)
+        local.flush()
+        tx.close()
+        deadline = time.time() + 15
+        dropped = 0
+        while time.time() < deadline and not dropped:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{api.address[1]}/debug/vars",
+                timeout=5).read())
+            dropped = body.get("forward", {}).get("dropped", 0)
+            time.sleep(0.05)
+        assert dropped > 0
+        assert body["forward"]["retries"] > 0
+        assert "forward_slots_dropped" in body
+    finally:
+        api.stop()
+        local.shutdown()

@@ -334,6 +334,117 @@ def test_proxy_http_gated_endpoints_off_by_default():
         proxy.stop()
 
 
+def test_destination_death_reroutes_to_survivor_no_double_landing():
+    """ISSUE 5 satellite: destination death via failpoint stream-reset ->
+    the victim leaves the ring (breaker open), every key reroutes to a
+    surviving global, NO key lands on two globals within a ring epoch,
+    /healthcheck stays 200 at one destination (and 503 only at zero),
+    and the victim's in-flight loss is accounted, not silent."""
+    import queue
+
+    from veneur_tpu import failpoints
+
+    g1, s1 = boot_global()
+    g2, s2 = boot_global()
+    a1 = f"127.0.0.1:{g1.grpc_import.port}"
+    a2 = f"127.0.0.1:{g2.grpc_import.port}"
+    proxy = Proxy(ProxyConfig(
+        static_destinations=[a1, a2],
+        discovery_interval=3600,          # drive discovery manually
+        breaker_failure_threshold=1,      # one reset trips
+        breaker_reset_timeout=0.3))
+    proxy.start()
+
+    def drain(srv, sink, prefix):
+        got = set()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            srv.flush()
+            try:
+                for m in sink.queue.get(timeout=0.1):
+                    if m.name.startswith(prefix):
+                        got.add(m.name)
+            except queue.Empty:
+                break
+        while not sink.queue.empty():
+            for m in sink.queue.get():
+                if m.name.startswith(prefix):
+                    got.add(m.name)
+        return got
+
+    def send_keys(prefix, n=40):
+        for i in range(n):
+            proxy.handle_metric(convert.to_pb(
+                fm_counter(f"{prefix}{i}", 1)))
+
+    try:
+        # phase 1: both globals serve
+        send_keys("rr1.")
+        deadline = time.time() + 10
+        while time.time() < deadline and proxy.stats["routed"] < 40:
+            time.sleep(0.05)
+        time.sleep(0.3)          # destination queues drain
+        seen1a, seen1b = drain(g1, s1, "rr1."), drain(g2, s2, "rr1.")
+        assert len(seen1a | seen1b) == 40
+        assert not (seen1a & seen1b)
+        assert seen1a and seen1b
+
+        # destination death: the next batch RPC on one destination is
+        # reset mid-fleet
+        failpoints.configure("proxy.send_batch", "stream-reset", times=1)
+        try:
+            deadline = time.time() + 10
+            m = convert.to_pb(fm_counter("rr.sacrifice", 1))
+            while time.time() < deadline and proxy.destinations.size() > 1:
+                proxy.handle_metric(m)
+                time.sleep(0.05)
+        finally:
+            failpoints.disarm("proxy.send_batch")
+        assert proxy.destinations.size() == 1
+        survivor = next(iter(proxy.destinations.stats()))
+        victim = a1 if survivor == a2 else a2
+        bs = proxy.destinations.breaker_stats()
+        assert bs[victim]["state"] in ("open", "probe_due")
+        # the victim's death dropped at least the reset batch — visible
+        # in totals() once the retire thread folds the drained counts in
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                proxy.destinations.totals()["dropped"] < 1:
+            time.sleep(0.05)
+        assert proxy.destinations.totals()["dropped"] >= 1
+
+        # healthcheck: 200 with one destination left
+        url = f"http://127.0.0.1:{proxy.http_port}/healthcheck"
+        assert urllib.request.urlopen(url).status == 200
+
+        # phase 2: rebuilt ring — every key lands on the SURVIVOR only
+        sent_before = proxy.destinations.totals()["sent"]
+        send_keys("rr2.")
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                proxy.destinations.totals()["sent"] < sent_before + 40:
+            time.sleep(0.05)
+        time.sleep(0.3)
+        vic_srv, vic_sink = (g1, s1) if victim == a1 else (g2, s2)
+        sur_srv, sur_sink = (g2, s2) if victim == a1 else (g1, s1)
+        assert len(drain(sur_srv, sur_sink, "rr2.")) == 40
+        assert not drain(vic_srv, vic_sink, "rr2.")
+        assert proxy.stats["no_destination"] == 0
+
+        # half-open restore: after the cooldown the discovery poll
+        # re-dials the (healthy) victim and the ring grows back
+        deadline = time.time() + 10
+        while time.time() < deadline and proxy.destinations.size() < 2:
+            proxy.handle_discovery()
+            time.sleep(0.1)
+        assert proxy.destinations.size() == 2
+        assert proxy.destinations.breaker_stats() == {}
+    finally:
+        proxy.stop()
+        g1.shutdown()
+        g2.shutdown()
+
+
 def test_native_wire_router_matches_python_routing():
     """vn_route must route every metric of a serialized MetricList to
     the same destination the python routing_key + consistent ring pick,

@@ -1,0 +1,128 @@
+"""3-tier cluster testbed (ISSUE 5 / ROADMAP #3): the non-slow smoke
+boots local -> proxy -> meshed-global in one process tree and asserts
+exact counter/set conservation plus percentile error within the
+committed t-digest envelope across the forward/import edge; the slow
+chaos matrix proves every failpoint arm either conserves totals after
+retry or surfaces the loss in the drop accounting — no silent loss."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from veneur_tpu import failpoints
+from veneur_tpu.testbed import (CHAOS_ARMS, PROMISED_KEYS, run_chaos_arm,
+                                run_dryrun)
+from veneur_tpu.testbed import verify
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def test_three_tier_smoke_conservation_and_envelope():
+    """The tier-1 smoke: 1 local x 1 proxy x 1 MESHED global (2 virtual
+    devices), 2 intervals, CPU.  End-to-end at the global sinks:
+    counters and sets conserved exactly, percentiles within the
+    committed accuracy envelope, every key on exactly one global."""
+    report = run_dryrun(n_locals=1, n_globals=1, intervals=2, seed=11,
+                        mesh_devices=2, counter_keys=6, histo_keys=3,
+                        set_keys=2, histo_samples=150)
+    assert report["ok"], report
+    cons = report["conservation"]
+    assert cons["counters_exact"] and cons["counter_deficit"] == 0.0
+    assert cons["sets_exact"] and cons["sets_checked"] == 4
+    assert report["routing_exclusive"]
+    for q, rec in report["quantile_errors"].items():
+        assert rec["within"], (q, rec)
+        assert rec["checked"] == 6          # 3 histo keys x 2 intervals
+        assert rec["max_span_err"] <= rec["envelope"]
+    # nothing lost, nothing silently retried away
+    assert report["dropped"] == 0
+    assert report["imported"] > 0 and report["forwarded"] > 0
+    # promised report shape (CI tooling keys off these)
+    assert set(PROMISED_KEYS) <= set(report)
+
+
+def test_dryrun_report_promised_keys_multi_node():
+    """2 locals x 2 globals: the fan-in/fan-out shape, plus the promised
+    JSON keys the bench/CI tooling relies on."""
+    report = run_dryrun(n_locals=2, n_globals=2, intervals=2, seed=3,
+                        counter_keys=6, histo_keys=2, set_keys=1,
+                        histo_samples=80)
+    missing = [k for k in PROMISED_KEYS if k not in report]
+    assert not missing, missing
+    assert report["ok"], report
+    assert report["per_tier"]["local_flushes"] >= 4
+    assert report["per_tier"]["global_flushes"] >= 4
+    assert report["per_tier"]["proxy_routed"] > 0
+    # JSON-serializable end to end (the script's contract)
+    json.dumps(report)
+
+
+def test_dryrun_script_cli_emits_promised_json(tmp_path):
+    """scripts/dryrun_3tier.py is the one-command entry point: its JSON
+    report carries the promised keys and exits 0 on a clean run."""
+    spec = importlib.util.spec_from_file_location(
+        "dryrun_3tier", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "dryrun_3tier.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = tmp_path / "report.json"
+    rc = mod.main(["--intervals", "1", "--counter-keys", "4",
+                   "--histo-keys", "1", "--set-keys", "1",
+                   "--histo-samples", "50", "--seed", "5",
+                   "--out", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert set(PROMISED_KEYS) <= set(report)
+    assert report["ok"]
+
+
+def test_envelope_loads_and_is_sane():
+    env = verify.load_envelope()
+    assert set(env) >= {0.5, 0.9, 0.99}
+    for q, e in env.items():
+        assert 0.0 <= e < 0.25, (q, e)
+    # widened + floored per-quantile allowance
+    assert verify.envelope_for(0.5, env) >= verify.ENVELOPE_FLOOR
+
+
+def test_chaos_single_arm_retry_conserves():
+    """One non-slow matrix cell: transient forward unavailability inside
+    the retry budget conserves exactly (the fastest arm)."""
+    row = run_chaos_arm(CHAOS_ARMS[0], seed=2, intervals=2)
+    assert row["arm"] == "forward-unavailable"
+    assert row["fired"] > 0 and row["forward_retries"] > 0
+    assert row["conserved"] and row["counter_deficit"] == 0.0
+    assert row["ok"], row
+
+
+@pytest.mark.slow
+def test_chaos_matrix_no_silent_loss():
+    """The full matrix: every failpoint x edge arm either conserves
+    totals after retry/reroute, or its deficit is matched by visible
+    drop accounting.  No arm may lose data silently."""
+    rows = [run_chaos_arm(arm, seed=4, intervals=2)
+            for arm in CHAOS_ARMS]
+    failed = [r for r in rows if not r["ok"]]
+    assert not failed, failed
+    for r in rows:
+        assert r["fired"] > 0, r                  # the fault happened
+        assert r["routing_exclusive"], r
+        if r["expect"] == "conserved":
+            assert r["conserved"] and r["counter_deficit"] == 0.0, r
+        else:
+            # loss is allowed but must be accounted
+            assert r["no_silent_loss"], r
+            if not r["conserved"]:
+                assert r["dropped_total"] > 0, r
+    # the matrix exercises both verdict classes
+    assert any(r["expect"] == "accounted" and not r["conserved"]
+               for r in rows)
+    assert any(r["expect"] == "conserved" for r in rows)

@@ -85,6 +85,12 @@ class Config:
     # parallel SendMetricsV2 streams per forward flush for big batches
     # (a single python-grpc client stream caps at ~20k msgs/s)
     forward_streams: int = 8
+    # bounded forward retries (forward/client.py RetryPolicy): retries
+    # BEYOND the first attempt, with exponential backoff + jitter from
+    # forward_retry_backoff; exhausted retries are accounted in
+    # forward.dropped_total / /debug/vars, never silent
+    forward_max_retries: int = 2
+    forward_retry_backoff: float = 0.05   # base backoff ("50ms", doubles)
     stats_address: str = ""         # self-metrics statsd target
 
     # aggregation
@@ -249,6 +255,10 @@ class Config:
             self.interval = 10.0
         if self.forward_timeout < 0:
             self.forward_timeout = 0.0
+        if self.forward_max_retries < 0:
+            self.forward_max_retries = 0
+        if self.forward_retry_backoff < 0:
+            self.forward_retry_backoff = 0.0
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
         if self.read_buffer_size_bytes <= 0:
@@ -281,7 +291,8 @@ class Config:
 
 _LIST_FIELDS_OF_FLOAT = {"percentiles"}
 # fields accepting Go-style duration strings ("10s", "500ms")
-_DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval"}
+_DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval",
+                    "forward_retry_backoff"}
 
 
 def _coerce(key: str, value: Any) -> Any:

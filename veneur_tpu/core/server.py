@@ -310,6 +310,9 @@ class Server:
         self._forward_slots = threading.BoundedSemaphore(
             self.FORWARD_MAX_IN_FLIGHT)
         self.forward_dropped = 0
+        # last-reported forward-client (retries, dropped) totals, for
+        # per-interval forward.retries_total/forward.dropped_total deltas
+        self._forward_client_reported = (0, 0)
         # accepted stream connections, closed on shutdown so reader
         # threads blocked in recv are unblocked
         self._stream_conns: set = set()
@@ -419,19 +422,24 @@ class Server:
             self.grpc_import.start()
         if self.config.forward_address and self.forwarder is None:
             # local tier: persistent forward connection (server.go:810-828)
-            from veneur_tpu.forward.client import ForwardClient
+            from veneur_tpu.forward.client import ForwardClient, RetryPolicy
             # The reference bounds each forward by one flush interval
             # (flusher.go:516-591).  Here at most FORWARD_MAX_IN_FLIGHT
             # forwards run concurrently (later flushes drop theirs once the
             # semaphore is exhausted — see flush()), so the deadline can be
             # floored at the reference's default interval without unbounded
             # pileup; sub-second test intervals would otherwise starve a
-            # cold-start peer mid-stream.
+            # cold-start peer mid-stream.  Transient failures retry under
+            # the config-driven bounded policy (exhaustion is accounted in
+            # forward.dropped_total / /debug/vars).
             self.forwarder = ForwardClient(
                 self.config.forward_address,
                 timeout_s=self.config.forward_timeout
                 or max(self.config.interval, 10.0),
-                max_streams=self.config.forward_streams)
+                max_streams=self.config.forward_streams,
+                retry=RetryPolicy(
+                    attempts=self.config.forward_max_retries + 1,
+                    backoff_base_s=self.config.forward_retry_backoff))
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")
@@ -989,9 +997,11 @@ class Server:
             self._flush_locked()
 
     def _flush_locked(self) -> None:
+        from veneur_tpu import failpoints
         from veneur_tpu import scopedstatsd
         from veneur_tpu import ssf as ssf_mod
 
+        failpoints.inject("server.flush")
         self.last_flush_unix = time.time()
         statsd = scopedstatsd.ensure(self.statsd)
         span = self.trace_client.span(
@@ -1137,6 +1147,18 @@ class Server:
             statsd.timing("flush.compile_duration_ms",
                           (cs - self._compiles_reported[1]) * 1e3)
             self._compiles_reported = (ce, cs)
+        # forward retry/drop accounting from the client's bounded retry
+        # policy (forward/client.py): interval deltas, so dashboards see
+        # retry storms and exhausted-retry drops as they happen
+        fw = self.forwarder
+        if fw is not None and hasattr(fw, "stats"):
+            st = fw.stats()
+            pr, pd = self._forward_client_reported
+            if st["retries"] > pr:
+                statsd.count("forward.retries_total", st["retries"] - pr)
+            if st["dropped"] > pd:
+                statsd.count("forward.dropped_total", st["dropped"] - pd)
+            self._forward_client_reported = (st["retries"], st["dropped"])
         statsd.count("spans.received_total", self.ssf_received)
         self.ssf_received = 0
         # per-span-sink ingest accounting (worker.go:603-678)

@@ -1,0 +1,193 @@
+"""Failpoint registry: deterministic fault injection for the testbed.
+
+The in-process analog of the freebsd/etcd `failpoint` facility
+(SNIPPETS-adjacent idiom; gofail's `// gofail:` markers): production code
+calls `failpoints.inject("forward.send")` at the few seams where a
+distributed deployment actually fails — the forward edge, the proxy's
+per-destination sends, connect/dial, the flush path — and the call is a
+single module-global boolean check unless a test/chaos harness has armed
+that name.  Armed failpoints execute one of four actions with SEEDED
+determinism, so a chaos arm replays bit-identically:
+
+  drop          raise FailpointDrop (the request vanishes before the wire;
+                call sites treat it as a retryable transport loss)
+  delay         sleep `delay_s`, then proceed normally
+  grpc-error    raise FailpointRpcError(code) — a real grpc.RpcError
+                subclass, so existing `except grpc.RpcError` handling and
+                status-code triage see it exactly like a peer's failure
+  stream-reset  grpc-error with code UNAVAILABLE and reset details (the
+                shape of a mid-stream RST / GOAWAY)
+
+Arming is scoped: `configure()` returns the Failpoint (counters included),
+`clear()` disarms everything, and `active()` is a context manager for
+tests.  Disabled cost: one global bool read per inject() call.
+
+Injection sites threaded through this repo (grep `failpoints.inject`):
+
+  forward.send        per forward attempt      (forward/client.py)
+  forward.v2_stream   per V2 fan-out stream    (forward/client.py)
+  proxy.connect       Destination dial         (proxy/connect.py)
+  proxy.send_batch    per V1 chunk RPC         (proxy/connect.py)
+  proxy.stream        V2 sender stream         (proxy/connect.py)
+  destinations.add    Destinations._connect    (proxy/destinations.py)
+  server.flush        top of the flush path    (core/server.py)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+_ENABLED = False          # fast-path gate: read without a lock
+_registry: dict[str, "Failpoint"] = {}
+_lock = threading.Lock()
+
+ACTIONS = ("drop", "delay", "grpc-error", "stream-reset")
+
+
+class FailpointDrop(Exception):
+    """The injected request vanished before reaching the wire (packet-loss
+    shape).  Nothing was delivered: safe to retry."""
+
+    def __init__(self, name: str):
+        super().__init__(f"failpoint {name!r}: dropped")
+        self.failpoint = name
+
+
+class FailpointRpcError(grpc.RpcError):
+    """An injected RPC failure carrying a real grpc StatusCode, so call
+    sites' `except grpc.RpcError` + `.code()` triage is exercised
+    verbatim."""
+
+    def __init__(self, name: str, code: grpc.StatusCode,
+                 details: str = ""):
+        super().__init__()
+        self.failpoint = name
+        self._code = code
+        self._details = details or f"failpoint {name!r}: injected {code}"
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def __str__(self) -> str:
+        return f"<FailpointRpcError {self._code} {self._details!r}>"
+
+
+class Failpoint:
+    """One armed failpoint.  Counters are cumulative for the arm's
+    lifetime; `evaluated` counts inject() passes through this name,
+    `fired` counts the times the action actually executed."""
+
+    def __init__(self, name: str, action: str, *,
+                 code: str = "UNAVAILABLE", delay_s: float = 0.0,
+                 prob: float = 1.0, times: Optional[int] = None,
+                 after: int = 0, seed: int = 0):
+        if action not in ACTIONS:
+            raise ValueError(f"unknown failpoint action {action!r} "
+                             f"(want one of {ACTIONS})")
+        self.name = name
+        self.action = action
+        self.code = getattr(grpc.StatusCode, code)
+        self.delay_s = float(delay_s)
+        self.prob = float(prob)
+        self.times = times          # None = unlimited
+        self.after = int(after)     # skip the first `after` evaluations
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._flock = threading.Lock()
+        self.evaluated = 0
+        self.fired = 0
+
+    def _should_fire(self) -> bool:
+        with self._flock:
+            self.evaluated += 1
+            if self.evaluated <= self.after:
+                return False
+            if self.times is not None and self.fired >= self.times:
+                return False
+            if self.prob < 1.0 and self._rng.random() >= self.prob:
+                return False
+            self.fired += 1
+            return True
+
+    def evaluate(self) -> None:
+        if not self._should_fire():
+            return
+        if self.action == "delay":
+            time.sleep(self.delay_s)
+            return
+        if self.action == "drop":
+            raise FailpointDrop(self.name)
+        if self.action == "stream-reset":
+            raise FailpointRpcError(
+                self.name, grpc.StatusCode.UNAVAILABLE,
+                f"failpoint {self.name!r}: stream reset")
+        raise FailpointRpcError(self.name, self.code)
+
+    def snapshot(self) -> dict:
+        with self._flock:
+            return {"action": self.action, "evaluated": self.evaluated,
+                    "fired": self.fired,
+                    "times": self.times, "prob": self.prob}
+
+
+def inject(name: str) -> None:
+    """The production-code hook.  A single global bool read when nothing
+    is armed; otherwise evaluates the named failpoint (missing names are
+    still no-ops, so sites can be added freely)."""
+    if not _ENABLED:
+        return
+    fp = _registry.get(name)
+    if fp is not None:
+        fp.evaluate()
+
+
+def configure(name: str, action: str, **kwargs) -> Failpoint:
+    """Arm `name` with `action` (see ACTIONS); returns the Failpoint so
+    callers can read its counters.  Re-configuring a name replaces it."""
+    global _ENABLED
+    fp = Failpoint(name, action, **kwargs)
+    with _lock:
+        _registry[name] = fp
+        _ENABLED = True
+    return fp
+
+
+def disarm(name: str) -> None:
+    global _ENABLED
+    with _lock:
+        _registry.pop(name, None)
+        if not _registry:
+            _ENABLED = False
+
+
+def clear() -> None:
+    """Disarm everything (test teardown)."""
+    global _ENABLED
+    with _lock:
+        _registry.clear()
+        _ENABLED = False
+
+
+def stats() -> dict[str, dict]:
+    with _lock:
+        return {n: fp.snapshot() for n, fp in _registry.items()}
+
+
+@contextlib.contextmanager
+def active(name: str, action: str, **kwargs):
+    """`with failpoints.active("forward.send", "drop", times=2) as fp:`
+    — arms for the block, disarms on exit (other armed names are kept)."""
+    fp = configure(name, action, **kwargs)
+    try:
+        yield fp
+    finally:
+        disarm(name)

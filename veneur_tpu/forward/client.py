@@ -6,17 +6,34 @@ flush one `SendMetricsV2` client stream carrying each metric
 (forwardrpc/forward.proto:12).  The service methods are invoked through
 explicit method paths + serializers, which is wire-identical to generated
 stubs.
+
+Retry policy: the reference's loss model is UDP-heritage — a failed
+forward drops the interval.  Here each flush's send runs under a bounded
+RetryPolicy (exponential backoff + seeded jitter) that retries only what
+is provably undelivered: V1 batches are chunked unary RPCs, so failed
+chunks are known exactly and only they are re-sent; a V2 stream retries
+only when grpc pulled ZERO messages from its request iterator before
+the failure (nothing can have reached the peer) — any later break may
+have partially imported and is dropped rather than risk double-counting
+counters.  Exhausted retries are accounted in
+`dropped` (surfaced at /debug/vars and as forward.dropped_total), never
+silently logged.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
 import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
 from typing import Optional
 
 import grpc
 from google.protobuf import empty_pb2
 
+from veneur_tpu import failpoints
 from veneur_tpu.forward import convert
 from veneur_tpu.protocol import forward_pb2, metric_pb2
 from veneur_tpu.samplers import samplers as sm
@@ -38,19 +55,76 @@ SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
 STREAM_CHUNK = 2048
 BATCH_MAX = 2000
 
+# Status codes where gRPC guarantees (UNAVAILABLE: the RPC never left
+# the client / the connection refused) or strongly implies
+# (RESOURCE_EXHAUSTED, ABORTED: the peer rejected before applying) that
+# nothing was imported — safe to re-send without double-counting.
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+    grpc.StatusCode.ABORTED,
+})
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded exponential backoff with seeded jitter.
+
+    attempts counts TOTAL tries (1 = no retry).  Sleep before retry k
+    (k=1..) is min(backoff_max_s, backoff_base_s * 2**(k-1)) * (1 +
+    jitter * U[0,1)) with U drawn from a Random(seed) stream, so a
+    seeded chaos run replays the same schedule."""
+    attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    jitter: float = 0.5
+    seed: Optional[int] = None
+
+    def delay_s(self, retry_idx: int, rng: random.Random) -> float:
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * (2 ** retry_idx))
+        return base * (1.0 + self.jitter * rng.random())
+
 
 class _V1Unsupported(Exception):
     """The first V1 batch answered UNIMPLEMENTED before anything was
     imported: safe to fall back to V2 for the same metrics."""
 
 
+class _SendFailure(Exception):
+    """An attempt failed with `undelivered` protobuf metrics known (or
+    pessimistically assumed) not to have been imported.  `retry_safe`
+    means re-sending them cannot double-count."""
+
+    def __init__(self, undelivered: list, cause: BaseException,
+                 retry_safe: bool):
+        super().__init__(str(cause))
+        self.undelivered = undelivered
+        self.cause = cause
+        self.retry_safe = retry_safe
+
+
+def _retry_safe(exc: BaseException) -> bool:
+    if isinstance(exc, failpoints.FailpointDrop):
+        return True
+    if isinstance(exc, grpc.RpcError):
+        try:
+            return exc.code() in RETRYABLE_CODES
+        except Exception:   # noqa: BLE001 - code() can fail on odd errors
+            return False
+    return False
+
+
 class ForwardClient:
     def __init__(self, address: str,
                  credentials: Optional[grpc.ChannelCredentials] = None,
-                 timeout_s: float = 10.0, max_streams: int = 8):
+                 timeout_s: float = 10.0, max_streams: int = 8,
+                 retry: Optional[RetryPolicy] = None):
         self.address = address
         self.timeout_s = timeout_s
         self.max_streams = max(1, max_streams)
+        self.retry = retry or RetryPolicy()
+        self._retry_rng = random.Random(self.retry.seed)
         if credentials is not None:
             self.channel = grpc.secure_channel(address, credentials)
         else:
@@ -67,18 +141,68 @@ class ForwardClient:
             max_workers=self.max_streams,
             thread_name_prefix=f"fwd-{address}")
         self._use_v1: Optional[bool] = None   # None = not yet probed
+        # diagnostics counters (surfaced at /debug/vars -> "forward" and
+        # as forward.retries_total / forward.dropped_total self-metrics)
+        self._stats_lock = threading.Lock()
+        self.sent = 0        # metrics delivered (per-chunk accounting)
+        self.retries = 0     # retry attempts taken
+        self.dropped = 0     # metrics given up on after exhausted retries
 
     def __call__(self, metrics: list[sm.ForwardMetric]) -> None:
         self.send(metrics)
+
+    def stats(self) -> dict[str, int]:
+        with self._stats_lock:
+            return {"sent": self.sent, "retries": self.retries,
+                    "dropped": self.dropped}
+
+    def _count(self, field: str, n: int) -> None:
+        with self._stats_lock:
+            setattr(self, field, getattr(self, field) + n)
 
     def send(self, metrics: list[sm.ForwardMetric]) -> None:
         """One flush's forward: batched V1 against this framework's
         globals, the reference's V2 stream protocol otherwise
         (flusher.go:578-591 semantics — every metric is Sent exactly
-        once per flush)."""
+        once per flush), under the bounded RetryPolicy."""
         if not metrics:
             return
-        pbs = [convert.to_pb(fm) for fm in metrics]
+        self.send_pbs([convert.to_pb(fm) for fm in metrics])
+
+    def send_pbs(self, pbs: list) -> None:
+        remaining = pbs
+        retry_idx = 0
+        while True:
+            try:
+                self._send_attempt(remaining)
+                return
+            except _SendFailure as f:
+                remaining = f.undelivered
+                if (not f.retry_safe
+                        or retry_idx >= self.retry.attempts - 1):
+                    self._count("dropped", len(remaining))
+                    logger.warning(
+                        "forward to %s: dropping %d metrics after %d "
+                        "attempt(s) (%s%s)", self.address, len(remaining),
+                        retry_idx + 1, f.cause,
+                        "" if f.retry_safe else "; not retry-safe")
+                    raise f.cause
+                self._count("retries", 1)
+                delay = self.retry.delay_s(retry_idx, self._retry_rng)
+                logger.info(
+                    "forward to %s: attempt %d failed (%s); retrying %d "
+                    "metrics in %.0f ms", self.address, retry_idx + 1,
+                    f.cause, len(remaining), delay * 1e3)
+                time.sleep(delay)
+                retry_idx += 1
+
+    def _send_attempt(self, pbs: list) -> None:
+        """One try at delivering `pbs`; raises _SendFailure carrying
+        exactly what is still undelivered."""
+        try:
+            failpoints.inject("forward.send")
+        except (failpoints.FailpointDrop, grpc.RpcError) as e:
+            raise _SendFailure(pbs, e, _retry_safe(e)) from e
         if self._use_v1 is not False:
             try:
                 self._send_v1_batches(pbs)
@@ -100,23 +224,66 @@ class ForwardClient:
     def _send_v2_fanout(self, pbs: list) -> None:
         """V2 streams, fanned out in parallel for big payloads — one
         python-grpc client stream tops out around ~20k msgs/s, so large
-        flushes split round-robin across max_streams."""
+        flushes split round-robin across max_streams.
+
+        Retry safety is PESSIMISTIC here: the import server applies V2
+        messages incrementally as the stream flows, so a break after the
+        first message may have partially imported the slice — blind
+        re-send would double-count counters.  Each stream's request
+        iterator therefore tracks how many messages grpc has PULLED;
+        only a failure with zero pulled (connection never got a message
+        to carry — e.g. refused at dial, or an injected pre-send fault)
+        is retry-safe.  Anything later is dropped and ACCOUNTED instead
+        (the V1 batch path, which is chunk-atomic, carries the
+        fleet-internal retry story)."""
         n_streams = min(self.max_streams,
                         max(1, len(pbs) // STREAM_CHUNK))
+
+        class _Stream:
+            __slots__ = ("pulled",)
+
+            def __init__(self):
+                self.pulled = 0
+
+            def run(self, client: "ForwardClient",
+                    slice_pbs: list) -> None:
+                failpoints.inject("forward.v2_stream")
+
+                def it():
+                    for pb in slice_pbs:
+                        self.pulled += 1
+                        yield pb
+                client._v2(it(), timeout=client.timeout_s)
+
+        def stream_safe(st: _Stream, e: BaseException) -> bool:
+            return st.pulled == 0 and _retry_safe(e)
+
         if n_streams == 1:
-            self._v2(iter(pbs), timeout=self.timeout_s)
+            st = _Stream()
+            try:
+                st.run(self, pbs)
+            except (grpc.RpcError, failpoints.FailpointDrop) as e:
+                raise _SendFailure(pbs, e, stream_safe(st, e)) from e
+            self._count("sent", len(pbs))
         else:
-            futs = [self._pool.submit(self._v2, iter(pbs[i::n_streams]),
-                                      timeout=self.timeout_s)
-                    for i in range(n_streams)]
+            slices = [pbs[i::n_streams] for i in range(n_streams)]
+            streams = [_Stream() for _ in slices]
+            futs = [self._pool.submit(st.run, self, s)
+                    for st, s in zip(streams, slices)]
+            undelivered: list = []
             errs = []
-            for f in futs:
+            safe = True
+            for st, s, f in zip(streams, slices, futs):
                 try:
                     f.result()
+                    self._count("sent", len(s))
                 except Exception as e:   # noqa: BLE001 - re-raised below
+                    undelivered.extend(s)
                     errs.append(e)
+                    safe = safe and stream_safe(st, e)
             if errs:
-                raise errs[0]
+                raise _SendFailure(undelivered, errs[0],
+                                   safe) from errs[0]
         logger.debug("forwarded %d metrics to %s over %d streams",
                      len(pbs), self.address, n_streams)
 
@@ -128,7 +295,9 @@ class ForwardClient:
         version load balancer routing chunks to a reference backend)
         re-sends exactly those chunks over V2 — chunk boundaries are
         known, so nothing double-sends — and flips _use_v1 off so the
-        next flush avoids the mixed path entirely."""
+        next flush avoids the mixed path entirely.  Any other chunk
+        failure surfaces as _SendFailure carrying exactly the failed
+        chunks' metrics, so the retry loop re-sends only those."""
         chunks = [pbs[i:i + BATCH_MAX]
                   for i in range(0, len(pbs), BATCH_MAX)]
         try:
@@ -137,44 +306,61 @@ class ForwardClient:
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                 raise _V1Unsupported() from e
-            raise
+            # nothing delivered yet: every chunk is undelivered
+            raise _SendFailure(pbs, e, _retry_safe(e)) from e
+        self._count("sent", len(chunks[0]))
         if len(chunks) == 1:
             return
-        futs = [(c, self._pool.submit(
-            self._v1, forward_pb2.MetricList(metrics=c),
-            timeout=self.timeout_s)) for c in chunks[1:]]
+        futs = [(c, self._pool.submit(self._send_v1_chunk, c))
+                for c in chunks[1:]]
         errs = []
+        undelivered: list = []
         v2_retry: list = []
-        n_failed_chunks = 0
+        n_unimpl_chunks = 0
         for c, f in futs:
             try:
                 f.result()
+                self._count("sent", len(c))
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                     v2_retry.extend(c)
-                    n_failed_chunks += 1
+                    n_unimpl_chunks += 1
                 else:
                     errs.append(e)
+                    undelivered.extend(c)
             except Exception as e:       # noqa: BLE001 - re-raised below
                 errs.append(e)
+                undelivered.extend(c)
         if v2_retry:
             logger.info(
                 "global %s answered UNIMPLEMENTED on %d later V1 "
                 "chunk(s); re-sending those over V2 and disabling V1",
-                self.address, n_failed_chunks)
+                self.address, n_unimpl_chunks)
             self._use_v1 = False
             try:
                 self._send_v2_fanout(v2_retry)
-            except Exception as e:       # noqa: BLE001 - merged below
-                # surface the V1 errors too before this propagates: the
-                # operator needs both to diagnose a mixed-backend flush
+            except _SendFailure as f:
+                # fold the V2-undelivered remainder into this attempt's
+                # failure so the OUTER bounded retry loop re-sends it —
+                # the old behavior was a single unbounded shot that
+                # logged the V1 errors and gave up
                 for prior in errs:
                     logger.warning(
-                        "V1 chunk to %s also failed (masked by V2 "
-                        "retry error): %s", self.address, prior)
-                raise e
+                        "V1 chunk to %s also failed (alongside the V2 "
+                        "retry failure): %s", self.address, prior)
+                undelivered.extend(f.undelivered)
+                raise _SendFailure(
+                    undelivered, f.cause,
+                    f.retry_safe and all(_retry_safe(e) for e in errs)
+                ) from f.cause
         if errs:
-            raise errs[0]
+            raise _SendFailure(
+                undelivered, errs[0],
+                all(_retry_safe(e) for e in errs)) from errs[0]
+
+    def _send_v1_chunk(self, chunk: list) -> None:
+        self._v1(forward_pb2.MetricList(metrics=chunk),
+                 timeout=self.timeout_s)
 
     def send_v1(self, metrics: list[sm.ForwardMetric]) -> None:
         """Batch API; the reference global leaves this unimplemented

@@ -116,7 +116,15 @@ def make_handler(server) -> type:
                     "metric_sinks": [s.name() for _, s in
                                      server.metric_sinks],
                     "threads": threading.active_count(),
+                    # metrics dropped because every forward slot was
+                    # stalled (bounded-buffering loss, core/server.py)
+                    "forward_slots_dropped": server.forward_dropped,
                 }
+                fw = getattr(server, "forwarder", None)
+                if fw is not None and hasattr(fw, "stats"):
+                    # the forward client's retry-policy accounting:
+                    # sent / retries / dropped metric totals
+                    stats["forward"] = fw.stats()
                 native = getattr(server, "native", None)
                 if native is not None:
                     ni = native.stats()  # None while tearing down

@@ -33,6 +33,7 @@ from typing import Callable, Optional
 import grpc
 from google.protobuf import empty_pb2
 
+from veneur_tpu import failpoints
 from veneur_tpu.forward.client import (BATCH_MAX, SEND_METRICS,
                                        SEND_METRICS_V2)
 from veneur_tpu.protocol import forward_pb2, metric_pb2
@@ -63,8 +64,13 @@ class _Raw:
 class Destination:
     def __init__(self, address: str, send_buffer_size: int = 1024,
                  on_closed: Optional[Callable[["Destination"], None]] = None,
-                 dial_timeout_s: float = 5.0, n_streams: int = 8):
+                 dial_timeout_s: float = 5.0, n_streams: int = 8,
+                 send_timeout_s: float = 30.0):
+        failpoints.inject("proxy.connect")
         self.address = address
+        # per-RPC send deadline (config: proxy_send_timeout) — was a
+        # hard-coded 30.0 in _send_batch/_send_raw_item
+        self.send_timeout_s = send_timeout_s
         self.closed = threading.Event()
         self._closing = threading.Event()     # graceful close() marker
         self.on_closed = on_closed
@@ -79,7 +85,12 @@ class Destination:
         self._buf_cap = max(1, send_buffer_size)
         self._buffered = 0
         self._buf_cv = threading.Condition()
-        self.channel = grpc.insecure_channel(address)
+        # local subchannel pool: grpc's GLOBAL pool would hand a fresh
+        # Destination the previous (dead) connection's subchannel, still
+        # in TRANSIENT_FAILURE backoff — a circuit breaker's half-open
+        # probe must dial for real, not inherit the failure it is probing
+        self.channel = grpc.insecure_channel(
+            address, options=[("grpc.use_local_subchannel_pool", 1)])
         grpc.channel_ready_future(self.channel).result(
             timeout=dial_timeout_s)
         self._v2 = self.channel.stream_unary(
@@ -235,7 +246,7 @@ class Destination:
                     self._send_batch(batch)
                 finally:
                     self._release(len(batch))
-        except grpc.RpcError as e:
+        except (grpc.RpcError, failpoints.FailpointDrop) as e:
             logger.warning("destination %s batch send failed: %s",
                            self.address, e)
         finally:
@@ -248,9 +259,10 @@ class Destination:
         for i in range(0, len(batch), BATCH_MAX):
             chunk = batch[i:i + BATCH_MAX]
             try:
+                failpoints.inject("proxy.send_batch")
                 self._v1(forward_pb2.MetricList(metrics=chunk),
-                         timeout=30.0)
-            except grpc.RpcError:
+                         timeout=self.send_timeout_s)
+            except (grpc.RpcError, failpoints.FailpointDrop):
                 with self._sent_lock:
                     self.dropped += len(batch) - i
                 raise
@@ -263,8 +275,9 @@ class Destination:
         remaining = item.count
         for chunk, n in zip(item.chunks, item.chunk_counts):
             try:
-                self._v1_raw(chunk, timeout=30.0)
-            except grpc.RpcError:
+                failpoints.inject("proxy.send_batch")
+                self._v1_raw(chunk, timeout=self.send_timeout_s)
+            except (grpc.RpcError, failpoints.FailpointDrop):
                 with self._sent_lock:
                     self.dropped += remaining
                 raise
@@ -318,8 +331,9 @@ class Destination:
             ok[0] = True    # iterator exhausted = _CLOSE consumed
 
         try:
+            failpoints.inject("proxy.stream")
             self._v2(it())
-        except grpc.RpcError as e:
+        except (grpc.RpcError, failpoints.FailpointDrop) as e:
             logger.warning("destination %s stream closed: %s",
                            self.address, e)
         finally:

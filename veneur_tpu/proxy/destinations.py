@@ -1,10 +1,22 @@
-"""Destination set + consistent-hash routing.
+"""Destination set + consistent-hash routing + per-destination breaker.
 
 Mirrors `proxy/destinations/destinations.go`: Add connects new addresses in
 parallel (`Add`, destinations.go:47-81), Get routes a key through the hash
 ring (`:129-142`), closed connections self-remove (`ConnectionClosed`,
 `:100-126`), Clear tears everything down, and Wait blocks until all
 destinations have drained.
+
+On top of the reference semantics, each address carries a CIRCUIT BREAKER:
+`breaker_threshold` consecutive failures (abrupt close, failed dial) TRIP
+it — the address is removed from the ring, so every key that hashed to it
+reroutes to the survivors (consistent-hash route-around), and re-adds are
+refused while the breaker is open.  After `breaker_reset_s` (doubling per
+consecutive trip, capped at 8x) the next add() for the address becomes the
+HALF-OPEN probe: one real dial — success closes the breaker and restores
+the member to the ring; failure re-opens it with a longer cooldown.  The
+discovery poll (proxy.go:345-387 -> set_members) is the natural probe
+driver: every poll re-offers the wanted membership, and the breaker decides
+which offers turn into dials.
 """
 
 from __future__ import annotations
@@ -12,6 +24,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import threading
+import time
 
 from veneur_tpu.proxy.connect import Destination
 from veneur_tpu.proxy.consistent import ConsistentHash
@@ -19,21 +32,122 @@ from veneur_tpu.proxy.consistent import ConsistentHash
 logger = logging.getLogger("veneur_tpu.proxy.destinations")
 
 
+class _Breaker:
+    """Per-address failure state.  Guarded by the Destinations lock."""
+
+    __slots__ = ("failures", "trips", "open_until", "half_open")
+
+    def __init__(self):
+        self.failures = 0       # consecutive failures since last success
+        self.trips = 0          # times the breaker has opened
+        self.open_until = 0.0   # monotonic deadline; 0 = not open
+        self.half_open = False  # a probe dial is in flight
+
+    def state(self, now: float) -> str:
+        if self.half_open:
+            return "half_open"
+        if self.open_until > now:
+            return "open"
+        if self.open_until:
+            return "probe_due"
+        return "closed"
+
+
 class Destinations:
+    # cooldown doubles per consecutive trip, capped at this multiple
+    BREAKER_MAX_BACKOFF_X = 8
+
     def __init__(self, send_buffer_size: int = 1024, grpc_stats=None,
-                 n_streams: int = 8):
+                 n_streams: int = 8, send_timeout_s: float = 30.0,
+                 dial_timeout_s: float = 5.0,
+                 breaker_threshold: int = 3,
+                 breaker_reset_s: float = 5.0):
         self.send_buffer_size = send_buffer_size
         self.n_streams = n_streams
         self.grpc_stats = grpc_stats
+        self.send_timeout_s = send_timeout_s
+        self.dial_timeout_s = dial_timeout_s
+        self.breaker_threshold = max(1, breaker_threshold)
+        self.breaker_reset_s = breaker_reset_s
         self._lock = threading.Lock()
         self._ring = ConsistentHash()
         self._dests: dict[str, Destination] = {}
+        self._breakers: dict[str, _Breaker] = {}
+        # sent/dropped totals of destinations that have been removed —
+        # without this, a dead destination's drop accounting would vanish
+        # from stats() with it (silent loss in the chaos arithmetic)
+        self._retired_sent = 0
+        self._retired_dropped = 0
         self._ring_cache = None   # (hashes, didx, dests); see ring_arrays
 
+    # -- breaker bookkeeping (all under self._lock) ------------------------
+
+    def _record_failure(self, address: str) -> None:
+        with self._lock:
+            b = self._breakers.setdefault(address, _Breaker())
+            b.failures += 1
+            b.half_open = False
+            if b.failures >= self.breaker_threshold or b.trips:
+                # past the threshold (or re-failing a half-open probe):
+                # open with exponential cooldown
+                b.trips += 1
+                backoff = min(2 ** (b.trips - 1), self.BREAKER_MAX_BACKOFF_X)
+                b.open_until = time.monotonic() + self.breaker_reset_s * backoff
+                logger.warning(
+                    "destination %s circuit OPEN (%d consecutive "
+                    "failures, trip #%d, retry in %.1fs); routing around "
+                    "via the ring", address, b.failures, b.trips,
+                    self.breaker_reset_s * backoff)
+
+    def _record_success(self, address: str) -> None:
+        """A dial succeeded.  Only a post-trip (half-open) probe closes
+        the breaker — a mere successful dial must NOT reset the
+        consecutive-failure count, or a half-broken peer that accepts
+        dials but kills every RPC would flap connect/fail/reconnect
+        forever without ever reaching the threshold."""
+        with self._lock:
+            b = self._breakers.get(address)
+            if b is None:
+                return
+            if b.trips or b.half_open:
+                logger.info("destination %s circuit CLOSED "
+                            "(probe succeeded); restored to the ring",
+                            address)
+                del self._breakers[address]
+
+    def _admit(self, address: str) -> bool:
+        """May we dial this address now?  False while its breaker is
+        open; an expired breaker admits ONE dial (the half-open probe)."""
+        with self._lock:
+            b = self._breakers.get(address)
+            if b is None:
+                return True
+            now = time.monotonic()
+            if b.half_open:
+                return False            # a probe is already in flight
+            if b.open_until > now:
+                return False
+            if b.open_until:
+                b.half_open = True      # this dial is the probe
+            return True
+
+    def breaker_stats(self) -> dict[str, dict]:
+        now = time.monotonic()
+        with self._lock:
+            return {a: {"state": b.state(now), "failures": b.failures,
+                        "trips": b.trips,
+                        "retry_in_s": round(max(0.0, b.open_until - now), 3)}
+                    for a, b in self._breakers.items()}
+
+    # -- membership --------------------------------------------------------
+
     def add(self, addresses: list[str]) -> None:
-        """Connect any new addresses in parallel; keep existing ones."""
+        """Connect any new addresses in parallel; keep existing ones.
+        Open-breaker addresses are skipped (route-around); an expired
+        breaker turns its address's dial into the half-open probe."""
         with self._lock:
             new = [a for a in addresses if a not in self._dests]
+        new = [a for a in new if self._admit(a)]
         if not new:
             return
         with concurrent.futures.ThreadPoolExecutor(
@@ -45,7 +159,9 @@ class Destinations:
                     dest = fut.result()
                 except Exception as e:
                     logger.warning("could not connect to %s: %s", addr, e)
+                    self._record_failure(addr)
                     continue
+                self._record_success(addr)
                 duplicate = None
                 with self._lock:
                     if addr in self._dests:
@@ -61,14 +177,29 @@ class Destinations:
                                      daemon=True).start()
 
     def _connect(self, address: str) -> Destination:
+        from veneur_tpu import failpoints
+        failpoints.inject("destinations.add")
         dest = Destination(address, self.send_buffer_size,
                            on_closed=self._connection_closed,
-                           n_streams=self.n_streams)
+                           n_streams=self.n_streams,
+                           send_timeout_s=self.send_timeout_s,
+                           dial_timeout_s=self.dial_timeout_s)
         if self.grpc_stats is not None:
             self.grpc_stats.watch_channel(dest.channel)
         return dest
 
     def _connection_closed(self, dest: Destination) -> None:
+        # an ABRUPT close (broken stream / failed RPC) — graceful closes
+        # never notify (connect.py _mark_closed).  A connection that
+        # DELIVERED traffic before dying is real progress: reset the
+        # consecutive-failure history first, so only genuinely
+        # back-to-back failures (dials or zero-delivery lives) trip.
+        if dest.sent > 0:
+            with self._lock:
+                b = self._breakers.get(dest.address)
+                if b is not None and not b.trips:
+                    del self._breakers[dest.address]
+        self._record_failure(dest.address)
         self.remove(dest.address, expected=dest)
 
     def remove(self, address: str, expected=None) -> None:
@@ -82,15 +213,37 @@ class Destinations:
             del self._dests[address]
             self._ring.remove(address)
             self._ring_cache = None
-        if not dest.closed.is_set():
-            threading.Thread(target=dest.close, daemon=True).start()
+            # fold the current counts into the retired totals UNDER THE
+            # SAME LOCK that removes the destination, so totals() never
+            # dips (monotonic for rate() scrapers); the drain may keep
+            # counting for seconds, so _retire adds the post-snapshot
+            # delta once close() completes
+            base = (dest.sent, dest.dropped)
+            self._retired_sent += base[0]
+            self._retired_dropped += base[1]
+        threading.Thread(target=self._retire, args=(dest, base),
+                         daemon=True).start()
+
+    def _retire(self, dest: Destination, base: tuple[int, int]) -> None:
+        try:
+            dest.close()     # idempotent; joins senders + final sweep
+        finally:
+            with self._lock:
+                self._retired_sent += dest.sent - base[0]
+                self._retired_dropped += dest.dropped - base[1]
 
     def set_members(self, addresses: list[str]) -> None:
         """Reconcile with a discovery result: add new, drop vanished
-        (proxy.go:345-387 HandleDiscovery)."""
+        (proxy.go:345-387 HandleDiscovery).  Addresses leaving the wanted
+        set also shed their breaker state (a deliberate removal is not a
+        failure); wanted-but-tripped addresses get probed by add() once
+        their cooldown expires."""
         want = set(addresses)
         with self._lock:
             have = set(self._dests)
+            for addr in list(self._breakers):
+                if addr not in want:
+                    del self._breakers[addr]
         for addr in have - want:
             self.remove(addr)
         self.add(sorted(want - have))
@@ -130,14 +283,32 @@ class Destinations:
     def clear(self) -> None:
         with self._lock:
             dests = list(self._dests.values())
+            bases = []
+            for d in dests:
+                bases.append((d.sent, d.dropped))
+                self._retired_sent += d.sent
+                self._retired_dropped += d.dropped
             self._dests.clear()
             self._ring = ConsistentHash()
+            self._breakers.clear()
             self._ring_cache = None
-        for d in dests:
-            d.close()
+        for d, base in zip(dests, bases):
+            self._retire(d, base)   # close + fold the drain delta in
 
     def stats(self) -> dict[str, dict[str, int]]:
         with self._lock:
             return {a: {"sent": d.sent, "dropped": d.dropped,
                         "queued": d._buffered}
                     for a, d in self._dests.items()}
+
+    def totals(self) -> dict[str, int]:
+        """Cumulative sent/dropped including REMOVED destinations, so a
+        dead destination's losses stay visible (/debug/vars + the chaos
+        matrix's no-silent-loss arithmetic)."""
+        with self._lock:
+            return {
+                "sent": self._retired_sent
+                + sum(d.sent for d in self._dests.values()),
+                "dropped": self._retired_dropped
+                + sum(d.dropped for d in self._dests.values()),
+            }

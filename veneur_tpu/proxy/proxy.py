@@ -49,6 +49,17 @@ class ProxyConfig:
     # parallel SendMetricsV2 streams per destination (a single python-
     # grpc stream caps at ~20k msgs/s; see proxy/connect.py)
     send_streams: int = 8
+    # per-RPC deadline for destination sends and the dial/probe deadline
+    # (were hard-coded 30.0/5.0 in proxy/connect.py)
+    proxy_send_timeout: float = 30.0
+    proxy_dial_timeout: float = 5.0
+    # per-destination circuit breaker (proxy/destinations.py): after
+    # breaker_failure_threshold consecutive failures the address is
+    # tripped out of the ring (keys route around via consistent hashing)
+    # until a half-open probe succeeds; cooldown starts at
+    # breaker_reset_timeout and doubles per consecutive trip (cap 8x)
+    breaker_failure_threshold: int = 3
+    breaker_reset_timeout: float = 5.0
     ignore_tags: list[TagMatcher] = field(default_factory=list)
     static_destinations: list[str] = field(default_factory=list)
     # optional second, TLS-authenticated listener (proxy.go:190-306: the
@@ -80,6 +91,14 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
             data.get("discovery_interval", 10.0)),
         send_buffer_size=int(data.get("send_buffer_size", 1024)),
         send_streams=int(data.get("send_streams", 8)),
+        proxy_send_timeout=parse_duration(
+            data.get("proxy_send_timeout", 30.0)),
+        proxy_dial_timeout=parse_duration(
+            data.get("proxy_dial_timeout", 5.0)),
+        breaker_failure_threshold=int(
+            data.get("breaker_failure_threshold", 3)),
+        breaker_reset_timeout=parse_duration(
+            data.get("breaker_reset_timeout", 5.0)),
         ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
         static_destinations=list(data.get("static_destinations", [])),
         grpc_tls_address=data.get("grpc_tls_address", ""),
@@ -110,9 +129,14 @@ class Proxy:
             cfg.static_destinations)
         # connection open/close accounting (grpcstats/stats.go:1-49)
         self.grpc_stats = GrpcStats(statsd=statsd)
-        self.destinations = Destinations(cfg.send_buffer_size,
-                                         n_streams=cfg.send_streams,
-                                         grpc_stats=self.grpc_stats)
+        self.destinations = Destinations(
+            cfg.send_buffer_size,
+            n_streams=cfg.send_streams,
+            grpc_stats=self.grpc_stats,
+            send_timeout_s=cfg.proxy_send_timeout,
+            dial_timeout_s=cfg.proxy_dial_timeout,
+            breaker_threshold=cfg.breaker_failure_threshold,
+            breaker_reset_s=cfg.breaker_reset_timeout)
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
                       "no_destination": 0}
         self._stats_lock = threading.Lock()
@@ -340,6 +364,14 @@ class Proxy:
                     with proxy._stats_lock:
                         stats = dict(proxy.stats)
                     stats["destinations"] = proxy.destinations.size()
+                    stats["destination_stats"] = \
+                        proxy.destinations.stats()
+                    # cumulative incl. removed destinations: a dead
+                    # destination's drop accounting must stay visible
+                    stats["destination_totals"] = \
+                        proxy.destinations.totals()
+                    stats["breakers"] = \
+                        proxy.destinations.breaker_stats()
                     stats["threads"] = threading.active_count()
                     http_api.reply(self, 200, json_mod.dumps(
                         stats, indent=2).encode(), "application/json")

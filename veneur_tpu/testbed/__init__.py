@@ -1,0 +1,28 @@
+"""In-process 3-tier cluster testbed (ROADMAP #3).
+
+Boots N local servers, one consistent-hash proxy, and M (optionally
+virtual-device-meshed) global servers inside one process tree over
+loopback gRPC, drives them with a seeded deterministic traffic generator
+backed by a CPU ground-truth oracle, and asserts end-to-end conservation,
+percentile accuracy within the committed t-digest envelope, and the
+consistent-hash routing invariant — including under injected faults
+(veneur_tpu.failpoints).
+
+Entry points:
+  Cluster/ClusterSpec   the harness           (testbed/cluster.py)
+  TrafficGen/Oracle     seeded traffic        (testbed/traffic.py)
+  run_dryrun            one-call dryrun       (testbed/dryrun.py)
+  CHAOS_ARMS et al.     the chaos matrix      (testbed/chaos.py)
+"""
+
+from veneur_tpu.testbed.chaos import (CHAOS_ARMS, ChaosArm, arm_by_name,
+                                      run_chaos_arm, run_chaos_matrix)
+from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
+from veneur_tpu.testbed.dryrun import PROMISED_KEYS, run_dryrun
+from veneur_tpu.testbed.traffic import Oracle, TrafficGen
+
+__all__ = [
+    "CHAOS_ARMS", "ChaosArm", "arm_by_name", "run_chaos_arm",
+    "run_chaos_matrix", "Cluster", "ClusterSpec", "PROMISED_KEYS",
+    "run_dryrun", "Oracle", "TrafficGen",
+]

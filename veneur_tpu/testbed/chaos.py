@@ -1,0 +1,137 @@
+"""Chaos matrix: each failpoint x each edge of the 3-tier pipe.
+
+Every arm arms ONE failpoint (seeded, bounded) over a fresh cluster, runs
+a few intervals of oracle-tracked traffic, and checks the ISSUE-5
+no-silent-loss contract:
+
+  expect="conserved"   delivery eventually succeeds (the fault is within
+                       the retry/reroute budget) -> counter totals at the
+                       global tier are EXACT
+  expect="accounted"   the fault defeats delivery for some metrics -> the
+                       counter deficit must be matched by nonzero drop
+                       accounting somewhere visible (forward.dropped,
+                       proxy dropped, destination totals) — never silent
+
+Arms cover the forward edge (transient unavailability, pre-wire drops,
+delays, mid-fleet stream resets, permanent outage -> exhausted retries),
+the proxy's per-destination sends (destination death -> ring route-around
+with accounted loss), the dial path (connect failure -> breaker +
+survivor routing), and the server flush path (stall).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from veneur_tpu import failpoints
+from veneur_tpu.testbed import verify
+from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
+from veneur_tpu.testbed.traffic import TrafficGen
+
+
+@dataclass(frozen=True)
+class ChaosArm:
+    name: str
+    failpoint: str
+    action: str
+    expect: str                      # "conserved" | "accounted"
+    kwargs: dict = field(default_factory=dict)
+
+
+CHAOS_ARMS: list[ChaosArm] = [
+    # forward edge: transient faults within the retry budget
+    ChaosArm("forward-unavailable", "forward.send", "grpc-error",
+             "conserved", {"code": "UNAVAILABLE", "times": 2}),
+    ChaosArm("forward-drop", "forward.send", "drop",
+             "conserved", {"times": 2}),
+    ChaosArm("forward-delay", "forward.send", "delay",
+             "conserved", {"delay_s": 0.08, "times": 2}),
+    ChaosArm("forward-stream-reset", "forward.send", "stream-reset",
+             "conserved", {"times": 2}),
+    # forward edge: permanent outage -> retries exhaust -> accounted drop
+    ChaosArm("forward-outage", "forward.send", "grpc-error",
+             "accounted", {"code": "UNAVAILABLE"}),
+    # proxy destination edge: one batch RPC dies -> destination closes,
+    # its in-flight/buffered metrics are accounted dropped, the ring
+    # routes the keys around to the survivor
+    ChaosArm("proxy-batch-unavailable", "proxy.send_batch", "grpc-error",
+             "accounted", {"code": "UNAVAILABLE", "times": 1}),
+    ChaosArm("proxy-batch-drop", "proxy.send_batch", "drop",
+             "accounted", {"times": 1}),
+    # dial edge: a destination's connect fails -> breaker failure, keys
+    # route to the surviving global, discovery re-dials later; nothing
+    # was accepted for the dead member so nothing can be lost
+    ChaosArm("proxy-connect-reset", "proxy.connect", "stream-reset",
+             "conserved", {"times": 1}),
+    # flush path: a stalled flush is slow, not lossy
+    ChaosArm("server-flush-delay", "server.flush", "delay",
+             "conserved", {"delay_s": 0.05, "times": 1}),
+]
+
+
+def arm_by_name(name: str) -> ChaosArm:
+    for a in CHAOS_ARMS:
+        if a.name == name:
+            return a
+    raise KeyError(f"unknown chaos arm {name!r} "
+                   f"(have {[a.name for a in CHAOS_ARMS]})")
+
+
+def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
+                  n_globals: int = 2, intervals: int = 2,
+                  counter_keys: int = 4, histo_keys: int = 1,
+                  set_keys: int = 1, histo_samples: int = 40) -> dict:
+    """One matrix cell: fresh cluster, armed failpoint, oracle verdict."""
+    spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
+                       forward_max_retries=2,
+                       forward_retry_backoff=0.02,
+                       breaker_failure_threshold=2,
+                       breaker_reset_timeout=0.4,
+                       discovery_interval_s=0.2)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    fp = failpoints.configure(arm.failpoint, arm.action,
+                              seed=seed, **arm.kwargs)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    try:
+        cluster.start()
+        for _ in range(intervals):
+            per_interval.append(cluster.run_interval(
+                traffic.next_interval(n_locals)))
+        acct = cluster.accounting()
+    finally:
+        failpoints.disarm(arm.failpoint)
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    fired = fp.fired
+    conserved = counters["exact"]
+    accounted = conserved or acct["dropped_total"] > 0
+    if arm.expect == "conserved":
+        ok = fired > 0 and conserved and routing["exclusive"]
+    else:
+        # loss is allowed — but only VISIBLE loss
+        ok = fired > 0 and accounted and routing["exclusive"]
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": arm.action,
+        "expect": arm.expect,
+        "fired": fired,
+        "conserved": conserved,
+        "counter_deficit": counters["deficit"],
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": accounted,
+        "ok": ok,
+    }
+
+
+def run_chaos_matrix(arms=None, seed: int = 0, **kwargs) -> list[dict]:
+    return [run_chaos_arm(a, seed=seed, **kwargs)
+            for a in (arms or CHAOS_ARMS)]

@@ -1,0 +1,326 @@
+"""In-process 3-tier cluster: N locals -> consistent-hash proxy -> M
+meshed globals, one process tree.
+
+The dryrun shape ROADMAP #3 asks for: every tier is the REAL component
+(core.Server locals with native UDP ingest and the real ForwardClient,
+proxy.Proxy with real loopback gRPC and the breaker-guarded destination
+set, core.Server globals with the gRPC import source and — optionally —
+a virtual-device mesh under the flush), wired over 127.0.0.1 ephemeral
+ports.  Only the clocks are virtual: flushes are driven explicitly per
+interval, with a quiescence-based settle() between "local flush" and
+"global flush" so an interval's forwards are fully imported before the
+global tier evaluates — which is what makes exact conservation
+assertable.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from dataclasses import dataclass, field
+
+from veneur_tpu import config as config_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.proxy.proxy import Proxy, ProxyConfig
+from veneur_tpu.sinks import simple as simple_sinks
+
+# keep datagrams comfortably under loopback MTU
+_MAX_DGRAM_LINES = 25
+_MAX_DGRAM_BYTES = 1200
+
+
+@dataclass
+class ClusterSpec:
+    n_locals: int = 1
+    n_globals: int = 1
+    interval_s: float = 0.05
+    percentiles: tuple = (0.5, 0.9, 0.99)
+    aggregates: tuple = ("min", "max", "count")
+    # virtual-device mesh on the GLOBAL tier (conftest provides 8
+    # emulated CPU devices; 0 = unmeshed lanes)
+    mesh_devices: int = 0
+    # forward-edge retry policy + deadline (local tier)
+    forward_timeout: float = 5.0
+    forward_max_retries: int = 2
+    forward_retry_backoff: float = 0.02
+    # proxy deadlines + breaker
+    proxy_send_timeout: float = 5.0
+    proxy_dial_timeout: float = 2.0
+    breaker_failure_threshold: int = 2
+    breaker_reset_timeout: float = 0.5
+    discovery_interval_s: float = 0.25
+    send_buffer_size: int = 8192
+    # serve the operator /debug surface for local[0] (tests assert the
+    # forward retry/drop counters are visible at /debug/vars)
+    http_api: bool = False
+
+
+@dataclass
+class _Node:
+    server: Server
+    sink: object
+    # local tier only:
+    udp_addr: tuple = None
+    tx: socket.socket = None
+    ingest_base: int = 0
+
+
+class Cluster:
+    def __init__(self, spec: ClusterSpec):
+        self.spec = spec
+        self.globals: list[_Node] = []
+        self.locals: list[_Node] = []
+        self.proxy: Proxy = None
+        self.http = None
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Cluster":
+        spec = self.spec
+        for i in range(spec.n_globals):
+            sink = simple_sinks.ChannelMetricSink()
+            srv = Server(config_mod.Config(
+                grpc_address="127.0.0.1:0",
+                interval=spec.interval_s,
+                percentiles=list(spec.percentiles),
+                aggregates=list(spec.aggregates),
+                mesh_devices=spec.mesh_devices,
+                hostname=f"tb-g{i}"),
+                extra_metric_sinks=[sink])
+            srv.start()
+            self.globals.append(_Node(srv, sink))
+        self.proxy = Proxy(ProxyConfig(
+            static_destinations=[
+                f"127.0.0.1:{g.server.grpc_import.port}"
+                for g in self.globals],
+            discovery_interval=spec.discovery_interval_s,
+            send_buffer_size=spec.send_buffer_size,
+            proxy_send_timeout=spec.proxy_send_timeout,
+            proxy_dial_timeout=spec.proxy_dial_timeout,
+            breaker_failure_threshold=spec.breaker_failure_threshold,
+            breaker_reset_timeout=spec.breaker_reset_timeout))
+        self.proxy.start()
+        for i in range(spec.n_locals):
+            sink = simple_sinks.ChannelMetricSink()
+            srv = Server(config_mod.Config(
+                statsd_listen_addresses=["udp://127.0.0.1:0"],
+                forward_address=f"127.0.0.1:{self.proxy.grpc_port}",
+                forward_timeout=spec.forward_timeout,
+                forward_max_retries=spec.forward_max_retries,
+                forward_retry_backoff=spec.forward_retry_backoff,
+                interval=spec.interval_s,
+                percentiles=list(spec.percentiles),
+                aggregates=list(spec.aggregates),
+                hostname=f"tb-l{i}"),
+                extra_metric_sinks=[sink])
+            srv.start()
+            _, addr = srv.statsd_addrs[0]
+            tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self.locals.append(_Node(srv, sink, udp_addr=addr, tx=tx))
+        if spec.http_api:
+            from veneur_tpu.http_api import HttpApi
+            self.http = HttpApi(self.locals[0].server, "127.0.0.1:0")
+            self.http.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        if self.http is not None:
+            self.http.stop()
+        for n in self.locals:
+            try:
+                n.tx.close()
+            except OSError:
+                pass
+            n.server.shutdown()
+        if self.proxy is not None:
+            self.proxy.stop()
+        for n in self.globals:
+            n.server.shutdown()
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- traffic -----------------------------------------------------------
+
+    def send_lines(self, local_idx: int, lines: list[bytes]) -> int:
+        """Batch lines into datagrams to local `local_idx`; returns the
+        line count (for the ingestion wait)."""
+        node = self.locals[local_idx]
+        dgram: list[bytes] = []
+        size = 0
+        for line in lines:
+            if dgram and (len(dgram) >= _MAX_DGRAM_LINES
+                          or size + len(line) + 1 > _MAX_DGRAM_BYTES):
+                node.tx.sendto(b"\n".join(dgram), node.udp_addr)
+                dgram, size = [], 0
+            dgram.append(line)
+            size += len(line) + 1
+        if dgram:
+            node.tx.sendto(b"\n".join(dgram), node.udp_addr)
+        return len(lines)
+
+    def wait_ingested(self, local_idx: int, n_lines: int,
+                      timeout_s: float = 15.0) -> None:
+        """Block until the local's data plane has consumed `n_lines`
+        more lines than at the last call (native engine line totals;
+        falls back to a staged-quiescence wait on the Python path)."""
+        node = self.locals[local_idx]
+        srv = node.server
+        deadline = time.time() + timeout_s
+        if srv.native is not None:
+            want = node.ingest_base + n_lines
+            while time.time() < deadline:
+                srv._drain_native()
+                got = srv.native.engine.totals()[0]
+                if got >= want:
+                    node.ingest_base = got
+                    return
+                time.sleep(0.01)
+            raise TimeoutError(
+                f"local {local_idx}: ingested "
+                f"{srv.native.engine.totals()[0] - node.ingest_base}"
+                f"/{n_lines} lines in {timeout_s}s")
+        # Python packet path: processed is contaminated by self-telemetry
+        # spans, so wait for growth then a short quiet window
+        base = srv.aggregator.processed
+        while time.time() < deadline:
+            if srv.aggregator.processed >= base + n_lines:
+                return
+            time.sleep(0.01)
+        raise TimeoutError(f"local {local_idx}: ingest timed out")
+
+    # -- interval driving --------------------------------------------------
+
+    def _forwards_idle(self) -> bool:
+        return all(
+            n.server._forward_slots._value == n.server.FORWARD_MAX_IN_FLIGHT
+            for n in self.locals)
+
+    def _pipe_counters(self) -> tuple:
+        """Composite counter snapshot across the whole pipe; settle()
+        waits until it stops moving."""
+        fw = [n.server.forwarder.stats() if n.server.forwarder is not None
+              else {} for n in self.locals]
+        with self.proxy._stats_lock:
+            pstats = dict(self.proxy.stats)
+        dest = self.proxy.destinations
+        return (
+            tuple(sorted((k, v) for d in fw for k, v in d.items())),
+            tuple(sorted(pstats.items())),
+            tuple(sorted(dest.totals().items())),
+            tuple(g.server.aggregator.imported for g in self.globals),
+            tuple(getattr(g.server.grpc_import, "imported_count", 0)
+                  for g in self.globals),
+        )
+
+    def _buffers_empty(self) -> bool:
+        dest = self.proxy.destinations
+        with dest._lock:
+            return all(d._buffered == 0 for d in dest._dests.values())
+
+    def settle(self, timeout_s: float = 30.0, quiet_polls: int = 3,
+               poll_s: float = 0.05) -> None:
+        """Wait until the forward/route/import pipe is quiescent: no
+        forward in flight, destination buffers empty, and every counter
+        stable for `quiet_polls` consecutive polls.  Bounded: raises on
+        timeout rather than hanging a test."""
+        deadline = time.time() + timeout_s
+        last = None
+        stable = 0
+        while time.time() < deadline:
+            cur = self._pipe_counters()
+            if (cur == last and self._forwards_idle()
+                    and self._buffers_empty()):
+                stable += 1
+                if stable >= quiet_polls:
+                    return
+            else:
+                stable = 0
+            last = cur
+            time.sleep(poll_s)
+        raise TimeoutError("cluster did not settle "
+                           f"within {timeout_s}s")
+
+    def flush_locals(self) -> None:
+        for n in self.locals:
+            n.server.flush()
+
+    def flush_globals(self) -> list[list]:
+        """Flush every global and drain its sink; returns per-global
+        lists of InterMetric for THIS interval."""
+        out = []
+        for n in self.globals:
+            n.server.flush()
+            got = []
+            while not n.sink.queue.empty():
+                got.extend(n.sink.queue.get())
+            out.append(got)
+        return out
+
+    def drain_local_sinks(self) -> list[list]:
+        out = []
+        for n in self.locals:
+            got = []
+            while not n.sink.queue.empty():
+                got.extend(n.sink.queue.get())
+            out.append(got)
+        return out
+
+    def run_interval(self, per_local_lines: list[list[bytes]],
+                     settle_timeout_s: float = 30.0) -> list[list]:
+        """One complete interval: ingest -> local flush -> settle ->
+        global flush.  Returns per-global emissions."""
+        counts = [self.send_lines(i, lines)
+                  for i, lines in enumerate(per_local_lines)]
+        for i, c in enumerate(counts):
+            if c:
+                self.wait_ingested(i, c)
+        self.flush_locals()
+        self.settle(timeout_s=settle_timeout_s)
+        return self.flush_globals()
+
+    # -- accounting --------------------------------------------------------
+
+    def accounting(self) -> dict:
+        """The end-to-end ledger: what left the locals, what the proxy
+        did with it, what the globals imported, and every drop counter a
+        metric could have died in.  `dropped_total` is the no-silent-loss
+        denominator the chaos matrix checks deficits against."""
+        fw = {"sent": 0, "retries": 0, "dropped": 0}
+        for n in self.locals:
+            f = n.server.forwarder
+            if f is not None and hasattr(f, "stats"):
+                for k, v in f.stats().items():
+                    fw[k] += v
+        with self.proxy._stats_lock:
+            pstats = dict(self.proxy.stats)
+        dest_totals = self.proxy.destinations.totals()
+        return {
+            "forward": fw,
+            "forward_slots_dropped": sum(
+                n.server.forward_dropped for n in self.locals),
+            "proxy": pstats,
+            "destination_totals": dest_totals,
+            "breakers": self.proxy.destinations.breaker_stats(),
+            "imported": sum(
+                getattr(g.server.grpc_import, "imported_count", 0)
+                for g in self.globals),
+            "local_flushes": sum(n.server.flush_count
+                                 for n in self.locals),
+            "global_flushes": sum(n.server.flush_count
+                                  for n in self.globals),
+            "dropped_total": (fw["dropped"]
+                              + sum(n.server.forward_dropped
+                                    for n in self.locals)
+                              + pstats["dropped"]
+                              + pstats["no_destination"]
+                              + dest_totals["dropped"]),
+        }

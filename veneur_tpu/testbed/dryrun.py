@@ -1,0 +1,108 @@
+"""The 3-tier dryrun: boot, drive, verify, report.
+
+One call runs the whole ROADMAP-#3 story — local tier -> consistent-hash
+proxy -> (optionally meshed) global tier in one process tree, seeded
+deterministic traffic with a CPU oracle, K flush intervals, then the
+conservation / accuracy-envelope / routing checks — and returns a
+JSON-able report whose keys are PROMISED (asserted by the test suite, so
+downstream tooling can rely on them).  `scripts/dryrun_3tier.py` is the
+CLI wrapper.
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.testbed import verify
+from veneur_tpu.testbed.chaos import (CHAOS_ARMS, arm_by_name,
+                                      run_chaos_arm)
+from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
+from veneur_tpu.testbed.traffic import TrafficGen
+
+# keys every dryrun report carries (tests/test_testbed.py pins them)
+PROMISED_KEYS = [
+    "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
+    "conservation", "quantile_errors", "routing_exclusive",
+    "chaos_matrix", "ok",
+]
+
+
+def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
+               seed: int = 0, mesh_devices: int = 0,
+               counter_keys: int = 8, histo_keys: int = 4,
+               set_keys: int = 2, histo_samples: int = 200,
+               interval_s: float = 0.05,
+               percentiles: tuple = (0.5, 0.9, 0.99),
+               chaos: str | None = None) -> dict:
+    """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all"."""
+    spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
+                       interval_s=interval_s, mesh_devices=mesh_devices,
+                       percentiles=tuple(percentiles))
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    try:
+        cluster.start()
+        for _ in range(intervals):
+            per_interval.append(cluster.run_interval(
+                traffic.next_interval(n_locals)))
+        acct = cluster.accounting()
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    sets = verify.check_sets(traffic.oracle, per_interval)
+    quantiles = verify.check_quantiles(traffic.oracle, per_interval,
+                                       list(percentiles))
+    routing = verify.check_routing(per_interval)
+
+    chaos_rows: list[dict] = []
+    if chaos:
+        arms = CHAOS_ARMS if chaos == "all" else [arm_by_name(chaos)]
+        for arm in arms:
+            chaos_rows.append(run_chaos_arm(arm, seed=seed))
+
+    ok = (counters["exact"] and sets["exact"] and quantiles["ok"]
+          and routing["exclusive"]
+          and all(r["ok"] for r in chaos_rows))
+    return {
+        "spec": {
+            "n_locals": n_locals, "n_globals": n_globals,
+            "intervals": intervals, "seed": seed,
+            "mesh_devices": mesh_devices,
+            "counter_keys": counter_keys, "histo_keys": histo_keys,
+            "set_keys": set_keys, "histo_samples": histo_samples,
+            "percentiles": list(percentiles),
+        },
+        "per_tier": {
+            "local_flushes": acct["local_flushes"],
+            "global_flushes": acct["global_flushes"],
+            "proxy_received": acct["proxy"]["received"],
+            "proxy_routed": acct["proxy"]["routed"],
+            "proxy_no_destination": acct["proxy"]["no_destination"],
+            "destination_totals": acct["destination_totals"],
+            "breakers": acct["breakers"],
+        },
+        "forwarded": acct["forward"]["sent"],
+        "imported": acct["imported"],
+        "retried": acct["forward"]["retries"],
+        "dropped": acct["dropped_total"],
+        "conservation": {
+            "counters_exact": counters["exact"],
+            "counter_deficit": counters["deficit"],
+            "counter_keys": counters["keys"],
+            "sets_exact": sets["exact"],
+            "sets_checked": sets["checked"],
+        },
+        "quantile_errors": {
+            str(q): {
+                "max_span_err": rec["max_span_err"],
+                "envelope": rec["envelope"],
+                "checked": rec["checked"],
+                "within": rec["within"],
+            } for q, rec in quantiles["per_quantile"].items()
+        },
+        "routing_exclusive": routing["exclusive"],
+        "chaos_matrix": chaos_rows,
+        "ok": ok,
+    }

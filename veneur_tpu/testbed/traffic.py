@@ -1,0 +1,111 @@
+"""Seeded deterministic traffic generator + CPU-side ground-truth oracle.
+
+The generator emits DogStatsD lines for the cluster's local tier while
+recording EXACTLY what it sent into an Oracle:
+
+  counters   exact per-key totals (additive across locals and intervals;
+             tagged #veneurglobalonly so the value surfaces only at the
+             global tier — conservation is then a single sum)
+  sets       exact per-(interval, key) member sets, with members split
+             across locals and a shared overlap slice, so the global-tier
+             HLL union is checked against the true distinct count
+  histos     the raw per-(interval, key) sample values; the global tier's
+             percentile emissions are checked against exact numpy
+             quantiles of the same values, within the committed t-digest
+             accuracy envelope (analysis/tdigest_accuracy.csv)
+
+Everything derives from one numpy Generator(seed): the same seed replays
+the same packets, member strings, and values — which is what makes the
+chaos matrix's conservation verdicts reproducible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# one shared prefix so verification can filter the servers' own
+# self-telemetry (flush spans etc.) out of the sink streams
+PREFIX = "tb."
+
+
+@dataclass
+class Oracle:
+    counters: dict[str, float] = field(default_factory=dict)
+    # (interval, name) -> set of member strings
+    sets: dict[tuple[int, str], set] = field(default_factory=dict)
+    # (interval, name) -> list of sample values
+    histos: dict[tuple[int, str], list] = field(default_factory=dict)
+
+    def add_counter(self, name: str, v: int) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + v
+
+    def add_set(self, interval: int, name: str, member: str) -> None:
+        self.sets.setdefault((interval, name), set()).add(member)
+
+    def add_histo(self, interval: int, name: str, v: float) -> None:
+        self.histos.setdefault((interval, name), []).append(v)
+
+
+class TrafficGen:
+    """One instance drives one cluster run; next_interval() returns the
+    DogStatsD lines for each local and advances the oracle."""
+
+    def __init__(self, seed: int = 0, counter_keys: int = 8,
+                 histo_keys: int = 4, set_keys: int = 2,
+                 histo_samples: int = 200, set_members: int = 12,
+                 counter_max: int = 9):
+        self.rng = np.random.default_rng(seed)
+        self.oracle = Oracle()
+        self.counter_keys = counter_keys
+        self.histo_keys = histo_keys
+        self.set_keys = set_keys
+        self.histo_samples = histo_samples
+        self.set_members = set_members
+        self.counter_max = counter_max
+        self.interval = 0
+
+    def next_interval(self, n_locals: int) -> list[list[bytes]]:
+        """Lines for each local for one flush interval."""
+        iv = self.interval
+        self.interval += 1
+        lines: list[list[bytes]] = [[] for _ in range(n_locals)]
+
+        # counters: every key increments on every local, global-only so
+        # the exact total is a single global-tier sum
+        for k in range(self.counter_keys):
+            name = f"{PREFIX}c{k}"
+            for li in range(n_locals):
+                v = int(self.rng.integers(1, self.counter_max + 1))
+                lines[li].append(
+                    f"{name}:{v}|c|#veneurglobalonly".encode())
+                self.oracle.add_counter(name, v)
+
+        # histograms (mixed scope): per-key gamma samples split
+        # round-robin across locals, so the global's digest merge spans
+        # the forward/import edge from every local
+        for k in range(self.histo_keys):
+            name = f"{PREFIX}h{k}"
+            vals = self.rng.gamma(2.0, 10.0, self.histo_samples)
+            for j, v in enumerate(vals):
+                li = j % n_locals
+                lines[li].append(f"{name}:{v:.6f}|h".encode())
+                self.oracle.add_histo(iv, name, float(v))
+
+        # sets: interval-scoped members (the global's HLL resets each
+        # flush, so distinctness is per interval), partitioned across
+        # locals with a shared overlap slice every local also sends —
+        # the union at the global must still count each member once
+        for k in range(self.set_keys):
+            name = f"{PREFIX}s{k}"
+            for j in range(self.set_members):
+                member = f"m{iv}_{k}_{j}"
+                li = j % n_locals
+                lines[li].append(f"{name}:{member}|s".encode())
+                self.oracle.add_set(iv, name, member)
+            shared = f"shared{iv}_{k}"
+            for li in range(n_locals):
+                lines[li].append(f"{name}:{shared}|s".encode())
+            self.oracle.add_set(iv, name, shared)
+        return lines

@@ -1,0 +1,187 @@
+"""End-to-end correctness checks for a testbed run.
+
+Three invariants, straight from ROADMAP #3 / the t-digest mergeability
+contract (arXiv:1902.04023 — and the partial-merge hazard 2511.17396
+warns about):
+
+  conservation   counters and set cardinalities arrive at the global tier
+                 EXACTLY (they are algebraic merges: addition / HLL
+                 union); any deficit must be matched by visible drop
+                 accounting somewhere in the pipe
+  accuracy       global-tier percentiles of forwarded digests stay inside
+                 the committed accuracy envelope (the per-quantile worst
+                 case of analysis/tdigest_accuracy.csv, x a safety factor
+                 for the extra local->global merge level), normalized by
+                 the sample span like the dossier does
+  routing        every metric key surfaces on exactly one global per ring
+                 epoch (the consistent-hash invariant)
+"""
+
+from __future__ import annotations
+
+import csv
+import math
+import os
+
+import numpy as np
+
+from veneur_tpu.testbed.traffic import PREFIX, Oracle
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENVELOPE_CSV = os.path.join(_REPO_ROOT, "analysis",
+                            "tdigest_accuracy.csv")
+
+# the dossier's errors are ONE digest's compression error; the testbed
+# path adds a second merge level (N local digests -> global merge) and
+# much smaller per-interval sample counts, so the envelope is widened
+ENVELOPE_SAFETY = 5.0
+ENVELOPE_FLOOR = 1e-3     # span-relative
+
+
+def load_envelope(path: str = ENVELOPE_CSV) -> dict[float, float]:
+    """Per-quantile worst-case span-relative error across every
+    (distribution, n) cell of the committed dossier."""
+    env: dict[float, float] = {}
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            q = float(row["q"])
+            err = max(float(row["parallel_err_q"]),
+                      float(row["flush_err_q"]))
+            env[q] = max(env.get(q, 0.0), err)
+    return env
+
+
+def envelope_for(q: float, env: dict[float, float]) -> float:
+    """Allowed span-relative error at quantile q: the nearest committed
+    quantile's worst case, widened and floored."""
+    nearest = min(env, key=lambda eq: abs(eq - q))
+    return max(env[nearest] * ENVELOPE_SAFETY, ENVELOPE_FLOOR)
+
+
+def _filter(emissions: list) -> list:
+    return [m for m in emissions if m.name.startswith(PREFIX)]
+
+
+def check_counters(oracle: Oracle,
+                   per_interval: list[list[list]]) -> dict:
+    """Exact conservation: the sum over all intervals and globals of each
+    counter key's emissions equals the oracle total.  Returns a report
+    with the deficit (expected - got) so chaos arms can reconcile loss
+    against drop accounting."""
+    got: dict[str, float] = {}
+    for interval in per_interval:
+        for g in interval:
+            for m in _filter(g):
+                if m.type == "counter":
+                    got[m.name] = got.get(m.name, 0.0) + m.value
+    deficit = 0.0
+    mismatched = []
+    for name, want in oracle.counters.items():
+        have = got.get(name, 0.0)
+        if have != want:
+            deficit += want - have
+            mismatched.append((name, want, have))
+    return {"exact": not mismatched, "deficit": deficit,
+            "keys": len(oracle.counters), "mismatched": mismatched[:8]}
+
+
+def check_sets(oracle: Oracle, per_interval: list[list[list]]) -> dict:
+    """Exact per-interval set cardinality at the global tier.  Small
+    deterministic member sets keep HLL's linear-counting regime exact,
+    and the seed pins the hash inputs, so equality is stable."""
+    mismatched = []
+    total = 0
+    for (iv, name), members in oracle.sets.items():
+        if iv >= len(per_interval):
+            continue
+        total += 1
+        got = None
+        for g in per_interval[iv]:
+            for m in _filter(g):
+                if m.name == name and m.type == "gauge":
+                    got = m.value
+        if got != float(len(members)):
+            mismatched.append((iv, name, len(members), got))
+    return {"exact": not mismatched, "checked": total,
+            "mismatched": mismatched[:8]}
+
+
+def check_quantiles(oracle: Oracle, per_interval: list[list[list]],
+                    percentiles: list[float],
+                    env: dict[float, float] | None = None) -> dict:
+    """Global-tier percentile emissions vs exact numpy quantiles of the
+    oracle's raw per-(interval, key) values, span-normalized like the
+    dossier, within the committed envelope."""
+    env = env or load_envelope()
+    per_q: dict[float, dict] = {
+        q: {"max_span_err": 0.0, "envelope": envelope_for(q, env),
+            "checked": 0, "within": True} for q in percentiles}
+    missing = []
+    for (iv, name), vals in oracle.histos.items():
+        if iv >= len(per_interval):
+            continue
+        arr = np.asarray(vals, np.float64)
+        span = float(arr.max() - arr.min()) or 1.0
+        emitted = {}
+        for g in per_interval[iv]:
+            for m in _filter(g):
+                if m.name.startswith(name + ".") and \
+                        m.name.endswith("percentile"):
+                    emitted[m.name] = m.value
+        for q in percentiles:
+            suffix = f".{int(q * 100)}percentile"
+            mname = name + suffix
+            if mname not in emitted:
+                missing.append((iv, mname))
+                per_q[q]["within"] = False
+                continue
+            exact = float(np.quantile(arr, q, method="hazen"))
+            err = abs(emitted[mname] - exact) / span
+            rec = per_q[q]
+            rec["checked"] += 1
+            rec["max_span_err"] = max(rec["max_span_err"], err)
+            if err > rec["envelope"]:
+                rec["within"] = False
+    ok = not missing and all(r["within"] for r in per_q.values())
+    return {"ok": ok, "per_quantile": per_q, "missing": missing[:8]}
+
+
+def check_routing(per_interval: list[list[list]],
+                  per_epoch: bool = False) -> dict:
+    """Consistent-hash invariant: each metric key surfaces on exactly
+    one global.  With per_epoch=True the check is per interval (a chaos
+    arm that kills a destination legitimately remaps keys across ring
+    epochs)."""
+    conflicts = []
+
+    def base_key(name: str) -> str:
+        # percentile/aggregate suffixes belong to the same routed key
+        for suf in (".50percentile", ".90percentile", ".99percentile",
+                    ".min", ".max", ".count"):
+            if name.endswith(suf):
+                return name[: -len(suf)]
+        head, _, tail = name.rpartition(".")
+        if tail.endswith("percentile"):
+            return head
+        return name
+
+    def scan(intervals) -> None:
+        owner: dict[str, int] = {}
+        for interval in intervals:
+            for gi, g in enumerate(interval):
+                for m in _filter(g):
+                    k = base_key(m.name)
+                    if owner.setdefault(k, gi) != gi:
+                        conflicts.append((k, owner[k], gi))
+
+    if per_epoch:
+        for interval in per_interval:
+            scan([interval])
+    else:
+        scan(per_interval)
+    return {"exclusive": not conflicts, "conflicts": conflicts[:8]}
+
+
+def isclose_or_nan(a: float, b: float) -> bool:
+    return (math.isnan(a) and math.isnan(b)) or math.isclose(a, b)
