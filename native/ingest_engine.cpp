@@ -1224,7 +1224,8 @@ extern "C" {
 void* vn_route(const uint8_t* data, long long len,
                const uint32_t* ring_hashes, const int32_t* ring_dests,
                long long ring_len, int n_dests, int chunk_max) {
-  if (n_dests <= 0 || ring_len <= 0) return nullptr;
+  // chunk_max <= 0 would divide-by-zero in the chunking loop (UBSan)
+  if (n_dests <= 0 || ring_len <= 0 || chunk_max <= 0) return nullptr;
   struct Rec {
     const uint8_t* start;   // record start (incl. tag+len prefix)
     long long size;

@@ -1,20 +1,36 @@
-// ThreadSanitizer exercise of the ingest engine's stage counters.
+// Sanitizer exercise of the ingest engine: the concurrency arm
+// (stage-counter accounting under TSan) plus single-threaded
+// memory/UB arms (protobuf wire fuzz, dense-fill boundary abuse) that
+// give ASan and UBSan builds something to bite on.
 //
-// Built and run by tests/test_profiling.py (slow-marked):
-//   g++ -fsanitize=thread -O1 -g -std=c++17 -pthread
+// Built and run by tests/test_native_sanitizers.py (slow-marked) and
+// scripts/native_sanitize.sh with each of -fsanitize=thread /
+// address / undefined:
+//   g++ -fsanitize=<mode> -O1 -g -std=c++17 -pthread
 //       native/stage_tsan_driver.cpp native/ingest_engine.cpp -o <bin>
 //
-// Hammers the counters from every direction at once — ingest threads
-// (vn_ingest), a drain thread (vn_drain / vn_drain_clear), and a stats
-// reader (vn_stage_stats / vn_stage_drain / vn_totals / vn_intern_count)
-// — so a data race anywhere on the accounting path is a TSan report
-// (nonzero exit), and finishes with a conservation check: after a final
-// drain, parse-stage packets must equal the engine's packet total and
-// stage-stage values its processed total.
+// Phase 1 hammers the counters from every direction at once — ingest
+// threads (vn_ingest), a drain thread (vn_drain / vn_drain_clear),
+// and a stats reader (vn_stage_stats / vn_stage_drain / vn_totals /
+// vn_intern_count) — so a data race anywhere on the accounting path
+// is a TSan report (nonzero exit), and finishes with a conservation
+// check: after a final drain, parse-stage packets must equal the
+// engine's packet total and stage-stage values its processed total.
+// Phase 2 (wire fuzz) hand-encodes a forwardrpc.MetricList, routes
+// and import-scans it intact, truncated at every stride, bit-flipped,
+// and with degenerate ring/chunk arguments — corrupt wire bytes must
+// yield a null fallback, never an out-of-bounds read.  Phase 3 feeds
+// vn_fill_dense adversarial COO rows (negative ids, ids past the
+// arena capacity, per-row overflow past the dense depth) and checks
+// the drop accounting and depth clamps hold.
+//
+// VN_SAN_ITERS / VN_SAN_THREADS shrink phase 1 for smoke runs
+// (scripts/check.py uses VN_SAN_ITERS=2000).
 
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -33,24 +49,205 @@ long long vn_stage_thread_count(void* ep);
 long long vn_stage_stats(void* ep, unsigned long long* out,
                          long long cap_threads);
 void vn_stage_drain(void* ep, unsigned long long* out3);
+unsigned long long vn_metro64(const char* data, long n);
+void* vn_route(const uint8_t* data, long long len,
+               const uint32_t* ring_hashes, const int32_t* ring_dests,
+               long long ring_len, int n_dests, int chunk_max);
+void vn_route_dest(void* handle, int d, const uint8_t** ptr,
+                   long long* nbytes, long long* count);
+void vn_route_free(void* handle);
+void* vn_import_scan(const uint8_t* data, long long len);
+long long vn_import_scan_n(void* handle);
+void vn_import_scan_free(void* handle);
+long long vn_fill_dense(const long long* rows, const double* vals,
+                        const double* wts, long long n,
+                        const long long* dense_id, long long capacity,
+                        float* dv, float* dw, short* depths,
+                        long long u_pad, long long d_pad,
+                        int n_threads);
 }
+
+namespace {
+
+void put_varint(std::vector<uint8_t>& v, uint64_t x) {
+  while (x >= 0x80) {
+    v.push_back((uint8_t)(x | 0x80));
+    x >>= 7;
+  }
+  v.push_back((uint8_t)x);
+}
+
+// Hand-encoded `repeated Metric metrics = 1` list: name (1), one tag
+// (2), type enum (3) per record — the three fields vn_route keys on.
+std::vector<uint8_t> make_metric_list(int n) {
+  std::vector<uint8_t> ml;
+  char buf[48];
+  for (int i = 0; i < n; i++) {
+    std::vector<uint8_t> m;
+    int nl = snprintf(buf, sizeof buf, "svc.wire.metric.%d", i);
+    m.push_back(0x0A);
+    put_varint(m, (uint64_t)nl);
+    m.insert(m.end(), buf, buf + nl);
+    int tl = snprintf(buf, sizeof buf, "shard:%d", i % 7);
+    m.push_back(0x12);
+    put_varint(m, (uint64_t)tl);
+    m.insert(m.end(), buf, buf + tl);
+    m.push_back(0x18);
+    put_varint(m, (uint64_t)(i % 5));
+    ml.push_back(0x0A);
+    put_varint(ml, m.size());
+    ml.insert(ml.end(), m.begin(), m.end());
+  }
+  return ml;
+}
+
+int wire_fuzz() {
+  const int kMetrics = 64;
+  std::vector<uint8_t> ml = make_metric_list(kMetrics);
+  uint32_t ring_hashes[8];
+  int32_t ring_dests[8];
+  for (int i = 0; i < 8; i++) {
+    ring_hashes[i] = (uint32_t)i * 0x20000000u;
+    ring_dests[i] = i % 2;
+  }
+  // intact: every record routes to exactly one of two destinations
+  void* r = vn_route(ml.data(), (long long)ml.size(), ring_hashes,
+                     ring_dests, 8, 2, 3);
+  if (r == nullptr) {
+    fprintf(stderr, "wire fuzz: intact list failed to route\n");
+    return 1;
+  }
+  long long total = 0;
+  for (int d = 0; d < 2; d++) {
+    const uint8_t* p;
+    long long nb, cnt;
+    vn_route_dest(r, d, &p, &nb, &cnt);
+    total += cnt;
+  }
+  vn_route_free(r);
+  if (total != kMetrics) {
+    fprintf(stderr, "wire fuzz: routed %lld of %d metrics\n", total,
+            kMetrics);
+    return 1;
+  }
+  void* s = vn_import_scan(ml.data(), (long long)ml.size());
+  if (s == nullptr || vn_import_scan_n(s) != kMetrics) {
+    fprintf(stderr, "wire fuzz: intact list failed to scan\n");
+    if (s) vn_import_scan_free(s);
+    return 1;
+  }
+  vn_import_scan_free(s);
+  // truncation sweep: every prefix must parse or fall back, never
+  // read past the buffer (the ASan payoff)
+  for (size_t cut = 0; cut <= ml.size(); cut += 3) {
+    void* rr = vn_route(ml.data(), (long long)cut, ring_hashes,
+                        ring_dests, 8, 2, 3);
+    if (rr) vn_route_free(rr);
+    void* ss = vn_import_scan(ml.data(), (long long)cut);
+    if (ss) vn_import_scan_free(ss);
+  }
+  // bit-flip sweep: corrupt tags/lengths/varints in place
+  std::vector<uint8_t> mut(ml);
+  for (size_t i = 0; i < mut.size(); i += 5) {
+    mut[i] ^= 0xFF;
+    void* rr = vn_route(mut.data(), (long long)mut.size(), ring_hashes,
+                        ring_dests, 8, 2, 3);
+    if (rr) vn_route_free(rr);
+    void* ss = vn_import_scan(mut.data(), (long long)mut.size());
+    if (ss) vn_import_scan_free(ss);
+    mut[i] ^= 0xFF;
+  }
+  // degenerate arguments: empty ring, zero destinations, chunk_max=0
+  // (was a division by zero before the guard) — all must refuse
+  if (vn_route(ml.data(), (long long)ml.size(), ring_hashes,
+               ring_dests, 0, 2, 3) != nullptr ||
+      vn_route(ml.data(), (long long)ml.size(), ring_hashes,
+               ring_dests, 8, 0, 3) != nullptr ||
+      vn_route(ml.data(), (long long)ml.size(), ring_hashes,
+               ring_dests, 8, 2, 0) != nullptr) {
+    fprintf(stderr, "wire fuzz: degenerate args were not refused\n");
+    return 1;
+  }
+  vn_metro64((const char*)ml.data(), (long)ml.size());
+  vn_metro64("", 0);
+  return 0;
+}
+
+int fill_dense_fuzz() {
+  const long long n = 4096, cap = 64, u_pad = 16, d_pad = 8;
+  std::vector<long long> rows(n);
+  std::vector<double> vals(n), wts(n);
+  std::vector<long long> dense_id(cap, -1);
+  for (int i = 0; i < (int)u_pad; i++) dense_id[i * 4] = i;
+  for (long long i = 0; i < n; i++) {
+    // mix of corrupt (negative / past capacity) and valid arena rows
+    rows[i] = (i % 13 == 0) ? -5
+              : (i % 17 == 0) ? cap + 3
+                              : (i % cap);
+    vals[i] = (double)i;
+    wts[i] = 1.0;
+  }
+  for (int threads : {1, 3}) {
+    std::vector<float> dv((size_t)(u_pad * d_pad), 0.f);
+    std::vector<float> dw((size_t)(u_pad * d_pad), 0.f);
+    std::vector<short> depths((size_t)u_pad, 0);
+    long long dropped = vn_fill_dense(
+        rows.data(), vals.data(), wts.data(), n, dense_id.data(), cap,
+        dv.data(), dw.data(), depths.data(), u_pad, d_pad, threads);
+    if (dropped <= 0) {
+      fprintf(stderr, "fill fuzz: adversarial rows were not dropped "
+                      "(threads=%d)\n", threads);
+      return 1;
+    }
+    for (long long rr = 0; rr < u_pad; rr++) {
+      if (depths[rr] < 0 || depths[rr] > d_pad) {
+        fprintf(stderr, "fill fuzz: depth %d out of [0, %lld] "
+                        "(threads=%d)\n", depths[rr], d_pad, threads);
+        return 1;
+      }
+    }
+    // uniform path: null weights + null depths must also be legal
+    long long d2 = vn_fill_dense(
+        rows.data(), vals.data(), nullptr, n, dense_id.data(), cap,
+        dv.data(), nullptr, nullptr, u_pad, d_pad, threads);
+    if (d2 != dropped) {
+      fprintf(stderr, "fill fuzz: uniform path dropped %lld != %lld\n",
+              d2, dropped);
+      return 1;
+    }
+  }
+  return 0;
+}
+
+int env_int(const char* name, int dflt) {
+  const char* v = getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  int out = atoi(v);
+  return out > 0 ? out : dflt;
+}
+
+}  // namespace
 
 int main() {
   void* e = vn_engine_new(4096, "env:tsan");
-  const int kIngestThreads = 4;
-  const int kIters = 20000;
+  const int kIngestThreads = env_int("VN_SAN_THREADS", 4);
+  const int kIters = env_int("VN_SAN_ITERS", 20000);
   std::atomic<bool> stop{false};
 
   std::vector<std::thread> workers;
   for (int t = 0; t < kIngestThreads; t++) {
     int tid = vn_thread_new(e);
-    workers.emplace_back([e, tid, t] {
-      char buf[128];
+    workers.emplace_back([e, tid, t, kIters] {
+      char buf[224];
       for (int i = 0; i < kIters; i++) {
+        // every metric family the parser speaks, plus a sampled
+        // timer and a malformed tail line
         int n = snprintf(buf, sizeof(buf),
                          "tsan.m%d:%d|c|#thr:%d\ntsan.h:%d|h|@0.5\n"
-                         "tsan.s:u%d|s\nbad line",
-                         i % 37, i, t, i % 101, i % 17);
+                         "tsan.s:u%d|s\ntsan.g:%d|g\n"
+                         "tsan.t:%d|ms|@0.25\nbad line",
+                         i % 37, i, t, i % 101, i % 17, i % 23,
+                         i % 19);
         vn_ingest(e, tid, buf, n);
       }
     });
@@ -110,7 +307,11 @@ int main() {
     rc = 1;
   }
   vn_engine_free(e);
-  if (rc == 0) fprintf(stderr, "tsan driver ok: %llu pkts, %llu values\n",
-                       parse_pkts, stage_vals);
+  rc |= wire_fuzz();
+  rc |= fill_dense_fuzz();
+  if (rc == 0)
+    fprintf(stderr,
+            "sanitize driver ok: %llu pkts, %llu values, wire fuzz + "
+            "dense fill clean\n", parse_pkts, stage_vals);
   return rc;
 }

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""One-command repo gate: vnlint -> native sanitizer smoke -> tier-1
+pytest.  Nonzero exit on ANY unsuppressed lint finding, sanitizer
+report, or test failure — the local equivalent of a CI required check.
+
+    python scripts/check.py              # the full gate
+    python scripts/check.py --fast      # vnlint + sanitizer smoke only
+    python scripts/check.py --skip-native   # no g++ on this box
+
+Stage order is cheapest-first so the common failure (a lint finding)
+costs seconds, not the pytest run.  The sanitizer smoke is the
+combined address+undefined arm over a reduced driver workload
+(scripts/native_sanitize.sh smoke); the full asan/ubsan/tsan matrix is
+`scripts/native_sanitize.sh` with no arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def stage(name: str):
+    print(f"\n=== check: {name} " + "=" * max(0, 50 - len(name)))
+    sys.stdout.flush()
+    return time.perf_counter()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="skip the tier-1 pytest stage")
+    ap.add_argument("--skip-native", action="store_true",
+                    help="skip the native sanitizer smoke")
+    ap.add_argument("--json", metavar="FILE",
+                    help="also write the vnlint JSON report here")
+    args = ap.parse_args()
+    os.chdir(REPO)
+    results: list[tuple[str, str, float]] = []
+
+    # 1. vnlint over the package tree
+    t0 = stage("vnlint (veneur_tpu/)")
+    from veneur_tpu.analysis.__main__ import main as vnlint_main
+    lint_args = ["--json", args.json] if args.json else []
+    lint_rc = vnlint_main(lint_args)
+    results.append(("vnlint", "PASS" if lint_rc == 0 else "FAIL",
+                    time.perf_counter() - t0))
+
+    # 2. native sanitizer smoke (combined address+undefined arm)
+    if args.skip_native:
+        results.append(("sanitizer smoke", "SKIP", 0.0))
+        native_rc = 0
+    elif shutil.which("g++") is None or shutil.which("bash") is None:
+        print("check: no g++/bash — skipping the sanitizer smoke "
+              "(run scripts/native_sanitize.sh where a toolchain "
+              "exists)")
+        results.append(("sanitizer smoke", "SKIP", 0.0))
+        native_rc = 0
+    else:
+        t0 = stage("native sanitizer smoke (address+undefined)")
+        native_rc = subprocess.call(
+            ["bash", "scripts/native_sanitize.sh", "smoke"])
+        results.append(("sanitizer smoke",
+                        "PASS" if native_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
+    # 3. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
+    test_rc = 0
+    if args.fast:
+        results.append(("tier-1 pytest", "SKIP", 0.0))
+    else:
+        t0 = stage("tier-1 pytest (-m 'not slow')")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        test_rc = subprocess.call(
+            [sys.executable, "-m", "pytest", "tests/", "-q",
+             "-m", "not slow", "--continue-on-collection-errors",
+             "-p", "no:cacheprovider"], env=env)
+        results.append(("tier-1 pytest",
+                        "PASS" if test_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
+    print("\n=== check: summary " + "=" * 40)
+    for name, verdict, dt in results:
+        print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
+    rc = 1 if (lint_rc or native_rc or test_rc) else 0
+    print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())

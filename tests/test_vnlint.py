@@ -1,0 +1,491 @@
+"""vnlint: each rule pinned to a fixture reproducing its historical
+bug, the corrected form staying quiet, suppression grammar, and the
+repo's own lint-clean state as a tier-1 regression gate.
+
+The fixtures are deliberately minimal re-creations of real shipped
+bugs:
+
+  - PR-1: donated lane-update buffers read by an in-flight flush
+    (donation-aliasing)
+  - PR-3: set-lane snapshot pin leaked on failed dispatch/fetch paths
+    (resource-pairing)
+  - PR-3: prewarm weight-struct dtype diverged from the live flush
+    upload dtype, causing an uncovered in-flush XLA compile
+    (prewarm-parity)
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from veneur_tpu.analysis import LintEngine, run_paths  # noqa: E402
+from veneur_tpu.analysis.__main__ import main as vnlint_main  # noqa: E402
+
+
+_CASE = [0]
+
+
+def lint_source(tmp_path, source: str, relname: str = "mod.py"):
+    """Write `source` into a FRESH subdir of tmp_path and lint it (so
+    back-to-back buggy/fixed fixtures never see each other)."""
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    path = root / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return LintEngine().run([str(root)])
+
+
+def rules_fired(report) -> set:
+    return {f.rule for f in report.findings if not f.suppressed}
+
+
+# ---------------------------------------------------------------------------
+# donation-aliasing — the PR-1 donation race
+# ---------------------------------------------------------------------------
+
+DONATION_BUG = """
+import jax
+
+update = jax.jit(lambda regs, rows: regs, donate_argnums=(0,))
+
+
+def step(regs, rows):
+    out = update(regs, rows)
+    total = regs.sum()      # read-after-donate: the PR-1 race
+    return out, total
+"""
+
+DONATION_FIXED = """
+import jax
+
+update = jax.jit(lambda regs, rows: regs, donate_argnums=(0,))
+
+
+def step(regs, rows):
+    regs = update(regs, rows)   # rebound: the donated buffer is dead
+    total = regs.sum()
+    return regs, total
+"""
+
+
+def test_donation_race_fires(tmp_path):
+    report = lint_source(tmp_path, DONATION_BUG)
+    hits = [f for f in report.findings if f.rule == "donation-aliasing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "regs" in hits[0].message
+    assert "donate" in hits[0].message
+
+
+def test_donation_rebind_is_quiet(tmp_path):
+    report = lint_source(tmp_path, DONATION_FIXED)
+    assert "donation-aliasing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_donation_partial_jit_and_cross_module(tmp_path):
+    """The real PR-1 shape: the donated kernel lives in one module
+    (serving-style `functools.partial(jax.jit, donate_argnums=...)`)
+    and the hazardous read in another."""
+    (tmp_path / "serving.py").write_text(
+        "import functools\nimport jax\n\n"
+        "def _scatter(lanes, rows):\n    return lanes\n\n"
+        "lane_scatter = functools.partial(\n"
+        "    jax.jit, donate_argnums=(0,))(_scatter)\n")
+    (tmp_path / "arena.py").write_text(
+        "import serving\n\n"
+        "class Arena:\n"
+        "    def sync(self, rows):\n"
+        "        serving.lane_scatter(self.lanes, rows)\n"
+        "        return self.lanes.sum()   # donated state re-read\n")
+    report = LintEngine().run([str(tmp_path)])
+    hits = [f for f in report.findings if f.rule == "donation-aliasing"]
+    assert len(hits) == 1 and hits[0].path == "arena.py"
+
+
+# ---------------------------------------------------------------------------
+# resource-pairing — the PR-3 snapshot-pin leak
+# ---------------------------------------------------------------------------
+
+PIN_LEAK = """
+def flush(self):
+    snap = self.sets.snapshot_lanes()
+    out = self.flush_fn(snap)        # dispatch can raise (OOM, compile)
+    res = self.fetch(out)            # fetch can raise too
+    self.sets.unpin_lanes(snap)      # ...and then this never runs
+    return res
+"""
+
+PIN_FIXED = """
+def flush(self):
+    snap = self.sets.snapshot_lanes()
+    try:
+        out = self.flush_fn(snap)
+        res = self.fetch(out)
+    finally:
+        self.sets.unpin_lanes(snap)
+    return res
+"""
+
+ARM_LEAK_LATE_TRY = """
+from veneur_tpu import failpoints
+
+
+def run_arm(arm, spec):
+    fp = failpoints.configure(arm.failpoint, arm.action)
+    cluster = Cluster(spec)          # raises => failpoint stays armed
+    try:
+        cluster.start()
+    finally:
+        failpoints.disarm(arm.failpoint)
+"""
+
+
+def test_pin_leak_fires(tmp_path):
+    report = lint_source(tmp_path, PIN_LEAK)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "snapshot_lanes" in hits[0].message
+
+
+def test_pin_finally_is_quiet(tmp_path):
+    report = lint_source(tmp_path, PIN_FIXED)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_failpoint_arm_before_try_window_fires(tmp_path):
+    report = lint_source(tmp_path, ARM_LEAK_LATE_TRY)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1
+    assert "try begins only AFTER" in hits[0].message
+
+
+def test_ownership_handoff_is_quiet(tmp_path):
+    """The production shape: _snapshot_and_reset stores the pin into
+    the snapshot dict (ownership moves to the emit path)."""
+    report = lint_source(tmp_path, (
+        "def snapshot(self, snap):\n"
+        "    snap['lanes'] = self.sets.snapshot_lanes()\n"
+        "    return snap\n"))
+    assert "resource-pairing" not in rules_fired(report)
+
+
+def test_chained_dispatch_emit_is_quiet(tmp_path):
+    report = lint_source(tmp_path, (
+        "def flush(self, is_local):\n"
+        "    return self.flush_dispatch(is_local).emit()\n"))
+    assert "resource-pairing" not in rules_fired(report)
+
+
+def test_unemitted_dispatch_fires(tmp_path):
+    report = lint_source(tmp_path, (
+        "def flush(self, is_local):\n"
+        "    pending = self.agg.flush_dispatch(is_local)\n"
+        "    self.account()\n"))
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1 and "never released" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# prewarm-parity — the PR-3 in-flush recompile
+# ---------------------------------------------------------------------------
+
+PREWARM_BUG = """
+import jax
+
+
+class Agg:
+    def prewarm(self):
+        dv = jax.ShapeDtypeStruct((8, 8), self.stage_dtype)
+        dw = jax.ShapeDtypeStruct((8, 8), self.stage_dtype)  # BUG
+        self.flush_fn.lower(dv, dw).compile()
+
+    def flush(self, staged, weights):
+        dv = staged.astype(self.stage_dtype)
+        dw = weights.astype(self.eval_dtype)   # live weights: eval
+        return self.flush_fn(dv, dw)
+"""
+
+PREWARM_FIXED = PREWARM_BUG.replace(
+    "jax.ShapeDtypeStruct((8, 8), self.stage_dtype)  # BUG",
+    "jax.ShapeDtypeStruct((8, 8), self.eval_dtype)")
+
+
+def test_prewarm_dtype_mismatch_fires(tmp_path):
+    report = lint_source(tmp_path, PREWARM_BUG)
+    hits = [f for f in report.findings if f.rule == "prewarm-parity"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "stage_dtype" in hits[0].message
+    assert "eval_dtype" in hits[0].message
+
+
+def test_prewarm_matching_dtype_is_quiet(tmp_path):
+    report = lint_source(tmp_path, PREWARM_FIXED)
+    assert "prewarm-parity" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_prewarm_static_kwarg_mismatch_fires(tmp_path):
+    report = lint_source(tmp_path, (
+        "import jax\n\n"
+        "class Agg:\n"
+        "    def prewarm(self):\n"
+        "        dv = jax.ShapeDtypeStruct((8, 8), self.eval_dtype)\n"
+        "        self.flush_fn.lower(dv, uniform=True).compile()\n\n"
+        "    def flush(self, dvd):\n"
+        "        return self.flush_fn(dvd, uniform=False)\n"))
+    hits = [f for f in report.findings if f.rule == "prewarm-parity"]
+    assert len(hits) == 1 and "uniform" in hits[0].message
+
+
+def test_prewarm_donated_alias_matches_live_twin(tmp_path):
+    """The production alias shape: prewarm lowers through the donated
+    twin, live launches pick either — same canonical callable, no
+    finding when dtypes agree."""
+    report = lint_source(tmp_path, (
+        "import jax\n\n"
+        "class Agg:\n"
+        "    def prewarm(self, donate):\n"
+        "        dep = jax.ShapeDtypeStruct((8,), self.depth_dtype)\n"
+        "        du = (self.flush_fn.depth_variant_donated if donate\n"
+        "              else self.flush_fn.depth_variant)\n"
+        "        du.lower(dep).compile()\n\n"
+        "    def flush(self, depths, donate):\n"
+        "        dep = depths.astype(self.depth_dtype)\n"
+        "        fn = (self.flush_fn.depth_variant_donated if donate\n"
+        "              else self.flush_fn.depth_variant)\n"
+        "        return fn(dep)\n"))
+    assert "prewarm-parity" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# sync-under-lock + magic-literal
+# ---------------------------------------------------------------------------
+
+def test_sync_under_lock_fires_and_moves_out(tmp_path):
+    buggy = (
+        "def snapshot(self):\n"
+        "    with self.lock:\n"
+        "        val = self.dev_array.item()\n"
+        "    return val\n")
+    fixed = (
+        "def snapshot(self):\n"
+        "    with self.lock:\n"
+        "        arr = self.dev_array\n"
+        "    return arr.item()\n")
+    assert "sync-under-lock" in rules_fired(
+        lint_source(tmp_path, buggy))
+    assert "sync-under-lock" not in rules_fired(
+        lint_source(tmp_path, fixed, relname="fixed.py"))
+
+
+def test_locked_suffix_convention_scanned(tmp_path):
+    report = lint_source(tmp_path, (
+        "def _flush_locked(self):\n"
+        "    res = self.pending.emit()\n"
+        "    return res\n"))
+    hits = [f for f in report.findings if f.rule == "sync-under-lock"]
+    assert len(hits) == 1 and "emit" in hits[0].message
+
+
+def test_asarray_of_host_list_is_quiet(tmp_path):
+    report = lint_source(tmp_path, (
+        "import numpy as np\n\n"
+        "def merge(self):\n"
+        "    rows: list = []\n"
+        "    with self.lock:\n"
+        "        rows.append(1)\n"
+        "        a = np.asarray(rows, np.int64)\n"
+        "        b = np.asarray([h for h in self.ring], np.uint32)\n"
+        "    return a, b\n"))
+    assert "sync-under-lock" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_magic_literal_scoped_to_wire_dirs(tmp_path):
+    src = (
+        "def send(self, chan, batch):\n"
+        "    return chan.send_batch(batch, timeout=30.0)\n")
+    # in proxy/: fires
+    report = lint_source(tmp_path, src, relname="proxy/connect.py")
+    hits = [f for f in report.findings if f.rule == "magic-literal"]
+    assert len(hits) == 1 and "timeout=30.0" in hits[0].message
+    # same code outside the wire dirs: out of scope
+    report2 = lint_source(tmp_path, src, relname="core/other.py")
+    assert "magic-literal" not in rules_fired(report2)
+
+
+def test_magic_literal_exempts_config_defaults(tmp_path):
+    report = lint_source(tmp_path, (
+        "from dataclasses import dataclass\n\n"
+        "@dataclass\n"
+        "class ProxyConfig:\n"
+        "    send_timeout: float = 30.0\n\n"
+        "def dial(self, cfg, address, dial_timeout_s: float = 5.0):\n"
+        "    return self.connect(address, timeout=cfg.send_timeout)\n"),
+        relname="proxy/cfg.py")
+    assert "magic-literal" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+SUPPRESSED_OK = """
+def snapshot(self):
+    with self.lock:
+        # vnlint: disable=sync-under-lock (fixture: reason present)
+        val = self.dev_array.item()
+    return val
+"""
+
+SUPPRESSED_NO_REASON = """
+def snapshot(self):
+    with self.lock:
+        val = self.dev_array.item()  # vnlint: disable=sync-under-lock
+    return val
+"""
+
+
+def test_suppression_with_reason_mutes(tmp_path):
+    report = lint_source(tmp_path, SUPPRESSED_OK)
+    assert report.unsuppressed == [], \
+        [f.format() for f in report.findings]
+    sup = [f for f in report.findings if f.suppressed]
+    assert len(sup) == 1
+    assert sup[0].reason == "fixture: reason present"
+
+
+def test_suppression_without_reason_rejected(tmp_path):
+    report = lint_source(tmp_path, SUPPRESSED_NO_REASON)
+    rules = rules_fired(report)
+    # the mute does NOT take effect, and the directive itself is an
+    # unsuppressable finding
+    assert "bad-suppression" in rules
+    assert "sync-under-lock" in rules
+
+
+def test_suppression_inline_wrapped_reason(tmp_path):
+    """The README's documented form: inline directive, reason wrapped
+    onto the following comment-only line."""
+    report = lint_source(tmp_path, (
+        "def snapshot(self):\n"
+        "    with self.lock:\n"
+        "        val = self.arr.item()  # vnlint: "
+        "disable=sync-under-lock (reason\n"
+        "                               #   wrapped onto this line)\n"
+        "    return val\n"))
+    assert report.unsuppressed == [], \
+        [f.format() for f in report.findings]
+    assert any(f.suppressed for f in report.findings)
+
+
+def test_suppression_skips_trailing_commentary(tmp_path):
+    """A comment-only directive governs the next SOURCE line even when
+    ordinary commentary sits in between."""
+    report = lint_source(tmp_path, (
+        "def snapshot(self):\n"
+        "    with self.lock:\n"
+        "        # vnlint: disable=sync-under-lock (fixture reason)\n"
+        "        # unrelated commentary between directive and code\n"
+        "        val = self.arr.item()\n"
+        "    return val\n"))
+    assert report.unsuppressed == [], \
+        [f.format() for f in report.findings]
+
+
+def test_rule_subset_keeps_other_suppressions_valid(tmp_path):
+    """--rules <subset> must not flag the tree's suppressions of
+    UNSELECTED rules as bad-suppression."""
+    from veneur_tpu.analysis.rules.literals import MagicLiteral
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        "def snapshot(self):\n"
+        "    with self.lock:\n"
+        "        # vnlint: disable=sync-under-lock (fixture reason)\n"
+        "        val = self.arr.item()\n"
+        "    return val\n")
+    report = LintEngine(rules=[MagicLiteral()]).run([str(root)])
+    assert report.findings == [], \
+        [f.format() for f in report.findings]
+
+
+def test_suppression_unknown_rule_rejected(tmp_path):
+    report = lint_source(tmp_path, (
+        "# vnlint: disable-file=not-a-rule (whatever)\n"
+        "x = 1\n"))
+    assert "bad-suppression" in rules_fired(report)
+
+
+def test_directive_in_docstring_is_prose(tmp_path):
+    report = lint_source(tmp_path, (
+        '"""Docs showing `# vnlint: disable=magic-literal` usage."""\n'
+        "x = 1\n"))
+    assert report.findings == [], \
+        [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing + the self-hosted gate
+# ---------------------------------------------------------------------------
+
+def test_json_report_shape(tmp_path):
+    report = lint_source(tmp_path, DONATION_BUG)
+    d = report.to_dict()
+    assert d["unsuppressed_total"] == 1
+    assert d["counts"] == {"donation-aliasing": 1}
+    (f,) = d["findings"]
+    assert set(f) >= {"rule", "path", "line", "col", "message",
+                      "suppressed"}
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "mod.py").write_text(DONATION_BUG)
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "mod.py").write_text(DONATION_FIXED)
+    out = tmp_path / "report.json"
+    assert vnlint_main([str(bad), "--json", str(out)]) == 1
+    assert out.exists() and "donation-aliasing" in out.read_text()
+    assert vnlint_main([str(good)]) == 0
+    assert vnlint_main(["--list-rules"]) == 0
+    assert vnlint_main(["--rules", "nope"]) == 2
+    capsys.readouterr()
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    report = lint_source(tmp_path, "def broken(:\n")
+    assert rules_fired(report) == {"parse-error"}
+
+
+def test_repo_self_run_is_clean():
+    """The tier-1 gate: the repo lints clean.  A regression in any rule
+    OR a new unsuppressed hazard in the tree fails here first."""
+    report = run_paths([os.path.join(REPO, "veneur_tpu")])
+    assert report.files_scanned > 80
+    bad = [f.format() for f in report.unsuppressed]
+    assert bad == [], "\n".join(bad)
+    # the audited, reasoned suppressions (BASELINE.md round 9): every
+    # one carries its rationale
+    for f in report.findings:
+        if f.suppressed:
+            assert len(f.reason) > 10
+
+
+@pytest.mark.parametrize("rule", [
+    "donation-aliasing", "resource-pairing", "prewarm-parity",
+    "sync-under-lock", "magic-literal"])
+def test_rule_registry_complete(rule):
+    from veneur_tpu.analysis import rule_names
+    assert rule in rule_names()

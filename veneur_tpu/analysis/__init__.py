@@ -1,0 +1,66 @@
+"""vnlint: TPU-hazard static analysis for this repo.
+
+An AST-based lint engine whose rules target the hazard classes this
+codebase has actually shipped and root-caused, so review catches the
+next instance instead of production:
+
+  donation-aliasing   a binding donated to a jit/pmap program
+                      (donate_argnums) is read again after dispatch
+                      without a rebind — the PR-1 set-register donation
+                      race (donated sharded lane-update chains read by
+                      an in-flight flush: corrupted estimates,
+                      interpreter segfaults)
+  resource-pairing    acquire/release pairs (set-lane snapshot pins,
+                      failpoint arm/disarm, PendingFlush
+                      dispatch/emit) whose release is not reachable on
+                      error paths — the PR-3 snapshot-pin leak on
+                      failed dispatch/fetch paths
+  prewarm-parity      prewarm call sites whose abstract signatures
+                      (dtype descriptors / static args) match no live
+                      flush call site of the same jitted callable —
+                      the PR-3 prewarm-signature mismatch that caused
+                      an uncovered in-flush XLA recompile
+  sync-under-lock     implicit device→host syncs (.item(),
+                      block_until_ready, np.asarray, fetch,
+                      float(x[...]), PendingFlush.emit) and blocking
+                      waits (futures.wait, .result(), time.sleep)
+                      inside `with <lock>:` regions or `*_locked`
+                      functions — flush-lock stalls that back up the
+                      ingest path
+  magic-literal       timeouts/retries/backoffs/intervals hard-coded
+                      at call sites in forward/, proxy/ and testbed/
+                      instead of flowing from config — the PR-4
+                      hard-coded-timeout hunt
+
+Run it:
+
+    python -m veneur_tpu.analysis                # lint veneur_tpu/
+    python -m veneur_tpu.analysis path/ --json out.json
+
+Suppress a finding (the reason is MANDATORY — a reasonless suppression
+is itself an error):
+
+    x = thing()  # vnlint: disable=sync-under-lock (flush lock is meant
+                 #   to cover the device wait)
+
+or on its own line above the offending one, or file-wide near the top:
+
+    # vnlint: disable-file=magic-literal (bench driver, not production)
+
+The engine emits a JSON findings report and exits nonzero on any
+unsuppressed finding; `tests/test_vnlint.py` pins each rule to a
+fixture reproducing its historical bug, and the repo's own lint-clean
+state is a tier-1 test.
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.analysis.engine import (  # noqa: F401
+    BAD_SUPPRESSION,
+    Finding,
+    LintEngine,
+    Report,
+    default_target,
+    run_paths,
+)
+from veneur_tpu.analysis.rules import all_rules, rule_names  # noqa: F401

@@ -1,0 +1,71 @@
+"""CLI: `python -m veneur_tpu.analysis [paths...]`.
+
+Exit status: 0 = clean (no unsuppressed findings), 1 = findings,
+2 = bad invocation.  `--json` writes the machine-readable report
+(scripts/check.py consumes it); stdout stays human-oriented.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from veneur_tpu.analysis import engine as engine_mod
+    from veneur_tpu.analysis import rules as rules_mod
+
+    ap = argparse.ArgumentParser(
+        prog="python -m veneur_tpu.analysis",
+        description="vnlint: TPU-hazard static analysis for this repo")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the veneur_tpu "
+                         "package)")
+    ap.add_argument("--json", metavar="FILE",
+                    help="write the JSON findings report here "
+                         "('-' = stdout)")
+    ap.add_argument("--rules", metavar="R1,R2",
+                    help="run only these rules")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule names + descriptions and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    args = ap.parse_args(argv)
+
+    every = rules_mod.all_rules()
+    if args.list_rules:
+        for r in every:
+            print(f"{r.name:18s} {r.description}")
+        return 0
+    rules = every
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.name for r in every}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in every if r.name in wanted]
+
+    report = engine_mod.LintEngine(rules=rules).run(args.paths or None)
+
+    shown = [f for f in report.findings
+             if args.show_suppressed or not f.suppressed]
+    for f in shown:
+        print(f.format())
+    n_bad = len(report.unsuppressed)
+    n_sup = sum(f.suppressed for f in report.findings)
+    print(f"vnlint: {report.files_scanned} files, "
+          f"{n_bad} finding(s), {n_sup} suppressed")
+    if args.json:
+        payload = report.to_json(indent=2) + "\n"
+        if args.json == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload)
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Shared AST helpers for the vnlint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+_PARENT = "_vnlint_parent"
+
+
+def add_parents(tree: ast.AST) -> None:
+    """Attach a parent pointer to every node (walk order is irrelevant;
+    each node has exactly one parent in an AST)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            setattr(child, _PARENT, node)
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, _PARENT, None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(node: ast.AST):
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'self.flush_fn.depth_variant' for an Attribute/Name chain, None
+    for anything dynamic (calls, subscripts) along the chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def call_func_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def int_tuple(node: ast.expr) -> Optional[tuple[int, ...]]:
+    """Resolve a literal donate_argnums-style expression to a tuple of
+    ints.  An IfExp (`(0, 1) if donate else ()`) resolves to the UNION
+    of its branches — the conservative read for donation analysis."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        out = []
+        for elt in node.elts:
+            if (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)
+                    and not isinstance(elt.value, bool)):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(node, ast.IfExp):
+        a = int_tuple(node.body)
+        b = int_tuple(node.orelse)
+        if a is None and b is None:
+            return None
+        return tuple(sorted(set(a or ()) | set(b or ())))
+    return None
+
+
+_DTYPE_PREFIXES = ("self.", "np.", "jnp.", "numpy.", "onp.", "_np.",
+                   "jax.numpy.")
+
+
+def normalize_dtype_text(text: str) -> str:
+    """Canonical comparison form for a dtype-source expression: module
+    aliases and `self.` receivers stripped, so `self.digests.eval_dtype`
+    and `eval_dtype` read via a local compare equal only when the
+    trailing attribute path matches."""
+    t = text.strip()
+    changed = True
+    while changed:
+        changed = False
+        for p in _DTYPE_PREFIXES:
+            if t.startswith(p):
+                t = t[len(p):]
+                changed = True
+    return t
+
+
+def node_source(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.10
+        return "<expr>"
+
+
+def is_constant_num(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool))

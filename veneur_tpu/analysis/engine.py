@@ -1,0 +1,221 @@
+"""vnlint engine: file discovery, parsing, rule driving, suppression
+application, JSON report.
+
+Three passes over the target tree:
+
+  1. parse     every .py file into a `Module` (AST + parent links +
+               suppression directives); syntax errors become findings
+               (rule `parse-error`) instead of crashes
+  2. collect   each rule sees every module and builds project-wide
+               indexes (donated callables, prewarm/live call sites) —
+               cross-module hazards need the whole picture before any
+               verdict
+  3. check     per-module rule checks, then project-wide `finalize`
+               checks; findings then meet the suppression table
+
+Generated code (`protocol/gen/`) and bytecode caches are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from veneur_tpu.analysis import astutil, suppress
+
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+
+_SKIP_DIR_NAMES = {"__pycache__", ".build", ".git", "testdata"}
+_SKIP_REL_PARTS = ("protocol/gen",)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # relative to the lint root
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message,
+             "suppressed": self.suppressed}
+        if self.suppressed:
+            d["reason"] = self.reason
+        return d
+
+    def format(self) -> str:
+        tail = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}{tail}")
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 known_rules: set[str]):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        astutil.add_parents(self.tree)
+        self.suppressions = suppress.parse(source, known_rules)
+        # module stem for cross-module symbol resolution
+        # ("serving.set_lane_scatter" -> stem "serving")
+        base = os.path.basename(relpath)
+        self.stem = ("__init__" if base == "__init__.py"
+                     else base[:-3] if base.endswith(".py") else base)
+        if self.stem == "__init__":
+            # a package __init__ is addressed by its package name
+            self.stem = os.path.basename(os.path.dirname(relpath))
+
+
+@dataclass
+class Report:
+    root: str
+    findings: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            if not f.suppressed:
+                out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "vnlint": 1,
+            "root": self.root,
+            "files_scanned": self.files_scanned,
+            "unsuppressed_total": len(self.unsuppressed),
+            "suppressed_total": sum(f.suppressed for f in self.findings),
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True, **kw)
+
+
+def default_target() -> str:
+    """The package tree itself: `python -m veneur_tpu.analysis` with no
+    arguments lints the production code (scripts/bench are drivers;
+    lint them by passing their paths explicitly)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def discover(paths: Iterable[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIR_NAMES)
+            rel = os.path.relpath(dirpath, p).replace(os.sep, "/")
+            if any(part in rel for part in _SKIP_REL_PARTS):
+                continue
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(os.path.abspath(
+                        os.path.join(dirpath, fn)))
+    # stable order, no duplicates
+    seen: set[str] = set()
+    out = []
+    for f in files:
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out
+
+
+class LintEngine:
+    def __init__(self, rules: Optional[list] = None):
+        from veneur_tpu.analysis.rules import all_rules, rule_names
+        self.rules = all_rules() if rules is None else rules
+        # suppression directives validate against the FULL registry, not
+        # the subset being run: `--rules magic-literal` must not turn
+        # the tree's legitimate suppressions of other rules into
+        # bad-suppression findings
+        self.known_rules = (set(rule_names())
+                            | {r.name for r in self.rules}
+                            | {BAD_SUPPRESSION, PARSE_ERROR})
+
+    def run(self, paths: Optional[Iterable[str]] = None) -> Report:
+        targets = list(paths) if paths else [default_target()]
+        root = (targets[0] if len(targets) == 1
+                and os.path.isdir(targets[0]) else os.getcwd())
+        report = Report(root=os.path.abspath(root))
+        modules: list[Module] = []
+        for path in discover(targets):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                modules.append(Module(path, rel, src, self.known_rules))
+            except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+                line = getattr(e, "lineno", 0) or 0
+                report.findings.append(Finding(
+                    PARSE_ERROR, rel, line, 0,
+                    f"could not parse: {e}"))
+        report.files_scanned = len(modules)
+
+        ctx = ProjectContext(modules)
+        for rule in self.rules:
+            for mod in modules:
+                rule.collect(mod, ctx)
+        raw: list[Finding] = []
+        for rule in self.rules:
+            for mod in modules:
+                raw.extend(rule.check(mod, ctx))
+            raw.extend(rule.finalize(ctx))
+
+        # suppression application + bad-suppression surfacing
+        by_rel = {m.relpath: m for m in modules}
+        for f in raw:
+            mod = by_rel.get(f.path)
+            if mod is not None:
+                reason = mod.suppressions.lookup(f.rule, f.line)
+                if reason is not None:
+                    f.suppressed = True
+                    f.reason = reason
+            report.findings.append(f)
+        for mod in modules:
+            for line, msg in mod.suppressions.bad:
+                report.findings.append(Finding(
+                    BAD_SUPPRESSION, mod.relpath, line, 0, msg))
+        report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return report
+
+
+class ProjectContext:
+    """Cross-module state shared by the rules; each rule namespaces its
+    own entries under an attribute it owns."""
+
+    def __init__(self, modules: list[Module]):
+        self.modules = modules
+        self.by_stem: dict[str, list[Module]] = {}
+        for m in modules:
+            self.by_stem.setdefault(m.stem, []).append(m)
+
+
+def run_paths(paths: Optional[Iterable[str]] = None,
+              rules: Optional[list] = None) -> Report:
+    """Convenience one-shot: lint `paths` (default: the veneur_tpu
+    package) and return the Report."""
+    return LintEngine(rules=rules).run(paths)

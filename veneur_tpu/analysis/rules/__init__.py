@@ -1,0 +1,52 @@
+"""vnlint rule registry.
+
+A rule is three hooks over parsed modules:
+
+    collect(module, ctx)   build cross-module indexes (optional)
+    check(module, ctx)     per-module findings
+    finalize(ctx)          project-wide findings once every module has
+                           been collected (optional)
+
+Adding a rule: subclass Rule in a new module here, set `name` (kebab
+case — it is the suppression token) and `description`, implement the
+hooks, and append it in `all_rules()`.  Pin it with a fixture pair in
+tests/test_vnlint.py: one snippet where it MUST fire, the corrected
+form where it must stay quiet.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from veneur_tpu.analysis.engine import Finding, Module, \
+        ProjectContext
+
+
+class Rule:
+    name: str = ""
+    description: str = ""
+
+    def collect(self, module: "Module", ctx: "ProjectContext") -> None:
+        pass
+
+    def check(self, module: "Module",
+              ctx: "ProjectContext") -> list["Finding"]:
+        return []
+
+    def finalize(self, ctx: "ProjectContext") -> list["Finding"]:
+        return []
+
+
+def all_rules() -> list[Rule]:
+    from veneur_tpu.analysis.rules.donation import DonationAliasing
+    from veneur_tpu.analysis.rules.literals import MagicLiteral
+    from veneur_tpu.analysis.rules.lockguard import SyncUnderLock
+    from veneur_tpu.analysis.rules.pairing import ResourcePairing
+    from veneur_tpu.analysis.rules.prewarm import PrewarmParity
+    return [DonationAliasing(), ResourcePairing(), PrewarmParity(),
+            SyncUnderLock(), MagicLiteral()]
+
+
+def rule_names() -> list[str]:
+    return [r.name for r in all_rules()]

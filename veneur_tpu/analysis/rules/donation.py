@@ -1,0 +1,255 @@
+"""donation-aliasing: read-after-donate on jit/pmap donated arguments.
+
+The PR-1 bug class: a buffer handed to a `jax.jit(...,
+donate_argnums=...)` program is XLA's to reuse the moment the call
+DISPATCHES — on backends honoring donation the caller's array is dead,
+and on PJRT:CPU a sharded donated update chain raced by an in-flight
+reader double-frees (corrupted set estimates, interpreter segfaults).
+Any later read of the donated binding without an intervening rebind is
+therefore a latent race even when today's backend happens to tolerate
+it.
+
+Detection is a two-pass, project-wide dataflow sketch:
+
+  collect   every binding of a donated callable — `f = jax.jit(g,
+            donate_argnums=(0,))`, `functools.partial(jax.jit,
+            donate_argnums=...)(g)` applied or decorating, jax.pmap
+            likewise — indexed by (module stem, name) so call sites in
+            other modules (`serving.set_lane_scatter`) resolve
+  check     per function, statements in source order: a call through a
+            donated callable taints the dotted name passed at each
+            donated position; a Store to that name (including the
+            enclosing `x = f(x)` rebind, because the value is visited
+            before the target) clears the taint; a Load while tainted
+            is the finding
+
+Conditional aliases (`g = donating if ok else copying`) taint
+conservatively — the donating branch COULD run.  Limitations (by
+design, documented): control flow is not modeled, so a read textually
+before the call inside the same loop body is missed, and reads through
+a different alias of the same buffer are invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from veneur_tpu.analysis import astutil
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+_JIT_NAMES = {"jit", "pmap"}
+
+
+def _donate_positions(call: ast.Call) -> Optional[tuple[int, ...]]:
+    """donate_argnums of a jax.jit/jax.pmap call expression, or None if
+    this call donates nothing."""
+    fname = astutil.call_func_name(call.func) if isinstance(
+        call.func, ast.Call) else astutil.call_func_name(call)
+    kw = astutil.keyword_arg(call, "donate_argnums")
+    if kw is None:
+        return None
+    if fname is None:
+        return None
+    leaf = fname.rsplit(".", 1)[-1]
+    if leaf in _JIT_NAMES:
+        tup = astutil.int_tuple(kw)
+        # unresolvable donate expression: assume the canonical arg-0
+        return tup if tup else (0,)
+    if leaf == "partial":
+        # functools.partial(jax.jit, ..., donate_argnums=...)
+        if call.args and astutil.dotted(call.args[0]) and \
+                astutil.dotted(call.args[0]).rsplit(".", 1)[-1] \
+                in _JIT_NAMES:
+            tup = astutil.int_tuple(kw)
+            return tup if tup else (0,)
+    return None
+
+
+def _donating_expr(node: ast.expr) -> Optional[tuple[int, ...]]:
+    """Donated positions if `node` evaluates to a donated callable:
+    a jit/pmap call with donate_argnums, or `partial(jax.jit,
+    donate_argnums=...)(fn)` (partial applied to the target)."""
+    if not isinstance(node, ast.Call):
+        return None
+    pos = _donate_positions(node)
+    if pos is not None:
+        return pos
+    # partial(...)(fn): the donation kwargs live on the inner call
+    if isinstance(node.func, ast.Call):
+        return _donate_positions(node.func)
+    return None
+
+
+class DonationAliasing(Rule):
+    name = "donation-aliasing"
+    description = ("donated jit/pmap argument read again after dispatch "
+                   "without a rebind (PR-1 donation race class)")
+
+    def __init__(self):
+        # (module_stem, name) -> donated positions
+        self.registry: dict[tuple[str, str], tuple[int, ...]] = {}
+
+    # -- pass 1 ------------------------------------------------------------
+
+    def collect(self, module: Module, ctx: ProjectContext) -> None:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                pos = _donating_expr(node.value)
+                if pos is None:
+                    continue
+                for tgt in node.targets:
+                    name = astutil.dotted(tgt)
+                    if name:
+                        self.registry[(module.stem,
+                                       name.rsplit(".", 1)[-1])] = pos
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    pos = (_donating_expr(dec)
+                           if isinstance(dec, ast.Call) else None)
+                    if pos is not None:
+                        self.registry[(module.stem, node.name)] = pos
+
+    # -- pass 2 ------------------------------------------------------------
+
+    def _resolve(self, expr: ast.expr, module: Module,
+                 local_aliases: dict[str, tuple[int, ...]]
+                 ) -> Optional[tuple[int, ...]]:
+        """Donated positions for a callable expression at a call site."""
+        direct = _donating_expr(expr)
+        if direct is not None:
+            return direct
+        if isinstance(expr, ast.IfExp):
+            a = self._resolve(expr.body, module, local_aliases)
+            b = self._resolve(expr.orelse, module, local_aliases)
+            if a is None and b is None:
+                return None
+            return tuple(sorted(set(a or ()) | set(b or ())))
+        name = astutil.dotted(expr)
+        if name is None:
+            return None
+        if name in local_aliases:
+            return local_aliases[name]
+        parts = name.split(".")
+        leaf = parts[-1]
+        # same-module binding (module-level or class-level)
+        if (module.stem, leaf) in self.registry and len(parts) <= 2:
+            # bare name, self.name, or <stem>.name
+            if len(parts) == 1 or parts[0] in ("self", module.stem):
+                return self.registry[(module.stem, leaf)]
+        # cross-module: mod.attr where some scanned module has stem mod
+        if len(parts) >= 2:
+            stem = parts[-2]
+            return self.registry.get((stem, leaf))
+        return None
+
+    def check(self, module: Module,
+              ctx: ProjectContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(node, module))
+        return findings
+
+    def _check_function(self, fn, module: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        tainted: dict[str, tuple[str, int]] = {}  # name -> (callee, line)
+        aliases: dict[str, tuple[int, ...]] = {}
+
+        def clear(name: str) -> None:
+            for key in [k for k in tainted
+                        if k == name or k.startswith(name + ".")
+                        or name.startswith(k + ".")]:
+                tainted.pop(key, None)
+
+        def visit(node: ast.AST, toplevel_fn) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not toplevel_fn:
+                return  # nested defs run later; out of scope
+            if isinstance(node, ast.Assign):
+                visit(node.value, toplevel_fn)
+                pos = self._resolve(node.value, module, aliases)
+                for tgt in node.targets:
+                    self._visit_store(tgt, clear, visit, toplevel_fn)
+                    name = astutil.dotted(tgt)
+                    if name and pos is not None:
+                        aliases[name] = pos
+                return
+            if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    visit(node.value, toplevel_fn)
+                self._visit_store(node.target, clear, visit, toplevel_fn)
+                return
+            if isinstance(node, ast.NamedExpr):
+                visit(node.value, toplevel_fn)
+                clear(astutil.dotted(node.target) or "")
+                return
+            if isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    name = astutil.dotted(tgt)
+                    if name:
+                        clear(name)
+                return
+            if isinstance(node, ast.Call):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, toplevel_fn)
+                pos = self._resolve(node.func, module, aliases)
+                if pos is not None:
+                    callee = (astutil.dotted(node.func)
+                              or astutil.node_source(node.func))
+                    for p in pos:
+                        if p < len(node.args):
+                            name = astutil.dotted(node.args[p])
+                            if name:
+                                tainted[name] = (callee, node.lineno)
+                return
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                name = astutil.dotted(node)
+                if name:
+                    hit_key = name if name in tainted else None
+                    if hit_key is None:
+                        # a read of a PREFIX chain (e.g. `self` or
+                        # `self.obj` when `self.obj.buf` is tainted)
+                        # is fine; a read of a LONGER chain through the
+                        # tainted buffer is not
+                        for tname in tainted:
+                            if name.startswith(tname + "."):
+                                hit_key = tname
+                                break
+                    if hit_key is not None:
+                        callee, line = tainted.pop(hit_key)
+                        findings.append(Finding(
+                            self.name, module.relpath, node.lineno,
+                            node.col_offset,
+                            f"`{name}` was donated to `{callee}` at "
+                            f"line {line} and is read again here "
+                            "without an intervening rebind/copy — the "
+                            "dispatched program may already be reusing "
+                            "its buffer (PR-1 donation race class)"))
+                        return
+                # still walk attribute bases (x.y loads x)
+            for child in ast.iter_child_nodes(node):
+                visit(child, toplevel_fn)
+
+        for stmt in fn.body:
+            visit(stmt, fn)
+        return findings
+
+    @staticmethod
+    def _visit_store(tgt: ast.expr, clear, visit, toplevel_fn) -> None:
+        """A Store clears taint for the stored dotted name; tuple
+        targets recurse; subscript stores evaluate their index
+        expressions (Loads) but clear nothing."""
+        name = astutil.dotted(tgt)
+        if name:
+            clear(name)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                DonationAliasing._visit_store(elt, clear, visit,
+                                              toplevel_fn)
+            return
+        visit(tgt, toplevel_fn)

@@ -1,0 +1,123 @@
+"""magic-literal: timeouts/retries/sizes hard-coded at call sites.
+
+The PR-4 bug class: `proxy/connect.py` carried bare `30.0`/`5.0` send
+and dial deadlines that had to be hunted down one by one before the
+testbed could run sub-second chaos intervals.  Any tuning literal that
+bypasses `config.py` (or a named module-level constant) is invisible
+to operators and un-overridable by tests.
+
+Scope: the wire-facing trees where the class actually bit —
+`forward/`, `proxy/`, `testbed/`.  Flagged:
+
+  - a numeric literal passed as a keyword argument whose name smells
+    like tuning (`timeout`, `deadline`, `retry`, `attempts`,
+    `backoff`, `interval`, `grace`, `cooldown`, `threshold`,
+    `capacity`, `max_*`, `chunk`, `poll`);
+  - `time.sleep(<literal>)` above 0.25 s (sub-quarter-second poll
+    ticks are loop mechanics, not tuning).
+
+Exempt, because they ARE the named-knob pattern the rule pushes
+toward: function-signature defaults, fields of `*Config`/`*Spec`/
+`*Policy`/`*Options` class bodies, constructor calls OF such classes,
+assignments to UPPER_CASE module constants, and config plumbing calls
+(`.get(...)`, `parse_duration(...)`, `min`/`max` clamps).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from veneur_tpu.analysis import astutil
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+_SCOPES = ("forward/", "proxy/", "testbed/")
+_TUNING_KW = re.compile(
+    r"(timeout|deadline|retr(y|ies)|attempt|backoff|interval|grace"
+    r"|cooldown|threshold|capacity|max_|chunk|poll|expiry|ttl)",
+    re.IGNORECASE)
+_CONFIGISH = re.compile(r"(Config|Spec|Policy|Options)$")
+_EXEMPT_FUNCS = {"get", "parse_duration", "min", "max", "setdefault"}
+_SLEEP_FLOOR = 0.25
+
+
+def _in_scope(relpath: str) -> bool:
+    return any(f"/{s}" in f"/{relpath}" for s in _SCOPES)
+
+
+class MagicLiteral(Rule):
+    name = "magic-literal"
+    description = ("tuning literal at a call site bypasses config.py "
+                   "(PR-4 hard-coded-timeout class)")
+
+    def check(self, module: Module,
+              ctx: ProjectContext) -> list[Finding]:
+        if not _in_scope(module.relpath):
+            return []
+        findings: list[Finding] = []
+        exempt_spans = self._exempt_spans(module.tree)
+        for call in (n for n in ast.walk(module.tree)
+                     if isinstance(n, ast.Call)):
+            if self._call_exempt(call):
+                continue
+            if any(lo <= call.lineno <= hi for lo, hi in exempt_spans):
+                continue
+            findings.extend(self._check_call(call, module))
+        return findings
+
+    @staticmethod
+    def _exempt_spans(tree: ast.AST) -> list[tuple[int, int]]:
+        """Line spans of signature-default lists and config-class
+        bodies."""
+        spans: list[tuple[int, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and _CONFIGISH.search(
+                    node.name):
+                spans.append((node.lineno,
+                              node.end_lineno or node.lineno))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                args = node.args
+                defaults = list(args.defaults) + [
+                    d for d in args.kw_defaults if d is not None]
+                for d in defaults:
+                    spans.append((d.lineno, d.end_lineno or d.lineno))
+        return spans
+
+    @staticmethod
+    def _call_exempt(call: ast.Call) -> bool:
+        fname = astutil.call_func_name(call)
+        if fname is None:
+            return False
+        leaf = fname.rsplit(".", 1)[-1]
+        return leaf in _EXEMPT_FUNCS or bool(_CONFIGISH.search(leaf))
+
+    def _check_call(self, call: ast.Call,
+                    module: Module) -> list[Finding]:
+        out: list[Finding] = []
+        fname = astutil.call_func_name(call) or "<call>"
+        for kw in call.keywords:
+            if kw.arg and _TUNING_KW.search(kw.arg) \
+                    and astutil.is_constant_num(kw.value) \
+                    and kw.value.value != 0:
+                out.append(Finding(
+                    self.name, module.relpath, kw.value.lineno,
+                    kw.value.col_offset,
+                    f"`{kw.arg}={kw.value.value!r}` hard-coded at the "
+                    f"`{fname}(...)` call site — route it through "
+                    "config.py (or a named module constant) so "
+                    "operators and tests can tune it (PR-4 timeout "
+                    "class)"))
+        leaf = fname.rsplit(".", 1)[-1]
+        base = fname.rsplit(".", 1)[0] if "." in fname else ""
+        if leaf == "sleep" and base in ("time", "") and call.args \
+                and astutil.is_constant_num(call.args[0]) \
+                and call.args[0].value > _SLEEP_FLOOR:
+            out.append(Finding(
+                self.name, module.relpath, call.lineno,
+                call.col_offset,
+                f"`{fname}({call.args[0].value!r})` hard-coded delay — "
+                "name it or make it configurable (PR-4 timeout "
+                "class)"))
+        return out

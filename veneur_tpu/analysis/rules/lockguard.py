@@ -1,0 +1,188 @@
+"""sync-under-lock: device waits and blocking calls inside lock regions.
+
+The hazard: the aggregator/server locks serialize the INGEST hot path.
+A device→host sync (`.item()`, `block_until_ready`, `np.asarray` of a
+device array, `serving.fetch`, `float(x[...])`, `PendingFlush.emit`)
+or a blocking wait (`concurrent.futures.wait`, future `.result()`,
+`time.sleep`, thread `.join(timeout=...)`, `urlopen`) executed while
+one is held turns a multi-second XLA compile or a congested PCIe link
+into dropped packets.  `Server._flush_locked` is the canonical region:
+everything it awaits is time the flush serialization lock is
+unavailable.
+
+Lock regions are found lexically:
+
+  - `with <expr>:` where the context expression's dotted name smells
+    like a lock (`lock`, `mutex`, `flock`, `serial`, `_cv`) — each
+    `with` item is checked independently;
+  - whole bodies of functions named `*_locked` (the repo's convention
+    for "caller holds the lock").
+
+Nested function definitions inside a region are skipped (they execute
+later, not under the lock).  The pattern table errs toward precision:
+`np.asarray` of a staged host list is a false positive the suppression
+syntax exists for, but generic `.send()`/`.wait()` (generators,
+condvars) stay out entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from veneur_tpu.analysis import astutil
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+_LOCKISH = re.compile(r"(^|[._])(_?lock|mutex|flock|serial|cv)\b|"
+                      r"(^|[._])_?(lock|mutex)$", re.IGNORECASE)
+
+
+def _lockish(name: str | None) -> bool:
+    return bool(name and _LOCKISH.search(name))
+
+
+_HOST_LITERALS = (ast.List, ast.ListComp, ast.Tuple, ast.Dict,
+                  ast.GeneratorExp, ast.Constant)
+
+
+def _host_list_names(fn) -> set[str]:
+    """Names in `fn` whose every assignment is a list/tuple literal or
+    comprehension — `np.asarray` of those is a host conversion, not a
+    device fetch."""
+    assigns: dict[str, bool] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            pairs = []
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Tuple, ast.List)) \
+                        and isinstance(node.value, ast.Tuple) \
+                        and len(node.value.elts) == len(tgt.elts):
+                    pairs.extend(zip(tgt.elts, node.value.elts))
+                else:
+                    pairs.append((tgt, node.value))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            pairs = [(node.target, node.value)]
+        else:
+            continue
+        for t, v in pairs:
+            if isinstance(t, ast.Name):
+                host = isinstance(v, _HOST_LITERALS)
+                assigns[t.id] = assigns.get(t.id, True) and host
+    return {n for n, host in assigns.items() if host}
+
+
+def _describe_call(call: ast.Call, host_lists: set[str]) -> str | None:
+    """The matched hazard, or None.  Returns a short label."""
+    fname = astutil.call_func_name(call)
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        base = astutil.dotted(call.func.value) or ""
+        if attr == "item" and not call.args:
+            return "device sync `.item()`"
+        if attr == "block_until_ready":
+            return "device sync `.block_until_ready()`"
+        if attr == "asarray" and base.rsplit(".", 1)[-1] in (
+                "np", "numpy", "_np", "onp"):
+            arg = call.args[0] if call.args else None
+            if isinstance(arg, _HOST_LITERALS):
+                return None  # literal/comprehension: host data
+            if isinstance(arg, ast.Name) and arg.id in host_lists:
+                return None  # provably a host-built list
+            return f"host fetch `{base}.asarray(...)`"
+        if attr == "device_get":
+            return "device sync `jax.device_get(...)`"
+        if attr == "fetch" and base.rsplit(".", 1)[-1] == "serving":
+            return "device sync `serving.fetch(...)`"
+        if attr == "emit" and "pend" in base.lower():
+            return f"device wait `{base}.emit()` (PendingFlush fetch)"
+        if attr == "wait" and "futures" in base:
+            return f"blocking wait `{fname}(...)`"
+        if attr == "result":
+            return f"blocking future wait `{fname}(...)`"
+        if attr == "sleep" and base.rsplit(".", 1)[-1] == "time":
+            return "blocking `time.sleep(...)`"
+        if attr == "join" and not isinstance(call.func.value,
+                                             ast.Constant) \
+                and "path" not in base \
+                and astutil.keyword_arg(call, "timeout") is not None:
+            return f"blocking thread join `{fname}(...)`"
+        if attr == "urlopen":
+            return "network call `urlopen(...)`"
+        return None
+    if isinstance(call.func, ast.Name):
+        if call.func.id == "fetch":
+            return "device sync `fetch(...)`"
+        if call.func.id == "urlopen":
+            return "network call `urlopen(...)`"
+        if call.func.id == "float" and call.args and isinstance(
+                call.args[0], ast.Subscript):
+            return "device sync `float(<array>[...])`"
+    return None
+
+
+class SyncUnderLock(Rule):
+    name = "sync-under-lock"
+    description = ("implicit device→host sync or blocking call inside "
+                   "a lock region (ingest-stall class)")
+
+    def check(self, module: Module,
+              ctx: ProjectContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    name = astutil.dotted(item.context_expr)
+                    if name is None and isinstance(
+                            item.context_expr, ast.Call):
+                        # e.g. `with lock_for(x):` — look at the callee
+                        name = astutil.call_func_name(item.context_expr)
+                    if _lockish(name):
+                        fn = astutil.enclosing_function(node)
+                        hosts = _host_list_names(fn) if fn else set()
+                        findings.extend(self._scan_region(
+                            node.body, module, hosts,
+                            f"lock region `with {name}:`"))
+                        break
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    and node.name.endswith("_locked"):
+                findings.extend(self._scan_region(
+                    node.body, module, _host_list_names(node),
+                    f"`{node.name}` (runs with the caller's lock "
+                    "held)"))
+        # dedup: a with-region inside a *_locked function reports once
+        # (same call node = same line/col; the region description may
+        # differ between the two scans, so it stays out of the key)
+        seen: set[tuple[int, int]] = set()
+        out = []
+        for f in findings:
+            k = (f.line, f.col)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        return out
+
+    def _scan_region(self, body: list[ast.stmt], module: Module,
+                     host_lists: set[str], where: str) -> list[Finding]:
+        findings: list[Finding] = []
+
+        def walk(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # deferred execution
+            if isinstance(node, ast.Call):
+                label = _describe_call(node, host_lists)
+                if label is not None:
+                    findings.append(Finding(
+                        self.name, module.relpath, node.lineno,
+                        node.col_offset,
+                        f"{label} inside {where} — the lock is held "
+                        "across a wait the ingest path may be queued "
+                        "behind"))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        for stmt in body:
+            walk(stmt)
+        return findings

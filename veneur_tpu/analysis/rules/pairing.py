@@ -1,0 +1,238 @@
+"""resource-pairing: acquire/release pairing on ALL paths, not just the
+happy one.
+
+The PR-3 bug class: `SetArena.snapshot_lanes()` pins the lane
+registers (lane updates reroute through copying kernels) and the unpin
+lived only on the straight-line path — a failed dispatch or fetch
+leaked the pin, leaving the copying kernels engaged for the process
+lifetime.  The same shape recurs for failpoint arm/disarm and
+PendingFlush dispatch/emit (an un-emitted flush never fetches, so the
+interval's accounting and the next dispatch's snapshot invariants are
+both off).
+
+Per acquire call site the rule demands ONE of:
+
+  - the acquire is the context expression of a `with` (RAII);
+  - a matching release in the `finally` of a try enclosing the window,
+    or releases on BOTH an except handler and the normal path;
+  - the release is chained in the same expression (`acquire().emit()`)
+    or is the immediately following statement (nothing in between can
+    raise);
+  - the acquired value ESCAPES the function — returned, yielded, or
+    stored into an attribute/subscript/collection, i.e. ownership
+    moves to a peer that the matching release sites consume (the
+    snapshot dict handed from `_snapshot_and_reset` to the emit path).
+
+Anything else is a leak-on-exception and gets flagged at the acquire.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Optional
+
+from veneur_tpu.analysis import astutil
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+
+@dataclass(frozen=True)
+class PairSpec:
+    label: str
+    acquires: frozenset
+    releases: frozenset
+    # substring the dotted callee must contain for BARE-name acquire
+    # calls ("configure" alone is too generic — require the failpoints
+    # module in the chain, or the failpoints module itself)
+    acquire_base_hint: str = ""
+
+    def is_acquire(self, call: ast.Call, module_stem: str) -> bool:
+        name = astutil.call_func_name(call)
+        if name is None:
+            return False
+        parts = name.split(".")
+        if parts[-1] not in self.acquires:
+            return False
+        if self.acquire_base_hint:
+            base = ".".join(parts[:-1])
+            if self.acquire_base_hint not in base \
+                    and self.acquire_base_hint not in module_stem:
+                return False
+        return True
+
+    def is_release(self, call: ast.Call) -> bool:
+        name = astutil.call_func_name(call)
+        return (name is not None
+                and name.split(".")[-1] in self.releases)
+
+
+PAIRS = (
+    PairSpec("set-lane snapshot pin",
+             frozenset({"snapshot_lanes"}), frozenset({"unpin_lanes"})),
+    PairSpec("failpoint arm",
+             frozenset({"configure"}),
+             frozenset({"disarm", "clear"}),
+             acquire_base_hint="failpoint"),
+    PairSpec("pending flush",
+             frozenset({"flush_dispatch"}), frozenset({"emit"})),
+)
+
+
+def _stmt_of(node: ast.AST) -> ast.stmt:
+    cur = node
+    for anc in astutil.ancestors(node):
+        if isinstance(anc, ast.stmt):
+            return anc
+        cur = anc
+    return cur  # pragma: no cover
+
+
+class ResourcePairing(Rule):
+    name = "resource-pairing"
+    description = ("acquire without release on error paths: snapshot "
+                   "pins, failpoint arms, PendingFlush dispatch/emit "
+                   "(PR-3 pin-leak class)")
+
+    def check(self, module: Module,
+              ctx: ProjectContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in (n for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            for spec in PAIRS:
+                findings.extend(self._check_pair(fn, spec, module))
+        return findings
+
+    def _check_pair(self, fn, spec: PairSpec,
+                    module: Module) -> list[Finding]:
+        acquires = [n for n in ast.walk(fn)
+                    if isinstance(n, ast.Call)
+                    and spec.is_acquire(n, module.stem)
+                    and astutil.enclosing_function(n) is fn]
+        if not acquires:
+            return []
+        releases = [n for n in ast.walk(fn)
+                    if isinstance(n, ast.Call) and spec.is_release(n)
+                    and astutil.enclosing_function(n) is fn]
+        out: list[Finding] = []
+        for acq in acquires:
+            verdict = self._classify(fn, acq, releases, spec)
+            if verdict is not None:
+                out.append(Finding(
+                    self.name, module.relpath, acq.lineno,
+                    acq.col_offset,
+                    f"{spec.label}: "
+                    f"`{astutil.call_func_name(acq)}` {verdict} "
+                    f"(release: {'/'.join(sorted(spec.releases))}; "
+                    "PR-3 snapshot-pin-leak class — release in a "
+                    "finally, or hand the value off)"))
+        return out
+
+    def _classify(self, fn, acq: ast.Call, releases: list[ast.Call],
+                  spec: PairSpec) -> Optional[str]:
+        """None when safely paired, else the complaint text."""
+        # chained release in the same expression:
+        # self.flush_dispatch(...).emit()
+        par = astutil.parent(acq)
+        if isinstance(par, ast.Attribute) and par.attr in spec.releases:
+            return None
+        # `with acquire() as x:` — RAII
+        if isinstance(par, ast.withitem):
+            return None
+        if self._escapes(acq):
+            return None
+        if not releases:
+            return ("is acquired but never released in this function, "
+                    "and its result does not escape")
+        acq_stmt = _stmt_of(acq)
+        prot_tries = [t for r in releases
+                      for t in [self._protecting_try(fn, r)]
+                      if t is not None]
+        if prot_tries:
+            # the protecting try must BEGIN before anything that can
+            # raise, or the window between acquire and try leaks
+            first_try = min(prot_tries, key=lambda t: t.lineno)
+            if first_try.lineno <= (acq_stmt.end_lineno
+                                    or acq_stmt.lineno):
+                return None  # acquire itself inside the try
+            if self._raisers_between(fn, acq_stmt, first_try, releases):
+                return ("is released in a finally/except, but the "
+                        "protecting try begins only AFTER other calls "
+                        "that can raise — a failure in that window "
+                        "leaks the acquire")
+            return None
+        # releases exist but only on the fall-through path: safe only
+        # if nothing between acquire and the first release can raise
+        rel_stmts = sorted((_stmt_of(r) for r in releases
+                            if r.lineno >= acq.lineno),
+                           key=lambda s: s.lineno)
+        if not rel_stmts:
+            return ("is released only BEFORE the acquire in source "
+                    "order — no release is reachable after it")
+        first_rel = rel_stmts[0]
+        if self._raisers_between(fn, acq_stmt, first_rel, releases):
+            return ("is released only on the fall-through path; an "
+                    "exception between acquire and release leaks it")
+        return None
+
+    @staticmethod
+    def _escapes(acq: ast.Call) -> bool:
+        """Ownership transfer: the acquired value is returned/yielded,
+        stored into an attribute/subscript/collection, or passed
+        straight into another call."""
+        node: ast.AST = acq
+        for anc in astutil.ancestors(acq):
+            if isinstance(anc, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return True
+            if isinstance(anc, ast.Call) and node is not anc.func:
+                return True  # argument to another call
+            if isinstance(anc, ast.Assign):
+                return any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in anc.targets)
+            if isinstance(anc, (ast.Dict, ast.List, ast.Tuple, ast.Set)):
+                return True
+            if isinstance(anc, ast.stmt):
+                return False
+            node = anc
+        return False
+
+    @staticmethod
+    def _protecting_try(fn, release: ast.Call):
+        """The Try whose finally (or except handler) holds this
+        release, or None for a fall-through release."""
+        handler = None
+        for anc in astutil.ancestors(release):
+            if anc is fn:
+                return None
+            if isinstance(anc, ast.Try):
+                if any(ResourcePairing._contains(s, release)
+                       for s in anc.finalbody):
+                    return anc
+                if handler is not None and handler in anc.handlers:
+                    return anc
+            if isinstance(anc, ast.ExceptHandler):
+                handler = anc
+        return None
+
+    @staticmethod
+    def _contains(tree: ast.AST, target: ast.AST) -> bool:
+        return any(n is target for n in ast.walk(tree))
+
+    @staticmethod
+    def _raisers_between(fn, acq_stmt: ast.stmt, rel_stmt: ast.stmt,
+                         releases: list[ast.Call]) -> bool:
+        """Any call or raise strictly between acquire and release (by
+        line span, excluding both statements and the release calls
+        themselves)?"""
+        lo = acq_stmt.end_lineno or acq_stmt.lineno
+        hi = rel_stmt.lineno
+        release_set = set(map(id, releases))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Call, ast.Raise)) \
+                    and id(node) not in release_set:
+                line = node.lineno
+                if lo < line < hi:
+                    return True
+        return False

@@ -1,0 +1,324 @@
+"""prewarm-parity: prewarm signatures must match a live call site.
+
+The PR-3 bug class: `MetricAggregator.prewarm` AOT-compiled the
+general flush program with a weight struct in the STAGING dtype while
+the live flush uploaded weights in the EVAL dtype — the prewarmed jit
+signature never matched, and the first production flush paid the
+multi-second XLA compile inside a flush interval (exactly what prewarm
+exists to prevent).  The mismatch is invisible at runtime until a
+latency SLO blows; statically it is a comparison of dtype expressions.
+
+Mechanics (project-wide, best-effort):
+
+  collect   * prewarm sites: calls of `<callable>.lower(...)` /
+              `.lower_donated(...)` (directly or through a local alias,
+              incl. `a if donate else b` picking the donated twin)
+              inside any function whose name contains "prewarm";
+              positional `jax.ShapeDtypeStruct` args resolve — through
+              simple local assignments — to a DTYPE DESCRIPTOR (the
+              normalized source text of the dtype expression)
+            * live sites: every other call whose canonical callable
+              path (`self.` stripped, `_donated` suffixes folded)
+              matches a prewarm site's; argument dtype descriptors
+              resolve through `x.astype(D)`, `np.zeros(..., D)`,
+              `np.asarray(x, D)`, `np.full(..., dtype=D)` and
+              ShapeDtypeStruct locals
+  finalize  for each prewarm site: among live sites of the same
+            callable AND positional arity, every RESOLVED prewarm slot
+            descriptor must appear among the live descriptors for that
+            slot, and literal static kwargs shared by both sides must
+            agree.  A prewarm site whose arity matches no live site at
+            all is flagged too — it compiles a program production never
+            launches while leaving the real shape uncovered.
+
+Unresolvable descriptors (conditionals, cross-module builders) are
+skipped, never guessed: the rule prefers silence to noise, and the
+fixture in tests/test_vnlint.py pins the resolvable shape of the
+historical bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from veneur_tpu.analysis import astutil
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+_LOWER = {"lower", "lower_donated"}
+
+
+def _canon_callable(text: str) -> str:
+    """Canonical callable path: strip `self.`, fold donated twins."""
+    parts = [p[:-len("_donated")] if p.endswith("_donated") else p
+             for p in text.split(".")]
+    if parts and parts[0] == "self":
+        parts = parts[1:]
+    return ".".join(p for p in parts if p)
+
+
+@dataclass
+class Site:
+    module: str
+    line: int
+    col: int
+    key: str
+    arity: int
+    # slot index -> dtype descriptor (None = unresolved)
+    slots: list
+    static_kwargs: dict = field(default_factory=dict)
+
+
+class _Env:
+    """Last simple assignment per local name, in source order — enough
+    to chase `dt = self.digests.eval_dtype` chains without a real
+    dataflow engine."""
+
+    def __init__(self, fn):
+        self.assign: dict[str, ast.expr] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name in self.assign:
+                    self.assign[name] = _AMBIGUOUS
+                else:
+                    self.assign[name] = node.value
+
+    def resolve(self, expr: ast.expr, depth: int = 0
+                ) -> Optional[ast.expr]:
+        if depth > 8 or expr is _AMBIGUOUS:
+            return None
+        if isinstance(expr, ast.Name):
+            nxt = self.assign.get(expr.id)
+            if nxt is None or nxt is _AMBIGUOUS:
+                return None
+            return self.resolve(nxt, depth + 1) or nxt
+        return expr
+
+
+_AMBIGUOUS = ast.Constant(value=...)  # sentinel
+
+
+def _dtype_descriptor(env: _Env, expr: ast.expr) -> Optional[str]:
+    """Descriptor of the dtype SOURCE for an argument expression."""
+    resolved = env.resolve(expr) if isinstance(expr, ast.Name) else expr
+    if resolved is None:
+        return None
+    e = resolved
+    if isinstance(e, ast.Call):
+        fname = astutil.call_func_name(e) or ""
+        leaf = fname.rsplit(".", 1)[-1]
+        if leaf == "ShapeDtypeStruct" and (len(e.args) >= 2
+                                           or astutil.keyword_arg(
+                                               e, "dtype")):
+            d = (e.args[1] if len(e.args) >= 2
+                 else astutil.keyword_arg(e, "dtype"))
+            return _dtype_text(env, d)
+        if leaf == "astype" and e.args:
+            return _dtype_text(env, e.args[0])
+        if leaf in ("zeros", "ones", "empty", "full", "asarray",
+                    "array"):
+            kw = astutil.keyword_arg(e, "dtype")
+            if kw is not None:
+                return _dtype_text(env, kw)
+            if leaf in ("zeros", "ones", "empty") and len(e.args) >= 2:
+                return _dtype_text(env, e.args[1])
+            if leaf in ("asarray", "array") and len(e.args) >= 2:
+                return _dtype_text(env, e.args[1])
+    return None
+
+
+def _dtype_text(env: _Env, expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        chased = env.resolve(expr)
+        if chased is not None and not isinstance(chased, ast.IfExp):
+            expr = chased
+        elif chased is None:
+            return None
+        else:
+            return None  # conditional dtype: never guess
+    if isinstance(expr, ast.IfExp):
+        return None
+    name = astutil.dotted(expr)
+    if name is None:
+        return None
+    return astutil.normalize_dtype_text(name)
+
+
+def _lower_target(env: _Env, call: ast.Call) -> Optional[str]:
+    """Canonical callable key if `call` is a prewarm lowering call."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOWER:
+        base = astutil.dotted(fn.value)
+        if base is None and isinstance(fn.value, ast.Name):
+            base = fn.value.id
+        if base is not None:
+            resolved = _resolve_alias(env, base)
+            return resolved
+    if isinstance(fn, ast.Name):
+        resolved = _resolve_alias(env, fn.id)
+        return resolved
+    return None
+
+
+def _resolve_alias(env: _Env, name: str) -> Optional[str]:
+    """Chase `dg = self.f.lower_donated if d else self.f.lower` style
+    aliases down to a canonical callable key, or canonicalize a direct
+    dotted path that ends in a lower/donated leaf."""
+
+    def canon_expr(e: ast.expr) -> Optional[str]:
+        d = astutil.dotted(e)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[-1] in _LOWER:
+            parts = parts[:-1]
+        return _canon_callable(".".join(parts))
+
+    top = name.split(".")[0]
+    bound = env.assign.get(top)
+    if bound is not None and bound is not _AMBIGUOUS \
+            and name == top:
+        if isinstance(bound, ast.IfExp):
+            a = canon_expr(bound.body)
+            b = canon_expr(bound.orelse)
+            if a is not None and a == b:
+                return a
+            return None
+        c = canon_expr(bound)
+        if c is not None:
+            return c
+        return None
+    # dotted path used directly
+    parts = name.split(".")
+    if parts[-1] in _LOWER:
+        parts = parts[:-1]
+    out = _canon_callable(".".join(parts))
+    return out or None
+
+
+class PrewarmParity(Rule):
+    name = "prewarm-parity"
+    description = ("prewarm abstract signature matches no live call "
+                   "site of the same jitted callable (PR-3 in-flush "
+                   "recompile class)")
+
+    def __init__(self):
+        self.prewarm_sites: list[Site] = []
+        self.live_sites: dict[str, list[Site]] = {}
+
+    def collect(self, module: Module, ctx: ProjectContext) -> None:
+        for fn in (n for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            env = _Env(fn)
+            in_prewarm = "prewarm" in fn.name
+            for call in (n for n in ast.walk(fn)
+                         if isinstance(n, ast.Call)):
+                if astutil.enclosing_function(call) is not fn:
+                    continue
+                if in_prewarm:
+                    key = _lower_target(env, call)
+                    is_lower = (isinstance(call.func, ast.Attribute)
+                                and call.func.attr in _LOWER) or (
+                        isinstance(call.func, ast.Name)
+                        and self._alias_is_lowerish(env, call.func.id))
+                    if key and is_lower:
+                        self.prewarm_sites.append(self._site(
+                            module, call, key, env))
+                        continue
+                self._collect_live(module, fn, env, call)
+
+    @staticmethod
+    def _alias_is_lowerish(env: _Env, name: str) -> bool:
+        bound = env.assign.get(name)
+        if bound is None or bound is _AMBIGUOUS:
+            return False
+        exprs = ([bound.body, bound.orelse]
+                 if isinstance(bound, ast.IfExp) else [bound])
+        for e in exprs:
+            d = astutil.dotted(e)
+            if d is None:
+                return False
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf not in _LOWER and not leaf.endswith("_donated") \
+                    and "variant" not in leaf:
+                return False
+        return True
+
+    def _collect_live(self, module: Module, fn, env: _Env,
+                      call: ast.Call) -> None:
+        fname = astutil.call_func_name(call)
+        if fname is None:
+            # alias call: `fn(dvd, depd, pct)` with fn = <ifexp>
+            if isinstance(call.func, ast.Name):
+                fname = call.func.id
+            else:
+                return
+        if isinstance(call.func, ast.Name):
+            resolved = _resolve_alias(env, call.func.id)
+            key = resolved if resolved else _canon_callable(fname)
+        else:
+            parts = fname.split(".")
+            if parts[-1] in _LOWER:
+                return  # lowering outside prewarm: not a live launch
+            key = _canon_callable(fname)
+        if not key:
+            return
+        self.live_sites.setdefault(key, []).append(
+            self._site(module, call, key, env))
+
+    @staticmethod
+    def _site(module: Module, call: ast.Call, key: str,
+              env: _Env) -> Site:
+        slots = [_dtype_descriptor(env, a) for a in call.args]
+        kwargs = {}
+        for kw in call.keywords:
+            if kw.arg and isinstance(kw.value, ast.Constant):
+                kwargs[kw.arg] = kw.value.value
+        return Site(module.relpath, call.lineno, call.col_offset, key,
+                    len(call.args), slots, kwargs)
+
+    def finalize(self, ctx: ProjectContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for pw in self.prewarm_sites:
+            lives = self.live_sites.get(pw.key, [])
+            if not lives:
+                continue  # callable never launched in linted tree
+            peers = [lv for lv in lives if lv.arity == pw.arity]
+            if not peers:
+                findings.append(Finding(
+                    self.name, pw.module, pw.line, pw.col,
+                    f"prewarm lowers `{pw.key}` with {pw.arity} "
+                    "positional args but no live call site of that "
+                    "callable has that arity — the compiled program "
+                    "can never be the one production launches"))
+                continue
+            for i, desc in enumerate(pw.slots):
+                if desc is None:
+                    continue
+                live_descs = {lv.slots[i] for lv in peers
+                              if lv.slots[i] is not None}
+                if live_descs and desc not in live_descs:
+                    findings.append(Finding(
+                        self.name, pw.module, pw.line, pw.col,
+                        f"prewarm builds arg {i} of `{pw.key}` from "
+                        f"dtype `{desc}` but live call sites build it "
+                        f"from {sorted(live_descs)} — the prewarmed "
+                        "signature will never match and the first "
+                        "live flush pays the XLA compile (PR-3 "
+                        "in-flush recompile)"))
+            for kname, kval in pw.static_kwargs.items():
+                live_vals = {lv.static_kwargs[kname] for lv in peers
+                             if kname in lv.static_kwargs}
+                if live_vals and kval not in live_vals:
+                    findings.append(Finding(
+                        self.name, pw.module, pw.line, pw.col,
+                        f"prewarm passes static {kname}={kval!r} to "
+                        f"`{pw.key}` but live call sites pass "
+                        f"{sorted(map(repr, live_vals))} — distinct "
+                        "static args compile distinct programs"))
+        return findings

@@ -1,0 +1,145 @@
+"""Inline suppression parsing: `# vnlint: disable=<rules> (reason)`.
+
+A suppression applies to findings on its own line; a comment-ONLY line
+annotates the next source line (so long findings can carry a readable
+rationale above them).  `disable-file=` applies to the whole file.  The
+parenthesised reason is MANDATORY: a suppression without one does not
+take effect and is itself reported (rule `bad-suppression`, which can
+never be suppressed) — an unexplained mute is exactly the kind of
+folklore this linter exists to kill.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+SUPPRESS_RE = re.compile(
+    r"#\s*vnlint:\s*(disable(?:-file)?)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\- ]+?)\s*"
+    r"(?:\((?P<reason>.+)\))?\s*$")
+
+# Loose detector: anything that *tries* to talk to vnlint but fails the
+# strict grammar must surface as bad-suppression, not silently lint.
+ATTEMPT_RE = re.compile(r"#\s*vnlint\s*:")
+
+
+@dataclass
+class Suppressions:
+    """Parsed suppression state for one file."""
+    # line -> {rule -> reason}; the line a directive GOVERNS (already
+    # shifted for comment-only lines)
+    by_line: dict[int, dict[str, str]] = field(default_factory=dict)
+    # rule -> reason for file-wide directives
+    file_wide: dict[str, str] = field(default_factory=dict)
+    # (line, message) for malformed / reasonless directives
+    bad: list[tuple[int, str]] = field(default_factory=list)
+
+    def lookup(self, rule: str, line: int) -> str | None:
+        """Reason iff `rule` is suppressed at `line`, else None."""
+        reason = self.file_wide.get(rule)
+        if reason is None:
+            reason = self.by_line.get(line, {}).get(rule)
+        return reason
+
+
+def _comments(source: str, lines: list[str]) -> dict[int, str]:
+    """line -> comment text, from REAL comment tokens only (a
+    '# vnlint:' inside a docstring or string literal is prose, not a
+    directive).  Falls back to a naive scan if tokenization fails —
+    the engine reports syntax errors separately."""
+    import io
+    import tokenize
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        for i, raw in enumerate(lines, start=1):
+            if raw.lstrip().startswith("#"):
+                out[i] = raw.strip()
+    return out
+
+
+def parse(source: str, known_rules: set[str]) -> Suppressions:
+    sup = Suppressions()
+    lines = source.splitlines()
+    comments = _comments(source, lines)
+    # A directive on a comment-only line may be CONTINUED by further
+    # comment-only lines (reason wrapped over several lines); the
+    # directive then governs the first non-comment line after the run.
+    for i in sorted(comments):
+        raw = comments[i]
+        if not ATTEMPT_RE.search(raw):
+            continue
+        comment_only = lines[i - 1].strip().startswith("#")
+        m = SUPPRESS_RE.search(raw)
+        end = i
+        if m is None:
+            # possibly a wrapped reason: directive line without the
+            # closing paren — join following comment-only lines (works
+            # for both the comment-only and the inline trailing form)
+            joined, end = _join_comment_run(lines, comments, i)
+            m = SUPPRESS_RE.search(joined)
+            if m is None:
+                sup.bad.append(
+                    (i, "malformed vnlint directive (expected "
+                        "'# vnlint: disable=<rule,...> (reason)')"))
+                continue
+        # an inline directive governs its own line; a comment-only one
+        # governs the next SOURCE line after the comment run (further
+        # commentary/blank lines in between don't swallow it)
+        target_line = _next_code_line(lines, end) if comment_only else i
+        kind = m.group(1)
+        reason = (m.group("reason") or "").strip()
+        rules = [r.strip() for r in m.group("rules").split(",")
+                 if r.strip()]
+        if not reason:
+            sup.bad.append(
+                (i, f"suppression of {', '.join(rules) or '<none>'} "
+                    "has no reason — write "
+                    "'# vnlint: disable=<rule> (why this is safe)'"))
+            continue
+        unknown = [r for r in rules if r not in known_rules]
+        if unknown:
+            sup.bad.append(
+                (i, "suppression names unknown rule(s) "
+                    f"{', '.join(unknown)}"))
+            rules = [r for r in rules if r in known_rules]
+        for r in rules:
+            if kind == "disable-file":
+                sup.file_wide[r] = reason
+            else:
+                sup.by_line.setdefault(target_line, {})[r] = reason
+    return sup
+
+
+def _join_comment_run(lines: list[str], comments: dict[int, str],
+                      start: int) -> tuple[str, int]:
+    """Join the directive comment at 1-based line `start` (comment-only
+    OR trailing a statement) with the comment-ONLY lines that follow it
+    — the wrapped-reason form — into one directive string; returns
+    (joined text, last line of the run)."""
+    parts = [comments[start]]
+    end = start
+    if ")" not in parts[0]:
+        for ln in range(start + 1, len(lines) + 1):
+            nxt = lines[ln - 1].strip()
+            if not nxt.startswith("#"):
+                break
+            parts.append(nxt.lstrip("#").strip())
+            end = ln
+            if ")" in nxt:
+                break
+    return " ".join(parts), end
+
+
+def _next_code_line(lines: list[str], after: int) -> int:
+    """First non-blank, non-comment line after 1-based `after`."""
+    for ln in range(after + 1, len(lines) + 1):
+        s = lines[ln - 1].strip()
+        if s and not s.startswith("#"):
+            return ln
+    return after + 1

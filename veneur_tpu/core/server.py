@@ -1022,6 +1022,11 @@ class Server:
         try:
             self._flush_interval_accounting(statsd)
         finally:
+            # vnlint: disable=sync-under-lock (_flush_serial only
+            #   serializes flush callers — ticker, tests, /debug — and
+            #   is never taken on the ingest path; the emit IS the
+            #   flush's one deliberate device wait, already overlapped
+            #   behind the host-side accounting above)
             res = pending.emit()
 
         # worker.metrics_processed_total (worker.go:477)
@@ -1076,6 +1081,10 @@ class Server:
             futures[self._flush_pool.submit(
                 self._flush_span_sink, sink,
                 statsd)] = f"span:{sink.name()}"
+        # vnlint: disable=sync-under-lock (the one-interval sink-fanout
+        #   deadline is the flush's straggler bound, intentionally
+        #   inside the flush serialization lock; ingest threads never
+        #   contend on _flush_serial)
         done, not_done = concurrent.futures.wait(
             futures, timeout=self.config.interval)
         # deadline classification (flusher.go:553-566): a sink still

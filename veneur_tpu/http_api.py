@@ -267,6 +267,10 @@ def _jax_profile(server, seconds: float) -> dict:
                     "flush under profiler failed")
             remaining = seconds - (time.perf_counter() - t0)
             if remaining > 0:
+                # vnlint: disable=sync-under-lock (the sleep IS the
+                #   requested profiler capture window; _profile_lock
+                #   only serializes the process-global JAX profiler,
+                #   nothing on the data plane waits on it)
                 time.sleep(remaining)
         files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
         return {"trace_dir": trace_dir,

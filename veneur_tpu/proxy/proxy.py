@@ -60,6 +60,10 @@ class ProxyConfig:
     # breaker_reset_timeout and doubles per consecutive trip (cap 8x)
     breaker_failure_threshold: int = 3
     breaker_reset_timeout: float = 5.0
+    # inbound gRPC handler pool width, and how long stop() lets
+    # in-flight RPCs finish before cancelling them
+    grpc_workers: int = 16
+    shutdown_grace: float = 1.0
     ignore_tags: list[TagMatcher] = field(default_factory=list)
     static_destinations: list[str] = field(default_factory=list)
     # optional second, TLS-authenticated listener (proxy.go:190-306: the
@@ -99,6 +103,8 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
             data.get("breaker_failure_threshold", 3)),
         breaker_reset_timeout=parse_duration(
             data.get("breaker_reset_timeout", 5.0)),
+        grpc_workers=int(data.get("grpc_workers", 16)),
+        shutdown_grace=parse_duration(data.get("shutdown_grace", 1.0)),
         ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
         static_destinations=list(data.get("static_destinations", [])),
         grpc_tls_address=data.get("grpc_tls_address", ""),
@@ -147,7 +153,8 @@ class Proxy:
 
         self.grpc_server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="proxy-grpc"),
+                max_workers=cfg.grpc_workers,
+                thread_name_prefix="proxy-grpc"),
             interceptors=[self.grpc_stats.interceptor()])
         self.grpc_server.add_generic_rpc_handlers([self._handlers()])
         self.grpc_port = self.grpc_server.add_insecure_port(
@@ -411,7 +418,7 @@ class Proxy:
 
     def stop(self) -> None:
         self._shutdown.set()
-        self.grpc_server.stop(grace=1.0)
+        self.grpc_server.stop(grace=self.cfg.shutdown_grace)
         if self._started:
             # shutdown() blocks forever unless serve_forever is running
             self.httpd.shutdown()

@@ -91,10 +91,13 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
-    fp = failpoints.configure(arm.failpoint, arm.action,
-                              seed=seed, **arm.kwargs)
+    # construct BEFORE arming: a failure in Cluster.__init__ must not
+    # leave the process-global failpoint armed (vnlint resource-pairing
+    # demands the protecting try start right after the arm)
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
+    fp = failpoints.configure(arm.failpoint, arm.action,
+                              seed=seed, **arm.kwargs)
     try:
         cluster.start()
         for _ in range(intervals):
