@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""One-command repo gate: vnlint -> native sanitizer smoke -> tier-1
-pytest.  Nonzero exit on ANY unsuppressed lint finding, sanitizer
-report, or test failure — the local equivalent of a CI required check.
+"""One-command repo gate: vnlint -> native sanitizer smoke -> one fast
+reshard chaos cell -> tier-1 pytest.  Nonzero exit on ANY unsuppressed
+lint finding, sanitizer report, failed chaos cell, or test failure —
+the local equivalent of a CI required check.
 
     python scripts/check.py              # the full gate
     python scripts/check.py --fast      # vnlint + sanitizer smoke only
@@ -70,7 +71,24 @@ def main() -> int:
                         "PASS" if native_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
-    # 3. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
+    # 3. one fast reshard chaos cell: scale a live ring up under traffic
+    # and require conservation + per-epoch routing + bounded movement
+    # (the ISSUE-7 elastic-topology gate; the full matrix is
+    # `scripts/dryrun_3tier.py --chaos all`)
+    reshard_rc = 0
+    if args.fast:
+        results.append(("reshard chaos cell", "SKIP", 0.0))
+    else:
+        t0 = stage("reshard chaos cell (ring-scale-up)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        reshard_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py",
+             "--chaos-only", "ring-scale-up"], env=env)
+        results.append(("reshard chaos cell",
+                        "PASS" if reshard_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
+    # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
         results.append(("tier-1 pytest", "SKIP", 0.0))
@@ -88,7 +106,7 @@ def main() -> int:
     print("\n=== check: summary " + "=" * 40)
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
-    rc = 1 if (lint_rc or native_rc or test_rc) else 0
+    rc = 1 if (lint_rc or native_rc or reshard_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

@@ -12,6 +12,8 @@ Usage:
   python scripts/dryrun_3tier.py --mesh-devices 2        # meshed globals
   python scripts/dryrun_3tier.py --chaos all             # full matrix
   python scripts/dryrun_3tier.py --chaos forward-outage --out report.json
+  python scripts/dryrun_3tier.py --chaos-only ring-scale-up   # one cell
+  python scripts/dryrun_3tier.py --cardinality-budget 8  # tenant budgets
 
 Exit status is nonzero when any check fails, so CI can gate on it.
 Report keys are promised (veneur_tpu.testbed.dryrun.PROMISED_KEYS,
@@ -40,8 +42,14 @@ def main(argv=None) -> int:
     ap.add_argument("--set-keys", type=int, default=2)
     ap.add_argument("--histo-samples", type=int, default=200)
     ap.add_argument("--interval-s", type=float, default=0.05)
+    ap.add_argument("--cardinality-budget", type=int, default=0,
+                    help="per-tenant key budget on the local tier "
+                    "(0 = cardinality defense off)")
     ap.add_argument("--chaos", default=None,
                     help="chaos arm name, or 'all' for the full matrix")
+    ap.add_argument("--chaos-only", default=None, metavar="ARM",
+                    help="run ONE chaos arm (no surrounding dryrun) and "
+                    "emit just its row — the fast CI reshard cell")
     ap.add_argument("--cpu", action="store_true",
                     help="force JAX onto CPU (the dryrun's default "
                     "posture off the driver host)")
@@ -58,6 +66,22 @@ def main(argv=None) -> int:
                     flags + " --xla_force_host_platform_device_count="
                     f"{max(8, args.mesh_devices)}").strip()
 
+    if args.chaos_only:
+        from veneur_tpu.testbed.chaos import arm_by_name, run_chaos_arm
+
+        row = run_chaos_arm(arm_by_name(args.chaos_only), seed=args.seed)
+        body = json.dumps(row, indent=2, default=str)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(body + "\n")
+        else:
+            print(body)
+        if not row["ok"]:
+            print(f"CHAOS ARM {args.chaos_only} FAILED", file=sys.stderr)
+            return 1
+        print(f"# chaos arm {args.chaos_only} OK", file=sys.stderr)
+        return 0
+
     from veneur_tpu.testbed.dryrun import run_dryrun
 
     report = run_dryrun(
@@ -66,7 +90,9 @@ def main(argv=None) -> int:
         mesh_devices=args.mesh_devices,
         counter_keys=args.counter_keys, histo_keys=args.histo_keys,
         set_keys=args.set_keys, histo_samples=args.histo_samples,
-        interval_s=args.interval_s, chaos=args.chaos)
+        interval_s=args.interval_s,
+        cardinality_key_budget=args.cardinality_budget,
+        chaos=args.chaos)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:

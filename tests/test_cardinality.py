@@ -1,0 +1,314 @@
+"""Cardinality defense (ISSUE 7): per-tenant key budgets, deterministic
+seeded count-ordered eviction, mergeable tail rollups composing across
+the local -> global tiers, eager arena row release, and the
+observability surface (/debug/vars + cardinality.* gauges)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from veneur_tpu import diagnostics as diag_mod  # noqa: E402
+from veneur_tpu import failpoints  # noqa: E402
+from veneur_tpu.core.aggregator import MetricAggregator  # noqa: E402
+from veneur_tpu.core.cardinality import (  # noqa: E402
+    ROLLUP_NAME_PREFIX, ROLLUP_TAG, CardinalityGuard)
+from veneur_tpu.samplers import samplers as sm  # noqa: E402
+from veneur_tpu.samplers.metric_key import (  # noqa: E402
+    MetricKey, MetricScope, UDPMetric)
+from veneur_tpu.testbed import verify  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def mk(name, mtype="counter", tags=""):
+    return MetricKey(name, mtype, tags)
+
+
+def udp(name, typ, value, tags, scope=MetricScope.MIXED):
+    m = UDPMetric(name=name, type=typ, value=value, scope=scope)
+    m.update_tags(list(tags), None)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# guard unit behavior
+# ---------------------------------------------------------------------------
+
+def test_guard_admits_under_budget_and_rolls_tail():
+    g = CardinalityGuard(2, seed=7)
+    tags = ["tenant:acme"]
+    assert g.resolve(mk("a"), MetricScope.MIXED, tags) is None
+    assert g.resolve(mk("b"), MetricScope.MIXED, tags) is None
+    rolled = g.resolve(mk("c"), MetricScope.MIXED, tags)
+    assert rolled is not None
+    rkey, rscope, rtags = rolled
+    assert rkey.name == ROLLUP_NAME_PREFIX + "counter"
+    assert rscope == MetricScope.MIXED
+    assert ROLLUP_TAG in rtags and "tenant:acme" in rtags
+    # untenanted keys are never budgeted
+    assert g.resolve(mk("z"), MetricScope.MIXED, ["host:x"]) is None
+    snap = g.snapshot()
+    assert snap["tenants"]["acme"]["exact_keys"] == 2
+    assert snap["keys_evicted"] == 1
+    assert snap["tenants_over_budget"] == 1
+
+
+def test_guard_rollup_identity_per_type_and_scope():
+    g = CardinalityGuard(1)
+    tags = ["tenant:t"]
+    g.resolve(mk("a"), MetricScope.MIXED, tags)             # fills budget
+    rc = g.resolve(mk("b", "counter"), MetricScope.GLOBAL_ONLY, tags)
+    rh = g.resolve(mk("c", "histogram"), MetricScope.MIXED, tags)
+    assert rc[0].name == "veneur.rollup.counter"
+    assert rc[1] == MetricScope.GLOBAL_ONLY
+    assert rh[0].name == "veneur.rollup.histogram"
+    assert rh[0].type == "histogram"
+
+
+def test_eviction_is_count_ordered_and_seed_deterministic():
+    def run(seed):
+        g = CardinalityGuard(2, seed=seed)
+        tags = ["tenant:t"]
+        # cold/warm fill the budget with 1 touch each; hot out-touches
+        g.resolve(mk("cold"), MetricScope.MIXED, tags)
+        g.resolve(mk("warm"), MetricScope.MIXED, tags, n=2)
+        for _ in range(5):
+            assert g.resolve(mk("hot"), MetricScope.MIXED, tags) \
+                is not None
+        evicted = []
+        g.end_interval(lambda dks: evicted.extend(dks))
+        return g, evicted
+
+    g1, ev1 = run(seed=3)
+    g2, ev2 = run(seed=3)
+    assert ev1 == ev2 == [(mk("cold"), MetricScope.MIXED)]
+    assert g1.epoch == 1
+    # the hot key now resolves exact; the demoted key rolls
+    tags = ["tenant:t"]
+    assert g1.resolve(mk("hot"), MetricScope.MIXED, tags) is None
+    assert g1.resolve(mk("cold"), MetricScope.MIXED, tags) is not None
+
+
+def test_eviction_requires_strict_win():
+    g = CardinalityGuard(1)
+    tags = ["tenant:t"]
+    g.resolve(mk("a"), MetricScope.MIXED, tags, n=3)
+    g.resolve(mk("b"), MetricScope.MIXED, tags, n=3)   # tie: no swap
+    g.end_interval()
+    assert g.epoch == 0
+    assert g.resolve(mk("a"), MetricScope.MIXED, tags) is None
+
+
+def test_candidate_table_stays_budget_bounded():
+    g = CardinalityGuard(4)
+    tags = ["tenant:t"]
+    for i in range(4):
+        g.resolve(mk(f"exact{i}"), MetricScope.MIXED, tags)
+    for i in range(10_000):
+        g.resolve(mk(f"tail{i}"), MetricScope.MIXED, tags)
+    st = g.tenants["t"]
+    assert len(st.candidates) <= 4
+    assert len(st.exact) == 4
+    assert g.rollup_points_total == 10_000
+
+
+def test_idle_exact_keys_decay_and_free_budget():
+    from veneur_tpu.core import cardinality as card_mod
+    g = CardinalityGuard(1)
+    tags = ["tenant:t"]
+    g.resolve(mk("a"), MetricScope.MIXED, tags)
+    # touched in interval 0, so decay starts counting from interval 1
+    for _ in range(card_mod.IDLE_EXACT_INTERVALS + 1):
+        g.end_interval()
+    # the idle key was retired; a new key admits exact immediately
+    assert g.resolve(mk("b"), MetricScope.MIXED, tags) is None
+
+
+# ---------------------------------------------------------------------------
+# aggregator integration
+# ---------------------------------------------------------------------------
+
+def _agg(budget=3, **kw):
+    return MetricAggregator(percentiles=[0.5], is_local=True,
+                            cardinality_key_budget=budget, **kw)
+
+
+def test_aggregator_rolls_tail_and_tags_rollup_series():
+    agg = _agg(budget=2)
+    for k in range(2):
+        for _ in range(5):
+            agg.process_metric(udp(f"pin{k}", sm.TYPE_COUNTER, 1,
+                                   ["tenant:hog"]))
+    for k in range(7):
+        agg.process_metric(udp(f"tail{k}", sm.TYPE_COUNTER, 3,
+                               ["tenant:hog"]))
+    res = agg.flush(is_local=True)
+    got = {m.name: m for m in res.metrics}
+    assert got["pin0"].value == 5.0 and got["pin1"].value == 5.0
+    roll = got["veneur.rollup.counter"]
+    assert roll.value == 21.0                      # exact tail mass
+    assert ROLLUP_TAG in roll.tags
+    # the arenas never grew rows for the tail
+    assert all(f"tail{k}" not in got for k in range(7))
+    assert len(agg.counters.kdict) == 3            # 2 pins + rollup
+
+
+def test_aggregator_releases_evicted_rows():
+    agg = _agg(budget=1)
+    agg.process_metric(udp("cold", sm.TYPE_COUNTER, 1, ["tenant:t"]))
+    for _ in range(4):
+        agg.process_metric(udp("hot", sm.TYPE_COUNTER, 1, ["tenant:t"]))
+    assert (mk("cold", tags="tenant:t"), MetricScope.MIXED) \
+        in agg.counters.kdict
+    agg.flush(is_local=True)   # eviction pass swaps hot in, cold out
+    assert agg.cardinality.epoch == 1
+    assert (mk("cold", tags="tenant:t"), MetricScope.MIXED) \
+        not in agg.counters.kdict
+    # cold's row went back to the free list and its state is zeroed
+    agg.process_metric(udp("hot", sm.TYPE_COUNTER, 2, ["tenant:t"]))
+    res = agg.flush(is_local=True)
+    got = {m.name: m.value for m in res.metrics}
+    assert got["hot"] == 2.0
+
+
+def test_arena_evict_failpoint_aborts_pass_safely():
+    agg = _agg(budget=1)
+    agg.process_metric(udp("cold", sm.TYPE_COUNTER, 1, ["tenant:t"]))
+    for _ in range(4):
+        agg.process_metric(udp("hot", sm.TYPE_COUNTER, 1, ["tenant:t"]))
+    with failpoints.active("arena.evict", "drop", times=1):
+        agg.flush(is_local=True)          # eviction pass aborts cleanly
+    assert agg.cardinality.epoch == 0     # nothing mutated
+    # next interval retries and succeeds
+    for _ in range(4):
+        agg.process_metric(udp("hot", sm.TYPE_COUNTER, 1, ["tenant:t"]))
+    agg.flush(is_local=True)
+    assert agg.cardinality.epoch == 1
+
+
+def test_release_keys_recycles_arena_rows():
+    from veneur_tpu.core import arena as arena_mod
+    ar = arena_mod.CounterArena()
+    row = ar.row_for(mk("a"), MetricScope.MIXED, [])
+    ar.sample(row, 5, 1.0)
+    ck0 = ar.keyset_checksum
+    assert ar.release_keys([(mk("a"), MetricScope.MIXED)]) == 1
+    assert (mk("a"), MetricScope.MIXED) not in ar.kdict
+    assert ar.keyset_checksum != ck0          # fingerprint folded out
+    assert float(ar.values[:, row].sum()) == 0.0
+    row2 = ar.row_for(mk("b"), MetricScope.MIXED, [])
+    assert row2 == row                        # the row was freed
+    assert ar.release_keys([(mk("zzz"), MetricScope.MIXED)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# mergeability across tiers: local rollup U local rollup == global
+# rollup of the union
+# ---------------------------------------------------------------------------
+
+def test_rollup_merge_associativity_across_tiers():
+    rng = np.random.default_rng(5)
+    halves = [rng.gamma(2.0, 10.0, 300), rng.gamma(2.0, 10.0, 300)]
+    members = [[f"m{i}" for i in range(0, 40)],
+               [f"m{i}" for i in range(20, 60)]]   # overlapping
+
+    def local_flush(vals, mems, ctr):
+        agg = _agg(budget=1)
+        # fill the tenant's budget so EVERYTHING below folds
+        agg.process_metric(udp("pin", sm.TYPE_COUNTER, 1, ["tenant:t"],
+                               scope=MetricScope.GLOBAL_ONLY))
+        for v in vals:
+            agg.process_metric(udp(f"h{v:.9f}", sm.TYPE_HISTOGRAM,
+                                   float(v), ["tenant:t"]))
+        for mem in mems:
+            agg.process_metric(udp("s.many", sm.TYPE_SET, mem,
+                                   ["tenant:t"]))
+        for i in range(ctr):
+            agg.process_metric(udp(f"c{i}", sm.TYPE_COUNTER, 2,
+                                   ["tenant:t"],
+                                   scope=MetricScope.GLOBAL_ONLY))
+        return agg.flush(is_local=True).forward
+
+    glob = MetricAggregator(percentiles=[0.5, 0.9, 0.99],
+                            is_local=False)
+    n_fwd_rollups = 0
+    for vals, mems, ctr in ((halves[0], members[0], 10),
+                            (halves[1], members[1], 15)):
+        for fm in local_flush(vals, mems, ctr):
+            if fm.name.startswith(ROLLUP_NAME_PREFIX):
+                n_fwd_rollups += 1
+                assert ROLLUP_TAG in fm.tags
+            glob.import_metric(fm)
+    # each local forwards one rollup per touched (type, scope):
+    # counter + histogram + set
+    assert n_fwd_rollups == 6
+    res = glob.flush(is_local=False)
+    got = {m.name: m.value for m in res.metrics}
+
+    # counters: the union's exact sum (addition is associative)
+    assert got["veneur.rollup.counter"] == 10 * 2 + 15 * 2
+    # sets: distinct raw members of the union (HLL union, exact in the
+    # linear-counting regime)
+    assert got["veneur.rollup.set"] == 60.0
+    # histograms: the merged digest's quantiles vs numpy over the union,
+    # inside the committed envelope
+    union = np.concatenate(halves)
+    span = float(union.max() - union.min())
+    env = verify.load_envelope()
+    for q in (0.5, 0.9, 0.99):
+        name = f"veneur.rollup.histogram.{int(q * 100)}percentile"
+        exact = float(np.quantile(union, q, method="hazen"))
+        err = abs(got[name] - exact) / span
+        assert err <= verify.envelope_for(q, env), (q, err)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_snapshot_and_diagnostics_gauges():
+    agg = _agg(budget=1)
+    agg.process_metric(udp("a", sm.TYPE_COUNTER, 1, ["tenant:t"]))
+    for k in range(3):
+        agg.process_metric(udp(f"t{k}", sm.TYPE_COUNTER, 1,
+                               ["tenant:t"]))
+    gauges = diag_mod.cardinality_gauges(agg)
+    assert gauges["cardinality.keys_evicted"] == 3.0
+    assert gauges["cardinality.tenants_over_budget"] == 1.0
+    assert gauges["cardinality.tenant.t.exact_keys"] == 1.0
+    # guard off -> empty dict (safe to wire unconditionally)
+    plain = MetricAggregator(percentiles=[0.5], is_local=True)
+    assert diag_mod.cardinality_gauges(plain) == {}
+
+
+def test_guard_rejects_nonpositive_budget():
+    with pytest.raises(ValueError):
+        CardinalityGuard(0)
+
+
+def test_ephemeral_tenants_are_pruned():
+    """A workload whose tenant-tag values are themselves ephemeral (one
+    key per tenant, never over budget) must not grow the guard's own
+    state without bound: emptied tenants prune at the interval
+    boundary."""
+    from veneur_tpu.core import cardinality as card_mod
+    g = CardinalityGuard(4)
+    for i in range(200):
+        g.resolve(mk(f"k{i}"), MetricScope.MIXED, [f"tenant:req-{i}"])
+    assert len(g.tenants) == 200
+    for _ in range(card_mod.IDLE_EXACT_INTERVALS + 1):
+        g.end_interval()
+    assert len(g.tenants) == 0
+    # a returning tenant starts cleanly
+    assert g.resolve(mk("k0"), MetricScope.MIXED, ["tenant:req-0"]) \
+        is None

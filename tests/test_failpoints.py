@@ -344,15 +344,25 @@ def test_breaker_trips_routes_around_and_half_open_restores():
         assert bs[dead]["state"] == "open"
         assert bs[dead]["retry_in_s"] > 0.4   # doubled vs the base 0.4
 
-        # a deliberate membership change (discovery) sheds breaker state
-        # for addresses leaving the wanted set; a healthy replacement
-        # joins cleanly
+        # a deliberate membership change (discovery) drops the dead
+        # address from the wanted set; its ENGAGED (open) breaker
+        # survives the flap — ISSUE-7 satellite: a reshard can never
+        # resurrect a tripped destination without a successful probe —
+        # and a healthy replacement joins cleanly
         revived = _FlakyGlobal()
         revived_addr = f"127.0.0.1:{revived.port}"
         try:
             dests.set_members([live_addr, revived_addr])
             assert dests.size() == 2
-            assert dests.breaker_stats() == {}
+            assert dests.breaker_stats()[dead]["state"] == "open"
+            assert dests.breaker_stats()[dead]["trips"] >= 2
+            # once the cooldown expires, the next reconcile that still
+            # excludes the address finally sheds its (disengaged) state
+            with dests._lock:
+                dests._breakers[dead].open_until = \
+                    time.monotonic() - 0.01
+            dests.set_members([live_addr, revived_addr])
+            assert dead not in dests.breaker_stats()
         finally:
             revived.stop()
     finally:

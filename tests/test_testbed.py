@@ -12,8 +12,9 @@ import os
 import pytest
 
 from veneur_tpu import failpoints
-from veneur_tpu.testbed import (CHAOS_ARMS, PROMISED_KEYS, run_chaos_arm,
-                                run_dryrun)
+from veneur_tpu.testbed import (CHAOS_ARMS, PROMISED_KEYS,
+                                TOPOLOGY_ARMS, arm_by_name,
+                                run_chaos_arm, run_dryrun)
 from veneur_tpu.testbed import verify
 
 
@@ -101,6 +102,65 @@ def test_chaos_single_arm_retry_conserves():
     assert row["fired"] > 0 and row["forward_retries"] > 0
     assert row["conserved"] and row["counter_deficit"] == 0.0
     assert row["ok"], row
+
+
+def test_dryrun_report_carries_cardinality_and_reshard_keys():
+    """ISSUE-7 satellite: keys_evicted / tenants_over_budget ride the
+    dryrun JSON (nested under `cardinality`) next to reshard_moved —
+    promised keys, present and zero when the defense is off."""
+    report = run_dryrun(n_locals=1, n_globals=1, intervals=1, seed=9,
+                        counter_keys=4, histo_keys=1, set_keys=1,
+                        histo_samples=40)
+    assert report["cardinality"] == {
+        "keys_evicted": 0, "tenants_over_budget": 0, "rollup_points": 0}
+    assert report["reshard_moved"] == 0
+    assert report["ok"]
+
+
+def test_topology_cell_scale_up_conserves_with_bounded_movement():
+    """One non-slow topology cell: grow the global ring mid-run —
+    conservation stays exact across ring epochs, one-global-per-key
+    holds per epoch, and the committed reshard record shows bounded
+    sampled movement (<= 1.5*K/N for one joiner on an N-ring)."""
+    row = run_chaos_arm(arm_by_name("ring-scale-up"), seed=6)
+    assert row["arm"] == "ring-scale-up"
+    assert row["fired"] >= 1                      # reshard epochs
+    assert row["conserved"] and row["counter_deficit"] == 0.0
+    assert row["routing_exclusive"] and row["moved_bounded"]
+    assert row["reshard"]["committed"]
+    assert row["reshard"]["added"] and not row["reshard"]["removed"]
+    assert row["ok"], row
+
+
+def test_topology_cell_cardinality_storm_stays_under_budget():
+    """One non-slow storm cell: a tenant floods fresh keys past its
+    budget — arenas stay bounded, the folded tail conserves (counter
+    mass exact, sets exact, quantiles inside the dossier envelope),
+    and rollup series carry the reserved degraded-data tag."""
+    row = run_chaos_arm(arm_by_name("cardinality-storm"), seed=6)
+    assert row["under_budget"] and row["keys_evicted"] > 0
+    assert row["tenants_over_budget"] >= 2        # both locals
+    assert row["conserved"] and row["counter_deficit"] == 0.0
+    assert row["rollup_tagged"]
+    assert row["rollup_quantiles_within_envelope"]
+    # the defense's point: emitted tail cardinality >> live arena rows
+    assert row["tail_keys_emitted"] > 4 * max(row["digest_rows_live"])
+    assert row["ok"], row
+
+
+@pytest.mark.slow
+def test_chaos_matrix_topology_arms_no_silent_loss():
+    """The elastic-topology half of the matrix: scale-up, scale-down,
+    rolling-global-restart, cardinality-storm — each conserving (or
+    visibly accounting) with the routing invariant held through the
+    reshard."""
+    rows = [run_chaos_arm(arm, seed=4) for arm in TOPOLOGY_ARMS]
+    failed = [r for r in rows if not r["ok"]]
+    assert not failed, failed
+    for r in rows:
+        assert r["fired"] > 0, r
+        assert r["routing_exclusive"], r
+        assert r["no_silent_loss"], r
 
 
 @pytest.mark.slow

@@ -191,6 +191,43 @@ def test_unemitted_dispatch_fires(tmp_path):
     assert len(hits) == 1 and "never released" in hits[0].message
 
 
+RESHARD_ABANDONED = """
+def set_members(self, addresses):
+    rec = self.reshard_begin(sorted(addresses))
+    self.add(addresses)              # raises => window never commits:
+    for addr in self.leavers():      # the serial lock wedges every
+        self.remove(addr, handoff=rec)   # future reshard
+    self.reshard_commit(rec)
+"""
+
+RESHARD_COMMITTED = """
+def set_members(self, addresses):
+    rec = self.reshard_begin(sorted(addresses))
+    try:
+        self.add(addresses)
+        for addr in self.leavers():
+            self.remove(addr, handoff=rec)
+    finally:
+        self.reshard_commit(rec)
+"""
+
+
+def test_abandoned_reshard_window_fires(tmp_path):
+    """ISSUE-7 satellite: an abandoned handoff (reshard_begin with the
+    commit only on the fall-through path) is a lint error."""
+    report = lint_source(tmp_path, RESHARD_ABANDONED)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "reshard_begin" in hits[0].message
+    assert "reshard_commit" in hits[0].message
+
+
+def test_reshard_commit_in_finally_is_quiet(tmp_path):
+    report = lint_source(tmp_path, RESHARD_COMMITTED)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
 # ---------------------------------------------------------------------------
 # prewarm-parity — the PR-3 in-flush recompile
 # ---------------------------------------------------------------------------

@@ -76,6 +76,13 @@ PAIRS = (
              acquire_base_hint="failpoint"),
     PairSpec("pending flush",
              frozenset({"flush_dispatch"}), frozenset({"emit"})),
+    # the elastic-reshard window (proxy/destinations.py): begin takes
+    # the reshard serial lock and opens the record; an abandoned window
+    # (no commit on an error path) wedges every future reshard AND
+    # leaves the handoff accounting unpublished
+    PairSpec("ring reshard window",
+             frozenset({"reshard_begin"}),
+             frozenset({"reshard_commit"})),
 )
 
 

@@ -119,6 +119,20 @@ class Config:
     # 0 = follow arena_initial_capacity up to 8192 rows (128 MiB/lane);
     # sets grow on demand past the pre-size either way
     set_arena_initial_capacity: int = 0
+    # cardinality defense (core/cardinality.py): per-tenant key budget.
+    # 0 disables.  With a budget set, every metric key carrying the
+    # tenant tag (cardinality_tenant_tag, "tenant:<t>" by default)
+    # counts against its tenant; once a tenant's distinct-key count
+    # crosses the budget, the long tail folds into one mergeable rollup
+    # sketch per (tenant, type) — emitted as `veneur.rollup.<type>`
+    # with the reserved `veneur_rollup:true` tag so downstream can tell
+    # degraded data from exact data.  Eviction is deterministic
+    # (cardinality_seed, count-ordered); quota state is visible at
+    # /debug/vars -> cardinality and as cardinality.* self-metrics.
+    # Untenanted keys (self-telemetry included) are never budgeted.
+    cardinality_key_budget: int = 0
+    cardinality_tenant_tag: str = "tenant"
+    cardinality_seed: int = 0
     # rolling-upgrade migration lane for sets: merge legacy 'VH'
     # (blake2b-hashed) HLL imports into a side lane and emit
     # max(primary, legacy) instead of hash-mixing the registers (which

@@ -107,7 +107,10 @@ class MetricAggregator:
                  digest_float64: bool = False,
                  digest_bf16_staging: bool = False,
                  flush_upload_chunks: int = 2,
-                 flush_presharded_staging: bool = True):
+                 flush_presharded_staging: bool = True,
+                 cardinality_key_budget: int = 0,
+                 cardinality_tenant_tag: str = "tenant",
+                 cardinality_seed: int = 0):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -168,6 +171,17 @@ class MetricAggregator:
         self.counters = arena_mod.CounterArena(mesh=mesh, **kw)
         self.gauges = arena_mod.GaugeArena(**kw)
         self.status = arena_mod.StatusArena(**kw)
+        # per-tenant key budget + tail rollup (core/cardinality.py);
+        # None = defense off, zero hot-path cost.  Applies at the INGEST
+        # edge (process path + native drain): imports arrive pre-rolled
+        # from the local tier, whose rollup series are ordinary mergeable
+        # keys here.
+        from veneur_tpu.core.cardinality import CardinalityGuard
+        self.cardinality = (
+            CardinalityGuard(cardinality_key_budget,
+                             tenant_tag=cardinality_tenant_tag,
+                             seed=cardinality_seed)
+            if cardinality_key_budget > 0 else None)
         self.processed = 0
         self.imported = 0
         # V1 import identity->row cache; cleared at every snapshot so a
@@ -219,6 +233,17 @@ class MetricAggregator:
             for m in ms:
                 self._process_locked(m)
 
+    def _card_resolve(self, key, scope, tags, n: int = 1):
+        """Cardinality defense at the ingest edge: under-budget (or
+        untenanted) keys pass through; an over-budget tenant's tail
+        rewrites to its reserved rollup identity
+        (core/cardinality.py)."""
+        g = self.cardinality
+        if g is None:
+            return key, scope, tags
+        rolled = g.resolve(key, scope, tags, n)
+        return (key, scope, tags) if rolled is None else rolled
+
     def _process_locked(self, m: UDPMetric) -> None:
         self.processed += 1
         if self.unique_ts is not None:
@@ -228,22 +253,26 @@ class MetricAggregator:
             scope = (MetricScope.GLOBAL_ONLY
                      if m.scope == MetricScope.GLOBAL_ONLY
                      else MetricScope.MIXED)
-            row = self.counters.row_for(m.key, scope, m.tags)
+            key, scope, tags = self._card_resolve(m.key, scope, m.tags)
+            row = self.counters.row_for(key, scope, tags)
             self.counters.sample(row, m.value, m.sample_rate)
         elif t == sm.TYPE_GAUGE:
             scope = (MetricScope.GLOBAL_ONLY
                      if m.scope == MetricScope.GLOBAL_ONLY
                      else MetricScope.MIXED)
-            row = self.gauges.row_for(m.key, scope, m.tags)
+            key, scope, tags = self._card_resolve(m.key, scope, m.tags)
+            row = self.gauges.row_for(key, scope, tags)
             self.gauges.sample(row, m.value)
         elif t in (sm.TYPE_HISTOGRAM, sm.TYPE_TIMER):
-            row = self.digests.row_for(m.key, m.scope, m.tags)
+            key, scope, tags = self._card_resolve(m.key, m.scope, m.tags)
+            row = self.digests.row_for(key, scope, tags)
             self.digests.sample(row, m.value, m.sample_rate)
         elif t == sm.TYPE_SET:
             scope = (MetricScope.LOCAL_ONLY
                      if m.scope == MetricScope.LOCAL_ONLY
                      else MetricScope.MIXED)
-            row = self.sets.row_for(m.key, scope, m.tags)
+            key, scope, tags = self._card_resolve(m.key, scope, m.tags)
+            row = self.sets.row_for(key, scope, tags)
             self.sets.sample(row, str(m.value))
         elif t == sm.TYPE_STATUS:
             row = self.status.row_for(m.key, MetricScope.LOCAL_ONLY, m.tags)
@@ -1168,7 +1197,44 @@ class MetricAggregator:
                          (s, srows), (d, drows)):
             ar.reset_rows(rows)
             ar.end_interval()
+        if self.cardinality is not None:
+            self._cardinality_end_interval()
         return snap
+
+    def _arena_for_type(self, mtype: str):
+        if mtype == sm.TYPE_COUNTER:
+            return self.counters
+        if mtype == sm.TYPE_GAUGE:
+            return self.gauges
+        if mtype == sm.TYPE_SET:
+            return self.sets
+        return self.digests   # histogram / timer
+
+    def _cardinality_end_interval(self) -> None:
+        """Apply the guard's count-ordered eviction pass (under the
+        aggregator lock, after the snapshot has copied and reset the
+        arenas).  The callback is the `arena.evict` failpoint edge and
+        the eager row release; a fault injected there aborts the pass
+        with the quota state untouched — reclamation is delayed one
+        interval (idle GC still bounds the rows), never corrupted."""
+        def release(dks):
+            from veneur_tpu import failpoints
+            failpoints.inject("arena.evict")
+            by_arena: dict = {}
+            for dk in dks:
+                by_arena.setdefault(
+                    id(self._arena_for_type(dk[0].type)),
+                    (self._arena_for_type(dk[0].type), []))[1].append(dk)
+            for arena, lst in by_arena.values():
+                arena.release_keys(lst)
+
+        try:
+            self.cardinality.end_interval(release)
+        except Exception as e:
+            import logging
+            logging.getLogger("veneur_tpu.core.aggregator").warning(
+                "cardinality eviction pass aborted (%s); retrying next "
+                "interval", e)
 
     # -- emitters ----------------------------------------------------------
 

@@ -115,19 +115,13 @@ class _ArenaBase:
         self.key_checksum = 0
         self.keyset_checksum = 0
 
-    @staticmethod
-    def _fnv1a(s: str) -> int:
-        h = 0xCBF29CE484222325  # FNV-1a 64-bit offset basis
-        for b in s.encode():
-            h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
-        return h
-
     def _fold_key_fingerprints(self, key: MetricKey, scope: MetricScope,
                                row: int) -> None:
-        base = (f"{key.name}\x00{key.type}\x00{key.joined_tags}"
-                f"\x00{int(scope)}")
-        self.keyset_checksum ^= self._fnv1a(base)
-        self.key_checksum ^= self._fnv1a(f"{base}\x00{row}")
+        from veneur_tpu.samplers.metric_key import (fnv1a_64,
+                                                    identity_string)
+        base = identity_string(key, scope)
+        self.keyset_checksum ^= fnv1a_64(base)
+        self.key_checksum ^= fnv1a_64(f"{base}\x00{row}")
 
     def _init_mesh_lanes(self, mesh, family: str) -> int:
         """Shared mesh plumbing for device-resident arenas: validate the
@@ -211,6 +205,36 @@ class _ArenaBase:
 
     def touched_rows(self) -> np.ndarray:
         return np.nonzero(self.touched)[0]
+
+    def release_keys(self, dks: list) -> int:
+        """Immediately recycle the rows of the given (MetricKey, scope)
+        pairs (cardinality eviction, core/cardinality.py): clear the
+        metadata columns, fold the key fingerprints back out, zero the
+        rows' state in ONE batched reset, and return them to the free
+        list — the eager form of the idle GC in end_interval, for keys a
+        tenant's budget has demoted to the rollup.  Call under the
+        aggregator lock, after the flush snapshot has copied everything
+        it needs.  Returns rows released."""
+        rows: list[int] = []
+        for dk in dks:
+            row = self.kdict.pop(dk, None)
+            if row is None:
+                continue
+            m = self.meta[row]
+            self.meta[row] = None
+            self.name_col[row] = None
+            self.tags_col[row] = None
+            if self.kind_col is not None:
+                self.kind_col[row] = None
+            self.scope_col[row] = 0
+            self.idle[row] = 0
+            self.touched[row] = False
+            self._fold_key_fingerprints(m.key, m.scope, int(row))
+            self._free.append(int(row))
+            rows.append(int(row))
+        if rows:
+            self.reset_rows(np.asarray(rows, np.int64))
+        return len(rows)
 
     def end_interval(self) -> None:
         """Reset touched state and GC idle rows (after flush)."""

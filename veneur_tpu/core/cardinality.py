@@ -1,0 +1,372 @@
+"""Per-tenant cardinality defense: key budgets + mergeable tail rollups.
+
+At millions of users the key space explodes before the packet rate does
+(ROADMAP #4): one tenant emitting 10M unique series grows the arenas
+without bound and blows the flush interval.  The guard bounds that:
+
+  - every tenanted metric key (a key carrying the configured tenant tag,
+    default `tenant:<t>`) counts against its tenant's KEY BUDGET;
+  - while a tenant is under budget its keys get exact arena rows as
+    usual ("heavy keys keep exact/sketched state");
+  - once the budget is full, the long tail REWRITES to one reserved
+    per-(tenant, type, scope) ROLLUP key — `veneur.rollup.<type>` tagged
+    with `veneur_rollup:true` + the tenant tag — so the tail's samples
+    fold into a single sketch per family instead of a row per key.
+
+The rollup state is whatever the family's arena already keeps, which is
+exactly why it composes across tiers (the mergeable-summary contract of
+arXiv:1902.04023 / 1803.01969):
+
+  counter    an exact sum; local rollups ADD at the global tier
+  set        an HLL; local rollups UNION at the global tier (the rolled
+             cardinality is distinct raw members across the tail)
+  histogram  a t-digest of the tail's samples; local rollup digests
+  /timer     MERGE at the global tier within the committed envelope
+  gauge      last-write-wins (an arbitrary tail member's value — the
+             reserved tag is what tells downstream it is degraded)
+
+Eviction is DETERMINISTIC (seeded, count-ordered): per flush interval
+the guard tracks touch counts for the exact set and a bounded
+space-saving candidate table of rolled keys (capacity = budget, so the
+tracking can never become the cardinality explosion it defends
+against); at interval end a rolled candidate that strictly out-touched
+the coldest exact key swaps with it — the cold key's arena row is
+released immediately (the `arena.evict` failpoint edge) and the hot key
+gets an exact row from the next sample on.  Ties break on a seeded
+fnv1a of the key identity, so replays are bit-stable.  Exact keys idle
+for IDLE_EXACT_INTERVALS flushes are dropped from the budget the same
+way.  Every swap bumps `epoch`, which the native ingest id cache uses
+to invalidate its row bindings.
+
+Quota state is visible at `/debug/vars -> cardinality` and pushed by
+the diagnostics loop as `cardinality.*` self-metrics.
+
+Scope limit worth knowing: budgets are PER TENANT, so a workload whose
+tenant tag itself explodes (one key per ephemeral tenant value) is not
+defended — no single tenant ever crosses its budget.  The guard's own
+memory stays bounded regardless: a tenant whose exact set and candidate
+table are both empty (idle decay, or never admitted anything) is pruned
+at the interval boundary.
+
+Thread-safety: every MUTATING method (resolve, end_interval) is called
+under the owning aggregator's lock; the guard itself takes no locks.
+snapshot()/over_budget_tenants() are read-only observers safe to call
+WITHOUT the lock (the /debug/vars handler and diagnostics loop do):
+they iterate over list() copies, so a concurrent first-sight tenant
+insert can skew a count by one but can never raise.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Optional
+
+from veneur_tpu.samplers.metric_key import (MetricKey, MetricScope,
+                                            fnv1a_64, identity_string)
+
+# reserved marker tag: downstream consumers can tell degraded (rolled-up)
+# series from exact ones by its presence
+ROLLUP_TAG = "veneur_rollup:true"
+# reserved name prefix of the per-(tenant, type) rollup series
+ROLLUP_NAME_PREFIX = "veneur.rollup."
+
+# flush intervals an exact key may stay untouched before its budget slot
+# (and arena row) is reclaimed — mirrors the arenas' IDLE_GC_INTERVALS
+IDLE_EXACT_INTERVALS = 10
+
+
+class _Tenant:
+    __slots__ = ("exact", "idle", "candidates", "ranks", "cand_heap",
+                 "seq", "evicted_total", "rollup_points")
+
+    def __init__(self):
+        # (MetricKey, scope) -> touches this interval, for admitted keys
+        self.exact: dict = {}
+        # (MetricKey, scope) -> consecutive untouched intervals
+        self.idle: dict = {}
+        # bounded space-saving table of rolled keys' interval touches:
+        # dk -> [count, rank] (rank = seeded identity hash, computed
+        # ONCE per membership, never per comparison)
+        self.candidates: dict = {}
+        # dk -> rank memo, held only for current exact + candidate
+        # members (bounded at ~2x budget; pruned with the entries)
+        self.ranks: dict = {}
+        # lazy min-heap over candidates: (count, rank, seq, dk) entries
+        # pushed on insert AND on count update; stale entries (count no
+        # longer matching the table) discard at pop time.  Replaces the
+        # O(budget) min() scan per new over-budget key with O(log H)
+        self.cand_heap: list = []
+        self.seq = 0
+        self.evicted_total = 0
+        self.rollup_points = 0
+
+
+class CardinalityGuard:
+    def __init__(self, budget: int, tenant_tag: str = "tenant",
+                 seed: int = 0):
+        if budget <= 0:
+            raise ValueError("cardinality budget must be positive "
+                             "(leave the guard off instead)")
+        self.budget = int(budget)
+        self.tenant_tag = tenant_tag
+        self._prefix = tenant_tag + ":"
+        self.seed = int(seed)
+        # bumped whenever a key's exact/rolled bucket changes (interval-
+        # end swaps only); row caches keyed on it revalidate lazily
+        self.epoch = 0
+        self.tenants: dict[str, _Tenant] = {}
+        self.keys_evicted_total = 0
+        self.rollup_points_total = 0
+        # (type, scope, tenant) -> (rollup MetricKey, scope, tags)
+        self._rollup_cache: dict = {}
+
+    # -- classification (hot path, under the aggregator lock) -------------
+
+    def tenant_of(self, tags: list[str]) -> Optional[str]:
+        for t in tags:
+            if t.startswith(self._prefix):
+                return t[len(self._prefix):]
+        return None
+
+    def resolve(self, key: MetricKey, scope: MetricScope,
+                tags: list[str], n: int = 1):
+        """Classify one key sighting carrying `n` samples.  Returns None
+        to keep the original identity (untenanted, or exact under
+        budget), or the (rollup_key, scope, rollup_tags) rewrite for the
+        folded tail.  Also the ONLY place touch counts accrue, so
+        callers must invoke it once per staged batch even on cached
+        rows."""
+        tenant = self.tenant_of(tags)
+        if tenant is None:
+            return None
+        st = self.tenants.get(tenant)
+        if st is None:
+            st = self.tenants[tenant] = _Tenant()
+        dk = (key, scope)
+        cnt = st.exact.get(dk)
+        if cnt is not None:
+            st.exact[dk] = cnt + n
+            return None
+        if len(st.exact) < self.budget:
+            st.exact[dk] = n
+            self._rank_of(st, dk)
+            return None
+        # over budget: the tail folds into the rollup sketch
+        cand = st.candidates
+        entry = cand.get(dk)
+        if entry is not None:
+            entry[0] += n
+            self._heap_push(st, entry[0], entry[1], dk)
+        elif len(cand) < self.budget:
+            rank = self._rank_of(st, dk)
+            cand[dk] = [n, rank]
+            self._heap_push(st, n, rank, dk)
+            self.keys_evicted_total += 1
+            st.evicted_total += 1
+        else:
+            # space-saving replacement: the new key inherits the
+            # smallest candidate's count (deterministic victim via the
+            # seeded tie-break, found through the lazy heap), so a
+            # genuinely hot newcomer can still earn promotion while the
+            # table stays budget-bounded
+            vcount, _, vdk = self._heap_min(st)
+            del cand[vdk]
+            if vdk not in st.exact:
+                st.ranks.pop(vdk, None)
+            heapq.heappop(st.cand_heap)
+            rank = self._rank_of(st, dk)
+            cand[dk] = [vcount + n, rank]
+            self._heap_push(st, vcount + n, rank, dk)
+            self.keys_evicted_total += 1
+            st.evicted_total += 1
+        st.rollup_points += n
+        self.rollup_points_total += n
+        return self._rollup_identity(key.type, scope, tenant)
+
+    @staticmethod
+    def _heap_push(st: _Tenant, count: int, rank: int, dk) -> None:
+        # lazy updates leave stale tuples behind; COMPACT once the heap
+        # outgrows a small multiple of the live table, so a high-rate
+        # stable tail (every sample an update-push) cannot grow the
+        # heap unboundedly within an interval — the tracking must never
+        # itself become the explosion it defends against
+        if len(st.cand_heap) > 4 * len(st.candidates) + 64:
+            st.seq = len(st.candidates)
+            st.cand_heap = [
+                (e[0], e[1], i, cdk)
+                for i, (cdk, e) in enumerate(st.candidates.items())]
+            heapq.heapify(st.cand_heap)
+            if dk in st.candidates:
+                return   # the rebuild already carries the fresh count
+        st.seq += 1
+        heapq.heappush(st.cand_heap, (count, rank, st.seq, dk))
+
+    @staticmethod
+    def _heap_min(st: _Tenant):
+        """Current space-saving minimum: pop stale heap entries (their
+        key left the table or its count moved on) until the top matches
+        the live table.  Amortized O(log H) — every entry is discarded
+        at most once."""
+        while st.cand_heap:
+            count, rank, _, dk = st.cand_heap[0]
+            entry = st.candidates.get(dk)
+            if entry is not None and entry[0] == count:
+                return count, rank, dk
+            heapq.heappop(st.cand_heap)
+        raise RuntimeError("space-saving heap empty with a full "
+                           "candidate table")  # unreachable by invariant
+
+    def _rank_hash(self, dk) -> int:
+        # the arena fingerprints' canonical identity encoding, seeded —
+        # one shared definition (samplers/metric_key.py), so the two can
+        # never silently diverge
+        return fnv1a_64(identity_string(*dk), self.seed)
+
+    def _rank_of(self, st: _Tenant, dk) -> int:
+        """Memoized seeded tie-break rank, computed once per exact/
+        candidate membership (never per comparison — the hot path stays
+        off the per-byte identity hash)."""
+        r = st.ranks.get(dk)
+        if r is None:
+            r = st.ranks[dk] = self._rank_hash(dk)
+        return r
+
+    def _rollup_identity(self, mtype: str, scope: MetricScope,
+                         tenant: str):
+        ck = (mtype, scope, tenant)
+        rolled = self._rollup_cache.get(ck)
+        if rolled is None:
+            tags = sorted([ROLLUP_TAG, f"{self.tenant_tag}:{tenant}"])
+            rkey = MetricKey(ROLLUP_NAME_PREFIX + mtype, mtype,
+                             ",".join(tags))
+            rolled = self._rollup_cache[ck] = (rkey, scope, tags)
+        return rolled
+
+    # -- interval-end eviction (under the aggregator lock, at snapshot) ----
+
+    def end_interval(self,
+                     evict_cb: Optional[Callable[[list], None]] = None
+                     ) -> int:
+        """Seeded count-ordered eviction: promote rolled candidates that
+        strictly out-touched the coldest exact keys, retire exact keys
+        idle for IDLE_EXACT_INTERVALS, and reset the interval counters.
+
+        `evict_cb(evicted_dks)` runs ONCE with the full planned eviction
+        list BEFORE any guard state mutates (it is the `arena.evict`
+        failpoint edge and the arena row release); if it raises, the
+        pass aborts with the quota state untouched — a fault injected
+        mid-eviction can delay reclamation, never corrupt it.  Returns
+        keys evicted."""
+        planned: list[tuple] = []   # (tenant, evicted dk, promoted dk|None)
+        for tenant, st in self.tenants.items():
+            # idle decay first: an exact key untouched for the window
+            # frees its budget slot (its arena row is released too)
+            exact_live: dict = {}
+            for dk, cnt in st.exact.items():
+                idle = st.idle.get(dk, 0) + 1 if cnt == 0 else 0
+                st.idle[dk] = idle
+                if idle >= IDLE_EXACT_INTERVALS:
+                    planned.append((tenant, dk, None))
+                else:
+                    exact_live[dk] = cnt
+            if not st.candidates:
+                continue
+            # one sort each way (ranks are memoized per membership, so
+            # no identity re-hashing here), then a two-pointer walk:
+            # hottest candidates vs coldest exact keys.  Equivalent to
+            # repeated max/min extraction — candidates are consumed
+            # hottest-first, so a promoted key can never be displaced
+            # by a LATER (colder) candidate in the same pass — without
+            # the O(swaps x budget) rescans
+            cand_desc = sorted(
+                ((e[0], e[1], dk) for dk, e in st.candidates.items()),
+                reverse=True)
+            exact_asc = sorted(
+                ((cnt, self._rank_of(st, dk), dk)
+                 for dk, cnt in exact_live.items()))
+            n_live = len(exact_live)
+            ci = xi = 0
+            while ci < len(cand_desc):
+                hot_cnt, _, hot_dk = cand_desc[ci]
+                if n_live < self.budget:
+                    # headroom (idle decay, or a raised budget): the
+                    # hottest candidates claim the free slots
+                    planned.append((tenant, None, hot_dk))
+                    ci += 1
+                    n_live += 1
+                    continue
+                if xi >= len(exact_asc):
+                    break
+                cold_cnt, _, cold_dk = exact_asc[xi]
+                if hot_cnt <= cold_cnt:
+                    break   # strict: promotion must be earned
+                planned.append((tenant, cold_dk, hot_dk))
+                ci += 1
+                xi += 1
+
+        evicted = [(t, dk) for t, dk, _ in planned if dk is not None]
+        if evicted and evict_cb is not None:
+            evict_cb([dk for _, dk in evicted])
+
+        changed = False
+        for tenant, cold_dk, hot_dk in planned:
+            st = self.tenants[tenant]
+            if cold_dk is not None:
+                st.exact.pop(cold_dk, None)
+                st.idle.pop(cold_dk, None)
+                st.evicted_total += 1
+                self.keys_evicted_total += 1
+                changed = True
+            if hot_dk is not None:
+                st.candidates.pop(hot_dk, None)
+                st.exact[hot_dk] = 0
+                st.idle[hot_dk] = 0
+                changed = True
+        for st in self.tenants.values():
+            for dk in st.exact:
+                st.exact[dk] = 0
+            st.candidates.clear()
+            st.cand_heap.clear()
+            st.seq = 0
+            # the rank memo follows the membership: exact keys only at
+            # the interval boundary (candidates re-memoize on re-sight)
+            st.ranks = {dk: st.ranks[dk] for dk in st.exact
+                        if dk in st.ranks}
+        # prune tenants that hold nothing: a fleet with ephemeral tenant
+        # values (one key per tenant, never over budget) must not grow
+        # the guard's own state without bound — the very hazard it
+        # exists to defend the arenas against
+        empty = [t for t, st in self.tenants.items()
+                 if not st.exact and not st.candidates]
+        for t in empty:
+            del self.tenants[t]
+        if changed:
+            self.epoch += 1
+        return len(evicted)
+
+    # -- observability -----------------------------------------------------
+
+    def over_budget_tenants(self) -> int:
+        # list() copy: safe against a concurrent first-sight insert on
+        # the ingest path (observers run without the aggregator lock)
+        return sum(1 for st in list(self.tenants.values())
+                   if len(st.exact) >= self.budget)
+
+    def snapshot(self) -> dict:
+        """/debug/vars payload: global totals plus the per-tenant quota
+        ledger.  Lock-free observer — iterates list() copies, so a
+        racing tenant insert can skew a count by one, never raise."""
+        return {
+            "budget": self.budget,
+            "tenant_tag": self.tenant_tag,
+            "keys_evicted": self.keys_evicted_total,
+            "rollup_points": self.rollup_points_total,
+            "tenants_over_budget": self.over_budget_tenants(),
+            "epoch": self.epoch,
+            "tenants": {
+                t: {"exact_keys": len(st.exact),
+                    "evicted_total": st.evicted_total,
+                    "rollup_points": st.rollup_points,
+                    "over_budget": len(st.exact) >= self.budget}
+                for t, st in list(self.tenants.items())},
+        }

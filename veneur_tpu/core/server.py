@@ -203,7 +203,10 @@ class Server:
             digest_float64=cfg.digest_float64,
             digest_bf16_staging=cfg.digest_bf16_staging,
             flush_upload_chunks=cfg.flush_upload_chunks,
-            flush_presharded_staging=cfg.flush_presharded_staging)
+            flush_presharded_staging=cfg.flush_presharded_staging,
+            cardinality_key_budget=cfg.cardinality_key_budget,
+            cardinality_tenant_tag=cfg.cardinality_tenant_tag,
+            cardinality_seed=cfg.cardinality_seed)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -481,7 +484,11 @@ class Server:
                 # push the data-plane stage totals alongside the runtime
                 # stats (reads self.native at call time: safe across the
                 # engine's whole lifecycle, {} once it is torn down)
-                sources=[lambda: diag_mod.ingest_stage_gauges(self.native)])
+                sources=[
+                    lambda: diag_mod.ingest_stage_gauges(self.native),
+                    # per-tenant quota/eviction counters (cardinality.*)
+                    lambda: diag_mod.cardinality_gauges(self.aggregator),
+                ])
             self.diagnostics.start()
         for source in self.sources:
             source.start(self.ingest_shim)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import gc
 import os
+import re
 import threading
 import time
 from typing import Optional
@@ -63,6 +64,47 @@ def ingest_stage_gauges(native) -> dict[str, float]:
     for stage, counters in st["totals"].items():
         for k, v in counters.items():
             out[f"ingest.stage.{stage}.{k}"] = float(v)
+    return out
+
+
+# per-tenant cardinality gauges are capped to the worst offenders: the
+# self-metric namespace must never itself become the unbounded,
+# attacker-influenced key space the guard exists to prevent
+CARDINALITY_GAUGE_TENANTS = 8
+_TENANT_NAME_SAFE = re.compile(r"[^A-Za-z0-9_-]")
+
+
+def cardinality_gauges(aggregator) -> dict[str, float]:
+    """Per-tenant quota/eviction counters from the cardinality guard
+    (`cardinality.*`); {} when the defense is off, so the source is safe
+    to wire unconditionally.  Per-tenant gauges cover only over-budget
+    tenants, capped at the CARDINALITY_GAUGE_TENANTS worst offenders by
+    rollup points, with the tenant value sanitized before it lands in a
+    metric name (raw values may carry statsd metacharacters); the full
+    uncapped ledger stays at /debug/vars -> cardinality."""
+    guard = getattr(aggregator, "cardinality", None)
+    if guard is None:
+        return {}
+    snap = guard.snapshot()
+    out = {
+        "cardinality.keys_evicted": float(snap["keys_evicted"]),
+        "cardinality.rollup_points": float(snap["rollup_points"]),
+        "cardinality.tenants_over_budget":
+            float(snap["tenants_over_budget"]),
+        "cardinality.tenants": float(len(snap["tenants"])),
+    }
+    offenders = sorted(
+        ((t, st) for t, st in snap["tenants"].items()
+         if st["over_budget"]),
+        key=lambda kv: kv[1]["rollup_points"], reverse=True)
+    for tenant, st in offenders[:CARDINALITY_GAUGE_TENANTS]:
+        name = _TENANT_NAME_SAFE.sub("_", tenant)[:64] or "_"
+        out[f"cardinality.tenant.{name}.exact_keys"] = \
+            float(st["exact_keys"])
+        out[f"cardinality.tenant.{name}.keys_evicted"] = \
+            float(st["evicted_total"])
+        out[f"cardinality.tenant.{name}.rollup_points"] = \
+            float(st["rollup_points"])
     return out
 
 

@@ -30,6 +30,11 @@ Injection sites threaded through this repo (grep `failpoints.inject`):
   proxy.send_batch    per V1 chunk RPC         (proxy/connect.py)
   proxy.stream        V2 sender stream         (proxy/connect.py)
   destinations.add    Destinations._connect    (proxy/destinations.py)
+  destinations.reshard  top of a two-phase reshard window, before any
+                      membership mutation      (proxy/destinations.py)
+  arena.evict         the cardinality eviction pass, before any arena
+                      row is released — a fault here aborts the pass
+                      with quota state intact  (core/aggregator.py)
   server.flush        top of the flush path    (core/server.py)
 """
 

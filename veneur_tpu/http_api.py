@@ -125,6 +125,11 @@ def make_handler(server) -> type:
                     # the forward client's retry-policy accounting:
                     # sent / retries / dropped metric totals
                     stats["forward"] = fw.stats()
+                guard = getattr(server.aggregator, "cardinality", None)
+                if guard is not None:
+                    # per-tenant key-budget ledger: exact keys, evicted
+                    # cardinality, rollup point totals
+                    stats["cardinality"] = guard.snapshot()
                 native = getattr(server, "native", None)
                 if native is not None:
                     ni = native.stats()  # None while tearing down

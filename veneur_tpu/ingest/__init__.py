@@ -500,6 +500,10 @@ class _IdInfo:
     uts_bytes: Optional[bytes]  # unique-timeseries HLL insert, if counted
     row: int = -1
     meta: object = None      # RowMeta identity for GC revalidation
+    # cardinality-guard epoch this row binding was resolved under; an
+    # interval-end eviction/promotion bumps the guard's epoch, which
+    # forces a re-resolve (the key may have changed buckets)
+    card_epoch: int = -1
 
 
 class NativeIngest:
@@ -557,15 +561,31 @@ class NativeIngest:
 
     def _rows_for(self, arena, ids: np.ndarray) -> np.ndarray:
         """Resolve engine ids to arena rows (vectorized via the cache;
-        row_for only on first sight or after GC)."""
-        uids = np.unique(ids)
+        row_for only on first sight or after GC).  With a cardinality
+        guard active, every unique id reports its staged-sample count to
+        the guard (touch counts drive the seeded count-ordered
+        eviction), and row bindings resolved under a stale guard epoch
+        re-resolve — the key may have moved between its exact row and
+        the tenant rollup row."""
+        guard = getattr(self.agg, "cardinality", None)
+        uids, ucounts = np.unique(ids, return_counts=True)
         lut = np.empty(int(uids[-1]) + 1 if len(uids) else 0, np.int64)
         uts = self.agg.unique_ts
-        for uid in uids:
+        for uid, ucount in zip(uids, ucounts):
             info = self._info[uid]
             row = info.row
+            resolved = None
+            if guard is not None:
+                resolved = guard.resolve(info.key, info.row_scope,
+                                         info.tags, int(ucount))
+                if info.card_epoch != guard.epoch:
+                    info.card_epoch = guard.epoch
+                    row = -1
             if row < 0 or arena.meta[row] is not info.meta:
-                row = arena.row_for(info.key, info.row_scope, info.tags)
+                key, scope, tags = (resolved if resolved is not None
+                                    else (info.key, info.row_scope,
+                                          info.tags))
+                row = arena.row_for(key, scope, tags)
                 info.row = row
                 info.meta = arena.meta[row]
             else:

@@ -43,6 +43,22 @@ logger = logging.getLogger("veneur_tpu.proxy.connect")
 _CLOSE = object()  # sentinel terminating a sender
 
 
+def _closed_channel_error(e: BaseException) -> bool:
+    """grpc raises a bare ValueError("Cannot invoke RPC on closed
+    channel!") when the channel is torn down mid-send (a reshard
+    retire); ONLY that condition may take the dropped-accounting path —
+    any other ValueError is a programming defect and must stay loud."""
+    return "closed channel" in str(e)
+
+
+def _reraise_unless_closed_channel(e: BaseException) -> None:
+    """The one shared gate in front of every sender's dropped-accounting
+    path: tolerated transport-teardown exceptions pass through; a
+    foreign ValueError re-raises."""
+    if isinstance(e, ValueError) and not _closed_channel_error(e):
+        raise e
+
+
 class _Raw:
     """A pre-serialized routed group from the native wire router
     (ingest.route_metric_list): `chunks` are VALID MetricList bodies
@@ -246,7 +262,9 @@ class Destination:
                     self._send_batch(batch)
                 finally:
                     self._release(len(batch))
-        except (grpc.RpcError, failpoints.FailpointDrop) as e:
+        except (grpc.RpcError, failpoints.FailpointDrop,
+                ValueError) as e:
+            _reraise_unless_closed_channel(e)
             logger.warning("destination %s batch send failed: %s",
                            self.address, e)
         finally:
@@ -262,7 +280,13 @@ class Destination:
                 failpoints.inject("proxy.send_batch")
                 self._v1(forward_pb2.MetricList(metrics=chunk),
                          timeout=self.send_timeout_s)
-            except (grpc.RpcError, failpoints.FailpointDrop):
+            except (grpc.RpcError, failpoints.FailpointDrop,
+                    ValueError) as e:
+                # closed-channel ValueError = the destination was
+                # retired while this batch was in flight: same
+                # accounting as a broken RPC; other ValueErrors re-raise
+                # un-accounted (they are bugs, not transport loss)
+                _reraise_unless_closed_channel(e)
                 with self._sent_lock:
                     self.dropped += len(batch) - i
                 raise
@@ -277,7 +301,9 @@ class Destination:
             try:
                 failpoints.inject("proxy.send_batch")
                 self._v1_raw(chunk, timeout=self.send_timeout_s)
-            except (grpc.RpcError, failpoints.FailpointDrop):
+            except (grpc.RpcError, failpoints.FailpointDrop,
+                    ValueError) as e:
+                _reraise_unless_closed_channel(e)
                 with self._sent_lock:
                     self.dropped += remaining
                 raise
@@ -333,7 +359,9 @@ class Destination:
         try:
             failpoints.inject("proxy.stream")
             self._v2(it())
-        except (grpc.RpcError, failpoints.FailpointDrop) as e:
+        except (grpc.RpcError, failpoints.FailpointDrop,
+                ValueError) as e:
+            _reraise_unless_closed_channel(e)
             logger.warning("destination %s stream closed: %s",
                            self.address, e)
         finally:
@@ -391,6 +419,29 @@ class Destination:
                 # sentinel; consuming it would strand that thread in
                 # q.get() forever
                 qq.put(_CLOSE)
+
+    def take_swept(self) -> list:
+        """Consume the close-sweep's undelivered items as a flat Metric
+        list (the reshard drain-and-forward handoff,
+        proxy/destinations.py): raw routed chunks parse back into
+        Metrics; call only after close() has run its final sweep.  The
+        swept record is consumed, so a producer racing the close may
+        see its reclaimed item reported 'ok' — harmless here, since the
+        item is about to be re-delivered through the new ring rather
+        than dropped."""
+        with self._sent_lock:
+            items, self._swept = self._swept, []
+        out: list = []
+        for item in items:
+            if isinstance(item, _Raw):
+                for ch in item.chunks:
+                    out.extend(
+                        forward_pb2.MetricList.FromString(ch).metrics)
+            elif isinstance(item, list):
+                out.extend(item)
+            else:
+                out.append(item)
+        return out
 
     # -- enqueue -----------------------------------------------------------
 

@@ -12,6 +12,24 @@ import bisect
 import zlib
 
 
+def moved_keys(old_members: list[str], new_members: list[str],
+               n_keys: int = 2048,
+               prefix: str = "reshard-sample-") -> tuple[int, int]:
+    """Deterministic ownership-movement estimate between two ring
+    memberships: route `n_keys` fixed sample keys through both rings and
+    count the ones whose owner changed.  Consistent hashing bounds the
+    true movement at ~K/N for one node joining an N-ring; the reshard
+    record reports this sample so operators can see the bound holding.
+    Returns (moved, sampled); (0, 0) when either ring is empty."""
+    if not old_members or not new_members or n_keys <= 0:
+        return 0, 0
+    old = ConsistentHash(list(old_members))
+    new = ConsistentHash(list(new_members))
+    moved = sum(1 for i in range(n_keys)
+                if old.get(f"{prefix}{i}") != new.get(f"{prefix}{i}"))
+    return moved, n_keys
+
+
 class ConsistentHash:
     REPLICAS = 20
 

@@ -17,6 +17,16 @@ the member to the ring; failure re-opens it with a longer cooldown.  The
 discovery poll (proxy.go:345-387 -> set_members) is the natural probe
 driver: every poll re-offers the wanted membership, and the breaker decides
 which offers turn into dials.
+
+Membership changes run as a TWO-PHASE ELASTIC RESHARD (set_members):
+joiners connect while the old ring still serves, then each leaver drains
+its undelivered buffer through the proxy's handoff back onto the new ring
+(drain-and-forward) instead of dropping it.  Consistent hashing bounds
+movement at ~K/N keys per node joining an N-ring; every reshard commits a
+record (epoch, members, sampled keys moved, handoff counts, duration) at
+/debug/vars -> reshard.  An engaged (open/half-open) breaker survives the
+flap, so a reshard can never resurrect a tripped destination without a
+successful probe.
 """
 
 from __future__ import annotations
@@ -61,7 +71,10 @@ class Destinations:
                  n_streams: int = 8, send_timeout_s: float = 30.0,
                  dial_timeout_s: float = 5.0,
                  breaker_threshold: int = 3,
-                 breaker_reset_s: float = 5.0):
+                 breaker_reset_s: float = 5.0,
+                 handoff=None,
+                 handoff_timeout_s: float = 2.0,
+                 reshard_sample_keys: int = 2048):
         self.send_buffer_size = send_buffer_size
         self.n_streams = n_streams
         self.grpc_stats = grpc_stats
@@ -69,6 +82,13 @@ class Destinations:
         self.dial_timeout_s = dial_timeout_s
         self.breaker_threshold = max(1, breaker_threshold)
         self.breaker_reset_s = breaker_reset_s
+        # reshard drain-and-forward: `handoff(metrics)` re-routes a
+        # retiring destination's undelivered buffer through the NEW ring
+        # (the proxy wires handle_metrics in); None = legacy behavior,
+        # swept items stay accounted as dropped
+        self.handoff = handoff
+        self.handoff_timeout_s = handoff_timeout_s
+        self.reshard_sample_keys = reshard_sample_keys
         self._lock = threading.Lock()
         self._ring = ConsistentHash()
         self._dests: dict[str, Destination] = {}
@@ -79,6 +99,14 @@ class Destinations:
         self._retired_sent = 0
         self._retired_dropped = 0
         self._ring_cache = None   # (hashes, didx, dests); see ring_arrays
+        # elastic-reshard bookkeeping: one reshard window at a time
+        # (reshard_begin acquires, reshard_commit releases), the last
+        # committed record for /debug/vars, and cumulative totals
+        self._reshard_serial = threading.Lock()
+        self._reshard_epoch = 0
+        self._reshard_moved_total = 0
+        self._reshard_handoff_total = 0
+        self._last_reshard: dict | None = None
 
     # -- breaker bookkeeping (all under self._lock) ------------------------
 
@@ -202,10 +230,15 @@ class Destinations:
         self._record_failure(dest.address)
         self.remove(dest.address, expected=dest)
 
-    def remove(self, address: str, expected=None) -> None:
+    def remove(self, address: str, expected=None, handoff=None) -> None:
         """Remove a destination; with `expected`, only if the registered
         object is that same instance (so a stale connection's close
-        callback cannot tear down a re-added healthy destination)."""
+        callback cannot tear down a re-added healthy destination).
+
+        `handoff` (a reshard record) switches to the SYNCHRONOUS
+        drain-and-forward retire: the destination's undelivered buffer
+        re-routes through the new ring instead of counting as dropped,
+        and the record accumulates the handoff accounting."""
         with self._lock:
             dest = self._dests.get(address)
             if dest is None or (expected is not None and dest is not expected):
@@ -221,32 +254,175 @@ class Destinations:
             base = (dest.sent, dest.dropped)
             self._retired_sent += base[0]
             self._retired_dropped += base[1]
-        threading.Thread(target=self._retire, args=(dest, base),
-                         daemon=True).start()
+        if handoff is not None:
+            # synchronous: the reshard record must carry final counts at
+            # commit, and set_members' caller (the discovery loop) is
+            # the natural place to pay the bounded drain
+            self._retire(dest, base, handoff)
+        else:
+            threading.Thread(target=self._retire, args=(dest, base),
+                             daemon=True).start()
 
-    def _retire(self, dest: Destination, base: tuple[int, int]) -> None:
+    def _retire(self, dest: Destination, base: tuple[int, int],
+                handoff: dict | None = None) -> None:
         try:
-            dest.close()     # idempotent; joins senders + final sweep
+            # a reshard drain is bounded by the handoff timeout; an
+            # ordinary retire keeps the destination's own default
+            dest.close(**({"drain_timeout_s": self.handoff_timeout_s}
+                          if handoff is not None else {}))
         finally:
+            rerouted = 0
+            if handoff is not None and self.handoff is not None:
+                metrics = dest.take_swept()
+                if metrics:
+                    handoff["handoff_inflight"] = len(metrics)
+                    try:
+                        self.handoff(metrics)
+                        rerouted = len(metrics)
+                    except Exception:
+                        logger.exception(
+                            "reshard handoff re-route failed; %d "
+                            "metrics stay accounted as dropped",
+                            len(metrics))
+                    handoff["handoff_inflight"] = 0
+                    handoff["handoff_metrics"] += rerouted
             with self._lock:
                 self._retired_sent += dest.sent - base[0]
-                self._retired_dropped += dest.dropped - base[1]
+                # the close sweep counted the swept items dropped on the
+                # destination; the ones the handoff re-routed MOVED, they
+                # did not die (any that the NEW owner drops are counted
+                # there) — keep the visible totals truthful
+                self._retired_dropped += dest.dropped - base[1] - rerouted
+                self._reshard_handoff_total += rerouted
+
+    # -- elastic reshard ---------------------------------------------------
+
+    def reshard_begin(self, want: list[str]) -> dict:
+        """Open a reshard window (one at a time; pairs with
+        reshard_commit — the vnlint resource-pairing contract, so an
+        abandoned handoff is a lint error).  Returns the mutable record
+        the phases fill in."""
+        self._reshard_serial.acquire()
+        with self._lock:
+            before = sorted(self._ring.members())
+            self._reshard_epoch += 1
+            epoch = self._reshard_epoch
+        return {
+            "epoch": epoch,
+            "started_unix": time.time(),
+            "_t0": time.monotonic(),
+            "members_before": before,
+            "wanted": sorted(want),
+            "members_after": None,
+            "added": [],
+            "removed": [],
+            "keys_moved": 0,
+            "sample_keys": self.reshard_sample_keys,
+            "moved_frac": 0.0,
+            "handoff_metrics": 0,
+            "handoff_inflight": 0,
+            "duration_s": None,
+            "committed": False,
+        }
+
+    def reshard_commit(self, rec: dict) -> None:
+        """Close a reshard window: record the achieved membership, the
+        sampled key movement (bounded-movement evidence), and the
+        duration; publish as the /debug/vars reshard record."""
+        try:
+            from veneur_tpu.proxy import consistent
+            with self._lock:
+                after = sorted(self._ring.members())
+            before = rec["members_before"]
+            rec["members_after"] = after
+            rec["added"] = sorted(set(after) - set(before))
+            rec["removed"] = sorted(set(before) - set(after))
+            moved, sampled = consistent.moved_keys(
+                before, after, self.reshard_sample_keys)
+            rec["keys_moved"] = moved
+            rec["sample_keys"] = sampled
+            rec["moved_frac"] = moved / sampled if sampled else 0.0
+            rec["duration_s"] = round(
+                time.monotonic() - rec.pop("_t0"), 6)
+            rec["committed"] = True
+            with self._lock:
+                self._reshard_moved_total += moved
+                self._last_reshard = rec
+        finally:
+            self._reshard_serial.release()
+
+    def reshard_stats(self) -> dict:
+        """Cumulative reshard accounting + the last committed record
+        (/debug/vars -> reshard)."""
+        with self._lock:
+            return {
+                "epochs": self._reshard_epoch,
+                "moved_total": self._reshard_moved_total,
+                "handoff_total": self._reshard_handoff_total,
+                "last": (dict(self._last_reshard)
+                         if self._last_reshard is not None else None),
+            }
 
     def set_members(self, addresses: list[str]) -> None:
-        """Reconcile with a discovery result: add new, drop vanished
-        (proxy.go:345-387 HandleDiscovery).  Addresses leaving the wanted
-        set also shed their breaker state (a deliberate removal is not a
-        failure); wanted-but-tripped addresses get probed by add() once
-        their cooldown expires."""
+        """Reconcile with a discovery result (proxy.go:345-387
+        HandleDiscovery), grown into a TWO-PHASE RESHARD when the ring
+        membership actually changes:
+
+          phase 1 (grow)   joiners connect while the old ring still
+                           serves — no window where keys have no owner;
+          phase 2 (drain)  leavers retire one by one, each draining its
+                           undelivered buffer through the handoff back
+                           onto the NEW ring (drain-and-forward) so a
+                           scale-down moves queued metrics instead of
+                           dropping them.
+
+        Consistent hashing bounds the movement to ~K/N keys for one node
+        joining an N-ring; the committed record (reshard_stats) carries
+        a sampled measurement of exactly that, plus the handoff counts
+        and duration.  Breaker and sent/dropped-totals state of
+        SURVIVING destinations is untouched.
+
+        Breaker interplay: a LEAVING address sheds its breaker state
+        only when the breaker is not engaged (a deliberate removal is
+        not a failure) — an OPEN or HALF-OPEN breaker survives the
+        membership flap, so a reshard that drops and re-adds a tripped
+        destination can never resurrect it without a successful probe.
+        Wanted-but-tripped addresses keep being offered to add() every
+        poll; the breaker decides which offers become dials."""
         want = set(addresses)
+        now = time.monotonic()
         with self._lock:
             have = set(self._dests)
+            engaged = set()
             for addr in list(self._breakers):
+                b = self._breakers[addr]
+                if b.half_open or b.open_until > now:
+                    # engaged breaker: state survives even if the
+                    # address leaves the wanted set (the satellite fix:
+                    # no probe-free resurrection through a reshard)
+                    engaged.add(addr)
+                    continue
                 if addr not in want:
                     del self._breakers[addr]
-        for addr in have - want:
-            self.remove(addr)
-        self.add(sorted(want - have))
+        to_add = sorted(want - have)
+        to_remove = sorted(have - want)
+        if not to_remove and not (want - have - engaged):
+            # no ring change on offer: every new wanted address is
+            # breaker-gated (add() runs anyway — it is the half-open
+            # probe driver once cooldowns expire).  No reshard record;
+            # a probe restoring a member is breaker telemetry, not an
+            # operator reshard.
+            self.add(to_add)
+            return
+        from veneur_tpu import failpoints
+        rec = self.reshard_begin(sorted(want))
+        try:
+            failpoints.inject("destinations.reshard")
+            self.add(to_add)
+            for addr in to_remove:
+                self.remove(addr, handoff=rec)
+        finally:
+            self.reshard_commit(rec)
 
     def get(self, key: str) -> Destination:
         with self._lock:

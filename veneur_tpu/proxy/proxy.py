@@ -60,6 +60,13 @@ class ProxyConfig:
     # breaker_reset_timeout and doubles per consecutive trip (cap 8x)
     breaker_failure_threshold: int = 3
     breaker_reset_timeout: float = 5.0
+    # elastic ring reshard (proxy/destinations.py set_members): how long
+    # a retiring destination may drain before its undelivered buffer is
+    # swept into the handoff (drain-and-forward onto the new ring), and
+    # how many deterministic sample keys the committed reshard record
+    # routes through old+new rings to measure key movement
+    reshard_handoff_timeout: float = 2.0
+    reshard_sample_keys: int = 2048
     # inbound gRPC handler pool width, and how long stop() lets
     # in-flight RPCs finish before cancelling them
     grpc_workers: int = 16
@@ -103,6 +110,9 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
             data.get("breaker_failure_threshold", 3)),
         breaker_reset_timeout=parse_duration(
             data.get("breaker_reset_timeout", 5.0)),
+        reshard_handoff_timeout=parse_duration(
+            data.get("reshard_handoff_timeout", 2.0)),
+        reshard_sample_keys=int(data.get("reshard_sample_keys", 2048)),
         grpc_workers=int(data.get("grpc_workers", 16)),
         shutdown_grace=parse_duration(data.get("shutdown_grace", 1.0)),
         ignore_tags=[TagMatcher(**t) for t in data.get("ignore_tags", [])],
@@ -142,9 +152,14 @@ class Proxy:
             send_timeout_s=cfg.proxy_send_timeout,
             dial_timeout_s=cfg.proxy_dial_timeout,
             breaker_threshold=cfg.breaker_failure_threshold,
-            breaker_reset_s=cfg.breaker_reset_timeout)
+            breaker_reset_s=cfg.breaker_reset_timeout,
+            # reshard drain-and-forward: a retiring destination's
+            # undelivered buffer re-routes through the NEW ring
+            handoff=self._reshard_handoff,
+            handoff_timeout_s=cfg.reshard_handoff_timeout,
+            reshard_sample_keys=cfg.reshard_sample_keys)
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
-                      "no_destination": 0}
+                      "no_destination": 0, "rerouted": 0}
         self._stats_lock = threading.Lock()
         self._shutdown = threading.Event()
         # native wire router, resolved lazily (None = untried,
@@ -298,12 +313,17 @@ class Proxy:
             self.stats["routed"] += routed_n
             self.stats["dropped"] += dropped
 
-    def handle_metrics(self, ms) -> None:
+    def handle_metrics(self, ms, rerouted: bool = False) -> None:
         """Batched routing (the V1 inbound path): group by destination,
         enqueue each group as one unit, take the stats lock once.  Same
         per-metric routing key and drop accounting as handle_metric —
         just amortized, so one proxy process keeps up with the batched
-        fleet-internal transport it now speaks on both edges."""
+        fleet-internal transport it now speaks on both edges.
+
+        `rerouted` marks a reshard handoff replay: the metrics were
+        already counted received AND routed when they first arrived, so
+        the replay bumps only `rerouted` plus any NEW outcome —
+        drops/no-owner at the new destination are fresh, real losses."""
         groups: dict = {}
         no_dest = 0
         for m in ms:
@@ -323,11 +343,24 @@ class Proxy:
             dropped += n_drop
             routed += len(batch) - n_drop
         with self._stats_lock:
-            self.stats["received"] += len(ms) if hasattr(ms, "__len__") \
-                else routed + dropped + no_dest
+            if rerouted:
+                # replayed metrics were counted received AND routed when
+                # they first arrived; only the replay outcome is new —
+                # drops/no-owner at the new destination are real losses
+                self.stats["rerouted"] += routed + dropped + no_dest
+            else:
+                self.stats["received"] += len(ms) \
+                    if hasattr(ms, "__len__") \
+                    else routed + dropped + no_dest
+                self.stats["routed"] += routed
             self.stats["no_destination"] += no_dest
-            self.stats["routed"] += routed
             self.stats["dropped"] += dropped
+
+    def _reshard_handoff(self, ms) -> None:
+        """Drain-and-forward target for Destinations: re-route a
+        retiring destination's undelivered buffer through the new
+        ring."""
+        self.handle_metrics(ms, rerouted=True)
 
     # -- HTTP surface (handlers.go:30-38 healthcheck +
     #    cmd/veneur-proxy/main.go:84-102 version/builddate/config/debug) --
@@ -379,6 +412,10 @@ class Proxy:
                         proxy.destinations.totals()
                     stats["breakers"] = \
                         proxy.destinations.breaker_stats()
+                    # elastic-reshard record: epochs, sampled keys
+                    # moved, handoff counts, last committed window
+                    stats["reshard"] = \
+                        proxy.destinations.reshard_stats()
                     stats["threads"] = threading.active_count()
                     http_api.reply(self, 200, json_mod.dumps(
                         stats, indent=2).encode(), "application/json")

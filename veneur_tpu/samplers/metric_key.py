@@ -42,6 +42,29 @@ def metric_digest(name: str, mtype: str, joined_tags: str) -> int:
     return h
 
 
+_FNV1A_INIT64 = 0xCBF29CE484222325
+_FNV1A_PRIME64 = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a_64(s: str, seed: int = 0) -> int:
+    """64-bit FNV-1a of a string, with an optional seed XOR-folded into
+    the offset basis (seeded deterministic tie-breaks)."""
+    h = _FNV1A_INIT64 ^ (seed & _MASK64)
+    for b in s.encode():
+        h = ((h ^ b) * _FNV1A_PRIME64) & _MASK64
+    return h
+
+
+def identity_string(key: "MetricKey", scope: "MetricScope") -> str:
+    """THE canonical (key, scope) identity encoding — shared by the
+    arena key-dictionary fingerprints (core/arena.py) and the
+    cardinality guard's seeded eviction ranking (core/cardinality.py),
+    so the two can never silently diverge."""
+    return (f"{key.name}\x00{key.type}\x00{key.joined_tags}"
+            f"\x00{int(scope)}")
+
+
 @dataclass(frozen=True)
 class MetricKey:
     """Comparable/hashable sampler-map key (`samplers/parser.go:100-104`)."""

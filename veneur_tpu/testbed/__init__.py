@@ -15,14 +15,16 @@ Entry points:
   CHAOS_ARMS et al.     the chaos matrix      (testbed/chaos.py)
 """
 
-from veneur_tpu.testbed.chaos import (CHAOS_ARMS, ChaosArm, arm_by_name,
-                                      run_chaos_arm, run_chaos_matrix)
+from veneur_tpu.testbed.chaos import (ALL_ARMS, CHAOS_ARMS,
+                                      TOPOLOGY_ARMS, ChaosArm,
+                                      arm_by_name, run_chaos_arm,
+                                      run_chaos_matrix)
 from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
 from veneur_tpu.testbed.dryrun import PROMISED_KEYS, run_dryrun
-from veneur_tpu.testbed.traffic import Oracle, TrafficGen
+from veneur_tpu.testbed.traffic import Oracle, StormGen, TrafficGen
 
 __all__ = [
-    "CHAOS_ARMS", "ChaosArm", "arm_by_name", "run_chaos_arm",
-    "run_chaos_matrix", "Cluster", "ClusterSpec", "PROMISED_KEYS",
-    "run_dryrun", "Oracle", "TrafficGen",
+    "ALL_ARMS", "CHAOS_ARMS", "TOPOLOGY_ARMS", "ChaosArm", "arm_by_name",
+    "run_chaos_arm", "run_chaos_matrix", "Cluster", "ClusterSpec",
+    "PROMISED_KEYS", "run_dryrun", "Oracle", "StormGen", "TrafficGen",
 ]

@@ -1,8 +1,9 @@
-"""Chaos matrix: each failpoint x each edge of the 3-tier pipe.
+"""Chaos matrix: each failpoint x each edge of the 3-tier pipe, plus the
+elastic-topology arms.
 
-Every arm arms ONE failpoint (seeded, bounded) over a fresh cluster, runs
-a few intervals of oracle-tracked traffic, and checks the ISSUE-5
-no-silent-loss contract:
+Every FAILPOINT arm arms ONE failpoint (seeded, bounded) over a fresh
+cluster, runs a few intervals of oracle-tracked traffic, and checks the
+ISSUE-5 no-silent-loss contract:
 
   expect="conserved"   delivery eventually succeeds (the fault is within
                        the retry/reroute budget) -> counter totals at the
@@ -17,16 +18,38 @@ delays, mid-fleet stream resets, permanent outage -> exhausted retries),
 the proxy's per-destination sends (destination death -> ring route-around
 with accounted loss), the dial path (connect failure -> breaker +
 survivor routing), and the server flush path (stall).
+
+The TOPOLOGY arms (ISSUE 7) change the ring or the key space mid-run:
+
+  ring-scale-up         add a global between intervals: conservation
+                        stays exact, one-global-per-key holds per ring
+                        epoch, and the committed reshard record shows
+                        bounded movement (<= 1.5*K/N sampled keys for
+                        one joiner on an N-ring)
+  ring-scale-down       drain a global: its buffers drain-and-forward
+                        onto the survivors, totals stay exact
+  ring-rolling-restart  restart every global in sequence; conservation
+                        and routing hold through each reshard
+  cardinality-storm     one tenant floods fresh keys past its budget:
+                        the local arenas stay under budget, the tail
+                        folds into mergeable rollups (counter mass
+                        exact, set cardinality exact, histogram
+                        quantiles inside the dossier envelope), and the
+                        rollup series carry the reserved degraded-data
+                        tag
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from veneur_tpu import failpoints
+from veneur_tpu.core.cardinality import ROLLUP_TAG
 from veneur_tpu.testbed import verify
 from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
-from veneur_tpu.testbed.traffic import TrafficGen
+from veneur_tpu.testbed.traffic import StormGen, TrafficGen
 
 
 @dataclass(frozen=True)
@@ -36,6 +59,7 @@ class ChaosArm:
     action: str
     expect: str                      # "conserved" | "accounted"
     kwargs: dict = field(default_factory=dict)
+    kind: str = "failpoint"          # "failpoint" | "topology"
 
 
 CHAOS_ARMS: list[ChaosArm] = [
@@ -68,20 +92,46 @@ CHAOS_ARMS: list[ChaosArm] = [
              "conserved", {"delay_s": 0.05, "times": 1}),
 ]
 
+# elastic-topology + cardinality arms (ISSUE 7); `failpoint` names the
+# new edge each arm exercises (the reshard window / the eviction pass)
+TOPOLOGY_ARMS: list[ChaosArm] = [
+    ChaosArm("ring-scale-up", "destinations.reshard", "", "conserved",
+             {"op": "scale-up"}, kind="topology"),
+    ChaosArm("ring-scale-down", "destinations.reshard", "", "conserved",
+             {"op": "scale-down"}, kind="topology"),
+    ChaosArm("ring-rolling-restart", "destinations.reshard", "",
+             "conserved", {"op": "rolling-restart"}, kind="topology"),
+    ChaosArm("cardinality-storm", "arena.evict", "", "conserved",
+             {"op": "storm"}, kind="topology"),
+]
+
+ALL_ARMS: list[ChaosArm] = CHAOS_ARMS + TOPOLOGY_ARMS
+
 
 def arm_by_name(name: str) -> ChaosArm:
-    for a in CHAOS_ARMS:
+    for a in ALL_ARMS:
         if a.name == name:
             return a
     raise KeyError(f"unknown chaos arm {name!r} "
-                   f"(have {[a.name for a in CHAOS_ARMS]})")
+                   f"(have {[a.name for a in ALL_ARMS]})")
 
 
 def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   n_globals: int = 2, intervals: int = 2,
                   counter_keys: int = 4, histo_keys: int = 1,
                   set_keys: int = 1, histo_samples: int = 40) -> dict:
-    """One matrix cell: fresh cluster, armed failpoint, oracle verdict."""
+    """One matrix cell: fresh cluster, armed failpoint (or topology
+    action), oracle verdict."""
+    if arm.kind == "topology":
+        if arm.kwargs.get("op") == "storm":
+            return _run_cardinality_storm(arm, seed=seed,
+                                          n_locals=max(n_locals, 2),
+                                          intervals=intervals)
+        return _run_ring_arm(arm, seed=seed, n_locals=n_locals,
+                             intervals=intervals,
+                             counter_keys=counter_keys,
+                             histo_keys=histo_keys, set_keys=set_keys,
+                             histo_samples=histo_samples)
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        forward_max_retries=2,
                        forward_retry_backoff=0.02,
@@ -135,6 +185,207 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     }
 
 
+def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
+                  intervals: int = 3, counter_keys: int = 4,
+                  histo_keys: int = 1, set_keys: int = 1,
+                  histo_samples: int = 40) -> dict:
+    """Scale-up / scale-down / rolling-restart under live traffic: run an
+    interval on the starting ring, reshard, keep running — conservation
+    must stay EXACT across ring epochs, one-global-per-key must hold per
+    epoch, and the committed reshard record must show bounded movement
+    (one joiner on an N-ring moves ~K/(N+1) of the key space; the gate
+    is the satellite's 1.5*K/N)."""
+    op = arm.kwargs["op"]
+    start_globals = 3 if op == "scale-down" else 2
+    intervals = max(intervals, 3 if op == "rolling-restart" else 2)
+    spec = ClusterSpec(n_locals=n_locals, n_globals=start_globals,
+                       forward_max_retries=2, forward_retry_backoff=0.02,
+                       breaker_failure_threshold=2,
+                       breaker_reset_timeout=0.4,
+                       discovery_interval_s=0.2)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    restarts = 0
+    try:
+        cluster.start()
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(n_locals)))
+        # the topology action lands BETWEEN intervals: the reshard runs
+        # with the pipe live (buffers drain-and-forward through the new
+        # ring) while each interval stays single-ring-epoch, which is
+        # what makes the per-epoch routing invariant assertable
+        if op == "scale-up":
+            cluster.add_global()
+        elif op == "scale-down":
+            cluster.remove_global(start_globals - 1)
+        else:
+            cluster.restart_global(0)
+            restarts += 1
+        for i in range(1, intervals):
+            per_interval.append(cluster.run_interval(
+                traffic.next_interval(n_locals)))
+            if op == "rolling-restart" and restarts < len(cluster.globals):
+                cluster.restart_global(restarts)
+                restarts += 1
+        acct = cluster.accounting()
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    rs = acct["reshard"]
+    conserved = counters["exact"]
+    accounted = conserved or acct["dropped_total"] > 0
+    moved_ok = True
+    if op == "scale-up" and rs["last"] is not None:
+        # one joiner on an N-ring: sampled movement <= 1.5*K/N
+        moved_ok = (rs["last"]["keys_moved"]
+                    <= 1.5 * rs["last"]["sample_keys"] / start_globals)
+    ok = (rs["epochs"] >= 1 and conserved and routing["exclusive"]
+          and moved_ok and rs["last"] is not None
+          and rs["last"]["committed"])
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": arm.kwargs["op"],
+        "expect": arm.expect,
+        "fired": rs["epochs"],
+        "conserved": conserved,
+        "counter_deficit": counters["deficit"],
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": accounted,
+        "reshard": rs["last"],
+        "reshard_moved": rs["moved_total"],
+        "handoff_total": rs["handoff_total"],
+        "moved_bounded": moved_ok,
+        "ok": ok,
+    }
+
+
+def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
+                           n_locals: int = 2, intervals: int = 2,
+                           budget: int = 6) -> dict:
+    """One tenant floods fresh keys past its budget on every local: the
+    arenas must stay under budget, the folded tail must stay ACCOUNTED —
+    rollup counter mass exact, rollup set cardinality exact, rollup
+    histogram quantiles inside the committed dossier envelope — and the
+    rollup series must carry the reserved degraded-data tag."""
+    spec = ClusterSpec(n_locals=n_locals, n_globals=2,
+                       forward_max_retries=2, forward_retry_backoff=0.02,
+                       breaker_failure_threshold=2,
+                       breaker_reset_timeout=0.4,
+                       discovery_interval_s=0.2,
+                       cardinality_key_budget=budget)
+    storm = StormGen(seed=seed, budget=budget)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    try:
+        cluster.start()
+        for _ in range(intervals):
+            per_interval.append(cluster.run_interval(
+                storm.next_interval(n_locals)))
+        acct = cluster.accounting()
+        card_snaps = [n.server.aggregator.cardinality.snapshot()
+                      for n in cluster.locals]
+        digest_rows = [len(n.server.aggregator.digests.kdict)
+                       for n in cluster.locals]
+    finally:
+        cluster.stop()
+
+    flat = [m for interval in per_interval for g in interval for m in g]
+
+    # exact conservation of the PINNED (exact-state) counters
+    pinned_got: dict[str, float] = {}
+    for m in flat:
+        if m.type == "counter" and m.name in storm.pinned_totals:
+            pinned_got[m.name] = pinned_got.get(m.name, 0.0) + m.value
+    pinned_exact = all(
+        pinned_got.get(name) == want
+        for name, want in storm.pinned_totals.items())
+
+    # rollup counter: total tail mass, exact (a sum of sums), tagged
+    rollup_counters = [m for m in flat
+                       if m.name == "veneur.rollup.counter"]
+    rollup_mass = sum(m.value for m in rollup_counters)
+    tail_mass = sum(storm.tail_mass.values())
+    tagged = all(ROLLUP_TAG in m.tags for m in rollup_counters)
+    conserved = pinned_exact and rollup_mass == tail_mass
+
+    # rollup set: distinct tail members per interval, exact in HLL's
+    # linear-counting regime
+    sets_exact = True
+    for iv, members in storm.tail_sets.items():
+        got = sum(m.value for g in per_interval[iv] for m in g
+                  if m.name == "veneur.rollup.set" and m.type == "gauge")
+        if got != float(len(members)):
+            sets_exact = False
+
+    # rollup histogram: per-interval quantiles of the whole folded tail
+    # vs numpy, span-normalized inside the committed envelope
+    env = verify.load_envelope()
+    quantiles_ok = True
+    max_span_err = 0.0
+    for iv, vals in storm.tail_histo.items():
+        arr = np.asarray(vals, np.float64)
+        span = float(arr.max() - arr.min()) or 1.0
+        emitted = {m.name: m.value
+                   for g in per_interval[iv] for m in g
+                   if m.name.startswith("veneur.rollup.histogram.")}
+        for q in spec.percentiles:
+            name = f"veneur.rollup.histogram.{int(q * 100)}percentile"
+            if name not in emitted:
+                quantiles_ok = False
+                continue
+            exact = float(np.quantile(arr, q, method="hazen"))
+            err = abs(emitted[name] - exact) / span
+            max_span_err = max(max_span_err, err)
+            if err > verify.envelope_for(q, env):
+                quantiles_ok = False
+
+    # the defense's whole point: live arena cardinality stays bounded
+    # while the emitted tail grows without bound
+    under_budget = all(
+        snap["tenants"].get(storm.tenant, {}).get("exact_keys", 0)
+        <= budget for snap in card_snaps)
+    rows_bounded = all(rows <= budget + 16 for rows in digest_rows)
+    evicted = sum(s["keys_evicted"] for s in card_snaps)
+    over_budget = sum(s["tenants_over_budget"] for s in card_snaps)
+
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    ok = (conserved and sets_exact and quantiles_ok and tagged
+          and under_budget and rows_bounded and evicted > 0
+          and over_budget >= n_locals and routing["exclusive"])
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": "storm",
+        "expect": arm.expect,
+        "fired": evicted,
+        "conserved": conserved and sets_exact,
+        "counter_deficit": (tail_mass - rollup_mass),
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": conserved or acct["dropped_total"] > 0,
+        "keys_evicted": evicted,
+        "tenants_over_budget": over_budget,
+        "tail_keys_emitted": storm.tail_keys_emitted,
+        "digest_rows_live": digest_rows,
+        "rollup_tagged": tagged,
+        "rollup_quantile_max_span_err": max_span_err,
+        "rollup_quantiles_within_envelope": quantiles_ok,
+        "under_budget": under_budget,
+        "ok": ok,
+    }
+
+
 def run_chaos_matrix(arms=None, seed: int = 0, **kwargs) -> list[dict]:
     return [run_chaos_arm(a, seed=seed, **kwargs)
-            for a in (arms or CHAOS_ARMS)]
+            for a in (arms or ALL_ARMS)]

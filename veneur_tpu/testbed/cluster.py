@@ -50,6 +50,12 @@ class ClusterSpec:
     breaker_reset_timeout: float = 0.5
     discovery_interval_s: float = 0.25
     send_buffer_size: int = 8192
+    # reshard drain window for topology arms (proxy/destinations.py)
+    reshard_handoff_timeout: float = 1.0
+    # cardinality defense on the LOCAL tier (core/cardinality.py):
+    # per-tenant key budget; 0 = off
+    cardinality_key_budget: int = 0
+    cardinality_tenant_tag: str = "tenant"
     # serve the operator /debug surface for local[0] (tests assert the
     # forward retry/drop counters are visible at /debug/vars)
     http_api: bool = False
@@ -73,23 +79,30 @@ class Cluster:
         self.proxy: Proxy = None
         self.http = None
         self._started = False
+        self._global_seq = 0   # hostnames stay unique across restarts
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _boot_global(self) -> _Node:
+        spec = self.spec
+        i = self._global_seq
+        self._global_seq += 1
+        sink = simple_sinks.ChannelMetricSink()
+        srv = Server(config_mod.Config(
+            grpc_address="127.0.0.1:0",
+            interval=spec.interval_s,
+            percentiles=list(spec.percentiles),
+            aggregates=list(spec.aggregates),
+            mesh_devices=spec.mesh_devices,
+            hostname=f"tb-g{i}"),
+            extra_metric_sinks=[sink])
+        srv.start()
+        return _Node(srv, sink)
+
     def start(self) -> "Cluster":
         spec = self.spec
-        for i in range(spec.n_globals):
-            sink = simple_sinks.ChannelMetricSink()
-            srv = Server(config_mod.Config(
-                grpc_address="127.0.0.1:0",
-                interval=spec.interval_s,
-                percentiles=list(spec.percentiles),
-                aggregates=list(spec.aggregates),
-                mesh_devices=spec.mesh_devices,
-                hostname=f"tb-g{i}"),
-                extra_metric_sinks=[sink])
-            srv.start()
-            self.globals.append(_Node(srv, sink))
+        for _ in range(spec.n_globals):
+            self.globals.append(self._boot_global())
         self.proxy = Proxy(ProxyConfig(
             static_destinations=[
                 f"127.0.0.1:{g.server.grpc_import.port}"
@@ -99,7 +112,8 @@ class Cluster:
             proxy_send_timeout=spec.proxy_send_timeout,
             proxy_dial_timeout=spec.proxy_dial_timeout,
             breaker_failure_threshold=spec.breaker_failure_threshold,
-            breaker_reset_timeout=spec.breaker_reset_timeout))
+            breaker_reset_timeout=spec.breaker_reset_timeout,
+            reshard_handoff_timeout=spec.reshard_handoff_timeout))
         self.proxy.start()
         for i in range(spec.n_locals):
             sink = simple_sinks.ChannelMetricSink()
@@ -112,6 +126,8 @@ class Cluster:
                 interval=spec.interval_s,
                 percentiles=list(spec.percentiles),
                 aggregates=list(spec.aggregates),
+                cardinality_key_budget=spec.cardinality_key_budget,
+                cardinality_tenant_tag=spec.cardinality_tenant_tag,
                 hostname=f"tb-l{i}"),
                 extra_metric_sinks=[sink])
             srv.start()
@@ -124,6 +140,46 @@ class Cluster:
             self.http.start()
         self._started = True
         return self
+
+    # -- elastic topology (the ROADMAP-#4 scale arms) ----------------------
+
+    def _sync_ring(self) -> None:
+        """Point discovery at the CURRENT global set and reshard now
+        (the testbed drives set_members directly instead of waiting out
+        a poll tick)."""
+        addrs = [f"127.0.0.1:{g.server.grpc_import.port}"
+                 for g in self.globals]
+        self.proxy.discoverer.destinations = addrs
+        self.proxy.handle_discovery()
+
+    def add_global(self) -> str:
+        """Scale-up under live traffic: boot a new global, then grow the
+        ring (two-phase set_members — the old ring serves until the
+        joiner is connected).  Returns the new member's address."""
+        node = self._boot_global()
+        self.globals.append(node)
+        self._sync_ring()
+        return f"127.0.0.1:{node.server.grpc_import.port}"
+
+    def remove_global(self, idx: int) -> _Node:
+        """Scale-down under live traffic: shrink the ring FIRST (the
+        leaver's undelivered buffer drains-and-forwards onto the new
+        ring), then stop the drained server."""
+        node = self.globals.pop(idx)
+        self._sync_ring()
+        node.server.shutdown()
+        return node
+
+    def restart_global(self, idx: int) -> str:
+        """One rolling-restart step: ring out, stop, boot a replacement
+        (new port = new ring member), ring in."""
+        old = self.globals.pop(idx)
+        self._sync_ring()
+        old.server.shutdown()
+        node = self._boot_global()
+        self.globals.insert(idx, node)
+        self._sync_ring()
+        return f"127.0.0.1:{node.server.grpc_import.port}"
 
     def stop(self) -> None:
         if not self._started:
@@ -152,10 +208,13 @@ class Cluster:
 
     def send_lines(self, local_idx: int, lines: list[bytes]) -> int:
         """Batch lines into datagrams to local `local_idx`; returns the
-        line count (for the ingestion wait)."""
+        VALUE count (multi-value packets `name:v1:v2|t` carry several —
+        the ingestion wait tracks staged values, which is what the
+        engine's processed total counts)."""
         node = self.locals[local_idx]
         dgram: list[bytes] = []
         size = 0
+        values = 0
         for line in lines:
             if dgram and (len(dgram) >= _MAX_DGRAM_LINES
                           or size + len(line) + 1 > _MAX_DGRAM_BYTES):
@@ -163,9 +222,10 @@ class Cluster:
                 dgram, size = [], 0
             dgram.append(line)
             size += len(line) + 1
+            values += line.split(b"|", 1)[0].count(b":")
         if dgram:
             node.tx.sendto(b"\n".join(dgram), node.udp_addr)
-        return len(lines)
+        return values
 
     def wait_ingested(self, local_idx: int, n_lines: int,
                       timeout_s: float = 15.0) -> None:
@@ -303,8 +363,21 @@ class Cluster:
         with self.proxy._stats_lock:
             pstats = dict(self.proxy.stats)
         dest_totals = self.proxy.destinations.totals()
+        # per-tenant quota/eviction totals across the local tier (zeros
+        # when the defense is off — the keys are still promised)
+        card = {"keys_evicted": 0, "tenants_over_budget": 0,
+                "rollup_points": 0}
+        for n in self.locals:
+            guard = getattr(n.server.aggregator, "cardinality", None)
+            if guard is not None:
+                snap = guard.snapshot()
+                card["keys_evicted"] += snap["keys_evicted"]
+                card["tenants_over_budget"] += snap["tenants_over_budget"]
+                card["rollup_points"] += snap["rollup_points"]
         return {
             "forward": fw,
+            "cardinality": card,
+            "reshard": self.proxy.destinations.reshard_stats(),
             "forward_slots_dropped": sum(
                 n.server.forward_dropped for n in self.locals),
             "proxy": pstats,

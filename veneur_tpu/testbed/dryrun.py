@@ -12,16 +12,17 @@ CLI wrapper.
 from __future__ import annotations
 
 from veneur_tpu.testbed import verify
-from veneur_tpu.testbed.chaos import (CHAOS_ARMS, arm_by_name,
+from veneur_tpu.testbed.chaos import (ALL_ARMS, arm_by_name,
                                       run_chaos_arm)
 from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
 from veneur_tpu.testbed.traffic import TrafficGen
 
-# keys every dryrun report carries (tests/test_testbed.py pins them)
+# keys every dryrun report carries (tests/test_testbed.py pins them);
+# `cardinality` nests keys_evicted / tenants_over_budget / rollup_points
 PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
-    "conservation", "quantile_errors", "routing_exclusive",
-    "chaos_matrix", "ok",
+    "cardinality", "reshard_moved", "conservation", "quantile_errors",
+    "routing_exclusive", "chaos_matrix", "ok",
 ]
 
 
@@ -31,11 +32,13 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                set_keys: int = 2, histo_samples: int = 200,
                interval_s: float = 0.05,
                percentiles: tuple = (0.5, 0.9, 0.99),
+               cardinality_key_budget: int = 0,
                chaos: str | None = None) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all"."""
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        interval_s=interval_s, mesh_devices=mesh_devices,
-                       percentiles=tuple(percentiles))
+                       percentiles=tuple(percentiles),
+                       cardinality_key_budget=cardinality_key_budget)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -58,7 +61,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
 
     chaos_rows: list[dict] = []
     if chaos:
-        arms = CHAOS_ARMS if chaos == "all" else [arm_by_name(chaos)]
+        arms = ALL_ARMS if chaos == "all" else [arm_by_name(chaos)]
         for arm in arms:
             chaos_rows.append(run_chaos_arm(arm, seed=seed))
 
@@ -73,6 +76,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             "counter_keys": counter_keys, "histo_keys": histo_keys,
             "set_keys": set_keys, "histo_samples": histo_samples,
             "percentiles": list(percentiles),
+            "cardinality_key_budget": cardinality_key_budget,
         },
         "per_tier": {
             "local_flushes": acct["local_flushes"],
@@ -87,6 +91,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         "imported": acct["imported"],
         "retried": acct["forward"]["retries"],
         "dropped": acct["dropped_total"],
+        # cardinality-defense ledger (zeros with the budget off) and the
+        # ring's cumulative sampled key movement across reshard epochs
+        "cardinality": acct["cardinality"],
+        "reshard_moved": acct["reshard"]["moved_total"],
         "conservation": {
             "counters_exact": counters["exact"],
             "counter_deficit": counters["deficit"],

@@ -109,3 +109,105 @@ class TrafficGen:
                 lines[li].append(f"{name}:{shared}|s".encode())
             self.oracle.add_set(iv, name, shared)
         return lines
+
+
+class StormGen:
+    """Cardinality-storm traffic for one abusive tenant, with an oracle
+    that knows EXACTLY what should fold into the rollups.
+
+    Per interval the tenant emits:
+
+      pinned    `budget` hot counter keys, each touched `pin_touches`
+                times (multi-value packets) on EVERY local — more
+                touches than any tail key can accrue, so the seeded
+                count-ordered eviction keeps exactly these keys exact
+                across intervals (deterministic fold set);
+      tail      `tail_counter_keys` one-shot counters (global-only),
+                `tail_histo_keys` histograms x `tail_histo_samples`
+                gamma samples, and `tail_set_keys` x `tail_set_members`
+                unique set members — all under FRESH per-interval names,
+                so live cardinality grows without bound unless the
+                budget defense folds it.
+
+    Pins arrive before the tail on every local (single UDP socket, FIFO
+    into one reader), so the tail is over-budget by construction and the
+    oracle's per-interval tail ledgers are exact:
+
+      pinned_totals      exact per-key counter totals
+      tail_mass[iv]      total tail counter mass (rollup sum is exact)
+      tail_histo[iv]     every tail histogram sample (rollup quantiles
+                         check against numpy within the dossier envelope)
+      tail_sets[iv]      distinct tail set members (rollup HLL is exact
+                         in the linear-counting regime)
+    """
+
+    def __init__(self, seed: int = 0, tenant: str = "hog",
+                 budget: int = 6, pin_touches: int = 120,
+                 tail_counter_keys: int = 24, counter_max: int = 9,
+                 tail_histo_keys: int = 4, tail_histo_samples: int = 30,
+                 tail_set_keys: int = 3, tail_set_members: int = 8):
+        self.rng = np.random.default_rng(seed)
+        self.tenant = tenant
+        self.budget = budget
+        self.pin_touches = pin_touches
+        self.tail_counter_keys = tail_counter_keys
+        self.counter_max = counter_max
+        self.tail_histo_keys = tail_histo_keys
+        self.tail_histo_samples = tail_histo_samples
+        self.tail_set_keys = tail_set_keys
+        self.tail_set_members = tail_set_members
+        self.interval = 0
+        self.pinned_totals: dict[str, float] = {}
+        self.tail_mass: dict[int, float] = {}
+        self.tail_histo: dict[int, list] = {}
+        self.tail_sets: dict[int, set] = {}
+        self.tail_keys_emitted = 0
+
+    def next_interval(self, n_locals: int) -> list[list[bytes]]:
+        iv = self.interval
+        self.interval += 1
+        lines: list[list[bytes]] = [[] for _ in range(n_locals)]
+        ttag = f"tenant:{self.tenant}"
+        # pinned heavy keys first: budget fills with THESE on every local
+        for k in range(self.budget):
+            name = f"{PREFIX}pin{k}"
+            values = ":".join(["1"] * self.pin_touches)
+            for li in range(n_locals):
+                lines[li].append(
+                    f"{name}:{values}|c|#veneurglobalonly,{ttag}"
+                    .encode())
+                self.pinned_totals[name] = \
+                    self.pinned_totals.get(name, 0.0) + self.pin_touches
+        # tail counters: fresh names, one increment, split across locals
+        mass = 0.0
+        for k in range(self.tail_counter_keys):
+            v = int(self.rng.integers(1, self.counter_max + 1))
+            lines[k % n_locals].append(
+                f"{PREFIX}tc{iv}_{k}:{v}|c|#veneurglobalonly,{ttag}"
+                .encode())
+            mass += v
+            self.tail_keys_emitted += 1
+        self.tail_mass[iv] = mass
+        # tail histograms: fresh names, gamma samples round-robin
+        vals: list[float] = []
+        for k in range(self.tail_histo_keys):
+            name = f"{PREFIX}th{iv}_{k}"
+            samples = self.rng.gamma(2.0, 10.0, self.tail_histo_samples)
+            for j, v in enumerate(samples):
+                lines[(k + j) % n_locals].append(
+                    f"{name}:{v:.6f}|h|#{ttag}".encode())
+                vals.append(float(v))
+            self.tail_keys_emitted += 1
+        self.tail_histo[iv] = vals
+        # tail sets: fresh names, globally-unique members
+        members: set = set()
+        for k in range(self.tail_set_keys):
+            name = f"{PREFIX}ts{iv}_{k}"
+            for j in range(self.tail_set_members):
+                member = f"sm{iv}_{k}_{j}"
+                lines[(k + j) % n_locals].append(
+                    f"{name}:{member}|s|#{ttag}".encode())
+                members.add(member)
+            self.tail_keys_emitted += 1
+        self.tail_sets[iv] = members
+        return lines
