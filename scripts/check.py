@@ -74,16 +74,20 @@ def main() -> int:
     # 3. one fast reshard chaos cell: scale a live ring up under traffic
     # and require conservation + per-epoch routing + bounded movement
     # (the ISSUE-7 elastic-topology gate; the full matrix is
-    # `scripts/dryrun_3tier.py --chaos all`)
+    # `scripts/dryrun_3tier.py --chaos all`).  Runs under the lock
+    # witness: every acquisition-order edge the cell exercises must be
+    # in the static lock-order graph (the ISSUE-8 concurrency gate —
+    # an observed-but-unmodeled edge is an analyzer gap and fails)
     reshard_rc = 0
     if args.fast:
         results.append(("reshard chaos cell", "SKIP", 0.0))
     else:
-        t0 = stage("reshard chaos cell (ring-scale-up)")
+        t0 = stage("reshard chaos cell (ring-scale-up, lock witness)")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         reshard_rc = subprocess.call(
             [sys.executable, "scripts/dryrun_3tier.py",
-             "--chaos-only", "ring-scale-up"], env=env)
+             "--chaos-only", "ring-scale-up", "--lock-witness"],
+            env=env)
         results.append(("reshard chaos cell",
                         "PASS" if reshard_rc == 0 else "FAIL",
                         time.perf_counter() - t0))

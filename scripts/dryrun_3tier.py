@@ -50,6 +50,11 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos-only", default=None, metavar="ARM",
                     help="run ONE chaos arm (no surrounding dryrun) and "
                     "emit just its row — the fast CI reshard cell")
+    ap.add_argument("--lock-witness", action="store_true",
+                    help="wrap every tier's named locks in the runtime "
+                    "lock witness and cross-validate observed "
+                    "acquisition-order edges against the static "
+                    "lock-order graph (nonzero exit on analyzer gaps)")
     ap.add_argument("--cpu", action="store_true",
                     help="force JAX onto CPU (the dryrun's default "
                     "posture off the driver host)")
@@ -67,9 +72,19 @@ def main(argv=None) -> int:
                     f"{max(8, args.mesh_devices)}").strip()
 
     if args.chaos_only:
-        from veneur_tpu.testbed.chaos import arm_by_name, run_chaos_arm
+        from veneur_tpu.testbed.chaos import (arm_by_name,
+                                              run_chaos_arm,
+                                              witness_comparison)
 
-        row = run_chaos_arm(arm_by_name(args.chaos_only), seed=args.seed)
+        witness = None
+        if args.lock_witness:
+            from veneur_tpu.analysis.witness import LockWitness
+            witness = LockWitness()
+        row = run_chaos_arm(arm_by_name(args.chaos_only),
+                            seed=args.seed, witness=witness)
+        if witness is not None:
+            row["lock_witness"] = witness_comparison(witness)
+            row["ok"] = row["ok"] and row["lock_witness"]["ok"]
         body = json.dumps(row, indent=2, default=str)
         if args.out:
             with open(args.out, "w") as f:
@@ -79,7 +94,13 @@ def main(argv=None) -> int:
         if not row["ok"]:
             print(f"CHAOS ARM {args.chaos_only} FAILED", file=sys.stderr)
             return 1
-        print(f"# chaos arm {args.chaos_only} OK", file=sys.stderr)
+        tail = ""
+        if witness is not None:
+            lw = row["lock_witness"]
+            tail = (f"; lock witness: {lw['observed_edges']} observed "
+                    f"edge(s), 0 gaps")
+        print(f"# chaos arm {args.chaos_only} OK{tail}",
+              file=sys.stderr)
         return 0
 
     from veneur_tpu.testbed.dryrun import run_dryrun
@@ -92,7 +113,7 @@ def main(argv=None) -> int:
         set_keys=args.set_keys, histo_samples=args.histo_samples,
         interval_s=args.interval_s,
         cardinality_key_budget=args.cardinality_budget,
-        chaos=args.chaos)
+        chaos=args.chaos, lock_witness=args.lock_witness)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:

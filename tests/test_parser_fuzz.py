@@ -13,6 +13,12 @@ reference (itself matching `samplers/parser.go:349-503` error-for-error).
 import math
 
 import pytest
+
+# property-based layer only where hypothesis exists: without the guard,
+# the tier-1 run reports a collection ERROR on images that don't bake
+# the package in (the table-driven vectors in test_parser.py /
+# test_native_ingest.py still run everywhere)
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from veneur_tpu import ingest as ingest_mod

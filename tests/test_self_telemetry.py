@@ -81,19 +81,32 @@ def _send_udp(srv, payload: bytes):
     s.close()
 
 
-def _wait_processed(srv, n, timeout=5.0):
+def _send_and_wait(srv, payload: bytes, timeout=5.0):
+    """Send one datagram and wait until the data plane has INGESTED
+    it — event-driven on the native engine's monotonic line totals
+    (aggregator.processed is cumulative across sends and reset by
+    flushes, so waiting on it races the 5 ms drain loop: the wait can
+    pass on a STALE count before the new packet even arrives)."""
+    n_lines = payload.count(b"\n") + 1
+    base = (srv.native.engine.totals()[0]
+            if srv.native is not None else srv.aggregator.processed)
+    _send_udp(srv, payload)
     deadline = time.time() + timeout
     while time.time() < deadline:
-        if srv.aggregator.processed >= n:
+        if srv.native is not None:
+            srv._drain_native()
+            if srv.native.engine.totals()[0] >= base + n_lines:
+                return
+        elif srv.aggregator.processed >= base + n_lines:
             return
-        time.sleep(0.01)
-    raise AssertionError("packets not processed in time")
+        time.sleep(0.005)
+    raise AssertionError(f"{n_lines} lines not ingested in {timeout}s")
+
 
 
 def test_flush_emits_self_metrics(telemetry_server):
     srv, msink, _ = telemetry_server
-    _send_udp(srv, b"a:1|c\nb:2.5|g\nlat:3|h")
-    _wait_processed(srv, 3)
+    _send_and_wait(srv, b"a:1|c\nb:2.5|g\nlat:3|h")
     srv.flush()
 
     stats = srv.statsd
@@ -120,8 +133,7 @@ def test_flush_emits_self_metrics(telemetry_server):
     assert len(per_proto2) == len(per_proto)  # no new UDP packets counted
     # counting keeps working after the drain swap (the reader must not
     # hold a reference to the drained Counter)
-    _send_udp(srv, b"c:1|c")
-    _wait_processed(srv, 1)  # processed counter was reset by the flushes
+    _send_and_wait(srv, b"c:1|c")
     srv.flush()
     per_proto3 = stats.by_name("listen.received_per_protocol_total")
     assert len(per_proto3) == len(per_proto2) + 1
@@ -130,8 +142,7 @@ def test_flush_emits_self_metrics(telemetry_server):
 
 def test_flush_is_traced_as_span(telemetry_server):
     srv, _, ssink = telemetry_server
-    _send_udp(srv, b"x:1|c")
-    _wait_processed(srv, 1)
+    _send_and_wait(srv, b"x:1|c")
     srv.flush()
     # the flush span loops back through the trace client into the span
     # pipeline and lands in every span sink (flusher.go:26-34)
@@ -166,8 +177,7 @@ def test_debug_vars_stage_counters_monotonic(telemetry_server):
     host, port = api.address
     base = f"http://{host}:{port}"
     try:
-        _send_udp(srv, b"stage.a:1|c\nstage.b:2.5|g")
-        _wait_processed(srv, 2)
+        _send_and_wait(srv, b"stage.a:1|c\nstage.b:2.5|g")
         srv._drain_native()
         doc1 = json.loads(urllib.request.urlopen(
             base + "/debug/vars").read())
@@ -178,23 +188,34 @@ def test_debug_vars_stage_counters_monotonic(telemetry_server):
         assert doc1["ingest_stages"]["threads"], "per-thread view missing"
 
         # more traffic + more drains: every counter is >= its old value
-        _send_udp(srv, b"stage.a:3|c\nstage.c:4|ms")
-        _wait_processed(srv, 2)
+        _send_and_wait(srv, b"stage.a:3|c\nstage.c:4|ms")
         srv._drain_native()
         srv.flush()               # flush drains too; still monotonic
-        doc2 = json.loads(urllib.request.urlopen(
-            base + "/debug/vars").read())
-        tot2 = _stage_counters(doc2)
-        for stage, counters in tot2.items():
-            for k, v in counters.items():
-                assert v >= tot1[stage][k], \
-                    f"{stage}.{k}: {v} < {tot1[stage][k]}"
-        assert tot2["stage"]["values"] >= tot1["stage"]["values"] + 2
-        assert tot2["drain"]["calls"] > tot1["drain"]["calls"]
-        # packet conservation against the engine's own totals
-        ni = doc2["native_ingest"]
-        assert tot2["parse"]["packets"] == ni["packets"]
-        assert tot2["drain"]["packets"] == ni["packets"]
+        # the 5 ms drain loop keeps folding counters concurrently with
+        # the scrape, so a SINGLE snapshot can catch the document
+        # between a stage-counter read and the totals read.  Poll: the
+        # monotonic property must hold on EVERY sample; the
+        # conservation equalities must hold within the window.
+        deadline = time.time() + 10.0
+        while True:
+            doc2 = json.loads(urllib.request.urlopen(
+                base + "/debug/vars").read())
+            tot2 = _stage_counters(doc2)
+            for stage, counters in tot2.items():
+                for k, v in counters.items():
+                    assert v >= tot1[stage][k], \
+                        f"{stage}.{k}: {v} < {tot1[stage][k]}"
+            ni = doc2["native_ingest"]
+            if (tot2["stage"]["values"] >= tot1["stage"]["values"] + 2
+                    and tot2["drain"]["calls"] > tot1["drain"]["calls"]
+                    and tot2["parse"]["packets"] == ni["packets"]
+                    and tot2["drain"]["packets"] == ni["packets"]):
+                break
+            assert time.time() < deadline, (
+                f"stage counters never reconciled with engine totals: "
+                f"{tot2} vs {ni}")
+            srv._drain_native()
+            time.sleep(0.02)
         # the flush-timeline counter rides the same document
         assert doc2["flush_timeline_recorded"] >= 1
     finally:
@@ -205,8 +226,7 @@ def test_flush_timeline_records_on_ticker_flush(telemetry_server):
     """Every flush appends one timeline record whose interval id matches
     the server's flush counter."""
     srv, _, _ = telemetry_server
-    _send_udp(srv, b"tlm.h:4.2|h")
-    _wait_processed(srv, 1)
+    _send_and_wait(srv, b"tlm.h:4.2|h")
     srv.flush()
     srv.flush()
     assert len(srv.flush_timeline) >= 2
@@ -225,8 +245,7 @@ def test_forward_subspan_records_timing(telemetry_server):
     forwarded = []
     srv.forwarder = forwarded.extend
     srv.config.forward_address = "fake:1"
-    _send_udp(srv, b"hist:3|h")  # mixed-scope histogram -> forwarded
-    _wait_processed(srv, 1)
+    _send_and_wait(srv, b"hist:3|h")  # mixed-scope histogram -> forwarded
     srv.flush()
     assert len(forwarded) >= 0  # forward happens async
     deadline = time.time() + 5.0

@@ -372,6 +372,276 @@ def test_magic_literal_exempts_config_defaults(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# lock-order + blocking-propagation (the interprocedural pass)
+# ---------------------------------------------------------------------------
+
+LOCK_INVERSION = """
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def forward(self):
+        with self.a_lock:
+            self._fill()
+
+    def _fill(self):
+        with self.b_lock:
+            self.n = 1
+
+    def backward(self):
+        with self.b_lock:
+            with self.a_lock:
+                self.n = 2
+"""
+
+# same code, consistently ordered: a_lock always before b_lock
+LOCK_ORDERED = LOCK_INVERSION.replace(
+    "        with self.b_lock:\n"
+    "            with self.a_lock:",
+    "        with self.a_lock:\n"
+    "            with self.b_lock:")
+
+
+def test_lock_order_inversion_fires_with_both_chains(tmp_path):
+    """A two-lock inversion — one edge through a CALL CHAIN, the other
+    lexically nested — is one cycle finding carrying both witness
+    chains."""
+    report = lint_source(tmp_path, LOCK_INVERSION)
+    hits = [f for f in report.findings if f.rule == "lock-order"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    msg = hits[0].message
+    assert "Pair.a_lock" in msg and "Pair.b_lock" in msg
+    # both directions, each with its witness
+    assert "`Pair.a_lock` -> `Pair.b_lock`" in msg
+    assert "`Pair.b_lock` -> `Pair.a_lock`" in msg
+    # the interprocedural edge names the call chain
+    assert "via Pair._fill" in msg
+    assert "deadlock" in msg
+
+
+def test_lock_order_consistent_order_is_quiet(tmp_path):
+    report = lint_source(tmp_path, LOCK_ORDERED)
+    assert "lock-order" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+BLOCKING_TWO_HOP = """
+import time
+
+
+class Server:
+    def _flush_locked(self):
+        self._account()
+
+    def _account(self):
+        self._drain_all()
+
+    def _drain_all(self):
+        time.sleep(0.5)
+"""
+
+BLOCKING_HOISTED = """
+import time
+
+
+class Server:
+    def _flush_locked(self):
+        self.snap = self.counts
+
+    def drive(self):
+        self._flush_locked()
+        self._drain_all()
+
+    def _drain_all(self):
+        time.sleep(0.5)
+"""
+
+
+def test_blocking_propagation_two_hops_fires_with_chain(tmp_path):
+    """An INDIRECT (two-hop) blocking call under the _flush_locked
+    convention: lockguard cannot see it; the propagation rule prints
+    the full chain."""
+    report = lint_source(tmp_path, BLOCKING_TWO_HOP)
+    hits = [f for f in report.findings
+            if f.rule == "blocking-propagation"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    msg = hits[0].message
+    assert "Server._account -> Server._drain_all" in msg
+    assert "time.sleep" in msg
+    assert "_flush_locked" in msg
+    # the direct sleep is NOT under any lock: lockguard stays quiet
+    assert "sync-under-lock" not in rules_fired(report)
+
+
+def test_blocking_hoisted_out_of_lock_is_quiet(tmp_path):
+    report = lint_source(tmp_path, BLOCKING_HOISTED)
+    assert "blocking-propagation" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_blocking_propagation_through_acquire_window(tmp_path):
+    """A callee that RETURNS holding a lock (`begin()` with the
+    release in `commit()`) extends the caller's held set across the
+    window — the PR-6 reshard shape."""
+    report = lint_source(tmp_path, (
+        "import time\n\n\n"
+        "class Ring:\n"
+        "    def begin(self):\n"
+        "        self._serial_lock.acquire()\n"
+        "        return {}\n\n"
+        "    def commit(self, rec):\n"
+        "        self._serial_lock.release()\n\n"
+        "    def _dial_all(self):\n"
+        "        time.sleep(0.2)\n\n"
+        "    def reshard(self):\n"
+        "        rec = self.begin()\n"
+        "        try:\n"
+        "            self._dial_all()\n"
+        "        finally:\n"
+        "            self.commit(rec)\n"))
+    hits = [f for f in report.findings
+            if f.rule == "blocking-propagation"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "Ring._dial_all" in hits[0].message
+    assert "_serial_lock" in hits[0].message
+
+
+def test_reach_through_mutual_recursion_not_memo_poisoned(tmp_path):
+    """A recursion cycle must not poison the reach memo: the first
+    traversal of `b` happens while `a` is on the stack (truncated);
+    caching that empty result would silently drop the n_lock -> l_lock
+    edge for the second caller."""
+    from veneur_tpu.analysis import callgraph
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        "import threading\n\n\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self.m_lock = threading.Lock()\n"
+        "        self.n_lock = threading.Lock()\n"
+        "        self.l_lock = threading.Lock()\n\n"
+        "    def a(self, d):\n"
+        "        with self.l_lock:\n"
+        "            pass\n"
+        "        self.b(d)\n\n"
+        "    def b(self, d):\n"
+        "        if d:\n"
+        "            self.a(d - 1)\n\n"
+        "    def f(self):\n"
+        "        with self.m_lock:\n"
+        "            self.a(2)\n\n"
+        "    def g(self):\n"
+        "        with self.n_lock:\n"
+        "            self.b(2)\n")
+    _, idx = callgraph.build_index([str(root)])
+    edges = {(e["src"], e["dst"])
+             for e in idx.to_graph_dict()["edges"]}
+    assert ("R.m_lock", "R.l_lock") in edges, edges
+    assert ("R.n_lock", "R.l_lock") in edges, edges
+
+
+def test_bare_acquire_survives_with_block_exit(tmp_path):
+    """A lock bare-`.acquire()`d inside a `with` block stays held when
+    the with exits (only the with's own locks release): popping the
+    tail of the held stack would both fabricate an a->c edge and lose
+    the real b->c edge."""
+    from veneur_tpu.analysis import callgraph
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        "import threading\n\n\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self.a_lock = threading.Lock()\n"
+        "        self.b_lock = threading.Lock()\n"
+        "        self.c_lock = threading.Lock()\n\n"
+        "    def f(self):\n"
+        "        with self.a_lock:\n"
+        "            self.b_lock.acquire()\n"
+        "        with self.c_lock:\n"
+        "            pass\n"
+        "        self.b_lock.release()\n")
+    _, idx = callgraph.build_index([str(root)])
+    edges = {(e["src"], e["dst"])
+             for e in idx.to_graph_dict()["edges"]}
+    assert ("A.a_lock", "A.b_lock") in edges, edges
+    assert ("A.b_lock", "A.c_lock") in edges, edges
+    assert ("A.a_lock", "A.c_lock") not in edges, edges
+
+
+def test_emit_graph_cli_writes_lock_graph(tmp_path, capsys):
+    d = tmp_path / "graph_src"
+    d.mkdir()
+    (d / "mod.py").write_text(LOCK_INVERSION)
+    out = tmp_path / "graph.json"
+    rc = vnlint_main([str(d), "--rules", "lock-order",
+                      "--emit-graph", str(out)])
+    assert rc == 1    # the inversion cycle is a finding
+    import json
+    g = json.loads(out.read_text())
+    assert g["vnlint_lock_graph"] == 1
+    assert "Pair.a_lock" in g["locks"] and "Pair.b_lock" in g["locks"]
+    edge_pairs = {(e["src"], e["dst"]) for e in g["edges"]}
+    assert ("Pair.a_lock", "Pair.b_lock") in edge_pairs
+    assert ("Pair.b_lock", "Pair.a_lock") in edge_pairs
+    assert g["cycles"] and sorted(g["cycles"][0]["locks"]) == \
+        ["Pair.a_lock", "Pair.b_lock"]
+    # every edge carries at least one witness chain
+    assert all(e["witnesses"] for e in g["edges"])
+    capsys.readouterr()
+
+
+def test_witness_comparator_flags_unmodeled_edge():
+    """ISSUE-8 satellite: an edge observed at runtime but absent from
+    the static graph is an analyzer gap — the comparison fails loud."""
+    from veneur_tpu.analysis import witness as wmod
+    graph = {"edges": [{"src": "A", "dst": "B"}], "cycles": []}
+    ok = wmod.compare(graph, {("A", "B")})
+    assert ok["ok"] and ok["gaps"] == []
+    bad = wmod.compare(graph, {("A", "B"), ("B", "A")})
+    assert not bad["ok"]
+    assert bad["gaps"] == [{"src": "B", "dst": "A", "site": "?"}]
+
+
+def test_witness_comparator_promotes_fully_observed_cycle():
+    from veneur_tpu.analysis import witness as wmod
+    graph = {
+        "edges": [{"src": "A", "dst": "B"}, {"src": "B", "dst": "A"}],
+        "cycles": [{"locks": ["A", "B"],
+                    "edges": [["A", "B"], ["B", "A"]]}],
+    }
+    half = wmod.compare(graph, {("A", "B")})
+    assert half["ok"] and half["confirmed_cycles"] == []
+    full = wmod.compare(graph, {("A", "B"), ("B", "A")})
+    assert full["ok"] and len(full["confirmed_cycles"]) == 1
+
+
+def test_repo_lock_graph_matches_committed_artifact():
+    """The committed lock-order graph artifact stays in sync with the
+    analyzer: regenerating it over the tree yields the same locks and
+    edges (witness sites may drift with line numbers; identities and
+    topology must not silently change)."""
+    import json
+    from veneur_tpu.analysis import callgraph
+    with open(os.path.join(REPO, "analysis",
+                           "lock_order_graph.json")) as f:
+        committed = json.load(f)
+    _, idx = callgraph.build_index([os.path.join(REPO, "veneur_tpu")])
+    fresh = idx.to_graph_dict()
+    assert fresh["locks"] == committed["locks"]
+    assert [(e["src"], e["dst"]) for e in fresh["edges"]] == \
+        [(e["src"], e["dst"]) for e in committed["edges"]]
+    assert fresh["cycles"] == committed["cycles"]
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -522,7 +792,8 @@ def test_repo_self_run_is_clean():
 
 @pytest.mark.parametrize("rule", [
     "donation-aliasing", "resource-pairing", "prewarm-parity",
-    "sync-under-lock", "magic-literal"])
+    "sync-under-lock", "lock-order", "blocking-propagation",
+    "magic-literal"])
 def test_rule_registry_complete(rule):
     from veneur_tpu.analysis import rule_names
     assert rule in rule_names()

@@ -32,10 +32,33 @@ next instance instead of production:
                       instead of flowing from config — the PR-4
                       hard-coded-timeout hunt
 
+Two rules are WHOLE-PROGRAM (callgraph.py: interprocedural call graph
++ canonical lock identities), because every concurrency bug this repo
+shipped crossed a function boundary:
+
+  lock-order          cycles in the acquired-while-holding graph —
+                      lexically nested or through any call chain
+                      (including `begin()`/`commit()` windows that
+                      return holding a lock); each cycle reports both
+                      witness chains as a potential deadlock
+  blocking-propagation  sync-under-lock made transitive: a function
+                      that REACHES .result()/time.sleep/device sync
+                      through any call chain is blocking, and calling
+                      it under a lock fires with the full chain
+
+The static lock-order graph is exported (`--emit-graph`; committed at
+analysis/lock_order_graph.json) and cross-validated at runtime by the
+lock witness (analysis/witness.py): testbed runs record the REAL
+acquisition-order edges, an observed edge the graph lacks is an
+analyzer gap (fails loud), and a static cycle whose edges are all
+observed is a confirmed hazard.
+
 Run it:
 
     python -m veneur_tpu.analysis                # lint veneur_tpu/
     python -m veneur_tpu.analysis path/ --json out.json
+    python -m veneur_tpu.analysis --rules lock-order,blocking-propagation
+    python -m veneur_tpu.analysis --emit-graph analysis/lock_order_graph.json
 
 Suppress a finding (the reason is MANDATORY — a reasonless suppression
 is itself an error):

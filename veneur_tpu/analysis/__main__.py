@@ -30,6 +30,11 @@ def main(argv=None) -> int:
                     help="print the rule names + descriptions and exit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
+    ap.add_argument("--emit-graph", metavar="FILE",
+                    help="write the whole-program lock-order graph "
+                         "(nodes, edges with witness chains, cycles) "
+                         "as JSON ('-' = stdout); the static side of "
+                         "the runtime lock-witness comparison")
     args = ap.parse_args(argv)
 
     every = rules_mod.all_rules()
@@ -47,7 +52,23 @@ def main(argv=None) -> int:
             return 2
         rules = [r for r in every if r.name in wanted]
 
-    report = engine_mod.LintEngine(rules=rules).run(args.paths or None)
+    eng = engine_mod.LintEngine(rules=rules)
+    report = eng.run(args.paths or None)
+
+    if args.emit_graph:
+        import json
+
+        from veneur_tpu.analysis import callgraph
+        # reuse the run's parsed modules (and, when the concurrency
+        # rules ran, their cached index) — no second parse of the tree
+        idx = callgraph.index_for(eng.last_context)
+        payload = json.dumps(idx.to_graph_dict(root=report.root),
+                             indent=2, sort_keys=True) + "\n"
+        if args.emit_graph == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.emit_graph, "w", encoding="utf-8") as fh:
+                fh.write(payload)
 
     shown = [f for f in report.findings
              if args.show_suppressed or not f.suppressed]

@@ -144,6 +144,29 @@ def discover(paths: Iterable[str]) -> list[str]:
     return out
 
 
+def load_modules(paths: Optional[Iterable[str]],
+                 known_rules: set[str]) -> tuple:
+    """Shared discovery + parse (the lint run and the callgraph's
+    standalone build must see the SAME tree): returns (abs root,
+    modules, failures) where failures is [(relpath, line, message)]
+    for unparseable files."""
+    targets = list(paths) if paths else [default_target()]
+    root = (targets[0] if len(targets) == 1
+            and os.path.isdir(targets[0]) else os.getcwd())
+    modules: list[Module] = []
+    failures: list[tuple[str, int, str]] = []
+    for path in discover(targets):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            modules.append(Module(path, rel, src, known_rules))
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+            failures.append((rel, getattr(e, "lineno", 0) or 0,
+                             f"could not parse: {e}"))
+    return os.path.abspath(root), modules, failures
+
+
 class LintEngine:
     def __init__(self, rules: Optional[list] = None):
         from veneur_tpu.analysis.rules import all_rules, rule_names
@@ -155,27 +178,20 @@ class LintEngine:
         self.known_rules = (set(rule_names())
                             | {r.name for r in self.rules}
                             | {BAD_SUPPRESSION, PARSE_ERROR})
+        # the last run's ProjectContext: --emit-graph reuses it (and
+        # any concurrency index the rules cached on it) instead of
+        # re-parsing the tree
+        self.last_context: Optional[ProjectContext] = None
 
     def run(self, paths: Optional[Iterable[str]] = None) -> Report:
-        targets = list(paths) if paths else [default_target()]
-        root = (targets[0] if len(targets) == 1
-                and os.path.isdir(targets[0]) else os.getcwd())
-        report = Report(root=os.path.abspath(root))
-        modules: list[Module] = []
-        for path in discover(targets):
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            try:
-                with open(path, "r", encoding="utf-8") as f:
-                    src = f.read()
-                modules.append(Module(path, rel, src, self.known_rules))
-            except (SyntaxError, UnicodeDecodeError, ValueError) as e:
-                line = getattr(e, "lineno", 0) or 0
-                report.findings.append(Finding(
-                    PARSE_ERROR, rel, line, 0,
-                    f"could not parse: {e}"))
+        root, modules, failures = load_modules(paths, self.known_rules)
+        report = Report(root=root)
+        for rel, line, msg in failures:
+            report.findings.append(Finding(PARSE_ERROR, rel, line, 0,
+                                           msg))
         report.files_scanned = len(modules)
 
-        ctx = ProjectContext(modules)
+        ctx = self.last_context = ProjectContext(modules)
         for rule in self.rules:
             for mod in modules:
                 rule.collect(mod, ctx)

@@ -39,13 +39,16 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
+    from veneur_tpu.analysis.rules.blocking import BlockingPropagation
     from veneur_tpu.analysis.rules.donation import DonationAliasing
     from veneur_tpu.analysis.rules.literals import MagicLiteral
     from veneur_tpu.analysis.rules.lockguard import SyncUnderLock
+    from veneur_tpu.analysis.rules.lockorder import LockOrder
     from veneur_tpu.analysis.rules.pairing import ResourcePairing
     from veneur_tpu.analysis.rules.prewarm import PrewarmParity
     return [DonationAliasing(), ResourcePairing(), PrewarmParity(),
-            SyncUnderLock(), MagicLiteral()]
+            SyncUnderLock(), LockOrder(), BlockingPropagation(),
+            MagicLiteral()]
 
 
 def rule_names() -> list[str]:

@@ -1,0 +1,69 @@
+"""blocking-propagation: sync-under-lock made transitive.
+
+`sync-under-lock` (rules/lockguard.py) sees a DIRECT `.result()` /
+`time.sleep` / device-sync call inside a lock region.  This rule makes
+the property transitive over the whole-program call graph
+(analysis/callgraph.py): a function that *reaches* a blocking
+operation through any call chain is itself blocking, and CALLING it
+while a lock is held fires — with the full chain printed, so the
+report explains exactly how the wait gets under the lock.
+
+Scope discipline vs sync-under-lock: this rule only fires on calls to
+PROJECT functions that transitively block (chain length >= 1).  Direct
+table matches (`time.sleep(...)` itself, `fut.result()` itself) stay
+sync-under-lock findings — the two rules partition the hazard, they
+never double-report one site.
+
+Held regions are `with <lock>:` blocks, explicit `.acquire()` windows
+(including a callee that RETURNS holding a lock, like
+`reshard_begin`), and the bodies of `*_locked`-convention functions
+(which run with their caller's lock held).
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.analysis import callgraph
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+
+def _held_name(lock: str) -> str:
+    if lock.startswith(callgraph.CONVENTION_PREFIX):
+        return (f"the caller's lock (`{lock[1:]}` is a *_locked-"
+                "convention function)")
+    return f"`{lock}`"
+
+
+class BlockingPropagation(Rule):
+    name = "blocking-propagation"
+    description = ("call chain reaching a blocking wait/device sync "
+                   "while a lock is held (transitive sync-under-lock)")
+
+    def check(self, module: Module,
+              ctx: ProjectContext) -> list[Finding]:
+        idx = callgraph.index_for(ctx)
+        findings: list[Finding] = []
+        seen: set[tuple[int, int]] = set()
+        for fn in idx.functions:
+            if fn.relpath != module.relpath:
+                continue
+            for cs in fn.calls:
+                if not cs.held or (cs.line, cs.col) in seen:
+                    continue
+                for callee in cs.callees:
+                    bc = idx.blocking_chain(callee)
+                    if bc is None:
+                        continue
+                    chain, label, site = bc
+                    hops = " -> ".join((callee.qname,) + chain)
+                    lock = cs.held[-1][0]
+                    seen.add((cs.line, cs.col))
+                    findings.append(Finding(
+                        self.name, module.relpath, cs.line, cs.col,
+                        f"`{cs.text}(...)` reaches {label} while "
+                        f"holding {_held_name(lock)} — chain: {hops} "
+                        f"-> {label} at {site[0]}:{site[1]}; the lock "
+                        "is held across a wait every queued "
+                        "acquirer pays"))
+                    break
+        return findings

@@ -1,0 +1,64 @@
+"""lock-order: cycles in the whole-program acquired-while-holding graph.
+
+The hazard: thread 1 holds lock A and (possibly through several call
+frames) acquires lock B; thread 2 holds B and acquires A.  Neither
+thread ever sees both acquisitions on one screen — the PR-6 reshard
+window (`reshard_begin` returns holding `_reshard_serial`, the locked
+work happens in the CALLER) is exactly the shape an intraprocedural
+rule cannot check.
+
+The rule builds the lock-order graph over canonical lock identities
+(analysis/callgraph.py): an edge A -> B for every site where B is
+acquired while A is held, lexically nested or via any call chain from
+inside A's region.  Every cycle is reported ONCE as a potential
+deadlock, with one witness chain per edge — the holder function, the
+call chain to the acquisition, and the acquisition site — so the
+report reads as the two interleavings that deadlock.
+
+The full graph (all edges, cyclic or not) is exported by
+`python -m veneur_tpu.analysis --emit-graph` and is the static side of
+the runtime lock-witness comparison (analysis/witness.py): an edge the
+witness observes at runtime that this graph lacks is an analyzer gap.
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.analysis import callgraph
+from veneur_tpu.analysis.engine import Finding, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+
+def _edge_text(src: str, dst: str, wits: list[dict]) -> str:
+    w = wits[0]
+    via = (" via " + " -> ".join(w["chain"])) if w["chain"] else ""
+    return (f"`{src}` -> `{dst}` (held in {w['holder']} at "
+            f"{w['holder_site']}{via}; acquired at "
+            f"{w['acquire_site']})")
+
+
+class LockOrder(Rule):
+    name = "lock-order"
+    description = ("cycle in the acquired-while-holding graph — two "
+                   "threads taking the locks in opposite order "
+                   "deadlock (whole-program, call-chain aware)")
+
+    def finalize(self, ctx: ProjectContext) -> list[Finding]:
+        idx = callgraph.index_for(ctx)
+        edges = idx.lock_order_edges()
+        findings: list[Finding] = []
+        for cycle in idx.find_cycles(edges):
+            cyc_edges = sorted(
+                (a, b) for (a, b) in edges
+                if a in cycle and b in cycle)
+            parts = [_edge_text(a, b, edges[(a, b)])
+                     for a, b in cyc_edges]
+            # anchor the finding at the first witness's holder site so
+            # a reviewed cycle can be suppressed where it is held
+            first = edges[cyc_edges[0]][0]
+            path, line = first["holder_site"].rsplit(":", 1)
+            findings.append(Finding(
+                self.name, path, int(line), 0,
+                "lock-order cycle over {" + ", ".join(cycle) + "}: "
+                + "; ".join(parts)
+                + " — opposite-order interleavings deadlock"))
+        return findings

@@ -1,0 +1,297 @@
+"""Runtime lock witness: observed acquisition-order edges +
+held-while-blocking events, cross-validated against the static
+lock-order graph.
+
+The static pass (analysis/callgraph.py + rules/lockorder.py) claims to
+model every acquired-while-holding edge in the package.  This module
+closes the loop with runtime evidence: opt-in wrappers on the NAMED
+locks — the same canonical identities the static side uses
+(`Server._flush_serial`, `MetricAggregator.lock`, `Destinations._lock`,
+`failpoints._lock`, ...) — record, per thread, which locks are held
+when another is acquired.  While the testbed chaos matrix runs, every
+real interleaving leaves an edge.
+
+The comparator then cross-validates in both directions:
+
+  observed edge NOT in the static graph   -> an ANALYZER GAP: the
+        call-graph resolution missed a path reality takes.  The check
+        fails loud (`ok: False`); the fix belongs in callgraph.py, not
+        in the witness.
+  static cycle whose edges are ALL observed -> promoted from
+        "potential deadlock" to CONFIRMED HAZARD: both witness chains
+        are real interleavings, only scheduling luck separates the
+        process from the deadlock.
+
+Held-while-blocking events (a wrapped lock held longer than
+`blocking_threshold_s`) are the runtime mirror of
+sync-under-lock/blocking-propagation: they name which locks actually
+sit across long waits, with the acquire site, so a static suppression
+can be re-audited against measured hold times.
+
+Overhead when installed: one thread-local list append/pop plus a dict
+increment per acquisition — testbed-grade, not production-default;
+nothing is installed unless `install_*` is called.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional
+
+_THIS_FILE = "analysis/witness"
+
+
+def _caller_site() -> str:
+    """Innermost project frame below the witness (first non-witness
+    frame when the acquisition comes from outside the package)."""
+    import os
+    f = sys._getframe(2)
+    fallback = "?"
+    while f is not None:
+        fname = f.f_code.co_filename.replace("\\", "/")
+        if _THIS_FILE not in fname:
+            if "veneur_tpu" in fname:
+                short = fname.split("veneur_tpu/", 1)[-1]
+                return f"{short}:{f.f_lineno}"
+            if fallback == "?":
+                fallback = f"{os.path.basename(fname)}:{f.f_lineno}"
+        f = f.f_back
+    return fallback
+
+
+class WitnessLock:
+    """A named lock proxy: same blocking semantics as the wrapped lock,
+    plus edge/hold recording on the owning LockWitness."""
+
+    __slots__ = ("name", "_inner", "_reg")
+
+    def __init__(self, name: str, inner, reg: "LockWitness"):
+        self.name = name
+        self._inner = inner
+        self._reg = reg
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._reg._on_acquire(self.name, _caller_site())
+        return ok
+
+    def release(self) -> None:
+        self._reg._on_release(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockWitness:
+    """The edge/event registry one witnessed process (or testbed
+    cluster) shares across all its wrapped locks."""
+
+    def __init__(self, blocking_threshold_s: float = 0.05):
+        self.blocking_threshold_s = blocking_threshold_s
+        self._tls = threading.local()
+        # registry state guarded by a PLAIN lock (never witnessed)
+        self._mu = threading.Lock()
+        self._edges: dict[tuple[str, str], int] = {}
+        self._edge_sites: dict[tuple[str, str], str] = {}
+        self._held_blocking: dict[str, dict] = {}
+        self.acquisitions = 0
+
+    # -- recording (hot path) ----------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, name: str, site: str) -> None:
+        st = self._stack()
+        if st:
+            held_names = {h[0] for h in st}
+            with self._mu:
+                self.acquisitions += 1
+                for src in held_names:
+                    key = (src, name)
+                    self._edges[key] = self._edges.get(key, 0) + 1
+                    self._edge_sites.setdefault(key, site)
+        else:
+            with self._mu:
+                self.acquisitions += 1
+        st.append((name, time.perf_counter(), site))
+
+    def _on_release(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                _, t0, site = st.pop(i)
+                held = time.perf_counter() - t0
+                if held > self.blocking_threshold_s:
+                    with self._mu:
+                        ev = self._held_blocking.setdefault(
+                            name, {"count": 0, "max_s": 0.0,
+                                   "site": site})
+                        ev["count"] += 1
+                        ev["max_s"] = max(ev["max_s"], held)
+                return
+        # release of a lock this thread never acquired (cross-thread
+        # handoff): nothing to unwind, the inner lock still releases
+
+    # -- wrapping ----------------------------------------------------------
+
+    def wrap(self, obj, attr: str, name: str) -> bool:
+        """Replace `obj.attr` with a witnessed proxy; install BEFORE
+        any thread contends on the lock (mid-traffic replacement would
+        briefly split mutual exclusion across two objects)."""
+        cur = getattr(obj, attr, None)
+        if cur is None or isinstance(cur, WitnessLock):
+            return False
+        setattr(obj, attr, WitnessLock(name, cur, self))
+        return True
+
+    # -- observation API ---------------------------------------------------
+
+    def observed_edges(self) -> set[tuple[str, str]]:
+        with self._mu:
+            return set(self._edges)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "acquisitions": self.acquisitions,
+                "edges": [
+                    {"src": a, "dst": b, "count": n,
+                     "site": self._edge_sites.get((a, b), "?")}
+                    for (a, b), n in sorted(self._edges.items())],
+                "held_blocking": {
+                    k: dict(v) for k, v in
+                    sorted(self._held_blocking.items())},
+            }
+
+
+# -- install helpers: the canonical identity map -------------------------
+
+def install_server(server, reg: LockWitness) -> None:
+    """Wrap the named locks of one core.Server (and its aggregator /
+    arenas / native plane / timeline / forwarder).  Names MUST match
+    the static pass's canonical identities or the comparison is
+    meaningless — that contract is pinned by
+    tests/test_lock_witness.py."""
+    reg.wrap(server, "_flush_serial", "Server._flush_serial")
+    reg.wrap(server, "_events_lock", "Server._events_lock")
+    reg.wrap(server, "_proto_lock", "Server._proto_lock")
+    reg.wrap(server, "_stream_conns_lock", "Server._stream_conns_lock")
+    agg = getattr(server, "aggregator", None)
+    if agg is not None:
+        reg.wrap(agg, "lock", "MetricAggregator.lock")
+        reg.wrap(agg, "_compile_lock", "MetricAggregator._compile_lock")
+        for fam in ("digests", "sets", "counters", "gauges", "status"):
+            ar = getattr(agg, fam, None)
+            if ar is not None:
+                reg.wrap(ar, "lock", "_ArenaBase.lock")
+    native = getattr(server, "native", None)
+    if native is not None:
+        reg.wrap(native, "_drain_lock", "NativeIngest._drain_lock")
+    tl = getattr(server, "flush_timeline", None)
+    if tl is not None:
+        reg.wrap(tl, "_lock", "FlushTimeline._lock")
+    fwd = getattr(server, "forwarder", None)
+    if fwd is not None:
+        reg.wrap(fwd, "_stats_lock", "ForwardClient._stats_lock")
+
+
+def install_proxy(proxy, reg: LockWitness) -> None:
+    reg.wrap(proxy, "_stats_lock", "Proxy._stats_lock")
+    dest = getattr(proxy, "destinations", None)
+    if dest is not None:
+        reg.wrap(dest, "_lock", "Destinations._lock")
+        reg.wrap(dest, "_reshard_serial",
+                 "Destinations._reshard_serial")
+    gs = getattr(proxy, "grpc_stats", None)
+    if gs is not None:
+        reg.wrap(gs, "_lock", "GrpcStats._lock")
+
+
+def install_failpoints(reg: LockWitness):
+    """Wrap the failpoint registry lock and every Failpoint armed from
+    now on (configure() is patched to wrap the new instance's _flock).
+    Returns an uninstaller restoring both; idempotent."""
+    from veneur_tpu import failpoints
+
+    if isinstance(failpoints._lock, WitnessLock):
+        return lambda: None
+    orig_lock = failpoints._lock
+    failpoints._lock = WitnessLock("failpoints._lock", orig_lock, reg)
+    orig_configure = failpoints.configure
+
+    def configure(name, action, **kwargs):
+        fp = orig_configure(name, action, **kwargs)
+        if not isinstance(fp._flock, WitnessLock):
+            fp._flock = WitnessLock("Failpoint._flock", fp._flock, reg)
+        return fp
+
+    failpoints.configure = configure
+
+    def uninstall() -> None:
+        failpoints._lock = orig_lock
+        failpoints.configure = orig_configure
+
+    return uninstall
+
+
+# -- the static/observed comparison --------------------------------------
+
+def compare(static_graph: dict, observed) -> dict:
+    """Cross-validate observed edges against the static graph.
+
+    `static_graph` is `ConcurrencyIndex.to_graph_dict()` (or the JSON
+    loaded back); `observed` is a LockWitness, its snapshot() dict, or
+    a bare edge iterable.  Fails loud (`ok: False`) on any observed
+    edge the static graph lacks — an analyzer gap, not a runtime bug —
+    and promotes fully-observed static cycles to confirmed hazards."""
+    if isinstance(observed, LockWitness):
+        snap = observed.snapshot()
+        obs = observed.observed_edges()
+    elif isinstance(observed, dict):
+        snap = observed
+        obs = {(e["src"], e["dst"]) for e in observed.get("edges", [])}
+    else:
+        snap = {"edges": [], "held_blocking": {}}
+        obs = {tuple(e) for e in observed}
+    static_edges = {(e["src"], e["dst"])
+                    for e in static_graph.get("edges", [])}
+    sites = {(e["src"], e["dst"]): e.get("site", "?")
+             for e in snap.get("edges", [])}
+    gaps = sorted(obs - static_edges)
+    confirmed = []
+    for cyc in static_graph.get("cycles", []):
+        cedges = {tuple(e) for e in cyc.get("edges", [])}
+        if cedges and cedges <= obs:
+            confirmed.append(cyc)
+    return {
+        "ok": not gaps,
+        "gaps": [{"src": a, "dst": b, "site": sites.get((a, b), "?")}
+                 for a, b in gaps],
+        "confirmed_cycles": confirmed,
+        "observed_edges": len(obs),
+        "static_edges": len(static_edges),
+        "held_blocking": snap.get("held_blocking", {}),
+    }
+
+
+def static_graph(paths=None) -> dict:
+    """Build the static lock-order graph for the comparison (default:
+    the installed veneur_tpu package — the same tree the witness
+    instruments)."""
+    from veneur_tpu.analysis import callgraph
+    _ctx, idx = callgraph.build_index(paths)
+    return idx.to_graph_dict()

@@ -567,7 +567,13 @@ class MetricAggregator:
             if (self.digests.staged_count()
                     + self.sets.staged_count() < min_samples):
                 return False
+            # vnlint: disable=blocking-propagation (arena sync IS the
+            #   locked work by design — it consolidates host-side COO
+            #   staging; the asarray chains convert host lists, never
+            #   device arrays)
             self.digests.sync()
+            # vnlint: disable=blocking-propagation (same as above:
+            #   host staging consolidation, no device wait)
             self.sets.sync()
             return True
 
@@ -595,8 +601,19 @@ class MetricAggregator:
         seg = self.last_flush_segments = {}
         t0 = time.perf_counter()
         with self.lock:
+            # vnlint: disable=blocking-propagation (the snapshot must
+            #   be lock-coherent; its only flagged chain stages a
+            #   host-built lanes buffer via serving.put — asarray of
+            #   host data, not a device wait.  The unique-ts estimate
+            #   reduction is deferred below, outside the lock)
             snap = self._snapshot_and_reset()
             res.processed, res.imported = snap.pop("counts")
+        # deferred from the locked snapshot: the unique-ts estimate is
+        # a pure reduction over the swapped-out registers, so it runs
+        # without the ingest lock held
+        uts_raw = snap.pop("uts_raw", None)
+        if uts_raw is not None:
+            snap["uts_host"] = hll_mod.estimate_np(uts_raw)
         seg["snapshot_s"] = time.perf_counter() - t0
         # per-family touched-key counts ride the segment dict so the
         # flush timeline (and the flush.* self-metric gauges) can relate
@@ -1079,9 +1096,13 @@ class MetricAggregator:
             uts = None
         if self.mesh is None:
             # nothing to pmax over without a mesh: estimate on host (the
-            # digest-only program never sees these registers)
-            snap["uts_host"] = (hll_mod.estimate_np(uts)
-                                if uts is not None else None)
+            # digest-only program never sees these registers).  The
+            # register array is swapped out here; the O(m) estimate
+            # reduction runs in flush_dispatch AFTER the lock releases
+            # (blocking-propagation finding: ingest threads were queued
+            # behind a numpy reduction over 16 KiB of registers)
+            snap["uts_host"] = None
+            snap["uts_raw"] = uts
             snap["uts_regs"] = None
         else:
             # [R, m] register lanes, this process's tally in lane 0; the

@@ -255,6 +255,10 @@ class Server:
         self.ingest_shim = _IngestShim(self)
         self.statsd = None        # self-metrics client (stats_address)
         self.diagnostics = None   # runtime stats loop
+        # opt-in runtime lock witness (analysis/witness.py): set a
+        # LockWitness BEFORE start() and the named locks are wrapped to
+        # record acquisition-order edges for the static cross-check
+        self.lock_witness = None
 
         self._listeners: list[socket.socket] = []
         # (lockfile path, open file) pairs guarding unix socket paths
@@ -443,6 +447,14 @@ class Server:
                 retry=RetryPolicy(
                     attempts=self.config.forward_max_retries + 1,
                     backoff_base_s=self.config.forward_retry_backoff))
+        if self.lock_witness is not None:
+            # testbed/dryrun lock witness (analysis/witness.py): wrap
+            # the named locks NOW — native plane and forwarder exist,
+            # none of the contending threads (ticker, drain loop,
+            # watchdog, prewarm) have spawned yet, so no lock is
+            # replaced while another thread can hold it
+            from veneur_tpu.analysis import witness as witness_mod
+            witness_mod.install_server(self, self.lock_witness)
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")
@@ -1001,6 +1013,9 @@ class Server:
         beyond the ticker (tests, /debug/profile, flush_on_shutdown) race
         the non-atomic per-interval counters otherwise."""
         with self._flush_serial:
+            # vnlint: disable=blocking-propagation (_flush_serial
+            #   exists to hold the entire flush — device waits
+            #   included; ingest threads never contend on it)
             self._flush_locked()
 
     def _flush_locked(self) -> None:
@@ -1008,6 +1023,9 @@ class Server:
         from veneur_tpu import scopedstatsd
         from veneur_tpu import ssf as ssf_mod
 
+        # vnlint: disable=blocking-propagation (deliberate failpoint
+        #   edge: the chaos delay arm exists to stall the flush path
+        #   itself; disarmed cost is one module-global bool read)
         failpoints.inject("server.flush")
         self.last_flush_unix = time.time()
         statsd = scopedstatsd.ensure(self.statsd)
@@ -1023,17 +1041,21 @@ class Server:
         # only device wait — happens once the host work is done.  The
         # try/finally guarantees exactly one emit even if an accounting
         # statsd call raises.
+        # vnlint: disable=blocking-propagation (the dispatch's host
+        #   staging build + unique-ts estimate run under _flush_serial
+        #   by definition — the flush serialization lock covers the
+        #   whole flush and is never taken on the ingest path)
         pending = self.aggregator.flush_dispatch(is_local=self.is_local)
         self.flush_count += 1
 
         try:
             self._flush_interval_accounting(statsd)
         finally:
-            # vnlint: disable=sync-under-lock (_flush_serial only
-            #   serializes flush callers — ticker, tests, /debug — and
-            #   is never taken on the ingest path; the emit IS the
-            #   flush's one deliberate device wait, already overlapped
-            #   behind the host-side accounting above)
+            # vnlint: disable=sync-under-lock,blocking-propagation (the
+            #   emit IS the flush's one deliberate device wait, already
+            #   overlapped behind the host-side accounting above;
+            #   _flush_serial only serializes flush callers — ticker,
+            #   tests, /debug — and is never taken on the ingest path)
             res = pending.emit()
 
         # worker.metrics_processed_total (worker.go:477)

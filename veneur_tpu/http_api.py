@@ -266,6 +266,10 @@ def _jax_profile(server, seconds: float) -> dict:
         t0 = time.perf_counter()
         with jax.profiler.trace(trace_dir):
             try:
+                # vnlint: disable=blocking-propagation (the flush IS
+                #   the capture payload: the trace window must contain
+                #   one full device program; _profile_lock only
+                #   serializes the process-global JAX profiler)
                 server.flush()
             except Exception:
                 logging.getLogger("veneur_tpu.http").exception(

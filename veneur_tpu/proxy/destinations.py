@@ -417,9 +417,23 @@ class Destinations:
         from veneur_tpu import failpoints
         rec = self.reshard_begin(sorted(want))
         try:
+            # vnlint: disable=blocking-propagation (the reshard
+            #   failpoint edge deliberately sits inside the window —
+            #   a chaos delay arm must stall the reshard itself;
+            #   _reshard_serial only serializes operator reshards)
             failpoints.inject("destinations.reshard")
+            # vnlint: disable=blocking-propagation (phase 1 of the
+            #   two-phase reshard: joiner dials are SYNCHRONOUS under
+            #   the window so the old ring serves until every joiner
+            #   is connected; bounded by dial_timeout_s, and only the
+            #   discovery loop ever waits here)
             self.add(to_add)
             for addr in to_remove:
+                # vnlint: disable=blocking-propagation (phase 2:
+                #   drain-and-forward retire is deliberately
+                #   synchronous — the committed record must carry
+                #   final handoff counts; bounded by
+                #   handoff_timeout_s per leaver)
                 self.remove(addr, handoff=rec)
         finally:
             self.reshard_commit(rec)

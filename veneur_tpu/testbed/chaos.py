@@ -119,25 +119,31 @@ def arm_by_name(name: str) -> ChaosArm:
 def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   n_globals: int = 2, intervals: int = 2,
                   counter_keys: int = 4, histo_keys: int = 1,
-                  set_keys: int = 1, histo_samples: int = 40) -> dict:
+                  set_keys: int = 1, histo_samples: int = 40,
+                  witness=None) -> dict:
     """One matrix cell: fresh cluster, armed failpoint (or topology
-    action), oracle verdict."""
+    action), oracle verdict.  `witness` (a LockWitness) additionally
+    records every lock-acquisition-order edge the cell exercises for
+    the static cross-check (analysis/witness.py)."""
     if arm.kind == "topology":
         if arm.kwargs.get("op") == "storm":
             return _run_cardinality_storm(arm, seed=seed,
                                           n_locals=max(n_locals, 2),
-                                          intervals=intervals)
+                                          intervals=intervals,
+                                          witness=witness)
         return _run_ring_arm(arm, seed=seed, n_locals=n_locals,
                              intervals=intervals,
                              counter_keys=counter_keys,
                              histo_keys=histo_keys, set_keys=set_keys,
-                             histo_samples=histo_samples)
+                             histo_samples=histo_samples,
+                             witness=witness)
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        forward_max_retries=2,
                        forward_retry_backoff=0.02,
                        breaker_failure_threshold=2,
                        breaker_reset_timeout=0.4,
-                       discovery_interval_s=0.2)
+                       discovery_interval_s=0.2,
+                       lock_witness=witness)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -188,7 +194,7 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
 def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   intervals: int = 3, counter_keys: int = 4,
                   histo_keys: int = 1, set_keys: int = 1,
-                  histo_samples: int = 40) -> dict:
+                  histo_samples: int = 40, witness=None) -> dict:
     """Scale-up / scale-down / rolling-restart under live traffic: run an
     interval on the starting ring, reshard, keep running — conservation
     must stay EXACT across ring epochs, one-global-per-key must hold per
@@ -202,7 +208,8 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                        forward_max_retries=2, forward_retry_backoff=0.02,
                        breaker_failure_threshold=2,
                        breaker_reset_timeout=0.4,
-                       discovery_interval_s=0.2)
+                       discovery_interval_s=0.2,
+                       lock_witness=witness)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -270,7 +277,7 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
 
 def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
                            n_locals: int = 2, intervals: int = 2,
-                           budget: int = 6) -> dict:
+                           budget: int = 6, witness=None) -> dict:
     """One tenant floods fresh keys past its budget on every local: the
     arenas must stay under budget, the folded tail must stay ACCOUNTED —
     rollup counter mass exact, rollup set cardinality exact, rollup
@@ -281,7 +288,8 @@ def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
                        breaker_failure_threshold=2,
                        breaker_reset_timeout=0.4,
                        discovery_interval_s=0.2,
-                       cardinality_key_budget=budget)
+                       cardinality_key_budget=budget,
+                       lock_witness=witness)
     storm = StormGen(seed=seed, budget=budget)
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
@@ -389,3 +397,11 @@ def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
 def run_chaos_matrix(arms=None, seed: int = 0, **kwargs) -> list[dict]:
     return [run_chaos_arm(a, seed=seed, **kwargs)
             for a in (arms or ALL_ARMS)]
+
+
+def witness_comparison(witness) -> dict:
+    """Cross-validate a chaos run's observed lock edges against the
+    static lock-order graph: observed-but-unmodeled edge = analyzer
+    gap (ok: False), fully-observed static cycle = confirmed hazard."""
+    from veneur_tpu.analysis import witness as witness_mod
+    return witness_mod.compare(witness_mod.static_graph(), witness)

@@ -59,6 +59,11 @@ class ClusterSpec:
     # serve the operator /debug surface for local[0] (tests assert the
     # forward retry/drop counters are visible at /debug/vars)
     http_api: bool = False
+    # runtime lock witness (analysis/witness.py): True = record
+    # acquisition-order edges on every tier's named locks into a fresh
+    # LockWitness (Cluster.witness); a LockWitness instance = share one
+    # registry across several clusters (the chaos matrix)
+    lock_witness: object = None
 
 
 @dataclass
@@ -80,6 +85,19 @@ class Cluster:
         self.http = None
         self._started = False
         self._global_seq = 0   # hostnames stay unique across restarts
+        self.witness = None
+        self._fp_unwitness = None
+        if spec.lock_witness:
+            from veneur_tpu.analysis import witness as witness_mod
+            self.witness = (spec.lock_witness
+                            if isinstance(spec.lock_witness,
+                                          witness_mod.LockWitness)
+                            else witness_mod.LockWitness())
+            # install at CONSTRUCTION: chaos arms configure their
+            # failpoint between Cluster() and start(), and the armed
+            # Failpoint's _flock must be witnessed too
+            self._fp_unwitness = witness_mod.install_failpoints(
+                self.witness)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -96,6 +114,7 @@ class Cluster:
             mesh_devices=spec.mesh_devices,
             hostname=f"tb-g{i}"),
             extra_metric_sinks=[sink])
+        srv.lock_witness = self.witness
         srv.start()
         return _Node(srv, sink)
 
@@ -114,6 +133,9 @@ class Cluster:
             breaker_failure_threshold=spec.breaker_failure_threshold,
             breaker_reset_timeout=spec.breaker_reset_timeout,
             reshard_handoff_timeout=spec.reshard_handoff_timeout))
+        if self.witness is not None:
+            from veneur_tpu.analysis import witness as witness_mod
+            witness_mod.install_proxy(self.proxy, self.witness)
         self.proxy.start()
         for i in range(spec.n_locals):
             sink = simple_sinks.ChannelMetricSink()
@@ -130,6 +152,7 @@ class Cluster:
                 cardinality_tenant_tag=spec.cardinality_tenant_tag,
                 hostname=f"tb-l{i}"),
                 extra_metric_sinks=[sink])
+            srv.lock_witness = self.witness
             srv.start()
             _, addr = srv.statsd_addrs[0]
             tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -197,6 +220,9 @@ class Cluster:
             self.proxy.stop()
         for n in self.globals:
             n.server.shutdown()
+        if self._fp_unwitness is not None:
+            self._fp_unwitness()
+            self._fp_unwitness = None
 
     def __enter__(self) -> "Cluster":
         return self.start()

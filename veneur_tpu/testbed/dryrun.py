@@ -18,11 +18,13 @@ from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
 from veneur_tpu.testbed.traffic import TrafficGen
 
 # keys every dryrun report carries (tests/test_testbed.py pins them);
-# `cardinality` nests keys_evicted / tenants_over_budget / rollup_points
+# `cardinality` nests keys_evicted / tenants_over_budget / rollup_points;
+# `lock_witness` is None unless the run was witnessed, else the
+# static-vs-observed comparison (analysis/witness.py)
 PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
-    "routing_exclusive", "chaos_matrix", "ok",
+    "routing_exclusive", "chaos_matrix", "lock_witness", "ok",
 ]
 
 
@@ -33,12 +35,22 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                interval_s: float = 0.05,
                percentiles: tuple = (0.5, 0.9, 0.99),
                cardinality_key_budget: int = 0,
-               chaos: str | None = None) -> dict:
-    """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all"."""
+               chaos: str | None = None,
+               lock_witness: bool = False) -> dict:
+    """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
+    With `lock_witness`, every tier's named locks record runtime
+    acquisition-order edges (shared across the chaos arms too) and the
+    report carries the static-vs-observed comparison — an observed
+    edge the static lock-order graph lacks fails the run."""
+    witness = None
+    if lock_witness:
+        from veneur_tpu.analysis.witness import LockWitness
+        witness = LockWitness()
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        interval_s=interval_s, mesh_devices=mesh_devices,
                        percentiles=tuple(percentiles),
-                       cardinality_key_budget=cardinality_key_budget)
+                       cardinality_key_budget=cardinality_key_budget,
+                       lock_witness=witness)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -63,11 +75,18 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     if chaos:
         arms = ALL_ARMS if chaos == "all" else [arm_by_name(chaos)]
         for arm in arms:
-            chaos_rows.append(run_chaos_arm(arm, seed=seed))
+            chaos_rows.append(run_chaos_arm(arm, seed=seed,
+                                            witness=witness))
+
+    witness_cmp = None
+    if witness is not None:
+        from veneur_tpu.testbed.chaos import witness_comparison
+        witness_cmp = witness_comparison(witness)
 
     ok = (counters["exact"] and sets["exact"] and quantiles["ok"]
           and routing["exclusive"]
-          and all(r["ok"] for r in chaos_rows))
+          and all(r["ok"] for r in chaos_rows)
+          and (witness_cmp is None or witness_cmp["ok"]))
     return {
         "spec": {
             "n_locals": n_locals, "n_globals": n_globals,
@@ -112,5 +131,6 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         },
         "routing_exclusive": routing["exclusive"],
         "chaos_matrix": chaos_rows,
+        "lock_witness": witness_cmp,
         "ok": ok,
     }
