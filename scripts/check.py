@@ -75,18 +75,21 @@ def main() -> int:
     # and require conservation + per-epoch routing + bounded movement
     # (the ISSUE-7 elastic-topology gate; the full matrix is
     # `scripts/dryrun_3tier.py --chaos all`).  Runs under the lock
-    # witness: every acquisition-order edge the cell exercises must be
-    # in the static lock-order graph (the ISSUE-8 concurrency gate —
-    # an observed-but-unmodeled edge is an analyzer gap and fails)
+    # witness (ISSUE-8: an observed-but-unmodeled acquisition-order
+    # edge is an analyzer gap and fails) AND traced (ISSUE-9: every
+    # settled interval must assemble into one complete 3-tier trace
+    # with zero orphan spans, across the live reshard)
     reshard_rc = 0
     if args.fast:
         results.append(("reshard chaos cell", "SKIP", 0.0))
     else:
-        t0 = stage("reshard chaos cell (ring-scale-up, lock witness)")
+        t0 = stage("reshard chaos cell (ring-scale-up, "
+                   "lock witness, traced)")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         reshard_rc = subprocess.call(
             [sys.executable, "scripts/dryrun_3tier.py",
-             "--chaos-only", "ring-scale-up", "--lock-witness"],
+             "--chaos-only", "ring-scale-up", "--lock-witness",
+             "--trace"],
             env=env)
         results.append(("reshard chaos cell",
                         "PASS" if reshard_rc == 0 else "FAIL",

@@ -14,6 +14,12 @@ Usage:
   python scripts/dryrun_3tier.py --chaos forward-outage --out report.json
   python scripts/dryrun_3tier.py --chaos-only ring-scale-up   # one cell
   python scripts/dryrun_3tier.py --cardinality-budget 8  # tenant budgets
+  python scripts/dryrun_3tier.py --trace   # traced: every interval must
+                                           # assemble into ONE complete
+                                           # 3-tier trace (incl. the
+                                           # forward-retry + ring-scale-up
+                                           # arms); prints the per-interval
+                                           # critical-path table
 
 Exit status is nonzero when any check fails, so CI can gate on it.
 Report keys are promised (veneur_tpu.testbed.dryrun.PROMISED_KEYS,
@@ -50,6 +56,13 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos-only", default=None, metavar="ARM",
                     help="run ONE chaos arm (no surrounding dryrun) and "
                     "emit just its row — the fast CI reshard cell")
+    ap.add_argument("--trace", action="store_true",
+                    help="gate the run on cross-tier trace assembly: "
+                    "every settled interval must form one complete "
+                    "local->proxy->global trace with zero orphan "
+                    "spans (forward-retry and ring-scale-up chaos "
+                    "arms included), and the per-interval "
+                    "critical-path table is printed")
     ap.add_argument("--lock-witness", action="store_true",
                     help="wrap every tier's named locks in the runtime "
                     "lock witness and cross-validate observed "
@@ -81,7 +94,8 @@ def main(argv=None) -> int:
             from veneur_tpu.analysis.witness import LockWitness
             witness = LockWitness()
         row = run_chaos_arm(arm_by_name(args.chaos_only),
-                            seed=args.seed, witness=witness)
+                            seed=args.seed, witness=witness,
+                            trace=args.trace)
         if witness is not None:
             row["lock_witness"] = witness_comparison(witness)
             row["ok"] = row["ok"] and row["lock_witness"]["ok"]
@@ -113,7 +127,8 @@ def main(argv=None) -> int:
         set_keys=args.set_keys, histo_samples=args.histo_samples,
         interval_s=args.interval_s,
         cardinality_key_budget=args.cardinality_budget,
-        chaos=args.chaos, lock_witness=args.lock_witness)
+        chaos=args.chaos, lock_witness=args.lock_witness,
+        trace=args.trace)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:
@@ -121,13 +136,23 @@ def main(argv=None) -> int:
             f.write(body + "\n")
     else:
         print(body)
+    if args.trace:
+        from veneur_tpu.trace import assembly
+        print("# per-interval critical path "
+              "(sum_seg vs wall: >wall = overlap made visible)",
+              file=sys.stderr)
+        print(assembly.format_table(report["trace"]), file=sys.stderr)
     if not report["ok"]:
         print("DRYRUN FAILED", file=sys.stderr)
         return 1
+    tr = report["trace"]
+    tail = (f"; {tr['intervals']} interval trace(s) complete, "
+            f"{tr['orphans']} orphans" if args.trace else "")
     print(f"# 3-tier dryrun OK: {report['forwarded']} forwarded, "
           f"{report['imported']} imported, {report['retried']} retried, "
           f"{report['dropped']} dropped; "
-          f"{len(report['chaos_matrix'])} chaos arm(s)", file=sys.stderr)
+          f"{len(report['chaos_matrix'])} chaos arm(s){tail}",
+          file=sys.stderr)
     return 0
 
 

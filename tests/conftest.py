@@ -14,6 +14,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("VENEUR_TPU_TEST", "1")
+# grpc's C core logs transport INFO lines (GOAWAY on channel teardown)
+# straight to stderr, which interleaves into pytest's progress output
+# mid-line — harmless but it corrupts dot-counting CI heuristics
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
 
 # A sitecustomize in this image prepends the experimental "axon" TPU-tunnel
 # platform to jax_platforms, overriding the env var — force CPU explicitly so

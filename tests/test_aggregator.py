@@ -476,3 +476,34 @@ def test_hll_legacy_migration_lane():
     assert run(True) == pytest.approx(20_000, rel=0.05)
     inflated = run(False)
     assert inflated > 20_000 * 1.5  # the documented hazard, for contrast
+
+
+def test_nonuniform_counts_sums_keep_host_f64_precision():
+    """ADVICE r5 follow-up: non-uniform (weighted-staging) intervals
+    must source .count/.sum from the exact f64 host accumulators
+    (d_weight/d_sum) like uniform intervals do — not from the device's
+    f32 readback — so a series' reported precision cannot shift when
+    staging flips uniform/non-uniform between intervals."""
+    from veneur_tpu.samplers.metric_key import MetricKey
+
+    g = agg(is_local=False,
+            aggregates=sm.parse_aggregates(["count", "sum"]))
+    # weights force the general (non-uniform) network; the totals are
+    # chosen to be exactly representable in f64 but NOT in f32
+    # (16777219 is odd and > 2^24; 16777222.5 needs sub-2 spacing)
+    big = 16_777_217.0      # 2^24 + 1
+    with g.lock:
+        row = g.digests.row_for(
+            MetricKey("adv.h", sm.TYPE_HISTOGRAM, ""),
+            MetricScope.GLOBAL_ONLY, [])
+        g.digests.sample_batch(
+            np.full(3, row, np.int64),
+            np.asarray([1.0, 2.0, 3.5]),
+            np.asarray([big, 1.0, 1.0]))
+    assert g.digests.staged_uniform is False
+    res = g.flush(is_local=False)
+    by = by_name(res.metrics)
+    assert by["adv.h.count"].value == big + 2.0          # 16777219.0
+    assert by["adv.h.sum"].value == big * 1.0 + 2.0 + 3.5
+    # the same totals in f32 would have rounded
+    assert float(np.float32(big + 2.0)) != big + 2.0

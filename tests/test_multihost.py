@@ -52,26 +52,39 @@ assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
 
 # Mesh (shard=4, replica=2), device order process-major => this process
-# owns shards [2*pid, 2*pid+2), i.e. compact dense rows [2*pid, 2*pid+2)
-# of a 4-row flush.  Register the SAME four keys in the same order on
-# both processes (the global key dictionary both controllers agree on),
-# then stage samples ONLY for the keys this process owns — exactly the
-# proxy-ring ownership model carried onto the mesh.
+# owns shards [2*pid, 2*pid+2).  Register the SAME eight keys in the
+# same order on both processes (the global key dictionary both
+# controllers agree on), then stage samples ONLY for the keys whose
+# dense rows this process's shards own — exactly the proxy-ring
+# ownership model carried onto the mesh.  Ownership comes from the
+# build's own block math (DigestArena.dense_block_per_shard: each
+# shard's row block is a replica-divisible pow2, so 8 keys on a 4x2
+# mesh sit 2 per shard), not hand-derived constants that can drift.
 agg = srv.aggregator
 rng = np.random.default_rng(7)
+N_KEYS = 8
 datasets = {
     0: rng.gamma(2.0, 10.0, 500),
     1: rng.normal(50.0, 5.0, 300),
     2: rng.exponential(4.0, 400),
     3: rng.uniform(10.0, 20.0, 256),
+    4: rng.gamma(3.0, 5.0, 320),
+    5: rng.normal(120.0, 11.0, 410),
+    6: rng.exponential(9.0, 280),
+    7: rng.uniform(40.0, 90.0, 360),
 }
+block = agg.digests.dense_block_per_shard(N_KEYS)
+shards_per_proc = agg.digests.n_shards // jax.process_count()
+lo = block * shards_per_proc * pid
+hi = lo + block * shards_per_proc
+owned = tuple(i for i in range(N_KEYS) if lo <= i < hi)
+assert owned, (pid, block, shards_per_proc)
 with agg.lock:
     rows = {}
-    for i in range(4):
+    for i in range(N_KEYS):
         rows[i] = agg.digests.row_for(
             MetricKey(f"mh.lat{i}", sm.TYPE_HISTOGRAM, ""),
             MetricScope.MIXED, [])
-    owned = (0, 1) if pid == 0 else (2, 3)
     for i in owned:
         vals = datasets[i]
         agg.digests.sample_batch(
@@ -93,7 +106,7 @@ by = {m.name: m.value for m in res.metrics}
 # every process sees the GLOBAL percentile evaluation (the dense rows
 # and min/max of non-owned keys came from the OTHER process's shards via
 # the multi-controller array construction + allgather readback)
-for i in range(4):
+for i in range(N_KEYS):
     vals = datasets[i]
     p50 = by[f"mh.lat{i}.50percentile"]
     t50 = np.percentile(vals, 50)
@@ -104,7 +117,7 @@ for i in owned:
     vals = datasets[i]
     assert by[f"mh.lat{i}.count"] == float(len(vals)), i
     assert abs(by[f"mh.lat{i}.max"] - vals.max()) < 1e-3, i
-for i in set(range(4)) - set(owned):
+for i in set(range(N_KEYS)) - set(owned):
     assert f"mh.lat{i}.count" not in by, i
 if pid == 0:
     assert by["mh.reqs"] == 5.0 and by["mh.users"] == 2.0

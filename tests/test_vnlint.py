@@ -229,6 +229,92 @@ def test_reshard_commit_in_finally_is_quiet(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# resource-pairing: trace span lifetimes (ISSUE-9 self-tracing)
+# ---------------------------------------------------------------------------
+
+SPAN_LEAK = """
+def flush(self):
+    span = self.trace_client.span("flush")
+    res = self.run_flush()           # raises => span never finishes:
+    span.finish()                    # the trace loses its root node
+    return res
+"""
+
+SPAN_WITH_RAII = """
+def flush(self):
+    with self.trace_client.span("flush") as span:
+        res = self.run_flush()
+        span.tags["metrics"] = str(len(res))
+    return res
+"""
+
+SPAN_FINISH_IN_FINALLY = """
+def forward(self, parent):
+    aspan = parent.child("forward.attempt")
+    try:
+        self.send()
+    finally:
+        aspan.finish()
+"""
+
+SPAN_IMMEDIATE_FINISH = """
+def segments(self, span, t0, dur):
+    child = span.child("flush.seg.device")
+    child.start_ns = t0
+    child.end_ns = t0 + dur
+    child.finish()
+"""
+
+SPAN_OWNERSHIP_HANDOFF = """
+def start_active_span(self, name):
+    span = self.start_span(name)
+    return self.scope_manager.activate(span, True)
+"""
+
+
+def test_span_leak_fires(tmp_path):
+    """A span created via client.span() whose finish() sits only on the
+    fall-through path leaks on any exception in between — the interval
+    trace silently loses a node."""
+    report = lint_source(tmp_path, SPAN_LEAK)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "trace span" in hits[0].message
+    assert "span" in hits[0].message
+
+
+def test_span_with_raii_is_quiet(tmp_path):
+    """`with client.span(...) as span:` — Span.__exit__ finishes with
+    the error flag; the production flush root shape."""
+    report = lint_source(tmp_path, SPAN_WITH_RAII)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_span_finish_in_finally_is_quiet(tmp_path):
+    report = lint_source(tmp_path, SPAN_FINISH_IN_FINALLY)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_span_immediate_finish_is_quiet(tmp_path):
+    """Synthesized segment children: attribute stamps between create
+    and finish cannot raise, so adjacency satisfies the pairing."""
+    report = lint_source(tmp_path, SPAN_IMMEDIATE_FINISH)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_span_ownership_handoff_is_quiet(tmp_path):
+    """The OpenTracing bridge hands the started span to the scope
+    manager (which owns finishing it): name-flow escape, legal only
+    because the function holds no finish() of its own."""
+    report = lint_source(tmp_path, SPAN_OWNERSHIP_HANDOFF)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
 # prewarm-parity — the PR-3 in-flush recompile
 # ---------------------------------------------------------------------------
 

@@ -83,6 +83,16 @@ PAIRS = (
     PairSpec("ring reshard window",
              frozenset({"reshard_begin"}),
              frozenset({"reshard_commit"})),
+    # trace span lifetime (trace/, core/server.py flush tracing): a
+    # span created via start_span()/client.span()/parent.child() that
+    # is never finish()ed on an error path silently drops out of the
+    # flight-recorder ring — the interval's trace loses a node and the
+    # assembler reports a hole that was really an instrumentation leak.
+    # with-RAII (Span.__exit__ finishes, error-flagged), finally
+    # releases, immediate finish, and ownership escape all satisfy it.
+    PairSpec("trace span",
+             frozenset({"start_span", "span", "child"}),
+             frozenset({"finish"})),
 )
 
 
@@ -98,7 +108,8 @@ def _stmt_of(node: ast.AST) -> ast.stmt:
 class ResourcePairing(Rule):
     name = "resource-pairing"
     description = ("acquire without release on error paths: snapshot "
-                   "pins, failpoint arms, PendingFlush dispatch/emit "
+                   "pins, failpoint arms, PendingFlush dispatch/emit, "
+                   "reshard windows, trace span start/finish "
                    "(PR-3 pin-leak class)")
 
     def check(self, module: Module,
@@ -150,6 +161,13 @@ class ResourcePairing(Rule):
         if self._escapes(acq):
             return None
         if not releases:
+            # name-flow escape counts ONLY when the function holds no
+            # release responsibility of its own: with release calls
+            # present, handing the value to a callee does not excuse
+            # the missing error-path release (the PIN_LEAK shape
+            # passes the pin into the dispatch it protects)
+            if self._name_escapes(fn, acq):
+                return None
             return ("is acquired but never released in this function, "
                     "and its result does not escape")
         acq_stmt = _stmt_of(acq)
@@ -203,6 +221,41 @@ class ResourcePairing(Rule):
             if isinstance(anc, ast.stmt):
                 return False
             node = anc
+        return False
+
+    @staticmethod
+    def _name_escapes(fn, acq: ast.Call) -> bool:
+        """Ownership transfer THROUGH a local name: the acquire is
+        assigned to a plain name whose value is later handed off —
+        passed as an ARGUMENT to another call (the OpenTracing bridge's
+        `span = self.start_span(...); return activate(span, ...)`),
+        returned/yielded, or stored into an attribute/subscript/
+        collection.  Using the name as a method receiver (`span.add()`)
+        is NOT a transfer — the release sites still apply."""
+        par = astutil.parent(acq)
+        if not (isinstance(par, ast.Assign) and len(par.targets) == 1
+                and isinstance(par.targets[0], ast.Name)):
+            return False
+        name = par.targets[0].id
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)
+                    and node.lineno > par.lineno):
+                continue
+            anc = astutil.parent(node)
+            if isinstance(anc, ast.Call) and node in anc.args:
+                return True
+            if isinstance(anc, ast.keyword):
+                return True
+            if isinstance(anc, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return True
+            if isinstance(anc, (ast.Dict, ast.List, ast.Tuple,
+                                ast.Set)):
+                return True
+            if isinstance(anc, ast.Assign) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in anc.targets):
+                return True
         return False
 
     @staticmethod

@@ -218,6 +218,20 @@ class Config:
     profiling_cpu_max_seconds: float = 60.0  # per-request duration cap
     profiling_timeline_capacity: int = 512   # flush records in the ring
     profiling_use_pyspy: bool = True     # py-spy subprocess when on PATH
+    # self-tracing flight recorder (veneur_tpu/trace/recorder.py): every
+    # flush interval becomes a distributed trace over the pipeline's own
+    # SSF span plane — root flush span, segment children, per-attempt
+    # forward spans, context propagated over gRPC metadata to the proxy
+    # and global tiers.  The bounded span ring is ALWAYS on (served at
+    # /debug/trace); trace_flush_sample_rate gates how many intervals
+    # get the full treatment (deterministic seeded head sampling, so
+    # every tier configured alike samples the same intervals), and
+    # trace_flush_enabled=False turns interval tracing off entirely
+    # (the ring still records externally-submitted spans).
+    trace_flush_enabled: bool = True
+    trace_flush_sample_rate: float = 1.0
+    trace_seed: int = 0
+    trace_ring_capacity: int = 512
     http_quit: bool = False
     http_config_endpoint: bool = False
     # accepted for reference-config compatibility; Go-runtime-specific

@@ -1039,14 +1039,18 @@ class MetricAggregator:
             seg["readback_bytes"] = ev.nbytes
             host["dense_dev"] = pend["first_dev"]
             host["dense_uniform"] = pend["uniform"]
-            if pend["uniform"]:
-                # slim readback: ev carries the quantile columns only;
-                # exact f64 totals come from the host accumulators
-                host["qs"] = ev[:nd, :n_cols]
-                host["counts"] = np.asarray(dpart["d_weight"],
-                                            np.float64)
-                host["sums"] = np.asarray(dpart["d_sum"], np.float64)
-                return host
+            # counts/sums come from the exact f64 host accumulators on
+            # BOTH staging shapes (they cover every staged point,
+            # merged-digest centroids included) — sourcing only the
+            # uniform path from the host made a series' reported
+            # count/sum precision shift whenever staging flipped
+            # uniform/non-uniform between intervals (ADVICE r5 #6); the
+            # device ev columns carry the same totals in eval dtype and
+            # remain the meshed path's (collective-reduced) source
+            host["qs"] = ev[:nd, :n_cols]
+            host["counts"] = np.asarray(dpart["d_weight"], np.float64)
+            host["sums"] = np.asarray(dpart["d_sum"], np.float64)
+            return host
         else:
             t0 = time.perf_counter()
             flat_t, set_regs_t = serving.fetch(

@@ -964,6 +964,24 @@ class DigestArena(_ArenaBase):
             return 0
         return int(np.bincount(rows).max())
 
+    def dense_block_per_shard(self, n_rows: int) -> int:
+        """Row-block size each mesh shard owns in the dense build for
+        `n_rows` touched keys: each shard's block must split evenly
+        over the replicas (the flush body's all_to_all re-partitions a
+        shard's rows R ways), so the block is the pow2 ceiling of
+        n_rows/S rounded up to a replica multiple.  This IS the
+        multi-controller key-ownership contract: dense row r (touched
+        order) lives on shard r // block, and devices are process-major
+        — a deployment must stage/import key k only on the process
+        whose shards cover its dense row (parallel/multihost.py;
+        tests/test_multihost.py drives it through this method so the
+        test and the build cannot drift)."""
+        per_shard = _pow2(-(-max(int(n_rows), 1) // self.n_shards))
+        if per_shard % self.n_replicas:
+            per_shard = self.n_replicas * _pow2(
+                -(-per_shard // self.n_replicas))
+        return per_shard
+
     def build_dense(self, staged, touched: np.ndarray,
                     d_min_t: np.ndarray, d_max_t: np.ndarray,
                     u_floor: int = 0, d_floor: int = 0,
@@ -997,12 +1015,7 @@ class DigestArena(_ArenaBase):
             rows, vals, wts = rows[keep_mask], vals[keep_mask], \
                 wts[keep_mask]
         nd = len(touched)
-        # each shard's row block must split evenly over the replicas:
-        # the flush body's all_to_all re-partitions K_s rows R-ways
-        per_shard = _pow2(-(-max(nd, u_floor, 1) // self.n_shards))
-        if per_shard % self.n_replicas:
-            per_shard = self.n_replicas * _pow2(
-                -(-per_shard // self.n_replicas))
+        per_shard = self.dense_block_per_shard(max(nd, u_floor))
         u_pad = self.n_shards * per_shard
         dense_id = np.full(self.capacity, -1, np.int64)
         dense_id[touched] = np.arange(nd)

@@ -231,6 +231,28 @@ class Server:
             objective_timer_name=cfg.objective_span_timer_name)
         self.span_sinks.append(self.metric_extraction)
 
+        # self-tracing flight recorder (veneur_tpu/trace/recorder.py):
+        # an always-on bounded ring of finished spans, installed as a
+        # span sink so everything on the span plane — the server's own
+        # flush traces included — is queryable at /debug/trace
+        from veneur_tpu.trace import recorder as trace_rec
+        self.flight_recorder = trace_rec.FlightRecorder(
+            cfg.trace_ring_capacity)
+        self.span_sinks.append(self.flight_recorder)
+        # per-interval distributed tracing: the deterministic seeded
+        # sampler decides which flush intervals get the full treatment
+        # (segment children, per-attempt forward spans, gRPC metadata
+        # propagation); None = interval tracing off
+        self.trace_sampler = (
+            trace_rec.DeterministicSampler(cfg.trace_flush_sample_rate,
+                                           cfg.trace_seed)
+            if cfg.trace_flush_enabled else None)
+        # trace ids imported since the last flush (global tier): the
+        # flush root span tags them so the cross-tier assembler can join
+        # this global flush onto each settled local interval's trace
+        self._imported_traces: set = set()
+        self._imported_traces_lock = threading.Lock()
+
         # event/service-check accumulation (EventWorker, worker.go:491-536)
         self._events: list[parser_mod.SSFSample] = []
         self._events_lock = threading.Lock()
@@ -425,7 +447,8 @@ class Server:
                 _import_counted,
                 ingest_span=self._grpc_span_counted,
                 handle_packet=self._grpc_packet_counted,
-                import_payload=_import_payload_counted)
+                import_payload=_import_payload_counted,
+                trace_hook=self._record_import_span)
             self.grpc_import.start()
         if self.config.forward_address and self.forwarder is None:
             # local tier: persistent forward connection (server.go:810-828)
@@ -1018,10 +1041,67 @@ class Server:
             #   included; ingest threads never contend on it)
             self._flush_locked()
 
+    # bound on the flush root span's imported_traces tag (the tag is
+    # operator-facing JSON, not a database; the assembler only needs
+    # the ids of the intervals this flush settled)
+    IMPORTED_TRACES_TAG_MAX = 64
+
+    def _record_import_span(self, ctxs, n_metrics: int, start_ns: int,
+                            transport: str) -> None:
+        """gRPC import trace hook (sources/proxy.py): continue each
+        inbound RPC's propagated trace context with one child span
+        covering the import, and remember the trace ids so the next
+        flush's root span can tag the intervals it settles."""
+        from veneur_tpu.trace import recorder as trace_rec
+        for tid, sid in ctxs:
+            span = trace_rec.continue_span(
+                "global.import", tid, sid, client=self.trace_client,
+                tags={"metrics": str(n_metrics), "transport": transport,
+                      "host": self.config.hostname},
+                start_ns=start_ns)
+            span.finish()
+        if ctxs:
+            with self._imported_traces_lock:
+                if len(self._imported_traces) < 4096:
+                    self._imported_traces.update(t for t, _ in ctxs)
+
+    # canonical order for the synthesized segment child spans.  The
+    # aggregator measures segment DURATIONS, not timestamps (device_s is
+    # the residual wait after the overlapped host accounting), so the
+    # children are laid end to end from the flush start: their summed
+    # extent vs the root's wall is exactly the overlap signal the
+    # critical-path table reports.
+    _SEGMENT_ORDER = ("snapshot", "build", "layout", "dispatch",
+                      "device", "emit")
+
+    def _emit_segment_spans(self, span, flush_start: float) -> None:
+        """One child span per measured flush segment (the staging/
+        upload/kernel/readback decomposition from last_flush_segments).
+        Synthesized children go straight into the flight-recorder ring
+        (record_span's proto-free fast path): they exist for trace
+        assembly, and the full SSF submission pipeline — built for
+        externally-sourced spans — would cost more per flush than the
+        segments it annotates."""
+        elapsed_ns = int((time.perf_counter() - flush_start) * 1e9)
+        t0 = time.time_ns() - elapsed_ns   # wall-clock of flush start
+        off = 0
+        segs = self.aggregator.last_flush_segments
+        for seg_name in self._SEGMENT_ORDER:
+            v = segs.get(f"{seg_name}_s")
+            if v is None:
+                continue
+            dur_ns = int(float(v) * 1e9)
+            child = span.child(f"flush.seg.{seg_name}")
+            child.start_ns = t0 + off
+            child.end_ns = child.start_ns + dur_ns
+            child.client = None          # ring fast path below
+            child.finish()
+            self.flight_recorder.record_span(child)
+            off += dur_ns
+
     def _flush_locked(self) -> None:
         from veneur_tpu import failpoints
         from veneur_tpu import scopedstatsd
-        from veneur_tpu import ssf as ssf_mod
 
         # vnlint: disable=blocking-propagation (deliberate failpoint
         #   edge: the chaos delay arm exists to stall the flush path
@@ -1029,12 +1109,47 @@ class Server:
         failpoints.inject("server.flush")
         self.last_flush_unix = time.time()
         statsd = scopedstatsd.ensure(self.statsd)
-        span = self.trace_client.span(
-            "flush", service="veneur_tpu",
-            tags={"veneurglobalonly": str(not self.is_local).lower()})
+        interval = self.flush_count + 1
+        traced = (self.trace_sampler is not None
+                  and self.trace_sampler.sample(interval))
         flush_start = time.perf_counter()
+        # the interval's ROOT span: every flush is a distributed trace
+        # over the pipeline's own span plane (context propagates through
+        # forward metadata -> proxy -> global import).  The with-exit
+        # finishes it — error-flagged on an exception — and submission
+        # lands it in the flight-recorder ring (/debug/trace).
+        with self.trace_client.span(
+                "flush", service="veneur_tpu",
+                tags={"veneurglobalonly": str(not self.is_local).lower(),
+                      "tier": "local" if self.is_local else "global",
+                      "interval": str(interval),
+                      "host": self.config.hostname,
+                      "forward_metrics": "0",
+                      "sampled": str(traced).lower()}) as span:
+            # vnlint: disable=blocking-propagation (the body IS the
+            #   flush — _flush_serial deliberately covers its one
+            #   device wait, pending.emit, and the sink-fanout
+            #   deadline; ingest threads never contend on
+            #   _flush_serial.  Same rationale as the suppressions at
+            #   the waits themselves)
+            self._flush_body_locked(span, statsd, flush_start, traced)
+
+    def _flush_body_locked(self, span, statsd, flush_start: float,
+                           traced: bool) -> None:
+        from veneur_tpu import ssf as ssf_mod
 
         self._drain_native()
+        # swap the imported-trace set out NOW, just before the snapshot:
+        # a trace id belongs on THIS flush's imported_traces tag only if
+        # its metrics were imported before the snapshot this flush
+        # evaluates — imports landing mid-flush are the NEXT flush's to
+        # settle (the tag drives the assembler's global-flush join)
+        if not self.is_local:
+            with self._imported_traces_lock:
+                settled_tids, self._imported_traces = (
+                    self._imported_traces, set())
+        else:
+            settled_tids = ()
         # overlapped launch: snapshot + stage + dispatch the device
         # program, then run this interval's host-side self-metric
         # accounting WHILE the kernel executes; pending.emit() — the
@@ -1090,7 +1205,12 @@ class Server:
             if self._forward_slots.acquire(blocking=False):
                 try:
                     futures[self._flush_pool.submit(
-                        self._forward_safely, res.forward, span)] = "forward"
+                        self._forward_safely, res.forward, span,
+                        traced)] = "forward"
+                    # the assembler requires a complete 3-tier trace
+                    # only for intervals whose forward was SUBMITTED
+                    # (slot-exhausted drops are accounted, not traced)
+                    span.tags["forward_metrics"] = str(len(res.forward))
                 except RuntimeError:  # pool shut down mid-flush
                     self._forward_slots.release()
             else:
@@ -1110,6 +1230,7 @@ class Server:
             futures[self._flush_pool.submit(
                 self._flush_span_sink, sink,
                 statsd)] = f"span:{sink.name()}"
+        fanout_start_ns = time.time_ns()
         # vnlint: disable=sync-under-lock (the one-interval sink-fanout
         #   deadline is the flush's straggler bound, intentionally
         #   inside the flush serialization lock; ingest threads never
@@ -1127,14 +1248,31 @@ class Server:
             logger.warning("flush deadline: still running after %.1fs: %s",
                            self.config.interval,
                            ", ".join(sorted(futures[f] for f in not_done)))
+        if traced:
+            # segment children (staging/upload/kernel/readback) + the
+            # sink-fanout wait, as spans on the interval's own trace
+            self._emit_segment_spans(span, flush_start)
+            fanout_end_ns = time.time_ns()
+            fanout = span.child("flush.seg.fanout")
+            fanout.start_ns = fanout_start_ns
+            fanout.end_ns = fanout_end_ns
+            fanout.client = None         # ring fast path, like segments
+            fanout.finish()
+            self.flight_recorder.record_span(fanout)
+        if settled_tids:
+            # tag the intervals this global flush settled (bounded), so
+            # the assembler can join it onto each local trace
+            sample = sorted(settled_tids)[:self.IMPORTED_TRACES_TAG_MAX]
+            span.tags["imported_traces"] = ",".join(
+                f"{t:x}" for t in sample)
         span.add(ssf_mod.timing(
             "flush.total_duration_ns",
             time.perf_counter() - flush_start))
-        span.finish()
         # one structured record per flush into the timeline ring: the
         # measured segment decomposition (snapshot/build/layout/dispatch/
         # device/emit + bytes + per-family key counts), the interval id,
-        # and what the interval carried
+        # what the interval carried, and the trace/span ids that make
+        # timeline rows cross-link into /debug/trace
         from veneur_tpu.parallel import serving as serving_mod
         self.flush_timeline.record(
             interval=self.flush_count,
@@ -1144,7 +1282,9 @@ class Server:
             devices=serving_mod.mesh_device_count(self.mesh),
             processed=res.processed, imported=res.imported,
             metrics_emitted=len(res.metrics),
-            forward_metrics=len(res.forward))
+            forward_metrics=len(res.forward),
+            trace_id=f"{span.trace_id:x}",
+            span_id=f"{span.span_id:x}")
 
     def _flush_interval_accounting(self, statsd) -> None:
         """Host-side per-interval self-metric accounting that does not
@@ -1219,20 +1359,29 @@ class Server:
         return self._tags_exclude_global | per_sink
 
     def _forward_safely(self, forward: list[sm.ForwardMetric],
-                        parent=None) -> None:
+                        parent=None, traced: bool = False) -> None:
         """Forward with sub-timings on a child span
-        (flusher.go:516-576: export/grpc parts + error cause)."""
+        (flusher.go:516-576: export/grpc parts + error cause).  When the
+        interval is `traced`, the forward client gets the child span as
+        trace parent: each attempt becomes its own span and the attempt
+        context rides the RPC metadata to the proxy."""
         from veneur_tpu import scopedstatsd
         from veneur_tpu import ssf as ssf_mod
         statsd = scopedstatsd.ensure(self.statsd)
+        grpc_start = time.perf_counter()
         fspan = (parent.child("flush.forward") if parent is not None
                  else self.trace_client.span("flush.forward"))
-        fspan.add(
-            ssf_mod.gauge("forward.metrics_total", float(len(forward))),
-            ssf_mod.count("forward.post_metrics_total", float(len(forward))))
-        grpc_start = time.perf_counter()
         try:
-            self.forwarder(forward)
+            fspan.add(
+                ssf_mod.gauge("forward.metrics_total",
+                              float(len(forward))),
+                ssf_mod.count("forward.post_metrics_total",
+                              float(len(forward))))
+            if traced and getattr(self.forwarder, "accepts_trace",
+                                  False):
+                self.forwarder(forward, trace_parent=fspan)
+            else:
+                self.forwarder(forward)
             fspan.add(ssf_mod.count("forward.error_total", 0))
         except TimeoutError:
             fspan.add(ssf_mod.count("forward.error_total", 1,

@@ -37,6 +37,7 @@ from veneur_tpu import failpoints
 from veneur_tpu.forward import convert
 from veneur_tpu.protocol import forward_pb2, metric_pb2
 from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.trace import recorder as trace_rec
 
 logger = logging.getLogger("veneur_tpu.forward")
 
@@ -148,8 +149,14 @@ class ForwardClient:
         self.retries = 0     # retry attempts taken
         self.dropped = 0     # metrics given up on after exhausted retries
 
-    def __call__(self, metrics: list[sm.ForwardMetric]) -> None:
-        self.send(metrics)
+    # the server's flush path may hand a trace parent span down
+    # (core/server.py _forward_safely); custom forwarder callables that
+    # lack this attribute are called with metrics alone
+    accepts_trace = True
+
+    def __call__(self, metrics: list[sm.ForwardMetric],
+                 trace_parent=None) -> None:
+        self.send(metrics, trace_parent=trace_parent)
 
     def stats(self) -> dict[str, int]:
         with self._stats_lock:
@@ -160,23 +167,50 @@ class ForwardClient:
         with self._stats_lock:
             setattr(self, field, getattr(self, field) + n)
 
-    def send(self, metrics: list[sm.ForwardMetric]) -> None:
+    def send(self, metrics: list[sm.ForwardMetric],
+             trace_parent=None) -> None:
         """One flush's forward: batched V1 against this framework's
         globals, the reference's V2 stream protocol otherwise
         (flusher.go:578-591 semantics — every metric is Sent exactly
         once per flush), under the bounded RetryPolicy."""
         if not metrics:
             return
-        self.send_pbs([convert.to_pb(fm) for fm in metrics])
+        self.send_pbs([convert.to_pb(fm) for fm in metrics],
+                      trace_parent=trace_parent)
 
-    def send_pbs(self, pbs: list) -> None:
+    def send_pbs(self, pbs: list, trace_parent=None) -> None:
+        """With `trace_parent` (a trace.Span), every attempt becomes one
+        child span — tagged with its attempt index, outcome, and the
+        injected failpoint name when chaos fired — and the attempt's
+        trace context rides the RPC metadata, so the receiving proxy /
+        global parents its own span to exactly the attempt that
+        delivered the metrics (duplicate attempts stay leaf spans with
+        error=true; only the delivered edge continues the trace)."""
         remaining = pbs
         retry_idx = 0
         while True:
+            aspan = (trace_parent.child(
+                "forward.attempt",
+                tags={"attempt": str(retry_idx + 1),
+                      "metrics": str(len(remaining))})
+                if trace_parent is not None else None)
             try:
-                self._send_attempt(remaining)
+                self._send_attempt(
+                    remaining,
+                    metadata=(None if aspan is None else
+                              trace_rec.ctx_metadata(aspan.trace_id,
+                                                     aspan.span_id)))
                 return
             except _SendFailure as f:
+                if aspan is not None:
+                    aspan.error = True
+                    aspan.tags["cause"] = type(f.cause).__name__
+                    fp = getattr(f.cause, "failpoint", None)
+                    if fp:
+                        aspan.tags["failpoint"] = str(fp)
+                    # stamp the failure now — the finally also finishes
+                    # (idempotently) but only after the backoff sleep
+                    aspan.finish()
                 remaining = f.undelivered
                 if (not f.retry_safe
                         or retry_idx >= self.retry.attempts - 1):
@@ -195,8 +229,11 @@ class ForwardClient:
                     f.cause, len(remaining), delay * 1e3)
                 time.sleep(delay)
                 retry_idx += 1
+            finally:
+                if aspan is not None:
+                    aspan.finish()
 
-    def _send_attempt(self, pbs: list) -> None:
+    def _send_attempt(self, pbs: list, metadata=None) -> None:
         """One try at delivering `pbs`; raises _SendFailure carrying
         exactly what is still undelivered."""
         try:
@@ -205,7 +242,7 @@ class ForwardClient:
             raise _SendFailure(pbs, e, _retry_safe(e)) from e
         if self._use_v1 is not False:
             try:
-                self._send_v1_batches(pbs)
+                self._send_v1_batches(pbs, metadata=metadata)
                 # a later-chunk UNIMPLEMENTED inside the batch sender
                 # flips _use_v1 off; don't override that verdict
                 if self._use_v1 is not False:
@@ -219,9 +256,9 @@ class ForwardClient:
                 logger.info("global %s has no V1 batch import; "
                             "using V2 streams", self.address)
                 self._use_v1 = False
-        self._send_v2_fanout(pbs)
+        self._send_v2_fanout(pbs, metadata=metadata)
 
-    def _send_v2_fanout(self, pbs: list) -> None:
+    def _send_v2_fanout(self, pbs: list, metadata=None) -> None:
         """V2 streams, fanned out in parallel for big payloads — one
         python-grpc client stream tops out around ~20k msgs/s, so large
         flushes split round-robin across max_streams.
@@ -253,7 +290,8 @@ class ForwardClient:
                     for pb in slice_pbs:
                         self.pulled += 1
                         yield pb
-                client._v2(it(), timeout=client.timeout_s)
+                client._v2(it(), timeout=client.timeout_s,
+                           metadata=metadata)
 
         def stream_safe(st: _Stream, e: BaseException) -> bool:
             return st.pulled == 0 and _retry_safe(e)
@@ -287,7 +325,7 @@ class ForwardClient:
         logger.debug("forwarded %d metrics to %s over %d streams",
                      len(pbs), self.address, n_streams)
 
-    def _send_v1_batches(self, pbs: list) -> None:
+    def _send_v1_batches(self, pbs: list, metadata=None) -> None:
         """BATCH_MAX-sized MetricList RPCs, in parallel for big
         flushes.  The first chunk is sent ALONE: if it answers
         UNIMPLEMENTED nothing has been imported yet, so the V2 fallback
@@ -302,7 +340,7 @@ class ForwardClient:
                   for i in range(0, len(pbs), BATCH_MAX)]
         try:
             self._v1(forward_pb2.MetricList(metrics=chunks[0]),
-                     timeout=self.timeout_s)
+                     timeout=self.timeout_s, metadata=metadata)
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                 raise _V1Unsupported() from e
@@ -311,7 +349,7 @@ class ForwardClient:
         self._count("sent", len(chunks[0]))
         if len(chunks) == 1:
             return
-        futs = [(c, self._pool.submit(self._send_v1_chunk, c))
+        futs = [(c, self._pool.submit(self._send_v1_chunk, c, metadata))
                 for c in chunks[1:]]
         errs = []
         undelivered: list = []
@@ -338,7 +376,7 @@ class ForwardClient:
                 self.address, n_unimpl_chunks)
             self._use_v1 = False
             try:
-                self._send_v2_fanout(v2_retry)
+                self._send_v2_fanout(v2_retry, metadata=metadata)
             except _SendFailure as f:
                 # fold the V2-undelivered remainder into this attempt's
                 # failure so the OUTER bounded retry loop re-sends it —
@@ -358,9 +396,9 @@ class ForwardClient:
                 undelivered, errs[0],
                 all(_retry_safe(e) for e in errs)) from errs[0]
 
-    def _send_v1_chunk(self, chunk: list) -> None:
+    def _send_v1_chunk(self, chunk: list, metadata=None) -> None:
         self._v1(forward_pb2.MetricList(metrics=chunk),
-                 timeout=self.timeout_s)
+                 timeout=self.timeout_s, metadata=metadata)
 
     def send_v1(self, metrics: list[sm.ForwardMetric]) -> None:
         """Batch API; the reference global leaves this unimplemented

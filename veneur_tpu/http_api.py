@@ -13,6 +13,8 @@ SURVEY §5.1):
                          (?seconds=N&hz=M; py-spy when available, else
                          the in-process sampler — veneur_tpu/profiling)
   /debug/flush_timeline  ring of structured per-flush records (?last=N)
+  /debug/trace           flight-recorder span ring: every flush interval
+                         is a distributed trace (?trace_id=HEX | ?last=N)
 """
 
 from __future__ import annotations
@@ -146,6 +148,9 @@ def make_handler(server) -> type:
                 if timeline is not None:
                     stats["flush_timeline_recorded"] = \
                         timeline.total_recorded
+                recorder = getattr(server, "flight_recorder", None)
+                if recorder is not None:
+                    stats["trace_recorded"] = recorder.total_recorded
                 self._reply(200, json.dumps(stats, indent=2).encode(),
                             "application/json")
             elif self.path.rstrip("/") == "/debug/pprof":
@@ -199,6 +204,24 @@ def make_handler(server) -> type:
                        "records": timeline.snapshot(last)}
                 self._reply(200, json.dumps(out, indent=2).encode(),
                             "application/json")
+            elif self.path.startswith("/debug/trace"):
+                # the self-tracing flight recorder: always on, like the
+                # ring it serves — a black box is most needed when
+                # nothing else was enabled ahead of the incident
+                from veneur_tpu.trace import recorder as trace_rec
+                recorder = getattr(server, "flight_recorder", None)
+                if recorder is None:
+                    self._reply(404, b"no flight recorder\n")
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                try:
+                    out = trace_rec.debug_trace_body(recorder, q)
+                except ValueError:
+                    self._reply(400, b"bad trace_id/last\n")
+                    return
+                self._reply(200, json.dumps(out, indent=2).encode(),
+                            "application/json")
             elif self.path.startswith("/debug/profile"):
                 if not cfg.enable_profiling:
                     self._reply(403, b"profiling disabled "
@@ -240,6 +263,9 @@ def _pprof_index(cfg) -> bytes:
         "                runtime stats + per-stage data-plane counters",
         "flush_timeline  /debug/flush_timeline?last=N",
         "                structured per-flush segment records",
+        "trace           /debug/trace?trace_id=HEX | ?last=N",
+        "                flight-recorder span ring (per-flush "
+        "distributed traces)",
         f"device          /debug/profile?seconds=N{gate}",
         "                JAX device trace (tensorboard-loadable)",
         "",

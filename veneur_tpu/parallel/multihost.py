@@ -49,6 +49,7 @@ until a deployment grows past one accelerator host.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 logger = logging.getLogger("veneur_tpu.parallel.multihost")
@@ -68,6 +69,21 @@ def init_multihost(coordinator_address: str,
     if _initialized:
         return
     import jax
+
+    # XLA:CPU runs cross-process collectives only through the gloo
+    # transport ("Multiprocess computations aren't implemented on the
+    # CPU backend" otherwise) — select it whenever the process is
+    # pinned to the CPU platform, BEFORE the backend initializes.  TPU
+    # processes keep their native DCN transport untouched.
+    platforms = (jax.config.jax_platforms
+                 or os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" in str(platforms).lower():
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # older jaxlib without the option
+            logger.warning("could not select gloo CPU collectives: %s",
+                           e)
 
     kwargs = {"coordinator_address": coordinator_address}
     if num_processes is not None and num_processes >= 0:

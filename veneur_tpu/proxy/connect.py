@@ -37,6 +37,7 @@ from veneur_tpu import failpoints
 from veneur_tpu.forward.client import (BATCH_MAX, SEND_METRICS,
                                        SEND_METRICS_V2)
 from veneur_tpu.protocol import forward_pb2, metric_pb2
+from veneur_tpu.trace import recorder as trace_rec
 
 logger = logging.getLogger("veneur_tpu.proxy.connect")
 
@@ -96,6 +97,13 @@ class Destination:
         self.dropped = 0
         self._sent_lock = threading.Lock()
         self._swept: list = []   # items reclaimed by close-time drains
+        # trace contexts whose metrics were coalesced into this
+        # destination's buffer since the last send: the next outbound
+        # V1 RPC carries them all as metadata (proxy -> global
+        # propagation; V2 stream mode cannot carry per-batch metadata —
+        # reference globals do not continue traces anyway)
+        self._trace_ctxs: dict = {}    # ordered set of (tid, sid)
+        self._trace_lock = threading.Lock()
         # metric-count buffer bound (send_buffer_size metrics total,
         # whatever the queue-item granularity)
         self._buf_cap = max(1, send_buffer_size)
@@ -187,6 +195,35 @@ class Destination:
             self._buffered -= n
             self._buf_cv.notify_all()
 
+    # pending trace contexts per destination: bounded — past the cap
+    # the OLDEST context drops (one trace loses its import edge; newer
+    # traces and the delivery accounting are unaffected)
+    TRACE_CTX_MAX = 128
+
+    def attach_trace(self, ctx) -> None:
+        """Remember a (trace_id, span_id) context whose metrics were
+        just enqueued here; the next outbound batch RPC carries every
+        pending context as metadata so the global's import span parents
+        to the proxy span that routed the metrics."""
+        if ctx is None:
+            return
+        with self._trace_lock:
+            self._trace_ctxs[ctx] = None
+            while len(self._trace_ctxs) > self.TRACE_CTX_MAX:
+                del self._trace_ctxs[next(iter(self._trace_ctxs))]
+
+    def _take_trace_meta(self):
+        """Consume the pending contexts into gRPC metadata (None when
+        empty).  Consumed-on-failure is deliberate: a failed batch
+        closes the destination and its metrics re-route or drop with
+        accounting — the trace simply shows no delivered import edge."""
+        if not self._trace_ctxs:       # benign lock-free fast path
+            return None
+        with self._trace_lock:
+            ctxs = list(self._trace_ctxs)
+            self._trace_ctxs.clear()
+        return trace_rec.ctxs_metadata(ctxs)
+
     def _queue_for(self, name: str) -> queue.Queue:
         """Key-affine queue choice: every metric of a given name rides
         the same sender, so same-timeseries updates (gauges are
@@ -274,12 +311,16 @@ class Destination:
         """Per-chunk sent accounting; a failed chunk counts itself and
         everything after it as dropped (in-flight-counted-as-dropped,
         connect.go:231-245)."""
+        meta = self._take_trace_meta()
         for i in range(0, len(batch), BATCH_MAX):
             chunk = batch[i:i + BATCH_MAX]
             try:
                 failpoints.inject("proxy.send_batch")
+                # contexts ride the FIRST chunk only (one import span
+                # per context per batch, not per chunk)
                 self._v1(forward_pb2.MetricList(metrics=chunk),
-                         timeout=self.send_timeout_s)
+                         timeout=self.send_timeout_s,
+                         metadata=meta if i == 0 else None)
             except (grpc.RpcError, failpoints.FailpointDrop,
                     ValueError) as e:
                 # closed-channel ValueError = the destination was
@@ -297,16 +338,21 @@ class Destination:
         """Send a routed raw group chunk by chunk (each chunk is already
         a valid MetricList body; counts travel with the group)."""
         remaining = item.count
+        meta = self._take_trace_meta()
         for chunk, n in zip(item.chunks, item.chunk_counts):
             try:
                 failpoints.inject("proxy.send_batch")
-                self._v1_raw(chunk, timeout=self.send_timeout_s)
+                self._v1_raw(chunk, timeout=self.send_timeout_s,
+                             metadata=meta)
             except (grpc.RpcError, failpoints.FailpointDrop,
                     ValueError) as e:
                 _reraise_unless_closed_channel(e)
                 with self._sent_lock:
                     self.dropped += remaining
                 raise
+            # contexts ride the first chunk only (one import span per
+            # context per routed group)
+            meta = None
             with self._sent_lock:
                 self.sent += n
             remaining -= n

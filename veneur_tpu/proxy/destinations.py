@@ -74,7 +74,8 @@ class Destinations:
                  breaker_reset_s: float = 5.0,
                  handoff=None,
                  handoff_timeout_s: float = 2.0,
-                 reshard_sample_keys: int = 2048):
+                 reshard_sample_keys: int = 2048,
+                 recorder=None):
         self.send_buffer_size = send_buffer_size
         self.n_streams = n_streams
         self.grpc_stats = grpc_stats
@@ -89,6 +90,9 @@ class Destinations:
         self.handoff = handoff
         self.handoff_timeout_s = handoff_timeout_s
         self.reshard_sample_keys = reshard_sample_keys
+        # flight recorder (trace/recorder.py): breaker transitions and
+        # reshard windows become spans on the proxy's /debug/trace ring
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._ring = ConsistentHash()
         self._dests: dict[str, Destination] = {}
@@ -126,6 +130,13 @@ class Destinations:
                     "failures, trip #%d, retry in %.1fs); routing around "
                     "via the ring", address, b.failures, b.trips,
                     self.breaker_reset_s * backoff)
+                from veneur_tpu.trace import recorder as trace_rec
+                trace_rec.event_span(
+                    self.recorder, "proxy.breaker.open",
+                    {"address": address, "failures": b.failures,
+                     "trip": b.trips,
+                     "retry_in_s": round(
+                         self.breaker_reset_s * backoff, 3)})
 
     def _record_success(self, address: str) -> None:
         """A dial succeeded.  Only a post-trip (half-open) probe closes
@@ -141,6 +152,10 @@ class Destinations:
                 logger.info("destination %s circuit CLOSED "
                             "(probe succeeded); restored to the ring",
                             address)
+                from veneur_tpu.trace import recorder as trace_rec
+                trace_rec.event_span(
+                    self.recorder, "proxy.breaker.close",
+                    {"address": address, "trips": b.trips})
                 del self._breakers[address]
 
     def _admit(self, address: str) -> bool:
@@ -311,6 +326,7 @@ class Destinations:
             "epoch": epoch,
             "started_unix": time.time(),
             "_t0": time.monotonic(),
+            "_start_ns": time.time_ns(),
             "members_before": before,
             "wanted": sorted(want),
             "members_after": None,
@@ -345,6 +361,23 @@ class Destinations:
             rec["duration_s"] = round(
                 time.monotonic() - rec.pop("_t0"), 6)
             rec["committed"] = True
+            start_ns = rec.pop("_start_ns")
+            if self.recorder is not None:
+                # the whole two-phase window as one span on the proxy's
+                # flight-recorder ring (begin -> grow -> drain -> commit)
+                from veneur_tpu import trace as trace_mod
+                span = trace_mod.Span(
+                    "proxy.reshard", service="veneur_tpu",
+                    client=self.recorder,
+                    tags={"epoch": str(rec["epoch"]),
+                          "added": ",".join(rec["added"]),
+                          "removed": ",".join(rec["removed"]),
+                          "keys_moved": str(rec["keys_moved"]),
+                          "moved_frac": str(rec["moved_frac"]),
+                          "handoff_metrics": str(
+                              rec["handoff_metrics"])})
+                span.start_ns = start_ns
+                span.finish()
             with self._lock:
                 self._reshard_moved_total += moved
                 self._last_reshard = rec

@@ -86,6 +86,10 @@ class ProxyConfig:
     # here the Python-flavored /debug/vars + /debug/threads instead)
     http_enable_config: bool = False
     http_enable_profiling: bool = False
+    # always-on flight-recorder span ring (/debug/trace): inbound
+    # forward RPCs carrying a trace context get a proxy.route span;
+    # breaker transitions and reshard windows are recorded as spans too
+    trace_ring_capacity: int = 512
 
 
 def proxy_config_from_dict(data: dict) -> ProxyConfig:
@@ -124,7 +128,8 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
             "tls_authority_certificate", ""),
         http_enable_config=bool(data.get("http_enable_config", False)),
         http_enable_profiling=bool(
-            data.get("http_enable_profiling", False)))
+            data.get("http_enable_profiling", False)),
+        trace_ring_capacity=int(data.get("trace_ring_capacity", 512)))
 
 
 def redacted_proxy_dict(cfg: ProxyConfig, redact: bool = True) -> dict:
@@ -145,6 +150,12 @@ class Proxy:
             cfg.static_destinations)
         # connection open/close accounting (grpcstats/stats.go:1-49)
         self.grpc_stats = GrpcStats(statsd=statsd)
+        # self-tracing flight recorder: the proxy has no span pipeline,
+        # so spans submit synchronously into the bounded ring
+        # (trace/recorder.py duck-types the trace client), served at
+        # /debug/trace on the proxy HTTP surface
+        from veneur_tpu.trace import recorder as trace_rec
+        self.recorder = trace_rec.FlightRecorder(cfg.trace_ring_capacity)
         self.destinations = Destinations(
             cfg.send_buffer_size,
             n_streams=cfg.send_streams,
@@ -157,7 +168,8 @@ class Proxy:
             # undelivered buffer re-routes through the NEW ring
             handoff=self._reshard_handoff,
             handoff_timeout_s=cfg.reshard_handoff_timeout,
-            reshard_sample_keys=cfg.reshard_sample_keys)
+            reshard_sample_keys=cfg.reshard_sample_keys,
+            recorder=self.recorder)
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
                       "no_destination": 0, "rerouted": 0}
         self._stats_lock = threading.Lock()
@@ -212,18 +224,46 @@ class Proxy:
 
     # -- gRPC Forward service ---------------------------------------------
 
+    def _route_span(self, context, transport: str):
+        """Continue an inbound RPC's propagated trace context with a
+        proxy.route span into the flight recorder; None when the sender
+        is untraced (no metadata -> zero overhead)."""
+        from veneur_tpu.trace import recorder as trace_rec
+        ctxs = trace_rec.extract_contexts(context.invocation_metadata())
+        if not ctxs:
+            return None
+        tid, sid = ctxs[0]
+        return trace_rec.continue_span(
+            "proxy.route", tid, sid, client=self.recorder,
+            tags={"transport": transport})
+
     def _handlers(self):
         def send_metrics_raw(request_bytes, context):
             # fleet-internal batch inbound, kept as RAW BYTES: the
             # native wire router slices/regroups the MetricList without
             # any python (de)serialization — the whole proxy data plane
             # is bytes in -> C++ route -> bytes out
-            self.handle_metrics_raw(bytes(request_bytes))
+            span = self._route_span(context, "v1")
+            try:
+                self.handle_metrics_raw(
+                    bytes(request_bytes),
+                    trace_ctx=(None if span is None
+                               else (span.trace_id, span.span_id)))
+            finally:
+                if span is not None:
+                    span.finish()
             return empty_pb2.Empty()
 
         def send_metrics_v2(request_iterator, context):
-            for m in request_iterator:
-                self.handle_metric(m)
+            span = self._route_span(context, "v2")
+            ctx = (None if span is None
+                   else (span.trace_id, span.span_id))
+            try:
+                for m in request_iterator:
+                    self.handle_metric(m, trace_ctx=ctx)
+            finally:
+                if span is not None:
+                    span.finish()
             return empty_pb2.Empty()
 
         return grpc.method_handlers_generic_handler(
@@ -245,7 +285,8 @@ class Proxy:
                 if not any(tm.match(t) for tm in self.cfg.ignore_tags)]
         return f"{m.name}{_TYPE_NAMES.get(m.type, '')}{','.join(tags)}"
 
-    def handle_metric(self, m: metric_pb2.Metric) -> None:
+    def handle_metric(self, m: metric_pb2.Metric,
+                      trace_ctx=None) -> None:
         try:
             dest = self.destinations.get(self.routing_key(m))
         except LookupError:
@@ -253,6 +294,10 @@ class Proxy:
                 self.stats["received"] += 1
                 self.stats["no_destination"] += 1
             return
+        if trace_ctx is not None:
+            # attach BEFORE the enqueue so the sender that drains this
+            # metric is guaranteed to carry the context onward
+            dest.attach_trace(trace_ctx)
         outcome = dest.send(m)
         with self._stats_lock:
             self.stats["received"] += 1
@@ -261,7 +306,8 @@ class Proxy:
             else:
                 self.stats["routed"] += 1
 
-    def handle_metrics_raw(self, payload: bytes) -> None:
+    def handle_metrics_raw(self, payload: bytes,
+                           trace_ctx=None) -> None:
         """Route a serialized MetricList without deserializing it: the
         native wire router (ingest.route_metric_list) slices the payload
         at protobuf record boundaries, hashes each metric's routing key
@@ -284,19 +330,21 @@ class Proxy:
                 if router and not self.cfg.ignore_tags else None)
         if not ring:
             ml = forward_pb2.MetricList.FromString(payload)
-            self.handle_metrics(ml.metrics)
+            self.handle_metrics(ml.metrics, trace_ctx=trace_ctx)
             return
         hashes, didx, dests = ring
         routed = router(payload, hashes, didx, len(dests))
         if routed is None:          # malformed for the wire scanner
             ml = forward_pb2.MetricList.FromString(payload)
-            self.handle_metrics(ml.metrics)
+            self.handle_metrics(ml.metrics, trace_ctx=trace_ctx)
             return
         received = routed_n = dropped = 0
         for (chunks, chunk_counts, count), dest in zip(routed, dests):
             if not count:
                 continue
             received += count
+            if trace_ctx is not None:
+                dest.attach_trace(trace_ctx)
             if dest.batch_mode:
                 n_drop = dest.send_raw(chunks, chunk_counts, count)
             else:
@@ -313,7 +361,8 @@ class Proxy:
             self.stats["routed"] += routed_n
             self.stats["dropped"] += dropped
 
-    def handle_metrics(self, ms, rerouted: bool = False) -> None:
+    def handle_metrics(self, ms, rerouted: bool = False,
+                       trace_ctx=None) -> None:
         """Batched routing (the V1 inbound path): group by destination,
         enqueue each group as one unit, take the stats lock once.  Same
         per-metric routing key and drop accounting as handle_metric —
@@ -339,6 +388,8 @@ class Proxy:
         routed = 0
         dropped = 0
         for dest, batch in groups.values():
+            if trace_ctx is not None:
+                dest.attach_trace(trace_ctx)
             n_drop = dest.send_many(batch)
             dropped += n_drop
             routed += len(batch) - n_drop
@@ -416,9 +467,29 @@ class Proxy:
                     # moved, handoff counts, last committed window
                     stats["reshard"] = \
                         proxy.destinations.reshard_stats()
+                    stats["trace_recorded"] = \
+                        proxy.recorder.total_recorded
                     stats["threads"] = threading.active_count()
                     http_api.reply(self, 200, json_mod.dumps(
                         stats, indent=2).encode(), "application/json")
+                elif self.path.startswith("/debug/trace"):
+                    # always-on (like the ring itself): the flight
+                    # recorder is the proxy's black box, most needed
+                    # when nothing else was enabled in advance
+                    import urllib.parse
+
+                    from veneur_tpu.trace import recorder as trace_rec
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        body = trace_rec.debug_trace_body(
+                            proxy.recorder, q)
+                    except ValueError:
+                        http_api.reply(self, 400,
+                                       b"bad trace_id/last\n")
+                        return
+                    http_api.reply(self, 200, json_mod.dumps(
+                        body, indent=2).encode(), "application/json")
                 elif (self.path == "/debug/threads"
                         and cfg.http_enable_profiling):
                     http_api.reply(self, 200, http_api.thread_dump())

@@ -20,6 +20,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
 import grpc
@@ -42,18 +43,24 @@ class GrpcImportServer:
                  handle_packet: Optional[Callable[[bytes], None]] = None,
                  max_workers: int = 64,
                  server_credentials: Optional[grpc.ServerCredentials] = None,
-                 import_payload: Optional[Callable] = None):
+                 import_payload: Optional[Callable] = None,
+                 trace_hook: Optional[Callable] = None):
         """With import_metric=None the Forward service is omitted — the
         ingest-only shape of `grpc_listen_addresses` edge listeners
         (StartGRPC, networking.go:326-391), vs the global tier's
         `grpc_address` which serves all three.  import_payload, when
         provided, takes the whole V1 MetricList as RAW BYTES in one
         call (native wire scan + single aggregator lock — the
-        fleet-rate inbound path)."""
+        fleet-rate inbound path).  trace_hook(ctxs, n_metrics,
+        start_ns, transport) receives the propagated trace contexts of
+        each import RPC (veneur_tpu/trace/recorder.py metadata dialect)
+        so the server can continue the sender's flush trace with an
+        import span."""
         self.import_metric = import_metric
         self.import_payload = import_payload
         self.ingest_span = ingest_span
         self.handle_packet = handle_packet
+        self.trace_hook = trace_hook
         self.imported_count = 0
         self._count_lock = threading.Lock()
         # Each long-lived client stream (a proxy destination keeps 8 of
@@ -77,6 +84,15 @@ class GrpcImportServer:
     # -- service wiring ----------------------------------------------------
 
     def _make_handlers(self):
+        def _trace_ctxs(context):
+            """Propagated trace contexts on this RPC, [] when the
+            sender is untraced (or no hook is installed)."""
+            if self.trace_hook is None:
+                return []
+            from veneur_tpu.trace import recorder as trace_rec
+            return trace_rec.extract_contexts(
+                context.invocation_metadata())
+
         def send_metrics(request, context):
             # V1 batch import — the fleet-internal fast path.  The
             # reference leaves this UNIMPLEMENTED (sources/proxy/
@@ -86,6 +102,8 @@ class GrpcImportServer:
             # proxies/forwarders probe V1 and fall back to V2 against
             # reference globals (python-grpc streams cap at ~20k msgs/s;
             # one MetricList carries thousands per RPC).
+            ctxs = _trace_ctxs(context)
+            start_ns = time.time_ns()
             if self.import_payload is not None:
                 # RAW bytes straight to the native scan path — no
                 # python protobuf materialization on the fleet edge
@@ -105,9 +123,13 @@ class GrpcImportServer:
                                      pb.name, e)
             with self._count_lock:
                 self.imported_count += count
+            if ctxs:
+                self.trace_hook(ctxs, count, start_ns, "v1")
             return empty_pb2.Empty()
 
         def send_metrics_v2(request_iterator, context):
+            ctxs = _trace_ctxs(context)
+            start_ns = time.time_ns()
             count = 0
             for pb in request_iterator:
                 try:
@@ -118,6 +140,8 @@ class GrpcImportServer:
                                  pb.name, e)
             with self._count_lock:
                 self.imported_count += count
+            if ctxs:
+                self.trace_hook(ctxs, count, start_ns, "v2")
             return empty_pb2.Empty()
 
         handlers = []

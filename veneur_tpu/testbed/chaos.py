@@ -120,11 +120,15 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   n_globals: int = 2, intervals: int = 2,
                   counter_keys: int = 4, histo_keys: int = 1,
                   set_keys: int = 1, histo_samples: int = 40,
-                  witness=None) -> dict:
+                  witness=None, trace: bool = False) -> dict:
     """One matrix cell: fresh cluster, armed failpoint (or topology
     action), oracle verdict.  `witness` (a LockWitness) additionally
     records every lock-acquisition-order edge the cell exercises for
-    the static cross-check (analysis/witness.py)."""
+    the static cross-check (analysis/witness.py).  `trace` assembles
+    the tiers' flight-recorder rings after the run and gates ok on
+    every settled interval forming one complete 3-tier trace with zero
+    orphans — duplicate retry attempts must dedup to one delivered
+    edge (trace/assembly.py)."""
     if arm.kind == "topology":
         if arm.kwargs.get("op") == "storm":
             return _run_cardinality_storm(arm, seed=seed,
@@ -136,7 +140,7 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                              counter_keys=counter_keys,
                              histo_keys=histo_keys, set_keys=set_keys,
                              histo_samples=histo_samples,
-                             witness=witness)
+                             witness=witness, trace=trace)
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        forward_max_retries=2,
                        forward_retry_backoff=0.02,
@@ -154,12 +158,15 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     per_interval: list[list[list]] = []
     fp = failpoints.configure(arm.failpoint, arm.action,
                               seed=seed, **arm.kwargs)
+    trace_spans = None
     try:
         cluster.start()
         for _ in range(intervals):
             per_interval.append(cluster.run_interval(
                 traffic.next_interval(n_locals)))
         acct = cluster.accounting()
+        if trace:
+            trace_spans = cluster.collect_trace_spans()
     finally:
         failpoints.disarm(arm.failpoint)
         cluster.stop()
@@ -174,7 +181,7 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     else:
         # loss is allowed — but only VISIBLE loss
         ok = fired > 0 and accounted and routing["exclusive"]
-    return {
+    row = {
         "arm": arm.name,
         "failpoint": arm.failpoint,
         "action": arm.action,
@@ -189,12 +196,29 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
         "no_silent_loss": accounted,
         "ok": ok,
     }
+    if trace:
+        _apply_trace_gate(row, trace_spans)
+    return row
+
+
+def _apply_trace_gate(row: dict, trace_spans: list[dict]) -> None:
+    """Fold the cross-tier trace assembly into a chaos row: every
+    settled interval must form one complete 3-tier trace with zero
+    orphan spans (retried attempts dedup to one delivered edge)."""
+    from veneur_tpu.trace import assembly
+    rep = assembly.flush_report(trace_spans or [])
+    row["trace_complete"] = rep["complete"]
+    row["trace_orphans"] = rep["orphans"]
+    row["trace_intervals"] = rep["intervals"]
+    row["ok"] = bool(row["ok"] and rep["complete"]
+                     and rep["orphans"] == 0)
 
 
 def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   intervals: int = 3, counter_keys: int = 4,
                   histo_keys: int = 1, set_keys: int = 1,
-                  histo_samples: int = 40, witness=None) -> dict:
+                  histo_samples: int = 40, witness=None,
+                  trace: bool = False) -> dict:
     """Scale-up / scale-down / rolling-restart under live traffic: run an
     interval on the starting ring, reshard, keep running — conservation
     must stay EXACT across ring epochs, one-global-per-key must hold per
@@ -238,6 +262,7 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                 cluster.restart_global(restarts)
                 restarts += 1
         acct = cluster.accounting()
+        trace_spans = cluster.collect_trace_spans() if trace else None
     finally:
         cluster.stop()
 
@@ -254,7 +279,7 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     ok = (rs["epochs"] >= 1 and conserved and routing["exclusive"]
           and moved_ok and rs["last"] is not None
           and rs["last"]["committed"])
-    return {
+    row = {
         "arm": arm.name,
         "failpoint": arm.failpoint,
         "action": arm.kwargs["op"],
@@ -273,6 +298,9 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
         "moved_bounded": moved_ok,
         "ok": ok,
     }
+    if trace:
+        _apply_trace_gate(row, trace_spans)
+    return row
 
 
 def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,

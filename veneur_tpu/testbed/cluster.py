@@ -85,6 +85,9 @@ class Cluster:
         self.http = None
         self._started = False
         self._global_seq = 0   # hostnames stay unique across restarts
+        # globals retired by topology arms: their flight-recorder rings
+        # still hold this run's spans, so trace assembly keeps them
+        self._retired_globals: list[_Node] = []
         self.witness = None
         self._fp_unwitness = None
         if spec.lock_witness:
@@ -191,6 +194,7 @@ class Cluster:
         node = self.globals.pop(idx)
         self._sync_ring()
         node.server.shutdown()
+        self._retired_globals.append(node)
         return node
 
     def restart_global(self, idx: int) -> str:
@@ -199,6 +203,7 @@ class Cluster:
         old = self.globals.pop(idx)
         self._sync_ring()
         old.server.shutdown()
+        self._retired_globals.append(old)
         node = self._boot_global()
         self.globals.insert(idx, node)
         self._sync_ring()
@@ -372,6 +377,48 @@ class Cluster:
         self.flush_locals()
         self.settle(timeout_s=settle_timeout_s)
         return self.flush_globals()
+
+    # -- trace collection (trace/assembly.py feeds on this) ----------------
+
+    def _span_plane_idle(self) -> bool:
+        """Every live server's span plane drained: trace-client queue
+        empty and every span-sink worker queue empty."""
+        for n in self.locals + self.globals:
+            srv = n.server
+            if not srv.trace_client._q.empty():
+                return False
+            if any(not w.queue.empty() for w in srv.span_workers):
+                return False
+        return True
+
+    def collect_trace_spans(self, timeout_s: float = 10.0) -> list[dict]:
+        """Drain the span plane and return every tier's flight-recorder
+        ring, each record labeled with its tier — the assembler's raw
+        material.  Retired globals' rings are included (a restarted
+        member's spans belong to this run's traces).  Bounded wait:
+        empty queues plus two stable recorded-total polls (a worker may
+        be mid-ingest after its queue empties)."""
+        deadline = time.time() + timeout_s
+        last = None
+        while time.time() < deadline:
+            totals = tuple(
+                n.server.flight_recorder.total_recorded
+                for n in self.locals + self.globals)
+            if self._span_plane_idle() and totals == last:
+                break
+            last = totals
+            time.sleep(0.02)
+        spans: list[dict] = []
+        for i, n in enumerate(self.locals):
+            spans.extend(dict(r, tier=f"local-{i}")
+                         for r in n.server.flight_recorder.snapshot())
+        if self.proxy is not None:
+            spans.extend(dict(r, tier="proxy")
+                         for r in self.proxy.recorder.snapshot())
+        for i, n in enumerate(self.globals + self._retired_globals):
+            spans.extend(dict(r, tier=f"global-{i}")
+                         for r in n.server.flight_recorder.snapshot())
+        return spans
 
     # -- accounting --------------------------------------------------------
 

@@ -20,11 +20,13 @@ from veneur_tpu.testbed.traffic import TrafficGen
 # keys every dryrun report carries (tests/test_testbed.py pins them);
 # `cardinality` nests keys_evicted / tenants_over_budget / rollup_points;
 # `lock_witness` is None unless the run was witnessed, else the
-# static-vs-observed comparison (analysis/witness.py)
+# static-vs-observed comparison (analysis/witness.py); `trace` nests
+# complete / orphans / critical_path_ms (the per-interval table) +
+# timeline_linked from the cross-tier assembler (trace/assembly.py)
 PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
-    "routing_exclusive", "chaos_matrix", "lock_witness", "ok",
+    "routing_exclusive", "chaos_matrix", "lock_witness", "trace", "ok",
 ]
 
 
@@ -36,12 +38,20 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                percentiles: tuple = (0.5, 0.9, 0.99),
                cardinality_key_budget: int = 0,
                chaos: str | None = None,
-               lock_witness: bool = False) -> dict:
+               lock_witness: bool = False,
+               trace: bool = False) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
     With `lock_witness`, every tier's named locks record runtime
     acquisition-order edges (shared across the chaos arms too) and the
     report carries the static-vs-observed comparison — an observed
-    edge the static lock-order graph lacks fails the run."""
+    edge the static lock-order graph lacks fails the run.
+
+    Trace assembly always runs (the span plane is always on) and the
+    report always carries the `trace` keys; `trace=True` additionally
+    GATES ok on it — every settled interval must assemble into one
+    complete local->proxy->global trace with zero orphan spans — and,
+    when no chaos selection was given, runs the forward-retry and
+    ring-scale-up chaos arms with the same trace gate."""
     witness = None
     if lock_witness:
         from veneur_tpu.analysis.witness import LockWitness
@@ -62,6 +72,9 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             per_interval.append(cluster.run_interval(
                 traffic.next_interval(n_locals)))
         acct = cluster.accounting()
+        trace_spans = cluster.collect_trace_spans()
+        timeline_rows = [r for n in cluster.locals
+                         for r in n.server.flush_timeline.snapshot()]
     finally:
         cluster.stop()
 
@@ -71,21 +84,42 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                                        list(percentiles))
     routing = verify.check_routing(per_interval)
 
+    from veneur_tpu.trace import assembly
+    trace_report = assembly.flush_report(trace_spans)
+    # the timeline <-> trace cross-link the satellite promises: every
+    # local flush-timeline row names the trace its interval became
+    trace_ids = {f"{s['trace_id']:x}" for s in trace_spans}
+    trace_report["timeline_linked"] = bool(timeline_rows) and all(
+        r.get("trace_id") in trace_ids and r.get("span_id")
+        for r in timeline_rows)
+
     chaos_rows: list[dict] = []
     if chaos:
         arms = ALL_ARMS if chaos == "all" else [arm_by_name(chaos)]
         for arm in arms:
             chaos_rows.append(run_chaos_arm(arm, seed=seed,
-                                            witness=witness))
+                                            witness=witness,
+                                            trace=trace))
+    elif trace:
+        # the acceptance arms: context must survive forward retries and
+        # a live ring reshard without duplicate delivered edges
+        for arm_name in ("forward-drop", "ring-scale-up"):
+            chaos_rows.append(run_chaos_arm(arm_by_name(arm_name),
+                                            seed=seed, witness=witness,
+                                            trace=True))
 
     witness_cmp = None
     if witness is not None:
         from veneur_tpu.testbed.chaos import witness_comparison
         witness_cmp = witness_comparison(witness)
 
+    trace_ok = (trace_report["complete"]
+                and trace_report["orphans"] == 0
+                and trace_report["timeline_linked"])
     ok = (counters["exact"] and sets["exact"] and quantiles["ok"]
           and routing["exclusive"]
           and all(r["ok"] for r in chaos_rows)
+          and (not trace or trace_ok)
           and (witness_cmp is None or witness_cmp["ok"]))
     return {
         "spec": {
@@ -132,5 +166,9 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         "routing_exclusive": routing["exclusive"],
         "chaos_matrix": chaos_rows,
         "lock_witness": witness_cmp,
+        # trace.{complete,orphans,critical_path_ms} + timeline_linked:
+        # the per-interval critical-path table from the cross-tier
+        # assembler; gates ok only when trace=True was requested
+        "trace": trace_report,
         "ok": ok,
     }

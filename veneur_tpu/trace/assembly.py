@@ -1,0 +1,212 @@
+"""Cross-tier trace assembly: join per-tier flight-recorder rings into
+complete flush-interval traces and attribute the critical path.
+
+The flight recorder (trace/recorder.py) gives each tier a bounded ring
+of its own spans; this module is the *reader* side — the testbed (and
+any operator pulling ``/debug/trace`` from a fleet) concatenates the
+rings and asks the two questions counters cannot answer:
+
+  1. **Causality**: does every settled flush interval assemble into ONE
+     complete local -> proxy -> global trace — root flush span, forward
+     attempt(s), proxy route span, global import span, all
+     parent-linked, with zero orphan spans?  Duplicate attempts (a
+     retried forward) must dedup to one *delivered* edge: completeness
+     counts tiers reached, not RPCs made.
+
+  2. **Attribution**: which segment of the interval's wall-clock
+     dominates, and does the overlap the flush pipeline promises
+     (upload/eval/readback, host accounting behind the kernel) actually
+     happen?  ``sum(segments) - wall`` > 0 is overlap made visible;
+     the per-interval table carries both.
+
+Spans are the ring's flat dicts (recorder.span_record), optionally
+augmented with a ``tier`` key by the collector.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# span names the instrumented pipeline emits (core/server.py,
+# forward/client.py, proxy/proxy.py, sources/proxy.py)
+ROOT_NAME = "flush"
+FORWARD_NAME = "flush.forward"
+ATTEMPT_NAME = "forward.attempt"
+PROXY_NAME = "proxy.route"
+IMPORT_NAME = "global.import"
+SEG_PREFIX = "flush.seg."
+
+
+def group_traces(spans: list[dict]) -> dict[int, list[dict]]:
+    traces: dict[int, list[dict]] = {}
+    for s in spans:
+        traces.setdefault(s["trace_id"], []).append(s)
+    return traces
+
+
+def find_orphans(trace_spans: list[dict]) -> list[dict]:
+    """Spans whose parent is neither root (0) nor present in the same
+    trace — a broken causal link (lost propagation, evicted parent)."""
+    ids = {s["span_id"] for s in trace_spans}
+    return [s for s in trace_spans
+            if s["parent_id"] != 0 and s["parent_id"] not in ids]
+
+
+def _ancestry(span: dict, by_id: dict) -> list[dict]:
+    """Chain from `span` up to its root (span first), cycle-safe."""
+    chain = [span]
+    seen = {span["span_id"]}
+    cur = span
+    while cur["parent_id"] != 0:
+        cur = by_id.get(cur["parent_id"])
+        if cur is None or cur["span_id"] in seen:
+            break
+        seen.add(cur["span_id"])
+        chain.append(cur)
+    return chain
+
+
+def delivered_edges(trace_spans: list[dict]) -> dict[str, int]:
+    """How many distinct tiers each hop reached: import spans whose
+    ancestry runs global.import -> proxy.route -> forward.attempt ->
+    flush.forward -> root.  Duplicate attempts / parallel streams dedup
+    here — an edge is counted by the distinct receiving span's *tier*
+    (falling back to the span service), not per RPC."""
+    by_id = {s["span_id"]: s for s in trace_spans}
+    proxies: set = set()
+    imports: set = set()
+    for s in trace_spans:
+        if s["name"] == PROXY_NAME:
+            chain = _ancestry(s, by_id)
+            if chain[-1]["name"] == ROOT_NAME:
+                proxies.add(s.get("tier", s.get("service", "proxy")))
+        elif s["name"] == IMPORT_NAME:
+            chain = _ancestry(s, by_id)
+            names = [c["name"] for c in chain]
+            # the proxy hop is NOT required here: locals forwarding
+            # straight to a global (proxyless fleets) still deliver —
+            # the 3-tier completeness gate separately demands a proxy
+            # edge, so the testbed contract is unchanged
+            if (chain[-1]["name"] == ROOT_NAME
+                    and ATTEMPT_NAME in names):
+                imports.add(s.get("tier", s.get("service", "global")))
+    return {"proxy": len(proxies), "global": len(imports)}
+
+
+def _span_ms(s: dict) -> float:
+    return float(s["duration_ms"])
+
+
+def critical_path_ms(trace_spans: list[dict],
+                     root: dict) -> float:
+    """End-to-end wall of the whole distributed trace: latest span end
+    minus the root's start (sub-ms spans round up to their duration).
+    Synthesized segment children are EXCLUDED from the max: they are
+    laid end to end so their combined extent is sum(segments), which
+    deliberately overshoots the wall whenever stages overlap — exactly
+    the intervals this column must stay truthful for."""
+    t0 = root["start_ns"]
+    latest = max((s["start_ns"] + s["duration_ms"] * 1e6
+                  for s in trace_spans
+                  if not s["name"].startswith(SEG_PREFIX)),
+                 default=t0)
+    return round(max(latest - t0, root["duration_ms"] * 1e6) / 1e6, 3)
+
+
+def interval_row(root: dict, trace_spans: list[dict],
+                 joined_flushes: Optional[list[dict]] = None) -> dict:
+    """One row of the per-interval critical-path table."""
+    segments = {s["name"][len(SEG_PREFIX):]: _span_ms(s)
+                for s in trace_spans
+                if s["name"].startswith(SEG_PREFIX)
+                and s["parent_id"] == root["span_id"]}
+    forward_ms = sum(_span_ms(s) for s in trace_spans
+                     if s["name"] == FORWARD_NAME)
+    wall = _span_ms(root)
+    seg_sum = round(sum(segments.values()), 3)
+    all_spans = list(trace_spans)
+    for g in (joined_flushes or []):
+        all_spans.append(g)
+    edges = delivered_edges(trace_spans)
+    orphans = find_orphans(trace_spans)
+    forwarded = int(root["tags"].get("forward_metrics", "0") or 0)
+    sampled = root["tags"].get("sampled", "true") == "true"
+    complete = (not sampled or forwarded == 0
+                or (edges["proxy"] >= 1 and edges["global"] >= 1
+                    and not orphans))
+    return {
+        "interval": int(root["tags"].get("interval", "0") or 0),
+        "tier": root.get("tier", root["tags"].get("tier", "")),
+        "trace_id": f"{root['trace_id']:x}",
+        "sampled": sampled,
+        "forwarded": forwarded,
+        "wall_ms": wall,
+        "segments_ms": segments,
+        "sum_segments_ms": seg_sum,
+        # overlap the pipeline promises (dispatch/emit double-buffering,
+        # host accounting behind the kernel): visible as segment time
+        # exceeding the wall that contains it
+        "overlap_ms": round(max(0.0, seg_sum - wall), 3),
+        "forward_ms": round(forward_ms, 3),
+        "critical_path_ms": critical_path_ms(all_spans, root),
+        "spans": len(trace_spans),
+        "edges": edges,
+        "orphans": len(orphans),
+        "complete": bool(complete),
+    }
+
+
+def flush_report(spans: list[dict]) -> dict:
+    """The dryrun's promised ``trace`` report: every *local* flush root
+    becomes one row; ``complete`` holds iff every sampled forwarding
+    interval assembled into a full 3-tier trace with zero orphans
+    anywhere.  Global flush spans (their own traces, since one global
+    flush settles many locals' intervals) join rows via their
+    ``imported_traces`` tag."""
+    traces = group_traces(spans)
+    # global flush roots indexed by the local trace ids they settled
+    joined: dict[int, list[dict]] = {}
+    for tspans in traces.values():
+        for s in tspans:
+            if (s["name"] == ROOT_NAME and s["parent_id"] == 0
+                    and s["tags"].get("tier") == "global"):
+                for tid_hex in filter(None, s["tags"].get(
+                        "imported_traces", "").split(",")):
+                    try:
+                        joined.setdefault(int(tid_hex, 16), []).append(s)
+                    except ValueError:
+                        continue
+    rows = []
+    orphan_total = 0
+    for tid, tspans in traces.items():
+        orphan_total += len(find_orphans(tspans))
+        for s in tspans:
+            if (s["name"] == ROOT_NAME and s["parent_id"] == 0
+                    and s["tags"].get("tier") == "local"):
+                rows.append(interval_row(s, tspans, joined.get(tid)))
+    rows.sort(key=lambda r: (r["tier"], r["interval"]))
+    complete = bool(rows) and all(r["complete"] for r in rows)
+    return {
+        "complete": complete,
+        "orphans": orphan_total,
+        "intervals": len(rows),
+        "critical_path_ms": rows,
+    }
+
+
+def format_table(report: dict) -> str:
+    """Human rendering of the per-interval critical-path table."""
+    lines = [f"{'interval':>8} {'tier':>10} {'wall_ms':>9} "
+             f"{'sum_seg':>9} {'overlap':>8} {'critpath':>9} "
+             f"{'edges':>11} {'ok':>3}  dominant"]
+    for r in report["critical_path_ms"]:
+        dom = max(r["segments_ms"].items(), key=lambda kv: kv[1],
+                  default=("-", 0.0))
+        edges = f"p{r['edges']['proxy']}/g{r['edges']['global']}"
+        lines.append(
+            f"{r['interval']:>8} {r['tier']:>10} {r['wall_ms']:>9.3f} "
+            f"{r['sum_segments_ms']:>9.3f} {r['overlap_ms']:>8.3f} "
+            f"{r['critical_path_ms']:>9.3f} {edges:>11} "
+            f"{'ok' if r['complete'] else 'NO':>3}  "
+            f"{dom[0]}={dom[1]:.3f}ms")
+    return "\n".join(lines)

@@ -1,0 +1,268 @@
+"""Self-tracing flight recorder: the pipeline dogfoods its own span plane.
+
+The server already carries SSF spans as *payload* (ssf/, trace/,
+span sinks); this module turns the same span plane into the pipeline's
+own observability instrument.  Three pieces:
+
+  * ``FlightRecorder`` — an always-on bounded ring of finished spans.
+    It is both a span SINK (``ingest``; installed on the server's span
+    pipeline next to the metric-extraction sink) and a duck-typed trace
+    CLIENT (``record``; the proxy — which has no span pipeline — hands
+    it straight to ``trace.Span(client=...)``).  Served at
+    ``/debug/trace?trace_id=...|last=N`` on both the server and the
+    proxy.
+
+  * ``DeterministicSampler`` — the per-flush-interval sampling decision.
+    Seeded and a pure function of (seed, interval), so every instance
+    configured alike samples the same intervals — a chaos run replays
+    bit-identically and a fleet-wide rate of 0.01 yields *coherent*
+    traces instead of per-tier coin flips.
+
+  * Trace-context propagation over gRPC metadata: one repeated
+    ``veneur-trace-ctx: <trace_id_hex>:<span_id_hex>`` entry per context
+    (a forward RPC carries exactly one — the attempt span that delivered
+    it; a proxy batch RPC may carry several, one per inbound RPC whose
+    metrics were coalesced into the batch).  Extraction tolerates
+    foreign metadata and malformed values (ignored, never raised).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+TRACE_CTX_KEY = "veneur-trace-ctx"
+
+DEFAULT_RING_CAPACITY = 512
+
+# 64-bit FNV-1a, the sampler's hash (seeded, stable across processes)
+_FNV_OFFSET = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_U64 = (1 << 64) - 1
+
+
+def _fnv1a_64(data: bytes, h: int = _FNV_OFFSET) -> int:
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _U64
+    return h
+
+
+class DeterministicSampler:
+    """Seeded per-interval head sampling: ``sample(interval)`` is a pure
+    function of (seed, interval), so the decision replays bit-identically
+    and agrees across every instance configured with the same seed."""
+
+    def __init__(self, rate: float = 1.0, seed: int = 0):
+        self.rate = min(1.0, max(0.0, float(rate)))
+        self.seed = int(seed)
+        # compare in integer space: threshold = rate * 2^64
+        self._threshold = int(self.rate * (_U64 + 1))
+
+    def sample(self, interval: int) -> bool:
+        if self.rate >= 1.0:
+            return True
+        if self._threshold <= 0:
+            return False
+        h = _fnv1a_64(int(interval).to_bytes(8, "little", signed=True),
+                      _fnv1a_64(self.seed.to_bytes(8, "little",
+                                                   signed=True)))
+        return h < self._threshold
+
+
+# -- gRPC metadata propagation ----------------------------------------------
+
+def ctx_metadata(trace_id: int, span_id: int) -> tuple:
+    """gRPC metadata carrying one trace context."""
+    return ((TRACE_CTX_KEY, f"{trace_id:x}:{span_id:x}"),)
+
+
+def ctxs_metadata(ctxs) -> Optional[tuple]:
+    """Metadata carrying several contexts (one repeated entry each);
+    None when there is nothing to carry (grpc accepts metadata=None)."""
+    if not ctxs:
+        return None
+    return tuple((TRACE_CTX_KEY, f"{tid:x}:{sid:x}") for tid, sid in ctxs)
+
+
+def extract_contexts(metadata) -> list[tuple[int, int]]:
+    """All (trace_id, parent span_id) contexts in a metadata sequence.
+    Foreign keys and malformed values are ignored — an instrumented peer
+    must never be able to fault the import path with a bad header."""
+    out: list[tuple[int, int]] = []
+    for entry in (metadata or ()):
+        try:
+            key, value = entry[0], entry[1]
+            if key != TRACE_CTX_KEY:
+                continue
+            tid_s, _, sid_s = str(value).partition(":")
+            tid, sid = int(tid_s, 16), int(sid_s, 16)
+            if tid and sid:
+                out.append((tid, sid))
+        except (ValueError, IndexError, TypeError):
+            continue
+    return out
+
+
+def continue_span(name: str, trace_id: int, parent_id: int, *,
+                  client=None, service: str = "veneur_tpu",
+                  tags: Optional[dict] = None,
+                  start_ns: Optional[int] = None):
+    """A span continuing a propagated context (the server-side half of
+    extract: same trace_id, parent = the remote span)."""
+    from veneur_tpu import trace as trace_mod
+    span = trace_mod.Span(name, service=service, client=client,
+                          tags=tags)
+    span.trace_id = int(trace_id)
+    span.parent_id = int(parent_id)
+    if start_ns is not None:
+        span.start_ns = int(start_ns)
+    return span
+
+
+def event_span(recorder, name: str, tags: dict,
+               service: str = "veneur_tpu") -> None:
+    """Record a point-in-time operational event (breaker transition) as
+    a zero-duration root span.  No-op without a recorder."""
+    if recorder is None:
+        return
+    from veneur_tpu import trace as trace_mod
+    span = trace_mod.Span(name, service=service,
+                          tags={k: str(v) for k, v in tags.items()})
+    span.end_ns = span.start_ns
+    recorder.record(span.to_proto())
+
+
+def span_record(span) -> dict:
+    """Flatten an SSFSpan proto into the ring's JSON-able record."""
+    end_ns = span.end_timestamp or span.start_timestamp
+    return {
+        "trace_id": int(span.trace_id),
+        "span_id": int(span.id),
+        "parent_id": int(span.parent_id),
+        "name": span.name,
+        "service": span.service,
+        "start_ns": int(span.start_timestamp),
+        "duration_ms": round(
+            max(0, end_ns - span.start_timestamp) / 1e6, 3),
+        "error": bool(span.error),
+        "tags": dict(span.tags),
+    }
+
+
+class FlightRecorder:
+    """Always-on bounded ring of finished trace spans (newest last).
+
+    Dual protocol: a span SINK (``ingest``/``name``/``start``/``flush``,
+    so the server installs it on the span pipeline like any sink) and a
+    trace CLIENT (``record``, so ``trace.Span(client=recorder)`` submits
+    synchronously — the proxy's path, which has no span pipeline).
+    Metrics-only spans (``trace.report`` wrappers, trace_id 0) are not
+    trace data and are skipped."""
+
+    def __init__(self, capacity: int = DEFAULT_RING_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.total_recorded = 0
+
+    # span-sink protocol (sinks.BaseSpanSink shape)
+    def name(self) -> str:
+        return "flight_recorder"
+
+    def kind(self) -> str:
+        return "flight_recorder"
+
+    def start(self, traceclient=None) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        if not span.trace_id or not span.id:
+            return      # metrics-only carrier span, not trace data
+        rec = span_record(span)
+        with self._lock:
+            self._ring.append(rec)
+            self.total_recorded += 1
+
+    # trace-client protocol (trace.Client duck type)
+    def record(self, span) -> None:
+        self.ingest(span)
+
+    def record_span(self, span) -> None:
+        """Proto-free fast path for the server's own synthesized spans
+        (flush segment children): the ring record is built straight
+        from the live trace.Span object — to_proto() costs ~30us of
+        protobuf field sets per span, which at ~10 spans per flush
+        would tax the flush p50 the tracing exists to measure."""
+        if not span.trace_id or not span.span_id:
+            return
+        end_ns = span.end_ns or time.time_ns()
+        rec = {
+            "trace_id": int(span.trace_id),
+            "span_id": int(span.span_id),
+            "parent_id": int(span.parent_id),
+            "name": span.name,
+            "service": span.service,
+            "start_ns": int(span.start_ns),
+            "duration_ms": round(
+                max(0, end_ns - span.start_ns) / 1e6, 3),
+            "error": bool(span.error),
+            "tags": dict(span.tags),
+        }
+        with self._lock:
+            self._ring.append(rec)
+            self.total_recorded += 1
+
+    # queries (the /debug/trace surface + the testbed assembler)
+    def snapshot(self, last: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            recs = list(self._ring)
+        if last is not None and last >= 0:
+            recs = recs[-last:] if last else []
+        return [dict(r) for r in recs]
+
+    def trace(self, trace_id: int) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._ring
+                    if r["trace_id"] == trace_id]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+def parse_trace_id(s: str) -> int:
+    """/debug/trace?trace_id= accepts decimal or hex (with/without 0x —
+    the ids in metadata and reports render as bare hex)."""
+    s = s.strip().lower()
+    if s.startswith("0x"):
+        return int(s, 16)
+    try:
+        return int(s)
+    except ValueError:
+        return int(s, 16)
+
+
+def debug_trace_body(recorder: FlightRecorder, query: dict) -> dict:
+    """The shared /debug/trace handler body (server + proxy HTTP
+    surfaces): ?trace_id= filters to one trace, ?last=N tails the ring.
+    Raises ValueError on malformed parameters (handlers reply 400)."""
+    if "trace_id" in query:
+        tid = parse_trace_id(query["trace_id"][0])
+        spans = recorder.trace(tid)
+    else:
+        last = int(query["last"][0]) if "last" in query else None
+        spans = recorder.snapshot(last)
+    return {
+        "capacity": recorder.capacity,
+        "recorded_total": recorder.total_recorded,
+        "spans": spans,
+    }
+
+
+def now_ns() -> int:
+    return time.time_ns()
