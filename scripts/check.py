@@ -95,6 +95,27 @@ def main() -> int:
                         "PASS" if reshard_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3b. one crash-durability cell (ISSUE 10): kill a global with no
+    # drain mid-run — the local's retries must exhaust into the durable
+    # spool, the revived global must restore its dedup ledger from the
+    # checkpoint, the replayer must re-deliver, and an injected
+    # duplicate delivery must merge exactly once (conservation EXACT
+    # under crash+replay; the full 3-arm matrix is
+    # `scripts/dryrun_3tier.py --chaos all` or the slow pytest arm)
+    crash_rc = 0
+    if args.fast:
+        results.append(("crash chaos cell", "SKIP", 0.0))
+    else:
+        t0 = stage("crash chaos cell (global-crash-with-spill-replay)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        crash_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py",
+             "--chaos-only", "global-crash-with-spill-replay"],
+            env=env)
+        results.append(("crash chaos cell",
+                        "PASS" if crash_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -113,7 +134,8 @@ def main() -> int:
     print("\n=== check: summary " + "=" * 40)
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
-    rc = 1 if (lint_rc or native_rc or reshard_rc or test_rc) else 0
+    rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
+               or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

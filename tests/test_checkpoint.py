@@ -1,0 +1,388 @@
+"""Sketch checkpoint/restore (ISSUE 10): arena snapshot/restore
+bit-parity for every sampler family, the atomic-rename crash window,
+corrupt-file cold starts, cardinality-guard (rollup identity) survival,
+server-level resume, and the dedup ledger riding the checkpoint."""
+
+import os
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core import checkpoint as ckpt_mod
+from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric
+
+
+def _metric(name, mtype, value, tags=(), rate=1.0, scope=None):
+    m = UDPMetric(name=name, type=mtype, value=value,
+                  sample_rate=rate)
+    if scope is not None:
+        m.scope = scope
+    m.update_tags(list(tags), None)
+    return m
+
+
+def _mk_agg(**kw):
+    kw.setdefault("percentiles", [0.5, 0.9, 0.99])
+    kw.setdefault("is_local", True)
+    kw.setdefault("count_unique_timeseries", True)
+    return MetricAggregator(**kw)
+
+
+def _feed_all_families(agg, n=80):
+    for i in range(n):
+        agg.process_metric(_metric(f"ck.c{i % 5}", sm.TYPE_COUNTER, 3))
+        agg.process_metric(_metric(f"ck.g{i % 3}", sm.TYPE_GAUGE,
+                                   float(i)))
+        agg.process_metric(_metric(f"ck.h{i % 4}", sm.TYPE_HISTOGRAM,
+                                   float(i) * 1.7, rate=0.5))
+        agg.process_metric(_metric(f"ck.t{i % 2}", sm.TYPE_TIMER,
+                                   float(i) / 3.0))
+        agg.process_metric(_metric("ck.s0", sm.TYPE_SET, f"member{i}"))
+    agg.process_metric(_metric("ck.status", sm.TYPE_STATUS, 1.0))
+    # an imported digest + HLL, so the restore covers merge state too
+    agg.import_metric(sm.ForwardMetric(
+        name="ck.h0", tags=[], kind=sm.TYPE_HISTOGRAM,
+        scope=MetricScope.MIXED, digest_means=[1.0, 5.0, 9.0],
+        digest_weights=[2.0, 1.0, 4.0], digest_min=0.5,
+        digest_max=9.5, digest_rsum=3.25))
+
+
+def _emissions(res):
+    return sorted((m.name, m.type, repr(m.value), tuple(m.tags))
+                  for m in res.metrics)
+
+
+def _forwards(res):
+    return sorted((f.name, f.kind, repr(f.counter_value),
+                   repr(f.gauge_value),
+                   tuple(np.round(f.digest_means, 12))
+                   if f.digest_means else ())
+                  for f in res.forward)
+
+
+def _roundtrip(tmp_path, agg, mk=None):
+    meta, arrays = agg.checkpoint_state()
+    ckpt_mod.write_checkpoint(str(tmp_path), {"aggregator": meta},
+                              arrays)
+    m2, arr2 = ckpt_mod.read_checkpoint(str(tmp_path))
+    fresh = (mk or _mk_agg)()
+    fresh.restore_state(m2["aggregator"], arr2)
+    return fresh
+
+
+# -- bit-parity across every family ----------------------------------------
+
+def test_snapshot_restore_bit_parity_all_families(tmp_path):
+    agg = _mk_agg()
+    _feed_all_families(agg)
+    fresh = _roundtrip(tmp_path, agg)
+    assert fresh.processed == agg.processed
+    assert fresh.imported == agg.imported
+    # key tables restored at the exact rows (fingerprints are
+    # row-binding, so equality here is row-exactness)
+    for fam in MetricAggregator._FAMILIES:
+        a, b = getattr(agg, fam), getattr(fresh, fam)
+        assert b.kdict == a.kdict
+        assert b.key_checksum == a.key_checksum
+        assert b.keyset_checksum == a.keyset_checksum
+    ra = agg.flush(is_local=True)
+    rb = fresh.flush(is_local=True)
+    assert _emissions(rb) == _emissions(ra)
+    assert _forwards(rb) == _forwards(ra)
+    assert len(_emissions(ra)) > 0 and len(_forwards(ra)) > 0
+    assert rb.unique_ts == ra.unique_ts
+
+
+def test_restore_requires_fresh_arena(tmp_path):
+    agg = _mk_agg()
+    _feed_all_families(agg, n=5)
+    meta, arrays = agg.checkpoint_state()
+    ckpt_mod.write_checkpoint(str(tmp_path), {"aggregator": meta},
+                              arrays)
+    m2, arr2 = ckpt_mod.read_checkpoint(str(tmp_path))
+    dirty = _mk_agg()
+    dirty.process_metric(_metric("other.c", sm.TYPE_COUNTER, 1))
+    with pytest.raises(RuntimeError, match="fresh arena"):
+        dirty.restore_state(m2["aggregator"], arr2)
+
+
+def test_mid_interval_staged_digest_points_survive(tmp_path):
+    """The crash window the arms prove: staged-but-unflushed digest
+    samples checkpoint as consolidated COO and restore bit-exactly."""
+    agg = _mk_agg()
+    rng = np.random.default_rng(3)
+    for v in rng.gamma(2.0, 10.0, 500):
+        agg.process_metric(_metric("ck.mid", sm.TYPE_HISTOGRAM,
+                                   float(v)))
+    fresh = _roundtrip(tmp_path, agg)
+    ra, rb = agg.flush(is_local=True), fresh.flush(is_local=True)
+    assert _forwards(rb) == _forwards(ra)
+
+
+# -- the atomic-rename crash window ----------------------------------------
+
+def test_crash_mid_write_keeps_previous_checkpoint(tmp_path):
+    agg = _mk_agg()
+    _feed_all_families(agg, n=10)
+    meta, arrays = agg.checkpoint_state()
+    ckpt_mod.write_checkpoint(str(tmp_path), {"aggregator": meta,
+                                              "gen": 1}, arrays)
+    # a crash mid-write of generation 2: the tempfile exists with
+    # partial bytes but was never renamed
+    f, tmp = ckpt_mod.open_checkpoint_tmp(str(tmp_path))
+    f.write(b"partial garbage that never got renamed")
+    f.close()
+    loaded = ckpt_mod.read_checkpoint(str(tmp_path))
+    assert loaded is not None and loaded[0]["gen"] == 1
+    # discard cleans the tempfile on the error path
+    f2, tmp2 = ckpt_mod.open_checkpoint_tmp(str(tmp_path))
+    ckpt_mod.discard_checkpoint(f2, tmp2)
+    assert not os.path.exists(tmp2)
+
+
+def test_corrupt_checkpoint_is_cold_start_not_crash(tmp_path):
+    path = ckpt_mod.checkpoint_path(str(tmp_path))
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(b"not an npz at all")
+    assert ckpt_mod.read_checkpoint(str(tmp_path)) is None
+    assert ckpt_mod.read_checkpoint(str(tmp_path / "missing")) is None
+
+
+# -- cardinality guard: rollup identity survives ---------------------------
+
+def test_rollup_identity_survives_checkpoint_restore(tmp_path):
+    mk = lambda: _mk_agg(cardinality_key_budget=3,
+                         count_unique_timeseries=False)
+    agg = mk()
+    tags = ["tenant:hog"]
+    for i in range(10):
+        agg.process_metric(_metric(f"ck.k{i}", sm.TYPE_COUNTER, 1,
+                                   tags=tags))
+    snap = agg.cardinality.snapshot()
+    assert snap["tenants_over_budget"] == 1
+    fresh = _roundtrip(tmp_path, agg, mk=mk)
+    g = fresh.cardinality
+    assert g.epoch == agg.cardinality.epoch
+    assert g.snapshot()["tenants"]["hog"]["exact_keys"] == 3
+    # the restored guard keeps folding NEW tail keys into the SAME
+    # rollup identity (no budget re-learning, no identity drift)
+    fresh.process_metric(_metric("ck.k99", sm.TYPE_COUNTER, 5,
+                                 tags=tags))
+    res = fresh.flush(is_local=True)
+    rollups = [m for m in res.metrics
+               if m.name == "veneur.rollup.counter"]
+    assert rollups and "veneur_rollup:true" in rollups[0].tags
+    # restored tail mass (7 rolled sightings pre-crash) + the new one
+    assert rollups[0].value == 12.0
+
+
+# -- import-edge budget (the PR-6 known gap) -------------------------------
+
+def test_import_edge_enforces_tenant_budget():
+    """Locals-direct-to-global fleets: the budget applies on the gRPC
+    import path too — an over-budget tenant's imported tail folds into
+    the rollup instead of growing the global's arenas."""
+    agg = _mk_agg(is_local=False, cardinality_key_budget=3,
+                  count_unique_timeseries=False)
+    for i in range(12):
+        agg.import_metric(sm.ForwardMetric(
+            name=f"imp.c{i}", tags=["tenant:hog"],
+            kind=sm.TYPE_COUNTER, scope=MetricScope.GLOBAL_ONLY,
+            counter_value=2))
+    snap = agg.cardinality.snapshot()
+    assert snap["tenants_over_budget"] == 1
+    assert snap["rollup_points"] == 9            # 12 sightings - budget
+    # arena stays bounded: 3 exact rows + 1 rollup row
+    assert len(agg.counters.kdict) == 4
+    res = agg.flush(is_local=False)
+    got = {m.name: m.value for m in res.metrics
+           if m.type == "counter"}
+    # mass conserved exactly: 3 exact keys *2 each + rollup carries 18
+    assert got["veneur.rollup.counter"] == 18.0
+    assert sum(got.values()) == 24.0
+
+
+def test_import_edge_budget_via_payload_path():
+    """The raw-bytes V1 payload path applies the same defense (the
+    native wire scan is bypassed when the guard is armed, since it
+    cannot see tags)."""
+    from veneur_tpu.protocol import forward_pb2, metric_pb2
+    agg = _mk_agg(is_local=False, cardinality_key_budget=2,
+                  count_unique_timeseries=False)
+    pbs = []
+    for i in range(8):
+        pb = metric_pb2.Metric(name=f"imp.p{i}", tags=["tenant:hog"],
+                               type=metric_pb2.Counter)
+        pb.counter.value = 1
+        pbs.append(pb)
+    payload = forward_pb2.MetricList(metrics=pbs).SerializeToString()
+    ok, failed = agg.import_payload(payload)
+    assert (ok, failed) == (8, 0)
+    assert len(agg.counters.kdict) == 3          # 2 exact + rollup
+    res = agg.flush(is_local=False)
+    got = {m.name: m.value for m in res.metrics if m.type == "counter"}
+    assert got["veneur.rollup.counter"] == 6.0
+    assert sum(got.values()) == 8.0
+
+
+# -- server-level resume ----------------------------------------------------
+
+def test_server_checkpoint_and_crash_resume(tmp_path):
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+
+    def boot():
+        return Server(config_mod.Config(
+            interval=10.0, percentiles=[0.5],
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            hostname="ckpt-test"))
+
+    a = boot()
+    a.start()
+    try:
+        for i in range(20):
+            a.aggregator.process_metric(
+                _metric("srv.c0", sm.TYPE_COUNTER, 1))
+        a.flush()
+        for i in range(7):
+            a.aggregator.process_metric(
+                _metric("srv.c1", sm.TYPE_COUNTER, 1))
+        assert a.checkpoint_now()
+        assert a.checkpoint_stats["writes"] == 1
+        # timeline carries the checkpoint event
+        events = [r for r in a.flush_timeline.snapshot()
+                  if r.get("event") == "checkpoint"]
+        assert events and events[0]["checkpoint_bytes"] > 0
+    finally:
+        a.crash()       # no shutdown checkpoint, no final flush
+
+    b = boot()
+    b.start()
+    try:
+        assert b.checkpoint_stats["restores"] == 1
+        assert b.checkpoint_stats["age_ms"] >= 0.0
+        assert b.flush_count == 1                # interval RESUMED
+        restores = [r for r in b.flush_timeline.snapshot()
+                    if r.get("event") == "restore"]
+        assert restores
+        res = b.aggregator.flush(is_local=False)
+        got = {m.name: m.value for m in res.metrics
+               if m.type == "counter" and m.name.startswith("srv.")}
+        # only the mid-interval ingest since the last flush remains
+        # (self-telemetry counters from the flush span may ride along)
+        assert got == {"srv.c1": 7.0}
+    finally:
+        b.shutdown()
+
+
+def test_stale_checkpoint_skipped_after_later_flush(tmp_path):
+    """A checkpoint written BEFORE a flush that completed must not
+    restore its arenas: that data was already forwarded/emitted, and a
+    revived sender would re-deliver it under a fresh boot nonce the
+    dedup ledger cannot match — the restore skips the arenas (honest
+    crash-window loss), resumes the interval count, and counts the
+    skip."""
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+
+    def boot():
+        return Server(config_mod.Config(
+            interval=10.0, percentiles=[0.5],
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            hostname="stale-test"))
+
+    a = boot()
+    a.start()
+    try:
+        for _ in range(9):
+            a.aggregator.process_metric(
+                _metric("st.c0", sm.TYPE_COUNTER, 1))
+        assert a.checkpoint_now()          # checkpoint at interval 0
+        a.flush()                          # flush 1 DELIVERS st.c0
+    finally:
+        a.crash()
+
+    b = boot()
+    b.start()
+    try:
+        # arenas NOT restored (re-emitting st.c0 would double-count);
+        # the interval count resumed from the flush marker
+        assert b.checkpoint_stats["restores"] == 0
+        assert b.checkpoint_stats["stale_skips"] == 1
+        assert b.flush_count == 1
+        res = b.aggregator.flush(is_local=False)
+        assert not [m for m in res.metrics if m.name == "st.c0"]
+    finally:
+        b.shutdown()
+
+
+def test_dedup_duplicate_waits_for_inflight_original():
+    """A duplicate delivery must not be acked while the original
+    import of the same chunk is still in flight — if the original
+    fails, the duplicate (arriving later) must perform the import."""
+    import threading
+    from veneur_tpu.sources.proxy import DedupLedger
+
+    led = DedupLedger()
+    release = threading.Event()
+    outcome = {}
+
+    def slow_failing_import():
+        release.wait(5.0)
+        raise RuntimeError("original import dies")
+
+    def original():
+        try:
+            led.run_once(("s", 1, 0), slow_failing_import)
+        except RuntimeError:
+            outcome["original"] = "failed"
+
+    t = threading.Thread(target=original)
+    t.start()
+    import time as time_mod
+    time_mod.sleep(0.1)            # original is parked in import_fn
+    done = []
+
+    def duplicate():
+        res, dup = led.run_once(("s", 1, 0), lambda: done.append(1))
+        outcome["dup_flag"] = dup
+
+    t2 = threading.Thread(target=duplicate)
+    t2.start()
+    time_mod.sleep(0.1)
+    assert not done                # duplicate is WAITING, not acked
+    release.set()
+    t.join(5.0)
+    t2.join(5.0)
+    assert outcome["original"] == "failed"
+    # the original failed -> the "duplicate" performed the import
+    assert outcome["dup_flag"] is False and done == [1]
+    assert led.duplicates == 0
+
+
+def test_dedup_ledger_snapshot_restore_and_window():
+    from veneur_tpu.sources.proxy import DedupLedger
+    led = DedupLedger(window=16)
+    hits = []
+    for i in range(5):
+        led.run_once(("src", 1, i), lambda: hits.append(1))
+    assert len(hits) == 5
+    _, dup = led.run_once(("src", 1, 2), lambda: hits.append(1))
+    assert dup and len(hits) == 5
+    # None identity always imports (unidentified senders)
+    led.run_once(None, lambda: hits.append(1))
+    led.run_once(None, lambda: hits.append(1))
+    assert len(hits) == 7
+    state = led.snapshot()
+    led2 = DedupLedger(window=16)
+    led2.restore(state)
+    _, dup2 = led2.run_once(("src", 1, 4), lambda: hits.append(1))
+    assert dup2 and len(hits) == 7
+    # bounded window: old identities eventually evict
+    for i in range(40):
+        led2.run_once(("src", 2, i), lambda: None)
+    _, dup3 = led2.run_once(("src", 1, 4), lambda: hits.append(1))
+    assert not dup3

@@ -16,6 +16,7 @@ from veneur_tpu.testbed import (CHAOS_ARMS, PROMISED_KEYS,
                                 TOPOLOGY_ARMS, arm_by_name,
                                 run_chaos_arm, run_dryrun)
 from veneur_tpu.testbed import verify
+from veneur_tpu.testbed.chaos import CRASH_ARMS
 
 
 @pytest.fixture(autouse=True)
@@ -114,6 +115,11 @@ def test_dryrun_report_carries_cardinality_and_reshard_keys():
     assert report["cardinality"] == {
         "keys_evicted": 0, "tenants_over_budget": 0, "rollup_points": 0}
     assert report["reshard_moved"] == 0
+    # ISSUE-10 satellite: the crash-durability ledgers are promised
+    # keys too — present and zero when the run has no durable dirs
+    assert report["spool"] == {"spilled": 0, "replayed": 0,
+                               "expired": 0}
+    assert report["checkpoint"] == {"restores": 0, "age_ms": 0.0}
     assert report["ok"]
 
 
@@ -146,6 +152,61 @@ def test_topology_cell_cardinality_storm_stays_under_budget():
     # the defense's point: emitted tail cardinality >> live arena rows
     assert row["tail_keys_emitted"] > 4 * max(row["digest_rows_live"])
     assert row["ok"], row
+
+
+def test_crash_cell_local_crash_restores_and_conserves():
+    """One non-slow crash cell (ISSUE 10): ingest an interval into the
+    local, checkpoint, kill -9 (no drain), revive from disk, flush —
+    conservation at the global tier stays EXACT because the checkpoint
+    carried the arenas, the staged mid-interval samples AND the
+    interval count."""
+    row = run_chaos_arm(arm_by_name("local-crash-mid-interval"), seed=6)
+    assert row["arm"] == "local-crash-mid-interval"
+    assert row["fired"] >= 1                      # checkpoint restores
+    assert row["checkpoint"]["restores"] >= 1
+    assert row["conserved"] and row["counter_deficit"] == 0.0
+    assert row["routing_exclusive"] and row["dropped_total"] == 0
+    assert row["ok"], row
+
+
+def test_crash_cell_global_crash_spill_replay_dedups():
+    """One non-slow crash cell: the global dies mid-run (direct mode —
+    the local's forward edge takes the outage), retries exhaust into
+    the durable spool, the revived global restores its dedup ledger
+    from the checkpoint, the replayer re-delivers, and an INJECTED
+    duplicate delivery of a replayed chunk merges exactly once."""
+    row = run_chaos_arm(arm_by_name("global-crash-with-spill-replay"),
+                        seed=6)
+    assert row["spool"]["spilled"] > 0
+    assert row["spool"]["replayed"] == row["spool"]["spilled"]
+    assert row["spool_closure"]
+    assert row["ledger_restored"] > 0             # survived the crash
+    assert row["duplicates_skipped"] >= 1         # merged ONCE
+    assert row["conserved"] and row["counter_deficit"] == 0.0
+    assert row["ok"], row
+
+
+@pytest.mark.slow
+def test_chaos_matrix_crash_arms():
+    """The full crash matrix, traced: local-crash and
+    global-crash-with-spill-replay conserve exactly; spool-expiry
+    accounts every lost point in spool.expired; every settled interval
+    still assembles into ONE complete trace across the crash."""
+    rows = [run_chaos_arm(arm, seed=4, trace=True)
+            for arm in CRASH_ARMS]
+    failed = [r for r in rows if not r["ok"]]
+    assert not failed, failed
+    by_name = {r["arm"]: r for r in rows}
+    assert by_name["crash-with-spool-expiry"]["spool"]["expired_points"] > 0
+    assert not by_name["crash-with-spool-expiry"]["conserved"]
+    assert by_name["crash-with-spool-expiry"]["no_silent_loss"]
+    for r in rows:
+        assert r["trace_orphans"] == 0, r
+        assert r["spool_closure"], r
+        if r["arm"] != "crash-with-spool-expiry":
+            # the expiry arm's lost interval legitimately cannot form
+            # a complete trace (delivery never happened)
+            assert r["trace_complete"], r
 
 
 @pytest.mark.slow

@@ -315,6 +315,112 @@ def test_span_ownership_handoff_is_quiet(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# resource-pairing: spool segment + checkpoint tempfile (ISSUE-10)
+# ---------------------------------------------------------------------------
+
+SPOOL_SEGMENT_LEAK = """
+from veneur_tpu.forward.spool import open_segment, close_segment
+
+
+def spill(self, path, frame):
+    f = open_segment(path)
+    f.write(frame)               # raises (disk full) => handle leaks,
+    self.fsync_maybe(f)          # tail never fsynced: torn on recovery
+    close_segment(f)
+"""
+
+SPOOL_SEGMENT_FINALLY = """
+from veneur_tpu.forward.spool import open_segment, close_segment
+
+
+def spill(self, path, frame):
+    f = open_segment(path)
+    try:
+        f.write(frame)
+        self.fsync_maybe(f)
+    finally:
+        close_segment(f)
+"""
+
+SPOOL_SEGMENT_ESCAPE = """
+from veneur_tpu.forward.spool import open_segment
+
+
+def rotate(self, path, seq):
+    f = open_segment(path)
+    self._active = (seq, f, 0)   # ownership moves to the spool object
+    return seq, f
+"""
+
+CHECKPOINT_TMP_LEAK = """
+from veneur_tpu.core.checkpoint import (open_checkpoint_tmp,
+                                        commit_checkpoint)
+
+
+def write(self, directory, data, final):
+    f, tmp = open_checkpoint_tmp(directory)
+    f.write(data)                # raises => tmp file stranded: the
+    commit_checkpoint(f, tmp, final)   # write was never atomic
+"""
+
+CHECKPOINT_TMP_DISCARD_ON_ERROR = """
+from veneur_tpu.core.checkpoint import (open_checkpoint_tmp,
+                                        commit_checkpoint,
+                                        discard_checkpoint)
+
+
+def write(self, directory, data, final):
+    f, tmp = open_checkpoint_tmp(directory)
+    try:
+        f.write(data)
+    except BaseException:
+        discard_checkpoint(f, tmp)
+        raise
+    commit_checkpoint(f, tmp, final)
+"""
+
+
+def test_spool_segment_leak_fires(tmp_path):
+    """An open_segment whose close sits only on the fall-through path
+    leaks the fd AND leaves the tail un-fsynced — the crash-recovery
+    scan then reads a torn record."""
+    report = lint_source(tmp_path, SPOOL_SEGMENT_LEAK)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "spool segment handle" in hits[0].message
+
+
+def test_spool_segment_finally_is_quiet(tmp_path):
+    report = lint_source(tmp_path, SPOOL_SEGMENT_FINALLY)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_spool_segment_ownership_escape_is_quiet(tmp_path):
+    """The production shape: the active segment handle is stored on
+    the spool object, whose settle/close paths own the release."""
+    report = lint_source(tmp_path, SPOOL_SEGMENT_ESCAPE)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_checkpoint_tmp_leak_fires(tmp_path):
+    """A checkpoint tempfile that can strand without rename-or-unlink
+    is a NON-ATOMIC checkpoint write — the crash-window bug the format
+    exists to prevent."""
+    report = lint_source(tmp_path, CHECKPOINT_TMP_LEAK)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "checkpoint tempfile" in hits[0].message
+
+
+def test_checkpoint_tmp_discard_on_error_is_quiet(tmp_path):
+    report = lint_source(tmp_path, CHECKPOINT_TMP_DISCARD_ON_ERROR)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
 # prewarm-parity — the PR-3 in-flush recompile
 # ---------------------------------------------------------------------------
 

@@ -93,6 +93,21 @@ PAIRS = (
     PairSpec("trace span",
              frozenset({"start_span", "span", "child"}),
              frozenset({"finish"})),
+    # durable-spool segment handle (forward/spool.py): an open_segment
+    # that can leak on an error path strands an fd AND leaves the
+    # segment's tail un-fsynced — the crash-recovery scan then reads a
+    # torn record where a graceful close would have committed it
+    PairSpec("spool segment handle",
+             frozenset({"open_segment"}),
+             frozenset({"close_segment"})),
+    # checkpoint tempfile (core/checkpoint.py): the atomic-rename
+    # contract — every open_checkpoint_tmp must end in commit (fsync +
+    # os.replace) or discard (unlink); a leaked tempfile is a
+    # non-atomic checkpoint write, the exact crash-window bug the
+    # format exists to prevent
+    PairSpec("checkpoint tempfile",
+             frozenset({"open_checkpoint_tmp"}),
+             frozenset({"commit_checkpoint", "discard_checkpoint"})),
 )
 
 

@@ -91,6 +91,32 @@ class Config:
     # forward.dropped_total / /debug/vars, never silent
     forward_max_retries: int = 2
     forward_retry_backoff: float = 0.05   # base backoff ("50ms", doubles)
+    # crash durability (forward/spool.py + core/checkpoint.py).
+    # spool_dir != "": when the bounded retries exhaust, provably-
+    # chunked V1 payloads spill to an on-disk segment spool (length-
+    # prefixed, CRC32-per-record) and a background replayer re-delivers
+    # them oldest-first when the destination recovers — under the SAME
+    # chunk identity, so the global's dedup ledger merges each chunk
+    # exactly once even across crashes on either side.  Bounded by
+    # spool_max_bytes / spool_max_age; expiry is visibly-accounted loss
+    # (/debug/vars -> spool, forward.spool.* self-metrics), never
+    # silent.
+    spool_dir: str = ""                  # "" = spool off
+    spool_max_bytes: int = 64 * 1024 * 1024
+    spool_max_age: float = 600.0         # oldest record kept ("10m")
+    spool_fsync: str = "rotate"          # always | rotate | never
+    spool_replay_interval: float = 0.5   # replay tick ("500ms")
+    spool_segment_max_bytes: int = 4 * 1024 * 1024
+    # per-source identity window of the global tier's dedup ledger
+    spool_dedup_window: int = 4096
+    # checkpoint_dir != "": periodic (checkpoint_interval > 0) and
+    # shutdown snapshots of every arena — dense registers, key tables,
+    # staged digest points, cardinality quota state, the dedup ledger —
+    # to an atomic-rename file; on boot the server restores and resumes
+    # the interval, so a hard crash loses at most one checkpoint period
+    # of ingest instead of everything.
+    checkpoint_dir: str = ""             # "" = checkpointing off
+    checkpoint_interval: float = 0.0     # 0 = shutdown/manual only
     stats_address: str = ""         # self-metrics statsd target
 
     # aggregation
@@ -287,6 +313,10 @@ class Config:
             self.forward_max_retries = 0
         if self.forward_retry_backoff < 0:
             self.forward_retry_backoff = 0.0
+        if self.spool_fsync not in ("always", "rotate", "never"):
+            raise ValueError(
+                f"spool_fsync must be always|rotate|never, "
+                f"got {self.spool_fsync!r}")
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
         if self.read_buffer_size_bytes <= 0:
@@ -320,7 +350,8 @@ class Config:
 _LIST_FIELDS_OF_FLOAT = {"percentiles"}
 # fields accepting Go-style duration strings ("10s", "500ms")
 _DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval",
-                    "forward_retry_backoff"}
+                    "forward_retry_backoff", "spool_max_age",
+                    "spool_replay_interval", "checkpoint_interval"}
 
 
 def _coerce(key: str, value: Any) -> Any:

@@ -309,21 +309,26 @@ class MetricAggregator:
         with self.lock:
             self.imported += 1
             if fm.kind == sm.TYPE_COUNTER:
-                row = self.counters.row_for(key, MetricScope.GLOBAL_ONLY,
-                                            fm.tags)
+                key, cls, tags = self._card_resolve(
+                    key, MetricScope.GLOBAL_ONLY, fm.tags)
+                row = self.counters.row_for(key, cls, tags)
                 self.counters.merge(row, fm.counter_value)
             elif fm.kind == sm.TYPE_GAUGE:
-                row = self.gauges.row_for(key, MetricScope.GLOBAL_ONLY,
-                                          fm.tags)
+                key, cls, tags = self._card_resolve(
+                    key, MetricScope.GLOBAL_ONLY, fm.tags)
+                row = self.gauges.row_for(key, cls, tags)
                 self.gauges.merge(row, fm.gauge_value)
             elif fm.kind == sm.TYPE_SET:
-                row = self.sets.row_for(key, MetricScope.MIXED, fm.tags)
+                key, cls, tags = self._card_resolve(
+                    key, MetricScope.MIXED, fm.tags)
+                row = self.sets.row_for(key, cls, tags)
                 self.sets.merge(row, fm.hll)
             elif fm.kind in (sm.TYPE_HISTOGRAM, sm.TYPE_TIMER):
                 cls = (MetricScope.GLOBAL_ONLY
                        if scope == MetricScope.GLOBAL_ONLY
                        else MetricScope.MIXED)
-                row = self.digests.row_for(key, cls, fm.tags)
+                key, cls, tags = self._card_resolve(key, cls, fm.tags)
+                row = self.digests.row_for(key, cls, tags)
                 self.digests.merge_digest(
                     row, fm.digest_means or [], fm.digest_weights or [],
                     fm.digest_min, fm.digest_max, fm.digest_rsum)
@@ -379,27 +384,38 @@ class MetricAggregator:
                             f"type/value mismatch: type={pb.type} "
                             f"carrying {which}")
                     if which == "counter":
-                        ck = (pb.name, tuple(pb.tags), 0)
-                        row = cache.get(ck)
+                        # guard armed: no identity cache at all — every
+                        # record must pass through resolve() for touch
+                        # accounting, and caching raw identities during
+                        # a storm would itself be the unbounded growth
+                        # the guard bounds
+                        ck = ((pb.name, tuple(pb.tags), 0)
+                              if self.cardinality is None else None)
+                        row = cache.get(ck) if ck is not None else None
                         if row is None:
                             tags = list(pb.tags)
-                            row = counters.row_for(
+                            key, cls, tags = self._card_resolve(
                                 MetricKey(pb.name, sm.TYPE_COUNTER,
                                           ",".join(sorted(tags))),
                                 MetricScope.GLOBAL_ONLY, tags)
-                            cache[ck] = row
+                            row = counters.row_for(key, cls, tags)
+                            if ck is not None:
+                                cache[ck] = row
                         c_rows.append(row)
                         c_vals.append(pb.counter.value)
                     elif which == "gauge":
-                        ck = (pb.name, tuple(pb.tags), 1)
-                        row = cache.get(ck)
+                        ck = ((pb.name, tuple(pb.tags), 1)
+                              if self.cardinality is None else None)
+                        row = cache.get(ck) if ck is not None else None
                         if row is None:
                             tags = list(pb.tags)
-                            row = gauges.row_for(
+                            key, cls, tags = self._card_resolve(
                                 MetricKey(pb.name, sm.TYPE_GAUGE,
                                           ",".join(sorted(tags))),
                                 MetricScope.GLOBAL_ONLY, tags)
-                            cache[ck] = row
+                            row = gauges.row_for(key, cls, tags)
+                            if ck is not None:
+                                cache[ck] = row
                         g_rows.append(row)
                         g_vals.append(pb.gauge.value)
                     elif which in ("set", "histogram"):
@@ -431,18 +447,20 @@ class MetricAggregator:
         tags = list(pb.tags)
         joined = ",".join(sorted(tags))
         if which == "set":
-            row = self.sets.row_for(
+            key, cls, tags = self._card_resolve(
                 MetricKey(pb.name, sm.TYPE_SET, joined),
                 MetricScope.MIXED, tags)
+            row = self.sets.row_for(key, cls, tags)
             self.sets.merge(row, pb.set.hyper_log_log)
             return
         kind = (sm.TYPE_TIMER if pb.type == metric_pb2.Timer
                 else sm.TYPE_HISTOGRAM)
         cls = (MetricScope.GLOBAL_ONLY if pb.scope == metric_pb2.Global
                else MetricScope.MIXED)
-        dig = pb.histogram.t_digest
-        row = self.digests.row_for(
+        key, cls, tags = self._card_resolve(
             MetricKey(pb.name, kind, joined), cls, tags)
+        dig = pb.histogram.t_digest
+        row = self.digests.row_for(key, cls, tags)
         self.digests.merge_digest(
             row,
             [c.mean for c in dig.main_centroids],
@@ -458,7 +476,11 @@ class MetricAggregator:
         Falls back to import_pb_batch when the native engine is
         unavailable or rejects the payload."""
         scan = None
-        if self._native_import is not False:
+        # the native wire scan never materializes tags, which the
+        # per-tenant budget classifies on — with the guard armed on
+        # this (import) edge, every record takes the parsed path so
+        # locals-direct-to-global fleets get the same defense
+        if self._native_import is not False and self.cardinality is None:
             try:
                 from veneur_tpu import ingest as ingest_mod
                 ingest_mod.load_library()
@@ -576,6 +598,75 @@ class MetricAggregator:
             #   host staging consolidation, no device wait)
             self.sets.sync()
             return True
+
+    # -- crash checkpoint (core/checkpoint.py) -----------------------------
+
+    _FAMILIES = ("digests", "sets", "counters", "gauges", "status")
+
+    def checkpoint_state(self) -> tuple[dict, dict]:
+        """One coherent cut of every arena (plus unique-ts registers and
+        the cardinality quota ledger), taken under the aggregator lock
+        after folding staged samples — the write side of the crash
+        checkpoint.  Returns (JSON-able meta, numpy arrays); the disk
+        format is core/checkpoint.py's concern."""
+        with self.lock:
+            # vnlint: disable=blocking-propagation (arena sync is
+            #   host-side COO consolidation — asarray of host lists,
+            #   no device wait; same rationale as sync_staged)
+            self.digests.sync()
+            # vnlint: disable=blocking-propagation (same as above)
+            self.sets.sync()
+            meta: dict = {"processed": self.processed,
+                          "imported": self.imported,
+                          "families": {}}
+            arrays: dict = {}
+            # LOCK-HELD: C-speed captures only; the per-key Python
+            # rendering runs after release so ingest is never queued
+            # behind O(keys) row formatting
+            caps = {name: getattr(self, name).checkpoint_capture()
+                    for name in self._FAMILIES}
+            if self.unique_ts is not None:
+                arrays["unique_ts/regs"] = self.unique_ts.regs.copy()
+            if self.cardinality is not None:
+                # budget-bounded, not key-space-bounded: stays cheap
+                meta["cardinality"] = self.cardinality.checkpoint_state()
+        for name, cap in caps.items():
+            fmeta, farr = getattr(self, name).checkpoint_render(cap)
+            meta["families"][name] = fmeta
+            for k, v in farr.items():
+                arrays[f"{name}/{k}"] = v
+        return meta, arrays
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        """Rebuild the arenas from a checkpoint (fresh aggregator, at
+        boot before any listener runs): every sketch family restores
+        bit-exactly — same rows, same registers, same staged points —
+        so the flush after a crash emits what the flush before it would
+        have.  Every family PRECHECKS compatibility first (changed
+        sketch parameters raise CheckpointIncompatible before any
+        arena mutates — a clean cold start, never a half-restored
+        mix)."""
+        with self.lock:
+            per_family = {}
+            for name in self._FAMILIES:
+                fmeta = meta["families"][name]
+                prefix = f"{name}/"
+                farr = {k[len(prefix):]: v for k, v in arrays.items()
+                        if k.startswith(prefix)}
+                getattr(self, name).restore_precheck(fmeta, farr)
+                per_family[name] = (fmeta, farr)
+            for name, (fmeta, farr) in per_family.items():
+                getattr(self, name).restore_state(fmeta, farr)
+            self.processed = int(meta.get("processed", 0))
+            self.imported = int(meta.get("imported", 0))
+            uts = arrays.get("unique_ts/regs")
+            if (self.unique_ts is not None and uts is not None
+                    and uts.shape == self.unique_ts.regs.shape):
+                np.maximum(self.unique_ts.regs, uts,
+                           out=self.unique_ts.regs)
+            if (self.cardinality is not None
+                    and meta.get("cardinality") is not None):
+                self.cardinality.restore_state(meta["cardinality"])
 
     # -- flush -------------------------------------------------------------
 

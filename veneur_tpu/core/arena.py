@@ -56,6 +56,13 @@ HOT_DENSE_BUDGET = 1 << 23
 IDLE_GC_INTERVALS = 10
 
 
+class CheckpointIncompatible(ValueError):
+    """The checkpoint was written under a different sketch
+    configuration (set precision, digest compression): restoring it
+    would mix unmergeable state.  Raised by restore_precheck BEFORE
+    any arena mutates, so the caller can cold-start cleanly."""
+
+
 def _pow2(n: int) -> int:
     return 1 << (int(n) - 1).bit_length() if n > 1 else 1
 
@@ -114,6 +121,9 @@ class _ArenaBase:
         # membership analog) differs in BOTH and stays legal
         self.key_checksum = 0
         self.keyset_checksum = 0
+        # (key_checksum, rendered key-table arrays): the checkpoint
+        # writer's memo — a stable key table re-renders nothing
+        self._ckpt_render_cache = None
 
     def _fold_key_fingerprints(self, key: MetricKey, scope: MetricScope,
                                row: int) -> None:
@@ -236,6 +246,165 @@ class _ArenaBase:
             self.reset_rows(np.asarray(rows, np.int64))
         return len(rows)
 
+    # -- crash checkpoint (core/checkpoint.py) -----------------------------
+
+    def checkpoint_state(self) -> tuple[dict, dict]:
+        """(meta, arrays) snapshot of the key table + family state —
+        call under the aggregator lock, after sync().  Restoring the
+        pair into a FRESH arena reproduces rows bit-exactly (same row
+        indices, same registers/scalars/staging), which is what makes
+        the crash chaos arms' conservation checks EXACT rather than
+        approximate."""
+        return self.checkpoint_render(self.checkpoint_capture())
+
+    def checkpoint_capture(self) -> dict:
+        """The lock-held half of a checkpoint: C-speed copies only
+        (dict items list, fancy-indexed columns, family state arrays) —
+        the per-key Python rendering runs lock-free afterwards, so the
+        ingest path is never queued behind it."""
+        items = list(self.kdict.items())
+        rows = (np.fromiter((r for _, r in items), np.int64,
+                            len(items))
+                if items else np.zeros(0, np.int64))
+        extra: dict = {}
+        self._checkpoint_extra(extra)
+        return {"items": items,
+                "tags": (self.tags_col[rows].copy() if len(items)
+                         else np.empty(0, object)),
+                "rows": rows,
+                "idle": self.idle[rows].copy(),
+                "touched": self.touched[rows].copy(),
+                "capacity": int(self.capacity),
+                "key_checksum": self.key_checksum,
+                "arrays": self._checkpoint_arrays(),
+                "extra": extra}
+
+    def checkpoint_render(self, cap: dict) -> tuple[dict, dict]:
+        """The lock-free half: render the captured key table to numpy
+        string/int arrays (no per-key JSON — a 20k-row table rendered
+        as nested lists held the GIL long enough to tax concurrent
+        flushes).  The rendered table is CACHED on the arena's
+        incremental key fingerprint: a steady-state key table (the
+        production common case) re-renders nothing, so periodic
+        checkpoints cost array copies, not O(keys) Python.  MetricKey
+        fields are immutable and tags lists are never mutated in
+        place, so the captured refs stay coherent after the lock
+        releases."""
+        cached = self._ckpt_render_cache
+        # the checksum binds the key->row MAP but is order-insensitive
+        # (XOR fold): a GC + re-registration can return to the same
+        # checksum with a permuted kdict order, which would misalign
+        # the cached name/row arrays with this capture's idle/touched
+        # vectors — so a hit additionally requires elementwise row
+        # agreement (rows are unique, so equal rows in equal positions
+        # + an equal map pins every position to the same key)
+        if (cached is not None and cached[0] == cap["key_checksum"]
+                and np.array_equal(cached[1]["key_rows"],
+                                   cap["rows"])):
+            key_arrays = cached[1]
+        else:
+            items = cap["items"]
+            n = len(items)
+            names = [None] * n
+            types = [None] * n
+            jtags = [None] * n
+            scopes = np.zeros(n, np.int8)
+            for i, ((key, scope), _row) in enumerate(items):
+                names[i] = key.name
+                types[i] = key.type
+                jtags[i] = key.joined_tags
+                scopes[i] = int(scope)
+            def _str_arr(lst):
+                return (np.asarray(lst, dtype=np.str_) if lst
+                        else np.zeros(0, "<U1"))
+
+            key_arrays = {
+                "key_names": _str_arr(names),
+                "key_types": _str_arr(types),
+                "key_jtags": _str_arr(jtags),
+                # tags lists join on "," (a tag cannot carry a comma
+                # on the wire, and an empty-string tag cannot occur,
+                # so "" unambiguously encodes the empty list)
+                "key_tags": _str_arr(
+                    [",".join(t) if t else "" for t in cap["tags"]]),
+                "key_scopes": scopes,
+                "key_rows": cap["rows"],
+            }
+            self._ckpt_render_cache = (cap["key_checksum"], key_arrays)
+        arrays = dict(cap["arrays"])
+        arrays.update(key_arrays)
+        arrays["key_idle"] = cap["idle"]
+        arrays["key_touched"] = cap["touched"]
+        meta = {"capacity": cap["capacity"]}
+        meta.update(cap["extra"])
+        return meta, arrays
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        """Rebuild from a checkpoint into this (fresh) arena: rows land
+        at their recorded indices, fingerprints re-fold, the free list
+        excludes live rows."""
+        if self.kdict:
+            raise RuntimeError(
+                "checkpoint restore requires a fresh arena "
+                f"({len(self.kdict)} keys already registered)")
+        while self.capacity < int(meta["capacity"]):
+            self._grow()
+        used = set()
+        for name, mtype, jtags, scope_i, row, tags_joined, idle, \
+                touched in zip(arrays["key_names"],
+                               arrays["key_types"],
+                               arrays["key_jtags"],
+                               arrays["key_scopes"],
+                               arrays["key_rows"],
+                               arrays["key_tags"],
+                               arrays["key_idle"],
+                               arrays["key_touched"]):
+            key = MetricKey(str(name), str(mtype), str(jtags))
+            scope = MetricScope(int(scope_i))
+            row = int(row)
+            tags = (str(tags_joined).split(",") if tags_joined
+                    else [])
+            self.kdict[(key, scope)] = row
+            self.meta[row] = RowMeta(key=key, tags=list(tags),
+                                     scope=scope)
+            self.name_col[row] = key.name
+            self.tags_col[row] = list(tags)
+            if self.kind_col is not None:
+                self.kind_col[row] = key.type
+            self.scope_col[row] = int(scope)
+            self.idle[row] = int(idle)
+            self.touched[row] = bool(touched)
+            self._fold_key_fingerprints(key, scope, row)
+            used.add(row)
+        self._free = [r for r in range(self.capacity - 1, -1, -1)
+                      if r not in used]
+        self._restore_arrays(meta, arrays)
+
+    def _checkpoint_arrays(self) -> dict:
+        raise NotImplementedError
+
+    def _checkpoint_extra(self, meta: dict) -> None:
+        """Hook for family-specific JSON-able state."""
+
+    def restore_precheck(self, meta: dict, arrays: dict) -> None:
+        """Raise CheckpointIncompatible BEFORE any mutation when this
+        checkpoint cannot restore into the current configuration
+        (changed sketch parameters across the restart).  The
+        aggregator prechecks EVERY family first, so a mismatch is a
+        clean cold start instead of a half-restored arena set."""
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def _restore_into(dst: np.ndarray, src: np.ndarray) -> None:
+        """Copy a checkpointed array into the (possibly larger) live
+        array along the capacity (last) axis."""
+        if src.ndim == 1:
+            dst[:len(src)] = src
+        else:
+            dst[:, :src.shape[1]] = src
+
     def end_interval(self) -> None:
         """Reset touched state and GC idle rows (after flush)."""
         self.idle[self.touched] = 0
@@ -325,6 +494,20 @@ class CounterArena(_ArenaBase):
     def reset_rows(self, rows: np.ndarray) -> None:
         self.values[:, rows] = 0
 
+    def _checkpoint_arrays(self) -> dict:
+        return {"values": self.values.copy()}
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        src = arrays["values"]
+        if src.shape[0] != self.n_lanes:
+            # lane layout changed across the restart (mesh reconfig):
+            # fold the lanes down — counter lanes are additive
+            folded = np.zeros((self.n_lanes, src.shape[1]), np.float64)
+            for lane in range(src.shape[0]):
+                folded[lane % self.n_lanes] += src[lane]
+            src = folded
+        self._restore_into(self.values, src)
+
 
 class GaugeArena(_ArenaBase):
     """Last-write-wins gauges (samplers/samplers.go:152-202)."""
@@ -361,6 +544,12 @@ class GaugeArena(_ArenaBase):
     def reset_rows(self, rows: np.ndarray) -> None:
         self.values[rows] = 0
 
+    def _checkpoint_arrays(self) -> dict:
+        return {"values": self.values.copy()}
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        self._restore_into(self.values, arrays["values"])
+
 
 class StatusArena(_ArenaBase):
     """Service-check state: last value + message + hostname
@@ -386,6 +575,22 @@ class StatusArena(_ArenaBase):
         for r in rows:
             self.messages.pop(int(r), None)
             self.hostnames.pop(int(r), None)
+
+    def _checkpoint_arrays(self) -> dict:
+        return {"values": self.values.copy()}
+
+    def _checkpoint_extra(self, meta: dict) -> None:
+        meta["messages"] = {str(r): m for r, m in self.messages.items()}
+        meta["hostnames"] = {str(r): h
+                             for r, h in self.hostnames.items()}
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        self._restore_into(self.values, arrays["values"])
+        self.messages = {int(r): str(m)
+                         for r, m in (meta.get("messages") or {}).items()}
+        self.hostnames = {int(r): str(h)
+                          for r, h in
+                          (meta.get("hostnames") or {}).items()}
 
 
 class SetArena(_ArenaBase):
@@ -629,6 +834,67 @@ class SetArena(_ArenaBase):
         # the flush snapshot never aliases the live (donatable) one
         self.lanes_regs = serving.set_reset_rows(
             self.lanes_regs, jnp.asarray(self._reset_index(rows)))
+
+    def _checkpoint_arrays(self) -> dict:
+        # call after sync(): staging and imported-row unions are folded
+        # into the registers, so the register planes ARE the state.
+        # Only LIVE rows serialize (registers are 16 KiB/row at p=14;
+        # a default arena's full plane would be 16 MB of zeros)
+        live = np.asarray(sorted(self.kdict.values()), np.int64)
+        out = {"reg_rows": live}
+        if self.host_regs is not None:
+            out["host_regs"] = self.host_regs[live].copy()
+        else:
+            out["lanes_regs"] = np.asarray(self.lanes_regs)[:, live]
+        if self._legacy_regs:
+            rows = sorted(self._legacy_regs)
+            out["legacy_rows"] = np.asarray(rows, np.int64)
+            out["legacy_regs"] = np.stack(
+                [self._legacy_regs[r] for r in rows])
+        return out
+
+    def _checkpoint_extra(self, meta: dict) -> None:
+        meta["precision"] = int(self.precision)
+
+    def restore_precheck(self, meta: dict, arrays: dict) -> None:
+        if int(meta.get("precision", self.precision)) != self.precision:
+            raise CheckpointIncompatible(
+                "set checkpoint precision "
+                f"{meta.get('precision')} != configured "
+                f"{self.precision}; registers are not mergeable "
+                "across precisions")
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        rows = arrays.get("reg_rows")
+        if rows is not None and len(rows):
+            rows = rows.astype(np.int64, copy=False)
+            if "host_regs" in arrays:
+                src = arrays["host_regs"]
+                if self.host_regs is not None:
+                    self.host_regs[rows] = src
+                else:
+                    # unmeshed checkpoint restored onto a meshed arena:
+                    # registers land in lane 0 (pmax unions them anyway)
+                    lanes = np.asarray(self.lanes_regs).copy()
+                    lanes[0, rows] = np.maximum(lanes[0, rows], src)
+                    self.lanes_regs = serving.put(lanes, self._lane_shd)
+            elif "lanes_regs" in arrays:
+                src = arrays["lanes_regs"]
+                if self.host_regs is not None:
+                    # meshed checkpoint onto an unmeshed arena: union
+                    self.host_regs[rows] = src.max(axis=0)
+                else:
+                    lanes = np.asarray(self.lanes_regs).copy()
+                    for lane in range(src.shape[0]):
+                        tgt = lane % self.n_lanes
+                        lanes[tgt, rows] = np.maximum(lanes[tgt, rows],
+                                                      src[lane])
+                    self.lanes_regs = serving.put(lanes, self._lane_shd)
+        if "legacy_rows" in arrays:
+            self._legacy_regs = {
+                int(r): regs.copy()
+                for r, regs in zip(arrays["legacy_rows"],
+                                   arrays["legacy_regs"])}
 
 
 class DigestArena(_ArenaBase):
@@ -1130,6 +1396,48 @@ class DigestArena(_ArenaBase):
         weight matrix and no minmax (see digest_eval_uniform)."""
         return (serving.put(dv, self._dense_shd),
                 serving.put(depths, None))
+
+    _CKPT_SCALARS = ("d_min", "d_max", "d_rsum", "d_weight", "d_sum",
+                     "l_weight", "l_min", "l_max", "l_sum", "l_rsum",
+                     "_depth")
+
+    def _checkpoint_arrays(self) -> dict:
+        # call after sync(): raw COO staging and native chunks are
+        # consolidated into _acc, so the interval's not-yet-flushed
+        # samples checkpoint as three aligned arrays and restore
+        # BIT-EXACTLY (the mid-interval durability the crash arms prove)
+        out = {name: getattr(self, name).copy()
+               for name in self._CKPT_SCALARS}
+        rows, vals, wts = self._consolidated()
+        out["acc_rows"] = rows.copy()
+        out["acc_vals"] = vals.copy()
+        out["acc_wts"] = wts.copy()
+        return out
+
+    def _checkpoint_extra(self, meta: dict) -> None:
+        meta["staged_nonuniform"] = bool(self._staged_nonuniform)
+        meta["compression"] = float(self.compression)
+
+    def restore_precheck(self, meta: dict, arrays: dict) -> None:
+        if float(meta.get("compression",
+                          self.compression)) != self.compression:
+            raise CheckpointIncompatible(
+                "digest checkpoint compression "
+                f"{meta.get('compression')} != configured "
+                f"{self.compression}")
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        for name in self._CKPT_SCALARS:
+            self._restore_into(getattr(self, name), arrays[name])
+        rows = arrays["acc_rows"].astype(np.int64, copy=False)
+        if len(rows):
+            self._acc = [(rows,
+                          arrays["acc_vals"].astype(np.float64,
+                                                    copy=False),
+                          arrays["acc_wts"].astype(np.float64,
+                                                   copy=False))]
+        self._staged_nonuniform = bool(meta.get("staged_nonuniform",
+                                                False))
 
     def reset_rows(self, rows: np.ndarray) -> None:
         if len(rows) == 0:

@@ -344,6 +344,68 @@ class CardinalityGuard:
             self.epoch += 1
         return len(evicted)
 
+    # -- crash checkpoint (core/checkpoint.py) -----------------------------
+
+    @staticmethod
+    def _dk_list(d: dict) -> list:
+        return [[k.name, k.type, k.joined_tags, int(s), int(v)]
+                for (k, s), v in d.items()]
+
+    @staticmethod
+    def _dk_dict(rows: list) -> dict:
+        return {(MetricKey(str(n), str(t), str(j)),
+                 MetricScope(int(s))): int(v)
+                for n, t, j, s, v in rows}
+
+    def checkpoint_state(self) -> dict:
+        """JSON-able quota ledger (call under the aggregator lock):
+        budgets, per-tenant exact sets and candidate counts, epoch and
+        totals — restoring it means an over-budget tenant's tail keeps
+        folding into the SAME rollup identity after a crash, so the
+        degraded-data contract (rollup name + reserved tag) survives
+        the restart exactly."""
+        return {
+            "epoch": self.epoch,
+            "keys_evicted_total": self.keys_evicted_total,
+            "rollup_points_total": self.rollup_points_total,
+            "tenants": {
+                t: {"exact": self._dk_list(st.exact),
+                    "idle": self._dk_list(st.idle),
+                    "candidates": [
+                        [dk[0].name, dk[0].type, dk[0].joined_tags,
+                         int(dk[1]), int(e[0])]
+                        for dk, e in st.candidates.items()],
+                    "evicted_total": st.evicted_total,
+                    "rollup_points": st.rollup_points}
+                for t, st in self.tenants.items()},
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Rebuild the ledger (fresh guard, under the aggregator lock).
+        Ranks are recomputed from the seeded identity hash — a pure
+        function, so eviction order replays bit-identically — and the
+        candidate heap is rebuilt from the restored table."""
+        self.epoch = int(state.get("epoch", 0))
+        self.keys_evicted_total = int(state.get("keys_evicted_total", 0))
+        self.rollup_points_total = int(
+            state.get("rollup_points_total", 0))
+        for t, ts in (state.get("tenants") or {}).items():
+            st = self.tenants[t] = _Tenant()
+            st.exact = self._dk_dict(ts.get("exact") or [])
+            st.idle = self._dk_dict(ts.get("idle") or [])
+            st.evicted_total = int(ts.get("evicted_total", 0))
+            st.rollup_points = int(ts.get("rollup_points", 0))
+            for n, ty, j, s, cnt in (ts.get("candidates") or []):
+                dk = (MetricKey(str(n), str(ty), str(j)),
+                      MetricScope(int(s)))
+                st.candidates[dk] = [int(cnt), self._rank_of(st, dk)]
+            for dk in st.exact:
+                self._rank_of(st, dk)
+            st.cand_heap = [(e[0], e[1], i, dk) for i, (dk, e)
+                            in enumerate(st.candidates.items())]
+            heapq.heapify(st.cand_heap)
+            st.seq = len(st.cand_heap)
+
     # -- observability -----------------------------------------------------
 
     def over_budget_tenants(self) -> int:

@@ -1,0 +1,152 @@
+"""Crash-durable sketch checkpoints: atomic snapshot files for arenas.
+
+The reference's answer to a hard crash is "re-panic and let the
+supervisor restart" (sentry.go semantics) — the process comes back, the
+data does not.  Because every sampler family here is a MERGEABLE
+summary (t-digest centroids, HLL registers, exact counter sums — the
+contract of arXiv:1902.04023), a periodic snapshot composes exactly on
+restart: restore the arenas, resume the interval, and a crash loses at
+most one checkpoint period of ingest instead of everything.
+
+File format: one numpy .npz (zip container, per-entry CRC32) holding
+the flattened state arrays plus a single `__meta__` entry — the
+JSON-encoded key tables, scalar counts and the cardinality-guard quota
+ledger.  Writes are ATOMIC: serialize into `<name>.tmp` in the same
+directory, flush+fsync, then os.replace onto the final name — a crash
+mid-write leaves the previous checkpoint intact, and `read_checkpoint`
+treats any unreadable/corrupt file as absent (counted, logged, never
+fatal).  The tempfile lifecycle (`open_checkpoint_tmp` ->
+`commit_checkpoint`/`discard_checkpoint`) is a vnlint resource-pairing
+contract: a writer that can leave the tmp file without renaming or
+removing it is a lint error.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("veneur_tpu.core.checkpoint")
+
+CHECKPOINT_NAME = "checkpoint.ckpt"
+MARKER_NAME = "last_flush"
+_META_KEY = "__meta__"
+FORMAT_VERSION = 1
+
+
+def checkpoint_path(directory: str) -> str:
+    return os.path.join(directory, CHECKPOINT_NAME)
+
+
+def write_flush_marker(directory: str, flush_count: int) -> None:
+    """Stamp that flush `flush_count` COMPLETED (its emit/forward
+    hand-off happened and the arenas were reset).  A checkpoint whose
+    interval is older than the marker must not restore its arenas: the
+    data was already delivered, and a revived sender would re-forward
+    it under a fresh boot nonce the dedup ledger cannot recognize —
+    the double-count the exactly-once contract forbids.  Tiny
+    atomic-rename write per flush (no fsync: the threat model is
+    process death — a kill -9 keeps OS-buffered writes; an OS/power
+    crash can lose the last marker, narrowing back to at most one
+    flush interval of possible re-delivery)."""
+    tmp = os.path.join(directory, MARKER_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(json.dumps({"flush_count": int(flush_count),
+                            "unix": time.time()}))
+    os.replace(tmp, os.path.join(directory, MARKER_NAME))
+
+
+def read_flush_marker(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, MARKER_NAME)
+    try:
+        with open(path) as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def open_checkpoint_tmp(directory: str):
+    """Create the checkpoint tempfile for writing — paired with
+    commit_checkpoint (atomic rename) or discard_checkpoint on every
+    path (vnlint resource-pairing)."""
+    os.makedirs(directory, exist_ok=True)
+    tmp_path = checkpoint_path(directory) + ".tmp"
+    return open(tmp_path, "wb"), tmp_path
+
+
+def commit_checkpoint(f, tmp_path: str, final_path: str) -> None:
+    """Flush + fsync the tempfile, close it, and atomically rename it
+    onto the live checkpoint — the only way checkpoint bytes become
+    visible to a restart."""
+    try:
+        f.flush()
+        os.fsync(f.fileno())
+    finally:
+        f.close()
+    os.replace(tmp_path, final_path)
+
+
+def discard_checkpoint(f, tmp_path: str) -> None:
+    """Error-path release: close and remove the tempfile so a failed
+    write can never be mistaken for (or block) a real checkpoint."""
+    try:
+        f.close()
+    finally:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+
+
+def write_checkpoint(directory: str, meta: dict,
+                     arrays: dict[str, np.ndarray]) -> int:
+    """Serialize (meta, arrays) atomically into directory; returns the
+    byte size written.  Raises OSError on disk failure — the caller
+    (core/server.py checkpoint_now) accounts the error and keeps the
+    previous checkpoint."""
+    meta = dict(meta)
+    meta["format_version"] = FORMAT_VERSION
+    meta["written_unix"] = time.time()
+    payload = dict(arrays)
+    payload[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    data = buf.getvalue()
+    f, tmp_path = open_checkpoint_tmp(directory)
+    try:
+        f.write(data)
+    except BaseException:
+        discard_checkpoint(f, tmp_path)
+        raise
+    commit_checkpoint(f, tmp_path, checkpoint_path(directory))
+    return len(data)
+
+
+def read_checkpoint(directory: str) -> Optional[tuple[dict, dict]]:
+    """Load the live checkpoint; returns (meta, arrays) or None when
+    absent or unreadable (corruption is logged and treated as a cold
+    start — a damaged checkpoint must never wedge boot)."""
+    path = checkpoint_path(directory)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(bytes(z[_META_KEY].tobytes()).decode())
+            if meta.get("format_version") != FORMAT_VERSION:
+                logger.warning(
+                    "checkpoint %s has format %s (want %s); ignoring",
+                    path, meta.get("format_version"), FORMAT_VERSION)
+                return None
+            arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    except Exception as e:
+        logger.error("checkpoint %s is unreadable (%s); cold start",
+                     path, e)
+        return None
+    return meta, arrays

@@ -282,6 +282,31 @@ class Server:
         # record acquisition-order edges for the static cross-check
         self.lock_witness = None
 
+        # crash durability (core/checkpoint.py + forward/spool.py):
+        # the dedup ledger exists whenever this instance imports (its
+        # state rides the checkpoint, so replayed chunks merge exactly
+        # once across a receiver crash); checkpoint_stats is the
+        # /debug/vars -> checkpoint ledger
+        self.dedup = None
+        if cfg.grpc_address:
+            from veneur_tpu.sources.proxy import DedupLedger
+            self.dedup = DedupLedger(cfg.spool_dedup_window)
+        self.checkpoint_stats = {
+            "enabled": bool(cfg.checkpoint_dir),
+            "writes": 0, "restores": 0, "errors": 0,
+            # checkpoints skipped at boot because a later flush had
+            # already delivered their arena contents (flush marker)
+            "stale_skips": 0,
+            "last_bytes": 0, "last_unix": 0.0,
+            # age of the restored checkpoint at boot (how much ingest
+            # the crash window could have cost), 0 on a cold start
+            "age_ms": 0.0,
+        }
+        self._checkpoint_write_lock = threading.Lock()
+        # set by crash() (the testbed's simulated kill -9): shutdown
+        # skips the final flush, the checkpoint write and the spool
+        # drain — in-memory state is dropped, disk state is kept
+        self._crashed = False
         self._listeners: list[socket.socket] = []
         # (lockfile path, open file) pairs guarding unix socket paths
         self._socket_locks: list[tuple[str, object]] = []
@@ -342,6 +367,8 @@ class Server:
         # last-reported forward-client (retries, dropped) totals, for
         # per-interval forward.retries_total/forward.dropped_total deltas
         self._forward_client_reported = (0, 0)
+        # last-reported spool ledger totals (forward.spool.* deltas)
+        self._spool_reported: dict = {}
         # accepted stream connections, closed on shutdown so reader
         # threads blocked in recv are unblocked
         self._stream_conns: set = set()
@@ -393,6 +420,11 @@ class Server:
     # -- listeners (networking.go) ----------------------------------------
 
     def start(self) -> None:
+        # restore from the crash checkpoint FIRST — before any
+        # listener, import server or drain thread can race the arena
+        # rebuild (the arenas must be fresh for restore_state)
+        if self.config.checkpoint_dir:
+            self._maybe_restore_checkpoint()
         has_udp_statsd = any(
             parse_listen_addr(a)[0] == "udp"
             for a in self.config.statsd_listen_addresses)
@@ -448,11 +480,22 @@ class Server:
                 ingest_span=self._grpc_span_counted,
                 handle_packet=self._grpc_packet_counted,
                 import_payload=_import_payload_counted,
-                trace_hook=self._record_import_span)
+                trace_hook=self._record_import_span,
+                dedup=self.dedup)
             self.grpc_import.start()
         if self.config.forward_address and self.forwarder is None:
             # local tier: persistent forward connection (server.go:810-828)
             from veneur_tpu.forward.client import ForwardClient, RetryPolicy
+            spool = None
+            if self.config.spool_dir:
+                from veneur_tpu.forward.spool import ForwardSpool
+                spool = ForwardSpool(
+                    os.path.expanduser(self.config.spool_dir),
+                    max_bytes=self.config.spool_max_bytes,
+                    max_age_s=self.config.spool_max_age,
+                    fsync=self.config.spool_fsync,
+                    segment_max_bytes=self.config.spool_segment_max_bytes,
+                    replay_interval_s=self.config.spool_replay_interval)
             # The reference bounds each forward by one flush interval
             # (flusher.go:516-591).  Here at most FORWARD_MAX_IN_FLIGHT
             # forwards run concurrently (later flushes drop theirs once the
@@ -469,7 +512,9 @@ class Server:
                 max_streams=self.config.forward_streams,
                 retry=RetryPolicy(
                     attempts=self.config.forward_max_retries + 1,
-                    backoff_base_s=self.config.forward_retry_backoff))
+                    backoff_base_s=self.config.forward_retry_backoff),
+                spool=spool, source=self.config.hostname,
+                trace_recorder=self.flight_recorder)
         if self.lock_witness is not None:
             # testbed/dryrun lock witness (analysis/witness.py): wrap
             # the named locks NOW — native plane and forwarder exist,
@@ -481,6 +526,11 @@ class Server:
         if self.config.flush_watchdog_missed_flushes > 0:
             t = threading.Thread(target=self._watchdog, daemon=True,
                                  name="flush-watchdog")
+            t.start()
+            self._threads.append(t)
+        if self.config.checkpoint_dir and self.config.checkpoint_interval > 0:
+            t = threading.Thread(target=self._checkpoint_loop,
+                                 daemon=True, name="checkpoint-loop")
             t.start()
             self._threads.append(t)
         if self.config.prewarm_flush_shapes:
@@ -1027,6 +1077,145 @@ class Server:
             except OSError:
                 pass
 
+    # -- crash durability (core/checkpoint.py) -----------------------------
+
+    def _maybe_restore_checkpoint(self) -> None:
+        """Boot-time restore: rebuild arenas, resume the interval count
+        and refill the dedup ledger from the last committed checkpoint.
+        A missing/corrupt file is a cold start, never a boot failure."""
+        from veneur_tpu.core import checkpoint as ckpt_mod
+        ckpt_dir = os.path.expanduser(self.config.checkpoint_dir)
+        loaded = ckpt_mod.read_checkpoint(ckpt_dir)
+        if loaded is None:
+            return
+        meta, arrays = loaded
+        marker = ckpt_mod.read_flush_marker(ckpt_dir)
+        if (marker is not None and int(marker.get("flush_count", 0))
+                > int(meta.get("flush_count", 0))):
+            # a flush COMPLETED after this checkpoint was written: its
+            # arenas hold data that was already forwarded/emitted, and
+            # a revived sender would re-deliver it under a fresh boot
+            # nonce the dedup ledger cannot match.  Skip the arena
+            # restore (honest crash-window loss: at most the ingest
+            # since that flush), but still resume the interval count
+            # and the receiver-side dedup ledger.
+            self.flush_count = int(marker["flush_count"])
+            if self.dedup is not None and meta.get("dedup") is not None:
+                self.dedup.restore(meta["dedup"])
+            self.checkpoint_stats["stale_skips"] = (
+                self.checkpoint_stats.get("stale_skips", 0) + 1)
+            logger.warning(
+                "checkpoint (interval %s) predates the last completed "
+                "flush (interval %s): skipping arena restore to avoid "
+                "re-forwarding delivered data; interval count and "
+                "dedup ledger resumed",
+                meta.get("flush_count"), marker["flush_count"])
+            return
+        from veneur_tpu.core.arena import CheckpointIncompatible
+        try:
+            self.aggregator.restore_state(meta["aggregator"], arrays)
+            self.flush_count = int(meta.get("flush_count", 0))
+            if self.dedup is not None and meta.get("dedup") is not None:
+                self.dedup.restore(meta["dedup"])
+        except CheckpointIncompatible as e:
+            # prechecked BEFORE any mutation: the arenas are still
+            # fresh, so continuing as a cold start is safe (the
+            # operator changed sketch parameters across the restart)
+            logger.warning("checkpoint incompatible with the current "
+                           "configuration (%s); cold start", e)
+            return
+        except Exception:
+            # restore failed MID-mutation: the arenas may hold a mix
+            # of restored and fresh state — refusing to boot is safer
+            # than emitting stale pre-crash data as if newly ingested
+            logger.critical("checkpoint restore failed mid-rebuild; "
+                            "refusing to run half-restored (delete %s "
+                            "to cold-start)",
+                            self.config.checkpoint_dir)
+            raise
+        age_ms = max(0.0, (time.time()
+                           - float(meta.get("written_unix", 0.0))) * 1e3)
+        self.checkpoint_stats["restores"] += 1
+        self.checkpoint_stats["age_ms"] = round(age_ms, 1)
+        logger.info(
+            "restored checkpoint: interval %d, %d processed / %d "
+            "imported, %.0f ms old", self.flush_count,
+            self.aggregator.processed, self.aggregator.imported, age_ms)
+        # restore is an operational event on the flush timeline, so the
+        # crash window is visible next to the flush records it gapped
+        self.flush_timeline.record(
+            interval=self.flush_count, unix_ts=time.time(),
+            total_s=0.0, event="restore", checkpoint_age_ms=age_ms)
+
+    def checkpoint_now(self) -> bool:
+        """Write one checkpoint: a coherent (arenas, interval, dedup
+        ledger) cut — the ledger's pause gate drains in-flight imports
+        and blocks new ones across both snapshots, so a chunk's data
+        and its identity can never split across the cut — then the
+        atomic tempfile->rename write OUTSIDE every lock.  Returns
+        False (with accounting) on disk failure; the previous
+        checkpoint stays live either way."""
+        from veneur_tpu.core import checkpoint as ckpt_mod
+        import contextlib
+        t0 = time.perf_counter()
+        # fold the C++ engine's staged batches into the arenas first —
+        # mid-interval ingest parked in the data plane must be part of
+        # the cut, or a crash right after the checkpoint loses it
+        self._drain_native()
+        with self._checkpoint_write_lock:
+            # drain + block imports for the cut: a chunk's data and
+            # its ledger identity must land on the same side
+            gate = (self.dedup.paused() if self.dedup is not None
+                    else contextlib.nullcontext())
+            with gate:
+                # vnlint: disable=blocking-propagation (the snapshot's
+                #   flagged chain is host COO consolidation inside
+                #   checkpoint_state; _checkpoint_write_lock only
+                #   serializes checkpoint writers — nothing on the
+                #   ingest or flush path ever takes it)
+                agg_meta, arrays = self.aggregator.checkpoint_state()
+                meta = {
+                    "aggregator": agg_meta,
+                    "flush_count": self.flush_count,
+                    "hostname": self.config.hostname,
+                    "dedup": (self.dedup.snapshot()
+                              if self.dedup is not None else None),
+                }
+            try:
+                nbytes = ckpt_mod.write_checkpoint(
+                    os.path.expanduser(self.config.checkpoint_dir),
+                    meta, arrays)
+            except Exception as e:
+                self.checkpoint_stats["errors"] += 1
+                logger.error("checkpoint write failed (previous "
+                             "checkpoint stays live): %s", e)
+                return False
+        dur = time.perf_counter() - t0
+        self.checkpoint_stats["writes"] += 1
+        self.checkpoint_stats["last_bytes"] = nbytes
+        self.checkpoint_stats["last_unix"] = time.time()
+        self.flush_timeline.record(
+            interval=self.flush_count, unix_ts=time.time(),
+            total_s=dur, event="checkpoint", checkpoint_bytes=nbytes)
+        return True
+
+    def _checkpoint_loop(self) -> None:
+        iv = self.config.checkpoint_interval
+        while not self._shutdown.wait(iv):
+            try:
+                self.checkpoint_now()
+            except Exception:
+                logger.exception("periodic checkpoint failed")
+
+    def crash(self) -> None:
+        """Simulated kill -9 for the crash chaos arms: tear down
+        listeners and threads WITHOUT the graceful exits — no final
+        flush, no shutdown checkpoint, no spool drain.  Everything
+        in memory is dropped; whatever already reached the spool/
+        checkpoint directories is what the revived instance gets."""
+        self._crashed = True
+        self.shutdown()
+
     # -- flush (flusher.go:26-122) ----------------------------------------
 
     def flush(self) -> None:
@@ -1040,6 +1229,18 @@ class Server:
             #   exists to hold the entire flush — device waits
             #   included; ingest threads never contend on it)
             self._flush_locked()
+            if self.config.checkpoint_dir:
+                # stamp the completed flush: a checkpoint OLDER than
+                # this marker must not restore its arenas (the data
+                # was delivered; re-forwarding it post-crash would
+                # double-count — see checkpoint.write_flush_marker)
+                from veneur_tpu.core import checkpoint as ckpt_mod
+                try:
+                    ckpt_mod.write_flush_marker(
+                        os.path.expanduser(self.config.checkpoint_dir),
+                        self.flush_count)
+                except OSError as e:
+                    logger.warning("flush marker write failed: %s", e)
 
     # bound on the flush root span's imported_traces tag (the tag is
     # operator-facing JSON, not a database; the assembler only needs
@@ -1206,7 +1407,7 @@ class Server:
                 try:
                     futures[self._flush_pool.submit(
                         self._forward_safely, res.forward, span,
-                        traced)] = "forward"
+                        traced, self.flush_count)] = "forward"
                     # the assembler requires a complete 3-tier trace
                     # only for intervals whose forward was SUBMITTED
                     # (slot-exhausted drops are accounted, not traced)
@@ -1337,6 +1538,25 @@ class Server:
             if st["dropped"] > pd:
                 statsd.count("forward.dropped_total", st["dropped"] - pd)
             self._forward_client_reported = (st["retries"], st["dropped"])
+        # durable-spool ledger deltas (forward/spool.py): spilled /
+        # replayed / expired metric points per interval — expiry is the
+        # spool's visibly-accounted loss channel, so it must reach
+        # dashboards, not just /debug/vars
+        sp = fw.spool_stats() if (fw is not None and
+                                  hasattr(fw, "spool_stats")) else None
+        if sp is not None:
+            prev = self._spool_reported
+            for key in ("spilled_points", "replayed_points",
+                        "expired_points", "dropped_points"):
+                delta = sp[key] - prev.get(key, 0)
+                if delta > 0:
+                    statsd.count(
+                        f"forward.spool.{key.split('_')[0]}_total",
+                        delta)
+            pending = sp["pending_records"]
+            statsd.gauge("forward.spool.pending_records",
+                         float(pending))
+            self._spool_reported = sp
         statsd.count("spans.received_total", self.ssf_received)
         self.ssf_received = 0
         # per-span-sink ingest accounting (worker.go:603-678)
@@ -1359,12 +1579,15 @@ class Server:
         return self._tags_exclude_global | per_sink
 
     def _forward_safely(self, forward: list[sm.ForwardMetric],
-                        parent=None, traced: bool = False) -> None:
+                        parent=None, traced: bool = False,
+                        epoch: Optional[int] = None) -> None:
         """Forward with sub-timings on a child span
         (flusher.go:516-576: export/grpc parts + error cause).  When the
         interval is `traced`, the forward client gets the child span as
         trace parent: each attempt becomes its own span and the attempt
-        context rides the RPC metadata to the proxy."""
+        context rides the RPC metadata to the proxy.  `epoch` (the
+        flush interval, checkpoint-stable across restarts) becomes the
+        interval half of every chunk's exactly-once identity."""
         from veneur_tpu import scopedstatsd
         from veneur_tpu import ssf as ssf_mod
         statsd = scopedstatsd.ensure(self.statsd)
@@ -1377,11 +1600,15 @@ class Server:
                               float(len(forward))),
                 ssf_mod.count("forward.post_metrics_total",
                               float(len(forward))))
+            kwargs = {}
+            if epoch is not None and getattr(self.forwarder,
+                                             "accepts_epoch", False):
+                kwargs["epoch"] = epoch
             if traced and getattr(self.forwarder, "accepts_trace",
                                   False):
-                self.forwarder(forward, trace_parent=fspan)
+                self.forwarder(forward, trace_parent=fspan, **kwargs)
             else:
-                self.forwarder(forward)
+                self.forwarder(forward, **kwargs)
             fspan.add(ssf_mod.count("forward.error_total", 0))
         except TimeoutError:
             fspan.add(ssf_mod.count("forward.error_total", 1,
@@ -1551,12 +1778,22 @@ class Server:
                 compile_hold_since = None
 
     def shutdown(self) -> None:
-        """server.go:1417-1435."""
-        if self.config.flush_on_shutdown:
+        """server.go:1417-1435.  A crash() teardown skips the graceful
+        exits (final flush, shutdown checkpoint, spool drain) — the
+        revived instance recovers from disk instead."""
+        if self.config.flush_on_shutdown and not self._crashed:
             try:
                 self.flush()
             except Exception:
                 logger.exception("final flush failed")
+        if self.config.checkpoint_dir and not self._crashed:
+            # SIGTERM/graceful-exit snapshot: the supervisor's restart
+            # resumes from here (cli/veneur.py routes SIGTERM through
+            # this path)
+            try:
+                self.checkpoint_now()
+            except Exception:
+                logger.exception("shutdown checkpoint failed")
         self._shutdown.set()
         self._readers_stop.set()
         for source in self.sources:
@@ -1614,7 +1851,10 @@ class Server:
                 logger.exception("grpc ingest listener stop failed")
         if self.forwarder is not None and hasattr(self.forwarder, "close"):
             try:
-                self.forwarder.close()
+                if getattr(self.forwarder, "spool", None) is not None:
+                    self.forwarder.close(drain_spool=not self._crashed)
+                else:
+                    self.forwarder.close()
             except Exception:
                 pass
         for _, sink in self.metric_sinks:

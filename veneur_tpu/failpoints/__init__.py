@@ -36,6 +36,10 @@ Injection sites threaded through this repo (grep `failpoints.inject`):
                       row is released — a fault here aborts the pass
                       with quota state intact  (core/aggregator.py)
   server.flush        top of the flush path    (core/server.py)
+  spool.io            durable-spool disk I/O: the spill append (write/
+                      fsync) and the replay read — a fault degrades to
+                      drop-with-accounting, never a wedged forward
+                      thread           (forward/spool.py)
 """
 
 from __future__ import annotations

@@ -44,6 +44,32 @@ logger = logging.getLogger("veneur_tpu.forward")
 SEND_METRICS = "/forwardrpc.Forward/SendMetrics"
 SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
 
+# gRPC metadata key carrying one V1 chunk's (source, interval_epoch,
+# chunk_id) identity — the exactly-once handle the global tier's dedup
+# ledger (sources/proxy.py) keys on.  The identity is minted ONCE when
+# the chunk is formed and reused verbatim by every retry and every
+# spool replay, so an ambiguous failure (a timeout on a chunk the peer
+# actually imported) re-delivers under the SAME identity and merges
+# exactly once.
+CHUNK_ID_KEY = "veneur-chunk-id"
+
+
+def chunk_id_value(ident: tuple) -> str:
+    source, epoch, idx = ident
+    return f"{source}:{epoch:x}:{idx:x}"
+
+
+def parse_chunk_id(value: str) -> Optional[tuple]:
+    """Inverse of chunk_id_value; None on malformed input (a foreign
+    sender must never fault the import path with a bad header)."""
+    try:
+        source, epoch_s, idx_s = str(value).rsplit(":", 2)
+        if not source:
+            return None
+        return source, int(epoch_s, 16), int(idx_s, 16)
+    except (ValueError, AttributeError):
+        return None
+
 
 # A python-grpc client stream tops out at ~20k msgs/s (each message is a
 # cond-var handoff to the channel thread).  Against this framework's own
@@ -92,15 +118,25 @@ class _V1Unsupported(Exception):
     imported: safe to fall back to V2 for the same metrics."""
 
 
+@dataclass
+class _Chunk:
+    """One V1 MetricList chunk with its stable identity.  `ident` is
+    None for payloads that lost chunk atomicity (the V2 fallback path
+    against reference globals) — those are never spooled."""
+    pbs: list
+    ident: Optional[tuple] = None
+
+
 class _SendFailure(Exception):
-    """An attempt failed with `undelivered` protobuf metrics known (or
+    """An attempt failed with `undelivered` chunks known (or
     pessimistically assumed) not to have been imported.  `retry_safe`
-    means re-sending them cannot double-count."""
+    means re-sending them cannot double-count (identified chunks are
+    additionally idempotent via the global's dedup ledger)."""
 
     def __init__(self, undelivered: list, cause: BaseException,
                  retry_safe: bool):
         super().__init__(str(cause))
-        self.undelivered = undelivered
+        self.undelivered = undelivered      # list[_Chunk]
         self.cause = cause
         self.retry_safe = retry_safe
 
@@ -120,7 +156,18 @@ class ForwardClient:
     def __init__(self, address: str,
                  credentials: Optional[grpc.ChannelCredentials] = None,
                  timeout_s: float = 10.0, max_streams: int = 8,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 spool=None, source: str = "",
+                 trace_recorder=None):
+        """`spool` (a forward.spool.ForwardSpool) makes exhausted
+        retries crash-durable: identified V1 chunks spill to disk and a
+        background replayer re-delivers them oldest-first once the
+        destination recovers.  `source` names this sender in chunk
+        identities; a per-boot nonce is appended so a restart without a
+        spool can never collide with a previous boot's epochs at the
+        global's dedup ledger (spooled records keep their RECORDED
+        identity — that is the exactly-once handle).  `trace_recorder`
+        (a FlightRecorder) receives the forward.replay spans."""
         self.address = address
         self.timeout_s = timeout_s
         self.max_streams = max(1, max_streams)
@@ -138,37 +185,56 @@ class ForwardClient:
             SEND_METRICS,
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
+        # raw-bytes V1 sender: spool replay re-delivers the serialized
+        # MetricList exactly as recorded (no re-parse, same identity)
+        self._v1_raw = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=self.max_streams,
             thread_name_prefix=f"fwd-{address}")
         self._use_v1: Optional[bool] = None   # None = not yet probed
+        self.spool = spool
+        self.trace_recorder = trace_recorder
+        self.source = (f"{source or 'veneur'}"
+                       f"#{time.time_ns() & 0xFFFFFFFF:08x}")
+        self._epoch_seq = 0
         # diagnostics counters (surfaced at /debug/vars -> "forward" and
         # as forward.retries_total / forward.dropped_total self-metrics)
         self._stats_lock = threading.Lock()
         self.sent = 0        # metrics delivered (per-chunk accounting)
         self.retries = 0     # retry attempts taken
         self.dropped = 0     # metrics given up on after exhausted retries
+        self.spilled = 0     # metrics spilled to the durable spool
+        if self.spool is not None:
+            self.spool.start_replayer(self._replay_send)
 
     # the server's flush path may hand a trace parent span down
     # (core/server.py _forward_safely); custom forwarder callables that
     # lack this attribute are called with metrics alone
     accepts_trace = True
+    # ...and the flush interval as the chunk-identity epoch
+    accepts_epoch = True
 
     def __call__(self, metrics: list[sm.ForwardMetric],
-                 trace_parent=None) -> None:
-        self.send(metrics, trace_parent=trace_parent)
+                 trace_parent=None, epoch: Optional[int] = None) -> None:
+        self.send(metrics, trace_parent=trace_parent, epoch=epoch)
 
     def stats(self) -> dict[str, int]:
         with self._stats_lock:
             return {"sent": self.sent, "retries": self.retries,
-                    "dropped": self.dropped}
+                    "dropped": self.dropped, "spilled": self.spilled}
+
+    def spool_stats(self) -> Optional[dict]:
+        return None if self.spool is None else self.spool.stats()
 
     def _count(self, field: str, n: int) -> None:
         with self._stats_lock:
             setattr(self, field, getattr(self, field) + n)
 
     def send(self, metrics: list[sm.ForwardMetric],
-             trace_parent=None) -> None:
+             trace_parent=None, epoch: Optional[int] = None) -> None:
         """One flush's forward: batched V1 against this framework's
         globals, the reference's V2 stream protocol otherwise
         (flusher.go:578-591 semantics — every metric is Sent exactly
@@ -176,23 +242,42 @@ class ForwardClient:
         if not metrics:
             return
         self.send_pbs([convert.to_pb(fm) for fm in metrics],
-                      trace_parent=trace_parent)
+                      trace_parent=trace_parent, epoch=epoch)
 
-    def send_pbs(self, pbs: list, trace_parent=None) -> None:
+    def _mint_epoch(self) -> int:
+        with self._stats_lock:
+            self._epoch_seq += 1
+            return self._epoch_seq
+
+    def send_pbs(self, pbs: list, trace_parent=None,
+                 epoch: Optional[int] = None) -> None:
         """With `trace_parent` (a trace.Span), every attempt becomes one
         child span — tagged with its attempt index, outcome, and the
         injected failpoint name when chaos fired — and the attempt's
         trace context rides the RPC metadata, so the receiving proxy /
         global parents its own span to exactly the attempt that
         delivered the metrics (duplicate attempts stay leaf spans with
-        error=true; only the delivered edge continues the trace)."""
-        remaining = pbs
+        error=true; only the delivered edge continues the trace).
+
+        The payload is chunked ONCE up front and every chunk's identity
+        (source, epoch, chunk_id) is minted here — retries, the durable
+        spool and its replays all reuse the same identity, which is
+        what lets the global's dedup ledger make re-delivery
+        idempotent.  `epoch` is the caller's interval number (the
+        server passes its flush count, which survives a checkpoint
+        restore); None mints a client-local epoch."""
+        epoch = self._mint_epoch() if epoch is None else int(epoch)
+        remaining = [
+            _Chunk(pbs[i:i + BATCH_MAX],
+                   ident=(self.source, epoch, i // BATCH_MAX))
+            for i in range(0, len(pbs), BATCH_MAX)]
         retry_idx = 0
         while True:
             aspan = (trace_parent.child(
                 "forward.attempt",
                 tags={"attempt": str(retry_idx + 1),
-                      "metrics": str(len(remaining))})
+                      "metrics": str(sum(len(c.pbs)
+                                         for c in remaining))})
                 if trace_parent is not None else None)
             try:
                 self._send_attempt(
@@ -214,18 +299,14 @@ class ForwardClient:
                 remaining = f.undelivered
                 if (not f.retry_safe
                         or retry_idx >= self.retry.attempts - 1):
-                    self._count("dropped", len(remaining))
-                    logger.warning(
-                        "forward to %s: dropping %d metrics after %d "
-                        "attempt(s) (%s%s)", self.address, len(remaining),
-                        retry_idx + 1, f.cause,
-                        "" if f.retry_safe else "; not retry-safe")
-                    raise f.cause
+                    self._spill_or_drop(remaining, f, retry_idx + 1,
+                                        trace_parent)
+                    return
                 self._count("retries", 1)
                 delay = self.retry.delay_s(retry_idx, self._retry_rng)
                 logger.info(
                     "forward to %s: attempt %d failed (%s); retrying %d "
-                    "metrics in %.0f ms", self.address, retry_idx + 1,
+                    "chunks in %.0f ms", self.address, retry_idx + 1,
                     f.cause, len(remaining), delay * 1e3)
                 time.sleep(delay)
                 retry_idx += 1
@@ -233,16 +314,89 @@ class ForwardClient:
                 if aspan is not None:
                     aspan.finish()
 
-    def _send_attempt(self, pbs: list, metadata=None) -> None:
-        """One try at delivering `pbs`; raises _SendFailure carrying
+    def _spill_or_drop(self, chunks: list, f: _SendFailure,
+                       attempts: int, trace_parent=None) -> None:
+        """Exhausted remainder: PROVABLY-undelivered identified chunks
+        spill to the durable spool; everything else — ambiguous
+        failures (the peer may be a proxy, which re-shards without a
+        dedup ledger, so re-delivery could double-count), anonymous V2
+        remainders, spool off, disk errors — drops with accounting and
+        re-raises the cause.  The chunk identity still guards the
+        REPLAY path's own crash window against a ledger-bearing
+        global."""
+        spilled = dropped = 0
+        tid = sid = 0
+        if trace_parent is not None:
+            tid, sid = trace_parent.trace_id, trace_parent.span_id
+        for c in chunks:
+            if (self.spool is not None and c.ident is not None
+                    and f.retry_safe):
+                body = forward_pb2.MetricList(
+                    metrics=c.pbs).SerializeToString()
+                if self.spool.append(c.ident, body, len(c.pbs),
+                                     trace_id=tid, span_id=sid):
+                    spilled += len(c.pbs)
+                    continue
+            dropped += len(c.pbs)
+        if spilled:
+            self._count("spilled", spilled)
+            logger.info(
+                "forward to %s: spilled %d metrics to the spool after "
+                "%d attempt(s) (%s); background replay will re-deliver",
+                self.address, spilled, attempts, f.cause)
+        if dropped:
+            self._count("dropped", dropped)
+            logger.warning(
+                "forward to %s: dropping %d metrics after %d "
+                "attempt(s) (%s%s)", self.address, dropped, attempts,
+                f.cause, "" if f.retry_safe else "; not retry-safe")
+            raise f.cause
+
+    def _replay_send(self, rec, body: bytes) -> None:
+        """Spool replay delivery: the recorded MetricList bytes go out
+        as one raw V1 RPC under the RECORDED chunk identity, with a
+        forward.replay span continuing the original interval's trace
+        context so the cross-tier assembler sees one trace across the
+        crash.  Retry-safe failures re-raise as RetryableReplayError
+        (the spool keeps the record for the next tick)."""
+        from veneur_tpu.forward import spool as spool_mod
+        span = None
+        if rec.trace_id and rec.span_id:
+            span = trace_rec.continue_span(
+                "forward.replay", rec.trace_id, rec.span_id,
+                tags={"chunk": chunk_id_value(rec.ident),
+                      "metrics": str(rec.n_metrics)})
+            span.client = None
+        metadata = ((CHUNK_ID_KEY, chunk_id_value(rec.ident)),)
+        if span is not None:
+            metadata += trace_rec.ctx_metadata(span.trace_id,
+                                               span.span_id)
+        try:
+            self._v1_raw(body, timeout=self.timeout_s,
+                         metadata=metadata)
+        except grpc.RpcError as e:
+            if span is not None:
+                span.error = True
+            if _retry_safe(e):
+                raise spool_mod.RetryableReplayError(str(e)) from e
+            raise
+        finally:
+            if span is not None:
+                span.finish()
+                if self.trace_recorder is not None:
+                    self.trace_recorder.record_span(span)
+        self._count("sent", rec.n_metrics)
+
+    def _send_attempt(self, chunks: list, metadata=None) -> None:
+        """One try at delivering `chunks`; raises _SendFailure carrying
         exactly what is still undelivered."""
         try:
             failpoints.inject("forward.send")
         except (failpoints.FailpointDrop, grpc.RpcError) as e:
-            raise _SendFailure(pbs, e, _retry_safe(e)) from e
+            raise _SendFailure(chunks, e, _retry_safe(e)) from e
         if self._use_v1 is not False:
             try:
-                self._send_v1_batches(pbs, metadata=metadata)
+                self._send_v1_batches(chunks, metadata=metadata)
                 # a later-chunk UNIMPLEMENTED inside the batch sender
                 # flips _use_v1 off; don't override that verdict
                 if self._use_v1 is not False:
@@ -256,7 +410,15 @@ class ForwardClient:
                 logger.info("global %s has no V1 batch import; "
                             "using V2 streams", self.address)
                 self._use_v1 = False
-        self._send_v2_fanout(pbs, metadata=metadata)
+        pbs = [pb for c in chunks for pb in c.pbs]
+        try:
+            self._send_v2_fanout(pbs, metadata=metadata)
+        except _SendFailure as f:
+            # V2 loses chunk atomicity: the undelivered remainder is one
+            # anonymous chunk (never spooled — a reference global has no
+            # dedup ledger to make re-delivery idempotent)
+            raise _SendFailure([_Chunk(f.undelivered)], f.cause,
+                               f.retry_safe) from f.cause
 
     def _send_v2_fanout(self, pbs: list, metadata=None) -> None:
         """V2 streams, fanned out in parallel for big payloads — one
@@ -325,28 +487,37 @@ class ForwardClient:
         logger.debug("forwarded %d metrics to %s over %d streams",
                      len(pbs), self.address, n_streams)
 
-    def _send_v1_batches(self, pbs: list, metadata=None) -> None:
-        """BATCH_MAX-sized MetricList RPCs, in parallel for big
-        flushes.  The first chunk is sent ALONE: if it answers
-        UNIMPLEMENTED nothing has been imported yet, so the V2 fallback
-        never double-sends.  UNIMPLEMENTED on a LATER chunk (a mixed-
-        version load balancer routing chunks to a reference backend)
-        re-sends exactly those chunks over V2 — chunk boundaries are
-        known, so nothing double-sends — and flips _use_v1 off so the
-        next flush avoids the mixed path entirely.  Any other chunk
-        failure surfaces as _SendFailure carrying exactly the failed
-        chunks' metrics, so the retry loop re-sends only those."""
-        chunks = [pbs[i:i + BATCH_MAX]
-                  for i in range(0, len(pbs), BATCH_MAX)]
+    @staticmethod
+    def _chunk_metadata(metadata, chunk: _Chunk):
+        """The per-RPC metadata: the attempt's trace context plus this
+        chunk's stable identity header."""
+        if chunk.ident is None:
+            return metadata
+        entry = ((CHUNK_ID_KEY, chunk_id_value(chunk.ident)),)
+        return entry if metadata is None else tuple(metadata) + entry
+
+    def _send_v1_batches(self, chunks: list, metadata=None) -> None:
+        """One MetricList RPC per chunk, in parallel for big flushes,
+        each carrying its chunk-identity metadata.  The first chunk is
+        sent ALONE: if it answers UNIMPLEMENTED nothing has been
+        imported yet, so the V2 fallback never double-sends.
+        UNIMPLEMENTED on a LATER chunk (a mixed-version load balancer
+        routing chunks to a reference backend) re-sends exactly those
+        chunks over V2 — chunk boundaries are known, so nothing
+        double-sends — and flips _use_v1 off so the next flush avoids
+        the mixed path entirely.  Any other chunk failure surfaces as
+        _SendFailure carrying exactly the failed chunks, so the retry
+        loop re-sends only those (under their original identities)."""
         try:
-            self._v1(forward_pb2.MetricList(metrics=chunks[0]),
-                     timeout=self.timeout_s, metadata=metadata)
+            self._v1(forward_pb2.MetricList(metrics=chunks[0].pbs),
+                     timeout=self.timeout_s,
+                     metadata=self._chunk_metadata(metadata, chunks[0]))
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                 raise _V1Unsupported() from e
             # nothing delivered yet: every chunk is undelivered
-            raise _SendFailure(pbs, e, _retry_safe(e)) from e
-        self._count("sent", len(chunks[0]))
+            raise _SendFailure(list(chunks), e, _retry_safe(e)) from e
+        self._count("sent", len(chunks[0].pbs))
         if len(chunks) == 1:
             return
         futs = [(c, self._pool.submit(self._send_v1_chunk, c, metadata))
@@ -354,26 +525,24 @@ class ForwardClient:
         errs = []
         undelivered: list = []
         v2_retry: list = []
-        n_unimpl_chunks = 0
         for c, f in futs:
             try:
                 f.result()
-                self._count("sent", len(c))
+                self._count("sent", len(c.pbs))
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.UNIMPLEMENTED:
-                    v2_retry.extend(c)
-                    n_unimpl_chunks += 1
+                    v2_retry.extend(c.pbs)
                 else:
                     errs.append(e)
-                    undelivered.extend(c)
+                    undelivered.append(c)
             except Exception as e:       # noqa: BLE001 - re-raised below
                 errs.append(e)
-                undelivered.extend(c)
+                undelivered.append(c)
         if v2_retry:
             logger.info(
-                "global %s answered UNIMPLEMENTED on %d later V1 "
-                "chunk(s); re-sending those over V2 and disabling V1",
-                self.address, n_unimpl_chunks)
+                "global %s answered UNIMPLEMENTED on later V1 "
+                "chunk(s); re-sending %d metrics over V2 and disabling "
+                "V1", self.address, len(v2_retry))
             self._use_v1 = False
             try:
                 self._send_v2_fanout(v2_retry, metadata=metadata)
@@ -386,7 +555,7 @@ class ForwardClient:
                     logger.warning(
                         "V1 chunk to %s also failed (alongside the V2 "
                         "retry failure): %s", self.address, prior)
-                undelivered.extend(f.undelivered)
+                undelivered.append(_Chunk(f.undelivered))
                 raise _SendFailure(
                     undelivered, f.cause,
                     f.retry_safe and all(_retry_safe(e) for e in errs)
@@ -396,9 +565,10 @@ class ForwardClient:
                 undelivered, errs[0],
                 all(_retry_safe(e) for e in errs)) from errs[0]
 
-    def _send_v1_chunk(self, chunk: list, metadata=None) -> None:
-        self._v1(forward_pb2.MetricList(metrics=chunk),
-                 timeout=self.timeout_s, metadata=metadata)
+    def _send_v1_chunk(self, chunk: _Chunk, metadata=None) -> None:
+        self._v1(forward_pb2.MetricList(metrics=chunk.pbs),
+                 timeout=self.timeout_s,
+                 metadata=self._chunk_metadata(metadata, chunk))
 
     def send_v1(self, metrics: list[sm.ForwardMetric]) -> None:
         """Batch API; the reference global leaves this unimplemented
@@ -408,6 +578,11 @@ class ForwardClient:
             metrics=[convert.to_pb(fm) for fm in metrics])
         self._v1(req, timeout=self.timeout_s)
 
-    def close(self) -> None:
+    def close(self, drain_spool: bool = True) -> None:
+        if self.spool is not None:
+            # graceful close fsyncs the spool tail; a simulated crash
+            # (Server.crash) passes drain_spool=False and relies on
+            # the per-append flush + recovery scan
+            self.spool.close(drain=drain_spool)
         self._pool.shutdown(wait=False)
         self.channel.close()

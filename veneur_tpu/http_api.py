@@ -125,8 +125,24 @@ def make_handler(server) -> type:
                 fw = getattr(server, "forwarder", None)
                 if fw is not None and hasattr(fw, "stats"):
                     # the forward client's retry-policy accounting:
-                    # sent / retries / dropped metric totals
+                    # sent / retries / dropped / spilled metric totals
                     stats["forward"] = fw.stats()
+                if fw is not None and hasattr(fw, "spool_stats"):
+                    sp = fw.spool_stats()
+                    if sp is not None:
+                        # the durable spool's ledger: pending depth plus
+                        # spilled/replayed/expired records AND points —
+                        # spilled == replayed + expired + dropped once
+                        # drained, so loss is reconcilable from here
+                        stats["spool"] = sp
+                ckpt = getattr(server, "checkpoint_stats", None)
+                if ckpt is not None and ckpt.get("enabled"):
+                    stats["checkpoint"] = dict(ckpt)
+                dedup = getattr(server, "dedup", None)
+                if dedup is not None:
+                    # exactly-once ledger: recorded chunk identities and
+                    # duplicates skipped (replays of delivered chunks)
+                    stats["dedup"] = dedup.stats()
                 guard = getattr(server.aggregator, "cardinality", None)
                 if guard is not None:
                     # per-tenant key-budget ledger: exact keys, evicted

@@ -18,6 +18,7 @@ packet bytes (`networking.go:326-391`).
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import logging
 import threading
 import time
@@ -33,6 +34,160 @@ from veneur_tpu.protocol import (dogstatsd_grpc_pb2, forward_pb2, metric_pb2,
 logger = logging.getLogger("veneur_tpu.sources.proxy")
 
 
+class DedupLedger:
+    """Bounded per-source ledger of imported chunk identities — the
+    receiving half of the exactly-once contract (forward/client.py
+    CHUNK_ID_KEY).  A chunk delivered both directly and via spool
+    replay (an ambiguous timeout, a sender crash mid-ack, a receiver
+    crash after import) merges ONCE: the second delivery is recognized
+    and skipped.
+
+    Concurrency: `run_once(ident, import_fn)` RESERVES the identity
+    under the ledger condition (O(1)), runs the import OUTSIDE it —
+    concurrent V1 payloads keep parsing in parallel; only the
+    aggregator-lock merge serializes, as before — and un-reserves on
+    import failure so a failed delivery can retry.  Reservation at
+    entry also makes two concurrent deliveries of the SAME chunk merge
+    once.  The checkpoint writer takes `paused()` around its snapshot:
+    new imports block and in-flight ones drain first, so a checkpoint
+    can never capture a chunk's data without its ledger entry (or vice
+    versa) — restore replays stay exact, not approximate.  The window
+    is a per-source FIFO (`window` identities, oldest evicted), sized
+    far beyond any spool's pending depth."""
+
+    def __init__(self, window: int = 4096):
+        self.window = max(16, int(window))
+        self._cond = threading.Condition()
+        # source -> (deque of idents in arrival order, set for O(1))
+        self._sources: dict = {}
+        self._active = 0          # imports between reserve and finish
+        self._inflight: set = set()   # reserved idents not yet settled
+        self._paused = False      # checkpoint cut in progress
+        self.recorded = 0
+        self.duplicates = 0
+
+    def _seen_locked(self, ident: tuple) -> bool:
+        entry = self._sources.get(ident[0])
+        return entry is not None and ident in entry[1]
+
+    def _record_locked(self, ident: tuple) -> None:
+        entry = self._sources.get(ident[0])
+        if entry is None:
+            import collections
+            entry = self._sources[ident[0]] = (collections.deque(), set())
+        dq, seen = entry
+        if ident in seen:
+            return
+        dq.append(ident)
+        seen.add(ident)
+        if len(dq) > self.window:
+            seen.discard(dq.popleft())
+        self.recorded += 1
+
+    def _unrecord_locked(self, ident: tuple) -> None:
+        entry = self._sources.get(ident[0])
+        if entry is None or ident not in entry[1]:
+            return
+        entry[1].discard(ident)
+        try:
+            entry[0].remove(ident)
+        except ValueError:
+            pass
+        self.recorded -= 1
+
+    def run_once(self, ident, import_fn):
+        """Execute `import_fn()` exactly once per identity.  Returns
+        (result, duplicate): on a duplicate the import is skipped and
+        result is None.  ident=None (an unidentified sender) always
+        imports (still draining through the pause gate so the
+        checkpoint cut covers every in-flight import)."""
+        with self._cond:
+            if ident is None:
+                while self._paused:
+                    self._cond.wait()
+            else:
+                # wait out BOTH a checkpoint cut and any in-flight
+                # import of this same identity — a duplicate must not
+                # be acked as success while the original could still
+                # fail (the spool would settle the record and the
+                # chunk would be lost silently)
+                while self._paused or ident in self._inflight:
+                    self._cond.wait()
+                if self._seen_locked(ident):
+                    # recorded AND no longer in flight = the original
+                    # import completed successfully
+                    self.duplicates += 1
+                    logger.info("dedup: skipping duplicate chunk %s",
+                                ident)
+                    return None, True
+                # reserve NOW: a concurrent duplicate delivery of the
+                # same chunk parks on _inflight above
+                self._record_locked(ident)
+                self._inflight.add(ident)
+            self._active += 1
+        try:
+            result = import_fn()
+        except BaseException:
+            with self._cond:
+                if ident is not None:
+                    # failed import: allow the sender's retry/replay
+                    self._unrecord_locked(ident)
+                    self._inflight.discard(ident)
+                self._active -= 1
+                self._cond.notify_all()
+            raise
+        with self._cond:
+            if ident is not None:
+                self._inflight.discard(ident)
+            self._active -= 1
+            self._cond.notify_all()
+        return result, False
+
+    @contextlib.contextmanager
+    def paused(self):
+        """The checkpoint cut: block new imports and drain in-flight
+        ones, so ledger + aggregator snapshot as one coherent state."""
+        with self._cond:
+            while self._paused:      # one cut at a time
+                self._cond.wait()
+            self._paused = True
+            while self._active > 0:
+                self._cond.wait()
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._paused = False
+                self._cond.notify_all()
+
+    # -- checkpoint plumbing (core/server.py) ------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able state for the crash checkpoint.  Callers that need
+        the import-atomic cut (checkpoint_now) wrap this AND the
+        aggregator snapshot in `paused()`."""
+        with self._cond:
+            return {
+                "window": self.window,
+                "sources": {
+                    src: [[s, int(e), int(i)] for (s, e, i) in dq]
+                    for src, (dq, _) in self._sources.items()},
+            }
+
+    def restore(self, state: dict) -> None:
+        with self._cond:
+            for src, idents in (state.get("sources") or {}).items():
+                for s, e, i in idents:
+                    self._record_locked((str(s), int(e), int(i)))
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {"recorded": self.recorded,
+                    "duplicates": self.duplicates,
+                    "sources": len(self._sources),
+                    "window": self.window}
+
+
 class GrpcImportServer:
     """Hosts forwardrpc.Forward (+ optional SSF/dogstatsd ingest) on one
     grpc.Server."""
@@ -44,7 +199,8 @@ class GrpcImportServer:
                  max_workers: int = 64,
                  server_credentials: Optional[grpc.ServerCredentials] = None,
                  import_payload: Optional[Callable] = None,
-                 trace_hook: Optional[Callable] = None):
+                 trace_hook: Optional[Callable] = None,
+                 dedup: Optional[DedupLedger] = None):
         """With import_metric=None the Forward service is omitted — the
         ingest-only shape of `grpc_listen_addresses` edge listeners
         (StartGRPC, networking.go:326-391), vs the global tier's
@@ -61,6 +217,7 @@ class GrpcImportServer:
         self.ingest_span = ingest_span
         self.handle_packet = handle_packet
         self.trace_hook = trace_hook
+        self.dedup = dedup
         self.imported_count = 0
         self._count_lock = threading.Lock()
         # Each long-lived client stream (a proxy destination keeps 8 of
@@ -93,6 +250,39 @@ class GrpcImportServer:
             return trace_rec.extract_contexts(
                 context.invocation_metadata())
 
+        def _chunk_ident(context):
+            """The sender's chunk identity on this RPC, or None for an
+            unidentified sender (reference veneurs, V2 streams)."""
+            from veneur_tpu.forward.client import (CHUNK_ID_KEY,
+                                                   parse_chunk_id)
+            for entry in (context.invocation_metadata() or ()):
+                try:
+                    if entry[0] == CHUNK_ID_KEY:
+                        return parse_chunk_id(entry[1])
+                except (IndexError, TypeError):
+                    continue
+            return None
+
+        def _import_v1_body(request):
+            if self.import_payload is not None:
+                # RAW bytes straight to the native scan path — no
+                # python protobuf materialization on the fleet edge
+                count, failed = self.import_payload(bytes(request))
+                if failed:
+                    logger.error("failed to import %d metrics in a V1 "
+                                 "batch", failed)
+                return count
+            ml = forward_pb2.MetricList.FromString(bytes(request))
+            count = 0
+            for pb in ml.metrics:
+                try:
+                    self.import_metric(convert.from_pb(pb))
+                    count += 1
+                except Exception as e:
+                    logger.error("failed to import metric %s: %s",
+                                 pb.name, e)
+            return count
+
         def send_metrics(request, context):
             # V1 batch import — the fleet-internal fast path.  The
             # reference leaves this UNIMPLEMENTED (sources/proxy/
@@ -102,25 +292,22 @@ class GrpcImportServer:
             # proxies/forwarders probe V1 and fall back to V2 against
             # reference globals (python-grpc streams cap at ~20k msgs/s;
             # one MetricList carries thousands per RPC).
+            #
+            # A chunk-identity header routes through the dedup ledger:
+            # a chunk already imported (delivered pre-crash, or an
+            # ambiguous timeout the sender's spool replays) is skipped
+            # — merged exactly once — and the RPC still succeeds so the
+            # replayer settles the record.
             ctxs = _trace_ctxs(context)
             start_ns = time.time_ns()
-            if self.import_payload is not None:
-                # RAW bytes straight to the native scan path — no
-                # python protobuf materialization on the fleet edge
-                count, failed = self.import_payload(bytes(request))
-                if failed:
-                    logger.error("failed to import %d metrics in a V1 "
-                                 "batch", failed)
+            if self.dedup is not None:
+                count, duplicate = self.dedup.run_once(
+                    _chunk_ident(context),
+                    lambda: _import_v1_body(request))
+                if duplicate:
+                    return empty_pb2.Empty()
             else:
-                ml = forward_pb2.MetricList.FromString(bytes(request))
-                count = 0
-                for pb in ml.metrics:
-                    try:
-                        self.import_metric(convert.from_pb(pb))
-                        count += 1
-                    except Exception as e:
-                        logger.error("failed to import metric %s: %s",
-                                     pb.name, e)
+                count = _import_v1_body(request)
             with self._count_lock:
                 self.imported_count += count
             if ctxs:

@@ -105,7 +105,23 @@ TOPOLOGY_ARMS: list[ChaosArm] = [
              {"op": "storm"}, kind="topology"),
 ]
 
-ALL_ARMS: list[ChaosArm] = CHAOS_ARMS + TOPOLOGY_ARMS
+# hard-crash arms (ISSUE 10): a node dies with NO drain (simulated
+# kill -9 — in-memory state dropped, spool/checkpoint dirs kept) and is
+# revived from disk.  local-crash-mid-interval and
+# global-crash-with-spill-replay must conserve EXACTLY (checkpoint
+# restore + spool replay + dedup ledger); crash-with-spool-expiry loses
+# data by construction and must account every lost point in
+# spool.expired.
+CRASH_ARMS: list[ChaosArm] = [
+    ChaosArm("local-crash-mid-interval", "server.crash", "",
+             "conserved", {"op": "local-crash"}, kind="crash"),
+    ChaosArm("global-crash-with-spill-replay", "server.crash", "",
+             "conserved", {"op": "global-crash"}, kind="crash"),
+    ChaosArm("crash-with-spool-expiry", "server.crash", "",
+             "accounted", {"op": "spool-expiry"}, kind="crash"),
+]
+
+ALL_ARMS: list[ChaosArm] = CHAOS_ARMS + TOPOLOGY_ARMS + CRASH_ARMS
 
 
 def arm_by_name(name: str) -> ChaosArm:
@@ -129,6 +145,12 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     every settled interval forming one complete 3-tier trace with zero
     orphans — duplicate retry attempts must dedup to one delivered
     edge (trace/assembly.py)."""
+    if arm.kind == "crash":
+        return _run_crash_arm(arm, seed=seed, n_locals=n_locals,
+                              counter_keys=counter_keys,
+                              histo_keys=histo_keys, set_keys=set_keys,
+                              histo_samples=histo_samples,
+                              witness=witness, trace=trace)
     if arm.kind == "topology":
         if arm.kwargs.get("op") == "storm":
             return _run_cardinality_storm(arm, seed=seed,
@@ -201,12 +223,16 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     return row
 
 
-def _apply_trace_gate(row: dict, trace_spans: list[dict]) -> None:
+def _apply_trace_gate(row: dict, trace_spans: list[dict],
+                      require_proxy: bool = True) -> None:
     """Fold the cross-tier trace assembly into a chaos row: every
-    settled interval must form one complete 3-tier trace with zero
-    orphan spans (retried attempts dedup to one delivered edge)."""
+    settled interval must form one complete trace with zero orphan
+    spans (retried attempts dedup to one delivered edge).
+    require_proxy=False accepts the 2-tier local->global shape of the
+    direct-mode crash arms."""
     from veneur_tpu.trace import assembly
-    rep = assembly.flush_report(trace_spans or [])
+    rep = assembly.flush_report(trace_spans or [],
+                                require_proxy=require_proxy)
     row["trace_complete"] = rep["complete"]
     row["trace_orphans"] = rep["orphans"]
     row["trace_intervals"] = rep["intervals"]
@@ -420,6 +446,199 @@ def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
         "under_budget": under_budget,
         "ok": ok,
     }
+
+
+def _wait_until(cond, timeout_s: float = 15.0, what: str = "") -> None:
+    import time
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"crash arm: {what or 'condition'} not reached "
+                       f"within {timeout_s}s")
+
+
+def _crash_row(arm: ChaosArm, acct: dict, counters: dict,
+               routing: dict, fired: int) -> dict:
+    conserved = counters["exact"]
+    accounted = conserved or acct["dropped_total"] > 0
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": arm.kwargs["op"],
+        "expect": arm.expect,
+        "fired": fired,
+        "conserved": conserved,
+        "counter_deficit": counters["deficit"],
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": accounted,
+        "spool": acct["spool"],
+        "checkpoint": acct["checkpoint"],
+        "dedup": acct["dedup"],
+    }
+
+
+def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
+                   counter_keys: int = 4, histo_keys: int = 1,
+                   set_keys: int = 1, histo_samples: int = 40,
+                   witness=None, trace: bool = False) -> dict:
+    """One crash cell.  Three ops:
+
+    local-crash      proxied: ingest interval 2 into the local, force a
+                     checkpoint, kill -9, revive from disk, flush —
+                     conservation must be EXACT (the checkpoint carried
+                     the arenas AND the interval count, so chunk
+                     identities don't collide either).
+    global-crash     direct (no proxy — the shape where a global crash
+                     hits the LOCAL's forward edge): kill the global
+                     after checkpointing it, flush the local into the
+                     outage so retries exhaust into the spool, revive,
+                     let the replayer drain, then INJECT a duplicate
+                     delivery of a replayed chunk — the restored dedup
+                     ledger must merge it once and conservation stays
+                     exact.
+    spool-expiry     direct, tiny spool_max_age, global stays down past
+                     it: every spilled point must land in spool.expired
+                     (visibly-accounted loss, never silent)."""
+    op = arm.kwargs["op"]
+    direct = op != "local-crash"
+    spec = ClusterSpec(
+        n_locals=n_locals, n_globals=1 if direct else 2,
+        durable=True, direct=direct,
+        forward_max_retries=1, forward_retry_backoff=0.02,
+        spool_replay_interval_s=0.05,
+        spool_max_age_s=0.3 if op == "spool-expiry" else 60.0,
+        breaker_failure_threshold=2, breaker_reset_timeout=0.4,
+        discovery_interval_s=0.2, lock_witness=witness)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    fired = 0
+    extra: dict = {}
+    try:
+        cluster.start()
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(n_locals)))
+        if op == "local-crash":
+            lines = traffic.next_interval(n_locals)
+            for i, ls in enumerate(lines):
+                n = cluster.send_lines(i, ls)
+                if n:
+                    cluster.wait_ingested(i, n)
+            # the cut: everything ingested so far is on disk; the
+            # crash then drops every in-memory structure
+            assert cluster.checkpoint_local(0)
+            cluster.crash_local(0)
+            cluster.revive_local(0)
+            fired = cluster.locals[0].server.checkpoint_stats["restores"]
+            cluster.flush_locals()
+            cluster.settle()
+            per_interval.append(cluster.flush_globals())
+        elif op == "global-crash":
+            # persist the global's (arenas + dedup ledger) cut, then
+            # kill it with no drain
+            assert cluster.checkpoint_global(0)
+            cluster.crash_global(0)
+            lines = traffic.next_interval(n_locals)
+            for i, ls in enumerate(lines):
+                n = cluster.send_lines(i, ls)
+                if n:
+                    cluster.wait_ingested(i, n)
+            cluster.flush_locals()     # retries exhaust -> spool spill
+            fwd = cluster.locals[0].server.forwarder
+            _wait_until(lambda: fwd.spool_stats()["spilled"] > 0,
+                        what="spill")
+            # capture one spooled chunk NOW (its segment is deleted
+            # once replayed) for the duplicate-delivery injection
+            rec = fwd.spool.peek(1)[0]
+            body = fwd.spool.read_body(rec)
+            cluster.revive_global(0)
+            g = cluster.globals[0].server
+            fired = g.checkpoint_stats["restores"]
+            # ledger persistence: the revived global already knows the
+            # pre-crash intervals' chunk identities
+            extra["ledger_restored"] = g.dedup.stats()["recorded"]
+            cluster.wait_spool_drained()
+            cluster.settle()
+            # the dedup proof: deliver a REPLAYED chunk a second time
+            # under its recorded identity — it must merge exactly once
+            fwd._replay_send(rec, body)
+            extra["duplicates_skipped"] = g.dedup.stats()["duplicates"]
+            per_interval.append(cluster.flush_globals())
+        else:   # spool-expiry
+            cluster.crash_global(0)
+            lines = traffic.next_interval(n_locals)
+            for i, ls in enumerate(lines):
+                n = cluster.send_lines(i, ls)
+                if n:
+                    cluster.wait_ingested(i, n)
+            cluster.flush_locals()
+            fwd = cluster.locals[0].server.forwarder
+            _wait_until(lambda: fwd.spool_stats()["spilled"] > 0,
+                        what="spill")
+            # the destination stays down past spool_max_age: every
+            # record must expire with accounting
+            _wait_until(
+                lambda: (fwd.spool_stats()["pending_records"] == 0
+                         and fwd.spool_stats()["expired"] > 0),
+                what="expiry")
+            cluster.revive_global(0)
+            fired = fwd.spool_stats()["expired"]
+            cluster.settle()
+            per_interval.append(cluster.flush_globals())
+        acct = cluster.accounting()
+        trace_spans = cluster.collect_trace_spans() if trace else None
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    row = _crash_row(arm, acct, counters, routing, fired)
+    row.update(extra)
+    sp = acct["spool"]
+    closure = (sp["spilled"]
+               == sp["replayed"] + sp["expired"] + sp["dropped"]
+               + sp["pending"])
+    row["spool_closure"] = closure
+    if op == "local-crash":
+        row["ok"] = (fired >= 1 and row["conserved"]
+                     and row["routing_exclusive"])
+    elif op == "global-crash":
+        row["ok"] = (fired >= 1 and row["conserved"]
+                     and row["routing_exclusive"] and closure
+                     and sp["replayed"] > 0
+                     and extra.get("ledger_restored", 0) > 0
+                     and extra.get("duplicates_skipped", 0) >= 1)
+    else:
+        # loss by construction — but every lost point must be in the
+        # expired ledger, and nothing may ALSO have been delivered
+        row["ok"] = (not row["conserved"] and row["no_silent_loss"]
+                     and closure and sp["expired_points"] > 0
+                     and sp["replayed"] == 0
+                     and row["counter_deficit"] > 0)
+    if trace:
+        if op == "spool-expiry":
+            # delivery never happened for the expired interval, so its
+            # trace CANNOT be complete — the honest gate here is zero
+            # orphans (no broken causal links) with the incompleteness
+            # reported, not asserted away
+            from veneur_tpu.trace import assembly
+            rep = assembly.flush_report(trace_spans or [],
+                                        require_proxy=False)
+            row["trace_complete"] = rep["complete"]
+            row["trace_orphans"] = rep["orphans"]
+            row["trace_intervals"] = rep["intervals"]
+            row["ok"] = bool(row["ok"] and rep["orphans"] == 0)
+        else:
+            _apply_trace_gate(row, trace_spans,
+                              require_proxy=not direct)
+    return row
 
 
 def run_chaos_matrix(arms=None, seed: int = 0, **kwargs) -> list[dict]:

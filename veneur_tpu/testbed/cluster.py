@@ -15,7 +15,10 @@ assertable.
 
 from __future__ import annotations
 
+import os
+import shutil
 import socket
+import tempfile
 import time
 from dataclasses import dataclass, field
 
@@ -64,6 +67,18 @@ class ClusterSpec:
     # LockWitness (Cluster.witness); a LockWitness instance = share one
     # registry across several clusters (the chaos matrix)
     lock_witness: object = None
+    # crash durability (the ISSUE-10 arms): every node gets its own
+    # spool + checkpoint directory under one tempdir (removed at
+    # cluster stop); crash_*/revive_* then prove recovery from disk
+    durable: bool = False
+    spool_max_age_s: float = 60.0
+    spool_max_bytes: int = 8 << 20
+    spool_replay_interval_s: float = 0.05
+    checkpoint_interval_s: float = 0.0   # 0 = manual/shutdown only
+    # direct mode: NO proxy tier — every local forwards straight to
+    # global[0]'s gRPC import (the locals-direct-to-global fleet shape;
+    # what makes a global crash exercise the LOCAL's spool)
+    direct: bool = False
 
 
 @dataclass
@@ -74,6 +89,10 @@ class _Node:
     udp_addr: tuple = None
     tx: socket.socket = None
     ingest_base: int = 0
+    # crash durability: this node's on-disk state (survives crash_*)
+    checkpoint_dir: str = ""
+    spool_dir: str = ""
+    grpc_port: int = 0       # global tier: pinned so a revival rebinds it
 
 
 class Cluster:
@@ -88,6 +107,10 @@ class Cluster:
         # globals retired by topology arms: their flight-recorder rings
         # still hold this run's spans, so trace assembly keeps them
         self._retired_globals: list[_Node] = []
+        # crashed locals' shells: ring kept for trace assembly
+        self._retired_locals: list[_Node] = []
+        self._durable_root = (tempfile.mkdtemp(prefix="tb-durable-")
+                              if spec.durable else "")
         self.witness = None
         self._fp_unwitness = None
         if spec.lock_witness:
@@ -104,68 +127,148 @@ class Cluster:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def _boot_global(self) -> _Node:
+    def _node_dirs(self, name: str) -> tuple[str, str]:
+        """(checkpoint_dir, spool_dir) for a durable node, ("", "")
+        otherwise.  The dirs are stable per node NAME, so a revival
+        finds the crashed instance's disk state."""
+        if not self._durable_root:
+            return "", ""
+        base = os.path.join(self._durable_root, name)
+        ckpt, spool = (os.path.join(base, "ckpt"),
+                       os.path.join(base, "spool"))
+        os.makedirs(ckpt, exist_ok=True)
+        os.makedirs(spool, exist_ok=True)
+        return ckpt, spool
+
+    def _boot_global(self, port: int = 0,
+                     hostname: str = "") -> _Node:
         spec = self.spec
-        i = self._global_seq
-        self._global_seq += 1
+        if not hostname:
+            hostname = f"tb-g{self._global_seq}"
+            self._global_seq += 1
+        ckpt_dir, _ = self._node_dirs(hostname)
         sink = simple_sinks.ChannelMetricSink()
         srv = Server(config_mod.Config(
-            grpc_address="127.0.0.1:0",
+            grpc_address=f"127.0.0.1:{port}",
             interval=spec.interval_s,
             percentiles=list(spec.percentiles),
             aggregates=list(spec.aggregates),
             mesh_devices=spec.mesh_devices,
-            hostname=f"tb-g{i}"),
+            checkpoint_dir=ckpt_dir,
+            checkpoint_interval=spec.checkpoint_interval_s,
+            hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
         srv.start()
-        return _Node(srv, sink)
+        return _Node(srv, sink, checkpoint_dir=ckpt_dir,
+                     grpc_port=srv.grpc_import.port)
+
+    def _boot_local(self, i: int, forward_address: str) -> _Node:
+        spec = self.spec
+        hostname = f"tb-l{i}"
+        ckpt_dir, spool_dir = self._node_dirs(hostname)
+        sink = simple_sinks.ChannelMetricSink()
+        srv = Server(config_mod.Config(
+            statsd_listen_addresses=["udp://127.0.0.1:0"],
+            forward_address=forward_address,
+            forward_timeout=spec.forward_timeout,
+            forward_max_retries=spec.forward_max_retries,
+            forward_retry_backoff=spec.forward_retry_backoff,
+            interval=spec.interval_s,
+            percentiles=list(spec.percentiles),
+            aggregates=list(spec.aggregates),
+            cardinality_key_budget=spec.cardinality_key_budget,
+            cardinality_tenant_tag=spec.cardinality_tenant_tag,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_interval=spec.checkpoint_interval_s,
+            spool_dir=spool_dir,
+            spool_max_age=spec.spool_max_age_s,
+            spool_max_bytes=spec.spool_max_bytes,
+            spool_replay_interval=spec.spool_replay_interval_s,
+            hostname=hostname),
+            extra_metric_sinks=[sink])
+        srv.lock_witness = self.witness
+        srv.start()
+        _, addr = srv.statsd_addrs[0]
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        return _Node(srv, sink, udp_addr=addr, tx=tx,
+                     checkpoint_dir=ckpt_dir, spool_dir=spool_dir)
+
+    def _forward_address(self) -> str:
+        if self.spec.direct:
+            return f"127.0.0.1:{self.globals[0].grpc_port}"
+        return f"127.0.0.1:{self.proxy.grpc_port}"
 
     def start(self) -> "Cluster":
         spec = self.spec
         for _ in range(spec.n_globals):
             self.globals.append(self._boot_global())
-        self.proxy = Proxy(ProxyConfig(
-            static_destinations=[
-                f"127.0.0.1:{g.server.grpc_import.port}"
-                for g in self.globals],
-            discovery_interval=spec.discovery_interval_s,
-            send_buffer_size=spec.send_buffer_size,
-            proxy_send_timeout=spec.proxy_send_timeout,
-            proxy_dial_timeout=spec.proxy_dial_timeout,
-            breaker_failure_threshold=spec.breaker_failure_threshold,
-            breaker_reset_timeout=spec.breaker_reset_timeout,
-            reshard_handoff_timeout=spec.reshard_handoff_timeout))
-        if self.witness is not None:
-            from veneur_tpu.analysis import witness as witness_mod
-            witness_mod.install_proxy(self.proxy, self.witness)
-        self.proxy.start()
+        if not spec.direct:
+            self.proxy = Proxy(ProxyConfig(
+                static_destinations=[
+                    f"127.0.0.1:{g.server.grpc_import.port}"
+                    for g in self.globals],
+                discovery_interval=spec.discovery_interval_s,
+                send_buffer_size=spec.send_buffer_size,
+                proxy_send_timeout=spec.proxy_send_timeout,
+                proxy_dial_timeout=spec.proxy_dial_timeout,
+                breaker_failure_threshold=spec.breaker_failure_threshold,
+                breaker_reset_timeout=spec.breaker_reset_timeout,
+                reshard_handoff_timeout=spec.reshard_handoff_timeout))
+            if self.witness is not None:
+                from veneur_tpu.analysis import witness as witness_mod
+                witness_mod.install_proxy(self.proxy, self.witness)
+            self.proxy.start()
         for i in range(spec.n_locals):
-            sink = simple_sinks.ChannelMetricSink()
-            srv = Server(config_mod.Config(
-                statsd_listen_addresses=["udp://127.0.0.1:0"],
-                forward_address=f"127.0.0.1:{self.proxy.grpc_port}",
-                forward_timeout=spec.forward_timeout,
-                forward_max_retries=spec.forward_max_retries,
-                forward_retry_backoff=spec.forward_retry_backoff,
-                interval=spec.interval_s,
-                percentiles=list(spec.percentiles),
-                aggregates=list(spec.aggregates),
-                cardinality_key_budget=spec.cardinality_key_budget,
-                cardinality_tenant_tag=spec.cardinality_tenant_tag,
-                hostname=f"tb-l{i}"),
-                extra_metric_sinks=[sink])
-            srv.lock_witness = self.witness
-            srv.start()
-            _, addr = srv.statsd_addrs[0]
-            tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            self.locals.append(_Node(srv, sink, udp_addr=addr, tx=tx))
+            self.locals.append(
+                self._boot_local(i, self._forward_address()))
         if spec.http_api:
             from veneur_tpu.http_api import HttpApi
             self.http = HttpApi(self.locals[0].server, "127.0.0.1:0")
             self.http.start()
         self._started = True
         return self
+
+    # -- crash / revive (simulated kill -9 + supervisor restart) -----------
+
+    def checkpoint_local(self, idx: int) -> bool:
+        return self.locals[idx].server.checkpoint_now()
+
+    def checkpoint_global(self, idx: int) -> bool:
+        return self.globals[idx].server.checkpoint_now()
+
+    def crash_local(self, idx: int) -> None:
+        """Tear the local down with NO drain: no final flush, no
+        shutdown checkpoint, no spool drain — in-memory state is
+        dropped, the node's disk dirs are kept."""
+        node = self.locals[idx]
+        node.server.crash()
+        try:
+            node.tx.close()
+        except OSError:
+            pass
+        self._retired_locals.append(node)
+
+    def revive_local(self, idx: int) -> None:
+        """Boot a replacement over the crashed node's disk state (same
+        hostname => same checkpoint/spool dirs); the new instance
+        restores arenas + interval and the spool replayer re-delivers
+        whatever the crash stranded."""
+        self.locals[idx] = self._boot_local(idx, self._forward_address())
+
+    def crash_global(self, idx: int) -> None:
+        node = self.globals[idx]
+        node.server.crash()
+        self._retired_globals.append(node)
+
+    def revive_global(self, idx: int) -> None:
+        """Revive on the SAME port (locals' forward channels and the
+        proxy ring re-reach it without reconfiguration) from the same
+        checkpoint dir."""
+        old = self.globals[idx]
+        self.globals[idx] = self._boot_global(
+            port=old.grpc_port,
+            hostname=old.server.config.hostname)
 
     # -- elastic topology (the ROADMAP-#4 scale arms) ----------------------
 
@@ -228,6 +331,8 @@ class Cluster:
         if self._fp_unwitness is not None:
             self._fp_unwitness()
             self._fp_unwitness = None
+        if self._durable_root:
+            shutil.rmtree(self._durable_root, ignore_errors=True)
 
     def __enter__(self) -> "Cluster":
         return self.start()
@@ -295,24 +400,48 @@ class Cluster:
             n.server._forward_slots._value == n.server.FORWARD_MAX_IN_FLIGHT
             for n in self.locals)
 
+    def _proxy_stats(self) -> dict:
+        if self.proxy is None:
+            return {"received": 0, "routed": 0, "dropped": 0,
+                    "no_destination": 0, "rerouted": 0}
+        with self.proxy._stats_lock:
+            return dict(self.proxy.stats)
+
+    def _spool_counts(self) -> list[tuple]:
+        """Per-local settled spool ledgers (spilled/replayed/expired/
+        dropped — NOT replay attempts, which tick while a destination
+        stays down and would keep settle() from ever stabilizing)."""
+        out = []
+        for n in self.locals:
+            sp = (n.server.forwarder.spool_stats()
+                  if hasattr(n.server.forwarder, "spool_stats")
+                  else None)
+            if sp is not None:
+                out.append((sp["spilled"], sp["replayed"],
+                            sp["expired"], sp["dropped"],
+                            sp["pending_records"]))
+        return out
+
     def _pipe_counters(self) -> tuple:
         """Composite counter snapshot across the whole pipe; settle()
         waits until it stops moving."""
         fw = [n.server.forwarder.stats() if n.server.forwarder is not None
               else {} for n in self.locals]
-        with self.proxy._stats_lock:
-            pstats = dict(self.proxy.stats)
-        dest = self.proxy.destinations
+        dest_totals = (self.proxy.destinations.totals()
+                       if self.proxy is not None else {})
         return (
             tuple(sorted((k, v) for d in fw for k, v in d.items())),
-            tuple(sorted(pstats.items())),
-            tuple(sorted(dest.totals().items())),
+            tuple(sorted(self._proxy_stats().items())),
+            tuple(sorted(dest_totals.items())),
+            tuple(self._spool_counts()),
             tuple(g.server.aggregator.imported for g in self.globals),
             tuple(getattr(g.server.grpc_import, "imported_count", 0)
                   for g in self.globals),
         )
 
     def _buffers_empty(self) -> bool:
+        if self.proxy is None:
+            return True
         dest = self.proxy.destinations
         with dest._lock:
             return all(d._buffered == 0 for d in dest._dests.values())
@@ -339,6 +468,19 @@ class Cluster:
             time.sleep(poll_s)
         raise TimeoutError("cluster did not settle "
                            f"within {timeout_s}s")
+
+    def wait_spool_drained(self, timeout_s: float = 15.0) -> None:
+        """Block until every local's durable spool has settled every
+        record (replayed, expired or dropped — pending hits zero)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            counts = self._spool_counts()
+            if all(c[4] == 0 for c in counts):
+                return
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"spool did not drain within {timeout_s}s: "
+            f"{[n.server.forwarder.spool_stats() for n in self.locals]}")
 
     def flush_locals(self) -> None:
         for n in self.locals:
@@ -412,6 +554,13 @@ class Cluster:
         for i, n in enumerate(self.locals):
             spans.extend(dict(r, tier=f"local-{i}")
                          for r in n.server.flight_recorder.snapshot())
+        for n in self._retired_locals:
+            # a crashed local's ring still holds the pre-crash spans of
+            # this run's traces (same tier label as its replacement:
+            # hostname "tb-lN" -> "local-N")
+            tier = "local-" + n.server.config.hostname[4:]
+            spans.extend(dict(r, tier=tier)
+                         for r in n.server.flight_recorder.snapshot())
         if self.proxy is not None:
             spans.extend(dict(r, tier="proxy")
                          for r in self.proxy.recorder.snapshot())
@@ -427,15 +576,46 @@ class Cluster:
         did with it, what the globals imported, and every drop counter a
         metric could have died in.  `dropped_total` is the no-silent-loss
         denominator the chaos matrix checks deficits against."""
-        fw = {"sent": 0, "retries": 0, "dropped": 0}
+        fw = {"sent": 0, "retries": 0, "dropped": 0, "spilled": 0}
         for n in self.locals:
             f = n.server.forwarder
             if f is not None and hasattr(f, "stats"):
                 for k, v in f.stats().items():
-                    fw[k] += v
-        with self.proxy._stats_lock:
-            pstats = dict(self.proxy.stats)
-        dest_totals = self.proxy.destinations.totals()
+                    fw[k] = fw.get(k, 0) + v
+        pstats = self._proxy_stats()
+        dest_totals = (self.proxy.destinations.totals()
+                       if self.proxy is not None
+                       else {"sent": 0, "dropped": 0})
+        # durable-spool ledger across the local tier (zeros when the
+        # spool is off — keys still promised in the dryrun JSON)
+        spool = {"spilled": 0, "replayed": 0, "expired": 0,
+                 "dropped": 0, "pending": 0, "spilled_points": 0,
+                 "replayed_points": 0, "expired_points": 0,
+                 "dropped_points": 0}
+        for n in self.locals:
+            sp = (n.server.forwarder.spool_stats()
+                  if hasattr(n.server.forwarder, "spool_stats")
+                  else None)
+            if sp is not None:
+                for k in ("spilled", "replayed", "expired", "dropped",
+                          "spilled_points", "replayed_points",
+                          "expired_points", "dropped_points"):
+                    spool[k] += sp[k]
+                spool["pending"] += sp["pending_records"]
+        # checkpoint + dedup ledgers across every live node
+        ckpt = {"writes": 0, "restores": 0, "errors": 0, "age_ms": 0.0}
+        for n in self.locals + self.globals:
+            cs = n.server.checkpoint_stats
+            ckpt["writes"] += cs["writes"]
+            ckpt["restores"] += cs["restores"]
+            ckpt["errors"] += cs["errors"]
+            ckpt["age_ms"] = max(ckpt["age_ms"], cs["age_ms"])
+        dedup = {"recorded": 0, "duplicates": 0}
+        for n in self.globals:
+            if n.server.dedup is not None:
+                ds = n.server.dedup.stats()
+                dedup["recorded"] += ds["recorded"]
+                dedup["duplicates"] += ds["duplicates"]
         # per-tenant quota/eviction totals across the local tier (zeros
         # when the defense is off — the keys are still promised)
         card = {"keys_evicted": 0, "tenants_over_budget": 0,
@@ -450,12 +630,19 @@ class Cluster:
         return {
             "forward": fw,
             "cardinality": card,
-            "reshard": self.proxy.destinations.reshard_stats(),
+            "spool": spool,
+            "checkpoint": ckpt,
+            "dedup": dedup,
+            "reshard": (self.proxy.destinations.reshard_stats()
+                        if self.proxy is not None
+                        else {"epochs": 0, "moved_total": 0,
+                              "handoff_total": 0, "last": None}),
             "forward_slots_dropped": sum(
                 n.server.forward_dropped for n in self.locals),
             "proxy": pstats,
             "destination_totals": dest_totals,
-            "breakers": self.proxy.destinations.breaker_stats(),
+            "breakers": (self.proxy.destinations.breaker_stats()
+                         if self.proxy is not None else {}),
             "imported": sum(
                 getattr(g.server.grpc_import, "imported_count", 0)
                 for g in self.globals),
@@ -463,10 +650,14 @@ class Cluster:
                                  for n in self.locals),
             "global_flushes": sum(n.server.flush_count
                                   for n in self.globals),
+            # spool expiry and replay-drops are VISIBLE loss channels:
+            # they join the no-silent-loss denominator
             "dropped_total": (fw["dropped"]
                               + sum(n.server.forward_dropped
                                     for n in self.locals)
                               + pstats["dropped"]
                               + pstats["no_destination"]
-                              + dest_totals["dropped"]),
+                              + dest_totals["dropped"]
+                              + spool["expired_points"]
+                              + spool["dropped_points"]),
         }

@@ -26,7 +26,8 @@ from veneur_tpu.testbed.traffic import TrafficGen
 PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
-    "routing_exclusive", "chaos_matrix", "lock_witness", "trace", "ok",
+    "routing_exclusive", "chaos_matrix", "lock_witness", "trace",
+    "spool", "checkpoint", "ok",
 ]
 
 
@@ -147,6 +148,14 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         # cardinality-defense ledger (zeros with the budget off) and the
         # ring's cumulative sampled key movement across reshard epochs
         "cardinality": acct["cardinality"],
+        # crash-durability ledgers (zeros when the dryrun ran without
+        # durable dirs — the crash chaos arms exercise them): spilled/
+        # replayed/expired spool totals + checkpoint restores/age
+        "spool": {"spilled": acct["spool"]["spilled"],
+                  "replayed": acct["spool"]["replayed"],
+                  "expired": acct["spool"]["expired"]},
+        "checkpoint": {"restores": acct["checkpoint"]["restores"],
+                       "age_ms": acct["checkpoint"]["age_ms"]},
         "reshard_moved": acct["reshard"]["moved_total"],
         "conservation": {
             "counters_exact": counters["exact"],

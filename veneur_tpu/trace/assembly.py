@@ -32,6 +32,10 @@ from typing import Optional
 ROOT_NAME = "flush"
 FORWARD_NAME = "flush.forward"
 ATTEMPT_NAME = "forward.attempt"
+# a spool replay's delivery span (forward/client.py _replay_send):
+# continues the original interval's flush.forward context, so a chunk
+# delivered after a crash still closes that interval's trace
+REPLAY_NAME = "forward.replay"
 PROXY_NAME = "proxy.route"
 IMPORT_NAME = "global.import"
 SEG_PREFIX = "flush.seg."
@@ -88,7 +92,8 @@ def delivered_edges(trace_spans: list[dict]) -> dict[str, int]:
             # the 3-tier completeness gate separately demands a proxy
             # edge, so the testbed contract is unchanged
             if (chain[-1]["name"] == ROOT_NAME
-                    and ATTEMPT_NAME in names):
+                    and (ATTEMPT_NAME in names
+                         or REPLAY_NAME in names)):
                 imports.add(s.get("tier", s.get("service", "global")))
     return {"proxy": len(proxies), "global": len(imports)}
 
@@ -114,8 +119,11 @@ def critical_path_ms(trace_spans: list[dict],
 
 
 def interval_row(root: dict, trace_spans: list[dict],
-                 joined_flushes: Optional[list[dict]] = None) -> dict:
-    """One row of the per-interval critical-path table."""
+                 joined_flushes: Optional[list[dict]] = None,
+                 require_proxy: bool = True) -> dict:
+    """One row of the per-interval critical-path table.
+    `require_proxy=False` relaxes completeness to the 2-tier shape of
+    a locals-direct-to-global fleet (the crash arms' direct mode)."""
     segments = {s["name"][len(SEG_PREFIX):]: _span_ms(s)
                 for s in trace_spans
                 if s["name"].startswith(SEG_PREFIX)
@@ -132,7 +140,8 @@ def interval_row(root: dict, trace_spans: list[dict],
     forwarded = int(root["tags"].get("forward_metrics", "0") or 0)
     sampled = root["tags"].get("sampled", "true") == "true"
     complete = (not sampled or forwarded == 0
-                or (edges["proxy"] >= 1 and edges["global"] >= 1
+                or ((edges["proxy"] >= 1 or not require_proxy)
+                    and edges["global"] >= 1
                     and not orphans))
     return {
         "interval": int(root["tags"].get("interval", "0") or 0),
@@ -156,7 +165,8 @@ def interval_row(root: dict, trace_spans: list[dict],
     }
 
 
-def flush_report(spans: list[dict]) -> dict:
+def flush_report(spans: list[dict],
+                 require_proxy: bool = True) -> dict:
     """The dryrun's promised ``trace`` report: every *local* flush root
     becomes one row; ``complete`` holds iff every sampled forwarding
     interval assembled into a full 3-tier trace with zero orphans
@@ -183,7 +193,8 @@ def flush_report(spans: list[dict]) -> dict:
         for s in tspans:
             if (s["name"] == ROOT_NAME and s["parent_id"] == 0
                     and s["tags"].get("tier") == "local"):
-                rows.append(interval_row(s, tspans, joined.get(tid)))
+                rows.append(interval_row(s, tspans, joined.get(tid),
+                                         require_proxy=require_proxy))
     rows.sort(key=lambda r: (r["tier"], r["interval"]))
     complete = bool(rows) and all(r["complete"] for r in rows)
     return {
