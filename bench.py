@@ -1034,6 +1034,100 @@ def bench_trace_overhead(n_keys: int = 20_000, iters: int = 20,
         srv_off.shutdown()
 
 
+def bench_egress_overhead(n_keys: int = 20_000, iters: int = 20,
+                          samples_per_key: int = 2,
+                          n_sinks: int = 3) -> float:
+    """Flush-path cost of the egress data plane with `n_sinks` metric
+    sinks attached (ISSUE-11 acceptance: <5% of flush p50 with 3+
+    sinks at the 1M-key shape; this arm runs the same paired design at
+    the CI shape, and the driver-host sweep validates at 1M).
+
+    Before the egress plane, sink fan-out ran synchronously under the
+    flush serialization lock — N sinks meant N filter+serialize+flush
+    walks on the flush path.  Now `_flush_locked` only ENQUEUES one
+    job per sink lane, so the measured delta is the handoff cost.
+    PAIRED design (the bench_trace_overhead pattern): a server with
+    `n_sinks` blackhole sinks and a sink-less twin flush the same
+    refill alternately; the number is the median paired delta as a
+    percent of the sink-less p50."""
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+    from veneur_tpu.sinks.simple import BlackholeMetricSink
+
+    def boot(with_sinks: bool) -> Server:
+        sinks = ([BlackholeMetricSink() for _ in range(n_sinks)]
+                 if with_sinks else [])
+        cfg = config_mod.Config(
+            interval=10.0, percentiles=list(PERCENTILES),
+            hostname="egress-bench", trace_flush_enabled=False)
+        srv = Server(cfg, extra_metric_sinks=sinks)
+        srv.start()
+        return srv
+
+    def prime(srv: Server):
+        agg = srv.aggregator
+        rows = np.empty(n_keys, np.int64)
+        with agg.lock:
+            for i in range(n_keys):
+                rows[i] = agg.digests.row_for(
+                    MetricKey(f"eb.k{i}", sm.TYPE_HISTOGRAM, ""),
+                    MetricScope.GLOBAL_ONLY, [])
+        return rows
+
+    srv_on, srv_off = boot(True), boot(False)
+    try:
+        rows_on, rows_off = prime(srv_on), prime(srv_off)
+        rng = np.random.default_rng(7)
+        wts = np.ones(n_keys * samples_per_key)
+
+        def flush_once(srv: Server, rows, vals) -> float:
+            agg = srv.aggregator
+            with agg.lock:
+                agg.digests.sample_batch(
+                    np.tile(rows, samples_per_key), vals, wts)
+                agg.digests.touched[rows] = True
+            agg.sync_staged(min_samples=1)
+            t0 = time.perf_counter()
+            srv.flush()
+            return time.perf_counter() - t0
+
+        deltas = []
+        offs = []
+
+        def flush_on(vals) -> float:
+            t = flush_once(srv_on, rows_on, vals)
+            # settle IMMEDIATELY after the sink-ful arm's measurement:
+            # its lanes must not keep filtering/serializing on the same
+            # CPUs while the sink-less twin's flush is being timed (that
+            # would inflate t_off and bias the reported overhead low),
+            # and every iteration starts from identical queue depth
+            srv_on.egress.settle(timeout_s=10.0)
+            return t
+
+        for i in range(iters + 2):
+            vals = rng.gamma(2.0, 10.0, n_keys * samples_per_key)
+            if i % 2:
+                t_on = flush_on(vals)
+                t_off = flush_once(srv_off, rows_off, vals)
+            else:
+                t_off = flush_once(srv_off, rows_off, vals)
+                t_on = flush_on(vals)
+            if i >= 2:      # first pairs pay compile/warmup
+                deltas.append(t_on - t_off)
+                offs.append(t_off)
+        p50_off = float(np.percentile(offs, 50))
+        pct = float(np.percentile(deltas, 50)) / p50_off * 100.0
+        log(f"egress-overhead arm: sink-less p50 {p50_off * 1e3:.3f} ms, "
+            f"{n_sinks} sinks, median paired delta "
+            f"{np.percentile(deltas, 50) * 1e6:.0f} us -> {pct:+.2f}%")
+        return round(pct, 2)
+    finally:
+        srv_on.shutdown()
+        srv_off.shutdown()
+
+
 def bench_checkpoint_overhead(n_keys: int = 20_000, iters: int = 40,
                               samples_per_key: int = 2) -> float:
     """Steady-state cost of crash checkpointing on the flush path
@@ -1213,6 +1307,14 @@ def main() -> None:
     except Exception as e:
         log(f"checkpoint-overhead arm failed: {e}")
         result["checkpoint_overhead_pct"] = {"error": str(e)[:200]}
+    # egress fan-out cost (ISSUE-11 acceptance: <5% of flush p50 with
+    # 3+ sinks attached — the flush path only enqueues; sink I/O runs
+    # on the lanes).  Promised key: error value on arm failure.
+    try:
+        result["egress_overhead_pct"] = bench_egress_overhead()
+    except Exception as e:
+        log(f"egress-overhead arm failed: {e}")
+        result["egress_overhead_pct"] = {"error": str(e)[:200]}
     try:
         dvec = bench_depth_vector()
         if dvec is not None:
@@ -1300,7 +1402,8 @@ def main() -> None:
                 "device_only_p50_ms", "device_only_p99_ms",
                 "hbm_roofline_frac", "weighted_p99",
                 "weighted_dev_only_p50", "kernel_stage_ms",
-                "trace_overhead_pct", "checkpoint_overhead_pct"]
+                "trace_overhead_pct", "checkpoint_overhead_pct",
+                "egress_overhead_pct"]
     if "mesh_scaling_per_device_work_ms" in result:
         promised += ["mesh_scaling_e2e_ms", "mesh_scaling_segments_ms"]
     if "ingest_udp_pkts_per_sec" in result:

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
-"""One-command repo gate: vnlint -> native sanitizer smoke -> one fast
-reshard chaos cell -> tier-1 pytest.  Nonzero exit on ANY unsuppressed
-lint finding, sanitizer report, failed chaos cell, or test failure —
-the local equivalent of a CI required check.
+"""One-command repo gate: vnlint -> native sanitizer smoke -> reshard,
+crash and egress chaos cells -> tier-1 pytest.  Nonzero exit on ANY
+unsuppressed lint finding, sanitizer report, failed chaos cell, or
+test failure — the local equivalent of a CI required check.
 
     python scripts/check.py              # the full gate
     python scripts/check.py --fast      # vnlint + sanitizer smoke only
@@ -116,6 +116,27 @@ def main() -> int:
                         "PASS" if crash_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3c. one egress cell (ISSUE 11): blackhole a metric sink at the
+    # egress.sink failpoint — bounded retries must exhaust into the
+    # per-sink breaker + durable spool, recovery must close the breaker
+    # and replay-drain to EXACT conservation, and the egress ledger
+    # closure (spilled == replayed + expired + dropped + pending) must
+    # hold throughout (the full matrix is
+    # `scripts/dryrun_3tier.py --chaos all`)
+    egress_rc = 0
+    if args.fast:
+        results.append(("egress chaos cell", "SKIP", 0.0))
+    else:
+        t0 = stage("egress chaos cell (sink-blackhole)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        egress_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py",
+             "--chaos-only", "sink-blackhole"],
+            env=env)
+        results.append(("egress chaos cell",
+                        "PASS" if egress_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -135,7 +156,7 @@ def main() -> int:
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
-               or test_rc) else 0
+               or egress_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

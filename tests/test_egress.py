@@ -1,0 +1,438 @@
+"""Egress data plane (ISSUE 11): async per-sink fan-out off the flush
+critical path, bounded retries under per-sink breakers, spool-backed
+durable delivery, ledger closure at /debug/vars -> egress, and the
+flush.sink.<name> spans on the flight-recorder trace."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu import config as config_mod
+from veneur_tpu import failpoints
+from veneur_tpu import sinks as sink_mod
+from veneur_tpu.core.server import Server
+from veneur_tpu.egress import CircuitBreaker, decode_metrics, encode_metrics
+from veneur_tpu.samplers.samplers import InterMetric
+from veneur_tpu.sinks.mock import MockMetricSink
+from veneur_tpu.sinks.simple import ChannelMetricSink
+
+
+class _CapturingStatsd:
+    def __init__(self):
+        self.counts = []
+        self.timings = []
+
+    def count(self, name, value, tags=None, rate=1.0):
+        self.counts.append((name, value, tuple(tags or ())))
+
+    def timing(self, name, value, tags=None, rate=1.0):
+        self.timings.append((name, value, tuple(tags or ())))
+
+    def gauge(self, name, value, tags=None, rate=1.0):
+        pass
+
+    def close(self):
+        pass
+
+
+class _FailingSink(sink_mod.BaseMetricSink):
+    KIND = "failing"
+
+    def __init__(self, fail_times=None):
+        super().__init__("failing")
+        self.fail_times = fail_times    # None = always
+        self.calls = 0
+        self.flushes = []
+
+    def flush(self, metrics):
+        self.calls += 1
+        if self.fail_times is None or self.calls <= self.fail_times:
+            raise RuntimeError("backend down")
+        self.flushes.append(list(metrics))
+        return sink_mod.MetricFlushResult(flushed=len(metrics))
+
+
+class _SlowSink(sink_mod.BaseMetricSink):
+    KIND = "slow"
+
+    def __init__(self, delay_s: float):
+        super().__init__("slow")
+        self.delay_s = delay_s
+        self.flushed = 0
+
+    def flush(self, metrics):
+        time.sleep(self.delay_s)
+        self.flushed += len(metrics)
+        return sink_mod.MetricFlushResult(flushed=len(metrics))
+
+
+def _server(tmp_path=None, extra_sinks=(), **overrides):
+    kw = dict(interval=0.05, hostname="eg-test",
+              egress_max_retries=1, egress_retry_backoff=0.01,
+              egress_breaker_threshold=2, egress_breaker_reset=0.1,
+              egress_spool_replay_interval=0.02)
+    if tmp_path is not None:
+        kw["egress_spool_dir"] = str(tmp_path / "egress-spool")
+    kw.update(overrides)
+    srv = Server(config_mod.Config(**kw),
+                 extra_metric_sinks=list(extra_sinks))
+    srv.start()
+    return srv
+
+
+def _ingest(srv, lines):
+    for line in lines:
+        srv.handle_metric_packet(line)
+
+
+def _metric_lane(srv, name):
+    return next(l for l in srv.egress.lanes
+                if l.kind == "metric" and l.name == name)
+
+
+def test_payload_codec_roundtrip():
+    ms = [InterMetric(name="a.b", timestamp=123, value=4.5,
+                      tags=["k:v", "t:u"], type="counter",
+                      message="m", hostname="h"),
+          InterMetric(name="c", timestamp=0, value=-1.0, tags=[],
+                      type="gauge")]
+    out = decode_metrics(encode_metrics(ms))
+    assert out == ms
+
+
+def test_codec_rejects_unknown_version():
+    body = json.dumps([99, []]).encode()
+    with pytest.raises(ValueError):
+        decode_metrics(body)
+
+
+def test_breaker_trip_halfopen_probe_and_close():
+    b = CircuitBreaker(threshold=2, reset_s=0.05)
+    assert b.admit() and b.state() == "closed"
+    assert not b.record_failure()          # 1 of 2
+    assert b.record_failure()              # trips
+    assert b.state() == "open"
+    assert not b.admit()                   # open: refused
+    time.sleep(0.06)
+    assert b.admit()                       # half-open probe
+    assert b.state() == "half_open"
+    assert not b.admit()                   # one probe at a time
+    assert b.record_failure()              # probe failed: re-trip,
+    assert b.retry_in_s() > 0.05           # longer cooldown (2x)
+    time.sleep(0.21)
+    assert b.admit()
+    assert b.record_success()              # probe delivered: closed
+    assert b.state() == "closed"
+    assert b.admit()
+
+
+def test_flush_returns_without_waiting_on_slow_sink():
+    """The tentpole contract: a slow sink costs its own lane, not the
+    flush serialization lock (the old fan-out held _flush_serial for
+    up to one interval of sink I/O)."""
+    slow = _SlowSink(0.5)
+    chan = ChannelMetricSink()
+    srv = _server(extra_sinks=[slow, chan])
+    try:
+        _ingest(srv, [b"eg.fast:3|c"])
+        t0 = time.perf_counter()
+        srv.flush()
+        wall = time.perf_counter() - t0
+        assert wall < 0.4, f"flush waited on the slow sink: {wall:.2f}s"
+        assert srv.egress.settle(timeout_s=5.0)
+        got = []
+        while not chan.queue.empty():
+            got.extend(chan.queue.get())
+        assert any(m.name == "eg.fast" for m in got)
+        assert slow.flushed > 0
+    finally:
+        srv.shutdown()
+
+
+def test_transient_failure_retries_then_delivers():
+    sink = _FailingSink(fail_times=1)
+    srv = _server(extra_sinks=[sink])
+    try:
+        _ingest(srv, [b"eg.retry:7|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        lane = _metric_lane(srv, "failing")
+        assert lane.retried == 1
+        assert lane.errors == 1
+        assert sink.flushes and any(
+            m.name == "eg.retry" for m in sink.flushes[0])
+        assert lane.breaker.state() == "closed"
+    finally:
+        srv.shutdown()
+
+
+def test_exhausted_retries_without_spool_drop_with_accounting():
+    sink = _FailingSink()       # always fails; no egress spool dir
+    srv = _server(extra_sinks=[sink])
+    try:
+        _ingest(srv, [b"eg.doomed:1|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        lane = _metric_lane(srv, "failing")
+        assert lane.dropped_points > 0
+        assert lane.breaker.trips >= 1
+        eg = srv.egress.stats()
+        assert eg["dropped"] > 0 and eg["spilled"] == 0
+        assert eg["ledger_closed"]
+    finally:
+        srv.shutdown()
+
+
+def test_blackhole_spills_then_replays_on_recovery(tmp_path):
+    """The chaos-arm chain at unit scale, driven by the egress.sink
+    failpoint: blackhole -> retries exhaust -> breaker opens -> spool
+    absorbs -> recovery -> replay drains -> exact delivery, ledger
+    closed."""
+    chan = ChannelMetricSink()
+    srv = _server(tmp_path, extra_sinks=[chan])
+    lane = _metric_lane(srv, "channel")
+    fp = failpoints.configure("egress.sink", "grpc-error",
+                              code="UNAVAILABLE")
+    try:
+        _ingest(srv, [b"eg.bh:5|c", b"eg.bh2:6|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        assert fp.fired >= 2                      # both attempts
+        assert lane.breaker.trips >= 1
+        sp = lane.spool.stats()
+        assert sp["spilled_points"] == 2 and sp["pending_records"] == 1
+        assert srv.egress.stats()["ledger_closed"]
+        # recovery: disarm, wait for the half-open probe + replay
+        failpoints.disarm("egress.sink")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            sp = lane.spool.stats()
+            if sp["pending_records"] == 0 and sp["replayed"] > 0:
+                break
+            time.sleep(0.02)
+        sp = lane.spool.stats()
+        assert sp["replayed_points"] == 2 and sp["pending_records"] == 0
+        got = []
+        while not chan.queue.empty():
+            got.extend(chan.queue.get())
+        by_name = {m.name: m.value for m in got
+                   if m.name.startswith("eg.")}
+        assert by_name == {"eg.bh": 5.0, "eg.bh2": 6.0}
+        eg = srv.egress.stats()
+        assert eg["ledger_closed"] and eg["replayed"] == 2
+        assert lane.breaker.state() == "closed"
+    finally:
+        failpoints.disarm("egress.sink")
+        srv.shutdown()
+
+
+def test_egress_spool_survives_crash_and_replays_on_revive(tmp_path):
+    """Crash durability: a blackholed interval's spilled payload
+    survives a simulated kill -9 on disk and the REVIVED instance's
+    replayer delivers it (the forward spool's crash contract, reused
+    for egress)."""
+    chan = ChannelMetricSink()
+    srv = _server(tmp_path, extra_sinks=[chan])
+    fp = failpoints.configure("egress.sink", "drop")
+    try:
+        _ingest(srv, [b"eg.crash:9|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        assert _metric_lane(srv, "channel").spool.stats()[
+            "pending_records"] == 1
+    finally:
+        # crash FIRST, then disarm: the dying server's replayer must
+        # never win a recovery probe in the disarm window and drain
+        # the spool before the revived instance can
+        srv.crash()     # no drain: the spool keeps its on-disk record
+        failpoints.disarm("egress.sink")
+    assert fp.fired > 0
+    chan2 = ChannelMetricSink()
+    srv2 = _server(tmp_path, extra_sinks=[chan2])
+    try:
+        deadline = time.time() + 10
+        got = []
+        while time.time() < deadline:
+            while not chan2.queue.empty():
+                got.extend(chan2.queue.get())
+            if any(m.name == "eg.crash" for m in got):
+                break
+            time.sleep(0.02)
+        assert any(m.name == "eg.crash" and m.value == 9.0
+                   for m in got)
+        sp = _metric_lane(srv2, "channel").spool.stats()
+        assert sp["replayed_points"] == 1 and sp["pending_records"] == 0
+        # the revived instance never spilled itself — the record it
+        # replayed was RECOVERED from the crashed process's spill, and
+        # the ledger closure must hold across that boundary
+        assert sp["recovered_points"] == 1 and sp["spilled_points"] == 0
+        assert srv2.egress.stats()["ledger_closed"]
+    finally:
+        srv2.shutdown()
+
+
+def test_corrupt_replay_payload_drops_instead_of_wedging(tmp_path):
+    """An undecodable spooled payload must propagate plainly (the
+    spool drops it with accounting) rather than retry until expiry —
+    and must not strand the breaker's half-open probe flag."""
+    from veneur_tpu.forward.spool import RetryableReplayError, SpoolRecord
+
+    chan = ChannelMetricSink()
+    srv = _server(tmp_path, extra_sinks=[chan])
+    try:
+        lane = _metric_lane(srv, "channel")
+        rec = SpoolRecord(ident=("channel", 1, 1), ts_ms=0, n_metrics=1,
+                          trace_id=0, span_id=0, seg_seq=0, offset=0,
+                          body_len=7, disk_bytes=7)
+        with pytest.raises(Exception) as exc:
+            lane._replay_deliver(rec, b"garbage")
+        assert not isinstance(exc.value, RetryableReplayError)
+        assert lane.breaker.admit()      # probe flag not stranded
+    finally:
+        srv.shutdown()
+
+
+def test_queue_full_drops_whole_interval_with_accounting():
+    slow = _SlowSink(0.3)
+    srv = _server(extra_sinks=[slow], egress_queue_depth=1)
+    try:
+        stats = _CapturingStatsd()
+        srv.statsd = stats
+        for i in range(4):
+            _ingest(srv, [f"eg.qf{i}:1|c".encode()])
+            srv.flush()
+        lane = _metric_lane(srv, "slow")
+        assert lane.queue_dropped_points > 0
+        assert any(n == "egress.queue_full_total"
+                   for n, _, _ in stats.counts)
+    finally:
+        srv.shutdown()
+
+
+def test_sink_error_accounting_and_isolation():
+    """Satellite: a sink whose flush() raises must still emit the
+    per-status flushed_metrics counters and flush.sink_errors_total,
+    and must NOT poison the other sinks' deliveries."""
+    bad = _FailingSink()
+    good = MockMetricSink()
+    srv = _server(extra_sinks=[bad, good],
+                  egress_max_retries=0)
+    try:
+        stats = _CapturingStatsd()
+        srv.statsd = stats
+        _ingest(srv, [b"eg.iso:2|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        # the healthy sink delivered despite the failing one
+        assert any(m.name == "eg.iso" for m in good.metrics)
+        bad_tags = ("sink_name:failing", "sink_kind:failing")
+        statuses = {t for n, _, tags in stats.counts
+                    if n == "flushed_metrics"
+                    and all(bt in tags for bt in bad_tags)
+                    for t in tags if t.startswith("status:")}
+        assert statuses == {"status:skipped", "status:max_name_length",
+                            "status:max_tags", "status:max_tag_length",
+                            "status:flushed"}
+        errs = [(n, tags) for n, _, tags in stats.counts
+                if n == "flush.sink_errors_total"
+                and all(bt in tags for bt in bad_tags)]
+        assert errs, stats.counts
+        # the failing sink emitted its per-sink duration despite the
+        # raise (the finally accounting contract)
+        assert any(n == "sink.metric_flush_total_duration_ms"
+                   and all(bt in tags for bt in bad_tags)
+                   for n, _, tags in stats.timings)
+    finally:
+        srv.shutdown()
+
+
+def test_flush_sink_spans_on_traced_interval():
+    """Every sink flush is a flush.sink.<name> span on the interval's
+    trace, attempt-per-span like forward — a breaker trip is causally
+    visible on the critical path."""
+    sink = _FailingSink(fail_times=1)
+    srv = _server(extra_sinks=[sink],
+                  trace_flush_enabled=True, trace_flush_sample_rate=1.0)
+    try:
+        _ingest(srv, [b"eg.traced:1|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        deadline = time.time() + 5
+        recs = []
+        while time.time() < deadline:
+            recs = srv.flight_recorder.snapshot()
+            if any(r["name"] == "flush.sink.failing" for r in recs):
+                break
+            time.sleep(0.02)
+        roots = [r for r in recs if r["name"] == "flush"]
+        sink_spans = [r for r in recs
+                      if r["name"] == "flush.sink.failing"]
+        attempts = [r for r in recs if r["name"] == "egress.attempt"]
+        assert roots and sink_spans
+        # the sink span continues the flush root's context
+        assert sink_spans[0]["trace_id"] == roots[-1]["trace_id"]
+        assert sink_spans[0]["parent_id"] == roots[-1]["span_id"]
+        # attempt-per-span: the failed first attempt is error-flagged,
+        # the delivered second is clean, both parented on the sink span
+        by_parent = [a for a in attempts
+                     if a["parent_id"] == sink_spans[0]["span_id"]]
+        assert len(by_parent) == 2
+        assert sorted(a["error"] for a in by_parent) == [False, True]
+    finally:
+        srv.shutdown()
+
+
+def test_debug_vars_egress_ledger_and_span_sink_counters():
+    """Satellites: /debug/vars carries the egress ledger (with its
+    closure bit) and per-span-sink ingested/dropped/errors totals."""
+    from veneur_tpu.http_api import HttpApi
+
+    srv = _server(extra_sinks=[ChannelMetricSink()])
+    api = HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    try:
+        _ingest(srv, [b"eg.vars:1|c"])
+        srv.flush()
+        assert srv.egress.settle(timeout_s=5.0)
+        host, port = api.address[:2]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/vars") as resp:
+            stats = json.loads(resp.read())
+        eg = stats["egress"]
+        assert eg["ledger_closed"] is True
+        assert eg["flushed"] >= 1
+        assert "metric:channel" in eg["per_sink"]
+        assert eg["breakers"]["channel"]["state"] == "closed"
+        # span-sink ingest accounting (the _SpanSinkWorker satellite)
+        assert "span_sinks" in stats
+        for name in ("ssfmetrics", "flight_recorder"):
+            assert {"ingested", "dropped", "errors"} <= set(
+                stats["span_sinks"][name])
+    finally:
+        api.stop()
+        srv.shutdown()
+
+
+def test_dryrun_report_promises_egress_keys():
+    from veneur_tpu.testbed.dryrun import PROMISED_KEYS, run_dryrun
+    assert "egress" in PROMISED_KEYS
+    report = run_dryrun(intervals=1, counter_keys=2, histo_keys=1,
+                        set_keys=1, histo_samples=20)
+    assert report["ok"], report["conservation"]
+    for key in ("flushed", "retried", "spilled", "replayed", "dropped"):
+        assert key in report["egress"]
+    assert report["egress"]["flushed"] > 0
+    assert report["egress"]["dropped"] == 0
+
+
+def test_sink_blackhole_chaos_arm():
+    from veneur_tpu.testbed import chaos
+    row = chaos.run_chaos_arm(chaos.arm_by_name("sink-blackhole"),
+                              seed=3)
+    assert row["ok"], row
+    assert row["conserved"] and row["egress_ledger_closed"]
+    assert row["breaker_trips"] >= 1
+    assert row["egress"]["spilled"] > 0
+    assert row["egress"]["spilled"] == row["egress"]["replayed"]

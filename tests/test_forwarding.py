@@ -88,7 +88,9 @@ def test_local_server_mixed_metrics():
             np.quantile(data, 0.9), rel=0.02)
         assert by["lat.50percentile"].tags == ["svc:x"]
 
-        # local side emitted aggregates, no percentiles
+        # local side emitted aggregates, no percentiles (egress is
+        # async: settle the local's lanes before reading its sink)
+        local.egress.settle(timeout_s=10.0)
         lgot = []
         while not lsink.queue.empty():
             lgot.extend(lsink.queue.get())
@@ -121,6 +123,14 @@ def test_global_counters_gauges_sets_over_grpc():
             time.sleep(0.05)
         for l in locals_:
             l.flush()
+        # flush() no longer waits for its forward future (the old
+        # fan-out wait covered it); block until every local's forward
+        # slot is released so the global sees all three imports
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                l._forward_slots._value < l.FORWARD_MAX_IN_FLIGHT
+                for l in locals_):
+            time.sleep(0.02)
         got = flush_and_collect(
             glob, gsink,
             lambda g: any(m.name == "reqs" for m in g)

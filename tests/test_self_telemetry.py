@@ -108,6 +108,9 @@ def test_flush_emits_self_metrics(telemetry_server):
     srv, msink, _ = telemetry_server
     _send_and_wait(srv, b"a:1|c\nb:2.5|g\nlat:3|h")
     srv.flush()
+    # per-sink accounting (flushed_metrics, durations) is emitted from
+    # the async egress lanes now — settle them before reading
+    srv.egress.settle(timeout_s=10.0)
 
     stats = srv.statsd
     # worker.metrics_processed_total (worker.go:477)

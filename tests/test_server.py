@@ -199,6 +199,7 @@ def test_tls_client_cert_required(fixture_server, tmp_path):
         tls2.recv(1)  # force handshake completion
     time.sleep(0.2)
     srv.flush()
+    srv.egress.settle(timeout_s=5.0)   # fan-out is async now
     while not sink.queue.empty():
         batch = sink.queue.get()
         assert not any(m.name == "evil" for m in batch)

@@ -1048,12 +1048,17 @@ def test_sink_http_phase_self_metrics_emitted():
         def flush(self, metrics):
             return sink_mod.MetricFlushResult(flushed=0)
 
-    srv = Server(config_mod.Config(interval=0.05, hostname="h"))
     sink = _PosterSink()
+    srv = Server(config_mod.Config(interval=0.05, hostname="h"),
+                 extra_metric_sinks=[sink])
     stats = _CapturingStatsd()
     try:
-        srv._flush_sink(sink_mod.SinkSpec(kind="fakeposter"), sink,
-                        [], [], statsd=stats)
+        # delivery (and the sink.http.* phase emission) runs on the
+        # sink's egress lane now
+        from veneur_tpu.egress import EgressJob
+        lane = next(l for l in srv.egress.lanes
+                    if l.kind == "metric" and l.name == "fakeposter")
+        lane._deliver_job(EgressJob([], [], stats, 1))
         names = {n for n, _, _ in stats.timings}
         assert {"sink.http.connect_ms", "sink.http.ttfb_ms",
                 "sink.http.total_ms"} <= names

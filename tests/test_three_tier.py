@@ -102,6 +102,7 @@ def test_three_tier_end_to_end_with_ring_rebuild():
         assert len(counters1) == 60
         assert len(pcts1) == 60
         assert {seen1[n] for n in seen1} == {0, 1}
+        local.egress.settle(timeout_s=10.0)   # fan-out is async now
         local_batch = []
         while not lsink.queue.empty():
             local_batch.extend(lsink.queue.get())

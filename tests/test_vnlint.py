@@ -420,6 +420,42 @@ def test_checkpoint_tmp_discard_on_error_is_quiet(tmp_path):
         [f.format() for f in report.findings]
 
 
+# egress-queue job handoff (ISSUE-11): a job claimed from a sink lane
+# must be settled on EVERY path — delivered, spilled or dropped with
+# accounting — or the pending count wedges settle()/the shutdown drain
+
+EGRESS_JOB_LEAK = """
+def run_lane(self):
+    job = self.claim_job()
+    self.deliver(job)       # can raise: the claimed job never settles
+    self.settle_job(job)
+"""
+
+EGRESS_JOB_FINALLY = """
+def run_lane(self):
+    job = self.claim_job()
+    try:
+        self.deliver(job)
+    finally:
+        self.settle_job(job)
+"""
+
+
+def test_egress_job_leak_fires(tmp_path):
+    """A claimed egress job whose settle sits only on the fall-through
+    path is silent metric loss and a stuck pending count."""
+    report = lint_source(tmp_path, EGRESS_JOB_LEAK)
+    hits = [f for f in report.findings if f.rule == "resource-pairing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "egress job handoff" in hits[0].message
+
+
+def test_egress_job_settle_in_finally_is_quiet(tmp_path):
+    report = lint_source(tmp_path, EGRESS_JOB_FINALLY)
+    assert "resource-pairing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
 # ---------------------------------------------------------------------------
 # prewarm-parity — the PR-3 in-flush recompile
 # ---------------------------------------------------------------------------

@@ -108,6 +108,14 @@ PAIRS = (
     PairSpec("checkpoint tempfile",
              frozenset({"open_checkpoint_tmp"}),
              frozenset({"commit_checkpoint", "discard_checkpoint"})),
+    # egress-queue job handoff (egress/plane.py): a job claimed from a
+    # sink lane's queue (claim_job) must be settled (settle_job) on
+    # EVERY path — delivered, spilled to the durable spool, or dropped
+    # with accounting.  A lost job is silent metric loss AND a stuck
+    # pending count that wedges settle()/the shutdown drain forever.
+    PairSpec("egress job handoff",
+             frozenset({"claim_job"}),
+             frozenset({"settle_job"})),
 )
 
 

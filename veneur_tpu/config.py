@@ -109,6 +109,28 @@ class Config:
     spool_segment_max_bytes: int = 4 * 1024 * 1024
     # per-source identity window of the global tier's dedup ledger
     spool_dedup_window: int = 4096
+    # egress data plane (veneur_tpu/egress/): sink fan-out runs on
+    # bounded per-sink queues + worker lanes off the flush critical
+    # path.  Each metric sink gets a circuit breaker
+    # (egress_breaker_threshold consecutive failures trip it open;
+    # cooldown egress_breaker_reset, doubling per trip) and bounded
+    # retries with seeded backoff; when retries exhaust — or the
+    # breaker is open — the filtered payload spills to that sink's own
+    # durable spool under egress_spool_dir ("" = drop with accounting
+    # instead) and a background replayer re-delivers once the backend
+    # recovers.  The ledger (spilled == replayed + expired + dropped +
+    # pending) surfaces at /debug/vars -> egress and as egress.*
+    # self-metrics.
+    egress_queue_depth: int = 128        # intervals buffered per sink
+    egress_max_retries: int = 2          # retries beyond first attempt
+    egress_retry_backoff: float = 0.05   # base backoff ("50ms", doubles)
+    egress_retry_seed: int = 0           # seeded jitter (chaos replay)
+    egress_breaker_threshold: int = 3    # consecutive failures to trip
+    egress_breaker_reset: float = 5.0    # cooldown before half-open probe
+    egress_spool_dir: str = ""           # "" = egress spool off
+    egress_spool_max_bytes: int = 64 * 1024 * 1024
+    egress_spool_max_age: float = 600.0  # oldest record kept ("10m")
+    egress_spool_replay_interval: float = 0.5
     # checkpoint_dir != "": periodic (checkpoint_interval > 0) and
     # shutdown snapshots of every arena — dense registers, key tables,
     # staged digest points, cardinality quota state, the dedup ledger —
@@ -317,6 +339,18 @@ class Config:
             raise ValueError(
                 f"spool_fsync must be always|rotate|never, "
                 f"got {self.spool_fsync!r}")
+        if self.egress_queue_depth <= 0:
+            self.egress_queue_depth = 128
+        if self.egress_max_retries < 0:
+            self.egress_max_retries = 0
+        if self.egress_retry_backoff < 0:
+            self.egress_retry_backoff = 0.0
+        if self.egress_breaker_threshold < 1:
+            self.egress_breaker_threshold = 1
+        if self.egress_breaker_reset < 0:
+            self.egress_breaker_reset = 0.0
+        if self.egress_spool_replay_interval <= 0:
+            self.egress_spool_replay_interval = 0.5
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
         if self.read_buffer_size_bytes <= 0:
@@ -351,7 +385,10 @@ _LIST_FIELDS_OF_FLOAT = {"percentiles"}
 # fields accepting Go-style duration strings ("10s", "500ms")
 _DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval",
                     "forward_retry_backoff", "spool_max_age",
-                    "spool_replay_interval", "checkpoint_interval"}
+                    "spool_replay_interval", "checkpoint_interval",
+                    "egress_retry_backoff", "egress_breaker_reset",
+                    "egress_spool_max_age",
+                    "egress_spool_replay_interval"}
 
 
 def _coerce(key: str, value: Any) -> Any:

@@ -4,8 +4,9 @@ Composition root mirroring the reference `Server`
 (`server.go:106-174,462-868`): DogStatsD listeners (UDP with SO_REUSEPORT
 multi-reader parallelism as in `networking.go:54-107`/`socket_linux.go`,
 TCP with optional TLS client-cert auth, UNIX datagram/stream), the interval
-flush ticker with per-flush deadline, parallel metric-sink fan-out with
-central filtering (`flusher.go:115-247`), event/service-check handling
+flush ticker with per-flush deadline, metric-sink fan-out handed to the
+async egress data plane (central filtering per `flusher.go:115-247` runs
+on the per-sink lanes, veneur_tpu/egress/), event/service-check handling
 (`server.go:942-993`), the flush watchdog (`server.go:877-912`), and
 pluggable sources/sinks/forwarder.
 
@@ -320,9 +321,10 @@ class Server:
         self._compiles_reported = (0, 0.0)
         self._threads: list[threading.Thread] = []
         self._shutdown = threading.Event()
+        # the pool now carries only forward submissions — sink fan-out
+        # moved to the egress data plane's per-sink lanes (below)
         self._flush_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(4, len(self.metric_sinks) + 2)
-            + self.FORWARD_MAX_IN_FLIGHT,
+            max_workers=self.FORWARD_MAX_IN_FLIGHT + 2,
             thread_name_prefix="flush")
         self.last_flush_unix = time.time()
         self.flush_count = 0
@@ -346,6 +348,37 @@ class Server:
                             sink_name, set()).add(key)
             else:
                 self._tags_exclude_global.add(key)
+        # egress data plane (veneur_tpu/egress/): bounded per-sink
+        # queues + worker lanes that take the whole sink fan-out —
+        # filtering, serialization, HTTP, retries, spool spill — off
+        # the flush critical path.  _flush_body_locked just enqueues.
+        from veneur_tpu.egress import EgressPlane
+        from veneur_tpu.forward.client import RetryPolicy as _RetryPolicy
+        self.egress = EgressPlane(
+            interval_s=cfg.interval,
+            queue_depth=cfg.egress_queue_depth,
+            retry=_RetryPolicy(
+                attempts=cfg.egress_max_retries + 1,
+                backoff_base_s=cfg.egress_retry_backoff,
+                seed=cfg.egress_retry_seed),
+            breaker_threshold=cfg.egress_breaker_threshold,
+            breaker_reset_s=cfg.egress_breaker_reset,
+            spool_dir=(os.path.expanduser(cfg.egress_spool_dir)
+                       if cfg.egress_spool_dir else ""),
+            spool_max_bytes=cfg.egress_spool_max_bytes,
+            spool_max_age_s=cfg.egress_spool_max_age,
+            spool_fsync=cfg.spool_fsync,
+            spool_replay_interval_s=cfg.egress_spool_replay_interval,
+            routing_enabled=cfg.enable_metric_sink_routing,
+            excluded_tags_for=self._excluded_tags_for,
+            recorder=self.flight_recorder,
+            statsd_fn=lambda: self.statsd)
+        for spec, sink in self.metric_sinks:
+            self.egress.add_metric_sink(spec, sink)
+        for sink in self.span_sinks:
+            self.egress.add_span_sink(sink)
+        # last-reported egress totals (egress.* per-interval deltas)
+        self._egress_reported: dict = {}
         # per-protocol received-packet tallies, drained each flush into
         # listen.received_per_protocol_total (flusher.go:280,455-475).
         # Plain int increments; GIL-atomic enough for telemetry.  Batch
@@ -449,6 +482,9 @@ class Server:
             sink.start(None)
         for sink in self.span_sinks:
             sink.start(None)
+        # spin up the egress lanes (sinks are started; the lanes may
+        # immediately replay any spool records a crash left behind)
+        self.egress.start()
         for addr in self.config.statsd_listen_addresses:
             self._start_statsd(addr)
         for addr in self.config.ssf_listen_addresses:
@@ -1329,10 +1365,10 @@ class Server:
                       "sampled": str(traced).lower()}) as span:
             # vnlint: disable=blocking-propagation (the body IS the
             #   flush — _flush_serial deliberately covers its one
-            #   device wait, pending.emit, and the sink-fanout
-            #   deadline; ingest threads never contend on
-            #   _flush_serial.  Same rationale as the suppressions at
-            #   the waits themselves)
+            #   device wait, pending.emit; ingest threads never
+            #   contend on _flush_serial, and sink fan-out is a
+            #   non-blocking egress-queue handoff.  Same rationale as
+            #   the suppression at the wait itself)
             self._flush_body_locked(span, statsd, flush_start, traced)
 
     def _flush_body_locked(self, span, statsd, flush_start: float,
@@ -1401,13 +1437,12 @@ class Server:
             res.metrics.apply_routing(self.config.metric_sink_routing,
                                       matcher_mod.match)
 
-        futures = {}
         if self.forwarder is not None and self.is_local and res.forward:
             if self._forward_slots.acquire(blocking=False):
                 try:
-                    futures[self._flush_pool.submit(
+                    self._flush_pool.submit(
                         self._forward_safely, res.forward, span,
-                        traced, self.flush_count)] = "forward"
+                        traced, self.flush_count)
                     # the assembler requires a complete 3-tier trace
                     # only for intervals whose forward was SUBMITTED
                     # (slot-exhausted drops are accounted, not traced)
@@ -1423,37 +1458,23 @@ class Server:
                 logger.warning("%d forwards in flight; dropped %d "
                                "forward metrics",
                                self.FORWARD_MAX_IN_FLIGHT, len(res.forward))
-        for spec, sink in self.metric_sinks:
-            futures[self._flush_pool.submit(
-                self._flush_sink, spec, sink, res.metrics, events,
-                statsd)] = f"metric:{spec.name or spec.kind}"
-        for sink in self.span_sinks:
-            futures[self._flush_pool.submit(
-                self._flush_span_sink, sink,
-                statsd)] = f"span:{sink.name()}"
+        # sink fan-out: hand the rendered interval to the egress data
+        # plane and return — filtering, serialization, HTTP, bounded
+        # retries, breaker trips and spool spill all run on per-sink
+        # lanes off this lock.  A slow or blackholed backend costs its
+        # own lane, never the flush p99 (ROADMAP #8).
         fanout_start_ns = time.time_ns()
-        # vnlint: disable=sync-under-lock (the one-interval sink-fanout
-        #   deadline is the flush's straggler bound, intentionally
-        #   inside the flush serialization lock; ingest threads never
-        #   contend on _flush_serial)
-        done, not_done = concurrent.futures.wait(
-            futures, timeout=self.config.interval)
-        # deadline classification (flusher.go:553-566): a sink still
-        # running after one full interval is a straggler; it keeps running
-        # (we cannot safely interrupt it) but is counted per sink so the
-        # slow backend is identifiable from self-metrics alone.
-        for fut in not_done:
-            statsd.count("flush.stragglers_total", 1,
-                         tags=[f"flush:{futures[fut]}"])
-        if not_done:
-            logger.warning("flush deadline: still running after %.1fs: %s",
-                           self.config.interval,
-                           ", ".join(sorted(futures[f] for f in not_done)))
+        self.egress.submit_interval(
+            res.metrics, events, statsd, self.flush_count,
+            trace_id=span.trace_id, parent_span_id=span.span_id,
+            traced=traced)
         if traced:
             # segment children (staging/upload/kernel/readback) + the
-            # sink-fanout wait, as spans on the interval's own trace
-            self._emit_segment_spans(span, flush_start)
+            # egress handoff, as spans on the interval's own trace.
+            # flush.seg.fanout now covers only the ENQUEUE — sink I/O
+            # happens on the lanes, visible as flush.sink.<name> spans
             fanout_end_ns = time.time_ns()
+            self._emit_segment_spans(span, flush_start)
             fanout = span.child("flush.seg.fanout")
             fanout.start_ns = fanout_start_ns
             fanout.end_ns = fanout_end_ns
@@ -1557,6 +1578,36 @@ class Server:
             statsd.gauge("forward.spool.pending_records",
                          float(pending))
             self._spool_reported = sp
+        # egress data-plane ledger deltas (veneur_tpu/egress/) for the
+        # series with no event-site emission: delivered points and the
+        # spool's replay/expiry/terminal-drop outcomes.  The failure-
+        # side series (egress.retries/spilled/dropped/queue_full) are
+        # emitted sink- and cause-tagged at their event sites in the
+        # lanes — exactly once per event, never re-summed here.
+        eg = self.egress.stats()
+        prev_eg = self._egress_reported
+        for key in ("flushed", "replayed", "expired", "spool_dropped"):
+            delta = eg[key] - prev_eg.get(key, 0)
+            if delta > 0:
+                statsd.count(f"egress.{key}_total", delta)
+        eg_pending = eg["pending"]
+        statsd.gauge("egress.pending_records", float(eg_pending))
+        self._egress_reported = {
+            k: eg[k] for k in ("flushed", "replayed", "expired",
+                               "spool_dropped")}
+        # straggler classification (flusher.go:553-566 heritage, same
+        # per-interval semantics as the old in-lock fan-out deadline):
+        # a sink whose CURRENT delivery has been running longer than
+        # one interval counts once per interval — which also catches a
+        # sink.flush that never returns at all
+        for label, lane_st in eg["per_sink"].items():
+            if lane_st["busy_for_s"] > self.config.interval:
+                statsd.count("flush.stragglers_total", 1,
+                             tags=[f"flush:{label}"])
+                logger.warning(
+                    "flush straggler: sink %s delivery running %.1fs "
+                    "(> %.1fs interval)", label,
+                    lane_st["busy_for_s"], self.config.interval)
         statsd.count("spans.received_total", self.ssf_received)
         self.ssf_received = 0
         # per-span-sink ingest accounting (worker.go:603-678)
@@ -1629,90 +1680,25 @@ class Server:
                                     tags={"cause": cause}))
             statsd.count("forward.error_total", 1, tags=[f"cause:{cause}"])
         finally:
+            wall = time.perf_counter() - grpc_start
+            if wall > self.config.interval:
+                # the old in-lock fan-out wait classified a forward
+                # running past one interval as a straggler; keep the
+                # signal, now stamped at completion like the sink lanes
+                statsd.count("flush.stragglers_total", 1,
+                             tags=["flush:forward"])
+                logger.warning("forward straggler: ran %.1fs "
+                               "(> %.1fs interval)", wall,
+                               self.config.interval)
             fspan.add(ssf_mod.timing(
-                "forward.duration_ns", time.perf_counter() - grpc_start,
-                tags={"part": "grpc"}))
+                "forward.duration_ns", wall, tags={"part": "grpc"}))
             fspan.finish()
             self._forward_slots.release()
 
-    def _flush_sink(self, spec, sink, metrics, events, statsd=None) -> None:
-        """One metric sink's flush, with the standard accounting
-        (flusher.go:138-247: flushed_metrics by status + per-sink flush
-        duration timer)."""
-        from veneur_tpu import scopedstatsd
-        statsd = scopedstatsd.ensure(statsd or self.statsd)
-        sink_tags = [f"sink_name:{sink.name()}", f"sink_kind:{spec.kind}"]
-        start = time.perf_counter()
-        try:
-            filtered, counts = sink_mod.filter_metrics_for_sink(
-                spec, self.config.enable_metric_sink_routing, metrics,
-                excluded_tags=self._excluded_tags_for(sink.name()))
-            for status in ("skipped", "max_name_length", "max_tags",
-                           "max_tag_length", "flushed"):
-                statsd.count("flushed_metrics", counts.get(status, 0),
-                             tags=sink_tags + [f"status:{status}"])
-            sink.flush_other_samples(events)
-            result = sink.flush(filtered)
-            statsd.count(sink_mod.METRICS_FLUSHED_TOTAL, result.flushed,
-                         tags=sink_tags)
-            statsd.count(sink_mod.METRICS_DROPPED_TOTAL, result.dropped,
-                         tags=sink_tags)
-            logger.debug("flush complete sink=%s flushed=%s counts=%s",
-                         sink.name(), result.flushed, counts)
-        except Exception as e:
-            statsd.count("flush.sink_errors_total", 1, tags=sink_tags)
-            logger.error("sink %s flush failed: %s", sink.name(), e)
-        finally:
-            statsd.timing("sink.metric_flush_total_duration_ms",
-                          (time.perf_counter() - start) * 1e3,
-                          tags=sink_tags)
-            self._emit_http_phases(sink, sink_tags, statsd)
-
-    def _emit_http_phases(self, sink, sink_tags, statsd) -> None:
-        """Per-POST HTTP phase self-metrics for poster-backed sinks —
-        the reference traces DNS/connect/TTFB on every sink POST
-        (`http/http.go:23-100`); here the poster's tracing adapter
-        records them and this emits `sink.http.{connect,ttfb,total}_ms`
-        + `sink.http.connections_used_total` by state."""
-        poster = getattr(sink, "_poster", None)
-        if poster is None or not hasattr(poster, "drain_phase_stats"):
-            return
-        new_conns = reused = 0
-        for rec in poster.drain_phase_stats():
-            if rec["reused"]:
-                reused += 1
-            else:
-                new_conns += 1
-                statsd.timing("sink.http.connect_ms",
-                              rec["connect_ms"], tags=sink_tags)
-            statsd.timing("sink.http.ttfb_ms", rec["ttfb_ms"],
-                          tags=sink_tags)
-            statsd.timing("sink.http.total_ms", rec["total_ms"],
-                          tags=sink_tags)
-        if new_conns:
-            statsd.count("sink.http.connections_used_total", new_conns,
-                         tags=sink_tags + ["state:new"])
-        if reused:
-            statsd.count("sink.http.connections_used_total", reused,
-                         tags=sink_tags + ["state:reused"])
-
-    def _flush_span_sink(self, sink, statsd=None) -> None:
-        """One span sink's flush with per-sink timing
-        (SpanWorker.Flush, worker.go:657-678)."""
-        from veneur_tpu import scopedstatsd
-        statsd = scopedstatsd.ensure(statsd or self.statsd)
-        start = time.perf_counter()
-        try:
-            sink.flush()
-        except Exception as e:
-            logger.error("span sink %s flush failed: %s", sink.name(), e)
-        finally:
-            statsd.timing("worker.span.flush_duration_ns",
-                          (time.perf_counter() - start) * 1e9,
-                          tags=[f"sink:{sink.name()}"])
-            self._emit_http_phases(
-                sink, [f"sink_name:{sink.name()}",
-                       f"sink_kind:{sink.kind()}"], statsd)
+    # per-sink delivery (filtering, flushed_metrics accounting, bounded
+    # retries, HTTP phase self-metrics) lives on the egress lanes now:
+    # veneur_tpu/egress/plane.py SinkLane._deliver_metric /
+    # _deliver_span_flush
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1801,6 +1787,26 @@ class Server:
                 source.stop()
             except Exception:
                 logger.exception("source stop failed")
+        # drain the egress lanes BEFORE the statsd client closes (the
+        # final interval's per-sink accounting still needs it) and
+        # before sinks close further down.  A crash skips the drain:
+        # queued jobs die with the process and the per-sink spools keep
+        # their on-disk records for the revived instance's replayers.
+        try:
+            self.egress.close(drain=not self._crashed,
+                              timeout_s=max(2.0, self.config.interval))
+        except Exception:
+            logger.exception("egress close failed")
+        if not self._crashed:
+            # flush() no longer waits on its forward future (the old
+            # in-lock fan-out wait covered it): give the final
+            # interval's in-flight forwards a bounded window to land
+            # before the channel is torn down below
+            deadline = time.time() + max(2.0, self.config.interval)
+            while (time.time() < deadline
+                   and self._forward_slots._value
+                   < self.FORWARD_MAX_IN_FLIGHT):
+                time.sleep(0.02)
         if self.diagnostics is not None:
             self.diagnostics.stop()
         if self.statsd is not None:

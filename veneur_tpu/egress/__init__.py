@@ -1,0 +1,13 @@
+"""Egress data plane: async sink fan-out with per-sink breakers,
+bounded retries, and spool-backed durable delivery (ROADMAP #8).
+
+See egress/plane.py for the architecture; egress/breaker.py holds the
+per-sink circuit breaker (the proxy destination-set contract, reused).
+"""
+
+from veneur_tpu.egress.breaker import CircuitBreaker
+from veneur_tpu.egress.plane import (EgressJob, EgressPlane, SinkLane,
+                                     decode_metrics, encode_metrics)
+
+__all__ = ["CircuitBreaker", "EgressJob", "EgressPlane", "SinkLane",
+           "decode_metrics", "encode_metrics"]

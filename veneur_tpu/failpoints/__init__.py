@@ -40,6 +40,11 @@ Injection sites threaded through this repo (grep `failpoints.inject`):
                       fsync) and the replay read — a fault degrades to
                       drop-with-accounting, never a wedged forward
                       thread           (forward/spool.py)
+  egress.sink         per metric-sink delivery attempt on the egress
+                      lanes (initial attempts AND spool replays) — the
+                      sink-blackhole chaos arm's edge: error/delay/drop
+                      actions drive breaker trips, spool spill and
+                      recovery replay   (egress/plane.py)
 """
 
 from __future__ import annotations

@@ -142,11 +142,16 @@ class ForwardSpool:
         self._active = None          # (seq, file handle, bytes written)
         self._next_seq = 0
         self.pending_bytes = 0
-        # ledger counters: spilled == replayed + expired + dropped once
-        # the spool is drained — the accounting closure the crash chaos
-        # arms assert
+        self.pending_points = 0      # metric points in pending records
+        # ledger counters: spilled + recovered == replayed + expired +
+        # dropped + pending at all times — the accounting closure the
+        # crash chaos arms assert.  `recovered` counts records a reopen
+        # re-indexed from disk: they were spilled by a PREVIOUS
+        # process, so this instance's spilled counters never saw them.
         self.spilled_records = 0
         self.spilled_points = 0
+        self.recovered_records = 0
+        self.recovered_points = 0
         self.replayed_records = 0
         self.replayed_points = 0
         self.expired_records = 0
@@ -247,6 +252,9 @@ class ForwardSpool:
             self._records.append(rec)
             self._seg_pending[seq] = self._seg_pending.get(seq, 0) + 1
             self.pending_bytes += rec.disk_bytes
+            self.pending_points += rec.n_metrics
+            self.recovered_records += 1
+            self.recovered_points += rec.n_metrics
             off = next_off
         return None
 
@@ -292,6 +300,7 @@ class ForwardSpool:
             self._records.append(rec)
             self._seg_pending[seq] = self._seg_pending.get(seq, 0) + 1
             self.pending_bytes += rec.disk_bytes
+            self.pending_points += n_metrics
             self.spilled_records += 1
             self.spilled_points += n_metrics
             self._enforce_bytes_locked()
@@ -330,6 +339,7 @@ class ForwardSpool:
 
     def _settle_locked(self, rec: SpoolRecord, outcome: str) -> None:
         self.pending_bytes -= rec.disk_bytes
+        self.pending_points -= rec.n_metrics
         if outcome == "replayed":
             self.replayed_records += 1
             self.replayed_points += rec.n_metrics
@@ -487,8 +497,11 @@ class ForwardSpool:
             return {
                 "pending_records": len(self._records),
                 "pending_bytes": self.pending_bytes,
+                "pending_points": self.pending_points,
                 "spilled": self.spilled_records,
                 "spilled_points": self.spilled_points,
+                "recovered": self.recovered_records,
+                "recovered_points": self.recovered_points,
                 "replayed": self.replayed_records,
                 "replayed_points": self.replayed_points,
                 "expired": self.expired_records,

@@ -122,6 +122,25 @@ def make_handler(server) -> type:
                     # stalled (bounded-buffering loss, core/server.py)
                     "forward_slots_dropped": server.forward_dropped,
                 }
+                egress = getattr(server, "egress", None)
+                if egress is not None:
+                    # the egress data plane's ledger: per-sink lanes
+                    # (queue depth, breaker state, spool) plus the
+                    # aggregated closure — spilled == replayed +
+                    # expired + dropped + pending, so sink-delivery
+                    # loss is reconcilable from here
+                    stats["egress"] = egress.stats()
+                workers = getattr(server, "span_workers", None)
+                if workers:
+                    # per-span-sink ingest accounting: a full queue or
+                    # a sink ingest error is visible loss, not a log
+                    # line (the _SpanSinkWorker drop-counter satellite)
+                    stats["span_sinks"] = {
+                        w.sink.name(): {
+                            "ingested": w.ingested,
+                            "dropped": w.dropped,
+                            "errors": w.errors,
+                        } for w in workers}
                 fw = getattr(server, "forwarder", None)
                 if fw is not None and hasattr(fw, "stats"):
                     # the forward client's retry-policy accounting:

@@ -70,8 +70,9 @@ class ParallelPoster:
     (`http/http.go:23-100`): per-POST connect (DNS+TCP+TLS, absent on a
     reused connection), time-to-first-byte, and total wall time, plus
     new/reused connection counts.  `drain_phase_stats()` hands the
-    accumulated records to whoever emits self-metrics (the server's
-    _flush_sink does, as `sink.http.*`).
+    accumulated records to whoever emits self-metrics (the egress
+    lanes do, via `egress/plane.py` `emit_http_phases`, as
+    `sink.http.*`).
     """
 
     def __init__(self, max_workers: int = 8,

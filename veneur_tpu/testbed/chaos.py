@@ -121,7 +121,21 @@ CRASH_ARMS: list[ChaosArm] = [
              "accounted", {"op": "spool-expiry"}, kind="crash"),
 ]
 
-ALL_ARMS: list[ChaosArm] = CHAOS_ARMS + TOPOLOGY_ARMS + CRASH_ARMS
+# egress arm (ISSUE 11 / ROADMAP #8): a metric sink is blackholed at
+# the `egress.sink` failpoint — the full degradation chain must hold:
+# attempts fail -> bounded retries exhaust -> breaker opens -> later
+# intervals spill straight to the sink's durable spool -> the backend
+# recovers (failpoint disarmed) -> the half-open probe closes the
+# breaker and the replayer drains -> EXACT conservation at the sink,
+# with the egress ledger closure (spilled == replayed + expired +
+# dropped + pending) holding throughout.
+EGRESS_ARMS: list[ChaosArm] = [
+    ChaosArm("sink-blackhole", "egress.sink", "drop",
+             "conserved", {"op": "sink-blackhole"}, kind="egress"),
+]
+
+ALL_ARMS: list[ChaosArm] = (CHAOS_ARMS + TOPOLOGY_ARMS + CRASH_ARMS
+                            + EGRESS_ARMS)
 
 
 def arm_by_name(name: str) -> ChaosArm:
@@ -145,6 +159,9 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     every settled interval forming one complete 3-tier trace with zero
     orphans — duplicate retry attempts must dedup to one delivered
     edge (trace/assembly.py)."""
+    if arm.kind == "egress":
+        return _run_egress_arm(arm, seed=seed,
+                               counter_keys=counter_keys)
     if arm.kind == "crash":
         return _run_crash_arm(arm, seed=seed, n_locals=n_locals,
                               counter_keys=counter_keys,
@@ -639,6 +656,109 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
             _apply_trace_gate(row, trace_spans,
                               require_proxy=not direct)
     return row
+
+
+def _run_egress_arm(arm: ChaosArm, *, seed: int = 0,
+                    counter_keys: int = 4) -> dict:
+    """The sink-blackhole cell: one server, one channel sink, the
+    `egress.sink` failpoint armed unbounded (a true blackhole), then
+    disarmed to model backend recovery.  Every emitted point must
+    either reach the sink exactly once (via the spool replay) or be
+    visibly accounted — and the egress ledger must close at every
+    step."""
+    import shutil
+    import tempfile
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks import simple as simple_sinks
+
+    tmp = tempfile.mkdtemp(prefix="tb-egress-")
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=0, set_keys=0, histo_samples=0)
+    sink = simple_sinks.ChannelMetricSink()
+    srv = Server(config_mod.Config(
+        interval=0.05, hostname="tb-egress",
+        egress_max_retries=1, egress_retry_backoff=0.02,
+        egress_breaker_threshold=2, egress_breaker_reset=0.2,
+        egress_spool_dir=tmp,
+        egress_spool_replay_interval=0.05),
+        extra_metric_sinks=[sink])
+    lane = next(l for l in srv.egress.lanes if l.kind == "metric")
+    trips_seen = 0
+    fp = failpoints.configure(arm.failpoint, arm.action, seed=seed)
+    try:
+        srv.start()
+
+        from veneur_tpu.testbed.cluster import EGRESS_SETTLE_TIMEOUT_S
+
+        def ingest_and_flush():
+            for line in traffic.next_interval(1)[0]:
+                srv.handle_metric_packet(line)
+            srv.flush()
+            srv.egress.settle(timeout_s=EGRESS_SETTLE_TIMEOUT_S)
+
+        # interval 1: attempts fail, retries exhaust, breaker trips,
+        # the payload spills to the sink's durable spool
+        ingest_and_flush()
+        _wait_until(lambda: lane.spool.stats()["spilled"] >= 1,
+                    what="first spill")
+        _wait_until(lambda: lane.breaker.trips >= 1,
+                    what="breaker trip")
+        # interval 2: the breaker is engaged — the spool keeps
+        # absorbing (straight spill or a failed half-open probe)
+        ingest_and_flush()
+        _wait_until(lambda: lane.spool.stats()["spilled"] >= 2,
+                    what="breaker-window spill")
+        trips_seen = lane.breaker.trips
+        mid = srv.egress.stats()
+        mid_closed = mid["ledger_closed"]
+        # the backend recovers: the half-open probe must close the
+        # breaker and the replayer must drain every pending record
+        failpoints.disarm(arm.failpoint)
+        _wait_until(lambda: (lane.spool.stats()["pending_records"] == 0
+                             and lane.spool.stats()["replayed"] > 0),
+                    what="replay drain")
+        _wait_until(lambda: lane.breaker.state() == "closed",
+                    what="breaker close")
+        eg = srv.egress.stats()
+    finally:
+        failpoints.disarm(arm.failpoint)
+        srv.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    got = []
+    while not sink.queue.empty():
+        got.extend(sink.queue.get())
+    counters = verify.check_counters(traffic.oracle, [[got]])
+    conserved = counters["exact"]
+    dropped_total = eg["dropped"] + eg["queue_dropped"] \
+        + eg["spool_dropped"] + eg["expired"]
+    accounted = conserved or dropped_total > 0
+    ok = (fp.fired > 0 and conserved and trips_seen >= 1
+          and eg["spilled"] > 0 and eg["replayed"] > 0
+          and mid_closed and eg["ledger_closed"]
+          and eg["pending"] == 0)
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": arm.action,
+        "expect": arm.expect,
+        "fired": fp.fired,
+        "conserved": conserved,
+        "counter_deficit": counters["deficit"],
+        "dropped_total": dropped_total,
+        "forward_retries": 0,
+        "forward_dropped": 0,
+        "routing_exclusive": True,
+        "no_silent_loss": accounted,
+        "breaker_trips": trips_seen,
+        "egress": {k: eg[k] for k in
+                   ("flushed", "retried", "spilled", "replayed",
+                    "expired", "dropped", "pending")},
+        "egress_ledger_closed": mid_closed and eg["ledger_closed"],
+        "ok": ok,
+    }
 
 
 def run_chaos_matrix(arms=None, seed: int = 0, **kwargs) -> list[dict]:

@@ -31,6 +31,10 @@ from veneur_tpu.sinks import simple as simple_sinks
 _MAX_DGRAM_LINES = 25
 _MAX_DGRAM_BYTES = 1200
 
+# bound on waiting out a node's async egress lanes before reading its
+# channel sink (sink fan-out is queue-handoff now, not in-flush)
+EGRESS_SETTLE_TIMEOUT_S = 15.0
+
 
 @dataclass
 class ClusterSpec:
@@ -488,10 +492,13 @@ class Cluster:
 
     def flush_globals(self) -> list[list]:
         """Flush every global and drain its sink; returns per-global
-        lists of InterMetric for THIS interval."""
+        lists of InterMetric for THIS interval.  Sink fan-out is async
+        (the egress lanes), so each flush settles its egress queue
+        before the channel sink is read."""
         out = []
         for n in self.globals:
             n.server.flush()
+            n.server.egress.settle(timeout_s=EGRESS_SETTLE_TIMEOUT_S)
             got = []
             while not n.sink.queue.empty():
                 got.extend(n.sink.queue.get())
@@ -501,6 +508,7 @@ class Cluster:
     def drain_local_sinks(self) -> list[list]:
         out = []
         for n in self.locals:
+            n.server.egress.settle(timeout_s=EGRESS_SETTLE_TIMEOUT_S)
             got = []
             while not n.sink.queue.empty():
                 got.extend(n.sink.queue.get())
@@ -616,6 +624,21 @@ class Cluster:
                 ds = n.server.dedup.stats()
                 dedup["recorded"] += ds["recorded"]
                 dedup["duplicates"] += ds["duplicates"]
+        # egress data-plane ledger across every live node (sink
+        # fan-out loss channels join the no-silent-loss denominator)
+        egress = {"flushed": 0, "retried": 0, "spilled": 0,
+                  "replayed": 0, "expired": 0, "dropped": 0,
+                  "pending": 0}
+        for n in self.locals + self.globals:
+            es = n.server.egress.stats()
+            egress["flushed"] += es["flushed"]
+            egress["retried"] += es["retried"]
+            egress["spilled"] += es["spilled"]
+            egress["replayed"] += es["replayed"]
+            egress["expired"] += es["expired"]
+            egress["dropped"] += (es["dropped"] + es["queue_dropped"]
+                                  + es["spool_dropped"])
+            egress["pending"] += es["pending"]
         # per-tenant quota/eviction totals across the local tier (zeros
         # when the defense is off — the keys are still promised)
         card = {"keys_evicted": 0, "tenants_over_budget": 0,
@@ -630,6 +653,7 @@ class Cluster:
         return {
             "forward": fw,
             "cardinality": card,
+            "egress": egress,
             "spool": spool,
             "checkpoint": ckpt,
             "dedup": dedup,
@@ -650,8 +674,9 @@ class Cluster:
                                  for n in self.locals),
             "global_flushes": sum(n.server.flush_count
                                   for n in self.globals),
-            # spool expiry and replay-drops are VISIBLE loss channels:
-            # they join the no-silent-loss denominator
+            # spool expiry, replay-drops and egress-lane drops are
+            # VISIBLE loss channels: they join the no-silent-loss
+            # denominator
             "dropped_total": (fw["dropped"]
                               + sum(n.server.forward_dropped
                                     for n in self.locals)
@@ -659,5 +684,7 @@ class Cluster:
                               + pstats["no_destination"]
                               + dest_totals["dropped"]
                               + spool["expired_points"]
-                              + spool["dropped_points"]),
+                              + spool["dropped_points"]
+                              + egress["dropped"]
+                              + egress["expired"]),
         }

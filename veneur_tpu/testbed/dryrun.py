@@ -27,7 +27,7 @@ PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
     "routing_exclusive", "chaos_matrix", "lock_witness", "trace",
-    "spool", "checkpoint", "ok",
+    "spool", "checkpoint", "egress", "ok",
 ]
 
 
@@ -154,6 +154,15 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         "spool": {"spilled": acct["spool"]["spilled"],
                   "replayed": acct["spool"]["replayed"],
                   "expired": acct["spool"]["expired"]},
+        # egress data-plane ledger across every tier (sink fan-out):
+        # points delivered / retry attempts / spool spill-replay /
+        # visible drops — zeros on a healthy run, but the keys are
+        # promised so dashboards and CI can rely on them
+        "egress": {"flushed": acct["egress"]["flushed"],
+                   "retried": acct["egress"]["retried"],
+                   "spilled": acct["egress"]["spilled"],
+                   "replayed": acct["egress"]["replayed"],
+                   "dropped": acct["egress"]["dropped"]},
         "checkpoint": {"restores": acct["checkpoint"]["restores"],
                        "age_ms": acct["checkpoint"]["age_ms"]},
         "reshard_moved": acct["reshard"]["moved_total"],
