@@ -41,14 +41,25 @@ def main() -> int:
                     help="skip the native sanitizer smoke")
     ap.add_argument("--json", metavar="FILE",
                     help="also write the vnlint JSON report here")
+    ap.add_argument("--changed-only", metavar="GIT_REF",
+                    help="vnlint incremental mode: report findings "
+                    "only for files changed vs this git ref (the "
+                    "whole tree is still parsed; the schema-sync "
+                    "check always runs in full)")
     args = ap.parse_args()
     os.chdir(REPO)
     results: list[tuple[str, str, float]] = []
 
-    # 1. vnlint over the package tree
-    t0 = stage("vnlint (veneur_tpu/)")
+    # 1. vnlint over the package tree + telemetry-schema artifact sync
+    # (a new emit site that was not re-committed to
+    # analysis/telemetry_schema.json fails HERE, in seconds)
+    t0 = stage("vnlint (veneur_tpu/) + telemetry schema sync")
     from veneur_tpu.analysis.__main__ import main as vnlint_main
-    lint_args = ["--json", args.json] if args.json else []
+    lint_args = ["--check-schema", "analysis/telemetry_schema.json"]
+    if args.json:
+        lint_args += ["--json", args.json]
+    if args.changed_only:
+        lint_args += ["--changed-only", args.changed_only]
     lint_rc = vnlint_main(lint_args)
     results.append(("vnlint", "PASS" if lint_rc == 0 else "FAIL",
                     time.perf_counter() - t0))
@@ -121,17 +132,22 @@ def main() -> int:
     # per-sink breaker + durable spool, recovery must close the breaker
     # and replay-drain to EXACT conservation, and the egress ledger
     # closure (spilled == replayed + expired + dropped + pending) must
-    # hold throughout (the full matrix is
-    # `scripts/dryrun_3tier.py --chaos all`)
+    # hold throughout.  Runs telemetry-witnessed (ISSUE 12): every
+    # series the cell emits and every /debug/vars key it snapshots must
+    # exist in the committed schema (an unknown one is an analyzer gap
+    # and fails), and the runtime ledger comparator must report every
+    # declared closure holding over the observed counters (the full
+    # matrix is `scripts/dryrun_3tier.py --chaos all`)
     egress_rc = 0
     if args.fast:
         results.append(("egress chaos cell", "SKIP", 0.0))
     else:
-        t0 = stage("egress chaos cell (sink-blackhole)")
+        t0 = stage("egress chaos cell (sink-blackhole, "
+                   "telemetry-witnessed)")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         egress_rc = subprocess.call(
             [sys.executable, "scripts/dryrun_3tier.py",
-             "--chaos-only", "sink-blackhole"],
+             "--chaos-only", "sink-blackhole", "--telemetry"],
             env=env)
         results.append(("egress chaos cell",
                         "PASS" if egress_rc == 0 else "FAIL",

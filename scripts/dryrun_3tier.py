@@ -68,6 +68,13 @@ def main(argv=None) -> int:
                     "lock witness and cross-validate observed "
                     "acquisition-order edges against the static "
                     "lock-order graph (nonzero exit on analyzer gaps)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="record every emitted series + /debug/vars "
+                    "snapshot on every tier and cross-validate against "
+                    "the static telemetry schema: observed "
+                    "series/keys the schema lacks are analyzer gaps, "
+                    "and every declared runtime ledger must CLOSE "
+                    "(nonzero exit on gaps or an open ledger)")
     ap.add_argument("--cpu", action="store_true",
                     help="force JAX onto CPU (the dryrun's default "
                     "posture off the driver host)")
@@ -87,18 +94,26 @@ def main(argv=None) -> int:
     if args.chaos_only:
         from veneur_tpu.testbed.chaos import (arm_by_name,
                                               run_chaos_arm,
+                                              telemetry_comparison,
                                               witness_comparison)
 
         witness = None
         if args.lock_witness:
             from veneur_tpu.analysis.witness import LockWitness
             witness = LockWitness()
+        telemetry = None
+        if args.telemetry:
+            from veneur_tpu.analysis.telemetry import TelemetryWitness
+            telemetry = TelemetryWitness()
         row = run_chaos_arm(arm_by_name(args.chaos_only),
                             seed=args.seed, witness=witness,
-                            trace=args.trace)
+                            trace=args.trace, telemetry=telemetry)
         if witness is not None:
             row["lock_witness"] = witness_comparison(witness)
             row["ok"] = row["ok"] and row["lock_witness"]["ok"]
+        if telemetry is not None:
+            row["telemetry"] = telemetry_comparison(telemetry)
+            row["ok"] = row["ok"] and row["telemetry"]["ok"]
         body = json.dumps(row, indent=2, default=str)
         if args.out:
             with open(args.out, "w") as f:
@@ -113,6 +128,12 @@ def main(argv=None) -> int:
             lw = row["lock_witness"]
             tail = (f"; lock witness: {lw['observed_edges']} observed "
                     f"edge(s), 0 gaps")
+        if telemetry is not None:
+            tm = row["telemetry"]
+            closed = sum(1 for r in tm["ledgers"].values()
+                         if r["nodes"])
+            tail += (f"; telemetry: {tm['observed_series']} series, "
+                     f"0 gaps, {closed} ledger(s) closed")
         print(f"# chaos arm {args.chaos_only} OK{tail}",
               file=sys.stderr)
         return 0
@@ -128,7 +149,7 @@ def main(argv=None) -> int:
         interval_s=args.interval_s,
         cardinality_key_budget=args.cardinality_budget,
         chaos=args.chaos, lock_witness=args.lock_witness,
-        trace=args.trace)
+        trace=args.trace, telemetry=args.telemetry)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:

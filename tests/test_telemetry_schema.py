@@ -1,0 +1,336 @@
+"""Telemetry schema registry (ISSUE 12): static extraction, the three
+schema checks, the committed-artifact sync gate, the runtime comparator
+(gap vs matched vs ledger closure), and the tier-1 testbed gate where a
+live cluster's observed telemetry must match the schema with every
+declared ledger closing.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from veneur_tpu.analysis import LintEngine  # noqa: E402
+from veneur_tpu.analysis import telemetry  # noqa: E402
+from veneur_tpu.analysis.__main__ import main as vnlint_main  # noqa: E402
+
+PKG = os.path.join(REPO, "veneur_tpu")
+ARTIFACT = os.path.join(REPO, "analysis", "telemetry_schema.json")
+
+_CASE = [0]
+
+
+def lint_source(tmp_path, source: str, relname: str = "mod.py"):
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    path = root / relname
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return LintEngine().run([str(root)])
+
+
+def rules_fired(report) -> set:
+    return {f.rule for f in report.findings if not f.suppressed}
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def test_extraction_resolves_fstrings_and_constants(tmp_path):
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        'SERIES_NAME = "pipe.delivered_total"\n\n\n'
+        "def emit(statsd, seg):\n"
+        '    statsd.count(SERIES_NAME, 1, tags=["sink:x"])\n'
+        '    statsd.timing(f"pipe.segment.{seg}_ms", 1.0)\n'
+        "    statsd.gauge(compute_name(), 2.0)\n")
+    _root, modules, _ = __import__(
+        "veneur_tpu.analysis.engine", fromlist=["x"]).load_modules(
+        [str(root)], set())
+    emits, dynamic = telemetry.extract_emits(modules)
+    by_name = {e["name"]: e for e in emits}
+    # constant resolved through the project table
+    assert by_name["pipe.delivered_total"]["type"] == "counter"
+    assert by_name["pipe.delivered_total"]["tags"] == ["sink"]
+    # f-string becomes a * pattern
+    assert by_name["pipe.segment.*_ms"]["pattern"] is True
+    # a truly dynamic name is an explicit blind spot, never dropped
+    assert len(dynamic) == 1
+    assert "compute_name" in dynamic[0]["expr"]
+
+
+def test_schema_matcher_exact_then_pattern():
+    schema = {"emits": [
+        {"name": "a.b_total", "pattern": False, "type": "counter",
+         "tags": [], "site": "x:1", "ledger": ""},
+        {"name": "a.seg.*_ms", "pattern": True, "type": "timing",
+         "tags": [], "site": "x:2", "ledger": ""},
+    ]}
+    match = telemetry.series_matcher(schema)
+    assert match("a.b_total")["site"] == "x:1"
+    assert match("a.seg.device_ms")["site"] == "x:2"
+    assert match("a.unknown_total") is None
+
+
+# ---------------------------------------------------------------------------
+# the three static checks (as the telemetry-schema lint rule)
+# ---------------------------------------------------------------------------
+
+COLLIDING_TYPES = """
+def a(statsd):
+    statsd.count("pipe.latency_ms", 1, tags=["t:1"])
+
+
+def b(statsd):
+    statsd.gauge("pipe.latency_ms", 2.0)
+"""
+
+
+def test_type_collision_fires(tmp_path):
+    report = lint_source(tmp_path, COLLIDING_TYPES)
+    hits = [f for f in report.findings
+            if f.rule == "telemetry-schema"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "conflicting types" in hits[0].message
+    assert "pipe.latency_ms" in hits[0].message
+
+
+def test_subset_tag_shapes_are_compatible(tmp_path):
+    """A success-path emit with FEWER tags than its failure-path twin
+    (forward.error_total's shape) groups fine — only disjoint
+    dimensions collide."""
+    report = lint_source(tmp_path, (
+        "def ok(statsd):\n"
+        '    statsd.count("pipe.err_total", 0)\n\n\n'
+        "def bad(statsd):\n"
+        '    statsd.count("pipe.err_total", 1, tags=["cause:x"])\n'))
+    assert "telemetry-schema" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_disjoint_tag_shapes_collide(tmp_path):
+    report = lint_source(tmp_path, (
+        "def a(statsd):\n"
+        '    statsd.count("pipe.x_total", 1, tags=["sink:a"])\n\n\n'
+        "def b(statsd):\n"
+        '    statsd.count("pipe.x_total", 1, tags=["cause:b"])\n'))
+    hits = [f for f in report.findings
+            if f.rule == "telemetry-schema"]
+    assert len(hits) == 1
+    assert "tag shapes" in hits[0].message
+
+
+def test_consumer_drift_fires_and_emitted_is_quiet(tmp_path):
+    drifted = (
+        'PROMISED_SERIES = ["pipe.lost_total", "pipe.kept_total"]\n\n\n'
+        "def emit(statsd):\n"
+        '    statsd.count("pipe.kept_total", 1)\n')
+    report = lint_source(tmp_path, drifted)
+    hits = [f for f in report.findings
+            if f.rule == "telemetry-schema"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "pipe.lost_total" in hits[0].message
+    assert "no site emits it" in hits[0].message
+    fixed = drifted + (
+        "\n\ndef emit2(statsd):\n"
+        '    statsd.count("pipe.lost_total", 1)\n')
+    report2 = lint_source(tmp_path, fixed, relname="mod2.py")
+    assert "telemetry-schema" not in rules_fired(report2), \
+        [f.format() for f in report2.findings]
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact
+# ---------------------------------------------------------------------------
+
+def test_repo_schema_matches_committed_artifact():
+    """The tier-1 sync gate, exactly like lock_order_graph.json: a new
+    emit site / debug-vars key / ledger change that is not re-committed
+    (python -m veneur_tpu.analysis --emit-schema
+    analysis/telemetry_schema.json) fails here first.  Sites may drift
+    with line numbers; names, types, tag shapes and ledger topology
+    must not change silently."""
+    with open(ARTIFACT) as f:
+        committed = json.load(f)
+    fresh = telemetry.build_schema_for_tree([PKG])
+    assert telemetry.schema_fingerprint(fresh) == \
+        telemetry.schema_fingerprint(committed)
+
+
+def test_repo_schema_covers_the_known_surface():
+    fresh = telemetry.build_schema_for_tree([PKG])
+    names = {e["name"] for e in fresh["emits"]}
+    # the conservation story's flagship series all extract
+    for known in ("forward.retries_total", "forward.dropped_total",
+                  "egress.queue_full_total", "import.errors_total",
+                  "listen.parse_errors_total",
+                  "sink.metrics_flushed_total"):
+        assert known in names, sorted(names)
+    dv = {(d["tier"], d["key"]) for d in fresh["debug_vars"]}
+    assert ("server", "egress") in dv
+    assert ("server", "spool") in dv
+    assert ("proxy", "reshard") in dv
+    # every declared closure references only producer-written fields
+    for name, led in fresh["ledgers"].items():
+        if led["closure"]:
+            for side in led["closure"]:
+                for field in side:
+                    assert field in led["fields"], (name, field)
+    # and the repo's schema is internally clean
+    assert telemetry.schema_issues(fresh) == []
+
+
+def test_emit_and_check_schema_cli(tmp_path, capsys):
+    d = tmp_path / "tree"
+    d.mkdir()
+    (d / "mod.py").write_text(
+        "def emit(statsd):\n"
+        '    statsd.count("pipe.kept_total", 1)\n')
+    out = tmp_path / "schema.json"
+    assert vnlint_main([str(d), "--emit-schema", str(out)]) == 0
+    assert vnlint_main([str(d), "--check-schema", str(out)]) == 0
+    # the tree grows an emit the artifact doesn't know: DRIFT
+    (d / "mod.py").write_text(
+        "def emit(statsd):\n"
+        '    statsd.count("pipe.kept_total", 1)\n'
+        '    statsd.count("pipe.new_total", 1)\n')
+    assert vnlint_main([str(d), "--check-schema", str(out)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# the runtime comparator
+# ---------------------------------------------------------------------------
+
+def _schema(emits=(), debug_vars=(), ledgers=None):
+    return {"emits": list(emits), "dynamic_emits": [],
+            "debug_vars": list(debug_vars),
+            "ledgers": ledgers or {}, "consumers": []}
+
+
+def test_comparator_matches_and_flags_series_gaps():
+    schema = _schema(emits=[
+        {"name": "a.b_total", "pattern": False, "type": "counter",
+         "tags": [], "site": "x:1", "ledger": ""},
+        {"name": "a.seg.*_ms", "pattern": True, "type": "timing",
+         "tags": [], "site": "x:2", "ledger": ""}])
+    good = telemetry.compare_runtime(schema, {
+        "series": [{"name": "a.b_total", "type": "counter", "count": 3},
+                   {"name": "a.seg.sort_ms", "type": "timing",
+                    "count": 1}],
+        "nodes": []})
+    assert good["ok"] and good["matched_series"] == 2
+    bad = telemetry.compare_runtime(schema, {
+        "series": [{"name": "a.rogue_total", "type": "counter",
+                    "count": 1}],
+        "nodes": []})
+    assert not bad["ok"]
+    assert bad["gaps"][0]["name"] == "a.rogue_total"
+    # type mismatch on an exact name is also an analyzer gap
+    wrong = telemetry.compare_runtime(schema, {
+        "series": [{"name": "a.b_total", "type": "gauge", "count": 1}],
+        "nodes": []})
+    assert not wrong["ok"]
+    assert wrong["gaps"][0]["kind"] == "series-type"
+
+
+def test_comparator_flags_unknown_debug_vars_key():
+    schema = _schema(debug_vars=[{"tier": "server", "key": "known",
+                                  "site": "x:1"}])
+    bad = telemetry.compare_runtime(schema, {
+        "series": [],
+        "nodes": [{"tier": "server",
+                   "vars": {"known": 1, "rogue": 2}}]})
+    assert not bad["ok"]
+    assert bad["gaps"] == [{"kind": "debug-vars", "name": "rogue",
+                            "detail": "server /debug/vars key absent "
+                                      "from the static schema"}]
+
+
+def test_comparator_ledger_closure_and_open_ledger():
+    ledgers = {"spool": {
+        "debug_vars": "spool",
+        "closure": [["spilled"], ["replayed", "pending"]],
+        "fields": ["spilled", "replayed", "pending"],
+        "prefixes": []}}
+    schema = _schema(
+        debug_vars=[{"tier": "server", "key": "spool", "site": "x:1"}],
+        ledgers=ledgers)
+    closed = telemetry.compare_runtime(schema, {
+        "series": [],
+        "nodes": [{"tier": "server",
+                   "vars": {"spool": {"spilled": 5, "replayed": 3,
+                                      "pending": 2}}}]})
+    assert closed["ok"]
+    assert closed["ledgers"]["spool"] == {"nodes": 1, "closed": True}
+    leaking = telemetry.compare_runtime(schema, {
+        "series": [],
+        "nodes": [{"tier": "server",
+                   "vars": {"spool": {"spilled": 5, "replayed": 3,
+                                      "pending": 1}}}]})
+    assert not leaking["ok"]
+    assert leaking["ledgers"]["spool"]["closed"] is False
+    assert leaking["ledgers"]["spool"]["delta"] == 1
+
+
+def test_comparator_missing_closure_field_is_a_gap():
+    ledgers = {"spool": {
+        "debug_vars": "spool",
+        "closure": [["spilled"], ["replayed"]],
+        "fields": ["spilled", "replayed"], "prefixes": []}}
+    schema = _schema(
+        debug_vars=[{"tier": "server", "key": "spool", "site": "x:1"}],
+        ledgers=ledgers)
+    bad = telemetry.compare_runtime(schema, {
+        "series": [],
+        "nodes": [{"tier": "server",
+                   "vars": {"spool": {"spilled": 5}}}]})
+    assert not bad["ok"]
+    assert bad["gaps"][0]["kind"] == "ledger"
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 runtime gate: a live testbed cluster vs the schema
+# ---------------------------------------------------------------------------
+
+def test_testbed_telemetry_matches_schema_tier1():
+    """A real 1x1 cluster interval, telemetry-witnessed: every series
+    the tiers emit and every /debug/vars key they expose must exist in
+    the static schema (an unknown one is an analyzer gap), and every
+    declared ledger closure must hold over the observed counters."""
+    from veneur_tpu.testbed.dryrun import run_dryrun
+    report = run_dryrun(n_locals=1, n_globals=1, intervals=1,
+                        telemetry=True)
+    tm = report["telemetry"]
+    assert tm is not None
+    assert tm["gaps"] == [], tm["gaps"]
+    assert tm["observed_series"] > 10
+    assert tm["matched_series"] == tm["observed_series"]
+    # the egress ledger is live on every node of the cell
+    assert tm["ledgers"]["egress"]["nodes"] >= 2
+    assert tm["ledgers"]["egress"]["closed"]
+    assert tm["ok"] and report["ok"]
+
+
+@pytest.mark.slow
+def test_chaos_matrix_telemetry_gate_slow():
+    """Every chaos arm in the matrix, one shared telemetry witness: the
+    full fault surface (drops, retries, breakers, crashes, spill and
+    replay) must stay inside the schema with all ledgers closing."""
+    from veneur_tpu.testbed.chaos import (ALL_ARMS, run_chaos_arm,
+                                          telemetry_comparison)
+    witness = telemetry.TelemetryWitness()
+    rows = [run_chaos_arm(a, seed=0, telemetry=witness)
+            for a in ALL_ARMS]
+    assert all(r["ok"] for r in rows), \
+        [(r["arm"], r["ok"]) for r in rows]
+    cmp = telemetry_comparison(witness)
+    assert cmp["gaps"] == [], cmp["gaps"]
+    assert cmp["ok"]

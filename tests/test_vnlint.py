@@ -870,6 +870,205 @@ def test_repo_lock_graph_matches_committed_artifact():
 
 
 # ---------------------------------------------------------------------------
+# silent-loss — the conservation dataflow pass (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+SILENT_QUEUE_DROP = """
+import queue
+
+
+def submit_batch(self, batch):
+    try:
+        self.q.put_nowait(batch)
+    except queue.Full:
+        pass
+"""
+
+ACCOUNTED_QUEUE_DROP = """
+import queue
+
+
+def submit_batch(self, batch):
+    try:
+        self.q.put_nowait(batch)
+    except queue.Full:
+        self.statsd.count("egress.queue_full_total", 1,
+                          tags=["sink:x"])
+"""
+
+INTERPROC_ACCOUNTED_DROP = """
+import queue
+
+
+def submit_batch(self, batch):
+    try:
+        self.q.put_nowait(batch)
+    except queue.Full:
+        self._note_drop(len(batch))
+
+
+def _note_drop(self, n):
+    self.dropped_points += n
+"""
+
+
+def test_silent_loss_queue_full_fires(tmp_path):
+    """The canonical log-and-lose shape: a queue-full branch with no
+    counter is invisible loss — the exact bug class every chaos arm
+    exists to rule out."""
+    report = lint_source(tmp_path, SILENT_QUEUE_DROP,
+                         relname="egress/mod.py")
+    hits = [f for f in report.findings if f.rule == "silent-loss"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "queue-full branch" in hits[0].message
+    assert "batch" in hits[0].message
+
+
+def test_silent_loss_accounted_form_is_quiet(tmp_path):
+    report = lint_source(tmp_path, ACCOUNTED_QUEUE_DROP,
+                         relname="egress/mod.py")
+    assert "silent-loss" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_silent_loss_interprocedural_reach_is_quiet(tmp_path):
+    """The accounting may live in a helper: the rule must follow the
+    resolved call (`self._note_drop` -> ledger-field bump) before
+    declaring the discard silent."""
+    report = lint_source(tmp_path, INTERPROC_ACCOUNTED_DROP,
+                         relname="egress/mod.py")
+    assert "silent-loss" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_silent_loss_out_of_pipeline_scope_is_quiet(tmp_path):
+    """The same swallowed except outside the pipeline packages (a
+    bench driver, a test helper) is not conservation-relevant."""
+    report = lint_source(tmp_path, SILENT_QUEUE_DROP,
+                         relname="profiling/mod.py")
+    assert "silent-loss" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_silent_loss_reraise_is_quiet(tmp_path):
+    report = lint_source(tmp_path, (
+        "def deliver(self, payload):\n"
+        "    try:\n"
+        "        self.sink.send(payload)\n"
+        "    except Exception as e:\n"
+        "        raise RuntimeError('send failed') from e\n"),
+        relname="sinks/mod.py")
+    assert "silent-loss" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_silent_loss_discard_named_function(tmp_path):
+    """A function NAMED for discarding is the site other code trusts to
+    account the loss — an unaccounted one fires, the counted form is
+    quiet."""
+    buggy = ("def evict_rows(self, rows):\n"
+             "    self.table.remove_rows(rows)\n")
+    fixed = ("def evict_rows(self, rows):\n"
+             "    self.table.remove_rows(rows)\n"
+             "    self.evicted_total += len(rows)\n")
+    report = lint_source(tmp_path, buggy, relname="ingest/mod.py")
+    hits = [f for f in report.findings if f.rule == "silent-loss"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "evict_rows" in hits[0].message
+    report2 = lint_source(tmp_path, fixed, relname="ingest/mod2.py")
+    assert "silent-loss" not in rules_fired(report2), \
+        [f.format() for f in report2.findings]
+
+
+def test_silent_loss_error_reply_is_accounted(tmp_path):
+    """Reporting the failure to the SENDER (an HTTP 4xx reply) is not
+    silent loss — the caller owns the retry."""
+    report = lint_source(tmp_path, (
+        "def handle(self, request):\n"
+        "    try:\n"
+        "        out = self.decode(request)\n"
+        "    except ValueError:\n"
+        "        self._reply(400, b'bad request')\n"
+        "        return\n"
+        "    return out\n"), relname="sources/mod.py")
+    assert "silent-loss" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# dead-suppression — stale mutes auto-expire (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+DEAD_SUPPRESSION_SRC = """
+def snapshot(self):
+    with self.lock:
+        # vnlint: disable=sync-under-lock (the fetch used to live here)
+        val = self.plain_value
+    return val
+"""
+
+
+def test_dead_suppression_fires_when_code_moved(tmp_path):
+    """A suppression whose governed line no longer triggers its rule is
+    stale folklore: it must surface, carrying the stale reason."""
+    report = lint_source(tmp_path, DEAD_SUPPRESSION_SRC)
+    hits = [f for f in report.findings if f.rule == "dead-suppression"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "sync-under-lock" in hits[0].message
+    assert "the fetch used to live here" in hits[0].message
+
+
+def test_live_suppression_not_flagged_dead(tmp_path):
+    report = lint_source(tmp_path, SUPPRESSED_OK)
+    assert "dead-suppression" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_line_directive_under_file_wide_not_flagged_dead(tmp_path):
+    """A line-level directive layered under a file-wide one for the
+    same rule is LIVE when its line genuinely fires — file-wide
+    precedence must not mark it dead."""
+    report = lint_source(tmp_path, (
+        "# vnlint: disable-file=sync-under-lock (fixture: file-wide)\n"
+        "def snapshot(self):\n"
+        "    with self.lock:\n"
+        "        # vnlint: disable=sync-under-lock (fixture: layered)\n"
+        "        val = self.dev_array.item()\n"
+        "    return val\n"))
+    assert "dead-suppression" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
+def test_dead_suppression_skipped_for_unselected_rules(tmp_path):
+    """--rules subsets must not judge suppressions of rules that did
+    not run (the suppressed rule might well still fire)."""
+    from veneur_tpu.analysis.rules.literals import MagicLiteral
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    root.mkdir()
+    (root / "mod.py").write_text(DEAD_SUPPRESSION_SRC)
+    report = LintEngine(rules=[MagicLiteral()]).run([str(root)])
+    assert report.findings == [], \
+        [f.format() for f in report.findings]
+
+
+def test_changed_only_filters_to_changed_files(tmp_path):
+    """--changed-only: the whole tree parses (cross-module rules keep
+    the full picture) but findings report only for the changed set."""
+    _CASE[0] += 1
+    root = tmp_path / f"case{_CASE[0]}"
+    root.mkdir()
+    (root / "a.py").write_text(DONATION_BUG)
+    (root / "b.py").write_text(DONATION_BUG)
+    eng = LintEngine()
+    full = eng.run([str(root)])
+    assert {f.path for f in full.unsuppressed} == {"a.py", "b.py"}
+    partial = eng.run([str(root)],
+                      changed_only={str(root / "b.py")})
+    assert {f.path for f in partial.unsuppressed} == {"b.py"}
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1021,7 +1220,7 @@ def test_repo_self_run_is_clean():
 @pytest.mark.parametrize("rule", [
     "donation-aliasing", "resource-pairing", "prewarm-parity",
     "sync-under-lock", "lock-order", "blocking-propagation",
-    "magic-literal"])
+    "silent-loss", "telemetry-schema", "magic-literal"])
 def test_rule_registry_complete(rule):
     from veneur_tpu.analysis import rule_names
     assert rule in rule_names()

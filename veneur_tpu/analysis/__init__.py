@@ -45,6 +45,20 @@ shipped crossed a function boundary:
                       that REACHES .result()/time.sleep/device sync
                       through any call chain is blocking, and calling
                       it under a lock fires with the full chain
+  silent-loss         a pipeline discard path (swallowed except,
+                      queue-full branch, discard-named function) that
+                      reaches NO accounting increment — statsd count,
+                      /debug/vars dict bump, or ledger-field write —
+                      within the region or any resolved callee:
+                      invisible data loss, the conservation
+                      invariant's structural check
+  telemetry-schema    the accounting surface itself: emit-site
+                      collisions, promised-series drift, and ledger
+                      drift against the telemetry schema registry
+                      (analysis/telemetry.py; committed artifact
+                      analysis/telemetry_schema.json, --emit-schema /
+                      --check-schema, runtime-witnessed via
+                      `dryrun_3tier.py --telemetry`)
 
 The static lock-order graph is exported (`--emit-graph`; committed at
 analysis/lock_order_graph.json) and cross-validated at runtime by the
@@ -61,7 +75,8 @@ Run it:
     python -m veneur_tpu.analysis --emit-graph analysis/lock_order_graph.json
 
 Suppress a finding (the reason is MANDATORY — a reasonless suppression
-is itself an error):
+is itself an error, and a suppression whose governed line no longer
+fires its rule is flagged `dead-suppression` so stale mutes expire):
 
     x = thing()  # vnlint: disable=sync-under-lock (flush lock is meant
                  #   to cover the device wait)

@@ -35,6 +35,20 @@ def main(argv=None) -> int:
                          "(nodes, edges with witness chains, cycles) "
                          "as JSON ('-' = stdout); the static side of "
                          "the runtime lock-witness comparison")
+    ap.add_argument("--emit-schema", metavar="FILE",
+                    help="write the telemetry schema registry (every "
+                         "emitted series + /debug/vars key + ledger) "
+                         "as JSON ('-' = stdout); commit it at "
+                         "analysis/telemetry_schema.json")
+    ap.add_argument("--check-schema", metavar="FILE",
+                    help="compare the freshly-extracted telemetry "
+                         "schema against this committed artifact; "
+                         "exit 1 on drift (the artifact-sync gate)")
+    ap.add_argument("--changed-only", metavar="GIT_REF",
+                    help="report findings only for files changed vs "
+                         "this git ref (plus untracked files); the "
+                         "whole tree is still parsed so cross-module "
+                         "rules keep the full picture")
     args = ap.parse_args(argv)
 
     every = rules_mod.all_rules()
@@ -52,8 +66,50 @@ def main(argv=None) -> int:
             return 2
         rules = [r for r in every if r.name in wanted]
 
+    changed = None
+    if args.changed_only:
+        import os
+        import subprocess
+        try:
+            changed = engine_mod.changed_paths(
+                args.changed_only,
+                (args.paths or [os.getcwd()])[0])
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"--changed-only: {e}", file=sys.stderr)
+            return 2
+
     eng = engine_mod.LintEngine(rules=rules)
-    report = eng.run(args.paths or None)
+    report = eng.run(args.paths or None, changed_only=changed)
+
+    schema_rc = 0
+    if args.emit_schema or args.check_schema:
+        from veneur_tpu.analysis import telemetry
+        # reuse the run's schema when the telemetry-schema rule built
+        # one over these modules; else build it fresh from the same
+        # parsed tree
+        schema = getattr(eng.last_context, "_telemetry_schema", None)
+        if schema is None:
+            schema = telemetry.build_schema_for_tree(args.paths or None)
+        if args.emit_schema:
+            telemetry.write_schema(schema, args.emit_schema)
+        if args.check_schema:
+            try:
+                committed = telemetry.load_schema(args.check_schema)
+            except (OSError, ValueError) as e:
+                print(f"--check-schema: {e}", file=sys.stderr)
+                return 2
+            if telemetry.schema_fingerprint(committed) != \
+                    telemetry.schema_fingerprint(schema):
+                print("telemetry schema DRIFT: the committed artifact "
+                      f"{args.check_schema} no longer matches the "
+                      "tree; regenerate with --emit-schema "
+                      f"{args.check_schema}", file=sys.stderr)
+                schema_rc = 1
+            else:
+                print(f"telemetry schema in sync "
+                      f"({len(schema['emits'])} emits, "
+                      f"{len(schema['debug_vars'])} debug-vars keys, "
+                      f"{len(schema['ledgers'])} ledgers)")
 
     if args.emit_graph:
         import json
@@ -85,7 +141,7 @@ def main(argv=None) -> int:
         else:
             with open(args.json, "w", encoding="utf-8") as fh:
                 fh.write(payload)
-    return 1 if n_bad else 0
+    return 1 if (n_bad or schema_rc) else 0
 
 
 if __name__ == "__main__":

@@ -27,6 +27,7 @@ from typing import Iterable, Optional
 from veneur_tpu.analysis import astutil, suppress
 
 BAD_SUPPRESSION = "bad-suppression"
+DEAD_SUPPRESSION = "dead-suppression"
 PARSE_ERROR = "parse-error"
 
 _SKIP_DIR_NAMES = {"__pycache__", ".build", ".git", "testdata"}
@@ -68,6 +69,13 @@ class Module:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         astutil.add_parents(self.tree)
+        # ONE walk, shared by every rule: nodes bucketed by exact AST
+        # class (plus the flat walk-order list for multi-class scans),
+        # so N rules cost one tree traversal, not N
+        self._all_nodes = list(ast.walk(self.tree))
+        self._node_buckets: dict[type, list] = {}
+        for node in self._all_nodes:
+            self._node_buckets.setdefault(type(node), []).append(node)
         self.suppressions = suppress.parse(source, known_rules)
         # module stem for cross-module symbol resolution
         # ("serving.set_lane_scatter" -> stem "serving")
@@ -77,6 +85,14 @@ class Module:
         if self.stem == "__init__":
             # a package __init__ is addressed by its package name
             self.stem = os.path.basename(os.path.dirname(relpath))
+
+    def nodes(self, *types) -> list:
+        """All AST nodes of the given class(es), in walk order — the
+        shared per-module index (one tree walk at parse time) every
+        rule iterates instead of re-walking."""
+        if len(types) == 1:
+            return self._node_buckets.get(types[0], [])
+        return [n for n in self._all_nodes if isinstance(n, types)]
 
 
 @dataclass
@@ -177,13 +193,20 @@ class LintEngine:
         # bad-suppression findings
         self.known_rules = (set(rule_names())
                             | {r.name for r in self.rules}
-                            | {BAD_SUPPRESSION, PARSE_ERROR})
+                            | {BAD_SUPPRESSION, DEAD_SUPPRESSION,
+                               PARSE_ERROR})
         # the last run's ProjectContext: --emit-graph reuses it (and
         # any concurrency index the rules cached on it) instead of
         # re-parsing the tree
         self.last_context: Optional[ProjectContext] = None
 
-    def run(self, paths: Optional[Iterable[str]] = None) -> Report:
+    def run(self, paths: Optional[Iterable[str]] = None,
+            changed_only: Optional[set] = None) -> Report:
+        """Lint `paths`.  With `changed_only` (a set of absolute file
+        paths — the `--changed-only <git-ref>` incremental mode), the
+        WHOLE tree is still parsed and collected (cross-module rules
+        need the full picture), but only findings anchored in changed
+        files are reported."""
         root, modules, failures = load_modules(paths, self.known_rules)
         report = Report(root=root)
         for rel, line, msg in failures:
@@ -191,7 +214,7 @@ class LintEngine:
                                            msg))
         report.files_scanned = len(modules)
 
-        ctx = self.last_context = ProjectContext(modules)
+        ctx = self.last_context = ProjectContext(modules, root=root)
         for rule in self.rules:
             for mod in modules:
                 rule.collect(mod, ctx)
@@ -201,20 +224,72 @@ class LintEngine:
                 raw.extend(rule.check(mod, ctx))
             raw.extend(rule.finalize(ctx))
 
-        # suppression application + bad-suppression surfacing
+        # suppression application + bad-suppression surfacing.  Used
+        # directives are tracked so a suppression whose governed line
+        # no longer fires its rule surfaces as dead-suppression — a
+        # stale mute rots into folklore exactly like a reasonless one.
         by_rel = {m.relpath: m for m in modules}
+        used_line: set[tuple[str, int, str]] = set()
+        used_file: set[tuple[str, str]] = set()
         for f in raw:
             mod = by_rel.get(f.path)
             if mod is not None:
-                reason = mod.suppressions.lookup(f.rule, f.line)
-                if reason is not None:
+                got = mod.suppressions.match(f.rule, f.line)
+                if got is not None:
+                    reason, file_wide = got
+                    if file_wide:
+                        used_file.add((f.path, f.rule))
+                        # a line-level directive layered under the
+                        # file-wide one still governs a REAL finding on
+                        # its line: it is live, not dead, even though
+                        # the file-wide reason won precedence
+                        if f.rule in mod.suppressions.by_line.get(
+                                f.line, {}):
+                            used_line.add((f.path, f.line, f.rule))
+                    else:
+                        used_line.add((f.path, f.line, f.rule))
                     f.suppressed = True
                     f.reason = reason
             report.findings.append(f)
+        ran = {r.name for r in self.rules}
         for mod in modules:
             for line, msg in mod.suppressions.bad:
                 report.findings.append(Finding(
                     BAD_SUPPRESSION, mod.relpath, line, 0, msg))
+            # dead suppressions: only judged for rules that actually
+            # RAN (a --rules subset must not flag the tree's
+            # suppressions of unselected rules as dead)
+            for line, rules in sorted(mod.suppressions.by_line.items()):
+                for rule_name, reason in sorted(rules.items()):
+                    if rule_name in ran and \
+                            (mod.relpath, line, rule_name) not in \
+                            used_line:
+                        report.findings.append(Finding(
+                            DEAD_SUPPRESSION, mod.relpath, line, 0,
+                            f"suppression of {rule_name} no longer "
+                            "matches a finding on its line — the "
+                            "suppressed code moved or was fixed; "
+                            "delete the directive (stale reason: "
+                            f"{reason})"))
+            for rule_name, reason in sorted(
+                    mod.suppressions.file_wide.items()):
+                if rule_name in ran and \
+                        (mod.relpath, rule_name) not in used_file:
+                    report.findings.append(Finding(
+                        DEAD_SUPPRESSION, mod.relpath, 1, 0,
+                        f"file-wide suppression of {rule_name} "
+                        "suppresses nothing — delete the directive "
+                        f"(stale reason: {reason})"))
+        if changed_only is not None:
+            changed_rel = {os.path.relpath(p, root).replace(os.sep, "/")
+                           for p in changed_only}
+            # telemetry-schema findings are PROJECT-WIDE and often
+            # anchor outside the changed set (README.md, the registry
+            # module): a consumer-drift caused by deleting an emit in a
+            # changed file must not vanish in incremental mode
+            report.findings = [f for f in report.findings
+                               if f.path in changed_rel
+                               or f.rule == "telemetry-schema"]
         report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
         return report
 
@@ -223,11 +298,30 @@ class ProjectContext:
     """Cross-module state shared by the rules; each rule namespaces its
     own entries under an attribute it owns."""
 
-    def __init__(self, modules: list[Module]):
+    def __init__(self, modules: list[Module], root: str = ""):
         self.modules = modules
+        self.root = root
         self.by_stem: dict[str, list[Module]] = {}
         for m in modules:
             self.by_stem.setdefault(m.stem, []).append(m)
+
+
+def changed_paths(ref: str, root: str) -> set[str]:
+    """Absolute paths of .py files changed vs `ref` (plus untracked
+    ones) — the `--changed-only` working set.  Raises CalledProcessError
+    outside a git repo; the CLI reports that as a bad invocation."""
+    import subprocess
+    top = subprocess.check_output(
+        ["git", "-C", root, "rev-parse", "--show-toplevel"],
+        text=True).strip()
+    diff = subprocess.check_output(
+        ["git", "-C", top, "diff", "--name-only", ref], text=True)
+    untracked = subprocess.check_output(
+        ["git", "-C", top, "ls-files", "--others",
+         "--exclude-standard"], text=True)
+    return {os.path.abspath(os.path.join(top, p))
+            for p in diff.splitlines() + untracked.splitlines()
+            if p.endswith(".py")}
 
 
 def run_paths(paths: Optional[Iterable[str]] = None,

@@ -40,15 +40,18 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from veneur_tpu.analysis.rules.blocking import BlockingPropagation
+    from veneur_tpu.analysis.rules.conservation import SilentLoss
     from veneur_tpu.analysis.rules.donation import DonationAliasing
     from veneur_tpu.analysis.rules.literals import MagicLiteral
     from veneur_tpu.analysis.rules.lockguard import SyncUnderLock
     from veneur_tpu.analysis.rules.lockorder import LockOrder
     from veneur_tpu.analysis.rules.pairing import ResourcePairing
     from veneur_tpu.analysis.rules.prewarm import PrewarmParity
+    from veneur_tpu.analysis.rules.telemetry_schema import \
+        TelemetrySchema
     return [DonationAliasing(), ResourcePairing(), PrewarmParity(),
             SyncUnderLock(), LockOrder(), BlockingPropagation(),
-            MagicLiteral()]
+            SilentLoss(), TelemetrySchema(), MagicLiteral()]
 
 
 def rule_names() -> list[str]:

@@ -1,0 +1,396 @@
+"""silent-loss: data-discarding statements that reach no accounting.
+
+The system's defining invariant — proven by every chaos arm since the
+testbed landed — is "exact conservation or visibly-accounted loss":
+any point the pipeline discards (queue full, retry exhaustion, spool
+expiry, eviction, a swallowed delivery error) must land in a counter
+that joins a ledger closure.  Runtime tests enforce that for the drop
+sites that exist TODAY; a new drop site with no accounting compiles,
+passes tier 1, and silently breaks the conservation story.  This rule
+makes the invariant structural:
+
+  discard sites (pipeline packages only — forward/, proxy/, sources/,
+  egress/, sinks/, ingest/ plus the core server/aggregator files):
+
+    * a swallowed `except` body (no re-raise) whose `try` has a
+      payload-typed value in flight — the classic "log and lose" shape
+      (`except queue.Full` is called out as the queue-full branch)
+    * an early `return`/`continue` behind a `.full()` queue test
+    * a function NAMED for discarding (`drop`/`evict`/`expire`/
+      `discard`/`shed`/`reject` in its name) — the site other code
+      trusts to do the accounting
+
+  each site must REACH an accounting increment — a statsd counter emit
+  (`statsd.count/incr`), a `/debug/vars`-style dict bump
+  (`stats["dropped"] += n`), or a ledger-field write
+  (`self.dropped_total += n`, `setattr(self, field, getattr(...) + n)`)
+  — within the discard region itself or through any resolved callee
+  (the PR-7 call graph), before the path leaves the function.  A
+  finding prints the callees it checked, witness-chain style, so the
+  report explains where the accounting was expected to be.
+
+Precision notes: handlers for poll/teardown exceptions
+(`queue.Empty`, `StopIteration`, `GeneratorExit`, `KeyboardInterrupt`)
+never fire; predicate-named functions (`should_drop`, `is_expired`)
+are exempt; a `raise` anywhere in the discard region defers the
+accounting to the caller and stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from veneur_tpu.analysis import astutil, callgraph
+from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+# pipeline scope: where a discard is a DATA-PLANE loss (an except in a
+# bench script or test helper is not conservation-relevant)
+_SCOPE_DIRS = {"forward", "proxy", "sources", "egress", "sinks",
+               "ingest"}
+_SCOPE_FILES = {"core/server.py", "core/aggregator.py",
+                "core/arena.py", "core/cardinality.py", "http_api.py"}
+
+# identifier words that mark a payload value (the thing whose loss
+# must be accounted) when referenced inside a try body
+_PAYLOAD_WORDS = {
+    "metric", "metrics", "payload", "payloads", "pb", "pbs", "batch",
+    "batches", "chunk", "chunks", "packet", "packets", "line", "lines",
+    "sample", "samples", "span", "spans", "record", "records", "rec",
+    "job", "jobs", "point", "points", "frame", "frames", "datagram",
+    "datagrams", "msg", "message", "messages", "event", "events", "ml",
+    "request", "filtered",
+}
+
+# identifier words that mark a counter/ledger field
+_COUNTER_WORDS = {
+    "total", "totals", "count", "counts", "counter", "counters",
+    "dropped", "drops", "drop", "expired", "evicted", "errors",
+    "skipped", "spilled", "replayed", "failed", "lost", "shed",
+    "missed", "duplicates", "recorded", "bounced", "rejected",
+    "retries", "retried", "invalid", "malformed", "received",
+    "imported", "sent", "delivered", "flushed", "enqueued",
+    "stragglers", "torn", "stats",
+}
+
+_DISCARD_FN_WORDS = {"drop", "evict", "expire", "discard", "shed",
+                     "reject"}
+_PREDICATE_PREFIXES = ("should_", "is_", "can_", "has_", "want_")
+
+# handler types that are polling / teardown / fallback control flow,
+# not loss: import fallbacks never consume a payload, and
+# RetryableReplayError is the spool's KEEP-the-record signal (the
+# payload stays queued for the next tick by contract)
+_BENIGN_EXC = {"Empty", "StopIteration", "GeneratorExit",
+               "KeyboardInterrupt", "SystemExit", "ImportError",
+               "ModuleNotFoundError", "RetryableReplayError"}
+
+_WORD_SPLIT = re.compile(r"[^a-zA-Z0-9]+")
+
+
+def _words(name: str) -> set[str]:
+    return {w.lower() for w in _WORD_SPLIT.split(name) if w}
+
+
+def in_scope(relpath: str) -> bool:
+    return (relpath.split("/", 1)[0] in _SCOPE_DIRS
+            or relpath in _SCOPE_FILES)
+
+
+def _mentioned_payloads(node) -> set[str]:
+    """Payload words referenced anywhere under `node` (nested function
+    definitions excluded — they run later, not on this path)."""
+    found: set[str] = set()
+
+    def visit(n) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return
+        if isinstance(n, ast.Name):
+            found.update(_words(n.id) & _PAYLOAD_WORDS)
+        elif isinstance(n, ast.Attribute):
+            found.update(_words(n.attr) & _PAYLOAD_WORDS)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return found
+
+
+def _counterish(name: Optional[str]) -> bool:
+    return bool(name) and bool(_words(name) & _COUNTER_WORDS)
+
+
+def _target_counterish(tgt) -> bool:
+    if isinstance(tgt, ast.Attribute):
+        return _counterish(tgt.attr)
+    if isinstance(tgt, ast.Name):
+        return _counterish(tgt.id)
+    if isinstance(tgt, ast.Subscript):
+        if isinstance(tgt.slice, ast.Constant) \
+                and isinstance(tgt.slice.value, str) \
+                and _counterish(tgt.slice.value):
+            return True
+        return _target_counterish(tgt.value)
+    return False
+
+
+def is_accounting_node(node) -> bool:
+    """One AST node that makes the loss VISIBLE: a counter increment, a
+    drop-tally write, a dropped-count result, or an error returned to
+    the caller (who then owns the retry — an HTTP 4xx/5xx reply or a
+    gRPC abort is not silent loss)."""
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in ("incr", "increment"):
+                return True
+            if attr == "count" and (
+                    len(node.args) >= 2
+                    or astutil.keyword_arg(node, "tags") is not None):
+                return True
+            if attr == "abort":     # grpc context.abort -> caller owns it
+                return True
+        name = astutil.call_func_name(node) or ""
+        simple = name.rsplit(".", 1)[-1]
+        if simple == "setattr" and len(node.args) == 3:
+            # setattr(self, field, getattr(self, field) + n) — the
+            # generic ledger-field bump helper shape
+            for sub in ast.walk(node.args[2]):
+                if isinstance(sub, ast.BinOp) \
+                        and isinstance(sub.op, ast.Add):
+                    return True
+        if simple in ("reply", "_reply"):
+            # an error status reported to the sender is accounted loss
+            for a in node.args:
+                if isinstance(a, ast.Constant) \
+                        and isinstance(a.value, int) and a.value >= 400:
+                    return True
+        if simple.endswith("Result"):
+            # `return MetricFlushResult(dropped=len(metrics))` — the
+            # egress lane counts the result's drop tally
+            for kw in node.keywords:
+                if kw.arg and _counterish(kw.arg):
+                    return True
+        return False
+    if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+        return _target_counterish(node.target)
+    if isinstance(node, ast.Assign):
+        # d[k] = d.get(k, 0) + n  /  dropped = len(lines) - flushed
+        if not any(isinstance(sub, ast.BinOp)
+                   and isinstance(sub.op, (ast.Add, ast.Sub))
+                   for sub in ast.walk(node.value)):
+            return False
+        return any(_target_counterish(t) for t in node.targets)
+    return False
+
+
+def _region_has(region_stmts, pred) -> bool:
+    for stmt in region_stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if pred(node):
+                return True
+    return False
+
+
+class SilentLoss(Rule):
+    name = "silent-loss"
+    description = ("pipeline discard path (swallowed except, queue-full "
+                   "branch, discard-named function) reaching no "
+                   "accounting increment — invisible data loss")
+
+    # -- interprocedural accounting reach ---------------------------------
+
+    def _fn_accounts(self, fn, idx, _depth: int = 0,
+                     _stack: Optional[set] = None) -> bool:
+        """Does `fn` (or anything it can reach) increment a counter?"""
+        memo = self._memo
+        if id(fn) in memo:
+            return memo[id(fn)]
+        _stack = _stack if _stack is not None else set()
+        if id(fn) in _stack or _depth > callgraph._MAX_CHAIN_DEPTH:
+            return False
+        _stack.add(id(fn))
+        out = False
+        for node in ast.walk(fn.node):
+            if is_accounting_node(node):
+                out = True
+                break
+        if not out:
+            for cs in fn.calls:
+                for callee in cs.callees:
+                    if self._fn_accounts(callee, idx, _depth + 1,
+                                         _stack):
+                        out = True
+                        break
+                if out:
+                    break
+        _stack.discard(id(fn))
+        memo[id(fn)] = out
+        return out
+
+    def _region_accounts(self, fn_info, segments,
+                         idx) -> tuple[bool, list[str]]:
+        """(accounted, checked-callee qnames) for a discard region —
+        one or more statement segments (e.g. an except body PLUS the
+        try's finally, which also runs on the discard path)."""
+        spans = []
+        for stmts in segments:
+            if not stmts:
+                continue
+            if _region_has(stmts, is_accounting_node):
+                return True, []
+            spans.append((stmts[0].lineno,
+                          max(getattr(s, "end_lineno", s.lineno)
+                              for s in stmts)))
+        checked: list[str] = []
+        if fn_info is not None:
+            for cs in fn_info.calls:
+                if not any(lo <= cs.line <= hi for lo, hi in spans):
+                    continue
+                for callee in cs.callees:
+                    if self._fn_accounts(callee, idx):
+                        return True, checked
+                    if callee.qname not in checked:
+                        checked.append(callee.qname)
+        return False, checked
+
+    # -- the per-module check ---------------------------------------------
+
+    def check(self, module: Module,
+              ctx: ProjectContext) -> list[Finding]:
+        if not in_scope(module.relpath):
+            return []
+        idx = callgraph.index_for(ctx)
+        self._memo = getattr(ctx, "_silent_loss_memo", None)
+        if self._memo is None:
+            self._memo = ctx._silent_loss_memo = {}
+        fn_by_node = getattr(ctx, "_silent_loss_fns", None)
+        if fn_by_node is None:
+            fn_by_node = ctx._silent_loss_fns = {
+                id(f.node): f for f in idx.functions}
+
+        findings: list[Finding] = []
+        findings.extend(self._check_handlers(module, idx, fn_by_node))
+        findings.extend(self._check_full_bails(module, idx, fn_by_node))
+        findings.extend(self._check_discard_fns(module, idx,
+                                                fn_by_node))
+        return findings
+
+    def _fn_info_for(self, node, fn_by_node):
+        fn_node = astutil.enclosing_function(node)
+        return (fn_by_node.get(id(fn_node))
+                if fn_node is not None else None)
+
+    @staticmethod
+    def _handler_exc_names(handler) -> set[str]:
+        t = handler.type
+        elts = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+        names = set()
+        for e in elts:
+            text = astutil.dotted(e)
+            if text:
+                names.add(text.rsplit(".", 1)[-1])
+        return names
+
+    def _check_handlers(self, module, idx, fn_by_node) -> list[Finding]:
+        findings = []
+        for handler in module.nodes(ast.ExceptHandler):
+            exc_names = self._handler_exc_names(handler)
+            if exc_names and exc_names <= _BENIGN_EXC:
+                continue
+            # a re-raise (bare or wrapped) defers to the caller
+            if _region_has(handler.body,
+                           lambda n: isinstance(n, ast.Raise)):
+                continue
+            try_node = astutil.parent(handler)
+            if not isinstance(try_node, ast.Try):
+                continue
+            payloads = set()
+            for stmt in try_node.body:
+                payloads |= _mentioned_payloads(stmt)
+            if not payloads:
+                continue
+            fn_info = self._fn_info_for(handler, fn_by_node)
+            # the try's finally also runs on the discard path — a
+            # close/retire helper there may own the accounting
+            ok, checked = self._region_accounts(
+                fn_info, [handler.body, try_node.finalbody], idx)
+            if ok:
+                continue
+            kind = ("queue-full branch"
+                    if "Full" in exc_names else "swallowed except")
+            via = (" — checked callees: " + ", ".join(checked[:4])
+                   + " (none reach a counter)" if checked
+                   else " — the handler body reaches no counter at "
+                        "all")
+            findings.append(Finding(
+                self.name, module.relpath, handler.lineno,
+                handler.col_offset,
+                f"{kind} discards in-flight payload "
+                f"({', '.join(sorted(payloads)[:4])}) with no "
+                f"accounting increment{via}; emit a statsd count, bump "
+                "a /debug/vars ledger field, or re-raise"))
+        return findings
+
+    def _check_full_bails(self, module, idx,
+                          fn_by_node) -> list[Finding]:
+        """`if q.full(): return/continue` — the lossy fast path of a
+        bounded handoff must account the bounce."""
+        findings = []
+        for node in module.nodes(ast.If):
+            is_full_test = any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "full"
+                for sub in ast.walk(node.test))
+            if not is_full_test:
+                continue
+            bails = [s for s in node.body
+                     if isinstance(s, (ast.Return, ast.Continue))]
+            if not bails:
+                continue
+            fn_info = self._fn_info_for(node, fn_by_node)
+            if fn_info is None or not (
+                    _words(fn_info.name) & _PAYLOAD_WORDS
+                    or _mentioned_payloads(node)):
+                continue
+            ok, checked = self._region_accounts(
+                fn_info, [node.body], idx)
+            if ok:
+                continue
+            via = (" — checked callees: " + ", ".join(checked[:4])
+                   if checked else "")
+            findings.append(Finding(
+                self.name, module.relpath, bails[0].lineno,
+                bails[0].col_offset,
+                "queue-full bail drops the payload with no accounting "
+                f"increment{via}; count the bounce before returning"))
+        return findings
+
+    def _check_discard_fns(self, module, idx,
+                           fn_by_node) -> list[Finding]:
+        """A function NAMED for discarding is the site the rest of the
+        code trusts to do the accounting — it must reach a counter."""
+        findings = []
+        for fn in idx.functions:
+            if fn.relpath != module.relpath:
+                continue
+            if not (_words(fn.name) & _DISCARD_FN_WORDS):
+                continue
+            if fn.name.startswith(_PREDICATE_PREFIXES):
+                continue
+            if self._fn_accounts(fn, idx):
+                continue
+            findings.append(Finding(
+                self.name, module.relpath, fn.node.lineno,
+                fn.node.col_offset,
+                f"`{fn.qname}` is named for discarding data but "
+                "neither it nor any resolved callee increments a "
+                "counter — eviction/expiry must be visibly accounted"))
+        return findings

@@ -94,7 +94,8 @@ class DonationAliasing(Rule):
     # -- pass 1 ------------------------------------------------------------
 
     def collect(self, module: Module, ctx: ProjectContext) -> None:
-        for node in ast.walk(module.tree):
+        for node in module.nodes(ast.Assign, ast.FunctionDef,
+                                 ast.AsyncFunctionDef):
             if isinstance(node, ast.Assign):
                 pos = _donating_expr(node.value)
                 if pos is None:
@@ -148,9 +149,8 @@ class DonationAliasing(Rule):
     def check(self, module: Module,
               ctx: ProjectContext) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(module.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                findings.extend(self._check_function(node, module))
+        for node in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            findings.extend(self._check_function(node, module))
         return findings
 
     def _check_function(self, fn, module: Module) -> list[Finding]:

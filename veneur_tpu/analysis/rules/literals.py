@@ -57,8 +57,7 @@ class MagicLiteral(Rule):
             return []
         findings: list[Finding] = []
         exempt_spans = self._exempt_spans(module.tree)
-        for call in (n for n in ast.walk(module.tree)
-                     if isinstance(n, ast.Call)):
+        for call in module.nodes(ast.Call):
             if self._call_exempt(call):
                 continue
             if any(lo <= call.lineno <= hi for lo, hi in exempt_spans):

@@ -129,7 +129,8 @@ class SyncUnderLock(Rule):
     def check(self, module: Module,
               ctx: ProjectContext) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.nodes(ast.With, ast.FunctionDef,
+                                 ast.AsyncFunctionDef):
             if isinstance(node, ast.With):
                 for item in node.items:
                     name = astutil.dotted(item.context_expr)

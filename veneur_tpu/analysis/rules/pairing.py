@@ -138,9 +138,7 @@ class ResourcePairing(Rule):
     def check(self, module: Module,
               ctx: ProjectContext) -> list[Finding]:
         findings: list[Finding] = []
-        for fn in (n for n in ast.walk(module.tree)
-                   if isinstance(n, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef))):
+        for fn in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
             for spec in PAIRS:
                 findings.extend(self._check_pair(fn, spec, module))
         return findings

@@ -211,9 +211,7 @@ class PrewarmParity(Rule):
         self.live_sites: dict[str, list[Site]] = {}
 
     def collect(self, module: Module, ctx: ProjectContext) -> None:
-        for fn in (n for n in ast.walk(module.tree)
-                   if isinstance(n, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef))):
+        for fn in module.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
             env = _Env(fn)
             in_prewarm = "prewarm" in fn.name
             for call in (n for n in ast.walk(fn)

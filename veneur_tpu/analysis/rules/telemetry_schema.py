@@ -1,0 +1,53 @@
+"""telemetry-schema: the statically-extracted telemetry surface must be
+internally consistent.
+
+Three checks over the registry (analysis/telemetry.py) — emit-site
+collisions (one series name, conflicting types or provably different
+tag shapes), consumer drift (promised series / README references no
+site emits), and ledger drift (closure equations referencing fields no
+producer writes).  The registry itself is exported with
+`python -m veneur_tpu.analysis --emit-schema` and committed at
+`analysis/telemetry_schema.json`; artifact sync is a tier-1 test plus
+`--check-schema`, exactly like the lock-order graph.
+"""
+
+from __future__ import annotations
+
+import os
+
+from veneur_tpu.analysis.engine import Finding, ProjectContext
+from veneur_tpu.analysis.rules import Rule
+
+
+def _site_anchor(site: str) -> tuple[str, int]:
+    path, _, line = site.rpartition(":")
+    if path and line.isdigit():
+        return path, int(line)
+    return site, 1
+
+
+class TelemetrySchema(Rule):
+    name = "telemetry-schema"
+    description = ("emitted-series collision, promised-series drift, or "
+                   "ledger-field drift in the telemetry schema "
+                   "registry")
+
+    def finalize(self, ctx: ProjectContext) -> list[Finding]:
+        from veneur_tpu.analysis import telemetry
+        readme = ""
+        if ctx.root:
+            cand = os.path.join(os.path.dirname(ctx.root), "README.md")
+            if os.path.isfile(cand):
+                readme = cand
+        schema = telemetry.build_schema(ctx.modules, root=ctx.root,
+                                        readme_path=readme)
+        # cached for --emit-schema / --check-schema (same parse, same
+        # tree — the artifact always matches what this run checked)
+        ctx._telemetry_schema = schema
+        findings = []
+        for issue in telemetry.schema_issues(schema):
+            path, line = _site_anchor(issue["site"])
+            findings.append(Finding(
+                self.name, path, line, 0,
+                f"{issue['kind']}: {issue['message']}"))
+        return findings

@@ -35,12 +35,23 @@ class Suppressions:
     # (line, message) for malformed / reasonless directives
     bad: list[tuple[int, str]] = field(default_factory=list)
 
+    def match(self, rule: str, line: int) -> tuple[str, bool] | None:
+        """(reason, is_file_wide) iff `rule` is suppressed at `line`
+        (file-wide directives take precedence), else None — the ONE
+        precedence implementation; the engine uses the kind to track
+        which directives are live for the dead-suppression check."""
+        reason = self.file_wide.get(rule)
+        if reason is not None:
+            return reason, True
+        reason = self.by_line.get(line, {}).get(rule)
+        if reason is not None:
+            return reason, False
+        return None
+
     def lookup(self, rule: str, line: int) -> str | None:
         """Reason iff `rule` is suppressed at `line`, else None."""
-        reason = self.file_wide.get(rule)
-        if reason is None:
-            reason = self.by_line.get(line, {}).get(rule)
-        return reason
+        got = self.match(rule, line)
+        return got[0] if got is not None else None
 
 
 def _comments(source: str, lines: list[str]) -> dict[int, str]:

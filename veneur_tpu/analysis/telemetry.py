@@ -1,0 +1,849 @@
+"""Telemetry schema registry: every emitted series and /debug/vars key,
+statically extracted, committed as an artifact, and cross-validated
+against the runtime ledgers.
+
+The conservation story (exact delivery or visibly-accounted loss) is
+only auditable if the ACCOUNTING SURFACE itself is closed: every
+counter a drop can land in must be a series some dashboard can read,
+every ledger equation must reference fields some code actually writes,
+and a new series must not silently collide with an existing one under
+a different type.  This module is the single source of that surface:
+
+  1. EXTRACTION — every statsd self-metric emit site
+     (`statsd.count/incr/gauge/histogram/timing/set`, `ssf_mod.*`) and
+     every `/debug/vars` key (the `debug_vars(...)` builders in
+     http_api.py and proxy/proxy.py, plus each ledger's `stats()`
+     producer) is resolved to (name, type, tag shape, site).  F-string
+     names become `*` patterns; names flowing from module constants
+     (`sink_mod.METRICS_FLUSHED_TOTAL`) resolve through a project-wide
+     constant table; anything truly dynamic is recorded as an explicit
+     blind spot, never silently skipped.
+
+  2. THE COMMITTED ARTIFACT — `analysis/telemetry_schema.json`, regrown
+     with `python -m veneur_tpu.analysis --emit-schema <file>` and
+     sync-tested in tier 1 exactly like `lock_order_graph.json`: a new
+     emit site that is not re-committed fails the build.
+
+  3. CHECKS (the `telemetry-schema` lint rule drives these):
+       collisions      same series name emitted with different types
+                       (or provably different tag-key shapes)
+       consumer drift  promised series (PROMISED_SERIES here, any
+                       module-level *PROMISED*/*_SERIES list, README
+                       references) that no site emits
+       ledger drift    a ledger closure equation referencing a field
+                       its producer `stats()` never writes, or a
+                       ledger /debug/vars key no builder exposes
+
+  4. RUNTIME CROSS-VALIDATION — `TelemetryWitness` wraps each testbed
+     server's statsd client (recording every emitted series) and
+     snapshots the real `/debug/vars` dicts; `compare_runtime` then
+     fails loud on any runtime-observed series or vars key the static
+     schema lacks (an ANALYZER GAP, same contract as the lock
+     witness), and asserts every declared ledger closure over the
+     observed counters.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import threading
+import weakref
+from typing import Iterable, Optional
+
+from veneur_tpu.analysis import astutil
+
+SCHEMA_VERSION = 1
+
+# emit-method -> series type, per client family
+_STATSD_TYPES = {"count": "counter", "incr": "counter",
+                 "gauge": "gauge", "histogram": "histogram",
+                 "timing": "timing", "set": "set"}
+_SSF_TYPES = {"count": "counter", "gauge": "gauge",
+              "histogram": "histogram", "timing": "timing",
+              "set_sample": "set", "status": "status"}
+
+_SSF_RECEIVERS = ("ssf", "ssf_mod")
+
+# a plausible series name: dotted lowercase words (what the drift scan
+# accepts from promised lists and README back-ticks)
+SERIES_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+# series names PROMISED to dashboards and the test suite: the
+# consumer-drift check fails if no emit site produces them, so renaming
+# a series without updating its consumers is a lint error, not a silent
+# dashboard hole.  (Module-level *PROMISED*/*_SERIES string lists
+# anywhere in the tree join this set automatically.)
+PROMISED_SERIES = [
+    "egress.dropped_total",
+    "egress.pending_records",
+    "egress.queue_full_total",
+    "egress.retries_total",
+    "egress.spilled_total",
+    "flush.sink_errors_total",
+    "flush.stragglers_total",
+    "flush.unique_timeseries_total",
+    "forward.dropped_total",
+    "forward.retries_total",
+    "forward.spool.pending_records",
+    "import.errors_total",
+    "listen.parse_errors_total",
+    "sink.metrics_flushed_total",
+    "worker.metrics_processed_total",
+]
+
+# the runtime ledgers: where each lives under /debug/vars, which
+# `stats()`/`snapshot()` method produces its fields, the closure
+# equation (sum(lhs) == sum(rhs); None = membership only), and which
+# series prefixes belong to it (longest prefix wins).
+LEDGERS = {
+    "forward": {
+        "debug_vars": "forward",
+        "producer": ("ForwardClient", "stats"),
+        "closure": None,
+        "prefixes": ("forward.",),
+    },
+    "forward_spool": {
+        "debug_vars": "spool",
+        "producer": ("ForwardSpool", "stats"),
+        "closure": (("spilled_points", "recovered_points"),
+                    ("replayed_points", "expired_points",
+                     "dropped_points", "pending_points")),
+        "prefixes": ("forward.spool.",),
+    },
+    "egress": {
+        "debug_vars": "egress",
+        "producer": ("EgressPlane", "stats"),
+        "closure": (("spilled", "recovered"),
+                    ("replayed", "expired", "spool_dropped",
+                     "pending_points")),
+        "prefixes": ("egress.", "sink.", "flushed_metrics",
+                     "flush.sink_errors_total",
+                     "flush.stragglers_total"),
+    },
+    "dedup": {
+        "debug_vars": "dedup",
+        "producer": ("DedupLedger", "stats"),
+        "closure": None,
+        "prefixes": ("import.",),
+    },
+    "cardinality": {
+        "debug_vars": "cardinality",
+        "producer": ("CardinalityGuard", "snapshot"),
+        "closure": None,
+        "prefixes": ("cardinality.",),
+    },
+    "span_sinks": {
+        "debug_vars": "span_sinks",
+        "producer": None,
+        "closure": None,
+        "prefixes": ("worker.span.", "spans."),
+    },
+}
+
+
+# -- name / tag resolution -------------------------------------------------
+
+def _const_table(modules) -> dict[str, Optional[str]]:
+    """Simple name -> module-level string constant, project-wide.
+    A name bound to different strings in different modules is
+    ambiguous and resolves to None (never guess)."""
+    out: dict[str, Optional[str]] = {}
+    for mod in modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    prev = out.get(tgt.id, "\x00")
+                    if prev == "\x00":
+                        out[tgt.id] = node.value.value
+                    elif prev != node.value.value:
+                        out[tgt.id] = None
+    return out
+
+
+def _resolve_name(node, consts: dict) -> tuple[Optional[str], bool]:
+    """(series name, is_pattern) for a series-name expression; `*`
+    marks each dynamic segment.  (None, False) = unresolvable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        name = re.sub(r"\*+", "*", "".join(parts))
+        return name, "*" in name
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        text = astutil.dotted(node)
+        if text:
+            got = consts.get(text.rsplit(".", 1)[-1])
+            if got is not None:
+                return got, False
+        return None, False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left, lpat = _resolve_name(node.left, consts)
+        right, rpat = _resolve_name(node.right, consts)
+        if left is None:
+            left, lpat = "*", True
+        if right is None:
+            right, rpat = "*", True
+        name = re.sub(r"\*+", "*", left + right)
+        if name == "*":
+            return None, False
+        return name, lpat or rpat or "*" in name
+    return None, False
+
+
+def _tag_keys(node) -> list[str]:
+    """Sorted tag KEYS for a `tags=` argument; "?" marks an
+    unresolvable element (a variable tag list), so shape comparisons
+    only bind when both sides are fully known."""
+    if node is None:
+        return []
+    if isinstance(node, (ast.List, ast.Tuple)):
+        keys: set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value,
+                                                            str):
+                keys.add(elt.value.split(":", 1)[0])
+            elif isinstance(elt, ast.JoinedStr) and elt.values \
+                    and isinstance(elt.values[0], ast.Constant) \
+                    and ":" in str(elt.values[0].value):
+                keys.add(str(elt.values[0].value).split(":", 1)[0])
+            else:
+                keys.add("?")
+        return sorted(keys)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return sorted(set(_tag_keys(node.left))
+                      | set(_tag_keys(node.right)))
+    return ["?"]
+
+
+def ledger_for_series(name: str) -> str:
+    """Longest-prefix ledger membership for a series name ("" = none)."""
+    best = ""
+    best_len = -1
+    for ledger, spec in LEDGERS.items():
+        for p in spec["prefixes"]:
+            if (name == p or name.startswith(p)) and len(p) > best_len:
+                best, best_len = ledger, len(p)
+    return best
+
+
+# -- extraction ------------------------------------------------------------
+
+def _is_statsd_recv(text: Optional[str]) -> bool:
+    return bool(text) and (text == "statsd" or text.endswith(".statsd"))
+
+
+def extract_emits(modules) -> tuple[list[dict], list[dict]]:
+    """(emits, dynamic_emits): every self-metric emit call site in the
+    tree.  `emits` carry resolved names (possibly `*` patterns);
+    `dynamic_emits` are the explicit blind spots (name expression
+    recorded verbatim) — the artifact lists them so an unmodellable
+    emit is a visible fact, not a silent gap."""
+    consts = _const_table(modules)
+    emits: list[dict] = []
+    dynamic: list[dict] = []
+    for mod in modules:
+        for call in mod.nodes(ast.Call):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            attr = call.func.attr
+            recv = astutil.dotted(call.func.value)
+            if _is_statsd_recv(recv) and attr in _STATSD_TYPES:
+                mtype = _STATSD_TYPES[attr]
+            elif recv in _SSF_RECEIVERS and attr in _SSF_TYPES:
+                mtype = _SSF_TYPES[attr]
+            else:
+                continue
+            if not call.args:
+                continue
+            name, pattern = _resolve_name(call.args[0], consts)
+            site = f"{mod.relpath}:{call.lineno}"
+            if name is None:
+                dynamic.append({
+                    "expr": astutil.node_source(call.args[0]),
+                    "type": mtype, "site": site})
+                continue
+            emits.append({
+                "name": name, "pattern": pattern, "type": mtype,
+                "tags": _tag_keys(astutil.keyword_arg(call, "tags")),
+                "site": site, "ledger": ledger_for_series(name)})
+    emits.sort(key=lambda e: (e["name"], e["site"]))
+    dynamic.sort(key=lambda e: (e["expr"], e["site"]))
+    return emits, dynamic
+
+
+def _dict_keys_in(fn_node) -> list[tuple[str, int]]:
+    """String keys written inside one function: dict-literal keys plus
+    `<name>[<const str>] = ...` subscript stores."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                              str):
+                    out.append((k.value, k.lineno))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            for tgt in tgts:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and isinstance(tgt.slice.value, str):
+                    out.append((tgt.slice.value, tgt.lineno))
+    return out
+
+
+def extract_debug_vars(modules) -> list[dict]:
+    """Top-level /debug/vars keys per tier, from the shared
+    `debug_vars(...)` builders (http_api.py = server tier,
+    proxy/proxy.py = proxy tier).  A builder that SEEDS from a stats
+    attribute (`stats = dict(proxy.stats)`) also contributes the keys
+    of that attribute's dict-literal initializer anywhere in the same
+    module — the proxy's per-request counters live there."""
+    out: list[dict] = []
+    for mod in modules:
+        tier = {"http_api": "server", "proxy": "proxy"}.get(mod.stem)
+        if tier is None:
+            continue
+        seeds_stats = False
+        seen: set[str] = set()
+        keys: list[tuple[str, int]] = []
+        for fn in mod.nodes(ast.FunctionDef):
+            if fn.name != "debug_vars":
+                continue
+            # TOP-LEVEL keys only: the dict literal assigned to `stats`
+            # plus `stats[<const>] = ...` stores.  Nested dicts are a
+            # ledger's internal shape, not part of the top-level key
+            # space the runtime gap check validates — registering them
+            # here would let a future genuinely-new top-level key named
+            # like a nested one slip past the witness.
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) \
+                                and tgt.id == "stats" \
+                                and isinstance(node.value, ast.Dict):
+                            for k in node.value.keys:
+                                if isinstance(k, ast.Constant) and \
+                                        isinstance(k.value, str):
+                                    keys.append((k.value, k.lineno))
+                        elif isinstance(tgt, ast.Subscript) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "stats" \
+                                and isinstance(tgt.slice, ast.Constant) \
+                                and isinstance(tgt.slice.value, str):
+                            keys.append((tgt.slice.value, tgt.lineno))
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call) \
+                        and isinstance(call.func, ast.Name) \
+                        and call.func.id == "dict" and call.args:
+                    text = astutil.dotted(call.args[0]) or ""
+                    if text.endswith(".stats"):
+                        seeds_stats = True
+        if seeds_stats:
+            for node in mod.nodes(ast.Assign):
+                if isinstance(node.value, ast.Dict) and any(
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "stats" for t in node.targets):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            keys.append((k.value, k.lineno))
+        for key, line in keys:
+            if key not in seen:
+                seen.add(key)
+                out.append({"tier": tier, "key": key,
+                            "site": f"{mod.relpath}:{line}"})
+    out.sort(key=lambda d: (d["tier"], d["key"]))
+    return out
+
+
+def extract_producer_fields(modules) -> dict[str, list[str]]:
+    """ledger name -> dict keys its declared producer method writes
+    (the fields a closure equation may legally reference)."""
+    fields: dict[str, list[str]] = {}
+    want = {spec["producer"]: name for name, spec in LEDGERS.items()
+            if spec["producer"] is not None}
+    for mod in modules:
+        for cls in mod.nodes(ast.ClassDef):
+            for child in cls.body:
+                if not isinstance(child, ast.FunctionDef):
+                    continue
+                ledger = want.get((cls.name, child.name))
+                if ledger is None:
+                    continue
+                keys = sorted({k for k, _ in _dict_keys_in(child)})
+                fields[ledger] = keys
+    return fields
+
+
+def extract_consumers(modules) -> list[dict]:
+    """Promised-series consumer references: module-level string lists
+    whose name mentions PROMISED or ends in _SERIES, filtered to
+    series-shaped entries."""
+    out: list[dict] = []
+    for mod in modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not any(re.search(r"PROMISED|_SERIES$", n)
+                       for n in names):
+                continue
+            if not isinstance(node.value, (ast.List, ast.Tuple)):
+                continue
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str) \
+                        and SERIES_RE.match(elt.value):
+                    out.append({
+                        "name": elt.value,
+                        "consumer": f"{mod.relpath}:{node.lineno}"})
+    out.sort(key=lambda c: (c["name"], c["consumer"]))
+    return out
+
+
+_README_TOKEN = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)`")
+
+# a README back-tick only counts as a SERIES reference when it carries
+# a metric-ish suffix — span names (`egress.attempt`) and failpoint
+# names (`egress.sink`) share the dotted grammar but are not series
+_SERIES_SUFFIXES = ("_total", "_ms", "_ns", "_records", "_seconds",
+                    "percentile")
+
+
+def readme_consumers(readme_path: str,
+                     first_segments: set[str]) -> list[dict]:
+    """Back-ticked series references in the README whose first segment
+    matches an emitted family (so `os.path` never counts): drift-checked
+    like any other consumer."""
+    if not os.path.isfile(readme_path):
+        return []
+    with open(readme_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    out = []
+    seen: set[str] = set()
+    for m in _README_TOKEN.finditer(text):
+        tok = m.group(1)
+        if tok in seen or not SERIES_RE.match(tok):
+            continue
+        if not tok.endswith(_SERIES_SUFFIXES):
+            continue
+        if tok.split(".", 1)[0] not in first_segments:
+            continue
+        seen.add(tok)
+        line = text.count("\n", 0, m.start()) + 1
+        out.append({"name": tok, "consumer": f"README.md:{line}"})
+    return sorted(out, key=lambda c: c["name"])
+
+
+# -- the schema ------------------------------------------------------------
+
+def build_schema(modules, root: str = "",
+                 readme_path: str = "") -> dict:
+    """The full registry over parsed Modules (engine.Module objects).
+    Deterministic, byte-stable for the committed artifact."""
+    emits, dynamic = extract_emits(modules)
+    debug_vars = extract_debug_vars(modules)
+    consumers = extract_consumers(modules)
+    if readme_path:
+        firsts = {e["name"].split(".", 1)[0] for e in emits
+                  if not e["pattern"]}
+        consumers = sorted(
+            consumers + readme_consumers(readme_path, firsts),
+            key=lambda c: (c["name"], c["consumer"]))
+    producer_fields = extract_producer_fields(modules)
+    ledgers = {}
+    for name, spec in sorted(LEDGERS.items()):
+        ledgers[name] = {
+            "debug_vars": spec["debug_vars"],
+            "closure": ([sorted(spec["closure"][0]),
+                         sorted(spec["closure"][1])]
+                        if spec["closure"] else None),
+            "fields": producer_fields.get(name, []),
+            "prefixes": sorted(spec["prefixes"]),
+        }
+    return {
+        "vnlint_telemetry_schema": SCHEMA_VERSION,
+        # basename only: an absolute root would make the committed
+        # artifact churn with every contributor's checkout path
+        "root": os.path.basename(root.rstrip("/")) if root else "",
+        "emits": emits,
+        "dynamic_emits": dynamic,
+        "debug_vars": debug_vars,
+        "ledgers": ledgers,
+        "consumers": consumers,
+    }
+
+
+def build_schema_for_tree(paths=None, readme_path: str = "") -> dict:
+    """Standalone build (the CLI / artifact-sync / runtime-comparator
+    entry point): discovery + parsing are the lint engine's own, so the
+    schema covers exactly the tree a lint run sees."""
+    from veneur_tpu.analysis import engine as engine_mod
+    eng = engine_mod.LintEngine(rules=[])
+    root, modules, _failures = engine_mod.load_modules(
+        paths, eng.known_rules)
+    if not readme_path:
+        cand = os.path.join(os.path.dirname(root), "README.md")
+        readme_path = cand if os.path.isfile(cand) else ""
+    return build_schema(modules, root=root, readme_path=readme_path)
+
+
+def schema_fingerprint(schema: dict) -> dict:
+    """The site-insensitive projection the artifact-sync check compares
+    (line numbers drift with unrelated edits; names, types, tag shapes
+    and ledger topology must not change silently)."""
+    return {
+        "emits": sorted({(e["name"], e["type"], tuple(e["tags"]),
+                          e["pattern"], e["ledger"])
+                         for e in schema["emits"]}),
+        "dynamic": sorted({(d["expr"], d["type"])
+                           for d in schema["dynamic_emits"]}),
+        "debug_vars": sorted({(d["tier"], d["key"])
+                              for d in schema["debug_vars"]}),
+        "ledgers": {
+            name: {"debug_vars": led["debug_vars"],
+                   "closure": led["closure"],
+                   "fields": list(led["fields"]),
+                   "prefixes": list(led["prefixes"])}
+            for name, led in schema["ledgers"].items()},
+    }
+
+
+def write_schema(schema: dict, path) -> None:
+    payload = json.dumps(schema, indent=2, sort_keys=True) + "\n"
+    if path == "-":
+        import sys
+        sys.stdout.write(payload)
+        return
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(payload)
+
+
+def load_schema(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+# -- static checks ---------------------------------------------------------
+
+def _pattern_re(pattern: str) -> re.Pattern:
+    return re.compile("^" + ".*".join(
+        re.escape(p) for p in pattern.split("*")) + "$")
+
+
+def series_matcher(schema: dict):
+    """Callable name -> matching emit dict (or None), exact names
+    first, then `*` patterns."""
+    exact: dict[str, dict] = {}
+    patterns: list[tuple[re.Pattern, dict]] = []
+    for e in schema["emits"]:
+        if e["pattern"]:
+            patterns.append((_pattern_re(e["name"]), e))
+        else:
+            exact.setdefault(e["name"], e)
+
+    def match(name: str) -> Optional[dict]:
+        hit = exact.get(name)
+        if hit is not None:
+            return hit
+        for rx, e in patterns:
+            if rx.match(name):
+                return e
+        return None
+
+    return match
+
+
+def schema_issues(schema: dict) -> list[dict]:
+    """The three static checks: emit-site collisions, consumer drift,
+    ledger drift.  Each issue carries the site to anchor a lint finding
+    at."""
+    issues: list[dict] = []
+    by_name: dict[str, list[dict]] = {}
+    for e in schema["emits"]:
+        by_name.setdefault(e["name"], []).append(e)
+    for name, sites in sorted(by_name.items()):
+        types = sorted({e["type"] for e in sites})
+        if len(types) > 1:
+            where = ", ".join(f"{e['site']} ({e['type']})"
+                              for e in sites)
+            issues.append({
+                "kind": "collision", "site": sites[0]["site"],
+                "message": f"series `{name}` emitted with conflicting "
+                           f"types {types} at {where} — one name, one "
+                           "type, or dashboards aggregate garbage"})
+            continue
+        known_shapes = sorted({tuple(e["tags"]) for e in sites
+                               if "?" not in e["tags"]})
+        # subset shapes are compatible (a success-path emit with fewer
+        # tags than its failure-path twin groups fine); only DISJOINT
+        # dimensions split the series
+        known_shapes = [s for s in known_shapes
+                        if not any(set(s) < set(o)
+                                   for o in known_shapes)]
+        if len(known_shapes) > 1:
+            where = ", ".join(
+                f"{e['site']} (tags {sorted(e['tags'])})"
+                for e in sites if "?" not in e["tags"])
+            issues.append({
+                "kind": "collision", "site": sites[0]["site"],
+                "message": f"series `{name}` emitted with conflicting "
+                           f"tag shapes at {where} — group-bys split "
+                           "one series into disjoint halves"})
+    match = series_matcher(schema)
+    for c in schema["consumers"]:
+        if match(c["name"]) is None:
+            issues.append({
+                "kind": "consumer-drift", "site": c["consumer"],
+                "message": f"`{c['name']}` is promised to consumers "
+                           f"({c['consumer']}) but no site emits it — "
+                           "the series was renamed or removed without "
+                           "its readers"})
+    dv_keys = {d["key"] for d in schema["debug_vars"]}
+    if not dv_keys:
+        # the analyzed tree has no debug_vars builder at all (a lint
+        # fixture, a partial tree): the declared ledgers aren't ITS
+        # contract, so ledger drift is out of scope
+        return issues
+    for name, led in sorted(schema["ledgers"].items()):
+        if led["debug_vars"] not in dv_keys:
+            issues.append({
+                "kind": "ledger-drift", "site": "analysis/telemetry.py",
+                "message": f"ledger `{name}` claims /debug/vars key "
+                           f"`{led['debug_vars']}` but no debug_vars "
+                           "builder exposes it"})
+        if led["closure"]:
+            missing = [f for side in led["closure"] for f in side
+                       if f not in led["fields"]]
+            if missing:
+                issues.append({
+                    "kind": "ledger-drift",
+                    "site": "analysis/telemetry.py",
+                    "message": f"ledger `{name}` closure references "
+                               f"field(s) {missing} its producer "
+                               "never writes — the equation can "
+                               "never be evaluated"})
+    return issues
+
+
+# -- runtime cross-validation ---------------------------------------------
+
+class _RecordingStatsd:
+    """Statsd-interface proxy: records (name, type) for the witness,
+    then delegates to the real client (or a no-op)."""
+
+    def __init__(self, witness: "TelemetryWitness", inner):
+        from veneur_tpu import scopedstatsd
+        self._w = witness
+        self._inner = scopedstatsd.ensure(inner)
+
+    def replace_inner(self, client) -> None:
+        """Server.start() calls this when a `stats_address` client is
+        built AFTER the witness wrapped a pre-start None — recording
+        must compose with, not suppress, the configured client."""
+        from veneur_tpu import scopedstatsd
+        self._inner = scopedstatsd.ensure(client)
+
+    def count(self, name, value, tags=None, rate=1.0):
+        self._w.record(name, "counter")
+        self._inner.count(name, value, tags=tags, rate=rate)
+
+    def incr(self, name, tags=None, rate=1.0):
+        self._w.record(name, "counter")
+        self._inner.incr(name, tags=tags, rate=rate)
+
+    def gauge(self, name, value, tags=None, rate=1.0):
+        self._w.record(name, "gauge")
+        self._inner.gauge(name, value, tags=tags, rate=rate)
+
+    def histogram(self, name, value, tags=None, rate=1.0):
+        self._w.record(name, "histogram")
+        self._inner.histogram(name, value, tags=tags, rate=rate)
+
+    def timing(self, name, ms, tags=None, rate=1.0):
+        self._w.record(name, "timing")
+        self._inner.timing(name, ms, tags=tags, rate=rate)
+
+    def set(self, name, member, tags=None, rate=1.0):
+        self._w.record(name, "set")
+        self._inner.set(name, member, tags=tags, rate=rate)
+
+    def close(self):
+        self._inner.close()
+
+
+class TelemetryWitness:
+    """Runtime half of the schema cross-validation: a recording statsd
+    client on every witnessed server plus /debug/vars snapshots, shared
+    across a testbed cluster (or several chaos cells)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._series: dict[tuple[str, str], int] = {}
+        # monotonic token -> (weakref, tier); vars snapshots keyed the
+        # same way.  NOT id(obj): across a shared-witness chaos matrix
+        # CPython reuses addresses, and a reused id would silently
+        # overwrite a crashed node's final ledger snapshot — the most
+        # interesting one.
+        self._next_token = 0
+        self._nodes: dict[int, tuple] = {}
+        self._vars: dict[int, dict] = {}
+
+    def record(self, name: str, mtype: str) -> None:
+        with self._mu:
+            key = (name, mtype)
+            self._series[key] = self._series.get(key, 0) + 1
+
+    def _register(self, obj, tier: str) -> None:
+        with self._mu:
+            for ref, _tier in self._nodes.values():
+                if ref() is obj:
+                    return          # idempotent re-install
+            self._nodes[self._next_token] = (weakref.ref(obj), tier)
+            self._next_token += 1
+
+    def install_server(self, server) -> None:
+        """Wrap `server.statsd` (install before traffic; every later
+        flush records its emissions) and register the server for
+        /debug/vars collection."""
+        if not isinstance(server.statsd, _RecordingStatsd):
+            server.statsd = _RecordingStatsd(self, server.statsd)
+        self._register(server, "server")
+
+    def install_proxy(self, proxy) -> None:
+        self._register(proxy, "proxy")
+
+    def collect(self) -> None:
+        """Snapshot /debug/vars for every live witnessed node (latest
+        snapshot wins; crashed/stopped nodes keep their last one)."""
+        with self._mu:
+            nodes = list(self._nodes.items())
+        for key, (ref, tier) in nodes:
+            obj = ref()
+            if obj is None:
+                continue
+            try:
+                if tier == "server":
+                    from veneur_tpu import http_api
+                    snap = http_api.debug_vars(obj)
+                else:
+                    from veneur_tpu.proxy import proxy as proxy_mod
+                    snap = proxy_mod.debug_vars(obj)
+            except Exception:
+                continue    # a crashed node's last snapshot stands
+            with self._mu:
+                self._vars[key] = {"tier": tier, "vars": snap}
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "series": [
+                    {"name": n, "type": t, "count": c}
+                    for (n, t), c in sorted(self._series.items())],
+                "nodes": [dict(v) for v in self._vars.values()],
+            }
+
+
+def compare_runtime(schema: dict, observed) -> dict:
+    """Cross-validate runtime observations against the static schema.
+
+    `observed` is a TelemetryWitness or its snapshot() dict.  Fails
+    loud (`ok: False`) on any observed series or /debug/vars key the
+    schema lacks — an analyzer gap, not a runtime bug — and evaluates
+    every declared ledger closure over the observed counters."""
+    if isinstance(observed, TelemetryWitness):
+        observed = observed.snapshot()
+    match = series_matcher(schema)
+    gaps: list[dict] = []
+    matched = 0
+    for s in observed.get("series", []):
+        hit = match(s["name"])
+        if hit is None:
+            gaps.append({"kind": "series", "name": s["name"],
+                         "detail": "observed series absent from the "
+                                   "static schema"})
+        elif not hit["pattern"] and hit["type"] != s["type"]:
+            gaps.append({"kind": "series-type", "name": s["name"],
+                         "detail": f"observed as {s['type']}, schema "
+                                   f"says {hit['type']} "
+                                   f"({hit['site']})"})
+        else:
+            matched += 1
+    dv_by_tier: dict[str, set] = {}
+    for d in schema.get("debug_vars", []):
+        dv_by_tier.setdefault(d["tier"], set()).add(d["key"])
+    ledgers: dict[str, dict] = {
+        name: {"nodes": 0, "closed": True}
+        for name, led in schema.get("ledgers", {}).items()
+        if led["closure"]}
+    for node in observed.get("nodes", []):
+        tier, snap = node["tier"], node["vars"]
+        known = dv_by_tier.get(tier, set())
+        for key in snap:
+            if key not in known:
+                gaps.append({"kind": "debug-vars", "name": key,
+                             "detail": f"{tier} /debug/vars key "
+                                       "absent from the static "
+                                       "schema"})
+        for name, led in schema.get("ledgers", {}).items():
+            if not led["closure"]:
+                continue
+            sub = snap.get(led["debug_vars"])
+            if not isinstance(sub, dict):
+                continue
+            missing = [f for side in led["closure"] for f in side
+                       if f not in sub]
+            if missing:
+                gaps.append({"kind": "ledger", "name": name,
+                             "detail": f"closure field(s) {missing} "
+                                       "absent from the observed "
+                                       "ledger"})
+                continue
+            lhs = sum(sub[f] for f in led["closure"][0])
+            rhs = sum(sub[f] for f in led["closure"][1])
+            rec = ledgers[name]
+            rec["nodes"] += 1
+            if lhs != rhs:
+                rec["closed"] = False
+                rec["delta"] = lhs - rhs
+    # dedup gap rows (several nodes can observe the same unknown key)
+    seen: set[tuple] = set()
+    uniq = []
+    for g in gaps:
+        k = (g["kind"], g["name"])
+        if k not in seen:
+            seen.add(k)
+            uniq.append(g)
+    open_ledgers = [n for n, r in ledgers.items()
+                    if r["nodes"] and not r["closed"]]
+    return {
+        "ok": not uniq and not open_ledgers,
+        "gaps": uniq,
+        "ledgers": ledgers,
+        "observed_series": len(observed.get("series", [])),
+        "matched_series": matched,
+        "nodes": len(observed.get("nodes", [])),
+    }
+
+
+def runtime_comparison(witness: TelemetryWitness,
+                       paths=None) -> dict:
+    """Build the static schema for the installed package and compare a
+    witnessed run against it — the telemetry analog of
+    chaos.witness_comparison."""
+    return compare_runtime(build_schema_for_tree(paths), witness)

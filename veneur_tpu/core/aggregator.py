@@ -485,6 +485,9 @@ class MetricAggregator:
                 from veneur_tpu import ingest as ingest_mod
                 ingest_mod.load_library()
                 scan = ingest_mod.import_scan(payload)
+            # vnlint: disable=silent-loss (native-scan unavailability is
+            #   a FALLBACK, not a drop: scan stays None and the payload
+            #   takes the import_pb_batch python path right below)
             except Exception:
                 self._native_import = False
         if scan is None:

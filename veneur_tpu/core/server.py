@@ -388,6 +388,17 @@ class Server:
         self._proto_lock = threading.Lock()
         # last-reported native parse-error/too-long totals (flush deltas)
         self._native_err_reported = (0, 0)
+        # host-path loss counters (the no-silent-loss ledger for lines
+        # the PYTHON paths discard — the native plane keeps its own):
+        # unparseable statsd lines, unparseable SSF datagrams, span-sink
+        # ingest raises, and import-edge failures; drained each flush
+        # into listen.parse_errors_total / worker.span.* /
+        # import.errors_total deltas
+        self.parse_errors = 0
+        self.ssf_parse_errors = 0
+        self.span_ingest_errors = 0
+        self._host_err_reported = (0, 0, 0)
+        self._import_err_reported = 0
         # Bounded-concurrency forwarding: the reference gives each flush its
         # own goroutine with a one-interval ctx deadline (flusher.go:81-86),
         # so in-flight forwards are implicitly bounded by deadline/interval.
@@ -438,6 +449,12 @@ class Server:
                 self.parser.parse_metric(
                     packet, self.aggregator.process_metric)
         except parser_mod.ParseError as e:
+            # visible loss: joins listen.parse_errors_total
+            # (protocol:python) at the next interval accounting.
+            # Locked: several reader threads hit this path, and the
+            # loss ledger itself must not lose increments.
+            with self._proto_lock:
+                self.parse_errors += 1
             logger.debug("could not parse packet %r: %s", packet[:64], e)
 
     def process_packet_buffer(self, buf: bytes) -> None:
@@ -473,6 +490,10 @@ class Server:
                     max_packet=self.config.metric_max_length,
                     implicit_tags=list(self.config.extend_tags),
                     on_other=self.handle_metric_packet)
+            # vnlint: disable=silent-loss (engine unavailability is a
+            #   FALLBACK, not a drop: native=None routes every packet
+            #   through the Python path, which has its own parse-error
+            #   accounting)
             except Exception as e:
                 logger.warning(
                     "native ingest engine unavailable (%s); "
@@ -584,11 +605,16 @@ class Server:
             t.start()
             self._threads.append(t)
         # self-metrics statsd client + runtime diagnostics loop
-        # (cmd/veneur/main.go:85-94, diagnostics/diagnostics_metrics.go)
-        if self.config.stats_address and self.statsd is None:
+        # (cmd/veneur/main.go:85-94, diagnostics/diagnostics_metrics.go).
+        # A telemetry-witness recorder (analysis/telemetry.py) may have
+        # wrapped a pre-start None: the configured client slots in as
+        # its inner target so recording composes instead of suppressing.
+        if self.config.stats_address and (
+                self.statsd is None
+                or hasattr(self.statsd, "replace_inner")):
             from veneur_tpu import scopedstatsd
             sc = self.config.veneur_metrics_scopes or {}
-            self.statsd = scopedstatsd.ScopedClient(
+            client = scopedstatsd.ScopedClient(
                 self.config.stats_address,
                 scopes=scopedstatsd.MetricScopes(
                     counter=sc.get("counter", ""),
@@ -597,6 +623,10 @@ class Server:
                     set_=sc.get("set", ""),
                     timing=sc.get("timing", "")),
                 tags=list(self.config.veneur_metrics_additional_tags))
+            if self.statsd is None:
+                self.statsd = client
+            else:
+                self.statsd.replace_inner(client)
         if self.config.diagnostics_metrics_enabled:
             from veneur_tpu import diagnostics as diag_mod
             self.diagnostics = diag_mod.Diagnostics(
@@ -696,6 +726,9 @@ class Server:
             while True:
                 try:
                     data = sock.recv(self.config.metric_max_length + 1)
+                # vnlint: disable=silent-loss (EWOULDBLOCK is the
+                #   drain-until-empty terminator of the shutdown sweep:
+                #   no datagram was read, so none can be lost here)
                 except (BlockingIOError, OSError):
                     break
                 if data:
@@ -972,6 +1005,10 @@ class Server:
                     return
             if buf:
                 self.handle_metric_packet(buf)
+        # vnlint: disable=silent-loss (connection teardown: every
+        #   COMPLETE line was already handled above; only the torn tail
+        #   of a dying stream is unreadable, and the peer owns
+        #   reconnect-and-resend per the statsd-TCP contract)
         except (ssl.SSLError, OSError, TimeoutError) as e:
             logger.debug("stream connection error: %s", e)
         finally:
@@ -992,6 +1029,11 @@ class Server:
         try:
             span = ssf_mod.parse_ssf(packet)
         except Exception as e:
+            # visible loss: joins listen.parse_errors_total
+            # (protocol:ssf) at the next interval accounting (locked:
+            # concurrent SSF readers share this counter)
+            with self._proto_lock:
+                self.ssf_parse_errors += 1
             logger.debug("could not parse SSF packet: %s", e)
             return
         self.handle_span(span)
@@ -1018,6 +1060,11 @@ class Server:
             try:
                 sink.ingest(span)
             except Exception as e:
+                # visible loss: this direct path (gRPC SendSpan) has no
+                # _SpanSinkWorker error counter in front of it (locked:
+                # the gRPC pool runs these handlers concurrently)
+                with self._proto_lock:
+                    self.span_ingest_errors += 1
                 logger.warning("span sink %s ingest error: %s",
                                sink.name(), e)
 
@@ -1101,9 +1148,15 @@ class Server:
                     return
                 self.proto_received["ssf-stream"] += 1
                 self.handle_span(span)
+        # vnlint: disable=silent-loss (stream teardown: every parsed
+        #   span was counted into proto_received above; a poisoned or
+        #   dying stream closes and the SSF client reconnects — no
+        #   complete span is dropped here)
         except ssf_mod.FramingError as e:
             # the stream is poisoned; close it (protocol/wire.go:26-28)
             logger.debug("SSF framing error, closing stream: %s", e)
+        # vnlint: disable=silent-loss (same teardown contract as the
+        #   framing-error arm above: nothing parsed is in flight)
         except OSError:
             pass
         finally:
@@ -1448,6 +1501,12 @@ class Server:
                     # (slot-exhausted drops are accounted, not traced)
                     span.tags["forward_metrics"] = str(len(res.forward))
                 except RuntimeError:  # pool shut down mid-flush
+                    # the batch never forwards: account it exactly like
+                    # the slots-exhausted drop below, not silently
+                    self.forward_dropped += len(res.forward)
+                    statsd.count("forward.error_total",
+                                 len(res.forward),
+                                 tags=["cause:pool_shutdown"])
                     self._forward_slots.release()
             else:
                 # all forward slots stalled: drop this interval's batch
@@ -1530,6 +1589,30 @@ class Server:
                 statsd.count("listen.packets_too_long_total", tl - pt,
                              tags=["protocol:udp"])
             self._native_err_reported = (mal, tl)
+        # host-path loss deltas (the silent-loss lint's ledger): python
+        # parse errors, SSF parse errors, direct span-sink ingest raises
+        pe, se, si = (self.parse_errors, self.ssf_parse_errors,
+                      self.span_ingest_errors)
+        ppe, pse, psi = self._host_err_reported
+        if pe > ppe:
+            statsd.count("listen.parse_errors_total", pe - ppe,
+                         tags=["protocol:python"])
+        if se > pse:
+            statsd.count("listen.parse_errors_total", se - pse,
+                         tags=["protocol:ssf"])
+        if si > psi:
+            statsd.count("worker.span.ingest_errors_total", si - psi,
+                         tags=["sink:direct"])
+        self._host_err_reported = (pe, se, si)
+        # import-edge failures (sources/proxy.py GrpcImportServer):
+        # metrics that arrived at this global but failed to import
+        gi = getattr(self, "grpc_import", None)
+        if gi is not None:
+            ie = getattr(gi, "import_errors", 0)
+            if ie > self._import_err_reported:
+                statsd.count("import.errors_total",
+                             ie - self._import_err_reported)
+                self._import_err_reported = ie
         # legacy VH HLL payload accounting (mixed-hash inflation warning
         # lives in sketches/hll.py; the metric makes it monitorable)
         vh_total = hll_mod.legacy_vh_total

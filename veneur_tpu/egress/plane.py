@@ -239,8 +239,13 @@ class SinkLane:
                 if job is not None:
                     self._deliver_job(job)
             except Exception:
-                # delivery paths account their own failures; this is
-                # the backstop that keeps the lane alive on a bug
+                # the backstop that keeps the lane alive on a delivery
+                # BUG — counted as an error episode so the crash is a
+                # visible loss channel.  The points are NOT added to
+                # dropped_points here: the delivery path may have
+                # already accounted them (flushed or spilled) before
+                # the crash, and a double count would break the ledger.
+                self._count("errors")
                 logger.exception("egress %s: delivery crashed",
                                  self.label)
             finally:
@@ -698,8 +703,9 @@ class EgressPlane:
         per_sink = {}
         agg = {"flushed": 0, "retried": 0, "errors": 0,
                "queue_dropped": 0, "dropped": 0, "stragglers": 0,
-               "spilled": 0, "replayed": 0, "expired": 0,
-               "spool_dropped": 0, "pending": 0, "pending_points": 0}
+               "spilled": 0, "recovered": 0, "replayed": 0,
+               "expired": 0, "spool_dropped": 0, "pending": 0,
+               "pending_points": 0}
         breakers = {}
         ledger_closed = True
         for lane in self.lanes:
@@ -716,6 +722,7 @@ class EgressPlane:
             sp = st.get("spool")
             if sp is not None:
                 agg["spilled"] += sp["spilled_points"]
+                agg["recovered"] += sp["recovered_points"]
                 agg["replayed"] += sp["replayed_points"]
                 agg["expired"] += sp["expired_points"]
                 agg["spool_dropped"] += sp["dropped_points"]

@@ -402,6 +402,9 @@ class ForwardClient:
                 if self._use_v1 is not False:
                     self._use_v1 = True
                 return
+            # vnlint: disable=silent-loss (protocol FALLBACK, not loss:
+            #   the first batch was sent alone so nothing imported, and
+            #   the whole payload re-sends over V2 streams below)
             except _V1Unsupported:
                 # the FIRST batch (sent alone, nothing imported) got
                 # UNIMPLEMENTED — either the initial probe or the global
@@ -529,12 +532,18 @@ class ForwardClient:
             try:
                 f.result()
                 self._count("sent", len(c.pbs))
+            # vnlint: disable=silent-loss (errors COLLECT, then
+            #   re-raise: an UNIMPLEMENTED chunk re-sends over V2 below,
+            #   and errs/undelivered raise _SendFailure at the end of
+            #   this function — the bounded retry loop owns accounting)
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                     v2_retry.extend(c.pbs)
                 else:
                     errs.append(e)
                     undelivered.append(c)
+            # vnlint: disable=silent-loss (same collect-then-re-raise
+            #   contract as the RpcError arm above)
             except Exception as e:       # noqa: BLE001 - re-raised below
                 errs.append(e)
                 undelivered.append(c)

@@ -72,6 +72,110 @@ def thread_dump() -> bytes:
     return "\n".join(out).encode()
 
 
+def debug_vars(server) -> dict:
+    """The `/debug/vars` payload for one core.Server — the single
+    source of the server-tier debug-vars key space.  The handler below
+    serves it over HTTP and the telemetry witness
+    (analysis/telemetry.py) snapshots it directly, so the statically
+    extracted schema and the runtime observation read the same dict.
+    """
+    stats = {
+        "flush_count": server.flush_count,
+        "last_flush_unix": server.last_flush_unix,
+        "is_local": server.is_local,
+        "processed": server.aggregator.processed,
+        "imported": server.aggregator.imported,
+        "imported_total": getattr(
+            server.grpc_import, "imported_count", 0)
+        if getattr(server, "grpc_import", None) else 0,
+        # import-edge failures: metrics that ARRIVED but failed to
+        # import (visible loss; also import.errors_total)
+        "import_errors_total": getattr(
+            server.grpc_import, "import_errors", 0)
+        if getattr(server, "grpc_import", None) else 0,
+        # host-path loss counters (python parse / ssf parse / direct
+        # span-sink ingest): the silent-loss lint's server-side ledger
+        "parse_errors_python": getattr(server, "parse_errors", 0),
+        "parse_errors_ssf": getattr(server, "ssf_parse_errors", 0),
+        "span_ingest_errors": getattr(server, "span_ingest_errors", 0),
+        "metric_sinks": [s.name() for _, s in
+                         server.metric_sinks],
+        "threads": threading.active_count(),
+        # metrics dropped because every forward slot was
+        # stalled (bounded-buffering loss, core/server.py)
+        "forward_slots_dropped": server.forward_dropped,
+    }
+    egress = getattr(server, "egress", None)
+    if egress is not None:
+        # the egress data plane's ledger: per-sink lanes
+        # (queue depth, breaker state, spool) plus the
+        # aggregated closure — spilled + recovered == replayed +
+        # expired + dropped + pending, so sink-delivery
+        # loss is reconcilable from here
+        stats["egress"] = egress.stats()
+    workers = getattr(server, "span_workers", None)
+    if workers:
+        # per-span-sink ingest accounting: a full queue or
+        # a sink ingest error is visible loss, not a log
+        # line (the _SpanSinkWorker drop-counter satellite);
+        # sinks with internal loss tallies (ssfmetrics invalid
+        # samples, newrelic POST drops) merge theirs in
+        stats["span_sinks"] = {
+            w.sink.name(): {
+                "ingested": w.ingested,
+                "dropped": w.dropped,
+                "errors": w.errors,
+                **(w.sink.loss_stats()
+                   if hasattr(w.sink, "loss_stats") else {}),
+            } for w in workers}
+    fw = getattr(server, "forwarder", None)
+    if fw is not None and hasattr(fw, "stats"):
+        # the forward client's retry-policy accounting:
+        # sent / retries / dropped / spilled metric totals
+        stats["forward"] = fw.stats()
+    if fw is not None and hasattr(fw, "spool_stats"):
+        sp = fw.spool_stats()
+        if sp is not None:
+            # the durable spool's ledger: pending depth plus
+            # spilled/replayed/expired records AND points —
+            # spilled == replayed + expired + dropped once
+            # drained, so loss is reconcilable from here
+            stats["spool"] = sp
+    ckpt = getattr(server, "checkpoint_stats", None)
+    if ckpt is not None and ckpt.get("enabled"):
+        stats["checkpoint"] = dict(ckpt)
+    dedup = getattr(server, "dedup", None)
+    if dedup is not None:
+        # exactly-once ledger: recorded chunk identities and
+        # duplicates skipped (replays of delivered chunks)
+        stats["dedup"] = dedup.stats()
+    guard = getattr(server.aggregator, "cardinality", None)
+    if guard is not None:
+        # per-tenant key-budget ledger: exact keys, evicted
+        # cardinality, rollup point totals
+        stats["cardinality"] = guard.snapshot()
+    native = getattr(server, "native", None)
+    if native is not None:
+        ni = native.stats()  # None while tearing down
+        if ni is not None:
+            stats["native_ingest"] = ni
+        st = native.stage_stats()
+        if st is not None:
+            # monotonic per-stage packet/ns counters
+            # (recvmmsg/parse/intern/stage/drain), per reader
+            # thread + totals — the live view the ceiling
+            # harness (scripts/ingest_ceiling.py) tabulates
+            stats["ingest_stages"] = st
+    timeline = getattr(server, "flush_timeline", None)
+    if timeline is not None:
+        stats["flush_timeline_recorded"] = \
+            timeline.total_recorded
+    recorder = getattr(server, "flight_recorder", None)
+    if recorder is not None:
+        stats["trace_recorded"] = recorder.total_recorded
+    return stats
+
+
 def make_handler(server) -> type:
     cfg = server.config
 
@@ -106,87 +210,9 @@ def make_handler(server) -> type:
                             config_yaml_body(config_mod.redacted_dict(cfg)),
                             "application/x-yaml")
             elif self.path == "/debug/vars":
-                stats = {
-                    "flush_count": server.flush_count,
-                    "last_flush_unix": server.last_flush_unix,
-                    "is_local": server.is_local,
-                    "processed": server.aggregator.processed,
-                    "imported": server.aggregator.imported,
-                    "imported_total": getattr(
-                        server.grpc_import, "imported_count", 0)
-                    if getattr(server, "grpc_import", None) else 0,
-                    "metric_sinks": [s.name() for _, s in
-                                     server.metric_sinks],
-                    "threads": threading.active_count(),
-                    # metrics dropped because every forward slot was
-                    # stalled (bounded-buffering loss, core/server.py)
-                    "forward_slots_dropped": server.forward_dropped,
-                }
-                egress = getattr(server, "egress", None)
-                if egress is not None:
-                    # the egress data plane's ledger: per-sink lanes
-                    # (queue depth, breaker state, spool) plus the
-                    # aggregated closure — spilled == replayed +
-                    # expired + dropped + pending, so sink-delivery
-                    # loss is reconcilable from here
-                    stats["egress"] = egress.stats()
-                workers = getattr(server, "span_workers", None)
-                if workers:
-                    # per-span-sink ingest accounting: a full queue or
-                    # a sink ingest error is visible loss, not a log
-                    # line (the _SpanSinkWorker drop-counter satellite)
-                    stats["span_sinks"] = {
-                        w.sink.name(): {
-                            "ingested": w.ingested,
-                            "dropped": w.dropped,
-                            "errors": w.errors,
-                        } for w in workers}
-                fw = getattr(server, "forwarder", None)
-                if fw is not None and hasattr(fw, "stats"):
-                    # the forward client's retry-policy accounting:
-                    # sent / retries / dropped / spilled metric totals
-                    stats["forward"] = fw.stats()
-                if fw is not None and hasattr(fw, "spool_stats"):
-                    sp = fw.spool_stats()
-                    if sp is not None:
-                        # the durable spool's ledger: pending depth plus
-                        # spilled/replayed/expired records AND points —
-                        # spilled == replayed + expired + dropped once
-                        # drained, so loss is reconcilable from here
-                        stats["spool"] = sp
-                ckpt = getattr(server, "checkpoint_stats", None)
-                if ckpt is not None and ckpt.get("enabled"):
-                    stats["checkpoint"] = dict(ckpt)
-                dedup = getattr(server, "dedup", None)
-                if dedup is not None:
-                    # exactly-once ledger: recorded chunk identities and
-                    # duplicates skipped (replays of delivered chunks)
-                    stats["dedup"] = dedup.stats()
-                guard = getattr(server.aggregator, "cardinality", None)
-                if guard is not None:
-                    # per-tenant key-budget ledger: exact keys, evicted
-                    # cardinality, rollup point totals
-                    stats["cardinality"] = guard.snapshot()
-                native = getattr(server, "native", None)
-                if native is not None:
-                    ni = native.stats()  # None while tearing down
-                    if ni is not None:
-                        stats["native_ingest"] = ni
-                    st = native.stage_stats()
-                    if st is not None:
-                        # monotonic per-stage packet/ns counters
-                        # (recvmmsg/parse/intern/stage/drain), per reader
-                        # thread + totals — the live view the ceiling
-                        # harness (scripts/ingest_ceiling.py) tabulates
-                        stats["ingest_stages"] = st
-                timeline = getattr(server, "flush_timeline", None)
-                if timeline is not None:
-                    stats["flush_timeline_recorded"] = \
-                        timeline.total_recorded
-                recorder = getattr(server, "flight_recorder", None)
-                if recorder is not None:
-                    stats["trace_recorded"] = recorder.total_recorded
-                self._reply(200, json.dumps(stats, indent=2).encode(),
+                self._reply(200,
+                            json.dumps(debug_vars(server),
+                                       indent=2).encode(),
                             "application/json")
             elif self.path.rstrip("/") == "/debug/pprof":
                 self._reply(200, _pprof_index(cfg))

@@ -294,6 +294,11 @@ class Destinations:
                     try:
                         self.handoff(metrics)
                         rerouted = len(metrics)
+                    # vnlint: disable=silent-loss (already accounted:
+                    #   swept metrics were counted into the retiring
+                    #   destination's dropped total at close; rerouted
+                    #   SUBTRACTS from it only on success, so a failed
+                    #   handoff leaves them visibly dropped)
                     except Exception:
                         logger.exception(
                             "reshard handoff re-route failed; %d "

@@ -141,6 +141,27 @@ def redacted_proxy_dict(cfg: ProxyConfig, redact: bool = True) -> dict:
     return redacted_fields(cfg, {"tls_key"}, redact)
 
 
+def debug_vars(proxy) -> dict:
+    """The proxy-tier `/debug/vars` payload — one builder shared by the
+    HTTP handler and the telemetry witness (analysis/telemetry.py), so
+    the static schema and the runtime observation cover the same
+    keys."""
+    with proxy._stats_lock:
+        stats = dict(proxy.stats)
+    stats["destinations"] = proxy.destinations.size()
+    stats["destination_stats"] = proxy.destinations.stats()
+    # cumulative incl. removed destinations: a dead destination's drop
+    # accounting must stay visible
+    stats["destination_totals"] = proxy.destinations.totals()
+    stats["breakers"] = proxy.destinations.breaker_stats()
+    # elastic-reshard record: epochs, sampled keys moved, handoff
+    # counts, last committed window
+    stats["reshard"] = proxy.destinations.reshard_stats()
+    stats["trace_recorded"] = proxy.recorder.total_recorded
+    stats["threads"] = threading.active_count()
+    return stats
+
+
 class Proxy:
     def __init__(self, cfg: ProxyConfig,
                  discoverer: Optional[Discoverer] = None,
@@ -324,6 +345,9 @@ class Proxy:
                 from veneur_tpu import ingest as ingest_mod
                 ingest_mod.load_library()
                 router = self._native_router = ingest_mod.route_metric_list
+            # vnlint: disable=silent-loss (native-router unavailability
+            #   is a FALLBACK, not a drop: ring stays None and the
+            #   payload takes the python handle_metrics path below)
             except Exception:
                 router = self._native_router = False
         ring = (self.destinations.ring_arrays()
@@ -452,26 +476,9 @@ class Proxy:
                         "application/x-yaml")
                 elif (self.path == "/debug/vars"
                         and cfg.http_enable_profiling):
-                    with proxy._stats_lock:
-                        stats = dict(proxy.stats)
-                    stats["destinations"] = proxy.destinations.size()
-                    stats["destination_stats"] = \
-                        proxy.destinations.stats()
-                    # cumulative incl. removed destinations: a dead
-                    # destination's drop accounting must stay visible
-                    stats["destination_totals"] = \
-                        proxy.destinations.totals()
-                    stats["breakers"] = \
-                        proxy.destinations.breaker_stats()
-                    # elastic-reshard record: epochs, sampled keys
-                    # moved, handoff counts, last committed window
-                    stats["reshard"] = \
-                        proxy.destinations.reshard_stats()
-                    stats["trace_recorded"] = \
-                        proxy.recorder.total_recorded
-                    stats["threads"] = threading.active_count()
                     http_api.reply(self, 200, json_mod.dumps(
-                        stats, indent=2).encode(), "application/json")
+                        debug_vars(proxy), indent=2).encode(),
+                        "application/json")
                 elif self.path.startswith("/debug/trace"):
                     # always-on (like the ring itself): the flight
                     # recorder is the proxy's black box, most needed

@@ -153,10 +153,19 @@ class NewRelicSpanSink(sink_mod.BaseSpanSink):
                          "Data-Format-Version": "1"},
                 timeout=10.0)
             if resp.status_code >= 400:
+                # the spans are gone (the buffer was swapped): count
+                # them into the sink's visible drop tally
+                self.dropped += len(spans)
                 logger.warning("newrelic trace POST -> %d: %.200s",
                                resp.status_code, resp.text)
         except requests.RequestException as e:
+            self.dropped += len(spans)
             logger.warning("newrelic trace POST failed: %s", e)
+
+    def loss_stats(self) -> dict:
+        """Visible-loss tally (buffer-full ingest bounces + failed
+        POSTs), merged into /debug/vars -> span_sinks."""
+        return {"sink_dropped": self.dropped}
 
 
 sink_mod.register_metric_sink("newrelic")(NewRelicMetricSink)

@@ -34,6 +34,11 @@ class MetricExtractionSink(sink_mod.BaseSpanSink):
         self.objective_timer_name = objective_timer_name
         self.uniqueness_rate = uniqueness_rate
         self.spans_processed = 0
+        # samples a span carried that could not become metrics, and
+        # derived-metric conversions that raised: visible loss tallies
+        # for a path that used to log-and-lose
+        self.invalid_samples = 0
+        self.conversion_errors = 0
 
     def ingest(self, span) -> None:
         metrics = []
@@ -41,6 +46,7 @@ class MetricExtractionSink(sink_mod.BaseSpanSink):
             metrics.extend(ssf_convert.convert_metrics(self.parser, span))
         except ssf_convert.InvalidMetricsError as e:
             metrics.extend(e.metrics)
+            self.invalid_samples += len(e.samples)
             logger.debug("span contained %d invalid samples",
                          len(e.samples))
         if span.indicator:
@@ -49,13 +55,21 @@ class MetricExtractionSink(sink_mod.BaseSpanSink):
                     self.parser, span, self.indicator_timer_name,
                     self.objective_timer_name))
             except Exception as e:
+                self.conversion_errors += 1
                 logger.warning("indicator conversion failed: %s", e)
         if self.uniqueness_rate > 0:
             try:
                 metrics.extend(ssf_convert.convert_span_uniqueness_metrics(
                     self.parser, span, self.uniqueness_rate))
             except Exception as e:
+                self.conversion_errors += 1
                 logger.debug("uniqueness conversion failed: %s", e)
         for m in metrics:
             self.process_metric(m)
         self.spans_processed += 1
+
+    def loss_stats(self) -> dict:
+        """Visible-loss tallies, merged into /debug/vars -> span_sinks
+        by the server's debug_vars builder."""
+        return {"invalid_samples": self.invalid_samples,
+                "conversion_errors": self.conversion_errors}

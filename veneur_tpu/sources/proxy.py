@@ -219,6 +219,11 @@ class GrpcImportServer:
         self.trace_hook = trace_hook
         self.dedup = dedup
         self.imported_count = 0
+        # metrics that arrived but failed to import (malformed pb,
+        # aggregator rejection): visible loss, part of the import-edge
+        # ledger (surfaced at /debug/vars -> import_errors_total and as
+        # the import.errors_total series)
+        self.import_errors = 0
         self._count_lock = threading.Lock()
         # Each long-lived client stream (a proxy destination keeps 8 of
         # them open per global, proxy/connect.py) pins one worker thread
@@ -259,6 +264,9 @@ class GrpcImportServer:
                 try:
                     if entry[0] == CHUNK_ID_KEY:
                         return parse_chunk_id(entry[1])
+                # vnlint: disable=silent-loss (a malformed metadata
+                #   entry only degrades dedup to the unidentified path —
+                #   the chunk itself still imports below, nothing drops)
                 except (IndexError, TypeError):
                     continue
             return None
@@ -269,6 +277,8 @@ class GrpcImportServer:
                 # python protobuf materialization on the fleet edge
                 count, failed = self.import_payload(bytes(request))
                 if failed:
+                    with self._count_lock:
+                        self.import_errors += failed
                     logger.error("failed to import %d metrics in a V1 "
                                  "batch", failed)
                 return count
@@ -279,6 +289,8 @@ class GrpcImportServer:
                     self.import_metric(convert.from_pb(pb))
                     count += 1
                 except Exception as e:
+                    with self._count_lock:
+                        self.import_errors += 1
                     logger.error("failed to import metric %s: %s",
                                  pb.name, e)
             return count
@@ -323,6 +335,8 @@ class GrpcImportServer:
                     self.import_metric(convert.from_pb(pb))
                     count += 1
                 except Exception as e:
+                    with self._count_lock:
+                        self.import_errors += 1
                     logger.error("failed to import metric %s: %s",
                                  pb.name, e)
             with self._count_lock:

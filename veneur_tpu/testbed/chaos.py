@@ -150,43 +150,52 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   n_globals: int = 2, intervals: int = 2,
                   counter_keys: int = 4, histo_keys: int = 1,
                   set_keys: int = 1, histo_samples: int = 40,
-                  witness=None, trace: bool = False) -> dict:
+                  witness=None, trace: bool = False,
+                  telemetry=None) -> dict:
     """One matrix cell: fresh cluster, armed failpoint (or topology
     action), oracle verdict.  `witness` (a LockWitness) additionally
     records every lock-acquisition-order edge the cell exercises for
-    the static cross-check (analysis/witness.py).  `trace` assembles
-    the tiers' flight-recorder rings after the run and gates ok on
-    every settled interval forming one complete 3-tier trace with zero
-    orphans — duplicate retry attempts must dedup to one delivered
-    edge (trace/assembly.py)."""
+    the static cross-check (analysis/witness.py); `telemetry` (a
+    TelemetryWitness) records every emitted series + /debug/vars
+    snapshot for the schema cross-check and ledger-closure assertion
+    (analysis/telemetry.py).  `trace` assembles the tiers'
+    flight-recorder rings after the run and gates ok on every settled
+    interval forming one complete 3-tier trace with zero orphans —
+    duplicate retry attempts must dedup to one delivered edge
+    (trace/assembly.py)."""
     if arm.kind == "egress":
         return _run_egress_arm(arm, seed=seed,
-                               counter_keys=counter_keys)
+                               counter_keys=counter_keys,
+                               telemetry=telemetry)
     if arm.kind == "crash":
         return _run_crash_arm(arm, seed=seed, n_locals=n_locals,
                               counter_keys=counter_keys,
                               histo_keys=histo_keys, set_keys=set_keys,
                               histo_samples=histo_samples,
-                              witness=witness, trace=trace)
+                              witness=witness, trace=trace,
+                              telemetry=telemetry)
     if arm.kind == "topology":
         if arm.kwargs.get("op") == "storm":
             return _run_cardinality_storm(arm, seed=seed,
                                           n_locals=max(n_locals, 2),
                                           intervals=intervals,
-                                          witness=witness)
+                                          witness=witness,
+                                          telemetry=telemetry)
         return _run_ring_arm(arm, seed=seed, n_locals=n_locals,
                              intervals=intervals,
                              counter_keys=counter_keys,
                              histo_keys=histo_keys, set_keys=set_keys,
                              histo_samples=histo_samples,
-                             witness=witness, trace=trace)
+                             witness=witness, trace=trace,
+                             telemetry=telemetry)
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        forward_max_retries=2,
                        forward_retry_backoff=0.02,
                        breaker_failure_threshold=2,
                        breaker_reset_timeout=0.4,
                        discovery_interval_s=0.2,
-                       lock_witness=witness)
+                       lock_witness=witness,
+                       telemetry=telemetry)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -261,7 +270,7 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                   intervals: int = 3, counter_keys: int = 4,
                   histo_keys: int = 1, set_keys: int = 1,
                   histo_samples: int = 40, witness=None,
-                  trace: bool = False) -> dict:
+                  trace: bool = False, telemetry=None) -> dict:
     """Scale-up / scale-down / rolling-restart under live traffic: run an
     interval on the starting ring, reshard, keep running — conservation
     must stay EXACT across ring epochs, one-global-per-key must hold per
@@ -276,7 +285,8 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                        breaker_failure_threshold=2,
                        breaker_reset_timeout=0.4,
                        discovery_interval_s=0.2,
-                       lock_witness=witness)
+                       lock_witness=witness,
+                       telemetry=telemetry)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -348,7 +358,8 @@ def _run_ring_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
 
 def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
                            n_locals: int = 2, intervals: int = 2,
-                           budget: int = 6, witness=None) -> dict:
+                           budget: int = 6, witness=None,
+                           telemetry=None) -> dict:
     """One tenant floods fresh keys past its budget on every local: the
     arenas must stay under budget, the folded tail must stay ACCOUNTED —
     rollup counter mass exact, rollup set cardinality exact, rollup
@@ -360,7 +371,8 @@ def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
                        breaker_reset_timeout=0.4,
                        discovery_interval_s=0.2,
                        cardinality_key_budget=budget,
-                       lock_witness=witness)
+                       lock_witness=witness,
+                       telemetry=telemetry)
     storm = StormGen(seed=seed, budget=budget)
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
@@ -502,7 +514,8 @@ def _crash_row(arm: ChaosArm, acct: dict, counters: dict,
 def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                    counter_keys: int = 4, histo_keys: int = 1,
                    set_keys: int = 1, histo_samples: int = 40,
-                   witness=None, trace: bool = False) -> dict:
+                   witness=None, trace: bool = False,
+                   telemetry=None) -> dict:
     """One crash cell.  Three ops:
 
     local-crash      proxied: ingest interval 2 into the local, force a
@@ -530,7 +543,8 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
         spool_replay_interval_s=0.05,
         spool_max_age_s=0.3 if op == "spool-expiry" else 60.0,
         breaker_failure_threshold=2, breaker_reset_timeout=0.4,
-        discovery_interval_s=0.2, lock_witness=witness)
+        discovery_interval_s=0.2, lock_witness=witness,
+        telemetry=telemetry)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -659,7 +673,7 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
 
 
 def _run_egress_arm(arm: ChaosArm, *, seed: int = 0,
-                    counter_keys: int = 4) -> dict:
+                    counter_keys: int = 4, telemetry=None) -> dict:
     """The sink-blackhole cell: one server, one channel sink, the
     `egress.sink` failpoint armed unbounded (a true blackhole), then
     disarmed to model backend recovery.  Every emitted point must
@@ -685,6 +699,8 @@ def _run_egress_arm(arm: ChaosArm, *, seed: int = 0,
         egress_spool_replay_interval=0.05),
         extra_metric_sinks=[sink])
     lane = next(l for l in srv.egress.lanes if l.kind == "metric")
+    if telemetry is not None:
+        telemetry.install_server(srv)
     trips_seen = 0
     fp = failpoints.configure(arm.failpoint, arm.action, seed=seed)
     try:
@@ -724,6 +740,8 @@ def _run_egress_arm(arm: ChaosArm, *, seed: int = 0,
         eg = srv.egress.stats()
     finally:
         failpoints.disarm(arm.failpoint)
+        if telemetry is not None:
+            telemetry.collect()
         srv.shutdown()
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -772,3 +790,12 @@ def witness_comparison(witness) -> dict:
     gap (ok: False), fully-observed static cycle = confirmed hazard."""
     from veneur_tpu.analysis import witness as witness_mod
     return witness_mod.compare(witness_mod.static_graph(), witness)
+
+
+def telemetry_comparison(telemetry) -> dict:
+    """Cross-validate a chaos run's observed telemetry (emitted series
+    + /debug/vars snapshots) against the static schema: an observed
+    series/key the schema lacks = analyzer gap (ok: False), and every
+    declared ledger closure is asserted over the observed counters."""
+    from veneur_tpu.analysis import telemetry as telemetry_mod
+    return telemetry_mod.runtime_comparison(telemetry)

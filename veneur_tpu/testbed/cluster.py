@@ -71,6 +71,13 @@ class ClusterSpec:
     # LockWitness (Cluster.witness); a LockWitness instance = share one
     # registry across several clusters (the chaos matrix)
     lock_witness: object = None
+    # runtime telemetry witness (analysis/telemetry.py): True = record
+    # every emitted series + /debug/vars snapshot on every tier into a
+    # fresh TelemetryWitness (Cluster.telemetry); an instance = share
+    # one registry across clusters (the chaos matrix).  The comparator
+    # then fails loud on any observed series/key the static schema
+    # lacks and asserts every declared ledger closure.
+    telemetry: object = None
     # crash durability (the ISSUE-10 arms): every node gets its own
     # spool + checkpoint directory under one tempdir (removed at
     # cluster stop); crash_*/revive_* then prove recovery from disk
@@ -115,6 +122,14 @@ class Cluster:
         self._retired_locals: list[_Node] = []
         self._durable_root = (tempfile.mkdtemp(prefix="tb-durable-")
                               if spec.durable else "")
+        self.telemetry = None
+        if spec.telemetry:
+            from veneur_tpu.analysis import telemetry as telemetry_mod
+            self.telemetry = (spec.telemetry
+                              if isinstance(spec.telemetry,
+                                            telemetry_mod
+                                            .TelemetryWitness)
+                              else telemetry_mod.TelemetryWitness())
         self.witness = None
         self._fp_unwitness = None
         if spec.lock_witness:
@@ -163,6 +178,8 @@ class Cluster:
             hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
+        if self.telemetry is not None:
+            self.telemetry.install_server(srv)
         srv.start()
         return _Node(srv, sink, checkpoint_dir=ckpt_dir,
                      grpc_port=srv.grpc_import.port)
@@ -192,6 +209,8 @@ class Cluster:
             hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
+        if self.telemetry is not None:
+            self.telemetry.install_server(srv)
         srv.start()
         _, addr = srv.statsd_addrs[0]
         tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -222,6 +241,8 @@ class Cluster:
             if self.witness is not None:
                 from veneur_tpu.analysis import witness as witness_mod
                 witness_mod.install_proxy(self.proxy, self.witness)
+            if self.telemetry is not None:
+                self.telemetry.install_proxy(self.proxy)
             self.proxy.start()
         for i in range(spec.n_locals):
             self.locals.append(
@@ -320,6 +341,10 @@ class Cluster:
         if not self._started:
             return
         self._started = False
+        if self.telemetry is not None:
+            # final /debug/vars snapshot of every live tier BEFORE
+            # teardown — the ledger-closure comparison reads these
+            self.telemetry.collect()
         if self.http is not None:
             self.http.stop()
         for n in self.locals:

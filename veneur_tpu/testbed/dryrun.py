@@ -26,8 +26,8 @@ from veneur_tpu.testbed.traffic import TrafficGen
 PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
-    "routing_exclusive", "chaos_matrix", "lock_witness", "trace",
-    "spool", "checkpoint", "egress", "ok",
+    "routing_exclusive", "chaos_matrix", "lock_witness", "telemetry",
+    "trace", "spool", "checkpoint", "egress", "ok",
 ]
 
 
@@ -40,12 +40,19 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                cardinality_key_budget: int = 0,
                chaos: str | None = None,
                lock_witness: bool = False,
-               trace: bool = False) -> dict:
+               trace: bool = False,
+               telemetry: bool = False) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
     With `lock_witness`, every tier's named locks record runtime
     acquisition-order edges (shared across the chaos arms too) and the
     report carries the static-vs-observed comparison — an observed
     edge the static lock-order graph lacks fails the run.
+
+    With `telemetry`, every tier's statsd client records the series it
+    emits and /debug/vars is snapshotted at teardown; the report
+    carries the schema comparison (analysis/telemetry.py) — an
+    observed series or vars key the committed schema lacks, or an
+    unclosed runtime ledger, fails the run.
 
     Trace assembly always runs (the span plane is always on) and the
     report always carries the `trace` keys; `trace=True` additionally
@@ -57,11 +64,16 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     if lock_witness:
         from veneur_tpu.analysis.witness import LockWitness
         witness = LockWitness()
+    telemetry_witness = None
+    if telemetry:
+        from veneur_tpu.analysis.telemetry import TelemetryWitness
+        telemetry_witness = TelemetryWitness()
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        interval_s=interval_s, mesh_devices=mesh_devices,
                        percentiles=tuple(percentiles),
                        cardinality_key_budget=cardinality_key_budget,
-                       lock_witness=witness)
+                       lock_witness=witness,
+                       telemetry=telemetry_witness)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -100,19 +112,25 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         for arm in arms:
             chaos_rows.append(run_chaos_arm(arm, seed=seed,
                                             witness=witness,
-                                            trace=trace))
+                                            trace=trace,
+                                            telemetry=telemetry_witness))
     elif trace:
         # the acceptance arms: context must survive forward retries and
         # a live ring reshard without duplicate delivered edges
         for arm_name in ("forward-drop", "ring-scale-up"):
             chaos_rows.append(run_chaos_arm(arm_by_name(arm_name),
                                             seed=seed, witness=witness,
-                                            trace=True))
+                                            trace=True,
+                                            telemetry=telemetry_witness))
 
     witness_cmp = None
     if witness is not None:
         from veneur_tpu.testbed.chaos import witness_comparison
         witness_cmp = witness_comparison(witness)
+    telemetry_cmp = None
+    if telemetry_witness is not None:
+        from veneur_tpu.testbed.chaos import telemetry_comparison
+        telemetry_cmp = telemetry_comparison(telemetry_witness)
 
     trace_ok = (trace_report["complete"]
                 and trace_report["orphans"] == 0
@@ -121,7 +139,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
           and routing["exclusive"]
           and all(r["ok"] for r in chaos_rows)
           and (not trace or trace_ok)
-          and (witness_cmp is None or witness_cmp["ok"]))
+          and (witness_cmp is None or witness_cmp["ok"])
+          and (telemetry_cmp is None or telemetry_cmp["ok"]))
     return {
         "spec": {
             "n_locals": n_locals, "n_globals": n_globals,
@@ -184,6 +203,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         "routing_exclusive": routing["exclusive"],
         "chaos_matrix": chaos_rows,
         "lock_witness": witness_cmp,
+        # telemetry-schema cross-validation (analysis/telemetry.py):
+        # observed-series/vars gaps vs the static schema + runtime
+        # ledger closures; None unless the run was telemetry-witnessed
+        "telemetry": telemetry_cmp,
         # trace.{complete,orphans,critical_path_ms} + timeline_linked:
         # the per-interval critical-path table from the cross-tier
         # assembler; gates ok only when trace=True was requested
