@@ -349,6 +349,84 @@ def bench_device_scale() -> tuple[float, int] | None:
     return p99, n
 
 
+def bench_moments_merge() -> dict:
+    """Sketch-family comparison arm (ROADMAP #3 acceptance): the two
+    histogram flush paths — t-digest (bitonic sort network + quantile
+    tail) vs moments (segmented-sum merge kernel + batched maxent
+    solver) — timed DEVICE-ONLY on identical resident ``[U, D]`` dense
+    staged-sample inputs at the 100k and 1M key shapes (1M TPU-only;
+    the CPU-XLA twin compiles minutes for no signal).  Depth models
+    the global-tier MERGE regime (8 locals x 32 forwarded points per
+    key), which is where the no-sort roofline argument bites.
+
+    Emits per-shape p50s plus the headline ``moments_merge_p50_ms`` /
+    ``moments_vs_tdigest_speedup`` (largest shape measured)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import moments_eval
+    from veneur_tpu.parallel import serving
+    from veneur_tpu.sketches import moments as mo
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    depth = 256                      # 8 locals x 32 points/key
+    shapes = [(100_000 if on_tpu else 16_384, depth)]
+    if on_tpu:
+        shapes.append((1_000_000, depth))
+    flush = serving.make_serving_flush(None)
+    mfn = moments_eval.make_moments_flush()
+    pct = jnp.asarray(np.asarray(PERCENTILES), jnp.float32)
+    rng = np.random.default_rng(7)
+    out: dict = {}
+    rounds, pipeline = 3, (20 if on_tpu else 3)
+    for u, d in shapes:
+        u_pad = 1 << (u - 1).bit_length()
+        dv = rng.gamma(2.0, 10.0, (u_pad, d)).astype(np.float32)
+        dep = np.full(u_pad, d, np.int16)
+        a, b = dv.min(axis=1), dv.max(axis=1)
+        la, lb = mo.log_domain(a.astype(np.float64),
+                               b.astype(np.float64))
+        dev = jax.devices()[0]
+        dvd = jax.device_put(dv, dev)
+        depd = jax.device_put(dep, dev)
+        abd = jax.device_put(np.stack([a, b]).astype(np.float32), dev)
+        labd = jax.device_put(
+            np.stack([la, lb]).astype(np.float32), dev)
+        impd = jax.device_put(
+            np.zeros((u_pad, 2 * (mo.DEFAULT_K + 1)), np.float32), dev)
+
+        def run_td():
+            return float(np.asarray(
+                flush.depth_variant(dvd, depd, pct))[0, 0])
+
+        def run_mo():
+            return float(np.asarray(mfn.depth_variant(
+                dvd, depd, abd, labd, impd, pct))[0, 0])
+
+        per = {}
+        for name, fn in (("tdigest", run_td), ("moments", run_mo)):
+            fn()                           # compile + first run
+            lat = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                for _ in range(pipeline):
+                    fn()
+                lat.append((time.perf_counter() - t0) * 1e3
+                           / pipeline)
+            per[name] = float(np.percentile(lat, 50))
+        tag = f"{u // 1000}k" if u < 1_000_000 else "1m"
+        out[f"tdigest_{tag}_p50_ms"] = round(per["tdigest"], 3)
+        out[f"moments_{tag}_p50_ms"] = round(per["moments"], 3)
+        out[f"speedup_{tag}"] = round(
+            per["tdigest"] / max(per["moments"], 1e-9), 2)
+        log(f"moments arm [{u_pad}x{d}]: tdigest "
+            f"{per['tdigest']:.2f}ms moments {per['moments']:.2f}ms "
+            f"= {out[f'speedup_{tag}']}x")
+        out["moments_merge_p50_ms"] = out[f"moments_{tag}_p50_ms"]
+        out["moments_vs_tdigest_speedup"] = out[f"speedup_{tag}"]
+    return out
+
+
 def bench_kernel_stages() -> dict:
     """Per-stage decomposition of the flush evaluation — the
     `kernel_stage_ms` breakdown BASELINE.md promises (cumulative
@@ -1291,6 +1369,18 @@ def main() -> None:
     except Exception as e:
         log(f"kernel-stage arm failed: {e}")
         result["kernel_stage_ms"] = {"error": str(e)[:200]}
+    # sketch-family comparison (ISSUE-13 acceptance: the moments merge
+    # path beats the t-digest sort path at the 1M-key merge shape).
+    # Promised keys: error values on arm failure, like kernel_stage_ms.
+    try:
+        fam = bench_moments_merge()
+        result.update({k: fam[k] for k in ("moments_merge_p50_ms",
+                                           "moments_vs_tdigest_speedup")})
+        result["sketch_family_ms"] = fam
+    except Exception as e:
+        log(f"moments arm failed: {e}")
+        result["moments_merge_p50_ms"] = {"error": str(e)[:200]}
+        result["moments_vs_tdigest_speedup"] = {"error": str(e)[:200]}
     # self-tracing cost (ISSUE-9 acceptance: <1% on flush p50/p99 with
     # the sampler at 1.0).  Promised key: present as an error value if
     # the arm fails, like kernel_stage_ms.
@@ -1403,7 +1493,8 @@ def main() -> None:
                 "hbm_roofline_frac", "weighted_p99",
                 "weighted_dev_only_p50", "kernel_stage_ms",
                 "trace_overhead_pct", "checkpoint_overhead_pct",
-                "egress_overhead_pct"]
+                "egress_overhead_pct", "moments_merge_p50_ms",
+                "moments_vs_tdigest_speedup"]
     if "mesh_scaling_per_device_work_ms" in result:
         promised += ["mesh_scaling_e2e_ms", "mesh_scaling_segments_ms"]
     if "ingest_udp_pkts_per_sec" in result:

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """One-command repo gate: vnlint -> native sanitizer smoke -> reshard,
-crash and egress chaos cells -> tier-1 pytest.  Nonzero exit on ANY
-unsuppressed lint finding, sanitizer report, failed chaos cell, or
-test failure — the local equivalent of a CI required check.
+crash and egress chaos cells -> mixed-family dryrun -> tier-1 pytest.
+Nonzero exit on ANY unsuppressed lint finding, sanitizer report,
+failed chaos cell, failed mixed-family conservation, or test failure —
+the local equivalent of a CI required check.
 
     python scripts/check.py              # the full gate
     python scripts/check.py --fast      # vnlint + sanitizer smoke only
@@ -153,6 +154,28 @@ def main() -> int:
                         "PASS" if egress_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3d. the mixed-family dryrun cell (ISSUE 13): both sketch
+    # families live in one 3-tier cluster — tb.mh* keys route to the
+    # moments arenas via sketch_family_rules, forward as wire moments
+    # vectors, and merge exactly at the global tier.  Gates: EXACT
+    # histogram count conservation for every key of both families,
+    # plus each family's percentile emissions inside ITS committed
+    # envelope (analysis/tdigest_accuracy.csv family column)
+    mixed_rc = 0
+    if args.fast:
+        results.append(("mixed-family dryrun", "SKIP", 0.0))
+    else:
+        t0 = stage("mixed-family dryrun (tdigest + moments)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        mixed_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py",
+             "--locals", "2", "--moments-keys", "2",
+             "--histo-keys", "2", "--intervals", "2"],
+            env=env)
+        results.append(("mixed-family dryrun",
+                        "PASS" if mixed_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -172,7 +195,7 @@ def main() -> int:
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
-               or egress_rc or test_rc) else 0
+               or egress_rc or mixed_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

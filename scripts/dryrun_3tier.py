@@ -51,6 +51,12 @@ def main(argv=None) -> int:
     ap.add_argument("--cardinality-budget", type=int, default=0,
                     help="per-tenant key budget on the local tier "
                     "(0 = cardinality defense off)")
+    ap.add_argument("--moments-keys", type=int, default=0,
+                    help="moments-family histogram keys per interval "
+                    "(tb.mh*, routed by sketch_family_rules on every "
+                    "tier): >0 makes this a MIXED-FAMILY dryrun — "
+                    "exact count conservation and the per-family "
+                    "percentile envelopes both gate the run")
     ap.add_argument("--chaos", default=None,
                     help="chaos arm name, or 'all' for the full matrix")
     ap.add_argument("--chaos-only", default=None, metavar="ARM",
@@ -148,6 +154,7 @@ def main(argv=None) -> int:
         set_keys=args.set_keys, histo_samples=args.histo_samples,
         interval_s=args.interval_s,
         cardinality_key_budget=args.cardinality_budget,
+        moments_histo_keys=args.moments_keys,
         chaos=args.chaos, lock_witness=args.lock_witness,
         trace=args.trace, telemetry=args.telemetry)
 
@@ -169,6 +176,13 @@ def main(argv=None) -> int:
     tr = report["trace"]
     tail = (f"; {tr['intervals']} interval trace(s) complete, "
             f"{tr['orphans']} orphans" if args.trace else "")
+    if args.moments_keys:
+        sf = report["sketch_families"]
+        tail += ("; mixed-family: "
+                 f"{sf['histo_keys_by_family']} keys, counts "
+                 f"{'EXACT' if sf['histo_counts_exact'] else 'LOST'}, "
+                 f"quantiles checked "
+                 f"{sf['quantiles_checked_by_family']}")
     print(f"# 3-tier dryrun OK: {report['forwarded']} forwarded, "
           f"{report['imported']} imported, {report['retried']} retried, "
           f"{report['dropped']} dropped; "

@@ -14,6 +14,10 @@ per-call RTT amortizes out:
   depth      the depth-vector (uniform) kernel, f32      -> key-only network
   depth_bf16 the depth-vector kernel on bf16 staging     -> 16-bit keys
   xla        the lax.sort twin (td.weighted_eval)        -> XLA comparison
+  moments    the moments-family flush (segmented-sum     -> the OTHER
+             merge kernel + maxent solver,                  compute class
+             ops/moments_eval.py depth variant)             (ROADMAP #3)
+  moments_sums the merge kernel alone (no solver)        -> merge roofline
 
 Usage: python scripts/profile_flush_kernel.py [K] [D] [pipeline] [rounds]
        [modes]
@@ -61,6 +65,25 @@ def run_variant(mode: str, mean, weight, minmax, qs, tile: int):
     if mode == "xla":
         return td.weighted_eval(mean, weight, minmax[:, 0], minmax[:, 1],
                                 qs[0])
+    if mode in ("moments", "moments_sums"):
+        from veneur_tpu.ops import moments_eval as me
+        from veneur_tpu.sketches import moments as mo
+        depths = jnp.full((u,), d, jnp.int16)
+        a = minmax[:, 0]
+        b = minmax[:, 1]
+        # traced log_domain twin (the host helper is numpy)
+        ok = a > 0
+        la = jnp.where(ok, jnp.log(jnp.where(ok, a, 1.0)), 0.0)
+        lb = jnp.where(ok, jnp.log(jnp.where(ok, jnp.maximum(b, a),
+                                             1.0)), -1.0)
+        ab = jnp.stack([a, b]).astype(jnp.float32)
+        lab = jnp.stack([la, lb]).astype(jnp.float32)
+        if mode == "moments_sums":
+            return me.moments_sums(mean, depths, ab, lab,
+                                   mo.DEFAULT_K, True)
+        imp = jnp.zeros((u, 2 * (mo.DEFAULT_K + 1)), jnp.float32)
+        fn = me.make_moments_flush()
+        return fn.depth_variant(mean, depths, ab, lab, imp, qs[0])
     # cumulative stage cuts shared with bench.bench_kernel_stages:
     # built from the production stage functions (sorted_eval
     # stage_slice_kernel), so they cannot drift from the kernel
@@ -104,6 +127,8 @@ def main():
             return k * d * 4 + k * 4          # f32 values + i32 depths
         if mode == "depth_bf16":
             return k * d * 2 + k * 4          # bf16 values + i32 depths
+        if mode in ("moments", "moments_sums"):
+            return k * d * 4 + k * 2          # f32 values + i16 depths
         return 2 * k * d * 4                  # both [K, D] f32 operands
 
     modes = (sys.argv[5].split(",") if len(sys.argv) > 5

@@ -1,10 +1,11 @@
-"""Statistical deep-analysis harness for the batched t-digest kernels.
+"""Statistical deep-analysis harness for the batched sketch kernels.
 
 The analog of the reference's `tdigest/analysis/` tooling (CSV dumps of
 quantile error mirroring Dunning's upstream tests, consumed by R plots):
 sweeps distributions x sample sizes x quantiles and emits one CSV row
-per cell with the observed error of
+per cell PER SKETCH FAMILY with the observed error of
 
+  family=tdigest
   * the batched parallel kernel (sketches/tdigest.py: sort -> prefix-sum
     -> arcsine bucket -> segmented reduce),
   * the sequential reference-faithful yardstick
@@ -12,9 +13,22 @@ per cell with the observed error of
   * the flush-path uncompressed point-cloud evaluation
     (td.weighted_eval — what the serving flush actually reports),
 
+  family=moments
+  * the moments sketch + maxent solver (sketches/moments.py +
+    ops/moments_eval.py — the serving flush's moments path), in both
+    the whole-data and the split-merge (two half sketches, rebased
+    elementwise merge) arms — the columns map parallel_* -> merged
+    sketch, flush_* -> single sketch, sequential_* -> single sketch,
+
 against exact numpy quantiles, plus the structural invariants the
-reference CI enforces (centroid count <= ceil(pi*delta/2), exact weight
-conservation, merge-order invariance).
+reference CI enforces (centroid count <= ceil(pi*delta/2), exact
+weight conservation, merge-order invariance; for moments: exact count
+conservation under merge and bounded solver residuals).
+
+The committed CSV (analysis/tdigest_accuracy.csv) is the testbed
+oracle's PER-FAMILY accuracy envelope (testbed/verify.py): each
+family's flush-path worst case per quantile, widened by a safety
+factor, is what mixed-family dryruns gate on.
 
 Usage: python scripts/tdigest_analysis.py [out.csv]   (default stdout)
 """
@@ -55,10 +69,12 @@ def main() -> None:
     from veneur_tpu.sketches import tdigest as td
     from veneur_tpu.sketches.tdigest_cpu import SequentialDigest
 
+    from veneur_tpu.sketches.moments import MomentsSketch
+
     out = (open(sys.argv[1], "w", newline="")
            if len(sys.argv) > 1 else sys.stdout)
     w = csv.writer(out)
-    w.writerow(["distribution", "n", "q", "exact",
+    w.writerow(["family", "distribution", "n", "q", "exact",
                 "parallel_q", "parallel_err_q",
                 "sequential_q", "sequential_err_q",
                 "flush_eval_q", "flush_err_q",
@@ -70,10 +86,39 @@ def main() -> None:
     bound = math.ceil(math.pi * compression / 2)
     qs = [0.001, 0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 0.999]
 
+    # moments rows additionally sweep n=200: the moments envelope is
+    # what testbed-scale intervals (a few hundred samples per key) gate
+    # on, and small-n maxent error is the family's worst regime — the
+    # committed evidence must cover it, not hide it
     for dist_name, gen in distributions(rng).items():
-        for n in (1_000, 10_000, 100_000):
+        for n in (200, 1_000, 10_000, 100_000):
             data = np.asarray(gen(n), np.float64)
             exact = np.quantile(data, qs, method="hazen")
+            span = float(exact[-1] - exact[0]) or 1.0
+
+            # moments family: single sketch (the flush path) and a
+            # split-merge pair (the cross-tier rebased merge)
+            msk = MomentsSketch()
+            msk.add_batch(data)
+            half_a, half_b = MomentsSketch(), MomentsSketch()
+            half_a.add_batch(data[: n // 2])
+            half_b.add_batch(data[n // 2:])
+            half_a.merge(half_b)
+            assert half_a.count == n, (dist_name, n)  # exact merge
+            m_single = msk.quantiles(qs)
+            m_merged = half_a.quantiles(qs)
+            for i, q in enumerate(qs):
+                w.writerow([
+                    "moments", dist_name, n, q, f"{exact[i]:.6g}",
+                    f"{m_merged[i]:.6g}",
+                    f"{abs(m_merged[i] - exact[i]) / span:.3e}",
+                    f"{m_single[i]:.6g}",
+                    f"{abs(m_single[i] - exact[i]) / span:.3e}",
+                    f"{m_single[i]:.6g}",
+                    f"{abs(m_single[i] - exact[i]) / span:.3e}",
+                    len(msk.vec), len(msk.vec), True])
+            if n == 200:
+                continue   # t-digest dossier keeps its historical grid
 
             # parallel batched kernel (K=1 row)
             dig = td.MergingDigest(compression)
@@ -99,13 +144,12 @@ def main() -> None:
                 jnp.asarray([data.max()], jnp.float32),
                 jnp.asarray(qs, jnp.float32)))[0]
 
-            span = float(exact[-1] - exact[0]) or 1.0
             for i, q in enumerate(qs):
                 pq = dig.quantile(q)
                 sq = seq.quantile(q)
                 fq = float(ev[i])
                 w.writerow([
-                    dist_name, n, q, f"{exact[i]:.6g}",
+                    "tdigest", dist_name, n, q, f"{exact[i]:.6g}",
                     f"{pq:.6g}", f"{abs(pq - exact[i]) / span:.3e}",
                     f"{sq:.6g}", f"{abs(sq - exact[i]) / span:.3e}",
                     f"{fq:.6g}", f"{abs(fq - exact[i]) / span:.3e}",

@@ -508,6 +508,54 @@ def test_prewarm_static_kwarg_mismatch_fires(tmp_path):
     assert len(hits) == 1 and "uniform" in hits[0].message
 
 
+MOMENTS_PREWARM = """
+import jax
+import numpy as np
+
+
+class Agg:
+    def prewarm(self):
+        m_dv = jax.ShapeDtypeStruct((8, 8), np.float32)
+        m_ab = jax.ShapeDtypeStruct((2, 8), np.float32)
+        m_dep = jax.ShapeDtypeStruct((8,), np.int32)  # BUG: live is i16
+        mg = self.moments_fn.lower
+        mg(m_dv, m_ab).compile()
+        md = self.moments_fn.depth_variant
+        md.lower(m_dv, m_dep).compile()
+
+    def dispatch(self, dv, dep, ab, uniform):
+        dvd = dv.astype(np.float32)
+        abd = ab.astype(np.float32)
+        depd = dep.astype(np.int16)
+        if uniform:
+            return self.moments_fn.depth_variant(dvd, depd)
+        return self.moments_fn(dvd, abd)
+"""
+
+MOMENTS_PREWARM_FIXED = MOMENTS_PREWARM.replace(
+    "jax.ShapeDtypeStruct((8,), np.int32)  # BUG: live is i16",
+    "jax.ShapeDtypeStruct((8,), np.int16)")
+
+
+def test_prewarm_covers_moments_flush_program(tmp_path):
+    """The moments-family flush program (ISSUE 13): prewarm lowers BOTH
+    variants (general + depth) through `moments_fn` attributes; a
+    depth-vector struct in the wrong dtype fires exactly like the
+    historical digest weight-struct bug, and the corrected form is
+    quiet — the rule covers both sketch families' programs."""
+    report = lint_source(tmp_path, MOMENTS_PREWARM)
+    hits = [f for f in report.findings if f.rule == "prewarm-parity"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "int32" in hits[0].message and "int16" in hits[0].message
+    assert "moments_fn.depth_variant" in hits[0].message
+
+
+def test_prewarm_moments_corrected_form_is_quiet(tmp_path):
+    report = lint_source(tmp_path, MOMENTS_PREWARM_FIXED)
+    assert "prewarm-parity" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
 def test_prewarm_donated_alias_matches_live_twin(tmp_path):
     """The production alias shape: prewarm lowers through the donated
     twin, live launches pick either — same canonical callable, no

@@ -146,6 +146,23 @@ class Config:
     percentiles: list[float] = field(default_factory=list)
     aggregates: list[str] = field(default_factory=lambda: ["min", "max", "count"])
     tdigest_compression: float = 100.0
+    # sketch-family dispatch (core/aggregator.py): per-key choice of
+    # the histogram/timer sketch — "tdigest" (default; centroid sets,
+    # sort-network flush) or "moments" (fixed-size moment vectors,
+    # dense segmented-sum flush + maxent solver — a fundamentally
+    # cheaper merge for high-cardinality/low-accuracy tiers; error
+    # envelopes per family are committed in
+    # analysis/tdigest_accuracy.csv).  Rules match at ingest, first
+    # hit wins; each entry is {match: <name glob>, family: ...} or
+    # {tenant: <tenant-tag value>, family: ...}.  Imports route by the
+    # wire payload itself, so tiers with different rules still merge
+    # every sketch into its own family.  Single-device tiers only.
+    sketch_family_default: str = "tdigest"
+    sketch_family_rules: list = field(default_factory=list)
+    # power-sum order k of the moments vector (6 + 2k doubles per key;
+    # every tier of a fleet must agree — vectors of different k refuse
+    # to merge)
+    sketch_moments_k: int = 8
     set_precision: int = 14
     # evaluate t-digest flush quantiles in float64 (the reference's
     # merging_digest.go float64 semantics): keeps integer exactness for
@@ -181,6 +198,13 @@ class Config:
     cardinality_key_budget: int = 0
     cardinality_tenant_tag: str = "tenant"
     cardinality_seed: int = 0
+    # sketch family of the guard's histogram/timer tail rollups:
+    # "moments" folds an over-budget tenant's tail into one moments
+    # vector per (tenant, type) instead of a t-digest — same exact
+    # cross-tier count/sum conservation, fixed-size state, and the
+    # merge stays elementwise at every tier (the guard is the first
+    # production consumer of the family dispatch)
+    cardinality_rollup_family: str = "tdigest"
     # rolling-upgrade migration lane for sets: merge legacy 'VH'
     # (blake2b-hashed) HLL imports into a side lane and emit
     # max(primary, legacy) instead of hash-mixing the registers (which
@@ -365,6 +389,35 @@ class Config:
             raise ValueError(
                 "digest_bf16_staging is unsupported with a device mesh "
                 "(the meshed flush program is f32-native); drop one")
+        for fam in (self.sketch_family_default,
+                    self.cardinality_rollup_family):
+            if fam not in ("tdigest", "moments"):
+                raise ValueError(
+                    f"unknown sketch family {fam!r} "
+                    "(tdigest | moments)")
+        for rule in self.sketch_family_rules:
+            if not isinstance(rule, dict) \
+                    or rule.get("family", "moments") not in ("tdigest",
+                                                             "moments") \
+                    or not (rule.get("match") or rule.get("tenant")):
+                raise ValueError(
+                    f"bad sketch_family rule {rule!r}: need "
+                    "{match: <glob> | tenant: <t>, family: "
+                    "tdigest|moments}")
+        if self.sketch_moments_k < 2 or self.sketch_moments_k > 16:
+            raise ValueError(
+                f"sketch_moments_k {self.sketch_moments_k} out of "
+                "range [2, 16] (the maxent solve conditions past 16)")
+        family_dispatch = (self.sketch_family_rules
+                           or self.sketch_family_default == "moments"
+                           or (self.cardinality_rollup_family
+                               == "moments"
+                               and self.cardinality_key_budget > 0))
+        if family_dispatch and self.mesh_devices:
+            raise ValueError(
+                "sketch_family_* dispatch is unsupported with a device "
+                "mesh (mesh_devices > 0): the moments flush program is "
+                "single-device — drop one")
         if self.digest_float64 and self.mesh_devices:
             # config-level rejection (not a deep aggregator error): the
             # meshed flush program is f32-native — hi/lo counter planes,

@@ -110,7 +110,11 @@ class MetricAggregator:
                  flush_presharded_staging: bool = True,
                  cardinality_key_budget: int = 0,
                  cardinality_tenant_tag: str = "tenant",
-                 cardinality_seed: int = 0):
+                 cardinality_seed: int = 0,
+                 sketch_family_default: str = "tdigest",
+                 sketch_family_rules: Optional[list] = None,
+                 sketch_moments_k: int = 0,
+                 cardinality_rollup_family: str = "tdigest"):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -165,6 +169,53 @@ class MetricAggregator:
             bf16_staging=digest_bf16_staging,
             presharded_staging=flush_presharded_staging,
             **kw)
+        # sketch-family dispatch (ROADMAP #3): per-key choice of
+        # tdigest vs moments for histogram/timer samples.  Rules match
+        # at ingest (first hit wins: name glob or tenant tag); imports
+        # route by the PAYLOAD (a moments vector merges into the
+        # moments arena whatever the local rules say — wire
+        # self-description beats configuration, so a rules mismatch
+        # across tiers degrades to per-tier family choice instead of
+        # corrupting either sketch).  The moments arena always exists
+        # (imports may deliver vectors regardless of local rules); the
+        # dispatch fast path is one bool when no rule can ever fire.
+        for fam in (sketch_family_default, cardinality_rollup_family):
+            if fam not in ("tdigest", "moments"):
+                raise ValueError(
+                    f"unknown sketch family {fam!r} "
+                    "(tdigest | moments)")
+        self._fam_default_moments = sketch_family_default == "moments"
+        self._rollup_moments = cardinality_rollup_family == "moments"
+        self._fam_rules = []
+        for r in (sketch_family_rules or []):
+            fam = r.get("family", "moments")
+            if fam not in ("tdigest", "moments"):
+                raise ValueError(
+                    f"unknown sketch family {fam!r} in rule {r!r}")
+            if not (r.get("match") or r.get("tenant")):
+                raise ValueError(
+                    f"sketch_family rule needs match: or tenant:, "
+                    f"got {r!r}")
+            self._fam_rules.append((r.get("match"), r.get("tenant"),
+                                    fam == "moments"))
+        self.family_dispatch = bool(
+            self._fam_rules or self._fam_default_moments
+            or (self._rollup_moments and cardinality_key_budget > 0))
+        if self.family_dispatch and mesh is not None:
+            raise ValueError(
+                "sketch_family_* dispatch is unsupported with a "
+                "device mesh (the moments flush program is "
+                "single-device); drop one")
+        self._fam_cache: dict = {}
+        # pre-size only when the dispatch can actually route keys here
+        # (the ivec plane is f64 and capacity-sized)
+        self.moments = arena_mod.MomentsArena(
+            k=sketch_moments_k, mesh=None,
+            **(kw if self.family_dispatch else {}))
+        from veneur_tpu.ops import moments_eval
+        self.moments_fn = moments_eval.make_moments_flush(
+            self.moments.k)
+        self.last_moments_resid = 0.0
         self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
                                        legacy_migration=hll_legacy_migration,
                                        **set_kw)
@@ -244,6 +295,50 @@ class MetricAggregator:
         rolled = g.resolve(key, scope, tags, n)
         return (key, scope, tags) if rolled is None else rolled
 
+    # -- sketch-family dispatch (ROADMAP #3) -------------------------------
+
+    _FAM_CACHE_CAP = 65536
+
+    def _family_is_moments(self, key: MetricKey, tags) -> bool:
+        """Family choice for one histogram/timer key: rollup identities
+        follow cardinality_rollup_family, then the first matching rule
+        (name glob / tenant tag), then the default.  Memoized on the
+        key identity (bounded; a cardinality storm of fresh identities
+        falls back to uncached evaluation instead of growing the
+        memo)."""
+        ck = (key.name, key.joined_tags)
+        hit = self._fam_cache.get(ck)
+        if hit is not None:
+            return hit
+        from veneur_tpu.core.cardinality import ROLLUP_TAG
+        if ROLLUP_TAG in tags:
+            fam = self._rollup_moments
+        else:
+            fam = self._fam_default_moments
+            import fnmatch
+            for pattern, tenant, is_moments in self._fam_rules:
+                if pattern is not None:
+                    if fnmatch.fnmatchcase(key.name, pattern):
+                        fam = is_moments
+                        break
+                elif tenant is not None:
+                    if f"tenant:{tenant}" in tags:
+                        fam = is_moments
+                        break
+        if len(self._fam_cache) < self._FAM_CACHE_CAP:
+            self._fam_cache[ck] = fam
+        return fam
+
+    def _histo_arena(self, key: MetricKey, tags):
+        """The arena a histogram/timer key's RAW SAMPLES land in (call
+        after _card_resolve, so rollup identities route by the rollup
+        family).  Imports do NOT come through here — a wire payload is
+        self-describing (digest centroids vs moments vector)."""
+        if not self.family_dispatch:
+            return self.digests
+        return (self.moments if self._family_is_moments(key, tags)
+                else self.digests)
+
     def _process_locked(self, m: UDPMetric) -> None:
         self.processed += 1
         if self.unique_ts is not None:
@@ -265,8 +360,9 @@ class MetricAggregator:
             self.gauges.sample(row, m.value)
         elif t in (sm.TYPE_HISTOGRAM, sm.TYPE_TIMER):
             key, scope, tags = self._card_resolve(m.key, m.scope, m.tags)
-            row = self.digests.row_for(key, scope, tags)
-            self.digests.sample(row, m.value, m.sample_rate)
+            arena = self._histo_arena(key, tags)
+            row = arena.row_for(key, scope, tags)
+            arena.sample(row, m.value, m.sample_rate)
         elif t == sm.TYPE_SET:
             scope = (MetricScope.LOCAL_ONLY
                      if m.scope == MetricScope.LOCAL_ONLY
@@ -328,10 +424,22 @@ class MetricAggregator:
                        if scope == MetricScope.GLOBAL_ONLY
                        else MetricScope.MIXED)
                 key, cls, tags = self._card_resolve(key, cls, fm.tags)
-                row = self.digests.row_for(key, cls, tags)
-                self.digests.merge_digest(
-                    row, fm.digest_means or [], fm.digest_weights or [],
-                    fm.digest_min, fm.digest_max, fm.digest_rsum)
+                if fm.moments is not None:
+                    # payload self-description wins: a moments vector
+                    # merges exactly into the moments arena whatever
+                    # this tier's own dispatch rules say
+                    row = self.moments.row_for(key, cls, tags)
+                    # vnlint: disable=blocking-propagation (the
+                    #   flagged asarray converts the WIRE vector — a
+                    #   host list off the protobuf — never a device
+                    #   array; merge_moments is pure host numpy)
+                    self.moments.merge_moments(row, fm.moments)
+                else:
+                    row = self.digests.row_for(key, cls, tags)
+                    self.digests.merge_digest(
+                        row, fm.digest_means or [],
+                        fm.digest_weights or [],
+                        fm.digest_min, fm.digest_max, fm.digest_rsum)
             else:
                 raise ValueError(f"unknown metric kind {fm.kind!r}")
 
@@ -419,6 +527,9 @@ class MetricAggregator:
                         g_rows.append(row)
                         g_vals.append(pb.gauge.value)
                     elif which in ("set", "histogram"):
+                        # vnlint: disable=blocking-propagation (the
+                        #   moments branch's asarray converts wire
+                        #   vectors — host lists, no device wait)
                         self._import_slow_pb(pb, which)
                     else:
                         raise ValueError("nil or unknown value")
@@ -460,6 +571,13 @@ class MetricAggregator:
         key, cls, tags = self._card_resolve(
             MetricKey(pb.name, kind, joined), cls, tags)
         dig = pb.histogram.t_digest
+        if dig.compression < 0:
+            # moments-family wire marker (forward/convert.py): the
+            # centroid means ARE the f64 moments vector
+            row = self.moments.row_for(key, cls, tags)
+            self.moments.merge_moments(
+                row, [c.mean for c in dig.main_centroids])
+            return
         row = self.digests.row_for(key, cls, tags)
         self.digests.merge_digest(
             row,
@@ -560,6 +678,9 @@ class MetricAggregator:
                     try:
                         pb = metric_pb2.Metric.FromString(
                             payload[offs[i]:offs[i] + lens[i]])
+                        # vnlint: disable=blocking-propagation (the
+                        #   moments branch's asarray converts wire
+                        #   vectors — host lists, no device wait)
                         self._import_slow_pb(
                             pb, "set" if w == 3 else "histogram")
                         ok += 1
@@ -590,6 +711,7 @@ class MetricAggregator:
                 # to amortize the fixed numpy overheads
                 min_samples = 4096
             if (self.digests.staged_count()
+                    + self.moments.staged_count()
                     + self.sets.staged_count() < min_samples):
                 return False
             # vnlint: disable=blocking-propagation (arena sync IS the
@@ -599,12 +721,15 @@ class MetricAggregator:
             self.digests.sync()
             # vnlint: disable=blocking-propagation (same as above:
             #   host staging consolidation, no device wait)
+            self.moments.sync()
+            # vnlint: disable=blocking-propagation (same as above)
             self.sets.sync()
             return True
 
     # -- crash checkpoint (core/checkpoint.py) -----------------------------
 
-    _FAMILIES = ("digests", "sets", "counters", "gauges", "status")
+    _FAMILIES = ("digests", "moments", "sets", "counters", "gauges",
+                 "status")
 
     def checkpoint_state(self) -> tuple[dict, dict]:
         """One coherent cut of every arena (plus unique-ts registers and
@@ -617,6 +742,8 @@ class MetricAggregator:
             #   host-side COO consolidation — asarray of host lists,
             #   no device wait; same rationale as sync_staged)
             self.digests.sync()
+            # vnlint: disable=blocking-propagation (same as above)
+            self.moments.sync()
             # vnlint: disable=blocking-propagation (same as above)
             self.sets.sync()
             meta: dict = {"processed": self.processed,
@@ -652,6 +779,8 @@ class MetricAggregator:
         with self.lock:
             per_family = {}
             for name in self._FAMILIES:
+                if name not in meta["families"]:
+                    continue   # pre-family checkpoint: cold start it
                 fmeta = meta["families"][name]
                 prefix = f"{name}/"
                 farr = {k[len(prefix):]: v for k, v in arrays.items()
@@ -713,6 +842,7 @@ class MetricAggregator:
         # flush timeline (and the flush.* self-metric gauges) can relate
         # segment times to interval size
         seg["keys_digest"] = len(snap["digests"]["rows"])
+        seg["keys_moments"] = len(snap["moments"]["rows"])
         seg["keys_counter"] = len(snap["counters"]["rows"])
         seg["keys_set"] = len(snap["sets"]["rows"])
 
@@ -730,6 +860,7 @@ class MetricAggregator:
         multi_mesh = self.mesh is not None and jax.process_count() > 1
         idle = (not multi_mesh
                 and len(snap["digests"]["rows"]) == 0
+                and len(snap["moments"]["rows"]) == 0
                 and len(snap["sets"]["rows"]) == 0
                 and len(snap["counters"]["rows"]) == 0
                 and (not snap["have_uts"]
@@ -772,6 +903,12 @@ class MetricAggregator:
         self._emit_status(res, snap, now)
         self._emit_sets(res, snap, host, is_local, now)
         self._emit_digests(res, snap, host, is_local, now)
+        self._emit_moments(res, snap, host, is_local, now)
+        if "m_resid" in host and len(host["m_resid"]):
+            # solver-convergence observability (sketch.* self-metrics)
+            self.last_moments_resid = float(
+                np.max(np.abs(host["m_resid"])))
+            seg["moments_resid"] = self.last_moments_resid
         seg["emit_s"] = time.perf_counter() - t0
         return res
 
@@ -894,6 +1031,34 @@ class MetricAggregator:
             with self._CompileGuard(self, ((u_pad, d_pad), False, donate)):
                 dg(dv, dw_s, mm, self._pct_arr, uniform=False).compile()
             n += 1
+            # moments family: both program variants per bucket, with
+            # the EXACT live operand dtypes (f32 dense + f32 ab/lab/imp
+            # conversions, int16 depth vector) — prewarm-parity
+            # (analysis/rules/prewarm.py) checks these signatures
+            # against the _dispatch_moments call sites.  Covered even
+            # with dispatch rules off: moments WIRE payloads still
+            # route into the moments arena (self-description beats
+            # configuration), so any tier can see moments rows
+            mk = self.moments.k
+            m_dv = jax.ShapeDtypeStruct((u_pad, d_pad), np.float32)
+            m_dw = jax.ShapeDtypeStruct((u_pad, d_pad), np.float32)
+            m_ab = jax.ShapeDtypeStruct((2, u_pad), np.float32)
+            m_lab = jax.ShapeDtypeStruct((2, u_pad), np.float32)
+            m_imp = jax.ShapeDtypeStruct((u_pad, 2 * (mk + 1)),
+                                         np.float32)
+            m_dep = jax.ShapeDtypeStruct((u_pad,), np.int16)
+            mg = self.moments_fn.lower
+            md = self.moments_fn.depth_variant
+            with self._CompileGuard(
+                    self, ("moments", (u_pad, d_pad), False)):
+                mg(m_dv, m_dw, m_ab, m_lab, m_imp,
+                   self._pct_arr).compile()
+            n += 1
+            with self._CompileGuard(
+                    self, ("moments", (u_pad, d_pad), True)):
+                md.lower(m_dv, m_dep, m_ab, m_lab, m_imp,
+                         self._pct_arr).compile()
+            n += 1
         return n
 
     def _dispatch_flush(self, snap: dict, is_local: bool) -> dict:
@@ -914,6 +1079,11 @@ class MetricAggregator:
         nd = len(dpart["rows"])
         seg = self.last_flush_segments
         pend: dict = {"nd": nd, "meshed": self.mesh is not None}
+        # the moments family launches its own (single-device) program —
+        # a dense segmented-sum merge + batched maxent solve, a
+        # different compute class from the digest sort network — so it
+        # dispatches first and its kernel overlaps the digest staging
+        pend["moments"] = self._dispatch_moments(snap)
         if self.mesh is None:
             if nd == 0:
                 return pend
@@ -927,8 +1097,9 @@ class MetricAggregator:
             # the [U, D] weight matrix, and minmax stays host-side —
             # roughly half the build and the uploaded bytes
             seg["build_s"] = time.perf_counter() - t0
-            seg["upload_bytes"] = dv.nbytes + dw.nbytes + (
-                0 if uniform else minmax.nbytes)
+            seg["upload_bytes"] = (
+                seg.get("upload_bytes", 0) + dv.nbytes + dw.nbytes
+                + (0 if uniform else minmax.nbytes))
             # Upload/evaluate overlap (the P7 double-buffer, on device
             # streams): a big GLOBAL-tier flush splits into row chunks —
             # chunk i+1's upload rides the transfer engine while chunk
@@ -1004,7 +1175,8 @@ class MetricAggregator:
                 from jax.experimental import multihost_utils
                 local_depth = self.digests.staged_depth(dpart["staged"])
                 fams = snap["key_fingerprints"]   # lock-coherent snapshot
-                names = ("digest", "counter", "gauge", "set", "status")
+                names = ("digest", "moments", "counter", "gauge", "set",
+                         "status")
                 cks = np.asarray(
                     [fams[n][0] for n in names]
                     + [fams[n][1] for n in names],
@@ -1059,7 +1231,9 @@ class MetricAggregator:
                 dpart["d_min"], dpart["d_max"],
                 u_floor=g_nd, d_floor=g_depth)
             seg["build_s"] = time.perf_counter() - t0
-            seg["upload_bytes"] = dv.nbytes + dw.nbytes + minmax.nbytes
+            seg["upload_bytes"] = (seg.get("upload_bytes", 0)
+                                   + dv.nbytes + dw.nbytes
+                                   + minmax.nbytes)
             # pre-sharded staging: each device's blocks are placed
             # directly (no process-wide re-layout on program entry)
             t0 = time.perf_counter()
@@ -1111,6 +1285,44 @@ class MetricAggregator:
                 dense_dev=None if donate else (dvd, dwd))
             return pend
 
+    def _dispatch_moments(self, snap: dict) -> Optional[dict]:
+        """Build, stage and LAUNCH the moments-family program on the
+        snapshot (outside the lock): compact dense build of the staged
+        samples (uniform depth-vector variant on raw-sample intervals),
+        host f64 conversion of the ivec accumulators to Chebyshev
+        contributions, one program call (merge kernel + maxent solver,
+        ops/moments_eval.py).  Returns None when no moments rows were
+        touched."""
+        mpart = snap["moments"]
+        nm = len(mpart["rows"])
+        if nm == 0:
+            return None
+        seg = self.last_flush_segments
+        m = self.moments
+        uniform = mpart["uniform"]
+        t0 = time.perf_counter()
+        dv, dw, _ = m.build_dense(
+            mpart["staged"], mpart["rows"],
+            mpart["d_min"], mpart["d_max"], uniform=uniform)
+        imp, ab, lab = m.import_contrib(mpart, dv.shape[0])
+        seg["m_build_s"] = time.perf_counter() - t0
+        seg["upload_bytes"] = (seg.get("upload_bytes", 0) + dv.nbytes
+                               + dw.nbytes + imp.nbytes + ab.nbytes
+                               + lab.nbytes)
+        t0 = time.perf_counter()
+        dvd, dwd, abd, labd, impd = (
+            jnp.asarray(dv), jnp.asarray(dw), jnp.asarray(ab),
+            jnp.asarray(lab), jnp.asarray(imp))
+        with self._CompileGuard(self, ("moments", dv.shape, uniform)):
+            if uniform:
+                out = self.moments_fn.depth_variant(
+                    dvd, dwd, abd, labd, impd, self._pct_arr)
+            else:
+                out = self.moments_fn(dvd, dwd, abd, labd, impd,
+                                      self._pct_arr)
+        seg["m_dispatch_s"] = time.perf_counter() - t0
+        return {"out": out, "nm": nm}
+
     def _fetch_flush(self, snap: dict, pend: dict, seg: dict) -> dict:
         """Wait on a dispatched flush's device outputs and read them
         back as host numpy — the ONLY place a flush blocks on the
@@ -1121,6 +1333,15 @@ class MetricAggregator:
         nd = pend["nd"]
         n_cols = len(self._pct_arr)  # median + configured percentiles
         host: dict = {}
+        mp = pend.get("moments")
+        if mp is not None:
+            t0 = time.perf_counter()
+            mout = serving.fetch(mp["out"])
+            seg["m_device_s"] = time.perf_counter() - t0
+            seg["readback_bytes"] = (seg.get("readback_bytes", 0)
+                                     + mout.nbytes)
+            host["m_qs"] = mout[:mp["nm"], :n_cols]
+            host["m_resid"] = mout[:mp["nm"], -1]
         if not pend["meshed"]:
             host["set_ests"] = snap["sets"]["estimates"]
             if nd == 0:
@@ -1130,7 +1351,8 @@ class MetricAggregator:
             ev = (fetched[0] if pend["n_chunks"] == 1
                   else np.concatenate(fetched))
             seg["device_s"] = time.perf_counter() - t0
-            seg["readback_bytes"] = ev.nbytes
+            seg["readback_bytes"] = (seg.get("readback_bytes", 0)
+                                     + ev.nbytes)
             host["dense_dev"] = pend["first_dev"]
             host["dense_uniform"] = pend["uniform"]
             # counts/sums come from the exact f64 host accumulators on
@@ -1150,8 +1372,9 @@ class MetricAggregator:
             flat_t, set_regs_t = serving.fetch(
                 (pend["flat_dev"], pend["set_regs_dev"]))
             seg["device_s"] = time.perf_counter() - t0
-            seg["readback_bytes"] = flat_t.nbytes + (
-                0 if set_regs_t is None else set_regs_t.nbytes)
+            seg["readback_bytes"] = (
+                seg.get("readback_bytes", 0) + flat_t.nbytes
+                + (0 if set_regs_t is None else set_regs_t.nbytes))
             ev_t, c_hi_t, c_lo_t, set_ests_t, uts = \
                 serving.unpack_outputs(flat_t, pend["k_rows"], n_cols,
                                        pend["k2"], pend["n_sets_cap"])
@@ -1182,6 +1405,7 @@ class MetricAggregator:
                           self.gauges, self.status)
         self._import_row_cache.clear()
         d.sync()
+        self.moments.sync()
         s.sync()
         snap = {"counts": (self.processed, self.imported)}
         self.processed = 0
@@ -1296,6 +1520,32 @@ class MetricAggregator:
             "d_sum": d.d_sum[drows].copy(),
         }
 
+        m = self.moments
+        mrows = m.touched_rows()
+        snap["moments"] = {
+            "rows": mrows,
+            "names": m.name_col[mrows],
+            "tags": m.tags_col[mrows],
+            "kinds": m.kind_col[mrows],
+            "scopes": m.scope_col[mrows].copy(),
+            "uniform": m.staged_uniform,
+            "staged": m.take_staged(),
+            "l_weight": m.l_weight[mrows].copy(),
+            "l_min": m.l_min[mrows].copy(),
+            "l_max": m.l_max[mrows].copy(),
+            "l_sum": m.l_sum[mrows].copy(),
+            "l_rsum": m.l_rsum[mrows].copy(),
+            "d_min": m.d_min[mrows].copy(),
+            "d_max": m.d_max[mrows].copy(),
+            "d_rsum": m.d_rsum[mrows].copy(),
+            "d_weight": m.d_weight[mrows].copy(),
+            "d_sum": m.d_sum[mrows].copy(),
+            "d_logn": m.d_logn[mrows].copy(),
+            "ivec": m.ivec[mrows].copy(),
+            "iv_a": m.iv_a[mrows].copy(),
+            "iv_b": m.iv_b[mrows].copy(),
+        }
+
         # key-dictionary fingerprints for the multi-controller lockstep
         # gather — snapshotted HERE, under the lock and before the GC in
         # end_interval, so the flush gathers one coherent (keyset,
@@ -1304,6 +1554,7 @@ class MetricAggregator:
         # spurious lockstep error)
         snap["key_fingerprints"] = {
             "digest": (d.keyset_checksum, d.key_checksum),
+            "moments": (m.keyset_checksum, m.key_checksum),
             "counter": (c.keyset_checksum, c.key_checksum),
             "gauge": (g.keyset_checksum, g.key_checksum),
             "set": (s.keyset_checksum, s.key_checksum),
@@ -1313,21 +1564,28 @@ class MetricAggregator:
         for ar, rows in ((c, crows),
                          (g, snap["gauges"]["rows"]),
                          (st, snap["status"]["rows"]),
-                         (s, srows), (d, drows)):
+                         (s, srows), (d, drows), (m, mrows)):
             ar.reset_rows(rows)
             ar.end_interval()
         if self.cardinality is not None:
             self._cardinality_end_interval()
         return snap
 
-    def _arena_for_type(self, mtype: str):
+    def _arena_for_type(self, mtype: str, key: Optional[MetricKey] = None):
         if mtype == sm.TYPE_COUNTER:
             return self.counters
         if mtype == sm.TYPE_GAUGE:
             return self.gauges
         if mtype == sm.TYPE_SET:
             return self.sets
-        return self.digests   # histogram / timer
+        # histogram / timer: family dispatch decides (the cardinality
+        # release path passes the key so evicted moments rows release
+        # from the arena that actually holds them)
+        if key is not None and self.family_dispatch:
+            tags = key.joined_tags.split(",") if key.joined_tags else []
+            if self._family_is_moments(key, tags):
+                return self.moments
+        return self.digests
 
     def _cardinality_end_interval(self) -> None:
         """Apply the guard's count-ordered eviction pass (under the
@@ -1341,9 +1599,19 @@ class MetricAggregator:
             failpoints.inject("arena.evict")
             by_arena: dict = {}
             for dk in dks:
-                by_arena.setdefault(
-                    id(self._arena_for_type(dk[0].type)),
-                    (self._arena_for_type(dk[0].type), []))[1].append(dk)
+                arena = self._arena_for_type(dk[0].type, dk[0])
+                if dk[0].type in (sm.TYPE_HISTOGRAM, sm.TYPE_TIMER):
+                    # release from the arena that ACTUALLY holds the
+                    # key, not the one the rules would pick today:
+                    # payload-routed imports can land a key in the
+                    # moments arena on a tier whose rules say tdigest
+                    # (the supported cross-tier rules-mismatch), and a
+                    # rules-derived release would silently skip it
+                    if dk in self.moments.kdict:
+                        arena = self.moments
+                    elif dk in self.digests.kdict:
+                        arena = self.digests
+                by_arena.setdefault(id(arena), (arena, []))[1].append(dk)
             for arena, lst in by_arena.values():
                 arena.release_keys(lst)
 
@@ -1456,18 +1724,12 @@ class MetricAggregator:
         qs = host["qs"]
         counts = host["counts"]
         sums = host["sums"]
-        l_weight = np.asarray(part["l_weight"], np.float64)
-        l_min = np.asarray(part["l_min"], np.float64)
-        l_max = np.asarray(part["l_max"], np.float64)
-        l_sum = np.asarray(part["l_sum"], np.float64)
-        l_rsum = np.asarray(part["l_rsum"], np.float64)
         d_min = np.asarray(part["d_min"], np.float64)
         d_max = np.asarray(part["d_max"], np.float64)
         d_rsum = np.asarray(part["d_rsum"], np.float64)
 
         bases = part["names"].tolist()
         tags = part["tags"].tolist()
-        use_global = part["scopes"] == int(MetricScope.GLOBAL_ONLY)
         if is_local:
             forwarded = part["scopes"] != int(MetricScope.LOCAL_ONLY)
         else:
@@ -1524,6 +1786,60 @@ class MetricAggregator:
                     digest_min=float(d_min[i]), digest_max=float(d_max[i]),
                     digest_sum=float(sums[i]), digest_rsum=float(d_rsum[i]),
                     digest_compression=compression))
+
+        self._emit_histo_aggregates(res, part, qs, counts, sums,
+                                    is_local, now, forwarded)
+
+    def _emit_moments(self, res, snap, host, is_local, now):
+        """Moments-family emission: identical aggregate/percentile
+        surface to the digest family (sinks cannot tell the families
+        apart), with forwarding as wire moments VECTORS instead of
+        centroid lists."""
+        part = snap["moments"]
+        rows = part["rows"]
+        if len(rows) == 0:
+            return
+        n = len(rows)
+        qs = host["m_qs"]
+        counts = np.asarray(part["d_weight"], np.float64)
+        sums = np.asarray(part["d_sum"], np.float64)
+        if is_local:
+            forwarded = part["scopes"] != int(MetricScope.LOCAL_ONLY)
+        else:
+            forwarded = np.zeros(n, bool)
+        if forwarded.any():
+            fidx = np.nonzero(forwarded)[0]
+            vecs = self.moments.assemble_vectors(part, part["staged"],
+                                                 fidx)
+            bases = part["names"].tolist()
+            tags = part["tags"].tolist()
+            kinds = part["kinds"]
+            scopes = part["scopes"]
+            for j, i in enumerate(fidx.tolist()):
+                res.forward.append(sm.ForwardMetric(
+                    name=bases[i], tags=tags[i], kind=kinds[i],
+                    scope=MetricScope(int(scopes[i])),
+                    moments=vecs[j].tolist()))
+        self._emit_histo_aggregates(res, part, qs, counts, sums,
+                                    is_local, now, forwarded)
+
+    def _emit_histo_aggregates(self, res, part, qs, counts, sums,
+                               is_local, now, forwarded):
+        """The aggregate/percentile emission shared by both histogram
+        sketch families: sparse-emission guards per aggregate mirror
+        Histo.Flush (samplers/samplers.go:359-514) as column masks over
+        the snapshot's host scalar copies."""
+        l_weight = np.asarray(part["l_weight"], np.float64)
+        l_min = np.asarray(part["l_min"], np.float64)
+        l_max = np.asarray(part["l_max"], np.float64)
+        l_sum = np.asarray(part["l_sum"], np.float64)
+        l_rsum = np.asarray(part["l_rsum"], np.float64)
+        d_min = np.asarray(part["d_min"], np.float64)
+        d_max = np.asarray(part["d_max"], np.float64)
+        d_rsum = np.asarray(part["d_rsum"], np.float64)
+        bases = part["names"].tolist()
+        tags = part["tags"].tolist()
+        use_global = part["scopes"] == int(MetricScope.GLOBAL_ONLY)
 
         # alive: rows that emit anything locally (a forwarded global-only
         # row emits nothing here, flusher.go:57-74); sparse-emission

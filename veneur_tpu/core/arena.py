@@ -1113,6 +1113,7 @@ class DigestArena(_ArenaBase):
         np.add.at(self.l_sum, lr, lv * lw)
         with np.errstate(divide="ignore"):
             np.add.at(self.l_rsum, lr, lw / lv)
+        self._sync_extra(rows, vals, wts, local)
 
         self._acc.append((rows, vals, wts))
         np.add.at(self._depth, rows, 1)
@@ -1124,6 +1125,11 @@ class DigestArena(_ArenaBase):
             self._pre_reduce()
             if int(self._depth.max()) >= before:
                 break
+
+    def _sync_extra(self, rows: np.ndarray, vals: np.ndarray,
+                    wts: np.ndarray, local: np.ndarray) -> None:
+        """Family hook: extra host-scalar accumulation over one sync
+        batch (MomentsArena tracks the positive-sample mass here)."""
 
     def _consolidated(self):
         """Collapse _acc into single (rows, vals, wts) arrays."""
@@ -1453,3 +1459,332 @@ class DigestArena(_ArenaBase):
         self.l_sum[rows] = 0
         self.l_rsum[rows] = 0
         self._depth[rows] = 0
+
+
+class MomentsArena(DigestArena):
+    """The moments sketch family (sketches/moments.py): each row is one
+    fixed-size f64 moments vector instead of a centroid set, and the
+    flush's merge is a dense segmented SUM (ops/moments_eval.py Pallas
+    kernel) followed by the batched maxent solver — no sort network at
+    all.  The low-accuracy/high-cardinality counterpart to DigestArena
+    (ROADMAP #3); family choice per key is the aggregator's dispatch
+    layer (config ``sketch_family_*``).
+
+    Shares DigestArena's whole staging machinery — COO buffers, native
+    chunk staging, interval consolidation, the compact dense build with
+    its uniform depth-vector variant, and ``dense_block_per_shard`` —
+    plus the exact host scalar accumulators (d_min/d_max/d_weight/
+    d_sum/d_rsum and the local-only l_* set), and adds:
+
+      d_logn   per-row weight over strictly-positive samples (the mass
+               the log-domain power sums cover)
+      ivec     ``[capacity, 2(k+1)]`` f64 accumulator of NON-STAGED
+               power-sum mass — imported vectors (merge_moments) and
+               hot-row pre-reductions — as range-scaled monomial sums
+               in the row's own ivec domain (iv_a/iv_b).  Layout:
+               [count, U_1..U_k, logn, V_1..V_k].
+
+    The interval's raw staged samples stay in COO staging and reduce
+    ON DEVICE at flush; the host converts ivec to Chebyshev
+    contributions in the authoritative [d_min, d_max] domain and the
+    program adds the two before solving.  Hot rows whose staged depth
+    outgrows DENSE_DEPTH_CAP pre-reduce by folding into ivec on host
+    (exact f64) instead of a device t-digest compress.
+
+    Unmeshed only: the moments flush is a single-device program (config
+    rejects ``sketch_family_*`` with a device mesh)."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY,
+                 k: int = 0, mesh=None, **kw):
+        from veneur_tpu.sketches import moments as mo
+        if mesh is not None:
+            raise ValueError(
+                "the moments sketch family serves unmeshed tiers only "
+                "(its flush program is single-device; drop "
+                "mesh_devices or the sketch_family_* rules)")
+        kw.pop("compression", None)
+        kw.pop("bf16_staging", None)
+        super().__init__(capacity=capacity, mesh=None, **kw)
+        self.k = int(k) if k else mo.DEFAULT_K
+        self.d_logn = np.zeros(self.capacity)
+        self.ivec = np.zeros((self.capacity, 2 * (self.k + 1)),
+                             np.float64)
+        self.iv_a = np.full(self.capacity, np.inf)
+        self.iv_b = np.full(self.capacity, -np.inf)
+
+    def _grow_state(self, old: int) -> None:
+        super()._grow_state(old)
+        # super() doubled self.capacity before calling; extend the
+        # moments-only state the same way
+        self.d_logn = np.concatenate([self.d_logn, np.zeros(old)])
+        self.ivec = np.concatenate(
+            [self.ivec, np.zeros((old, self.ivec.shape[1]))], axis=0)
+        self.iv_a = np.concatenate([self.iv_a, np.full(old, np.inf)])
+        self.iv_b = np.concatenate([self.iv_b, np.full(old, -np.inf)])
+
+    def _sync_extra(self, rows, vals, wts, local) -> None:
+        pos = vals > 0
+        if pos.any():
+            np.add.at(self.d_logn, rows[pos], wts[pos])
+
+    # -- imports (vector merge: the elementwise-add path) ------------------
+
+    def merge_moments(self, row: int, vec) -> None:
+        """Fold one wire moments vector into a row: exact scalar
+        merges plus a domain-rebased elementwise add of the power-sum
+        blocks (sketches/moments.py contract)."""
+        from veneur_tpu.sketches import moments as mo
+        vec = np.asarray(vec, np.float64)
+        if len(vec) != mo.vector_len(self.k):
+            raise ValueError(
+                f"moments vector length {len(vec)} does not match "
+                f"k={self.k} (len {mo.vector_len(self.k)}); mixed-k "
+                "fleets are not mergeable")
+        self.d_min[row] = min(self.d_min[row], vec[mo.IDX_MIN])
+        self.d_max[row] = max(self.d_max[row], vec[mo.IDX_MAX])
+        self.d_weight[row] += vec[mo.IDX_COUNT]
+        self.d_sum[row] += vec[mo.IDX_SUM]
+        self.d_rsum[row] += vec[mo.IDX_RSUM]
+        self.d_logn[row] += vec[mo.IDX_LOGN]
+        self._ivec_fold(
+            row, (vec[mo.IDX_MIN], vec[mo.IDX_MAX]),
+            np.concatenate([[vec[mo.IDX_COUNT]],
+                            vec[mo.SUMS_OFF:mo.SUMS_OFF + self.k]]),
+            np.concatenate([[vec[mo.IDX_LOGN]],
+                            vec[mo.SUMS_OFF + self.k:]]))
+
+    def _ivec_fold(self, row: int, src_ab, raw_sums, log_sums) -> None:
+        """Rebase-add one (raw, log) monomial power-sum pair (in domain
+        ``src_ab``) into the row's ivec accumulator, growing the ivec
+        domain to cover both."""
+        from veneur_tpu.sketches import moments as mo
+        k = self.k
+        a0, b0 = self.iv_a[row], self.iv_b[row]
+        a1 = min(a0, float(src_ab[0]))
+        b1 = max(b0, float(src_ab[1]))
+        new_ab = (np.asarray([a1]), np.asarray([b1]))
+        new_lab = mo.log_domain(*map(np.asarray, ([a1], [b1])))
+        cur_raw = self.ivec[row:row + 1, :k + 1]
+        cur_log = self.ivec[row:row + 1, k + 1:]
+        src_lab = mo.log_domain(np.asarray([float(src_ab[0])]),
+                                np.asarray([float(src_ab[1])]))
+        if a1 == a0 and b1 == b0:
+            # steady state: the row's domain already covers the
+            # incoming vector — rebasing the existing sums would be
+            # an exact identity, so skip its two O(k^2) transforms
+            raw = cur_raw
+            log = cur_log
+        else:
+            old_lab = mo.log_domain(
+                np.asarray([a0 if np.isfinite(a0) else 0.0]),
+                np.asarray([b0 if np.isfinite(b0) else 0.0]))
+            raw = mo.rebase_sums(cur_raw, ([a0], [b0]), new_ab)
+            log = mo.rebase_sums(cur_log, old_lab, new_lab)
+        raw = raw + mo.rebase_sums(
+            raw_sums[None, :],
+            ([float(src_ab[0])], [float(src_ab[1])]), new_ab)
+        log = log + mo.rebase_sums(log_sums[None, :], src_lab, new_lab)
+        self.ivec[row, :k + 1] = raw[0]
+        self.ivec[row, k + 1:] = log[0]
+        self.iv_a[row], self.iv_b[row] = a1, b1
+
+    # -- hot-row pre-reduction (host fold, no device compress) -------------
+
+    def _pre_reduce(self) -> None:
+        """Collapse rows deeper than DENSE_DEPTH_CAP by folding their
+        staged points into the ivec accumulator (exact f64 host fold,
+        sketches/moments.fold_values) — a moments "compress" is just
+        the merge itself, so no device round-trip and no re-staging.
+        Scalars are NOT re-applied (sync already did)."""
+        from veneur_tpu.sketches import moments as mo
+        rows, vals, wts = self._consolidated()
+        deep = np.nonzero(self._depth > DENSE_DEPTH_CAP)[0]
+        if len(deep) == 0:
+            return
+        is_deep = np.zeros(self.capacity, bool)
+        is_deep[deep] = True
+        sel = is_deep[rows]
+        drows, dvals, dwts = rows[sel], vals[sel], wts[sel]
+        # compact index space over the deep rows
+        ridx = np.searchsorted(deep, drows)
+        n = len(deep)
+        k = self.k
+        sub_a = np.minimum.reduceat(
+            *self._reduceat_args(drows, dvals, np.inf))
+        sub_b = np.maximum.reduceat(
+            *self._reduceat_args(drows, dvals, -np.inf))
+        # per-deep-row fold domain: the union of the row's ivec domain
+        # and the staged subset's own range
+        a1 = np.minimum(np.where(np.isfinite(self.iv_a[deep]),
+                                 self.iv_a[deep], np.inf), sub_a)
+        b1 = np.maximum(np.where(np.isfinite(self.iv_b[deep]),
+                                 self.iv_b[deep], -np.inf), sub_b)
+        lab1 = mo.log_domain(a1, b1)
+        # rebase the existing ivec rows to the grown domains
+        old_lab = mo.log_domain(
+            np.where(np.isfinite(self.iv_a[deep]), self.iv_a[deep],
+                     0.0),
+            np.where(np.isfinite(self.iv_b[deep]), self.iv_b[deep],
+                     0.0))
+        raw = mo.rebase_sums(self.ivec[deep, :k + 1],
+                             (self.iv_a[deep], self.iv_b[deep]),
+                             (a1, b1))
+        log = mo.rebase_sums(self.ivec[deep, k + 1:], old_lab, lab1)
+        mo.fold_values(raw, log, ridx, dvals, dwts, (a1, b1), lab1)
+        self.ivec[deep, :k + 1] = raw
+        self.ivec[deep, k + 1:] = log
+        self.iv_a[deep], self.iv_b[deep] = a1, b1
+        keep = ~sel
+        self._acc = [(rows[keep], vals[keep], wts[keep])]
+        self._depth[deep] = 0
+
+    @staticmethod
+    def _reduceat_args(sorted_rows, vals, fill):
+        """(values, starts) for np.{minimum,maximum}.reduceat over the
+        per-row segments of a row-sorted COO subset."""
+        order = np.argsort(sorted_rows, kind="stable")
+        sr, sv = sorted_rows[order], vals[order]
+        starts = np.searchsorted(sr, np.unique(sr))
+        del fill
+        return sv, starts
+
+    # -- forwarding export -------------------------------------------------
+
+    def assemble_vectors(self, part: dict, staged, sel: np.ndarray
+                         ) -> np.ndarray:
+        """Wire vectors ``[F, M]`` for the selected snapshot rows:
+        exact scalars from the snapshot copies, power sums = the ivec
+        contribution rebased to the authoritative [d_min, d_max] plus
+        a host f64 fold of the interval's staged points (subset-sized
+        — forwarding cost scales with the forwarded rows).  Call at
+        emit time on the SNAPSHOT dict (the live arrays are already
+        reset)."""
+        from veneur_tpu.sketches import moments as mo
+        k = self.k
+        f = len(sel)
+        a = np.where(np.isfinite(part["d_min"][sel]),
+                     part["d_min"][sel], 0.0)
+        b = np.where(np.isfinite(part["d_max"][sel]),
+                     part["d_max"][sel], 0.0)
+        lab = mo.log_domain(a, b)
+        old_a, old_b = part["iv_a"][sel], part["iv_b"][sel]
+        old_lab = mo.log_domain(
+            np.where(np.isfinite(old_a), old_a, 0.0),
+            np.where(np.isfinite(old_b), old_b, 0.0))
+        raw = mo.rebase_sums(part["ivec"][sel, :k + 1],
+                             (old_a, old_b), (a, b))
+        log = mo.rebase_sums(part["ivec"][sel, k + 1:], old_lab, lab)
+        # fold this interval's staged points of the selected rows
+        srows, svals, swts = staged
+        if len(srows):
+            grows = part["rows"][sel]
+            lut = np.full(self.capacity, -1, np.int64)
+            lut[grows] = np.arange(f)
+            m = lut[srows] >= 0
+            if m.any():
+                mo.fold_values(raw, log, lut[srows[m]], svals[m],
+                               swts[m], (a, b), lab)
+        vecs = np.zeros((f, mo.vector_len(k)), np.float64)
+        vecs[:, mo.IDX_COUNT] = part["d_weight"][sel]
+        vecs[:, mo.IDX_MIN] = part["d_min"][sel]
+        vecs[:, mo.IDX_MAX] = part["d_max"][sel]
+        vecs[:, mo.IDX_SUM] = part["d_sum"][sel]
+        vecs[:, mo.IDX_RSUM] = part["d_rsum"][sel]
+        vecs[:, mo.IDX_LOGN] = part["d_logn"][sel]
+        vecs[:, mo.SUMS_OFF:mo.SUMS_OFF + k] = raw[:, 1:]
+        vecs[:, mo.SUMS_OFF + k:] = log[:, 1:]
+        return vecs
+
+    # -- flush conversion --------------------------------------------------
+
+    def import_contrib(self, part: dict, u_pad: int):
+        """The flush program's ``imp`` operand: Chebyshev contributions
+        of the snapshot rows' ivec accumulators in the authoritative
+        domain, f64-converted on host, zero-padded to the dense row
+        count.  Returns (imp [u_pad, 2(k+1)] f32, ab [2, u_pad] f32,
+        lab [2, u_pad] f32)."""
+        from veneur_tpu.ops import moments_eval as me
+        from veneur_tpu.sketches import moments as mo
+        k = self.k
+        n = len(part["rows"])
+        a = np.where(np.isfinite(part["d_min"]), part["d_min"], 0.0)
+        b = np.where(np.isfinite(part["d_max"]), part["d_max"], 0.0)
+        la, lb = mo.log_domain(a, b)
+        old_a, old_b = part["iv_a"], part["iv_b"]
+        old_lab = mo.log_domain(
+            np.where(np.isfinite(old_a), old_a, 0.0),
+            np.where(np.isfinite(old_b), old_b, 0.0))
+        raw = mo.rebase_sums(part["ivec"][:, :k + 1],
+                             (old_a, old_b), (a, b))
+        log = mo.rebase_sums(part["ivec"][:, k + 1:], old_lab,
+                             (la, lb))
+        c = me._mono_to_cheb(k).T
+        imp = np.zeros((u_pad, 2 * (k + 1)), np.float32)
+        imp[:n, :k + 1] = raw @ c
+        imp[:n, k + 1:] = log @ c
+        ab = np.zeros((2, u_pad), np.float32)
+        ab[0, :n] = a
+        ab[1, :n] = b
+        lab = np.zeros((2, u_pad), np.float32)
+        lab[1, :] = -1.0          # sentinel: lb < la = log invalid
+        lab[0, :n] = la
+        lab[1, :n] = lb
+        return imp, ab, lab
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        super().reset_rows(rows)
+        if len(rows) == 0:
+            return
+        self.d_logn[rows] = 0
+        self.ivec[rows] = 0
+        self.iv_a[rows] = np.inf
+        self.iv_b[rows] = -np.inf
+
+    # -- crash checkpoint --------------------------------------------------
+
+    def _checkpoint_arrays(self) -> dict:
+        out = super()._checkpoint_arrays()
+        out["d_logn"] = self.d_logn.copy()
+        # ivec serializes live rows only (the dense plane is f64 and
+        # capacity-sized; live rows are what restores bit-exactly)
+        live = np.asarray(sorted(self.kdict.values()), np.int64)
+        out["ivec_rows"] = live
+        out["ivec"] = self.ivec[live].copy()
+        out["iv_a"] = self.iv_a[live].copy()
+        out["iv_b"] = self.iv_b[live].copy()
+        return out
+
+    def _checkpoint_extra(self, meta: dict) -> None:
+        from veneur_tpu.ops import moments_eval as me
+        super()._checkpoint_extra(meta)
+        meta["moments_k"] = int(self.k)
+        meta["solver"] = [int(me.QUAD_POINTS), int(me.NEWTON_ITERS)]
+
+    def restore_precheck(self, meta: dict, arrays: dict) -> None:
+        from veneur_tpu.ops import moments_eval as me
+        super().restore_precheck(meta, arrays)
+        if int(meta.get("moments_k", self.k)) != self.k:
+            raise CheckpointIncompatible(
+                f"moments checkpoint k {meta.get('moments_k')} != "
+                f"configured {self.k}; power-sum blocks are not "
+                "mergeable across orders")
+        solver = [int(x) for x in (meta.get("solver")
+                                   or [me.QUAD_POINTS,
+                                       me.NEWTON_ITERS])]
+        if solver != [int(me.QUAD_POINTS), int(me.NEWTON_ITERS)]:
+            raise CheckpointIncompatible(
+                f"moments checkpoint solver config {solver} != "
+                f"current [{me.QUAD_POINTS}, {me.NEWTON_ITERS}]; "
+                "restored quantiles would not replay bit-identically")
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        super()._restore_arrays(meta, arrays)
+        self._restore_into(self.d_logn, arrays["d_logn"])
+        rows = arrays.get("ivec_rows")
+        if rows is not None and len(rows):
+            rows = rows.astype(np.int64, copy=False)
+            self.ivec[rows] = arrays["ivec"]
+            self.iv_a[rows] = arrays["iv_a"]
+            self.iv_b[rows] = arrays["iv_b"]

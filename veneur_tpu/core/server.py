@@ -207,7 +207,11 @@ class Server:
             flush_presharded_staging=cfg.flush_presharded_staging,
             cardinality_key_budget=cfg.cardinality_key_budget,
             cardinality_tenant_tag=cfg.cardinality_tenant_tag,
-            cardinality_seed=cfg.cardinality_seed)
+            cardinality_seed=cfg.cardinality_seed,
+            sketch_family_default=cfg.sketch_family_default,
+            sketch_family_rules=list(cfg.sketch_family_rules),
+            sketch_moments_k=cfg.sketch_moments_k,
+            cardinality_rollup_family=cfg.cardinality_rollup_family)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -1481,6 +1485,18 @@ class Server:
                               v * 1e3)
             else:
                 statsd.gauge(f"flush.{seg_name}", float(v))
+        # sketch-family observability: per-family key counts of the
+        # flush that just ran, and the moments solver's worst moment
+        # residual (a converged maxent solve sits at ~1e-4; a blowup
+        # here is the canary for degenerate moment inputs)
+        segs = self.aggregator.last_flush_segments
+        statsd.gauge("sketch.keys", float(segs.get("keys_digest", 0)),
+                     tags=["family:tdigest"])
+        statsd.gauge("sketch.keys", float(segs.get("keys_moments", 0)),
+                     tags=["family:moments"])
+        if segs.get("keys_moments"):
+            statsd.gauge("sketch.moments.solver_resid",
+                         float(self.aggregator.last_moments_resid))
 
         with self._events_lock:
             events, self._events = self._events, []

@@ -41,7 +41,24 @@ def to_pb(fm: sm.ForwardMetric) -> metric_pb2.Metric:
         m.gauge.value = float(fm.gauge_value)
     elif fm.kind == sm.TYPE_SET:
         m.set.hyper_log_log = fm.hll
-    else:  # histogram / timer
+    elif fm.moments is not None:  # histogram / timer, moments family
+        # the moments vector rides the histogram oneof with a NEGATIVE
+        # compression as the family marker (-k, the power-sum order):
+        # centroid means are wire doubles, so the f64 vector transports
+        # exactly and the payload stays self-describing — an importer
+        # never needs this tier's dispatch rules to route it.  min/max/
+        # reciprocalSum mirror the vector's scalars for wire debuggers.
+        from veneur_tpu.sketches import moments as mo
+        vec = [float(x) for x in fm.moments]
+        k = mo.k_from_len(len(vec))
+        td = tdigest_pb2.MergingDigestData(
+            compression=-float(k),
+            min=vec[mo.IDX_MIN], max=vec[mo.IDX_MAX],
+            reciprocalSum=vec[mo.IDX_RSUM])
+        for x in vec:
+            td.main_centroids.add(mean=x, weight=1.0)
+        m.histogram.t_digest.CopyFrom(td)
+    else:  # histogram / timer, t-digest family
         td = tdigest_pb2.MergingDigestData(
             compression=fm.digest_compression,
             min=fm.digest_min, max=fm.digest_max,
@@ -67,12 +84,16 @@ def from_pb(m: metric_pb2.Metric) -> sm.ForwardMetric:
         fm.hll = m.set.hyper_log_log
     elif which == "histogram":
         td = m.histogram.t_digest
-        fm.digest_means = [c.mean for c in td.main_centroids]
-        fm.digest_weights = [c.weight for c in td.main_centroids]
-        fm.digest_compression = td.compression or 100.0
-        fm.digest_min = td.min
-        fm.digest_max = td.max
-        fm.digest_rsum = td.reciprocalSum
+        if td.compression < 0:
+            # moments-family marker (see to_pb): means ARE the vector
+            fm.moments = [c.mean for c in td.main_centroids]
+        else:
+            fm.digest_means = [c.mean for c in td.main_centroids]
+            fm.digest_weights = [c.weight for c in td.main_centroids]
+            fm.digest_compression = td.compression or 100.0
+            fm.digest_min = td.min
+            fm.digest_max = td.max
+            fm.digest_rsum = td.reciprocalSum
     elif which is None:
         raise ValueError("can't import a metric with a nil value")
     return fm

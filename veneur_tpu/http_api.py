@@ -149,6 +149,18 @@ def debug_vars(server) -> dict:
         # exactly-once ledger: recorded chunk identities and
         # duplicates skipped (replays of delivered chunks)
         stats["dedup"] = dedup.stats()
+    agg = server.aggregator
+    if getattr(agg, "moments", None) is not None:
+        # sketch-family dispatch: live key counts per histogram
+        # family + the moments solver's last worst residual
+        stats["sketch_families"] = {
+            "dispatch": bool(getattr(agg, "family_dispatch", False)),
+            "tdigest_keys": len(agg.digests.kdict),
+            "moments_keys": len(agg.moments.kdict),
+            "moments_k": agg.moments.k,
+            "moments_solver_resid": float(
+                getattr(agg, "last_moments_resid", 0.0)),
+        }
     guard = getattr(server.aggregator, "cardinality", None)
     if guard is not None:
         # per-tenant key-budget ledger: exact keys, evicted

@@ -500,6 +500,9 @@ class _IdInfo:
     uts_bytes: Optional[bytes]  # unique-timeseries HLL insert, if counted
     row: int = -1
     meta: object = None      # RowMeta identity for GC revalidation
+    # histogram family dispatch: the arena this id's row binding lives
+    # in (digests or moments; None until first resolution)
+    arena: object = None
     # cardinality-guard epoch this row binding was resolved under; an
     # interval-end eviction/promotion bumps the guard's epoch, which
     # forces a re-resolve (the key may have changed buckets)
@@ -595,6 +598,48 @@ class NativeIngest:
                 uts.insert(info.uts_bytes)
         return lut[ids]
 
+    def _hrows_for(self, ids: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        """Histogram/timer twin of _rows_for under sketch-family
+        dispatch: the target arena depends on the (possibly guard-
+        rolled) identity, so each id resolves its arena alongside its
+        row.  Returns (rows, is_moments) aligned with ``ids``."""
+        agg = self.agg
+        guard = getattr(agg, "cardinality", None)
+        uids, ucounts = np.unique(ids, return_counts=True)
+        hi = int(uids[-1]) + 1 if len(uids) else 0
+        lut = np.empty(hi, np.int64)
+        mlut = np.zeros(hi, bool)
+        uts = agg.unique_ts
+        for uid, ucount in zip(uids, ucounts):
+            info = self._info[uid]
+            row = info.row
+            arena = info.arena
+            resolved = None
+            if guard is not None:
+                resolved = guard.resolve(info.key, info.row_scope,
+                                         info.tags, int(ucount))
+                if info.card_epoch != guard.epoch:
+                    info.card_epoch = guard.epoch
+                    row = -1
+            if row < 0 or arena is None \
+                    or arena.meta[row] is not info.meta:
+                key, scope, tags = (resolved if resolved is not None
+                                    else (info.key, info.row_scope,
+                                          info.tags))
+                arena = agg._histo_arena(key, tags)
+                row = arena.row_for(key, scope, tags)
+                info.row = row
+                info.meta = arena.meta[row]
+                info.arena = arena
+            else:
+                arena.touched[row] = True
+            lut[uid] = row
+            mlut[uid] = arena is agg.moments
+            if uts is not None and info.uts_bytes is not None:
+                uts.insert(info.uts_bytes)
+        return lut[ids], mlut[ids]
+
     # -- drain application -------------------------------------------------
 
     def drain_into(self) -> DrainBatch:
@@ -657,8 +702,21 @@ class NativeIngest:
                     # in-order fancy assignment: last write wins
                     agg.gauges.values[rows] = batch.g_vals
                 if len(batch.h_ids):
-                    rows = self._rows_for(agg.digests, batch.h_ids)
-                    agg.digests.sample_batch(rows, batch.h_vals, batch.h_wts)
+                    if getattr(agg, "family_dispatch", False):
+                        rows, is_m = self._hrows_for(batch.h_ids)
+                        if is_m.any():
+                            agg.moments.sample_batch(
+                                rows[is_m], batch.h_vals[is_m],
+                                batch.h_wts[is_m])
+                        keep = ~is_m
+                        if keep.any():
+                            agg.digests.sample_batch(
+                                rows[keep], batch.h_vals[keep],
+                                batch.h_wts[keep])
+                    else:
+                        rows = self._rows_for(agg.digests, batch.h_ids)
+                        agg.digests.sample_batch(rows, batch.h_vals,
+                                                 batch.h_wts)
                 if len(batch.s_ids):
                     rows = self._rows_for(agg.sets, batch.s_ids)
                     agg.sets.stage_hash_batch(rows, batch.s_hashes)

@@ -110,6 +110,11 @@ class ForwardMetric:
     digest_sum: float = 0.0
     digest_rsum: float = 0.0
     digest_compression: float = 100.0
+    # moments-family histogram payload (sketches/moments.py vector;
+    # mutually exclusive with the digest fields — a histogram/timer
+    # ForwardMetric carries exactly one sketch family and the importer
+    # routes by which is present)
+    moments: Optional[list[float]] = None
     # set payload
     hll: bytes = b""
 

@@ -1,0 +1,322 @@
+"""Moments sketch: a fixed-size mergeable quantile summary.
+
+The second sketch family (ROADMAP #3; "Moment-Based Quantile Sketches
+for Efficient High Cardinality Aggregation Queries", arXiv:1803.01969).
+Where a t-digest is a variable set of weighted centroids whose merge is
+concat+sort+compress, a moments sketch is ONE fixed-size f64 vector
+whose merge is (rebase +) elementwise addition — on TPU, merging a
+million keys becomes one dense batched reduction with no sort network
+at all (ops/moments_eval.py), a fundamentally better roofline for the
+high-cardinality/low-accuracy tiers (cardinality-guard tail rollups,
+coarse per-tenant quantiles).
+
+Vector layout (``vector_len(k)`` = 6 + 2k doubles)::
+
+    [0] count   total weight (exact; integer-exact below 2^53)
+    [1] min     true minimum
+    [2] max     true maximum
+    [3] sum     exact weighted sum (conservation; NOT derived from the
+                scaled power sums, whose reconstruction would round)
+    [4] rsum    reciprocal sum (sum of w/x; harmonic mean)
+    [5] logn    weight over strictly-positive samples (the mass the
+                log-domain power sums cover)
+    [6 .. 6+k)       U_j = sum of w * t^j, j = 1..k, with
+                     t = (2x - (min+max)) / (max - min)  in [-1, 1]
+    [6+k .. 6+2k)    V_j = sum of w * u^j, j = 1..k, with
+                     u the same map applied to ln(x) over
+                     [ln min, ln max]; all-zero unless min > 0
+
+The raw and log power sums are stored RANGE-SCALED to the sketch's own
+domain rather than as raw ``sum(x^j)``: raw power sums of values far
+from zero relative to their spread (epoch stamps, latencies in a narrow
+band) lose all significance when converted to the centered moments the
+maxent solver needs — the binomial conversion cancels ``(mean/span)^k``
+orders of magnitude, which at k = 8 exceeds f64 entirely.  Scaled sums
+are bounded by ``count`` at every order, and a cross-sketch merge
+rebases them to the combined domain with a binomial transform whose
+coefficients are all O(1) — exact in exact arithmetic, numerically
+stable by construction.  Within one domain the merge IS elementwise
+addition, which is the form the flush kernel exploits.
+
+The quantile solver (ops/moments_eval.py) recovers a maximum-entropy
+density matching the Chebyshev moments derived from this vector and
+reads quantiles off its CDF; accuracy per family is committed evidence
+in analysis/tdigest_accuracy.csv (scripts/tdigest_analysis.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+# power sums per domain (raw + log); the wire/checkpoint contract —
+# restoring or merging across a k mismatch is refused, never coerced
+DEFAULT_K = 8
+
+# log-domain solve engages when the data spans this dynamic range
+# (heavy-tailed data: the log map spends moment resolution where the
+# mass is instead of on the tail's span)
+LOG_DOMAIN_RATIO = 64.0
+
+IDX_COUNT = 0
+IDX_MIN = 1
+IDX_MAX = 2
+IDX_SUM = 3
+IDX_RSUM = 4
+IDX_LOGN = 5
+SUMS_OFF = 6
+
+
+def vector_len(k: int = DEFAULT_K) -> int:
+    return SUMS_OFF + 2 * k
+
+
+def k_from_len(m: int) -> int:
+    k, rem = divmod(m - SUMS_OFF, 2)
+    if rem or k < 1:
+        raise ValueError(f"not a moments vector length: {m}")
+    return k
+
+
+def empty_vector(k: int = DEFAULT_K) -> np.ndarray:
+    v = np.zeros(vector_len(k), np.float64)
+    v[IDX_MIN] = np.inf
+    v[IDX_MAX] = -np.inf
+    return v
+
+
+def _scale_params(a, b):
+    """(alpha, beta) of t = alpha*x + beta mapping [a, b] -> [-1, 1];
+    degenerate domains (b <= a) map everything to 0."""
+    span = b - a
+    safe = np.where(span > 0, span, 1.0)
+    alpha = np.where(span > 0, 2.0 / safe, 0.0)
+    beta = np.where(span > 0, -(a + b) / safe, 0.0)
+    return alpha, beta
+
+
+@functools.lru_cache(maxsize=None)
+def _binom_table(k: int) -> np.ndarray:
+    # cached: rebase_sums sits on the per-imported-metric hot path
+    t = np.zeros((k + 1, k + 1))
+    for j in range(k + 1):
+        for m in range(j + 1):
+            t[j, m] = math.comb(j, m)
+    return t
+
+
+def rebase_sums(sums: np.ndarray, old_ab, new_ab) -> np.ndarray:
+    """Rebase scaled power-sum rows ``[n, k+1]`` (order 0..k, order 0 =
+    the count) from per-row domain ``old_ab = (a0, b0)`` to ``new_ab``.
+
+    t_new = alpha * t_old + beta with alpha = span_old/span_new in
+    (0, 1] and |beta| <= 1 when the new domain contains the old one, so
+    every binomial term is O(count) — no cancellation blowup.  Rows
+    whose old domain is degenerate (a0 == b0: single-valued data) map
+    through the point's position in the new domain."""
+    sums = np.asarray(sums, np.float64)
+    n, kp1 = sums.shape
+    k = kp1 - 1
+    a0, b0 = (np.asarray(old_ab[0], np.float64),
+              np.asarray(old_ab[1], np.float64))
+    a1, b1 = (np.asarray(new_ab[0], np.float64),
+              np.asarray(new_ab[1], np.float64))
+    # empty sketches carry (inf, -inf) domains and all-zero sums; the
+    # mapping is then irrelevant, but inf * 0 would poison the zeros
+    # with NaN — sanitize to a degenerate finite domain instead
+    a0 = np.where(np.isfinite(a0), a0, 0.0)
+    b0 = np.where(np.isfinite(b0), b0, 0.0)
+    a1 = np.where(np.isfinite(a1), a1, 0.0)
+    b1 = np.where(np.isfinite(b1), b1, 0.0)
+    span0, span1 = b0 - a0, b1 - a1
+    safe1 = np.where(span1 > 0, span1, 1.0)
+    alpha = np.where(span1 > 0, np.where(span0 > 0, span0 / safe1, 0.0),
+                     0.0)
+    # degenerate old domain: all mass sits at x = a0 -> t fixed
+    t_point = np.where(span1 > 0, (2.0 * a0 - (a1 + b1)) / safe1, 0.0)
+    beta = np.where(span0 > 0,
+                    np.where(span1 > 0, (a0 + b0 - a1 - b1) / safe1,
+                             0.0),
+                    t_point)
+    binom = _binom_table(k)
+    # powers of alpha/beta per row, [n, k+1]
+    ap = np.ones((n, kp1))
+    bp = np.ones((n, kp1))
+    for j in range(1, kp1):
+        ap[:, j] = ap[:, j - 1] * alpha
+        bp[:, j] = bp[:, j - 1] * beta
+    out = np.zeros_like(sums)
+    for j in range(kp1):
+        acc = out[:, j]
+        for m in range(j + 1):
+            acc += binom[j, m] * ap[:, m] * bp[:, j - m] * sums[:, m]
+    return out
+
+
+def _scaled_powers_accumulate(sums: np.ndarray, rows: np.ndarray,
+                              t: np.ndarray, w: np.ndarray) -> None:
+    """sums[rows, j] += w * t^j for j = 1..k (order-0 column is the
+    caller's count bookkeeping), vectorized with np.add.at."""
+    k = sums.shape[1] - 1
+    p = np.ones_like(t)
+    for j in range(1, k + 1):
+        p = p * t
+        np.add.at(sums[:, j], rows, w * p)
+
+
+class MomentsSketch:
+    """Single-key convenience wrapper over one moments vector (the
+    analysis harness / test twin; production keys live batched in
+    core.arena.MomentsArena)."""
+
+    def __init__(self, k: int = DEFAULT_K):
+        self.k = k
+        self.vec = empty_vector(k)
+
+    def add_batch(self, values, weights=None) -> None:
+        vals = np.asarray(values, np.float64).ravel()
+        if len(vals) == 0:
+            return
+        wts = (np.ones_like(vals) if weights is None
+               else np.asarray(weights, np.float64).ravel())
+        inc = empty_vector(self.k)
+        inc[IDX_COUNT] = wts.sum()
+        inc[IDX_MIN] = vals.min()
+        inc[IDX_MAX] = vals.max()
+        inc[IDX_SUM] = float(vals @ wts)
+        with np.errstate(divide="ignore"):
+            nz = vals != 0
+            inc[IDX_RSUM] = float((wts[nz] / vals[nz]).sum())
+        pos = vals > 0
+        inc[IDX_LOGN] = float(wts[pos].sum())
+        alpha, beta = _scale_params(inc[IDX_MIN], inc[IDX_MAX])
+        t = alpha * vals + beta
+        sums = np.zeros((1, self.k + 1))
+        _scaled_powers_accumulate(
+            sums, np.zeros(len(vals), np.int64), t, wts)
+        inc[SUMS_OFF:SUMS_OFF + self.k] = sums[0, 1:]
+        if inc[IDX_MIN] > 0:
+            lv = np.log(vals)
+            la, lb = np.log(inc[IDX_MIN]), np.log(inc[IDX_MAX])
+            alpha, beta = _scale_params(la, lb)
+            u = alpha * lv + beta
+            lsums = np.zeros((1, self.k + 1))
+            _scaled_powers_accumulate(
+                lsums, np.zeros(len(vals), np.int64), u, wts)
+            inc[SUMS_OFF + self.k:] = lsums[0, 1:]
+        self.vec = merge_vectors(self.vec[None, :], inc[None, :])[0]
+
+    def merge(self, other: "MomentsSketch | np.ndarray") -> None:
+        vec = other.vec if isinstance(other, MomentsSketch) else other
+        self.vec = merge_vectors(self.vec[None, :],
+                                 np.asarray(vec, np.float64)[None, :])[0]
+
+    @property
+    def count(self) -> float:
+        return float(self.vec[IDX_COUNT])
+
+    def quantile(self, q: float) -> float:
+        return self.quantiles([q])[0]
+
+    def quantiles(self, qs) -> np.ndarray:
+        from veneur_tpu.ops import moments_eval
+        return moments_eval.quantiles_from_vectors(
+            self.vec[None, :], np.asarray(qs, np.float64))[0]
+
+
+def merge_vectors(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Merge batched moments vectors ``[n, M]`` elementwise: combined
+    domain, both power-sum blocks rebased to it, then added.  Exact for
+    count/min/max/sum/rsum/logn; the scaled sums rebase with O(1)
+    coefficients (see module docstring).  Returns a new array."""
+    dst = np.asarray(dst, np.float64)
+    src = np.asarray(src, np.float64)
+    if dst.shape != src.shape:
+        raise ValueError(f"shape mismatch: {dst.shape} vs {src.shape}")
+    k = k_from_len(dst.shape[1])
+    out = np.empty_like(dst)
+    out[:, IDX_COUNT] = dst[:, IDX_COUNT] + src[:, IDX_COUNT]
+    out[:, IDX_MIN] = np.minimum(dst[:, IDX_MIN], src[:, IDX_MIN])
+    out[:, IDX_MAX] = np.maximum(dst[:, IDX_MAX], src[:, IDX_MAX])
+    for i in (IDX_SUM, IDX_RSUM, IDX_LOGN):
+        out[:, i] = dst[:, i] + src[:, i]
+    new_ab = (out[:, IDX_MIN], out[:, IDX_MAX])
+
+    def sums_of(v, lo, hi, dom):
+        s = np.zeros((v.shape[0], k + 1))
+        s[:, 0] = v[:, IDX_COUNT] if dom == "raw" else v[:, IDX_LOGN]
+        s[:, 1:] = v[:, lo:hi]
+        return s
+
+    def domain_of(v, dom):
+        a, b = v[:, IDX_MIN], v[:, IDX_MAX]
+        if dom == "raw":
+            return a, b
+        ok = (a > 0) & np.isfinite(a) & np.isfinite(b)
+        sa = np.where(ok, a, 1.0)
+        sb = np.where(ok, np.maximum(b, sa), 1.0)
+        return np.log(sa), np.log(sb)
+
+    raw = (rebase_sums(sums_of(dst, SUMS_OFF, SUMS_OFF + k, "raw"),
+                       domain_of(dst, "raw"), new_ab)
+           + rebase_sums(sums_of(src, SUMS_OFF, SUMS_OFF + k, "raw"),
+                         domain_of(src, "raw"), new_ab))
+    out[:, SUMS_OFF:SUMS_OFF + k] = raw[:, 1:]
+    # log sums survive only while the combined domain stays positive
+    ok = (out[:, IDX_MIN] > 0) & np.isfinite(out[:, IDX_MIN]) \
+        & np.isfinite(out[:, IDX_MAX])
+    if ok.any():
+        la = np.log(np.where(ok, out[:, IDX_MIN], 1.0))
+        lb = np.log(np.where(ok, np.maximum(out[:, IDX_MAX],
+                                            out[:, IDX_MIN]), 1.0))
+        lg = (rebase_sums(sums_of(dst, SUMS_OFF + k, SUMS_OFF + 2 * k,
+                                  "log"),
+                          domain_of(dst, "log"), (la, lb))
+              + rebase_sums(sums_of(src, SUMS_OFF + k,
+                                    SUMS_OFF + 2 * k, "log"),
+                            domain_of(src, "log"), (la, lb)))
+        out[:, SUMS_OFF + k:] = np.where(ok[:, None], lg[:, 1:], 0.0)
+    else:
+        out[:, SUMS_OFF + k:] = 0.0
+    # empty-side hygiene: merging with an all-empty vector must be the
+    # identity (inf/-inf min/max poison nothing above by construction)
+    return out
+
+
+def fold_values(sums: np.ndarray, lsums: np.ndarray, rows: np.ndarray,
+                vals: np.ndarray, wts: np.ndarray, ab, lab) -> None:
+    """Fold weighted samples into batched scaled power-sum blocks
+    ``sums``/``lsums`` ``[n, k+1]`` (order 0 = count mass folded here),
+    where each row's domain is ``ab = (a[n], b[n])`` (and ``lab`` its
+    log twin; rows with a <= 0 skip the log block).  Pure numpy f64 —
+    the host-side fold the arena uses for hot-row pre-reduction and
+    forwarding export; the flush-path equivalent runs on device
+    (ops/moments_eval.py)."""
+    a, b = ab
+    alpha, beta = _scale_params(a[rows], b[rows])
+    t = np.clip(alpha * vals + beta, -1.0, 1.0)
+    np.add.at(sums[:, 0], rows, wts)
+    _scaled_powers_accumulate(sums, rows, t, wts)
+    pos = vals > 0
+    if pos.any():
+        la, lb = lab
+        prow = rows[pos]
+        ok = a[prow] > 0
+        prow, pv, pw = prow[ok], vals[pos][ok], wts[pos][ok]
+        if len(prow):
+            alpha, beta = _scale_params(la[prow], lb[prow])
+            u = np.clip(alpha * np.log(pv) + beta, -1.0, 1.0)
+            np.add.at(lsums[:, 0], prow, pw)
+            _scaled_powers_accumulate(lsums, prow, u, pw)
+
+
+def log_domain(a: np.ndarray, b: np.ndarray):
+    """(ln a, ln b) with degenerate placeholders where a <= 0 (the
+    sentinel lb < la disables the log-domain solve in-program)."""
+    ok = a > 0
+    la = np.where(ok, np.log(np.where(ok, a, 1.0)), 0.0)
+    lb = np.where(ok, np.log(np.where(ok, np.maximum(b, a), 1.0)),
+                  -1.0)
+    return la, lb

@@ -63,6 +63,14 @@ class ClusterSpec:
     # per-tenant key budget; 0 = off
     cardinality_key_budget: int = 0
     cardinality_tenant_tag: str = "tenant"
+    # sketch-family dispatch (applied on EVERY tier, so locals route
+    # raw samples and globals route their own raw-ingest consistently;
+    # imports self-describe either way); e.g.
+    # (TrafficGen.MOMENTS_RULE,) makes tb.mh* keys moments-family
+    sketch_family_rules: tuple = ()
+    sketch_family_default: str = "tdigest"
+    sketch_moments_k: int = 8
+    cardinality_rollup_family: str = "tdigest"
     # serve the operator /debug surface for local[0] (tests assert the
     # forward retry/drop counters are visible at /debug/vars)
     http_api: bool = False
@@ -173,6 +181,11 @@ class Cluster:
             percentiles=list(spec.percentiles),
             aggregates=list(spec.aggregates),
             mesh_devices=spec.mesh_devices,
+            sketch_family_rules=[dict(r) for r in
+                                 spec.sketch_family_rules],
+            sketch_family_default=spec.sketch_family_default,
+            sketch_moments_k=spec.sketch_moments_k,
+            cardinality_rollup_family=spec.cardinality_rollup_family,
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
             hostname=hostname),
@@ -200,6 +213,11 @@ class Cluster:
             aggregates=list(spec.aggregates),
             cardinality_key_budget=spec.cardinality_key_budget,
             cardinality_tenant_tag=spec.cardinality_tenant_tag,
+            sketch_family_rules=[dict(r) for r in
+                                 spec.sketch_family_rules],
+            sketch_family_default=spec.sketch_family_default,
+            sketch_moments_k=spec.sketch_moments_k,
+            cardinality_rollup_family=spec.cardinality_rollup_family,
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
             spool_dir=spool_dir,

@@ -27,7 +27,7 @@ PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
     "routing_exclusive", "chaos_matrix", "lock_witness", "telemetry",
-    "trace", "spool", "checkpoint", "egress", "ok",
+    "trace", "spool", "checkpoint", "egress", "sketch_families", "ok",
 ]
 
 
@@ -38,6 +38,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                interval_s: float = 0.05,
                percentiles: tuple = (0.5, 0.9, 0.99),
                cardinality_key_budget: int = 0,
+               moments_histo_keys: int = 0,
                chaos: str | None = None,
                lock_witness: bool = False,
                trace: bool = False,
@@ -72,18 +73,27 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                        interval_s=interval_s, mesh_devices=mesh_devices,
                        percentiles=tuple(percentiles),
                        cardinality_key_budget=cardinality_key_budget,
+                       sketch_family_rules=(
+                           (TrafficGen.MOMENTS_RULE,)
+                           if moments_histo_keys else ()),
                        lock_witness=witness,
                        telemetry=telemetry_witness)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
-                         histo_samples=histo_samples)
+                         histo_samples=histo_samples,
+                         moments_histo_keys=moments_histo_keys)
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
+    per_interval_locals: list[list[list]] = []
     try:
         cluster.start()
         for _ in range(intervals):
             per_interval.append(cluster.run_interval(
                 traffic.next_interval(n_locals)))
+            # the locals' own emissions (flush duality: mixed-scope
+            # counts/aggregates surface HERE) feed the per-family
+            # exact-count conservation check
+            per_interval_locals.append(cluster.drain_local_sinks())
         acct = cluster.accounting()
         trace_spans = cluster.collect_trace_spans()
         timeline_rows = [r for n in cluster.locals
@@ -95,6 +105,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     sets = verify.check_sets(traffic.oracle, per_interval)
     quantiles = verify.check_quantiles(traffic.oracle, per_interval,
                                        list(percentiles))
+    histo_counts = verify.check_histo_counts(traffic.oracle,
+                                             per_interval_locals)
     routing = verify.check_routing(per_interval)
 
     from veneur_tpu.trace import assembly
@@ -136,6 +148,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                 and trace_report["orphans"] == 0
                 and trace_report["timeline_linked"])
     ok = (counters["exact"] and sets["exact"] and quantiles["ok"]
+          and histo_counts["exact"]
           and routing["exclusive"]
           and all(r["ok"] for r in chaos_rows)
           and (not trace or trace_ok)
@@ -150,6 +163,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             "set_keys": set_keys, "histo_samples": histo_samples,
             "percentiles": list(percentiles),
             "cardinality_key_budget": cardinality_key_budget,
+            "moments_histo_keys": moments_histo_keys,
         },
         "per_tier": {
             "local_flushes": acct["local_flushes"],
@@ -199,6 +213,16 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                 "checked": rec["checked"],
                 "within": rec["within"],
             } for q, rec in quantiles["per_quantile"].items()
+        },
+        # mixed-family ledger: per-family key counts the quantile
+        # check actually gated, plus the exact histogram-count
+        # conservation verdict across both families (the LOCAL tier's
+        # flush-duality counts, integer-exact in both sketches)
+        "sketch_families": {
+            "histo_counts_exact": histo_counts["exact"],
+            "histo_keys_by_family": histo_counts["by_family"],
+            "quantiles_checked_by_family":
+                quantiles["checked_by_family"],
         },
         "routing_exclusive": routing["exclusive"],
         "chaos_matrix": chaos_rows,

@@ -37,6 +37,9 @@ class Oracle:
     sets: dict[tuple[int, str], set] = field(default_factory=dict)
     # (interval, name) -> list of sample values
     histos: dict[tuple[int, str], list] = field(default_factory=dict)
+    # name -> sketch family ("tdigest" default): the accuracy check
+    # gates each histogram key on ITS family's committed envelope
+    histo_family: dict[str, str] = field(default_factory=dict)
 
     def add_counter(self, name: str, v: int) -> None:
         self.counters[name] = self.counters.get(name, 0.0) + v
@@ -44,18 +47,27 @@ class Oracle:
     def add_set(self, interval: int, name: str, member: str) -> None:
         self.sets.setdefault((interval, name), set()).add(member)
 
-    def add_histo(self, interval: int, name: str, v: float) -> None:
+    def add_histo(self, interval: int, name: str, v: float,
+                  family: str = "tdigest") -> None:
         self.histos.setdefault((interval, name), []).append(v)
+        if family != "tdigest":
+            self.histo_family[name] = family
 
 
 class TrafficGen:
     """One instance drives one cluster run; next_interval() returns the
     DogStatsD lines for each local and advances the oracle."""
 
+    # name prefix of moments-family histogram keys; a testbed tier
+    # configured with MOMENTS_RULE routes exactly these to the moments
+    # arena, so one traffic stream drives both families at once
+    MOMENTS_PREFIX = PREFIX + "mh"
+    MOMENTS_RULE = {"match": MOMENTS_PREFIX + "*", "family": "moments"}
+
     def __init__(self, seed: int = 0, counter_keys: int = 8,
                  histo_keys: int = 4, set_keys: int = 2,
                  histo_samples: int = 200, set_members: int = 12,
-                 counter_max: int = 9):
+                 counter_max: int = 9, moments_histo_keys: int = 0):
         self.rng = np.random.default_rng(seed)
         self.oracle = Oracle()
         self.counter_keys = counter_keys
@@ -64,6 +76,7 @@ class TrafficGen:
         self.histo_samples = histo_samples
         self.set_members = set_members
         self.counter_max = counter_max
+        self.moments_histo_keys = moments_histo_keys
         self.interval = 0
 
     def next_interval(self, n_locals: int) -> list[list[bytes]]:
@@ -92,6 +105,20 @@ class TrafficGen:
                 li = j % n_locals
                 lines[li].append(f"{name}:{v:.6f}|h".encode())
                 self.oracle.add_histo(iv, name, float(v))
+
+        # moments-family histograms (mixed scope like the digest keys):
+        # same gamma traffic, names under MOMENTS_PREFIX so the tiers'
+        # sketch_family_rules route them to the moments arena — the
+        # mixed-family cell checks exact count conservation AND each
+        # family's percentile envelope against the same oracle
+        for k in range(self.moments_histo_keys):
+            name = f"{self.MOMENTS_PREFIX}{k}"
+            vals = self.rng.gamma(2.0, 10.0, self.histo_samples)
+            for j, v in enumerate(vals):
+                li = j % n_locals
+                lines[li].append(f"{name}:{v:.6f}|h".encode())
+                self.oracle.add_histo(iv, name, float(v),
+                                      family="moments")
 
         # sets: interval-scoped members (the global's HLL resets each
         # flush, so distinctness is per interval), partitioned across

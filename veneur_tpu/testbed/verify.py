@@ -39,24 +39,37 @@ ENVELOPE_SAFETY = 5.0
 ENVELOPE_FLOOR = 1e-3     # span-relative
 
 
-def load_envelope(path: str = ENVELOPE_CSV) -> dict[float, float]:
-    """Per-quantile worst-case span-relative error across every
-    (distribution, n) cell of the committed dossier."""
-    env: dict[float, float] = {}
+def load_envelope(path: str = ENVELOPE_CSV
+                  ) -> dict[str, dict[float, float]]:
+    """PER-FAMILY per-quantile worst-case span-relative error across
+    every (distribution, n) cell of the committed dossier.  Rows
+    without a family column (pre-family dossiers) count as tdigest."""
+    env: dict[str, dict[float, float]] = {}
     with open(path, newline="") as f:
         for row in csv.DictReader(f):
+            fam = row.get("family") or "tdigest"
             q = float(row["q"])
             err = max(float(row["parallel_err_q"]),
                       float(row["flush_err_q"]))
-            env[q] = max(env.get(q, 0.0), err)
+            fenv = env.setdefault(fam, {})
+            fenv[q] = max(fenv.get(q, 0.0), err)
     return env
 
 
-def envelope_for(q: float, env: dict[float, float]) -> float:
-    """Allowed span-relative error at quantile q: the nearest committed
-    quantile's worst case, widened and floored."""
-    nearest = min(env, key=lambda eq: abs(eq - q))
-    return max(env[nearest] * ENVELOPE_SAFETY, ENVELOPE_FLOOR)
+def envelope_for(q: float, env: dict[str, dict[float, float]],
+                 family: str = "tdigest") -> float:
+    """Allowed span-relative error at quantile q for one sketch
+    family: the nearest committed quantile's worst case, widened and
+    floored.  A family with no committed rows fails loudly — an
+    uncommitted family has no evidence to gate on."""
+    fenv = env.get(family)
+    if not fenv:
+        raise KeyError(
+            f"no committed accuracy envelope for sketch family "
+            f"{family!r} in {ENVELOPE_CSV}; regrow it with "
+            "scripts/tdigest_analysis.py")
+    nearest = min(fenv, key=lambda eq: abs(eq - q))
+    return max(fenv[nearest] * ENVELOPE_SAFETY, ENVELOPE_FLOOR)
 
 
 def _filter(emissions: list) -> list:
@@ -109,18 +122,28 @@ def check_sets(oracle: Oracle, per_interval: list[list[list]]) -> dict:
 
 def check_quantiles(oracle: Oracle, per_interval: list[list[list]],
                     percentiles: list[float],
-                    env: dict[float, float] | None = None) -> dict:
+                    env: dict | None = None) -> dict:
     """Global-tier percentile emissions vs exact numpy quantiles of the
     oracle's raw per-(interval, key) values, span-normalized like the
-    dossier, within the committed envelope."""
+    dossier, within the committed PER-FAMILY envelope (the oracle
+    records which sketch family each histogram key routes to, so a
+    mixed-family dryrun gates every key on its own family's committed
+    evidence)."""
     env = env or load_envelope()
+    families = {"tdigest"} | set(
+        getattr(oracle, "histo_family", {}).values())
     per_q: dict[float, dict] = {
-        q: {"max_span_err": 0.0, "envelope": envelope_for(q, env),
+        q: {"max_span_err": 0.0,
+            "envelope": {fam: envelope_for(q, env, fam)
+                         for fam in sorted(families)},
             "checked": 0, "within": True} for q in percentiles}
     missing = []
+    checked_by_family: dict[str, int] = {}
     for (iv, name), vals in oracle.histos.items():
         if iv >= len(per_interval):
             continue
+        family = getattr(oracle, "histo_family", {}).get(
+            name, "tdigest")
         arr = np.asarray(vals, np.float64)
         span = float(arr.max() - arr.min()) or 1.0
         emitted = {}
@@ -140,11 +163,43 @@ def check_quantiles(oracle: Oracle, per_interval: list[list[list]],
             err = abs(emitted[mname] - exact) / span
             rec = per_q[q]
             rec["checked"] += 1
+            checked_by_family[family] = \
+                checked_by_family.get(family, 0) + 1
             rec["max_span_err"] = max(rec["max_span_err"], err)
-            if err > rec["envelope"]:
+            if err > rec["envelope"][family]:
                 rec["within"] = False
     ok = not missing and all(r["within"] for r in per_q.values())
-    return {"ok": ok, "per_quantile": per_q, "missing": missing[:8]}
+    return {"ok": ok, "per_quantile": per_q, "missing": missing[:8],
+            "checked_by_family": checked_by_family}
+
+
+def check_histo_counts(oracle: Oracle,
+                       per_interval_locals: list[list[list]]) -> dict:
+    """EXACT histogram count conservation across both sketch families:
+    each mixed-scope histogram key's `.count` emissions (the LOCAL
+    tier's flush-duality output, summed over locals and intervals)
+    must equal the oracle's sample count exactly — counts are integer
+    sums in both families (t-digest weight totals, moments vector
+    count entries), so any deviation is loss, not rounding."""
+    want: dict[str, float] = {}
+    for (_iv, name), vals in oracle.histos.items():
+        want[name] = want.get(name, 0.0) + len(vals)
+    got: dict[str, float] = {}
+    for interval in per_interval_locals:
+        for loc in interval:
+            for m in _filter(loc):
+                if m.name.endswith(".count"):
+                    base = m.name[: -len(".count")]
+                    if base in want:
+                        got[base] = got.get(base, 0.0) + m.value
+    mismatched = [(n, w, got.get(n, 0.0)) for n, w in want.items()
+                  if got.get(n, 0.0) != w]
+    by_family: dict[str, int] = {}
+    for name in want:
+        fam = getattr(oracle, "histo_family", {}).get(name, "tdigest")
+        by_family[fam] = by_family.get(fam, 0) + 1
+    return {"exact": not mismatched, "keys": len(want),
+            "by_family": by_family, "mismatched": mismatched[:8]}
 
 
 def check_routing(per_interval: list[list[list]],
