@@ -176,6 +176,33 @@ def main() -> int:
                         "PASS" if mixed_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3e. one fast PROCESS-SEPARATED cell (ISSUE 14): 1 local -> proxy
+    # -> 1 global, every tier its own OS process (port-0 + readback,
+    # health-probed boot), the global killed by REAL SIGKILL mid-run
+    # and revived on the same port — the outage interval must be
+    # visibly accounted (never silent), the revived process must serve
+    # the next interval exactly, and the run is telemetry-witnessed
+    # over the REAL wire: every statsd series the subprocesses emit
+    # (captured on a parent UDP socket) and every scraped /debug/vars
+    # key must exist in the committed schema, with every declared
+    # ledger closure holding over the scraped counters (the full
+    # real-fault matrix is `scripts/dryrun_3tier.py --procs --chaos
+    # all`)
+    proc_rc = 0
+    if args.fast:
+        results.append(("proc chaos cell", "SKIP", 0.0))
+    else:
+        t0 = stage("proc chaos cell (proc-host-loss, real SIGKILL, "
+                   "telemetry-witnessed)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py",
+             "--chaos-only", "proc-host-loss", "--telemetry"],
+            env=env)
+        results.append(("proc chaos cell",
+                        "PASS" if proc_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -195,7 +222,7 @@ def main() -> int:
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
-               or egress_rc or mixed_rc or test_rc) else 0
+               or egress_rc or mixed_rc or proc_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

@@ -14,6 +14,17 @@ Usage:
   python scripts/dryrun_3tier.py --chaos forward-outage --out report.json
   python scripts/dryrun_3tier.py --chaos-only ring-scale-up   # one cell
   python scripts/dryrun_3tier.py --cardinality-budget 8  # tenant budgets
+  python scripts/dryrun_3tier.py --procs  # PROCESS-SEPARATED fleet:
+                                          # every tier its own OS
+                                          # process, verified over
+                                          # HTTP-scraped state
+  python scripts/dryrun_3tier.py --procs --globals 2 --mesh-devices 8
+                                          # meshed globals over real
+                                          # multi-process gloo
+  python scripts/dryrun_3tier.py --procs --chaos all  # real-fault
+                                          # matrix: SIGKILL host loss,
+                                          # SIGSTOP stragglers,
+                                          # crash/revive + replay
   python scripts/dryrun_3tier.py --trace   # traced: every interval must
                                            # assemble into ONE complete
                                            # 3-tier trace (incl. the
@@ -59,6 +70,17 @@ def main(argv=None) -> int:
                     "percentile envelopes both gate the run")
     ap.add_argument("--chaos", default=None,
                     help="chaos arm name, or 'all' for the full matrix")
+    ap.add_argument("--procs", action="store_true",
+                    help="run the PROCESS-SEPARATED cluster "
+                    "(testbed/proccluster.py): every tier is its own "
+                    "OS process with its own config YAML, ports bound "
+                    "at 0 and read back, health-probed readiness, and "
+                    "all verification over HTTP-scraped state; "
+                    "--chaos selects the real-fault matrix "
+                    "(SIGKILL/SIGSTOP/crash-revive), and "
+                    "--mesh-devices with --globals > 1 meshes the "
+                    "global tier over real multi-process gloo "
+                    "collectives")
     ap.add_argument("--chaos-only", default=None, metavar="ARM",
                     help="run ONE chaos arm (no surrounding dryrun) and "
                     "emit just its row — the fast CI reshard cell")
@@ -156,7 +178,8 @@ def main(argv=None) -> int:
         cardinality_key_budget=args.cardinality_budget,
         moments_histo_keys=args.moments_keys,
         chaos=args.chaos, lock_witness=args.lock_witness,
-        trace=args.trace, telemetry=args.telemetry)
+        trace=args.trace, telemetry=args.telemetry,
+        procs=args.procs)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:
@@ -183,7 +206,8 @@ def main(argv=None) -> int:
                  f"{'EXACT' if sf['histo_counts_exact'] else 'LOST'}, "
                  f"quantiles checked "
                  f"{sf['quantiles_checked_by_family']}")
-    print(f"# 3-tier dryrun OK: {report['forwarded']} forwarded, "
+    print(f"# 3-tier dryrun{' (procs)' if args.procs else ''} OK: "
+          f"{report['forwarded']} forwarded, "
           f"{report['imported']} imported, {report['retried']} retried, "
           f"{report['dropped']} dropped; "
           f"{len(report['chaos_matrix'])} chaos arm(s){tail}",

@@ -203,3 +203,22 @@ def test_slow_sink_straggler_isolation():
         assert b"flush:metric:slow" in blob
     finally:
         srv.shutdown()
+
+
+def test_frozen_global_window_dedups_thawed_original():
+    """server.sigstop_window fast cell (ISSUE 14): the global's import
+    handler freezes past the forward deadline — the in-process twin of
+    a SIGSTOP'd peer.  The client must surface DEADLINE_EXCEEDED
+    (never hang the flush), the bounded retry re-delivers under the
+    SAME chunk identity, and when the window ends the thawed original
+    import completes anyway — the dedup ledger must merge exactly
+    once.  (The real-signal version is `proc-straggler` in
+    testbed/proc_chaos.py.)"""
+    from veneur_tpu.testbed.chaos import arm_by_name, run_chaos_arm
+
+    row = run_chaos_arm(arm_by_name("frozen-global-window"), seed=3)
+    assert row["ok"], row
+    assert row["fired"] >= 1
+    assert row["conserved"] and row["dropped_total"] == 0
+    assert row["forward_retries"] >= 1
+    assert row["duplicates_skipped"] >= 1

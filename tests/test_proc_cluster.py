@@ -1,0 +1,91 @@
+"""Process-separated harness under REAL signal delivery (ISSUE 14).
+
+PR 9 installed the SIGTERM -> checkpoint-on-shutdown handler
+(cli/veneur.py routes the signal through Server.shutdown); until now it
+had only ever been exercised by calling shutdown() in-process.  Here a
+real `kill -TERM` lands on a real subprocess booted from YAML, and the
+proof is entirely over the process boundary: exit code, on-disk
+checkpoint artifacts, and the revived instance's scraped /debug/vars.
+
+Kept to ONE subprocess node so the cell stays tier-1-fast; the full
+3-tier proc fleet and the real-fault matrix run in check.py stage 3e
+and `scripts/dryrun_3tier.py --procs --chaos all`.
+"""
+
+import os
+import time
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.core import checkpoint as ckpt_mod
+from veneur_tpu.forward import convert
+from veneur_tpu.protocol import forward_pb2
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.samplers.metric_key import MetricScope
+from veneur_tpu.testbed.proccluster import ProcCluster, ProcClusterSpec
+
+
+def _import_counter(grpc_port: int, name: str, value: int) -> None:
+    """One V1 MetricList import over the parent's own channel — real
+    cross-process ingest into the subprocess global."""
+    body = forward_pb2.MetricList(metrics=[convert.to_pb(
+        sm.ForwardMetric(name=name, tags=[], kind="counter",
+                         scope=MetricScope.GLOBAL_ONLY,
+                         counter_value=value))]).SerializeToString()
+    channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+    try:
+        send = channel.unary_unary(
+            "/forwardrpc.Forward/SendMetrics",
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
+        send(body, timeout=10.0, wait_for_ready=True)
+    finally:
+        channel.close()
+
+
+def test_sigterm_checkpoint_then_revive_restores_state():
+    # single durable global subprocess (direct: no proxy, no locals)
+    cluster = ProcCluster(ProcClusterSpec(
+        n_locals=0, n_globals=1, direct=True, durable=True))
+    try:
+        cluster.start()
+        g = cluster.globals[0]
+        _import_counter(g.grpc_port, "sigterm.counter", 7)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            v = cluster.scrape_vars(g) or {}
+            if v.get("imported_total", 0) >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(f"import never landed:\n"
+                               f"{cluster.node_log(g)}")
+        assert (v.get("checkpoint") or {}).get("writes", 0) == 0
+
+        # REAL SIGTERM: the handler unblocks serve(), the teardown
+        # checkpoints (flush_on_shutdown defaults off, so the imported
+        # counter rides the checkpoint, not a final flush)
+        rc = cluster.terminate_node(g)
+        assert rc == 0, (f"graceful exit rc={rc}:\n"
+                         f"{cluster.node_log(g)}")
+        committed = ckpt_mod.checkpoint_path(g.ckpt_dir)
+        assert os.path.exists(committed), \
+            "SIGTERM teardown wrote no checkpoint"
+        assert not os.path.exists(committed + ".tmp"), \
+            "torn tempfile left next to the committed checkpoint"
+
+        # a NEW process over the same dirs must restore that state
+        cluster.revive_global(0)
+        g2 = cluster.globals[0]
+        post = cluster.scrape_vars(g2) or {}
+        assert (post.get("checkpoint") or {}).get("restores", 0) == 1, \
+            post.get("checkpoint")
+        # and the restored aggregator still holds the pre-TERM import:
+        # flushing the revived instance emits the counter
+        cluster._post(g2, "/flush")
+        emitted = cluster._read_emissions(g2)
+        rows = {m.name: m.value for m in emitted}
+        assert rows.get("sigterm.counter") == 7, rows
+    finally:
+        cluster.stop()

@@ -116,6 +116,16 @@ PAIRS = (
     PairSpec("egress job handoff",
              frozenset({"claim_job"}),
              frozenset({"settle_job"})),
+    # process-separated testbed node lifetime (testbed/proccluster.py):
+    # every spawn_node (a real OS subprocess with its own spool/
+    # checkpoint dirs and log capture) must end in terminate_node
+    # (graceful SIGTERM teardown / SIGKILL fault injection) or
+    # harvest_node (post-mortem reap of an already-dead child) on ALL
+    # paths — a leaked subprocess outlives the test run, holds its
+    # ports, and turns every later cell's bind into an EADDRINUSE flake
+    PairSpec("proc-cluster node",
+             frozenset({"spawn_node"}),
+             frozenset({"terminate_node", "harvest_node"})),
 )
 
 

@@ -749,6 +749,46 @@ class TelemetryWitness:
             with self._mu:
                 self._vars[key] = {"tier": tier, "vars": snap}
 
+    # statsd wire type char -> the schema's type vocabulary
+    _STATSD_TYPES = {"c": "counter", "g": "gauge", "h": "histogram",
+                     "ms": "timing", "s": "set"}
+
+    def record_statsd_payload(self, payload: bytes) -> None:
+        """HTTP/UDP-scrape equivalent of the in-process recording
+        client: parse a statsd datagram a witnessed SUBPROCESS tier
+        sent to the harness's capture socket and record each line's
+        (name, type).  Malformed lines are skipped — the witness
+        records what was emitted, it is not a validator (the schema
+        comparison will still flag unknown series)."""
+        for line in payload.split(b"\n"):
+            if not line:
+                continue
+            head, _, rest = line.decode(errors="replace") \
+                .partition("|")
+            name = head.split(":", 1)[0]
+            tchar = rest.split("|", 1)[0]
+            mtype = self._STATSD_TYPES.get(tchar)
+            if not name or not mtype:
+                continue
+            # the wire carries the ScopedClient's reference-compatible
+            # "veneur." namespace; the schema (and the in-process
+            # recorder, which wraps the client ABOVE the namespace)
+            # know series by their bare names
+            if name.startswith("veneur."):
+                name = name[len("veneur."):]
+            self.record(name, mtype)
+
+    def add_vars_snapshot(self, tier: str, snap: dict) -> None:
+        """HTTP-scrape equivalent of collect(): register one tier's
+        /debug/vars payload (already-parsed JSON) under a fresh token.
+        The process-separated testbed scrapes every tier at teardown
+        and feeds the snapshots here, so compare_runtime works
+        identically against either cluster flavor."""
+        with self._mu:
+            self._vars[self._next_token] = {"tier": tier,
+                                            "vars": dict(snap)}
+            self._next_token += 1
+
     def snapshot(self) -> dict:
         with self._mu:
             return {

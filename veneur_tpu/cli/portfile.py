@@ -1,0 +1,22 @@
+"""Resolved-address readback shared by both CLI entrypoints.
+
+Every listener may bind port 0; the supervising harness
+(testbed/proccluster.py, systemd, k8s) reads the REAL ports from the
+port file.  tempfile + os.replace so a reader never sees a torn JSON —
+the file's appearance doubles as the boot-complete marker, so writers
+must install their signal handlers BEFORE calling this.
+"""
+
+import json
+import os
+
+
+def write_port_file(path: str, ports: dict) -> None:
+    ports = dict(ports)
+    ports["pid"] = os.getpid()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(ports))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -36,6 +36,13 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _write_port_file(path: str, server, api) -> None:
+    from veneur_tpu.cli.portfile import write_port_file
+    ports = server.resolved_ports()
+    ports["http"] = list(api.address) if api is not None else None
+    write_port_file(path, ports)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.version:
@@ -73,18 +80,37 @@ def main(argv=None) -> int:
     from veneur_tpu.core.server import Server
     from veneur_tpu.http_api import HttpApi
 
-    server = Server(cfg)
-    server.start()
+    # boot failures must be a crisp nonzero exit with the cause on
+    # stderr, not a stack trace racing daemon-thread teardown — the
+    # supervising process (systemd, k8s, testbed/proccluster.py) keys
+    # restart/giving-up decisions off this
+    server = None
     api = None
-    if cfg.http_address:
-        api = HttpApi(server, cfg.http_address)
-        api.start()
+    try:
+        server = Server(cfg)
+        server.start()
+        if cfg.http_address:
+            api = HttpApi(server, cfg.http_address)
+            api.start()
+    except Exception as e:
+        logging.getLogger("veneur_tpu").exception("server boot failed")
+        print(f"server boot failed: {e}", file=sys.stderr)
+        if server is not None:
+            try:
+                server.shutdown()
+            except Exception:
+                pass
+        return 1
 
     def on_signal(signum, frame):
         # only unblock serve(); the full teardown (which may flush and
         # take locks the interrupted frame already holds) runs below
         server.stop_serving()
 
+    # handlers BEFORE the port file: its appearance is the
+    # boot-complete marker, and a supervisor may react to it with a
+    # signal immediately — the default disposition would kill the
+    # process without the checkpoint-on-shutdown pass
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
     # SIGUSR2 = zero-drop restart handoff (server.go:1365-1413): the
@@ -92,6 +118,9 @@ def main(argv=None) -> int:
     # group), then signals this process to drain and exit
     signal.signal(signal.SIGUSR2,
                   lambda s, f: server.request_graceful_restart())
+
+    if cfg.port_file:
+        _write_port_file(cfg.port_file, server, api)
 
     try:
         server.serve()  # blocking flush-ticker loop

@@ -49,18 +49,28 @@ def main(argv=None) -> int:
         discoverer = ConsulDiscoverer(data.get("consul_url",
                                                "http://127.0.0.1:8500"))
 
-    proxy = Proxy(cfg, discoverer=discoverer)
-    proxy.start()
+    try:
+        proxy = Proxy(cfg, discoverer=discoverer)
+        proxy.start()
+    except Exception as e:
+        logging.exception("proxy boot failed")
+        print(f"proxy boot failed: {e}", file=sys.stderr)
+        return 1
     logging.info("proxy serving grpc=:%d http=:%d", proxy.grpc_port,
                  proxy.http_port)
-
     stop = {"done": False}
 
     def on_signal(signum, frame):
         stop["done"] = True
 
+    # handlers BEFORE the port file (its appearance is the
+    # boot-complete marker — see cli/portfile.py)
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
+    if cfg.port_file:
+        from veneur_tpu.cli.portfile import write_port_file
+        write_port_file(cfg.port_file, {"grpc": proxy.grpc_port,
+                                        "http": proxy.http_port})
     try:
         while not stop["done"]:
             time.sleep(0.2)

@@ -91,6 +91,16 @@ class Config:
     # forward.dropped_total / /debug/vars, never silent
     forward_max_retries: int = 2
     forward_retry_backoff: float = 0.05   # base backoff ("50ms", doubles)
+    # DEADLINE_EXCEEDED joins the retry-safe forward status codes.  A
+    # deadline is AMBIGUOUS (the peer may have imported the chunk after
+    # the client gave up — a SIGSTOP'd or GC-paused global thaws and
+    # keeps going), so this is only safe when the forward peer is a
+    # ledger-bearing global of THIS framework (direct local->global
+    # fleets): every V1 chunk carries its stable identity and the
+    # global's dedup ledger merges re-delivery exactly once.  Leave it
+    # off when forwarding through a proxy (the proxy re-shards without
+    # a ledger, so re-delivery could double-count).
+    forward_deadline_retry_safe: bool = False
     # crash durability (forward/spool.py + core/checkpoint.py).
     # spool_dir != "": when the bounded retries exhaust, provably-
     # chunked V1 payloads spill to an on-disk segment spool (length-
@@ -306,6 +316,20 @@ class Config:
     trace_ring_capacity: int = 512
     http_quit: bool = False
     http_config_endpoint: bool = False
+    # operator-driven flush/checkpoint: POST /flush and POST /checkpoint
+    # on the HTTP API run one synchronous flush / checkpoint.  The
+    # process-separated testbed drives intervals through these instead
+    # of wall-clock tickers (explicit interval boundaries are what make
+    # exact cross-process conservation assertable); production keeps
+    # them off — an unauthenticated flush trigger is a DoS lever.
+    http_flush_endpoint: bool = False
+    # boot-from-YAML port readback: after the listeners bind, the entry
+    # point writes a JSON file {statsd: [...], grpc: N, http: N} of the
+    # RESOLVED addresses (tempfile + atomic rename).  Every listener can
+    # then bind port 0 — a supervising harness (testbed/proccluster.py)
+    # reads real ports back instead of assuming fixed ones, so parallel
+    # CI runs cannot flake on EADDRINUSE.  "" = no file.
+    port_file: str = ""
     # accepted for reference-config compatibility; Go-runtime-specific
     # knobs with no Python analog (profiling here is /debug/profile)
     mutex_profile_fraction: int = 0

@@ -435,6 +435,19 @@ class Server:
     def is_local(self) -> bool:
         return self.config.is_local
 
+    def resolved_ports(self) -> dict:
+        """The ACTUAL bound addresses after start() — what a
+        supervising harness needs when every listener bound port 0
+        (config.port_file; cli/veneur.py writes this dict as JSON)."""
+        return {
+            "statsd": [[scheme, list(addr) if isinstance(addr, tuple)
+                        else str(addr)]
+                       for scheme, addr in self.statsd_addrs],
+            "grpc": (self.grpc_import.port
+                     if self.grpc_import is not None else 0),
+            "hostname": self.config.hostname,
+        }
+
     # -- ingestion handlers (server.go:942-1011) ---------------------------
 
     def handle_metric_packet(self, packet: bytes) -> None:
@@ -575,7 +588,9 @@ class Server:
                     attempts=self.config.forward_max_retries + 1,
                     backoff_base_s=self.config.forward_retry_backoff),
                 spool=spool, source=self.config.hostname,
-                trace_recorder=self.flight_recorder)
+                trace_recorder=self.flight_recorder,
+                deadline_retry_safe=self.config
+                .forward_deadline_retry_safe)
         if self.lock_witness is not None:
             # testbed/dryrun lock witness (analysis/witness.py): wrap
             # the named locks NOW — native plane and forwarder exist,

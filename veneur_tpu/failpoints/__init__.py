@@ -36,6 +36,14 @@ Injection sites threaded through this repo (grep `failpoints.inject`):
                       row is released — a fault here aborts the pass
                       with quota state intact  (core/aggregator.py)
   server.flush        top of the flush path    (core/server.py)
+  server.sigstop_window  top of the global tier's V1 import handler
+                      (sources/proxy.py): a `delay` action freezes the
+                      handler for a bounded window — the in-process
+                      twin of a SIGSTOP'd global (the RPC neither
+                      refuses nor resets, it just hangs past the
+                      sender's deadline, then completes), so the fast
+                      tier-1 cell exercises the frozen-peer deadline +
+                      dedup path without real signals
   spool.io            durable-spool disk I/O: the spill append (write/
                       fsync) and the replay read — a fault degrades to
                       drop-with-accounting, never a wedged forward

@@ -92,6 +92,14 @@ RETRYABLE_CODES = frozenset({
     grpc.StatusCode.ABORTED,
 })
 
+# DEADLINE_EXCEEDED is AMBIGUOUS: a frozen (SIGSTOP'd, GC-paused,
+# overloaded) peer neither refuses nor resets — it just hangs, and may
+# import the chunk after the client gives up.  Re-sending is safe ONLY
+# against a ledger-bearing global of this framework, where the chunk's
+# stable identity makes re-delivery idempotent
+# (config.forward_deadline_retry_safe).
+DEADLINE_CODES = frozenset({grpc.StatusCode.DEADLINE_EXCEEDED})
+
 
 @dataclass
 class RetryPolicy:
@@ -141,15 +149,25 @@ class _SendFailure(Exception):
         self.retry_safe = retry_safe
 
 
-def _retry_safe(exc: BaseException) -> bool:
+def _code_of(exc: BaseException):
+    """The grpc status code, or None (code() can fail on odd
+    errors)."""
+    if not isinstance(exc, grpc.RpcError):
+        return None
+    try:
+        return exc.code()
+    except Exception:   # noqa: BLE001 - code() can fail on odd errors
+        return None
+
+
+def _retry_safe(exc: BaseException,
+                deadline_safe: bool = False) -> bool:
     if isinstance(exc, failpoints.FailpointDrop):
         return True
-    if isinstance(exc, grpc.RpcError):
-        try:
-            return exc.code() in RETRYABLE_CODES
-        except Exception:   # noqa: BLE001 - code() can fail on odd errors
-            return False
-    return False
+    code = _code_of(exc)
+    return code is not None and (
+        code in RETRYABLE_CODES or (
+            deadline_safe and code in DEADLINE_CODES))
 
 
 class ForwardClient:
@@ -158,7 +176,8 @@ class ForwardClient:
                  timeout_s: float = 10.0, max_streams: int = 8,
                  retry: Optional[RetryPolicy] = None,
                  spool=None, source: str = "",
-                 trace_recorder=None):
+                 trace_recorder=None,
+                 deadline_retry_safe: bool = False):
         """`spool` (a forward.spool.ForwardSpool) makes exhausted
         retries crash-durable: identified V1 chunks spill to disk and a
         background replayer re-delivers them oldest-first once the
@@ -171,6 +190,10 @@ class ForwardClient:
         self.address = address
         self.timeout_s = timeout_s
         self.max_streams = max(1, max_streams)
+        # DEADLINE_EXCEEDED joins the retry-safe codes only when the
+        # deployment says the peer is a ledger-bearing global
+        # (config.forward_deadline_retry_safe; see DEADLINE_CODES)
+        self.deadline_retry_safe = bool(deadline_retry_safe)
         self.retry = retry or RetryPolicy()
         self._retry_rng = random.Random(self.retry.seed)
         if credentials is not None:
@@ -232,6 +255,11 @@ class ForwardClient:
     def _count(self, field: str, n: int) -> None:
         with self._stats_lock:
             setattr(self, field, getattr(self, field) + n)
+
+    def _rsafe(self, exc: BaseException) -> bool:
+        """This client's retry-safety verdict for one failure (the
+        module-level table plus the deadline opt-in)."""
+        return _retry_safe(exc, self.deadline_retry_safe)
 
     def send(self, metrics: list[sm.ForwardMetric],
              trace_parent=None, epoch: Optional[int] = None) -> None:
@@ -358,7 +386,23 @@ class ForwardClient:
         forward.replay span continuing the original interval's trace
         context so the cross-tier assembler sees one trace across the
         crash.  Retry-safe failures re-raise as RetryableReplayError
-        (the spool keeps the record for the next tick)."""
+        (the spool keeps the record for the next tick).
+
+        The RPC runs wait_for_ready: a fail-fast RPC on a channel
+        whose peer DIED (real SIGKILL, not a refused dial) leaves the
+        subchannel wedged in TRANSIENT_FAILURE — grpc never re-dials
+        for it, so every replay tick fails UNAVAILABLE forever even
+        after the peer revives on the same port, and the record ages
+        out.  A queued (wait-for-ready) pick keeps the channel
+        dialing; the deadline still bounds each attempt.  Whether an
+        expired deadline KEEPS the record follows the same
+        forward_deadline_retry_safe gate as live sends: against a
+        ledger-bearing peer the next tick's re-delivery under the
+        same chunk identity merges exactly once, but through a PROXY
+        (which re-shards per-metric and does not propagate chunk
+        identity) an ambiguous deadline re-delivery would double-
+        count — there the record is dropped with accounting, same as
+        a live send."""
         from veneur_tpu.forward import spool as spool_mod
         span = None
         if rec.trace_id and rec.span_id:
@@ -373,11 +417,11 @@ class ForwardClient:
                                                span.span_id)
         try:
             self._v1_raw(body, timeout=self.timeout_s,
-                         metadata=metadata)
+                         metadata=metadata, wait_for_ready=True)
         except grpc.RpcError as e:
             if span is not None:
                 span.error = True
-            if _retry_safe(e):
+            if self._rsafe(e):
                 raise spool_mod.RetryableReplayError(str(e)) from e
             raise
         finally:
@@ -393,7 +437,7 @@ class ForwardClient:
         try:
             failpoints.inject("forward.send")
         except (failpoints.FailpointDrop, grpc.RpcError) as e:
-            raise _SendFailure(chunks, e, _retry_safe(e)) from e
+            raise _SendFailure(chunks, e, self._rsafe(e)) from e
         if self._use_v1 is not False:
             try:
                 self._send_v1_batches(chunks, metadata=metadata)
@@ -459,7 +503,7 @@ class ForwardClient:
                            metadata=metadata)
 
         def stream_safe(st: _Stream, e: BaseException) -> bool:
-            return st.pulled == 0 and _retry_safe(e)
+            return st.pulled == 0 and self._rsafe(e)
 
         if n_streams == 1:
             st = _Stream()
@@ -519,7 +563,7 @@ class ForwardClient:
             if e.code() == grpc.StatusCode.UNIMPLEMENTED:
                 raise _V1Unsupported() from e
             # nothing delivered yet: every chunk is undelivered
-            raise _SendFailure(list(chunks), e, _retry_safe(e)) from e
+            raise _SendFailure(list(chunks), e, self._rsafe(e)) from e
         self._count("sent", len(chunks[0].pbs))
         if len(chunks) == 1:
             return
@@ -567,12 +611,12 @@ class ForwardClient:
                 undelivered.append(_Chunk(f.undelivered))
                 raise _SendFailure(
                     undelivered, f.cause,
-                    f.retry_safe and all(_retry_safe(e) for e in errs)
+                    f.retry_safe and all(self._rsafe(e) for e in errs)
                 ) from f.cause
         if errs:
             raise _SendFailure(
                 undelivered, errs[0],
-                all(_retry_safe(e) for e in errs)) from errs[0]
+                all(self._rsafe(e) for e in errs)) from errs[0]
 
     def _send_v1_chunk(self, chunk: _Chunk, metadata=None) -> None:
         self._v1(forward_pb2.MetricList(metrics=chunk.pbs),

@@ -204,6 +204,36 @@ def make_handler(server) -> type:
                 self._reply(200, b"terminating\n")
                 threading.Thread(target=server.shutdown, daemon=True).start()
                 return
+            if self.path == "/flush" and cfg.http_flush_endpoint:
+                # the process-separated testbed's interval driver: one
+                # synchronous flush, so a supervising harness controls
+                # interval boundaries across real process boundaries
+                # exactly like the in-process cluster calls
+                # server.flush().  Gated: an unauthenticated flush
+                # trigger is a DoS lever in production.
+                try:
+                    server.flush()
+                except Exception as e:
+                    self._reply(500, f"flush failed: {e}\n".encode())
+                    return
+                self._reply(200, json.dumps(
+                    {"flush_count": server.flush_count}).encode(),
+                    "application/json")
+                return
+            if self.path == "/checkpoint" and cfg.http_flush_endpoint:
+                # crash-arm plumbing: force a checkpoint cut NOW (the
+                # cross-process analog of Cluster.checkpoint_global)
+                try:
+                    ok = server.checkpoint_now()
+                except Exception as e:
+                    self._reply(500,
+                                f"checkpoint failed: {e}\n".encode())
+                    return
+                self._reply(200 if ok else 500, json.dumps(
+                    {"ok": bool(ok),
+                     "writes": server.checkpoint_stats["writes"]}
+                ).encode(), "application/json")
+                return
             self._reply(404, b"not found\n")
 
         def do_GET(self):
@@ -275,6 +305,24 @@ def make_handler(server) -> type:
                 out = {"capacity": timeline.capacity,
                        "recorded_total": timeline.total_recorded,
                        "records": timeline.snapshot(last)}
+                self._reply(200, json.dumps(out, indent=2).encode(),
+                            "application/json")
+            elif self.path.startswith("/debug/spans"):
+                # raw ring records for the cross-process trace
+                # assembler; ?drain=1 takes them atomically so repeated
+                # scrapes return disjoint batches (testbed/proccluster)
+                from veneur_tpu.trace import recorder as trace_rec
+                recorder = getattr(server, "flight_recorder", None)
+                if recorder is None:
+                    self._reply(404, b"no flight recorder\n")
+                    return
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                try:
+                    out = trace_rec.debug_spans_body(recorder, q)
+                except ValueError:
+                    self._reply(400, b"bad drain\n")
+                    return
                 self._reply(200, json.dumps(out, indent=2).encode(),
                             "application/json")
             elif self.path.startswith("/debug/trace"):

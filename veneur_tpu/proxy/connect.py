@@ -82,12 +82,22 @@ class Destination:
     def __init__(self, address: str, send_buffer_size: int = 1024,
                  on_closed: Optional[Callable[["Destination"], None]] = None,
                  dial_timeout_s: float = 5.0, n_streams: int = 8,
-                 send_timeout_s: float = 30.0):
+                 send_timeout_s: float = 30.0,
+                 stream_timeout_s: float = 0.0):
         failpoints.inject("proxy.connect")
         self.address = address
         # per-RPC send deadline (config: proxy_send_timeout) — was a
         # hard-coded 30.0 in _send_batch/_send_raw_item
         self.send_timeout_s = send_timeout_s
+        # V2 stream lifetime deadline (config: proxy_stream_timeout).
+        # 0 = reference semantics: the long-lived stream has NO
+        # deadline, so a SIGSTOP'd/frozen reference global wedges its
+        # sender until the buffer backpressures.  Nonzero bounds every
+        # stream: a frozen peer surfaces as DEADLINE_EXCEEDED — the
+        # destination closes with its buffer counted dropped and the
+        # ring routes around — at the cost of re-dialing healthy
+        # streams every stream_timeout_s.
+        self.stream_timeout_s = stream_timeout_s
         self.closed = threading.Event()
         self._closing = threading.Event()     # graceful close() marker
         self.on_closed = on_closed
@@ -404,7 +414,7 @@ class Destination:
 
         try:
             failpoints.inject("proxy.stream")
-            self._v2(it())
+            self._v2(it(), timeout=self.stream_timeout_s or None)
         except (grpc.RpcError, failpoints.FailpointDrop,
                 ValueError) as e:
             _reraise_unless_closed_channel(e)

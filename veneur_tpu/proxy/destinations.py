@@ -70,6 +70,7 @@ class Destinations:
     def __init__(self, send_buffer_size: int = 1024, grpc_stats=None,
                  n_streams: int = 8, send_timeout_s: float = 30.0,
                  dial_timeout_s: float = 5.0,
+                 stream_timeout_s: float = 0.0,
                  breaker_threshold: int = 3,
                  breaker_reset_s: float = 5.0,
                  handoff=None,
@@ -81,6 +82,7 @@ class Destinations:
         self.grpc_stats = grpc_stats
         self.send_timeout_s = send_timeout_s
         self.dial_timeout_s = dial_timeout_s
+        self.stream_timeout_s = stream_timeout_s
         self.breaker_threshold = max(1, breaker_threshold)
         self.breaker_reset_s = breaker_reset_s
         # reshard drain-and-forward: `handoff(metrics)` re-routes a
@@ -226,7 +228,8 @@ class Destinations:
                            on_closed=self._connection_closed,
                            n_streams=self.n_streams,
                            send_timeout_s=self.send_timeout_s,
-                           dial_timeout_s=self.dial_timeout_s)
+                           dial_timeout_s=self.dial_timeout_s,
+                           stream_timeout_s=self.stream_timeout_s)
         if self.grpc_stats is not None:
             self.grpc_stats.watch_channel(dest.channel)
         return dest
@@ -480,6 +483,14 @@ class Destinations:
         with self._lock:
             addr = self._ring.get(key)
             return self._dests[addr]
+
+    def all_members(self) -> list:
+        """Every live destination in a STABLE order (sorted by
+        address): the mesh_fanout path sends each batch to all of them
+        identically, so the iteration order must not depend on
+        insertion/discovery timing."""
+        with self._lock:
+            return [self._dests[a] for a in sorted(self._dests)]
 
     def ring_arrays(self):
         """Snapshot of the ring as flat arrays for the native router

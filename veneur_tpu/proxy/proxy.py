@@ -53,6 +53,13 @@ class ProxyConfig:
     # (were hard-coded 30.0/5.0 in proxy/connect.py)
     proxy_send_timeout: float = 30.0
     proxy_dial_timeout: float = 5.0
+    # V2 stream lifetime deadline (0 = reference semantics: no
+    # deadline — a frozen reference global wedges its sender until the
+    # buffer backpressures; nonzero makes a SIGSTOP'd peer surface as
+    # DEADLINE_EXCEEDED and the ring route around, at the cost of
+    # re-dialing healthy streams every window).  Batch-mode (V1)
+    # destinations always run per-RPC deadlines (proxy_send_timeout)
+    proxy_stream_timeout: float = 0.0
     # per-destination circuit breaker (proxy/destinations.py): after
     # breaker_failure_threshold consecutive failures the address is
     # tripped out of the ring (keys route around via consistent hashing)
@@ -90,6 +97,23 @@ class ProxyConfig:
     # forward RPCs carrying a trace context get a proxy.route span;
     # breaker transitions and reshard windows are recorded as spans too
     trace_ring_capacity: int = 512
+    # boot port readback (cli/veneur_proxy.py): after the listeners
+    # bind, the entry point writes {grpc: N, http: N} of the RESOLVED
+    # ports here (atomic rename), so a supervising harness can bind
+    # port 0 everywhere and read real ports back.  "" = no file
+    port_file: str = ""
+    # the destination set is ONE meshed global group
+    # (parallel/multihost.py) instead of a consistent-hash ring: every
+    # inbound batch goes to EVERY member, in identical enqueue order
+    # (one fanout lock around the enqueue loop; batch-mode
+    # destinations each drain a single ordered lane).  Identical
+    # arrival order is what gives the mesh its lockstep contract —
+    # every member registers every key at the same dense row — while
+    # `serving.put` slices each process's own shards, so the COMPUTE
+    # stays sharded even though ingest is replicated.  Exactly-once
+    # emission is the deployment's side: configure metric sinks on the
+    # leader member only.
+    mesh_fanout: bool = False
 
 
 def proxy_config_from_dict(data: dict) -> ProxyConfig:
@@ -110,6 +134,8 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
             data.get("proxy_send_timeout", 30.0)),
         proxy_dial_timeout=parse_duration(
             data.get("proxy_dial_timeout", 5.0)),
+        proxy_stream_timeout=parse_duration(
+            data.get("proxy_stream_timeout", 0.0)),
         breaker_failure_threshold=int(
             data.get("breaker_failure_threshold", 3)),
         breaker_reset_timeout=parse_duration(
@@ -129,7 +155,9 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
         http_enable_config=bool(data.get("http_enable_config", False)),
         http_enable_profiling=bool(
             data.get("http_enable_profiling", False)),
-        trace_ring_capacity=int(data.get("trace_ring_capacity", 512)))
+        trace_ring_capacity=int(data.get("trace_ring_capacity", 512)),
+        port_file=data.get("port_file", ""),
+        mesh_fanout=bool(data.get("mesh_fanout", False)))
 
 
 def redacted_proxy_dict(cfg: ProxyConfig, redact: bool = True) -> dict:
@@ -183,6 +211,7 @@ class Proxy:
             grpc_stats=self.grpc_stats,
             send_timeout_s=cfg.proxy_send_timeout,
             dial_timeout_s=cfg.proxy_dial_timeout,
+            stream_timeout_s=cfg.proxy_stream_timeout,
             breaker_threshold=cfg.breaker_failure_threshold,
             breaker_reset_s=cfg.breaker_reset_timeout,
             # reshard drain-and-forward: a retiring destination's
@@ -194,6 +223,11 @@ class Proxy:
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
                       "no_destination": 0, "rerouted": 0}
         self._stats_lock = threading.Lock()
+        # mesh_fanout: held across the whole enqueue loop so every
+        # member's single ordered lane sees the SAME batch sequence —
+        # identical arrival order is the consistent-registration half
+        # of the multihost lockstep contract
+        self._fanout_lock = threading.Lock()
         self._shutdown = threading.Event()
         # native wire router, resolved lazily (None = untried,
         # False = unavailable)
@@ -306,8 +340,38 @@ class Proxy:
                 if not any(tm.match(t) for tm in self.cfg.ignore_tags)]
         return f"{m.name}{_TYPE_NAMES.get(m.type, '')}{','.join(tags)}"
 
+    def _fanout(self, ms: list, trace_ctx=None) -> None:
+        """mesh_fanout routing: every member of the meshed global
+        group receives the SAME metrics in the SAME order (the fanout
+        lock spans the enqueue loop; each batch-mode destination
+        drains one ordered lane).  Per-copy accounting: the proxy
+        genuinely performed members x len(ms) sends, and the
+        received == routed + dropped ledger must close over what it
+        did, not over the logical metric count."""
+        members = self.destinations.all_members()
+        if not members:
+            with self._stats_lock:
+                self.stats["received"] += len(ms)
+                self.stats["no_destination"] += len(ms)
+            return
+        routed = dropped = 0
+        with self._fanout_lock:
+            for dest in members:
+                if trace_ctx is not None:
+                    dest.attach_trace(trace_ctx)
+                n_drop = dest.send_many(ms)
+                dropped += n_drop
+                routed += len(ms) - n_drop
+        with self._stats_lock:
+            self.stats["received"] += len(ms) * len(members)
+            self.stats["routed"] += routed
+            self.stats["dropped"] += dropped
+
     def handle_metric(self, m: metric_pb2.Metric,
                       trace_ctx=None) -> None:
+        if self.cfg.mesh_fanout:
+            self._fanout([m], trace_ctx=trace_ctx)
+            return
         try:
             dest = self.destinations.get(self.routing_key(m))
         except LookupError:
@@ -339,6 +403,13 @@ class Proxy:
         library is unavailable, or a destination speaks V2 streams."""
         if not payload:
             return      # the V1 probe
+        if self.cfg.mesh_fanout:
+            # meshed group: the SAME batch goes to every member (the
+            # order-preserving fanout is what the lockstep contract
+            # needs); the native per-key router is meaningless here
+            ml = forward_pb2.MetricList.FromString(payload)
+            self._fanout(list(ml.metrics), trace_ctx=trace_ctx)
+            return
         router = self._native_router
         if router is None and not self.cfg.ignore_tags:
             try:
@@ -397,6 +468,24 @@ class Proxy:
         already counted received AND routed when they first arrived, so
         the replay bumps only `rerouted` plus any NEW outcome —
         drops/no-owner at the new destination are fresh, real losses."""
+        if self.cfg.mesh_fanout:
+            if rerouted:
+                # a retiring mesh member's undelivered fanout copies:
+                # every surviving member already holds its own replica
+                # of these batches, so hash-routing the replay to one
+                # member would double-deliver there and fork the
+                # lockstep state. The departing replica's copies are
+                # dropped — per-copy, visibly (same convention as the
+                # fanout accounting: the ledger closes over what the
+                # proxy did with each copy).
+                n = len(ms) if hasattr(ms, "__len__") \
+                    else len(list(ms))
+                with self._stats_lock:
+                    self.stats["rerouted"] += n
+                    self.stats["dropped"] += n
+                return
+            self._fanout(list(ms), trace_ctx=trace_ctx)
+            return
         groups: dict = {}
         no_dest = 0
         for m in ms:
@@ -479,6 +568,23 @@ class Proxy:
                     http_api.reply(self, 200, json_mod.dumps(
                         debug_vars(proxy), indent=2).encode(),
                         "application/json")
+                elif self.path.startswith("/debug/spans"):
+                    # raw ring records for the cross-process trace
+                    # assembler; ?drain=1 takes them atomically
+                    # (testbed/proccluster.py scrapes every tier)
+                    import urllib.parse
+
+                    from veneur_tpu.trace import recorder as trace_rec
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        body = trace_rec.debug_spans_body(
+                            proxy.recorder, q)
+                    except ValueError:
+                        http_api.reply(self, 400, b"bad drain\n")
+                        return
+                    http_api.reply(self, 200, json_mod.dumps(
+                        body, indent=2).encode(), "application/json")
                 elif self.path.startswith("/debug/trace"):
                     # always-on (like the ring itself): the flight
                     # recorder is the proxy's black box, most needed

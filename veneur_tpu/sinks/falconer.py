@@ -28,6 +28,9 @@ class FalconerSpanSink(sink_mod.BaseSpanSink):
         spec = spec or sink_mod.SinkSpec(kind=self.KIND)
         super().__init__(spec.name, spec.config)
         self.target = self.config.get("target", "")
+        # per-span RPC deadline (was a hard-coded 5.0: a frozen falconer
+        # backend must time the span out, not wedge the sink worker)
+        self.send_timeout_s = float(self.config.get("send_timeout", 5.0))
         self._channel = channel
         self._send = None
         self.sent = 0
@@ -50,7 +53,7 @@ class FalconerSpanSink(sink_mod.BaseSpanSink):
         if self._send is None:
             return
         try:
-            self._send(span, timeout=5.0)
+            self._send(span, timeout=self.send_timeout_s)
             self.sent += 1
         except Exception as e:
             self.errors += 1

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import io
 import logging
+import os
 import queue
 from typing import Optional
 
@@ -113,6 +114,44 @@ class ChannelMetricSink(sink_mod.BaseMetricSink):
 
     def flush_other_samples(self, samples):
         self.other_samples.extend(samples)
+
+
+@sink_mod.register_metric_sink("jsonl")
+class JsonLinesMetricSink(sink_mod.BaseMetricSink):
+    """Appends each flush's metrics as JSON lines — the cross-PROCESS
+    analog of the channel sink (testbed/proccluster.py): a parent
+    harness tails the file to observe a subprocess tier's emissions
+    with exact per-flush boundaries (each flush appends one `flush`
+    framing record after its metric rows, so a reader can attribute
+    rows to intervals without sharing memory)."""
+
+    KIND = "jsonl"
+
+    def __init__(self, spec: Optional[sink_mod.SinkSpec] = None,
+                 server_config=None):
+        import json
+        import threading
+        spec = spec or sink_mod.SinkSpec(kind=self.KIND)
+        super().__init__(spec.name, spec.config)
+        self._json = json
+        self.path = self.config.get("path", "/tmp/veneur_tpu_emit.jsonl")
+        self._lock = threading.Lock()
+
+    def flush(self, metrics):
+        rows = [self._json.dumps({
+            "name": m.name, "type": m.type, "value": m.value,
+            "tags": list(m.tags), "timestamp": m.timestamp,
+            "hostname": m.hostname}) for m in metrics]
+        rows.append(self._json.dumps(
+            {"flush": True, "metrics": len(metrics)}))
+        with self._lock:
+            # one write per flush; the final newline commits the frame
+            # (a torn tail is detectable as a line with no newline)
+            with open(self.path, "a") as f:
+                f.write("\n".join(rows) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        return sink_mod.MetricFlushResult(flushed=len(metrics))
 
 
 def encode_tsv_row(m: InterMetric, hostname: str, interval_s: float,

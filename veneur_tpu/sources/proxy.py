@@ -310,6 +310,16 @@ class GrpcImportServer:
             # ambiguous timeout the sender's spool replays) is skipped
             # — merged exactly once — and the RPC still succeeds so the
             # replayer settles the record.
+            #
+            # server.sigstop_window (delay action) freezes THIS handler
+            # for a bounded window — the in-process twin of a SIGSTOP'd
+            # global: the RPC neither refuses nor resets, it just
+            # hangs past the sender's deadline, and when the window
+            # ends the import still completes — so the sender's retry
+            # and the thawed original collide at the dedup ledger,
+            # which must merge the chunk exactly once.
+            from veneur_tpu import failpoints
+            failpoints.inject("server.sigstop_window")
             ctxs = _trace_ctxs(context)
             start_ns = time.time_ns()
             if self.dedup is not None:

@@ -1,18 +1,31 @@
-"""In-process 3-tier cluster testbed (ROADMAP #3).
+"""3-tier cluster testbeds (ROADMAP #3 / #5).
 
-Boots N local servers, one consistent-hash proxy, and M (optionally
-virtual-device-meshed) global servers inside one process tree over
-loopback gRPC, drives them with a seeded deterministic traffic generator
-backed by a CPU ground-truth oracle, and asserts end-to-end conservation,
-percentile accuracy within the committed t-digest envelope, and the
-consistent-hash routing invariant — including under injected faults
-(veneur_tpu.failpoints).
+Two flavors behind one verification interface:
+
+IN-PROCESS (testbed/cluster.py): N local servers, one consistent-hash
+proxy, and M (optionally virtual-device-meshed) global servers inside
+one process tree over loopback gRPC — driven by a seeded deterministic
+traffic generator backed by a CPU ground-truth oracle, asserting
+end-to-end conservation, percentile accuracy within the committed
+t-digest envelope, and the consistent-hash routing invariant —
+including under injected faults (veneur_tpu.failpoints).
+
+PROCESS-SEPARATED (testbed/proccluster.py): every tier is its own OS
+process booted from its own config YAML (globals optionally MESHED
+over real multi-process gloo collectives), supervised with port-0
+readback + health-probe readiness, and verified entirely over HTTP
+scrape (/debug/vars ledgers, /debug/spans trace drains, jsonl sink
+tails) — with REAL faults: SIGKILL host loss, SIGSTOP/SIGCONT
+stragglers, crash/revive over the same dirs (testbed/proc_chaos.py).
 
 Entry points:
-  Cluster/ClusterSpec   the harness           (testbed/cluster.py)
-  TrafficGen/Oracle     seeded traffic        (testbed/traffic.py)
-  run_dryrun            one-call dryrun       (testbed/dryrun.py)
-  CHAOS_ARMS et al.     the chaos matrix      (testbed/chaos.py)
+  Cluster/ClusterSpec       in-process harness  (testbed/cluster.py)
+  ProcCluster/ProcClusterSpec  real processes   (testbed/proccluster.py)
+  TrafficGen/Oracle         seeded traffic      (testbed/traffic.py)
+  run_dryrun                one-call dryrun, either flavor via
+                            procs=True          (testbed/dryrun.py)
+  CHAOS_ARMS / PROC_ARMS    the chaos matrices  (testbed/chaos.py,
+                                                 testbed/proc_chaos.py)
 """
 
 from veneur_tpu.testbed.chaos import (ALL_ARMS, CHAOS_ARMS,

@@ -121,6 +121,23 @@ CRASH_ARMS: list[ChaosArm] = [
              "accounted", {"op": "spool-expiry"}, kind="crash"),
 ]
 
+# frozen-peer arm (ISSUE 14): the `server.sigstop_window` failpoint
+# (delay action) freezes the global's V1 import handler for a bounded
+# window — the in-process twin of a SIGSTOP'd global, so the fast
+# tier-1 cell exercises the frozen-peer code path without real
+# signals.  The RPC neither refuses nor resets: it hangs past the
+# forward deadline (DEADLINE_EXCEEDED — retry-safe here because the
+# direct peer is a ledger-bearing global), the bounded retry
+# re-delivers under the SAME chunk identity, and when the window ends
+# the thawed original import completes anyway — the dedup ledger must
+# merge the chunk exactly once.  Conservation EXACT with
+# duplicates_skipped >= 1.
+FROZEN_ARMS: list[ChaosArm] = [
+    ChaosArm("frozen-global-window", "server.sigstop_window", "delay",
+             "conserved", {"op": "frozen-window", "delay_s": 1.2,
+                           "times": 1}, kind="frozen"),
+]
+
 # egress arm (ISSUE 11 / ROADMAP #8): a metric sink is blackholed at
 # the `egress.sink` failpoint — the full degradation chain must hold:
 # attempts fail -> bounded retries exhaust -> breaker opens -> later
@@ -135,15 +152,23 @@ EGRESS_ARMS: list[ChaosArm] = [
 ]
 
 ALL_ARMS: list[ChaosArm] = (CHAOS_ARMS + TOPOLOGY_ARMS + CRASH_ARMS
-                            + EGRESS_ARMS)
+                            + EGRESS_ARMS + FROZEN_ARMS)
 
 
-def arm_by_name(name: str) -> ChaosArm:
+def arm_by_name(name: str):
     for a in ALL_ARMS:
         if a.name == name:
             return a
-    raise KeyError(f"unknown chaos arm {name!r} "
-                   f"(have {[a.name for a in ALL_ARMS]})")
+    # the process-separated matrix (testbed/proc_chaos.py) registers
+    # its arms separately — real SIGKILL/SIGSTOP against real
+    # subprocesses; run_chaos_arm dispatches on kind == "proc"
+    from veneur_tpu.testbed.proc_chaos import PROC_ARMS
+    for a in PROC_ARMS:
+        if a.name == name:
+            return a
+    raise KeyError(
+        f"unknown chaos arm {name!r} (have "
+        f"{[a.name for a in ALL_ARMS] + [a.name for a in PROC_ARMS]})")
 
 
 def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
@@ -163,6 +188,23 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     interval forming one complete 3-tier trace with zero orphans —
     duplicate retry attempts must dedup to one delivered edge
     (trace/assembly.py)."""
+    if arm.kind == "proc":
+        # process-separated arms: real signals against real
+        # subprocesses (testbed/proc_chaos.py); lock witnessing stays
+        # in-process-only (there is no cross-process lock to wrap)
+        from veneur_tpu.testbed.proc_chaos import run_proc_arm
+        return run_proc_arm(arm, seed=seed, counter_keys=counter_keys,
+                            histo_keys=histo_keys, set_keys=set_keys,
+                            histo_samples=histo_samples,
+                            telemetry=telemetry)
+    if arm.kind == "frozen":
+        return _run_frozen_window_arm(arm, seed=seed,
+                                      counter_keys=counter_keys,
+                                      histo_keys=histo_keys,
+                                      set_keys=set_keys,
+                                      histo_samples=histo_samples,
+                                      witness=witness,
+                                      telemetry=telemetry)
     if arm.kind == "egress":
         return _run_egress_arm(arm, seed=seed,
                                counter_keys=counter_keys,
@@ -670,6 +712,75 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
             _apply_trace_gate(row, trace_spans,
                               require_proxy=not direct)
     return row
+
+
+def _run_frozen_window_arm(arm: ChaosArm, *, seed: int = 0,
+                           counter_keys: int = 4, histo_keys: int = 1,
+                           set_keys: int = 1, histo_samples: int = 40,
+                           witness=None, telemetry=None) -> dict:
+    """The frozen-peer fast cell: direct durable 1x1 fleet, the
+    global's import handler freezes for `delay_s` (> the forward
+    deadline) on the interval's FIRST chunk.  The client must surface
+    DEADLINE_EXCEEDED (never hang the flush), the bounded retry
+    re-delivers under the same identity, and the thawed original's
+    late import must dedup — conservation EXACT with a duplicate
+    skipped."""
+    delay_s = arm.kwargs["delay_s"]
+    spec = ClusterSpec(
+        n_locals=1, n_globals=1, direct=True, durable=True,
+        # the deadline must expire INSIDE the freeze window so the
+        # retry and the thawed original actually collide
+        forward_timeout=delay_s / 3.0,
+        forward_max_retries=2, forward_retry_backoff=0.05,
+        forward_deadline_retry_safe=True,
+        lock_witness=witness, telemetry=telemetry)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    fp = failpoints.configure(arm.failpoint, arm.action, seed=seed,
+                              delay_s=delay_s,
+                              times=arm.kwargs["times"])
+    try:
+        cluster.start()
+        g = cluster.globals[0].server
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(1),
+            settle_timeout_s=max(30.0, delay_s * 10)))
+        # the thawed original completes AFTER the retry delivered:
+        # wait for the ledger to record the duplicate skip
+        _wait_until(lambda: g.dedup.stats()["duplicates"] >= 1,
+                    what="duplicate skip")
+        dup = g.dedup.stats()["duplicates"]
+        acct = cluster.accounting()
+    finally:
+        failpoints.disarm(arm.failpoint)
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    conserved = counters["exact"]
+    ok = (fp.fired >= 1 and conserved
+          and acct["forward"]["retries"] >= 1
+          and dup >= 1 and acct["dropped_total"] == 0
+          and routing["exclusive"])
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": arm.action,
+        "expect": arm.expect,
+        "fired": fp.fired,
+        "conserved": conserved,
+        "counter_deficit": counters["deficit"],
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": conserved or acct["dropped_total"] > 0,
+        "duplicates_skipped": dup,
+        "ok": ok,
+    }
 
 
 def _run_egress_arm(arm: ChaosArm, *, seed: int = 0,

@@ -31,6 +31,30 @@ from veneur_tpu.sinks import simple as simple_sinks
 _MAX_DGRAM_LINES = 25
 _MAX_DGRAM_BYTES = 1200
 
+
+def pack_datagrams(lines: list[bytes]) -> tuple[list[bytes], int]:
+    """Batch DogStatsD lines into loopback-MTU-sized datagrams.
+    Returns (datagrams, value_count) — multi-value packets
+    `name:v1:v2|t` carry several values, which is what the ingestion
+    waits track.  Shared with the process-separated harness
+    (testbed/proccluster.py), so both cluster flavors put identical
+    bytes on the wire."""
+    dgrams: list[bytes] = []
+    dgram: list[bytes] = []
+    size = 0
+    values = 0
+    for line in lines:
+        if dgram and (len(dgram) >= _MAX_DGRAM_LINES
+                      or size + len(line) + 1 > _MAX_DGRAM_BYTES):
+            dgrams.append(b"\n".join(dgram))
+            dgram, size = [], 0
+        dgram.append(line)
+        size += len(line) + 1
+        values += line.split(b"|", 1)[0].count(b":")
+    if dgram:
+        dgrams.append(b"\n".join(dgram))
+    return dgrams, values
+
 # bound on waiting out a node's async egress lanes before reading its
 # channel sink (sink fan-out is queue-handoff now, not in-flush)
 EGRESS_SETTLE_TIMEOUT_S = 15.0
@@ -50,6 +74,11 @@ class ClusterSpec:
     forward_timeout: float = 5.0
     forward_max_retries: int = 2
     forward_retry_backoff: float = 0.02
+    # DEADLINE_EXCEEDED counts as retry-safe on the forward edge —
+    # only sound for DIRECT fleets whose peer is a ledger-bearing
+    # global (config.forward_deadline_retry_safe); the frozen-peer
+    # chaos arms set it
+    forward_deadline_retry_safe: bool = False
     # proxy deadlines + breaker
     proxy_send_timeout: float = 5.0
     proxy_dial_timeout: float = 2.0
@@ -208,6 +237,8 @@ class Cluster:
             forward_timeout=spec.forward_timeout,
             forward_max_retries=spec.forward_max_retries,
             forward_retry_backoff=spec.forward_retry_backoff,
+            forward_deadline_retry_safe=(
+                spec.forward_deadline_retry_safe),
             interval=spec.interval_s,
             percentiles=list(spec.percentiles),
             aggregates=list(spec.aggregates),
@@ -395,19 +426,9 @@ class Cluster:
         the ingestion wait tracks staged values, which is what the
         engine's processed total counts)."""
         node = self.locals[local_idx]
-        dgram: list[bytes] = []
-        size = 0
-        values = 0
-        for line in lines:
-            if dgram and (len(dgram) >= _MAX_DGRAM_LINES
-                          or size + len(line) + 1 > _MAX_DGRAM_BYTES):
-                node.tx.sendto(b"\n".join(dgram), node.udp_addr)
-                dgram, size = [], 0
-            dgram.append(line)
-            size += len(line) + 1
-            values += line.split(b"|", 1)[0].count(b":")
-        if dgram:
-            node.tx.sendto(b"\n".join(dgram), node.udp_addr)
+        dgrams, values = pack_datagrams(lines)
+        for dgram in dgrams:
+            node.tx.sendto(dgram, node.udp_addr)
         return values
 
     def wait_ingested(self, local_idx: int, n_lines: int,

@@ -42,7 +42,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                chaos: str | None = None,
                lock_witness: bool = False,
                trace: bool = False,
-               telemetry: bool = False) -> dict:
+               telemetry: bool = False,
+               procs: bool = False) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
     With `lock_witness`, every tier's named locks record runtime
     acquisition-order edges (shared across the chaos arms too) and the
@@ -60,7 +61,26 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     GATES ok on it — every settled interval must assemble into one
     complete local->proxy->global trace with zero orphan spans — and,
     when no chaos selection was given, runs the forward-retry and
-    ring-scale-up chaos arms with the same trace gate."""
+    ring-scale-up chaos arms with the same trace gate.
+
+    With `procs=True` the SAME story runs against the
+    process-separated cluster (testbed/proccluster.py): every tier is
+    its own OS process (globals meshed over real multi-process gloo
+    collectives when mesh_devices > 0 and n_globals > 1), conservation
+    and ledgers come from HTTP-scraped state, and `chaos` selects the
+    REAL-fault matrix (testbed/proc_chaos.py; "all" = every proc
+    arm)."""
+    if procs:
+        return _run_proc_dryrun(
+            n_locals=n_locals, n_globals=n_globals,
+            intervals=intervals, seed=seed, interval_s=interval_s,
+            mesh_devices=mesh_devices, counter_keys=counter_keys,
+            histo_keys=histo_keys, set_keys=set_keys,
+            histo_samples=histo_samples, percentiles=percentiles,
+            cardinality_key_budget=cardinality_key_budget,
+            moments_histo_keys=moments_histo_keys, chaos=chaos,
+            lock_witness=lock_witness, trace=trace,
+            telemetry=telemetry)
     witness = None
     if lock_witness:
         from veneur_tpu.analysis.witness import LockWitness
@@ -234,6 +254,176 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         # trace.{complete,orphans,critical_path_ms} + timeline_linked:
         # the per-interval critical-path table from the cross-tier
         # assembler; gates ok only when trace=True was requested
+        "trace": trace_report,
+        "ok": ok,
+    }
+
+
+def _run_proc_dryrun(*, n_locals: int, n_globals: int, intervals: int,
+                     seed: int, interval_s: float,
+                     mesh_devices: int, counter_keys: int,
+                     histo_keys: int, set_keys: int,
+                     histo_samples: int, percentiles: tuple,
+                     cardinality_key_budget: int,
+                     moments_histo_keys: int, chaos: str | None,
+                     lock_witness: bool, trace: bool,
+                     telemetry: bool) -> dict:
+    """The process-separated flavor of run_dryrun: same report shape
+    (PROMISED_KEYS), every observation HTTP-scraped.  Options that
+    only exist in-process are rejected loudly rather than silently
+    ignored."""
+    if lock_witness:
+        raise ValueError(
+            "lock_witness is in-process-only: there is no cross-"
+            "process lock to wrap — run the witnessed cell without "
+            "--procs")
+    if cardinality_key_budget or moments_histo_keys:
+        raise ValueError(
+            "cardinality/moments cells are covered by the in-process "
+            "dryrun (check.py stages 3/3d); the proc cluster runs "
+            "the core conservation + chaos story")
+    if interval_s != 0.05:
+        raise ValueError(
+            "interval_s is in-process-only: the proc cluster pins a "
+            "huge ticker interval and drives every flush explicitly "
+            "over POST /flush — drop --interval-s or drop --procs")
+    from veneur_tpu.testbed.proc_chaos import (PROC_ARMS,
+                                               run_proc_arm)
+    from veneur_tpu.testbed.proccluster import (ProcCluster,
+                                                ProcClusterSpec)
+    telemetry_witness = None
+    if telemetry:
+        from veneur_tpu.analysis.telemetry import TelemetryWitness
+        telemetry_witness = TelemetryWitness()
+    spec = ProcClusterSpec(
+        n_locals=n_locals, n_globals=n_globals,
+        percentiles=tuple(percentiles),
+        meshed=bool(mesh_devices and n_globals > 1),
+        mesh_devices=mesh_devices or 8,
+        telemetry=telemetry_witness)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = ProcCluster(spec)
+    per_interval: list[list[list]] = []
+    per_interval_locals: list[list[list]] = []
+    timeline_rows: list[dict] = []
+    try:
+        cluster.start()
+        for _ in range(intervals):
+            per_interval.append(cluster.run_interval(
+                traffic.next_interval(n_locals)))
+            per_interval_locals.append(cluster.drain_local_sinks())
+        acct = cluster.accounting()
+        trace_spans = cluster.collect_trace_spans()
+        for n in cluster.locals:
+            body = cluster._scrape_json(n, "/debug/flush_timeline")
+            timeline_rows.extend((body or {}).get("records", []))
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    sets = verify.check_sets(traffic.oracle, per_interval)
+    quantiles = verify.check_quantiles(traffic.oracle, per_interval,
+                                       list(percentiles))
+    histo_counts = verify.check_histo_counts(traffic.oracle,
+                                             per_interval_locals)
+    routing = verify.check_routing(per_interval)
+
+    from veneur_tpu.trace import assembly
+    trace_report = assembly.flush_report(trace_spans)
+    trace_ids = {f"{s['trace_id']:x}" for s in trace_spans}
+    trace_report["timeline_linked"] = bool(timeline_rows) and all(
+        r.get("trace_id") in trace_ids and r.get("span_id")
+        for r in timeline_rows if r.get("event") is None)
+
+    chaos_rows: list[dict] = []
+    if chaos:
+        arms = (PROC_ARMS if chaos == "all"
+                else [arm_by_name(chaos)])
+        for arm in arms:
+            chaos_rows.append(run_proc_arm(
+                arm, seed=seed, telemetry=telemetry_witness)
+                if getattr(arm, "kind", "") == "proc"
+                else run_chaos_arm(arm, seed=seed, trace=trace,
+                                   telemetry=telemetry_witness))
+
+    telemetry_cmp = None
+    if telemetry_witness is not None:
+        from veneur_tpu.testbed.chaos import telemetry_comparison
+        telemetry_cmp = telemetry_comparison(telemetry_witness)
+
+    trace_ok = (trace_report["complete"]
+                and trace_report["orphans"] == 0
+                and trace_report["timeline_linked"])
+    ok = (counters["exact"] and sets["exact"] and quantiles["ok"]
+          and histo_counts["exact"] and routing["exclusive"]
+          and all(r["ok"] for r in chaos_rows)
+          and (not trace or trace_ok)
+          and (telemetry_cmp is None or telemetry_cmp["ok"]))
+    return {
+        "spec": {
+            "n_locals": n_locals, "n_globals": n_globals,
+            "intervals": intervals, "seed": seed,
+            "mesh_devices": mesh_devices,
+            "counter_keys": counter_keys, "histo_keys": histo_keys,
+            "set_keys": set_keys, "histo_samples": histo_samples,
+            "percentiles": list(percentiles),
+            "cardinality_key_budget": 0,
+            "moments_histo_keys": 0,
+            "procs": True,
+            "meshed_globals": spec.meshed,
+        },
+        "per_tier": {
+            "local_flushes": acct["local_flushes"],
+            "global_flushes": acct["global_flushes"],
+            "proxy_received": acct["proxy"]["received"],
+            "proxy_routed": acct["proxy"]["routed"],
+            "proxy_no_destination": acct["proxy"]["no_destination"],
+            "destination_totals": acct["destination_totals"],
+            "breakers": acct["breakers"],
+        },
+        "forwarded": acct["forward"]["sent"],
+        "imported": acct["imported"],
+        "retried": acct["forward"]["retries"],
+        "dropped": acct["dropped_total"],
+        "cardinality": acct["cardinality"],
+        "spool": {"spilled": acct["spool"]["spilled"],
+                  "replayed": acct["spool"]["replayed"],
+                  "expired": acct["spool"]["expired"]},
+        "egress": {"flushed": acct["egress"]["flushed"],
+                   "retried": acct["egress"]["retried"],
+                   "spilled": acct["egress"]["spilled"],
+                   "replayed": acct["egress"]["replayed"],
+                   "dropped": acct["egress"]["dropped"]},
+        "checkpoint": {"restores": acct["checkpoint"]["restores"],
+                       "age_ms": acct["checkpoint"]["age_ms"]},
+        "reshard_moved": acct["reshard"]["moved_total"],
+        "conservation": {
+            "counters_exact": counters["exact"],
+            "counter_deficit": counters["deficit"],
+            "counter_keys": counters["keys"],
+            "sets_exact": sets["exact"],
+            "sets_checked": sets["checked"],
+        },
+        "quantile_errors": {
+            str(q): {
+                "max_span_err": rec["max_span_err"],
+                "envelope": rec["envelope"],
+                "checked": rec["checked"],
+                "within": rec["within"],
+            } for q, rec in quantiles["per_quantile"].items()
+        },
+        "sketch_families": {
+            "histo_counts_exact": histo_counts["exact"],
+            "histo_keys_by_family": histo_counts["by_family"],
+            "quantiles_checked_by_family":
+                quantiles["checked_by_family"],
+        },
+        "routing_exclusive": routing["exclusive"],
+        "chaos_matrix": chaos_rows,
+        "lock_witness": None,
+        "telemetry": telemetry_cmp,
         "trace": trace_report,
         "ok": ok,
     }

@@ -1,0 +1,441 @@
+"""Proc chaos matrix: REAL faults against the process-separated fleet.
+
+The in-process chaos matrix (testbed/chaos.py) injects faults at
+failpoint seams; here each fault is the actual operating-system event
+the failpoint simulates:
+
+  proc-host-loss        a global dies by SIGKILL — no atexit, no final
+                        flush, the exact event PR 9's Server.crash()
+                        method-call models — and the proxy must route
+                        around/account while a revived process on the
+                        SAME port rejoins the ring
+  proc-straggler        a global freezes under SIGSTOP: its RPCs are
+                        neither refused nor reset, they just hang — the
+                        proxy's per-RPC deadline must trip the breaker
+                        via DEADLINE_EXCEEDED (never wedge the flush),
+                        and SIGCONT + the half-open probe must restore
+  proc-crash-revive     direct durable fleet: checkpoint, SIGKILL, the
+                        local's retries exhaust into the durable spool,
+                        a NEW process boots over the same dirs (real
+                        boot-nonce change), restores the dedup ledger,
+                        replay drains, and a REAL duplicate delivery —
+                        the parent re-sends a captured spool record
+                        over its own gRPC channel under the recorded
+                        chunk identity — must merge exactly once:
+                        conservation EXACT
+  proc-torn-checkpoint  SIGKILL lands inside the checkpoint write
+                        window (a complete-but-unrenamed .tmp next to
+                        the committed file — os.replace is atomic, so
+                        that is exactly what the crash leaves): the
+                        revival must restore the COMMITTED checkpoint,
+                        never the torn tempfile, and conserve
+
+Every arm's verdict comes from HTTP-scraped state (/debug/vars
+ledgers, jsonl sink emissions) — no in-process reach-ins exist across
+a real process boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+from dataclasses import dataclass, field
+
+from veneur_tpu.testbed import verify
+from veneur_tpu.testbed.proccluster import ProcCluster, ProcClusterSpec
+from veneur_tpu.testbed.traffic import TrafficGen
+
+# how long a straggler stays frozen; must exceed the proxy's per-RPC
+# deadline (so DEADLINE_EXCEEDED actually fires) and stay far under
+# every settle timeout
+STRAGGLER_FREEZE_S = 2.0
+_WAIT_S = 60.0
+_POLL_S = 0.05
+# deadline on the parent's own duplicate-delivery RPC (the peer is
+# known-revived by then; this only bounds a wedged harness)
+_DUP_SEND_TIMEOUT_S = 10.0
+
+
+@dataclass(frozen=True)
+class ProcArm:
+    name: str
+    fault: str                     # "sigkill" | "sigstop" | ...
+    expect: str                    # "conserved" | "accounted"
+    kwargs: dict = field(default_factory=dict)
+    kind: str = "proc"
+
+
+PROC_ARMS: list[ProcArm] = [
+    ProcArm("proc-host-loss", "sigkill", "accounted",
+            {"op": "host-loss"}),
+    ProcArm("proc-straggler", "sigstop", "accounted",
+            {"op": "straggler"}),
+    ProcArm("proc-crash-revive", "sigkill", "conserved",
+            {"op": "crash-revive"}),
+    ProcArm("proc-torn-checkpoint", "sigkill", "conserved",
+            {"op": "torn-checkpoint"}),
+]
+
+
+def proc_arm_by_name(name: str) -> ProcArm:
+    for a in PROC_ARMS:
+        if a.name == name:
+            return a
+    raise KeyError(f"unknown proc chaos arm {name!r} "
+                   f"(have {[a.name for a in PROC_ARMS]})")
+
+
+def _wait(cond, what: str, timeout_s: float = _WAIT_S):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        out = cond()
+        if out:
+            return out
+        time.sleep(_POLL_S)
+    raise TimeoutError(f"proc arm: {what} not reached "
+                       f"within {timeout_s}s")
+
+
+def _row(arm: ProcArm, acct: dict, counters: dict, routing: dict,
+         fired: int) -> dict:
+    conserved = counters["exact"]
+    accounted = conserved or acct["dropped_total"] > 0
+    return {
+        "arm": arm.name,
+        "failpoint": arm.fault,
+        "action": arm.kwargs.get("op", ""),
+        "expect": arm.expect,
+        "fired": fired,
+        "conserved": conserved,
+        "counter_deficit": counters["deficit"],
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": accounted,
+        "spool": acct["spool"],
+        "checkpoint": acct["checkpoint"],
+        "dedup": acct["dedup"],
+    }
+
+
+def run_proc_arm(arm: ProcArm, *, seed: int = 0,
+                 counter_keys: int = 4, histo_keys: int = 1,
+                 set_keys: int = 1, histo_samples: int = 40,
+                 telemetry=None) -> dict:
+    op = arm.kwargs["op"]
+    if op == "host-loss":
+        return _run_host_loss(arm, seed, counter_keys, histo_keys,
+                              set_keys, histo_samples, telemetry)
+    if op == "straggler":
+        return _run_straggler(arm, seed, counter_keys, histo_keys,
+                              set_keys, histo_samples, telemetry)
+    if op in ("crash-revive", "torn-checkpoint"):
+        return _run_crash_revive(arm, seed, counter_keys, histo_keys,
+                                 set_keys, histo_samples, telemetry)
+    raise KeyError(f"unknown proc arm op {op!r}")
+
+
+def _run_host_loss(arm, seed, counter_keys, histo_keys, set_keys,
+                   histo_samples, telemetry) -> dict:
+    """1 local -> proxy -> 1 global, the check.py stage-3e cell: the
+    global dies by REAL SIGKILL mid-run; the interval flushed into the
+    outage must be visibly accounted (proxy destination drops /
+    no-owner), a revived process on the SAME port must rejoin the ring
+    (breaker probe / discovery re-dial), and the final interval must
+    conserve exactly again."""
+    spec = ProcClusterSpec(
+        n_locals=1, n_globals=1,
+        forward_max_retries=1, forward_retry_backoff=0.05,
+        breaker_failure_threshold=1, breaker_reset_timeout=0.3,
+        discovery_interval_s=0.2, telemetry=telemetry)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = ProcCluster(spec)
+    per_interval: list[list[list]] = []
+    post_revive = None
+    try:
+        cluster.start()
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(1)))
+        pre_acct = cluster.accounting()
+        cluster.sigkill_global(0)
+        # the outage interval: ingest + flush the local INTO the dead
+        # global — every point must land in visible drop accounting
+        lines = traffic.next_interval(1)
+        n = cluster.send_lines(0, lines[0])
+        cluster.wait_ingested(0, n)
+        cluster.flush_locals()
+        cluster.settle()
+        cluster.revive_global(0)
+        # the ring re-admits the revived member (discovery re-dial /
+        # breaker probe), after which routing works again
+        _wait(lambda: (cluster.scrape_vars(cluster.proxy) or {})
+              .get("destinations", 0) >= 1, "ring re-admission")
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(1)))
+        acct = cluster.accounting()
+        # the revived member must actually have received the final
+        # interval (conservation of interval 3 proves delivery; this
+        # pins that it went through the NEW process, not a ghost)
+        post_revive = (cluster.scrape_vars(cluster.globals[0])
+                       or {}).get("imported_total", 0)
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    row = _row(arm, acct, counters, routing, fired=1)
+    # interval 2 died with the global: NOT conserved, but every lost
+    # point must be visible — and the deficit must have appeared only
+    # AFTER the kill (interval 1 was clean)
+    row["pre_kill_dropped"] = pre_acct["dropped_total"]
+    row["post_revive_imported"] = post_revive
+    row["ok"] = (not row["conserved"]
+                 and row["counter_deficit"] > 0
+                 and row["no_silent_loss"]
+                 and pre_acct["dropped_total"] == 0
+                 and (post_revive or 0) > 0
+                 and row["routing_exclusive"])
+    return row
+
+
+def _run_straggler(arm, seed, counter_keys, histo_keys, set_keys,
+                   histo_samples, telemetry) -> dict:
+    """1 local -> proxy -> 2 globals: global 0 freezes under REAL
+    SIGSTOP.  Its RPCs hang (neither refused nor reset) — the proxy's
+    per-RPC deadline must surface DEADLINE_EXCEEDED, trip the breaker,
+    and route around; SIGCONT + the half-open probe must restore the
+    member, and the post-thaw interval conserves."""
+    spec = ProcClusterSpec(
+        n_locals=1, n_globals=2,
+        proxy_send_timeout=0.5,
+        forward_max_retries=2, forward_retry_backoff=0.05,
+        breaker_failure_threshold=1, breaker_reset_timeout=0.3,
+        discovery_interval_s=0.2, telemetry=telemetry)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = ProcCluster(spec)
+    per_interval: list[list[list]] = []
+    breaker_trips = 0
+    try:
+        cluster.start()
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(1)))
+        cluster.sigstop_global(0)
+        t_frozen = time.time()
+        # flush an interval into the freeze: sends to global 0 hang
+        # until the 0.5s deadline, then the destination closes with
+        # its buffer accounted and the breaker trips
+        lines = traffic.next_interval(1)
+        n = cluster.send_lines(0, lines[0])
+        cluster.wait_ingested(0, n)
+        cluster.flush_locals()
+
+        def _engaged():
+            # snapshot WHILE engaged: a later successful probe resets
+            # the breaker record, so the trip evidence must be
+            # captured inside the outage window
+            brk = ((cluster.scrape_vars(cluster.proxy) or {})
+                   .get("breakers") or {})
+            hit = [b for b in brk.values()
+                   if b.get("trips", 0) >= 1
+                   or b.get("state") in ("open", "half-open")]
+            return hit or None
+
+        engaged = _wait(_engaged, "breaker engagement")
+        cluster.settle()
+        remaining = STRAGGLER_FREEZE_S - (time.time() - t_frozen)
+        if remaining > 0:
+            time.sleep(remaining)
+        cluster.sigcont_global(0)
+        # recovery: discovery re-dials / the breaker's half-open probe
+        # restores the thawed member into the ring
+        _wait(lambda: (cluster.scrape_vars(cluster.proxy) or {})
+              .get("destinations", 0) >= 2, "ring restoration")
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(1)))
+        acct = cluster.accounting()
+        breaker_trips = max(
+            (b.get("trips", 0) for b in engaged), default=0)
+        breaker_engaged = len(engaged)
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    row = _row(arm, acct, counters, routing, fired=breaker_engaged)
+    row["breaker_trips"] = breaker_trips
+    row["breakers_engaged"] = breaker_engaged
+    # the frozen interval's keys for global 0 are visibly dropped (or
+    # rerouted exactly); the thawed interval conserves — so either the
+    # whole run conserved (everything rode the deadline + reroute) or
+    # the deficit is matched by visible drop accounting
+    row["ok"] = (breaker_engaged >= 1 and row["no_silent_loss"]
+                 and row["routing_exclusive"])
+    return row
+
+
+def _capture_spool_record(spool_dir: str):
+    """Read one pending record (ident + raw body) out of a local's
+    on-disk spool — from a COPY, so the owning process's appends are
+    untouched.  This is the parent acting as one more process over the
+    real on-disk format: the captured chunk becomes a genuine
+    cross-process duplicate delivery."""
+    from veneur_tpu.forward.spool import ForwardSpool
+    tmp = tempfile.mkdtemp(prefix="tb-spoolcap-")
+    try:
+        dst = os.path.join(tmp, "spool")
+        shutil.copytree(spool_dir, dst)
+        sp = ForwardSpool(dst)
+        try:
+            recs = sp.peek(1)
+            if not recs:
+                return None
+            return recs[0].ident, sp.read_body(recs[0])
+        finally:
+            sp.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _send_duplicate(grpc_port: int, ident: tuple, body: bytes) -> None:
+    """Deliver a captured spool chunk a second time under its RECORDED
+    identity — over the parent's own gRPC channel, i.e. a real
+    duplicate delivery from a third process."""
+    import grpc
+    from google.protobuf import empty_pb2
+
+    from veneur_tpu.forward.client import (CHUNK_ID_KEY, SEND_METRICS,
+                                           chunk_id_value)
+    channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+    try:
+        send = channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
+        send(body, timeout=_DUP_SEND_TIMEOUT_S,
+             metadata=((CHUNK_ID_KEY, chunk_id_value(ident)),))
+    finally:
+        channel.close()
+
+
+def _inject_torn_checkpoint_tmp(ckpt_dir: str) -> str:
+    """Recreate the SIGKILL-inside-the-write-window disk state: a
+    half-written `checkpoint.ckpt.tmp` sitting next to the committed
+    checkpoint (os.replace is atomic, so the crash can leave exactly
+    this — never a half-renamed final file)."""
+    from veneur_tpu.core import checkpoint as ckpt_mod
+    tmp_path = ckpt_mod.checkpoint_path(ckpt_dir) + ".tmp"
+    with open(tmp_path, "wb") as f:
+        f.write(b"\x93NUMPY-torn-checkpoint-write\x00" * 7)
+    return tmp_path
+
+
+def _run_crash_revive(arm, seed, counter_keys, histo_keys, set_keys,
+                      histo_samples, telemetry) -> dict:
+    """Direct durable 1 local -> 1 global.  crash-revive: checkpoint,
+    SIGKILL, spill, revive over the same dirs (new boot nonce),
+    ledger-restored replay drains, then a REAL duplicate delivery of a
+    replayed chunk merges once — conservation EXACT.  torn-checkpoint:
+    additionally plant a torn checkpoint tempfile before the revival,
+    which must restore the COMMITTED checkpoint and still conserve."""
+    torn = arm.kwargs["op"] == "torn-checkpoint"
+    spec = ProcClusterSpec(
+        n_locals=1, n_globals=1, direct=True, durable=True,
+        forward_timeout=2.0, forward_max_retries=1,
+        forward_retry_backoff=0.05,
+        # direct mode: the peer IS the ledger-bearing global, so an
+        # ambiguous deadline (wait-for-ready replay queued against a
+        # dead peer) may keep the record — re-delivery under the same
+        # chunk identity merges exactly once
+        forward_deadline_retry_safe=True,
+        spool_replay_interval_s=0.1, telemetry=telemetry)
+    traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
+                         histo_keys=histo_keys, set_keys=set_keys,
+                         histo_samples=histo_samples)
+    cluster = ProcCluster(spec)
+    per_interval: list[list[list]] = []
+    extra: dict = {}
+    try:
+        cluster.start()
+        per_interval.append(cluster.run_interval(
+            traffic.next_interval(1)))
+        # R1: chunk identities the global recorded for the delivered
+        # interval — the checkpoint must carry them across the crash
+        pre = cluster.scrape_vars(cluster.globals[0]) or {}
+        r1 = (pre.get("dedup") or {}).get("recorded", 0)
+        assert cluster.checkpoint_global(0)
+        gnode = cluster.sigkill_global(0)
+        if torn:
+            extra["torn_tmp"] = _inject_torn_checkpoint_tmp(
+                gnode.ckpt_dir)
+        # flush into the outage: UNAVAILABLE -> bounded retries
+        # exhaust -> identified chunks spill to the durable spool
+        lines = traffic.next_interval(1)
+        n = cluster.send_lines(0, lines[0])
+        cluster.wait_ingested(0, n)
+        cluster.flush_locals()
+        spilled_vars = cluster.wait_local(
+            0, lambda v: (v.get("spool") or {}).get("spilled", 0) > 0,
+            what="spool spill")
+        extra["spilled_records"] = \
+            spilled_vars["spool"]["spilled"]
+        # capture one spooled chunk NOW (records delete once replayed)
+        # for the post-drain duplicate injection
+        cap = _capture_spool_record(cluster.locals[0].spool_dir)
+        cluster.revive_global(0)
+        cluster.wait_spool_drained()
+        cluster.settle()
+        post = cluster.scrape_vars(cluster.globals[0]) or {}
+        extra["restores"] = (post.get("checkpoint")
+                             or {}).get("restores", 0)
+        # ledger-restore proof across the boot-nonce change: had the
+        # ledger NOT survived, recorded would only count the replayed
+        # chunks; restored + replayed strictly exceeds replayed alone
+        extra["ledger_recorded_pre"] = r1
+        extra["ledger_recorded_post"] = \
+            (post.get("dedup") or {}).get("recorded", 0)
+        if cap is not None:
+            _send_duplicate(cluster.globals[0].grpc_port, *cap)
+            after = cluster.scrape_vars(cluster.globals[0]) or {}
+            extra["duplicates_skipped"] = \
+                (after.get("dedup") or {}).get("duplicates", 0)
+        per_interval.append(cluster.flush_globals())
+        acct = cluster.accounting()
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    row = _row(arm, acct, counters, routing,
+               fired=extra.get("restores", 0))
+    row.update(extra)
+    sp = acct["spool"]
+    closure = (sp["spilled"] == sp["replayed"] + sp["expired"]
+               + sp["dropped"] + sp["pending"])
+    row["spool_closure"] = closure
+    row["ok"] = (row["conserved"] and closure
+                 and extra.get("restores", 0) >= 1
+                 and sp["replayed"] > 0
+                 and extra.get("ledger_recorded_post", 0)
+                 >= extra.get("ledger_recorded_pre", 0)
+                 + extra.get("spilled_records", 0)
+                 and extra.get("duplicates_skipped", 0) >= 1
+                 and row["routing_exclusive"])
+    if torn:
+        # additionally: the torn tempfile must still be lying there
+        # untouched-as-garbage or cleaned — either way the boot used
+        # the COMMITTED file (restores >= 1 proves a restore happened;
+        # conservation proves it was the right state)
+        row["ok"] = bool(row["ok"] and row["fired"] >= 1)
+    return row
+
+
+def run_proc_matrix(arms=None, seed: int = 0, **kwargs) -> list[dict]:
+    return [run_proc_arm(a, seed=seed, **kwargs)
+            for a in (arms or PROC_ARMS)]

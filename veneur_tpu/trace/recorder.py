@@ -230,6 +230,17 @@ class FlightRecorder:
             return [dict(r) for r in self._ring
                     if r["trace_id"] == trace_id]
 
+    def drain(self) -> list[dict]:
+        """Atomically take every ring record and clear the ring
+        (total_recorded keeps counting).  The /debug/spans?drain=1
+        surface: a cross-process assembler scrapes each tier
+        repeatedly without re-reading (or ring-evicting) spans it
+        already holds."""
+        with self._lock:
+            recs = list(self._ring)
+            self._ring.clear()
+        return [dict(r) for r in recs]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
@@ -260,6 +271,27 @@ def debug_trace_body(recorder: FlightRecorder, query: dict) -> dict:
     return {
         "capacity": recorder.capacity,
         "recorded_total": recorder.total_recorded,
+        "spans": spans,
+    }
+
+
+def debug_spans_body(recorder: FlightRecorder, query: dict) -> dict:
+    """The shared /debug/spans handler body (server + proxy): the raw
+    ring records for a cross-process trace assembler.  ?drain=1 takes
+    the records out of the ring atomically, so repeated scrapes return
+    disjoint batches and a long chaos run cannot silently evict spans
+    between polls.  Raises ValueError on malformed parameters."""
+    drain = False
+    if "drain" in query:
+        raw = str(query["drain"][0]).strip().lower()
+        if raw not in ("0", "1", "true", "false"):
+            raise ValueError(f"bad drain value {raw!r}")
+        drain = raw in ("1", "true")
+    spans = recorder.drain() if drain else recorder.snapshot()
+    return {
+        "capacity": recorder.capacity,
+        "recorded_total": recorder.total_recorded,
+        "drained": drain,
         "spans": spans,
     }
 
