@@ -37,6 +37,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -1206,6 +1207,176 @@ def bench_egress_overhead(n_keys: int = 20_000, iters: int = 20,
         srv_off.shutdown()
 
 
+def bench_query_plane(n_keys: int = 20_000, iters: int = 16,
+                      samples_per_key: int = 2,
+                      window_slots: int = 6,
+                      query_slots: int = 4,
+                      target_qps: float = 100.0) -> dict:
+    """The live query plane under concurrent full-rate ingest
+    (ISSUE-15 acceptance): a server with window rings runs a
+    flush-per-refill loop while a query worker issues windowed
+    /query evaluations back to back against random keys.
+
+    Reported:
+      query_p50_ms / query_p99_ms   per-query latency through the real
+                                    engine entry (parse -> ring fusion
+                                    -> numpy eval twin -> payload),
+                                    including the slot-finalize cost
+                                    the first query of each slot pays
+      query_staleness_ms            median answer staleness (time from
+                                    the covered cut to the answer)
+      query_flush_degrade_pct       flush p50 with the query worker
+                                    running vs without (acceptance:
+                                    <= 5% at the 100k-key shape on the
+                                    driver host; this arm runs the CI
+                                    shape, the driver sweep validates
+                                    at 100k)
+
+    PAIRED design (the bench_trace_overhead pattern): one flush loop,
+    the query worker GATED on/off alternately within each pair, the
+    reported degradation the median per-pair delta over the gated-off
+    p50 — host drift hits both arms of a pair and cancels (a
+    two-phase on-then-off design swung 3-20% run to run from drift
+    alone).  The worker is PACED at target_qps (a serving load, not a
+    GIL-saturating busy-loop; achieved qps is reported), and the
+    flush loop keeps a small inter-flush gap: production flushes are
+    periodic, so slot finalization and queries landing BETWEEN
+    flushes are free — back-to-back flushing would book every
+    microsecond of query work as flush degradation, which is not the
+    deployed contention shape.
+
+    On a GIL-shared CPU box the degradation is ~the worker's CPU
+    share (qps x per-query cost) independent of flush size — the
+    flush's "device" segment is host compute here.  On the driver
+    host the device segment releases the GIL, so the acceptance
+    number is expected lower than this arm's CPU reading at equal
+    qps.  100 qps is an aggressive operator load (dashboards poll at
+    ~1/s); the reported query_qps makes the load explicit.
+    """
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+
+    cfg = config_mod.Config(
+        interval=10.0, percentiles=list(PERCENTILES),
+        hostname="query-bench", trace_flush_enabled=False,
+        query_window_slots=window_slots)
+    srv = Server(cfg)
+    srv.start()
+    try:
+        agg = srv.aggregator
+        rows = np.empty(n_keys, np.int64)
+        with agg.lock:
+            for i in range(n_keys):
+                rows[i] = agg.digests.row_for(
+                    MetricKey(f"qb.k{i}", sm.TYPE_HISTOGRAM, ""),
+                    MetricScope.GLOBAL_ONLY, [])
+        rng = np.random.default_rng(11)
+        wts = np.ones(n_keys * samples_per_key)
+
+        flush_gap_s = 0.05
+
+        def flush_once() -> float:
+            vals = rng.gamma(2.0, 10.0, n_keys * samples_per_key)
+            with agg.lock:
+                agg.digests.sample_batch(
+                    np.tile(rows, samples_per_key), vals, wts)
+                agg.digests.touched[rows] = True
+            agg.sync_staged(min_samples=1)
+            t0 = time.perf_counter()
+            srv.flush()
+            dt = time.perf_counter() - t0
+            time.sleep(flush_gap_s)
+            return dt
+
+        stop = threading.Event()
+        gate = threading.Event()   # worker queries only while set
+        q_lat_ms: list[float] = []
+        q_stale_ms: list[float] = []
+        key_rng = np.random.default_rng(13)
+
+        period_s = 1.0 / target_qps
+
+        def query_worker() -> None:
+            # warm the engine (first query pays slot finalization for
+            # the whole ring) before latencies count
+            srv.query.serve({"name": ["qb.k0"], "q": ["0.5,0.99"],
+                             "slots": [str(query_slots)]})
+            while not stop.is_set():
+                if not gate.is_set():
+                    gate.wait(period_s)
+                    continue
+                name = f"qb.k{key_rng.integers(0, n_keys)}"
+                t0 = time.perf_counter()
+                code, body = srv.query.serve(
+                    {"name": [name], "q": ["0.5,0.99"],
+                     "slots": [str(query_slots)]})
+                dt = time.perf_counter() - t0
+                if code == 200:
+                    q_lat_ms.append(dt * 1e3)
+                    if body.get("staleness_ms") is not None:
+                        q_stale_ms.append(body["staleness_ms"])
+                if period_s > dt:
+                    stop.wait(period_s - dt)
+
+        worker = threading.Thread(target=query_worker, daemon=True,
+                                  name="query-bench")
+        gate.set()
+        t_b0 = time.perf_counter()
+        worker.start()
+        deltas: list[float] = []
+        offs: list[float] = []
+        for i in range(iters + 2):
+            # alternate which arm goes first within the pair so any
+            # first-mover advantage cancels too
+            if i % 2:
+                gate.set()
+                t_on = flush_once()
+                gate.clear()
+                t_off = flush_once()
+            else:
+                gate.clear()
+                t_off = flush_once()
+                gate.set()
+                t_on = flush_once()
+            if i >= 2:      # first pairs pay compile/warmup
+                deltas.append(t_on - t_off)
+                offs.append(t_off)
+        stop.set()
+        gate.set()          # unblock a worker parked on gate.wait
+        worker.join(timeout=10.0)
+        achieved_qps = len(q_lat_ms) / max(
+            time.perf_counter() - t_b0, 1e-9) * 2.0  # gated ~half time
+
+        p50_off = float(np.percentile(offs, 50))
+        degrade = float(np.percentile(deltas, 50)) / p50_off * 100.0
+        p50_on = p50_off * (1.0 + degrade / 100.0)
+        out = {
+            "query_p50_ms": round(float(np.percentile(q_lat_ms, 50)),
+                                  3),
+            "query_p99_ms": round(float(np.percentile(q_lat_ms, 99)),
+                                  3),
+            "query_staleness_ms": round(
+                float(np.percentile(q_stale_ms, 50)), 3),
+            "query_flush_degrade_pct": round(degrade, 2),
+            "queries_measured": len(q_lat_ms),
+            "query_qps": round(achieved_qps, 1),
+            "query_window_slots": window_slots,
+            "query_fused_slots": query_slots,
+        }
+        log(f"query-plane arm: {len(q_lat_ms)} queries over "
+            f"{len(deltas)} flush pairs at {n_keys} keys — query "
+            f"p50 {out['query_p50_ms']} ms / p99 "
+            f"{out['query_p99_ms']} ms, staleness p50 "
+            f"{out['query_staleness_ms']} ms, flush p50 "
+            f"{p50_off * 1e3:.1f} -> {p50_on * 1e3:.1f} ms "
+            f"({degrade:+.2f}%)")
+        return out
+    finally:
+        srv.shutdown()
+
+
 def bench_checkpoint_overhead(n_keys: int = 20_000, iters: int = 40,
                               samples_per_key: int = 2) -> float:
     """Steady-state cost of crash checkpointing on the flush path
@@ -1405,6 +1576,25 @@ def main() -> None:
     except Exception as e:
         log(f"egress-overhead arm failed: {e}")
         result["egress_overhead_pct"] = {"error": str(e)[:200]}
+    # live query plane under concurrent full-rate ingest (ISSUE-15
+    # acceptance: query p99 served between flushes, flush p50 degraded
+    # <= 5% at the 100k shape — CI runs 20k, the driver sweep
+    # validates at 100k).  Promised keys: error values on arm failure.
+    try:
+        import jax as _jax
+        qp = bench_query_plane(
+            n_keys=(100_000
+                    if _jax.devices()[0].platform == "tpu"
+                    else 20_000))
+        result.update({k: qp[k] for k in ("query_p50_ms",
+                                          "query_p99_ms",
+                                          "query_staleness_ms")})
+        result["query_plane"] = qp
+    except Exception as e:
+        log(f"query-plane arm failed: {e}")
+        for k in ("query_p50_ms", "query_p99_ms",
+                  "query_staleness_ms"):
+            result[k] = {"error": str(e)[:200]}
     try:
         dvec = bench_depth_vector()
         if dvec is not None:
@@ -1494,7 +1684,8 @@ def main() -> None:
                 "weighted_dev_only_p50", "kernel_stage_ms",
                 "trace_overhead_pct", "checkpoint_overhead_pct",
                 "egress_overhead_pct", "moments_merge_p50_ms",
-                "moments_vs_tdigest_speedup"]
+                "moments_vs_tdigest_speedup", "query_p50_ms",
+                "query_p99_ms", "query_staleness_ms"]
     if "mesh_scaling_per_device_work_ms" in result:
         promised += ["mesh_scaling_e2e_ms", "mesh_scaling_segments_ms"]
     if "ingest_udp_pkts_per_sec" in result:

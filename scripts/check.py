@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """One-command repo gate: vnlint -> native sanitizer smoke -> reshard,
-crash and egress chaos cells -> mixed-family dryrun -> tier-1 pytest.
-Nonzero exit on ANY unsuppressed lint finding, sanitizer report,
-failed chaos cell, failed mixed-family conservation, or test failure —
-the local equivalent of a CI required check.
+crash and egress chaos cells -> mixed-family dryrun -> proc chaos cell
+-> query dryrun cell -> tier-1 pytest.  Nonzero exit on ANY
+unsuppressed lint finding, sanitizer report, failed chaos cell, failed
+mixed-family conservation, failed query envelope/staleness gate, or
+test failure — the local equivalent of a CI required check.
 
     python scripts/check.py              # the full gate
     python scripts/check.py --fast      # vnlint + sanitizer smoke only
@@ -203,6 +204,31 @@ def main() -> int:
                         "PASS" if proc_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3f. the live-query-plane cell (ISSUE 15): every tier serves
+    # /query, and each interval's windowed answers — locals, every
+    # global directly, and the proxy's ring-routed scatter-gather —
+    # are gated on the exact CPU oracle: exact fused counts,
+    # per-family committed quantile envelopes, and the staleness
+    # contract (every answer covers data up to the last completed
+    # cut).  Mixed-family (tdigest + moments keys) so both window
+    # fusion codecs are exercised; nonzero exit on any envelope or
+    # staleness violation (promised report keys:
+    # query.{served,p99_ms,staleness_ms,envelope_ok})
+    query_rc = 0
+    if args.fast:
+        results.append(("query dryrun cell", "SKIP", 0.0))
+    else:
+        t0 = stage("query dryrun cell (windowed /query vs oracle)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        query_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py", "--query",
+             "--globals", "2", "--intervals", "3",
+             "--histo-keys", "2", "--moments-keys", "2"],
+            env=env)
+        results.append(("query dryrun cell",
+                        "PASS" if query_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -222,7 +248,8 @@ def main() -> int:
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
-               or egress_rc or mixed_rc or proc_rc or test_rc) else 0
+               or egress_rc or mixed_rc or proc_rc or query_rc
+               or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

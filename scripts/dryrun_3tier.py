@@ -25,6 +25,11 @@ Usage:
                                           # matrix: SIGKILL host loss,
                                           # SIGSTOP stragglers,
                                           # crash/revive + replay
+  python scripts/dryrun_3tier.py --query  # live-query-plane oracle arm:
+                                          # windowed /query answers on
+                                          # all three tiers gated on
+                                          # exact counts, per-family
+                                          # envelopes + staleness
   python scripts/dryrun_3tier.py --trace   # traced: every interval must
                                            # assemble into ONE complete
                                            # 3-tier trace (incl. the
@@ -91,6 +96,16 @@ def main(argv=None) -> int:
                     "spans (forward-retry and ring-scale-up chaos "
                     "arms included), and the per-interval "
                     "critical-path table is printed")
+    ap.add_argument("--query", action="store_true",
+                    help="run the live-query-plane oracle arm: every "
+                    "tier serves /query, and each interval's windowed "
+                    "answers (locals, every global, and the proxy "
+                    "scatter-gather) are gated on the exact CPU "
+                    "oracle — exact fused counts, per-family "
+                    "committed envelopes, and the staleness contract "
+                    "(answers cover data up to the last completed "
+                    "cut).  Nonzero exit on any envelope or "
+                    "staleness violation")
     ap.add_argument("--lock-witness", action="store_true",
                     help="wrap every tier's named locks in the runtime "
                     "lock witness and cross-validate observed "
@@ -179,7 +194,7 @@ def main(argv=None) -> int:
         moments_histo_keys=args.moments_keys,
         chaos=args.chaos, lock_witness=args.lock_witness,
         trace=args.trace, telemetry=args.telemetry,
-        procs=args.procs)
+        query=args.query, procs=args.procs)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:
@@ -199,6 +214,13 @@ def main(argv=None) -> int:
     tr = report["trace"]
     tail = (f"; {tr['intervals']} interval trace(s) complete, "
             f"{tr['orphans']} orphans" if args.trace else "")
+    if args.query and report["query"] is not None:
+        qr = report["query"]
+        tail += ("; query: "
+                 f"{qr['served']} served, {qr['errors']} errors, "
+                 f"p99 {qr['p99_ms']} ms, staleness "
+                 f"{qr['staleness_ms']} ms, envelopes "
+                 f"{'OK' if qr['envelope_ok'] else 'VIOLATED'}")
     if args.moments_keys:
         sf = report["sketch_families"]
         tail += ("; mixed-family: "

@@ -1,0 +1,213 @@
+"""gRPC wedged-subchannel audit (ROADMAP #5e): every long-lived
+channel must either re-dial fresh after a peer death or run
+wait_for_ready, so a killed-and-revived peer is always re-reachable.
+
+  ForwardClient   live sends stay fail-fast (an UNAVAILABLE failure is
+                  provably undelivered and therefore spool-able — a
+                  wait-for-ready DEADLINE would be ambiguous), and
+                  exhausted transport failures re-dial a FRESH channel
+                  (the proxy-destination immunity pattern).  Spool
+                  replay already runs wait_for_ready (PR 14).
+  Destinations    immune by construction (pinned here): a failed
+                  Destination is destroyed with its channel, and the
+                  post-revival re-add dials a fresh one.
+  Falconer sink   re-dials after consecutive send failures.
+"""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.forward.client import ForwardClient, RetryPolicy
+from veneur_tpu.samplers import samplers as sm
+from veneur_tpu.sources.proxy import GrpcImportServer
+
+
+def _free_port() -> int:
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+def _mk_metrics(n: int) -> list:
+    return [sm.ForwardMetric(name=f"cr.c{i}", tags=[],
+                             kind=sm.TYPE_COUNTER, scope=2,
+                             counter_value=1) for i in range(n)]
+
+
+def test_forward_client_redials_fresh_channel_after_peer_death():
+    """Kill-and-revive regression: a ForwardClient whose sends
+    exhausted against a dead peer must re-dial a fresh channel, so the
+    revived peer (same port) is reached by the NEXT send without
+    inheriting the dead subchannel's backoff state."""
+    port = _free_port()
+    imported = []
+    srv = GrpcImportServer(f"127.0.0.1:{port}",
+                           import_metric=imported.append)
+    srv.start()
+    client = ForwardClient(f"127.0.0.1:{port}", timeout_s=2.0,
+                           retry=RetryPolicy(attempts=2,
+                                             backoff_base_s=0.01))
+    try:
+        client.send(_mk_metrics(3), epoch=1)
+        assert client.stats()["sent"] == 3
+        # peer dies hard (no drain)
+        srv.server.stop(grace=0)
+        with pytest.raises(Exception):
+            client.send(_mk_metrics(3), epoch=2)
+        st = client.stats()
+        assert st["dropped"] == 3
+        # the exhausted transport failure re-dialed a fresh channel
+        assert st["redials"] == 1
+        # peer revives on the SAME port
+        srv2 = GrpcImportServer(f"127.0.0.1:{port}",
+                                import_metric=imported.append)
+        srv2.start()
+        try:
+            deadline = time.time() + 10.0
+            delivered = False
+            epoch = 3
+            while time.time() < deadline and not delivered:
+                try:
+                    client.send(_mk_metrics(3), epoch=epoch)
+                    delivered = True
+                except Exception:
+                    epoch += 1
+                    time.sleep(0.1)
+            assert delivered, "revived peer never re-reached"
+            assert len(imported) == 6
+        finally:
+            srv2.stop()
+    finally:
+        client.close()
+
+
+def test_forward_client_failpoint_failures_never_redial():
+    """Injected chaos faults must not churn channels: only REAL
+    transport failures trigger the fresh re-dial."""
+    from veneur_tpu import failpoints
+    port = _free_port()
+    srv = GrpcImportServer(f"127.0.0.1:{port}",
+                           import_metric=lambda m: None)
+    srv.start()
+    client = ForwardClient(f"127.0.0.1:{port}", timeout_s=2.0,
+                           retry=RetryPolicy(attempts=2,
+                                             backoff_base_s=0.01))
+    failpoints.configure("forward.send", "grpc-error",
+                         code="UNAVAILABLE")
+    try:
+        with pytest.raises(Exception):
+            client.send(_mk_metrics(2), epoch=1)
+        st = client.stats()
+        assert st["dropped"] == 2
+        assert st["redials"] == 0
+    finally:
+        failpoints.clear()
+        client.close()
+        srv.stop()
+
+
+def test_redial_rate_limited_and_stubs_swap():
+    """Back-to-back exhaustions re-dial at most once per
+    REDIAL_MIN_INTERVAL_S, and the channel object actually changes."""
+    port = _free_port()   # nothing ever listens here
+    client = ForwardClient(f"127.0.0.1:{port}", timeout_s=0.5,
+                           retry=RetryPolicy(attempts=1,
+                                             backoff_base_s=0.01))
+    try:
+        ch0 = client.channel
+        with pytest.raises(Exception):
+            client.send(_mk_metrics(1), epoch=1)
+        assert client.stats()["redials"] == 1
+        assert client.channel is not ch0
+        ch1 = client.channel
+        with pytest.raises(Exception):
+            client.send(_mk_metrics(1), epoch=2)
+        # within the rate limit: no second re-dial
+        assert client.stats()["redials"] == 1
+        assert client.channel is ch1
+    finally:
+        client.close()
+
+
+def test_proxy_destination_revival_dials_fresh_channel():
+    """Pin the proxy tier's immunity: a destination whose peer died is
+    destroyed with its channel, and the post-revival re-add (what the
+    discovery poll / breaker probe does) constructs a NEW Destination
+    on a NEW channel — no subchannel state survives the death."""
+    from veneur_tpu.proxy.destinations import Destinations
+    port = _free_port()
+    imported = []
+    srv = GrpcImportServer(f"127.0.0.1:{port}",
+                           import_metric=imported.append)
+    srv.start()
+    addr = f"127.0.0.1:{port}"
+    dests = Destinations(send_buffer_size=64, send_timeout_s=2.0,
+                         dial_timeout_s=2.0, breaker_threshold=1,
+                         breaker_reset_s=0.05)
+    try:
+        dests.add([addr])
+        d0 = dests.get("anykey")
+        ch0 = d0.channel
+        from veneur_tpu.protocol import metric_pb2
+        m = metric_pb2.Metric(name="cr.x", type=metric_pb2.Counter)
+        m.counter.value = 1
+        assert d0.send_many([m]) == 0
+        deadline = time.time() + 5.0
+        while not imported and time.time() < deadline:
+            time.sleep(0.02)
+        assert imported
+        srv.server.stop(grace=0)
+        # drive sends until the broken RPC destroys the destination
+        deadline = time.time() + 10.0
+        while dests.size() and time.time() < deadline:
+            try:
+                dests.get("anykey").send_many([m])
+            except LookupError:
+                break
+            time.sleep(0.05)
+        assert dests.size() == 0, "dead destination not torn down"
+        # revive on the same port; wait out the breaker cooldown, then
+        # the re-add IS the half-open probe — on a fresh channel
+        srv2 = GrpcImportServer(addr, import_metric=imported.append)
+        srv2.start()
+        try:
+            deadline = time.time() + 10.0
+            while not dests.size() and time.time() < deadline:
+                dests.add([addr])
+                time.sleep(0.05)
+            assert dests.size() == 1
+            d1 = dests.get("anykey")
+            assert d1 is not d0 and d1.channel is not ch0
+            before = len(imported)
+            assert d1.send_many([m]) == 0
+            deadline = time.time() + 5.0
+            while len(imported) == before and time.time() < deadline:
+                time.sleep(0.02)
+            assert len(imported) > before
+        finally:
+            srv2.stop()
+    finally:
+        dests.clear()
+
+
+def test_falconer_sink_redials_after_consecutive_errors():
+    from veneur_tpu import sinks as sink_mod
+    from veneur_tpu.sinks.falconer import FalconerSpanSink
+    from veneur_tpu.ssf import SSFSpan
+    port = _free_port()   # dead target
+    sink = FalconerSpanSink(sink_mod.SinkSpec(
+        kind="falconer",
+        config={"target": f"127.0.0.1:{port}",
+                "send_timeout": 0.2, "redial_after": 2}))
+    sink.start()
+    ch0 = sink._channel
+    span = SSFSpan()
+    sink.ingest(span)
+    assert sink.errors == 1 and sink.redials == 0
+    sink.ingest(span)
+    assert sink.errors == 2 and sink.redials == 1
+    assert sink._channel is not ch0

@@ -174,6 +174,22 @@ class Config:
     # to merge)
     sketch_moments_k: int = 8
     set_precision: int = 14
+    # live query plane (veneur_tpu/query/): each histogram arena keeps
+    # a bounded ring of query_window_slots per-interval mergeable
+    # sub-sketches, rotated at the flush cut, and GET /query fuses the
+    # slots covering a requested window on read — windowed quantiles
+    # between flushes ("p99 over the last 30 s, now").  0 disables the
+    # plane (and /query answers 404).  query_slot_seconds is the
+    # nominal slot duration for window->slot conversion and the
+    # documented staleness bound (answers cover data up to the last
+    # completed cut, <= 1 slot behind now); 0 = follow `interval`.
+    # OPT-IN (default 0 = off): each slot holds references to its
+    # interval's staged digest points, so an enabled ring retains up
+    # to query_window_slots intervals of staged samples — a real
+    # memory cost at high rates that a deployment must choose, not
+    # inherit (8 is the recommended enabled value; see example.yaml).
+    query_window_slots: int = 0
+    query_slot_seconds: float = 0.0
     # evaluate t-digest flush quantiles in float64 (the reference's
     # merging_digest.go float64 semantics): keeps integer exactness for
     # values past 2^24 (epoch stamps, byte counters) at the cost of
@@ -399,6 +415,10 @@ class Config:
             self.egress_breaker_reset = 0.0
         if self.egress_spool_replay_interval <= 0:
             self.egress_spool_replay_interval = 0.5
+        if self.query_window_slots < 0:
+            self.query_window_slots = 0
+        if self.query_slot_seconds < 0:
+            self.query_slot_seconds = 0.0
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
         if self.read_buffer_size_bytes <= 0:
@@ -465,7 +485,8 @@ _DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval",
                     "spool_replay_interval", "checkpoint_interval",
                     "egress_retry_backoff", "egress_breaker_reset",
                     "egress_spool_max_age",
-                    "egress_spool_replay_interval"}
+                    "egress_spool_replay_interval",
+                    "query_slot_seconds"}
 
 
 def _coerce(key: str, value: Any) -> Any:

@@ -114,7 +114,9 @@ class MetricAggregator:
                  sketch_family_default: str = "tdigest",
                  sketch_family_rules: Optional[list] = None,
                  sketch_moments_k: int = 0,
-                 cardinality_rollup_family: str = "tdigest"):
+                 cardinality_rollup_family: str = "tdigest",
+                 query_window_slots: int = 0,
+                 query_slot_seconds: float = 0.0):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -272,6 +274,21 @@ class MetricAggregator:
             else 1 << hll_mod.DEFAULT_PRECISION
         self._pct_arr = jnp.asarray([0.5] + list(self.percentiles),
                                     jnp.float32)
+        # live query plane (veneur_tpu/query/): bounded window rings of
+        # per-interval mergeable sub-sketches next to each histogram
+        # arena's live state.  Rotation rides the flush cut (the slot
+        # IS the cut's immutable snapshot part — zero copies, no new
+        # lock on the ingest path); reads fuse covered slots on demand.
+        # NOT checkpointed: a restore cold-starts the ring (documented
+        # cold-ring-on-restore contract, tests/test_query.py).
+        self.query_rings = None
+        if query_window_slots > 0:
+            from veneur_tpu.query.rings import WindowRing
+            self.query_rings = {
+                "tdigest": WindowRing(query_window_slots,
+                                      query_slot_seconds),
+                "moments": WindowRing(query_window_slots,
+                                      query_slot_seconds)}
 
     # -- ingest (ProcessMetric, worker.go:348-396) -------------------------
 
@@ -845,6 +862,9 @@ class MetricAggregator:
         seg["keys_moments"] = len(snap["moments"]["rows"])
         seg["keys_counter"] = len(snap["counters"]["rows"])
         seg["keys_set"] = len(snap["sets"]["rows"])
+        # the window-ring cut timestamp is taken HERE (the cut), but
+        # the slot is published at emit time — see _emit_pending
+        snap["query_cut_ts"] = time.time()
 
         # ONE device program call evaluates the flush on the snapshot
         # OUTSIDE the lock, so ingest continues (flusher.go:26-122 +
@@ -910,6 +930,21 @@ class MetricAggregator:
                 np.max(np.abs(host["m_resid"])))
             seg["moments_resid"] = self.last_moments_resid
         seg["emit_s"] = time.perf_counter() - t0
+
+        # window-ring rotation rides the cut: the snapshot parts taken
+        # at dispatch (immutable by construction — reset swapped in
+        # fresh state) become the newest query slot for each histogram
+        # family, stamped with the CUT's timestamp.  Published at emit
+        # rather than dispatch so the first query's lazy slot
+        # finalization (name-hash build + staged-COO sort) lands in
+        # the inter-flush gap instead of overlapping the in-flight
+        # flush.  Two O(1) deque appends; empty intervals rotate too,
+        # so the staleness contract (answers cover data up to the last
+        # completed cut) holds through idle periods.
+        if self.query_rings is not None:
+            cut_ts = snap["query_cut_ts"]
+            self.query_rings["tdigest"].rotate(snap["digests"], cut_ts)
+            self.query_rings["moments"].rotate(snap["moments"], cut_ts)
         return res
 
     @staticmethod
@@ -1498,6 +1533,9 @@ class MetricAggregator:
         snap["digests"] = {
             "rows": drows,
             "names": d.name_col[drows],
+            # hash(name) mirror for the query plane's vectorized slot
+            # lookups (maintained incrementally at registration)
+            "name_hashes": d.name_hash_col[drows].copy(),
             "tags": d.tags_col[drows],
             "kinds": d.kind_col[drows],
             "scopes": d.scope_col[drows].copy(),
@@ -1525,6 +1563,7 @@ class MetricAggregator:
         snap["moments"] = {
             "rows": mrows,
             "names": m.name_col[mrows],
+            "name_hashes": m.name_hash_col[mrows].copy(),
             "tags": m.tags_col[mrows],
             "kinds": m.kind_col[mrows],
             "scopes": m.scope_col[mrows].copy(),

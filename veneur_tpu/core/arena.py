@@ -100,6 +100,14 @@ class _ArenaBase:
         # the flush's host time)
         self.name_col = np.empty(capacity, object)
         self.tags_col = np.empty(capacity, object)
+        # per-row hash(name) mirror: the live query plane's window
+        # slots look keys up by ONE vectorized int64 compare instead
+        # of an object-array scan (or a per-slot python hash pass) —
+        # maintained incrementally here because rows persist across
+        # intervals, so the cost is O(1) per registration, not
+        # O(keys) per query slot.  Process-local (python str hashes),
+        # never serialized.
+        self.name_hash_col = np.zeros(capacity, np.int64)
         # only the digest snapshot consumes per-row kinds (histogram vs
         # timer for forwarding); other families skip the column
         self.kind_col = (np.empty(capacity, object)
@@ -179,6 +187,8 @@ class _ArenaBase:
             [self.name_col, np.empty(old, object)])
         self.tags_col = np.concatenate(
             [self.tags_col, np.empty(old, object)])
+        self.name_hash_col = np.concatenate(
+            [self.name_hash_col, np.zeros(old, np.int64)])
         if self.kind_col is not None:
             self.kind_col = np.concatenate(
                 [self.kind_col, np.empty(old, object)])
@@ -206,6 +216,7 @@ class _ArenaBase:
             self.meta[row] = RowMeta(key=key, tags=tags, scope=scope)
             self.name_col[row] = key.name
             self.tags_col[row] = tags
+            self.name_hash_col[row] = hash(key.name)
             if self.kind_col is not None:
                 self.kind_col[row] = key.type
             self.scope_col[row] = int(scope)
@@ -234,6 +245,7 @@ class _ArenaBase:
             self.meta[row] = None
             self.name_col[row] = None
             self.tags_col[row] = None
+            self.name_hash_col[row] = 0
             if self.kind_col is not None:
                 self.kind_col[row] = None
             self.scope_col[row] = 0
@@ -369,6 +381,7 @@ class _ArenaBase:
                                      scope=scope)
             self.name_col[row] = key.name
             self.tags_col[row] = list(tags)
+            self.name_hash_col[row] = hash(key.name)
             if self.kind_col is not None:
                 self.kind_col[row] = key.type
             self.scope_col[row] = int(scope)
@@ -419,6 +432,7 @@ class _ArenaBase:
             self.meta[row] = None
             self.name_col[row] = None
             self.tags_col[row] = None
+            self.name_hash_col[row] = 0
             if self.kind_col is not None:
                 self.kind_col[row] = None
             self.scope_col[row] = 0

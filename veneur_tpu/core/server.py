@@ -211,7 +211,10 @@ class Server:
             sketch_family_default=cfg.sketch_family_default,
             sketch_family_rules=list(cfg.sketch_family_rules),
             sketch_moments_k=cfg.sketch_moments_k,
-            cardinality_rollup_family=cfg.cardinality_rollup_family)
+            cardinality_rollup_family=cfg.cardinality_rollup_family,
+            query_window_slots=cfg.query_window_slots,
+            query_slot_seconds=(cfg.query_slot_seconds
+                                or cfg.interval))
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -252,6 +255,15 @@ class Server:
             trace_rec.DeterministicSampler(cfg.trace_flush_sample_rate,
                                            cfg.trace_seed)
             if cfg.trace_flush_enabled else None)
+        # live query plane (veneur_tpu/query/): the /query read path
+        # over the aggregator's window rings.  The engine exists even
+        # with the rings disabled so /query answers a clean 404.
+        from veneur_tpu.query.engine import QueryEngine
+        self.query = QueryEngine(
+            self.aggregator, recorder=self.flight_recorder,
+            statsd_fn=lambda: self.statsd,
+            tier="local" if cfg.is_local else "global",
+            hostname=cfg.hostname)
         # trace ids imported since the last flush (global tier): the
         # flush root span tags them so the cross-tier assembler can join
         # this global flush onto each settled local interval's trace

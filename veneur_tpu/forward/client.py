@@ -53,6 +53,16 @@ SEND_METRICS_V2 = "/forwardrpc.Forward/SendMetricsV2"
 # exactly once.
 CHUNK_ID_KEY = "veneur-chunk-id"
 
+# minimum spacing between fresh-channel re-dials after exhausted
+# transport failures (see ForwardClient._maybe_redial); an extended
+# outage re-dials once per failed flush at most, not once per chunk
+REDIAL_MIN_INTERVAL_S = 1.0
+# how long a replaced channel lingers before close(): concurrent
+# forwards (up to FORWARD_MAX_IN_FLIGHT flush threads) may still hold
+# in-flight RPCs on it, and closing under them turns recoverable
+# failures into closed-channel drops
+REDIAL_OLD_CHANNEL_LINGER_X = 2.0
+
 
 def chunk_id_value(ident: tuple) -> str:
     source, epoch, idx = ident
@@ -196,24 +206,8 @@ class ForwardClient:
         self.deadline_retry_safe = bool(deadline_retry_safe)
         self.retry = retry or RetryPolicy()
         self._retry_rng = random.Random(self.retry.seed)
-        if credentials is not None:
-            self.channel = grpc.secure_channel(address, credentials)
-        else:
-            self.channel = grpc.insecure_channel(address)
-        self._v2 = self.channel.stream_unary(
-            SEND_METRICS_V2,
-            request_serializer=metric_pb2.Metric.SerializeToString,
-            response_deserializer=empty_pb2.Empty.FromString)
-        self._v1 = self.channel.unary_unary(
-            SEND_METRICS,
-            request_serializer=forward_pb2.MetricList.SerializeToString,
-            response_deserializer=empty_pb2.Empty.FromString)
-        # raw-bytes V1 sender: spool replay re-delivers the serialized
-        # MetricList exactly as recorded (no re-parse, same identity)
-        self._v1_raw = self.channel.unary_unary(
-            SEND_METRICS,
-            request_serializer=lambda b: b,
-            response_deserializer=empty_pb2.Empty.FromString)
+        self._credentials = credentials
+        self._dial()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=self.max_streams,
             thread_name_prefix=f"fwd-{address}")
@@ -230,8 +224,73 @@ class ForwardClient:
         self.retries = 0     # retry attempts taken
         self.dropped = 0     # metrics given up on after exhausted retries
         self.spilled = 0     # metrics spilled to the durable spool
+        self.redials = 0     # fresh channels dialed after exhaustion
+        self._last_redial = 0.0
         if self.spool is not None:
             self.spool.start_replayer(self._replay_send)
+
+    def _dial(self) -> None:
+        """(Re)build the channel and its method stubs.  Stubs are
+        looked up as attributes at every call site, so an in-flight
+        send on the OLD channel keeps its stubs while new sends pick
+        up the fresh ones."""
+        if self._credentials is not None:
+            self.channel = grpc.secure_channel(self.address,
+                                               self._credentials)
+        else:
+            self.channel = grpc.insecure_channel(self.address)
+        self._v2 = self.channel.stream_unary(
+            SEND_METRICS_V2,
+            request_serializer=metric_pb2.Metric.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+        self._v1 = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+        # raw-bytes V1 sender: spool replay re-delivers the serialized
+        # MetricList exactly as recorded (no re-parse, same identity)
+        self._v1_raw = self.channel.unary_unary(
+            SEND_METRICS,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+    def _maybe_redial(self, cause: BaseException) -> None:
+        """Exhausted retries on a REAL transport failure: swap in a
+        fresh channel so later flushes (and spool replay ticks) never
+        inherit this channel's subchannel state.
+
+        This is the wedged-subchannel-after-peer-death audit fix
+        (ROADMAP #5e): a peer that died under a live channel leaves
+        its subchannel in TRANSIENT_FAILURE with growing backoff, and
+        fail-fast RPCs can keep failing UNAVAILABLE long after the
+        peer revived on the same port — the mode that bit spool
+        replay.  The proxy tier is immune by construction (a failed
+        Destination is destroyed with its channel and the half-open
+        probe dials fresh); this gives the forward client the same
+        re-dial-fresh story WITHOUT changing RPC semantics — live
+        sends stay fail-fast, so a dead peer still fails UNAVAILABLE
+        (provably undelivered -> spool-able), never an ambiguous
+        wait-for-ready DEADLINE.  Injected failpoint faults never
+        re-dial (chaos must not churn channels), and re-dials are
+        rate-limited.  The old channel lingers before close():
+        concurrent forwards may hold in-flight RPCs on it."""
+        if (not isinstance(cause, grpc.RpcError)
+                or getattr(cause, "failpoint", None)):
+            return
+        now = time.monotonic()
+        with self._stats_lock:
+            if now - self._last_redial < REDIAL_MIN_INTERVAL_S:
+                return
+            self._last_redial = now
+            self.redials += 1
+            old = self.channel
+        logger.info("forward to %s: re-dialing a fresh channel after "
+                    "exhausted retries (%s)", self.address, cause)
+        self._dial()
+        timer = threading.Timer(
+            REDIAL_OLD_CHANNEL_LINGER_X * self.timeout_s, old.close)
+        timer.daemon = True
+        timer.start()
 
     # the server's flush path may hand a trace parent span down
     # (core/server.py _forward_safely); custom forwarder callables that
@@ -247,7 +306,8 @@ class ForwardClient:
     def stats(self) -> dict[str, int]:
         with self._stats_lock:
             return {"sent": self.sent, "retries": self.retries,
-                    "dropped": self.dropped, "spilled": self.spilled}
+                    "dropped": self.dropped, "spilled": self.spilled,
+                    "redials": self.redials}
 
     def spool_stats(self) -> Optional[dict]:
         return None if self.spool is None else self.spool.stats()
@@ -352,6 +412,9 @@ class ForwardClient:
         re-raises the cause.  The chunk identity still guards the
         REPLAY path's own crash window against a ledger-bearing
         global."""
+        # exhausted transport failures re-dial a fresh channel so the
+        # NEXT flush / replay tick cannot inherit a wedged subchannel
+        self._maybe_redial(f.cause)
         spilled = dropped = 0
         tid = sid = 0
         if trace_parent is not None:

@@ -2,9 +2,16 @@
 
 Mirrors `http.go:15-67`: /healthcheck, /version, /builddate, optional
 /config/json + /config/yaml (secret-redacted, util/config/config.go:65-96),
-optional /quitquitquit, and the debug suite (server.go:1366-1383 /
-SURVEY §5.1):
+optional /quitquitquit, the live query plane, and the debug suite
+(server.go:1366-1383 / SURVEY §5.1):
 
+  /query                 windowed quantiles served between flushes
+                         (?name=&window_s=|slots=&q=0.5,0.99&tags=
+                         [&type=histogram|timer]): fuses the window
+                         ring's per-interval sub-sketches on read and
+                         answers quantiles + a self-describing
+                         mergeable payload (veneur_tpu/query/; gated
+                         by query_window_slots > 0)
   /debug/vars            runtime stats + native data-plane stage counters
   /debug/threads         stack dump of every live thread
   /debug/profile         JAX device trace (the TPU-side profile)
@@ -185,6 +192,12 @@ def debug_vars(server) -> dict:
     recorder = getattr(server, "flight_recorder", None)
     if recorder is not None:
         stats["trace_recorded"] = recorder.total_recorded
+    query = getattr(server, "query", None)
+    if query is not None:
+        # live query plane: served/error counts, recent latency
+        # percentiles, and per-family ring occupancy (slots held,
+        # total cuts, evictions, staged points retained)
+        stats["query"] = query.stats()
     return stats
 
 
@@ -251,6 +264,17 @@ def make_handler(server) -> type:
                 self._reply(200,
                             config_yaml_body(config_mod.redacted_dict(cfg)),
                             "application/x-yaml")
+            elif self.path.startswith("/query"):
+                # the live query plane: windowed quantiles between
+                # flushes (veneur_tpu/query/).  The engine owns the
+                # whole contract — parsing, fusion, telemetry, the
+                # flight-recorder query span — and returns the HTTP
+                # status with the JSON body
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                code, body = server.query.serve(q)
+                self._reply(code, json.dumps(body, indent=2).encode(),
+                            "application/json")
             elif self.path == "/debug/vars":
                 self._reply(200,
                             json.dumps(debug_vars(server),
@@ -372,9 +396,15 @@ def _pprof_index(cfg) -> bytes:
     server.go:1366-1383): one line per profile with where to get it."""
     gate = ("" if cfg.enable_profiling
             else "  [disabled: set enable_profiling]")
+    qgate = ("" if cfg.query_window_slots > 0
+             else "  [disabled: set query_window_slots]")
     lines = [
         "veneur_tpu /debug/pprof/",
         "",
+        f"query           /query?name=&window_s=|slots=&q=0.5,0.99"
+        f"&tags={qgate}",
+        "                windowed quantiles between flushes (the live "
+        "query plane)",
         f"profile         /debug/pprof/profile?seconds=N&hz=M{gate}",
         "                host CPU, folded stacks (flamegraph.pl ready)",
         "threads         /debug/threads",

@@ -102,6 +102,22 @@ class ProxyConfig:
     # ports here (atomic rename), so a supervising harness can bind
     # port 0 everywhere and read real ports back.  "" = no file
     port_file: str = ""
+    # live query plane (veneur_tpu/query/): the proxy answers
+    # GET /query by scatter-gather — it ring-routes the key to the one
+    # global that owns it (the one-global-per-key invariant makes this
+    # a single hop), fetches that global's windowed answer over HTTP,
+    # optionally fans out to requested locals, and merges the
+    # self-describing family payloads (query/engine.merge_responses).
+    # query_destinations maps each ring member's gRPC address to its
+    # HTTP address (the ring speaks gRPC; /query speaks HTTP);
+    # query_local_addresses lists local-tier HTTP addresses a
+    # `locals=all` query may fan out to (requests naming other
+    # addresses are rejected — the proxy only queries peers the
+    # operator configured).  query_timeout bounds the whole
+    # scatter-gather deadline.
+    query_destinations: dict = field(default_factory=dict)
+    query_local_addresses: list[str] = field(default_factory=list)
+    query_timeout: float = 2.0
     # the destination set is ONE meshed global group
     # (parallel/multihost.py) instead of a consistent-hash ring: every
     # inbound batch goes to EVERY member, in identical enqueue order
@@ -157,6 +173,10 @@ def proxy_config_from_dict(data: dict) -> ProxyConfig:
             data.get("http_enable_profiling", False)),
         trace_ring_capacity=int(data.get("trace_ring_capacity", 512)),
         port_file=data.get("port_file", ""),
+        query_destinations=dict(data.get("query_destinations") or {}),
+        query_local_addresses=list(
+            data.get("query_local_addresses") or []),
+        query_timeout=parse_duration(data.get("query_timeout", 2.0)),
         mesh_fanout=bool(data.get("mesh_fanout", False)))
 
 
@@ -187,6 +207,8 @@ def debug_vars(proxy) -> dict:
     stats["reshard"] = proxy.destinations.reshard_stats()
     stats["trace_recorded"] = proxy.recorder.total_recorded
     stats["threads"] = threading.active_count()
+    # live query plane: scatter-gather served/error counts per outcome
+    stats["query"] = dict(proxy.query_stats)
     return stats
 
 
@@ -222,6 +244,17 @@ class Proxy:
             recorder=self.recorder)
         self.stats = {"received": 0, "routed": 0, "dropped": 0,
                       "no_destination": 0, "rerouted": 0}
+        # live query plane scatter-gather accounting (/debug/vars ->
+        # query): answers served, request errors, upstream fetch
+        # failures (an upstream error degrades the merge, it does not
+        # fail the request unless EVERY upstream failed)
+        self.query_stats = {"served": 0, "errors": 0,
+                            "upstream_errors": 0}
+        # long-lived scatter-gather pool (lazy): a per-request
+        # ThreadPoolExecutor would pay thread spawn/teardown on the
+        # serving read path
+        self._query_pool = None
+        self._query_pool_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         # mesh_fanout: held across the whole enqueue loop so every
         # member's single ordered lane sees the SAME batch sequence —
@@ -526,6 +559,214 @@ class Proxy:
         ring."""
         self.handle_metrics(ms, rerouted=True)
 
+    # -- live query plane: scatter-gather /query ---------------------------
+
+    def _query_routing_key(self, name: str, tags: list,
+                           kind: str) -> str:
+        """The SAME key construction as metric routing
+        (handlers.go:111-112): name + lower(type) + joined filtered
+        tags — so a windowed query lands on exactly the global that
+        owns the key's sketches.  Tags join SORTED: every forwarded
+        metric's wire tags are parse-canonicalized (sorted,
+        util/tagging.py), so the owning global was chosen from the
+        sorted form — an unsorted query join would hash a
+        differently-ordered tag list to a different (wrong) ring
+        member."""
+        tags = sorted(
+            t for t in tags
+            if not any(tm.match(t) for tm in self.cfg.ignore_tags))
+        return f"{name}{kind}{','.join(tags)}"
+
+    @staticmethod
+    def _query_fetch(addr: str, params: str, timeout_s: float) -> dict:
+        """One upstream /query fetch; raises on transport errors or a
+        non-200 answer (the caller accounts it as an upstream
+        error)."""
+        import json as json_mod
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://{addr}/query?{params}",
+                timeout=timeout_s) as resp:
+            return json_mod.loads(resp.read())
+
+    def handle_query(self, q: dict) -> tuple[int, dict]:
+        """Scatter-gather one windowed query: ring-route to the owning
+        global (one hop, by the one-global-per-key invariant), fan out
+        to any requested locals, merge the self-describing family
+        payloads, and answer with the fused quantiles plus per-upstream
+        diagnostics.  Bounded by cfg.query_timeout end to end."""
+        import time as time_mod
+        import urllib.parse
+
+        from veneur_tpu.query import engine as qengine
+        t0 = time_mod.perf_counter()
+        deadline = t0 + self.cfg.query_timeout
+        try:
+            code, body = self._handle_query_inner(
+                q, deadline, qengine, urllib.parse, time_mod)
+        except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
+            # same contract as QueryEngine.serve: a malformed or
+            # version-skewed upstream body (merge KeyError etc.) must
+            # come back as an accounted JSON 500, not an aborted
+            # connection invisible to query_stats and the span ring
+            code, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        with self._stats_lock:
+            if code == 200:
+                self.query_stats["served"] += 1
+            else:
+                self.query_stats["errors"] += 1
+        from veneur_tpu.trace import recorder as trace_rec
+        trace_rec.event_span(
+            self.recorder, "query",
+            {"name": (q.get("name") or [""])[0], "code": code,
+             "latency_ms": round(
+                 (time_mod.perf_counter() - t0) * 1e3, 3)})
+        return code, body
+
+    def _handle_query_inner(self, q, deadline, qengine, uparse,
+                            time_mod) -> tuple[int, dict]:
+        try:
+            spec = qengine.parse_query_params(q)
+        except qengine.QueryError as e:
+            return e.code, {"error": str(e)}
+        locals_param = (q.get("locals") or [""])[0]
+        if locals_param == "all":
+            local_addrs = list(self.cfg.query_local_addresses)
+        elif locals_param:
+            local_addrs = [a for a in locals_param.split(",") if a]
+            unknown = [a for a in local_addrs
+                       if a not in self.cfg.query_local_addresses]
+            if unknown:
+                return 400, {"error": "unknown local address(es) "
+                             f"{unknown}; the proxy only queries "
+                             "configured query_local_addresses"}
+        else:
+            local_addrs = []
+        # ring-route by the SAME key the forward path used.  The wire
+        # key embeds the metric kind, and histogram vs timer keys of
+        # the same name can live on DIFFERENT globals — so a query
+        # that does not pin type= fans out to BOTH kinds' owners
+        # (usually the same member; deduped below), instead of
+        # silently asking the histogram-routed global about a timer.
+        # mesh_fanout is the opposite topology: every member holds
+        # the FULL replicated data, so exactly ONE member answers
+        # (merging two replicas would double-count everything)
+        if self.cfg.mesh_fanout:
+            members = self.destinations.all_members()
+            if not members:
+                return 503, {"error": "no destinations"}
+            http_addr = self.cfg.query_destinations.get(
+                members[0].address)
+            if http_addr is None:
+                return 502, {"error": "no query_destinations mapping "
+                             f"for mesh member {members[0].address}"}
+            global_addrs = [http_addr]
+        else:
+            kinds = ([spec["kind"]] if spec["kind"]
+                     else ["histogram", "timer"])
+            global_addrs = []
+            for kind in kinds:
+                try:
+                    dest = self.destinations.get(
+                        self._query_routing_key(
+                            spec["name"], spec["tags"], kind))
+                except LookupError:
+                    return 503, {"error": "no destinations"}
+                http_addr = self.cfg.query_destinations.get(
+                    dest.address)
+                if http_addr is None:
+                    return 502, {"error": "no query_destinations "
+                                 "mapping for ring member "
+                                 f"{dest.address}"}
+                if http_addr not in global_addrs:
+                    global_addrs.append(http_addr)
+
+        # the upstream request re-encodes the validated spec verbatim
+        params = {"name": spec["name"],
+                  "q": ",".join(repr(float(p)) for p in spec["qs"])}
+        if spec["slots"] is not None:
+            params["slots"] = str(spec["slots"])
+        elif spec["window_s"] is not None:
+            params["window_s"] = repr(spec["window_s"])
+        if spec["tags"]:
+            params["tags"] = ",".join(spec["tags"])
+        if spec["kind"]:
+            params["type"] = spec["kind"]
+        encoded = uparse.urlencode(params)
+
+        targets = ([("global", a) for a in global_addrs]
+                   + [("local", a) for a in local_addrs])
+        responses: list[dict] = []
+        upstreams: list[dict] = []
+
+        def fetch(tier_addr):
+            tier, addr = tier_addr
+            budget = deadline - time_mod.perf_counter()
+            if budget <= 0:
+                raise TimeoutError("query deadline exhausted")
+            return self._query_fetch(addr, encoded, budget)
+
+        if len(targets) == 1:
+            results = [(targets[0], self._try(fetch, targets[0]))]
+        else:
+            pool = self._ensure_query_pool()
+            futs = [(t, pool.submit(fetch, t)) for t in targets]
+            results = [(t, self._try(f.result)) for t, f in futs]
+        errors = 0
+        for (tier, addr), (resp, err) in results:
+            row = {"tier": tier, "address": addr}
+            if err is not None:
+                errors += 1
+                row["error"] = err
+            else:
+                responses.append(resp)
+                row.update(slots_fused=resp.get("slots_fused"),
+                           count=resp.get("count"),
+                           staleness_ms=resp.get("staleness_ms"),
+                           fresh=resp.get("fresh"))
+            upstreams.append(row)
+        if errors:
+            with self._stats_lock:
+                self.query_stats["upstream_errors"] += errors
+        if not responses:
+            return 502, {"error": "every upstream failed",
+                         "upstreams": upstreams}
+        merged = qengine.merge_responses(responses, spec["qs"])
+        merged["upstreams"] = upstreams
+        merged["tier"] = "proxy"
+        if local_addrs and len(responses) > 1:
+            # `locals=` exists for LOCAL_ONLY-scope keys that never
+            # forward; for mixed-scope keys the owning global already
+            # holds every local's forwarded samples, so this merge
+            # counts them twice.  The caller asked for it, but the
+            # answer says so out loud instead of being silently wrong.
+            merged["double_count_risk"] = True
+        return 200, merged
+
+    def _ensure_query_pool(self):
+        with self._query_pool_lock:
+            if self._query_pool is None:
+                if self._shutdown.is_set():
+                    # a request racing stop() must not resurrect the
+                    # pool stop() just tore down (its threads would
+                    # outlive the proxy); surfaced as a JSON 500 by
+                    # handle_query's catch-all
+                    raise RuntimeError("proxy is stopping")
+                self._query_pool = \
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=self.cfg.grpc_workers,
+                        thread_name_prefix="proxy-query")
+            return self._query_pool
+
+    @staticmethod
+    def _try(fn, *a) -> tuple:
+        """(result, None) or (None, error string) — upstream fetch
+        failures degrade the merge and are accounted, never silent."""
+        try:
+            return fn(*a), None
+        except Exception as e:  # noqa: BLE001 - stringified upstream error
+            return None, f"{type(e).__name__}: {e}"
+
     # -- HTTP surface (handlers.go:30-38 healthcheck +
     #    cmd/veneur-proxy/main.go:84-102 version/builddate/config/debug) --
 
@@ -563,6 +804,15 @@ class Proxy:
                         self, 200,
                         http_api.config_yaml_body(redacted_proxy_dict(cfg)),
                         "application/x-yaml")
+                elif self.path.startswith("/query"):
+                    # live query plane: scatter-gather the ring-routed
+                    # global (+ requested locals) and merge payloads
+                    import urllib.parse
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    code, body = proxy.handle_query(q)
+                    http_api.reply(self, code, json_mod.dumps(
+                        body, indent=2).encode(), "application/json")
                 elif (self.path == "/debug/vars"
                         and cfg.http_enable_profiling):
                     http_api.reply(self, 200, json_mod.dumps(
@@ -644,4 +894,8 @@ class Proxy:
             # shutdown() blocks forever unless serve_forever is running
             self.httpd.shutdown()
         self.httpd.server_close()
+        with self._query_pool_lock:
+            pool, self._query_pool = self._query_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         self.destinations.clear()

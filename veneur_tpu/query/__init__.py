@@ -1,0 +1,22 @@
+"""Live query plane: windowed quantiles served between flushes.
+
+The pipeline's historical read path is the interval flush -> sink
+fan-out; this package adds the ON-DEMAND read path (ROADMAP #6, after
+"Data stream fusion for accurate quantile tracking and analysis",
+arXiv 2101.06758): each histogram arena keeps a bounded ring of
+per-interval mergeable sub-sketches next to its live state
+(query/rings.py), and `GET /query` on every tier fuses the slots
+covering a requested window on read — t-digest point-cloud merge for
+the digest family, elementwise vector add + one maxent solve for the
+moments family (whose window fusion is nearly free, arXiv 1803.01969)
+— and evaluates quantiles through the existing eval twins.
+
+Rotation rides the flush cut (core/aggregator.py flush_dispatch): the
+ring slot IS the immutable flush snapshot the cut already produced, so
+the ingest path gains no new lock and the flush path gains two deque
+appends.  The staleness contract follows: an answer always covers data
+up to the most recent completed cut, i.e. at most one slot behind now.
+"""
+
+from veneur_tpu.query.engine import QueryEngine, QueryError  # noqa: F401
+from veneur_tpu.query.rings import WindowRing  # noqa: F401

@@ -1,0 +1,509 @@
+"""The query engine: window fusion + quantile evaluation on read.
+
+One `QueryEngine` per server answers `GET /query` from the arenas'
+window rings (query/rings.py):
+
+  digest family   fuse = concatenate the covered slots' staged weighted
+                  point clouds for the key (raw samples, imported
+                  centroids and hot-row pre-reduction centroids alike),
+                  then evaluate with the numpy mirror of the serving
+                  flush's evaluation core (sketches/tdigest.py
+                  weighted_eval: stable sort, cumulative-weight midpoint
+                  interpolation, clamp to the exact [min, max]).
+
+  moments family  fuse = elementwise vector add (sketches/moments.py
+                  merge_vectors rebases and adds the power-sum blocks),
+                  then ONE maxent solve (ops/moments_eval.py
+                  quantiles_from_vectors) — the arXiv 1803.01969 window
+                  story: fusion cost independent of the window's sample
+                  count.
+
+Every answer carries a self-describing mergeable PAYLOAD (a centroid
+list for digests — the forwarding wire shape — or the moments vector),
+so an upper tier (the proxy's scatter-gather) can merge answers through
+the same family codecs it already speaks, and `merge_responses` below
+is that merge.
+
+Telemetry per request: query.served_total / query.errors_total /
+query.latency_ms (tier-tagged), /debug/vars -> query, and a `query`
+span on the flight recorder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+_PCT_MIN, _PCT_MAX = 0.0, 1.0
+# answers whose fused digest point cloud exceeds this compress down to
+# the wire centroid shape (bounded payload; the reference's
+# MergingDigest.Data form) before serialization
+PAYLOAD_POINT_CAP = 2048
+# recent per-request latencies kept for stats()/bench percentiles
+_LATENCY_RING = 512
+
+
+class QueryError(ValueError):
+    """A request error with its HTTP status (400 bad params, 404
+    disabled/unknown, 503 upstream)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = int(code)
+
+
+def weighted_quantiles_np(vals: np.ndarray, wts: np.ndarray,
+                          d_min: float, d_max: float,
+                          qs) -> Optional[np.ndarray]:
+    """Numpy mirror of the flush evaluation core
+    (sketches/tdigest.py weighted_eval, single row): stable sort by
+    value, cumulative-weight midpoint interpolation, clamp to the
+    authoritative [min, max].  Returns None for an empty cloud."""
+    wts = np.asarray(wts, np.float64)
+    occ = wts > 0
+    v = np.asarray(vals, np.float64)[occ]
+    w = wts[occ]
+    if len(v) == 0:
+        return None
+    order = np.argsort(v, kind="stable")
+    v, w = v[order], w[order]
+    cum = np.cumsum(w)
+    total = cum[-1]
+    qs = np.asarray(qs, np.float64)
+    if len(v) == 1:
+        out = np.full(len(qs), v[0])
+        return np.clip(out, d_min, d_max)
+    cmid = cum - 0.5 * w
+    tq = qs * total
+    # rank = count of cmid strictly below the target (the twin's fused
+    # comparison-count), then clamp into [1, n-1] for interpolation
+    idx = np.searchsorted(cmid, tq, side="left")
+    ii = np.clip(idx, 1, len(v) - 1)
+    m_lo, m_hi = v[ii - 1], v[ii]
+    c_lo, c_hi = cmid[ii - 1], cmid[ii]
+    t = np.where(c_hi > c_lo,
+                 (tq - c_lo) / np.maximum(c_hi - c_lo, 1e-30), 0.0)
+    out = m_lo + (m_hi - m_lo) * np.clip(t, 0.0, 1.0)
+    return np.clip(out, d_min, d_max)
+
+
+def _compress_payload(vals: np.ndarray, wts: np.ndarray,
+                      compression: float) -> tuple[np.ndarray,
+                                                   np.ndarray]:
+    """Bound a fused point cloud to wire-centroid size via the serving
+    compress kernel (sketches/tdigest.py compress, eager on a [1, M]
+    row padded to a power of two)."""
+    import jax.numpy as jnp
+
+    from veneur_tpu.sketches import tdigest as td
+    m = 1 << (len(vals) - 1).bit_length()
+    dv = np.zeros((1, m), np.float32)
+    dw = np.zeros((1, m), np.float32)
+    dv[0, :len(vals)] = vals
+    dw[0, :len(wts)] = wts
+    ccap = td.centroid_capacity(compression)
+    cm, cw = td.compress(jnp.asarray(dv), jnp.asarray(dw),
+                         compression, ccap)
+    cm = np.asarray(cm[0], np.float64)
+    cw = np.asarray(cw[0], np.float64)
+    occ = cw > 0
+    return cm[occ], cw[occ]
+
+
+# -- parameter parsing (shared by server and proxy HTTP handlers) --------
+
+def parse_query_params(q: dict) -> dict:
+    """urllib parse_qs dict -> validated query spec.  Raises
+    QueryError(400) on anything malformed."""
+    name = (q.get("name") or [""])[0]
+    if not name:
+        raise QueryError(400, "missing name=")
+    try:
+        qs = [float(x) for x in
+              (q.get("q") or ["0.5"])[0].split(",") if x]
+    except ValueError:
+        raise QueryError(400, "bad q= (comma-separated floats)")
+    if not qs or any(not (_PCT_MIN < p < _PCT_MAX) for p in qs):
+        raise QueryError(400, "q= values must be in (0, 1)")
+    window_s = None
+    slots = None
+    if "slots" in q:
+        try:
+            slots = int(q["slots"][0])
+        except ValueError:
+            raise QueryError(400, "bad slots=")
+        if slots < 1:
+            raise QueryError(400, "slots= must be >= 1")
+    elif "window_s" in q:
+        try:
+            window_s = float(q["window_s"][0])
+        except ValueError:
+            raise QueryError(400, "bad window_s=")
+        if not window_s > 0:
+            raise QueryError(400, "window_s= must be > 0")
+    tags = [t for t in (q.get("tags") or [""])[0].split(",") if t]
+    kind = (q.get("type") or [None])[0]
+    if kind is not None and kind not in ("histogram", "timer"):
+        raise QueryError(400, "type= must be histogram or timer")
+    return {"name": name, "qs": qs, "window_s": window_s,
+            "slots": slots, "tags": tags, "kind": kind}
+
+
+class QueryEngine:
+    """Per-server windowed-quantile read path over the aggregator's
+    window rings.  Thread-safe; holds no aggregator or arena lock —
+    reads touch only immutable flush snapshots."""
+
+    def __init__(self, aggregator, recorder=None, statsd_fn=None,
+                 tier: str = "local", hostname: str = ""):
+        self.agg = aggregator
+        self.recorder = recorder
+        self._statsd_fn = statsd_fn or (lambda: None)
+        self.tier = tier
+        self.hostname = hostname
+        self.served = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._latencies_ms: list[float] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.agg.query_rings is not None
+
+    def stats(self) -> dict:
+        with self._lock:
+            lat = list(self._latencies_ms)
+        out = {"enabled": self.enabled, "served": self.served,
+               "errors": self.errors}
+        if lat:
+            out["latency_p50_ms"] = float(np.percentile(lat, 50))
+            out["latency_p99_ms"] = float(np.percentile(lat, 99))
+        if self.enabled:
+            rings = self.agg.query_rings
+            out["rings"] = {fam: r.stats() for fam, r in rings.items()}
+        return out
+
+    # -- HTTP entry (telemetry + span wrapper) ---------------------------
+
+    def serve(self, q: dict) -> tuple[int, dict]:
+        """parse_qs dict -> (http status, JSON-able body), with the
+        per-request telemetry contract: query.served_total /
+        query.errors_total / query.latency_ms (tier-tagged) and one
+        `query` span on the flight recorder."""
+        from veneur_tpu import scopedstatsd
+        statsd = scopedstatsd.ensure(self._statsd_fn())
+        t0 = time.perf_counter()
+        name = (q.get("name") or [""])[0]
+        code = 200
+        try:
+            spec = parse_query_params(q)
+            body = self.query(**spec)
+        except QueryError as e:
+            code, body = e.code, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
+            code, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        ttags = [f"tier:{self.tier}"]
+        with self._lock:
+            if code == 200:
+                self.served += 1
+            else:
+                self.errors += 1
+            self._latencies_ms.append(dt_ms)
+            if len(self._latencies_ms) > _LATENCY_RING:
+                del self._latencies_ms[:-_LATENCY_RING]
+        if code == 200:
+            statsd.count("query.served_total", 1, tags=ttags)
+        else:
+            statsd.count("query.errors_total", 1,
+                         tags=ttags + [f"code:{code}"])
+        statsd.timing("query.latency_ms", dt_ms, tags=ttags)
+        if self.recorder is not None:
+            from veneur_tpu import trace as trace_mod
+            span = trace_mod.Span("query", service="veneur_tpu",
+                                  tags={"tier": self.tier,
+                                        "name": name,
+                                        "code": str(code)})
+            span.start_ns = time.time_ns() - int(dt_ms * 1e6)
+            span.error = code >= 400
+            span.client = None        # ring fast path, like segments
+            span.finish()
+            self.recorder.record_span(span)
+        return code, body
+
+    # -- the windowed read -----------------------------------------------
+
+    def query(self, name: str, tags: Optional[list] = None,
+              qs=(0.5,), window_s: Optional[float] = None,
+              slots: Optional[int] = None,
+              kind: Optional[str] = None,
+              payload: bool = True) -> dict:
+        """Fuse the ring slots covering the window and evaluate the
+        requested quantiles for one key.  A key absent from every
+        covered slot answers count=0 (not an error: absence of samples
+        is a legitimate windowed answer)."""
+        rings = self.agg.query_rings
+        if rings is None:
+            raise QueryError(
+                404, "query plane disabled (query_window_slots: 0)")
+        jtags = ",".join(sorted(tags)) if tags else ""
+        now = time.time()
+        td_slots, td_info = rings["tdigest"].covering(
+            window_s=window_s, slots=slots, now=now)
+        mo_slots, mo_info = rings["moments"].covering(
+            window_s=window_s, slots=slots, now=now)
+        # the two family rings rotate back to back (not atomically);
+        # a read landing between the appends would see one ring a cut
+        # ahead of the other.  Coverage metadata merges CONSERVATIVELY
+        # over both so the answer never claims coverage one fused
+        # family lacks: fresh/partial only hold when both hold, and
+        # the covered window is the intersection's bounds
+        info = dict(td_info)
+        info["fresh"] = bool(td_info["fresh"] and mo_info["fresh"])
+        info["partial"] = bool(td_info["partial"]
+                               or mo_info["partial"])
+        info["slots_fused"] = min(td_info["slots_fused"],
+                                  mo_info["slots_fused"])
+        # intersection bounds: [max(from), min(to)] — min(from) would
+        # claim coverage one of the fused families lacks
+        for k, pick in (("covered_from_unix", max),
+                        ("covered_to_unix", min)):
+            vals = [v for v in (td_info[k], mo_info[k])
+                    if v is not None]
+            info[k] = pick(vals) if vals else None
+
+        td = self._fuse_tdigest(td_slots, name, jtags, kind)
+        mo = self._fuse_moments(mo_slots, name, jtags, kind)
+
+        qarr = np.asarray(list(qs), np.float64)
+        out = {
+            "name": name, "tags": sorted(tags) if tags else [],
+            "tier": self.tier, "host": self.hostname,
+            "staleness_ms": (
+                round((now - info["covered_to_unix"]) * 1e3, 3)
+                if info["covered_to_unix"] else None),
+            "quantiles": {}, "count": 0.0, "sum": 0.0,
+            "min": None, "max": None, "family": "none",
+            "mixed_families": bool(td["count"] > 0 and mo["count"] > 0),
+            "payload": None,
+        }
+        out.update(info)
+        # a key can legitimately live in BOTH families across a window
+        # (a cross-tier sketch_family_rules mismatch is the documented
+        # degradation); the families cannot merge exactly, so the
+        # answer follows the family holding more mass and flags it
+        fam = td if td["count"] >= mo["count"] else mo
+        if fam["count"] > 0:
+            out["family"] = fam["family"]
+            out["count"] = fam["count"]
+            out["sum"] = fam["sum"]
+            out["min"] = fam["min"]
+            out["max"] = fam["max"]
+            quants = fam["eval"](qarr)
+            if quants is not None:
+                out["quantiles"] = {
+                    repr(float(p)): float(v)
+                    for p, v in zip(qarr, quants)}
+            if payload:
+                out["payload"] = fam["payload"]()
+        return out
+
+    def _fuse_tdigest(self, slots_list, name, jtags, kind) -> dict:
+        vparts: list[np.ndarray] = []
+        wparts: list[np.ndarray] = []
+        mn, mx = np.inf, -np.inf
+        cnt = sm = rs = 0.0
+        for slot in slots_list:
+            pos = slot.positions(name, jtags, kind)
+            if not pos:
+                continue
+            prt = slot.part
+            if len(pos) == 1:
+                # the common case: one position per key per slot —
+                # scalar item reads beat five fancy-index+reduce
+                # numpy round-trips (~8 us each) on the query path
+                i = pos[0]
+                mn = min(mn, float(prt["d_min"][i]))
+                mx = max(mx, float(prt["d_max"][i]))
+                cnt += float(prt["d_weight"][i])
+                sm += float(prt["d_sum"][i])
+                rs += float(prt["d_rsum"][i])
+                rows_sel = prt["rows"][i:i + 1]
+            else:
+                parr = np.asarray(pos, np.int64)
+                mn = min(mn, float(prt["d_min"][parr].min()))
+                mx = max(mx, float(prt["d_max"][parr].max()))
+                cnt += float(prt["d_weight"][parr].sum())
+                sm += float(prt["d_sum"][parr].sum())
+                rs += float(prt["d_rsum"][parr].sum())
+                rows_sel = prt["rows"][parr]
+            v, w = slot.points_for(rows_sel)
+            if len(v):
+                vparts.append(v)
+                wparts.append(w)
+
+        def _eval(qarr):
+            if not vparts:
+                return None
+            return weighted_quantiles_np(
+                np.concatenate(vparts), np.concatenate(wparts),
+                mn, mx, qarr)
+
+        def _payload():
+            if not vparts:
+                return None
+            v = np.concatenate(vparts)
+            w = np.concatenate(wparts)
+            if len(v) > PAYLOAD_POINT_CAP:
+                v, w = _compress_payload(
+                    v, w, self.agg.digests.compression)
+            return {"family": "tdigest",
+                    "means": [float(x) for x in v],
+                    "weights": [float(x) for x in w],
+                    "min": float(mn), "max": float(mx),
+                    "count": cnt, "sum": sm, "rsum": rs}
+
+        return {"family": "tdigest", "count": cnt, "sum": sm,
+                "min": (float(mn) if cnt > 0 else None),
+                "max": (float(mx) if cnt > 0 else None),
+                "eval": _eval, "payload": _payload}
+
+    def _fuse_moments(self, slots_list, name, jtags, kind) -> dict:
+        from veneur_tpu.sketches import moments as mo
+        marena = self.agg.moments
+        vec = None
+        for slot in slots_list:
+            pos = slot.positions(name, jtags, kind)
+            if not pos:
+                continue
+
+            def _compute(slot=slot, pos=pos):
+                # REDUCED staged view: assemble_vectors' per-point
+                # mask walks only the key's own points, and the
+                # result memoizes per slot, so repeat queries are a
+                # dict hit + vector add
+                parr = np.asarray(pos, np.int64)
+                sub = slot.staged_rows_for(slot.part["rows"][parr])
+                vecs = marena.assemble_vectors(slot.part, sub, parr)
+                out = vecs[0].copy()
+                for row in vecs[1:]:
+                    out = mo.merge_vectors(out[None, :],
+                                           row[None, :])[0]
+                return out
+            svec = slot.vector_memo((name, jtags, kind), _compute)
+            vec = (svec.copy() if vec is None
+                   else mo.merge_vectors(vec[None, :],
+                                         svec[None, :])[0])
+        cnt = float(vec[mo.IDX_COUNT]) if vec is not None else 0.0
+
+        def _eval(qarr):
+            if vec is None or cnt <= 0:
+                return None
+            from veneur_tpu.ops import moments_eval as me
+            return me.quantiles_from_vectors(vec[None, :], qarr)[0]
+
+        def _payload():
+            if vec is None:
+                return None
+            return {"family": "moments", "k": marena.k,
+                    "vector": [float(x) for x in vec]}
+
+        return {"family": "moments", "count": cnt,
+                "sum": (float(vec[mo.IDX_SUM]) if vec is not None
+                        else 0.0),
+                "min": (float(vec[mo.IDX_MIN]) if cnt > 0 else None),
+                "max": (float(vec[mo.IDX_MAX]) if cnt > 0 else None),
+                "eval": _eval, "payload": _payload}
+
+
+# -- cross-tier merge (the proxy's scatter-gather codec) -----------------
+
+def merge_responses(responses: list[dict], qs,
+                    compression: float = 100.0) -> dict:
+    """Merge tier /query answers through their self-describing
+    payloads: digest payloads concatenate as weighted point clouds and
+    re-evaluate through the same twin; moments payloads vector-add and
+    re-solve.  Families that cannot merge exactly follow the
+    larger-mass family with `mixed_families` flagged (the same
+    degradation contract as a cross-tier sketch_family_rules
+    mismatch).  Coverage metadata merges conservatively: staleness is
+    the WORST upstream's, `partial`/`fresh` only hold if they hold
+    everywhere."""
+    from veneur_tpu.sketches import moments as mo
+    qarr = np.asarray(list(qs), np.float64)
+    td_v: list[np.ndarray] = []
+    td_w: list[np.ndarray] = []
+    td = {"count": 0.0, "sum": 0.0, "rsum": 0.0,
+          "min": np.inf, "max": -np.inf}
+    mo_vec = None
+    mixed = False
+    for r in responses:
+        mixed = mixed or bool(r.get("mixed_families"))
+        p = r.get("payload")
+        if not p:
+            continue
+        if p["family"] == "tdigest":
+            td_v.append(np.asarray(p["means"], np.float64))
+            td_w.append(np.asarray(p["weights"], np.float64))
+            td["count"] += float(p["count"])
+            td["sum"] += float(p["sum"])
+            td["rsum"] += float(p.get("rsum", 0.0))
+            td["min"] = min(td["min"], float(p["min"]))
+            td["max"] = max(td["max"], float(p["max"]))
+        elif p["family"] == "moments":
+            vec = np.asarray(p["vector"], np.float64)
+            mo_vec = (vec if mo_vec is None
+                      else mo.merge_vectors(mo_vec[None, :],
+                                            vec[None, :])[0])
+    mo_count = float(mo_vec[mo.IDX_COUNT]) if mo_vec is not None else 0.0
+    out = {
+        "name": responses[0]["name"] if responses else "",
+        "tags": responses[0].get("tags", []) if responses else [],
+        "quantiles": {}, "count": 0.0, "sum": 0.0,
+        "min": None, "max": None, "family": "none",
+        "mixed_families": mixed or (td["count"] > 0 and mo_count > 0),
+        "slots_fused": sum(r.get("slots_fused") or 0
+                           for r in responses),
+        "partial": any(r.get("partial") for r in responses),
+        "fresh": bool(responses) and all(r.get("fresh")
+                                         for r in responses),
+        "staleness_ms": max(
+            (r["staleness_ms"] for r in responses
+             if r.get("staleness_ms") is not None), default=None),
+        "payload": None,
+    }
+    if td["count"] >= mo_count and td["count"] > 0:
+        v = np.concatenate(td_v)
+        w = np.concatenate(td_w)
+        quants = weighted_quantiles_np(v, w, td["min"], td["max"],
+                                       qarr)
+        out.update(family="tdigest", count=td["count"], sum=td["sum"],
+                   min=float(td["min"]), max=float(td["max"]))
+        if quants is not None:
+            out["quantiles"] = {repr(float(p)): float(x)
+                                for p, x in zip(qarr, quants)}
+        if len(v) > PAYLOAD_POINT_CAP:
+            v, w = _compress_payload(v, w, compression)
+        out["payload"] = {"family": "tdigest",
+                          "means": [float(x) for x in v],
+                          "weights": [float(x) for x in w],
+                          "min": float(td["min"]),
+                          "max": float(td["max"]),
+                          "count": td["count"], "sum": td["sum"],
+                          "rsum": td["rsum"]}
+    elif mo_count > 0:
+        from veneur_tpu.ops import moments_eval as me
+        quants = me.quantiles_from_vectors(mo_vec[None, :], qarr)[0]
+        out.update(family="moments", count=mo_count,
+                   sum=float(mo_vec[mo.IDX_SUM]),
+                   min=float(mo_vec[mo.IDX_MIN]),
+                   max=float(mo_vec[mo.IDX_MAX]))
+        out["quantiles"] = {repr(float(p)): float(x)
+                            for p, x in zip(qarr, quants)}
+        out["payload"] = {"family": "moments",
+                          "k": mo.k_from_len(len(mo_vec)),
+                          "vector": [float(x) for x in mo_vec]}
+    return out

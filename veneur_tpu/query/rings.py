@@ -1,0 +1,267 @@
+"""Window rings: bounded per-arena rings of mergeable sub-sketches.
+
+One `WindowRing` per histogram family (digest and moments) lives next
+to the arena's live interval state.  Rotation rides the flush cut —
+the slot's payload IS the immutable snapshot `part` dict the cut
+already produced for the flush program (touched rows, columnar
+metadata, the consumed staged COO, and the exact host scalar copies;
+the moments part additionally carries the ivec accumulator copies) —
+so pushing a slot is two O(1) deque appends with zero copies, and the
+ingest path acquires no new lock.
+
+Slots finalize LAZILY on first read (a (name, tags) -> positions index
+plus, for the digest family, a row-sorted view of the staged COO), so
+the flush path never pays for a window nobody queried; the build cost
+lands on the first query's latency and is cached for the slot's
+lifetime.
+
+Checkpoint contract: rings are NOT checkpointed.  A restore cold-starts
+the ring — the first post-boot queries answer partial windows until
+`query_window_slots` cuts have refilled it (documented in README
+"Live query plane"; pinned by tests/test_query.py).  Windowed reads
+are a freshness surface, not a durability surface: the durable state
+(arena contents, spool, dedup ledger) already rides the checkpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+class WindowSlot:
+    """One completed flush interval's mergeable sub-sketch for one
+    histogram family: a reference to the flush cut's snapshot part plus
+    the cut timestamps.  Immutable after construction except for the
+    lazily-built (and then cached) read indexes."""
+
+    # per-slot memo of key -> positions lookups (bounded: a slot lives
+    # `query_window_slots` intervals, and the memo only grows with
+    # DISTINCT queried keys, but a scripted scan over a huge key space
+    # must not pin O(keys) python objects per slot)
+    _MEMO_CAP = 4096
+
+    __slots__ = ("part", "t_start", "t_end", "seq", "_lock",
+                 "_memo", "_vec_memo", "_name_hash", "_sorted")
+
+    def __init__(self, part: dict, t_start: float, t_end: float,
+                 seq: int):
+        self.part = part
+        self.t_start = t_start
+        self.t_end = t_end
+        self.seq = seq
+        self._lock = threading.Lock()
+        self._memo: dict = {}
+        # moments family: per-key fused wire vectors (an
+        # assemble_vectors walk is O(capacity + the key's staged
+        # points) — pay it once per key per slot, not per query)
+        self._vec_memo: dict = {}
+        self._name_hash: Optional[np.ndarray] = None
+        self._sorted = None
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.part["rows"])
+
+    @property
+    def n_points(self) -> int:
+        return len(self.part["staged"][0])
+
+    def positions(self, name: str, jtags: str,
+                  kind: Optional[str] = None) -> tuple:
+        """Positions (indexes into the part's touched-row arrays) of
+        the key (name, joined-sorted-tags), optionally filtered to one
+        metric kind.  The name match is ONE vectorized object-array
+        compare (never a python walk of the key space — at 100k keys a
+        per-slot dict build held the GIL long enough to tax concurrent
+        flushes by ~2x); only the (few) name hits pay python tag
+        joins, and the result memoizes per slot."""
+        mk = (name, jtags)
+        hits = self._memo.get(mk)
+        if hits is None:
+            names = self.part["names"]
+            # hash(name) column maintained by the arena at key
+            # registration and snapshotted with the part (so a lookup
+            # is ONE numeric compare; an object-array == holds the
+            # GIL per element).  Fallback pass for parts predating
+            # the column (str hashes are cached, so it is one cheap
+            # walk, built once per slot).
+            harr = self.part.get("name_hashes")
+            if harr is None:
+                harr = self._name_hash
+                if harr is None:
+                    with self._lock:
+                        harr = self._name_hash
+                        if harr is None:
+                            harr = np.fromiter(
+                                (hash(x) if x is not None else 0
+                                 for x in names), np.int64,
+                                len(names))
+                            self._name_hash = harr
+            cand = np.nonzero(harr == hash(name))[0] if len(names) \
+                else ()
+            tags = self.part["tags"]
+            kinds = self.part["kinds"]
+            out = []
+            for pos in cand:
+                # hash candidates verify the actual name (collisions)
+                # and the joined-sorted tags
+                t = tags[pos]
+                jt = ",".join(sorted(t)) if t else ""
+                if names[pos] == name and jt == jtags:
+                    out.append((int(pos), kinds[pos]))
+            hits = tuple(out)
+            with self._lock:
+                if len(self._memo) < self._MEMO_CAP:
+                    self._memo[mk] = hits
+        if kind is None:
+            return tuple(p for p, _ in hits)
+        return tuple(p for p, k in hits if k == kind)
+
+    def _ensure_sorted(self):
+        srt = self._sorted
+        if srt is None:
+            with self._lock:
+                srt = self._sorted
+                if srt is None:
+                    srows, svals, swts = self.part["staged"]
+                    order = np.argsort(srows, kind="stable")
+                    srt = (srows[order], svals[order], swts[order])
+                    self._sorted = srt
+        return srt
+
+    def points_for(self, rows: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        """The slot's staged weighted points of the given ROW IDS
+        (digest family: raw samples, imported centroids, and hot-row
+        pre-reduction centroids all live in the staged COO).  First
+        call sorts the COO by row; later reads are two binary searches
+        per row."""
+        _, vals, wts = self.staged_rows_for(rows)
+        return vals, wts
+
+    def staged_rows_for(self, rows: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray,
+                                   np.ndarray]:
+        """The staged COO subset (rows, vals, wts) of the given row
+        ids — the moments fusion hands this reduced view to
+        assemble_vectors so its per-point mask covers only the queried
+        key's points, not the whole interval."""
+        srows, svals, swts = self._ensure_sorted()
+        rparts: list[np.ndarray] = []
+        vparts: list[np.ndarray] = []
+        wparts: list[np.ndarray] = []
+        for r in rows:
+            lo, hi = np.searchsorted(srows, [r, r + 1])
+            if hi > lo:
+                rparts.append(srows[lo:hi])
+                vparts.append(svals[lo:hi])
+                wparts.append(swts[lo:hi])
+        if not vparts:
+            z = np.zeros(0, np.float64)
+            return z.astype(np.int64), z, z
+        if len(vparts) == 1:
+            return rparts[0], vparts[0], wparts[0]
+        return (np.concatenate(rparts), np.concatenate(vparts),
+                np.concatenate(wparts))
+
+    def vector_memo(self, key: tuple, compute):
+        """Per-slot memo of the moments family's fused wire vector for
+        one query key (bounded like the positions memo)."""
+        vec = self._vec_memo.get(key)
+        if vec is None:
+            vec = compute()
+            with self._lock:
+                if len(self._vec_memo) < self._MEMO_CAP:
+                    self._vec_memo[key] = vec
+        return vec
+
+
+class WindowRing:
+    """Bounded ring of `WindowSlot`s for one histogram family.
+
+    `rotate` is called from the flush path (after the lock-held
+    snapshot, outside the aggregator lock); `covering` is called from
+    query threads.  The ring's own lock only guards the deque and the
+    cut bookkeeping — it is never held while fusing or evaluating, and
+    it never nests inside (or outside) any aggregator or arena lock."""
+
+    def __init__(self, slots: int, slot_seconds: float):
+        if slots < 1:
+            raise ValueError(f"query_window_slots must be >= 1, "
+                             f"got {slots}")
+        self.capacity = int(slots)
+        self.slot_seconds = float(slot_seconds)
+        self.lock = threading.Lock()
+        self._slots: deque[WindowSlot] = deque(maxlen=self.capacity)
+        self.cuts = 0          # total rotations (evictions = cuts - len)
+        self.last_cut = 0.0    # unix ts of the newest completed cut
+
+    def rotate(self, part: dict, now_ts: float) -> None:
+        """Push one completed interval's snapshot part as the newest
+        slot (called at the flush cut; O(1), no copies)."""
+        with self.lock:
+            slot = WindowSlot(part,
+                              t_start=self.last_cut or now_ts,
+                              t_end=now_ts, seq=self.cuts)
+            self._slots.append(slot)
+            self.cuts += 1
+            self.last_cut = now_ts
+
+    def covering(self, window_s: Optional[float] = None,
+                 slots: Optional[int] = None,
+                 now: Optional[float] = None) -> tuple[list, dict]:
+        """The newest-first slot list covering the requested window
+        (`slots` = newest-k; else `window_s` of wall time, minimum one
+        slot so a sub-slot window still answers from the last cut),
+        plus coverage metadata: covered_[from,to]_unix, fused/requested
+        counts, `partial` (the ring could not cover the whole request)
+        and `fresh` (the newest completed cut is included — the
+        staleness contract's discrete form)."""
+        import time as _time
+        now = _time.time() if now is None else now
+        with self.lock:
+            snap = list(self._slots)
+            cuts, last_cut = self.cuts, self.last_cut
+        snap.reverse()   # newest first
+        if slots is not None:
+            want = max(1, int(slots))
+            take = snap[:want]
+            partial = len(take) < want
+        else:
+            horizon = now - float(window_s or self.slot_seconds)
+            take = [s for s in snap if s.t_end > horizon]
+            if not take and snap:
+                take = snap[:1]
+            # partial = the request reaches earlier than the fused
+            # coverage AND earlier cuts actually existed (seq > 0);
+            # before the first cut ever, "everything we have" is not
+            # partial — it is simply all the data there is
+            partial = (not take
+                       or (take[-1].t_start > horizon
+                           and take[-1].seq > 0))
+        info = {
+            "slots_fused": len(take),
+            "slots_requested": (want if slots is not None else None),
+            "window_s": (float(window_s) if window_s is not None
+                         else None),
+            "covered_from_unix": take[-1].t_start if take else None,
+            "covered_to_unix": take[0].t_end if take else None,
+            "partial": bool(partial),
+            "fresh": bool(take) and take[0].t_end == last_cut,
+        }
+        return take, info
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "slots": len(self._slots),
+                "capacity": self.capacity,
+                "cuts": self.cuts,
+                "evicted": self.cuts - len(self._slots),
+                "last_cut_unix": self.last_cut,
+                "points_held": sum(s.n_points for s in self._slots),
+            }

@@ -32,9 +32,18 @@ class FalconerSpanSink(sink_mod.BaseSpanSink):
         # backend must time the span out, not wedge the sink worker)
         self.send_timeout_s = float(self.config.get("send_timeout", 5.0))
         self._channel = channel
+        self._injected_channel = channel is not None
         self._send = None
         self.sent = 0
         self.errors = 0
+        self.redials = 0
+        self._consecutive_errors = 0
+        # consecutive send failures before the sink re-dials a fresh
+        # channel: a persistent gRPC client whose peer died and revived
+        # can keep a subchannel wedged in TRANSIENT_FAILURE (the
+        # wedged-subchannel audit, ROADMAP #5e) — re-dialing fresh is
+        # the same immunity the proxy's destination probes have
+        self.redial_after = int(self.config.get("redial_after", 8))
 
     def start(self, trace_client=None) -> None:
         import grpc
@@ -49,15 +58,36 @@ class FalconerSpanSink(sink_mod.BaseSpanSink):
             request_serializer=ssf_pb2.SSFSpan.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
 
+    def _redial(self) -> None:
+        """Swap in a fresh channel (injected test channels are left
+        alone — their owner controls their lifecycle)."""
+        if self._injected_channel or not self.target:
+            return
+        old = self._channel
+        self._channel = None
+        self.redials += 1
+        self.start()
+        if old is not None:
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001 - best-effort close
+                pass
+
     def ingest(self, span) -> None:
         if self._send is None:
             return
         try:
             self._send(span, timeout=self.send_timeout_s)
             self.sent += 1
+            self._consecutive_errors = 0
         except Exception as e:
             self.errors += 1
+            self._consecutive_errors += 1
             logger.debug("falconer send failed: %s", e)
+            if (self.redial_after > 0
+                    and self._consecutive_errors >= self.redial_after):
+                self._consecutive_errors = 0
+                self._redial()
 
 
 sink_mod.register_span_sink("falconer")(FalconerSpanSink)

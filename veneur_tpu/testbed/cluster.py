@@ -59,6 +59,10 @@ def pack_datagrams(lines: list[bytes]) -> tuple[list[bytes], int]:
 # channel sink (sink fan-out is queue-handoff now, not in-flush)
 EGRESS_SETTLE_TIMEOUT_S = 15.0
 
+# per-request bound on testbed /query fetches (the live query plane's
+# oracle arm; generous — CI boxes stall)
+QUERY_FETCH_TIMEOUT_S = 10.0
+
 
 @dataclass
 class ClusterSpec:
@@ -103,6 +107,13 @@ class ClusterSpec:
     # serve the operator /debug surface for local[0] (tests assert the
     # forward retry/drop counters are visible at /debug/vars)
     http_api: bool = False
+    # live query plane (veneur_tpu/query/): window-ring slots per
+    # histogram arena on every tier (rotation rides each flush cut)
+    query_window_slots: int = 8
+    # start an HTTP API on EVERY tier and wire the proxy's
+    # query_destinations/query_local_addresses maps, so /query is
+    # answerable on locals, globals, and the proxy scatter-gather
+    query_api: bool = False
     # runtime lock witness (analysis/witness.py): True = record
     # acquisition-order edges on every tier's named locks into a fresh
     # LockWitness (Cluster.witness); a LockWitness instance = share one
@@ -141,6 +152,9 @@ class _Node:
     checkpoint_dir: str = ""
     spool_dir: str = ""
     grpc_port: int = 0       # global tier: pinned so a revival rebinds it
+    # query_api: this node's operator HTTP surface (serves /query)
+    http: object = None
+    http_addr: str = ""
 
 
 class Cluster:
@@ -217,14 +231,17 @@ class Cluster:
             cardinality_rollup_family=spec.cardinality_rollup_family,
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
+            query_window_slots=spec.query_window_slots,
             hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
         if self.telemetry is not None:
             self.telemetry.install_server(srv)
         srv.start()
-        return _Node(srv, sink, checkpoint_dir=ckpt_dir,
+        node = _Node(srv, sink, checkpoint_dir=ckpt_dir,
                      grpc_port=srv.grpc_import.port)
+        self._attach_http(node)
+        return node
 
     def _boot_local(self, i: int, forward_address: str) -> _Node:
         spec = self.spec
@@ -255,6 +272,7 @@ class Cluster:
             spool_max_age=spec.spool_max_age_s,
             spool_max_bytes=spec.spool_max_bytes,
             spool_replay_interval=spec.spool_replay_interval_s,
+            query_window_slots=spec.query_window_slots,
             hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
@@ -263,8 +281,31 @@ class Cluster:
         srv.start()
         _, addr = srv.statsd_addrs[0]
         tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        return _Node(srv, sink, udp_addr=addr, tx=tx,
+        node = _Node(srv, sink, udp_addr=addr, tx=tx,
                      checkpoint_dir=ckpt_dir, spool_dir=spool_dir)
+        self._attach_http(node)
+        return node
+
+    def _attach_http(self, node: _Node) -> None:
+        """query_api: every tier serves the operator HTTP surface so
+        /query is reachable on locals AND globals (the proxy
+        scatter-gather dials these addresses)."""
+        if not self.spec.query_api:
+            return
+        from veneur_tpu.http_api import HttpApi
+        api = HttpApi(node.server, "127.0.0.1:0")
+        api.start()
+        node.http = api
+        node.http_addr = f"127.0.0.1:{api.address[1]}"
+
+    @staticmethod
+    def _stop_http(node: _Node) -> None:
+        """A retired/crashed node's HTTP surface dies with it (a real
+        crashed process's /query port goes away too — and a leaked
+        ThreadingHTTPServer would keep answering stale data)."""
+        if node.http is not None:
+            node.http.stop()
+            node.http = None
 
     def _forward_address(self) -> str:
         if self.spec.direct:
@@ -286,7 +327,18 @@ class Cluster:
                 proxy_dial_timeout=spec.proxy_dial_timeout,
                 breaker_failure_threshold=spec.breaker_failure_threshold,
                 breaker_reset_timeout=spec.breaker_reset_timeout,
-                reshard_handoff_timeout=spec.reshard_handoff_timeout))
+                reshard_handoff_timeout=spec.reshard_handoff_timeout,
+                # query scatter-gather: ring gRPC address -> that
+                # global's HTTP surface (query_api attaches one per
+                # node); locals extend the list below once booted.
+                # Deadline follows the testbed fetch bound: the FIRST
+                # moments query pays the maxent jax compile, which on
+                # a cold CI box outlives the production 2s default
+                query_timeout=QUERY_FETCH_TIMEOUT_S,
+                query_destinations=(
+                    {f"127.0.0.1:{g.server.grpc_import.port}":
+                     g.http_addr for g in self.globals}
+                    if spec.query_api else {})))
             if self.witness is not None:
                 from veneur_tpu.analysis import witness as witness_mod
                 witness_mod.install_proxy(self.proxy, self.witness)
@@ -296,6 +348,10 @@ class Cluster:
         for i in range(spec.n_locals):
             self.locals.append(
                 self._boot_local(i, self._forward_address()))
+        if spec.query_api and self.proxy is not None:
+            # a `locals=all` proxy query may fan out to exactly these
+            self.proxy.cfg.query_local_addresses.extend(
+                n.http_addr for n in self.locals)
         if spec.http_api:
             from veneur_tpu.http_api import HttpApi
             self.http = HttpApi(self.locals[0].server, "127.0.0.1:0")
@@ -317,6 +373,7 @@ class Cluster:
         dropped, the node's disk dirs are kept."""
         node = self.locals[idx]
         node.server.crash()
+        self._stop_http(node)
         try:
             node.tx.close()
         except OSError:
@@ -333,6 +390,7 @@ class Cluster:
     def crash_global(self, idx: int) -> None:
         node = self.globals[idx]
         node.server.crash()
+        self._stop_http(node)
         self._retired_globals.append(node)
 
     def revive_global(self, idx: int) -> None:
@@ -343,8 +401,22 @@ class Cluster:
         self.globals[idx] = self._boot_global(
             port=old.grpc_port,
             hostname=old.server.config.hostname)
+        # same gRPC port, but a NEW ephemeral HTTP port: the proxy's
+        # query map must follow or its /query fetches dial the corpse
+        self._sync_query_map()
 
     # -- elastic topology (the ROADMAP-#4 scale arms) ----------------------
+
+    def _sync_query_map(self) -> None:
+        """Rebuild the proxy's gRPC->HTTP query map over the CURRENT
+        global set (topology arms boot/retire members; a stale entry
+        means /query 502s for every key the member owns)."""
+        if self.proxy is None or not self.spec.query_api:
+            return
+        self.proxy.cfg.query_destinations.clear()
+        self.proxy.cfg.query_destinations.update({
+            f"127.0.0.1:{g.server.grpc_import.port}": g.http_addr
+            for g in self.globals})
 
     def _sync_ring(self) -> None:
         """Point discovery at the CURRENT global set and reshard now
@@ -353,6 +425,7 @@ class Cluster:
         addrs = [f"127.0.0.1:{g.server.grpc_import.port}"
                  for g in self.globals]
         self.proxy.discoverer.destinations = addrs
+        self._sync_query_map()
         self.proxy.handle_discovery()
 
     def add_global(self) -> str:
@@ -371,6 +444,7 @@ class Cluster:
         node = self.globals.pop(idx)
         self._sync_ring()
         node.server.shutdown()
+        self._stop_http(node)
         self._retired_globals.append(node)
         return node
 
@@ -380,6 +454,7 @@ class Cluster:
         old = self.globals.pop(idx)
         self._sync_ring()
         old.server.shutdown()
+        self._stop_http(old)
         self._retired_globals.append(old)
         node = self._boot_global()
         self.globals.insert(idx, node)
@@ -396,6 +471,9 @@ class Cluster:
             self.telemetry.collect()
         if self.http is not None:
             self.http.stop()
+        for n in (self.locals + self.globals
+                  + self._retired_locals + self._retired_globals):
+            self._stop_http(n)
         for n in self.locals:
             try:
                 n.tx.close()
@@ -591,6 +669,25 @@ class Cluster:
         self.flush_locals()
         self.settle(timeout_s=settle_timeout_s)
         return self.flush_globals()
+
+    # -- live query plane (query_api) --------------------------------------
+
+    def proxy_http_addr(self) -> str:
+        return f"127.0.0.1:{self.proxy.http_port}"
+
+    @staticmethod
+    def query_http(addr: str, **params) -> dict:
+        """GET /query on one tier's HTTP surface; raises on a non-200
+        answer (the oracle arm treats that as a failed probe)."""
+        import json
+        import urllib.parse
+        import urllib.request
+        qs = urllib.parse.urlencode(
+            {k: str(v) for k, v in params.items() if v is not None})
+        with urllib.request.urlopen(
+                f"http://{addr}/query?{qs}",
+                timeout=QUERY_FETCH_TIMEOUT_S) as resp:
+            return json.loads(resp.read())
 
     # -- trace collection (trace/assembly.py feeds on this) ----------------
 

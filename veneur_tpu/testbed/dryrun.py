@@ -27,8 +27,14 @@ PROMISED_KEYS = [
     "spec", "per_tier", "forwarded", "imported", "retried", "dropped",
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
     "routing_exclusive", "chaos_matrix", "lock_witness", "telemetry",
-    "trace", "spool", "checkpoint", "egress", "sketch_families", "ok",
+    "trace", "spool", "checkpoint", "egress", "sketch_families",
+    "query", "ok",
 ]
+
+# windowed probes fuse up to this many newest slots per query (each
+# interval's probes use min(intervals seen, this) so partial-history
+# intervals still probe)
+_QUERY_PROBE_SLOTS = 2
 
 
 def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
@@ -43,6 +49,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                lock_witness: bool = False,
                trace: bool = False,
                telemetry: bool = False,
+               query: bool = False,
                procs: bool = False) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
     With `lock_witness`, every tier's named locks record runtime
@@ -63,6 +70,19 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     when no chaos selection was given, runs the forward-retry and
     ring-scale-up chaos arms with the same trace gate.
 
+    With `query=True` (the live-query-plane oracle arm, ISSUE 15):
+    every tier serves its HTTP /query surface, and after each interval
+    the run probes windowed quantiles on all three tiers — each local,
+    every global directly (their counts must sum to the oracle's with
+    at most ONE owner nonzero: the one-global-per-key invariant read
+    back through the query plane), and the proxy's scatter-gather.
+    Every answer is gated on the exact CPU oracle: exact fused counts,
+    per-family committed quantile envelopes, and the staleness
+    contract (every answer fresh = covers data up to the last
+    completed cut).  The report's `query` key carries
+    served/errors/p50_ms/p99_ms/staleness_ms/envelope_ok/staleness_ok
+    and gates ok.
+
     With `procs=True` the SAME story runs against the
     process-separated cluster (testbed/proccluster.py): every tier is
     its own OS process (globals meshed over real multi-process gloo
@@ -71,6 +91,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     REAL-fault matrix (testbed/proc_chaos.py; "all" = every proc
     arm)."""
     if procs:
+        if query:
+            raise ValueError(
+                "the query oracle arm runs in-process (check.py's "
+                "--query cell); drop --procs or drop --query")
         return _run_proc_dryrun(
             n_locals=n_locals, n_globals=n_globals,
             intervals=intervals, seed=seed, interval_s=interval_s,
@@ -97,7 +121,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                            (TrafficGen.MOMENTS_RULE,)
                            if moments_histo_keys else ()),
                        lock_witness=witness,
-                       telemetry=telemetry_witness)
+                       telemetry=telemetry_witness,
+                       query_api=query)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples,
@@ -105,6 +130,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
     per_interval_locals: list[list[list]] = []
+    qstate = {"rows": [], "lat_ms": [], "errors": 0}
     try:
         cluster.start()
         for _ in range(intervals):
@@ -114,6 +140,11 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             # counts/aggregates surface HERE) feed the per-family
             # exact-count conservation check
             per_interval_locals.append(cluster.drain_local_sinks())
+            if query:
+                _query_probes(cluster, traffic,
+                              len(per_interval) - 1,
+                              list(percentiles), histo_keys,
+                              moments_histo_keys, qstate)
         acct = cluster.accounting()
         trace_spans = cluster.collect_trace_spans()
         timeline_rows = [r for n in cluster.locals
@@ -155,6 +186,33 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                                             trace=True,
                                             telemetry=telemetry_witness))
 
+    query_report = None
+    if query:
+        rows = qstate["rows"]
+        lat = sorted(qstate["lat_ms"])
+        stal = [r["staleness_ms"] for r in rows
+                if r.get("staleness_ms") is not None]
+
+        def pct(p: float) -> float | None:
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1,
+                                 int(p * (len(lat) - 1) + 0.5))], 3)
+
+        query_report = {
+            "served": len(rows),
+            "errors": qstate["errors"],
+            "p50_ms": pct(0.5),
+            "p99_ms": pct(0.99),
+            "staleness_ms": (round(max(stal), 3) if stal else None),
+            "envelope_ok": all(r.get("envelope_ok") for r in rows),
+            "staleness_ok": all(r.get("fresh") for r in rows),
+            "counts_exact": all(r.get("count_exact") for r in rows),
+            "failed": [r for r in rows if not r.get("ok")][:8],
+            "ok": (bool(rows) and qstate["errors"] == 0
+                   and all(r.get("ok") for r in rows)),
+        }
+
     witness_cmp = None
     if witness is not None:
         from veneur_tpu.testbed.chaos import witness_comparison
@@ -173,7 +231,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
           and all(r["ok"] for r in chaos_rows)
           and (not trace or trace_ok)
           and (witness_cmp is None or witness_cmp["ok"])
-          and (telemetry_cmp is None or telemetry_cmp["ok"]))
+          and (telemetry_cmp is None or telemetry_cmp["ok"])
+          and (query_report is None or query_report["ok"]))
     return {
         "spec": {
             "n_locals": n_locals, "n_globals": n_globals,
@@ -255,8 +314,84 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         # the per-interval critical-path table from the cross-tier
         # assembler; gates ok only when trace=True was requested
         "trace": trace_report,
+        # live-query-plane oracle arm (query=True): windowed /query
+        # answers on all three tiers gated on the exact CPU oracle —
+        # exact fused counts, per-family committed envelopes, and the
+        # staleness contract (fresh answers).  None when not requested
+        "query": query_report,
         "ok": ok,
     }
+
+
+def _query_probes(cluster, traffic, iv: int, percentiles: list,
+                  histo_keys: int, moments_histo_keys: int,
+                  qstate: dict) -> None:
+    """One interval's /query probes on all three tiers (see
+    run_dryrun's `query` docs).  Window = the newest
+    min(intervals so far, _QUERY_PROBE_SLOTS) slots, whose covered
+    oracle intervals are known by construction (one ring cut per
+    driven flush)."""
+    import time
+
+    from veneur_tpu.testbed.traffic import PREFIX, TrafficGen
+    env = verify.load_envelope()
+    k = min(iv + 1, _QUERY_PROBE_SLOTS)
+    covered = list(range(iv - k + 1, iv + 1))
+    qcsv = ",".join(repr(float(p)) for p in percentiles)
+    names = ([f"{PREFIX}h{i}" for i in range(histo_keys)]
+             + [f"{TrafficGen.MOMENTS_PREFIX}{i}"
+                for i in range(moments_histo_keys)])
+    n_locals = len(cluster.locals)
+
+    def probe(addr: str, name: str):
+        t0 = time.perf_counter()
+        try:
+            resp = cluster.query_http(addr, name=name, slots=k,
+                                      q=qcsv)
+        except Exception as e:  # noqa: BLE001 - counted, run continues
+            qstate["errors"] += 1
+            qstate["rows"].append({"name": name, "ok": False,
+                                   "error": f"{type(e).__name__}: "
+                                            f"{e}"})
+            return None
+        qstate["lat_ms"].append((time.perf_counter() - t0) * 1e3)
+        return resp
+
+    for name in names:
+        # proxy scatter-gather: ring-routes to the ONE owning global
+        resp = probe(cluster.proxy_http_addr(), name)
+        if resp is not None:
+            row = verify.check_window_answer(
+                traffic.oracle, name, covered, resp, percentiles, env)
+            row["tier"] = "proxy"
+            qstate["rows"].append(row)
+        # every global directly: exactly one may hold the key (the
+        # one-global-per-key invariant, read back through /query)
+        gresps = [r for r in (probe(g.http_addr, name)
+                              for g in cluster.globals)
+                  if r is not None]
+        owners = [r for r in gresps if (r.get("count") or 0) > 0]
+        if len(owners) == 1:
+            row = verify.check_window_answer(
+                traffic.oracle, name, covered, owners[0],
+                percentiles, env)
+        else:
+            row = {"name": name, "ok": False,
+                   "error": f"{len(owners)} globals answered the key "
+                   "with mass (one-global-per-key violated)"}
+        row["tier"] = "global"
+        qstate["rows"].append(row)
+        # local tier: a single local saw every sample, so its windowed
+        # answer is gated exactly like the global's (with N locals the
+        # per-local shares are not oracle-checkable key by key)
+        if n_locals == 1:
+            resp = probe(cluster.locals[0].http_addr, name)
+            if resp is not None:
+                row = verify.check_window_answer(
+                    traffic.oracle, name, covered, resp,
+                    percentiles, env)
+                row["tier"] = "local"
+                qstate["rows"].append(row)
 
 
 def _run_proc_dryrun(*, n_locals: int, n_globals: int, intervals: int,
@@ -425,5 +560,6 @@ def _run_proc_dryrun(*, n_locals: int, n_globals: int, intervals: int,
         "lock_witness": None,
         "telemetry": telemetry_cmp,
         "trace": trace_report,
+        "query": None,
         "ok": ok,
     }

@@ -202,6 +202,54 @@ def check_histo_counts(oracle: Oracle,
             "by_family": by_family, "mismatched": mismatched[:8]}
 
 
+def check_window_answer(oracle: Oracle, name: str,
+                        covered_ivs: list[int], resp: dict,
+                        percentiles: list[float],
+                        env: dict | None = None) -> dict:
+    """Gate ONE /query answer against the exact CPU oracle: the fused
+    count must equal the covered intervals' sample count EXACTLY
+    (counts are integer sums in both families), every requested
+    quantile must sit inside the key's family envelope
+    (span-normalized like the dossier), and the answer must be FRESH —
+    it covers data up to the most recent completed cut, i.e. at most
+    one slot behind now (the staleness contract's discrete form)."""
+    env = env or load_envelope()
+    family = getattr(oracle, "histo_family", {}).get(name, "tdigest")
+    vals = [v for iv in covered_ivs
+            for v in oracle.histos.get((iv, name), [])]
+    arr = np.asarray(vals, np.float64)
+    want = float(len(vals))
+    count_exact = resp.get("count") == want
+    span = 1.0
+    if len(arr):
+        span = float(arr.max() - arr.min()) or 1.0
+    quantile_rows = []
+    envelope_ok = True
+    for q in percentiles:
+        got = (resp.get("quantiles") or {}).get(repr(float(q)))
+        if got is None:
+            envelope_ok = False
+            quantile_rows.append({"q": q, "missing": True})
+            continue
+        exact = float(np.quantile(arr, q, method="hazen"))
+        err = abs(got - exact) / span
+        bar = envelope_for(q, env, family)
+        if err > bar:
+            envelope_ok = False
+        quantile_rows.append({"q": q, "span_err": err,
+                              "envelope": bar, "within": err <= bar})
+    return {"name": name, "family": family,
+            "covered_intervals": list(covered_ivs),
+            "count_exact": bool(count_exact),
+            "want_count": want, "got_count": resp.get("count"),
+            "fresh": bool(resp.get("fresh")),
+            "staleness_ms": resp.get("staleness_ms"),
+            "envelope_ok": envelope_ok,
+            "quantiles": quantile_rows,
+            "ok": bool(count_exact and envelope_ok
+                       and resp.get("fresh"))}
+
+
 def check_routing(per_interval: list[list[list]],
                   per_epoch: bool = False) -> dict:
     """Consistent-hash invariant: each metric key surfaces on exactly
