@@ -630,7 +630,8 @@ def bench_e2e_flush(n_keys: int, warmup: int, iters: int,
         nm = len(res.metrics)
         lat.append((time.perf_counter() - t0) * 1e3)
         for k, v in agg.last_flush_segments.items():
-            segs.setdefault(k, []).append(float(v))
+            if isinstance(v, (int, float)):   # skip per-chunk lists
+                segs.setdefault(k, []).append(float(v))
         if time.perf_counter() > deadline:
             log(f"{label}: time budget hit after {len(lat)}/{iters} iters; "
                 f"reporting from the completed samples")
@@ -660,6 +661,118 @@ def bench_e2e_flush(n_keys: int, warmup: int, iters: int,
         + f" | PCIe-host projection ~{pcie_ms:.0f} ms"
           f" ({pcie_ms * 1e3 / n_keys:.2f} us/key)")
     return p50, p99, len(lat)
+
+
+def bench_delta_flush(n_keys: int, warmup: int, iters: int,
+                      samples_per_key: int = 4) -> dict:
+    """Paired A/B of the delta flush (ISSUE-16): the SAME double-
+    buffered interval harness as bench_e2e_flush run twice — host-staged
+    twin vs `flush_resident_arenas` — so the only variable is where the
+    interval's staging bytes cross the link.  The resident arm's refill
+    streams consolidated COO chunks to HBM inside the (untimed)
+    interval, exactly like the production drain loop's per-tick
+    sync_staged; the timed flush then pays device-side assembly +
+    merge-eval + readback only.
+
+    Returns the BASELINE-promised keys: per-arm p50/p99,
+    `upload_amortized_pct` (fraction of staging bytes moved off the
+    flush critical path, from the measured amortized/critical byte
+    segments), and `resident_vs_staged_speedup` (staged p50 / resident
+    p50 — ≥ ~0.95 required on the CPU box, the win shows on the real
+    link)."""
+    from veneur_tpu.core.aggregator import MetricAggregator
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+
+    def run_arm(resident: bool, force_device: bool = False,
+                n_iters: int = 0) -> tuple[float, float, dict]:
+        label = (f"delta flush arm [{n_keys // 1000}k keys, "
+                 f"{'resident' if resident else 'host-staged'}"
+                 f"{', forced device assembly' if force_device else ''}]")
+        agg = MetricAggregator(percentiles=list(PERCENTILES),
+                               initial_capacity=n_keys, is_local=False,
+                               flush_resident_arenas=resident,
+                               resident_device_assembly=(
+                                   True if force_device else None))
+        rows = np.empty(n_keys, np.int64)
+        for i in range(n_keys):
+            rows[i] = agg.digests.row_for(
+                MetricKey(f"bench.k{i}", sm.TYPE_HISTOGRAM, ""),
+                MetricScope.GLOBAL_ONLY, [])
+        rng = np.random.default_rng(11)
+        all_rows = np.tile(rows, samples_per_key)
+        wts = np.ones(n_keys * samples_per_key, np.float64)
+
+        def refill() -> None:
+            vals = rng.gamma(2.0, 10.0, n_keys * samples_per_key)
+            with agg.lock:
+                agg.digests.sample_batch(all_rows, vals, wts)
+                agg.digests.touched[rows] = True
+            # interval tick: consolidate + (resident) stream the delta
+            # chunks to HBM — the amortization under measurement, kept
+            # OUTSIDE the timed flush like the production drain loop
+            agg.sync_staged(min_samples=1)
+
+        refill()
+        t0 = time.perf_counter()
+        agg.flush(is_local=False)
+        log(f"{label} compile+first run: "
+            f"{time.perf_counter() - t0:.1f}s")
+        for _ in range(warmup):
+            refill()
+            agg.flush(is_local=False)
+        lat = []
+        segs: dict[str, list[float]] = {}
+        deadline = time.perf_counter() + ARM_TIME_BUDGET_S
+        for _ in range(n_iters or iters):
+            refill()
+            t0 = time.perf_counter()
+            agg.flush(is_local=False)
+            lat.append((time.perf_counter() - t0) * 1e3)
+            for k, v in agg.last_flush_segments.items():
+                if isinstance(v, (int, float)):
+                    segs.setdefault(k, []).append(float(v))
+            if time.perf_counter() > deadline:
+                log(f"{label}: time budget hit after {len(lat)}/{iters}"
+                    f" iters")
+                break
+        lat = np.asarray(lat)
+        p50 = float(np.percentile(lat, 50))
+        p99 = float(np.percentile(lat, 99))
+        med = {k: float(np.median(v)) for k, v in segs.items()}
+        log(f"{label}: p50={p50:.1f}ms p99={p99:.1f}ms over {len(lat)} "
+            f"flushes; critical upload "
+            f"{med.get('upload_bytes', 0) / 1e6:.2f} MB, amortized "
+            f"{med.get('amortized_bytes', 0) / 1e6:.2f} MB")
+        return p50, p99, med
+
+    s_p50, s_p99, _ = run_arm(False)
+    r_p50, r_p99, r_med = run_arm(True)
+    amort = r_med.get("amortized_bytes", 0.0)
+    crit = r_med.get("upload_bytes", 0.0)
+    if amort == 0.0:
+        # the auto arm degrades device assembly on this backend
+        # (serving.resident_link_ok is False on CPU — no real link to
+        # amortize).  The BYTE accounting is backend-independent, so
+        # run a short forced-device-assembly arm purely to measure the
+        # amortized/critical split the resident layout achieves.
+        _, _, f_med = run_arm(True, force_device=True, n_iters=3)
+        amort = f_med.get("amortized_bytes", 0.0)
+        crit = f_med.get("upload_bytes", 0.0)
+    pct = 100.0 * amort / (amort + crit) if (amort + crit) > 0 else 0.0
+    out = {
+        "delta_flush_e2e_p50_ms": round(r_p50, 1),
+        "delta_flush_e2e_p99_ms": round(r_p99, 1),
+        "staged_e2e_p50_ms": round(s_p50, 1),
+        "staged_e2e_p99_ms": round(s_p99, 1),
+        "upload_amortized_pct": round(pct, 1),
+        "resident_vs_staged_speedup": round(
+            s_p50 / r_p50 if r_p50 > 0 else 0.0, 3),
+    }
+    log(f"delta flush [{n_keys // 1000}k]: amortized {pct:.0f}% of "
+        f"staging bytes; resident vs staged speedup "
+        f"{out['resident_vs_staged_speedup']}x")
+    return out
 
 
 def bench_mesh_overhead() -> dict | None:
@@ -1674,6 +1787,29 @@ def main() -> None:
                 result["e2e_1m_flushes_measured"] = n
         except Exception as e:
             log(f"e2e 1M flush arm failed: {e}")
+    # delta-flush paired A/B (ISSUE-16 acceptance: resident arenas move
+    # ≥80% of staging bytes off the flush critical path at the 1M shape;
+    # resident must be ≤ +5% vs host-staged on the CPU box at the 20k CI
+    # shape).  Promised keys: error values on arm failure.
+    _DELTA_KEYS = ("delta_flush_e2e_p50_ms", "delta_flush_e2e_p99_ms",
+                   "upload_amortized_pct", "resident_vs_staged_speedup")
+    try:
+        df = bench_delta_flush(100_000 if on_tpu else 20_000,
+                               warmup=2, iters=20 if on_tpu else 5)
+        result.update({k: df[k] for k in _DELTA_KEYS})
+        result["delta_flush"] = df
+    except Exception as e:
+        log(f"delta flush arm failed: {e}")
+        for k in _DELTA_KEYS:
+            result[k] = {"error": str(e)[:200]}
+    if on_tpu:
+        try:
+            df1m = bench_delta_flush(1_000_000, warmup=1, iters=5)
+            result["delta_flush_1m"] = df1m
+            result["upload_amortized_pct_1m"] = \
+                df1m["upload_amortized_pct"]
+        except Exception as e:
+            log(f"delta 1M flush arm failed: {e}")
     # every key BASELINE.md promises must be present in the emitted JSON
     # (kept in lockstep with the doc: the r5 verdict caught keys the
     # harness measured but never emitted).  Keys owned by optional arms
@@ -1685,7 +1821,9 @@ def main() -> None:
                 "trace_overhead_pct", "checkpoint_overhead_pct",
                 "egress_overhead_pct", "moments_merge_p50_ms",
                 "moments_vs_tdigest_speedup", "query_p50_ms",
-                "query_p99_ms", "query_staleness_ms"]
+                "query_p99_ms", "query_staleness_ms",
+                "delta_flush_e2e_p50_ms", "delta_flush_e2e_p99_ms",
+                "upload_amortized_pct", "resident_vs_staged_speedup"]
     if "mesh_scaling_per_device_work_ms" in result:
         promised += ["mesh_scaling_e2e_ms", "mesh_scaling_segments_ms"]
     if "ingest_udp_pkts_per_sec" in result:

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """One-command repo gate: vnlint -> native sanitizer smoke -> reshard,
 crash and egress chaos cells -> mixed-family dryrun -> proc chaos cell
--> query dryrun cell -> tier-1 pytest.  Nonzero exit on ANY
-unsuppressed lint finding, sanitizer report, failed chaos cell, failed
-mixed-family conservation, failed query envelope/staleness gate, or
-test failure — the local equivalent of a CI required check.
+-> resident-arena chaos cell -> query dryrun cell -> tier-1 pytest.
+Nonzero exit on ANY unsuppressed lint finding, sanitizer report,
+failed chaos cell, failed mixed-family conservation, failed query
+envelope/staleness gate, or test failure — the local equivalent of a
+CI required check.
 
     python scripts/check.py              # the full gate
     python scripts/check.py --fast      # vnlint + sanitizer smoke only
@@ -204,7 +205,31 @@ def main() -> int:
                         "PASS" if proc_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
-    # 3f. the live-query-plane cell (ISSUE 15): every tier serves
+    # 3f. the resident-arena conservation cell (ISSUE 16): the local
+    # tier runs flush_resident_arenas with device assembly forced on
+    # (the CPU auto-gate would otherwise degrade it) and is killed with
+    # no drain BETWEEN the interval's delta upload and its flush —
+    # full delta chunks are already in HBM when the process dies.  The
+    # exact-count oracle must hold after revival: host COO staging is
+    # the checkpoint source of truth, so deltas stranded on the dead
+    # device must be indistinguishable from never-streamed ones (the
+    # arm also fails if nothing streamed before the kill — a vacuous
+    # pass is a fail)
+    resident_rc = 0
+    if args.fast:
+        results.append(("resident chaos cell", "SKIP", 0.0))
+    else:
+        t0 = stage("resident chaos cell (crash-with-resident-arenas)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        resident_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py",
+             "--chaos-only", "crash-with-resident-arenas"],
+            env=env)
+        results.append(("resident chaos cell",
+                        "PASS" if resident_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
+    # 3g. the live-query-plane cell (ISSUE 15): every tier serves
     # /query, and each interval's windowed answers — locals, every
     # global directly, and the proxy's ring-routed scatter-gather —
     # are gated on the exact CPU oracle: exact fused counts,
@@ -248,8 +273,8 @@ def main() -> int:
     for name, verdict, dt in results:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
-               or egress_rc or mixed_rc or proc_rc or query_rc
-               or test_rc) else 0
+               or egress_rc or mixed_rc or proc_rc or resident_rc
+               or query_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

@@ -18,9 +18,20 @@ per-call RTT amortizes out:
              merge kernel + maxent solver,                  compute class
              ops/moments_eval.py depth variant)             (ROADMAP #3)
   moments_sums the merge kernel alone (no solver)        -> merge roofline
+  delta      the host->HBM delta-chunk stream            -> chunk-size x
+             (serving.resident_scatter assembly,            nbuf sweep with
+             flush_resident_arenas' amortized upload)       overlap efficiency
 
 Usage: python scripts/profile_flush_kernel.py [K] [D] [pipeline] [rounds]
        [modes]
+
+`delta` is not a kernel slice: it sweeps the OTHER pipeline level — the
+chunked host->device upload the resident delta flush amortizes across
+the interval — and reports per-configuration wall time plus
+sorted_eval.overlap_efficiency over the recorded per-chunk segments
+(the same upload_s/dispatch_s/wait_s stats the aggregator's
+`flush.seg.device` chunk spans carry).  K and D set the interval shape
+(K keys x D points/key).
 """
 
 from __future__ import annotations
@@ -100,6 +111,72 @@ def run_variant(mode: str, mean, weight, minmax, qs, tile: int):
     )(mean, weight)
 
 
+def run_delta_sweep(k: int, d: int, rounds: int) -> None:
+    """Chunk-size x nbuf sweep of the resident delta stream: replay one
+    interval's staged points (K keys x D points/key) through the
+    production scatter-assembly chunks at each configuration, recording
+    per-chunk upload/dispatch/wait segments and the pipeline's overlap
+    efficiency.  Uses the copying scatter twin so the sweep is identical
+    on every backend (donation is a separate axis, gated at runtime by
+    serving.resident_donation_ok)."""
+    from veneur_tpu.parallel import flush_step, serving
+
+    total = k * d
+    chunk_sizes = [c for c in (8192, 32768, 131072) if c <= total] or [total]
+    for chunk_points in chunk_sizes:
+        chunks, dense_id, expect_v, _ = flush_step.example_delta_chunks(
+            n_keys=k, depth=d, chunk_points=chunk_points)
+        # rehost: the sweep times the host->device crossing itself
+        host = [{kk: np.asarray(v) for kk, v in c.items()} for c in chunks]
+        did = jax.device_put(np.asarray(dense_id))
+        jax.block_until_ready(did)
+        for nbuf in (2, 4):
+            walls, effs, last = [], [], None
+            for _ in range(rounds):
+                dense = serving.resident_dense_zeros(
+                    shape=expect_v.shape, dtype=jnp.float32)
+                jax.block_until_ready(dense)
+                stats: list[dict] = []
+                outs = [dense]
+                t_wall = time.perf_counter()
+                for i, ch in enumerate(host):
+                    st: dict = {}
+                    t0 = time.perf_counter()
+                    dev = tuple(jax.device_put(ch[kk])
+                                for kk in ("rows", "pos", "vals"))
+                    st["upload_s"] = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    dense = serving.resident_scatter_copy(
+                        dense, did, *dev)
+                    st["dispatch_s"] = time.perf_counter() - t0
+                    outs.append(dense)
+                    if i + 1 >= nbuf:
+                        # double-buffer backpressure: the chunk nbuf
+                        # behind must have retired before we stage more
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(outs[i + 2 - nbuf])
+                        st["wait_s"] = time.perf_counter() - t0
+                    stats.append(st)
+                t0 = time.perf_counter()
+                jax.block_until_ready(dense)
+                stats[-1]["wait_s"] = (stats[-1].get("wait_s", 0.0)
+                                       + time.perf_counter() - t0)
+                walls.append((time.perf_counter() - t_wall) * 1e3)
+                effs.append(se.overlap_efficiency(stats))
+                last = dense
+            if not np.array_equal(np.asarray(last), expect_v):
+                raise AssertionError(
+                    f"delta sweep parity failure at chunk={chunk_points} "
+                    f"nbuf={nbuf}: scatter assembly != host dense build")
+            p50 = float(np.percentile(walls, 50))
+            mb = total * 12 / 1e6  # int32 rows + int32 pos + f32 vals
+            print(f"delta   chunk={chunk_points:7d} nbuf={nbuf}  "
+                  f"wall p50={p50:8.2f} ms  "
+                  f"stream-BW={mb / p50:6.2f} GB/s  "
+                  f"overlap-eff={float(np.median(effs)):.2f}  "
+                  f"({len(host)} chunks)", flush=True)
+
+
 def main():
     k = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
     d = int(sys.argv[2]) if len(sys.argv) > 2 else 256
@@ -134,6 +211,9 @@ def main():
     modes = (sys.argv[5].split(",") if len(sys.argv) > 5
              else ["dma", "sort", "cumsum", "full", "full_nodma",
                    "full_dma", "depth", "depth_bf16", "xla"])
+    if "delta" in modes:
+        modes = [m for m in modes if m != "delta"]
+        run_delta_sweep(k, d, rounds)
     for mode in modes:
         def fn(pct_jitter, _mode=mode):
             return run_variant(_mode, mean, weight, minmax,

@@ -1,0 +1,255 @@
+"""Device-resident arenas + asynchronous delta flush (ROADMAP #2).
+
+Three contracts pinned here:
+
+1. BIT-PARITY — the resident mirror is a replay twin of the host COO
+   staging, so emissions AND forward wire payloads are byte-identical
+   across staged / resident-auto / resident-forced modes, for all three
+   sketch families, on 1, 2 and 8 virtual devices.  Not approximately
+   equal: the dense matrix a resident flush assembles on device is the
+   same matrix the host build produces, so any drift is a bug.
+2. CHUNKED OVERLAP — the pipelined upload (upload(i+1) ‖ eval(i) ‖
+   readback(i-1)) is visible in the flight-recorder trace: the
+   flush.seg.device span's extent is the device-BUSY window since the
+   first chunk's dispatch, which reaches BACK over the later chunks'
+   dispatch segment — sum(flush.seg.*) exceeding the root flush wall
+   IS the overlap.
+3. CHECKPOINT — the host COO stays authoritative; a restore re-streams
+   the mirror from position zero and flushes bit-identically, and a
+   stage-dtype mismatch (the bit-replay contract's staging width)
+   raises CheckpointIncompatible BEFORE any arena mutates.
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.core.arena import CheckpointIncompatible
+from veneur_tpu.forward import convert
+from veneur_tpu.parallel import mesh as mesh_mod
+from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric
+
+PCTS = [0.5, 0.9, 0.99]
+
+# chunk floor: the arena pow2-floors resident_chunk_points at 1024, so
+# the parity traffic must stage >1024 points to stream at least one
+# full chunk (the anti-vacuity check below asserts it did)
+CHUNK = 1024
+
+
+def mk(name, mtype, value, rate=1.0, tags=(), scope=MetricScope.MIXED):
+    m = UDPMetric(name=name, type=mtype, value=value, sample_rate=rate,
+                  scope=scope)
+    m.update_tags(list(tags), None)
+    return m
+
+
+def _agg(**kw):
+    kw.setdefault("percentiles", list(PCTS))
+    # route mom.* to the moments family so all three sketch families
+    # (tdigest, moments, hll-set) ride every parity arm
+    kw.setdefault("sketch_family_rules",
+                  [{"match": "mom.*", "family": "moments"}])
+    return MetricAggregator(**kw)
+
+
+def _fill(a, seed=11):
+    """Deterministic three-family traffic: wide (32 digest keys, 48
+    deep) so rows stay under the dense cap — hot-key pre-reduction
+    would mark the mirror dirty and fall back to the host build, which
+    is correct but not the path under test."""
+    rng = np.random.default_rng(seed)
+    for i in range(32):
+        for v in rng.normal(50.0, 9.0, 48):
+            a.process_metric(mk(f"dig.h{i}", "histogram", float(v)))
+    for i in range(8):
+        for v in rng.gamma(2.0, 10.0, 48):
+            a.process_metric(mk(f"mom.t{i}", "histogram", float(v)))
+    for i in range(200):
+        a.process_metric(mk("s.users", "set", f"u{i % 61}"))
+    a.process_metric(mk("c.req", "counter", 3))
+    a.process_metric(mk("g.temp", "gauge", 20.5))
+
+
+def _emissions(res):
+    return sorted((m.name, tuple(m.tags or ()), m.type, m.value)
+                  for m in res.metrics)
+
+
+def _wire(res):
+    return sorted(convert.to_pb(f).SerializeToString()
+                  for f in res.forward)
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-parity: staged vs resident, emissions and wire payloads
+# ---------------------------------------------------------------------------
+
+def test_resident_parity_local_tier_all_modes():
+    """Local-tier flush in three modes: staged, resident with the
+    backend-auto device-assembly gate (degrades to the staged assembly
+    on PJRT:CPU), and resident with device assembly FORCED.  Emissions
+    and forward wire payload bytes must be identical across all three
+    — and the forced arm must actually have streamed delta chunks to
+    the device (anti-vacuity), or the parity is trivially true."""
+    staged = _agg()
+    auto = _agg(flush_resident_arenas=True,
+                flush_delta_chunk_keys=CHUNK)
+    forced = _agg(flush_resident_arenas=True,
+                  flush_delta_chunk_keys=CHUNK,
+                  resident_device_assembly=True)
+    for a in (staged, auto, forced):
+        _fill(a)
+    # stream the staged points to HBM mid-interval (the interval's
+    # sync tick), then prove the forced arm streamed real bytes
+    forced.sync_staged(min_samples=1)
+    assert forced.digests._res_bytes > 0, \
+        "forced-resident arm streamed nothing; parity would be vacuous"
+    r_staged = staged.flush(is_local=True)
+    r_auto = auto.flush(is_local=True)
+    r_forced = forced.flush(is_local=True)
+    assert _emissions(r_staged) == _emissions(r_auto)
+    assert _emissions(r_staged) == _emissions(r_forced)
+    assert _wire(r_staged) == _wire(r_auto)
+    assert _wire(r_staged) == _wire(r_forced)
+    # all three families actually emitted
+    names = {n for n, *_ in _emissions(r_staged)}
+    assert any(n.startswith("dig.h") for n in names)
+    assert any(n.startswith("mom.t") for n in names)
+    assert "c.req" in names
+    # the set + digests forwarded (mixed scope on a local tier)
+    assert len(r_staged.forward) > 0
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_resident_parity_meshed_global_tier(n_dev):
+    """2- and 8-device meshes (virtual CPU devices; conftest forces an
+    8-way host platform).  Meshed tiers already hold registers
+    device-resident, so the gate is a no-op there — but it must be a
+    BENIGN no-op: flipping it cannot perturb a single emitted bit."""
+    # no sketch_family_rules: family dispatch is single-device only
+    # (the moments flush program is unmeshed), so the meshed arms cover
+    # the tdigest + set + scalar families
+    staged = MetricAggregator(percentiles=list(PCTS),
+                              mesh=mesh_mod.make_mesh(n_dev),
+                              is_local=False)
+    resident = MetricAggregator(percentiles=list(PCTS),
+                                mesh=mesh_mod.make_mesh(n_dev),
+                                is_local=False,
+                                flush_resident_arenas=True,
+                                flush_delta_chunk_keys=CHUNK)
+    for a in (staged, resident):
+        _fill(a, seed=13)
+    r_s = staged.flush(is_local=False)
+    r_r = resident.flush(is_local=False)
+    assert _emissions(r_s) == _emissions(r_r)
+    # global tier renders percentiles
+    names = {n for n, *_ in _emissions(r_s)}
+    assert any(n.endswith("50percentile") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# 2. chunked overlap, proven from the trace
+# ---------------------------------------------------------------------------
+
+def test_chunked_overlap_visible_in_flight_recorder():
+    """Global-tier flush with a 2-row chunk override over 8 digest
+    keys: the dense upload splits into pipelined chunks, and the trace
+    shows it — per-chunk grandchildren exist under flush.seg.device,
+    and the device span's extent (the device-BUSY window since the
+    first chunk's dispatch) reaches back over the dispatch segment, so
+    the segment spans sum to MORE than the root flush wall."""
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks import simple as simple_sinks
+
+    cfg = config_mod.Config(
+        interval=600.0, percentiles=list(PCTS), hostname="resid",
+        flush_delta_chunk_keys=2, flush_delta_nbuf=2)
+    sink = simple_sinks.ChannelMetricSink()
+    srv = Server(cfg, extra_metric_sinks=[sink])
+    assert not srv.is_local
+    srv.start()
+    try:
+        rng = np.random.default_rng(5)
+        lines = [f"h{i}:{v:.3f}|h".encode()
+                 for i in range(8) for v in rng.normal(10, 2, 6)]
+        srv.process_packet_buffer(b"\n".join(lines))
+        srv.flush()
+    finally:
+        srv.shutdown()
+    segs = srv.aggregator.last_flush_segments
+    chunks = segs.get("device_chunks")
+    assert chunks and len(chunks) >= 2, segs
+    # the window since first dispatch covers the later chunks' dispatch
+    # + the fetch drain: strictly wider than the residual device wait
+    assert segs["device_window_s"] > segs["device_s"]
+    recs = srv.flight_recorder.snapshot()
+    names = [r["name"] for r in recs]
+    assert "flush.seg.device.chunk0" in names
+    assert "flush.seg.device.chunk1" in names
+    root = next(r for r in recs if r["name"] == "flush")
+    seg_children = [r for r in recs
+                    if r["name"].startswith("flush.seg.")
+                    and not r["name"].startswith("flush.seg.device.chunk")]
+    dev = next(r for r in seg_children
+               if r["name"] == "flush.seg.device")
+    disp = next(r for r in seg_children
+                if r["name"] == "flush.seg.dispatch")
+    # the overlap, structurally: the device span STARTS before the
+    # dispatch segment it overlaps has ENDED
+    disp_end_ns = disp["start_ns"] + int(disp["duration_ms"] * 1e6)
+    assert dev["start_ns"] < disp_end_ns, (dev, disp)
+    # and in aggregate: sum(flush.seg.*) > the root wall
+    assert sum(r["duration_ms"] for r in seg_children) \
+        > root["duration_ms"], (seg_children, root)
+
+
+# ---------------------------------------------------------------------------
+# 3. checkpoint: readback parity + stage-dtype precheck
+# ---------------------------------------------------------------------------
+
+def _resident_agg(**kw):
+    return _agg(flush_resident_arenas=True,
+                flush_delta_chunk_keys=CHUNK,
+                resident_device_assembly=True, **kw)
+
+
+def test_resident_checkpoint_roundtrip_bit_parity():
+    """Crash between delta stream and flush: the checkpointed host COO
+    is authoritative, the restored aggregator re-streams the mirror
+    from position zero, and its flush emits exactly what the original
+    would have — bit-for-bit, wire bytes included."""
+    a = _resident_agg()
+    _fill(a, seed=17)
+    a.sync_staged(min_samples=1)    # deltas now live in device chunks
+    assert a.digests._res_bytes > 0
+    meta, arrays = a.checkpoint_state()
+    b = _resident_agg()
+    b.restore_state(meta, arrays)
+    r_a = a.flush(is_local=True)
+    r_b = b.flush(is_local=True)
+    assert _emissions(r_a) == _emissions(r_b)
+    assert _wire(r_a) == _wire(r_b)
+    # and both match a staged twin fed the same traffic
+    c = _agg()
+    _fill(c, seed=17)
+    r_c = c.flush(is_local=True)
+    assert _emissions(r_c) == _emissions(r_a)
+
+
+def test_resident_checkpoint_stage_dtype_precheck():
+    """The streamed chunks' staging width is part of the bit-replay
+    contract (resident == host-staged twin): restoring a resident f32
+    checkpoint into a bf16-staging resident aggregator must raise
+    CheckpointIncompatible during the PRECHECK — before any arena
+    mutates — never half-restore."""
+    a = _resident_agg()
+    _fill(a, seed=19)
+    a.sync_staged(min_samples=1)
+    meta, arrays = a.checkpoint_state()
+    b = _resident_agg(digest_bf16_staging=True)
+    with pytest.raises(CheckpointIncompatible, match="stage dtype"):
+        b.restore_state(meta, arrays)
+    # precheck fired before mutation: the target is still cold
+    assert b.flush(is_local=True).metrics == []

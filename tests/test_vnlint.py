@@ -107,6 +107,53 @@ def test_donation_partial_jit_and_cross_module(tmp_path):
     assert len(hits) == 1 and hits[0].path == "arena.py"
 
 
+RESIDENT_BUG = """
+import jax
+
+merge = jax.jit(lambda dense, rows: dense, donate_argnums=(0,))
+
+
+class Arena:
+    def flush_step(self, rows):
+        # donates the PERSISTENT resident buffer but never rebinds it:
+        # self.dense still references the consumed buffer after return,
+        # so the next interval's read races the dispatched program
+        out = merge(self.dense, rows)
+        return out
+"""
+
+RESIDENT_FIXED = """
+import jax
+
+merge = jax.jit(lambda dense, rows: dense, donate_argnums=(0,))
+
+
+class Arena:
+    def flush_step(self, rows):
+        # corrected double-buffer form: the attribute is rebound to the
+        # program's fresh output before the frame dies
+        self.dense = merge(self.dense, rows)
+        return self.dense
+"""
+
+
+def test_donation_persistent_buffer_fires(tmp_path):
+    """ISSUE-16 resident-arena class: a donated self.* buffer outlives
+    the call, so 'no later read in this function' is not safety — an
+    un-rebound donated attribute fires even without an explicit read."""
+    report = lint_source(tmp_path, RESIDENT_BUG)
+    hits = [f for f in report.findings if f.rule == "donation-aliasing"]
+    assert len(hits) == 1, [f.format() for f in report.findings]
+    assert "self.dense" in hits[0].message
+    assert "persistent" in hits[0].message
+
+
+def test_donation_persistent_rebind_is_quiet(tmp_path):
+    report = lint_source(tmp_path, RESIDENT_FIXED)
+    assert "donation-aliasing" not in rules_fired(report), \
+        [f.format() for f in report.findings]
+
+
 # ---------------------------------------------------------------------------
 # resource-pairing — the PR-3 snapshot-pin leak
 # ---------------------------------------------------------------------------

@@ -28,6 +28,16 @@ conservatively — the donating branch COULD run.  Limitations (by
 design, documented): control flow is not modeled, so a read textually
 before the call inside the same loop body is missed, and reads through
 a different alias of the same buffer are invisible.
+
+PERSISTENT device buffers (the ISSUE-16 resident-arena class) extend
+the contract across calls: a `self.*` attribute donated to a merge
+step outlives the function, so "no later read in this function" is not
+safety — the NEXT interval's flush reads the attribute, racing the
+program that consumed its buffer.  A donated `self.*` binding still
+tainted at function exit is therefore a finding even without an
+explicit read; the corrected double-buffer form (`self.buf =
+merge(self.buf, ...)`, rebinding the attribute to the program's fresh
+output) clears the taint and stays quiet.
 """
 
 from __future__ import annotations
@@ -236,6 +246,24 @@ class DonationAliasing(Rule):
 
         for stmt in fn.body:
             visit(stmt, fn)
+        # persistent-buffer pass (ISSUE-16 resident arenas): a donated
+        # `self.*` attribute outlives this call — if it is still
+        # tainted at function exit, the attribute references a buffer
+        # the dispatched program owns, and the NEXT call's read races
+        # it.  Locals die with the frame, so only self-rooted names
+        # fire here.
+        for name, (callee, line) in sorted(tainted.items()):
+            if not name.startswith("self."):
+                continue
+            findings.append(Finding(
+                self.name, module.relpath, line, 0,
+                f"persistent device buffer `{name}` was donated to "
+                f"`{callee}` and never rebound before function exit — "
+                "the attribute keeps referencing the consumed buffer, "
+                "so the next call's read races the dispatched program "
+                "(resident-arena donation class); rebind it to the "
+                "program's output (`self.buf = merge(self.buf, ...)`) "
+                "or use the copying twin"))
         return findings
 
     @staticmethod

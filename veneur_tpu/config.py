@@ -305,6 +305,34 @@ class Config:
     # owning device (pre-sharded staging) instead of one process-wide
     # device_put funnel; off reverts to the funnel (A/B + debugging)
     flush_presharded_staging: bool = True
+    # device-resident arenas + asynchronous delta flush (ROADMAP #2):
+    # sketch registers for the digest/moments/set families stay in HBM
+    # across intervals; ingest keeps accumulating the host-side staged
+    # COO (still the checkpoint/forwarding source of truth) and streams
+    # fixed-size delta chunks to the device DURING the interval, so the
+    # flush critical path degenerates to merge-eval + readback — upload
+    # cost is amortized into the interval instead of paid at the p99.
+    # Unmeshed (global single-device) tiers only; meshed tiers already
+    # hold set/counter registers device-resident and ignore the gate.
+    flush_resident_arenas: bool = False
+    # granularity of the delta machinery (0 = defaults).  In the chunked
+    # host-staged pipeline this is dense ROWS per upload chunk (overrides
+    # the flush_upload_chunks even split); in resident mode it is staged
+    # POINTS per streamed delta chunk.  Rounded down to a power of two.
+    flush_delta_chunk_keys: int = 0
+    # in-flight window of the chunked upload pipeline: how many chunks may
+    # be dispatched-but-unfetched before the host blocks (the host<->HBM
+    # analog of the _dma_pipeline double buffer; 2 = classic double
+    # buffering, higher trades pinned-buffer memory for slack)
+    flush_delta_nbuf: int = 2
+    # tri-state override of the resident DEVICE-ASSEMBLY half: None
+    # (default) follows serving.resident_link_ok — on PJRT:CPU there is
+    # no host<->device link to amortize, so digest/moments assembly
+    # auto-degrades to the staged chunk-pipelined flush (the resident
+    # SET lanes stay active everywhere).  True forces device assembly
+    # regardless of backend (the CI conservation cells + bit-parity
+    # tests); False forces the staged path even on a real accelerator.
+    flush_resident_device_assembly: Optional[bool] = None
     debug: bool = False
     enable_profiling: bool = False
     # profiling subsystem (veneur_tpu/profiling/): the /debug/pprof

@@ -108,6 +108,10 @@ class MetricAggregator:
                  digest_bf16_staging: bool = False,
                  flush_upload_chunks: int = 2,
                  flush_presharded_staging: bool = True,
+                 flush_resident_arenas: bool = False,
+                 flush_delta_chunk_keys: int = 0,
+                 flush_delta_nbuf: int = 2,
+                 resident_device_assembly: Optional[bool] = None,
                  cardinality_key_budget: int = 0,
                  cardinality_tenant_tag: str = "tenant",
                  cardinality_seed: int = 0,
@@ -165,11 +169,28 @@ class MetricAggregator:
             raise ValueError(
                 "digest_bf16_staging contradicts digest_float64 "
                 "(half- vs double-precision staging); drop one")
+        # device-resident arenas + delta flush (ROADMAP #2): unmeshed
+        # tiers keep sketch registers in HBM across intervals and stream
+        # staged deltas during the interval; meshed tiers already hold
+        # set/counter registers device-resident, so the gate is a no-op
+        # there (the digest dense build stays the sharded all_to_all)
+        self.flush_resident = bool(flush_resident_arenas)
+        resident_unmeshed = self.flush_resident and mesh is None
+        # pow2-floored delta granularity, shared by both delta modes
+        # (dense ROWS per upload chunk when chunking host-staged builds,
+        # staged POINTS per streamed chunk when resident); 0 = defaults
+        self._delta_chunk = 1 << max(0, int(
+            flush_delta_chunk_keys).bit_length() - 1) \
+            if flush_delta_chunk_keys > 0 else 0
+        self._delta_nbuf = max(2, int(flush_delta_nbuf))
         self.digests = arena_mod.DigestArena(
             compression=compression, mesh=mesh, n_lanes=ingest_lanes,
             eval_dtype=np.float64 if digest_float64 else np.float32,
             bf16_staging=digest_bf16_staging,
             presharded_staging=flush_presharded_staging,
+            resident=resident_unmeshed,
+            resident_chunk_points=self._delta_chunk or 32768,
+            resident_device_assembly=resident_device_assembly,
             **kw)
         # sketch-family dispatch (ROADMAP #3): per-key choice of
         # tdigest vs moments for histogram/timer samples.  Rules match
@@ -213,6 +234,9 @@ class MetricAggregator:
         # (the ivec plane is f64 and capacity-sized)
         self.moments = arena_mod.MomentsArena(
             k=sketch_moments_k, mesh=None,
+            resident=resident_unmeshed,
+            resident_chunk_points=self._delta_chunk or 32768,
+            resident_device_assembly=resident_device_assembly,
             **(kw if self.family_dispatch else {}))
         from veneur_tpu.ops import moments_eval
         self.moments_fn = moments_eval.make_moments_flush(
@@ -220,6 +244,7 @@ class MetricAggregator:
         self.last_moments_resid = 0.0
         self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
                                        legacy_migration=hll_legacy_migration,
+                                       resident=resident_unmeshed,
                                        **set_kw)
         self.counters = arena_mod.CounterArena(mesh=mesh, **kw)
         self.gauges = arena_mod.GaugeArena(**kw)
@@ -741,6 +766,14 @@ class MetricAggregator:
             self.moments.sync()
             # vnlint: disable=blocking-propagation (same as above)
             self.sets.sync()
+            if self.flush_resident:
+                # resident arenas: mirror the freshly-consolidated
+                # prefix to the device NOW, inside the interval — this
+                # is the delta-flush amortization (sets already streamed
+                # through their lane sync above).  The uploads are
+                # asynchronous; the lock hold covers slice + cast only.
+                self.digests.stream_resident()
+                self.moments.stream_resident()
             return True
 
     # -- crash checkpoint (core/checkpoint.py) -----------------------------
@@ -891,8 +924,10 @@ class MetricAggregator:
             # a failed dispatch (device OOM, in-flush compile error)
             # must release the set-lane snapshot pin, or lane updates
             # stay on the copying kernels for the process lifetime
-            if self.mesh is not None:
-                self.sets.unpin_lanes(snap.get("sets", {}).get("lanes"))
+            # (lanes exist meshed AND unmeshed-resident; the pin exists
+            # only when the snapshot took one — "lanes" in the part)
+            if "lanes" in snap.get("sets", {}):
+                self.sets.unpin_lanes(snap["sets"]["lanes"])
             raise
         return PendingFlush(self, snap, pend, res, is_local, now, seg)
 
@@ -905,13 +940,15 @@ class MetricAggregator:
             host = {} if pend is None else self._fetch_flush(snap, pend,
                                                              seg)
         finally:
-            if self.mesh is not None:
+            if "lanes" in snap.get("sets", {}):
                 # fetched, idle-skipped, OR the fetch raised: either way
                 # the flush program can no longer read the snapshotted
                 # set registers — release the pin so lane updates go
                 # back to in-place donation (a leaked pin would pin the
-                # copying kernels forever)
-                self.sets.unpin_lanes(snap.get("sets", {}).get("lanes"))
+                # copying kernels forever).  Lanes exist meshed AND
+                # unmeshed-resident (flush_resident_arenas); the pin
+                # exists only when the snapshot took one.
+                self.sets.unpin_lanes(snap["sets"]["lanes"])
         if snap.pop("have_uts"):
             res.unique_ts = int(snap["uts_host"]
                                 if snap["uts_host"] is not None
@@ -1120,10 +1157,63 @@ class MetricAggregator:
         # dispatches first and its kernel overlaps the digest staging
         pend["moments"] = self._dispatch_moments(snap)
         if self.mesh is None:
+            spart = snap["sets"]
+            if self.sets.host_regs is None and len(spart["rows"]):
+                # resident set registers (flush_resident_arenas):
+                # dispatch ONE device gather of the touched rows'
+                # lane-union registers; the fetch reads the exact u8
+                # rows back and estimates HOST-side, so the results are
+                # bit-identical to the host-register path
+                ps = self._padded_rows(spart["rows"])
+                pend["set_rows_dev"] = serving.set_gather_rows(
+                    spart["lanes"], jnp.asarray(ps))
+                pend["set_ps"] = ps
             if nd == 0:
                 return pend
             uniform = dpart["uniform"]
             donate = not is_local
+            rpart = dpart.pop("resident", None)
+            if rpart is not None and not rpart["dirty"]:
+                # resident delta path: the dense matrices assemble ON
+                # DEVICE from the interval's streamed chunks plus the
+                # tail (arena.assemble_resident) — the critical-path
+                # upload is the dense-id map + tail; everything else
+                # already crossed the link during the interval
+                # (amortized_bytes vs upload_bytes is the bench's
+                # upload_amortized_pct)
+                t0 = time.perf_counter()
+                dvd, dwd, mmd, critical = \
+                    self.digests.assemble_resident(
+                        rpart, dpart["staged"], dpart["rows"],
+                        dpart["d_min"], dpart["d_max"], uniform,
+                        donate)
+                seg["build_s"] = time.perf_counter() - t0
+                seg["layout_s"] = 0.0
+                seg["resident"] = 1.0
+                seg["amortized_bytes"] = (
+                    seg.get("amortized_bytes", 0)
+                    + rpart["streamed_bytes"])
+                seg["upload_bytes"] = (seg.get("upload_bytes", 0)
+                                       + critical)
+                t0 = time.perf_counter()
+                shape = (int(dvd.shape[0]), int(dvd.shape[1]))
+                if uniform:
+                    fn = (self.flush_fn.depth_variant_donated
+                          if donate else self.flush_fn.depth_variant)
+                    with self._CompileGuard(
+                            self, (shape, True, donate)):
+                        outs = [fn(dvd, dwd, self._pct_arr)]
+                else:
+                    with self._CompileGuard(
+                            self, (shape, False, donate)):
+                        outs = [self.flush_fn(dvd, dwd, mmd,
+                                              self._pct_arr,
+                                              uniform=False,
+                                              donate=donate)]
+                seg["dispatch_s"] = time.perf_counter() - t0
+                pend.update(outs=outs, n_chunks=1, uniform=uniform,
+                            first_dev=None if donate else (dvd, dwd))
+                return pend
             t0 = time.perf_counter()
             dv, dw, minmax = self.digests.build_dense(
                 dpart["staged"], dpart["rows"],
@@ -1135,27 +1225,39 @@ class MetricAggregator:
             seg["upload_bytes"] = (
                 seg.get("upload_bytes", 0) + dv.nbytes + dw.nbytes
                 + (0 if uniform else minmax.nbytes))
-            # Upload/evaluate overlap (the P7 double-buffer, on device
-            # streams): a big GLOBAL-tier flush splits into row chunks —
-            # chunk i+1's upload rides the transfer engine while chunk
-            # i's program runs.  Forwarding tiers keep one piece (the
+            # Upload/evaluate/readback overlap (the _dma_pipeline
+            # double buffer lifted to the host<->HBM boundary): a big
+            # GLOBAL-tier flush splits into row chunks — chunk i+1's
+            # upload rides the transfer engine while chunk i's program
+            # runs and chunk i-1's readback drains (copy_to_host_async
+            # below), with at most _delta_nbuf chunks in flight before
+            # the host blocks.  Forwarding tiers keep one piece (the
             # digest export gathers from the whole dense matrix).
             n_chunks = 1
-            if (not is_local and self._upload_chunks > 1
-                    and dv.shape[0]
-                    >= self._upload_chunks * _CHUNK_MIN_ROWS):
-                n_chunks = self._upload_chunks
+            if not is_local:
+                if (self._delta_chunk
+                        and dv.shape[0] >= 2 * self._delta_chunk):
+                    # explicit rows-per-chunk override
+                    # (flush_delta_chunk_keys); pow2 over pow2 rows
+                    # always tiles exactly
+                    n_chunks = dv.shape[0] // self._delta_chunk
+                elif (self._upload_chunks > 1 and dv.shape[0]
+                        >= self._upload_chunks * _CHUNK_MIN_ROWS):
+                    n_chunks = self._upload_chunks
             rows_per = dv.shape[0] // n_chunks
             layout_s = dispatch_s = 0.0
             outs = []
+            chunk_stats = [] if n_chunks > 1 else None
             first_dev = None
+            t_dispatch0 = None
             for c in range(n_chunks):
                 sl = slice(c * rows_per, (c + 1) * rows_per)
                 t0 = time.perf_counter()
                 if uniform:
                     dvd, depd = self.digests.put_dense_uniform(
                         dv[sl], dw[sl])
-                    layout_s += time.perf_counter() - t0
+                    up_s = time.perf_counter() - t0
+                    layout_s += up_s
                     t0 = time.perf_counter()
                     if first_dev is None:
                         first_dev = (dvd, depd)
@@ -1167,7 +1269,8 @@ class MetricAggregator:
                 else:
                     dvd, dwd, mmd = self.digests.put_dense(
                         dv[sl], dw[sl], minmax[:, sl])
-                    layout_s += time.perf_counter() - t0
+                    up_s = time.perf_counter() - t0
+                    layout_s += up_s
                     t0 = time.perf_counter()
                     if first_dev is None:
                         first_dev = (dvd, dwd)
@@ -1177,12 +1280,35 @@ class MetricAggregator:
                                                   self._pct_arr,
                                                   uniform=False,
                                                   donate=donate))
-                dispatch_s += time.perf_counter() - t0
+                if t_dispatch0 is None:
+                    t_dispatch0 = t0
+                d_s = time.perf_counter() - t0
+                dispatch_s += d_s
+                if chunk_stats is not None:
+                    chunk_stats.append({"rows": rows_per,
+                                        "upload_s": up_s,
+                                        "dispatch_s": d_s})
+                    # stage 3 of the pipeline: start this chunk's D2H
+                    # readback now, so it drains while the NEXT chunk
+                    # uploads and evaluates
+                    for leaf in jax.tree_util.tree_leaves(outs[-1]):
+                        leaf.copy_to_host_async()
+                    if c + 1 >= self._delta_nbuf:
+                        # backpressure at the in-flight window
+                        # (flush_delta_nbuf): wait for the OLDEST
+                        # in-flight chunk, not the one just dispatched
+                        # — the classic double-buffer drain
+                        j = c + 1 - self._delta_nbuf
+                        t0 = time.perf_counter()
+                        jax.block_until_ready(outs[j])
+                        chunk_stats[j]["drain_s"] = (
+                            time.perf_counter() - t0)
             seg["layout_s"] = layout_s
             seg["dispatch_s"] = dispatch_s
             # donated buffers are consumed by the program; a forwarding
             # tier (never donating) keeps the first chunk for export
             pend.update(outs=outs, n_chunks=n_chunks, uniform=uniform,
+                        chunk_stats=chunk_stats, t_dispatch0=t_dispatch0,
                         first_dev=None if donate else first_dev)
             return pend
         else:
@@ -1335,6 +1461,39 @@ class MetricAggregator:
         seg = self.last_flush_segments
         m = self.moments
         uniform = mpart["uniform"]
+        rpart = mpart.pop("resident", None)
+        if rpart is not None and not rpart["dirty"]:
+            # resident delta path (flush_resident_arenas): dense sample
+            # matrices assemble on device from the streamed chunks +
+            # tail; only the ivec Chebyshev contributions (subset-sized)
+            # and the dense-id/tail cross the link at flush time.  The
+            # moments program never donates, so the scatter chain runs
+            # its copying form (donate=False).
+            t0 = time.perf_counter()
+            dvd, dwd, _, critical = m.assemble_resident(
+                rpart, mpart["staged"], mpart["rows"],
+                mpart["d_min"], mpart["d_max"], uniform, donate=False)
+            imp, ab, lab = m.import_contrib(mpart, int(dvd.shape[0]))
+            seg["m_build_s"] = time.perf_counter() - t0
+            seg["resident"] = 1.0
+            seg["amortized_bytes"] = (seg.get("amortized_bytes", 0)
+                                      + rpart["streamed_bytes"])
+            seg["upload_bytes"] = (seg.get("upload_bytes", 0)
+                                   + critical + imp.nbytes + ab.nbytes
+                                   + lab.nbytes)
+            t0 = time.perf_counter()
+            abd, labd, impd = (jnp.asarray(ab), jnp.asarray(lab),
+                               jnp.asarray(imp))
+            shape = (int(dvd.shape[0]), int(dvd.shape[1]))
+            with self._CompileGuard(self, ("moments", shape, uniform)):
+                if uniform:
+                    out = self.moments_fn.depth_variant(
+                        dvd, dwd, abd, labd, impd, self._pct_arr)
+                else:
+                    out = self.moments_fn(dvd, dwd, abd, labd, impd,
+                                          self._pct_arr)
+            seg["m_dispatch_s"] = time.perf_counter() - t0
+            return {"out": out, "nm": nm}
         t0 = time.perf_counter()
         dv, dw, _ = m.build_dense(
             mpart["staged"], mpart["rows"],
@@ -1378,11 +1537,48 @@ class MetricAggregator:
             host["m_qs"] = mout[:mp["nm"], :n_cols]
             host["m_resid"] = mout[:mp["nm"], -1]
         if not pend["meshed"]:
-            host["set_ests"] = snap["sets"]["estimates"]
+            if "set_rows_dev" in pend:
+                # resident set registers: exact u8 readback of the
+                # touched rows, estimated HOST-side — bit-identical to
+                # the host-register path, and the registers double as
+                # the forwarding marshal source (host["set_regs"])
+                srows = snap["sets"]["rows"]
+                t0 = time.perf_counter()
+                regs = serving.fetch(
+                    pend["set_rows_dev"])[:len(srows)]
+                seg["set_device_s"] = time.perf_counter() - t0
+                seg["readback_bytes"] = (seg.get("readback_bytes", 0)
+                                         + regs.nbytes)
+                host["set_ests"] = (
+                    hll_mod.estimate_np_rows(regs) if len(regs)
+                    else np.zeros(0, np.float64))
+                host["set_regs"] = regs
+            elif "estimates" in snap["sets"]:
+                host["set_ests"] = snap["sets"]["estimates"]
             if nd == 0:
                 return host
             t0 = time.perf_counter()
-            fetched = serving.fetch(tuple(pend["outs"]))
+            cs = pend.get("chunk_stats")
+            if cs is not None:
+                # pipelined chunks fetch one at a time so each chunk's
+                # residual wait is attributable (the readbacks were
+                # started at dispatch via copy_to_host_async)
+                fetched = []
+                for i, o in enumerate(pend["outs"]):
+                    t1 = time.perf_counter()
+                    fetched.append(serving.fetch(o))
+                    cs[i]["wait_s"] = time.perf_counter() - t1
+                seg["device_chunks"] = cs
+                # device_s stays the residual blocking wait; the
+                # device-BUSY window since the first chunk's dispatch —
+                # which OVERLAPS the later chunks' layout/dispatch
+                # segments, the causal proof of the pipeline — lands in
+                # device_window_s and is what the flight recorder lays
+                # as the flush.seg.device span
+                seg["device_window_s"] = (time.perf_counter()
+                                          - pend["t_dispatch0"])
+            else:
+                fetched = serving.fetch(tuple(pend["outs"]))
             ev = (fetched[0] if pend["n_chunks"] == 1
                   else np.concatenate(fetched))
             seg["device_s"] = time.perf_counter() - t0
@@ -1519,17 +1715,31 @@ class MetricAggregator:
             # estimates to max against the primary lane at emission
             "legacy_ests": s.legacy_estimates(srows),
         }
-        if self.mesh is None:
+        if s.host_regs is not None:
             # host registers: estimates now, register copies only if rows
             # will forward (Set.Metric marshal needs them post-reset)
             snap["sets"]["estimates"] = s.host_estimates(srows)
             if len(srows) and (snap["sets"]["scopes"]
                                == int(MetricScope.MIXED)).any():
                 snap["sets"]["host_regs"] = s.host_regs_copy(srows)
-        else:
+        elif self.mesh is not None or len(srows):
+            # device lanes — meshed, or unmeshed-resident
+            # (flush_resident_arenas): the flush reads the pinned lane
+            # snapshot (pmax-merge meshed, set_gather_rows resident) and
+            # resident estimates compute at FETCH time on the exact u8
+            # readback.  Meshed always pins (the SPMD program takes the
+            # full lane plane every flush); resident pins only when set
+            # rows were touched — an untouched interval dispatches no
+            # set gather, so nothing would ever read the snapshot
             snap["sets"]["lanes"] = s.snapshot_lanes()
 
         drows = d.touched_rows()
+        # uniform is captured BEFORE take_staged resets the tracking, and
+        # the resident mirror is consumed right after take_staged with
+        # its result (the tail's (row, pos) coordinates come from the
+        # same consolidated arrays)
+        d_uniform = d.staged_uniform
+        d_staged = d.take_staged()
         snap["digests"] = {
             "rows": drows,
             "names": d.name_col[drows],
@@ -1541,11 +1751,11 @@ class MetricAggregator:
             "scopes": d.scope_col[drows].copy(),
             # the interval's staged weighted points (consumed); the flush
             # program evaluates them in one dense pass outside the lock
-            # (uniform captured BEFORE take_staged resets the tracking —
-            # it selects the key-only sort network as a static program
-            # choice, ops/sorted_eval.py)
-            "uniform": d.staged_uniform,
-            "staged": d.take_staged(),
+            # (uniform selects the key-only sort network as a static
+            # program choice, ops/sorted_eval.py)
+            "uniform": d_uniform,
+            "staged": d_staged,
+            "resident": d.take_resident(d_staged),
             "l_weight": d.l_weight[drows].copy(),
             "l_min": d.l_min[drows].copy(),
             "l_max": d.l_max[drows].copy(),
@@ -1560,6 +1770,8 @@ class MetricAggregator:
 
         m = self.moments
         mrows = m.touched_rows()
+        m_uniform = m.staged_uniform
+        m_staged = m.take_staged()
         snap["moments"] = {
             "rows": mrows,
             "names": m.name_col[mrows],
@@ -1567,8 +1779,9 @@ class MetricAggregator:
             "tags": m.tags_col[mrows],
             "kinds": m.kind_col[mrows],
             "scopes": m.scope_col[mrows].copy(),
-            "uniform": m.staged_uniform,
-            "staged": m.take_staged(),
+            "uniform": m_uniform,
+            "staged": m_staged,
+            "resident": m.take_resident(m_staged),
             "l_weight": m.l_weight[mrows].copy(),
             "l_min": m.l_min[mrows].copy(),
             "l_max": m.l_max[mrows].copy(),

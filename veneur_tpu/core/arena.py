@@ -626,11 +626,22 @@ class SetArena(_ArenaBase):
 
     def __init__(self, capacity: int = _INITIAL_CAPACITY,
                  precision: int = hll_mod.DEFAULT_PRECISION, mesh=None,
-                 legacy_migration: bool = False):
+                 legacy_migration: bool = False,
+                 resident: bool = False):
         super().__init__(capacity)
         self.precision = precision
         self.m = 1 << precision
         self.n_lanes = self._init_mesh_lanes(mesh, "set")
+        # flush_resident_arenas: an UNMESHED arena keeps its registers
+        # device-resident too — the same [1, capacity, m] lane machinery
+        # the meshed tiers run (scatter-max sync, pinned snapshots, the
+        # copying-kernel donation fallback), with one lane and no
+        # sharding.  Inserts then stream to HBM during the interval and
+        # the flush reads back only the touched rows' registers
+        # (serving.set_gather_rows); estimates still compute HOST-side
+        # on the exact u8 readback, so they are bit-identical to the
+        # host-register path.
+        self.resident = bool(resident) and mesh is None
         # Rolling-upgrade migration lane (hll_legacy_migration): legacy
         # 'VH' imports carry blake2b-hashed members which do NOT union
         # meaningfully with metro-hashed registers (the same member lands
@@ -641,7 +652,7 @@ class SetArena(_ArenaBase):
         # population), a lower bound otherwise, and never hash-mixing.
         self.legacy_migration = legacy_migration
         self._legacy_regs: dict[int, np.ndarray] = {}
-        if mesh is None:
+        if mesh is None and not self.resident:
             self.host_regs = np.zeros((capacity, self.m), np.uint8)
             self.lanes_regs = None
         else:
@@ -844,8 +855,16 @@ class SetArena(_ArenaBase):
             if len(rows):
                 self.host_regs[rows] = 0
             return
-        # runs even for empty rows: the kernel swaps in a fresh buffer so
-        # the flush snapshot never aliases the live (donatable) one
+        if len(rows) == 0 and self._snapshot_inflight == 0:
+            # nothing to clear and no pinned snapshot that could alias
+            # the live buffer — skip the swap kernel (it walks the full
+            # lane plane, a real per-flush cost on untouched intervals
+            # in the unmeshed-resident mode where idle flushes never
+            # pin)
+            return
+        # runs even for empty rows while a snapshot is pinned: the
+        # kernel swaps in a fresh buffer so the flush snapshot never
+        # aliases the live (donatable) one
         self.lanes_regs = serving.set_reset_rows(
             self.lanes_regs, jnp.asarray(self._reset_index(rows)))
 
@@ -944,7 +963,10 @@ class DigestArena(_ArenaBase):
                  compression: float = td.DEFAULT_COMPRESSION,
                  mesh=None, n_lanes: Optional[int] = None,
                  eval_dtype=np.float32, bf16_staging: bool = False,
-                 presharded_staging: bool = True):
+                 presharded_staging: bool = True,
+                 resident: bool = False,
+                 resident_chunk_points: int = 32768,
+                 resident_device_assembly: Optional[bool] = None):
         super().__init__(capacity)
         self.compression = compression
         # pre-sharded staging (put_dense_sharded): per-device block
@@ -1024,6 +1046,40 @@ class DigestArena(_ArenaBase):
         # any sample_rate != 1, forwarded centroid weight != 1, or
         # hot-key pre-reduction flips it off until the next interval
         self._staged_nonuniform = False
+        # device-resident delta mirror (flush_resident_arenas): the host
+        # COO above stays AUTHORITATIVE — checkpoints, forwarding
+        # exports and the query rings read it unchanged, which is what
+        # keeps crash conservation exact — but with `resident` on, the
+        # consolidated prefix additionally streams to the device in
+        # fixed pow2-size chunks DURING the interval
+        # (stream_resident), so the flush assembles its dense matrix
+        # on device from already-resident chunks plus the un-streamed
+        # tail (assemble_resident / serving.resident_scatter*) instead
+        # of re-uploading the whole key space.  Unmeshed only: the
+        # meshed dense build is the pre-sharded all_to_all path.
+        self.resident = bool(resident) and mesh is None
+        # backend gate for the device-assembly half: on PJRT:CPU there
+        # is no link to amortize and XLA:CPU's serial scatter makes
+        # flush-time assembly strictly slower than the host dense
+        # builder, so streaming/assembly auto-degrade to the staged
+        # pipeline (serving.resident_link_ok); tests force the device
+        # path by passing resident_device_assembly=True
+        self._res_device = (serving.resident_link_ok()
+                            if resident_device_assembly is None
+                            else bool(resident_device_assembly))
+        self._res_chunk_points = max(1024, _pow2(
+            int(resident_chunk_points)))
+        self._res_chunks: list[dict] = []  # streamed device chunks
+        self._res_consumed = 0  # consolidated points already streamed
+        self._res_bytes = 0     # bytes moved off the flush critical path
+        self._res_dirty = False  # mirror invalidated for this interval
+        # per-row arrival cursors: the next streamed point of row r
+        # takes dense column _res_pos[r] — the same ordinal
+        # build_dense's stable argsort assigns, which is what makes the
+        # device-assembled dense matrix elementwise identical to the
+        # host-staged one (the bit-parity contract)
+        self._res_pos = (np.zeros(capacity, np.int32)
+                         if self.resident else None)
 
     def _grow_state(self, old: int) -> None:
         pad = lambda a, fill: np.concatenate(
@@ -1039,6 +1095,8 @@ class DigestArena(_ArenaBase):
         self.l_sum = pad(self.l_sum, 0)
         self.l_rsum = pad(self.l_rsum, 0)
         self._depth = pad(self._depth, 0)
+        if self._res_pos is not None:
+            self._res_pos = pad(self._res_pos, 0)
 
     # -- staging ----------------------------------------------------------
 
@@ -1136,6 +1194,10 @@ class DigestArena(_ArenaBase):
         # converges in O(log) passes even for absurd backlogs
         while int(self._depth.max()) > DENSE_DEPTH_CAP:
             before = int(self._depth.max())
+            # a pre-reduce reorders the consolidated accumulator, which
+            # invalidates the resident mirror's streamed (row, pos)
+            # coordinates for this interval
+            self._mark_resident_dirty()
             self._pre_reduce()
             if int(self._depth.max()) >= before:
                 break
@@ -1240,6 +1302,212 @@ class DigestArena(_ArenaBase):
         self._acc = []
         self._staged_nonuniform = False
         return rows, vals, wts
+
+    # -- resident delta mirror (flush_resident_arenas) ---------------------
+
+    def _mark_resident_dirty(self) -> None:
+        """Invalidate the interval's device mirror: drop the streamed
+        chunks and fall back to the host-staged dense build at the next
+        flush.  Rare — pre-reduce past DENSE_DEPTH_CAP or corrupt staged
+        row ids; the host COO is authoritative either way."""
+        if not self.resident:
+            return
+        self._res_chunks = []
+        self._res_consumed = 0
+        self._res_bytes = 0
+        self._res_dirty = True
+        self._res_pos[:] = 0
+
+    def stream_resident(self) -> int:
+        """Mirror freshly-consolidated staged points into device-resident
+        delta chunks (call under the aggregator lock, after sync()).
+        Only FULL chunks stream — the tail rides the flush dispatch —
+        so jit shapes are fixed and every chunk amortizes.  The upload
+        itself is asynchronous (jnp.asarray returns before the transfer
+        completes); the lock hold covers the host-side slice + cast
+        only.  Returns bytes moved off the flush critical path."""
+        if (not self.resident or not self._res_device
+                or self._res_dirty or not self._acc):
+            return 0
+        rows, vals, wts = self._consolidated()
+        cp = self._res_chunk_points
+        sent = 0
+        while len(rows) - self._res_consumed >= cp:
+            sl = slice(self._res_consumed, self._res_consumed + cp)
+            crows = rows[sl]
+            if (int(crows.min()) < 0
+                    or int(crows.max()) >= self.capacity):
+                # corrupt staged ids: leave them to build_dense's loud
+                # drop path (host fallback for this interval)
+                self._mark_resident_dirty()
+                return sent
+            sent += self._stream_chunk(crows, vals[sl], wts[sl], cp)
+            self._res_consumed += cp
+        return sent
+
+    def _stream_chunk(self, crows, cvals, cwts, pad_to: int) -> int:
+        """Upload one full delta chunk: (row, pos, value[, weight])
+        arrays, row-sorted (scatter order is irrelevant — (row, pos)
+        pairs are unique), positions continuing each row's arrival
+        cursor.  Weights upload only once the interval has gone
+        nonuniform; chunks streamed before that scatter exact 1.0
+        weights materialized on device."""
+        n = len(crows)
+        order = np.argsort(crows, kind="stable")
+        sr = crows[order]
+        starts = np.searchsorted(sr, sr)
+        pos = self._res_pos[sr] + (np.arange(n) - starts)
+        # duplicate fancy assignment: the LAST write per row wins, which
+        # is that row's highest position this chunk — the cursor
+        # advances past everything just streamed
+        self._res_pos[sr] = (pos + 1).astype(np.int32)
+        pr = np.full(pad_to, self.capacity, np.int32)  # pad -> sentinel
+        pp = np.zeros(pad_to, np.int32)
+        # unmeshed dense VALUES are always stage_dtype: the uniform and
+        # compact_general builds stage at wire width, and without bf16
+        # staging stage_dtype == eval_dtype — so chunks streamed before
+        # the flush knows its uniformity still land bit-identical
+        pv = np.zeros(pad_to, self.stage_dtype)
+        pr[:n] = sr
+        pp[:n] = pos
+        pv[:n] = cvals[order]  # same numpy cast as the dense build's
+        chunk = {"rows": jnp.asarray(pr), "pos": jnp.asarray(pp),
+                 "vals": jnp.asarray(pv)}
+        nbytes = pr.nbytes + pp.nbytes + pv.nbytes
+        if self._staged_nonuniform:
+            pw = np.zeros(pad_to, self.eval_dtype)
+            pw[:n] = cwts[order]
+            chunk["wts"] = jnp.asarray(pw)
+            nbytes += pw.nbytes
+        self._res_chunks.append(chunk)
+        self._res_bytes += nbytes
+        return nbytes
+
+    def take_resident(self, staged):
+        """Consume the interval's resident mirror (call under the
+        aggregator lock, immediately after take_staged, with its
+        result): returns the dispatch part for assemble_resident and
+        resets the mirror for the next interval.  The TAIL — staged
+        points after the last full streamed chunk — gets its (row, pos)
+        coordinates here: O(tail) indexing, the only per-flush host
+        build work left on the resident path.  Returns None when device
+        assembly is off for this backend (serving.resident_link_ok) —
+        the flush then takes the staged chunk-pipelined path."""
+        if not self.resident or not self._res_device:
+            return None
+        rows, vals, wts = staged
+        part = {"dirty": self._res_dirty,
+                "chunks": self._res_chunks,
+                "streamed_bytes": self._res_bytes,
+                "streamed_points": self._res_consumed}
+        if not part["dirty"]:
+            tr = rows[self._res_consumed:]
+            if len(tr) and (int(tr.min()) < 0
+                            or int(tr.max()) >= self.capacity):
+                part["dirty"] = True  # host fallback drops them loudly
+                part["chunks"] = []
+            else:
+                n = len(tr)
+                order = np.argsort(tr, kind="stable")
+                sr = tr[order]
+                starts = np.searchsorted(sr, sr)
+                pos = self._res_pos[sr] + (np.arange(n) - starts)
+                part["tail"] = (sr, pos,
+                                vals[self._res_consumed:][order],
+                                wts[self._res_consumed:][order])
+        self._res_chunks = []
+        self._res_consumed = 0
+        self._res_bytes = 0
+        self._res_dirty = False
+        self._res_pos[:] = 0
+        return part
+
+    def assemble_resident(self, part, staged, touched: np.ndarray,
+                          d_min_t: np.ndarray, d_max_t: np.ndarray,
+                          uniform: bool, donate: bool):
+        """Assemble the flush's dense build ON DEVICE from the resident
+        delta mirror: a zeros [U, D] accumulator born in HBM plus one
+        scatter per streamed chunk and one for the tail.  The critical-
+        path upload is the dense-id map, the tail chunk and the depth
+        vector / minmax scalars — everything else crossed the link
+        during the interval.  Same value contract as build_dense +
+        put_dense*, but the dense matrices come back as DEVICE arrays;
+        the extra return is the critical-path byte count.  Caller must
+        have checked part['dirty'].  donate=False keeps the scatter
+        chain copying even on donation-safe backends (a local tier
+        keeps the final matrices for centroid export)."""
+        rows, vals, wts = staged
+        nd = len(touched)
+        u_pad = self.n_shards * self.dense_block_per_shard(nd)
+        # dense-id map with a sentinel slot at index `capacity` (where
+        # chunk padding rows point); rows outside this flush map to the
+        # OOB marker the scatters drop on device
+        dense_id = np.full(self.capacity + 1, serving._RESIDENT_DROP,
+                           np.int32)
+        dense_id[touched] = np.arange(nd, dtype=np.int32)
+        counts = (np.bincount(rows, minlength=self.capacity)[touched]
+                  if len(rows) and nd else np.zeros(nd, np.int64))
+        depth = max(int(counts.max()) if len(counts) else 1, 1)
+        d_pad = max(2, self.n_replicas * _pow2(
+            -(-depth // self.n_replicas)))
+        vdt = (self.stage_dtype if (uniform or self.compact_general)
+               else self.eval_dtype)
+        chunks = list(part["chunks"])
+        critical = dense_id.nbytes
+        tail = part.get("tail")
+        if tail is not None and len(tail[0]):
+            tr, tp, tv, tw = tail
+            n = len(tr)
+            pad_to = max(2, _pow2(n))  # pow2 pad: jit-shape reuse
+            pr = np.full(pad_to, self.capacity, np.int32)
+            pp = np.zeros(pad_to, np.int32)
+            pv = np.zeros(pad_to, vdt)
+            pr[:n] = tr
+            pp[:n] = tp
+            pv[:n] = tv
+            tchunk = {"rows": jnp.asarray(pr), "pos": jnp.asarray(pp),
+                      "vals": jnp.asarray(pv)}
+            critical += pr.nbytes + pp.nbytes + pv.nbytes
+            if not uniform:
+                pw = np.zeros(pad_to, self.eval_dtype)
+                pw[:n] = tw
+                tchunk["wts"] = jnp.asarray(pw)
+                critical += pw.nbytes
+            chunks.append(tchunk)
+        did = jnp.asarray(dense_id)
+        donate = donate and serving.resident_donation_ok()
+        dv = serving.resident_dense_zeros(shape=(u_pad, d_pad),
+                                          dtype=vdt)
+        if uniform:
+            scat = (serving.resident_scatter if donate
+                    else serving.resident_scatter_copy)
+            for ch in chunks:
+                dv = scat(dv, did, ch["rows"], ch["pos"], ch["vals"])
+            depths_vec = np.zeros(u_pad, np.int16)
+            if nd:
+                depths_vec[:nd] = counts
+            critical += depths_vec.nbytes
+            return dv, serving.put(depths_vec, None), None, critical
+        dw = serving.resident_dense_zeros(shape=(u_pad, d_pad),
+                                          dtype=self.eval_dtype)
+        sw = (serving.resident_scatter_w if donate
+              else serving.resident_scatter_w_copy)
+        sw1 = (serving.resident_scatter_w1 if donate
+               else serving.resident_scatter_w1_copy)
+        for ch in chunks:
+            if "wts" in ch:
+                dv, dw = sw(dv, dw, did, ch["rows"], ch["pos"],
+                            ch["vals"], ch["wts"])
+            else:
+                # streamed while the interval was still uniform: exact
+                # 1.0 weights materialize on device, never uploaded
+                dv, dw = sw1(dv, dw, did, ch["rows"], ch["pos"],
+                             ch["vals"])
+        minmax = np.zeros((2, u_pad), self.eval_dtype)
+        minmax[0, :nd] = d_min_t
+        minmax[1, :nd] = d_max_t
+        critical += minmax.nbytes
+        return dv, dw, serving.put(minmax, self._minmax_shd), critical
 
     @staticmethod
     def staged_depth(staged) -> int:
@@ -1437,6 +1705,14 @@ class DigestArena(_ArenaBase):
     def _checkpoint_extra(self, meta: dict) -> None:
         meta["staged_nonuniform"] = bool(self._staged_nonuniform)
         meta["compression"] = float(self.compression)
+        # resident layout stamp (flush_resident_arenas): the host COO in
+        # this checkpoint is authoritative either way — the resident
+        # mirror re-streams from it after restore — but the streamed
+        # chunks' staging width is part of the bit-replay contract
+        # (resident == host-staged twin), so a resident restore prechecks
+        # it (restore_precheck)
+        meta["resident"] = bool(self.resident)
+        meta["resident_stage_dtype"] = str(np.dtype(self.stage_dtype))
 
     def restore_precheck(self, meta: dict, arrays: dict) -> None:
         if float(meta.get("compression",
@@ -1445,6 +1721,14 @@ class DigestArena(_ArenaBase):
                 "digest checkpoint compression "
                 f"{meta.get('compression')} != configured "
                 f"{self.compression}")
+        want = str(np.dtype(self.stage_dtype))
+        got = str(meta.get("resident_stage_dtype", want))
+        if bool(meta.get("resident")) and self.resident and got != want:
+            raise CheckpointIncompatible(
+                "resident-arena checkpoint streamed delta chunks at "
+                f"stage dtype {got} != configured {want}; the "
+                "bit-replay contract (resident == host-staged twin) "
+                "does not hold across staging widths")
 
     def _restore_arrays(self, meta: dict, arrays: dict) -> None:
         for name in self._CKPT_SCALARS:
@@ -1458,6 +1742,15 @@ class DigestArena(_ArenaBase):
                                                    copy=False))]
         self._staged_nonuniform = bool(meta.get("staged_nonuniform",
                                                 False))
+        if self.resident:
+            # drop any pre-restore mirror state: the restored accumulator
+            # re-streams from position 0 (readback is never needed — the
+            # checkpointed COO is the authoritative copy)
+            self._res_chunks = []
+            self._res_consumed = 0
+            self._res_bytes = 0
+            self._res_dirty = False
+            self._res_pos[:] = 0
 
     def reset_rows(self, rows: np.ndarray) -> None:
         if len(rows) == 0:

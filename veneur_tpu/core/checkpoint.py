@@ -19,6 +19,20 @@ fatal).  The tempfile lifecycle (`open_checkpoint_tmp` ->
 `commit_checkpoint`/`discard_checkpoint`) is a vnlint resource-pairing
 contract: a writer that can leave the tmp file without renaming or
 removing it is a lint error.
+
+Device-resident arenas (`flush_resident_arenas`) change WHERE live
+registers sit, not what a checkpoint holds: the set lanes read back to
+host at capture time (readback-on-checkpoint in
+SetArena._checkpoint_arrays), and digest/moments deltas are
+checkpointed from the authoritative host COO staging, so the on-disk
+format is layout-free — a checkpoint taken resident restores onto a
+host-staged config and vice versa.  The one non-portable dimension is
+the digest STAGE dtype: resident deltas already streamed to HBM were
+quantized at the writer's wire width, so restoring a resident
+checkpoint into a resident config with a different stage dtype would
+break bit-replay — the per-family meta records it and
+DigestArena.restore_precheck raises CheckpointIncompatible (cold
+start) instead of silently re-quantizing.
 """
 
 from __future__ import annotations

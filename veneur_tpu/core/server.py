@@ -205,6 +205,10 @@ class Server:
             digest_bf16_staging=cfg.digest_bf16_staging,
             flush_upload_chunks=cfg.flush_upload_chunks,
             flush_presharded_staging=cfg.flush_presharded_staging,
+            flush_resident_arenas=cfg.flush_resident_arenas,
+            flush_delta_chunk_keys=cfg.flush_delta_chunk_keys,
+            flush_delta_nbuf=cfg.flush_delta_nbuf,
+            resident_device_assembly=cfg.flush_resident_device_assembly,
             cardinality_key_budget=cfg.cardinality_key_budget,
             cardinality_tenant_tag=cfg.cardinality_tenant_tag,
             cardinality_seed=cfg.cardinality_seed,
@@ -706,11 +710,16 @@ class Server:
             except Exception:
                 logger.exception("native ingest drain failed")
                 continue
-            if self.config.eager_device_sync:
+            if (self.config.eager_device_sync
+                    or self.config.flush_resident_arenas):
                 # P7 pipelining: push this tick's staged samples into
                 # the device lanes NOW so flush-time sync only covers
                 # the final partial tick, instead of the whole
-                # interval's backlog arriving at the snapshot
+                # interval's backlog arriving at the snapshot.  With
+                # resident arenas the same tick also STREAMS the
+                # consolidated delta chunks into HBM, which is the whole
+                # point of the mode — upload amortized into the
+                # interval — so the gate is implied by the flag
                 try:
                     self.aggregator.sync_staged()
                 except Exception:
@@ -1412,11 +1421,54 @@ class Server:
             if v is None:
                 continue
             dur_ns = int(float(v) * 1e9)
+            if seg_name == "device":
+                win = segs.get("device_window_s")
+                if win is not None:
+                    # chunked pipeline: the device span's extent is the
+                    # device-BUSY window since the first chunk's
+                    # dispatch — it reaches BACK over the later chunks'
+                    # layout/dispatch children, so sum(flush.seg.*)
+                    # exceeding the root wall IS the overlap, visible in
+                    # the trace without any derived metric
+                    win_ns = int(float(win) * 1e9)
+                    child = span.child("flush.seg.device")
+                    child.end_ns = t0 + off + dur_ns
+                    child.start_ns = child.end_ns - win_ns
+                    child.client = None
+                    child.finish()
+                    self.flight_recorder.record_span(child)
+                    self._emit_chunk_spans(child, child.start_ns,
+                                           segs.get("device_chunks"))
+                    off += dur_ns
+                    continue
             child = span.child(f"flush.seg.{seg_name}")
             child.start_ns = t0 + off
             child.end_ns = child.start_ns + dur_ns
             child.client = None          # ring fast path below
             child.finish()
+            self.flight_recorder.record_span(child)
+            off += dur_ns
+
+    def _emit_chunk_spans(self, span, t0_ns: int, chunks) -> None:
+        """Per-chunk grandchildren under flush.seg.device: one span per
+        pipelined upload chunk laid from its measured upload/dispatch/
+        drain/wait durations, so a traced interval shows chunk i+1's
+        upload riding on top of chunk i's device window."""
+        if not chunks:
+            return
+        off = 0
+        for i, c in enumerate(chunks):
+            dur = (c.get("upload_s", 0.0) + c.get("dispatch_s", 0.0)
+                   + c.get("drain_s", 0.0) + c.get("wait_s", 0.0))
+            dur_ns = int(float(dur) * 1e9)
+            child = span.child(f"flush.seg.device.chunk{i}")
+            try:
+                child.start_ns = t0_ns + off
+                child.end_ns = child.start_ns + dur_ns
+                child.tags = {"rows": str(c.get("rows", 0))}
+                child.client = None
+            finally:
+                child.finish()
             self.flight_recorder.record_span(child)
             off += dur_ns
 
@@ -1507,6 +1559,8 @@ class Server:
         # emit so device_s reflects THIS flush, not the last one
         for seg_name, v in list(
                 self.aggregator.last_flush_segments.items()):
+            if not isinstance(v, (int, float)):
+                continue   # structured values (per-chunk stats list)
             if seg_name.endswith("_s"):
                 statsd.timing(f"flush.segment.{seg_name[:-2]}_ms",
                               v * 1e3)

@@ -25,6 +25,16 @@ sort network (ops/sorted_eval.py):
 
 Both halves are shape-static and batched over the row axis, so one
 program evaluates every touched moments key of a flush at once.
+
+The same double-buffered overlap discipline is lifted one level to the
+host↔HBM boundary by the delta flush (core/aggregator._dispatch_flush):
+the staged ``[U, D]`` matrix this kernel consumes arrives either as
+pipelined upload chunks or — under ``flush_resident_arenas`` — is
+assembled ON device from interval-streamed COO deltas
+(arena.MomentsArena.assemble_resident + serving.resident_scatter*), so
+by flush time the merge kernel's input is already in HBM and only the
+``[2(k+1), U]`` moment write and the solver's quantile columns cross
+the link.  `sorted_eval.overlap_efficiency` measures both levels.
 """
 
 from __future__ import annotations

@@ -519,6 +519,38 @@ def _dma_pipeline(big_refs, scratch, sems, tile: int, nbuf: int,
     jax.lax.fori_loop(0, nbuf, body, 0)
 
 
+def overlap_efficiency(chunks: list[dict]) -> float:
+    """Overlap efficiency of a chunked transfer pipeline: the fraction
+    of total per-chunk work (upload + dispatch + drain + wait) hidden
+    behind other chunks' segments.  0.0 = fully serial (the summed
+    segments equal the pipeline's wall span), approaching 1.0 as more
+    of each chunk's transfer rides under its neighbours' compute.
+
+    Shared metric for BOTH pipeline levels: the VMEM sub-tile stream
+    above (`_dma_pipeline`) and its host↔HBM lift — the per-chunk
+    `device_chunks` stats the aggregator's delta flush records and the
+    chunk-size × nbuf sweep in scripts/profile_flush_kernel.py delta
+    mode reports.  Each chunk dict carries second-valued segments
+    (upload_s/dispatch_s/drain_s/wait_s, absent keys = 0) and the list
+    spans one pipeline run whose wall is dominated by the slowest
+    chain, so `1 - wall/sum` is computed from the chunks alone via the
+    serial lower bound max(per-segment totals)."""
+    if not chunks:
+        return 0.0
+    keys = ("upload_s", "dispatch_s", "drain_s", "wait_s")
+    total = sum(float(c.get(k, 0.0)) for c in chunks for k in keys)
+    if total <= 0.0:
+        return 0.0
+    # the pipeline's wall is bounded below by its busiest resource:
+    # the host link (uploads+drains) or the device (dispatch+waits)
+    wall = max(
+        sum(float(c.get("upload_s", 0.0)) + float(c.get("drain_s", 0.0))
+            for c in chunks),
+        sum(float(c.get("dispatch_s", 0.0)) + float(c.get("wait_s", 0.0))
+            for c in chunks))
+    return max(0.0, min(1.0, 1.0 - wall / total))
+
+
 def _kernel_dma(mean_ref, weight_ref, minmax_ref, qs_ref, out_ref,
                 m_scr, w_scr, sems, *, tile: int, nbuf: int,
                 uniform: bool, compact: bool):

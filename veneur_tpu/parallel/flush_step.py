@@ -148,6 +148,59 @@ def example_depth_inputs(n_keys: int = 64, n_lanes: int = 2,
     return dv, jnp.asarray(depths)
 
 
+def example_delta_chunks(n_keys: int = 64, depth: int = 32,
+                         chunk_points: int = 1024, seed: int = 0,
+                         weighted: bool = False):
+    """Synthetic resident-delta stream for the scatter-assembly path
+    (serving.resident_scatter*): the interval's staged COO points cut
+    into fixed-size chunks of (rows, pos, vals[, wts]) exactly as
+    DigestArena.stream_resident emits them — rows padded with the
+    `capacity` sentinel, positions being per-row arrival ordinals — plus
+    the flush-time dense_id map and the dense [U, D] matrix the host
+    builder would have produced, for bit-parity checks and the
+    chunk-size × nbuf sweep in scripts/profile_flush_kernel.py delta
+    mode.  Returns (chunks, dense_id, expect_v, expect_w)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    cap = max(n_keys, 2 * n_keys)
+    k = 1 << (n_keys - 1).bit_length() if n_keys > 1 else 1
+    d = 1 << (depth - 1).bit_length() if depth > 1 else 2
+    rows = rng.integers(0, n_keys, n_keys * depth).astype(np.int64)
+    vals = rng.gamma(2.0, 10.0, len(rows)).astype(np.float32)
+    wts = (rng.integers(1, 9, len(rows)).astype(np.float32)
+           if weighted else np.ones(len(rows), np.float32))
+    dense_id = np.full(cap + 1, serving._RESIDENT_DROP, np.int32)
+    dense_id[:n_keys] = np.arange(n_keys, dtype=np.int32)
+    expect_v = np.zeros((k, d), np.float32)
+    expect_w = np.zeros((k, d), np.float32)
+    cursors = np.zeros(cap, np.int64)
+    chunks = []
+    for lo in range(0, len(rows), chunk_points):
+        cr, cv, cw = (a[lo:lo + chunk_points] for a in (rows, vals, wts))
+        order = np.argsort(cr, kind="stable")
+        sr, sv, sw = cr[order], cv[order], cw[order]
+        pos = (cursors[sr]
+               + (np.arange(len(sr)) - np.searchsorted(sr, sr)))
+        cursors[sr] = pos + 1
+        keep = pos < d            # overfull rows drop, like build_dense
+        expect_v[sr[keep], pos[keep]] = sv[keep]
+        expect_w[sr[keep], pos[keep]] = sw[keep]
+        pr = np.full(chunk_points, cap, np.int32)
+        pp = np.zeros(chunk_points, np.int32)
+        pv = np.zeros(chunk_points, np.float32)
+        pr[:len(sr)] = sr
+        pp[:len(sr)] = pos
+        pv[:len(sr)] = sv
+        ch = {"rows": jnp.asarray(pr), "pos": jnp.asarray(pp),
+              "vals": jnp.asarray(pv)}
+        if weighted:
+            pw = np.zeros(chunk_points, np.float32)
+            pw[:len(sr)] = sw
+            ch["wts"] = jnp.asarray(pw)
+        chunks.append(ch)
+    return chunks, jnp.asarray(dense_id), expect_v, expect_w
+
+
 def example_inputs(n_keys: int = 64, n_lanes: int = 2, n_sets: int = 8,
                    depth: int = 32,
                    compression: float = td.DEFAULT_COMPRESSION,

@@ -611,3 +611,110 @@ def set_regs_pack(set_regs: jax.Array, rows: jax.Array) -> jax.Array:
     """Flat [n * m] u8 readback of merged HLL registers for forwarding
     (Set.Metric marshal, `samplers/samplers.go:279-295`)."""
     return set_regs[rows].reshape(-1)
+
+
+@jax.jit
+def set_gather_rows(lanes_regs: jax.Array, rows: jax.Array) -> jax.Array:
+    """[n, m] u8 readback of the lane-union registers for the given rows —
+    the flush-side read of resident set arenas (flush_resident_arenas).
+    Unmeshed resident state has one lane, so the lane max is a no-op; the
+    meshed form is the same reduction flush_body performs.  NOT donating:
+    a dispatched-but-unfetched flush pins the lanes (snapshot_lanes)."""
+    return jnp.max(lanes_regs, axis=0)[rows]
+
+
+# ---------------------------------------------------------------------------
+# Resident-delta scatter kernels (flush_resident_arenas)
+# ---------------------------------------------------------------------------
+#
+# The device half of the delta-flush dense build: the host streams fixed-
+# size (row, pos, value[, weight]) delta chunks into HBM DURING the
+# interval (DigestArena.stream_resident), and at flush time the dense
+# sample matrix is assembled ON DEVICE — zeros [U, D] plus one scatter per
+# chunk — so the flush critical path uploads only the dense-id map and the
+# un-streamed tail, never the full key space.  Chunk `rows` are arena-row
+# ids; `dense_id` maps them to this flush's compacted dense rows, with
+# INT32_MAX marking rows outside the flush (and the padding sentinel slot
+# at index capacity), which mode="drop" discards without a host round
+# trip.  Positions are the host's per-row arrival cursors, byte-identical
+# to build_dense's stable-sort ordinals — the bit-parity contract.
+
+_RESIDENT_DROP = 2**31 - 1  # dense_id value for rows absent from the flush
+
+
+def _resident_scatter(dense_v: jax.Array, dense_id: jax.Array,
+                      rows: jax.Array, pos: jax.Array,
+                      vals: jax.Array) -> jax.Array:
+    """Scatter one value-only delta chunk (uniform interval: the weight
+    matrix never exists, occupancy rides the per-row depth vector)."""
+    r = dense_id[rows]
+    return dense_v.at[r, pos].set(vals, mode="drop")
+
+
+def _resident_scatter_w(dense_v: jax.Array, dense_w: jax.Array,
+                        dense_id: jax.Array, rows: jax.Array,
+                        pos: jax.Array, vals: jax.Array,
+                        wts: jax.Array
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Scatter a weighted delta chunk into the (values, weights) pair."""
+    r = dense_id[rows]
+    return (dense_v.at[r, pos].set(vals, mode="drop"),
+            dense_w.at[r, pos].set(wts, mode="drop"))
+
+
+def _resident_scatter_w1(dense_v: jax.Array, dense_w: jax.Array,
+                         dense_id: jax.Array, rows: jax.Array,
+                         pos: jax.Array, vals: jax.Array
+                         ) -> tuple[jax.Array, jax.Array]:
+    """Weighted-build scatter of a chunk streamed while the interval was
+    still uniform: its weights are exactly 1.0 and were never uploaded —
+    they materialize on device (exact in every eval dtype)."""
+    r = dense_id[rows]
+    ones = jnp.ones(vals.shape, dense_w.dtype)
+    return (dense_v.at[r, pos].set(vals, mode="drop"),
+            dense_w.at[r, pos].set(ones, mode="drop"))
+
+
+# Donating twins consume the dense accumulator chain in place (the
+# production TPU shape); the copying twins are the CPU-backend fallback —
+# the SAME PJRT:CPU donation race documented at lane_donation_ok applies
+# to the resident dense chain (a scatter's donated input racing the
+# previous flush's still-in-flight executable), so resident_donation_ok
+# gates every assembly the way SetArena.sync gates lane updates.
+resident_scatter = jax.jit(_resident_scatter, donate_argnums=(0,))
+resident_scatter_copy = jax.jit(_resident_scatter)
+resident_scatter_w = jax.jit(_resident_scatter_w, donate_argnums=(0, 1))
+resident_scatter_w_copy = jax.jit(_resident_scatter_w)
+resident_scatter_w1 = jax.jit(_resident_scatter_w1, donate_argnums=(0, 1))
+resident_scatter_w1_copy = jax.jit(_resident_scatter_w1)
+
+
+def resident_donation_ok() -> bool:
+    """Donation gate for the resident dense-assembly chain — one policy
+    with the lane kernels (see lane_donation_ok): in-place on TPU,
+    copying kernels on PJRT:CPU."""
+    return lane_donation_ok()
+
+
+@functools.lru_cache(maxsize=1)
+def resident_link_ok() -> bool:
+    """Whether this backend has a REAL host<->device link whose upload
+    cost the resident delta stream amortizes.  On PJRT:CPU "device"
+    buffers are host memory: streaming deltas moves no bytes off any
+    critical path, while the flush-time scatter assembly pays XLA:CPU's
+    serial scatter lowering — strictly worse than the host dense
+    builder.  So the digest/moments device-assembly half of
+    flush_resident_arenas auto-degrades to the staged (chunk-pipelined)
+    flush on CPU, exactly like lane_donation_ok routes CPU lane updates
+    through the copying kernels; the resident SET lanes (u8 scatter-max,
+    readback-on-checkpoint) stay active everywhere.  Tests force the
+    device-assembly path on CPU via the arenas'
+    resident_device_assembly override."""
+    return jax.default_backend() != "cpu"
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "dtype"))
+def resident_dense_zeros(shape, dtype) -> jax.Array:
+    """Device-side zero dense accumulator — the resident build's starting
+    buffer is born in HBM; nothing crosses the host link for it."""
+    return jnp.zeros(shape, dtype)

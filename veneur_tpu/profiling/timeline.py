@@ -43,6 +43,14 @@ def record_from_segments(interval: int, unix_ts: float, total_s: float,
                       total_ms=round(total_s * 1e3, 3),
                       devices=int(devices))
     for name, v in (segments or {}).items():
+        if not isinstance(v, (int, float)):
+            # structured sub-records (the chunked pipeline's per-chunk
+            # upload/dispatch/drain/wait stats) are trace material —
+            # the flight recorder lays them as spans; the timeline row
+            # keeps only their count
+            if name == "device_chunks":
+                rec["device_chunks"] = len(v)
+            continue
         if name.endswith("_s"):
             rec[name[:-2] + "_ms"] = round(float(v) * 1e3, 3)
         else:

@@ -119,6 +119,15 @@ CRASH_ARMS: list[ChaosArm] = [
              "conserved", {"op": "global-crash"}, kind="crash"),
     ChaosArm("crash-with-spool-expiry", "server.crash", "",
              "accounted", {"op": "spool-expiry"}, kind="crash"),
+    # ISSUE 16: the local runs flush_resident_arenas (device assembly
+    # forced on so the CPU cell exercises the streamed-delta scatter
+    # path) and dies BETWEEN the interval's delta upload and its flush
+    # — the kill lands after full chunks streamed to HBM.  Because the
+    # host COO staging stays the checkpoint source of truth, the
+    # revival restores every point the deltas mirrored: conservation
+    # must be EXACT, never resident-layout-dependent.
+    ChaosArm("crash-with-resident-arenas", "server.crash", "",
+             "conserved", {"op": "resident-crash"}, kind="crash"),
 ]
 
 # frozen-peer arm (ISSUE 14): the `server.sigstop_window` failpoint
@@ -575,18 +584,44 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                      exact.
     spool-expiry     direct, tiny spool_max_age, global stays down past
                      it: every spilled point must land in spool.expired
-                     (visibly-accounted loss, never silent)."""
+                     (visibly-accounted loss, never silent)
+    resident-crash   local-crash's shape with flush_resident_arenas on
+                     every tier (device assembly forced for the CPU
+                     cell) and the kill placed BETWEEN the interval's
+                     delta upload and its flush: full delta chunks are
+                     already in HBM when the process dies.  The revival
+                     restores from the host-COO checkpoint, so the
+                     mirrored deltas must be indistinguishable from
+                     never-streamed ones — conservation EXACT."""
     op = arm.kwargs["op"]
-    direct = op != "local-crash"
+    direct = op not in ("local-crash", "resident-crash")
+    resident = op == "resident-crash"
     spec = ClusterSpec(
         n_locals=n_locals, n_globals=1 if direct else 2,
         durable=True, direct=direct,
+        flush_resident_arenas=resident,
+        flush_resident_device_assembly=True if resident else None,
+        # the smallest chunk the arena allows (its 1024-point floor
+        # bounds jit-shape count); the arm's traffic is sized below so
+        # full delta chunks actually stream before the kill lands
+        flush_delta_chunk_keys=1024 if resident else 0,
         forward_max_retries=1, forward_retry_backoff=0.02,
         spool_replay_interval_s=0.05,
         spool_max_age_s=0.3 if op == "spool-expiry" else 60.0,
         breaker_failure_threshold=2, breaker_reset_timeout=0.4,
         discovery_interval_s=0.2, lock_witness=witness,
         telemetry=telemetry)
+    if resident:
+        # enough staged digest points per interval to fill at least
+        # one 1024-point delta chunk — otherwise everything rides the
+        # flush tail and the kill placement proves nothing.  Spread
+        # WIDE (many keys, shallow rows): piling the points onto one
+        # key would outgrow the dense cap and trigger hot-key
+        # pre-reduction, which marks the mirror dirty and (correctly)
+        # falls back to the host build — a different code path than
+        # the one this arm exists to kill mid-stream.
+        histo_keys = max(histo_keys, 32)
+        histo_samples = max(histo_samples, 48)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples)
@@ -598,12 +633,20 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
         cluster.start()
         per_interval.append(cluster.run_interval(
             traffic.next_interval(n_locals)))
-        if op == "local-crash":
+        if op in ("local-crash", "resident-crash"):
             lines = traffic.next_interval(n_locals)
             for i, ls in enumerate(lines):
                 n = cluster.send_lines(i, ls)
                 if n:
                     cluster.wait_ingested(i, n)
+            if resident:
+                # the interval's delta upload: full chunks stream to
+                # HBM NOW (the production drain-loop tick) — the kill
+                # below lands between this and the flush
+                agg = cluster.locals[0].server.aggregator
+                agg.sync_staged(min_samples=1)
+                extra["resident_streamed_bytes"] = int(
+                    agg.digests._res_bytes + agg.moments._res_bytes)
             # the cut: everything ingested so far is on disk; the
             # crash then drops every in-memory structure
             assert cluster.checkpoint_local(0)
@@ -682,6 +725,13 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     if op == "local-crash":
         row["ok"] = (fired >= 1 and row["conserved"]
                      and row["routing_exclusive"])
+    elif op == "resident-crash":
+        # EXACT conservation despite deltas stranded in the dead
+        # process's HBM — and the arm is vacuous unless chunks really
+        # streamed before the kill
+        row["ok"] = (fired >= 1 and row["conserved"]
+                     and row["routing_exclusive"]
+                     and extra.get("resident_streamed_bytes", 0) > 0)
     elif op == "global-crash":
         row["ok"] = (fired >= 1 and row["conserved"]
                      and row["routing_exclusive"] and closure

@@ -138,6 +138,18 @@ class ClusterSpec:
     # global[0]'s gRPC import (the locals-direct-to-global fleet shape;
     # what makes a global crash exercise the LOCAL's spool)
     direct: bool = False
+    # device-resident arenas + delta flush on EVERY tier (the ISSUE-16
+    # crash arm): sketch registers live in HBM across intervals while
+    # host COO staging stays the checkpoint/forward source of truth.
+    # flush_resident_device_assembly=True forces the device-assembly
+    # half on the CPU CI backend (where the auto gate degrades it), so
+    # the conservation cell exercises the streamed-delta scatter path.
+    flush_resident_arenas: bool = False
+    flush_resident_device_assembly: object = None
+    # staged POINTS per streamed delta chunk (0 = the 32768 default);
+    # the crash arm shrinks it so testbed-sized traffic actually
+    # streams full chunks before the kill lands
+    flush_delta_chunk_keys: int = 0
 
 
 @dataclass
@@ -232,6 +244,10 @@ class Cluster:
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
             query_window_slots=spec.query_window_slots,
+            flush_resident_arenas=spec.flush_resident_arenas,
+            flush_resident_device_assembly=(
+                spec.flush_resident_device_assembly),
+            flush_delta_chunk_keys=spec.flush_delta_chunk_keys,
             hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
@@ -273,6 +289,10 @@ class Cluster:
             spool_max_bytes=spec.spool_max_bytes,
             spool_replay_interval=spec.spool_replay_interval_s,
             query_window_slots=spec.query_window_slots,
+            flush_resident_arenas=spec.flush_resident_arenas,
+            flush_resident_device_assembly=(
+                spec.flush_resident_device_assembly),
+            flush_delta_chunk_keys=spec.flush_delta_chunk_keys,
             hostname=hostname),
             extra_metric_sinks=[sink])
         srv.lock_witness = self.witness
