@@ -1490,6 +1490,180 @@ def bench_query_plane(n_keys: int = 20_000, iters: int = 16,
         srv.shutdown()
 
 
+def bench_cube_query(total_series: int = 102_400,
+                     group_counts: tuple = (64, 256, 1024),
+                     iters: int = 40) -> dict:
+    """Group-by cube analytics (ISSUE-17 acceptance): 100k+ DISTINCT
+    ingested series (a high-cardinality ``host:`` tag under every
+    sample) collapse through the configured ``(endpoint, region)``
+    cube dimension into a bounded group set, and the windowed
+    ``/query?group_by=`` read answers per-group quantiles from the
+    materialized cube rows — never touching the 100k base rows.
+
+    Reported:
+      cube_query_p50_ms / cube_query_p99_ms
+                    exact group-by latency through the real engine
+                    entry (parse -> dimension match -> per-slot cube
+                    fusion -> batched per-group quantiles) at the
+                    HEADLINE shape: group_counts[0] groups over
+                    ``total_series`` distinct series, answered with
+                    ``payload=0`` (the operator dashboard read —
+                    quantiles and counts; mergeable family payloads
+                    are the proxy's scatter-gather currency, and the
+                    full-payload reading rides in the sweep row as
+                    ``p50_full_ms``).  Acceptance: single-digit ms
+                    on CPU
+      cube_groups_per_launch
+                    the segmented-reduce launch width of the moments
+                    coarsening read (``group_by=endpoint`` is a strict
+                    SUBSET of the dimension, so the answer rolls up
+                    through ops/segmented_reduce in one launch); the
+                    max across the sweep
+      cube_query_sweep
+                    the same probes per group count — query cost
+                    scales with GROUPS (the python per-group fuse +
+                    payload walk), not with ingested series, which is
+                    the point of materializing cubes at ingest
+
+    Every sweep point ingests the full ``total_series`` (series per
+    group shrinks as groups grow), so each latency is a 100k-series
+    reading.  Each point boots a fresh server: the group budget is a
+    boot-time knob and the sweep must not inherit warm arena rows.
+    A moments tenant (``cqm.*`` routed by family rule, 4 hosts/group)
+    rides along so the coarsened read exercises the segmented-reduce
+    path, and a top-8-by-q99 probe checks ranked reads at every
+    point."""
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricScope, UDPMetric
+
+    def run_point(groups: int, q_iters: int) -> dict:
+        cfg = config_mod.Config(
+            interval=10.0, percentiles=list(PERCENTILES),
+            hostname="cube-bench", trace_flush_enabled=False,
+            query_window_slots=4,
+            cube_dimensions=[
+                {"tags": ["endpoint", "region"], "match": "cq.*"},
+                {"tags": ["endpoint", "region"], "match": "cqm.*"},
+            ],
+            cube_group_budget=groups, cube_seed=3,
+            sketch_family_rules=[{"match": "cqm.*",
+                                  "family": "moments"}])
+        srv = Server(cfg)
+        srv.start()
+        try:
+            agg = srv.aggregator
+            rng = np.random.default_rng(17)
+            per_group = max(1, total_series // groups)
+
+            def ingest(name: str, hosts: int, hp: str) -> None:
+                vals = rng.gamma(2.0, 10.0, groups * hosts)
+                batch, n = [], 0
+                for i in range(groups):
+                    gt = [f"endpoint:e{i // 16}", f"region:r{i % 16}"]
+                    for j in range(hosts):
+                        tags = sorted(gt + [f"host:{hp}{j}"])
+                        batch.append(UDPMetric(
+                            name=name, type=sm.TYPE_HISTOGRAM,
+                            joined_tags=",".join(tags),
+                            value=float(vals[n]), tags=tags,
+                            scope=MetricScope.GLOBAL_ONLY))
+                        n += 1
+                        if len(batch) >= 8192:
+                            agg.process_batch(batch)
+                            batch = []
+                if batch:
+                    agg.process_batch(batch)
+
+            ingest("cq.load", per_group, "h")
+            ingest("cqm.load", 4, "m")
+            agg.sync_staged(min_samples=1)
+            srv.flush()
+            snap = agg.cubes.snapshot()
+            assert snap["overflowed"] == 0, snap   # budget == groups
+
+            def timed(params: dict) -> tuple:
+                t0 = time.perf_counter()
+                code, body = srv.query.serve(params)
+                return (time.perf_counter() - t0) * 1e3, code, body
+
+            exact_q = {"name": ["cq.load"],
+                       "group_by": ["endpoint,region"],
+                       "q": ["0.5,0.99"], "slots": ["1"],
+                       "payload": ["0"]}
+            full_q = dict(exact_q, payload=["1"])
+            coarse_q = {"name": ["cqm.load"], "group_by": ["endpoint"],
+                        "q": ["0.5,0.99"], "slots": ["1"]}
+            # warm: first read pays slot finalization; the first
+            # moments read pays the maxent solver jit
+            timed(exact_q)
+            timed(coarse_q)
+            lat = []
+            for _ in range(q_iters):
+                dt, code, body = timed(exact_q)
+                assert code == 200 and body["groups_total"] == groups, \
+                    (code, body.get("groups_total"), body.get("error"))
+                assert body["groups"][0]["payload"] is None, body
+                lat.append(dt)
+            flat = []
+            for _ in range(max(8, q_iters // 4)):
+                dt, code, body = timed(full_q)
+                assert code == 200 and \
+                    body["groups"][0]["payload"] is not None, (code,)
+                flat.append(dt)
+            clat, launch = [], 0
+            for _ in range(max(8, q_iters // 4)):
+                dt, code, body = timed(coarse_q)
+                assert code == 200 and body["coarsened"], (code, body)
+                launch = max(launch,
+                             int(body["cube_groups_per_launch"]))
+                clat.append(dt)
+            t_ms, code, body = timed(
+                {"name": ["cq.load"], "group_by": ["endpoint,region"],
+                 "q": ["0.99"], "slots": ["1"], "top": ["8"],
+                 "by": ["q99"]})
+            assert code == 200 and len(body["groups"]) == 8 \
+                and body["groups_total"] == groups, (code, body)
+            row = {
+                "groups": groups,
+                "series": groups * per_group,
+                "p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3),
+                "p50_full_ms": round(
+                    float(np.percentile(flat, 50)), 3),
+                "coarsen_p50_ms": round(
+                    float(np.percentile(clat, 50)), 3),
+                "launch": launch,
+                "topk_ms": round(t_ms, 3),
+            }
+            log(f"cube-query arm: {groups} groups x "
+                f"{per_group} hosts = {row['series']} series — exact "
+                f"group-by p50 {row['p50_ms']} ms / p99 "
+                f"{row['p99_ms']} ms (full payload p50 "
+                f"{row['p50_full_ms']} ms), coarsened p50 "
+                f"{row['coarsen_p50_ms']} ms (launch {launch}), "
+                f"top-8 {row['topk_ms']} ms")
+            return row
+        finally:
+            srv.shutdown()
+
+    sweep = {}
+    for gi, groups in enumerate(group_counts):
+        sweep[str(groups)] = run_point(
+            groups, iters if gi == 0 else max(10, iters // 3))
+    head = sweep[str(group_counts[0])]
+    return {
+        "cube_query_p50_ms": head["p50_ms"],
+        "cube_query_p99_ms": head["p99_ms"],
+        "cube_groups_per_launch": max(r["launch"]
+                                      for r in sweep.values()),
+        "cube_query_groups": head["groups"],
+        "cube_query_series": head["series"],
+        "cube_query_sweep": sweep,
+    }
+
+
 def bench_checkpoint_overhead(n_keys: int = 20_000, iters: int = 40,
                               samples_per_key: int = 2) -> float:
     """Steady-state cost of crash checkpointing on the flush path
@@ -1708,6 +1882,22 @@ def main() -> None:
         for k in ("query_p50_ms", "query_p99_ms",
                   "query_staleness_ms"):
             result[k] = {"error": str(e)[:200]}
+    # group-by cube analytics (ISSUE-17 acceptance: group-by quantile
+    # reads over 100k+ distinct series answer in single-digit ms on
+    # CPU at the operator dashboard shape; the sweep shows cost
+    # scaling with GROUPS, not series, and the coarsened read reports
+    # its segmented-reduce launch width).  Promised keys: error
+    # values on arm failure, like kernel_stage_ms.
+    _CUBE_KEYS = ("cube_query_p50_ms", "cube_query_p99_ms",
+                  "cube_groups_per_launch")
+    try:
+        cq = bench_cube_query()
+        result.update({k: cq[k] for k in _CUBE_KEYS})
+        result["cube_query"] = cq
+    except Exception as e:
+        log(f"cube-query arm failed: {e}")
+        for k in _CUBE_KEYS:
+            result[k] = {"error": str(e)[:200]}
     try:
         dvec = bench_depth_vector()
         if dvec is not None:
@@ -1822,6 +2012,8 @@ def main() -> None:
                 "egress_overhead_pct", "moments_merge_p50_ms",
                 "moments_vs_tdigest_speedup", "query_p50_ms",
                 "query_p99_ms", "query_staleness_ms",
+                "cube_query_p50_ms", "cube_query_p99_ms",
+                "cube_groups_per_launch",
                 "delta_flush_e2e_p50_ms", "delta_flush_e2e_p99_ms",
                 "upload_amortized_pct", "resident_vs_staged_speedup"]
     if "mesh_scaling_per_device_work_ms" in result:

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """One-command repo gate: vnlint -> native sanitizer smoke -> reshard,
 crash and egress chaos cells -> mixed-family dryrun -> proc chaos cell
--> resident-arena chaos cell -> query dryrun cell -> tier-1 pytest.
+-> resident-arena chaos cell -> query dryrun cell -> cube dryrun cell
+-> tier-1 pytest.
 Nonzero exit on ANY unsuppressed lint finding, sanitizer report,
 failed chaos cell, failed mixed-family conservation, failed query
 envelope/staleness gate, or test failure — the local equivalent of a
@@ -254,6 +255,31 @@ def main() -> int:
                         "PASS" if query_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3h. the group-by cube cell (ISSUE 17): two cube tenants (one per
+    # sketch family) drive tag-grouped histogram traffic past a tight
+    # per-dimension group budget in a 2-local / 2-global cluster.
+    # Gates: every pinned group conserves EXACTLY at the local
+    # emission tier, the over-budget tail is fully accounted in the
+    # dimension's veneur.cube.other row (never silent), each
+    # interval's proxy group-by scatter-gather (plus a ranked
+    # top-k-by-q99 probe) reconciles against the exact per-group
+    # ledger, and the final full-window answer sits inside both family
+    # envelopes (promised report keys:
+    # cube.{groups,rollup_points,overflowed,query_p50_ms})
+    cube_rc = 0
+    if args.fast:
+        results.append(("cube dryrun cell", "SKIP", 0.0))
+    else:
+        t0 = stage("cube dryrun cell (group-by analytics vs ledger)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cube_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py", "--cubes",
+             "--locals", "2", "--globals", "2", "--intervals", "3"],
+            env=env)
+        results.append(("cube dryrun cell",
+                        "PASS" if cube_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -274,7 +300,7 @@ def main() -> int:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
                or egress_rc or mixed_rc or proc_rc or resident_rc
-               or query_rc or test_rc) else 0
+               or query_rc or cube_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

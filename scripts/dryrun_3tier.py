@@ -30,6 +30,14 @@ Usage:
                                           # all three tiers gated on
                                           # exact counts, per-family
                                           # envelopes + staleness
+  python scripts/dryrun_3tier.py --cubes  # group-by analytics arm: two
+                                          # sketch-cube tenants (one per
+                                          # family) past a tight group
+                                          # budget — local emissions and
+                                          # proxy group-by scatter-gather
+                                          # both gated on the exact
+                                          # ledger; overflow stays
+                                          # accounted in the other row
   python scripts/dryrun_3tier.py --trace   # traced: every interval must
                                            # assemble into ONE complete
                                            # 3-tier trace (incl. the
@@ -106,6 +114,17 @@ def main(argv=None) -> int:
                     "(answers cover data up to the last completed "
                     "cut).  Nonzero exit on any envelope or "
                     "staleness violation")
+    ap.add_argument("--cubes", action="store_true",
+                    help="run the group-by analytics arm: two cube "
+                    "tenants (one per sketch family) drive tag-grouped "
+                    "traffic past a tight per-dimension group budget; "
+                    "local-tier emissions must conserve every pinned "
+                    "group exactly with the over-budget tail accounted "
+                    "in veneur.cube.other, and each interval's proxy "
+                    "group-by scatter-gather (plus a top-k-by-q99 "
+                    "probe) is gated on the exact per-group ledger and "
+                    "the family envelopes.  Nonzero exit on any "
+                    "unaccounted group mass")
     ap.add_argument("--lock-witness", action="store_true",
                     help="wrap every tier's named locks in the runtime "
                     "lock witness and cross-validate observed "
@@ -194,7 +213,7 @@ def main(argv=None) -> int:
         moments_histo_keys=args.moments_keys,
         chaos=args.chaos, lock_witness=args.lock_witness,
         trace=args.trace, telemetry=args.telemetry,
-        query=args.query, procs=args.procs)
+        query=args.query, cubes=args.cubes, procs=args.procs)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:
@@ -221,6 +240,13 @@ def main(argv=None) -> int:
                  f"p99 {qr['p99_ms']} ms, staleness "
                  f"{qr['staleness_ms']} ms, envelopes "
                  f"{'OK' if qr['envelope_ok'] else 'VIOLATED'}")
+    if args.cubes and report["cube"] is not None:
+        cu = report["cube"]
+        tail += ("; cubes: "
+                 f"{cu['groups']} live group(s), "
+                 f"{cu['rollup_points']} rollup points, "
+                 f"{cu['overflowed']} overflowed (accounted), "
+                 f"group-by p50 {cu['query_p50_ms']} ms")
     if args.moments_keys:
         sf = report["sketch_families"]
         tail += ("; mixed-family: "

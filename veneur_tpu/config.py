@@ -231,6 +231,23 @@ class Config:
     # merge stays elementwise at every tier (the guard is the first
     # production consumer of the family dispatch)
     cardinality_rollup_family: str = "tdigest"
+    # group-by sketch cubes (veneur_tpu/cubes/): each entry declares one
+    # group-by dimension — a tag-name list (`[region, endpoint]`) or a
+    # dict `{tags: [...], match: "api.*"}` gating it to matching metric
+    # names.  Every histogram/timer sample carrying ALL of a dimension's
+    # tag names is mirrored into a per-group rollup row (an ordinary
+    # mergeable arena key tagged `veneur_cube:true`, tag values joined
+    # SORTED), served by `/query?group_by=...`.  Empty list disables.
+    cube_dimensions: list = field(default_factory=list)
+    # per-dimension live-group budget (cardinality-guard pattern): the
+    # over-budget tail degrades into one accounted `veneur.cube.other`
+    # row per (dimension, type) — visible loss, never silent — while
+    # space-saving candidates track demoted groups for promotion at
+    # interval end.  Required > 0 when cube_dimensions is set.
+    cube_group_budget: int = 0
+    # deterministic tie-break seed for cube eviction/promotion ranks and
+    # the top-k ranking (the cardinality_seed of the cube plane)
+    cube_seed: int = 0
     # rolling-upgrade migration lane for sets: merge legacy 'VH'
     # (blake2b-hashed) HLL imports into a side lane and emit
     # max(primary, legacy) instead of hash-mixing the registers (which
@@ -490,6 +507,20 @@ class Config:
                 "sketch_family_* dispatch is unsupported with a device "
                 "mesh (mesh_devices > 0): the moments flush program is "
                 "single-device — drop one")
+        if self.cube_group_budget < 0:
+            self.cube_group_budget = 0
+        if self.cube_dimensions:
+            # validate at boot (identity rules live in cubes/cube.py);
+            # a malformed dimension must fail loudly here, not at the
+            # first matching sample
+            from veneur_tpu.cubes import parse_dimensions
+            parse_dimensions(self.cube_dimensions)
+            if self.cube_group_budget <= 0:
+                raise ValueError(
+                    "cube_dimensions requires cube_group_budget > 0: "
+                    "an unbounded cube is a cardinality explosion by "
+                    "construction (set a budget; overflow degrades "
+                    "into the accounted veneur.cube.other row)")
         if self.digest_float64 and self.mesh_devices:
             # config-level rejection (not a deep aggregator error): the
             # meshed flush program is f32-native — hi/lo counter planes,

@@ -120,7 +120,10 @@ class MetricAggregator:
                  sketch_moments_k: int = 0,
                  cardinality_rollup_family: str = "tdigest",
                  query_window_slots: int = 0,
-                 query_slot_seconds: float = 0.0):
+                 query_slot_seconds: float = 0.0,
+                 cube_dimensions: Optional[list] = None,
+                 cube_group_budget: int = 0,
+                 cube_seed: int = 0):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -260,6 +263,19 @@ class MetricAggregator:
                              tenant_tag=cardinality_tenant_tag,
                              seed=cardinality_seed)
             if cardinality_key_budget > 0 else None)
+        # group-by sketch cubes (veneur_tpu/cubes/): config-declared
+        # dimensions mirror each histogram/timer sample into per-group
+        # rollup rows — ordinary mergeable arena keys, so they flush,
+        # forward, and window through the existing machinery.  Ingest
+        # edge only: forwarded cube rows come back through the import
+        # path as ordinary wire keys (re-materializing there would
+        # double-count).
+        self.cubes = None
+        if cube_dimensions and cube_group_budget > 0:
+            from veneur_tpu.cubes import CubeMaintainer, parse_dimensions
+            self.cubes = CubeMaintainer(
+                parse_dimensions(cube_dimensions), cube_group_budget,
+                seed=cube_seed)
         self.processed = 0
         self.imported = 0
         # V1 import identity->row cache; cleared at every snapshot so a
@@ -405,6 +421,17 @@ class MetricAggregator:
             arena = self._histo_arena(key, tags)
             row = arena.row_for(key, scope, tags)
             arena.sample(row, m.value, m.sample_rate)
+            if self.cubes is not None:
+                # cube dimension rollups: the sample ALSO lands in each
+                # matching group's row (family dispatch by the cube
+                # key, so like groups merge family-coherently across
+                # tiers); over-budget groups land in the accounted
+                # veneur.cube.other row instead — counted, not lost
+                for ck, cs, ctags in self.cubes.rollups(key, scope,
+                                                        tags):
+                    carena = self._histo_arena(ck, ctags)
+                    crow = carena.row_for(ck, cs, ctags)
+                    carena.sample(crow, m.value, m.sample_rate)
         elif t == sm.TYPE_SET:
             scope = (MetricScope.LOCAL_ONLY
                      if m.scope == MetricScope.LOCAL_ONLY
@@ -1821,6 +1848,8 @@ class MetricAggregator:
             ar.end_interval()
         if self.cardinality is not None:
             self._cardinality_end_interval()
+        if self.cubes is not None:
+            self._cube_end_interval()
         return snap
 
     def _arena_for_type(self, mtype: str, key: Optional[MetricKey] = None):
@@ -1873,6 +1902,36 @@ class MetricAggregator:
             import logging
             logging.getLogger("veneur_tpu.core.aggregator").warning(
                 "cardinality eviction pass aborted (%s); retrying next "
+                "interval", e)
+
+    def _cube_end_interval(self) -> None:
+        """The cube maintainer's promotion pass — same shape and
+        failure contract as the guard's: a fault on the arena.evict
+        edge aborts with the cube membership untouched."""
+        def release(dks):
+            from veneur_tpu import failpoints
+            failpoints.inject("arena.evict")
+            by_arena: dict = {}
+            for dk in dks:
+                # cube rows are histogram/timer keys; release from the
+                # arena that ACTUALLY holds the key (family-rules drift
+                # across restarts must not skip a release)
+                if dk in self.moments.kdict:
+                    arena = self.moments
+                elif dk in self.digests.kdict:
+                    arena = self.digests
+                else:
+                    continue    # never materialized (pure candidate)
+                by_arena.setdefault(id(arena), (arena, []))[1].append(dk)
+            for arena, lst in by_arena.values():
+                arena.release_keys(lst)
+
+        try:
+            self.cubes.end_interval(release)
+        except Exception as e:
+            import logging
+            logging.getLogger("veneur_tpu.core.aggregator").warning(
+                "cube eviction pass aborted (%s); retrying next "
                 "interval", e)
 
     # -- emitters ----------------------------------------------------------

@@ -218,7 +218,10 @@ class Server:
             cardinality_rollup_family=cfg.cardinality_rollup_family,
             query_window_slots=cfg.query_window_slots,
             query_slot_seconds=(cfg.query_slot_seconds
-                                or cfg.interval))
+                                or cfg.interval),
+            cube_dimensions=list(cfg.cube_dimensions),
+            cube_group_budget=cfg.cube_group_budget,
+            cube_seed=cfg.cube_seed)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances

@@ -1,0 +1,27 @@
+"""Tag-dimensional analytics: group-by sketch cubes.
+
+A *cube* is a config-declared set of group-by dimensions (lists of tag
+names, optionally gated by metric-name globs).  Every histogram/timer
+sample whose tags carry ALL of a dimension's tag names is mirrored into
+a per-group rollup row — an ordinary mergeable arena key, so a moments
+group merge is one vector add and a digest group merge reuses the
+staged-COO path, and the rows forward/flush/window exactly like any
+other key.  Group identity is canonicalized through the shared
+``identity_string``/fnv1a machinery with SORTED tag values, and bounded
+by a per-dimension group budget that degrades overflow into an
+accounted ``veneur.cube.other`` row (the cardinality-guard pattern — no
+silent loss).
+"""
+
+from veneur_tpu.cubes.cube import (  # noqa: F401
+    CUBE_TAG,
+    DIM_TAG_PREFIX,
+    OTHER_NAME,
+    CubeDimension,
+    CubeMaintainer,
+    group_of,
+    is_cube_tags,
+    match_dimension,
+    parse_dimensions,
+    project_group,
+)

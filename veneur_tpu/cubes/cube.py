@@ -1,0 +1,445 @@
+"""Cube maintenance: group-by dimensions, group budget, overflow row.
+
+The maintainer lives next to the cardinality guard on the ingest edge
+and is called under the aggregator lock for every histogram/timer
+sample AFTER cardinality resolve: ``rollups()`` returns the extra cube
+identities the sample must ALSO land in.  Cube rows are ordinary arena
+keys — they flush, forward, and window through the existing machinery
+with zero new merge code — so the maintainer's only jobs are (a)
+canonical group identity and (b) the per-dimension group budget.
+
+Identity contract (the PR-15 routing-key rule): a cube row's tags are
+the dimension's ``tag:value`` pairs plus the ``veneur_cube:true``
+marker, joined SORTED.  Every tier — ingest, query, proxy routing —
+derives the same string for the same group regardless of the order the
+caller listed the tags, so ``group_by=b,a`` and ``group_by=a,b`` hit
+the same rows on the same owning global.
+
+Budget contract (the cardinality-guard pattern): at most
+``cube_group_budget`` live groups per dimension.  Over-budget groups
+degrade into the dimension's ``veneur.cube.other`` row — the samples
+still count, visibly, under a reserved identity — while a space-saving
+candidate table (seeded fnv1a ranks, lazy min-heap) tracks the hottest
+demoted groups; ``end_interval`` promotes candidates that strictly
+out-touched the coldest exact groups, releasing the evicted rows
+eagerly through the aggregator callback.  Nothing is silently lost:
+``rollup_points == exact-group points + overflowed``.
+"""
+from __future__ import annotations
+
+import fnmatch
+import heapq
+from typing import Callable, Iterable, Optional
+
+from veneur_tpu.samplers.metric_key import (MetricKey, MetricScope,
+                                            fnv1a_64, identity_string)
+
+# Marker tag carried by every cube row: keeps cube identities disjoint
+# from real keys (a user metric could otherwise collide with a group
+# row) and lets the query plane / testbed enumerate cube rows by a
+# plain tag filter.  Reserved like cardinality.ROLLUP_TAG.
+CUBE_TAG = "veneur_cube:true"
+
+# The accounted overflow row: one per (dimension, metric type, scope).
+# Carries DIM_TAG_PREFIX + the dimension id so operators can see WHICH
+# cube is over budget straight from the series tags.
+OTHER_NAME = "veneur.cube.other"
+DIM_TAG_PREFIX = "veneur_cube_dim:"
+
+# Candidate-table sizing relative to the budget (same shape as the
+# guard's bounded candidate state: enough slots to notice a regime
+# change, bounded so a group storm cannot grow it).
+_CAND_SLACK = 4
+_CAND_FLOOR = 256
+
+
+class CubeDimension:
+    """One configured group-by dimension: a sorted tag-name tuple plus
+    optional metric-name globs gating which keys it applies to."""
+
+    __slots__ = ("tags", "match", "dim_id", "_prefixes")
+
+    def __init__(self, tags: Iterable[str], match=None):
+        names = [str(t) for t in tags]
+        if not names:
+            raise ValueError("cube dimension needs at least one tag name")
+        for t in names:
+            if not t or ":" in t or "," in t:
+                raise ValueError(
+                    f"cube dimension tag name {t!r} invalid: tag names "
+                    "must be non-empty and free of ':' and ','")
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"cube dimension {names} repeats a tag name")
+        # SORTED tag names: the dimension id is order-independent just
+        # like the group identity it produces
+        self.tags = tuple(sorted(names))
+        if match is None:
+            globs = None
+        elif isinstance(match, str):
+            globs = (match,)
+        else:
+            globs = tuple(str(g) for g in match)
+            if not globs:
+                globs = None
+        self.match = globs
+        # name-gated dimensions get DISTINCT ids (and so distinct
+        # overflow rows): two dimensions may group by the same tags for
+        # different metric families, and their budgets/other rows must
+        # not collide
+        self.dim_id = "|".join(self.tags) + (
+            "@" + ";".join(globs) if globs else "")
+        self._prefixes = tuple(t + ":" for t in self.tags)
+
+    def matches_name(self, name: str) -> bool:
+        if self.match is None:
+            return True
+        return any(fnmatch.fnmatchcase(name, g) for g in self.match)
+
+    def extract(self, tags: list) -> Optional[list]:
+        """The sample's ``tag:value`` pairs for this dimension, or None
+        unless the sample carries ALL the dimension's tag names (a
+        partial match would smear unrelated series into one group).
+        First occurrence wins for duplicated tag names, matching the
+        parse-canonicalized (sorted) wire form."""
+        out = []
+        for pre in self._prefixes:
+            for t in tags:
+                if t.startswith(pre):
+                    out.append(t)
+                    break
+            else:
+                return None
+        return out
+
+    def describe(self) -> dict:
+        return {"tags": list(self.tags),
+                "match": list(self.match) if self.match else None}
+
+
+def parse_dimensions(raw) -> list:
+    """Validate the ``cube_dimensions`` config value: a list whose
+    entries are either tag-name lists (``[region, endpoint]``) or dicts
+    (``{tags: [...], match: "api.*"}``).  Raises ValueError with the
+    offending entry — config loading surfaces it as a boot error."""
+    if raw in (None, ()):
+        return []
+    if not isinstance(raw, (list, tuple)):
+        raise ValueError(
+            f"cube_dimensions must be a list, got {type(raw).__name__}")
+    dims, seen = [], set()
+    for ent in raw:
+        if isinstance(ent, dict):
+            unknown = set(ent) - {"tags", "match"}
+            if unknown:
+                raise ValueError(
+                    f"cube dimension {ent!r}: unknown keys {sorted(unknown)}")
+            dim = CubeDimension(ent.get("tags") or (), ent.get("match"))
+        elif isinstance(ent, (list, tuple)):
+            dim = CubeDimension(ent)
+        else:
+            raise ValueError(
+                f"cube dimension {ent!r} must be a tag list or a dict "
+                "with 'tags' (and optional 'match')")
+        if dim.dim_id in seen:
+            raise ValueError(
+                f"cube dimension {list(dim.tags)} declared twice")
+        seen.add(dim.dim_id)
+        dims.append(dim)
+    return dims
+
+
+def is_cube_tags(tags: Iterable[str]) -> bool:
+    return CUBE_TAG in tags
+
+
+def group_of(tags: Iterable[str]) -> dict:
+    """tag-name -> value for a cube row's group tags (markers
+    stripped).  The inverse of the identity the maintainer builds."""
+    out = {}
+    for t in tags:
+        if t == CUBE_TAG or t.startswith(DIM_TAG_PREFIX) \
+                or t.startswith("veneur_cube_base:"):
+            continue
+        name, _, val = t.partition(":")
+        out[name] = val
+    return out
+
+
+def project_group(jtags: str, keep: Iterable[str]) -> str:
+    """Project a cube row's canonical joined-sorted-tags onto a
+    coarser tag-name subset — the sub-cube roll-up's group identity
+    (``region,endpoint -> region``).  Kept pairs re-join sorted with
+    the cube marker, so a projected key equals the key an exact
+    coarse dimension would have produced."""
+    want = set(keep)
+    kept = [t for t in jtags.split(",")
+            if t != CUBE_TAG
+            and not t.startswith(DIM_TAG_PREFIX)
+            and t.partition(":")[0] in want]
+    return ",".join(sorted(kept + [CUBE_TAG]))
+
+
+def match_dimension(dims: list, group_by: list,
+                    name: Optional[str] = None) -> Optional[tuple]:
+    """Resolve a query's ``group_by`` tag list against the configured
+    dimensions: an exact dimension answers directly; otherwise the
+    SMALLEST configured superset answers via coarsening (the
+    ``region,endpoint -> region`` sub-cube roll-up).  With ``name``,
+    only dimensions whose glob gate covers that metric are considered
+    (a name-gated sibling dimension holds OTHER metrics' groups).
+    Returns ``(dimension, exact)`` or None when no dimension covers
+    the request."""
+    want = set(group_by)
+    cands = [d for d in dims
+             if name is None or d.matches_name(name)]
+    exact = [d for d in cands if set(d.tags) == want]
+    if exact:
+        return exact[0], True
+    supers = [d for d in cands if want < set(d.tags)]
+    if not supers:
+        return None
+    supers.sort(key=lambda d: (len(d.tags), d.dim_id))
+    return supers[0], False
+
+
+class _DimState:
+    """Mutable budget state for one dimension (one guard-tenant's worth
+    of machinery: exact groups + space-saving candidates)."""
+
+    __slots__ = ("exact", "cand", "heap", "other")
+
+    def __init__(self):
+        self.exact: dict = {}   # dk -> touches this interval
+        self.cand: dict = {}    # dk -> [est points, rank]
+        self.heap: list = []    # lazy min-heap of (est, rank, dk)
+        self.other: dict = {}   # (type, scope) -> overflow identity memo
+
+
+class CubeMaintainer:
+    """Per-aggregator cube state.  All mutating entry points run under
+    the aggregator lock (same locking discipline as CardinalityGuard —
+    documented in analysis/lock_order_graph.json)."""
+
+    def __init__(self, dimensions: list, group_budget: int,
+                 seed: int = 0):
+        self.dims = list(dimensions)
+        self.budget = int(group_budget)
+        self.seed = int(seed)
+        self.cand_cap = max(_CAND_SLACK * self.budget, _CAND_FLOOR)
+        self._st = [_DimState() for _ in self.dims]
+        self._ranks: dict = {}
+        # a membership epoch, like the guard's: bumped whenever the
+        # exact-group set changes so native row caches keyed on it
+        # revalidate
+        self.epoch = 0
+        # conservation counters (snapshot + /debug/vars):
+        # rollup_points == points landed in exact group rows + overflowed
+        self.rollup_points = 0
+        self.overflowed = 0
+        self.groups_admitted = 0
+        self.groups_evicted = 0
+
+    # -- identity ---------------------------------------------------------
+
+    def _rank(self, dk) -> int:
+        """Deterministic seeded tie-break rank for a group identity —
+        the same fnv1a-over-identity_string construction the guard and
+        the top-k ranking use."""
+        r = self._ranks.get(dk)
+        if r is None:
+            r = fnv1a_64(identity_string(dk[0], dk[1]), self.seed)
+            if len(self._ranks) < 4 * self.cand_cap * max(
+                    1, len(self.dims)):
+                self._ranks[dk] = r
+        return r
+
+    @staticmethod
+    def group_identity(name: str, mtype: str, kv_pairs: list,
+                       scope: MetricScope) -> tuple:
+        """The canonical cube identity for one group: tags are the
+        dimension's ``tag:value`` pairs plus the cube marker, joined
+        SORTED (order-independence is the routing contract)."""
+        ctags = sorted(list(kv_pairs) + [CUBE_TAG])
+        return (MetricKey(name, mtype, ",".join(ctags)), scope, ctags)
+
+    def _other_identity(self, st: _DimState, dim: CubeDimension,
+                        mtype: str, scope: MetricScope) -> tuple:
+        memo = st.other.get((mtype, int(scope)))
+        if memo is None:
+            ctags = sorted([CUBE_TAG, DIM_TAG_PREFIX + dim.dim_id])
+            memo = (MetricKey(OTHER_NAME, mtype, ",".join(ctags)),
+                    scope, ctags)
+            st.other[(mtype, int(scope))] = memo
+        return memo
+
+    # -- ingest edge ------------------------------------------------------
+
+    def rollups(self, key: MetricKey, scope: MetricScope, tags: list,
+                n: int = 1) -> list:
+        """The cube identities one resolved histogram/timer sample must
+        ALSO land in (0..len(dims) of them).  Rollup and cube
+        identities themselves never cube again — forwarded cube rows
+        arrive on the import path (which does not call this), and a
+        local re-materialization here would double-count."""
+        out = []
+        for t in tags:
+            if t == CUBE_TAG or t.startswith("veneur_rollup:"):
+                return out
+        for di, dim in enumerate(self.dims):
+            if not dim.matches_name(key.name):
+                continue
+            kv = dim.extract(tags)
+            if kv is None:
+                continue
+            ckey, cscope, ctags = self.group_identity(
+                key.name, key.type, kv, scope)
+            dk = (ckey, scope)
+            st = self._st[di]
+            self.rollup_points += n
+            if dk in st.exact:
+                st.exact[dk] += n
+                out.append((ckey, cscope, ctags))
+            elif len(st.exact) < self.budget:
+                st.exact[dk] = n
+                self.groups_admitted += 1
+                self.epoch += 1
+                out.append((ckey, cscope, ctags))
+            else:
+                self._touch_candidate(st, dk, n)
+                self.overflowed += n
+                out.append(self._other_identity(st, dim, key.type, scope))
+        return out
+
+    def _touch_candidate(self, st: _DimState, dk, n: int) -> None:
+        ent = st.cand.get(dk)
+        if ent is None:
+            if len(st.cand) >= self.cand_cap:
+                evicted = self._pop_min_candidate(st)
+                if evicted is None:
+                    return
+                # space-saving substitution: the newcomer inherits the
+                # evicted minimum's estimate (classic over-estimate
+                # bound, never an undercount)
+                base = evicted[0]
+            else:
+                base = 0
+            ent = st.cand[dk] = [base + n, self._rank(dk)]
+        else:
+            ent[0] += n
+        heapq.heappush(st.heap, (ent[0], ent[1], dk))
+        if len(st.heap) > _CAND_SLACK * len(st.cand) + 64:
+            self._compact_heap(st)
+
+    def _pop_min_candidate(self, st: _DimState):
+        while st.heap:
+            est, rank, dk = heapq.heappop(st.heap)
+            ent = st.cand.get(dk)
+            if ent is not None and ent[0] == est:
+                del st.cand[dk]
+                return ent
+        return None
+
+    def _compact_heap(self, st: _DimState) -> None:
+        st.heap = [(ent[0], ent[1], dk) for dk, ent in st.cand.items()]
+        heapq.heapify(st.heap)
+
+    # -- interval boundary ------------------------------------------------
+
+    def end_interval(self, evict_cb: Callable[[list], None]) -> None:
+        """Promotion pass, after the flush snapshot reset the arenas:
+        candidates that STRICTLY out-touched the coldest exact groups
+        this interval swap in (two-pointer, hottest candidate vs
+        coldest exact; rank breaks ties deterministically).  Evicted
+        group rows release eagerly via ``evict_cb`` — the same
+        ``arena.evict`` failpoint edge as the guard, so a fault there
+        aborts with the cube state untouched."""
+        for st in self._st:
+            if not st.exact and not st.cand:
+                continue
+            swaps: list = []
+            if st.cand:
+                hot = sorted(
+                    ((ent[0], ent[1], dk) for dk, ent in st.cand.items()),
+                    key=lambda e: (-e[0], e[1]))
+                cold = sorted(
+                    ((cnt, self._rank(dk), dk)
+                     for dk, cnt in st.exact.items()),
+                    key=lambda e: (e[0], e[1]))
+                for ci, (est, rank, dk) in enumerate(hot):
+                    if ci >= len(cold) or est <= cold[ci][0]:
+                        break
+                    swaps.append((cold[ci][2], dk))
+            if swaps:
+                # release FIRST: a fault on the arena.evict edge aborts
+                # the pass with the membership untouched (reclamation
+                # is delayed one interval, never corrupted)
+                evict_cb([out for out, _ in swaps])  # may raise
+                for out, inn in swaps:
+                    del st.exact[out]
+                    st.exact[inn] = 0
+                self.groups_evicted += len(swaps)
+                self.epoch += 1
+            # interval-local decay: both touch ledgers restart so one
+            # hot interval cannot pin membership forever
+            for dk in st.exact:
+                st.exact[dk] = 0
+            st.cand.clear()
+            st.heap = []
+
+    # -- introspection / persistence --------------------------------------
+
+    def top_groups(self, di: int, k: int) -> list:
+        """The dimension's live group identities, hottest first with
+        the seeded rank as the deterministic tie-break (the top-k
+        candidate machinery's ordering)."""
+        st = self._st[di]
+        rows = sorted(
+            ((cnt, self._rank(dk), dk) for dk, cnt in st.exact.items()),
+            key=lambda e: (-e[0], e[1]))
+        return [dk for _, _, dk in rows[:k]]
+
+    def snapshot(self) -> dict:
+        """/debug/vars view (no arena walks, O(dims))."""
+        return {
+            "budget": self.budget,
+            "seed": self.seed,
+            "epoch": self.epoch,
+            "groups": sum(len(st.exact) for st in self._st),
+            "rollup_points": self.rollup_points,
+            "overflowed": self.overflowed,
+            "groups_admitted": self.groups_admitted,
+            "groups_evicted": self.groups_evicted,
+            "dimensions": [
+                dict(dim.describe(), dim_id=dim.dim_id,
+                     groups=len(st.exact), candidates=len(st.cand))
+                for dim, st in zip(self.dims, self._st)],
+        }
+
+    def checkpoint_state(self) -> dict:
+        """Durable membership (identities only — counts are
+        interval-local and restart at zero, like the guard's)."""
+        return {
+            "v": 1,
+            "counters": [self.rollup_points, self.overflowed,
+                         self.groups_admitted, self.groups_evicted],
+            "exact": [
+                [[dk[0].name, dk[0].type, dk[0].joined_tags, int(dk[1])]
+                 for dk in st.exact]
+                for st in self._st],
+        }
+
+    def restore_state(self, state: dict) -> None:
+        if not state or state.get("v") != 1:
+            return
+        ctrs = state.get("counters") or [0, 0, 0, 0]
+        (self.rollup_points, self.overflowed,
+         self.groups_admitted, self.groups_evicted) = (
+            int(ctrs[0]), int(ctrs[1]), int(ctrs[2]), int(ctrs[3]))
+        for st, rows in zip(self._st, state.get("exact") or []):
+            st.exact.clear()
+            for name, mtype, jtags, scope in rows[:self.budget]:
+                dk = (MetricKey(name, mtype, jtags),
+                      MetricScope(int(scope)))
+                st.exact[dk] = 0
+        self.epoch += 1

@@ -173,6 +173,17 @@ def debug_vars(server) -> dict:
         # per-tenant key-budget ledger: exact keys, evicted
         # cardinality, rollup point totals
         stats["cardinality"] = guard.snapshot()
+    cubes = getattr(server.aggregator, "cubes", None)
+    if cubes is not None:
+        # group-by cube ledger: live groups / rollup points /
+        # accounted overflow per dimension (conservation:
+        # rollup_points == exact-group points + overflowed)
+        stats["cube"] = cubes.snapshot()
+    # staged-vs-resident assembly probe (parallel/serving.py): the
+    # one-shot measured link decision, inspectable without forcing
+    # a probe run
+    from veneur_tpu.parallel import serving as _serving
+    stats["resident_link_probe"] = _serving.link_probe_stats()
     native = getattr(server, "native", None)
     if native is not None:
         ni = native.stats()  # None while tearing down

@@ -524,6 +524,11 @@ class NativeIngest:
         self.engine = IngestEngine(max_packet, implicit_tags)
         self.on_other = on_other
         self._info: list[Optional[_IdInfo]] = []
+        # engine ids whose identity can NEVER produce a cube rollup
+        # (no dimension matches, or the key is itself a cube/rollup
+        # row) — static per identity, so the per-drain fast path skips
+        # them without re-scanning tags
+        self._cube_inert: set = set()
         self.malformed = 0
         self.too_long = 0
         self._drain_lock = threading.Lock()
@@ -675,6 +680,7 @@ class NativeIngest:
             batch = self._drain_apply(clear_intern)
             if clear_intern:
                 self._info = []
+                self._cube_inert.clear()
         if self.on_other:
             for line in batch.other:
                 self.on_other(line)
@@ -717,10 +723,47 @@ class NativeIngest:
                         rows = self._rows_for(agg.digests, batch.h_ids)
                         agg.digests.sample_batch(rows, batch.h_vals,
                                                  batch.h_wts)
+                    cubes = getattr(agg, "cubes", None)
+                    if cubes is not None:
+                        self._apply_cube_rollups(agg, cubes, batch)
                 if len(batch.s_ids):
                     rows = self._rows_for(agg.sets, batch.s_ids)
                     agg.sets.stage_hash_batch(rows, batch.s_hashes)
         return batch
+
+    def _apply_cube_rollups(self, agg, cubes, batch) -> None:
+        """Mirror the batch's histogram/timer samples into their cube
+        rollup rows — the native-path twin of the materialization
+        `_process_locked` does on the Python ingest edge (runs under
+        the same aggregator lock, from the drain).  ``rollups`` is
+        called per unique id per drain with the staged-sample count:
+        budget admission, touch accounting and the conservation
+        counters live there, so the call cannot be cached — only the
+        never-cubes verdict (a static property of the identity) is."""
+        ids = batch.h_ids
+        order = np.argsort(ids, kind="stable")
+        sids = ids[order]
+        svals = batch.h_vals[order]
+        swts = batch.h_wts[order]
+        uids = np.unique(sids)
+        bounds = np.searchsorted(sids, uids, side="left")
+        ends = np.searchsorted(sids, uids, side="right")
+        for uid, lo, hi in zip(uids, bounds, ends):
+            if uid in self._cube_inert:
+                continue
+            info = self._info[uid]
+            targets = cubes.rollups(info.key, info.row_scope,
+                                    info.tags, n=int(hi - lo))
+            if not targets:
+                self._cube_inert.add(int(uid))
+                continue
+            vals = svals[lo:hi]
+            wts = swts[lo:hi]
+            for ck, cs, ctags in targets:
+                arena = agg._histo_arena(ck, ctags)
+                row = arena.row_for(ck, cs, ctags)
+                arena.sample_batch(
+                    np.full(len(vals), row, np.int64), vals, wts)
 
     def stats(self) -> Optional[dict]:
         """Safe snapshot for observability endpoints: totals + intern

@@ -410,11 +410,26 @@ def make_moments_flush(k: int = mo.DEFAULT_K):
 # Vector-only convenience (analysis harness, MomentsSketch.quantile)
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=None)
+def _vector_solver(k: int):
+    """Jitted batched maxent solve for the vector-only path.  Eager
+    dispatch of the damped-Newton loop costs hundreds of ms per call
+    regardless of batch size — far too slow for the query plane, which
+    solves per-group batches on every group-by request — so the solver
+    compiles once per (rows, quantiles) shape and row counts are padded
+    to powers of two by the caller to bound recompiles."""
+    @jax.jit
+    def run(cheb_raw, cheb_log, ab, lab, pct):
+        return _maxent_quantiles(cheb_raw, cheb_log, ab, lab, pct, k)
+    return run
+
+
 def quantiles_from_vectors(vecs: np.ndarray, qs) -> np.ndarray:
     """Quantiles straight from batched moments VECTORS ``[n, M]`` (no
     dense staging): host f64 conversion to Chebyshev sums in each
     row's own domain, then the batched solver.  The path a vector-only
-    row (pure-import global rows, the analysis twin) takes."""
+    row (pure-import global rows, group-by cube queries, the analysis
+    twin) takes."""
     vecs = np.asarray(vecs, np.float64)
     n, m = vecs.shape
     k = mo.k_from_len(m)
@@ -424,14 +439,27 @@ def quantiles_from_vectors(vecs: np.ndarray, qs) -> np.ndarray:
                  vecs[:, mo.IDX_MAX], 0.0)
     la, lb = mo.log_domain(a, b)
     cheb_raw, cheb_log = cheb_contrib(vecs, (a, b), (la, lb))
+    # pad the row axis to the next power of two: the jitted solver
+    # compiles per shape, and group-by queries arrive with arbitrary
+    # group counts (padding rows are all-zero -> count 0 -> q 0,
+    # sliced off below)
+    npad = 1 << max(0, (n - 1).bit_length())
+    if npad != n:
+        pad = ((0, npad - n), (0, 0))
+        cheb_raw = np.pad(cheb_raw, pad)
+        cheb_log = np.pad(cheb_log, pad)
+        a = np.pad(a, (0, npad - n))
+        b = np.pad(b, (0, npad - n))
+        la = np.pad(la, (0, npad - n))
+        lb = np.pad(lb, (0, npad - n))
     pct = jnp.asarray(np.asarray(qs, np.float64), jnp.float32)
-    qs_out, _ = _maxent_quantiles(
+    qs_out, _ = _vector_solver(k)(
         jnp.asarray(cheb_raw, jnp.float32),
         jnp.asarray(cheb_log, jnp.float32),
         jnp.asarray(np.stack([a, b]), jnp.float32),
         jnp.asarray(np.stack([la, lb]), jnp.float32),
-        pct, k)
-    return np.asarray(qs_out, np.float64)
+        pct)
+    return np.asarray(qs_out, np.float64)[:n]
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,196 @@
+"""Segmented reduction: the cube sub-rollup kernel (ISSUE 17).
+
+Coarsening a cube (``region,endpoint -> region``) merges every fine
+group's mergeable vector into its coarse parent.  For the moments
+family that merge is ONE vector add once the rows are rebased to a
+common domain, so the whole coarsening collapses to a segmented sum:
+``vals [U, C]`` row vectors plus a SORTED int32 segment-id column (the
+rank of each row's coarse group hash) reduce to ``[G, C]`` per-group
+sums in a single launch — thousands of groups, no host walk.
+
+Kernel contract (the ``ops/`` pattern, like ``moments_eval``):
+
+  * ``usable(u, c, backend)`` is the static routing predicate; the
+    router falls back to the XLA twin (``.at[seg].add``) on CPU and on
+    shapes the kernel cannot tile.
+  * interpret-mode parity against the twin is test-enforced.
+  * the accumulation order is STRICTLY global row order — a sequential
+    grid over row tiles, a sequential ``fori_loop`` within each tile —
+    so the f32 sums are bit-identical across tile sizes (the
+    tiling-invariance contract the sort/merge kernels carry).
+
+``VENEUR_TPU_DISABLE_SEGMENTED_REDUCE`` forces the twin, mirroring
+``VENEUR_TPU_DISABLE_PALLAS_EVAL``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from veneur_tpu.sketches import moments as mo
+
+# f32 sublane granularity: group-axis padding of the output block
+_SUBLANE = 8
+
+
+def _row_tile(u: int) -> int:
+    """Row-axis tile: big enough to amortize the grid, small enough
+    that [tile, C] stays comfortably in VMEM at cube widths (C=128 ->
+    256 KiB at tile=512)."""
+    for t in (512, 256, 128, 64, 32, 16, 8):
+        if u % t == 0:
+            return t
+    return u
+
+
+def usable(u: int, c: int, backend: str) -> bool:
+    """Static predicate: whole 128-lane value rows, sublane-aligned row
+    count.  Small fan-ins take the XLA twin, where the scatter-add is
+    sub-millisecond anyway."""
+    return (backend == "tpu" and c >= 128 and c % 128 == 0
+            and u >= _SUBLANE and u % _SUBLANE == 0)
+
+
+def _kernel_segsum(seg_ref, v_ref, out_ref, *, tile: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    def body(r, carry):
+        sid = seg_ref[0, r]
+        out_ref[pl.ds(sid, 1), :] = (out_ref[pl.ds(sid, 1), :]
+                                     + v_ref[pl.ds(r, 1), :])
+        return carry
+
+    # strictly sequential row-order accumulation: with the sequential
+    # TPU grid this makes the f32 sums independent of the tiling
+    jax.lax.fori_loop(0, tile, body, 0)
+
+
+def _segment_sums_pallas(vals, seg, g_pad: int,
+                         interpret: bool = False):
+    u, c = vals.shape
+    tile = _row_tile(u)
+    return pl.pallas_call(
+        functools.partial(_kernel_segsum, tile=tile),
+        grid=(u // tile,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i: (0, i),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, c), lambda i: (i, 0)),
+        ],
+        # the output block is revisited by every grid step (init on the
+        # first): the whole [G, C] accumulator lives in VMEM
+        out_specs=pl.BlockSpec((g_pad, c), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((g_pad, c), jnp.float32),
+        interpret=interpret,
+    )(seg.reshape(1, u).astype(jnp.int32), vals.astype(jnp.float32))
+
+
+def _segment_sums_twin(vals, seg, g_pad: int):
+    """XLA twin (CPU tier-1 + unusable shapes): one scatter-add."""
+    return (jnp.zeros((g_pad, vals.shape[1]), jnp.float32)
+            .at[seg.astype(jnp.int32)].add(vals.astype(jnp.float32)))
+
+
+def segment_sums(vals, seg, n_groups: int, *,
+                 interpret: bool = False) -> jax.Array:
+    """``[U, C]`` rows + sorted segment ids -> ``[n_groups, C]`` f32
+    per-group sums.  Routes to the Pallas kernel when the backend and
+    shape allow, else the XLA twin — parity is test-enforced."""
+    import os
+    u, c = vals.shape
+    g_pad = max(_SUBLANE,
+                (n_groups + _SUBLANE - 1) // _SUBLANE * _SUBLANE)
+    if interpret:
+        out = _segment_sums_pallas(vals, seg, g_pad, interpret=True)
+    elif (not os.environ.get("VENEUR_TPU_DISABLE_SEGMENTED_REDUCE")
+            and usable(u, c, jax.default_backend())):
+        out = _segment_sums_pallas(vals, seg, g_pad)
+    else:
+        out = _segment_sums_twin(vals, seg, g_pad)
+    return out[:n_groups]
+
+
+# ---------------------------------------------------------------------------
+# Moments-vector coarsening on top of the kernel
+# ---------------------------------------------------------------------------
+
+def coarsen_moments_vectors(vecs: np.ndarray,
+                            group_hashes: np.ndarray) -> tuple:
+    """Merge moments wire vectors ``[U, M]`` into their coarse groups.
+
+    ``group_hashes`` (uint64, one per row: the fnv1a of the row's
+    COARSE group identity) is sorted to produce the segment-id column;
+    each group's rows are rebased (host f64, ``mo.rebase_sums``) to the
+    group's common [min, max] / log domain, the addable components
+    reduce through ``segment_sums`` in one launch, and min/max — the
+    two non-additive slots — reduce on the sorted boundaries
+    (``np.minimum.reduceat``).  Returns ``(unique_hashes [G] sorted,
+    group_vecs [G, M] f64, groups_per_launch G)``."""
+    vecs = np.asarray(vecs, np.float64)
+    u, m = vecs.shape
+    k = mo.k_from_len(m)
+    order = np.argsort(np.asarray(group_hashes, np.uint64),
+                       kind="stable")
+    v = vecs[order]
+    hs = np.asarray(group_hashes, np.uint64)[order]
+    uniq, seg = np.unique(hs, return_inverse=True)
+    g = len(uniq)
+    starts = np.searchsorted(hs, uniq, side="left")
+
+    a = np.where(np.isfinite(v[:, mo.IDX_MIN]), v[:, mo.IDX_MIN], 0.0)
+    b = np.where(np.isfinite(v[:, mo.IDX_MAX]), v[:, mo.IDX_MAX], 0.0)
+    occupied = v[:, mo.IDX_COUNT] > 0
+    # group domains: the non-additive envelope, exact on the sorted
+    # boundaries (empty member rows must not shrink the envelope)
+    ga = np.minimum.reduceat(np.where(occupied, a, np.inf), starts)
+    gb = np.maximum.reduceat(np.where(occupied, b, -np.inf), starts)
+    ga = np.where(np.isfinite(ga), ga, 0.0)
+    gb = np.where(np.isfinite(gb), gb, 0.0)
+    gla, glb = mo.log_domain(ga, gb)
+
+    raw = np.zeros((u, k + 1))
+    raw[:, 0] = v[:, mo.IDX_COUNT]
+    raw[:, 1:] = v[:, mo.SUMS_OFF:mo.SUMS_OFF + k]
+    raw = mo.rebase_sums(raw, (a, b), (ga[seg], gb[seg]))
+    la, lb = mo.log_domain(a, b)
+    log = np.zeros((u, k + 1))
+    log[:, 0] = v[:, mo.IDX_LOGN]
+    log[:, 1:] = v[:, mo.SUMS_OFF + k:mo.SUMS_OFF + 2 * k]
+    log = mo.rebase_sums(log, (la, lb), (gla[seg], glb[seg]))
+    # a member with positive mass can join a group whose envelope
+    # touches zero: the group log domain is the invalid sentinel
+    # (glb < gla), the solver will never read the log block — zero it
+    # rather than rebase into a collapsed domain
+    log = np.where((glb > gla)[seg][:, None], log, 0.0)
+
+    # addable block: count, sum, rsum, logn, k raw sums, k log sums —
+    # padded to whole 128-lane rows for the kernel
+    add = np.concatenate([
+        v[:, [mo.IDX_COUNT, mo.IDX_SUM, mo.IDX_RSUM]],
+        raw[:, 1:], log[:, 0:1], log[:, 1:]], axis=1)
+    c_pad = max(128, (add.shape[1] + 127) // 128 * 128)
+    padded = np.zeros((u, c_pad), np.float32)
+    padded[:, :add.shape[1]] = add
+    sums = np.asarray(segment_sums(
+        jnp.asarray(padded), jnp.asarray(seg, np.int32), g), np.float64)
+
+    out = np.zeros((g, m))
+    out[:, mo.IDX_COUNT] = sums[:, 0]
+    out[:, mo.IDX_SUM] = sums[:, 1]
+    out[:, mo.IDX_RSUM] = sums[:, 2]
+    out[:, mo.SUMS_OFF:mo.SUMS_OFF + k] = sums[:, 3:3 + k]
+    out[:, mo.IDX_LOGN] = sums[:, 3 + k]
+    out[:, mo.SUMS_OFF + k:mo.SUMS_OFF + 2 * k] = \
+        sums[:, 4 + k:4 + 2 * k]
+    out[:, mo.IDX_MIN] = np.where(out[:, mo.IDX_COUNT] > 0, ga, np.inf)
+    out[:, mo.IDX_MAX] = np.where(out[:, mo.IDX_COUNT] > 0, gb, -np.inf)
+    return uniq, out, g

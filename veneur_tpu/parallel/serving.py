@@ -696,21 +696,107 @@ def resident_donation_ok() -> bool:
     return lane_donation_ok()
 
 
-@functools.lru_cache(maxsize=1)
+# One-shot measured staged-vs-resident probe state (ROADMAP #2
+# remainder: marginal links — tunnel-attached chips — pick the faster
+# assembly path empirically, not by backend name).  Module-level dict
+# rather than an lru_cache so /debug/vars can INSPECT the decision
+# without forcing a measurement (http_api.link_probe_stats).
+_LINK_PROBE: dict = {"measured": False, "probes": 0}
+_PROBE_ROWS = 256          # synthetic dense chunk: [rows, depth] f32
+_PROBE_DEPTH = 64
+_PROBE_CHUNKS = 4          # per-chunk dispatch is what the stream pays
+_PROBE_REPS = 3            # best-of timing after a compile warmup
+
+
+def _measure_link_probe() -> dict:
+    """Time the two ways a flush gets its dense matrix into device
+    memory: (a) RESIDENT — the interval's delta chunks scatter into a
+    device-born accumulator (per-chunk upload of slim COO arrays +
+    scatter dispatch); (b) STAGED — the host builds the dense matrix
+    and uploads it whole at flush time.  On a real accelerator the
+    staged path pays the full dense upload on the flush critical path,
+    so (a) wins; on PJRT:CPU "upload" is a memcpy and (a) is pure
+    scatter-dispatch overhead, so (b) wins — the measurement reproduces
+    the old backend-name heuristic where that heuristic was right, and
+    decides marginal links by data.  Small fixed shapes: one compile +
+    microseconds of steady-state per process, cached forever."""
+    import time
+
+    import numpy as np
+
+    rows = np.tile(np.arange(_PROBE_ROWS, dtype=np.int32),
+                   _PROBE_DEPTH // 4)
+    pos = np.repeat(np.arange(_PROBE_DEPTH // 4, dtype=np.int32),
+                    _PROBE_ROWS)
+    vals = np.linspace(0.0, 1.0, rows.size, dtype=np.float32)
+    dense_id = jnp.arange(_PROBE_ROWS, dtype=jnp.int32)
+
+    def resident_once():
+        dv = resident_dense_zeros((_PROBE_ROWS, _PROBE_DEPTH),
+                                  jnp.float32)
+        for _ in range(_PROBE_CHUNKS):
+            dv = resident_scatter_copy(
+                dv, dense_id, jnp.asarray(rows), jnp.asarray(pos),
+                jnp.asarray(vals))
+        return dv.block_until_ready()
+
+    def staged_once():
+        dense = np.zeros((_PROBE_ROWS, _PROBE_DEPTH), np.float32)
+        for _ in range(_PROBE_CHUNKS):
+            dense[rows, pos] = vals
+        return jax.device_put(dense).block_until_ready()
+
+    resident_once(), staged_once()     # compile/warm outside the clock
+    res_s = stg_s = float("inf")
+    for _ in range(_PROBE_REPS):
+        t0 = time.perf_counter()
+        resident_once()
+        res_s = min(res_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        staged_once()
+        stg_s = min(stg_s, time.perf_counter() - t0)
+    # device assembly must win CLEARLY: near-parity links keep the
+    # staged path (no compile-churn exposure for a wash)
+    return {"ok": res_s < 0.8 * stg_s,
+            "backend": jax.default_backend(),
+            "resident_us": round(res_s * 1e6, 1),
+            "staged_us": round(stg_s * 1e6, 1),
+            "forced": False}
+
+
 def resident_link_ok() -> bool:
-    """Whether this backend has a REAL host<->device link whose upload
-    cost the resident delta stream amortizes.  On PJRT:CPU "device"
-    buffers are host memory: streaming deltas moves no bytes off any
-    critical path, while the flush-time scatter assembly pays XLA:CPU's
-    serial scatter lowering — strictly worse than the host dense
-    builder.  So the digest/moments device-assembly half of
-    flush_resident_arenas auto-degrades to the staged (chunk-pipelined)
-    flush on CPU, exactly like lane_donation_ok routes CPU lane updates
-    through the copying kernels; the resident SET lanes (u8 scatter-max,
-    readback-on-checkpoint) stay active everywhere.  Tests force the
-    device-assembly path on CPU via the arenas'
+    """Whether this backend's host<->device link makes flush-time
+    device assembly (resident delta stream) faster than the staged
+    host-dense-build + upload — decided by a ONE-SHOT measured probe
+    (cached per process; `/debug/vars -> resident_link_probe`).
+    `VENEUR_TPU_RESIDENT_LINK=0|1` pins the answer without measuring
+    (hermetic CI cells).  When False, the digest/moments
+    device-assembly half of flush_resident_arenas degrades to the
+    staged (chunk-pipelined) flush; the resident SET lanes (u8
+    scatter-max, readback-on-checkpoint) stay active everywhere.
+    Tests force the device-assembly path via the arenas'
     resident_device_assembly override."""
-    return jax.default_backend() != "cpu"
+    if _LINK_PROBE["measured"]:
+        return _LINK_PROBE["ok"]
+    import os
+    forced = os.environ.get("VENEUR_TPU_RESIDENT_LINK")
+    if forced is not None and forced != "":
+        _LINK_PROBE.update(ok=forced not in ("0", "false", "no"),
+                           backend=jax.default_backend(),
+                           forced=True, measured=True)
+        _LINK_PROBE["probes"] += 1
+        return _LINK_PROBE["ok"]
+    _LINK_PROBE.update(_measure_link_probe())
+    _LINK_PROBE["measured"] = True
+    _LINK_PROBE["probes"] += 1
+    return _LINK_PROBE["ok"]
+
+
+def link_probe_stats() -> dict:
+    """The cached probe decision for /debug/vars — never forces a
+    measurement (`measured: false` until something consulted the
+    link)."""
+    return dict(_LINK_PROBE)
 
 
 @functools.partial(jax.jit, static_argnames=("shape", "dtype"))

@@ -661,6 +661,25 @@ class Proxy:
                 return 502, {"error": "no query_destinations mapping "
                              f"for mesh member {members[0].address}"}
             global_addrs = [http_addr]
+        elif spec["group_by"]:
+            # a group-by answer spans MANY keys: every cube group row
+            # has its own tag set and ring-routes independently, so
+            # the groups of one metric scatter across the whole ring
+            # — the proxy must ask every member and merge per group
+            # (single-key one-hop routing would silently drop every
+            # group the routed member does not own)
+            members = self.destinations.all_members()
+            if not members:
+                return 503, {"error": "no destinations"}
+            global_addrs = []
+            for m in members:
+                http_addr = self.cfg.query_destinations.get(m.address)
+                if http_addr is None:
+                    return 502, {"error": "no query_destinations "
+                                 "mapping for ring member "
+                                 f"{m.address}"}
+                if http_addr not in global_addrs:
+                    global_addrs.append(http_addr)
         else:
             kinds = ([spec["kind"]] if spec["kind"]
                      else ["histogram", "timer"])
@@ -692,6 +711,13 @@ class Proxy:
             params["tags"] = ",".join(spec["tags"])
         if spec["kind"]:
             params["type"] = spec["kind"]
+        if spec["group_by"]:
+            params["group_by"] = ",".join(sorted(spec["group_by"]))
+            if spec["by"]:
+                params["by"] = spec["by"]
+            # top= is NOT forwarded: per-member top-k would clip
+            # groups whose merged mass only clears the bar once every
+            # member's share lands — the cut happens after the merge
         encoded = uparse.urlencode(params)
 
         targets = ([("global", a) for a in global_addrs]
@@ -731,9 +757,23 @@ class Proxy:
         if not responses:
             return 502, {"error": "every upstream failed",
                          "upstreams": upstreams}
-        merged = qengine.merge_responses(responses, spec["qs"])
+        if spec["group_by"]:
+            merged = qengine.merge_group_responses(
+                responses, spec["qs"], top=spec["top"],
+                by=spec["by"])
+        else:
+            merged = qengine.merge_responses(responses, spec["qs"])
         merged["upstreams"] = upstreams
         merged["tier"] = "proxy"
+        if not spec.get("payload", True):
+            # payload=0: upstreams still ship their mergeable family
+            # payloads (the scatter-gather currency), but the CLIENT
+            # asked for quantiles only — strip before answering
+            merged["payload"] = None
+            for e in merged.get("groups") or []:
+                e["payload"] = None
+            if merged.get("other"):
+                merged["other"]["payload"] = None
         if local_addrs and len(responses) > 1:
             # `locals=` exists for LOCAL_ONLY-scope keys that never
             # forward; for mixed-scope keys the owning global already

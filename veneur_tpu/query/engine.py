@@ -90,6 +90,75 @@ def weighted_quantiles_np(vals: np.ndarray, wts: np.ndarray,
     return np.clip(out, d_min, d_max)
 
 
+def weighted_quantiles_np_batch(vals_list, wts_list, mins, maxs,
+                                qs) -> list:
+    """Batched ``weighted_quantiles_np`` over many point clouds: ONE
+    global stable lexsort + reduceat ranking instead of a python loop
+    of per-cloud sort pipelines — the group-by cube read's hot path
+    (hundreds of groups per query; the per-group numpy call overhead
+    dominates at that width).  Returns one array per cloud (None for
+    an empty cloud), matching the per-group twin to float rounding
+    (the cumulative weights rebase off a global cumsum, so the
+    addition order differs — ranks at an exact boundary may shift one
+    interpolation step, which moves the answer continuously)."""
+    qs = np.asarray(qs, np.float64)
+    n_g = len(vals_list)
+    out: list = [None] * n_g
+    vs, ws = [], []
+    sizes = np.zeros(n_g, np.int64)
+    for g in range(n_g):
+        w = np.asarray(wts_list[g], np.float64)
+        occ = w > 0
+        v = np.asarray(vals_list[g], np.float64)[occ]
+        vs.append(v)
+        ws.append(w[occ])
+        sizes[g] = len(v)
+    if not sizes.sum():
+        return out
+    v = np.concatenate(vs)
+    w = np.concatenate(ws)
+    seg = np.repeat(np.arange(n_g), sizes)
+    order = np.lexsort((v, seg))    # stable: by group, then value
+    v, w, seg = v[order], w[order], seg[order]
+    starts = np.zeros(n_g, np.int64)
+    starts[1:] = np.cumsum(sizes)[:-1]
+    cumg = np.cumsum(w)
+    base = np.where(starts > 0, cumg[starts - 1], 0.0)
+    base[sizes == 0] = 0.0
+    ends = starts + sizes
+    tot = np.where(sizes > 0, cumg[np.maximum(ends - 1, 0)] - base,
+                   0.0)
+    cmid = (cumg - base[seg]) - 0.5 * w
+
+    nz = np.flatnonzero(sizes > 0)
+    tq = tot[nz, None] * qs[None, :]            # [Gnz, Q]
+    # rank = per-group count of cmid strictly below the target
+    # (the searchsorted(side="left") twin), via one reduceat over the
+    # nonzero segments' starts
+    cmp = cmid[:, None] < tq[np.searchsorted(nz, seg), :]
+    idx = np.add.reduceat(cmp, starts[nz], axis=0)
+    ii = np.clip(idx, 1, np.maximum(sizes[nz, None] - 1, 1))
+    # single-point clouds land on ii=1 past their only point; clamp
+    # into the buffer (their answer is overwritten just below)
+    gi = np.minimum(starts[nz, None] + ii, len(v) - 1)
+    m_lo, m_hi = v[gi - 1], v[gi]
+    c_lo, c_hi = cmid[gi - 1], cmid[gi]
+    t = np.where(c_hi > c_lo,
+                 (tq - c_lo) / np.maximum(c_hi - c_lo, 1e-30), 0.0)
+    ans = m_lo + (m_hi - m_lo) * np.clip(t, 0.0, 1.0)
+    # single-point clouds answer their one value (the twin's
+    # special case); then clamp to each cloud's authoritative domain
+    one = sizes[nz] == 1
+    if one.any():
+        ans[one] = v[starts[nz][one], None]
+    mins = np.asarray(mins, np.float64)[nz, None]
+    maxs = np.asarray(maxs, np.float64)[nz, None]
+    ans = np.clip(ans, mins, maxs)
+    for j, g in enumerate(nz):
+        out[int(g)] = ans[j]
+    return out
+
+
 def _compress_payload(vals: np.ndarray, wts: np.ndarray,
                       compression: float) -> tuple[np.ndarray,
                                                    np.ndarray]:
@@ -148,8 +217,80 @@ def parse_query_params(q: dict) -> dict:
     kind = (q.get("type") or [None])[0]
     if kind is not None and kind not in ("histogram", "timer"):
         raise QueryError(400, "type= must be histogram or timer")
+    # group-by cube queries: ?group_by=tag1,tag2[&top=K&by=q99]
+    group_by = [t for t in (q.get("group_by") or [""])[0].split(",")
+                if t]
+    for t in group_by:
+        if ":" in t:
+            raise QueryError(400, f"group_by= takes tag NAMES, got "
+                             f"{t!r} (a tag:value filter belongs in "
+                             "tags=)")
+    top = None
+    if "top" in q:
+        if not group_by:
+            raise QueryError(400, "top= requires group_by=")
+        try:
+            top = int(q["top"][0])
+        except ValueError:
+            raise QueryError(400, "bad top=")
+        if top < 1:
+            raise QueryError(400, "top= must be >= 1")
+    by = (q.get("by") or [None])[0]
+    if by is not None and not group_by:
+        raise QueryError(400, "by= requires group_by=")
+    parse_rank_by(by)   # validate eagerly (raises QueryError(400))
+    # payload=0 answers quantiles/counts only — the dashboard read.
+    # Mergeable family payloads are the proxy's scatter-gather
+    # currency, not something every client wants on the wire (a
+    # group-by answer carries one payload PER GROUP)
+    pay = (q.get("payload") or ["1"])[0]
+    if pay not in ("0", "1", "true", "false"):
+        raise QueryError(400, "payload= must be 0 or 1")
     return {"name": name, "qs": qs, "window_s": window_s,
-            "slots": slots, "tags": tags, "kind": kind}
+            "slots": slots, "tags": tags, "kind": kind,
+            "group_by": group_by or None, "top": top, "by": by,
+            "payload": pay in ("1", "true")}
+
+
+def parse_rank_by(by: Optional[str]) -> tuple:
+    """``by=`` ranking mode -> ("count", None) or ("quantile", p).
+    ``q99`` / ``q99.9`` are percent forms; ``q0.99`` the fraction
+    form."""
+    if by in (None, "", "count"):
+        return "count", None
+    if isinstance(by, str) and by.startswith("q"):
+        try:
+            p = float(by[1:])
+        except ValueError:
+            raise QueryError(400, f"bad by={by!r} (count | q<pct>)")
+        if p >= 1.0:
+            p = p / 100.0
+        if not (_PCT_MIN < p < _PCT_MAX):
+            raise QueryError(400, f"by={by!r} quantile out of (0, 1)")
+        return "quantile", p
+    raise QueryError(400, f"bad by={by!r} (count | q<pct>)")
+
+
+def rank_groups(entries: list, mode: str, p: Optional[float],
+                seed: int, top: Optional[int]) -> list:
+    """Order group entries for the top-k answer: descending by the
+    ranking stat (count, or the ``by=`` quantile read from the entry's
+    evaluated quantiles), with the DETERMINISTIC seeded fnv1a rank of
+    the canonical group key as the tie-break — the same
+    identity-hash ordering the cube budget machinery uses, so equal
+    groups order identically on every tier."""
+    from veneur_tpu.samplers.metric_key import fnv1a_64
+    qkey = repr(float(p)) if mode == "quantile" else None
+
+    def stat(e):
+        if mode == "count":
+            return float(e.get("count") or 0.0)
+        v = (e.get("quantiles") or {}).get(qkey)
+        return float(v) if v is not None else float("-inf")
+
+    entries.sort(key=lambda e: (-stat(e),
+                                fnv1a_64(e["key"], seed), e["key"]))
+    return entries[:top] if top else entries
 
 
 class QueryEngine:
@@ -236,31 +377,19 @@ class QueryEngine:
 
     # -- the windowed read -----------------------------------------------
 
-    def query(self, name: str, tags: Optional[list] = None,
-              qs=(0.5,), window_s: Optional[float] = None,
-              slots: Optional[int] = None,
-              kind: Optional[str] = None,
-              payload: bool = True) -> dict:
-        """Fuse the ring slots covering the window and evaluate the
-        requested quantiles for one key.  A key absent from every
-        covered slot answers count=0 (not an error: absence of samples
-        is a legitimate windowed answer)."""
+    def _covering(self, window_s, slots, now) -> tuple:
+        """Both family rings' covering slots + CONSERVATIVELY merged
+        coverage metadata.  The two family rings rotate back to back
+        (not atomically); a read landing between the appends would see
+        one ring a cut ahead of the other, so the answer never claims
+        coverage one fused family lacks: fresh/partial only hold when
+        both hold, and the covered window is the intersection's
+        bounds."""
         rings = self.agg.query_rings
-        if rings is None:
-            raise QueryError(
-                404, "query plane disabled (query_window_slots: 0)")
-        jtags = ",".join(sorted(tags)) if tags else ""
-        now = time.time()
         td_slots, td_info = rings["tdigest"].covering(
             window_s=window_s, slots=slots, now=now)
         mo_slots, mo_info = rings["moments"].covering(
             window_s=window_s, slots=slots, now=now)
-        # the two family rings rotate back to back (not atomically);
-        # a read landing between the appends would see one ring a cut
-        # ahead of the other.  Coverage metadata merges CONSERVATIVELY
-        # over both so the answer never claims coverage one fused
-        # family lacks: fresh/partial only hold when both hold, and
-        # the covered window is the intersection's bounds
         info = dict(td_info)
         info["fresh"] = bool(td_info["fresh"] and mo_info["fresh"])
         info["partial"] = bool(td_info["partial"]
@@ -274,6 +403,32 @@ class QueryEngine:
             vals = [v for v in (td_info[k], mo_info[k])
                     if v is not None]
             info[k] = pick(vals) if vals else None
+        return td_slots, mo_slots, info
+
+    def query(self, name: str, tags: Optional[list] = None,
+              qs=(0.5,), window_s: Optional[float] = None,
+              slots: Optional[int] = None,
+              kind: Optional[str] = None,
+              payload: bool = True,
+              group_by: Optional[list] = None,
+              top: Optional[int] = None,
+              by: Optional[str] = None) -> dict:
+        """Fuse the ring slots covering the window and evaluate the
+        requested quantiles for one key.  A key absent from every
+        covered slot answers count=0 (not an error: absence of samples
+        is a legitimate windowed answer).  With ``group_by`` the read
+        answers per cube group instead (query_groups)."""
+        rings = self.agg.query_rings
+        if rings is None:
+            raise QueryError(
+                404, "query plane disabled (query_window_slots: 0)")
+        if group_by:
+            return self.query_groups(
+                name, group_by, qs=qs, window_s=window_s, slots=slots,
+                kind=kind, top=top, by=by, payload=payload)
+        jtags = ",".join(sorted(tags)) if tags else ""
+        now = time.time()
+        td_slots, mo_slots, info = self._covering(window_s, slots, now)
 
         td = self._fuse_tdigest(td_slots, name, jtags, kind)
         mo = self._fuse_moments(mo_slots, name, jtags, kind)
@@ -418,6 +573,261 @@ class QueryEngine:
                 "max": (float(vec[mo.IDX_MAX]) if cnt > 0 else None),
                 "eval": _eval, "payload": _payload}
 
+    # -- the group-by cube read ------------------------------------------
+
+    def query_groups(self, name: str, group_by: list, qs=(0.5,),
+                     window_s: Optional[float] = None,
+                     slots: Optional[int] = None,
+                     kind: Optional[str] = None,
+                     top: Optional[int] = None,
+                     by: Optional[str] = None,
+                     payload: bool = True) -> dict:
+        """Per-group windowed answer from the cube rows
+        (veneur_tpu/cubes/): resolve ``group_by`` against the
+        configured dimensions (an exact dimension answers directly; a
+        SUPERSET dimension answers via the segmented-reduce
+        coarsening), fuse each group's rows across the covered slots,
+        and rank for ``top=K&by=``.  The accounted overflow row rides
+        along as ``other`` so degraded mass stays visible."""
+        from veneur_tpu.cubes import cube as cb
+        cubes = getattr(self.agg, "cubes", None)
+        gb = sorted(set(group_by))
+        md = cb.match_dimension(cubes.dims if cubes else [], gb,
+                                name=name)
+        if md is None:
+            # no configured dimension covers the request (or no cube
+            # plane at all — a global tier can hold forwarded cube
+            # rows without local dimensions): serve whatever cube
+            # rows carry EXACTLY the requested tag names
+            dim, exact = cb.CubeDimension(gb), True
+        else:
+            dim, exact = md
+        seed = cubes.seed if cubes is not None else 0
+        mode, rank_p = parse_rank_by(by)
+        qarr = np.asarray(list(qs), np.float64)
+        qeval = list(qarr)
+        if mode == "quantile" and rank_p not in qeval:
+            qeval.append(rank_p)
+        qeval = np.asarray(qeval, np.float64)
+
+        now = time.time()
+        td_slots, mo_slots, info = self._covering(window_s, slots, now)
+        td_groups = self._fuse_group_clouds(td_slots, name, dim, kind)
+        mo_groups = self._fuse_group_vectors(mo_slots, name, dim, kind)
+        launch = 0
+        if not exact:
+            td_groups = self._coarsen_clouds(td_groups, gb)
+            mo_groups, launch = self._coarsen_vectors(
+                mo_groups, gb, seed)
+
+        from veneur_tpu.sketches import moments as mo
+        entries = []
+        td_pending = []        # (entry, v, w, min, max): ONE batch
+        mo_pending = []        # (entry, vector): solved in ONE batch
+        for gkey in set(td_groups) | set(mo_groups):
+            td_g = td_groups.get(gkey)
+            mo_v = mo_groups.get(gkey)
+            td_cnt = td_g["count"] if td_g else 0.0
+            mo_cnt = float(mo_v[mo.IDX_COUNT]) if mo_v is not None \
+                else 0.0
+            if td_cnt <= 0 and mo_cnt <= 0:
+                continue
+            e = {"key": gkey,
+                 "group": cb.group_of(gkey.split(",")),
+                 "mixed_families": bool(td_cnt > 0 and mo_cnt > 0),
+                 "quantiles": {}, "payload": None}
+            # per-group family pick: same larger-mass rule as the
+            # single-key read (families cannot merge exactly)
+            if td_cnt >= mo_cnt:
+                v = np.concatenate(td_g["v"]) if td_g["v"] else \
+                    np.zeros(0)
+                w = np.concatenate(td_g["w"]) if td_g["w"] else \
+                    np.zeros(0)
+                e.update(family="tdigest", count=td_cnt,
+                         sum=td_g["sum"], min=float(td_g["min"]),
+                         max=float(td_g["max"]))
+                td_pending.append((e, v, w, float(td_g["min"]),
+                                   float(td_g["max"])))
+                if payload:
+                    pv, pw = v, w
+                    if len(pv) > PAYLOAD_POINT_CAP:
+                        pv, pw = _compress_payload(
+                            pv, pw, self.agg.digests.compression)
+                    e["payload"] = {
+                        "family": "tdigest",
+                        "means": [float(x) for x in pv],
+                        "weights": [float(x) for x in pw],
+                        "min": float(td_g["min"]),
+                        "max": float(td_g["max"]),
+                        "count": td_cnt, "sum": td_g["sum"],
+                        "rsum": td_g["rsum"]}
+            else:
+                e.update(family="moments", count=mo_cnt,
+                         sum=float(mo_v[mo.IDX_SUM]),
+                         min=float(mo_v[mo.IDX_MIN]),
+                         max=float(mo_v[mo.IDX_MAX]))
+                mo_pending.append((e, mo_v))
+                if payload:
+                    e["payload"] = {"family": "moments",
+                                    "k": self.agg.moments.k,
+                                    "vector": [float(x) for x in mo_v]}
+            entries.append(e)
+
+        if td_pending:
+            # one batched rank-and-interpolate for every digest group
+            # (one global lexsort instead of G per-cloud sorts)
+            allq = weighted_quantiles_np_batch(
+                [p[1] for p in td_pending], [p[2] for p in td_pending],
+                [p[3] for p in td_pending], [p[4] for p in td_pending],
+                qeval)
+            for (e, *_), quants in zip(td_pending, allq):
+                if quants is not None:
+                    e["quantiles"] = {repr(float(p)): float(x)
+                                      for p, x in zip(qeval, quants)}
+        if mo_pending:
+            # one batched maxent solve for every moments group — the
+            # per-group eager path costs hundreds of ms per call
+            from veneur_tpu.ops import moments_eval as me
+            allq = me.quantiles_from_vectors(
+                np.stack([v for _, v in mo_pending]), qeval)
+            for (e, _), quants in zip(mo_pending, allq):
+                e["quantiles"] = {repr(float(p)): float(x)
+                                  for p, x in zip(qeval, quants)}
+
+        groups_total = len(entries)
+        entries = rank_groups(entries, mode, rank_p, seed, top)
+
+        # the dimension's accounted overflow row (budget degradation):
+        # fused like any single key, reported out loud next to the
+        # exact groups so windowed cube answers reconcile
+        ojtags = ",".join(sorted([cb.CUBE_TAG,
+                                  cb.DIM_TAG_PREFIX + dim.dim_id]))
+        otd = self._fuse_tdigest(td_slots, cb.OTHER_NAME, ojtags, kind)
+        omo = self._fuse_moments(mo_slots, cb.OTHER_NAME, ojtags, kind)
+        ofam = otd if otd["count"] >= omo["count"] else omo
+        other = None
+        if ofam["count"] > 0:
+            other = {"family": ofam["family"], "count": ofam["count"],
+                     "sum": ofam["sum"], "min": ofam["min"],
+                     "max": ofam["max"], "quantiles": {},
+                     "payload": (ofam["payload"]() if payload
+                                 else None)}
+            oq = ofam["eval"](qarr)
+            if oq is not None:
+                other["quantiles"] = {repr(float(p)): float(x)
+                                      for p, x in zip(qarr, oq)}
+
+        out = {
+            "name": name, "group_by": gb,
+            "dimension": list(dim.tags), "coarsened": not exact,
+            "tier": self.tier, "host": self.hostname,
+            "groups": entries, "groups_total": groups_total,
+            "other": other, "top": top, "by": by,
+            "cube_groups_per_launch": launch,
+            "staleness_ms": (
+                round((now - info["covered_to_unix"]) * 1e3, 3)
+                if info["covered_to_unix"] else None),
+        }
+        out.update(info)
+        return out
+
+    def _fuse_group_clouds(self, slots_list, name, dim, kind) -> dict:
+        """Digest-family cube fusion: canonical group key -> the fused
+        accumulators + point-cloud parts across the covered slots."""
+        groups: dict = {}
+        for slot in slots_list:
+            prt = slot.part
+            for pos, gkey, _ in slot.cube_positions(
+                    name, tuple(dim.tags), kind):
+                g = groups.get(gkey)
+                if g is None:
+                    g = groups[gkey] = {
+                        "count": 0.0, "sum": 0.0, "rsum": 0.0,
+                        "min": np.inf, "max": -np.inf,
+                        "v": [], "w": []}
+                g["min"] = min(g["min"], float(prt["d_min"][pos]))
+                g["max"] = max(g["max"], float(prt["d_max"][pos]))
+                g["count"] += float(prt["d_weight"][pos])
+                g["sum"] += float(prt["d_sum"][pos])
+                g["rsum"] += float(prt["d_rsum"][pos])
+                v, w = slot.points_for(prt["rows"][pos:pos + 1])
+                if len(v):
+                    g["v"].append(v)
+                    g["w"].append(w)
+        return groups
+
+    def _fuse_group_vectors(self, slots_list, name, dim, kind) -> dict:
+        """Moments-family cube fusion: ONE assemble_vectors walk per
+        slot covers every group row (memoized per slot), then groups
+        merge across slots by vector add."""
+        from veneur_tpu.sketches import moments as mo
+        marena = self.agg.moments
+        groups: dict = {}
+        for slot in slots_list:
+            hits = slot.cube_positions(name, tuple(dim.tags), kind)
+            if not hits:
+                continue
+
+            def _compute(slot=slot, hits=hits):
+                parr = np.asarray([p for p, _, _ in hits], np.int64)
+                sub = slot.staged_rows_for(slot.part["rows"][parr])
+                vecs = marena.assemble_vectors(slot.part, sub, parr)
+                return tuple(g for _, g, _ in hits), vecs
+            gkeys, vecs = slot.vector_memo(
+                ("\x00cube", name, tuple(dim.tags), kind), _compute)
+            for gkey, vec in zip(gkeys, vecs):
+                cur = groups.get(gkey)
+                groups[gkey] = (
+                    vec.copy() if cur is None
+                    else mo.merge_vectors(cur[None, :],
+                                          vec[None, :])[0])
+        return groups
+
+    @staticmethod
+    def _coarsen_clouds(groups: dict, keep: list) -> dict:
+        """Digest sub-cube roll-up: concatenate the fine groups' point
+        clouds under their projected coarse key (host — clouds are
+        already materialized lists)."""
+        from veneur_tpu.cubes import cube as cb
+        out: dict = {}
+        for gkey, g in groups.items():
+            ck = cb.project_group(gkey, keep)
+            c = out.get(ck)
+            if c is None:
+                out[ck] = g
+                continue
+            c["count"] += g["count"]
+            c["sum"] += g["sum"]
+            c["rsum"] += g["rsum"]
+            c["min"] = min(c["min"], g["min"])
+            c["max"] = max(c["max"], g["max"])
+            c["v"].extend(g["v"])
+            c["w"].extend(g["w"])
+        return out
+
+    @staticmethod
+    def _coarsen_vectors(groups: dict, keep: list, seed: int) -> tuple:
+        """Moments sub-cube roll-up on the segmented-reduce kernel:
+        the fine group vectors stack to ``[U, M]``, segment ids come
+        from the SORTED fnv1a hash column of the projected coarse
+        identities, and every coarse group reduces in one launch
+        (ops/segmented_reduce.py).  Returns (coarse groups,
+        groups_per_launch)."""
+        if not groups:
+            return {}, 0
+        from veneur_tpu.cubes import cube as cb
+        from veneur_tpu.ops.segmented_reduce import \
+            coarsen_moments_vectors
+        from veneur_tpu.samplers.metric_key import fnv1a_64
+        keys = sorted(groups)
+        cks = [cb.project_group(k, keep) for k in keys]
+        hs = np.array([fnv1a_64(c, seed) for c in cks], np.uint64)
+        vecs = np.stack([groups[k] for k in keys])
+        uniq, gvecs, launch = coarsen_moments_vectors(vecs, hs)
+        by_hash = {int(fnv1a_64(c, seed)): c for c in cks}
+        return ({by_hash[int(h)]: gvecs[i]
+                 for i, h in enumerate(uniq)}, launch)
+
 
 # -- cross-tier merge (the proxy's scatter-gather codec) -----------------
 
@@ -507,3 +917,85 @@ def merge_responses(responses: list[dict], qs,
                           "k": mo.k_from_len(len(mo_vec)),
                           "vector": [float(x) for x in mo_vec]}
     return out
+
+
+def merge_group_responses(responses: list[dict], qs,
+                          compression: float = 100.0,
+                          top: Optional[int] = None,
+                          by: Optional[str] = None) -> dict:
+    """Merge tier group-by /query answers: bucket every upstream's
+    group entries by canonical group key, run each bucket through the
+    same self-describing payload codec as the single-key merge
+    (merge_responses per group), then re-rank for ``top=K&by=`` over
+    the MERGED stats — top-k must apply after the merge, since a group
+    inside one tier's top-k can fall out of (or into) the global top-k
+    once the other tiers' mass lands.  The accounted ``other`` rows
+    merge the same way, and coverage metadata stays conservative."""
+    from veneur_tpu.cubes import cube as cb
+    mode, rank_p = parse_rank_by(by)
+    qeval = [float(x) for x in qs]
+    if mode == "quantile" and rank_p not in qeval:
+        qeval.append(rank_p)
+
+    def _pseudo(r, g):
+        return {"name": r.get("name", ""),
+                "payload": g.get("payload"),
+                "mixed_families": g.get("mixed_families"),
+                "slots_fused": r.get("slots_fused"),
+                "partial": r.get("partial"),
+                "fresh": r.get("fresh"),
+                "staleness_ms": r.get("staleness_ms")}
+
+    buckets: dict = {}
+    others: list[dict] = []
+    groups_total = 0
+    launch = 0
+    for r in responses:
+        groups_total += int(r.get("groups_total") or 0)
+        launch = max(launch, int(r.get("cube_groups_per_launch") or 0))
+        for g in r.get("groups") or ():
+            buckets.setdefault(g["key"], []).append(_pseudo(r, g))
+        if r.get("other"):
+            others.append(_pseudo(r, r["other"]))
+
+    entries = []
+    for gkey, pseudo in buckets.items():
+        m = merge_responses(pseudo, qeval, compression)
+        if m["count"] <= 0:
+            continue
+        entries.append({
+            "key": gkey, "group": cb.group_of(gkey.split(",")),
+            "family": m["family"], "count": m["count"],
+            "sum": m["sum"], "min": m["min"], "max": m["max"],
+            "quantiles": m["quantiles"], "payload": m["payload"],
+            "mixed_families": m["mixed_families"]})
+    # proxies rank with seed 0: the scatter-gather answer must not
+    # depend on which member's cube seed the proxy happens to know
+    entries = rank_groups(entries, mode, rank_p, 0, top)
+
+    other = None
+    if others:
+        m = merge_responses(others, qeval, compression)
+        if m["count"] > 0:
+            other = {"family": m["family"], "count": m["count"],
+                     "sum": m["sum"], "min": m["min"], "max": m["max"],
+                     "quantiles": m["quantiles"],
+                     "payload": m["payload"]}
+
+    first = responses[0] if responses else {}
+    return {
+        "name": first.get("name", ""),
+        "group_by": first.get("group_by") or [],
+        "coarsened": any(r.get("coarsened") for r in responses),
+        "groups": entries, "groups_total": groups_total,
+        "other": other, "top": top, "by": by,
+        "cube_groups_per_launch": launch,
+        "slots_fused": sum(r.get("slots_fused") or 0
+                           for r in responses),
+        "partial": any(r.get("partial") for r in responses),
+        "fresh": bool(responses) and all(r.get("fresh")
+                                         for r in responses),
+        "staleness_ms": max(
+            (r["staleness_ms"] for r in responses
+             if r.get("staleness_ms") is not None), default=None),
+    }

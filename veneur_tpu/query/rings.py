@@ -121,6 +121,61 @@ class WindowSlot:
             return tuple(p for p, _ in hits)
         return tuple(p for p, k in hits if k == kind)
 
+    def _name_hash_col(self) -> np.ndarray:
+        """The part's hash(name) column (or the lazily-built fallback
+        for parts predating it)."""
+        harr = self.part.get("name_hashes")
+        if harr is None:
+            harr = self._name_hash
+            if harr is None:
+                with self._lock:
+                    harr = self._name_hash
+                    if harr is None:
+                        names = self.part["names"]
+                        harr = np.fromiter(
+                            (hash(x) if x is not None else 0
+                             for x in names), np.int64, len(names))
+                        self._name_hash = harr
+        return harr
+
+    def cube_positions(self, name: str, dim_tags: tuple,
+                       kind: Optional[str] = None) -> tuple:
+        """Every CUBE row of (metric name, dimension) in this slot:
+        ``(position, joined-sorted-tags, kind)`` triples.  Cube rows
+        share the base metric's name, so the same one-compare
+        name-hash scan finds the candidates; the marker tag and the
+        group's tag-NAME set separate them from the base key and from
+        other dimensions' rows.  Memoized per slot like positions()."""
+        from veneur_tpu.cubes.cube import CUBE_TAG, DIM_TAG_PREFIX
+        mk = ("\x00cube", name, dim_tags)
+        hits = self._memo.get(mk)
+        if hits is None:
+            names = self.part["names"]
+            harr = self._name_hash_col()
+            cand = np.nonzero(harr == hash(name))[0] if len(names) \
+                else ()
+            tags = self.part["tags"]
+            kinds = self.part["kinds"]
+            want = set(dim_tags)
+            out = []
+            for pos in cand:
+                t = tags[pos]
+                if not t or names[pos] != name or CUBE_TAG not in t:
+                    continue
+                gnames = {x.partition(":")[0] for x in t
+                          if x != CUBE_TAG
+                          and not x.startswith(DIM_TAG_PREFIX)}
+                if gnames != want:
+                    continue
+                out.append((int(pos), ",".join(sorted(t)), kinds[pos]))
+            hits = tuple(out)
+            with self._lock:
+                if len(self._memo) < self._MEMO_CAP:
+                    self._memo[mk] = hits
+        if kind is None:
+            return hits
+        return tuple(h for h in hits if h[2] == kind)
+
     def _ensure_sorted(self):
         srt = self._sorted
         if srt is None:

@@ -49,7 +49,7 @@ from veneur_tpu import failpoints
 from veneur_tpu.core.cardinality import ROLLUP_TAG
 from veneur_tpu.testbed import verify
 from veneur_tpu.testbed.cluster import Cluster, ClusterSpec
-from veneur_tpu.testbed.traffic import StormGen, TrafficGen
+from veneur_tpu.testbed.traffic import CubeGen, StormGen, TrafficGen
 
 
 @dataclass(frozen=True)
@@ -103,6 +103,14 @@ TOPOLOGY_ARMS: list[ChaosArm] = [
              "conserved", {"op": "rolling-restart"}, kind="topology"),
     ChaosArm("cardinality-storm", "arena.evict", "", "conserved",
              {"op": "storm"}, kind="topology"),
+    # ISSUE 17: one tenant's group-by cube floods fresh groups past the
+    # per-dimension budget on every local — the exact-group set must
+    # stay bounded, every over-budget sample must surface in the
+    # dimension's accounted `veneur.cube.other` row (emission-checked
+    # at the locals, query-plane-checked through the proxy), and the
+    # pinned groups must conserve EXACTLY end to end.
+    ChaosArm("cube-storm", "cube.overflow", "", "conserved",
+             {"op": "cube-storm"}, kind="topology"),
 ]
 
 # hard-crash arms (ISSUE 10): a node dies with NO drain (simulated
@@ -232,6 +240,12 @@ def run_chaos_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                                           intervals=intervals,
                                           witness=witness,
                                           telemetry=telemetry)
+        if arm.kwargs.get("op") == "cube-storm":
+            return _run_cube_storm(arm, seed=seed,
+                                   n_locals=max(n_locals, 2),
+                                   intervals=max(intervals, 2),
+                                   witness=witness,
+                                   telemetry=telemetry)
         return _run_ring_arm(arm, seed=seed, n_locals=n_locals,
                              intervals=intervals,
                              counter_keys=counter_keys,
@@ -523,6 +537,94 @@ def _run_cardinality_storm(arm: ChaosArm, *, seed: int = 0,
         "rollup_tagged": tagged,
         "rollup_quantile_max_span_err": max_span_err,
         "rollup_quantiles_within_envelope": quantiles_ok,
+        "under_budget": under_budget,
+        "ok": ok,
+    }
+
+
+def _run_cube_storm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 2,
+                    intervals: int = 2, witness=None,
+                    telemetry=None) -> dict:
+    """Group-by cube under cardinality pressure: every interval sends
+    the pinned groups (which fill the dimension budget exactly) plus
+    FRESH over-budget groups on every local.  The exact-group set must
+    stay <= budget on every local, pinned groups must conserve EXACTLY
+    both at the local emission tier and through the proxy's group-by
+    scatter-gather, and the over-budget tail must surface — fully
+    accounted — in the dimension's `veneur.cube.other` row on both
+    planes, with per-group quantiles inside the committed envelope."""
+    gen = CubeGen(seed=seed)
+    spec = ClusterSpec(n_locals=n_locals, n_globals=2,
+                       forward_max_retries=2,
+                       forward_retry_backoff=0.02,
+                       breaker_failure_threshold=2,
+                       breaker_reset_timeout=0.4,
+                       discovery_interval_s=0.2,
+                       query_api=True,
+                       cube_dimensions=(gen.dimension(),),
+                       cube_group_budget=gen.budget,
+                       cube_seed=seed + 1,
+                       lock_witness=witness,
+                       telemetry=telemetry)
+    cluster = Cluster(spec)
+    glb: list[list[list]] = []
+    loc: list[list[list]] = []
+    try:
+        cluster.start()
+        for _ in range(intervals):
+            glb.append(cluster.run_interval(
+                gen.next_interval(n_locals)))
+            loc.append(cluster.drain_local_sinks())
+        # query plane through the proxy: scatter-gather over the ring
+        # (group rows route independently), merged per-group
+        resp = cluster.query_http(cluster.proxy_http_addr(),
+                                  name=gen.name,
+                                  group_by="region,endpoint",
+                                  q="0.5,0.99", slots=intervals)
+        acct = cluster.accounting()
+        cube_snaps = [n.server.aggregator.cubes.snapshot()
+                      for n in cluster.locals]
+    finally:
+        cluster.stop()
+
+    local_check = verify.check_cube_counts(gen, loc)
+    query_check = verify.check_cube_query(gen, resp, intervals,
+                                          percentiles=[0.5, 0.99])
+
+    # the defense's whole point: live exact-group cardinality stays
+    # bounded while fresh groups keep arriving — the tail degrades
+    # into the accounted other row, never into new arena rows
+    under_budget = all(s["groups"] <= gen.budget for s in cube_snaps)
+    overflowed = sum(s["overflowed"] for s in cube_snaps)
+    rollup_points = sum(s["rollup_points"] for s in cube_snaps)
+    # routing is gated by (name, tags): cube group rows share one
+    # metric NAME but ring-route independently by tags — scattering
+    # one name across the ring is the design, so the by-name check
+    # would legitimately fail here
+    routing = verify.check_routing(glb, per_epoch=True, by_tags=True)
+    conserved = bool(local_check["ok"] and query_check["ok"])
+    ok = (conserved and under_budget and overflowed > 0
+          and overflowed == gen.overflow and routing["exclusive"])
+    return {
+        "arm": arm.name,
+        "failpoint": arm.failpoint,
+        "action": "cube-storm",
+        "expect": arm.expect,
+        "fired": overflowed,
+        "conserved": conserved,
+        "counter_deficit": (float(gen.overflow)
+                            - local_check["got_other"]),
+        "dropped_total": acct["dropped_total"],
+        "forward_retries": acct["forward"]["retries"],
+        "forward_dropped": acct["forward"]["dropped"],
+        "routing_exclusive": routing["exclusive"],
+        "no_silent_loss": conserved or acct["dropped_total"] > 0,
+        "cube_groups_live": [s["groups"] for s in cube_snaps],
+        "cube_rollup_points": rollup_points,
+        "cube_overflowed": overflowed,
+        "local_emission_exact": local_check["ok"],
+        "query_plane_exact": query_check["ok"],
+        "query_envelope_ok": query_check["envelope_ok"],
         "under_budget": under_budget,
         "ok": ok,
     }

@@ -104,6 +104,12 @@ class ClusterSpec:
     sketch_family_default: str = "tdigest"
     sketch_moments_k: int = 8
     cardinality_rollup_family: str = "tdigest"
+    # group-by sketch cubes (veneur_tpu/cubes/) on EVERY tier: locals
+    # materialize the rollup rows at ingest and forward them as
+    # ordinary keys; globals just merge (imports never re-materialize)
+    cube_dimensions: tuple = ()
+    cube_group_budget: int = 0
+    cube_seed: int = 0
     # serve the operator /debug surface for local[0] (tests assert the
     # forward retry/drop counters are visible at /debug/vars)
     http_api: bool = False
@@ -241,6 +247,11 @@ class Cluster:
             sketch_family_default=spec.sketch_family_default,
             sketch_moments_k=spec.sketch_moments_k,
             cardinality_rollup_family=spec.cardinality_rollup_family,
+            cube_dimensions=[list(d) if not isinstance(d, dict)
+                             else dict(d)
+                             for d in spec.cube_dimensions],
+            cube_group_budget=spec.cube_group_budget,
+            cube_seed=spec.cube_seed,
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
             query_window_slots=spec.query_window_slots,
@@ -282,6 +293,11 @@ class Cluster:
             sketch_family_default=spec.sketch_family_default,
             sketch_moments_k=spec.sketch_moments_k,
             cardinality_rollup_family=spec.cardinality_rollup_family,
+            cube_dimensions=[list(d) if not isinstance(d, dict)
+                             else dict(d)
+                             for d in spec.cube_dimensions],
+            cube_group_budget=spec.cube_group_budget,
+            cube_seed=spec.cube_seed,
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
             spool_dir=spool_dir,

@@ -28,7 +28,7 @@ PROMISED_KEYS = [
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
     "routing_exclusive", "chaos_matrix", "lock_witness", "telemetry",
     "trace", "spool", "checkpoint", "egress", "sketch_families",
-    "query", "ok",
+    "query", "cube", "ok",
 ]
 
 # windowed probes fuse up to this many newest slots per query (each
@@ -50,6 +50,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                trace: bool = False,
                telemetry: bool = False,
                query: bool = False,
+               cubes: bool = False,
                procs: bool = False) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
     With `lock_witness`, every tier's named locks record runtime
@@ -83,6 +84,17 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     served/errors/p50_ms/p99_ms/staleness_ms/envelope_ok/staleness_ok
     and gates ok.
 
+    With `cubes=True` (the group-by analytics arm, ISSUE 17): two
+    CubeGens — one per sketch family — drive tag-grouped histogram
+    traffic with an exact per-group ledger past a deliberately tight
+    per-dimension group budget.  Every tier serves /query; each
+    interval times a proxy group-by scatter-gather probe, the run ends
+    with a full-window probe gated per group on exact counts AND the
+    family envelopes, local-tier emissions are checked for exact cube
+    conservation (pinned groups exact, over-budget tail accounted in
+    `veneur.cube.other` — never silent), and the report's `cube` key
+    carries groups/rollup_points/overflowed/query_p50_ms and gates ok.
+
     With `procs=True` the SAME story runs against the
     process-separated cluster (testbed/proccluster.py): every tier is
     its own OS process (globals meshed over real multi-process gloo
@@ -95,6 +107,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             raise ValueError(
                 "the query oracle arm runs in-process (check.py's "
                 "--query cell); drop --procs or drop --query")
+        if cubes:
+            raise ValueError(
+                "the cube analytics arm runs in-process (check.py's "
+                "--cubes cell); drop --procs or drop --cubes")
         return _run_proc_dryrun(
             n_locals=n_locals, n_globals=n_globals,
             intervals=intervals, seed=seed, interval_s=interval_s,
@@ -113,16 +129,33 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     if telemetry:
         from veneur_tpu.analysis.telemetry import TelemetryWitness
         telemetry_witness = TelemetryWitness()
+    cube_gens = []
+    if cubes:
+        from veneur_tpu.testbed.traffic import CubeGen
+        # one gen per sketch family; name-glob-gated dimensions keep
+        # each gen's group budget (and its other row) its own
+        # pin_samples=80 keeps the moments tenant's final-probe mass
+        # (pin_samples * intervals per group) inside the solver's
+        # committed regime even at the 2-interval default — 40/group
+        # is seed-marginal against the family q99 envelope
+        cube_gens = [CubeGen(seed=seed), CubeGen(seed=seed + 1,
+                                                 moments=True,
+                                                 pin_samples=80)]
     spec = ClusterSpec(n_locals=n_locals, n_globals=n_globals,
                        interval_s=interval_s, mesh_devices=mesh_devices,
                        percentiles=tuple(percentiles),
                        cardinality_key_budget=cardinality_key_budget,
                        sketch_family_rules=(
                            (TrafficGen.MOMENTS_RULE,)
-                           if moments_histo_keys else ()),
+                           if (moments_histo_keys or cubes) else ()),
+                       cube_dimensions=tuple(
+                           g.dimension() for g in cube_gens),
+                       cube_group_budget=(
+                           cube_gens[0].budget if cube_gens else 0),
+                       cube_seed=seed + 1,
                        lock_witness=witness,
                        telemetry=telemetry_witness,
-                       query_api=query)
+                       query_api=query or cubes)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples,
@@ -131,11 +164,16 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     per_interval: list[list[list]] = []
     per_interval_locals: list[list[list]] = []
     qstate = {"rows": [], "lat_ms": [], "errors": 0}
+    cstate = {"rows": [], "lat_ms": [], "errors": 0}
     try:
         cluster.start()
         for _ in range(intervals):
-            per_interval.append(cluster.run_interval(
-                traffic.next_interval(n_locals)))
+            lines = traffic.next_interval(n_locals)
+            for g in cube_gens:
+                extra = g.next_interval(n_locals)
+                for li, xl in zip(lines, extra):
+                    li.extend(xl)
+            per_interval.append(cluster.run_interval(lines))
             # the locals' own emissions (flush duality: mixed-scope
             # counts/aggregates surface HERE) feed the per-family
             # exact-count conservation check
@@ -145,10 +183,17 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                               len(per_interval) - 1,
                               list(percentiles), histo_keys,
                               moments_histo_keys, qstate)
+            if cubes:
+                _cube_probes(cluster, cube_gens,
+                             len(per_interval), list(percentiles),
+                             cstate,
+                             final=len(per_interval) == intervals)
         acct = cluster.accounting()
         trace_spans = cluster.collect_trace_spans()
         timeline_rows = [r for n in cluster.locals
                          for r in n.server.flush_timeline.snapshot()]
+        cube_snaps = ([n.server.aggregator.cubes.snapshot()
+                       for n in cluster.locals] if cubes else [])
     finally:
         cluster.stop()
 
@@ -158,7 +203,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                                        list(percentiles))
     histo_counts = verify.check_histo_counts(traffic.oracle,
                                              per_interval_locals)
-    routing = verify.check_routing(per_interval)
+    # cube group rows share one metric NAME but ring-route by tags, so
+    # the cubes cell checks exclusivity per (name, tags) — identical
+    # strength for the classic traffic (one tag set per name)
+    routing = verify.check_routing(per_interval, by_tags=cubes)
 
     from veneur_tpu.trace import assembly
     trace_report = assembly.flush_report(trace_spans)
@@ -213,6 +261,42 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                    and all(r.get("ok") for r in rows)),
         }
 
+    cube_report = None
+    if cubes:
+        local_checks = {
+            g.name: verify.check_cube_counts(g, per_interval_locals)
+            for g in cube_gens}
+        clat = sorted(cstate["lat_ms"])
+
+        def cpct(p: float) -> float | None:
+            if not clat:
+                return None
+            return round(clat[min(len(clat) - 1,
+                                  int(p * (len(clat) - 1) + 0.5))], 3)
+
+        cube_report = {
+            # live exact-group cardinality summed over the locals —
+            # bounded by budget*dims per local while the over-budget
+            # tail keeps arriving
+            "groups": sum(s["groups"] for s in cube_snaps),
+            "rollup_points": sum(s["rollup_points"]
+                                 for s in cube_snaps),
+            "overflowed": sum(s["overflowed"] for s in cube_snaps),
+            "query_p50_ms": cpct(0.5),
+            "query_p99_ms": cpct(0.99),
+            "served": len(cstate["rows"]),
+            "errors": cstate["errors"],
+            "local_conservation": {
+                name: {"ok": c["ok"], "got_other": c["got_other"]}
+                for name, c in local_checks.items()},
+            "failed": [r for r in cstate["rows"]
+                       if not r.get("ok")][:8],
+            "ok": (bool(cstate["rows"]) and cstate["errors"] == 0
+                   and all(r.get("ok") for r in cstate["rows"])
+                   and all(c["ok"] for c in local_checks.values())
+                   and sum(s["overflowed"] for s in cube_snaps) > 0),
+        }
+
     witness_cmp = None
     if witness is not None:
         from veneur_tpu.testbed.chaos import witness_comparison
@@ -232,7 +316,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
           and (not trace or trace_ok)
           and (witness_cmp is None or witness_cmp["ok"])
           and (telemetry_cmp is None or telemetry_cmp["ok"])
-          and (query_report is None or query_report["ok"]))
+          and (query_report is None or query_report["ok"])
+          and (cube_report is None or cube_report["ok"]))
     return {
         "spec": {
             "n_locals": n_locals, "n_globals": n_globals,
@@ -243,6 +328,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             "percentiles": list(percentiles),
             "cardinality_key_budget": cardinality_key_budget,
             "moments_histo_keys": moments_histo_keys,
+            "cubes": cubes,
         },
         "per_tier": {
             "local_flushes": acct["local_flushes"],
@@ -319,8 +405,73 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         # exact fused counts, per-family committed envelopes, and the
         # staleness contract (fresh answers).  None when not requested
         "query": query_report,
+        # group-by cube arm (cubes=True): live group cardinality /
+        # rollup mass / accounted overflow across the locals, plus the
+        # timed proxy scatter-gather group-by latency.  None when not
+        # requested
+        "cube": cube_report,
         "ok": ok,
     }
+
+
+def _cube_probes(cluster, cube_gens, k: int, percentiles: list,
+                 cstate: dict, final: bool = False) -> None:
+    """One interval's proxy group-by probes (see run_dryrun's `cubes`
+    docs).  `k` = intervals driven so far; a window of k slots covers
+    the whole run, so every probe is gated on the FULL exact ledger —
+    per-group counts, the accounted other row, conservation — plus a
+    ranked top-k-by-quantile probe whose head must stay within the
+    exact-group set.  The family quantile envelopes additionally gate
+    the FINAL probe (per-group sample mass is smallest early in the
+    run, below the moments solver's committed regime)."""
+    import time
+    env = verify.load_envelope()
+    qcsv = ",".join(repr(float(p)) for p in percentiles)
+    for gen in cube_gens:
+        gb = ",".join(gen.DIMENSION)
+        t0 = time.perf_counter()
+        try:
+            resp = cluster.query_http(cluster.proxy_http_addr(),
+                                      name=gen.name, group_by=gb,
+                                      q=qcsv, slots=k)
+        except Exception as e:  # noqa: BLE001 - counted, run continues
+            cstate["errors"] += 1
+            cstate["rows"].append({"name": gen.name, "ok": False,
+                                   "error": f"{type(e).__name__}: "
+                                            f"{e}"})
+            continue
+        cstate["lat_ms"].append((time.perf_counter() - t0) * 1e3)
+        row = verify.check_cube_query(
+            gen, resp, k,
+            percentiles=percentiles if final else None, env=env)
+        row["name"] = gen.name
+        row["tier"] = "proxy"
+        cstate["rows"].append(row)
+        # ranked head: top-2 by q99 through the same merge — the head
+        # must come from the exact-group set with the full group count
+        # still reported
+        t0 = time.perf_counter()
+        try:
+            tresp = cluster.query_http(cluster.proxy_http_addr(),
+                                       name=gen.name, group_by=gb,
+                                       q=qcsv, slots=k, top=2,
+                                       by="q99")
+        except Exception as e:  # noqa: BLE001
+            cstate["errors"] += 1
+            cstate["rows"].append({"name": gen.name, "kind": "topk",
+                                   "ok": False,
+                                   "error": f"{type(e).__name__}: "
+                                            f"{e}"})
+            continue
+        cstate["lat_ms"].append((time.perf_counter() - t0) * 1e3)
+        got = [g["key"] for g in tresp.get("groups") or ()]
+        cstate["rows"].append({
+            "name": gen.name, "kind": "topk", "tier": "proxy",
+            "ok": (len(got) == 2
+                   and all(kk in gen.group_counts for kk in got)
+                   and tresp.get("groups_total")
+                   == len(gen.group_counts)),
+        })
 
 
 def _query_probes(cluster, traffic, iv: int, percentiles: list,
@@ -561,5 +712,6 @@ def _run_proc_dryrun(*, n_locals: int, n_globals: int, intervals: int,
         "telemetry": telemetry_cmp,
         "trace": trace_report,
         "query": None,
+        "cube": None,
         "ok": ok,
     }

@@ -138,6 +138,106 @@ class TrafficGen:
         return lines
 
 
+class CubeGen:
+    """Group-by cube traffic for one histogram metric, with an exact
+    per-group ledger.
+
+    Per interval the generator emits, for every PINNED (region,
+    endpoint) group, `pin_samples` gamma samples — pinned groups arrive
+    first and touch hardest, so with `budget == len(pinned)` the cube's
+    seeded budget machinery keeps exactly these groups exact across
+    intervals — then `overflow_groups` FRESH per-interval endpoint
+    values with `overflow_samples` each, which are over-budget by
+    construction and must fold into the dimension's accounted
+    ``veneur.cube.other`` row.  The ledger is exact either way:
+
+      group_counts   canonical group key -> total samples (pinned)
+      overflow       total samples sent to over-budget groups
+      total          every sample of this metric
+
+    so a tier conserves iff each pinned group's cube `.count` equals
+    its ledger, the other-row count equals `overflow`, and the two
+    partitions sum to `total` — no silent loss.
+    """
+
+    DIMENSION = ("endpoint", "region")
+
+    def __init__(self, seed: int = 0, budget: int = 4,
+                 regions: int = 2, endpoints: int = 2,
+                 pin_samples: int = 40, overflow_groups: int = 3,
+                 overflow_samples: int = 2, moments: bool = False):
+        if regions * endpoints != budget:
+            raise ValueError("budget must equal regions*endpoints so "
+                             "the exact-group set is deterministic")
+        from veneur_tpu.cubes import CUBE_TAG, CubeDimension
+        self.rng = np.random.default_rng(seed)
+        self.name = (TrafficGen.MOMENTS_PREFIX + "cube" if moments
+                     else PREFIX + "hcube")
+        self.family = "moments" if moments else "tdigest"
+        self.budget = budget
+        self.pin_samples = pin_samples
+        self.overflow_groups = overflow_groups
+        self.overflow_samples = overflow_samples
+        # name-gated dimension: several gens can share one cluster
+        # without their groups contending for one budget (each gen's
+        # dimension — and so its exact set AND its other row — is its
+        # own)
+        self.match = self.name + "*"
+        self.dim_id = CubeDimension(self.DIMENSION, self.match).dim_id
+        self.interval = 0
+        self.pinned = [(f"r{r}", f"/e{e}")
+                       for r in range(regions)
+                       for e in range(endpoints)]
+        self.group_counts: dict[str, int] = {
+            ",".join(sorted([f"endpoint:{ep}", f"region:{rg}",
+                             CUBE_TAG])): 0
+            for rg, ep in self.pinned}
+        self.group_vals: dict[str, list] = {
+            k: [] for k in self.group_counts}
+        self.overflow = 0
+        self.total = 0
+
+    def dimension(self) -> dict:
+        """This gen's `cube_dimensions` entry for ClusterSpec."""
+        return {"tags": list(self.DIMENSION), "match": self.match}
+
+    @staticmethod
+    def _gkey(rg: str, ep: str) -> str:
+        from veneur_tpu.cubes import CUBE_TAG
+        return ",".join(sorted([f"endpoint:{ep}", f"region:{rg}",
+                                CUBE_TAG]))
+
+    def next_interval(self, n_locals: int) -> list[list[bytes]]:
+        iv = self.interval
+        self.interval += 1
+        lines: list[list[bytes]] = [[] for _ in range(n_locals)]
+        # pinned groups first: the budget fills with exactly these
+        for gi, (rg, ep) in enumerate(self.pinned):
+            vals = self.rng.gamma(2.0, 10.0, self.pin_samples)
+            gkey = self._gkey(rg, ep)
+            for j, v in enumerate(vals):
+                lines[(gi + j) % n_locals].append(
+                    f"{self.name}:{v:.6f}|h|#region:{rg},endpoint:{ep}"
+                    .encode())
+                self.group_counts[gkey] += 1
+                self.group_vals[gkey].append(float(v))
+                self.total += 1
+        # fresh over-budget groups: endpoint values never seen before,
+        # touched far less than any pinned group, so the seeded budget
+        # keeps them OUT of the exact set — their mass must surface in
+        # the accounted other row
+        for k in range(self.overflow_groups):
+            ep = f"/ov{iv}_{k}"
+            vals = self.rng.gamma(2.0, 10.0, self.overflow_samples)
+            for j, v in enumerate(vals):
+                lines[(k + j) % n_locals].append(
+                    f"{self.name}:{v:.6f}|h|#region:r0,endpoint:{ep}"
+                    .encode())
+                self.overflow += 1
+                self.total += 1
+        return lines
+
+
 class StormGen:
     """Cardinality-storm traffic for one abusive tenant, with an oracle
     that knows EXACTLY what should fold into the rollups.

@@ -250,12 +250,119 @@ def check_window_answer(oracle: Oracle, name: str,
                        and resp.get("fresh"))}
 
 
+def check_cube_counts(gen, per_interval: list[list[list]]) -> dict:
+    """Exact cube conservation at one tier against a CubeGen ledger:
+    every pinned group's cube-row `.count` emissions (summed over
+    nodes and intervals) equal the ledger exactly, the dimension's
+    ``veneur.cube.other`` row carries exactly the over-budget mass,
+    no group OUTSIDE the pinned set surfaces as exact, and the two
+    partitions sum to every sample sent — degradation is accounted,
+    never silent."""
+    from veneur_tpu.cubes import CUBE_TAG, DIM_TAG_PREFIX, OTHER_NAME
+    got_groups: dict[str, float] = {}
+    got_other = 0.0
+    for interval in per_interval:
+        for node in interval:
+            for m in node:
+                if not m.name.endswith(".count"):
+                    continue
+                tags = m.tags or []
+                if CUBE_TAG not in tags:
+                    continue
+                base = m.name[: -len(".count")]
+                if base == gen.name:
+                    gkey = ",".join(sorted(tags))
+                    got_groups[gkey] = \
+                        got_groups.get(gkey, 0.0) + m.value
+                elif (base == OTHER_NAME
+                        and DIM_TAG_PREFIX + gen.dim_id in tags):
+                    got_other += m.value
+    mismatched = [(k, want, got_groups.get(k, 0.0))
+                  for k, want in gen.group_counts.items()
+                  if got_groups.get(k, 0.0) != float(want)]
+    unexpected = sorted(set(got_groups) - set(gen.group_counts))
+    other_exact = got_other == float(gen.overflow)
+    conserved = (sum(got_groups.values()) + got_other
+                 == float(gen.total))
+    return {"exact": not mismatched and not unexpected,
+            "groups": len(gen.group_counts),
+            "mismatched": mismatched[:8],
+            "unexpected_groups": unexpected[:8],
+            "other_exact": other_exact,
+            "want_other": float(gen.overflow),
+            "got_other": got_other,
+            "conserved": conserved,
+            "ok": bool(not mismatched and not unexpected
+                       and other_exact and conserved)}
+
+
+def check_cube_query(gen, resp: dict, slots: int,
+                     percentiles: list[float] | None = None,
+                     env: dict | None = None) -> dict:
+    """Gate one group-by /query answer (global direct or proxy
+    scatter-gather) against the CubeGen ledger: every pinned group's
+    fused count equals `pin_samples * slots` EXACTLY, the ``other``
+    entry carries exactly the covered overflow mass, nothing outside
+    the pinned set appears, and the partitions reconcile.  With
+    `percentiles` (valid only when the query covers the WHOLE run,
+    slots == gen.interval), each group's quantiles are additionally
+    gated on the family envelope against exact numpy quantiles of the
+    ledger's raw per-group values."""
+    want_group = float(gen.pin_samples * slots)
+    got = {g["key"]: g["count"] for g in resp.get("groups") or ()}
+    mismatched = [(k, want_group, got.get(k, 0.0))
+                  for k in gen.group_counts
+                  if got.get(k, 0.0) != want_group]
+    unexpected = sorted(set(got) - set(gen.group_counts))
+    want_other = float(gen.overflow_groups * gen.overflow_samples
+                       * slots)
+    other = resp.get("other") or {}
+    got_other = float(other.get("count") or 0.0)
+    conserved = (sum(got.values()) + got_other
+                 == want_group * len(gen.group_counts) + want_other)
+    envelope_ok = True
+    if percentiles:
+        if slots != gen.interval:
+            raise ValueError(
+                "percentile gating needs the query to cover the whole "
+                f"run (slots={slots}, intervals={gen.interval})")
+        env = env or load_envelope()
+        for g in resp.get("groups") or ():
+            vals = gen.group_vals.get(g["key"])
+            if not vals:
+                continue
+            arr = np.asarray(vals, np.float64)
+            span = float(arr.max() - arr.min()) or 1.0
+            for q in percentiles:
+                emitted = (g.get("quantiles") or {}).get(
+                    repr(float(q)))
+                if emitted is None:
+                    envelope_ok = False
+                    continue
+                exact = float(np.quantile(arr, q, method="hazen"))
+                err = abs(emitted - exact) / span
+                if err > envelope_for(q, env, gen.family):
+                    envelope_ok = False
+    return {"groups": len(gen.group_counts),
+            "mismatched": mismatched[:8],
+            "unexpected_groups": unexpected[:8],
+            "want_other": want_other, "got_other": got_other,
+            "other_exact": got_other == want_other,
+            "conserved": conserved, "envelope_ok": envelope_ok,
+            "ok": bool(not mismatched and not unexpected
+                       and got_other == want_other and conserved
+                       and envelope_ok)}
+
+
 def check_routing(per_interval: list[list[list]],
-                  per_epoch: bool = False) -> dict:
+                  per_epoch: bool = False,
+                  by_tags: bool = False) -> dict:
     """Consistent-hash invariant: each metric key surfaces on exactly
     one global.  With per_epoch=True the check is per interval (a chaos
     arm that kills a destination legitimately remaps keys across ring
-    epochs)."""
+    epochs).  With by_tags=True the routed key includes the tag set —
+    the right invariant for cube traffic, where group rows share one
+    metric NAME but ring-route independently by tags."""
     conflicts = []
 
     def base_key(name: str) -> str:
@@ -270,11 +377,13 @@ def check_routing(per_interval: list[list[list]],
         return name
 
     def scan(intervals) -> None:
-        owner: dict[str, int] = {}
+        owner: dict = {}
         for interval in intervals:
             for gi, g in enumerate(interval):
                 for m in _filter(g):
                     k = base_key(m.name)
+                    if by_tags:
+                        k = (k, ",".join(sorted(m.tags or [])))
                     if owner.setdefault(k, gi) != gi:
                         conflicts.append((k, owner[k], gi))
 
