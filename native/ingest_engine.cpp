@@ -14,12 +14,19 @@
 //
 // Layout:
 //   * Engine        — intern table (sharded), thread buffers, reader threads
+//   * tokenizer     — delimiter scan: memchr (scalar) or one SSE2/AVX2
+//                     wide-compare pass per datagram (runtime-selected)
 //   * parse_line    — DogStatsD metric lines (events/service checks and
 //                     anything malformed are punted/counted; the Python
 //                     parser remains the semantic reference)
 //   * metro64       — MetroHash64 (public domain algorithm, J. A. Mettes) so
 //                     set members land on the same HLL registers as
 //                     axiomhq/hyperloglog (wire + register interop)
+//   * SPSC rings    — per-reader staging handoff; a drain tick pops
+//                     published batches lock-free and never stalls a
+//                     reader mid-burst (only the rare intern-GC quiesces)
+//   * receive       — recvmmsg loop, or io_uring multishot receive where
+//                     the kernel/seccomp profile permits (runtime-probed)
 //   * drain ABI     — consolidation into contiguous arrays for ctypes
 //   * vn_blast_udp  — sendmmsg packet generator for the ingest benchmark
 //
@@ -44,9 +51,67 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <poll.h>
+#include <pthread.h>
+#include <sched.h>
 #include <sys/socket.h>
 #include <sys/types.h>
 #include <unistd.h>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+// io_uring multishot-receive backend: raw syscalls against the uapi
+// header (no liburing in the image).  Multishot recv + provided buffer
+// rings need kernel >= 6.0 at RUNTIME (probed; seccomp-blocked or old
+// kernels fall back to recvmmsg), and the uapi header in the image may
+// predate them — those constants/structs are ABI-frozen, so the missing
+// ones are self-defined below rather than compiled out.
+#if defined(__linux__) && __has_include(<linux/io_uring.h>)
+#include <linux/io_uring.h>
+#if __has_include(<linux/time_types.h>)
+#include <linux/time_types.h>
+#endif
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <csignal>
+#if defined(IOSQE_BUFFER_SELECT) && defined(IORING_FEAT_EXT_ARG) && \
+    defined(IORING_ENTER_EXT_ARG) && defined(IORING_CQE_F_MORE)
+#define VN_HAVE_IOURING 1
+// uapi additions newer than the image's header (values are kernel ABI)
+#ifndef IORING_RECV_MULTISHOT
+#define IORING_RECV_MULTISHOT (1U << 1)  // sqe->ioprio flag, 6.0+
+#endif
+#ifndef IORING_REGISTER_PBUF_RING
+#define IORING_REGISTER_PBUF_RING 22     // 5.19+
+#define IORING_UNREGISTER_PBUF_RING 23
+struct io_uring_buf {
+  __u64 addr;
+  __u32 len;
+  __u16 bid;
+  __u16 resv;
+};
+struct io_uring_buf_ring {
+  union {
+    struct {
+      __u64 resv1;
+      __u32 resv2;
+      __u16 resv3;
+      __u16 tail;
+    };
+    struct io_uring_buf bufs[0];
+  };
+};
+struct io_uring_buf_reg {
+  __u64 ring_addr;
+  __u32 ring_entries;
+  __u16 bgid;
+  __u16 flags;
+  __u64 resv[3];
+};
+#endif  // IORING_REGISTER_PBUF_RING
+#endif
+#endif
 
 namespace {
 
@@ -115,23 +180,242 @@ static uint64_t metro64(const uint8_t* ptr, size_t len, uint64_t seed) {
   return h;
 }
 
-// Intern-key hash (internal only; any good 64-bit mix works).
-static inline uint64_t hash_bytes(const char* p, size_t n) {
-  uint64_t h = 1469598103934665603ull ^ (n * 0x9E3779B97F4A7C15ull);
-  while (n >= 8) {
-    uint64_t k;
-    memcpy(&k, p, 8);
-    h = (h ^ k) * 0x9E3779B97F4A7C15ull;
-    h ^= h >> 29;
-    p += 8;
-    n -= 8;
-  }
-  uint64_t k = 0;
-  if (n) memcpy(&k, p, n);
-  h = (h ^ k) * 0x9E3779B97F4A7C15ull;
-  h ^= h >> 32;
+// ---------------------------------------------------------------------------
+// Intern-key hash (internal only): lane-structured so it vectorizes.
+//
+// Four independent u64 lanes consume 32-byte blocks with add/rotate/xor
+// only — SSE2 has no 64-bit multiply, so all multiplicative diffusion is
+// deferred to the scalar finalizer.  The scalar, SSE2 and AVX2 bodies
+// compute the IDENTICAL function: an engine resolves ONE mode at
+// creation, but identities hashed under different modes (parity tests,
+// a fleet mid-rollout of a simd override) must intern to the same shard
+// and thread-cache slot, so mode must never be observable in the value.
+// ---------------------------------------------------------------------------
+
+static const uint64_t kKH0 = 0x9E3779B97F4A7C15ull;  // golden-ratio odd mixers
+static const uint64_t kKH1 = 0xC2B2AE3D27D4EB4Full;
+static const uint64_t kKH2 = 0x165667B19E3779F9ull;
+static const uint64_t kKH3 = 0x27D4EB2F165667C5ull;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t kh_finish(uint64_t l0, uint64_t l1, uint64_t l2,
+                                 uint64_t l3, size_t n) {
+  uint64_t h = (uint64_t)n * kKH0;
+  h = (h ^ l0) * kKH1; h ^= h >> 29;
+  h = (h ^ l1) * kKH2; h ^= h >> 31;
+  h = (h ^ l2) * kKH3; h ^= h >> 30;
+  h = (h ^ l3) * kKH0; h ^= h >> 32;
+  h *= kKH1;
+  h ^= h >> 29;
   return h;
 }
+
+// One block step per lane; the trailing partial block is zero-padded
+// (length is folded into the finalizer, so padding cannot alias).
+static inline void kh_lane(uint64_t& l, uint64_t x) {
+  l += x;
+  l ^= rotl64(l, 13);
+  l += rotl64(l, 31);
+}
+
+static uint64_t key_hash_scalar(const char* p, size_t n) {
+  uint64_t l0 = kKH0, l1 = kKH1, l2 = kKH2, l3 = kKH3;
+  const uint8_t* q = (const uint8_t*)p;
+  size_t nb = n / 32;
+  for (size_t b = 0; b < nb; b++, q += 32) {
+    kh_lane(l0, rd64(q));
+    kh_lane(l1, rd64(q + 8));
+    kh_lane(l2, rd64(q + 16));
+    kh_lane(l3, rd64(q + 24));
+  }
+  if (n % 32) {
+    uint8_t tail[32] = {0};
+    memcpy(tail, q, n % 32);
+    kh_lane(l0, rd64(tail));
+    kh_lane(l1, rd64(tail + 8));
+    kh_lane(l2, rd64(tail + 16));
+    kh_lane(l3, rd64(tail + 24));
+  }
+  return kh_finish(l0, l1, l2, l3, n);
+}
+
+#if defined(__x86_64__)
+
+static inline __m128i kh_rot128(__m128i v, int r) {
+  return _mm_or_si128(_mm_slli_epi64(v, r), _mm_srli_epi64(v, 64 - r));
+}
+
+static inline void kh_lane128(__m128i& l, __m128i x) {
+  l = _mm_add_epi64(l, x);
+  l = _mm_xor_si128(l, kh_rot128(l, 13));
+  l = _mm_add_epi64(l, kh_rot128(l, 31));
+}
+
+static uint64_t key_hash_sse2(const char* p, size_t n) {
+  __m128i a = _mm_set_epi64x((long long)kKH1, (long long)kKH0);  // l1:l0
+  __m128i b = _mm_set_epi64x((long long)kKH3, (long long)kKH2);  // l3:l2
+  const uint8_t* q = (const uint8_t*)p;
+  size_t nb = n / 32;
+  for (size_t blk = 0; blk < nb; blk++, q += 32) {
+    kh_lane128(a, _mm_loadu_si128((const __m128i*)q));
+    kh_lane128(b, _mm_loadu_si128((const __m128i*)(q + 16)));
+  }
+  if (n % 32) {
+    uint8_t tail[32] = {0};
+    memcpy(tail, q, n % 32);
+    kh_lane128(a, _mm_loadu_si128((const __m128i*)tail));
+    kh_lane128(b, _mm_loadu_si128((const __m128i*)(tail + 16)));
+  }
+  uint64_t l0 = (uint64_t)_mm_cvtsi128_si64(a);
+  uint64_t l1 = (uint64_t)_mm_cvtsi128_si64(_mm_srli_si128(a, 8));
+  uint64_t l2 = (uint64_t)_mm_cvtsi128_si64(b);
+  uint64_t l3 = (uint64_t)_mm_cvtsi128_si64(_mm_srli_si128(b, 8));
+  return kh_finish(l0, l1, l2, l3, n);
+}
+
+__attribute__((target("avx2")))
+static inline __m256i kh_step256(__m256i l, const uint8_t* src) {
+  __m256i x = _mm256_loadu_si256((const __m256i*)src);
+  l = _mm256_add_epi64(l, x);
+  __m256i r13 = _mm256_or_si256(_mm256_slli_epi64(l, 13),
+                                _mm256_srli_epi64(l, 51));
+  l = _mm256_xor_si256(l, r13);
+  __m256i r31 = _mm256_or_si256(_mm256_slli_epi64(l, 31),
+                                _mm256_srli_epi64(l, 33));
+  return _mm256_add_epi64(l, r31);
+}
+
+__attribute__((target("avx2")))
+static uint64_t key_hash_avx2(const char* p, size_t n) {
+  __m256i l = _mm256_set_epi64x((long long)kKH3, (long long)kKH2,
+                                (long long)kKH1, (long long)kKH0);
+  const uint8_t* q = (const uint8_t*)p;
+  size_t nb = n / 32;
+  for (size_t blk = 0; blk < nb; blk++, q += 32) l = kh_step256(l, q);
+  if (n % 32) {
+    uint8_t tail[32] = {0};
+    memcpy(tail, q, n % 32);
+    l = kh_step256(l, tail);
+  }
+  alignas(32) uint64_t lanes[4];
+  _mm256_store_si256((__m256i*)lanes, l);
+  return kh_finish(lanes[0], lanes[1], lanes[2], lanes[3], n);
+}
+
+#endif  // __x86_64__
+
+typedef uint64_t (*key_hash_fn)(const char*, size_t);
+
+// ---------------------------------------------------------------------------
+// Vectorized DogStatsD tokenizer
+// ---------------------------------------------------------------------------
+//
+// One wide-compare pass per datagram records the positions of the three
+// structural delimiters the parser queries ('\n' line split, ':' name/
+// value split, '|' chunk split) into per-class sorted arrays; the parser
+// then consumes positions through monotone cursors instead of re-running
+// memchr over the same bytes.  The ',' tag split and '#'/'@' chunk leads
+// stay byte-compares in the parser: ',' is only walked on an intern MISS
+// (cold), and the leads are single-byte tests.
+
+struct TokenIndex {
+  std::vector<uint32_t> nl, co, pi;  // '\n', ':', '|' positions (ascending)
+  size_t inl = 0, ico = 0, ipi = 0;  // per-class cursors
+
+  void reset() {
+    nl.clear(); co.clear(); pi.clear();
+    inl = ico = ipi = 0;
+  }
+};
+
+typedef void (*scan_tokens_fn)(const uint8_t*, size_t, TokenIndex&);
+
+static inline void scan_byte(uint8_t c, uint32_t i, TokenIndex& ti) {
+  if (c == '\n') ti.nl.push_back(i);
+  else if (c == ':') ti.co.push_back(i);
+  else if (c == '|') ti.pi.push_back(i);
+}
+
+// Scalar twin of the SIMD scanners (parity reference + non-x86 hosts).
+static void scan_tokens_scalar(const uint8_t* p, size_t n, TokenIndex& ti) {
+  for (size_t i = 0; i < n; i++) scan_byte(p[i], (uint32_t)i, ti);
+}
+
+#if defined(__x86_64__)
+
+static void scan_tokens_sse2(const uint8_t* p, size_t n, TokenIndex& ti) {
+  const __m128i vnl = _mm_set1_epi8('\n');
+  const __m128i vco = _mm_set1_epi8(':');
+  const __m128i vpi = _mm_set1_epi8('|');
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m128i x = _mm_loadu_si128((const __m128i*)(p + i));
+    uint32_t mnl = (uint32_t)_mm_movemask_epi8(_mm_cmpeq_epi8(x, vnl));
+    uint32_t mco = (uint32_t)_mm_movemask_epi8(_mm_cmpeq_epi8(x, vco));
+    uint32_t mpi = (uint32_t)_mm_movemask_epi8(_mm_cmpeq_epi8(x, vpi));
+    while (mnl) { ti.nl.push_back((uint32_t)(i + __builtin_ctz(mnl))); mnl &= mnl - 1; }
+    while (mco) { ti.co.push_back((uint32_t)(i + __builtin_ctz(mco))); mco &= mco - 1; }
+    while (mpi) { ti.pi.push_back((uint32_t)(i + __builtin_ctz(mpi))); mpi &= mpi - 1; }
+  }
+  for (; i < n; i++) scan_byte(p[i], (uint32_t)i, ti);
+}
+
+__attribute__((target("avx2")))
+static void scan_tokens_avx2(const uint8_t* p, size_t n, TokenIndex& ti) {
+  const __m256i vnl = _mm256_set1_epi8('\n');
+  const __m256i vco = _mm256_set1_epi8(':');
+  const __m256i vpi = _mm256_set1_epi8('|');
+  size_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i x = _mm256_loadu_si256((const __m256i*)(p + i));
+    uint32_t mnl = (uint32_t)_mm256_movemask_epi8(_mm256_cmpeq_epi8(x, vnl));
+    uint32_t mco = (uint32_t)_mm256_movemask_epi8(_mm256_cmpeq_epi8(x, vco));
+    uint32_t mpi = (uint32_t)_mm256_movemask_epi8(_mm256_cmpeq_epi8(x, vpi));
+    while (mnl) { ti.nl.push_back((uint32_t)(i + __builtin_ctz(mnl))); mnl &= mnl - 1; }
+    while (mco) { ti.co.push_back((uint32_t)(i + __builtin_ctz(mco))); mco &= mco - 1; }
+    while (mpi) { ti.pi.push_back((uint32_t)(i + __builtin_ctz(mpi))); mpi &= mpi - 1; }
+  }
+  for (; i < n; i++) scan_byte(p[i], (uint32_t)i, ti);
+}
+
+#endif  // __x86_64__
+
+// Token sources: parse_line/ingest_datagram are templated over one of
+// these, so the scalar (memchr) and SIMD (index) tokenizers drive the
+// SAME parser body — byte-equivalence reduces to boundary equivalence,
+// which the fuzz corpus asserts end to end.
+struct MemchrTok {
+  const char* find(const char* from, const char* to, char c) {
+    return (const char*)memchr(from, c, (size_t)(to - from));
+  }
+};
+
+struct IndexTok {
+  const char* base;
+  TokenIndex* ti;
+
+  const char* find(const char* from, const char* to, char c) {
+    std::vector<uint32_t>* a;
+    size_t* cur;
+    if (c == '|') { a = &ti->pi; cur = &ti->ipi; }
+    else if (c == ':') { a = &ti->co; cur = &ti->ico; }
+    else { a = &ti->nl; cur = &ti->inl; }
+    uint32_t f = (uint32_t)(from - base);
+    uint32_t t = (uint32_t)(to - base);
+    size_t i = *cur;
+    // queries are monotone in `from` along a datagram (the parser only
+    // moves forward); a backwards query would mean a skipped candidate,
+    // so rewind by binary search if one ever appears (defensive)
+    if (i > 0 && i <= a->size() && (*a)[i - 1] >= f)
+      i = (size_t)(std::lower_bound(a->begin(), a->end(), f) - a->begin());
+    while (i < a->size() && (*a)[i] < f) i++;
+    *cur = i;
+    return (i < a->size() && (*a)[i] < t) ? base + (*a)[i] : nullptr;
+  }
+};
 
 // ---------------------------------------------------------------------------
 // Stage accounting clock
@@ -296,10 +580,66 @@ struct Batch {
   }
 };
 
+// ---------------------------------------------------------------------------
+// SPSC staging ring
+// ---------------------------------------------------------------------------
+//
+// Each producer thread publishes finished batches into its own
+// single-producer/single-consumer ring; the drainer pops them without
+// ever blocking the producer.  Single-consumer holds because drains are
+// serialized under Engine::drain_mu; single-producer holds because a
+// thread id has one feeding thread (same-tid misuse degrades to the
+// owner-token spin below, never to a data race).
+
+struct BatchRing {
+  std::vector<Batch> slots;
+  size_t mask;
+  alignas(64) std::atomic<uint64_t> head{0};  // consumer cursor
+  alignas(64) std::atomic<uint64_t> tail{0};  // producer cursor
+
+  explicit BatchRing(size_t n) : slots(n), mask(n - 1) {}
+
+  bool try_push(Batch& b) {
+    uint64_t t = tail.load(std::memory_order_relaxed);
+    if (t - head.load(std::memory_order_acquire) >= slots.size())
+      return false;
+    slots[t & mask] = std::move(b);
+    b = Batch();  // move leaves POD counters behind; reset wholesale
+    tail.store(t + 1, std::memory_order_release);
+    return true;
+  }
+
+  bool try_pop(Batch& out) {
+    uint64_t h = head.load(std::memory_order_relaxed);
+    if (h == tail.load(std::memory_order_acquire)) return false;
+    out = std::move(slots[h & mask]);
+    head.store(h + 1, std::memory_order_release);
+    return true;
+  }
+};
+
+// Receive backends a reader thread can resolve to (reported at
+// /debug/vars -> ingest_stages.readers).
+enum VnBackend {
+  VN_BACKEND_NONE = 0,      // not a UDP reader (vn_ingest-fed thread)
+  VN_BACKEND_RECVMMSG = 1,
+  VN_BACKEND_IOURING = 2,
+};
+
+// owner-token states for ThreadBuf::owner
+enum { OWN_FREE = 0, OWN_PRODUCER = 1, OWN_DRAINER = 2 };
+
 struct ThreadBuf {
-  std::mutex mu;
+  BatchRing ring;
+  // private to whoever holds `owner`; non-empty outside a producer
+  // critical section only while the ring is full (backpressure), in
+  // which case the drainer steals it with the owner token
   Batch cur;
+  alignas(64) std::atomic<uint32_t> owner{OWN_FREE};
+  std::atomic<int> backend{VN_BACKEND_NONE};
   StageCounters stages;
+
+  explicit ThreadBuf(size_t ring_slots) : ring(ring_slots) {}
 };
 
 struct InternSlot {
@@ -331,6 +671,67 @@ struct InternShard {
 
 static const int NSHARDS = 16;
 
+// tuning knob resolution (vn_engine_opt; Python routes config values here)
+enum VnSimd {
+  VN_SIMD_AUTO = 0,
+  VN_SIMD_SCALAR = 1,
+  VN_SIMD_SSE2 = 2,
+  VN_SIMD_AVX2 = 3,
+};
+
+static const int kDefaultBatch = 64;        // recv burst size (packets)
+static const int kMaxBatch = 1024;
+static const int kDefaultRingSlots = 1024;  // SPSC slots per reader
+static const int kMaxRingSlots = 65536;
+
+static size_t round_pow2(size_t v, size_t lo, size_t hi) {
+  size_t p = lo;
+  while (p < v && p < hi) p <<= 1;
+  return p;
+}
+
+static bool simd_supported(int mode) {
+  switch (mode) {
+    case VN_SIMD_SCALAR: return true;
+#if defined(__x86_64__)
+    case VN_SIMD_SSE2: return true;  // x86_64 baseline
+    case VN_SIMD_AVX2: return __builtin_cpu_supports("avx2") != 0;
+#endif
+    default: return false;
+  }
+}
+
+static int resolve_simd(int requested) {
+  if (requested != VN_SIMD_AUTO && simd_supported(requested))
+    return requested;
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("avx2")) return VN_SIMD_AVX2;
+  return VN_SIMD_SSE2;
+#else
+  return VN_SIMD_SCALAR;
+#endif
+}
+
+static scan_tokens_fn scan_fn_for(int mode) {
+  switch (mode) {
+#if defined(__x86_64__)
+    case VN_SIMD_SSE2: return scan_tokens_sse2;
+    case VN_SIMD_AVX2: return scan_tokens_avx2;
+#endif
+    default: return nullptr;  // scalar: parser memchrs directly, no index
+  }
+}
+
+static key_hash_fn hash_fn_for(int mode) {
+  switch (mode) {
+#if defined(__x86_64__)
+    case VN_SIMD_SSE2: return key_hash_sse2;
+    case VN_SIMD_AVX2: return key_hash_avx2;
+#endif
+    default: return key_hash_scalar;
+  }
+}
+
 struct Engine {
   int max_packet;
   // implicit tags (tagging.ExtendTags): pre-sorted tag strings + the key
@@ -351,6 +752,21 @@ struct Engine {
   std::atomic<bool> stop{false};
   std::vector<std::thread> readers;
 
+  // knobs (vn_engine_opt, set before threads exist) + resolved dispatch
+  int opt_simd = VN_SIMD_AUTO;
+  int opt_backend = VN_BACKEND_NONE;  // NONE == auto-probe
+  int opt_batch = kDefaultBatch;
+  int opt_ring_slots = kDefaultRingSlots;
+  int simd_mode = VN_SIMD_SCALAR;
+  scan_tokens_fn scan_fn = nullptr;
+  key_hash_fn hash_fn = key_hash_scalar;
+
+  // set for the duration of an intern-clearing drain; producers back off
+  // at burst boundaries so the GC's owner-token claim makes progress
+  std::atomic<bool> gc_active{false};
+  // serializes drains: the SPSC rings have exactly one consumer at a time
+  std::mutex drain_mu;
+
   // cumulative totals, updated at drain (for the benchmark / self-metrics)
   std::atomic<uint64_t> tot_processed{0}, tot_malformed{0}, tot_packets{0},
       tot_too_long{0};
@@ -368,9 +784,15 @@ struct Engine {
     return (double)(n1 - cal_ns0) / (double)(t1 - cal_ticks0);
   }
 
+  void resolve_dispatch() {
+    simd_mode = resolve_simd(opt_simd);
+    scan_fn = scan_fn_for(simd_mode);
+    hash_fn = hash_fn_for(simd_mode);
+  }
+
   int new_thread() {
     std::lock_guard<std::mutex> l(bufs_mu);
-    bufs.emplace_back(new ThreadBuf());
+    bufs.emplace_back(new ThreadBuf((size_t)opt_ring_slots));
     return (int)bufs.size() - 1;
   }
 
@@ -382,9 +804,54 @@ struct Engine {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Producer protocol
+// ---------------------------------------------------------------------------
+//
+// A producer claims its thread buffer with an owner-token CAS for the
+// span of one burst (parse + publish), backing off while an intern-GC
+// is pending.  A normal drain never takes this token from a running
+// producer — it only steals `cur` when the token is FREE — so a drain
+// tick cannot stall a reader mid-burst; only the rare intern-clearing
+// drain waits for every producer to reach a burst boundary.
+
+static inline void cpu_pause() {
+#if defined(__x86_64__)
+  _mm_pause();
+#endif
+}
+
+static void producer_acquire(Engine* e, ThreadBuf* tb) {
+  int spins = 0;
+  for (;;) {
+    if (!e->gc_active.load(std::memory_order_acquire)) {
+      uint32_t exp = OWN_FREE;
+      if (tb->owner.compare_exchange_weak(exp, OWN_PRODUCER,
+                                          std::memory_order_acquire,
+                                          std::memory_order_relaxed))
+        return;
+    }
+    if (++spins < 64) cpu_pause();
+    else std::this_thread::yield();
+  }
+}
+
+static inline void producer_release(ThreadBuf* tb) {
+  tb->owner.store(OWN_FREE, std::memory_order_release);
+}
+
+// Publish the producer's private batch into its ring.  On a full ring the
+// batch simply stays in `cur` (accumulating across bursts) until a drain
+// frees slots or steals it — the producer never blocks on the drainer.
+static inline void publish(ThreadBuf* tb) {
+  if (tb->cur.packets == 0) return;
+  tb->ring.try_push(tb->cur);
+}
+
 struct ThreadScratch {
   std::string key;                 // composite intern key
   std::vector<std::string> tags;   // canonicalization scratch
+  TokenIndex tokens;               // per-datagram delimiter index (SIMD path)
   // direct-mapped per-thread intern cache: most lines repeat a recent
   // identity, so the common case skips the shard mutex + probe entirely.
   // Entries are invalidated wholesale by the engine's intern generation
@@ -502,7 +969,7 @@ static uint32_t intern(Engine* e, ThreadScratch& sc, const char* name,
   key.append(name, nlen);
   key.push_back((char)('0' + mt));
   if (has_tags) key.append(raw_tags, rtlen);
-  uint64_t h = hash_bytes(key.data(), key.size());
+  uint64_t h = e->hash_fn(key.data(), key.size());
   uint32_t gen = e->intern_gen.load(std::memory_order_relaxed);
   auto& ce = sc.cache[h & (ThreadScratch::kCacheSlots - 1)];
   if (ce.engine == e->nonce && ce.gen == gen && ce.h == h
@@ -548,12 +1015,52 @@ static uint32_t intern(Engine* e, ThreadScratch& sc, const char* name,
   return id;
 }
 
+// Fast path for the overwhelmingly common value shapes [-]ddd[.ddd]:
+// with <= 15 digits both the integer mantissa and the power of ten are
+// exactly representable, so the single divide is correctly rounded —
+// the same result the strtod in strict_double produces.  Anything else
+// (exponents, long digit runs, inf/nan spellings, and the characters
+// strict_double rejects outright) falls back.
+static inline bool parse_value(const char* p, size_t n, double* out) {
+  static const double kPow10[16] = {1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6,
+                                    1e7, 1e8, 1e9, 1e10, 1e11, 1e12,
+                                    1e13, 1e14, 1e15};
+  if (n == 0 || n > 16) return strict_double(p, n, out);
+  const char* q = p;
+  const char* end = p + n;
+  bool neg = (*q == '-');
+  if (neg) q++;
+  uint64_t mant = 0;
+  int digs = 0, frac = 0;
+  bool dot = false;
+  for (; q < end; q++) {
+    char c = *q;
+    if (c >= '0' && c <= '9') {
+      mant = mant * 10 + (uint64_t)(c - '0');
+      digs++;
+      if (dot) frac++;
+    } else if (c == '.' && !dot) {
+      dot = true;
+    } else {
+      return strict_double(p, n, out);
+    }
+  }
+  if (digs == 0 || digs > 15) return strict_double(p, n, out);
+  double v = (double)mant;
+  if (frac) v /= kPow10[frac];
+  *out = neg ? -v : v;
+  return true;
+}
+
 // Parse one DogStatsD metric line into the batch.  Mirrors
 // Parser.parse_metric (veneur_tpu/samplers/parser.py, itself mirroring
 // parser.go:349-503) — including the partial-emit semantics of multi-value
-// packets (values before a malformed one are kept).
+// packets (values before a malformed one are kept).  Templated over the
+// token source (MemchrTok scalar / IndexTok SIMD) so both tokenizers
+// drive one parser body.
+template <class Tok>
 static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
-                       Batch& b) {
+                       Batch& b, Tok& tok) {
   if (n == 0) return;
   if (p[0] == '_' && n >= 3 &&
       (memcmp(p, "_e{", 3) == 0 || memcmp(p, "_sc", 3) == 0)) {
@@ -562,9 +1069,9 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
     return;
   }
   const char* end = p + n;
-  const char* type_pipe = (const char*)memchr(p, '|', n);
+  const char* type_pipe = tok.find(p, end, '|');
   if (!type_pipe) { b.malformed++; return; }
-  const char* colon = (const char*)memchr(p, ':', type_pipe - p);
+  const char* colon = tok.find(p, type_pipe, ':');
   if (!colon) { b.malformed++; return; }
   size_t name_len = colon - p;
   if (name_len == 0) { b.malformed++; return; }
@@ -572,7 +1079,7 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
   const char* val_end = type_pipe;
 
   const char* rest = type_pipe + 1;
-  const char* tags_pipe = (const char*)memchr(rest, '|', end - rest);
+  const char* tags_pipe = tok.find(rest, end, '|');
   const char* type_end = tags_pipe ? tags_pipe : end;
   if (type_end == rest) { b.malformed++; return; }
   uint8_t mt;
@@ -591,7 +1098,7 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
   size_t raw_tags_len = 0;
   const char* cur = type_end;
   while (cur < end) {
-    const char* nxt = (const char*)memchr(cur + 1, '|', end - cur - 1);
+    const char* nxt = tok.find(cur + 1, end, '|');
     const char* cend = nxt ? nxt : end;
     const char* chunk = cur + 1;
     size_t clen = cend - chunk;
@@ -635,7 +1142,7 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
   } stage_timed(sc, b);
   const char* v = val_begin;
   for (;;) {
-    const char* vc = (const char*)memchr(v, ':', val_end - v);
+    const char* vc = tok.find(v, val_end, ':');
     const char* ve = vc ? vc : val_end;
     if (mt == MT_SET) {
       b.s_ids.push_back(id);
@@ -643,7 +1150,7 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
       b.processed++;
     } else {
       double x;
-      if (!strict_double(v, ve - v, &x) || !std::isfinite(x)) {
+      if (!parse_value(v, ve - v, &x) || !std::isfinite(x)) {
         b.malformed++;
         return;  // earlier values stay staged (parser.py multi-value loop)
       }
@@ -669,8 +1176,9 @@ static void parse_line(Engine* e, ThreadScratch& sc, const char* p, size_t n,
   }
 }
 
-static void ingest_datagram(Engine* e, ThreadScratch& sc, const char* data,
-                            size_t len, Batch& b) {
+template <class Tok>
+static void ingest_datagram_t(Engine* e, ThreadScratch& sc, const char* data,
+                              size_t len, Batch& b, Tok& tok) {
   // count BEFORE the length guard: the Python path tallies proto_received
   // on receipt, then drops oversized datagrams (server.py _read_udp ->
   // process_packet_buffer), and received_per_protocol_total must agree
@@ -683,31 +1191,51 @@ static void ingest_datagram(Engine* e, ThreadScratch& sc, const char* data,
   const char* p = data;
   const char* end = data + len;
   while (p < end) {
-    const char* nl = (const char*)memchr(p, '\n', end - p);
+    const char* nl = tok.find(p, end, '\n');
     const char* le = nl ? nl : end;
-    if (le > p) parse_line(e, sc, p, le - p, b);
+    if (le > p) parse_line(e, sc, p, le - p, b, tok);
     if (!nl) break;
     p = nl + 1;
   }
 }
 
-// UDP reader loop: poll(100ms) + recvmmsg bursts, parsing under the thread
-// buffer lock (one acquisition per burst).  The multi-reader SO_REUSEPORT
-// fan-out is composed Python-side by attaching one reader per socket
-// (networking.go:54-107 equivalent).
-static void reader_loop(Engine* e, int fd, ThreadBuf* tb) {
-  constexpr int VLEN = 64;
+static void ingest_datagram(Engine* e, ThreadScratch& sc, const char* data,
+                            size_t len, Batch& b) {
+  if (e->scan_fn && (int)len <= e->max_packet) {
+    // SIMD path: one wide-compare pass builds the delimiter index; the
+    // parser consumes positions instead of re-scanning bytes
+    sc.tokens.reset();
+    e->scan_fn((const uint8_t*)data, len, sc.tokens);
+    IndexTok tok{data, &sc.tokens};
+    ingest_datagram_t(e, sc, data, len, b, tok);
+  } else {
+    MemchrTok tok;
+    ingest_datagram_t(e, sc, data, len, b, tok);
+  }
+}
+
+// UDP reader loops.  The multi-reader SO_REUSEPORT fan-out is composed
+// Python-side by attaching one reader per socket (networking.go:54-107
+// equivalent); each reader owns one ThreadBuf and parses a whole burst
+// under one producer-token acquisition, then publishes into its SPSC
+// ring so a drain tick never blocks it.
+
+// recvmmsg backend: poll(100ms) + recvmmsg bursts.  Portable fallback —
+// works on any Linux and under restrictive seccomp profiles.
+static void reader_loop_recvmmsg(Engine* e, int fd, ThreadBuf* tb) {
+  const int vlen = e->opt_batch;
   ThreadScratch sc;
   size_t bufsz = (size_t)e->max_packet + 1;
-  std::vector<char> store(bufsz * VLEN);
-  std::vector<iovec> iov(VLEN);
-  std::vector<mmsghdr> msgs(VLEN);
-  for (int i = 0; i < VLEN; i++) {
-    iov[i] = {store.data() + i * bufsz, bufsz};
+  std::vector<char> store(bufsz * (size_t)vlen);
+  std::vector<iovec> iov(vlen);
+  std::vector<mmsghdr> msgs(vlen);
+  for (int i = 0; i < vlen; i++) {
+    iov[i] = {store.data() + (size_t)i * bufsz, bufsz};
     memset(&msgs[i], 0, sizeof(mmsghdr));
     msgs[i].msg_hdr.msg_iov = &iov[i];
     msgs[i].msg_hdr.msg_iovlen = 1;
   }
+  tb->backend.store(VN_BACKEND_RECVMMSG, std::memory_order_relaxed);
   StageCounters& st = tb->stages;
   while (!e->stop.load(std::memory_order_relaxed)) {
     uint64_t recv_t0 = tick_now();
@@ -720,7 +1248,7 @@ static void reader_loop(Engine* e, int fd, ThreadBuf* tb) {
                               std::memory_order_relaxed);
       continue;
     }
-    int r = recvmmsg(fd, msgs.data(), VLEN, MSG_DONTWAIT, nullptr);
+    int r = recvmmsg(fd, msgs.data(), vlen, MSG_DONTWAIT, nullptr);
     if (r <= 0) {
       if (r < 0 && (errno == EAGAIN || errno == EINTR)) continue;
       return;
@@ -729,14 +1257,274 @@ static void reader_loop(Engine* e, int fd, ThreadBuf* tb) {
                             std::memory_order_relaxed);
     st.recv_pkts.fetch_add((uint64_t)r, std::memory_order_relaxed);
     uint64_t parse_t0 = tick_now();
-    {
-      std::lock_guard<std::mutex> l(tb->mu);
-      for (int i = 0; i < r; i++)
-        ingest_datagram(e, sc, (const char*)iov[i].iov_base,
-                        msgs[i].msg_len, tb->cur);
-    }
+    producer_acquire(e, tb);
+    for (int i = 0; i < r; i++)
+      ingest_datagram(e, sc, (const char*)iov[i].iov_base, msgs[i].msg_len,
+                      tb->cur);
+    publish(tb);
+    producer_release(tb);
     account_burst(st, sc, (uint64_t)r, ticks_since(parse_t0));
   }
+}
+
+#ifdef VN_HAVE_IOURING
+
+// io_uring multishot-receive backend: one armed IORING_OP_RECV with
+// IORING_RECV_MULTISHOT keeps posting a CQE per datagram into a provided
+// buffer ring — zero syscalls on the receive path while buffers last.
+// Raw syscalls (no liburing in the image); every setup step can fail on
+// older kernels or seccomp, in which case the caller falls back to
+// recvmmsg.
+struct UringRx {
+  int ring_fd = -1;
+  int sock_fd = -1;
+  void* sq_ptr = nullptr;
+  size_t sq_len = 0;
+  void* cq_ptr = nullptr;
+  size_t cq_len = 0;
+  io_uring_sqe* sqes = nullptr;
+  size_t sqes_len = 0;
+  io_uring_buf_ring* br = nullptr;
+  size_t br_len = 0;
+  std::vector<char> pktmem;
+  size_t bufsz = 0;
+  unsigned nbufs = 0;
+
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  unsigned short br_tail = 0;
+
+  ~UringRx() { destroy(); }
+
+  void destroy() {
+    if (br) {
+      if (ring_fd >= 0) {
+        io_uring_buf_reg reg{};
+        reg.bgid = 0;
+        syscall(__NR_io_uring_register, ring_fd, IORING_UNREGISTER_PBUF_RING,
+                &reg, 1);
+      }
+      munmap(br, br_len);
+      br = nullptr;
+    }
+    if (sqes) munmap(sqes, sqes_len), sqes = nullptr;
+    if (cq_ptr && cq_ptr != sq_ptr) munmap(cq_ptr, cq_len);
+    cq_ptr = nullptr;
+    if (sq_ptr) munmap(sq_ptr, sq_len), sq_ptr = nullptr;
+    if (ring_fd >= 0) close(ring_fd), ring_fd = -1;
+  }
+
+  const char* buf_at(unsigned bid) const {
+    return pktmem.data() + (size_t)bid * bufsz;
+  }
+
+  // Return a consumed buffer to the kernel's provided-buffer ring.
+  void recycle(unsigned bid) {
+    io_uring_buf* b = &br->bufs[br_tail & (nbufs - 1)];
+    b->addr = (__u64)(uintptr_t)buf_at(bid);
+    b->len = (__u32)bufsz;
+    b->bid = (__u16)bid;
+    br_tail++;
+  }
+  void recycle_commit() {
+    __atomic_store_n(&br->tail, br_tail, __ATOMIC_RELEASE);
+  }
+
+  // Push + submit one multishot recv SQE.  The kernel re-posts CQEs off
+  // this single submission until it runs out of buffers or errors.
+  bool arm() {
+    unsigned t = *sq_tail;
+    unsigned idx = t & *sq_mask;
+    io_uring_sqe* s = &sqes[idx];
+    memset(s, 0, sizeof(*s));
+    s->opcode = IORING_OP_RECV;
+    s->fd = sock_fd;
+    s->ioprio = IORING_RECV_MULTISHOT;
+    s->flags = IOSQE_BUFFER_SELECT;
+    s->buf_group = 0;
+    sq_array[idx] = idx;
+    __atomic_store_n(sq_tail, t + 1, __ATOMIC_RELEASE);
+    int r =
+        (int)syscall(__NR_io_uring_enter, ring_fd, 1, 0, 0u, nullptr, 0);
+    return r >= 0;
+  }
+
+  // Block for >= 1 CQE with a timeout so the reader can notice stop.
+  // Returns false on fatal enter() failure.
+  bool wait(long timeout_ms) {
+    struct __kernel_timespec ts {};
+    ts.tv_nsec = timeout_ms * 1000000L;
+    io_uring_getevents_arg arg{};
+    arg.ts = (__u64)(uintptr_t)&ts;
+    arg.sigmask_sz = _NSIG / 8;
+    int r = (int)syscall(__NR_io_uring_enter, ring_fd, 0, 1,
+                         IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG, &arg,
+                         sizeof(arg));
+    return r >= 0 || errno == ETIME || errno == EINTR;
+  }
+
+  bool init(int fd, size_t bufsz_, unsigned nbufs_) {
+    sock_fd = fd;
+    bufsz = bufsz_;
+    nbufs = nbufs_;  // caller guarantees a power of two
+    io_uring_params p{};
+    p.flags = IORING_SETUP_CQSIZE;
+    p.cq_entries = nbufs * 2;
+    ring_fd = (int)syscall(__NR_io_uring_setup, 8, &p);
+    if (ring_fd < 0) return false;
+    if (!(p.features & IORING_FEAT_EXT_ARG)) return false;
+    sq_len = p.sq_off.array + p.sq_entries * sizeof(__u32);
+    cq_len = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    if (p.features & IORING_FEAT_SINGLE_MMAP)
+      sq_len = cq_len = std::max(sq_len, cq_len);
+    sq_ptr = mmap(nullptr, sq_len, PROT_READ | PROT_WRITE,
+                  MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_SQ_RING);
+    if (sq_ptr == MAP_FAILED) return sq_ptr = nullptr, false;
+    if (p.features & IORING_FEAT_SINGLE_MMAP) {
+      cq_ptr = sq_ptr;
+    } else {
+      cq_ptr = mmap(nullptr, cq_len, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_CQ_RING);
+      if (cq_ptr == MAP_FAILED) return cq_ptr = nullptr, false;
+    }
+    sqes_len = p.sq_entries * sizeof(io_uring_sqe);
+    sqes = (io_uring_sqe*)mmap(nullptr, sqes_len, PROT_READ | PROT_WRITE,
+                               MAP_SHARED | MAP_POPULATE, ring_fd,
+                               IORING_OFF_SQES);
+    if (sqes == MAP_FAILED) return sqes = nullptr, false;
+    char* sqb = (char*)sq_ptr;
+    sq_tail = (unsigned*)(sqb + p.sq_off.tail);
+    sq_mask = (unsigned*)(sqb + p.sq_off.ring_mask);
+    sq_array = (unsigned*)(sqb + p.sq_off.array);
+    char* cqb = (char*)cq_ptr;
+    cq_head = (unsigned*)(cqb + p.cq_off.head);
+    cq_tail = (unsigned*)(cqb + p.cq_off.tail);
+    cq_mask = (unsigned*)(cqb + p.cq_off.ring_mask);
+    cqes = (io_uring_cqe*)(cqb + p.cq_off.cqes);
+
+    pktmem.resize((size_t)nbufs * bufsz);
+    br_len = (size_t)nbufs * sizeof(io_uring_buf);
+    br = (io_uring_buf_ring*)mmap(nullptr, br_len, PROT_READ | PROT_WRITE,
+                                  MAP_ANONYMOUS | MAP_PRIVATE, -1, 0);
+    if (br == MAP_FAILED) return br = nullptr, false;
+    io_uring_buf_reg reg{};
+    reg.ring_addr = (__u64)(uintptr_t)br;
+    reg.ring_entries = nbufs;
+    reg.bgid = 0;
+    if (syscall(__NR_io_uring_register, ring_fd, IORING_REGISTER_PBUF_RING,
+                &reg, 1) < 0)
+      return false;
+    for (unsigned i = 0; i < nbufs; i++) recycle(i);
+    recycle_commit();
+    return true;
+  }
+
+  // Probe the armed multishot recv: an unsupported opcode/flag posts a
+  // synchronous error CQE at submit time.  A CQE with res >= 0 is a real
+  // packet that raced in — leave it for the reader loop.
+  bool probe_ok() {
+    unsigned h = *cq_head;
+    unsigned t = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+    if (h == t) return true;
+    io_uring_cqe* c = &cqes[h & *cq_mask];
+    if (c->res >= 0) return true;
+    __atomic_store_n(cq_head, h + 1, __ATOMIC_RELEASE);
+    return false;
+  }
+};
+
+// io_uring reader loop.  Returns true if the backend ran (even if it later
+// hit a fatal error); false if the probe failed and the caller should fall
+// back to recvmmsg with the socket untouched.
+static bool reader_loop_iouring(Engine* e, int fd, ThreadBuf* tb) {
+  const int batch = e->opt_batch;
+  size_t bufsz = (size_t)e->max_packet + 1;
+  // enough provided buffers to ride out several bursts between reaps
+  unsigned nbufs =
+      (unsigned)round_pow2((size_t)batch * 8, 256, (size_t)kMaxBatch);
+  UringRx rx;
+  if (!rx.init(fd, bufsz, nbufs)) return false;
+  if (!rx.arm()) return false;
+  if (!rx.probe_ok()) return false;
+  tb->backend.store(VN_BACKEND_IOURING, std::memory_order_relaxed);
+  ThreadScratch sc;
+  StageCounters& st = tb->stages;
+  std::vector<unsigned> bids((size_t)batch);
+  std::vector<int> lens((size_t)batch);
+  bool rearm = false;
+  while (!e->stop.load(std::memory_order_relaxed)) {
+    uint64_t recv_t0 = tick_now();
+    unsigned head = *rx.cq_head;
+    unsigned tail = __atomic_load_n(rx.cq_tail, __ATOMIC_ACQUIRE);
+    if (head == tail) {
+      if (rearm) {
+        if (!rx.arm()) return true;
+        rearm = false;
+      }
+      if (!rx.wait(100)) return true;
+      st.recv_ticks.fetch_add(ticks_since(recv_t0),
+                              std::memory_order_relaxed);
+      continue;
+    }
+    int n = 0;
+    bool fatal = false;
+    while (head != tail && n < batch) {
+      io_uring_cqe* c = &rx.cqes[head & *rx.cq_mask];
+      if (c->res >= 0 && (c->flags & IORING_CQE_F_BUFFER)) {
+        bids[(size_t)n] = (unsigned)(c->flags >> IORING_CQE_BUFFER_SHIFT);
+        lens[(size_t)n] = c->res;
+        n++;
+      } else if (c->res < 0 && c->res != -ENOBUFS && c->res != -EINTR) {
+        fatal = true;
+      }
+      if (!(c->flags & IORING_CQE_F_MORE)) rearm = true;
+      head++;
+    }
+    __atomic_store_n(rx.cq_head, head, __ATOMIC_RELEASE);
+    st.recv_ticks.fetch_add(ticks_since(recv_t0), std::memory_order_relaxed);
+    if (n > 0) {
+      st.recv_pkts.fetch_add((uint64_t)n, std::memory_order_relaxed);
+      uint64_t parse_t0 = tick_now();
+      producer_acquire(e, tb);
+      for (int i = 0; i < n; i++)
+        ingest_datagram(e, sc, rx.buf_at(bids[(size_t)i]),
+                        (size_t)lens[(size_t)i], tb->cur);
+      publish(tb);
+      producer_release(tb);
+      account_burst(st, sc, (uint64_t)n, ticks_since(parse_t0));
+      for (int i = 0; i < n; i++) rx.recycle(bids[(size_t)i]);
+      rx.recycle_commit();
+    }
+    if (fatal) return true;
+    // re-arm as soon as recycled buffers exist: the terminated multishot's
+    // leftover CQEs still reap fine alongside the new submission's
+    if (rearm) {
+      if (!rx.arm()) return true;
+      rearm = false;
+    }
+  }
+  return true;
+}
+
+#endif  // VN_HAVE_IOURING
+
+// Reader entry: resolve the receive backend (auto = probe io_uring, fall
+// back to recvmmsg), then run the loop until stop.
+static void reader_loop(Engine* e, int fd, ThreadBuf* tb) {
+#ifdef VN_HAVE_IOURING
+  // an explicit io_uring request the kernel can't honor still falls back
+  // (dropping packets would be worse); the reported backend shows what ran
+  if (e->opt_backend != VN_BACKEND_RECVMMSG &&
+      reader_loop_iouring(e, fd, tb))
+    return;
+#endif
+  if (!e->stop.load(std::memory_order_relaxed))
+    reader_loop_recvmmsg(e, fd, tb);
 }
 
 // ---------------------------------------------------------------------------
@@ -755,53 +1543,87 @@ static DrainResult* drain(Engine* e, bool clear_intern) {
   uint64_t drain_t0 = tick_now();
   auto* d = new DrainResult();
   std::vector<NewKeyRec> keys;
-  {
-    // Hold bufs_mu across the swap pass; with clear_intern, additionally
-    // hold EVERY thread-buffer mutex while the intern table is wiped —
-    // parsing interns under its thread-buffer lock, so this makes
-    // {consolidate + clear} atomic: no sample can be staged against an id
-    // whose key record was dropped.
+  // Serialize drains: each SPSC ring has exactly one consumer at a time.
+  std::lock_guard<std::mutex> dl(e->drain_mu);
+  if (!clear_intern) {
+    // Lock-free tick: pop every published batch, then steal each idle
+    // producer's private `cur` with the owner token.  A producer that is
+    // mid-burst keeps its token and is simply skipped — its in-flight
+    // batch lands on the next tick, and the drain never stalls it.
+    std::vector<ThreadBuf*> tbs;
+    {
+      std::lock_guard<std::mutex> l(e->bufs_mu);
+      for (auto& tb : e->bufs) tbs.push_back(tb.get());
+    }
+    for (ThreadBuf* tb : tbs) {
+      Batch tmp;
+      while (tb->ring.try_pop(tmp)) d->b.append(std::move(tmp));
+      uint32_t exp = OWN_FREE;
+      if (tb->owner.compare_exchange_strong(exp, OWN_DRAINER,
+                                            std::memory_order_acquire)) {
+        if (tb->cur.packets != 0) {
+          // tmp is empty here: append() consumes its source completely
+          std::swap(tmp, tb->cur);
+          d->b.append(std::move(tmp));
+        }
+        tb->owner.store(OWN_FREE, std::memory_order_release);
+      }
+    }
+    // Shards AFTER buffers: a staged sample's intern happened before the
+    // sample was published (program order inside the producer's critical
+    // section), so collecting fresh keys afterwards can only over-collect
+    // (a key whose samples arrive next drain — harmless), never
+    // under-collect.
+    for (auto& sh : e->shards) {
+      std::lock_guard<std::mutex> sl(sh.mu);
+      for (auto& k : sh.fresh) keys.emplace_back(std::move(k));
+      sh.fresh.clear();
+    }
+  } else {
+    // Intern-GC drain: the one path that still quiesces.  Holding bufs_mu
+    // for the whole wipe blocks vn_thread_new/buf_for, so no thread the
+    // claim loop hasn't seen can start interning; gc_active parks every
+    // producer at its next burst boundary, and claiming every owner token
+    // makes {consolidate + clear} atomic — no sample can be staged against
+    // an id whose key record was dropped.
     std::lock_guard<std::mutex> l(e->bufs_mu);
-    if (clear_intern) {
-      for (auto& tb : e->bufs) tb->mu.lock();
-      for (auto& tb : e->bufs) {
+    e->gc_active.store(true);
+    for (auto& tb : e->bufs) {
+      uint32_t exp = OWN_FREE;
+      while (!tb->owner.compare_exchange_weak(exp, OWN_DRAINER,
+                                              std::memory_order_acquire,
+                                              std::memory_order_relaxed)) {
+        exp = OWN_FREE;
+        // keep popping while we wait: a producer backed up on a full ring
+        // finishes its burst once slots free, then parks on gc_active
         Batch tmp;
+        while (tb->ring.try_pop(tmp)) d->b.append(std::move(tmp));
+        std::this_thread::yield();
+      }
+    }
+    for (auto& tb : e->bufs) {
+      Batch tmp;
+      while (tb->ring.try_pop(tmp)) d->b.append(std::move(tmp));
+      if (tb->cur.packets != 0) {
         std::swap(tmp, tb->cur);
         d->b.append(std::move(tmp));
       }
-      for (auto& sh : e->shards) {
-        std::lock_guard<std::mutex> sl(sh.mu);
-        for (auto& k : sh.fresh) keys.emplace_back(std::move(k));
-        sh.fresh.clear();
-        sh.slots.assign(256, InternSlot{});
-        sh.count = 0;
-      }
-      // all old ids are dead (buffers drained, table wiped) — restart the
-      // id space so the Python id cache stays bounded by live cardinality,
-      // and invalidate every per-thread intern cache (threads are
-      // quiesced here: parsing requires the thread-buffer mutex)
-      e->next_id.store(0);
-      e->intern_gen.fetch_add(1);
-      for (auto& tb : e->bufs) tb->mu.unlock();
-    } else {
-      // Buffers BEFORE shards: a staged sample's intern happened before the
-      // sample (program order under the thread-buffer lock), so collecting
-      // fresh keys afterwards can only over-collect (a key whose samples
-      // arrive next drain — harmless), never under-collect.
-      for (auto& tb : e->bufs) {
-        Batch tmp;
-        {
-          std::lock_guard<std::mutex> bl(tb->mu);
-          std::swap(tmp, tb->cur);
-        }
-        d->b.append(std::move(tmp));
-      }
-      for (auto& sh : e->shards) {
-        std::lock_guard<std::mutex> sl(sh.mu);
-        for (auto& k : sh.fresh) keys.emplace_back(std::move(k));
-        sh.fresh.clear();
-      }
     }
+    for (auto& sh : e->shards) {
+      std::lock_guard<std::mutex> sl(sh.mu);
+      for (auto& k : sh.fresh) keys.emplace_back(std::move(k));
+      sh.fresh.clear();
+      sh.slots.assign(256, InternSlot{});
+      sh.count = 0;
+    }
+    // all old ids are dead (buffers drained, table wiped) — restart the
+    // id space so the Python id cache stays bounded by live cardinality,
+    // and invalidate every per-thread intern cache (threads are parked:
+    // the drainer holds every owner token)
+    e->next_id.store(0);
+    e->intern_gen.fetch_add(1);
+    for (auto& tb : e->bufs) tb->owner.store(OWN_FREE, std::memory_order_release);
+    e->gc_active.store(false);
   }
   // ids ascend so Python can grow its id->row table append-only
   std::sort(keys.begin(), keys.end(),
@@ -866,7 +1688,106 @@ void* vn_engine_new(int max_packet_len, const char* implicit_tags_nl) {
     }
     std::sort(e->implicit_tags.begin(), e->implicit_tags.end());
   }
+  e->resolve_dispatch();
   return e;
+}
+
+// Tune engine knobs (call before threads are created; ring_slots only
+// affects threads created after the call).  Returns 0, or -1 for an
+// unknown key / unsupported value.
+//   "simd"       0=auto 1=scalar 2=sse2 3=avx2 (rejected if unsupported)
+//   "backend"    0=auto 1=recvmmsg 2=io_uring
+//   "batch"      recv burst size, clamped to [1, kMaxBatch]
+//   "ring_slots" SPSC slots per reader, rounded to a power of two
+int vn_engine_opt(void* ep, const char* key, long long val) {
+  auto* e = (Engine*)ep;
+  if (!key) return -1;
+  if (strcmp(key, "simd") == 0) {
+    if (val < VN_SIMD_AUTO || val > VN_SIMD_AVX2) return -1;
+    if (val != VN_SIMD_AUTO && !simd_supported((int)val)) return -1;
+    e->opt_simd = (int)val;
+    e->resolve_dispatch();
+    return 0;
+  }
+  if (strcmp(key, "backend") == 0) {
+    if (val < VN_BACKEND_NONE || val > VN_BACKEND_IOURING) return -1;
+    e->opt_backend = (int)val;
+    return 0;
+  }
+  if (strcmp(key, "batch") == 0) {
+    if (val < 1) return -1;
+    e->opt_batch = (int)std::min<long long>(val, kMaxBatch);
+    return 0;
+  }
+  if (strcmp(key, "ring_slots") == 0) {
+    if (val < 1) return -1;
+    e->opt_ring_slots =
+        (int)round_pow2((size_t)val, 2, (size_t)kMaxRingSlots);
+    return 0;
+  }
+  return -1;
+}
+
+// Resolved dispatch / backend introspection (debug vars + tests).
+int vn_simd_mode(void* ep) { return ((Engine*)ep)->simd_mode; }
+
+int vn_simd_supported(int mode) { return simd_supported(mode) ? 1 : 0; }
+
+int vn_reader_backend(void* ep, int tid) {
+  auto* e = (Engine*)ep;
+  std::lock_guard<std::mutex> l(e->bufs_mu);
+  if (tid < 0 || (size_t)tid >= e->bufs.size()) return -1;
+  return e->bufs[(size_t)tid]->backend.load(std::memory_order_relaxed);
+}
+
+// Test hook: intern-key hash under an explicit SIMD mode (parity checks).
+// Returns 0 for an unsupported mode (0 is not a reachable hash of any
+// input: kh_finish always multiplies in a nonzero odd constant — callers
+// compare modes against each other, not against 0).
+unsigned long long vn_key_hash(const char* data, long n, int mode) {
+  if (mode == VN_SIMD_AUTO || !simd_supported(mode)) return 0;
+  return hash_fn_for(mode)(data, (size_t)n);
+}
+
+// Test hook: run one tokenizer pass under an explicit SIMD mode and flatten
+// the per-class index into (position, class) pairs, class 0='\n' 1=':'
+// 2='|'.  Returns the total token count (callers re-call with a bigger
+// buffer if it exceeds cap), or -1 for an unsupported mode.
+long long vn_scan_tokens(const char* data, long n, int mode,
+                         long long* out_pos, unsigned char* out_cls,
+                         long long cap) {
+  if (mode == VN_SIMD_AUTO || !simd_supported(mode)) return -1;
+  TokenIndex ti;
+  scan_tokens_fn f = scan_fn_for(mode);
+  if (!f) f = scan_tokens_scalar;
+  f((const uint8_t*)data, (size_t)n, ti);
+  long long total = (long long)(ti.nl.size() + ti.co.size() + ti.pi.size());
+  if (out_pos && out_cls && cap > 0) {
+    // three-way merge by position (each class array is ascending)
+    size_t a = 0, b = 0, c = 0;
+    long long w = 0;
+    while (w < cap) {
+      uint32_t pn = a < ti.nl.size() ? ti.nl[a] : UINT32_MAX;
+      uint32_t pc = b < ti.co.size() ? ti.co[b] : UINT32_MAX;
+      uint32_t pp = c < ti.pi.size() ? ti.pi[c] : UINT32_MAX;
+      if (pn == UINT32_MAX && pc == UINT32_MAX && pp == UINT32_MAX) break;
+      if (pn <= pc && pn <= pp) {
+        out_pos[w] = (long long)pn;
+        out_cls[w] = 0;
+        a++;
+      } else if (pc <= pp) {
+        out_pos[w] = (long long)pc;
+        out_cls[w] = 1;
+        b++;
+      } else {
+        out_pos[w] = (long long)pp;
+        out_cls[w] = 2;
+        c++;
+      }
+      w++;
+    }
+  }
+  return total;
 }
 
 void vn_engine_free(void* ep) {
@@ -881,24 +1802,39 @@ int vn_thread_new(void* ep) { return ((Engine*)ep)->new_thread(); }
 
 // Ingest one datagram buffer on a registered thread id (ctypes releases the
 // GIL around this call, so Python reader threads get real parallelism).
+// Batches accumulate in the thread's private `cur` and publish to its ring
+// once they reach the burst size; a drain steals whatever is pending.
 void vn_ingest(void* ep, int tid, const char* data, long len) {
   auto* e = (Engine*)ep;
   thread_local ThreadScratch sc;
   ThreadBuf* tb = e->buf_for(tid);
   uint64_t t0 = tick_now();
-  {
-    std::lock_guard<std::mutex> l(tb->mu);
-    ingest_datagram(e, sc, data, (size_t)len, tb->cur);
-  }
+  producer_acquire(e, tb);
+  ingest_datagram(e, sc, data, (size_t)len, tb->cur);
+  if (tb->cur.packets >= (uint64_t)e->opt_batch) publish(tb);
+  producer_release(tb);
   account_burst(tb->stages, sc, 1, ticks_since(t0));
 }
 
-// Spawn a native reader thread on an already-bound UDP socket fd.
-int vn_add_udp_reader(void* ep, int fd) {
+// Spawn a native reader thread on an already-bound UDP socket fd,
+// optionally pinned to a CPU (cpu < 0 = unpinned; pinning is best-effort,
+// an invalid cpu just leaves the thread floating).
+int vn_add_udp_reader_pinned(void* ep, int fd, int cpu) {
   auto* e = (Engine*)ep;
   int tid = e->new_thread();
   e->readers.emplace_back(reader_loop, e, fd, e->buf_for(tid));
+  if (cpu >= 0) {
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    CPU_SET(cpu, &set);
+    pthread_setaffinity_np(e->readers.back().native_handle(), sizeof(set),
+                           &set);
+  }
   return tid;
+}
+
+int vn_add_udp_reader(void* ep, int fd) {
+  return vn_add_udp_reader_pinned(ep, fd, -1);
 }
 
 void vn_stop(void* ep) {

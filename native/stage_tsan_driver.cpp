@@ -22,9 +22,19 @@
 // yield a null fallback, never an out-of-bounds read.  Phase 3 feeds
 // vn_fill_dense adversarial COO rows (negative ids, ids past the
 // arena capacity, per-row overflow past the dense depth) and checks
-// the drop accounting and depth clamps hold.
+// the drop accounting and depth clamps hold.  Phase 4 (SPSC stress)
+// shrinks the staging rings to 2 slots so every handoff wraps and
+// backpressures, runs TWO concurrent drainers against the producers,
+// and checks exact packet conservation — a torn handoff (double-pop,
+// lost steal) shows up as a count mismatch, a racy one as a TSan
+// report.  Phase 5 (SIMD parity) asserts the scalar and SSE2/AVX2
+// tokenizers and intern-key hashes compute identical results: direct
+// vn_key_hash / vn_scan_tokens comparison over random bytes, then a
+// seeded fuzz corpus (valid lines, truncations, bit-flips, degenerate
+// tags) pushed through a scalar engine and a SIMD engine whose drains
+// must serialize byte-for-byte.
 //
-// VN_SAN_ITERS / VN_SAN_THREADS shrink phase 1 for smoke runs
+// VN_SAN_ITERS / VN_SAN_THREADS shrink phases 1 and 4 for smoke runs
 // (scripts/check.py uses VN_SAN_ITERS=2000).
 
 #include <atomic>
@@ -65,6 +75,15 @@ long long vn_fill_dense(const long long* rows, const double* vals,
                         float* dv, float* dw, short* depths,
                         long long u_pad, long long d_pad,
                         int n_threads);
+int vn_engine_opt(void* ep, const char* key, long long val);
+long long vn_drain_section(void* dp, int which, const void** a,
+                           const void** b, const void** c);
+void vn_drain_stats(void* dp, unsigned long long* out4);
+int vn_simd_supported(int mode);
+unsigned long long vn_key_hash(const char* data, long n, int mode);
+long long vn_scan_tokens(const char* data, long n, int mode,
+                         long long* out_pos, unsigned char* out_cls,
+                         long long cap);
 }
 
 namespace {
@@ -226,6 +245,253 @@ int env_int(const char* name, int dflt) {
   return out > 0 ? out : dflt;
 }
 
+// -- phase 4: SPSC staging-ring stress --------------------------------------
+
+int spsc_stress() {
+  void* e = vn_engine_new(4096, "env:spsc");
+  // 2-slot rings + 4-packet batches: every publish wraps the ring and
+  // most of them find it full, so the producer-side accumulate path and
+  // the drainer-side cur steal both run constantly
+  if (vn_engine_opt(e, "ring_slots", 2) != 0 ||
+      vn_engine_opt(e, "batch", 4) != 0) {
+    fprintf(stderr, "spsc stress: engine options rejected\n");
+    vn_engine_free(e);
+    return 1;
+  }
+  const int kThreads = env_int("VN_SAN_THREADS", 4);
+  const int kIters = env_int("VN_SAN_ITERS", 20000) / 2 + 1;
+  std::atomic<bool> stop{false};
+  std::atomic<unsigned long long> drained_pkts{0};
+
+  std::vector<std::thread> workers;
+  for (int t = 0; t < kThreads; t++) {
+    int tid = vn_thread_new(e);
+    workers.emplace_back([e, tid, t, kIters] {
+      char buf[96];
+      for (int i = 0; i < kIters; i++) {
+        int n = snprintf(buf, sizeof buf, "spsc.m%d:%d|c|#thr:%d",
+                         i % 29, i, t);
+        vn_ingest(e, tid, buf, n);
+      }
+    });
+  }
+  // TWO concurrent drainers: drain_mu must keep each ring
+  // single-consumer; a torn pop double-counts or drops a batch, which
+  // the conservation check below catches even without TSan
+  std::vector<std::thread> drainers;
+  for (int di = 0; di < 2; di++) {
+    drainers.emplace_back([e, di, &stop, &drained_pkts] {
+      int i = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        void* d = (di == 0 && ++i % 32 == 0) ? vn_drain_clear(e)
+                                             : vn_drain(e);
+        unsigned long long s4[4];
+        vn_drain_stats(d, s4);
+        drained_pkts.fetch_add(s4[2], std::memory_order_relaxed);
+        vn_drain_free(d);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  stop.store(true);
+  for (auto& d : drainers) d.join();
+  {
+    void* d = vn_drain(e);  // consolidate ring tails + stolen curs
+    unsigned long long s4[4];
+    vn_drain_stats(d, s4);
+    drained_pkts.fetch_add(s4[2], std::memory_order_relaxed);
+    vn_drain_free(d);
+  }
+  int rc = 0;
+  unsigned long long want =
+      (unsigned long long)kThreads * (unsigned long long)kIters;
+  unsigned long long t4[4];
+  vn_totals(e, t4);
+  if (drained_pkts.load() != want || t4[2] != want) {
+    fprintf(stderr, "spsc stress: conservation failed: drained=%llu "
+                    "totals=%llu want=%llu\n",
+            drained_pkts.load(), t4[2], want);
+    rc = 1;
+  }
+  vn_engine_free(e);
+  return rc;
+}
+
+// -- phase 5: scalar/SIMD parity --------------------------------------------
+
+uint64_t lcg_next(uint64_t* s) {
+  *s = *s * 6364136223846793005ULL + 1442695040888963407ULL;
+  return *s >> 33;
+}
+
+// Seeded fuzz corpus: well-formed lines across every metric family,
+// truncations at random byte offsets, single bit-flips, and degenerate
+// tag sections.  Deterministic, so both engines see identical bytes.
+std::vector<std::vector<uint8_t>> parity_corpus() {
+  std::vector<std::vector<uint8_t>> out;
+  const char* degenerate[] = {
+      "par.d1:1|c|#",       "par.d2:2|c|#,,",     "par.d3:3|g|#:,x:",
+      "par.d4:4|ms|@0.5|#a:b,a:b", "par.d5:1:2:3|h|#t:u",
+      "par.d6:nan|g",       "par.d7:+1e3|c",      "par.d8:1_0|c",
+      ":|",                 "a:|c",               "par.d9:1|q",
+      "",                   "\n\n",               "#only:tags",
+      "par.d10:1|c|@",      "par.d11:1|",
+  };
+  for (const char* s : degenerate)
+    out.emplace_back((const uint8_t*)s, (const uint8_t*)s + strlen(s));
+  uint64_t seed = 0xC0FFEE5EEDULL;
+  char buf[256];
+  for (int i = 0; i < 200; i++) {
+    int n = snprintf(
+        buf, sizeof buf,
+        "par.m%llu:%llu|%s|#k%llu:v%llu,env:prod\npar.x:%llu|ms|@0.25",
+        (unsigned long long)(lcg_next(&seed) % 37),
+        (unsigned long long)(lcg_next(&seed) % 100000),
+        (lcg_next(&seed) & 1) ? "c" : "h",
+        (unsigned long long)(lcg_next(&seed) % 11),
+        (unsigned long long)(lcg_next(&seed) % 13),
+        (unsigned long long)(lcg_next(&seed) % 997));
+    std::vector<uint8_t> v(buf, buf + n);
+    out.push_back(v);
+    out.emplace_back(v.begin(),
+                     v.begin() + (long)(lcg_next(&seed) % (n + 1)));
+    std::vector<uint8_t> f(v);
+    f[lcg_next(&seed) % f.size()] ^=
+        (uint8_t)(1u << (lcg_next(&seed) % 8));
+    out.push_back(f);
+  }
+  return out;
+}
+
+void blob_append(std::vector<uint8_t>& blob, const void* p, size_t n) {
+  if (n == 0) return;
+  const uint8_t* q = (const uint8_t*)p;
+  blob.insert(blob.end(), q, q + n);
+}
+
+// Drain an engine and serialize every section — ids, values, weights,
+// set hashes, interned keys blob, other-lines blob — into one byte
+// string, so parity is a single memcmp.
+std::vector<uint8_t> drain_blob(void* e, unsigned long long out4[4]) {
+  void* d = vn_drain(e);
+  vn_drain_stats(d, out4);
+  std::vector<uint8_t> blob;
+  for (int w = 0; w <= 5; w++) {
+    const void *a = nullptr, *b = nullptr, *c = nullptr;
+    long long n = vn_drain_section(d, w, &a, &b, &c);
+    blob_append(blob, &n, sizeof n);
+    switch (w) {
+      case 0:  // counters: u32 ids + f64 values
+      case 1:  // gauges
+        blob_append(blob, a, (size_t)n * 4);
+        blob_append(blob, b, (size_t)n * 8);
+        break;
+      case 2:  // histograms: ids + values + weights
+        blob_append(blob, a, (size_t)n * 4);
+        blob_append(blob, b, (size_t)n * 8);
+        blob_append(blob, c, (size_t)n * 8);
+        break;
+      case 3:  // sets: u32 ids + u64 element hashes
+        blob_append(blob, a, (size_t)n * 4);
+        blob_append(blob, b, (size_t)n * 8);
+        break;
+      case 4: {  // interned keys blob (b carries the byte length)
+        unsigned long long nb = (unsigned long long)(uintptr_t)b;
+        blob_append(blob, &nb, sizeof nb);
+        blob_append(blob, a, (size_t)nb);
+        break;
+      }
+      case 5:  // events / service checks blob
+        blob_append(blob, a, (size_t)n);
+        break;
+    }
+  }
+  vn_drain_free(d);
+  return blob;
+}
+
+int simd_parity() {
+  int rc = 0;
+  // direct kernel parity: intern-key hash and token scan over random
+  // bytes (which naturally contain '\n' ':' '|') at every length that
+  // straddles the 16B/32B vector tails
+  uint64_t seed = 0x5EEDF00DULL;
+  uint8_t rnd[160];
+  for (int len = 0; len <= (int)sizeof rnd; len++) {
+    for (int i = 0; i < len; i++) rnd[i] = (uint8_t)lcg_next(&seed);
+    unsigned long long ref = vn_key_hash((const char*)rnd, len, 1);
+    long long pos1[176];
+    unsigned char cls1[176];
+    long long n1 =
+        vn_scan_tokens((const char*)rnd, len, 1, pos1, cls1, 176);
+    for (int m = 2; m <= 3; m++) {
+      if (!vn_simd_supported(m)) continue;
+      if (vn_key_hash((const char*)rnd, len, m) != ref) {
+        fprintf(stderr, "simd parity: key_hash mode=%d len=%d\n", m,
+                len);
+        rc = 1;
+      }
+      long long pos2[176];
+      unsigned char cls2[176];
+      long long n2 =
+          vn_scan_tokens((const char*)rnd, len, m, pos2, cls2, 176);
+      if (n1 != n2 ||
+          memcmp(pos1, pos2, (size_t)n1 * sizeof pos1[0]) != 0 ||
+          memcmp(cls1, cls2, (size_t)n1) != 0) {
+        fprintf(stderr, "simd parity: scan_tokens mode=%d len=%d "
+                        "(%lld vs %lld tokens)\n", m, len, n1, n2);
+        rc = 1;
+      }
+    }
+  }
+  // end-to-end parity: identical fuzz bytes through a scalar engine
+  // and a SIMD engine must drain byte-for-byte the same — same intern
+  // ids in the same order, same staged values, same rejects
+  std::vector<std::vector<uint8_t>> corpus = parity_corpus();
+  for (int m = 2; m <= 3; m++) {
+    if (!vn_simd_supported(m)) continue;
+    void* es = vn_engine_new(4096, "env:par");
+    void* ev = vn_engine_new(4096, "env:par");
+    if (vn_engine_opt(es, "simd", 1) != 0 ||
+        vn_engine_opt(ev, "simd", m) != 0) {
+      fprintf(stderr, "simd parity: simd option rejected (mode=%d)\n",
+              m);
+      vn_engine_free(es);
+      vn_engine_free(ev);
+      return 1;
+    }
+    int ts = vn_thread_new(es), tv = vn_thread_new(ev);
+    for (const auto& dgram : corpus) {
+      vn_ingest(es, ts, (const char*)dgram.data(), (long)dgram.size());
+      vn_ingest(ev, tv, (const char*)dgram.data(), (long)dgram.size());
+    }
+    unsigned long long a4[4], b4[4];
+    std::vector<uint8_t> ba = drain_blob(es, a4);
+    std::vector<uint8_t> bb = drain_blob(ev, b4);
+    if (memcmp(a4, b4, sizeof a4) != 0) {
+      fprintf(stderr, "simd parity: drain stats diverge (mode=%d): "
+                      "%llu/%llu/%llu/%llu vs %llu/%llu/%llu/%llu\n",
+              m, a4[0], a4[1], a4[2], a4[3], b4[0], b4[1], b4[2],
+              b4[3]);
+      rc = 1;
+    }
+    if (ba != bb) {
+      fprintf(stderr, "simd parity: drained sections diverge "
+                      "(mode=%d, %zu vs %zu bytes)\n",
+              m, ba.size(), bb.size());
+      rc = 1;
+    }
+    if (vn_intern_count(es) != vn_intern_count(ev)) {
+      fprintf(stderr, "simd parity: intern counts diverge (mode=%d)\n",
+              m);
+      rc = 1;
+    }
+    vn_engine_free(es);
+    vn_engine_free(ev);
+  }
+  return rc;
+}
+
 }  // namespace
 
 int main() {
@@ -309,9 +575,12 @@ int main() {
   vn_engine_free(e);
   rc |= wire_fuzz();
   rc |= fill_dense_fuzz();
+  rc |= spsc_stress();
+  rc |= simd_parity();
   if (rc == 0)
     fprintf(stderr,
             "sanitize driver ok: %llu pkts, %llu values, wire fuzz + "
-            "dense fill clean\n", parse_pkts, stage_vals);
+            "dense fill + spsc stress + simd parity clean\n",
+            parse_pkts, stage_vals);
   return rc;
 }

@@ -2,7 +2,7 @@
 """One-command repo gate: vnlint -> native sanitizer smoke -> reshard,
 crash and egress chaos cells -> mixed-family dryrun -> proc chaos cell
 -> resident-arena chaos cell -> query dryrun cell -> cube dryrun cell
--> tier-1 pytest.
+-> ingest data-plane floor -> tier-1 pytest.
 Nonzero exit on ANY unsuppressed lint finding, sanitizer report,
 failed chaos cell, failed mixed-family conservation, failed query
 envelope/staleness gate, or test failure — the local equivalent of a
@@ -29,6 +29,11 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ingest data-plane regression floor (pkt/s over a 2s single-sender
+# window; see BASELINE.md round 19 — the 1-core CI host saturates
+# ~300-340k pkt/s, so 150k trips only on a structural regression)
+INGEST_FLOOR_PPS = 150_000
 
 
 def stage(name: str):
@@ -280,6 +285,34 @@ def main() -> int:
                         "PASS" if cube_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3i. ingest data-plane regression floor (ISSUE 18): a short
+    # saturation window through the real native readers must stay above
+    # INGEST_FLOOR_PPS packets/s (scripts/ingest_ceiling.py
+    # --min-pkts-per-s exits 1 below the floor).  The floor is set WELL
+    # below the host's measured ceiling — it catches a structural
+    # regression (a lock back on the drain path, a quadratic parse), not
+    # scheduler noise; BASELINE.md round 19 records the methodology.
+    # Exit 2 means no native engine, which is a skip, not a failure.
+    ingest_rc = 0
+    if args.fast:
+        results.append(("ingest floor", "SKIP", 0.0))
+    elif shutil.which("g++") is None:
+        print("check: no g++ — skipping the ingest floor")
+        results.append(("ingest floor", "SKIP", 0.0))
+    else:
+        t0 = stage(f"ingest floor (>{INGEST_FLOOR_PPS:,} pkt/s)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        floor_rc = subprocess.call(
+            [sys.executable, "scripts/ingest_ceiling.py",
+             "--seconds", "2", "--senders", "1", "--readers", "1",
+             "--min-pkts-per-s", str(INGEST_FLOOR_PPS)],
+            env=env, stdout=subprocess.DEVNULL)
+        ingest_rc = 0 if floor_rc in (0, 2) else 1
+        results.append(("ingest floor",
+                        "SKIP" if floor_rc == 2 else
+                        "PASS" if floor_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 4. tier-1 pytest (the ROADMAP.md contract command, CPU-forced)
     test_rc = 0
     if args.fast:
@@ -300,7 +333,7 @@ def main() -> int:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
                or egress_rc or mixed_rc or proc_rc or resident_rc
-               or query_rc or cube_rc or test_rc) else 0
+               or query_rc or cube_rc or ingest_rc or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

@@ -10,11 +10,11 @@ data-plane pillar, also live at /debug/vars on any running server).
 
 Reading the table:
 
-  * `recvmmsg` covers the readers' poll+recvmmsg syscall time INCLUDING
-    the wait for the kernel to hand over datagrams.  At saturation a
-    dominant recvmmsg share means the bound is the loopback/NIC delivery
-    path (socket queues, kernel-side skb work, sender contention), not
-    this engine's CPU.
+  * `recvmmsg` covers the readers' receive-backend time (poll+recvmmsg
+    or the io_uring multishot wait) INCLUDING the wait for the kernel to
+    hand over datagrams.  At saturation a dominant recvmmsg share means
+    the bound is the loopback/NIC delivery path (socket queues,
+    kernel-side skb work, sender contention), not this engine's CPU.
   * `parse` / `intern` / `stage` are the engine's own CPU: line
     scanning, identity interning, value float-parse + columnar append.
     A dominant share here names the code to optimize.
@@ -23,9 +23,23 @@ Reading the table:
     thread, the four stage times must sum to ~the measurement window
     (the acceptance bar is within 10% at saturation).
 
+Modes:
+
+  * default: one saturation run at the requested knob settings.
+  * --sweep: a grid over readers x batch x pinning x SIMD (each cell a
+    short window, per-stage ns table per cell) — the tuning map for a
+    new host class.  The grid axes are CLI-overridable comma lists.
+  * --min-pkts-per-s N: regression floor — exit nonzero when the
+    (single-run) ceiling lands below N, so CI can gate on "the data
+    plane did not get slower" (scripts/check.py wires this).
+
 Usage:
     python scripts/ingest_ceiling.py [--seconds N] [--senders N]
         [--readers N] [--lines-per-packet N] [--payloads N]
+        [--pinning] [--simd MODE] [--backend NAME] [--batch N]
+        [--ring-slots N] [--min-pkts-per-s N]
+        [--sweep] [--sweep-readers LIST] [--sweep-batch LIST]
+        [--sweep-simd LIST] [--sweep-seconds N]
 
 Prints one JSON document to stdout; human-readable progress on stderr.
 """
@@ -83,30 +97,29 @@ def delta(after: dict, before: dict) -> dict:
             for stage in after}
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--seconds", type=float, default=10.0,
-                    help="measurement window (default 10)")
-    ap.add_argument("--senders", type=int, default=2,
-                    help="sendmmsg blaster threads (default 2)")
-    ap.add_argument("--readers", type=int, default=0,
-                    help="native reader threads (0 = auto)")
-    ap.add_argument("--lines-per-packet", type=int, default=4)
-    ap.add_argument("--payloads", type=int, default=128)
-    args = ap.parse_args()
-
+def measure(seconds: float, senders: int, readers: int,
+            lines_per_packet: int, payloads: list[bytes],
+            pinning: bool = False, simd: str = "auto",
+            backend: str = "auto", batch: int = 0,
+            ring_slots: int = 0) -> dict:
+    """One saturation run at one knob setting; returns the result doc
+    (per-stage table + throughput + named bound) or an error doc."""
     from veneur_tpu import config as config_mod
     from veneur_tpu import ingest as ingest_mod
     from veneur_tpu.core.server import Server
     from veneur_tpu.profiling import STAGE_UNITS, STAGES
 
-    n_readers = args.readers or min(4, max(2, (os.cpu_count() or 2) - 1))
     cfg = config_mod.Config(
         statsd_listen_addresses=["udp://127.0.0.1:0"],
         interval=3600.0,             # no flush during the run
         ingest_drain_interval=0.05,
         eager_device_sync=False,     # measure the ingest plane only
-        num_readers=n_readers,
+        num_readers=readers,
+        ingest_reader_pinning=pinning,
+        ingest_simd=simd,
+        ingest_backend=backend,
+        ingest_reader_batch=batch,
+        ingest_ring_slots=ring_slots,
         read_buffer_size_bytes=8 << 20,
         hostname="ceiling")
     srv = Server(cfg)
@@ -114,11 +127,8 @@ def main() -> None:
     try:
         if srv.native is None:
             log("native engine unavailable; nothing to measure")
-            print(json.dumps({"error": "no native engine"}))
-            return
+            return {"error": "no native engine"}
         _, addr = srv.statsd_addrs[0]
-        payloads = make_payloads(np.random.default_rng(11),
-                                 args.payloads, args.lines_per_packet)
 
         # warmup: intern the identities, fault the arenas, warm the caches
         ingest_mod.blast_udp(addr[0], addr[1], 8192, payloads)
@@ -126,7 +136,7 @@ def main() -> None:
         srv._drain_native()
 
         stop = threading.Event()
-        sent_counts = [0] * args.senders
+        sent_counts = [0] * senders
 
         def blaster(i: int) -> None:
             while not stop.is_set():
@@ -135,13 +145,13 @@ def main() -> None:
 
         before_tot, before_thr = stage_totals(srv)
         pkts0 = srv.native.engine.totals()[2]
-        senders = [threading.Thread(target=blaster, args=(i,), daemon=True)
-                   for i in range(args.senders)]
+        blasters = [threading.Thread(target=blaster, args=(i,), daemon=True)
+                    for i in range(senders)]
         t0 = time.perf_counter()
-        for t in senders:
+        for t in blasters:
             t.start()
         # drain on the main thread while the blasters saturate the socket
-        deadline = t0 + args.seconds
+        deadline = t0 + seconds
         while time.perf_counter() < deadline:
             time.sleep(0.05)
             srv._drain_native()
@@ -150,8 +160,13 @@ def main() -> None:
         window_s = time.perf_counter() - t0
         after_tot, after_thr = stage_totals(srv)
         pkts1 = srv.native.engine.totals()[2]
+        resolved = {
+            "simd": srv.native.engine.simd_mode(),
+            "backends": sorted(set(
+                srv.native.stage_stats()["readers"].values())),
+        }
         stop.set()
-        for t in senders:
+        for t in blasters:
             t.join(timeout=10.0)
         # cooldown: consume whatever the socket still holds, so the
         # conservation totals below settle
@@ -163,7 +178,7 @@ def main() -> None:
         sent = sum(sent_counts)
         received = pkts1 - pkts0
         pps = received / window_s
-        lines_ps = pps * args.lines_per_packet
+        lines_ps = pps * lines_per_packet
         d_tot = delta(after_tot, before_tot)
         d_thr = [delta(a, b) for a, b in zip(after_thr, before_thr)]
 
@@ -202,11 +217,15 @@ def main() -> None:
         bound = ("socket/kernel delivery (loopback/NIC)"
                  if recv_share >= 0.5 else f"engine CPU: {cpu_stage}")
 
-        out = {
+        return {
             "window_s": round(window_s, 3),
-            "senders": args.senders,
-            "readers": n_readers,
-            "lines_per_packet": args.lines_per_packet,
+            "senders": senders,
+            "readers": readers,
+            "pinning": pinning,
+            "resolved": resolved,
+            "knobs": {"simd": simd, "backend": backend, "batch": batch,
+                      "ring_slots": ring_slots},
+            "lines_per_packet": lines_per_packet,
             "sent_pkts": sent,
             "received_pkts": received,
             "shed_frac": round(max(0, sent - received) / max(sent, 1), 4),
@@ -220,16 +239,124 @@ def main() -> None:
             },
             "bound": bound,
         }
-        log(f"ceiling: {pps:,.0f} pkt/s ({lines_ps:,.0f} lines/s), "
-            f"shed {out['shed_frac']:.1%}, bound = {bound}")
-        for stage, row in table.items():
-            log(f"  {stage:9s} {row['ns_total'] / 1e6:10.1f} ms  "
-                f"share {row['share_of_wall']:.3f}  "
-                f"ns/unit {row['ns_per_unit']}")
-        log(f"  reader wall coverage: {coverage} (1.0 = fully accounted)")
-        print(json.dumps(out, indent=2))
     finally:
         srv.shutdown()
+
+
+def log_result(out: dict) -> None:
+    log(f"ceiling: {out['pkts_per_sec']:,} pkt/s "
+        f"({out['lines_per_sec']:,} lines/s), "
+        f"shed {out['shed_frac']:.1%}, bound = {out['bound']}")
+    for stage, row in out["stages"].items():
+        log(f"  {stage:9s} {row['ns_total'] / 1e6:10.1f} ms  "
+            f"share {row['share_of_wall']:.3f}  "
+            f"ns/unit {row['ns_per_unit']}")
+    log(f"  reader wall coverage: "
+        f"{out['wall_accounting']['per_reader_coverage']} "
+        f"(1.0 = fully accounted)")
+
+
+def run_sweep(args, payloads: list[bytes]) -> dict:
+    """Grid over readers x batch x pinning x SIMD; one short window per
+    cell, per-stage ns/unit in every cell.  The table answers "which
+    knob moves the ceiling on THIS host" without hand-driving runs."""
+    from veneur_tpu import ingest as ingest_mod
+
+    readers_axis = [int(x) for x in args.sweep_readers.split(",")]
+    batch_axis = [int(x) for x in args.sweep_batch.split(",")]
+    pin_axis = [False, True] if args.sweep_pinning else [False]
+    simd_axis = [m for m in args.sweep_simd.split(",")
+                 if m == "auto" or ingest_mod.simd_supported(m)]
+    cells = []
+    n_total = (len(readers_axis) * len(batch_axis) * len(pin_axis)
+               * len(simd_axis))
+    i = 0
+    for readers in readers_axis:
+        for batch in batch_axis:
+            for pin in pin_axis:
+                for simd in simd_axis:
+                    i += 1
+                    log(f"[sweep {i}/{n_total}] readers={readers} "
+                        f"batch={batch} pin={pin} simd={simd}")
+                    out = measure(
+                        args.sweep_seconds, args.senders, readers,
+                        args.lines_per_packet, payloads,
+                        pinning=pin, simd=simd, backend=args.backend,
+                        batch=batch, ring_slots=args.ring_slots)
+                    cells.append(out)
+                    if "error" in out:
+                        continue
+                    stg = out["stages"]
+                    log(f"  -> {out['pkts_per_sec']:,} pkt/s  "
+                        + "  ".join(
+                            f"{s}={stg[s]['ns_per_unit']}ns"
+                            for s in stg))
+    ok = [c for c in cells if "error" not in c]
+    best = max(ok, key=lambda c: c["pkts_per_sec"]) if ok else None
+    if best:
+        log(f"sweep best: {best['pkts_per_sec']:,} pkt/s at "
+            f"readers={best['readers']} batch={best['knobs']['batch']} "
+            f"pin={best['pinning']} simd={best['knobs']['simd']} "
+            f"(resolved {best['resolved']})")
+    return {"sweep": cells, "best": best}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="measurement window (default 10)")
+    ap.add_argument("--senders", type=int, default=2,
+                    help="sendmmsg blaster threads (default 2)")
+    ap.add_argument("--readers", type=int, default=0,
+                    help="native reader threads (0 = auto)")
+    ap.add_argument("--lines-per-packet", type=int, default=4)
+    ap.add_argument("--payloads", type=int, default=128)
+    ap.add_argument("--pinning", action="store_true",
+                    help="pin reader i to cpu i %% cpu_count")
+    ap.add_argument("--simd", default="auto",
+                    help="tokenizer dispatch: auto|scalar|sse2|avx2")
+    ap.add_argument("--backend", default="auto",
+                    help="receive path: auto|recvmmsg|io_uring")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="packets per receive burst (0 = engine default)")
+    ap.add_argument("--ring-slots", type=int, default=0,
+                    help="SPSC staging slots per reader (0 = default)")
+    ap.add_argument("--min-pkts-per-s", type=float, default=0.0,
+                    help="regression floor: exit 1 when the measured "
+                         "ceiling lands below this (CI gate)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the knob grid instead of a single cell")
+    ap.add_argument("--sweep-readers", default="1,2")
+    ap.add_argument("--sweep-batch", default="32,128")
+    ap.add_argument("--sweep-simd", default="scalar,auto")
+    ap.add_argument("--sweep-pinning", action="store_true", default=True)
+    ap.add_argument("--no-sweep-pinning", dest="sweep_pinning",
+                    action="store_false")
+    ap.add_argument("--sweep-seconds", type=float, default=3.0)
+    args = ap.parse_args()
+
+    payloads = make_payloads(np.random.default_rng(11),
+                             args.payloads, args.lines_per_packet)
+
+    if args.sweep:
+        print(json.dumps(run_sweep(args, payloads), indent=2))
+        return
+
+    n_readers = args.readers or min(4, max(2, (os.cpu_count() or 2) - 1))
+    out = measure(args.seconds, args.senders, n_readers,
+                  args.lines_per_packet, payloads,
+                  pinning=args.pinning, simd=args.simd,
+                  backend=args.backend, batch=args.batch,
+                  ring_slots=args.ring_slots)
+    if "error" not in out:
+        log_result(out)
+    print(json.dumps(out, indent=2))
+    if "error" in out:
+        sys.exit(2)
+    if args.min_pkts_per_s and out["pkts_per_sec"] < args.min_pkts_per_s:
+        log(f"REGRESSION: {out['pkts_per_sec']:,} pkt/s is below the "
+            f"floor {args.min_pkts_per_s:,.0f}")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

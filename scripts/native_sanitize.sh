@@ -6,7 +6,10 @@
 # -fno-sanitize-recover so the first report is fatal) and runs the
 # driver: concurrent stage-counter hammering + conservation checks,
 # protobuf wire fuzz (vn_route / vn_import_scan truncation + bit-flip
-# sweeps), and vn_fill_dense boundary abuse.
+# sweeps), vn_fill_dense boundary abuse, SPSC staging-ring stress
+# (2-slot rings, two concurrent drainers, exact packet conservation),
+# and scalar/SIMD parity (vn_key_hash / vn_scan_tokens over random
+# bytes plus byte-identical drains from a shared fuzz corpus).
 #
 # Usage:
 #   scripts/native_sanitize.sh              # asan ubsan tsan (full)

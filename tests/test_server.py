@@ -299,6 +299,40 @@ def test_config_strict_rejects_unknown(tmp_path):
     assert cfg.interval == 10.0
 
 
+def test_ingest_knob_validation(tmp_path):
+    """The ingest_* data-plane knobs strict-parse from YAML, clamp
+    negative counts to 0 (engine default), and reject unknown dispatch
+    enum values loudly."""
+    p = tmp_path / "ingest.yaml"
+    p.write_text("""
+ingest_reader_shards: 4
+ingest_reader_pinning: true
+ingest_reader_batch: 128
+ingest_simd: sse2
+ingest_backend: recvmmsg
+ingest_ring_slots: 2048
+""")
+    cfg = config_mod.read_config(str(p), strict=True, environ={})
+    assert cfg.ingest_reader_shards == 4
+    assert cfg.ingest_reader_pinning is True
+    assert cfg.ingest_reader_batch == 128
+    assert cfg.ingest_simd == "sse2"
+    assert cfg.ingest_backend == "recvmmsg"
+    assert cfg.ingest_ring_slots == 2048
+
+    neg = config_mod.Config(ingest_reader_shards=-3, ingest_reader_batch=-1,
+                            ingest_ring_slots=-8)
+    neg.apply_defaults()
+    assert (neg.ingest_reader_shards, neg.ingest_reader_batch,
+            neg.ingest_ring_slots) == (0, 0, 0)
+
+    for knob, val in (("ingest_simd", "neon"),
+                      ("ingest_backend", "epoll")):
+        bad = config_mod.Config(**{knob: val})
+        with pytest.raises(ValueError, match=knob):
+            bad.apply_defaults()
+
+
 def test_sink_filtering():
     from veneur_tpu import sinks as sink_mod
     from veneur_tpu.samplers.samplers import InterMetric

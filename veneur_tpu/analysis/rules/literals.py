@@ -32,7 +32,7 @@ from veneur_tpu.analysis import astutil
 from veneur_tpu.analysis.engine import Finding, Module, ProjectContext
 from veneur_tpu.analysis.rules import Rule
 
-_SCOPES = ("forward/", "proxy/", "testbed/")
+_SCOPES = ("forward/", "proxy/", "testbed/", "ingest/")
 _TUNING_KW = re.compile(
     r"(timeout|deadline|retr(y|ies)|attempt|backoff|interval|grace"
     r"|cooldown|threshold|capacity|max_|chunk|poll|expiry|ttl)",

@@ -274,6 +274,21 @@ class Config:
     # parser + columnar staging, native/ingest_engine.cpp); falls back to
     # the Python path if the engine cannot be built
     native_ingest: bool = True
+    # native data-plane tuning (engine defaults when 0 / "auto"):
+    #   ingest_reader_shards   SO_REUSEPORT sockets + native reader threads
+    #                          (0 = num_readers)
+    #   ingest_reader_pinning  pin reader i to cpu i % cpu_count
+    #   ingest_reader_batch    packets per receive burst
+    #   ingest_simd            tokenizer/hash dispatch: auto|scalar|sse2|avx2
+    #   ingest_backend         receive syscall path: auto|recvmmsg|io_uring
+    #                          (auto probes io_uring, falls back)
+    #   ingest_ring_slots      SPSC staging slots per reader (pow2)
+    ingest_reader_shards: int = 0
+    ingest_reader_pinning: bool = False
+    ingest_reader_batch: int = 0
+    ingest_simd: str = "auto"
+    ingest_backend: str = "auto"
+    ingest_ring_slots: int = 0
     ingest_drain_interval: float = 0.0  # 0 = auto (min(interval/10, 0.5s))
     # sync staged samples into device lanes on every drain tick instead
     # of all at once during the flush snapshot (P7: pipelined flush vs
@@ -466,6 +481,20 @@ class Config:
             self.query_slot_seconds = 0.0
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
+        if self.ingest_reader_shards < 0:
+            self.ingest_reader_shards = 0
+        if self.ingest_reader_batch < 0:
+            self.ingest_reader_batch = 0
+        if self.ingest_ring_slots < 0:
+            self.ingest_ring_slots = 0
+        if self.ingest_simd not in ("auto", "scalar", "sse2", "avx2"):
+            raise ValueError(
+                f"ingest_simd must be auto|scalar|sse2|avx2, "
+                f"got {self.ingest_simd!r}")
+        if self.ingest_backend not in ("auto", "recvmmsg", "io_uring"):
+            raise ValueError(
+                f"ingest_backend must be auto|recvmmsg|io_uring, "
+                f"got {self.ingest_backend!r}")
         if self.read_buffer_size_bytes <= 0:
             self.read_buffer_size_bytes = 2 * 1024 * 1024
         if self.span_channel_capacity <= 0:

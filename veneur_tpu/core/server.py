@@ -525,7 +525,11 @@ class Server:
                     self.aggregator,
                     max_packet=self.config.metric_max_length,
                     implicit_tags=list(self.config.extend_tags),
-                    on_other=self.handle_metric_packet)
+                    on_other=self.handle_metric_packet,
+                    simd=self.config.ingest_simd,
+                    backend=self.config.ingest_backend,
+                    batch=self.config.ingest_reader_batch,
+                    ring_slots=self.config.ingest_ring_slots)
             # vnlint: disable=silent-loss (engine unavailability is a
             #   FALLBACK, not a drop: native=None routes every packet
             #   through the Python path, which has its own parse-error
@@ -844,7 +848,14 @@ class Server:
         if scheme == "udp":
             host, port = _split_hostport(rest)
             first_sock = None
-            for i in range(max(1, self.config.num_readers)):
+            # shard count: the flow-sharded native plane can run more
+            # reader sockets than the Python fallback's thread count
+            n_shards = (self.config.ingest_reader_shards
+                        if self.native is not None
+                        and self.config.ingest_reader_shards > 0
+                        else max(1, self.config.num_readers))
+            n_cpus = os.cpu_count() or 1
+            for i in range(n_shards):
                 sock = socket.socket(_sock_family(host),
                                      socket.SOCK_DGRAM)
                 # SO_REUSEPORT kernel load balancing (socket_linux.go:26-28)
@@ -860,8 +871,12 @@ class Server:
                     sock.bind((host, port))
                 self._listeners.append(sock)
                 if self.native is not None:
-                    # C++ recvmmsg reader loop owns this socket's hot path
-                    self.native.engine.add_udp_reader(sock.fileno())
+                    # C++ reader loop owns this socket's hot path
+                    # (io_uring multishot or recvmmsg, runtime-probed)
+                    pin = (i % n_cpus
+                           if self.config.ingest_reader_pinning else -1)
+                    self.native.engine.add_udp_reader(sock.fileno(),
+                                                      pin_cpu=pin)
                 else:
                     t = threading.Thread(target=self._read_udp, args=(sock,),
                                          daemon=True, name=f"statsd-udp-{i}")

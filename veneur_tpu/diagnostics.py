@@ -64,6 +64,13 @@ def ingest_stage_gauges(native) -> dict[str, float]:
     for stage, counters in st["totals"].items():
         for k, v in counters.items():
             out[f"ingest.stage.{stage}.{k}"] = float(v)
+    # resolved dispatch: reader count per receive backend plus the SIMD
+    # mode in use (encoded as its enum value so it stays a gauge)
+    for backend in ("recvmmsg", "io_uring"):
+        out[f"ingest.backend.{backend}.readers"] = float(
+            sum(1 for b in st.get("readers", {}).values() if b == backend))
+    from veneur_tpu.ingest import SIMD_MODES
+    out["ingest.simd.mode"] = float(SIMD_MODES.get(st.get("simd", "auto"), 0))
     return out
 
 

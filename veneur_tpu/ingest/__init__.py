@@ -41,6 +41,12 @@ _SO = os.path.join(_REPO_ROOT, "native", ".build", "libvningest.so")
 
 _TYPE_NAMES = ("counter", "gauge", "histogram", "timer", "set")
 
+# vn_engine_opt enum mirrors (ingest_engine.cpp VnSimd / VnBackend)
+SIMD_MODES = {"auto": 0, "scalar": 1, "sse2": 2, "avx2": 3}
+SIMD_NAMES = {v: k for k, v in SIMD_MODES.items()}
+BACKEND_MODES = {"auto": 0, "recvmmsg": 1, "io_uring": 2}
+BACKEND_NAMES = {0: "none", 1: "recvmmsg", 2: "io_uring"}
+
 # Data-plane stage names in pipeline order; the first four are
 # per-reader-thread, drain is engine-level (the Python drainer thread).
 # veneur_tpu.profiling owns the canonical tuple + unit map (tests pin
@@ -85,6 +91,26 @@ def load_library():
                                   ctypes.c_char_p, ctypes.c_long]
         lib.vn_add_udp_reader.restype = ctypes.c_int
         lib.vn_add_udp_reader.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vn_add_udp_reader_pinned.restype = ctypes.c_int
+        lib.vn_add_udp_reader_pinned.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.vn_engine_opt.restype = ctypes.c_int
+        lib.vn_engine_opt.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.vn_reader_backend.restype = ctypes.c_int
+        lib.vn_reader_backend.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vn_simd_mode.restype = ctypes.c_int
+        lib.vn_simd_mode.argtypes = [ctypes.c_void_p]
+        lib.vn_simd_supported.restype = ctypes.c_int
+        lib.vn_simd_supported.argtypes = [ctypes.c_int]
+        lib.vn_key_hash.restype = ctypes.c_ulonglong
+        lib.vn_key_hash.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int]
+        lib.vn_scan_tokens.restype = ctypes.c_longlong
+        lib.vn_scan_tokens.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_longlong]
         lib.vn_stop.argtypes = [ctypes.c_void_p]
         lib.vn_drain.restype = ctypes.c_void_p
         lib.vn_drain.argtypes = [ctypes.c_void_p]
@@ -276,6 +302,35 @@ def metro64(data: bytes) -> int:
     return int(load_library().vn_metro64(data, len(data)))
 
 
+def simd_supported(mode: str) -> bool:
+    """Whether the host CPU supports a SIMD dispatch mode by name."""
+    return bool(load_library().vn_simd_supported(SIMD_MODES[mode]))
+
+
+def key_hash(data: bytes, mode: str) -> int:
+    """Intern-key hash under an explicit SIMD mode — test hook for the
+    scalar/SSE2/AVX2 lane-hash parity contract (all modes must compute
+    the identical function, or mixed-mode engines would intern the same
+    identity to different shard slots)."""
+    return int(load_library().vn_key_hash(data, len(data), SIMD_MODES[mode]))
+
+
+def scan_tokens(data: bytes, mode: str) -> list[tuple[int, str]]:
+    """Run one tokenizer pass under an explicit SIMD mode — test hook
+    returning [(position, delimiter), ...] sorted by position, for
+    scalar-vs-SIMD boundary parity checks."""
+    lib = load_library()
+    cap = max(len(data), 1)
+    pos = (ctypes.c_longlong * cap)()
+    cls = (ctypes.c_ubyte * cap)()
+    n = int(lib.vn_scan_tokens(data, len(data), SIMD_MODES[mode],
+                               pos, cls, cap))
+    if n < 0:
+        raise ValueError(f"unsupported SIMD mode {mode!r}")
+    chars = ("\n", ":", "|")
+    return [(int(pos[i]), chars[cls[i]]) for i in range(min(n, cap))]
+
+
 def blast_udp(host: str, port: int, n_packets: int,
               payloads: list[bytes]) -> int:
     """Benchmark sender: cycle `payloads` via sendmmsg; returns packets
@@ -344,15 +399,35 @@ def _copy_array(ptr, n, dtype):
 
 
 class IngestEngine:
-    """One native engine instance: reader threads + staging + intern table."""
+    """One native engine instance: reader threads + staging + intern table.
+
+    ``simd`` / ``backend`` / ``batch`` / ``ring_slots`` mirror the
+    ``ingest_*`` config knobs (0 / "auto" = engine default); an
+    unsupported explicit SIMD mode raises rather than silently
+    downgrading."""
 
     def __init__(self, max_packet: int = 4096,
-                 implicit_tags: Optional[list[str]] = None):
+                 implicit_tags: Optional[list[str]] = None,
+                 simd: str = "auto", backend: str = "auto",
+                 batch: int = 0, ring_slots: int = 0):
         self.lib = load_library()
         tags_nl = "\n".join(implicit_tags or [])
         self.handle = ctypes.c_void_p(self.lib.vn_engine_new(
             max_packet, tags_nl.encode()))
         self._closed = False
+        self._reader_tids: list[int] = []
+        if simd != "auto":
+            self._set_opt("simd", SIMD_MODES[simd])
+        if backend != "auto":
+            self._set_opt("backend", BACKEND_MODES[backend])
+        if batch:
+            self._set_opt("batch", batch)
+        if ring_slots:
+            self._set_opt("ring_slots", ring_slots)
+
+    def _set_opt(self, key: str, val: int) -> None:
+        if int(self.lib.vn_engine_opt(self.handle, key.encode(), val)) != 0:
+            raise ValueError(f"engine rejected option {key}={val}")
 
     # -- feeding ----------------------------------------------------------
 
@@ -362,9 +437,24 @@ class IngestEngine:
     def ingest(self, tid: int, datagram: bytes) -> None:
         self.lib.vn_ingest(self.handle, tid, datagram, len(datagram))
 
-    def add_udp_reader(self, fd: int) -> int:
-        """Spawn a C++ recvmmsg reader loop on a bound UDP socket fd."""
-        return int(self.lib.vn_add_udp_reader(self.handle, fd))
+    def add_udp_reader(self, fd: int, pin_cpu: int = -1) -> int:
+        """Spawn a C++ reader loop (io_uring multishot where the kernel
+        supports it, recvmmsg otherwise) on a bound UDP socket fd,
+        optionally pinned to a CPU (pin_cpu < 0 = unpinned)."""
+        tid = int(self.lib.vn_add_udp_reader_pinned(
+            self.handle, fd, pin_cpu))
+        self._reader_tids.append(tid)
+        return tid
+
+    def reader_backend(self, tid: int) -> str:
+        """Resolved receive backend name for a reader thread id."""
+        return BACKEND_NAMES.get(
+            int(self.lib.vn_reader_backend(self.handle, tid)), "none")
+
+    def simd_mode(self) -> str:
+        """Resolved SIMD dispatch mode name."""
+        return SIMD_NAMES.get(int(self.lib.vn_simd_mode(self.handle)),
+                              "scalar")
 
     def stop(self) -> None:
         if not self._closed:
@@ -489,7 +579,13 @@ class IngestEngine:
             for name in STAGE_NAMES[:-1]}
         totals["drain"] = {"calls": int(d3[0]), "packets": int(d3[1]),
                            "ns": int(d3[2])}
-        return {"threads": threads, "totals": totals}
+        # dispatch introspection rides alongside (diagnostics flattens
+        # only "totals", so these additive keys never collide with the
+        # per-stage gauge namespace)
+        readers = {str(t): self.reader_backend(t)
+                   for t in self._reader_tids}
+        return {"threads": threads, "totals": totals,
+                "readers": readers, "simd": self.simd_mode()}
 
 
 @dataclass
@@ -519,9 +615,13 @@ class NativeIngest:
 
     def __init__(self, aggregator, max_packet: int = 4096,
                  implicit_tags: Optional[list[str]] = None,
-                 on_other: Optional[Callable[[bytes], None]] = None):
+                 on_other: Optional[Callable[[bytes], None]] = None,
+                 simd: str = "auto", backend: str = "auto",
+                 batch: int = 0, ring_slots: int = 0):
         self.agg = aggregator
-        self.engine = IngestEngine(max_packet, implicit_tags)
+        self.engine = IngestEngine(max_packet, implicit_tags,
+                                   simd=simd, backend=backend,
+                                   batch=batch, ring_slots=ring_slots)
         self.on_other = on_other
         self._info: list[Optional[_IdInfo]] = []
         # engine ids whose identity can NEVER produce a cube rollup
