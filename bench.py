@@ -428,6 +428,92 @@ def bench_moments_merge() -> dict:
     return out
 
 
+def bench_compactor_merge() -> dict:
+    """Relative-error tier comparison arm (ISSUE-19 acceptance): the
+    t-digest flush path vs the compactor ladder read-off
+    (ops/compactor_eval.make_compactor_flush — implied ``2**level``
+    weights over the state, no sort of raw samples), timed DEVICE-ONLY
+    at the global-tier merge regime.  The ladder is benched at the
+    SLO-key geometry (cap=32: the provable-bound tier trades capacity
+    for guarantees, and a merged ladder's state is ``levels*cap``
+    slots however much mass it absorbed — the read-off cost is
+    mass-independent, which is the argument this arm measures).
+    Occupancies model a post-merge steady state: every compacting
+    level holds its ``cap/2`` keep region.
+
+    Emits per-shape p50s plus the headline ``compactor_merge_p50_ms``
+    / ``compactor_vs_tdigest_speedup`` (largest shape measured)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import compactor_eval
+    from veneur_tpu.parallel import serving
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cap, levels = 32, 14
+    depth = 256                      # the tdigest merge-regime twin
+    shapes = [(100_000 if on_tpu else 16_384, depth)]
+    if on_tpu:
+        shapes.append((1_000_000, depth))
+    flush = serving.make_serving_flush(None)
+    cfn = compactor_eval.make_compactor_flush(cap, levels)
+    pct = jnp.asarray(np.asarray(PERCENTILES), jnp.float32)
+    rng = np.random.default_rng(11)
+    out: dict = {}
+    rounds, pipeline = 3, (20 if on_tpu else 3)
+    for u, d in shapes:
+        u_pad = 1 << (u - 1).bit_length()
+        dv = rng.gamma(2.0, 10.0, (u_pad, d)).astype(np.float32)
+        dep = np.full(u_pad, d, np.int16)
+        dev = jax.devices()[0]
+        dvd = jax.device_put(dv, dev)
+        depd = jax.device_put(dep, dev)
+
+        # ladder state: keep-region occupancy on every level that has
+        # compacted at least once (steady state after a deep merge)
+        cvals = rng.gamma(2.0, 10.0,
+                          (u_pad, levels * cap)).astype(np.float32)
+        ccnt = np.full((u_pad, levels), cap // 2, np.int32)
+        ccnt[:, -2:] = 0             # top of the ladder never clips
+        cscale = np.ones(u_pad, np.float32)
+        mm = np.stack([dv.min(axis=1), dv.max(axis=1)])
+        cvd = jax.device_put(cvals, dev)
+        ccd = jax.device_put(ccnt, dev)
+        csd = jax.device_put(cscale, dev)
+        mmd = jax.device_put(mm.astype(np.float32), dev)
+
+        def run_td():
+            return float(np.asarray(
+                flush.depth_variant(dvd, depd, pct))[0, 0])
+
+        def run_cc():
+            return float(np.asarray(
+                cfn(cvd, ccd, csd, mmd, pct))[0, 0])
+
+        per = {}
+        for name, fn in (("tdigest", run_td), ("compactor", run_cc)):
+            fn()                           # compile + first run
+            lat = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                for _ in range(pipeline):
+                    fn()
+                lat.append((time.perf_counter() - t0) * 1e3
+                           / pipeline)
+            per[name] = float(np.percentile(lat, 50))
+        tag = f"{u // 1000}k" if u < 1_000_000 else "1m"
+        out[f"tdigest_{tag}_p50_ms"] = round(per["tdigest"], 3)
+        out[f"compactor_{tag}_p50_ms"] = round(per["compactor"], 3)
+        out[f"speedup_{tag}"] = round(
+            per["tdigest"] / max(per["compactor"], 1e-9), 2)
+        log(f"compactor arm [{u_pad}x{levels}x{cap}]: tdigest "
+            f"{per['tdigest']:.2f}ms compactor "
+            f"{per['compactor']:.2f}ms = {out[f'speedup_{tag}']}x")
+        out["compactor_merge_p50_ms"] = out[f"compactor_{tag}_p50_ms"]
+        out["compactor_vs_tdigest_speedup"] = out[f"speedup_{tag}"]
+    return out
+
+
 def bench_kernel_stages() -> dict:
     """Per-stage decomposition of the flush evaluation — the
     `kernel_stage_ms` breakdown BASELINE.md promises (cumulative
@@ -1850,6 +1936,19 @@ def main() -> None:
         log(f"moments arm failed: {e}")
         result["moments_merge_p50_ms"] = {"error": str(e)[:200]}
         result["moments_vs_tdigest_speedup"] = {"error": str(e)[:200]}
+    # relative-error tier comparison (ISSUE-19 acceptance: the ladder
+    # read-off's cost is merge-mass-independent).  Promised keys:
+    # error values on arm failure, like kernel_stage_ms.
+    try:
+        cfam = bench_compactor_merge()
+        result.update({k: cfam[k]
+                       for k in ("compactor_merge_p50_ms",
+                                 "compactor_vs_tdigest_speedup")})
+        result["compactor_family_ms"] = cfam
+    except Exception as e:
+        log(f"compactor arm failed: {e}")
+        result["compactor_merge_p50_ms"] = {"error": str(e)[:200]}
+        result["compactor_vs_tdigest_speedup"] = {"error": str(e)[:200]}
     # self-tracing cost (ISSUE-9 acceptance: <1% on flush p50/p99 with
     # the sampler at 1.0).  Promised key: present as an error value if
     # the arm fails, like kernel_stage_ms.
@@ -2021,7 +2120,8 @@ def main() -> None:
                 "weighted_dev_only_p50", "kernel_stage_ms",
                 "trace_overhead_pct", "checkpoint_overhead_pct",
                 "egress_overhead_pct", "moments_merge_p50_ms",
-                "moments_vs_tdigest_speedup", "query_p50_ms",
+                "moments_vs_tdigest_speedup", "compactor_merge_p50_ms",
+                "compactor_vs_tdigest_speedup", "query_p50_ms",
                 "query_p99_ms", "query_staleness_ms",
                 "cube_query_p50_ms", "cube_query_p99_ms",
                 "cube_groups_per_launch",

@@ -162,22 +162,26 @@ def main() -> int:
                         "PASS" if egress_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
-    # 3d. the mixed-family dryrun cell (ISSUE 13): both sketch
-    # families live in one 3-tier cluster — tb.mh* keys route to the
-    # moments arenas via sketch_family_rules, forward as wire moments
-    # vectors, and merge exactly at the global tier.  Gates: EXACT
-    # histogram count conservation for every key of both families,
-    # plus each family's percentile emissions inside ITS committed
-    # envelope (analysis/tdigest_accuracy.csv family column)
+    # 3d. the mixed-family dryrun cell (ISSUES 13 + 19): all THREE
+    # sketch families live in one 3-tier cluster — tb.mh* keys route
+    # to the moments arenas and tb.ch* to the compactor ladders via
+    # sketch_family_rules, forward as self-describing wire vectors
+    # (marker -k moments, -1024-cap compactor), and merge exactly at
+    # the global tier.  Gates: EXACT histogram count conservation for
+    # every key of every family, plus each family's percentile
+    # emissions inside ITS committed envelope
+    # (analysis/tdigest_accuracy.csv family column — the compactor's
+    # rows double as evidence its provable rank bound held)
     mixed_rc = 0
     if args.fast:
         results.append(("mixed-family dryrun", "SKIP", 0.0))
     else:
-        t0 = stage("mixed-family dryrun (tdigest + moments)")
+        t0 = stage("mixed-family dryrun (tdigest + moments + compactor)")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         mixed_rc = subprocess.call(
             [sys.executable, "scripts/dryrun_3tier.py",
              "--locals", "2", "--moments-keys", "2",
+             "--compactor-keys", "2",
              "--histo-keys", "2", "--intervals", "2"],
             env=env)
         results.append(("mixed-family dryrun",

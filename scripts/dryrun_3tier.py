@@ -14,6 +14,12 @@ Usage:
   python scripts/dryrun_3tier.py --chaos forward-outage --out report.json
   python scripts/dryrun_3tier.py --chaos-only ring-scale-up   # one cell
   python scripts/dryrun_3tier.py --cardinality-budget 8  # tenant budgets
+  python scripts/dryrun_3tier.py --moments-keys 2 --compactor-keys 2
+                                          # MIXED three-family run:
+                                          # tdigest + moments + compactor
+                                          # keys side by side, each gated
+                                          # on its committed envelope +
+                                          # exact count conservation
   python scripts/dryrun_3tier.py --procs  # PROCESS-SEPARATED fleet:
                                           # every tier its own OS
                                           # process, verified over
@@ -81,6 +87,13 @@ def main(argv=None) -> int:
                     "tier): >0 makes this a MIXED-FAMILY dryrun — "
                     "exact count conservation and the per-family "
                     "percentile envelopes both gate the run")
+    ap.add_argument("--compactor-keys", type=int, default=0,
+                    help="compactor-family histogram keys per interval "
+                    "(tb.ch*, routed by sketch_family_rules on every "
+                    "tier): >0 adds the relative-error tier to the "
+                    "mixed-family dryrun — exact count conservation "
+                    "and the committed compactor envelope gate it "
+                    "(in-process only; the proc fleet rejects it)")
     ap.add_argument("--chaos", default=None,
                     help="chaos arm name, or 'all' for the full matrix")
     ap.add_argument("--procs", action="store_true",
@@ -211,6 +224,7 @@ def main(argv=None) -> int:
         interval_s=args.interval_s,
         cardinality_key_budget=args.cardinality_budget,
         moments_histo_keys=args.moments_keys,
+        compactor_histo_keys=args.compactor_keys,
         chaos=args.chaos, lock_witness=args.lock_witness,
         trace=args.trace, telemetry=args.telemetry,
         query=args.query, cubes=args.cubes, procs=args.procs)
@@ -247,7 +261,7 @@ def main(argv=None) -> int:
                  f"{cu['rollup_points']} rollup points, "
                  f"{cu['overflowed']} overflowed (accounted), "
                  f"group-by p50 {cu['query_p50_ms']} ms")
-    if args.moments_keys:
+    if args.moments_keys or args.compactor_keys:
         sf = report["sketch_families"]
         tail += ("; mixed-family: "
                  f"{sf['histo_keys_by_family']} keys, counts "

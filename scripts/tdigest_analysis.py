@@ -20,10 +20,20 @@ per cell PER SKETCH FAMILY with the observed error of
     elementwise merge) arms — the columns map parallel_* -> merged
     sketch, flush_* -> single sketch, sequential_* -> single sketch,
 
+  family=compactor
+  * the adaptive-compactor ladder (sketches/compactor.py — the
+    relative-error tier's host twin), same whole-data vs split-merge
+    arm mapping as moments; every estimate is ADDITIONALLY checked
+    against the family's provable absolute rank-error bound
+    (rank_error_bound), so the committed rows are both the empirical
+    envelope and evidence the guarantee holds on real data,
+
 against exact numpy quantiles, plus the structural invariants the
 reference CI enforces (centroid count <= ceil(pi*delta/2), exact
 weight conservation, merge-order invariance; for moments: exact count
-conservation under merge and bounded solver residuals).
+conservation under merge and bounded solver residuals; for compactor:
+exact count conservation and measured rank error within the provable
+bound on every distribution).
 
 The committed CSV (analysis/tdigest_accuracy.csv) is the testbed
 oracle's PER-FAMILY accuracy envelope (testbed/verify.py): each
@@ -70,6 +80,7 @@ def main() -> None:
     from veneur_tpu.sketches.tdigest_cpu import SequentialDigest
 
     from veneur_tpu.sketches.moments import MomentsSketch
+    from veneur_tpu.sketches import compactor as csk
 
     out = (open(sys.argv[1], "w", newline="")
            if len(sys.argv) > 1 else sys.stdout)
@@ -117,6 +128,40 @@ def main() -> None:
                     f"{m_single[i]:.6g}",
                     f"{abs(m_single[i] - exact[i]) / span:.3e}",
                     len(msk.vec), len(msk.vec), True])
+            # compactor family (default testbed geometry — the same
+            # ladder a zero-knob deployment runs): single sketch (the
+            # flush/read-off path) and a split-merge pair (the
+            # forwarded-ladder merge).  Each estimate's rank in the
+            # raw data must sit within the provable absolute bound of
+            # the requested rank — the guarantee the README commits to.
+            cc = csk.CompactorSketch()
+            cc.add_batch(data)
+            ca, cb = csk.CompactorSketch(), csk.CompactorSketch()
+            ca.add_batch(data[: n // 2])
+            cb.add_batch(data[n // 2:])
+            ca.merge(cb)
+            assert ca.count == n, (dist_name, n)  # exact merge
+            c_single = cc.quantiles(qs)
+            c_merged = ca.quantiles(qs)
+            c_bound = csk.rank_error_bound(n)
+            srt = np.sort(data)
+            for i, q in enumerate(qs):
+                for est in (float(c_single[i]), float(c_merged[i])):
+                    lo = float(np.searchsorted(srt, est, side="left"))
+                    hi = float(np.searchsorted(srt, est, side="right"))
+                    r = 0.5 * (lo + hi)
+                    assert abs(r - q * n) <= c_bound + 1.0, (
+                        dist_name, n, q, r, q * n, c_bound)
+                w.writerow([
+                    "compactor", dist_name, n, q, f"{exact[i]:.6g}",
+                    f"{c_merged[i]:.6g}",
+                    f"{abs(c_merged[i] - exact[i]) / span:.3e}",
+                    f"{c_single[i]:.6g}",
+                    f"{abs(c_single[i] - exact[i]) / span:.3e}",
+                    f"{c_single[i]:.6g}",
+                    f"{abs(c_single[i] - exact[i]) / span:.3e}",
+                    int(cc.item_mass()), f"{c_bound:.6g}", True])
+
             if n == 200:
                 continue   # t-digest dossier keeps its historical grid
 

@@ -1,0 +1,385 @@
+"""Compactor sketch family (ISSUE 19): provable rank-error bounds,
+bit-for-bit merge order-invariance, kernel interpret parity + tiling
+bit-identity, arena contract, checkpoint bit-parity, wire interop, and
+the tier-1 THREE-family testbed cell."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.aggregator import MetricAggregator
+from veneur_tpu.core.arena import CheckpointIncompatible, CompactorArena
+from veneur_tpu.forward import convert
+from veneur_tpu.ops import compactor_eval as ce
+from veneur_tpu.samplers.metric_key import (MetricKey, MetricScope,
+                                            UDPMetric)
+from veneur_tpu.sketches import compactor as cs
+
+
+def _udp(name, value, scope=MetricScope.LOCAL_ONLY, tags=(),
+         mtype="histogram", rate=1.0):
+    return UDPMetric(name=name, type=mtype, value=float(value),
+                     sample_rate=rate, tags=list(tags),
+                     joined_tags=",".join(sorted(tags)), scope=scope)
+
+
+def _cvec(values):
+    s = cs.CompactorSketch()
+    s.add_batch(np.asarray(values, np.float64))
+    return s.to_vector()
+
+
+def _measured_rank(data_sorted, est, q, n):
+    lo = float(np.searchsorted(data_sorted, est, side="left"))
+    hi = float(np.searchsorted(data_sorted, est, side="right"))
+    return abs(0.5 * (lo + hi) - q * n)
+
+
+# ---------------------------------------------------------------------------
+# sketch math: the provable envelope
+# ---------------------------------------------------------------------------
+
+def test_sketch_rank_error_within_provable_bound():
+    """Every estimate's MEASURED rank error sits inside the committed
+    worst-case bound — the family's acceptance invariant, checked here
+    per-distribution on both the whole-data and the split-merge arm."""
+    rng = np.random.default_rng(0)
+    n = 20_000
+    cases = {
+        "uniform": rng.uniform(0, 100, n),
+        "gamma": rng.gamma(2.0, 10.0, n),
+        "lognormal": rng.lognormal(3.0, 1.0, n),
+        "heavy_tail": rng.pareto(1.5, n) + 1.0,
+        "adversarial_sorted": np.sort(rng.gamma(2.0, 10.0, n)),
+    }
+    qs = [0.1, 0.5, 0.9, 0.99]
+    bound = cs.rank_error_bound(n)
+    assert np.isfinite(bound) and 0 < bound < n
+    for name, data in cases.items():
+        whole = cs.CompactorSketch()
+        whole.add_batch(data)
+        a, b = cs.CompactorSketch(), cs.CompactorSketch()
+        a.add_batch(data[: n // 2])
+        b.add_batch(data[n // 2:])
+        a.merge(b)
+        assert a.count == float(n)             # exact merge
+        srt = np.sort(data)
+        for sk in (whole, a):
+            ests = sk.quantiles(qs)
+            for q, est in zip(qs, ests):
+                # +1 absorbs the half-open rank convention at ties
+                err = _measured_rank(srt, float(est), q, n)
+                assert err <= bound + 1.0, (name, q, err, bound)
+
+
+def test_exact_regime_is_lossless():
+    """n <= cap: no compaction ever fires, so the ladder holds the raw
+    multiset at unit weight — rank error exactly zero."""
+    rng = np.random.default_rng(1)
+    data = rng.gamma(2.0, 10.0, 100)
+    s = cs.CompactorSketch()
+    s.add_batch(data)
+    assert cs.rank_error_bound(len(data)) == 0.0
+    v, w = cs.items_and_weights(s.to_vector())
+    assert np.array_equal(np.sort(v), np.sort(data))
+    assert np.all(w == 1.0)
+    assert s.comps == 0 and s.clip == 0
+
+
+def test_merge_is_order_invariant_bit_for_bit():
+    """The coin continues from the SUMMED compaction counters, so
+    a.merge(b) and b.merge(a) produce bit-identical ladders — the
+    property that makes multi-tier fan-in deterministic."""
+    rng = np.random.default_rng(2)
+    data = rng.gamma(2.0, 10.0, 6000)
+    a1, b1 = cs.CompactorSketch(), cs.CompactorSketch()
+    a1.add_batch(data[:3000])
+    b1.add_batch(data[3000:])
+    a2 = cs.CompactorSketch.from_vector(a1.to_vector())
+    b2 = cs.CompactorSketch.from_vector(b1.to_vector())
+    a1.merge(b1)                               # a <- b
+    b2.merge(a2)                               # b <- a
+    assert np.array_equal(a1.to_vector(), b2.to_vector())
+    # exact scalar merges
+    assert a1.count == 6000.0
+    assert a1.min == data.min() and a1.max == data.max()
+    assert np.isclose(a1.sum, data.sum(), rtol=1e-12)
+
+
+def test_merge_with_empty_is_identity():
+    rng = np.random.default_rng(3)
+    s = cs.CompactorSketch()
+    s.add_batch(rng.gamma(2.0, 10.0, 1000))
+    before = s.to_vector()
+    s.merge(cs.CompactorSketch())              # empty right operand
+    assert np.array_equal(s.to_vector(), before)
+    e = cs.CompactorSketch()
+    e.merge(s)                                 # empty left operand
+    assert np.array_equal(e.to_vector(), before)
+
+
+def test_param_mismatch_refuses_to_merge():
+    # same geometry (so the vectors are shape-compatible) but a
+    # different coin seed: the schedules diverge, so the merge refuses
+    sa, sb = cs.CompactorSketch(), cs.CompactorSketch(seed=1)
+    sa.add_batch([1.0])
+    sb.add_batch([2.0])
+    with pytest.raises(ValueError, match="param mismatch"):
+        cs.merge_vectors(sa.to_vector()[None, :],
+                         sb.to_vector()[None, :])
+
+
+def test_weighted_samples_conserve_mass_exactly():
+    """Sample-rate weights decompose by binary expansion into ladder
+    levels; the exact header count carries the true (fractional) mass
+    and no sample's value is dropped."""
+    rng = np.random.default_rng(4)
+    vals = rng.gamma(2.0, 10.0, 500)
+    wts = rng.uniform(0.5, 9.5, 500)
+    s = cs.CompactorSketch()
+    s.add_batch(vals, wts)
+    assert np.isclose(s.count, wts.sum(), rtol=1e-12)
+    v, w = cs.items_and_weights(s.to_vector())
+    assert np.isclose(w.sum(), wts.sum(), rtol=1e-12)  # renormalized
+    q = s.quantile(0.5)
+    assert vals.min() <= q <= vals.max()
+
+
+def test_rank_error_bound_regimes():
+    cap, levels = cs.DEFAULT_CAP, cs.DEFAULT_LEVELS
+    assert cs.rank_error_bound(cap) == 0.0
+    ns = np.logspace(np.log10(cap * 2),
+                     np.log10(cap * 2.0 ** (levels - 1) * 0.99), 12)
+    bounds = [cs.rank_error_bound(float(n)) for n in ns]
+    assert all(np.isfinite(b) and b > 0 for b in bounds)
+    assert all(b2 >= b1 for b1, b2 in zip(bounds, bounds[1:]))
+    assert cs.rank_error_bound(cap * 2.0 ** (levels - 1) * 1.01) == np.inf
+
+
+# ---------------------------------------------------------------------------
+# kernel parity (host reference vs XLA twin vs Pallas interpret)
+# ---------------------------------------------------------------------------
+
+def _staged_batch(rng, u, cap, levels):
+    """Random staged ladders: occupied f32 prefixes, +inf padding, a
+    clip-forcing row, plus the planner's coin offsets."""
+    s2 = cs.STAGE_MUL * cap
+    stage_n = rng.integers(0, s2 + 1, (u, levels)).astype(np.int64)
+    stage_n[0, -1] = s2                        # force top-level clip
+    stage_v = np.full((u, levels, s2), np.inf, np.float64)
+    for i in range(u):
+        for l in range(levels):
+            occ = stage_n[i, l]
+            stage_v[i, l, :occ] = np.sort(
+                rng.gamma(2.0, 10.0, occ).astype(np.float32))
+    off, cnt_out, _, _ = cs.plan_pass(
+        stage_n, np.zeros(u, np.int64), np.zeros(u, np.int64),
+        cs.DEFAULT_SEED, cap)
+    return stage_v, stage_n, off, cnt_out
+
+
+def test_compact_batch_interpret_parity_and_tiling_bit_identity():
+    """ONE batched pass: the host numpy reference, the XLA twin (the
+    CPU tier-1 route), Pallas interpret mode, and interpret mode under
+    DIFFERENT lane tilings all produce bit-identical state."""
+    rng = np.random.default_rng(5)
+    cap, levels, u = 16, 5, 8
+    stage_v, stage_n, off, cnt_out = _staged_batch(rng, u, cap, levels)
+    host = cs.apply_pass(stage_v, stage_n, off, cap).astype(np.float32)
+    twin = ce.compact_batch(stage_v, stage_n, off)     # CPU -> XLA twin
+    interp = ce.compact_batch(stage_v, stage_n, off, interpret=True)
+    assert np.array_equal(host, twin)
+    assert np.array_equal(twin, interp)
+    for tile in (1, 2, 4):
+        tiled = ce.compact_batch(stage_v, stage_n, off, interpret=True,
+                                 tile=tile)
+        assert np.array_equal(interp, tiled), tile
+    # post-pass occupancies obey the planner: live prefix is finite,
+    # padding beyond it is +inf, every level is back under cap
+    assert np.all(cnt_out <= cap)
+    live = np.arange(cap)[None, None, :] < cnt_out[:, :, None]
+    assert np.all(np.isfinite(twin[live]))
+    assert np.all(np.isinf(twin[~live]))
+
+
+def test_compact_batch_rejects_ragged_tiling():
+    rng = np.random.default_rng(6)
+    stage_v, stage_n, off, _ = _staged_batch(rng, 6, 16, 3)
+    with pytest.raises(ValueError, match="whole number"):
+        ce.compact_batch(stage_v, stage_n, off, interpret=True, tile=4)
+
+
+# ---------------------------------------------------------------------------
+# arena contract
+# ---------------------------------------------------------------------------
+
+def _cc_agg(**kw):
+    kw.setdefault("percentiles", [0.5, 0.99])
+    kw.setdefault("sketch_family_rules",
+                  [{"match": "ch.*", "family": "compactor"}])
+    return MetricAggregator(**kw)
+
+
+def test_arena_flush_quantiles_match_numpy():
+    agg = _cc_agg()
+    rng = np.random.default_rng(7)
+    vals = rng.gamma(2.0, 10.0, 2000)
+    for v in vals:
+        agg.process_metric(_udp("ch.h", v))
+    res = agg.flush(is_local=True)
+    ms = {m.name: m.value for m in res.metrics}
+    assert ms["ch.h.count"] == 2000.0
+    assert ms["ch.h.min"] == vals.min()
+    assert ms["ch.h.max"] == vals.max()
+    exact = np.quantile(vals, [0.5, 0.99])
+    span = vals.max() - vals.min()
+    got = np.asarray([ms["ch.h.50percentile"],
+                      ms["ch.h.99percentile"]])
+    assert (np.abs(got - exact) / span).max() < 0.02
+
+
+def test_arena_rejects_mesh_and_bad_geometry():
+    class FakeMesh:
+        pass
+    with pytest.raises(ValueError, match="unmeshed"):
+        CompactorArena(mesh=FakeMesh())
+    with pytest.raises(ValueError, match="bad compactor params"):
+        CompactorArena(cap=24)                 # not a power of two
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/restore bit-parity
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_restore_bit_parity_mid_interval():
+    """Checkpoint with staged samples + an imported ladder mid-interval,
+    restore into a fresh aggregator, flush both: emissions AND forward
+    wire vectors must be BIT-IDENTICAL (the crash chaos arms'
+    exactness contract)."""
+    rng = np.random.default_rng(8)
+    kw = dict(percentiles=[0.5, 0.99],
+              sketch_family_rules=[{"match": "ch.*",
+                                    "family": "compactor"}])
+    agg = MetricAggregator(**kw)
+    for v in rng.gamma(2.0, 10.0, 500):
+        agg.process_metric(_udp("ch.a", v, scope=MetricScope.MIXED))
+    # an imported ladder too (cvals/ccnt/ccomps/cclip must restore)
+    key = MetricKey("ch.b", "histogram", "")
+    with agg.lock:
+        row = agg.compactors.row_for(key, MetricScope.MIXED, [])
+        agg.compactors.merge_compactor(
+            row, _cvec(rng.lognormal(3.0, 1.0, 400)))
+    meta, arrays = agg.checkpoint_state()
+
+    fresh = MetricAggregator(**kw)
+    fresh.restore_state(meta, arrays)
+    r1 = agg.flush(is_local=True)
+    r2 = fresh.flush(is_local=True)
+    m1 = sorted((m.name, m.value) for m in r1.metrics)
+    m2 = sorted((m.name, m.value) for m in r2.metrics)
+    assert m1 == m2                            # bit-identical emissions
+    f1 = sorted((f.name, tuple(f.compactor or [])) for f in r1.forward)
+    f2 = sorted((f.name, tuple(f.compactor or [])) for f in r2.forward)
+    assert f1 == f2                            # bit-identical wire vectors
+    assert any(f.compactor for f in r1.forward)
+
+
+def test_checkpoint_incompatible_on_param_mismatch():
+    agg = _cc_agg(sketch_compactor_cap=32)
+    for v in (1.0, 2.0, 3.0):
+        agg.process_metric(_udp("ch.k", v))
+    meta, arrays = agg.checkpoint_state()
+    other = _cc_agg(sketch_compactor_cap=64)
+    with pytest.raises(CheckpointIncompatible, match="compactor"):
+        other.restore_state(meta, arrays)
+    # the precheck fired BEFORE any arena mutated: clean cold start
+    assert not other.compactors.kdict and not other.digests.kdict
+
+
+# ---------------------------------------------------------------------------
+# wire interop
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_is_bit_exact():
+    vec = _cvec(np.random.default_rng(9).gamma(2.0, 10.0, 1000))
+    from veneur_tpu.samplers import samplers as sm
+    fm = sm.ForwardMetric(name="x", tags=["a:b"], kind="histogram",
+                          scope=int(MetricScope.MIXED),
+                          compactor=vec.tolist())
+    pb = convert.to_pb(fm)
+    # family marker: -1024 - cap, below the moments -k band
+    assert pb.histogram.t_digest.compression == -1024.0 - cs.DEFAULT_CAP
+    back = convert.from_pb(pb)
+    assert back.compactor is not None
+    assert np.array_equal(np.asarray(back.compactor), vec)
+    # digest payloads stay untouched by the marker logic
+    fm2 = sm.ForwardMetric(name="y", tags=[], kind="histogram",
+                           scope=int(MetricScope.MIXED),
+                           digest_means=[1.0], digest_weights=[2.0],
+                           digest_min=1.0, digest_max=1.0,
+                           digest_compression=100.0)
+    back2 = convert.from_pb(convert.to_pb(fm2))
+    assert back2.compactor is None and back2.digest_means == [1.0]
+
+
+def test_local_proxy_global_merge_conserves_exactly():
+    """Two locals -> (real wire bytes) -> one global: counts/min/max
+    conserve exactly, the merged quantiles stay inside the committed
+    envelope AND the provable rank bound."""
+    rng = np.random.default_rng(10)
+    vals = rng.gamma(2.0, 10.0, 600)
+    rules = [{"match": "ch.*", "family": "compactor"}]
+    locals_ = [MetricAggregator(percentiles=[0.5, 0.99],
+                                sketch_family_rules=rules)
+               for _ in range(2)]
+    glob = MetricAggregator(percentiles=[0.5, 0.99], is_local=False)
+    for i, v in enumerate(vals):
+        locals_[i % 2].process_metric(
+            _udp("ch.f", v, scope=MetricScope.MIXED))
+    local_count = 0.0
+    for lagg in locals_:
+        res = lagg.flush(is_local=True)
+        lm = {m.name: m.value for m in res.metrics}
+        local_count += lm["ch.f.count"]
+        for fm in res.forward:
+            # through the REAL wire bytes, like the proxy path
+            data = convert.to_pb(fm).SerializeToString()
+            from veneur_tpu.protocol import metric_pb2
+            glob.import_metric(convert.from_pb(
+                metric_pb2.Metric.FromString(data)))
+    assert local_count == 600.0                # counts conserve exactly
+    # the merged ladder on the global tier conserves the exact mass
+    from veneur_tpu.samplers.metric_key import MetricKey as MK
+    grow = glob.compactors.kdict[(MK("ch.f", "histogram", ""),
+                                  MetricScope.MIXED)]
+    assert glob.compactors.d_weight[grow] == 600.0
+    gres = glob.flush(is_local=False)
+    gm = {m.name: m.value for m in gres.metrics}
+    srt = np.sort(vals)
+    bound = cs.rank_error_bound(600.0)
+    for q, nm in ((0.5, "ch.f.50percentile"), (0.99, "ch.f.99percentile")):
+        err = _measured_rank(srt, gm[nm], q, 600)
+        assert err <= bound + 1.0, (q, err, bound)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 three-family testbed cell
+# ---------------------------------------------------------------------------
+
+def test_three_family_testbed_cell_conserves_exactly():
+    """All THREE families live in one 3-tier cluster: exact count
+    conservation for every histogram key, per-family percentile
+    envelopes — the ISSUE-19 acceptance cell."""
+    from veneur_tpu.testbed.dryrun import run_dryrun
+    report = run_dryrun(n_locals=2, n_globals=1, intervals=2, seed=19,
+                        counter_keys=4, histo_keys=2, set_keys=1,
+                        histo_samples=120, moments_histo_keys=2,
+                        compactor_histo_keys=2)
+    assert report["ok"], report
+    sf = report["sketch_families"]
+    assert sf["histo_counts_exact"]
+    assert sf["histo_keys_by_family"] == \
+        {"tdigest": 2, "moments": 2, "compactor": 2}
+    assert sf["quantiles_checked_by_family"]["compactor"] == \
+        2 * 2 * 3                              # keys x intervals x pctiles
+    assert report["conservation"]["counters_exact"]
+    assert report["conservation"]["sets_exact"]

@@ -51,11 +51,12 @@ def test_prewarm_makes_ramp_compile_free():
     agg = MetricAggregator(percentiles=[0.5], is_local=False,
                            initial_capacity=1024)
     warmed = agg.prewarm([1], max_keys=1024, min_keys=128)
-    # 4 key buckets (128..1024) x 4 production programs per bucket:
-    # the depth-vector uniform flush and the general weighted flush,
-    # for BOTH sketch families (moments wire payloads route into the
-    # moments arena on any tier, so its programs prewarm too)
-    assert warmed == 16
+    # 4 key buckets (128..1024) x 5 production programs per bucket:
+    # the depth-vector uniform flush and the general weighted flush
+    # for the digest family, plus the moments and compactor read-offs
+    # (wire payloads route into their arenas on any tier, so every
+    # family's programs prewarm too)
+    assert warmed == 20
     base = agg.compile_events
     for n in (128, 200, 400, 900, 1024):    # ramp within the buckets
         _stage(agg, n)

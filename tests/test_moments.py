@@ -510,13 +510,21 @@ def test_eviction_releases_from_the_arena_that_holds_the_key():
     assert agg.moments.ivec[row, 0] == 0.0
 
 
-def test_config_rejects_mesh_with_family_dispatch():
+def test_config_mesh_policy_is_per_family():
     from veneur_tpu import config as config_mod
+    # moments + mesh is allowed: the maxent solve shards over the key
+    # axis (single-process; multi-process is rejected at runtime by
+    # the aggregator where process_count is known)
+    config_mod.Config(
+        mesh_devices=2,
+        sketch_family_rules=[{"match": "a*",
+                              "family": "moments"}]).apply_defaults()
+    # compactor + mesh stays rejected at boot
     with pytest.raises(ValueError, match="mesh"):
         config_mod.Config(
             mesh_devices=2,
             sketch_family_rules=[{"match": "a*",
-                                  "family": "moments"}]).apply_defaults()
+                                  "family": "compactor"}]).apply_defaults()
     with pytest.raises(ValueError, match="unknown sketch family"):
         config_mod.Config(
             sketch_family_default="req").apply_defaults()
@@ -542,3 +550,81 @@ def test_mixed_family_testbed_cell_conserves_exactly():
         2 * 2 * 3                           # keys x intervals x pctiles
     assert report["conservation"]["counters_exact"]
     assert report["conservation"]["sets_exact"]
+
+
+# ---------------------------------------------------------------------------
+# meshed maxent solver: key-axis sharding bit-parity (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def _mesh_flush_inputs(rng, u=24, d=64, k=8):
+    dv = rng.lognormal(0.5, 1.0, (u, d)).astype(np.float32)
+    dw = np.ones((u, d), np.float32)
+    dep = np.full(u, d, np.int16)
+    a, b = dv.min(axis=1), dv.max(axis=1)
+    ab = np.stack([a, b]).astype(np.float32)
+    lab = np.stack([np.log(a), np.log(b)]).astype(np.float32)
+    imp = np.zeros((u, 2 * (k + 1)), np.float32)
+    return dv, dw, dep, ab, lab, imp
+
+
+@pytest.mark.parametrize("ndev", [2, 8])
+def test_meshed_moments_flush_bit_parity(ndev):
+    """The key-axis-sharded solver must return the SAME BITS as the
+    unmeshed program — both the general and uniform-depth variants.
+    The solver is row-local, so the only parity hazards are batch-
+    shape-dependent lowerings (the reason _chol_solve replaced
+    jnp.linalg.solve); any regression there lands here first."""
+    import jax
+    from veneur_tpu.parallel import mesh as mesh_mod
+    if len(jax.devices()) < ndev:
+        pytest.skip(f"needs {ndev} devices")
+    rng = np.random.default_rng(19)
+    dv, dw, dep, ab, lab, imp = _mesh_flush_inputs(rng)
+    pct = np.asarray([0.5, 0.9, 0.99], np.float32)
+
+    base = me.make_moments_flush(8)
+    fn = me.make_moments_flush(8, mesh=mesh_mod.make_mesh(ndev))
+    out0 = np.asarray(base(dv, dw, ab, lab, imp, pct))
+    out1 = np.asarray(fn(dv, dw, ab, lab, imp, pct))
+    assert (out0 == out1).all(), np.abs(out0 - out1).max()
+    u0 = np.asarray(base.depth_variant(dv, dep, ab, lab, imp, pct))
+    u1 = np.asarray(fn.depth_variant(dv, dep, ab, lab, imp, pct))
+    assert (u0 == u1).all(), np.abs(u0 - u1).max()
+
+
+def test_meshed_moments_flush_pads_ragged_rows():
+    """Row counts that don't divide the device count zero-pad
+    in-program and slice back; the visible rows still match the
+    unmeshed program bit-for-bit."""
+    import jax
+    from veneur_tpu.parallel import mesh as mesh_mod
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    rng = np.random.default_rng(7)
+    dv, dw, _, ab, lab, imp = _mesh_flush_inputs(rng, u=13)
+    pct = np.asarray([0.5, 0.99], np.float32)
+    base = me.make_moments_flush(8)
+    fn = me.make_moments_flush(8, mesh=mesh_mod.make_mesh(8))
+    out0 = np.asarray(base(dv, dw, ab, lab, imp, pct))
+    out1 = np.asarray(fn(dv, dw, ab, lab, imp, pct))
+    assert out1.shape == out0.shape
+    assert (out0 == out1).all(), np.abs(out0 - out1).max()
+
+
+def test_chol_solve_is_batch_shape_stable():
+    """The unrolled Cholesky must give identical bits for a row whether
+    it's solved in a batch of 3 or sliced from a batch of 24 — the
+    property LAPACK batched LU lacks and mesh parity stands on."""
+    import jax
+    rng = np.random.default_rng(0)
+    n = 9
+    h = rng.normal(0, 1, (24, n, n)).astype(np.float32)
+    h = h @ h.transpose(0, 2, 1) + 3 * np.eye(n, dtype=np.float32)
+    g = rng.normal(0, 1, (24, n)).astype(np.float32)
+    f = jax.jit(me._chol_solve)
+    full = np.asarray(f(h, g))
+    part = np.asarray(f(h[:3], g[:3]))
+    assert (full[:3] == part).all()
+    # and it actually solves: residual at f32 scale
+    r = np.einsum("uij,uj->ui", h, full) - g
+    assert np.abs(r).max() < 1e-3, np.abs(r).max()

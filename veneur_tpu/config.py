@@ -158,21 +158,35 @@ class Config:
     tdigest_compression: float = 100.0
     # sketch-family dispatch (core/aggregator.py): per-key choice of
     # the histogram/timer sketch — "tdigest" (default; centroid sets,
-    # sort-network flush) or "moments" (fixed-size moment vectors,
-    # dense segmented-sum flush + maxent solver — a fundamentally
-    # cheaper merge for high-cardinality/low-accuracy tiers; error
-    # envelopes per family are committed in
-    # analysis/tdigest_accuracy.csv).  Rules match at ingest, first
-    # hit wins; each entry is {match: <name glob>, family: ...} or
-    # {tenant: <tenant-tag value>, family: ...}.  Imports route by the
-    # wire payload itself, so tiers with different rules still merge
-    # every sketch into its own family.  Single-device tiers only.
+    # sort-network flush), "moments" (fixed-size moment vectors, dense
+    # segmented-sum flush + maxent solver — a fundamentally cheaper
+    # merge for high-cardinality/low-accuracy tiers) or "compactor"
+    # (relative-error adaptive-compactor ladders, batched Pallas
+    # compaction — provable rank-error envelopes where the empirical
+    # families only measure theirs; error envelopes per family are
+    # committed in analysis/tdigest_accuracy.csv).  Rules match at
+    # ingest, first hit wins; each entry is {match: <name glob>,
+    # family: ...} or {tenant: <tenant-tag value>, family: ...}.
+    # Imports route by the wire payload itself, so tiers with
+    # different rules still merge every sketch into its own family.
+    # Mesh policy is per family: moments shards its maxent solve over
+    # the key axis (single-process meshes), compactor is single-device
+    # only.
     sketch_family_default: str = "tdigest"
     sketch_family_rules: list = field(default_factory=list)
     # power-sum order k of the moments vector (6 + 2k doubles per key;
     # every tier of a fleet must agree — vectors of different k refuse
     # to merge)
     sketch_moments_k: int = 8
+    # adaptive-compactor ladder geometry (sketches/compactor.py): cap
+    # is the per-level buffer capacity (a power of two in [8, 256];
+    # 0 = built-in default), levels the ladder height (0 = default),
+    # seed the stride-select coin seed.  Every tier of a fleet must
+    # agree on all three — the importer prechecks and refuses
+    # mismatched ladders rather than merging garbage.
+    sketch_compactor_cap: int = 0
+    sketch_compactor_levels: int = 0
+    sketch_compactor_seed: int = 0
     set_precision: int = 14
     # live query plane (veneur_tpu/query/): each histogram arena keeps
     # a bounded ring of query_window_slots per-interval mergeable
@@ -507,35 +521,45 @@ class Config:
             raise ValueError(
                 "digest_bf16_staging is unsupported with a device mesh "
                 "(the meshed flush program is f32-native); drop one")
+        _FAMS = ("tdigest", "moments", "compactor")
         for fam in (self.sketch_family_default,
                     self.cardinality_rollup_family):
-            if fam not in ("tdigest", "moments"):
+            if fam not in _FAMS:
                 raise ValueError(
                     f"unknown sketch family {fam!r} "
-                    "(tdigest | moments)")
+                    "(tdigest | moments | compactor)")
         for rule in self.sketch_family_rules:
             if not isinstance(rule, dict) \
-                    or rule.get("family", "moments") not in ("tdigest",
-                                                             "moments") \
+                    or rule.get("family", "moments") not in _FAMS \
                     or not (rule.get("match") or rule.get("tenant")):
                 raise ValueError(
                     f"bad sketch_family rule {rule!r}: need "
                     "{match: <glob> | tenant: <t>, family: "
-                    "tdigest|moments}")
+                    "tdigest|moments|compactor}")
         if self.sketch_moments_k < 2 or self.sketch_moments_k > 16:
             raise ValueError(
                 f"sketch_moments_k {self.sketch_moments_k} out of "
                 "range [2, 16] (the maxent solve conditions past 16)")
-        family_dispatch = (self.sketch_family_rules
-                           or self.sketch_family_default == "moments"
-                           or (self.cardinality_rollup_family
-                               == "moments"
-                               and self.cardinality_key_budget > 0))
-        if family_dispatch and self.mesh_devices:
+        cap = self.sketch_compactor_cap
+        if cap and (cap < 8 or cap > 256 or cap & (cap - 1)):
             raise ValueError(
-                "sketch_family_* dispatch is unsupported with a device "
-                "mesh (mesh_devices > 0): the moments flush program is "
-                "single-device — drop one")
+                f"sketch_compactor_cap {cap} must be a power of two "
+                "in [8, 256] (or 0 for the built-in default)")
+        lv = self.sketch_compactor_levels
+        if lv and (lv < 4 or lv > 32):
+            raise ValueError(
+                f"sketch_compactor_levels {lv} out of range [4, 32] "
+                "(or 0 for the built-in default)")
+        fams_in_play = {self.sketch_family_default}
+        fams_in_play.update(rule.get("family", "moments")
+                            for rule in self.sketch_family_rules)
+        if self.cardinality_key_budget > 0:
+            fams_in_play.add(self.cardinality_rollup_family)
+        if "compactor" in fams_in_play and self.mesh_devices:
+            raise ValueError(
+                "the compactor sketch family is unsupported with a "
+                "device mesh (mesh_devices > 0): its batched "
+                "compaction program is single-device — drop one")
         if self.cube_group_budget < 0:
             self.cube_group_budget = 0
         if self.cube_dimensions:

@@ -118,6 +118,9 @@ class MetricAggregator:
                  sketch_family_default: str = "tdigest",
                  sketch_family_rules: Optional[list] = None,
                  sketch_moments_k: int = 0,
+                 sketch_compactor_cap: int = 0,
+                 sketch_compactor_levels: int = 0,
+                 sketch_compactor_seed: int = 0,
                  cardinality_rollup_family: str = "tdigest",
                  query_window_slots: int = 0,
                  query_slot_seconds: float = 0.0,
@@ -196,26 +199,31 @@ class MetricAggregator:
             resident_device_assembly=resident_device_assembly,
             **kw)
         # sketch-family dispatch (ROADMAP #3): per-key choice of
-        # tdigest vs moments for histogram/timer samples.  Rules match
-        # at ingest (first hit wins: name glob or tenant tag); imports
-        # route by the PAYLOAD (a moments vector merges into the
-        # moments arena whatever the local rules say — wire
-        # self-description beats configuration, so a rules mismatch
-        # across tiers degrades to per-tier family choice instead of
-        # corrupting either sketch).  The moments arena always exists
-        # (imports may deliver vectors regardless of local rules); the
-        # dispatch fast path is one bool when no rule can ever fire.
+        # tdigest vs moments vs compactor for histogram/timer samples.
+        # Rules match at ingest (first hit wins: name glob or tenant
+        # tag); imports route by the PAYLOAD (a moments vector or a
+        # compactor ladder merges into ITS arena whatever the local
+        # rules say — wire self-description beats configuration, so a
+        # rules mismatch across tiers degrades to per-tier family
+        # choice instead of corrupting any sketch).  The moments and
+        # compactor arenas always exist (imports may deliver their
+        # payloads regardless of local rules); the dispatch fast path
+        # is one bool when no rule can ever fire.
+        _FAMS = ("tdigest", "moments", "compactor")
         for fam in (sketch_family_default, cardinality_rollup_family):
-            if fam not in ("tdigest", "moments"):
+            if fam not in _FAMS:
                 raise ValueError(
                     f"unknown sketch family {fam!r} "
-                    "(tdigest | moments)")
-        self._fam_default_moments = sketch_family_default == "moments"
-        self._rollup_moments = cardinality_rollup_family == "moments"
+                    "(tdigest | moments | compactor)")
+        self._fam_default = sketch_family_default
+        self._rollup_family = cardinality_rollup_family
         self._fam_rules = []
+        fams_in_play = {sketch_family_default}
+        if cardinality_key_budget > 0:
+            fams_in_play.add(cardinality_rollup_family)
         for r in (sketch_family_rules or []):
             fam = r.get("family", "moments")
-            if fam not in ("tdigest", "moments"):
+            if fam not in _FAMS:
                 raise ValueError(
                     f"unknown sketch family {fam!r} in rule {r!r}")
             if not (r.get("match") or r.get("tenant")):
@@ -223,15 +231,25 @@ class MetricAggregator:
                     f"sketch_family rule needs match: or tenant:, "
                     f"got {r!r}")
             self._fam_rules.append((r.get("match"), r.get("tenant"),
-                                    fam == "moments"))
+                                    fam))
+            fams_in_play.add(fam)
         self.family_dispatch = bool(
-            self._fam_rules or self._fam_default_moments
-            or (self._rollup_moments and cardinality_key_budget > 0))
-        if self.family_dispatch and mesh is not None:
+            self._fam_rules or self._fam_default != "tdigest"
+            or (self._rollup_family != "tdigest"
+                and cardinality_key_budget > 0))
+        if mesh is not None and "compactor" in fams_in_play:
+            raise ValueError(
+                "the compactor sketch family is unsupported with a "
+                "device mesh (its fold/flush programs are "
+                "single-device); drop one")
+        if (self.family_dispatch and mesh is not None
+                and jax.process_count() > 1):
+            # single-process meshes shard the moments solver over the
+            # key axis (ops/moments_eval.py); the multi-process
+            # lockstep gather covers the digest program only
             raise ValueError(
                 "sketch_family_* dispatch is unsupported with a "
-                "device mesh (the moments flush program is "
-                "single-device); drop one")
+                "multi-process mesh; drop one")
         self._fam_cache: dict = {}
         # pre-size only when the dispatch can actually route keys here
         # (the ivec plane is f64 and capacity-sized)
@@ -242,9 +260,23 @@ class MetricAggregator:
             resident_device_assembly=resident_device_assembly,
             **(kw if self.family_dispatch else {}))
         from veneur_tpu.ops import moments_eval
+        # the solver is row-local, so a (single-process) mesh shards it
+        # over the key axis — bit-parity with the unmeshed program is
+        # test-pinned (tests/test_moments.py)
         self.moments_fn = moments_eval.make_moments_flush(
-            self.moments.k)
+            self.moments.k,
+            mesh=mesh if jax.process_count() == 1 else None)
         self.last_moments_resid = 0.0
+        # relative-error compactor family (ROADMAP #4): always exists —
+        # payload-routed imports can land ladders on any tier — but
+        # pre-sizes only when dispatch can route raw samples here
+        self.compactors = arena_mod.CompactorArena(
+            cap=sketch_compactor_cap, levels=sketch_compactor_levels,
+            seed=sketch_compactor_seed, mesh=None,
+            **(kw if self.family_dispatch else {}))
+        from veneur_tpu.ops import compactor_eval
+        self.compactor_fn = compactor_eval.make_compactor_flush(
+            self.compactors.cc_cap, self.compactors.cc_levels)
         self.sets = arena_mod.SetArena(precision=set_precision, mesh=mesh,
                                        legacy_migration=hll_legacy_migration,
                                        resident=resident_unmeshed,
@@ -329,7 +361,9 @@ class MetricAggregator:
                 "tdigest": WindowRing(query_window_slots,
                                       query_slot_seconds),
                 "moments": WindowRing(query_window_slots,
-                                      query_slot_seconds)}
+                                      query_slot_seconds),
+                "compactor": WindowRing(query_window_slots,
+                                        query_slot_seconds)}
 
     # -- ingest (ProcessMetric, worker.go:348-396) -------------------------
 
@@ -357,11 +391,12 @@ class MetricAggregator:
 
     _FAM_CACHE_CAP = 65536
 
-    def _family_is_moments(self, key: MetricKey, tags) -> bool:
-        """Family choice for one histogram/timer key: rollup identities
-        follow cardinality_rollup_family, then the first matching rule
-        (name glob / tenant tag), then the default.  Memoized on the
-        key identity (bounded; a cardinality storm of fresh identities
+    def _family_of(self, key: MetricKey, tags) -> str:
+        """Family choice for one histogram/timer key ("tdigest" |
+        "moments" | "compactor"): rollup identities follow
+        cardinality_rollup_family, then the first matching rule (name
+        glob / tenant tag), then the default.  Memoized on the key
+        identity (bounded; a cardinality storm of fresh identities
         falls back to uncached evaluation instead of growing the
         memo)."""
         ck = (key.name, key.joined_tags)
@@ -370,32 +405,40 @@ class MetricAggregator:
             return hit
         from veneur_tpu.core.cardinality import ROLLUP_TAG
         if ROLLUP_TAG in tags:
-            fam = self._rollup_moments
+            fam = self._rollup_family
         else:
-            fam = self._fam_default_moments
+            fam = self._fam_default
             import fnmatch
-            for pattern, tenant, is_moments in self._fam_rules:
+            for pattern, tenant, rfam in self._fam_rules:
                 if pattern is not None:
                     if fnmatch.fnmatchcase(key.name, pattern):
-                        fam = is_moments
+                        fam = rfam
                         break
                 elif tenant is not None:
                     if f"tenant:{tenant}" in tags:
-                        fam = is_moments
+                        fam = rfam
                         break
         if len(self._fam_cache) < self._FAM_CACHE_CAP:
             self._fam_cache[ck] = fam
         return fam
 
+    def _family_is_moments(self, key: MetricKey, tags) -> bool:
+        return self._family_of(key, tags) == "moments"
+
     def _histo_arena(self, key: MetricKey, tags):
         """The arena a histogram/timer key's RAW SAMPLES land in (call
         after _card_resolve, so rollup identities route by the rollup
         family).  Imports do NOT come through here — a wire payload is
-        self-describing (digest centroids vs moments vector)."""
+        self-describing (digest centroids vs moments vector vs
+        compactor ladder)."""
         if not self.family_dispatch:
             return self.digests
-        return (self.moments if self._family_is_moments(key, tags)
-                else self.digests)
+        fam = self._family_of(key, tags)
+        if fam == "moments":
+            return self.moments
+        if fam == "compactor":
+            return self.compactors
+        return self.digests
 
     def _process_locked(self, m: UDPMetric) -> None:
         self.processed += 1
@@ -503,6 +546,15 @@ class MetricAggregator:
                     #   host list off the protobuf — never a device
                     #   array; merge_moments is pure host numpy)
                     self.moments.merge_moments(row, fm.moments)
+                elif fm.compactor is not None:
+                    # same payload-routing contract for the compactor
+                    # family: the ladder merges by concatenate-then-
+                    # compact with the coin schedule continued from the
+                    # summed counters (deterministic, order-free)
+                    row = self.compactors.row_for(key, cls, tags)
+                    # vnlint: disable=blocking-propagation (wire
+                    #   vector off the protobuf; host numpy merge)
+                    self.compactors.merge_compactor(row, fm.compactor)
                 else:
                     row = self.digests.row_for(key, cls, tags)
                     self.digests.merge_digest(
@@ -640,6 +692,13 @@ class MetricAggregator:
         key, cls, tags = self._card_resolve(
             MetricKey(pb.name, kind, joined), cls, tags)
         dig = pb.histogram.t_digest
+        if dig.compression <= -1024:
+            # compactor-family wire marker (forward/convert.py): the
+            # centroid means ARE the f64 ladder vector
+            row = self.compactors.row_for(key, cls, tags)
+            self.compactors.merge_compactor(
+                row, [c.mean for c in dig.main_centroids])
+            return
         if dig.compression < 0:
             # moments-family wire marker (forward/convert.py): the
             # centroid means ARE the f64 moments vector
@@ -781,6 +840,7 @@ class MetricAggregator:
                 min_samples = 4096
             if (self.digests.staged_count()
                     + self.moments.staged_count()
+                    + self.compactors.staged_count()
                     + self.sets.staged_count() < min_samples):
                 return False
             # vnlint: disable=blocking-propagation (arena sync IS the
@@ -791,6 +851,8 @@ class MetricAggregator:
             # vnlint: disable=blocking-propagation (same as above:
             #   host staging consolidation, no device wait)
             self.moments.sync()
+            # vnlint: disable=blocking-propagation (same as above)
+            self.compactors.sync()
             # vnlint: disable=blocking-propagation (same as above)
             self.sets.sync()
             if self.flush_resident:
@@ -805,8 +867,8 @@ class MetricAggregator:
 
     # -- crash checkpoint (core/checkpoint.py) -----------------------------
 
-    _FAMILIES = ("digests", "moments", "sets", "counters", "gauges",
-                 "status")
+    _FAMILIES = ("digests", "moments", "compactors", "sets",
+                 "counters", "gauges", "status")
 
     def checkpoint_state(self) -> tuple[dict, dict]:
         """One coherent cut of every arena (plus unique-ts registers and
@@ -821,6 +883,8 @@ class MetricAggregator:
             self.digests.sync()
             # vnlint: disable=blocking-propagation (same as above)
             self.moments.sync()
+            # vnlint: disable=blocking-propagation (same as above)
+            self.compactors.sync()
             # vnlint: disable=blocking-propagation (same as above)
             self.sets.sync()
             meta: dict = {"processed": self.processed,
@@ -920,6 +984,7 @@ class MetricAggregator:
         # segment times to interval size
         seg["keys_digest"] = len(snap["digests"]["rows"])
         seg["keys_moments"] = len(snap["moments"]["rows"])
+        seg["keys_compactor"] = len(snap["compactors"]["rows"])
         seg["keys_counter"] = len(snap["counters"]["rows"])
         seg["keys_set"] = len(snap["sets"]["rows"])
         # the window-ring cut timestamp is taken HERE (the cut), but
@@ -941,6 +1006,7 @@ class MetricAggregator:
         idle = (not multi_mesh
                 and len(snap["digests"]["rows"]) == 0
                 and len(snap["moments"]["rows"]) == 0
+                and len(snap["compactors"]["rows"]) == 0
                 and len(snap["sets"]["rows"]) == 0
                 and len(snap["counters"]["rows"]) == 0
                 and (not snap["have_uts"]
@@ -988,6 +1054,7 @@ class MetricAggregator:
         self._emit_sets(res, snap, host, is_local, now)
         self._emit_digests(res, snap, host, is_local, now)
         self._emit_moments(res, snap, host, is_local, now)
+        self._emit_compactors(res, snap, host, is_local, now)
         if "m_resid" in host and len(host["m_resid"]):
             # solver-convergence observability (sketch.* self-metrics)
             self.last_moments_resid = float(
@@ -1009,6 +1076,8 @@ class MetricAggregator:
             cut_ts = snap["query_cut_ts"]
             self.query_rings["tdigest"].rotate(snap["digests"], cut_ts)
             self.query_rings["moments"].rotate(snap["moments"], cut_ts)
+            self.query_rings["compactor"].rotate(snap["compactors"],
+                                                 cut_ts)
         return res
 
     @staticmethod
@@ -1158,6 +1227,22 @@ class MetricAggregator:
                 md.lower(m_dv, m_dep, m_ab, m_lab, m_imp,
                          self._pct_arr).compile()
             n += 1
+            # compactor family: the read-off shape depends on keys
+            # only (ladder state replaces staged depth), so one
+            # program per key bucket, skipped on depth repeats
+            if ("compactor", u_pad) not in self._compiled_shapes:
+                c_cap = self.compactors.cc_cap
+                c_lv = self.compactors.cc_levels
+                c_cv = jax.ShapeDtypeStruct((u_pad, c_lv * c_cap),
+                                            np.float32)
+                c_cc = jax.ShapeDtypeStruct((u_pad, c_lv), np.int32)
+                c_cs = jax.ShapeDtypeStruct((u_pad,), np.float32)
+                c_mm = jax.ShapeDtypeStruct((2, u_pad), np.float32)
+                with self._CompileGuard(self, ("compactor", u_pad)):
+                    self.compactor_fn.lower(
+                        c_cv, c_cc, c_cs, c_mm,
+                        self._pct_arr).compile()
+                n += 1
         return n
 
     def _dispatch_flush(self, snap: dict, is_local: bool) -> dict:
@@ -1178,11 +1263,14 @@ class MetricAggregator:
         nd = len(dpart["rows"])
         seg = self.last_flush_segments
         pend: dict = {"nd": nd, "meshed": self.mesh is not None}
-        # the moments family launches its own (single-device) program —
-        # a dense segmented-sum merge + batched maxent solve, a
-        # different compute class from the digest sort network — so it
-        # dispatches first and its kernel overlaps the digest staging
+        # the moments family launches its own program — a dense
+        # segmented-sum merge + batched maxent solve, a different
+        # compute class from the digest sort network — so it dispatches
+        # first and its kernel overlaps the digest staging; the
+        # compactor read-off (a third compute class: implied-weight
+        # eval of folded ladder state) rides the same overlap
         pend["moments"] = self._dispatch_moments(snap)
+        pend["compactors"] = self._dispatch_compactors(snap)
         if self.mesh is None:
             spart = snap["sets"]
             if self.sets.host_regs is None and len(spart["rows"]):
@@ -1363,8 +1451,8 @@ class MetricAggregator:
                 from jax.experimental import multihost_utils
                 local_depth = self.digests.staged_depth(dpart["staged"])
                 fams = snap["key_fingerprints"]   # lock-coherent snapshot
-                names = ("digest", "moments", "counter", "gauge", "set",
-                         "status")
+                names = ("digest", "moments", "compactor", "counter",
+                         "gauge", "set", "status")
                 cks = np.asarray(
                     [fams[n][0] for n in names]
                     + [fams[n][1] for n in names],
@@ -1544,6 +1632,37 @@ class MetricAggregator:
         seg["m_dispatch_s"] = time.perf_counter() - t0
         return {"out": out, "nm": nm}
 
+    def _dispatch_compactors(self, snap: dict) -> Optional[dict]:
+        """Fold and LAUNCH the compactor-family read-off on the
+        snapshot (outside the lock): the interval's staged points fold
+        into the snapshot ladder states in batched compact_batch
+        rounds (arena.fold_flush — cached in the part, shared with
+        forwarding export and the query plane), then ONE program
+        evaluates every touched key's quantiles from the implied
+        ``2**level`` item weights (ops/compactor_eval.py).  Counts and
+        sums come exact from the host scalar accumulators.  Returns
+        None when no compactor rows were touched."""
+        part = snap["compactors"]
+        nc = len(part["rows"])
+        if nc == 0:
+            return None
+        seg = self.last_flush_segments
+        cp = self.compactors
+        t0 = time.perf_counter()
+        u_pad = arena_mod._pow2(max(nc, 2))
+        cv, cc, cscale, mm = cp.flush_operands(part, part["staged"],
+                                               u_pad)
+        seg["c_build_s"] = time.perf_counter() - t0
+        seg["upload_bytes"] = (seg.get("upload_bytes", 0) + cv.nbytes
+                               + cc.nbytes + cscale.nbytes + mm.nbytes)
+        t0 = time.perf_counter()
+        cvd, ccd, csd, mmd = (jnp.asarray(cv), jnp.asarray(cc),
+                              jnp.asarray(cscale), jnp.asarray(mm))
+        with self._CompileGuard(self, ("compactor", u_pad)):
+            out = self.compactor_fn(cvd, ccd, csd, mmd, self._pct_arr)
+        seg["c_dispatch_s"] = time.perf_counter() - t0
+        return {"out": out, "nc": nc}
+
     def _fetch_flush(self, snap: dict, pend: dict, seg: dict) -> dict:
         """Wait on a dispatched flush's device outputs and read them
         back as host numpy — the ONLY place a flush blocks on the
@@ -1563,6 +1682,14 @@ class MetricAggregator:
                                      + mout.nbytes)
             host["m_qs"] = mout[:mp["nm"], :n_cols]
             host["m_resid"] = mout[:mp["nm"], -1]
+        cpend = pend.get("compactors")
+        if cpend is not None:
+            t0 = time.perf_counter()
+            cout = serving.fetch(cpend["out"])
+            seg["c_device_s"] = time.perf_counter() - t0
+            seg["readback_bytes"] = (seg.get("readback_bytes", 0)
+                                     + cout.nbytes)
+            host["comp_qs"] = cout[:cpend["nc"], :n_cols]
         if not pend["meshed"]:
             if "set_rows_dev" in pend:
                 # resident set registers: exact u8 readback of the
@@ -1664,6 +1791,7 @@ class MetricAggregator:
         self._import_row_cache.clear()
         d.sync()
         self.moments.sync()
+        self.compactors.sync()
         s.sync()
         snap = {"counts": (self.processed, self.imported)}
         self.processed = 0
@@ -1825,6 +1953,37 @@ class MetricAggregator:
             "iv_b": m.iv_b[mrows].copy(),
         }
 
+        cp = self.compactors
+        prows = cp.touched_rows()
+        cp_staged = cp.take_staged()
+        snap["compactors"] = {
+            "rows": prows,
+            "names": cp.name_col[prows],
+            "name_hashes": cp.name_hash_col[prows].copy(),
+            "tags": cp.tags_col[prows],
+            "kinds": cp.kind_col[prows],
+            "scopes": cp.scope_col[prows].copy(),
+            # staged points fold into the SNAPSHOT ladder copies at
+            # dispatch (arena.fold_flush, outside the lock); the live
+            # ladders reset below, so an overlapping interval can
+            # never alias the in-flight fold
+            "staged": cp_staged,
+            "cvals": cp.cvals[prows].copy(),
+            "ccnt": cp.ccnt[prows].copy(),
+            "ccomps": cp.ccomps[prows].copy(),
+            "cclip": cp.cclip[prows].copy(),
+            "l_weight": cp.l_weight[prows].copy(),
+            "l_min": cp.l_min[prows].copy(),
+            "l_max": cp.l_max[prows].copy(),
+            "l_sum": cp.l_sum[prows].copy(),
+            "l_rsum": cp.l_rsum[prows].copy(),
+            "d_min": cp.d_min[prows].copy(),
+            "d_max": cp.d_max[prows].copy(),
+            "d_rsum": cp.d_rsum[prows].copy(),
+            "d_weight": cp.d_weight[prows].copy(),
+            "d_sum": cp.d_sum[prows].copy(),
+        }
+
         # key-dictionary fingerprints for the multi-controller lockstep
         # gather — snapshotted HERE, under the lock and before the GC in
         # end_interval, so the flush gathers one coherent (keyset,
@@ -1834,6 +1993,7 @@ class MetricAggregator:
         snap["key_fingerprints"] = {
             "digest": (d.keyset_checksum, d.key_checksum),
             "moments": (m.keyset_checksum, m.key_checksum),
+            "compactor": (cp.keyset_checksum, cp.key_checksum),
             "counter": (c.keyset_checksum, c.key_checksum),
             "gauge": (g.keyset_checksum, g.key_checksum),
             "set": (s.keyset_checksum, s.key_checksum),
@@ -1843,7 +2003,8 @@ class MetricAggregator:
         for ar, rows in ((c, crows),
                          (g, snap["gauges"]["rows"]),
                          (st, snap["status"]["rows"]),
-                         (s, srows), (d, drows), (m, mrows)):
+                         (s, srows), (d, drows), (m, mrows),
+                         (cp, prows)):
             ar.reset_rows(rows)
             ar.end_interval()
         if self.cardinality is not None:
@@ -1860,12 +2021,15 @@ class MetricAggregator:
         if mtype == sm.TYPE_SET:
             return self.sets
         # histogram / timer: family dispatch decides (the cardinality
-        # release path passes the key so evicted moments rows release
-        # from the arena that actually holds them)
+        # release path passes the key so evicted moments/compactor
+        # rows release from the arena that actually holds them)
         if key is not None and self.family_dispatch:
             tags = key.joined_tags.split(",") if key.joined_tags else []
-            if self._family_is_moments(key, tags):
+            fam = self._family_of(key, tags)
+            if fam == "moments":
                 return self.moments
+            if fam == "compactor":
+                return self.compactors
         return self.digests
 
     def _cardinality_end_interval(self) -> None:
@@ -1885,11 +2049,14 @@ class MetricAggregator:
                     # release from the arena that ACTUALLY holds the
                     # key, not the one the rules would pick today:
                     # payload-routed imports can land a key in the
-                    # moments arena on a tier whose rules say tdigest
-                    # (the supported cross-tier rules-mismatch), and a
-                    # rules-derived release would silently skip it
+                    # moments/compactor arena on a tier whose rules
+                    # say tdigest (the supported cross-tier
+                    # rules-mismatch), and a rules-derived release
+                    # would silently skip it
                     if dk in self.moments.kdict:
                         arena = self.moments
+                    elif dk in self.compactors.kdict:
+                        arena = self.compactors
                     elif dk in self.digests.kdict:
                         arena = self.digests
                 by_arena.setdefault(id(arena), (arena, []))[1].append(dk)
@@ -1918,6 +2085,8 @@ class MetricAggregator:
                 # across restarts must not skip a release)
                 if dk in self.moments.kdict:
                     arena = self.moments
+                elif dk in self.compactors.kdict:
+                    arena = self.compactors
                 elif dk in self.digests.kdict:
                     arena = self.digests
                 else:
@@ -2131,6 +2300,40 @@ class MetricAggregator:
                     name=bases[i], tags=tags[i], kind=kinds[i],
                     scope=MetricScope(int(scopes[i])),
                     moments=vecs[j].tolist()))
+        self._emit_histo_aggregates(res, part, qs, counts, sums,
+                                    is_local, now, forwarded)
+
+    def _emit_compactors(self, res, snap, host, is_local, now):
+        """Compactor-family emission: the same aggregate/percentile
+        surface as the other histogram families, with forwarding as
+        wire ladder VECTORS (self-describing header + level items —
+        the folded flush state, shared with the eval via
+        arena.fold_flush's part cache)."""
+        part = snap["compactors"]
+        rows = part["rows"]
+        if len(rows) == 0:
+            return
+        n = len(rows)
+        qs = host["comp_qs"]
+        counts = np.asarray(part["d_weight"], np.float64)
+        sums = np.asarray(part["d_sum"], np.float64)
+        if is_local:
+            forwarded = part["scopes"] != int(MetricScope.LOCAL_ONLY)
+        else:
+            forwarded = np.zeros(n, bool)
+        if forwarded.any():
+            fidx = np.nonzero(forwarded)[0]
+            vecs = self.compactors.assemble_vectors(
+                part, part["staged"], fidx)
+            bases = part["names"].tolist()
+            tags = part["tags"].tolist()
+            kinds = part["kinds"]
+            scopes = part["scopes"]
+            for j, i in enumerate(fidx.tolist()):
+                res.forward.append(sm.ForwardMetric(
+                    name=bases[i], tags=tags[i], kind=kinds[i],
+                    scope=MetricScope(int(scopes[i])),
+                    compactor=vecs[j].tolist()))
         self._emit_histo_aggregates(res, part, qs, counts, sums,
                                     is_local, now, forwarded)
 

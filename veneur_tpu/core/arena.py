@@ -2095,3 +2095,345 @@ class MomentsArena(DigestArena):
             self.ivec[rows] = arrays["ivec"]
             self.iv_a[rows] = arrays["iv_a"]
             self.iv_b[rows] = arrays["iv_b"]
+
+
+class CompactorArena(DigestArena):
+    """The relative-error compactor family (sketches/compactor.py): each
+    row is one fixed ladder of ``levels`` compactor buffers of ``cap``
+    slots — the provable-rank-error tier (ROADMAP #4, README "Sketch
+    families") operators pick by rule for SLA-grade tails, next to the
+    empirical t-digest (DigestArena) and the cheap-merge moments family
+    (MomentsArena).
+
+    Shares DigestArena's whole staging machinery — COO buffers, native
+    chunk staging, interval consolidation, the exact host scalar
+    accumulators — and adds the per-row ladder state:
+
+      cvals   ``[capacity, levels, cap]`` f32 level items (occupied
+              prefix per level, zero padding beyond ``ccnt``)
+      ccnt    ``[capacity, levels]`` per-level occupancies
+      ccomps / cclip   per-row compaction / clip counters (the coin
+              schedule position — what makes merges replayable)
+
+    The interval's staged samples fold into the ladders in batched
+    ROUNDS of ops/compactor_eval.compact_batch — each round is ONE
+    device launch compacting every pending row at once, the host only
+    assembles level staging and plans the coin schedule between rounds
+    (compactor.plan_pass).  The fold runs mid-interval when a row's
+    backlog outgrows DENSE_DEPTH_CAP (_pre_reduce) and at flush on the
+    snapshot (fold_flush); values round to f32 on entry so the host
+    reference, the XLA twin and the Pallas kernel replay
+    bit-identically — the checkpoint/restore parity contract.
+
+    Unmeshed only, like moments: one flush program per device, no
+    cross-shard collective in the family's merge algebra yet."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY,
+                 cap: int = 0, levels: int = 0, seed: int = 0,
+                 mesh=None, **kw):
+        from veneur_tpu.sketches import compactor as cs
+        if mesh is not None:
+            raise ValueError(
+                "the compactor sketch family serves unmeshed tiers "
+                "only (its fold/flush programs are single-device; "
+                "drop mesh_devices or the sketch_family_* rules)")
+        kw.pop("compression", None)
+        kw.pop("bf16_staging", None)
+        # no dense matrix build at flush -> nothing for the resident
+        # delta mirror to amortize
+        kw.pop("resident", None)
+        kw.pop("resident_chunk_points", None)
+        kw.pop("resident_device_assembly", None)
+        super().__init__(capacity=capacity, mesh=None, **kw)
+        self.cc_cap = int(cap) if cap else cs.DEFAULT_CAP
+        self.cc_levels = int(levels) if levels else cs.DEFAULT_LEVELS
+        self.cc_seed = int(seed) if seed else cs.DEFAULT_SEED
+        if (self.cc_cap < 8 or self.cc_cap & (self.cc_cap - 1)
+                or self.cc_levels < 2):
+            raise ValueError(
+                f"bad compactor params cap={self.cc_cap} "
+                f"levels={self.cc_levels} (cap must be a power of two "
+                ">= 8, levels >= 2)")
+        self.cvals = np.zeros(
+            (capacity, self.cc_levels, self.cc_cap), np.float32)
+        self.ccnt = np.zeros((capacity, self.cc_levels), np.int64)
+        self.ccomps = np.zeros(capacity, np.int64)
+        self.cclip = np.zeros(capacity, np.int64)
+
+    def _grow_state(self, old: int) -> None:
+        super()._grow_state(old)
+        self.cvals = np.concatenate(
+            [self.cvals,
+             np.zeros((old,) + self.cvals.shape[1:], np.float32)])
+        self.ccnt = np.concatenate(
+            [self.ccnt, np.zeros((old, self.cc_levels), np.int64)])
+        self.ccomps = np.concatenate([self.ccomps,
+                                      np.zeros(old, np.int64)])
+        self.cclip = np.concatenate([self.cclip, np.zeros(old, np.int64)])
+
+    # -- the batched fold (rounds of ONE compact_batch launch) -------------
+
+    def _fold_state(self, st: dict, srows: np.ndarray,
+                    svals: np.ndarray, swts: np.ndarray) -> None:
+        """Fold staged weighted points into ladder state arrays
+        ``st = {cvals, ccnt, comps, clip}`` (row space = whatever
+        ``srows`` indexes — the live capacity-sized arrays or a compact
+        snapshot).  Points enter in staged order per row; each round
+        feeds every pending row's level staging up to 2*cap and runs
+        one compact_batch over all of them, so the device launch count
+        is O(max backlog / cap), never O(rows)."""
+        from veneur_tpu.ops import compactor_eval as ce
+        from veneur_tpu.sketches import compactor as cs
+        if len(srows) == 0:
+            return
+        levels, cap = self.cc_levels, self.cc_cap
+        s2 = cs.STAGE_MUL * cap
+        # f32 value resolution on entry: the device fold and the host
+        # reference then agree bit-for-bit
+        v32 = np.clip(svals, -cs._FCLAMP, cs._FCLAMP).astype(
+            np.float32).astype(np.float64)
+        order = np.argsort(srows, kind="stable")
+        r_s, v_s = srows[order], v32[order]
+        w_s = np.asarray(swts, np.float64)[order]
+        uniq, starts = np.unique(r_s, return_index=True)
+        ends = np.append(starts[1:], len(r_s))
+        pending = []
+        for u0, s0, e0 in zip(uniq, starts, ends):
+            q = cs.split_levels(v_s[s0:e0], w_s[s0:e0], levels)
+            pending.append((int(u0), q, np.zeros(levels, np.int64)))
+        slot = np.arange(cap)[None, :]
+        while pending:
+            n = len(pending)
+            n_pad = max(8, _pow2(n))
+            stage_v = np.full((n_pad, levels, s2), np.inf)
+            stage_n = np.zeros((n_pad, levels), np.int64)
+            comps = np.zeros(n_pad, np.int64)
+            clip = np.zeros(n_pad, np.int64)
+            for i, (r, q, pos) in enumerate(pending):
+                comps[i] = st["comps"][r]
+                clip[i] = st["clip"][r]
+                for lvl in range(levels):
+                    occ = int(st["ccnt"][r, lvl])
+                    stage_v[i, lvl, :occ] = st["cvals"][r, lvl, :occ]
+                    take = min(s2 - occ, len(q[lvl]) - int(pos[lvl]))
+                    if take > 0:
+                        stage_v[i, lvl, occ:occ + take] = \
+                            q[lvl][pos[lvl]:pos[lvl] + take]
+                        pos[lvl] += take
+                    stage_n[i, lvl] = occ + take
+            off, cnt_out, comps_out, clip_out = cs.plan_pass(
+                stage_n, comps, clip, self.cc_seed, cap)
+            out = ce.compact_batch(stage_v, stage_n, off)
+            # zero the +inf padding back out (live-state convention)
+            out = np.where(slot[None, :, :] < cnt_out[:, :, None],
+                           out, 0.0).astype(np.float32)
+            nxt = []
+            for i, (r, q, pos) in enumerate(pending):
+                st["cvals"][r] = out[i]
+                st["ccnt"][r] = cnt_out[i]
+                st["comps"][r] = comps_out[i]
+                st["clip"][r] = clip_out[i]
+                if any(int(pos[lvl]) < len(q[lvl])
+                       for lvl in range(levels)):
+                    nxt.append((r, q, pos))
+            pending = nxt
+
+    def _live_state(self) -> dict:
+        return {"cvals": self.cvals, "ccnt": self.ccnt,
+                "comps": self.ccomps, "clip": self.cclip}
+
+    def _pre_reduce(self) -> None:
+        """Collapse rows deeper than DENSE_DEPTH_CAP by folding their
+        staged points into the ladder state — a compactor "compress"
+        is the fold itself, so nothing re-stages.  Scalars are NOT
+        re-applied (sync already did)."""
+        rows, vals, wts = self._consolidated()
+        deep = np.nonzero(self._depth > DENSE_DEPTH_CAP)[0]
+        if len(deep) == 0:
+            return
+        is_deep = np.zeros(self.capacity, bool)
+        is_deep[deep] = True
+        sel = is_deep[rows]
+        self._fold_state(self._live_state(), rows[sel], vals[sel],
+                         wts[sel])
+        keep = ~sel
+        self._acc = [(rows[keep], vals[keep], wts[keep])]
+        self._depth[deep] = 0
+
+    # -- imports (ladder merge: concatenate-then-compact) ------------------
+
+    def merge_compactor(self, row: int, vec) -> None:
+        """Fold one wire compactor vector into a row: exact scalar
+        merges plus a level-wise concatenate and ONE host compaction
+        pass (sketches/compactor.py contract — the coin continues from
+        the summed counters, so import order cannot change the bits).
+        Param (cap/levels/seed) mismatches are refused, never
+        coerced."""
+        from veneur_tpu.sketches import compactor as cs
+        vec = np.asarray(vec, np.float64)
+        params = cs.params_from_vector(vec)
+        if params != (self.cc_cap, self.cc_levels, self.cc_seed):
+            raise ValueError(
+                f"compactor vector params {params} do not match "
+                f"configured ({self.cc_cap}, {self.cc_levels}, "
+                f"{self.cc_seed}); mixed-param fleets are not "
+                "mergeable")
+        self.d_min[row] = min(self.d_min[row], vec[cs.IDX_MIN])
+        self.d_max[row] = max(self.d_max[row], vec[cs.IDX_MAX])
+        self.d_weight[row] += vec[cs.IDX_COUNT]
+        self.d_sum[row] += vec[cs.IDX_SUM]
+        self.d_rsum[row] += vec[cs.IDX_RSUM]
+        vb, cb, qb, lb = cs.state_from_vector(vec)
+        if not cb.any():
+            return
+        levels, cap = self.cc_levels, self.cc_cap
+        s2 = cs.STAGE_MUL * cap
+        stage_v = np.full((1, levels, s2), np.inf)
+        ca = self.ccnt[row]
+        for lvl in range(levels):
+            stage_v[0, lvl, :ca[lvl]] = self.cvals[row, lvl, :ca[lvl]]
+            stage_v[0, lvl, ca[lvl]:ca[lvl] + cb[lvl]] = \
+                vb[lvl, :cb[lvl]].astype(np.float32)
+        stage_n = (ca + cb)[None, :]
+        off, cnt_out, comps, clip = cs.plan_pass(
+            stage_n, np.asarray([self.ccomps[row] + qb]),
+            np.asarray([self.cclip[row] + lb]), self.cc_seed, cap)
+        out = cs.apply_pass(stage_v, stage_n, off, cap)[0]
+        live = np.arange(cap)[None, :] < cnt_out[0][:, None]
+        self.cvals[row] = np.where(live, out, 0.0).astype(np.float32)
+        self.ccnt[row] = cnt_out[0]
+        self.ccomps[row] = int(comps[0])
+        self.cclip[row] = int(clip[0])
+
+    # -- flush (fold-then-evaluate on the snapshot) ------------------------
+
+    def fold_flush(self, part: dict, staged):
+        """Fold the interval's staged points into the SNAPSHOT ladder
+        states — call at dispatch time, once; the result caches in the
+        part dict so the flush eval, the forwarding export and the
+        query plane all read the SAME folded state and cannot
+        disagree.  Returns ``(cvals [n, levels, cap] f32, ccnt
+        [n, levels], comps [n], clip [n])`` in snapshot row order."""
+        cached = part.get("cfold")
+        if cached is not None:
+            return cached
+        grows = np.asarray(part["rows"], np.int64)
+        n = len(grows)
+        st = {"cvals": part["cvals"].copy(), "ccnt": part["ccnt"].copy(),
+              "comps": part["ccomps"].copy(),
+              "clip": part["cclip"].copy()}
+        srows, svals, swts = staged
+        if len(srows):
+            lut = np.full(self.capacity, -1, np.int64)
+            lut[grows] = np.arange(n)
+            m = lut[srows] >= 0
+            if m.any():
+                self._fold_state(st, lut[srows[m]], svals[m], swts[m])
+        part["cfold"] = (st["cvals"], st["ccnt"], st["comps"],
+                         st["clip"])
+        return part["cfold"]
+
+    def flush_operands(self, part: dict, staged, u_pad: int):
+        """Operands for ops/compactor_eval.make_compactor_flush from
+        the folded snapshot state: ``(cvals [u_pad, levels*cap] f32,
+        ccnt [u_pad, levels] i32, cscale [u_pad] f32, mm [2, u_pad]
+        f32)``.  ``cscale`` renormalizes the implied item mass to the
+        exact header count (identity while counts are integral and the
+        ladder never clipped)."""
+        cvals, ccnt, comps, clip = self.fold_flush(part, staged)
+        n = len(part["rows"])
+        levels, cap = self.cc_levels, self.cc_cap
+        cv = np.zeros((u_pad, levels * cap), np.float32)
+        cv[:n] = cvals.reshape(n, levels * cap)
+        cc = np.zeros((u_pad, levels), np.int32)
+        cc[:n] = ccnt
+        mass = (ccnt * 2.0 ** np.arange(levels)[None, :]).sum(axis=1)
+        cnt = np.asarray(part["d_weight"][:n], np.float64)
+        cscale = np.ones(u_pad, np.float32)
+        nz = (mass > 0) & (cnt > 0)
+        cscale[:n][nz] = (cnt[nz] / mass[nz]).astype(np.float32)
+        mm = np.zeros((2, u_pad), np.float32)
+        mm[0, :n] = np.where(np.isfinite(part["d_min"][:n]),
+                             part["d_min"][:n], 0.0)
+        mm[1, :n] = np.where(np.isfinite(part["d_max"][:n]),
+                             part["d_max"][:n], 0.0)
+        return cv, cc, cscale, mm
+
+    # -- forwarding export -------------------------------------------------
+
+    def assemble_vectors(self, part: dict, staged, sel: np.ndarray
+                         ) -> np.ndarray:
+        """Wire vectors ``[F, M]`` for the selected snapshot rows:
+        exact scalars from the snapshot copies, ladder state from the
+        flush's folded snapshot (fold_flush — shared, not recomputed).
+        Call at emit time on the SNAPSHOT dict."""
+        from veneur_tpu.sketches import compactor as cs
+        cvals, ccnt, comps, clip = self.fold_flush(part, staged)
+        f = len(sel)
+        vecs = np.zeros(
+            (f, cs.vector_len(self.cc_cap, self.cc_levels)), np.float64)
+        for j, i in enumerate(sel):
+            vec = cs.empty_vector(self.cc_cap, self.cc_levels,
+                                  self.cc_seed)
+            vec[cs.IDX_COUNT] = part["d_weight"][i]
+            vec[cs.IDX_SUM] = part["d_sum"][i]
+            vec[cs.IDX_RSUM] = part["d_rsum"][i]
+            vec[cs.IDX_MIN] = part["d_min"][i]
+            vec[cs.IDX_MAX] = part["d_max"][i]
+            cs._encode(vec, cvals[i].astype(np.float64), ccnt[i],
+                       int(comps[i]), int(clip[i]))
+            vecs[j] = vec
+        return vecs
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset_rows(self, rows: np.ndarray) -> None:
+        super().reset_rows(rows)
+        if len(rows) == 0:
+            return
+        self.cvals[rows] = 0.0
+        self.ccnt[rows] = 0
+        self.ccomps[rows] = 0
+        self.cclip[rows] = 0
+
+    # -- crash checkpoint --------------------------------------------------
+
+    def _checkpoint_arrays(self) -> dict:
+        out = super()._checkpoint_arrays()
+        # ladder state serializes live rows only (capacity-sized
+        # [levels, cap] planes are the family's biggest arrays; live
+        # rows are what restores bit-exactly)
+        live = np.asarray(sorted(self.kdict.values()), np.int64)
+        out["compactor_rows"] = live
+        out["cvals"] = self.cvals[live].copy()
+        out["ccnt"] = self.ccnt[live].copy()
+        out["ccomps"] = self.ccomps[live].copy()
+        out["cclip"] = self.cclip[live].copy()
+        return out
+
+    def _checkpoint_extra(self, meta: dict) -> None:
+        super()._checkpoint_extra(meta)
+        meta["compactor_params"] = [int(self.cc_cap),
+                                    int(self.cc_levels),
+                                    int(self.cc_seed)]
+
+    def restore_precheck(self, meta: dict, arrays: dict) -> None:
+        super().restore_precheck(meta, arrays)
+        want = [int(self.cc_cap), int(self.cc_levels),
+                int(self.cc_seed)]
+        got = [int(x) for x in (meta.get("compactor_params") or want)]
+        if got != want:
+            raise CheckpointIncompatible(
+                f"compactor checkpoint params {got} != configured "
+                f"{want}; ladder states and coin schedules are not "
+                "mergeable across (cap, levels, seed)")
+
+    def _restore_arrays(self, meta: dict, arrays: dict) -> None:
+        super()._restore_arrays(meta, arrays)
+        rows = arrays.get("compactor_rows")
+        if rows is not None and len(rows):
+            rows = rows.astype(np.int64, copy=False)
+            self.cvals[rows] = arrays["cvals"]
+            self.ccnt[rows] = arrays["ccnt"]
+            self.ccomps[rows] = arrays["ccomps"]
+            self.cclip[rows] = arrays["cclip"]

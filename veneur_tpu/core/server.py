@@ -215,6 +215,9 @@ class Server:
             sketch_family_default=cfg.sketch_family_default,
             sketch_family_rules=list(cfg.sketch_family_rules),
             sketch_moments_k=cfg.sketch_moments_k,
+            sketch_compactor_cap=cfg.sketch_compactor_cap,
+            sketch_compactor_levels=cfg.sketch_compactor_levels,
+            sketch_compactor_seed=cfg.sketch_compactor_seed,
             cardinality_rollup_family=cfg.cardinality_rollup_family,
             query_window_slots=cfg.query_window_slots,
             query_slot_seconds=(cfg.query_slot_seconds

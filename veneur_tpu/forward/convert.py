@@ -41,6 +41,23 @@ def to_pb(fm: sm.ForwardMetric) -> metric_pb2.Metric:
         m.gauge.value = float(fm.gauge_value)
     elif fm.kind == sm.TYPE_SET:
         m.set.hyper_log_log = fm.hll
+    elif fm.compactor is not None:  # histogram/timer, compactor family
+        # the ladder vector rides the histogram oneof with compression
+        # <= -1024 as the family marker (-1024 - cap; the moments
+        # marker is -k, far above): centroid means are wire doubles,
+        # so the f64 vector — self-describing header (cap/levels/seed/
+        # counters) + level items — transports exactly.  min/max/
+        # reciprocalSum mirror the header scalars for wire debuggers.
+        from veneur_tpu.sketches import compactor as comp
+        vec = [float(x) for x in fm.compactor]
+        cap, _, _ = comp.params_from_vector(vec)
+        td = tdigest_pb2.MergingDigestData(
+            compression=-1024.0 - float(cap),
+            min=vec[comp.IDX_MIN], max=vec[comp.IDX_MAX],
+            reciprocalSum=vec[comp.IDX_RSUM])
+        for x in vec:
+            td.main_centroids.add(mean=x, weight=1.0)
+        m.histogram.t_digest.CopyFrom(td)
     elif fm.moments is not None:  # histogram / timer, moments family
         # the moments vector rides the histogram oneof with a NEGATIVE
         # compression as the family marker (-k, the power-sum order):
@@ -84,7 +101,10 @@ def from_pb(m: metric_pb2.Metric) -> sm.ForwardMetric:
         fm.hll = m.set.hyper_log_log
     elif which == "histogram":
         td = m.histogram.t_digest
-        if td.compression < 0:
+        if td.compression <= -1024:
+            # compactor-family marker (see to_pb): means ARE the vector
+            fm.compactor = [c.mean for c in td.main_centroids]
+        elif td.compression < 0:
             # moments-family marker (see to_pb): means ARE the vector
             fm.moments = [c.mean for c in td.main_centroids]
         else:

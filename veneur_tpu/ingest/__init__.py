@@ -708,13 +708,14 @@ class NativeIngest:
         """Histogram/timer twin of _rows_for under sketch-family
         dispatch: the target arena depends on the (possibly guard-
         rolled) identity, so each id resolves its arena alongside its
-        row.  Returns (rows, is_moments) aligned with ``ids``."""
+        row.  Returns (rows, fam) aligned with ``ids`` where ``fam``
+        codes the target arena: 0 digests, 1 moments, 2 compactors."""
         agg = self.agg
         guard = getattr(agg, "cardinality", None)
         uids, ucounts = np.unique(ids, return_counts=True)
         hi = int(uids[-1]) + 1 if len(uids) else 0
         lut = np.empty(hi, np.int64)
-        mlut = np.zeros(hi, bool)
+        mlut = np.zeros(hi, np.int8)
         uts = agg.unique_ts
         for uid, ucount in zip(uids, ucounts):
             info = self._info[uid]
@@ -740,7 +741,8 @@ class NativeIngest:
             else:
                 arena.touched[row] = True
             lut[uid] = row
-            mlut[uid] = arena is agg.moments
+            mlut[uid] = (1 if arena is agg.moments
+                         else 2 if arena is agg.compactors else 0)
             if uts is not None and info.uts_bytes is not None:
                 uts.insert(info.uts_bytes)
         return lut[ids], mlut[ids]
@@ -809,16 +811,15 @@ class NativeIngest:
                     agg.gauges.values[rows] = batch.g_vals
                 if len(batch.h_ids):
                     if getattr(agg, "family_dispatch", False):
-                        rows, is_m = self._hrows_for(batch.h_ids)
-                        if is_m.any():
-                            agg.moments.sample_batch(
-                                rows[is_m], batch.h_vals[is_m],
-                                batch.h_wts[is_m])
-                        keep = ~is_m
-                        if keep.any():
-                            agg.digests.sample_batch(
-                                rows[keep], batch.h_vals[keep],
-                                batch.h_wts[keep])
+                        rows, fam = self._hrows_for(batch.h_ids)
+                        for code, arena in ((1, agg.moments),
+                                            (2, agg.compactors),
+                                            (0, agg.digests)):
+                            sel = fam == code
+                            if sel.any():
+                                arena.sample_batch(
+                                    rows[sel], batch.h_vals[sel],
+                                    batch.h_wts[sel])
                     else:
                         rows = self._rows_for(agg.digests, batch.h_ids)
                         agg.digests.sample_batch(rows, batch.h_vals,

@@ -263,6 +263,49 @@ def moments_sums(dv, dw, ab, lab, k: int, uniform: bool):
 # Solve: Chebyshev moments -> quantiles (batched maxent Newton)
 # ---------------------------------------------------------------------------
 
+def _chol_solve(H, g):
+    """Batched SPD solve ``H x = g`` (``H`` [U, n, n], ``g`` [U, n])
+    via an unrolled Cholesky built from elementwise ops only.
+
+    ``jnp.linalg.solve`` lowers to LAPACK batched LU on CPU, whose
+    blocking — and therefore float accumulation order — depends on the
+    BATCH size; rows 0:3 of a batch-24 solve and a batch-3 solve of the
+    same systems differ in the last ulp.  That breaks meshed-vs-
+    unmeshed bit-parity (each shard solves its own slice).  Elementwise
+    chains are evaluated per-row regardless of batch, so this unrolled
+    form (n is small and static: k+1 = 9) is bit-stable under any row
+    partition.  H is SPD by construction (B' diag(p) B + ridge, p > 0),
+    so Cholesky is exact here, not a compromise."""
+    n = H.shape[-1]
+    L = [[None] * n for _ in range(n)]
+    inv = [None] * n
+    for j in range(n):
+        s = H[:, j, j]
+        for t in range(j):
+            s = s - L[j][t] * L[j][t]
+        d = jnp.sqrt(jnp.maximum(s, 1e-30))
+        L[j][j] = d
+        inv[j] = 1.0 / d
+        for i in range(j + 1, n):
+            s = H[:, i, j]
+            for t in range(j):
+                s = s - L[i][t] * L[j][t]
+            L[i][j] = s * inv[j]
+    y = [None] * n
+    for i in range(n):
+        s = g[:, i]
+        for t in range(i):
+            s = s - L[i][t] * y[t]
+        y[i] = s * inv[i]
+    x = [None] * n
+    for i in reversed(range(n)):
+        s = y[i]
+        for t in range(i + 1, n):
+            s = s - L[t][i] * x[t]
+        x[i] = s * inv[i]
+    return jnp.stack(x, axis=1)
+
+
 def _solve_domain(cheb, B, wq, xq, pct):
     """Batched maxent solve in ONE scaled domain.  ``cheb`` [U, k+1]
     are moment SUMS (cheb[:, 0] = mass); returns (t-quantiles [U, P],
@@ -292,7 +335,7 @@ def _solve_domain(cheb, B, wq, xq, pct):
         H = (p @ BB).reshape(-1, kp1, kp1)
         H = H + (RIDGE * (1.0 + mhat[:, 0]))[:, None, None] \
             * jnp.eye(kp1, dtype=jnp.float32)[None]
-        delta = jnp.linalg.solve(H, g[..., None])[..., 0]
+        delta = _chol_solve(H, g)
         nrm = jnp.max(jnp.abs(delta), axis=1, keepdims=True)
         step = jnp.minimum(1.0, 2.0 / jnp.maximum(nrm, 1e-12))
         return theta - delta * step
@@ -373,7 +416,7 @@ def _maxent_quantiles(cheb_raw, cheb_log, ab, lab, pct, k: int):
 # unmeshed shape so prewarm covers both variants)
 # ---------------------------------------------------------------------------
 
-def make_moments_flush(k: int = mo.DEFAULT_K):
+def make_moments_flush(k: int = mo.DEFAULT_K, mesh=None):
     """Build the per-flush moments program:
 
     ``fn(dv [U,D] f32, dw [U,D] f32, ab [2,U] f32, lab [2,U] f32,
@@ -383,9 +426,14 @@ def make_moments_flush(k: int = mo.DEFAULT_K):
     then log block), added to the kernel's staged sums before the
     solve.  ``fn.depth_variant`` is the uniform (depth-vector) twin:
     ``(dv, depths [U] i16, ab, lab, imp, pct)`` — the weight matrix
-    never crosses the link on raw-sample intervals.  Unmeshed only
-    (the moments family serves unmeshed tiers; config rejects the
-    combination)."""
+    never crosses the link on raw-sample intervals.
+
+    With a ``mesh``, the program shard_maps over the KEY axis across
+    every mesh device (shard x replica — the merge and the damped-
+    Newton solve are row-local, so there is not one collective in the
+    body and the per-row arithmetic is the exact unmeshed sequence:
+    meshed-vs-unmeshed bit-parity is test-pinned).  Rows pad up to a
+    device multiple in-program and slice back off."""
 
     def _run(dv, dw, ab, lab, imp, pct, uniform):
         sums = moments_sums(dv, dw, ab, lab, k, uniform)
@@ -394,8 +442,39 @@ def make_moments_flush(k: int = mo.DEFAULT_K):
             sums[:, :k + 1], sums[:, k + 1:], ab, lab, pct, k)
         return jnp.concatenate([qs, resid[:, None]], axis=1)
 
-    general = jax.jit(functools.partial(_run, uniform=False))
-    depth_variant = jax.jit(functools.partial(_run, uniform=True))
+    if mesh is None:
+        body = _run
+    else:
+        from veneur_tpu.parallel import mesh as mesh_mod
+        from jax.sharding import PartitionSpec as P
+        rows = (mesh_mod.SHARD_AXIS, mesh_mod.REPLICA_AXIS)
+        ndev = (mesh.shape[mesh_mod.SHARD_AXIS]
+                * mesh.shape[mesh_mod.REPLICA_AXIS])
+
+        def body(dv, dw, ab, lab, imp, pct, uniform):
+            u = dv.shape[0]
+            up = mesh_mod.pad_to_multiple(max(u, ndev), ndev)
+            if up != u:
+                # all-zero padding rows solve to q 0 / resid 0 and are
+                # sliced back off — same convention as the vector path
+                dv = jnp.pad(dv, ((0, up - u), (0, 0)))
+                dw = jnp.pad(
+                    dw, ((0, up - u),) + ((0, 0),) * (dw.ndim - 1))
+                ab = jnp.pad(ab, ((0, 0), (0, up - u)))
+                lab = jnp.pad(lab, ((0, 0), (0, up - u)))
+                imp = jnp.pad(imp, ((0, up - u), (0, 0)))
+            f = mesh_mod.shard_map(
+                functools.partial(_run, uniform=uniform),
+                mesh=mesh,
+                in_specs=(P(rows, None),
+                          P(rows) if uniform else P(rows, None),
+                          P(None, rows), P(None, rows),
+                          P(rows, None), P(None)),
+                out_specs=P(rows, None))
+            return f(dv, dw, ab, lab, imp, pct)[:u]
+
+    general = jax.jit(functools.partial(body, uniform=False))
+    depth_variant = jax.jit(functools.partial(body, uniform=True))
 
     def moments_flush(dv, dw, ab, lab, imp, pct):
         return general(dv, dw, ab, lab, imp, pct)
@@ -403,6 +482,7 @@ def make_moments_flush(k: int = mo.DEFAULT_K):
     moments_flush.lower = general.lower
     moments_flush.depth_variant = depth_variant
     moments_flush.k = k
+    moments_flush.mesh = mesh
     return moments_flush
 
 

@@ -18,11 +18,18 @@ window rings (query/rings.py):
                   story: fusion cost independent of the window's sample
                   count.
 
+  compactor family  fuse = level-wise concat-then-compact
+                  (sketches/compactor.py merge_vectors; order-free
+                  bit-for-bit), then the rank/quantile read-off
+                  (quantiles_from_vectors) — the relative-error
+                  guarantee survives the window fusion because the
+                  merge IS the sketch's own merge.
+
 Every answer carries a self-describing mergeable PAYLOAD (a centroid
-list for digests — the forwarding wire shape — or the moments vector),
-so an upper tier (the proxy's scatter-gather) can merge answers through
-the same family codecs it already speaks, and `merge_responses` below
-is that merge.
+list for digests — the forwarding wire shape — or the moments /
+compactor vector), so an upper tier (the proxy's scatter-gather) can
+merge answers through the same family codecs it already speaks, and
+`merge_responses` below is that merge.
 
 Telemetry per request: query.served_total / query.errors_total /
 query.latency_ms (tier-tagged), /debug/vars -> query, and a `query`
@@ -378,32 +385,32 @@ class QueryEngine:
     # -- the windowed read -----------------------------------------------
 
     def _covering(self, window_s, slots, now) -> tuple:
-        """Both family rings' covering slots + CONSERVATIVELY merged
-        coverage metadata.  The two family rings rotate back to back
-        (not atomically); a read landing between the appends would see
-        one ring a cut ahead of the other, so the answer never claims
+        """Every family ring's covering slots + CONSERVATIVELY merged
+        coverage metadata.  The family rings rotate back to back (not
+        atomically); a read landing between the appends would see one
+        ring a cut ahead of another, so the answer never claims
         coverage one fused family lacks: fresh/partial only hold when
-        both hold, and the covered window is the intersection's
+        all hold, and the covered window is the intersection's
         bounds."""
         rings = self.agg.query_rings
         td_slots, td_info = rings["tdigest"].covering(
             window_s=window_s, slots=slots, now=now)
         mo_slots, mo_info = rings["moments"].covering(
             window_s=window_s, slots=slots, now=now)
+        cc_slots, cc_info = rings["compactor"].covering(
+            window_s=window_s, slots=slots, now=now)
+        infos = (td_info, mo_info, cc_info)
         info = dict(td_info)
-        info["fresh"] = bool(td_info["fresh"] and mo_info["fresh"])
-        info["partial"] = bool(td_info["partial"]
-                               or mo_info["partial"])
-        info["slots_fused"] = min(td_info["slots_fused"],
-                                  mo_info["slots_fused"])
+        info["fresh"] = all(i["fresh"] for i in infos)
+        info["partial"] = any(i["partial"] for i in infos)
+        info["slots_fused"] = min(i["slots_fused"] for i in infos)
         # intersection bounds: [max(from), min(to)] — min(from) would
         # claim coverage one of the fused families lacks
         for k, pick in (("covered_from_unix", max),
                         ("covered_to_unix", min)):
-            vals = [v for v in (td_info[k], mo_info[k])
-                    if v is not None]
+            vals = [i[k] for i in infos if i[k] is not None]
             info[k] = pick(vals) if vals else None
-        return td_slots, mo_slots, info
+        return td_slots, mo_slots, cc_slots, info
 
     def query(self, name: str, tags: Optional[list] = None,
               qs=(0.5,), window_s: Optional[float] = None,
@@ -428,10 +435,12 @@ class QueryEngine:
                 kind=kind, top=top, by=by, payload=payload)
         jtags = ",".join(sorted(tags)) if tags else ""
         now = time.time()
-        td_slots, mo_slots, info = self._covering(window_s, slots, now)
+        td_slots, mo_slots, cc_slots, info = self._covering(
+            window_s, slots, now)
 
         td = self._fuse_tdigest(td_slots, name, jtags, kind)
         mo = self._fuse_moments(mo_slots, name, jtags, kind)
+        cc = self._fuse_compactor(cc_slots, name, jtags, kind)
 
         qarr = np.asarray(list(qs), np.float64)
         out = {
@@ -442,15 +451,16 @@ class QueryEngine:
                 if info["covered_to_unix"] else None),
             "quantiles": {}, "count": 0.0, "sum": 0.0,
             "min": None, "max": None, "family": "none",
-            "mixed_families": bool(td["count"] > 0 and mo["count"] > 0),
+            "mixed_families": sum(
+                f["count"] > 0 for f in (td, mo, cc)) > 1,
             "payload": None,
         }
         out.update(info)
-        # a key can legitimately live in BOTH families across a window
-        # (a cross-tier sketch_family_rules mismatch is the documented
-        # degradation); the families cannot merge exactly, so the
-        # answer follows the family holding more mass and flags it
-        fam = td if td["count"] >= mo["count"] else mo
+        # a key can legitimately live in SEVERAL families across a
+        # window (a cross-tier sketch_family_rules mismatch is the
+        # documented degradation); the families cannot merge exactly,
+        # so the answer follows the family holding most mass, flagged
+        fam = max((td, mo, cc), key=lambda f: f["count"])
         if fam["count"] > 0:
             out["family"] = fam["family"]
             out["count"] = fam["count"]
@@ -573,6 +583,52 @@ class QueryEngine:
                 "max": (float(vec[mo.IDX_MAX]) if cnt > 0 else None),
                 "eval": _eval, "payload": _payload}
 
+    def _fuse_compactor(self, slots_list, name, jtags, kind) -> dict:
+        from veneur_tpu.sketches import compactor as cs
+        carena = self.agg.compactors
+        vec = None
+        for slot in slots_list:
+            pos = slot.positions(name, jtags, kind)
+            if not pos:
+                continue
+
+            def _compute(slot=slot, pos=pos):
+                # same REDUCED staged view + per-slot memo as the
+                # moments fusion; compactor merges are concat-then-
+                # compact (order-free, the ladder geometry makes them
+                # associative) so cross-slot fusion is a fold
+                parr = np.asarray(pos, np.int64)
+                sub = slot.staged_rows_for(slot.part["rows"][parr])
+                vecs = carena.assemble_vectors(slot.part, sub, parr)
+                out = vecs[0].copy()
+                for row in vecs[1:]:
+                    out = cs.merge_vectors(out[None, :],
+                                           row[None, :])[0]
+                return out
+            svec = slot.vector_memo((name, jtags, kind), _compute)
+            vec = (svec.copy() if vec is None
+                   else cs.merge_vectors(vec[None, :],
+                                         svec[None, :])[0])
+        cnt = float(vec[cs.IDX_COUNT]) if vec is not None else 0.0
+
+        def _eval(qarr):
+            if vec is None or cnt <= 0:
+                return None
+            return cs.quantiles_from_vectors(vec[None, :], qarr)[0]
+
+        def _payload():
+            if vec is None:
+                return None
+            return {"family": "compactor",
+                    "vector": [float(x) for x in vec]}
+
+        return {"family": "compactor", "count": cnt,
+                "sum": (float(vec[cs.IDX_SUM]) if vec is not None
+                        else 0.0),
+                "min": (float(vec[cs.IDX_MIN]) if cnt > 0 else None),
+                "max": (float(vec[cs.IDX_MAX]) if cnt > 0 else None),
+                "eval": _eval, "payload": _payload}
+
     # -- the group-by cube read ------------------------------------------
 
     def query_groups(self, name: str, group_by: list, qs=(0.5,),
@@ -611,34 +667,43 @@ class QueryEngine:
         qeval = np.asarray(qeval, np.float64)
 
         now = time.time()
-        td_slots, mo_slots, info = self._covering(window_s, slots, now)
+        td_slots, mo_slots, cc_slots, info = self._covering(
+            window_s, slots, now)
         td_groups = self._fuse_group_clouds(td_slots, name, dim, kind)
         mo_groups = self._fuse_group_vectors(mo_slots, name, dim, kind)
+        cc_groups = self._fuse_group_ladders(cc_slots, name, dim, kind)
         launch = 0
         if not exact:
             td_groups = self._coarsen_clouds(td_groups, gb)
             mo_groups, launch = self._coarsen_vectors(
                 mo_groups, gb, seed)
+            cc_groups = self._coarsen_ladders(cc_groups, gb)
 
+        from veneur_tpu.sketches import compactor as cs
         from veneur_tpu.sketches import moments as mo
         entries = []
         td_pending = []        # (entry, v, w, min, max): ONE batch
         mo_pending = []        # (entry, vector): solved in ONE batch
-        for gkey in set(td_groups) | set(mo_groups):
+        cc_pending = []        # (entry, vector): read off in ONE batch
+        for gkey in set(td_groups) | set(mo_groups) | set(cc_groups):
             td_g = td_groups.get(gkey)
             mo_v = mo_groups.get(gkey)
+            cc_v = cc_groups.get(gkey)
             td_cnt = td_g["count"] if td_g else 0.0
             mo_cnt = float(mo_v[mo.IDX_COUNT]) if mo_v is not None \
                 else 0.0
-            if td_cnt <= 0 and mo_cnt <= 0:
+            cc_cnt = float(cc_v[cs.IDX_COUNT]) if cc_v is not None \
+                else 0.0
+            if td_cnt <= 0 and mo_cnt <= 0 and cc_cnt <= 0:
                 continue
             e = {"key": gkey,
                  "group": cb.group_of(gkey.split(",")),
-                 "mixed_families": bool(td_cnt > 0 and mo_cnt > 0),
+                 "mixed_families": sum(
+                     c > 0 for c in (td_cnt, mo_cnt, cc_cnt)) > 1,
                  "quantiles": {}, "payload": None}
             # per-group family pick: same larger-mass rule as the
             # single-key read (families cannot merge exactly)
-            if td_cnt >= mo_cnt:
+            if td_cnt >= mo_cnt and td_cnt >= cc_cnt:
                 v = np.concatenate(td_g["v"]) if td_g["v"] else \
                     np.zeros(0)
                 w = np.concatenate(td_g["w"]) if td_g["w"] else \
@@ -661,7 +726,7 @@ class QueryEngine:
                         "max": float(td_g["max"]),
                         "count": td_cnt, "sum": td_g["sum"],
                         "rsum": td_g["rsum"]}
-            else:
+            elif mo_cnt >= cc_cnt:
                 e.update(family="moments", count=mo_cnt,
                          sum=float(mo_v[mo.IDX_SUM]),
                          min=float(mo_v[mo.IDX_MIN]),
@@ -671,6 +736,15 @@ class QueryEngine:
                     e["payload"] = {"family": "moments",
                                     "k": self.agg.moments.k,
                                     "vector": [float(x) for x in mo_v]}
+            else:
+                e.update(family="compactor", count=cc_cnt,
+                         sum=float(cc_v[cs.IDX_SUM]),
+                         min=float(cc_v[cs.IDX_MIN]),
+                         max=float(cc_v[cs.IDX_MAX]))
+                cc_pending.append((e, cc_v))
+                if payload:
+                    e["payload"] = {"family": "compactor",
+                                    "vector": [float(x) for x in cc_v]}
             entries.append(e)
 
         if td_pending:
@@ -693,6 +767,12 @@ class QueryEngine:
             for (e, _), quants in zip(mo_pending, allq):
                 e["quantiles"] = {repr(float(p)): float(x)
                                   for p, x in zip(qeval, quants)}
+        if cc_pending:
+            allq = cs.quantiles_from_vectors(
+                np.stack([v for _, v in cc_pending]), qeval)
+            for (e, _), quants in zip(cc_pending, allq):
+                e["quantiles"] = {repr(float(p)): float(x)
+                                  for p, x in zip(qeval, quants)}
 
         groups_total = len(entries)
         entries = rank_groups(entries, mode, rank_p, seed, top)
@@ -704,7 +784,9 @@ class QueryEngine:
                                   cb.DIM_TAG_PREFIX + dim.dim_id]))
         otd = self._fuse_tdigest(td_slots, cb.OTHER_NAME, ojtags, kind)
         omo = self._fuse_moments(mo_slots, cb.OTHER_NAME, ojtags, kind)
-        ofam = otd if otd["count"] >= omo["count"] else omo
+        occ = self._fuse_compactor(cc_slots, cb.OTHER_NAME, ojtags,
+                                   kind)
+        ofam = max((otd, omo, occ), key=lambda f: f["count"])
         other = None
         if ofam["count"] > 0:
             other = {"family": ofam["family"], "count": ofam["count"],
@@ -783,6 +865,51 @@ class QueryEngine:
                                           vec[None, :])[0])
         return groups
 
+    def _fuse_group_ladders(self, slots_list, name, dim, kind) -> dict:
+        """Compactor-family cube fusion: ONE assemble_vectors walk per
+        slot covers every group row (memoized per slot), then groups
+        merge across slots by concat-then-compact."""
+        from veneur_tpu.sketches import compactor as cs
+        carena = self.agg.compactors
+        groups: dict = {}
+        for slot in slots_list:
+            hits = slot.cube_positions(name, tuple(dim.tags), kind)
+            if not hits:
+                continue
+
+            def _compute(slot=slot, hits=hits):
+                parr = np.asarray([p for p, _, _ in hits], np.int64)
+                sub = slot.staged_rows_for(slot.part["rows"][parr])
+                vecs = carena.assemble_vectors(slot.part, sub, parr)
+                return tuple(g for _, g, _ in hits), vecs
+            gkeys, vecs = slot.vector_memo(
+                ("\x00cube", name, tuple(dim.tags), kind), _compute)
+            for gkey, vec in zip(gkeys, vecs):
+                cur = groups.get(gkey)
+                groups[gkey] = (
+                    vec.copy() if cur is None
+                    else cs.merge_vectors(cur[None, :],
+                                          vec[None, :])[0])
+        return groups
+
+    @staticmethod
+    def _coarsen_ladders(groups: dict, keep: list) -> dict:
+        """Compactor sub-cube roll-up: fine group ladders merge under
+        their projected coarse key on the host (the concat-then-
+        compact merge is a per-pair host op — no batched kernel form,
+        and cube group counts stay small enough that it doesn't earn
+        one)."""
+        from veneur_tpu.cubes import cube as cb
+        from veneur_tpu.sketches import compactor as cs
+        out: dict = {}
+        for gkey, vec in groups.items():
+            ck = cb.project_group(gkey, keep)
+            cur = out.get(ck)
+            out[ck] = (vec if cur is None
+                       else cs.merge_vectors(cur[None, :],
+                                             vec[None, :])[0])
+        return out
+
     @staticmethod
     def _coarsen_clouds(groups: dict, keep: list) -> dict:
         """Digest sub-cube roll-up: concatenate the fine groups' point
@@ -842,6 +969,7 @@ def merge_responses(responses: list[dict], qs,
     mismatch).  Coverage metadata merges conservatively: staleness is
     the WORST upstream's, `partial`/`fresh` only hold if they hold
     everywhere."""
+    from veneur_tpu.sketches import compactor as cs
     from veneur_tpu.sketches import moments as mo
     qarr = np.asarray(list(qs), np.float64)
     td_v: list[np.ndarray] = []
@@ -849,6 +977,7 @@ def merge_responses(responses: list[dict], qs,
     td = {"count": 0.0, "sum": 0.0, "rsum": 0.0,
           "min": np.inf, "max": -np.inf}
     mo_vec = None
+    cc_vec = None
     mixed = False
     for r in responses:
         mixed = mixed or bool(r.get("mixed_families"))
@@ -868,13 +997,20 @@ def merge_responses(responses: list[dict], qs,
             mo_vec = (vec if mo_vec is None
                       else mo.merge_vectors(mo_vec[None, :],
                                             vec[None, :])[0])
+        elif p["family"] == "compactor":
+            vec = np.asarray(p["vector"], np.float64)
+            cc_vec = (vec if cc_vec is None
+                      else cs.merge_vectors(cc_vec[None, :],
+                                            vec[None, :])[0])
     mo_count = float(mo_vec[mo.IDX_COUNT]) if mo_vec is not None else 0.0
+    cc_count = float(cc_vec[cs.IDX_COUNT]) if cc_vec is not None else 0.0
     out = {
         "name": responses[0]["name"] if responses else "",
         "tags": responses[0].get("tags", []) if responses else [],
         "quantiles": {}, "count": 0.0, "sum": 0.0,
         "min": None, "max": None, "family": "none",
-        "mixed_families": mixed or (td["count"] > 0 and mo_count > 0),
+        "mixed_families": mixed or sum(
+            c > 0 for c in (td["count"], mo_count, cc_count)) > 1,
         "slots_fused": sum(r.get("slots_fused") or 0
                            for r in responses),
         "partial": any(r.get("partial") for r in responses),
@@ -885,7 +1021,8 @@ def merge_responses(responses: list[dict], qs,
              if r.get("staleness_ms") is not None), default=None),
         "payload": None,
     }
-    if td["count"] >= mo_count and td["count"] > 0:
+    if (td["count"] >= mo_count and td["count"] >= cc_count
+            and td["count"] > 0):
         v = np.concatenate(td_v)
         w = np.concatenate(td_w)
         quants = weighted_quantiles_np(v, w, td["min"], td["max"],
@@ -904,7 +1041,7 @@ def merge_responses(responses: list[dict], qs,
                           "max": float(td["max"]),
                           "count": td["count"], "sum": td["sum"],
                           "rsum": td["rsum"]}
-    elif mo_count > 0:
+    elif mo_count >= cc_count and mo_count > 0:
         from veneur_tpu.ops import moments_eval as me
         quants = me.quantiles_from_vectors(mo_vec[None, :], qarr)[0]
         out.update(family="moments", count=mo_count,
@@ -916,6 +1053,16 @@ def merge_responses(responses: list[dict], qs,
         out["payload"] = {"family": "moments",
                           "k": mo.k_from_len(len(mo_vec)),
                           "vector": [float(x) for x in mo_vec]}
+    elif cc_count > 0:
+        quants = cs.quantiles_from_vectors(cc_vec[None, :], qarr)[0]
+        out.update(family="compactor", count=cc_count,
+                   sum=float(cc_vec[cs.IDX_SUM]),
+                   min=float(cc_vec[cs.IDX_MIN]),
+                   max=float(cc_vec[cs.IDX_MAX]))
+        out["quantiles"] = {repr(float(p)): float(x)
+                            for p, x in zip(qarr, quants)}
+        out["payload"] = {"family": "compactor",
+                          "vector": [float(x) for x in cc_vec]}
     return out
 
 

@@ -115,6 +115,10 @@ class ForwardMetric:
     # ForwardMetric carries exactly one sketch family and the importer
     # routes by which is present)
     moments: Optional[list[float]] = None
+    # compactor-family histogram payload (sketches/compactor.py wire
+    # vector: self-describing header + level items; same exactly-one-
+    # sketch-family contract as `moments`)
+    compactor: Optional[list[float]] = None
     # set payload
     hll: bytes = b""
 

@@ -1,0 +1,551 @@
+"""Relative-error compactor sketch: guaranteed-rank-error quantiles.
+
+The third sketch family (ROADMAP #4; "SplitQuantiles" / relative-error
+adaptive compactors, arXiv:2511.17396, in the KLL/ReqSketch lineage of
+arXiv:1603.05346 + 2004.01668).  Where the t-digest's tail accuracy is
+only ever empirical and the moments family trades accuracy for the
+cheapest possible merge, a compactor sketch carries a PROVABLE rank
+error: every answer it returns is the value of some element whose rank
+is within ``rank_error_bound(n)`` of the requested one — the tier
+operators pick by rule for SLA-grade p99s (README "Sketch families").
+
+State is a fixed ladder of ``levels`` buffers of ``cap`` slots each.
+An item in level ``l`` stands for ``2**l`` original samples.  New
+samples enter level 0; when a level's occupancy exceeds ``cap`` it is
+*compacted*: sorted, the upper ``cap // 2`` items held back (the
+protected section — this is what concentrates accuracy in the upper
+tail), and of the rest every other survivor — offset chosen by a
+seeded deterministic coin — is promoted to the next level at double
+weight.  A merge is level-wise concatenate (each side carries at most
+``cap`` per level, so staging is bounded by ``2 * cap``) followed by
+one bottom-up compaction pass; because compaction is sort +
+stride-select it is exactly the bitonic machinery ops/sorted_eval.py
+already has, and thousands of keys' passes batch into ONE Pallas
+launch (ops/compactor_eval.py).
+
+Determinism: the coin for every compaction is ``_coin(seed, level,
+comps)`` where ``comps`` is the sketch's cumulative compaction
+counter.  Merging two sketches starts from the SUM of their counters
+and the level contents are sorted before selection, so ``a.merge(b)``
+and ``b.merge(a)`` are bit-identical and a replayed testbed run
+reproduces exactly.  The count-dynamics of a pass (``plan_pass``) are
+pure integer math shared by the host reference, the XLA twin and the
+Pallas kernel: the host plans each pass (which levels compact, each
+one's coin offset) and the device replays only the value movement.
+
+Exactness: ``count``/``sum``/``min``/``max`` live in the header and
+are exact regardless of compaction — the count-conservation oracle
+checks the header, and item mass equals the header count whenever
+``clip == 0``.  ``clip`` counts emergency in-place compactions of the
+TOP level (total mass beyond ``cap * 2**(levels-1)``): past that the
+rank guarantee lapses and the read-off renormalizes item weights to
+the exact header count instead of failing.
+
+Wire vector layout (``vector_len(cap, levels)`` doubles)::
+
+    [0] count  [1] sum  [2] rsum  [3] min  [4] max   exact scalars
+    [5] cap    [6] levels  [7] seed          self-describing params
+    [8] comps  [9] clip                      schedule counters
+    [10 .. 10+levels)                        per-level occupancy
+    [10+levels .. 10+levels+levels*cap)      level items, level l at
+                                             offset l*cap, occupied
+                                             prefix, zero padding
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+# cap drives the guarantee (eps ~ 2*log2(n/cap)/cap for a
+# deterministic-coin compactor) and levels the mass capacity
+# (cap * 2**(levels-1)); the defaults bound rank error by ~19% of n at
+# n = 100k worst-case — measured error sits two orders under that
+# (analysis/tdigest_accuracy.csv) — while one key's state stays a
+# 1.8k-double vector and the kernel buffer (4*cap) a legal bitonic
+# depth (<= 1024, ops/sorted_eval.MAX_DEPTH)
+DEFAULT_CAP = 128
+DEFAULT_LEVELS = 14
+DEFAULT_SEED = 2511
+
+# staging width per level: each merge side carries <= cap, and the
+# in-pass promotion carry is bounded by 2*cap (see plan_pass), so the
+# working buffer per level is 4*cap — pow2 whenever cap is, which is
+# what the bitonic schedule in the kernel requires
+STAGE_MUL = 2
+BUF_MUL = 4
+# emergency in-place rounds that bring a top level of 4*cap back under
+# cap (ceil(occ/2) per round: 4c -> 2c -> c)
+CLIP_ROUNDS = 2
+
+IDX_COUNT = 0
+IDX_SUM = 1
+IDX_RSUM = 2
+IDX_MIN = 3
+IDX_MAX = 4
+IDX_CAP = 5
+IDX_LEVELS = 6
+IDX_SEED = 7
+IDX_COMPS = 8
+IDX_CLIP = 9
+HDR = 10
+
+_PAD = np.inf
+# non-finite samples would alias the +inf slot padding; clamp instead
+# of dropping so the exact header scalars still see every sample
+_FCLAMP = float(np.finfo(np.float32).max)
+
+
+def vector_len(cap: int = DEFAULT_CAP, levels: int = DEFAULT_LEVELS) -> int:
+    return HDR + levels + levels * cap
+
+
+def keep_of(cap: int) -> int:
+    """Protected upper-section size: the top half of a compacting
+    buffer is never selected from, concentrating accuracy at high
+    ranks (the relative-error construction of the source family)."""
+    return cap // 2
+
+
+def empty_vector(cap: int = DEFAULT_CAP,
+                 levels: int = DEFAULT_LEVELS,
+                 seed: int = DEFAULT_SEED) -> np.ndarray:
+    v = np.zeros(vector_len(cap, levels), np.float64)
+    v[IDX_MIN] = np.inf
+    v[IDX_MAX] = -np.inf
+    v[IDX_CAP] = cap
+    v[IDX_LEVELS] = levels
+    v[IDX_SEED] = seed
+    return v
+
+
+def params_from_vector(vec: np.ndarray):
+    """(cap, levels, seed) from a wire vector, validated against its
+    length — the self-describing check every import runs."""
+    vec = np.asarray(vec, np.float64)
+    if vec.ndim != 1 or vec.shape[0] < HDR + 1:
+        raise ValueError(f"not a compactor vector: shape {vec.shape}")
+    cap, levels, seed = (int(vec[IDX_CAP]), int(vec[IDX_LEVELS]),
+                         int(vec[IDX_SEED]))
+    if cap < 8 or cap & (cap - 1) or levels < 2:
+        raise ValueError(f"bad compactor params cap={cap} levels={levels}")
+    if vec.shape[0] != vector_len(cap, levels):
+        raise ValueError(
+            f"compactor vector length {vec.shape[0]} != "
+            f"{vector_len(cap, levels)} for cap={cap} levels={levels}")
+    return cap, levels, seed
+
+
+_U64 = np.uint64
+_PHI = _U64(0x9E3779B97F4A7C15)
+_MIX1 = _U64(0xBF58476D1CE4E5B9)
+_MIX2 = _U64(0x94D049BB133111EB)
+
+
+def coin_bits(seed: int, level, comps) -> np.ndarray:
+    """Deterministic coin for the stride-select offset of a compaction
+    at ``level`` when the sketch has performed ``comps`` compactions:
+    splitmix64 finalizer over (seed, level, comps).  Vectorized over
+    ``comps``/``level``; returns int64 bits in {0, 1}."""
+    with np.errstate(over="ignore"):
+        x = (_U64(seed & 0xFFFFFFFFFFFFFFFF)
+             + (np.asarray(level).astype(np.uint64) + _U64(1)) * _PHI
+             + np.asarray(comps).astype(np.uint64) * _MIX2)
+        x = (x ^ (x >> _U64(30))) * _MIX1
+        x = (x ^ (x >> _U64(27))) * _MIX2
+        x = x ^ (x >> _U64(31))
+    return ((x >> _U64(17)) & _U64(1)).astype(np.int64)
+
+
+def plan_pass(stage_n: np.ndarray, comps: np.ndarray, clip: np.ndarray,
+              seed: int, cap: int):
+    """Count-dynamics of one bottom-up compaction pass over staged
+    levels ``stage_n [n, levels]`` (each <= 2*cap).  Pure integer math
+    — the single source of truth the host reference, the XLA twin and
+    the Pallas kernel all follow.
+
+    Returns ``(off, cnt_out, comps_out, clip_out)`` where ``off
+    [n, levels + CLIP_ROUNDS]`` carries the coin offset of every
+    compaction event in pass order (levels bottom-up, then the top
+    level's emergency clip rounds) and ``cnt_out [n, levels]`` the
+    post-pass occupancies (every level <= cap).
+
+    Per level: with carry from below, occupancy ``occ <= 4*cap``; the
+    level compacts iff ``occ > cap``; the compacted section is the
+    lowest ``occ - keep`` items minus an odd straggler, promoting half
+    of it.  The top level cannot promote: CLIP_ROUNDS in-place rounds
+    (keep = 0) halve it back under cap, counted in ``clip``."""
+    stage_n = np.asarray(stage_n, np.int64)
+    n, levels = stage_n.shape
+    comps = np.asarray(comps, np.int64).copy()
+    clip = np.asarray(clip, np.int64).copy()
+    keep = keep_of(cap)
+    off = np.zeros((n, levels + CLIP_ROUNDS), np.int64)
+    cnt_out = np.zeros_like(stage_n)
+    carry = np.zeros(n, np.int64)
+    for lvl in range(levels):
+        occ = stage_n[:, lvl] + carry
+        if lvl < levels - 1:
+            do = occ > cap
+            sec = occ - keep
+            m = np.where(do, sec - (sec & 1), 0)
+            off[:, lvl] = np.where(do, coin_bits(seed, lvl, comps), 0)
+            comps += do
+            cnt_out[:, lvl] = occ - m
+            carry = m // 2
+        else:
+            top = occ
+            for r in range(CLIP_ROUNDS):
+                do = top > cap
+                m = np.where(do, top - (top & 1), 0)
+                off[:, levels + r] = np.where(
+                    do, coin_bits(seed, levels + r, comps), 0)
+                comps += do
+                clip += do
+                top = top - m // 2
+            cnt_out[:, lvl] = top
+    return off, cnt_out, comps, clip
+
+
+def apply_pass(stage_v: np.ndarray, stage_n: np.ndarray, off: np.ndarray,
+               cap: int) -> np.ndarray:
+    """Value movement of one compaction pass: the host/numpy reference
+    the Pallas kernel replays bit-for-bit (ops/compactor_eval.py).
+
+    ``stage_v [n, levels, 2*cap]`` holds each level's staged items in
+    an occupied prefix (+inf padding beyond ``stage_n``); returns the
+    post-pass state ``[n, levels, cap]``.  Each level buffer is sorted
+    ascending (padding sorts to the end), the survivor/retain masks
+    are pure functions of occupancy + coin offset, and the scattered
+    survivors compress to a sorted prefix by a masked re-sort — the
+    same construction the kernel uses, so ties and all."""
+    stage_v = np.asarray(stage_v, np.float64)
+    stage_n = np.asarray(stage_n, np.int64)
+    n, levels, s2 = stage_v.shape
+    if s2 != STAGE_MUL * cap:
+        raise ValueError(f"stage width {s2} != {STAGE_MUL * cap}")
+    keep = keep_of(cap)
+    b = BUF_MUL * cap
+    iota = np.arange(b)[None, :]
+    out = np.full((n, levels, cap), _PAD)
+    carry_v = np.full((n, STAGE_MUL * cap), _PAD)
+    carry_n = np.zeros(n, np.int64)
+    for lvl in range(levels):
+        buf = np.sort(
+            np.concatenate([stage_v[:, lvl], carry_v], axis=1), axis=1)
+        occ = (stage_n[:, lvl] + carry_n)[:, None]
+        if lvl < levels - 1:
+            do = occ > cap
+            sec = occ - keep
+            m = np.where(do, sec - (sec & 1), 0)
+            o = off[:, lvl][:, None]
+            surv = do & (iota < m) & (iota % 2 == o)
+            retain = np.where(do, (iota >= m) & (iota < occ), iota < occ)
+            carry_v = np.sort(np.where(surv, buf, _PAD),
+                              axis=1)[:, :STAGE_MUL * cap]
+            carry_n = (m // 2)[:, 0]
+            out[:, lvl] = np.sort(np.where(retain, buf, _PAD),
+                                  axis=1)[:, :cap]
+        else:
+            top = occ
+            for r in range(CLIP_ROUNDS):
+                do = top > cap
+                m = np.where(do, top - (top & 1), 0)
+                o = off[:, levels + r][:, None]
+                surv = (iota < m) & (iota % 2 == o)
+                keep_mask = np.where(do, surv | ((iota >= m) & (iota < top)),
+                                     iota < top)
+                buf = np.sort(np.where(keep_mask, buf, _PAD), axis=1)
+                top = top - m // 2
+            out[:, lvl] = buf[:, :cap]
+    return out
+
+
+def _levels_touched(n: float, cap: int, levels: int) -> int:
+    if n <= cap:
+        return 0
+    return min(levels - 1, int(math.ceil(math.log2(n / cap))) + 1)
+
+
+def rank_error_bound(n: float, cap: int = DEFAULT_CAP,
+                     levels: int = DEFAULT_LEVELS) -> float:
+    """Provable worst-case ABSOLUTE rank error after absorbing total
+    mass ``n`` (any merge topology), the committed envelope the
+    dossier and testbed assert against.
+
+    Derivation: a compaction at level ``l`` replaces pairs of weight
+    ``2**l`` by one survivor at ``2**(l+1)``, shifting any rank by at
+    most ``2**l``.  A level holds back ``keep = cap/2`` items, so
+    consecutive compactions at ``l`` are separated by at least
+    ``cap/2`` arrivals there, and at most ``n / 2**l`` items ever
+    arrive: ``m_l <= 2n / (cap * 2**l) + 1`` compactions.  Summing
+    ``m_l * 2**l`` over the ``H`` levels that can compact (``H =
+    ceil(log2(n / cap)) + 1``, +1 for merge-staging slack) gives
+    ``2*H*n/cap`` plus a geometric tail under ``2n/cap``:
+
+        err(n) <= (2*H + 2) * n / cap
+
+    Valid while the top level never clips, i.e. ``n <= cap *
+    2**(levels-1)`` — beyond that the function returns +inf and the
+    read-off degrades to renormalized best-effort (module docstring)."""
+    if n <= cap:
+        return 0.0
+    if n > cap * 2.0 ** (levels - 1):
+        return float("inf")
+    h = _levels_touched(n, cap, levels)
+    return (2.0 * h + 2.0) * n / cap
+
+
+def state_from_vector(vec: np.ndarray):
+    """Decode a wire vector to ``(vals [levels, cap] (+inf padded),
+    cnt [levels], comps, clip)`` plus params via the header."""
+    cap, levels, seed = params_from_vector(vec)
+    cnt = np.asarray(vec[HDR:HDR + levels], np.int64).copy()
+    vals = np.asarray(
+        vec[HDR + levels:], np.float64).reshape(levels, cap).copy()
+    vals[np.arange(cap)[None, :] >= cnt[:, None]] = _PAD
+    return vals, cnt, int(vec[IDX_COMPS]), int(vec[IDX_CLIP])
+
+
+def _encode(vec: np.ndarray, vals: np.ndarray, cnt: np.ndarray,
+            comps: int, clip: int) -> np.ndarray:
+    levels, cap = vals.shape
+    vec[IDX_COMPS] = comps
+    vec[IDX_CLIP] = clip
+    vec[HDR:HDR + levels] = cnt
+    body = np.where(np.arange(cap)[None, :] < cnt[:, None], vals, 0.0)
+    vec[HDR + levels:] = body.reshape(-1)
+    return vec
+
+
+def items_and_weights(vec: np.ndarray):
+    """(values, weights) of every live item in a wire vector, weights
+    renormalized so their total equals the exact header count (a
+    no-op at clip == 0; past clip the implied mass undercounts and
+    the uniform rescale keeps the read-off mass-exact)."""
+    cap, levels, _ = params_from_vector(vec)
+    vec = np.asarray(vec, np.float64)
+    cnt = vec[HDR:HDR + levels].astype(np.int64)
+    body = vec[HDR + levels:].reshape(levels, cap)
+    live = np.arange(cap)[None, :] < cnt[:, None]
+    vals = body[live]
+    wts = np.repeat(2.0 ** np.arange(levels), cnt)
+    total = float(wts.sum())
+    count = float(vec[IDX_COUNT])
+    if total > 0 and count > 0 and total != count:
+        wts = wts * (count / total)
+    return vals, wts
+
+
+def quantiles_from_vectors(vecs: np.ndarray, qs) -> np.ndarray:
+    """Rank/quantile read-off for batched wire vectors ``[n, M]``:
+    weighted midpoint interpolation over the live items, pinned to the
+    convention of query.engine.weighted_quantiles_np so fused /query
+    answers and flush emissions agree.  Empty rows yield 0.0."""
+    vecs = np.asarray(vecs, np.float64)
+    qs = np.asarray(qs, np.float64)
+    out = np.zeros((vecs.shape[0], len(qs)))
+    for i in range(vecs.shape[0]):
+        v, w = items_and_weights(vecs[i])
+        if len(v) == 0:
+            continue
+        order = np.argsort(v, kind="stable")
+        v, w = v[order], w[order]
+        if len(v) == 1:
+            row = np.full(len(qs), v[0])
+        else:
+            cum = np.cumsum(w)
+            cmid = cum - 0.5 * w
+            tq = qs * cum[-1]
+            idx = np.clip(np.searchsorted(cmid, tq, side="left"),
+                          1, len(v) - 1)
+            lo, hi = v[idx - 1], v[idx]
+            c_lo, c_hi = cmid[idx - 1], cmid[idx]
+            t = np.where(c_hi > c_lo,
+                         (tq - c_lo) / np.maximum(c_hi - c_lo, 1e-30),
+                         0.0)
+            row = lo + (hi - lo) * np.clip(t, 0.0, 1.0)
+        out[i] = np.clip(row, vecs[i, IDX_MIN], vecs[i, IDX_MAX])
+    return out
+
+
+def split_levels(vals: np.ndarray, wts: np.ndarray, levels: int) -> list:
+    """Bucket weighted samples into per-level pending queues: an item
+    of weight ``2**l`` enters level ``l`` (imported compactor items
+    re-enter at their originating level), and an arbitrary sample-rate
+    weight decomposes by binary expansion of ``max(1, round(w))`` so
+    no sample's VALUE is ever dropped — the exact header count carries
+    the true mass and the read-off renormalizes the remainder.  Bits
+    at or above the ladder clamp to the top level."""
+    pending = [[] for _ in range(levels)]
+    w_int = np.maximum(1, np.rint(wts)).astype(np.int64)
+    top_extra = w_int >> (levels - 1)
+    for l in range(levels):
+        sel = ((w_int >> l) & 1).astype(bool) if l < levels - 1 \
+            else (top_extra > 0)
+        if sel.any():
+            pending[l].append(vals[sel])
+    return [np.concatenate(p) if p else np.empty(0) for p in pending]
+
+
+def rank_of(vec: np.ndarray, x: float) -> float:
+    """Estimated rank mass of ``x`` (weight of items <= x) — the other
+    half of the read-off, used by the rank-error oracles."""
+    v, w = items_and_weights(vec)
+    if len(v) == 0:
+        return 0.0
+    return float(w[v <= x].sum())
+
+
+class CompactorSketch:
+    """Single-key convenience wrapper over one compactor state (the
+    analysis harness / test twin; production keys live batched in
+    core.arena.CompactorArena)."""
+
+    def __init__(self, cap: int = DEFAULT_CAP, levels: int = DEFAULT_LEVELS,
+                 seed: int = DEFAULT_SEED):
+        self.cap, self.levels, self.seed = cap, levels, seed
+        self.vals = np.full((levels, cap), _PAD)
+        self.cnt = np.zeros(levels, np.int64)
+        self.comps = 0
+        self.clip = 0
+        self.count = 0.0
+        self.sum = 0.0
+        self.rsum = 0.0
+        self.min = np.inf
+        self.max = -np.inf
+
+    def _run_pass(self, stage_v, stage_n):
+        off, cnt_out, comps, clip = plan_pass(
+            stage_n, np.array([self.comps]), np.array([self.clip]),
+            self.seed, self.cap)
+        out = apply_pass(stage_v, stage_n, off, self.cap)
+        self.vals, self.cnt = out[0], cnt_out[0]
+        self.comps, self.clip = int(comps[0]), int(clip[0])
+
+    def add_batch(self, values, weights=None) -> None:
+        vals = np.asarray(values, np.float64).ravel()
+        if len(vals) == 0:
+            return
+        wts = (np.ones_like(vals) if weights is None
+               else np.asarray(weights, np.float64).ravel())
+        self.count += float(wts.sum())
+        self.sum += float(vals @ wts)
+        with np.errstate(divide="ignore"):
+            self.rsum += float((wts / vals).sum())
+        self.min = min(self.min, float(vals.min()))
+        self.max = max(self.max, float(vals.max()))
+        vals = np.clip(vals, -_FCLAMP, _FCLAMP)
+        s2 = STAGE_MUL * self.cap
+        pending = split_levels(vals, wts, self.levels)
+        pos = np.zeros(self.levels, np.int64)
+        while True:
+            stage_v = np.full((1, self.levels, s2), _PAD)
+            stage_n = np.zeros((1, self.levels), np.int64)
+            fed = False
+            for l in range(self.levels):
+                occ = self.cnt[l]
+                stage_v[0, l, :occ] = self.vals[l, :occ]
+                room = s2 - occ
+                take = min(room, len(pending[l]) - pos[l])
+                if take > 0:
+                    stage_v[0, l, occ:occ + take] = \
+                        pending[l][pos[l]:pos[l] + take]
+                    pos[l] += take
+                    fed = True
+                stage_n[0, l] = occ + take
+            if not fed:
+                break
+            self._run_pass(stage_v, stage_n)
+            if all(pos[l] >= len(pending[l]) for l in range(self.levels)):
+                break
+
+    def merge(self, other: "CompactorSketch | np.ndarray") -> None:
+        vec = (other.to_vector() if isinstance(other, CompactorSketch)
+               else np.asarray(other, np.float64))
+        merged = merge_vectors(self.to_vector()[None, :], vec[None, :])[0]
+        new = CompactorSketch.from_vector(merged)
+        self.__dict__.update(new.__dict__)
+
+    def to_vector(self) -> np.ndarray:
+        vec = empty_vector(self.cap, self.levels, self.seed)
+        vec[IDX_COUNT] = self.count
+        vec[IDX_SUM] = self.sum
+        vec[IDX_RSUM] = self.rsum
+        vec[IDX_MIN] = self.min
+        vec[IDX_MAX] = self.max
+        return _encode(vec, self.vals, self.cnt, self.comps, self.clip)
+
+    @classmethod
+    def from_vector(cls, vec: np.ndarray) -> "CompactorSketch":
+        cap, levels, seed = params_from_vector(vec)
+        s = cls(cap, levels, seed)
+        s.vals, s.cnt, s.comps, s.clip = state_from_vector(vec)
+        s.count = float(vec[IDX_COUNT])
+        s.sum = float(vec[IDX_SUM])
+        s.rsum = float(vec[IDX_RSUM])
+        s.min = float(vec[IDX_MIN]) if s.count else np.inf
+        s.max = float(vec[IDX_MAX]) if s.count else -np.inf
+        return s
+
+    def item_mass(self) -> float:
+        return float((self.cnt * 2.0 ** np.arange(self.levels)).sum())
+
+    def quantile(self, q: float) -> float:
+        return self.quantiles([q])[0]
+
+    def quantiles(self, qs) -> np.ndarray:
+        return quantiles_from_vectors(self.to_vector()[None, :],
+                                      np.asarray(qs, np.float64))[0]
+
+
+def merge_vectors(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Merge batched compactor wire vectors ``[n, M]`` level-wise:
+    concatenate each level's items (both sides are <= cap, so staging
+    fits 2*cap), then ONE bottom-up compaction pass.  Exact for
+    count/sum/min/max; the coin continues from the summed compaction
+    counters, so the merge is order-invariant bit-for-bit.  Param
+    (cap/levels/seed) mismatches are refused, never coerced."""
+    dst = np.asarray(dst, np.float64)
+    src = np.asarray(src, np.float64)
+    if dst.shape != src.shape:
+        raise ValueError(f"shape mismatch: {dst.shape} vs {src.shape}")
+    n = dst.shape[0]
+    out = np.empty_like(dst)
+    params = None
+    for i in range(n):
+        a, b = dst[i], src[i]
+        if float(b[IDX_COUNT]) == 0.0 and float(b[IDX_CAP]) == 0.0:
+            out[i] = a  # all-zero placeholder rows merge as identity
+            continue
+        if float(a[IDX_COUNT]) == 0.0 and float(a[IDX_CAP]) == 0.0:
+            out[i] = b
+            continue
+        pa, pb = params_from_vector(a), params_from_vector(b)
+        if pa != pb:
+            raise ValueError(f"compactor param mismatch: {pa} vs {pb}")
+        params = pa
+        cap, levels, seed = params
+        va, ca, qa, la = state_from_vector(a)
+        vb, cb, qb, lb = state_from_vector(b)
+        s2 = STAGE_MUL * cap
+        stage_v = np.full((1, levels, s2), _PAD)
+        stage_n = (ca + cb)[None, :]
+        for l in range(levels):
+            stage_v[0, l, :ca[l]] = va[l, :ca[l]]
+            stage_v[0, l, ca[l]:ca[l] + cb[l]] = vb[l, :cb[l]]
+        off, cnt_out, comps, clip = plan_pass(
+            stage_n, np.array([qa + qb]), np.array([la + lb]), seed, cap)
+        sv = apply_pass(stage_v, stage_n, off, cap)
+        vec = empty_vector(cap, levels, seed)
+        vec[IDX_COUNT] = a[IDX_COUNT] + b[IDX_COUNT]
+        vec[IDX_SUM] = a[IDX_SUM] + b[IDX_SUM]
+        vec[IDX_RSUM] = a[IDX_RSUM] + b[IDX_RSUM]
+        vec[IDX_MIN] = min(a[IDX_MIN], b[IDX_MIN]) \
+            if vec[IDX_COUNT] else np.inf
+        vec[IDX_MAX] = max(a[IDX_MAX], b[IDX_MAX]) \
+            if vec[IDX_COUNT] else -np.inf
+        out[i] = _encode(vec, sv[0], cnt_out[0], int(comps[0]),
+                         int(clip[0]))
+    return out

@@ -698,9 +698,17 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     op = arm.kwargs["op"]
     direct = op not in ("local-crash", "resident-crash")
     resident = op == "resident-crash"
+    # the local-crash cell additionally carries one compactor-family
+    # key: the checkpoint/restore arm is exactly where the ladder
+    # arena's durability matters, and its exact header count must
+    # survive the kill -9 + revival (gated below on the local tier's
+    # flush-duality .count emissions vs the oracle)
+    compactor_keys = 1 if op == "local-crash" else 0
     spec = ClusterSpec(
         n_locals=n_locals, n_globals=1 if direct else 2,
         durable=True, direct=direct,
+        sketch_family_rules=((TrafficGen.COMPACTOR_RULE,)
+                             if compactor_keys else ()),
         flush_resident_arenas=resident,
         flush_resident_device_assembly=True if resident else None,
         # the smallest chunk the arena allows (its 1024-point floor
@@ -726,7 +734,8 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
         histo_samples = max(histo_samples, 48)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
-                         histo_samples=histo_samples)
+                         histo_samples=histo_samples,
+                         compactor_histo_keys=compactor_keys)
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
     fired = 0
@@ -758,6 +767,26 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
             cluster.flush_locals()
             cluster.settle()
             per_interval.append(cluster.flush_globals())
+            if compactor_keys:
+                # compactor durability gate: the kill -9 landed after
+                # interval 2's ingest + checkpoint, so the REVIVED
+                # ladder's flush must emit that interval's exact
+                # sample count — the crashed process's memory never
+                # was the source of truth.  (Interval 1 flushed before
+                # the crash; its emissions died with the retired
+                # node's sink, which is the harness's bookkeeping,
+                # not data loss.)
+                ck = TrafficGen.COMPACTOR_PREFIX + "0"
+                want = sum(
+                    len(v) for (i2, nm), v
+                    in traffic.oracle.histos.items()
+                    if nm == ck and i2 == 1)
+                got = sum(
+                    m.value for loc in cluster.drain_local_sinks()
+                    for m in verify._filter(loc)
+                    if m.name == ck + ".count")
+                extra["compactor_count_exact"] = got == want
+                extra["compactor_counts"] = (got, want)
         elif op == "global-crash":
             # persist the global's (arenas + dedup ledger) cut, then
             # kill it with no drain
@@ -826,7 +855,8 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
     row["spool_closure"] = closure
     if op == "local-crash":
         row["ok"] = (fired >= 1 and row["conserved"]
-                     and row["routing_exclusive"])
+                     and row["routing_exclusive"]
+                     and extra.get("compactor_count_exact", False))
     elif op == "resident-crash":
         # EXACT conservation despite deltas stranded in the dead
         # process's HBM — and the arm is vacuous unless chunks really

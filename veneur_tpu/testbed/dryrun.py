@@ -45,6 +45,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                percentiles: tuple = (0.5, 0.9, 0.99),
                cardinality_key_budget: int = 0,
                moments_histo_keys: int = 0,
+               compactor_histo_keys: int = 0,
                chaos: str | None = None,
                lock_witness: bool = False,
                trace: bool = False,
@@ -111,6 +112,11 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             raise ValueError(
                 "the cube analytics arm runs in-process (check.py's "
                 "--cubes cell); drop --procs or drop --cubes")
+        if compactor_histo_keys:
+            raise ValueError(
+                "the compactor family is covered by the in-process "
+                "mixed-family dryrun (check.py's three-family cell); "
+                "drop --procs or drop --compactor-keys")
         return _run_proc_dryrun(
             n_locals=n_locals, n_globals=n_globals,
             intervals=intervals, seed=seed, interval_s=interval_s,
@@ -146,8 +152,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                        percentiles=tuple(percentiles),
                        cardinality_key_budget=cardinality_key_budget,
                        sketch_family_rules=(
-                           (TrafficGen.MOMENTS_RULE,)
-                           if (moments_histo_keys or cubes) else ()),
+                           ((TrafficGen.MOMENTS_RULE,)
+                            if (moments_histo_keys or cubes) else ())
+                           + ((TrafficGen.COMPACTOR_RULE,)
+                              if compactor_histo_keys else ())),
                        cube_dimensions=tuple(
                            g.dimension() for g in cube_gens),
                        cube_group_budget=(
@@ -159,7 +167,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples,
-                         moments_histo_keys=moments_histo_keys)
+                         moments_histo_keys=moments_histo_keys,
+                         compactor_histo_keys=compactor_histo_keys)
     cluster = Cluster(spec)
     per_interval: list[list[list]] = []
     per_interval_locals: list[list[list]] = []
@@ -182,7 +191,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                 _query_probes(cluster, traffic,
                               len(per_interval) - 1,
                               list(percentiles), histo_keys,
-                              moments_histo_keys, qstate)
+                              moments_histo_keys,
+                              compactor_histo_keys, qstate)
             if cubes:
                 _cube_probes(cluster, cube_gens,
                              len(per_interval), list(percentiles),
@@ -328,6 +338,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             "percentiles": list(percentiles),
             "cardinality_key_budget": cardinality_key_budget,
             "moments_histo_keys": moments_histo_keys,
+            "compactor_histo_keys": compactor_histo_keys,
             "cubes": cubes,
         },
         "per_tier": {
@@ -476,7 +487,7 @@ def _cube_probes(cluster, cube_gens, k: int, percentiles: list,
 
 def _query_probes(cluster, traffic, iv: int, percentiles: list,
                   histo_keys: int, moments_histo_keys: int,
-                  qstate: dict) -> None:
+                  compactor_histo_keys: int, qstate: dict) -> None:
     """One interval's /query probes on all three tiers (see
     run_dryrun's `query` docs).  Window = the newest
     min(intervals so far, _QUERY_PROBE_SLOTS) slots, whose covered
@@ -491,7 +502,9 @@ def _query_probes(cluster, traffic, iv: int, percentiles: list,
     qcsv = ",".join(repr(float(p)) for p in percentiles)
     names = ([f"{PREFIX}h{i}" for i in range(histo_keys)]
              + [f"{TrafficGen.MOMENTS_PREFIX}{i}"
-                for i in range(moments_histo_keys)])
+                for i in range(moments_histo_keys)]
+             + [f"{TrafficGen.COMPACTOR_PREFIX}{i}"
+                for i in range(compactor_histo_keys)])
     n_locals = len(cluster.locals)
 
     def probe(addr: str, name: str):
@@ -657,6 +670,7 @@ def _run_proc_dryrun(*, n_locals: int, n_globals: int, intervals: int,
             "percentiles": list(percentiles),
             "cardinality_key_budget": 0,
             "moments_histo_keys": 0,
+            "compactor_histo_keys": 0,
             "procs": True,
             "meshed_globals": spec.meshed,
         },

@@ -63,11 +63,17 @@ class TrafficGen:
     # arena, so one traffic stream drives both families at once
     MOMENTS_PREFIX = PREFIX + "mh"
     MOMENTS_RULE = {"match": MOMENTS_PREFIX + "*", "family": "moments"}
+    # same shape for the compactor family: a third rule and prefix let
+    # one traffic stream drive all three families through one cluster
+    COMPACTOR_PREFIX = PREFIX + "ch"
+    COMPACTOR_RULE = {"match": COMPACTOR_PREFIX + "*",
+                      "family": "compactor"}
 
     def __init__(self, seed: int = 0, counter_keys: int = 8,
                  histo_keys: int = 4, set_keys: int = 2,
                  histo_samples: int = 200, set_members: int = 12,
-                 counter_max: int = 9, moments_histo_keys: int = 0):
+                 counter_max: int = 9, moments_histo_keys: int = 0,
+                 compactor_histo_keys: int = 0):
         self.rng = np.random.default_rng(seed)
         self.oracle = Oracle()
         self.counter_keys = counter_keys
@@ -77,6 +83,7 @@ class TrafficGen:
         self.set_members = set_members
         self.counter_max = counter_max
         self.moments_histo_keys = moments_histo_keys
+        self.compactor_histo_keys = compactor_histo_keys
         self.interval = 0
 
     def next_interval(self, n_locals: int) -> list[list[bytes]]:
@@ -119,6 +126,19 @@ class TrafficGen:
                 lines[li].append(f"{name}:{v:.6f}|h".encode())
                 self.oracle.add_histo(iv, name, float(v),
                                       family="moments")
+
+        # compactor-family histograms: third family, same traffic
+        # shape — COMPACTOR_RULE routes these to the compactor arena
+        # and the oracle gates them on the family's PROVABLE rank-
+        # error envelope instead of a measured one
+        for k in range(self.compactor_histo_keys):
+            name = f"{self.COMPACTOR_PREFIX}{k}"
+            vals = self.rng.gamma(2.0, 10.0, self.histo_samples)
+            for j, v in enumerate(vals):
+                li = j % n_locals
+                lines[li].append(f"{name}:{v:.6f}|h".encode())
+                self.oracle.add_histo(iv, name, float(v),
+                                      family="compactor")
 
         # sets: interval-scoped members (the global's HLL resets each
         # flush, so distinctness is per interval), partitioned across
