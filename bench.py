@@ -1576,6 +1576,196 @@ def bench_query_plane(n_keys: int = 20_000, iters: int = 16,
         srv.shutdown()
 
 
+def bench_retention(days: int = 30, cut_s: float = 300.0,
+                    n_keys: int = 3, queries_per_res: int = 12,
+                    flush_pairs: int = 8,
+                    flush_keys: int = 5_000) -> dict:
+    """Multi-resolution retention timeline (ISSUE-20 acceptance): a
+    month-long synthetic timeline — ``days`` of cuts at ``cut_s``
+    cadence cascading through a 5min -> hour -> day tier ladder, the
+    day tier's ring deliberately smaller than the month so its tail
+    spills to the CRC-framed segment store — then timed
+    ``?since=&step=`` range reads through the real engine entry at
+    EACH resolution the plane serves: second-step (the window ring,
+    fed by the paired flush phase), 5-minute, hour, and day step (the
+    day read decodes the on-disk segments every time).
+
+    Reported:
+      timeline_query_p50_ms / timeline_query_p99_ms
+                    range-read latency pooled across the resolutions
+                    (per-resolution medians ride in the sub-dict);
+                    plan -> per-bin tier fusion -> ONE batched
+                    per-family eval -> payload
+      retention_footprint_bytes
+                    in-memory tiers + on-disk segments after the
+                    month is loaded — the bounded-retention claim's
+                    number
+      retention_flush_degrade_pct
+                    PAIRED A/B (the bench_query_plane pattern): the
+                    same flush loop with the compaction hook attached
+                    vs detached, alternating within each pair so host
+                    drift cancels.  The hook only ENQUEUES the cut's
+                    immutable parts (the egress-lane pattern) — the
+                    delta prices the handoff plus the compaction
+                    worker's GIL share while it summarizes the
+                    previous cut on a CPU box (the worker's device
+                    segments release the GIL on the driver host)
+    """
+    import math
+    import shutil
+    import tempfile
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.samplers import samplers as sm
+    from veneur_tpu.samplers.metric_key import MetricKey, MetricScope
+    from veneur_tpu.sketches import compactor as cs
+    from veneur_tpu.sketches import moments as mo
+
+    tiers = [{"seconds": cut_s, "buckets": 24, "name": "5min"},
+             {"seconds": 3600.0, "buckets": 48, "name": "hour"},
+             {"seconds": 86400.0, "buckets": max(4, days // 3),
+              "name": "day"}]
+    spill_dir = tempfile.mkdtemp(prefix="bench-retention-")
+    cfg = config_mod.Config(
+        interval=10.0, percentiles=list(PERCENTILES),
+        hostname="ret-bench", trace_flush_enabled=False,
+        query_window_slots=4, retention_tiers=tiers,
+        retention_dir=spill_dir)
+    srv = Server(cfg)
+    srv.start()
+    try:
+        agg = srv.aggregator
+        tl = agg.retention
+        rng = np.random.default_rng(17)
+        now = time.time()
+        t_begin = math.floor((now - days * 86400.0) / 86400.0) * 86400.0
+        n_cuts = int(days * 86400.0 / cut_s)
+        names = [f"rb.h{i}" for i in range(n_keys)]
+        ones16 = np.ones(16)
+        t_b0 = time.perf_counter()
+        for ci in range(n_cuts):
+            cut = t_begin + (ci + 1) * cut_s
+            vals = rng.gamma(2.0, 10.0, (n_keys, 16))
+            td = {}
+            for ki, name in enumerate(names):
+                v = vals[ki]
+                td[(name, "", "histogram")] = {
+                    "v": v, "w": ones16.copy(),
+                    "min": float(v.min()), "max": float(v.max()),
+                    "count": 16.0, "sum": float(v.sum()),
+                    "rsum": 0.0}
+            ms = mo.MomentsSketch()
+            ms.add_batch(vals[0])
+            ck = cs.CompactorSketch()
+            ck.add_batch(vals[1])
+            tl.absorb_summaries(
+                td, {("rb.m0", "", "histogram"): ms.vec.copy()},
+                {("rb.c0", "", "histogram"): ck.to_vector()}, cut)
+        build_s = time.perf_counter() - t_b0
+        tstats = tl.stats()
+        footprint = int(tstats["footprint_bytes"])
+
+        # paired flush A/B: the hook attached vs detached, alternating
+        # order within each pair (bench_query_plane's drift-cancelling
+        # design); ingest between flushes so every cut carries keys
+        rows = np.empty(flush_keys, np.int64)
+        with agg.lock:
+            for i in range(flush_keys):
+                rows[i] = agg.digests.row_for(
+                    MetricKey(f"rb.f{i}", sm.TYPE_HISTOGRAM, ""),
+                    MetricScope.GLOBAL_ONLY, [])
+        wts = np.ones(flush_keys)
+
+        def flush_once() -> float:
+            vals = rng.gamma(2.0, 10.0, flush_keys)
+            with agg.lock:
+                agg.digests.sample_batch(rows, vals, wts)
+                agg.digests.touched[rows] = True
+            agg.sync_staged(min_samples=1)
+            t0 = time.perf_counter()
+            srv.flush()
+            return time.perf_counter() - t0
+
+        deltas: list[float] = []
+        offs: list[float] = []
+        for i in range(flush_pairs + 2):
+            # drain between arms so each timed flush sees the same
+            # idle worker; the on-arm still races the worker for the
+            # part IT just enqueued — the deployed contention shape
+            if i % 2:
+                agg.retention = tl
+                t_on = flush_once()
+                tl.drain()
+                agg.retention = None
+                t_off = flush_once()
+            else:
+                agg.retention = None
+                t_off = flush_once()
+                agg.retention = tl
+                t_on = flush_once()
+                tl.drain()
+            if i >= 2:          # first pairs pay compile/warmup
+                deltas.append(t_on - t_off)
+                offs.append(t_off)
+        agg.retention = tl
+        tl.drain()
+        p50_off = float(np.percentile(offs, 50))
+        degrade = float(np.percentile(deltas, 50)) / p50_off * 100.0
+
+        # timed range reads at each served resolution (the flush phase
+        # just fed the window ring, so the second-step read is live)
+        resolutions = [
+            ("second", "rb.f0", 8.0, 1.0),
+            ("5min", "rb.h0", 86400.0, cut_s),
+            ("hour", "rb.h1", 7 * 86400.0, 3600.0),
+            ("day", "rb.h2", days * 86400.0, 86400.0),
+        ]
+        lat_by_res: dict = {}
+        all_lat: list[float] = []
+        for label, name, span, step in resolutions:
+            lats = []
+            for _ in range(queries_per_res):
+                t0 = time.perf_counter()
+                code, body = srv.query.serve(
+                    {"name": [name], "q": ["0.5,0.99"],
+                     "since": [repr(time.time() - span)],
+                     "step": [repr(step)], "type": ["histogram"]})
+                dt = (time.perf_counter() - t0) * 1e3
+                assert code == 200, (label, code, body)
+                lats.append(dt)
+                all_lat.append(dt)
+            lat_by_res[label] = round(float(np.percentile(lats, 50)),
+                                      3)
+        out = {
+            "timeline_query_p50_ms": round(
+                float(np.percentile(all_lat, 50)), 3),
+            "timeline_query_p99_ms": round(
+                float(np.percentile(all_lat, 99)), 3),
+            "retention_footprint_bytes": footprint,
+            "retention_on_disk_bytes": int(tstats["on_disk_bytes"]),
+            "retention_spilled_buckets": int(
+                tstats["spilled_buckets"]),
+            "retention_buckets": int(tstats["buckets"]),
+            "retention_flush_degrade_pct": round(degrade, 2),
+            "timeline_query_by_resolution_ms": lat_by_res,
+            "timeline_cuts": n_cuts,
+            "timeline_build_s": round(build_s, 2),
+        }
+        log(f"retention arm: {n_cuts} cuts over {days}d built in "
+            f"{build_s:.1f}s — {tstats['buckets']} bucket(s), "
+            f"{tstats['spilled_buckets']} spilled "
+            f"({out['retention_on_disk_bytes']} B on disk), "
+            f"footprint {footprint} B; range p50 "
+            f"{out['timeline_query_p50_ms']} ms / p99 "
+            f"{out['timeline_query_p99_ms']} ms "
+            f"{lat_by_res}; flush degrade {degrade:+.2f}%")
+        return out
+    finally:
+        srv.shutdown()
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
 def bench_cube_query(total_series: int = 102_400,
                      group_counts: tuple = (64, 256, 1024),
                      iters: int = 40) -> dict:
@@ -1992,6 +2182,22 @@ def main() -> None:
         for k in ("query_p50_ms", "query_p99_ms",
                   "query_staleness_ms"):
             result[k] = {"error": str(e)[:200]}
+    # multi-resolution retention (ISSUE-20 acceptance: a month-long
+    # synthetic timeline answers ?since=&step= range reads at every
+    # served resolution with a bounded, spill-backed footprint, and
+    # the compaction hook's flush-path cost is a paired A/B delta).
+    # Promised keys: error values on arm failure, like kernel_stage_ms.
+    _RET_KEYS = ("timeline_query_p50_ms", "timeline_query_p99_ms",
+                 "retention_footprint_bytes",
+                 "retention_flush_degrade_pct")
+    try:
+        rb = bench_retention()
+        result.update({k: rb[k] for k in _RET_KEYS})
+        result["retention"] = rb
+    except Exception as e:
+        log(f"retention arm failed: {e}")
+        for k in _RET_KEYS:
+            result[k] = {"error": str(e)[:200]}
     # group-by cube analytics (ISSUE-17 acceptance: group-by quantile
     # reads over 100k+ distinct series answer in single-digit ms on
     # CPU at the operator dashboard shape; the sweep shows cost
@@ -2125,6 +2331,9 @@ def main() -> None:
                 "query_p99_ms", "query_staleness_ms",
                 "cube_query_p50_ms", "cube_query_p99_ms",
                 "cube_groups_per_launch",
+                "timeline_query_p50_ms", "timeline_query_p99_ms",
+                "retention_footprint_bytes",
+                "retention_flush_degrade_pct",
                 "delta_flush_e2e_p50_ms", "delta_flush_e2e_p99_ms",
                 "upload_amortized_pct", "resident_vs_staged_speedup",
                 "ingest_pkts_per_s", "ingest_stage_ns"]

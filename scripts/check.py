@@ -289,6 +289,27 @@ def main() -> int:
                         "PASS" if cube_rc == 0 else "FAIL",
                         time.perf_counter() - t0))
 
+    # 3j. multi-resolution retention cell (ISSUE 20): the tiered
+    # timeline behind every local arena — cascades, the coarsest
+    # tier's CRC-framed disk spill, and timed ?since=&step= range
+    # queries — gated on source coverage, oracle mass, and a CLOSED
+    # spill/expiry ledger (report promises
+    # retention.{buckets,spilled,expired,query_p50_ms})
+    retention_rc = 0
+    if args.fast:
+        results.append(("retention dryrun cell", "SKIP", 0.0))
+    else:
+        t0 = stage("retention dryrun cell (tiered timeline + spill)")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        retention_rc = subprocess.call(
+            [sys.executable, "scripts/dryrun_3tier.py", "--retention",
+             "--intervals", "6", "--histo-keys", "2",
+             "--counter-keys", "2", "--set-keys", "1"],
+            env=env)
+        results.append(("retention dryrun cell",
+                        "PASS" if retention_rc == 0 else "FAIL",
+                        time.perf_counter() - t0))
+
     # 3i. ingest data-plane regression floor (ISSUE 18): a short
     # saturation window through the real native readers must stay above
     # INGEST_FLOOR_PPS packets/s (scripts/ingest_ceiling.py
@@ -337,7 +358,8 @@ def main() -> int:
         print(f"  {name:24s} {verdict:5s} {dt:8.1f}s")
     rc = 1 if (lint_rc or native_rc or reshard_rc or crash_rc
                or egress_rc or mixed_rc or proc_rc or resident_rc
-               or query_rc or cube_rc or ingest_rc or test_rc) else 0
+               or query_rc or cube_rc or retention_rc or ingest_rc
+               or test_rc) else 0
     print(f"check: {'CLEAN' if rc == 0 else 'FAILED'}")
     return rc
 

@@ -44,6 +44,13 @@ Usage:
                                           # both gated on the exact
                                           # ledger; overflow stays
                                           # accounted in the other row
+  python scripts/dryrun_3tier.py --retention  # multi-resolution
+                                          # retention cell: tiered
+                                          # timeline + disk spill behind
+                                          # every local arena, timed
+                                          # ?since=&step= range queries
+                                          # gated on coverage + a closed
+                                          # spill/expiry ledger
   python scripts/dryrun_3tier.py --trace   # traced: every interval must
                                            # assemble into ONE complete
                                            # 3-tier trace (incl. the
@@ -138,6 +145,16 @@ def main(argv=None) -> int:
                     "probe) is gated on the exact per-group ledger and "
                     "the family envelopes.  Nonzero exit on any "
                     "unaccounted group mass")
+    ap.add_argument("--retention", action="store_true",
+                    help="run the multi-resolution retention cell: "
+                    "every local's arena grows the tiered timeline "
+                    "(sub-second ladder so cascades — and the coarsest "
+                    "tier's CRC-framed disk spill — happen inside the "
+                    "run), and each interval times a `?since=&step=` "
+                    "range query per histogram on the local /query "
+                    "surface, gated on source coverage, oracle mass, "
+                    "and a CLOSED spill/expiry ledger.  Nonzero exit "
+                    "on any dropped bucket or open ledger")
     ap.add_argument("--lock-witness", action="store_true",
                     help="wrap every tier's named locks in the runtime "
                     "lock witness and cross-validate observed "
@@ -227,7 +244,8 @@ def main(argv=None) -> int:
         compactor_histo_keys=args.compactor_keys,
         chaos=args.chaos, lock_witness=args.lock_witness,
         trace=args.trace, telemetry=args.telemetry,
-        query=args.query, cubes=args.cubes, procs=args.procs)
+        query=args.query, cubes=args.cubes,
+        retention=args.retention, procs=args.procs)
 
     body = json.dumps(report, indent=2, default=str)
     if args.out:
@@ -261,6 +279,13 @@ def main(argv=None) -> int:
                  f"{cu['rollup_points']} rollup points, "
                  f"{cu['overflowed']} overflowed (accounted), "
                  f"group-by p50 {cu['query_p50_ms']} ms")
+    if args.retention and report["retention"] is not None:
+        rr = report["retention"]
+        tail += ("; retention: "
+                 f"{rr['buckets']} bucket(s), "
+                 f"{rr['spilled']} spilled, {rr['expired']} expired, "
+                 f"ledger {'CLOSED' if rr['ledger_closed'] else 'OPEN'}"
+                 f", range p50 {rr['query_p50_ms']} ms")
     if args.moments_keys or args.compactor_keys:
         sf = report["sketch_families"]
         tail += ("; mixed-family: "

@@ -510,3 +510,129 @@ def test_testbed_query_oracle_full_sweep():
     assert report["ok"], report
     qr = report["query"]
     assert qr["ok"] and qr["served"] >= 40 and qr["errors"] == 0
+
+
+# -- the ?since=&step= range form (multi-resolution retention) --------------
+
+def test_range_form_param_validation_400s():
+    """Every malformed range request answers 400, never a crash or a
+    silent full-window fallback: future since=, step<=0, non-finite
+    values, a lone since= or step=, until= at or before since=,
+    mixing the range form with slots=/window_s=, and a bin count
+    past MAX_RANGE_BINS."""
+    import time as _time
+
+    agg = _agg()
+    eng = QueryEngine(agg)
+    _ingest_histo(agg, "h", [1.0])
+    agg.flush(is_local=False)
+    now = _time.time()
+    bad = [
+        {"name": ["h"], "since": [repr(now + 60)], "step": ["1"]},
+        {"name": ["h"], "since": [repr(now - 60)], "step": ["0"]},
+        {"name": ["h"], "since": [repr(now - 60)], "step": ["-1"]},
+        {"name": ["h"], "since": [repr(now - 60)], "step": ["nan"]},
+        {"name": ["h"], "since": ["inf"], "step": ["1"]},
+        {"name": ["h"], "since": ["x"], "step": ["1"]},
+        {"name": ["h"], "since": [repr(now - 60)]},       # no step
+        {"name": ["h"], "step": ["1"]},                   # no since
+        {"name": ["h"], "since": [repr(now - 60)], "step": ["1"],
+         "until": [repr(now - 60)]},                      # until<=since
+        {"name": ["h"], "since": [repr(now - 60)], "step": ["1"],
+         "slots": ["1"]},
+        {"name": ["h"], "since": [repr(now - 60)], "step": ["1"],
+         "window_s": ["5"]},
+        {"name": ["h"], "since": [repr(now - 7 * 86400)],
+         "step": ["0.001"]},                              # bins cap
+    ]
+    for q in bad:
+        code, body = eng.serve(q)
+        assert code == 400 and "error" in body, q
+    assert eng.stats()["errors"] == len(bad)
+    # the window forms stay hardened too
+    for q in ({"name": ["h"], "window_s": ["0"]},
+              {"name": ["h"], "window_s": ["nan"]},
+              {"name": ["h"], "window_s": ["inf"]},
+              {"name": ["h"], "window_s": ["-0.5"]}):
+        code, body = eng.serve(q)
+        assert code == 400 and "error" in body, q
+
+
+def test_range_form_serves_bins_over_the_ring():
+    """Without retention tiers the range form still answers from the
+    window ring's slots, with coverage metadata per bin."""
+    import time as _time
+
+    agg = _agg()
+    eng = QueryEngine(agg)
+    # the first-ever cut's slot is zero-width (no prior cut anchors
+    # its window start), so warm the ring before the measured flush
+    agg.flush(is_local=False)
+    _ingest_histo(agg, "h", [1.0, 2.0, 3.0, 4.0])
+    agg.flush(is_local=False)
+    since = _time.time() - 5.0
+    code, body = eng.serve({"name": ["h"], "q": ["0.5"],
+                            "since": [repr(since)], "step": ["5"]})
+    assert code == 200 and body["range"]
+    assert body["bins"] == len(body["series"]) >= 1
+    assert "ring" in body["sources"]
+    assert sum(e["count"] for e in body["series"]) == 4.0
+    covered = [e for e in body["series"] if e["count"] > 0]
+    assert covered and covered[0]["family"] == "tdigest"
+    assert covered[0]["coverage_s"] > 0
+    assert covered[0]["quantiles"][repr(0.5)] == 2.5
+
+
+def test_range_form_404_when_query_plane_disabled():
+    import time as _time
+
+    agg = MetricAggregator(percentiles=[0.5], query_window_slots=0)
+    eng = QueryEngine(agg)
+    code, body = eng.serve({"name": ["h"],
+                            "since": [repr(_time.time() - 10)],
+                            "step": ["10"]})
+    assert code == 404
+
+
+def test_http_range_query_endpoint(tmp_path):
+    """?since=&step= over HTTP end to end, against a server whose
+    retention ladder is live: response carries bins/series/sources
+    and the /debug/vars retention block grows its served counter."""
+    import time as _time
+
+    from veneur_tpu import config as config_mod
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.http_api import HttpApi
+    srv = Server(config_mod.Config(
+        interval=10.0, percentiles=[0.5],
+        query_window_slots=4, hostname="r-test",
+        retention_tiers=[{"seconds": 0.25, "buckets": 4},
+                         {"seconds": 0.5, "buckets": 4}],
+        retention_dir=str(tmp_path / "tiers")))
+    srv.start()
+    api = HttpApi(srv, "127.0.0.1:0")
+    api.start()
+    try:
+        t0 = _time.time()
+        _ingest_histo(srv.aggregator, "tb.r", [1.0, 2.0, 3.0])
+        srv.flush()
+        assert srv.aggregator.retention.drain(timeout=10.0)
+        base = f"http://127.0.0.1:{api.address[1]}"
+        url = (f"{base}/query?name=tb.r&q=0.5"
+               f"&since={t0 - 1.0}&step=10")
+        with urllib.request.urlopen(url) as resp:
+            body = json.loads(resp.read())
+        assert body["range"] and body["bins"] >= 1
+        assert sum(e["count"] for e in body["series"]) == 3.0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/query?name=tb.r&since=1&step=0")
+        assert ei.value.code == 400
+        with urllib.request.urlopen(f"{base}/debug/vars") as resp:
+            dv = json.loads(resp.read())
+        assert dv["retention"]["compactions"] >= 1
+        assert dv["retention"]["buckets"] >= 1
+        assert dv["query"]["served"] >= 1
+    finally:
+        api.stop()
+        srv.shutdown()

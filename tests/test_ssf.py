@@ -226,7 +226,12 @@ def test_ssf_unix_stream_end_to_end(tmp_path):
             if not got:
                 srv.flush()
                 time.sleep(0.05)
-        assert {m.name for m in got} == {"temp"}
+        names = {m.name for m in got}
+        assert "temp" in names
+        # ssf.names_unique is a randomly-sampled self-metric
+        # (convert_span_uniqueness_metrics) and may ride the same
+        # flush; nothing else should.
+        assert names <= {"temp", "ssf.names_unique"}
     finally:
         srv.shutdown()
 

@@ -126,6 +126,14 @@ PAIRS = (
     PairSpec("proc-cluster node",
              frozenset({"spawn_node"}),
              frozenset({"terminate_node", "harvest_node"})),
+    # retention tier-segment handle (retention/spill.py): like the
+    # spool's segment handle — an open_tier_segment leaked on an error
+    # path strands an fd and leaves the segment tail un-fsynced, so
+    # the revive scan reads a torn record where a graceful
+    # close_tier_segment would have committed it
+    PairSpec("tier segment handle",
+             frozenset({"open_tier_segment"}),
+             frozenset({"close_tier_segment"})),
 )
 
 

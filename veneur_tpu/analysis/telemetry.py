@@ -141,6 +141,18 @@ LEDGERS = {
         "closure": None,
         "prefixes": ("worker.span.", "spans."),
     },
+    "retention": {
+        # the retention block flattens TierSegmentStore.stats() at its
+        # top level (zeros when no spill dir is configured) precisely
+        # so this closure can be asserted field-by-field over
+        # /debug/vars -> retention
+        "debug_vars": "retention",
+        "producer": ("TierSegmentStore", "stats"),
+        "closure": (("spilled_points", "recovered_points"),
+                    ("expired_points", "dropped_points",
+                     "pending_points")),
+        "prefixes": ("retention.",),
+    },
 }
 
 

@@ -204,6 +204,25 @@ class Config:
     # inherit (8 is the recommended enabled value; see example.yaml).
     query_window_slots: int = 0
     query_slot_seconds: float = 0.0
+    # multi-resolution retention (veneur_tpu/retention/): every flush
+    # cut additionally compacts into a finest-first ladder of coarser
+    # bucket tiers (each entry {seconds: <bucket width>, buckets:
+    # <ring capacity>[, name: <label>]}), kept mergeable by
+    # construction for all three sketch families; `GET
+    # /query?since=&step=` then answers bucketed ranges from whichever
+    # tier covers the window.  Requires the live query plane
+    # (query_window_slots > 0) — the tiers compact the same flush-cut
+    # snapshots the window ring holds.  Empty = retention off.
+    retention_tiers: list = field(default_factory=list)
+    # retention_dir != "": buckets evicted from the COARSEST in-memory
+    # tier spill to CRC-framed tier segments (the ForwardSpool disk
+    # format) and survive kill -9 — re-indexed on boot, queryable like
+    # in-memory buckets.  Bounded by retention_max_bytes /
+    # retention_max_age (0 = bytes budget only); expiry is visibly-
+    # accounted loss (/debug/vars -> retention), never silent.
+    retention_dir: str = ""              # "" = disk spill off
+    retention_max_bytes: int = 256 * 1024 * 1024
+    retention_max_age: float = 0.0       # oldest bucket kept ("30d")
     # evaluate t-digest flush quantiles in float64 (the reference's
     # merging_digest.go float64 semantics): keeps integer exactness for
     # values past 2^24 (epoch stamps, byte counters) at the cost of
@@ -493,6 +512,37 @@ class Config:
             self.query_window_slots = 0
         if self.query_slot_seconds < 0:
             self.query_slot_seconds = 0.0
+        if self.retention_max_bytes <= 0:
+            self.retention_max_bytes = 256 * 1024 * 1024
+        if self.retention_max_age < 0:
+            self.retention_max_age = 0.0
+        if self.retention_tiers:
+            if self.query_window_slots <= 0:
+                raise ValueError(
+                    "retention_tiers requires the live query plane "
+                    "(query_window_slots > 0): the tiers compact the "
+                    "same flush-cut snapshots the window ring holds")
+            prev = 0.0
+            for t in self.retention_tiers:
+                if not isinstance(t, dict):
+                    raise ValueError(
+                        f"bad retention tier {t!r}: need "
+                        "{seconds: <width>, buckets: <capacity>}")
+                secs = float(t.get("seconds", 0))
+                if secs <= prev:
+                    raise ValueError(
+                        "retention_tiers must be finest-first with "
+                        f"strictly increasing seconds (got {secs} "
+                        f"after {prev})")
+                if int(t.get("buckets", 8)) < 1:
+                    raise ValueError(
+                        f"retention tier {t!r}: buckets must be >= 1")
+                prev = secs
+        elif self.retention_dir:
+            raise ValueError(
+                "retention_dir without retention_tiers: the spill "
+                "store holds tier evictions — configure the tier "
+                "ladder or drop the directory")
         if self.metric_max_length <= 0:
             self.metric_max_length = 4096
         if self.ingest_reader_shards < 0:
@@ -598,7 +648,7 @@ _DURATION_FIELDS = {"interval", "forward_timeout", "ingest_drain_interval",
                     "egress_retry_backoff", "egress_breaker_reset",
                     "egress_spool_max_age",
                     "egress_spool_replay_interval",
-                    "query_slot_seconds"}
+                    "query_slot_seconds", "retention_max_age"}
 
 
 def _coerce(key: str, value: Any) -> Any:

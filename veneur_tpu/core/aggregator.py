@@ -126,7 +126,12 @@ class MetricAggregator:
                  query_slot_seconds: float = 0.0,
                  cube_dimensions: Optional[list] = None,
                  cube_group_budget: int = 0,
-                 cube_seed: int = 0):
+                 cube_seed: int = 0,
+                 retention_tiers: Optional[list] = None,
+                 retention_dir: str = "",
+                 retention_max_bytes: int = 256 * 1024 * 1024,
+                 retention_max_age_s: float = 0.0,
+                 retention_statsd_fn=None):
         self.percentiles = percentiles if percentiles is not None else [0.5]
         self.aggregates = aggregates
         self.lock = threading.Lock()
@@ -364,6 +369,26 @@ class MetricAggregator:
                                       query_slot_seconds),
                 "compactor": WindowRing(query_window_slots,
                                         query_slot_seconds)}
+        # multi-resolution retention (veneur_tpu/retention/): the same
+        # flush-cut snapshot parts the window ring holds also compact
+        # UPWARD into coarser in-memory tiers (minute/hour/day rings of
+        # mergeable buckets); buckets evicted from the coarsest tier
+        # spill to disk in the spool's CRC-framed segment format under
+        # a byte/age budget.  Requires the query plane (the range
+        # planner fuses ring slots and tier buckets behind one
+        # ?since=&step= surface) — config.apply_defaults enforces it.
+        self.retention = None
+        if retention_tiers:
+            from veneur_tpu.retention import (RetentionTimeline,
+                                              TierSegmentStore)
+            store = None
+            if retention_dir:
+                store = TierSegmentStore(retention_dir,
+                                         max_bytes=retention_max_bytes,
+                                         max_age_s=retention_max_age_s)
+            self.retention = RetentionTimeline(
+                retention_tiers, store=store, compression=compression,
+                statsd_fn=retention_statsd_fn)
 
     # -- ingest (ProcessMetric, worker.go:348-396) -------------------------
 
@@ -906,6 +931,17 @@ class MetricAggregator:
             meta["families"][name] = fmeta
             for k, v in farr.items():
                 arrays[f"{name}/{k}"] = v
+        # in-memory retention tiers ride the arena cut (outside the
+        # aggregator lock — the timeline has its own lock and is only
+        # ever mutated from the flush-emit path, which is not running
+        # concurrently with a checkpoint writer's capture by contract).
+        # On-disk tier segments are durable on their own; only the
+        # in-memory rings need the checkpoint.
+        if self.retention is not None:
+            rmeta, rarr = self.retention.checkpoint_capture()
+            meta["retention"] = rmeta
+            for k, v in rarr.items():
+                arrays[f"retention/{k}"] = v
         return meta, arrays
 
     def restore_state(self, meta: dict, arrays: dict) -> None:
@@ -940,6 +976,17 @@ class MetricAggregator:
             if (self.cardinality is not None
                     and meta.get("cardinality") is not None):
                 self.cardinality.restore_state(meta["cardinality"])
+        # retention tiers restore OUTSIDE the aggregator lock (the
+        # timeline has its own lock; keeping the two unnested keeps the
+        # lock-order graph acyclic).  Geometry mismatch cold-starts the
+        # tiers (documented in retention/timeline.py); absent block
+        # (pre-retention checkpoint) cold-starts too.
+        if (self.retention is not None
+                and meta.get("retention") is not None):
+            prefix = "retention/"
+            rarr = {k[len(prefix):]: v for k, v in arrays.items()
+                    if k.startswith(prefix)}
+            self.retention.checkpoint_restore(meta["retention"], rarr)
 
     # -- flush -------------------------------------------------------------
 
@@ -1078,6 +1125,16 @@ class MetricAggregator:
             self.query_rings["moments"].rotate(snap["moments"], cut_ts)
             self.query_rings["compactor"].rotate(snap["compactors"],
                                                  cut_ts)
+            # the retention timeline compacts the SAME immutable cut
+            # upward into its coarser tiers (summarized per-key state,
+            # not part references — the part's lifetime stays bound to
+            # the ring).  Runs at emit, off the ingest lock, like the
+            # rotation it rides.
+            if self.retention is not None:
+                self.retention.compact_cut(
+                    snap["digests"], snap["moments"],
+                    snap["compactors"], cut_ts,
+                    self.moments, self.compactors)
         return res
 
     @staticmethod

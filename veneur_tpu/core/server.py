@@ -224,7 +224,15 @@ class Server:
                                 or cfg.interval),
             cube_dimensions=list(cfg.cube_dimensions),
             cube_group_budget=cfg.cube_group_budget,
-            cube_seed=cfg.cube_seed)
+            cube_seed=cfg.cube_seed,
+            retention_tiers=list(cfg.retention_tiers),
+            retention_dir=(os.path.expanduser(cfg.retention_dir)
+                           if cfg.retention_dir else ""),
+            retention_max_bytes=cfg.retention_max_bytes,
+            retention_max_age_s=cfg.retention_max_age,
+            # lazy: self.statsd is created at start(); the timeline
+            # resolves the client per emission via scopedstatsd.ensure
+            retention_statsd_fn=lambda: self.statsd)
         self.forwarder = forwarder
 
         # sinks: configured kinds + directly injected instances
@@ -2064,6 +2072,25 @@ class Server:
                     self.forwarder.close()
             except Exception:
                 pass
+        ret = getattr(self.aggregator, "retention", None)
+        if ret is not None:
+            # stop the compaction worker first — crash discards its
+            # queue (those cuts were never checkpointed) so a dying
+            # server can't keep spilling into a directory its revival
+            # reopened.  Then graceful exit settles the active tier
+            # segment to disk; a crash leaves it as-is — the revived
+            # store re-indexes the durable segments (torn tail
+            # truncated, CRC-failing records rejected) exactly like
+            # the forward spool
+            try:
+                ret.close(drain=not self._crashed)
+            except Exception:
+                logger.exception("retention worker close failed")
+            if ret.store is not None:
+                try:
+                    ret.store.close(drain=not self._crashed)
+                except Exception:
+                    logger.exception("retention store close failed")
         for _, sink in self.metric_sinks:
             if hasattr(sink, "close"):
                 try:

@@ -209,6 +209,13 @@ def debug_vars(server) -> dict:
         # percentiles, and per-family ring occupancy (slots held,
         # total cuts, evictions, staged points retained)
         stats["query"] = query.stats()
+    retention = getattr(server.aggregator, "retention", None)
+    if retention is not None:
+        # multi-resolution retention: per-tier bucket occupancy,
+        # on-disk bytes, and the spill/expiry ledger (the telemetry
+        # witness asserts spilled + recovered == expired + dropped +
+        # pending directly over this block)
+        stats["retention"] = retention.stats()
     return stats
 
 
@@ -450,25 +457,46 @@ def _jax_profile(server, seconds: float) -> dict:
     import jax
 
     with _profile_lock:
+        # Profiler defaults serialize an HLO proto for EVERY module
+        # the process ever compiled plus a python-call trace of every
+        # live thread — in a long-lived process the export alone can
+        # take a minute.  A serving endpoint needs bounded cost: keep
+        # the device/TraceMe timeline, drop the unbounded extras.
+        # (_profile_lock also guards the one-active-session limit.)
+        session = None
+        try:
+            from jax._src.lib import xla_client
+
+            opts = xla_client.profiler.ProfileOptions()
+            opts.python_tracer_level = 0
+            opts.enable_hlo_proto = False
+            session = xla_client.profiler.ProfilerSession(opts)
+        except Exception:   # older/newer jaxlib: default profiler
+            session = None
         trace_dir = tempfile.mkdtemp(prefix="veneur-jax-trace-")
         t0 = time.perf_counter()
-        with jax.profiler.trace(trace_dir):
+
+        def _window():
             try:
-                # vnlint: disable=blocking-propagation (the flush IS
-                #   the capture payload: the trace window must contain
-                #   one full device program; _profile_lock only
-                #   serializes the process-global JAX profiler)
+                # the flush IS the capture payload: the trace window
+                # must contain one full device program
                 server.flush()
             except Exception:
                 logging.getLogger("veneur_tpu.http").exception(
                     "flush under profiler failed")
             remaining = seconds - (time.perf_counter() - t0)
             if remaining > 0:
-                # vnlint: disable=sync-under-lock (the sleep IS the
-                #   requested profiler capture window; _profile_lock
-                #   only serializes the process-global JAX profiler,
-                #   nothing on the data plane waits on it)
+                # the sleep IS the requested profiler capture window
                 time.sleep(remaining)
+
+        if session is not None:
+            try:
+                _window()
+            finally:
+                session.stop_and_export(trace_dir)
+        else:
+            with jax.profiler.trace(trace_dir):
+                _window()
         files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
         return {"trace_dir": trace_dir,
                 "seconds": round(time.perf_counter() - t0, 3),

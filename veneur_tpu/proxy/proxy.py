@@ -707,6 +707,14 @@ class Proxy:
             params["slots"] = str(spec["slots"])
         elif spec["window_s"] is not None:
             params["window_s"] = repr(spec["window_s"])
+        if spec.get("since") is not None:
+            # range form (?since=&step=): scatter-gathered exactly
+            # like point queries; bins align upstream because every
+            # member grids the same since/step
+            params["since"] = repr(spec["since"])
+            params["step"] = repr(spec["step"])
+            if spec.get("until") is not None:
+                params["until"] = repr(spec["until"])
         if spec["tags"]:
             params["tags"] = ",".join(spec["tags"])
         if spec["kind"]:
@@ -761,6 +769,9 @@ class Proxy:
             merged = qengine.merge_group_responses(
                 responses, spec["qs"], top=spec["top"],
                 by=spec["by"])
+        elif spec.get("since") is not None:
+            merged = qengine.merge_range_responses(responses,
+                                                   spec["qs"])
         else:
             merged = qengine.merge_responses(responses, spec["qs"])
         merged["upstreams"] = upstreams
@@ -772,6 +783,8 @@ class Proxy:
             merged["payload"] = None
             for e in merged.get("groups") or []:
                 e["payload"] = None
+            for b in merged.get("series") or []:
+                b["payload"] = None
             if merged.get("other"):
                 merged["other"]["payload"] = None
         if local_addrs and len(responses) > 1:

@@ -38,6 +38,7 @@ span on the flight recorder.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Optional
@@ -51,6 +52,9 @@ _PCT_MIN, _PCT_MAX = 0.0, 1.0
 PAYLOAD_POINT_CAP = 2048
 # recent per-request latencies kept for stats()/bench percentiles
 _LATENCY_RING = 512
+# bound on ?since=&step= range answers: a request asking more bins is
+# a 400, not an unbounded fuse-and-solve loop
+MAX_RANGE_BINS = 2048
 
 
 class QueryError(ValueError):
@@ -218,8 +222,12 @@ def parse_query_params(q: dict) -> dict:
             window_s = float(q["window_s"][0])
         except ValueError:
             raise QueryError(400, "bad window_s=")
-        if not window_s > 0:
-            raise QueryError(400, "window_s= must be > 0")
+        # `not (x > 0)` also rejects nan; isfinite rejects +inf (a
+        # window reaching past every ring is a malformed request, not
+        # an everything-window)
+        if not (window_s > 0 and math.isfinite(window_s)):
+            raise QueryError(400, "window_s= must be a positive "
+                                  "finite number of seconds")
     tags = [t for t in (q.get("tags") or [""])[0].split(",") if t]
     kind = (q.get("type") or [None])[0]
     if kind is not None and kind not in ("histogram", "timer"):
@@ -253,10 +261,50 @@ def parse_query_params(q: dict) -> dict:
     pay = (q.get("payload") or ["1"])[0]
     if pay not in ("0", "1", "true", "false"):
         raise QueryError(400, "payload= must be 0 or 1")
+    # range form: ?since=<unix>&step=<seconds>[&until=<unix>] asks a
+    # bucketed timeline instead of one point answer (the retention
+    # tiers' read surface).  Validation is strict-400, never a silent
+    # clamp: a future since=, step<=0, or a bin count past
+    # MAX_RANGE_BINS are caller bugs the server must say out loud.
+    since = until = step = None
+    if "since" in q or "until" in q or "step" in q:
+        if "since" not in q or "step" not in q:
+            raise QueryError(400, "range form needs both since= and "
+                                  "step=")
+        try:
+            since = float(q["since"][0])
+            step = float(q["step"][0])
+            until = float(q["until"][0]) if "until" in q else None
+        except ValueError:
+            raise QueryError(400, "bad since=/until=/step= "
+                                  "(unix seconds)")
+        if not (math.isfinite(since) and math.isfinite(step)
+                and (until is None or math.isfinite(until))):
+            raise QueryError(400, "since=/until=/step= must be "
+                                  "finite")
+        if step <= 0:
+            raise QueryError(400, "step= must be > 0")
+        now = time.time()
+        if since > now:
+            raise QueryError(400, "since= is in the future")
+        if until is not None and until <= since:
+            raise QueryError(400, "until= must be > since=")
+        if slots is not None or window_s is not None:
+            raise QueryError(400, "range form (since=/step=) "
+                                  "excludes slots= and window_s=")
+        if group_by:
+            raise QueryError(400, "range form does not take "
+                                  "group_by=")
+        if ((until if until is not None else now) - since) / step \
+                > MAX_RANGE_BINS:
+            raise QueryError(400, f"range asks more than "
+                                  f"{MAX_RANGE_BINS} bins — raise "
+                                  "step= or narrow the range")
     return {"name": name, "qs": qs, "window_s": window_s,
             "slots": slots, "tags": tags, "kind": kind,
             "group_by": group_by or None, "top": top, "by": by,
-            "payload": pay in ("1", "true")}
+            "payload": pay in ("1", "true"),
+            "since": since, "until": until, "step": step}
 
 
 def parse_rank_by(by: Optional[str]) -> tuple:
@@ -419,16 +467,24 @@ class QueryEngine:
               payload: bool = True,
               group_by: Optional[list] = None,
               top: Optional[int] = None,
-              by: Optional[str] = None) -> dict:
+              by: Optional[str] = None,
+              since: Optional[float] = None,
+              until: Optional[float] = None,
+              step: Optional[float] = None) -> dict:
         """Fuse the ring slots covering the window and evaluate the
         requested quantiles for one key.  A key absent from every
         covered slot answers count=0 (not an error: absence of samples
         is a legitimate windowed answer).  With ``group_by`` the read
-        answers per cube group instead (query_groups)."""
+        answers per cube group instead (query_groups); with ``since``
+        it answers the bucketed range form instead (query_range)."""
         rings = self.agg.query_rings
         if rings is None:
             raise QueryError(
                 404, "query plane disabled (query_window_slots: 0)")
+        if since is not None:
+            return self.query_range(
+                name, tags=tags, qs=qs, since=since, until=until,
+                step=step, kind=kind, payload=payload)
         if group_by:
             return self.query_groups(
                 name, group_by, qs=qs, window_s=window_s, slots=slots,
@@ -531,10 +587,16 @@ class QueryEngine:
                     "min": float(mn), "max": float(mx),
                     "count": cnt, "sum": sm, "rsum": rs}
 
+        def _cloud():
+            if not vparts:
+                return np.zeros(0, np.float64), np.zeros(0, np.float64)
+            return np.concatenate(vparts), np.concatenate(wparts)
+
         return {"family": "tdigest", "count": cnt, "sum": sm,
                 "min": (float(mn) if cnt > 0 else None),
                 "max": (float(mx) if cnt > 0 else None),
-                "eval": _eval, "payload": _payload}
+                "rsum": rs,
+                "eval": _eval, "payload": _payload, "cloud": _cloud}
 
     def _fuse_moments(self, slots_list, name, jtags, kind) -> dict:
         from veneur_tpu.sketches import moments as mo
@@ -581,7 +643,7 @@ class QueryEngine:
                         else 0.0),
                 "min": (float(vec[mo.IDX_MIN]) if cnt > 0 else None),
                 "max": (float(vec[mo.IDX_MAX]) if cnt > 0 else None),
-                "eval": _eval, "payload": _payload}
+                "eval": _eval, "payload": _payload, "vector": vec}
 
     def _fuse_compactor(self, slots_list, name, jtags, kind) -> dict:
         from veneur_tpu.sketches import compactor as cs
@@ -627,7 +689,265 @@ class QueryEngine:
                         else 0.0),
                 "min": (float(vec[cs.IDX_MIN]) if cnt > 0 else None),
                 "max": (float(vec[cs.IDX_MAX]) if cnt > 0 else None),
-                "eval": _eval, "payload": _payload}
+                "eval": _eval, "payload": _payload, "vector": vec}
+
+    # -- the range read (the retention timeline's query surface) ---------
+
+    @staticmethod
+    def _overlap(a0: float, a1: float, b0: float, b1: float) -> float:
+        return max(0.0, min(a1, b1) - max(a0, b0))
+
+    @staticmethod
+    def _fuse_buckets(buckets: list, keys: list) -> tuple:
+        """Fuse one key's payloads across retention buckets: digest
+        clouds concat (merge_cloud), moments vectors add, compactor
+        ladders merge — the same family merges that built the
+        buckets.  Returns (td entry | None, mo vec | None,
+        cc vec | None)."""
+        from veneur_tpu.retention.timeline import merge_cloud
+        from veneur_tpu.sketches import compactor as cs
+        from veneur_tpu.sketches import moments as mo
+        td_e = mo_v = cc_v = None
+        for bk in buckets:
+            for k in keys:
+                e = bk.td.get(k)
+                if e is not None:
+                    td_e = e if td_e is None else merge_cloud(td_e, e)
+                v = bk.mo.get(k)
+                if v is not None:
+                    mo_v = v.copy() if mo_v is None else \
+                        mo.merge_vectors(mo_v[None, :], v[None, :])[0]
+                v = bk.cc.get(k)
+                if v is not None:
+                    cc_v = v.copy() if cc_v is None else \
+                        cs.merge_vectors(cc_v[None, :], v[None, :])[0]
+        return td_e, mo_v, cc_v
+
+    def query_range(self, name: str, tags: Optional[list] = None,
+                    qs=(0.5,), since: float = 0.0,
+                    until: Optional[float] = None,
+                    step: Optional[float] = None,
+                    kind: Optional[str] = None,
+                    payload: bool = True) -> dict:
+        """The `?since=&step=` range read: plan which sources cover
+        each step bin — the window ring (finest), then the in-memory
+        retention tiers finest-first, then the on-disk tier segments —
+        fuse the winning source's buckets per bin, and evaluate every
+        bin's quantiles in ONE batch per family (a range of moments
+        bins costs one maxent solve, not one per bin).  Each bin
+        carries its coverage metadata (source, covered span), and each
+        bin's payload stays mergeable so the proxy scatter-gathers
+        ranges exactly like point queries (merge_range_responses)."""
+        from veneur_tpu.sketches import compactor as cs
+        from veneur_tpu.sketches import moments as mo
+        rings = self.agg.query_rings
+        now = time.time()
+        t_lo = float(since)
+        t_hi = min(float(until), now) if until is not None else now
+        step = float(step) if step else max(t_hi - t_lo, 1e-9)
+        # Bin edges via multiplication, not accumulation: at unix-timestamp
+        # magnitude one float64 ulp is ~2.4e-7 s, so `t += step` drifts off
+        # the floor-aligned bucket grid and manufactures spurious overlaps.
+        bins: list[tuple[float, float]] = []
+        i = 0
+        while len(bins) < MAX_RANGE_BINS:
+            b0 = t_lo + i * step
+            if b0 >= t_hi - 1e-9:
+                break
+            bins.append((b0, min(t_lo + (i + 1) * step, t_hi)))
+            i += 1
+        # Overlap slack: edges of bins vs. buckets come from different float
+        # computations and can disagree by a few ulp of the absolute time.
+        ov_eps = max(1e-9, step * 1e-4)
+        jtags = ",".join(sorted(tags)) if tags else ""
+        keys = ([(name, jtags, kind)] if kind is not None
+                else [(name, jtags, "histogram"),
+                      (name, jtags, "timer")])
+
+        # sources, finest first (order breaks coverage ties)
+        sources: list = []
+        last_cut = 0.0
+        if rings is not None:
+            td_sl = rings["tdigest"].slots_between(t_lo, t_hi)
+            mo_sl = rings["moments"].slots_between(t_lo, t_hi)
+            cc_sl = rings["compactor"].slots_between(t_lo, t_hi)
+            last_cut = rings["tdigest"].last_cut
+            sources.append(("ring", "ring",
+                            (td_sl, mo_sl, cc_sl)))
+        retention = getattr(self.agg, "retention", None)
+        if retention is not None:
+            for tname, _bs, buckets in \
+                    retention.sources_overlapping(t_lo, t_hi):
+                sources.append((tname, "tier", buckets))
+        if not sources:
+            raise QueryError(
+                404, "range form needs the query plane "
+                     "(query_window_slots > 0)")
+
+        series: list[dict] = []
+        td_pending: list = []
+        mo_pending: list = []
+        cc_pending: list = []
+        used_sources: set = set()
+        cov_from = cov_to = None
+        for b0, b1 in bins:
+            best = None
+            best_cov = 0.0
+            for label, skind, data in sources:
+                if skind == "ring":
+                    # conservative across the three family rings (they
+                    # rotate back to back, not atomically)
+                    cov = min(
+                        sum(self._overlap(s.t_start, s.t_end, b0, b1)
+                            for s in sl)
+                        for sl in data)
+                else:
+                    cov = sum(self._overlap(
+                        bk.t_start, min(bk.filled_to, bk.t_end),
+                        b0, b1) for bk in data)
+                if cov > best_cov + ov_eps:
+                    best, best_cov = (label, skind, data), cov
+            ent = {"t_start": b0, "t_end": b1, "source": None,
+                   "coverage_s": 0.0, "covered_from_unix": None,
+                   "covered_to_unix": None, "family": "none",
+                   "count": 0.0, "sum": 0.0, "min": None, "max": None,
+                   "mixed_families": False, "quantiles": {},
+                   "payload": None}
+            series.append(ent)
+            if best is None:
+                continue
+            label, skind, data = best
+            used_sources.add(label)
+            if skind == "ring":
+                sel = [[s for s in sl
+                        if self._overlap(s.t_start, s.t_end,
+                                         b0, b1) > ov_eps]
+                       for sl in data]
+                spans = [(s.t_start, s.t_end)
+                         for sl in sel for s in sl]
+                td = self._fuse_tdigest(sel[0], name, jtags, kind)
+                mof = self._fuse_moments(sel[1], name, jtags, kind)
+                ccf = self._fuse_compactor(sel[2], name, jtags, kind)
+                td_e = None
+                if td["count"] > 0:
+                    v, w = td["cloud"]()
+                    td_e = {"v": v, "w": w, "min": td["min"],
+                            "max": td["max"], "count": td["count"],
+                            "sum": td["sum"], "rsum": td["rsum"]}
+                mo_v, cc_v = mof["vector"], ccf["vector"]
+            else:
+                sel_b = [bk for bk in data
+                         if self._overlap(bk.t_start,
+                                          min(bk.filled_to, bk.t_end),
+                                          b0, b1) > ov_eps]
+                spans = [(bk.t_start, min(bk.filled_to, bk.t_end))
+                         for bk in sel_b]
+                td_e, mo_v, cc_v = self._fuse_buckets(sel_b, keys)
+            ent["source"] = label
+            ent["coverage_s"] = round(best_cov, 6)
+            if spans:
+                ent["covered_from_unix"] = max(
+                    min(s[0] for s in spans), b0)
+                ent["covered_to_unix"] = min(
+                    max(s[1] for s in spans), b1)
+                cov_from = ent["covered_from_unix"] if cov_from is \
+                    None else min(cov_from, ent["covered_from_unix"])
+                cov_to = ent["covered_to_unix"] if cov_to is None \
+                    else max(cov_to, ent["covered_to_unix"])
+            td_cnt = td_e["count"] if td_e is not None else 0.0
+            mo_cnt = float(mo_v[mo.IDX_COUNT]) if mo_v is not None \
+                else 0.0
+            cc_cnt = float(cc_v[cs.IDX_COUNT]) if cc_v is not None \
+                else 0.0
+            ent["mixed_families"] = sum(
+                c > 0 for c in (td_cnt, mo_cnt, cc_cnt)) > 1
+            if td_cnt <= 0 and mo_cnt <= 0 and cc_cnt <= 0:
+                continue
+            # same larger-mass family pick as the point read
+            if td_cnt >= mo_cnt and td_cnt >= cc_cnt:
+                ent.update(family="tdigest", count=td_cnt,
+                           sum=td_e["sum"], min=float(td_e["min"]),
+                           max=float(td_e["max"]))
+                td_pending.append((ent, td_e))
+                if payload:
+                    pv, pw = td_e["v"], td_e["w"]
+                    if len(pv) > PAYLOAD_POINT_CAP:
+                        pv, pw = _compress_payload(
+                            pv, pw, self.agg.digests.compression)
+                    ent["payload"] = {
+                        "family": "tdigest",
+                        "means": [float(x) for x in pv],
+                        "weights": [float(x) for x in pw],
+                        "min": float(td_e["min"]),
+                        "max": float(td_e["max"]),
+                        "count": td_cnt, "sum": td_e["sum"],
+                        "rsum": td_e["rsum"]}
+            elif mo_cnt >= cc_cnt:
+                ent.update(family="moments", count=mo_cnt,
+                           sum=float(mo_v[mo.IDX_SUM]),
+                           min=float(mo_v[mo.IDX_MIN]),
+                           max=float(mo_v[mo.IDX_MAX]))
+                mo_pending.append((ent, mo_v))
+                if payload:
+                    ent["payload"] = {
+                        "family": "moments",
+                        "k": mo.k_from_len(len(mo_v)),
+                        "vector": [float(x) for x in mo_v]}
+            else:
+                ent.update(family="compactor", count=cc_cnt,
+                           sum=float(cc_v[cs.IDX_SUM]),
+                           min=float(cc_v[cs.IDX_MIN]),
+                           max=float(cc_v[cs.IDX_MAX]))
+                cc_pending.append((ent, cc_v))
+                if payload:
+                    ent["payload"] = {
+                        "family": "compactor",
+                        "vector": [float(x) for x in cc_v]}
+
+        qarr = np.asarray(list(qs), np.float64)
+        if td_pending:
+            allq = weighted_quantiles_np_batch(
+                [e["v"] for _, e in td_pending],
+                [e["w"] for _, e in td_pending],
+                [e["min"] for _, e in td_pending],
+                [e["max"] for _, e in td_pending], qarr)
+            for (ent, _), quants in zip(td_pending, allq):
+                if quants is not None:
+                    ent["quantiles"] = {repr(float(p)): float(x)
+                                        for p, x in zip(qarr, quants)}
+        if mo_pending:
+            # one batched maxent solve for the WHOLE range — the
+            # per-bin eager path costs hundreds of ms per solve
+            from veneur_tpu.ops import moments_eval as me
+            allq = me.quantiles_from_vectors(
+                np.stack([v for _, v in mo_pending]), qarr)
+            for (ent, _), quants in zip(mo_pending, allq):
+                ent["quantiles"] = {repr(float(p)): float(x)
+                                    for p, x in zip(qarr, quants)}
+        if cc_pending:
+            allq = cs.quantiles_from_vectors(
+                np.stack([v for _, v in cc_pending]), qarr)
+            for (ent, _), quants in zip(cc_pending, allq):
+                ent["quantiles"] = {repr(float(p)): float(x)
+                                    for p, x in zip(qarr, quants)}
+
+        partial = any(
+            e["coverage_s"] + 1e-6 < (e["t_end"] - e["t_start"])
+            for e in series)
+        return {
+            "name": name, "tags": sorted(tags) if tags else [],
+            "tier": self.tier, "host": self.hostname,
+            "range": True, "since": t_lo, "until": t_hi,
+            "step": step, "bins": len(series), "series": series,
+            "sources": sorted(used_sources),
+            "covered_from_unix": cov_from,
+            "covered_to_unix": cov_to,
+            "partial": partial,
+            "fresh": (cov_to is not None and last_cut > 0
+                      and cov_to >= min(t_hi, last_cut) - 1e-6),
+            "staleness_ms": (round((now - cov_to) * 1e3, 3)
+                             if cov_to is not None else None),
+        }
 
     # -- the group-by cube read ------------------------------------------
 
@@ -1064,6 +1384,70 @@ def merge_responses(responses: list[dict], qs,
         out["payload"] = {"family": "compactor",
                           "vector": [float(x) for x in cc_vec]}
     return out
+
+
+def merge_range_responses(responses: list[dict], qs,
+                          compression: float = 100.0) -> dict:
+    """Merge tier range answers bin by bin: upstream bins align on
+    their [t_start, t_end) bounds (every upstream answered the same
+    validated spec, so the bin grid is shared), and each aligned
+    bucket of bins runs through the same self-describing payload codec
+    as the point merge (merge_responses per bin).  Coverage stays
+    conservative: a bin's covered span is the union the upstreams
+    report, `partial`/`fresh`/staleness merge exactly like the point
+    form."""
+    by_bin: dict = {}
+    for r in responses:
+        for b in r.get("series") or ():
+            kb = (round(float(b["t_start"]), 6),
+                  round(float(b["t_end"]), 6))
+            by_bin.setdefault(kb, []).append(b)
+    series = []
+    for kb in sorted(by_bin):
+        bl = by_bin[kb]
+        pseudo = [{"name": "", "payload": b.get("payload"),
+                   "mixed_families": b.get("mixed_families"),
+                   "slots_fused": None, "partial": False,
+                   "fresh": True, "staleness_ms": None} for b in bl]
+        m = merge_responses(pseudo, qs, compression)
+        froms = [b["covered_from_unix"] for b in bl
+                 if b.get("covered_from_unix") is not None]
+        tos = [b["covered_to_unix"] for b in bl
+               if b.get("covered_to_unix") is not None]
+        series.append({
+            "t_start": kb[0], "t_end": kb[1],
+            "source": "merged",
+            "coverage_s": max((b.get("coverage_s") or 0.0
+                               for b in bl), default=0.0),
+            "covered_from_unix": min(froms) if froms else None,
+            "covered_to_unix": max(tos) if tos else None,
+            "family": m["family"], "count": m["count"],
+            "sum": m["sum"], "min": m["min"], "max": m["max"],
+            "mixed_families": m["mixed_families"],
+            "quantiles": m["quantiles"], "payload": m["payload"]})
+    first = responses[0] if responses else {}
+    tos = [b["covered_to_unix"] for b in series
+           if b["covered_to_unix"] is not None]
+    return {
+        "name": first.get("name", ""),
+        "tags": first.get("tags", []),
+        "range": True,
+        "since": first.get("since"), "until": first.get("until"),
+        "step": first.get("step"), "bins": len(series),
+        "series": series,
+        "sources": sorted({s for r in responses
+                           for s in (r.get("sources") or ())}),
+        "covered_from_unix": min(
+            (b["covered_from_unix"] for b in series
+             if b["covered_from_unix"] is not None), default=None),
+        "covered_to_unix": max(tos) if tos else None,
+        "partial": any(r.get("partial") for r in responses),
+        "fresh": bool(responses) and all(r.get("fresh")
+                                         for r in responses),
+        "staleness_ms": max(
+            (r["staleness_ms"] for r in responses
+             if r.get("staleness_ms") is not None), default=None),
+    }
 
 
 def merge_group_responses(responses: list[dict], qs,

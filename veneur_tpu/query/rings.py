@@ -310,6 +310,16 @@ class WindowRing:
         }
         return take, info
 
+    def slots_between(self, t0: float, t1: float) -> list:
+        """Snapshot of the slots overlapping [t0, t1), newest first —
+        the range-query planner's view of the ring (the finest
+        retention source)."""
+        with self.lock:
+            snap = [s for s in self._slots
+                    if s.t_end > t0 and s.t_start < t1]
+        snap.reverse()
+        return snap
+
     def stats(self) -> dict:
         with self.lock:
             return {

@@ -1,0 +1,21 @@
+"""Multi-resolution retention: the tiered quantile timeline.
+
+On every flush cut the finalized window snapshot compacts upward into
+a ladder of coarser tiers (minute/hour/day by configuration), each a
+bounded ring of mergeable buckets; buckets evicted from the coarsest
+in-memory tier spill to disk in the CRC-framed ForwardSpool segment
+format under a byte/age budget.  `GET /query?since=&step=` plans which
+tiers cover the requested range and fuses buckets across them — the
+aggregation tier serving its own recent past at bounded error and
+bounded footprint.
+"""
+
+from veneur_tpu.retention.spill import (TierSegmentStore,
+                                        close_tier_segment,
+                                        open_tier_segment)
+from veneur_tpu.retention.timeline import (RetentionTier,
+                                           RetentionTimeline, TierBucket)
+
+__all__ = ["RetentionTimeline", "RetentionTier", "TierBucket",
+           "TierSegmentStore", "open_tier_segment",
+           "close_tier_segment"]

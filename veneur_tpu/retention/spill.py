@@ -1,0 +1,382 @@
+"""Tier-segment store: on-disk spill for evicted retention buckets.
+
+Buckets evicted from the coarsest in-memory retention tier land here
+in the ForwardSpool disk format REUSED VERBATIM (forward/spool.py):
+length-prefixed CRC32-framed records appended to bounded segment
+files, a torn final record truncated away on reopen, CRC-damaged
+records rejected individually.  The framing structs are imported from
+the spool module — one disk dialect, two subsystems.
+
+Identity mapping onto the spool header (the record's `ident` triple):
+
+    source    = the tier name ("hour", "day", ...)
+    epoch     = the bucket's t_start in unix ms
+    chunk_idx = the bucket's DURATION in ms (t_end - t_start; a u32
+                holds ~49 days, far past any tier's bucket width)
+    n_metrics = the bucket's total sample count
+
+The record body is the bucket's self-describing npz codec
+(timeline.encode_bucket_body): per-key digest point clouds, moments
+vectors and compactor ladders plus a JSON `__meta__` key table —
+bit-exact float round-trip, so a spilled bucket answers queries
+identically to its in-memory form.
+
+Unlike the forward spool there is no replayer: spilled buckets are a
+READ surface (range queries page them back in), not a delivery queue.
+The ledger therefore closes as
+
+    spilled + recovered == expired + dropped + pending
+
+(`recovered` counts records a reopen re-indexed from disk — the
+kill -9 durability path; `expired` is the visible byte/age-budget
+loss; `dropped` the disk-fault path).  Every counter surfaces at
+/debug/vars -> retention and the telemetry witness asserts the
+closure (analysis/telemetry.py LEDGERS).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from veneur_tpu import failpoints
+# the spool's disk dialect, reused verbatim: one frame/header layout
+# for every segment file the process writes
+from veneur_tpu.forward.spool import _FRAME, _HEADER, _VERSION, \
+    encode_record
+
+logger = logging.getLogger("veneur_tpu.retention.spill")
+
+TIER_SEGMENT_PREFIX = "tier-"
+TIER_SEGMENT_SUFFIX = ".seg"
+
+
+def open_tier_segment(path: str):
+    """Open (create) a tier segment for appending — paired with
+    close_tier_segment on every path (vnlint resource-pairing)."""
+    return open(path, "ab")
+
+
+def close_tier_segment(f, fsync: bool = False) -> None:
+    """Flush (optionally fsync) and close a tier segment handle."""
+    try:
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    finally:
+        f.close()
+
+
+@dataclass
+class TierRecord:
+    """One spilled bucket's index entry; the body stays on disk."""
+    tier: str
+    t_start: float          # bucket bounds, unix seconds
+    t_end: float
+    ts_ms: int              # spill wall time (header ts)
+    n_points: int
+    seg_seq: int
+    offset: int             # body offset within the segment file
+    body_len: int
+    disk_bytes: int         # full framed record size
+
+
+class TierSegmentStore:
+    """Bounded on-disk bucket store with crash recovery.
+
+    Thread-safe.  Appends rotate segments at segment_max_bytes; the
+    byte budget evicts oldest-first with accounting; `max_age_s > 0`
+    additionally expires buckets whose t_end has aged out.  A reopen
+    (the kill -9 revive path) re-indexes every intact record."""
+
+    def __init__(self, directory: str, max_bytes: int = 256 << 20,
+                 max_age_s: float = 0.0, fsync: str = "rotate",
+                 segment_max_bytes: int = 4 << 20):
+        self.dir = directory
+        self.max_bytes = int(max_bytes)
+        self.max_age_s = float(max_age_s)
+        self.fsync = fsync
+        self.segment_max_bytes = int(segment_max_bytes)
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._records: list[TierRecord] = []     # oldest t_start first
+        self._seg_pending: dict[int, int] = {}
+        self._active = None      # (seq, file handle, bytes written)
+        self._next_seq = 0
+        self.pending_bytes = 0
+        self.pending_points = 0
+        self.spilled_buckets = 0
+        self.spilled_points = 0
+        self.recovered_buckets = 0
+        self.recovered_points = 0
+        self.expired_buckets = 0
+        self.expired_points = 0
+        self.dropped_buckets = 0
+        self.dropped_points = 0
+        self.torn_records = 0
+        self.crc_rejected = 0
+        self.io_errors = 0
+        self.reads = 0
+        self._recover()
+
+    # -- recovery (reopen after a crash) --------------------------------
+
+    def _segment_path(self, seq: int) -> str:
+        return os.path.join(
+            self.dir, f"{TIER_SEGMENT_PREFIX}{seq}{TIER_SEGMENT_SUFFIX}")
+
+    def _recover(self) -> None:
+        """Re-index every on-disk segment: intact records re-enter the
+        query index (the kill -9 durability contract), a torn tail is
+        truncated away, CRC-damaged records are rejected one by one —
+        the ForwardSpool recovery discipline on the same framing."""
+        seqs = []
+        for name in os.listdir(self.dir):
+            if name.startswith(TIER_SEGMENT_PREFIX) and \
+                    name.endswith(TIER_SEGMENT_SUFFIX):
+                try:
+                    seqs.append(int(name[len(TIER_SEGMENT_PREFIX):
+                                         -len(TIER_SEGMENT_SUFFIX)]))
+                except ValueError:
+                    continue
+        for seq in sorted(seqs):
+            path = self._segment_path(seq)
+            try:
+                good_end = self._scan_segment(seq, path)
+            except OSError as e:
+                self.io_errors += 1
+                logger.error("retention: cannot recover segment %s: %s",
+                             path, e)
+                continue
+            if good_end is not None:
+                try:
+                    with open(path, "r+b") as f:
+                        f.truncate(good_end)
+                except OSError:
+                    self.io_errors += 1
+            if self._seg_pending.get(seq, 0) == 0:
+                self._unlink_segment(seq)
+        self._next_seq = max(seqs, default=-1) + 1
+        self._records.sort(key=lambda r: (r.t_start, r.t_end))
+
+    def _scan_segment(self, seq: int, path: str) -> Optional[int]:
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            if off + _FRAME.size > len(data):
+                self.torn_records += 1
+                return off
+            plen, crc = _FRAME.unpack_from(data, off)
+            start = off + _FRAME.size
+            if start + plen > len(data):
+                self.torn_records += 1
+                return off
+            payload = data[start:start + plen]
+            next_off = start + plen
+            if zlib.crc32(payload) != crc:
+                self.crc_rejected += 1
+                off = next_off
+                continue
+            try:
+                (ver, ts_ms, t0_ms, dur_ms, n_points, _tid, _sid,
+                 src_len) = _HEADER.unpack_from(payload, 0)
+                tier = payload[_HEADER.size:
+                               _HEADER.size + src_len].decode()
+                body_off = _HEADER.size + src_len
+                rec = TierRecord(
+                    tier=tier, t_start=t0_ms / 1e3,
+                    t_end=(t0_ms + dur_ms) / 1e3, ts_ms=ts_ms,
+                    n_points=n_points, seg_seq=seq,
+                    offset=start + body_off,
+                    body_len=plen - body_off,
+                    disk_bytes=_FRAME.size + plen)
+            except (struct.error, UnicodeDecodeError):
+                self.crc_rejected += 1
+                off = next_off
+                continue
+            if ver != _VERSION:
+                self.crc_rejected += 1
+                off = next_off
+                continue
+            self._records.append(rec)
+            self._seg_pending[seq] = self._seg_pending.get(seq, 0) + 1
+            self.pending_bytes += rec.disk_bytes
+            self.pending_points += rec.n_points
+            self.recovered_buckets += 1
+            self.recovered_points += rec.n_points
+            off = next_off
+        return None
+
+    # -- spill (the timeline's eviction path) ---------------------------
+
+    def spill(self, tier: str, t_start: float, t_end: float,
+              n_points: int, body: bytes) -> bool:
+        """Append one evicted bucket.  Returns False (after counting
+        the loss in dropped_*) when disk I/O fails — eviction must
+        never wedge the flush path."""
+        ts_ms = int(time.time() * 1e3)
+        ident = (tier, int(round(t_start * 1e3)),
+                 int(round((t_end - t_start) * 1e3)))
+        frame = encode_record(ident, body, n_points, ts_ms=ts_ms)
+        with self._lock:
+            try:
+                # vnlint: disable=blocking-propagation (deliberate
+                #   failpoint edge: retention.io faults the spill I/O
+                #   itself, mirroring the forward spool's spool.io)
+                failpoints.inject("retention.io")
+                seq, f = self._active_segment_locked(len(frame))
+                off = f.tell()
+                f.write(frame)
+                f.flush()
+                if self.fsync == "always":
+                    os.fsync(f.fileno())
+            except Exception as e:
+                self.io_errors += 1
+                self.dropped_buckets += 1
+                self.dropped_points += n_points
+                # the drop is accounted HERE (not by the caller): the
+                # evicting tier has already let go of the bucket
+                self.spilled_buckets += 1
+                self.spilled_points += n_points
+                logger.error("retention: spill failed, bucket dropped "
+                             "with accounting: %s", e)
+                return False
+            body_off = off + _FRAME.size + _HEADER.size \
+                + len(tier.encode())
+            rec = TierRecord(tier=tier, t_start=float(t_start),
+                             t_end=float(t_end), ts_ms=ts_ms,
+                             n_points=int(n_points), seg_seq=seq,
+                             offset=body_off, body_len=len(body),
+                             disk_bytes=len(frame))
+            self._records.append(rec)
+            self._seg_pending[seq] = self._seg_pending.get(seq, 0) + 1
+            self.pending_bytes += rec.disk_bytes
+            self.pending_points += rec.n_points
+            self.spilled_buckets += 1
+            self.spilled_points += rec.n_points
+            self._enforce_bytes_locked()
+        return True
+
+    def _close_active_locked(self, fsync: bool = False) -> None:
+        if self._active is None:
+            return
+        _, f, _ = self._active
+        self._active = None
+        try:
+            close_tier_segment(f, fsync=fsync)
+        except OSError:
+            self.io_errors += 1
+
+    def _active_segment_locked(self, need: int):
+        if self._active is not None:
+            seq, f, written = self._active
+            if written + need <= self.segment_max_bytes:
+                self._active = (seq, f, written + need)
+                return seq, f
+            self._close_active_locked(fsync=self.fsync != "never")
+        seq = self._next_seq
+        self._next_seq += 1
+        f = open_tier_segment(self._segment_path(seq))
+        self._active = (seq, f, need)
+        self._seg_pending.setdefault(seq, 0)
+        return seq, f
+
+    def _enforce_bytes_locked(self) -> None:
+        while self.pending_bytes > self.max_bytes and self._records:
+            self._expire_locked(self._records.pop(0))
+
+    def _expire_locked(self, rec: TierRecord) -> None:
+        self.pending_bytes -= rec.disk_bytes
+        self.pending_points -= rec.n_points
+        self.expired_buckets += 1
+        self.expired_points += rec.n_points
+        left = self._seg_pending.get(rec.seg_seq, 0) - 1
+        if left > 0:
+            self._seg_pending[rec.seg_seq] = left
+            return
+        self._seg_pending.pop(rec.seg_seq, None)
+        if self._active is not None and self._active[0] == rec.seg_seq:
+            self._close_active_locked()
+        self._unlink_segment(rec.seg_seq)
+
+    def _unlink_segment(self, seq: int) -> None:
+        try:
+            os.unlink(self._segment_path(seq))
+        except OSError:
+            pass
+        self._seg_pending.pop(seq, None)
+
+    def expire_now(self, now: Optional[float] = None) -> int:
+        """Expire buckets whose t_end has aged past max_age_s (0 =
+        keep until the byte budget evicts).  Returns buckets expired."""
+        if self.max_age_s <= 0:
+            return 0
+        cutoff = (time.time() if now is None else now) - self.max_age_s
+        n = 0
+        with self._lock:
+            while self._records and self._records[0].t_end < cutoff:
+                self._expire_locked(self._records.pop(0))
+                n += 1
+        return n
+
+    # -- the range-query read surface -----------------------------------
+
+    def records_overlapping(self, t0: float, t1: float
+                            ) -> list[TierRecord]:
+        with self._lock:
+            return [r for r in self._records
+                    if r.t_end > t0 and r.t_start < t1]
+
+    def read_body(self, rec: TierRecord) -> bytes:
+        """Page one bucket's codec bytes back in (CRC was verified at
+        index time; `retention.io` injects here too)."""
+        failpoints.inject("retention.io")
+        with open(self._segment_path(rec.seg_seq), "rb") as f:
+            f.seek(rec.offset)
+            body = f.read(rec.body_len)
+        if len(body) != rec.body_len:
+            raise OSError(f"short read ({len(body)}/{rec.body_len}) "
+                          f"from tier segment {rec.seg_seq}")
+        self.reads += 1
+        return body
+
+    def pending_buckets(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def close(self, drain: bool = False) -> None:
+        """Close the active segment.  `drain` fsyncs the tail out
+        (graceful shutdown); a simulated crash passes False and relies
+        on the per-append flush."""
+        with self._lock:
+            self._close_active_locked(
+                fsync=drain and self.fsync != "never")
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pending_buckets": len(self._records),
+                "pending_bytes": self.pending_bytes,
+                "pending_points": self.pending_points,
+                "spilled_buckets": self.spilled_buckets,
+                "spilled_points": self.spilled_points,
+                "recovered_buckets": self.recovered_buckets,
+                "recovered_points": self.recovered_points,
+                "expired_buckets": self.expired_buckets,
+                "expired_points": self.expired_points,
+                "dropped_buckets": self.dropped_buckets,
+                "dropped_points": self.dropped_points,
+                "torn_records": self.torn_records,
+                "crc_rejected": self.crc_rejected,
+                "io_errors": self.io_errors,
+                "reads": self.reads,
+            }

@@ -136,6 +136,16 @@ CRASH_ARMS: list[ChaosArm] = [
     # must be EXACT, never resident-layout-dependent.
     ChaosArm("crash-with-resident-arenas", "server.crash", "",
              "conserved", {"op": "resident-crash"}, kind="crash"),
+    # ISSUE 20: the multi-resolution retention timeline across a
+    # kill -9 — cuts compact into the tier ladder until the coarsest
+    # tier spills a bucket to disk, the in-memory tiers ride a forced
+    # checkpoint, the local dies with no drain and revives: the disk
+    # segments re-index, the tiers restore, and the total retained
+    # point mass (memory + disk) must equal the oracle EXACTLY — then
+    # a ?since=&step= range query on the revived (cold-ring) node must
+    # serve the whole run from tiers + disk with exact counts.
+    ChaosArm("timeline-crash-revive", "server.crash", "",
+             "conserved", {"op": "timeline-crash"}, kind="crash"),
 ]
 
 # frozen-peer arm (ISSUE 14): the `server.sigstop_window` failpoint
@@ -696,6 +706,10 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
                      mirrored deltas must be indistinguishable from
                      never-streamed ones — conservation EXACT."""
     op = arm.kwargs["op"]
+    if op == "timeline-crash":
+        return _run_timeline_crash_arm(arm, seed=seed,
+                                       witness=witness,
+                                       telemetry=telemetry)
     direct = op not in ("local-crash", "resident-crash")
     resident = op == "resident-crash"
     # the local-crash cell additionally carries one compactor-family
@@ -893,6 +907,183 @@ def _run_crash_arm(arm: ChaosArm, *, seed: int = 0, n_locals: int = 1,
         else:
             _apply_trace_gate(row, trace_spans,
                               require_proxy=not direct)
+    return row
+
+
+def _timeline_point_mass(ret, prefix: str = "tb.") -> float:
+    """The retention timeline's retained sample mass for metrics
+    under ``prefix``, counted ONCE per datum: the coarsest tier holds
+    everything that cascaded up, each finer tier's OPEN bucket holds
+    what has not cascaded yet (its closed buckets already merged
+    upward), and the spill store holds what the coarsest tier
+    evicted.  The prefix filter matters: the server's own internal
+    histograms ride the same timeline, so an unfiltered count would
+    not reconcile against the traffic oracle — and for the same
+    reason the disk side decodes bucket bodies rather than trusting
+    the store's all-names ``pending_points`` gauge."""
+    from veneur_tpu.retention.timeline import decode_bucket_body
+
+    def bpts(b) -> float:
+        pts = sum(e["count"] for k, e in b.td.items()
+                  if k[0].startswith(prefix))
+        pts += sum(float(v[0]) for k, v in b.mo.items()
+                   if k[0].startswith(prefix))
+        pts += sum(float(v[0]) for k, v in b.cc.items()
+                   if k[0].startswith(prefix))
+        return pts
+
+    ret.drain()     # fence cuts still queued on the compaction worker
+    with ret.lock:
+        coarse = ret.tiers[-1]
+        mem = sum(bpts(b) for b in coarse.buckets)
+        if coarse.open is not None:
+            mem += bpts(coarse.open)
+        for t in ret.tiers[:-1]:
+            if t.open is not None:
+                mem += bpts(t.open)
+    disk = 0.0
+    if ret.store is not None:
+        for rec in ret.store.records_overlapping(0.0, 1e18):
+            disk += bpts(decode_bucket_body(ret.store.read_body(rec)))
+    return float(mem + disk)
+
+
+def _run_timeline_crash_arm(arm: ChaosArm, *, seed: int = 0,
+                            histo_keys: int = 2,
+                            histo_samples: int = 40, witness=None,
+                            telemetry=None) -> dict:
+    """The timeline-crash-revive cell: direct durable 1x1 fleet with a
+    two-tier retention ladder (0.2s x2 -> 0.4s x1) and a spill dir.
+    Intervals run until the coarsest tier evicts at least one bucket
+    to disk; a forced checkpoint then cuts the in-memory tiers, the
+    local dies with NO drain and revives.  Gates: the re-indexed store
+    recovers every spilled point, total retained mass (memory + disk)
+    equals the oracle exactly before AND after the kill, and a
+    ?since=&step= range query on the revived node — whose window ring
+    is cold by the documented contract — answers the WHOLE run from
+    tiers + disk with exact per-name counts."""
+    import math
+    import time
+
+    tiers = ({"seconds": 0.2, "buckets": 2},
+             {"seconds": 0.4, "buckets": 1})
+    coarse_s = tiers[-1]["seconds"]
+    spec = ClusterSpec(
+        n_locals=1, n_globals=1, direct=True, durable=True,
+        query_api=True,
+        retention_tiers=tiers,
+        forward_max_retries=2, forward_retry_backoff=0.02,
+        spool_replay_interval_s=0.05,
+        lock_witness=witness, telemetry=telemetry)
+    traffic = TrafficGen(seed=seed, counter_keys=2,
+                         histo_keys=histo_keys, set_keys=0,
+                         histo_samples=histo_samples)
+    cluster = Cluster(spec)
+    per_interval: list[list[list]] = []
+    extra: dict = {}
+    fired = 0
+    try:
+        cluster.start()
+        srv = cluster.locals[0].server
+        ret = srv.aggregator.retention
+        t_begin = time.time()
+        # drive cuts until the coarsest tier spills (bounded: the
+        # ladder spans ~1.2s of cut time before the first eviction)
+        spilled = 0
+        for _ in range(40):
+            per_interval.append(cluster.run_interval(
+                traffic.next_interval(1)))
+            ret.drain()     # the cut rides the compaction worker
+            spilled = ret.store.stats()["spilled_buckets"]
+            if spilled >= 1:
+                break
+            time.sleep(0.02)
+        want_pts = float(sum(
+            len(v) for v in traffic.oracle.histos.values()))
+        pre_pts = _timeline_point_mass(ret)
+        pre_store = ret.store.stats()
+        # the cut: in-memory tiers ride the arena checkpoint; the
+        # crash then drops every in-memory structure
+        assert cluster.checkpoint_local(0)
+        cluster.crash_local(0)
+        cluster.revive_local(0)
+        srv2 = cluster.locals[0].server
+        fired = srv2.checkpoint_stats["restores"]
+        ret2 = srv2.aggregator.retention
+        post_pts = _timeline_point_mass(ret2)
+        post_store = ret2.store.stats()
+        # the revived store re-indexed every durable segment: what the
+        # dead instance spilled is exactly what the new one recovered,
+        # and the fresh ledger closes (spilled + recovered == expired
+        # + dropped + pending)
+        extra["spilled_buckets"] = int(pre_store["spilled_buckets"])
+        extra["recovered_buckets"] = int(
+            post_store["recovered_buckets"])
+        extra["recovered_points_exact"] = (
+            post_store["recovered_points"]
+            == pre_store["spilled_points"])
+        extra["store_closure"] = (
+            post_store["spilled_points"]
+            + post_store["recovered_points"]
+            == post_store["expired_points"]
+            + post_store["dropped_points"]
+            + post_store["pending_points"])
+        extra["timeline_points"] = (pre_pts, post_pts, want_pts)
+        conserved_pts = pre_pts == want_pts and post_pts == want_pts
+        # range query on the revived node: the ring is cold (NOT
+        # checkpointed), so every grid-aligned bin answers from the
+        # restored tiers and the re-indexed disk segments
+        since = math.floor(t_begin / coarse_s) * coarse_s
+        addr = cluster.locals[0].http_addr
+        range_exact = True
+        disk_served = False
+        range_bins = 0
+        for k in range(histo_keys):
+            name = f"tb.h{k}"
+            # the FIRST post-revive range probe can compile the fused
+            # serving kernel; on a loaded box that can blow past the
+            # client timeout (the server then logs a BrokenPipe on
+            # reply).  Retry the probe — the gate is on the answer's
+            # exactness, not on first-fetch latency.
+            resp = None
+            for attempt in range(3):
+                try:
+                    resp = cluster.query_http(
+                        addr, name=name, q="0.5", since=repr(since),
+                        step=repr(coarse_s), type="histogram")
+                    break
+                except OSError:
+                    if attempt == 2:
+                        raise
+                    time.sleep(0.2)
+            got = sum(b["count"] for b in resp["series"])
+            want = float(sum(
+                len(v) for (_, nm), v in traffic.oracle.histos.items()
+                if nm == name))
+            if got != want:
+                range_exact = False
+            range_bins = max(range_bins, resp["bins"])
+            if any(str(s).endswith(":disk")
+                   for s in resp.get("sources", ())):
+                disk_served = True
+        extra["range_counts_exact"] = range_exact
+        extra["range_disk_served"] = disk_served
+        extra["range_bins"] = range_bins
+        acct = cluster.accounting()
+    finally:
+        cluster.stop()
+
+    counters = verify.check_counters(traffic.oracle, per_interval)
+    routing = verify.check_routing(per_interval, per_epoch=True)
+    row = _crash_row(arm, acct, counters, routing, fired)
+    row.update(extra)
+    row["ok"] = (fired >= 1 and row["conserved"]
+                 and row["routing_exclusive"]
+                 and extra["spilled_buckets"] >= 1
+                 and extra["recovered_points_exact"]
+                 and extra["store_closure"]
+                 and conserved_pts
+                 and range_exact and disk_served)
     return row
 
 

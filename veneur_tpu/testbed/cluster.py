@@ -156,6 +156,13 @@ class ClusterSpec:
     # the crash arm shrinks it so testbed-sized traffic actually
     # streams full chunks before the kill lands
     flush_delta_chunk_keys: int = 0
+    # multi-resolution retention (veneur_tpu/retention/): finest-first
+    # tier specs applied on EVERY tier; () = off.  Durable clusters
+    # additionally give each node a retention spill dir so coarse-tier
+    # buckets evicted to disk survive kill -9 (the
+    # timeline-crash-revive arm)
+    retention_tiers: tuple = ()
+    retention_max_bytes: int = 8 << 20
 
 
 @dataclass
@@ -228,6 +235,16 @@ class Cluster:
         os.makedirs(spool, exist_ok=True)
         return ckpt, spool
 
+    def _retention_dir(self, name: str) -> str:
+        """Stable per-node retention spill dir (durable clusters with
+        retention tiers only), so a revival re-indexes the crashed
+        instance's on-disk tier segments."""
+        if not self._durable_root or not self.spec.retention_tiers:
+            return ""
+        d = os.path.join(self._durable_root, name, "retention")
+        os.makedirs(d, exist_ok=True)
+        return d
+
     def _boot_global(self, port: int = 0,
                      hostname: str = "") -> _Node:
         spec = self.spec
@@ -255,6 +272,9 @@ class Cluster:
             checkpoint_dir=ckpt_dir,
             checkpoint_interval=spec.checkpoint_interval_s,
             query_window_slots=spec.query_window_slots,
+            retention_tiers=[dict(t) for t in spec.retention_tiers],
+            retention_dir=self._retention_dir(hostname),
+            retention_max_bytes=spec.retention_max_bytes,
             flush_resident_arenas=spec.flush_resident_arenas,
             flush_resident_device_assembly=(
                 spec.flush_resident_device_assembly),
@@ -305,6 +325,9 @@ class Cluster:
             spool_max_bytes=spec.spool_max_bytes,
             spool_replay_interval=spec.spool_replay_interval_s,
             query_window_slots=spec.query_window_slots,
+            retention_tiers=[dict(t) for t in spec.retention_tiers],
+            retention_dir=self._retention_dir(hostname),
+            retention_max_bytes=spec.retention_max_bytes,
             flush_resident_arenas=spec.flush_resident_arenas,
             flush_resident_device_assembly=(
                 spec.flush_resident_device_assembly),

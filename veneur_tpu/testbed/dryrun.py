@@ -28,13 +28,20 @@ PROMISED_KEYS = [
     "cardinality", "reshard_moved", "conservation", "quantile_errors",
     "routing_exclusive", "chaos_matrix", "lock_witness", "telemetry",
     "trace", "spool", "checkpoint", "egress", "sketch_families",
-    "query", "cube", "ok",
+    "query", "cube", "retention", "ok",
 ]
 
 # windowed probes fuse up to this many newest slots per query (each
 # interval's probes use min(intervals seen, this) so partial-history
 # intervals still probe)
 _QUERY_PROBE_SLOTS = 2
+
+# retention=True hangs this tier ladder behind every local's arena:
+# sub-second buckets so flush cuts cascade (and, given enough
+# intervals of wallclock, the coarsest tier evicts and spills) within
+# the dryrun's lifetime
+_RETENTION_TIERS = ({"seconds": 0.2, "buckets": 2},
+                    {"seconds": 0.4, "buckets": 1})
 
 
 def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
@@ -52,6 +59,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                telemetry: bool = False,
                query: bool = False,
                cubes: bool = False,
+               retention: bool = False,
                procs: bool = False) -> dict:
     """Run the 3-tier dryrun; `chaos` is None, an arm name, or "all".
     With `lock_witness`, every tier's named locks record runtime
@@ -96,6 +104,17 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     `veneur.cube.other` — never silent), and the report's `cube` key
     carries groups/rollup_points/overflowed/query_p50_ms and gates ok.
 
+    With `retention=True` (the multi-resolution retention cell, ISSUE
+    20): every local's histogram arena grows the tiered timeline
+    (sub-second ladder so cascades — and with enough intervals, the
+    coarsest tier's disk spill — happen inside the run), the cluster
+    runs durable so evicted coarse buckets land in the CRC-framed
+    tier-segment store, and after each interval the run times a
+    `?since=&step=` range query per histogram on a local's /query
+    surface.  The report's `retention` key carries per-tier bucket
+    counts, the spill/expiry ledger (gated closed), on-disk footprint,
+    and range-query p50/p99 latency, and gates ok.
+
     With `procs=True` the SAME story runs against the
     process-separated cluster (testbed/proccluster.py): every tier is
     its own OS process (globals meshed over real multi-process gloo
@@ -112,6 +131,11 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             raise ValueError(
                 "the cube analytics arm runs in-process (check.py's "
                 "--cubes cell); drop --procs or drop --cubes")
+        if retention:
+            raise ValueError(
+                "the retention timeline cell runs in-process "
+                "(check.py's --retention cell); drop --procs or drop "
+                "--retention")
         if compactor_histo_keys:
             raise ValueError(
                 "the compactor family is covered by the in-process "
@@ -163,7 +187,10 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                        cube_seed=seed + 1,
                        lock_witness=witness,
                        telemetry=telemetry_witness,
-                       query_api=query or cubes)
+                       durable=retention,
+                       retention_tiers=(_RETENTION_TIERS
+                                        if retention else ()),
+                       query_api=query or cubes or retention)
     traffic = TrafficGen(seed=seed, counter_keys=counter_keys,
                          histo_keys=histo_keys, set_keys=set_keys,
                          histo_samples=histo_samples,
@@ -174,6 +201,9 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
     per_interval_locals: list[list[list]] = []
     qstate = {"rows": [], "lat_ms": [], "errors": 0}
     cstate = {"rows": [], "lat_ms": [], "errors": 0}
+    rstate = {"rows": [], "lat_ms": [], "errors": 0}
+    import time as _time
+    t_begin = _time.time()
     try:
         cluster.start()
         for _ in range(intervals):
@@ -198,12 +228,17 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                              len(per_interval), list(percentiles),
                              cstate,
                              final=len(per_interval) == intervals)
+            if retention:
+                _retention_probes(cluster, traffic, histo_keys,
+                                  t_begin, rstate)
         acct = cluster.accounting()
         trace_spans = cluster.collect_trace_spans()
         timeline_rows = [r for n in cluster.locals
                          for r in n.server.flush_timeline.snapshot()]
         cube_snaps = ([n.server.aggregator.cubes.snapshot()
                        for n in cluster.locals] if cubes else [])
+        ret_stats = ([n.server.aggregator.retention.stats()
+                      for n in cluster.locals] if retention else [])
     finally:
         cluster.stop()
 
@@ -307,6 +342,54 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
                    and sum(s["overflowed"] for s in cube_snaps) > 0),
         }
 
+    retention_report = None
+    if retention:
+        rlat = sorted(rstate["lat_ms"])
+
+        def rpct(p: float) -> float | None:
+            if not rlat:
+                return None
+            return round(rlat[min(len(rlat) - 1,
+                                  int(p * (len(rlat) - 1) + 0.5))], 3)
+
+        def rsum(key: str) -> int:
+            return int(sum(s[key] for s in ret_stats))
+
+        # ledger closure over the locals' spill stores: every bucket
+        # that ever left memory is spilled, and every spilled bucket is
+        # recovered, expired, visibly dropped, or still on disk
+        ledger_closed = all(
+            s["spilled_buckets"] + s["recovered_buckets"]
+            == (s["expired_buckets"] + s["dropped_buckets"]
+                + s["pending_buckets"] + s["recovered_buckets"])
+            for s in ret_stats)
+        retention_report = {
+            "buckets": rsum("buckets"),
+            "compactions": rsum("compactions"),
+            "tiers": [{name: {"buckets": t["buckets"] + t["open"],
+                              "evicted": t["evicted"]}
+                       for name, t in s["tiers"].items()}
+                      for s in ret_stats],
+            "spilled": rsum("spilled_buckets"),
+            "expired": rsum("expired_buckets"),
+            "dropped": rsum("dropped_buckets"),
+            "on_disk_bytes": rsum("on_disk_bytes"),
+            "footprint_bytes": rsum("footprint_bytes"),
+            "query_p50_ms": rpct(0.5),
+            "query_p99_ms": rpct(0.99),
+            "served": len(rstate["rows"]),
+            "errors": rstate["errors"],
+            "ledger_closed": ledger_closed,
+            "failed": [r for r in rstate["rows"]
+                       if not r.get("ok")][:8],
+            "ok": (bool(rstate["rows"]) and rstate["errors"] == 0
+                   and all(r.get("ok") for r in rstate["rows"])
+                   and rsum("compactions") > 0
+                   and rsum("buckets") >= 1
+                   and rsum("dropped_buckets") == 0
+                   and ledger_closed),
+        }
+
     witness_cmp = None
     if witness is not None:
         from veneur_tpu.testbed.chaos import witness_comparison
@@ -327,7 +410,8 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
           and (witness_cmp is None or witness_cmp["ok"])
           and (telemetry_cmp is None or telemetry_cmp["ok"])
           and (query_report is None or query_report["ok"])
-          and (cube_report is None or cube_report["ok"]))
+          and (cube_report is None or cube_report["ok"])
+          and (retention_report is None or retention_report["ok"]))
     return {
         "spec": {
             "n_locals": n_locals, "n_globals": n_globals,
@@ -340,6 +424,7 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
             "moments_histo_keys": moments_histo_keys,
             "compactor_histo_keys": compactor_histo_keys,
             "cubes": cubes,
+            "retention": retention,
         },
         "per_tier": {
             "local_flushes": acct["local_flushes"],
@@ -421,6 +506,12 @@ def run_dryrun(n_locals: int = 1, n_globals: int = 1, intervals: int = 2,
         # timed proxy scatter-gather group-by latency.  None when not
         # requested
         "cube": cube_report,
+        # multi-resolution retention cell (retention=True): tiered
+        # bucket counts, the spill/expiry ledger (gated closed), the
+        # on-disk/in-memory footprint, and the timed `?since=&step=`
+        # range-query latency across the locals.  None when not
+        # requested
+        "retention": retention_report,
         "ok": ok,
     }
 
@@ -482,6 +573,54 @@ def _cube_probes(cluster, cube_gens, k: int, percentiles: list,
                    and all(kk in gen.group_counts for kk in got)
                    and tresp.get("groups_total")
                    == len(gen.group_counts)),
+        })
+
+
+def _retention_probes(cluster, traffic, histo_keys: int,
+                      t_begin: float, rstate: dict) -> None:
+    """One interval's `?since=&step=` range probes against the LOCAL
+    tier (the retention timeline hangs behind the local arenas).  Step
+    = the coarsest tier's bucket width, since = the run's start: every
+    answered bin must name its source and the per-name mass must cover
+    the oracle's (ring slots straddling bin edges may overcount a bin,
+    never undercount — the cascade keeps every datum resident in the
+    coarsest tier or its disk spill)."""
+    import time
+
+    from veneur_tpu.testbed.traffic import PREFIX
+    step = _RETENTION_TIERS[-1]["seconds"]
+    # fence the compaction worker so the probe sees this interval's cut
+    for node in cluster.locals:
+        node.server.aggregator.retention.drain()
+    addr = cluster.locals[0].http_addr
+    for i in range(histo_keys):
+        name = f"{PREFIX}h{i}"
+        t0 = time.perf_counter()
+        try:
+            resp = cluster.query_http(addr, name=name, q="0.5,0.99",
+                                      since=repr(t_begin),
+                                      step=repr(step),
+                                      type="histogram")
+        except Exception as e:  # noqa: BLE001 - counted, run continues
+            rstate["errors"] += 1
+            rstate["rows"].append({"name": name, "ok": False,
+                                   "error": f"{type(e).__name__}: "
+                                            f"{e}"})
+            continue
+        rstate["lat_ms"].append((time.perf_counter() - t0) * 1e3)
+        want = float(sum(
+            len(v) for (_iv, n), v in traffic.oracle.histos.items()
+            if n == name))
+        series = resp.get("series") or []
+        got = float(sum(b.get("count") or 0.0 for b in series))
+        srcs = [b.get("source") for b in series if b.get("source")]
+        rstate["rows"].append({
+            "name": name, "tier": "local",
+            "bins": resp.get("bins"),
+            "count": got, "want": want,
+            "sources": sorted(set(srcs)),
+            "ok": (bool(resp.get("range")) and bool(series)
+                   and bool(srcs) and got + 1e-6 >= want),
         })
 
 
@@ -727,5 +866,6 @@ def _run_proc_dryrun(*, n_locals: int, n_globals: int, intervals: int,
         "trace": trace_report,
         "query": None,
         "cube": None,
+        "retention": None,
         "ok": ok,
     }
